@@ -1,0 +1,48 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid 0.15.0 (see SURVEY.md for the full capability map).
+
+Public API mirrors the reference's ``paddle.fluid`` surface: Program/Block
+graph building, layers DSL, program-level autodiff, optimizers, Executor,
+ParallelExecutor (mesh runtime), io save/load, Trainer.  The implementation
+is JAX/XLA/Pallas/pjit from the ground up.
+"""
+
+from . import core, unique_name
+from .framework import (
+    Program,
+    Block,
+    Operator,
+    Variable,
+    Parameter,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+    name_scope,
+)
+from . import ops  # registers the op library
+from . import layers
+from . import initializer
+from . import regularizer
+from . import clip
+from . import optimizer
+from . import metrics
+from . import nets
+from .backward import append_backward, calc_gradient
+from .executor import Executor, CPUPlace, TPUPlace, CUDAPlace
+from .scope import Scope, global_scope, scope_guard
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .data_feeder import DataFeeder
+from . import io
+from . import profiler
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Program", "Block", "Operator", "Variable", "Parameter",
+    "default_main_program", "default_startup_program", "program_guard",
+    "name_scope", "layers", "initializer", "regularizer", "clip",
+    "optimizer", "metrics", "nets", "append_backward", "calc_gradient",
+    "Executor", "CPUPlace", "TPUPlace", "CUDAPlace", "Scope",
+    "global_scope", "scope_guard", "ParamAttr", "WeightNormParamAttr",
+    "DataFeeder", "io", "profiler",
+]

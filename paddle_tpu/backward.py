@@ -1,0 +1,213 @@
+"""Program-level autodiff: append gradient ops to a Program.
+
+Capability parity with the reference's ``python/paddle/fluid/backward.py``
+(``append_backward:469``, duplicate-grad summation ``_addup_repetitive_
+outputs_:135``, no-grad pruning ``_remove_no_grad_branch_:204``) —
+TPU-native: per-op grad ops come from the registry's grad makers (most are
+the generic vjp-backed ``<type>_grad``; see ``registry.py``), so the grad
+section of the program is still ordinary ops that lower into the same jitted
+HLO module as the forward.  Gradients remain first-class program variables
+(``w@GRAD``) so clipping, regularizers, and the distributed rewrites can
+operate on them exactly like the reference does.
+"""
+
+from .framework import Parameter, Variable, grad_var_name
+from .registry import make_grad_ops
+
+__all__ = ["append_backward", "calc_gradient"]
+
+
+def _collect_no_grad_set(block, extra=None):
+    s = set(extra or ())
+    for v in block.vars.values():
+        if v.stop_gradient:
+            s.add(v.name)
+    return s
+
+
+def _ops_on_path_to(block, target_names):
+    """Indices of ops whose outputs (transitively) feed ``target_names``."""
+    needed = set(target_names)
+    keep = []
+    for i in reversed(range(len(block.ops))):
+        op = block.ops[i]
+        if set(op.output_arg_names) & needed:
+            keep.append(i)
+            needed.update(n for n in op.input_arg_names if n)
+    return set(keep)
+
+
+class _GradAccumulator:
+    """Tracks pending gradient contributions per forward var and
+    materializes ``sum`` ops on demand (the reference's
+    _addup_repetitive_outputs_ redesigned as lazy accumulation)."""
+
+    def __init__(self, block):
+        self.block = block
+        self.pending = {}  # fwd var name -> [grad var names]
+
+    def new_contribution_name(self, fwd_name):
+        cs = self.pending.setdefault(fwd_name, [])
+        if not cs:
+            name = grad_var_name(fwd_name)
+        else:
+            name = grad_var_name(fwd_name) + "@RENAME@%d" % len(cs)
+        cs.append(name)
+        return name
+
+    def has_grad(self, fwd_name):
+        return bool(self.pending.get(fwd_name))
+
+    def materialize(self, fwd_name):
+        """Ensure grad_var_name(fwd_name) holds the summed gradient;
+        returns the name or None if no grad flows."""
+        cs = self.pending.get(fwd_name)
+        if not cs:
+            return None
+        target = grad_var_name(fwd_name)
+        if len(cs) == 1:
+            if cs[0] != target:
+                # single renamed contribution: alias via assign
+                self.block.append_op(
+                    type="assign", inputs={"X": [cs[0]]}, outputs={"Out": [target]}
+                )
+            self.pending[fwd_name] = [target]
+            return target
+        self.block.append_op(
+            type="sum", inputs={"X": list(cs)}, outputs={"Out": [target]}
+        )
+        self.pending[fwd_name] = [target]
+        return target
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    loss_grad_input=None):
+    """Append gradient ops for ``loss`` to its program; returns
+    [(Parameter, grad Variable)] for the optimizer (reference
+    backward.py:469).  ``loss_grad_input`` optionally seeds the cotangent
+    with an existing Variable instead of ones (calc_gradient's
+    target_gradients)."""
+    assert isinstance(loss, Variable), "loss must be a Variable"
+    block = loss.block
+    program = block.program
+    no_grad = _collect_no_grad_set(block, no_grad_set)
+
+    # seed d(loss)/d(loss)
+    loss_grad = grad_var_name(loss.name)
+    if loss_grad_input is not None:
+        block.append_op(
+            type="assign",
+            inputs={"X": [loss_grad_input]},
+            outputs={"Out": [loss_grad]},
+        )
+    else:
+        block.append_op(
+            type="fill_constant",
+            inputs={},
+            outputs={"Out": [loss_grad]},
+            attrs={
+                "shape": list(loss.shape or ()),
+                "value": 1.0,
+                "dtype": str(loss.dtype),
+                "force_cpu": False,
+            },
+        )
+
+    acc = _GradAccumulator(block)
+    acc.pending[loss.name] = [loss_grad]
+
+    path = _ops_on_path_to(block, [loss.name])
+    # exclude the fill op we just appended
+    n_forward = len(block.ops) - 1
+
+    for i in reversed(range(n_forward)):
+        if i not in path:
+            continue
+        op = block.ops[i]
+        # does any output have a live gradient?
+        live = [n for n in op.output_arg_names if acc.has_grad(n)]
+        if not live:
+            continue
+        specs = make_grad_ops(op, no_grad)
+        for spec in specs:
+            # wire out-grad inputs: materialize sums / leave holes
+            for slot, names in list(spec["inputs"].items()):
+                if not slot.startswith("GRAD::"):
+                    continue
+                wired = []
+                for n in names:
+                    fwd = n[: -len("@GRAD")] if n.endswith("@GRAD") else n
+                    g = acc.materialize(fwd)
+                    wired.append(g or "")
+                spec["inputs"][slot] = wired
+            # rename duplicate grad outputs into fresh contribution names
+            for slot, names in list(spec["outputs"].items()):
+                renamed = []
+                for n in names:
+                    if not n:
+                        renamed.append("")
+                        continue
+                    fwd = n[: -len("@GRAD")]
+                    if fwd in no_grad:
+                        renamed.append("")
+                        continue
+                    renamed.append(acc.new_contribution_name(fwd))
+                spec["outputs"][slot] = renamed
+            if not any(n for ns in spec["outputs"].values() for n in ns):
+                continue
+            block.append_op(
+                type=spec["type"],
+                inputs=spec["inputs"],
+                outputs=spec["outputs"],
+                attrs=spec["attrs"],
+            )
+
+    # materialize every accumulated gradient so var@GRAD is always the
+    # summed value (fetchable, optimizer-consumable)
+    for fwd_name in list(acc.pending.keys()):
+        acc.materialize(fwd_name)
+
+    # finalize parameter gradients
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            params.append(block.var_recursive(p) if isinstance(p, str) else p)
+    else:
+        params = [
+            p for p in program.global_block().all_parameters() if p.trainable
+        ]
+
+    params_and_grads = []
+    for p in params:
+        g = acc.materialize(p.name)
+        if g is None:
+            continue
+        params_and_grads.append((p, block.var_recursive(g)))
+    return params_and_grads
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Gradients of ``targets`` w.r.t. ``inputs`` (reference
+    backward.py:calc_gradient).  Returns list of grad Variables (or None)."""
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    assert len(targets) == 1, "calc_gradient currently supports one target"
+    loss = targets[0]
+    block = loss.block
+    if target_gradients is not None:
+        if isinstance(target_gradients, Variable):
+            target_gradients = [target_gradients]
+        loss_grad_input = target_gradients[0]
+    else:
+        loss_grad_input = None
+    # reuse append_backward machinery but finalize for `inputs`
+    pg = append_backward(loss, parameter_list=None, no_grad_set=no_grad_set,
+                         loss_grad_input=loss_grad_input)
+    del pg
+    result = []
+    for v in inputs:
+        g = grad_var_name(v.name)
+        result.append(block.vars.get(g))
+    return result

@@ -1,0 +1,212 @@
+"""Gradient / error clipping.
+
+Parity: reference ``python/paddle/fluid/clip.py`` (359 LoC):
+``ErrorClipByValue``, ``GradientClipByValue``, ``GradientClipByNorm``,
+``GradientClipByGlobalNorm`` — clip ops appended between backward and
+optimizer ops, attached per-param via ParamAttr.gradient_clip or globally
+via ``set_gradient_clip``.
+"""
+
+from .framework import default_main_program
+from .layer_helper import LayerHelper
+
+__all__ = [
+    "ErrorClipByValue",
+    "GradientClipByValue",
+    "GradientClipByNorm",
+    "GradientClipByGlobalNorm",
+    "set_gradient_clip",
+    "append_gradient_clip_ops",
+]
+
+
+class BaseErrorClipAttr:
+    def _append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    """Clip an activation's backward error signal (reference clip.py:
+    ErrorClipByValue)."""
+
+    def __init__(self, max, min=None):
+        if min is None:
+            min = -max
+        self.max = float(max)
+        self.min = float(min)
+
+    def _append_clip_op(self, block, grad_name):
+        block.append_op(
+            type="clip", inputs={"X": [grad_name]},
+            outputs={"Out": [grad_name]},
+            attrs={"min": self.min, "max": self.max},
+        )
+
+
+def error_clip_callback(block, op):
+    for grad_n in op.output_arg_names:
+        if not grad_n.endswith("@GRAD"):
+            continue
+        fwd_var = block._find_var_recursive(grad_n[: -len("@GRAD")])
+        if fwd_var is None:
+            continue
+        error_clip = getattr(fwd_var, "error_clip", None)
+        if error_clip is not None:
+            error_clip._append_clip_op(block, grad_n)
+
+
+class BaseGradientClipAttr:
+    def _process_context(self, context, param, grad):
+        pass
+
+    def _create_operators(self, param, grad):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _create_operators(self, param, grad):
+        return param, grad
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        if min is None:
+            min = -max
+        self.max = float(max)
+        self.min = float(min)
+
+    def _create_operators(self, param, grad):
+        helper = LayerHelper("clip_grad")
+        new_grad = helper.create_variable_for_type_inference(dtype=grad.dtype)
+        grad.block.append_op(
+            type="clip", inputs={"X": [grad]}, outputs={"Out": [new_grad]},
+            attrs={"min": self.min, "max": self.max},
+        )
+        return param, new_grad
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _create_operators(self, param, grad):
+        helper = LayerHelper("clip_grad_norm")
+        new_grad = helper.create_variable_for_type_inference(dtype=grad.dtype)
+        grad.block.append_op(
+            type="clip_by_norm", inputs={"X": [grad]},
+            outputs={"Out": [new_grad]},
+            attrs={"max_norm": self.clip_norm},
+        )
+        return param, new_grad
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """Scale all grads by clip_norm/max(global_norm, clip_norm)
+    (reference clip.py:GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _process_context(self, context, param, grad):
+        if self.group_name not in context:
+            context[self.group_name] = []
+            context[self.group_name + "_clip_value"] = self.clip_norm
+        elif context[self.group_name + "_clip_value"] != self.clip_norm:
+            raise ValueError(
+                "all parameters in a group should share one clip_norm")
+        helper = LayerHelper("global_norm_part")
+        sq = helper.create_variable_for_type_inference(dtype=grad.dtype)
+        grad.block.append_op(
+            type="squared_l2_norm", inputs={"X": [grad]},
+            outputs={"Out": [sq]},
+        )
+        context[self.group_name].append(sq)
+        context[self.group_name + "_scale_computed"] = None
+
+    def _create_operators(self, param, grad):
+        # the scale var is computed once per group lazily
+        raise NotImplementedError(
+            "handled by append_gradient_clip_ops group logic")
+
+
+_gradient_clip_attr = [None]
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Set a global/default gradient clip (reference clip.py:
+    set_gradient_clip)."""
+    if param_list is not None:
+        program = program or default_main_program()
+        for p in param_list:
+            if isinstance(p, str):
+                p = program.global_block().var(p)
+            p.gradient_clip_attr = clip
+    else:
+        _gradient_clip_attr[0] = clip
+
+
+def append_gradient_clip_ops(param_grads):
+    """Apply per-param / global clip attrs to gradients (reference
+    clip.py:append_gradient_clip_ops)."""
+    context = {}
+    clips = []
+    for p, g in param_grads:
+        if g is None:
+            clips.append((p, g, None))
+            continue
+        clip_attr = getattr(p, "gradient_clip_attr", None) or \
+            _gradient_clip_attr[0] or NullGradientClipAttr()
+        clip_attr._process_context(context, p, g)
+        clips.append((p, g, clip_attr))
+
+    # resolve global-norm groups: compute scale per group
+    group_scales = {}
+    for group_name, sq_list in list(context.items()):
+        if not isinstance(sq_list, list):
+            continue
+        clip_value = context[group_name + "_clip_value"]
+        helper = LayerHelper("global_norm")
+        block = sq_list[0].block
+        total = helper.create_variable_for_type_inference(dtype=sq_list[0].dtype)
+        block.append_op(type="sum", inputs={"X": sq_list},
+                        outputs={"Out": [total]})
+        norm = helper.create_variable_for_type_inference(dtype=total.dtype)
+        block.append_op(type="sqrt", inputs={"X": [total]},
+                        outputs={"Out": [norm]})
+        # scale = clip / max(norm, clip)
+        maxed = helper.create_variable_for_type_inference(dtype=total.dtype)
+        clip_var = helper.create_variable_for_type_inference(dtype=total.dtype)
+        block.append_op(
+            type="fill_constant", outputs={"Out": [clip_var]},
+            attrs={"shape": [1], "value": clip_value,
+                   "dtype": str(total.dtype)},
+        )
+        block.append_op(
+            type="elementwise_max", inputs={"X": [norm], "Y": [clip_var]},
+            outputs={"Out": [maxed]},
+        )
+        scale = helper.create_variable_for_type_inference(dtype=total.dtype)
+        block.append_op(
+            type="elementwise_div", inputs={"X": [clip_var], "Y": [maxed]},
+            outputs={"Out": [scale]},
+        )
+        group_scales[group_name] = scale
+
+    result = []
+    for p, g, clip_attr in clips:
+        if g is None:
+            result.append((p, g))
+            continue
+        if isinstance(clip_attr, GradientClipByGlobalNorm):
+            scale = group_scales[clip_attr.group_name]
+            helper = LayerHelper("global_clip_grad")
+            new_grad = helper.create_variable_for_type_inference(dtype=g.dtype)
+            g.block.append_op(
+                type="elementwise_mul", inputs={"X": [g], "Y": [scale]},
+                outputs={"Out": [new_grad]},
+            )
+            result.append((p, new_grad))
+        else:
+            result.append(clip_attr._create_operators(p, g))
+    return result

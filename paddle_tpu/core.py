@@ -1,0 +1,67 @@
+"""Core scalar/dtype definitions shared by the whole framework.
+
+Plays the role of the reference's ``paddle/fluid/framework/framework.proto``
+VarType/data-type enums (framework.proto:104) plus ``platform/float16.h`` —
+but TPU-native: dtypes are numpy/jax dtypes, bfloat16 is first-class (the MXU
+native format), and there is no protobuf in the hot path (programs serialize
+to a plain-dict format in ``framework.py``).
+"""
+
+import numpy as np
+
+try:  # jax's bfloat16 comes from ml_dtypes
+    import ml_dtypes
+
+    bfloat16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+
+
+class VarType:
+    """Variable kinds, mirroring the capability of VarDesc.VarType
+    (reference framework.proto:104): dense tensors, parameter-like
+    persistables, readers and step scopes are represented; LoD is replaced by
+    packed segment metadata carried in ``Variable.lod_level`` plus explicit
+    segment-id companions (see SURVEY.md §5 long-context notes)."""
+
+    DENSE_TENSOR = "dense_tensor"
+    SELECTED_ROWS = "selected_rows"  # sparse row-slice gradients
+    READER = "reader"
+    STEP_SCOPES = "step_scopes"
+    RAW = "raw"
+
+
+_DTYPE_ALIASES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "int16": np.int16,
+    "int8": np.int8,
+    "uint8": np.uint8,
+    "bool": np.bool_,
+}
+if bfloat16 is not None:
+    _DTYPE_ALIASES["bfloat16"] = bfloat16
+
+
+def convert_dtype(dtype):
+    """Normalize user-provided dtype (str / np.dtype / jax dtype) to np.dtype."""
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_ALIASES:
+            raise ValueError("unsupported dtype string: %r" % dtype)
+        return np.dtype(_DTYPE_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_is_floating(dtype):
+    d = convert_dtype(dtype)
+    if bfloat16 is not None and d == bfloat16:
+        return True
+    return np.issubdtype(d, np.floating)
+
+
+def dtype_is_integer(dtype):
+    d = convert_dtype(dtype)
+    return np.issubdtype(d, np.integer) or d == np.bool_

@@ -1,0 +1,83 @@
+"""DataFeeder: convert python/numpy minibatches into executor feed dicts.
+
+Parity: reference ``python/paddle/fluid/data_feeder.py:83`` (DataFeeder:
+converts reader rows into LoDTensors per place; feed_parallel splits across
+devices) — TPU-native: produces numpy arrays (the executor moves them to
+device); ragged sequence rows are packed/padded via the sequence utilities
+instead of LoD.
+"""
+
+import numpy as np
+
+from .core import convert_dtype
+from .framework import Variable, default_main_program
+
+__all__ = ["DataFeeder"]
+
+
+class _Converter:
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.rows = []
+
+    def feed(self, item):
+        self.rows.append(np.asarray(item, dtype=self.dtype))
+
+    def done(self):
+        arr = np.stack(self.rows) if self.rows else np.zeros((0,), self.dtype)
+        if self.shape is not None and -1 not in self.shape[1:]:
+            want = tuple(s for s in self.shape if s != -1)
+            if arr.size and arr.shape[1:] != want[-len(arr.shape[1:]):]:
+                try:
+                    arr = arr.reshape((arr.shape[0],) + tuple(
+                        s for s in self.shape[1:]))
+                except ValueError:
+                    pass
+        return arr
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_dtypes = []
+        self.feed_names = []
+        self.feed_shapes = []
+        self.place = place
+        if program is None:
+            program = default_main_program()
+        for v in feed_list:
+            if isinstance(v, str):
+                v = program.global_block().var(v)
+            assert isinstance(v, Variable)
+            self.feed_names.append(v.name)
+            self.feed_dtypes.append(v.dtype)
+            self.feed_shapes.append(v.shape)
+
+    def feed(self, iterable):
+        """rows of tuples -> {name: batched ndarray}."""
+        converters = [
+            _Converter(shape, dtype)
+            for shape, dtype in zip(self.feed_shapes, self.feed_dtypes)
+        ]
+        for each_sample in iterable:
+            assert len(each_sample) == len(converters), (
+                "sample has %d fields, expected %d"
+                % (len(each_sample), len(converters))
+            )
+            for item, conv in zip(each_sample, converters):
+                conv.feed(item)
+        return {
+            name: conv.done()
+            for name, conv in zip(self.feed_names, converters)
+        }
+
+    def feed_parallel(self, iterable, num_places=None):
+        """Split one batch into per-device feeds (reference
+        data_feeder.py:feed_parallel) — used by the mesh runtime for
+        manual per-device feeding; pjit sharding usually replaces this."""
+        import math
+
+        rows = list(iterable)
+        n = num_places or 1
+        per = math.ceil(len(rows) / n)
+        return [self.feed(rows[i * per:(i + 1) * per]) for i in range(n)]

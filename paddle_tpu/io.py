@@ -1,0 +1,206 @@
+"""Checkpoint / model persistence.
+
+Parity: reference ``python/paddle/fluid/io.py`` (save/load_vars:89/295,
+save/load_params:204/417, save/load_persistables:252/464,
+save/load_inference_model:544/669) and the save_op/load_op tensor format —
+TPU-native: tensors serialize as ``.npy`` files (one per var, like the
+reference's one-file-per-var save_op) or a single combined ``.npz``
+(save_combine_op parity); programs serialize to JSON (``__model__``).
+Sharded/async checkpointing for the mesh runtime lives in
+``paddle_tpu.parallel.checkpoint`` (orbax-style).
+"""
+
+import json
+import os
+
+import numpy as np
+
+from .framework import Parameter, Program, default_main_program
+from .scope import global_scope
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables",
+    "load_vars", "load_params", "load_persistables",
+    "save_inference_model", "load_inference_model",
+    "save_checkpoint", "load_checkpoint", "clean_checkpoint",
+]
+
+
+def _is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def _is_persistable(var):
+    return var.persistable
+
+
+def _npz_path(dirname, filename):
+    # np.savez appends ".npz" itself; normalize so save and load agree
+    if not filename.endswith(".npz"):
+        filename += ".npz"
+    return os.path.join(dirname, filename)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """Save scope values of selected program vars (reference io.py:89)."""
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = [
+            v for v in main_program.list_vars()
+            if predicate is None or predicate(v)
+        ]
+    os.makedirs(dirname, exist_ok=True)
+    scope = global_scope()
+    if filename is not None:
+        arrays = {}
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is None:
+                continue
+            arrays[v.name] = np.asarray(val)
+        np.savez(_npz_path(dirname, filename), **arrays)
+        return
+    for v in vars:
+        val = scope.find_var(v.name)
+        if val is None:
+            continue
+        np.save(os.path.join(dirname, v.name + ".npy"), np.asarray(val))
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_parameter,
+              filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Params + optimizer accumulators + LR etc (reference io.py:252)."""
+    save_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = [
+            v for v in main_program.list_vars()
+            if predicate is None or predicate(v)
+        ]
+    scope = global_scope()
+    if filename is not None:
+        with np.load(_npz_path(dirname, filename)) as data:
+            for v in vars:
+                if v.name in data:
+                    scope.set_var(v.name, data[v.name])
+        return
+    for v in vars:
+        path = os.path.join(dirname, v.name + ".npy")
+        if os.path.exists(path):
+            scope.set_var(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_parameter,
+              filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable,
+              filename=filename)
+
+
+def save_inference_model(
+    dirname, feeded_var_names, target_vars, executor, main_program=None,
+    model_filename=None, params_filename=None, export_for_deployment=True,
+):
+    """Prune to the inference subgraph + save program & params
+    (reference io.py:544).  The program is written as JSON ``__model__``."""
+    if main_program is None:
+        main_program = default_main_program()
+    fetch_names = [v.name for v in target_vars]
+    pruned = main_program.clone(for_test=True).prune_feed_fetch(
+        feeded_var_names, fetch_names
+    )
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "w") as f:
+        json.dump({
+            "program": pruned.to_dict(),
+            "feed_names": list(feeded_var_names),
+            "fetch_names": fetch_names,
+        }, f)
+    save_persistables(executor, dirname, pruned, filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """Returns (program, feed_names, fetch_vars) (reference io.py:669)."""
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path) as f:
+        payload = json.load(f)
+    program = Program.from_dict(payload["program"])
+    load_persistables(executor, dirname, program, filename=params_filename)
+    fetch_vars = [
+        program.global_block().var(n) for n in payload["fetch_names"]
+    ]
+    return program, payload["feed_names"], fetch_vars
+
+
+# ---- trainer-level checkpoints (reference io.py save_checkpoint family) ---
+
+def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
+                    serial=0, max_num_checkpoints=3):
+    d = os.path.join(checkpoint_dir, "checkpoint_%d" % serial,
+                     "trainer_%d" % trainer_id)
+    save_persistables(executor, d, main_program, filename="persistables.npz")
+    # prune old serials
+    existing = sorted(
+        int(n.split("_")[1]) for n in os.listdir(checkpoint_dir)
+        if n.startswith("checkpoint_")
+    )
+    import shutil
+
+    while len(existing) > max_num_checkpoints:
+        victim = existing.pop(0)
+        shutil.rmtree(os.path.join(checkpoint_dir, "checkpoint_%d" % victim),
+                      ignore_errors=True)
+    return d
+
+
+def get_latest_checkpoint_serial(checkpoint_dir):
+    if not os.path.isdir(checkpoint_dir):
+        return -1
+    serials = [
+        int(n.split("_")[1]) for n in os.listdir(checkpoint_dir)
+        if n.startswith("checkpoint_")
+    ]
+    return max(serials) if serials else -1
+
+
+def load_checkpoint(executor, checkpoint_dir, trainer_id=0,
+                    main_program=None, serial=None):
+    if serial is None:
+        serial = get_latest_checkpoint_serial(checkpoint_dir)
+    if serial < 0:
+        return False
+    d = os.path.join(checkpoint_dir, "checkpoint_%d" % serial,
+                     "trainer_%d" % trainer_id)
+    load_persistables(executor, d, main_program, filename="persistables.npz")
+    return True
+
+
+def clean_checkpoint(checkpoint_dir, delete_dir=False):
+    import shutil
+
+    if not os.path.isdir(checkpoint_dir):
+        return
+    for n in os.listdir(checkpoint_dir):
+        if n.startswith("checkpoint_"):
+            shutil.rmtree(os.path.join(checkpoint_dir, n),
+                          ignore_errors=True)
+    if delete_dir and not os.listdir(checkpoint_dir):
+        os.rmdir(checkpoint_dir)

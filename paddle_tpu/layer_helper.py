@@ -1,0 +1,175 @@
+"""LayerHelper: shared machinery for layer functions.
+
+Parity: reference ``python/paddle/fluid/layer_helper.py`` — creates
+parameters (var in main program + init op in startup program), temporary
+variables, bias/activation append helpers.
+"""
+
+from .core import dtype_is_floating
+from .framework import default_main_program, default_startup_program
+from .initializer import ConstantInitializer, XavierInitializer
+from .param_attr import ParamAttr
+from . import unique_name
+
+__all__ = ["LayerHelper"]
+
+
+class LayerHelper:
+    def __init__(self, layer_type, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        if kwargs.get("name") is None:
+            self.kwargs["name"] = unique_name.generate(layer_type)
+
+    @property
+    def name(self):
+        return self.kwargs["name"]
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    # ---- inputs ----------------------------------------------------------
+    def multiple_input(self, input_param_name="input"):
+        inputs = self.kwargs.get(input_param_name, [])
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        return list(inputs)
+
+    def input(self, input_param_name="input"):
+        inputs = self.multiple_input(input_param_name)
+        if len(inputs) != 1:
+            raise ValueError("%s layer needs exactly one input" % self.layer_type)
+        return inputs[0]
+
+    @property
+    def param_attr(self):
+        return ParamAttr.to_attr(self.kwargs.get("param_attr", None))
+
+    @property
+    def bias_attr(self):
+        return ParamAttr.to_attr(self.kwargs.get("bias_attr", None))
+
+    def multiple_param_attr(self, length):
+        attr = self.param_attr
+        if isinstance(attr, ParamAttr):
+            attr = [attr]
+        if len(attr) != 1 and len(attr) != length:
+            raise ValueError("parameter number mismatch")
+        elif len(attr) == 1 and length != 1:
+            import copy
+
+            attr = [attr[0]] + [copy.deepcopy(attr[0]) for _ in range(length - 1)]
+        return attr
+
+    def iter_inputs_and_params(self, input_param_name="input"):
+        inputs = self.multiple_input(input_param_name)
+        attrs = self.multiple_param_attr(len(inputs))
+        yield from zip(inputs, attrs)
+
+    def input_dtype(self, input_param_name="input"):
+        inputs = self.multiple_input(input_param_name)
+        dtype = None
+        for v in inputs:
+            if dtype is None:
+                dtype = v.dtype
+            elif dtype != v.dtype:
+                raise ValueError("mismatched input dtypes")
+        return dtype
+
+    # ---- creation --------------------------------------------------------
+    def create_parameter(self, attr, shape, dtype, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr.to_attr(attr)
+        if attr is None or attr.trainable is False and attr.name is None and \
+                self.kwargs.get("allow_non_trainable", False):
+            return None
+        if default_initializer is None:
+            default_initializer = (
+                ConstantInitializer(0.0) if is_bias else XavierInitializer()
+            )
+        attr.set_default_initializer(default_initializer)
+        name = attr.name or unique_name.generate(
+            ".".join([self.name, "b" if is_bias else "w"]))
+        attr.name = name
+        # variable in main program (attr kwargs already carry the name)
+        param = self.main_program.global_block().create_parameter(
+            shape=shape, dtype=dtype, **attr.to_kwargs()
+        )
+        # mirror + init op in startup program
+        startup_blk = self.startup_program.global_block()
+        if not startup_blk.has_var(name):
+            sp = startup_blk.create_parameter(
+                shape=shape, dtype=dtype, **attr.to_kwargs()
+            )
+            attr.initializer(sp, startup_blk)
+        return param
+
+    def create_variable_for_type_inference(self, dtype=None, name=None):
+        return self.main_program.current_block().create_var(
+            name=name or unique_name.generate(".".join([self.name, "tmp"])),
+            dtype=dtype,
+            persistable=False,
+        )
+
+    # backwards-compatible alias (reference used create_tmp_variable)
+    create_tmp_variable = create_variable_for_type_inference
+
+    def create_global_variable(self, persistable=False, *args, **kwargs):
+        return self.main_program.global_block().create_var(
+            *args, persistable=persistable, **kwargs
+        )
+
+    def set_variable_initializer(self, var, initializer):
+        startup_blk = self.startup_program.global_block()
+        if not startup_blk.has_var(var.name):
+            sv = startup_blk.create_var(
+                name=var.name, shape=var.shape, dtype=var.dtype,
+                persistable=True,
+            )
+            initializer(sv, startup_blk)
+        return var
+
+    def append_op(self, *args, **kwargs):
+        return self.main_program.current_block().append_op(*args, **kwargs)
+
+    # ---- common tails ----------------------------------------------------
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        size = list(input_var.shape[dim_start:dim_end])
+        bias_attr = self.bias_attr
+        b = self.create_parameter(
+            attr=bias_attr, shape=size, dtype=input_var.dtype, is_bias=True
+        )
+        tmp = self.create_variable_for_type_inference(dtype=input_var.dtype)
+        self.append_op(
+            type="elementwise_add",
+            inputs={"X": [input_var], "Y": [b]},
+            outputs={"Out": [tmp]},
+            attrs={"axis": dim_start},
+        )
+        return tmp
+
+    def append_activation(self, input_var):
+        act = self.kwargs.get("act", None)
+        if act is None:
+            return input_var
+        if isinstance(act, str):
+            act = {"type": act}
+        act_type = act.pop("type")
+        tmp = self.create_variable_for_type_inference(dtype=input_var.dtype)
+        self.append_op(
+            type=act_type,
+            inputs={"X": [input_var]},
+            outputs={"Out": [tmp]},
+            attrs=act,
+        )
+        return tmp
+
+    def is_instance(self, param_name, cls):
+        param = self.kwargs.get(param_name, None)
+        if not isinstance(param, cls):
+            raise TypeError("%s must be %s" % (param_name, cls))

@@ -1,0 +1,16 @@
+"""Layer DSL (reference ``python/paddle/fluid/layers/``)."""
+
+from .. import ops as _ops  # noqa: F401 — register op library first
+
+from . import io, math_op_patch, metric_op, nn, ops, tensor  # noqa: F401
+from .io import *  # noqa: F401,F403
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+
+math_op_patch.monkey_patch_variable()
+
+__all__ = (
+    io.__all__ + nn.__all__ + ops.__all__ + tensor.__all__ + metric_op.__all__
+)

@@ -1,0 +1,37 @@
+"""Input layers: ``data`` (feed entry points).
+
+Parity: reference ``python/paddle/fluid/layers/io.py:37 data`` — declares a
+feedable program input.  ``append_batch_size=True`` prepends a -1 batch dim
+like the reference; on TPU the executor specializes the jit per concrete
+batch size (bucketing handles variance — see data layer docs).
+py_reader / double_buffer equivalents live in ``paddle_tpu.data.pipeline``.
+"""
+
+from ..core import VarType
+from ..framework import default_main_program, default_startup_program
+
+__all__ = ["data"]
+
+
+def data(
+    name,
+    shape,
+    append_batch_size=True,
+    dtype="float32",
+    lod_level=0,
+    type=VarType.DENSE_TENSOR,
+    stop_gradient=True,
+):
+    helper_block = default_main_program().current_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return helper_block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        type=type,
+        stop_gradient=stop_gradient,
+        lod_level=lod_level,
+        is_data=True,
+    )

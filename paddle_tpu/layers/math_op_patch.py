@@ -1,0 +1,93 @@
+"""Operator overloading on Variable (reference ``layers/math_op_patch.py``):
+``a + b``, ``a - 1.0``, ``x.astype``, comparisons — each overload appends an
+elementwise/scale op to the current program."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..core import convert_dtype
+
+__all__ = ["monkey_patch_variable"]
+
+
+def _create_scalar_broadcast(block, value, ref_var):
+    helper = LayerHelper("scalar")
+    out = helper.create_variable_for_type_inference(dtype=ref_var.dtype)
+    helper.append_op(
+        type="fill_constant_batch_size_like"
+        if ref_var.shape and ref_var.shape[0] == -1 else "fill_constant",
+        inputs={"Input": [ref_var]} if ref_var.shape and ref_var.shape[0] == -1
+        else {},
+        outputs={"Out": [out]},
+        attrs={
+            "shape": [1] if not (ref_var.shape and ref_var.shape[0] == -1)
+            else list(ref_var.shape),
+            "value": float(value),
+            "dtype": str(ref_var.dtype),
+        },
+    )
+    return out
+
+
+def _binary(op_type, reverse=False):
+    def impl(self, other):
+        helper = LayerHelper(op_type)
+        if isinstance(other, (int, float)):
+            if op_type == "elementwise_add":
+                from .ops import scale
+
+                return scale(self, scale=1.0, bias=float(other))
+            if op_type == "elementwise_sub" and not reverse:
+                from .ops import scale
+
+                return scale(self, scale=1.0, bias=-float(other))
+            if op_type == "elementwise_mul":
+                from .ops import scale
+
+                return scale(self, scale=float(other))
+            other = _create_scalar_broadcast(self.block, other, self)
+        x, y = (other, self) if reverse else (self, other)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        axis = -1
+        if len(y.shape or ()) < len(x.shape or ()):
+            axis = -1
+        helper.append_op(
+            type=op_type, inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out]}, attrs={"axis": axis},
+        )
+        return out
+
+    return impl
+
+
+def _astype(self, dtype):
+    helper = LayerHelper("cast")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="cast", inputs={"X": [self]}, outputs={"Out": [out]},
+        attrs={"out_dtype": str(convert_dtype(dtype))},
+    )
+    return out
+
+
+def _neg(self):
+    from .ops import scale
+
+    return scale(self, scale=-1.0)
+
+
+def monkey_patch_variable():
+    Variable.__add__ = _binary("elementwise_add")
+    Variable.__radd__ = _binary("elementwise_add")
+    Variable.__sub__ = _binary("elementwise_sub")
+    Variable.__rsub__ = _binary("elementwise_sub", reverse=True)
+    Variable.__mul__ = _binary("elementwise_mul")
+    Variable.__rmul__ = _binary("elementwise_mul")
+    Variable.__truediv__ = _binary("elementwise_div")
+    Variable.__rtruediv__ = _binary("elementwise_div", reverse=True)
+    Variable.__pow__ = _binary("elementwise_pow")
+    Variable.__lt__ = _binary("less_than")
+    Variable.__le__ = _binary("less_equal")
+    Variable.__gt__ = _binary("greater_than")
+    Variable.__ge__ = _binary("greater_equal")
+    Variable.__neg__ = _neg
+    Variable.astype = _astype

@@ -1,0 +1,410 @@
+"""Neural-network layers — the user-facing op DSL.
+
+Parity: reference ``python/paddle/fluid/layers/nn.py`` (7k LoC, 123 public
+fns).  This module covers the dense/MLP/classification core; conv/pool/norm
+live in ``conv.py``, sequence layers in ``sequence.py``, control flow in
+``control_flow.py``.  Layers append ops to the default main program and
+create parameters via LayerHelper exactly like the reference.
+"""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "fc",
+    "embedding",
+    "dropout",
+    "softmax",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "one_hot",
+    "topk",
+    "matmul",
+    "mul",
+    "label_smooth",
+    "log",
+    "relu",
+    "l2_normalize",
+    "prelu",
+    "maxout",
+    "cos_sim",
+    "sampling_id",
+    "smooth_l1",
+    "clip",
+    "clip_by_norm",
+    "mean",
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+]
+
+
+def fc(
+    input,
+    size,
+    num_flatten_dims=1,
+    param_attr=None,
+    bias_attr=None,
+    act=None,
+    is_test=False,
+    name=None,
+):
+    """Fully-connected layer (reference nn.py:fc): per-input weight matmul
+    (mul op), summed, plus bias and activation.  On TPU each mul is a single
+    MXU gemm; multiple inputs become independent gemms XLA can batch."""
+    helper = LayerHelper("fc", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+
+    mul_results = []
+    for input_var, p_attr in helper.iter_inputs_and_params():
+        input_shape = input_var.shape
+        param_num_flatten = num_flatten_dims
+        w_rows = 1
+        for s in input_shape[param_num_flatten:]:
+            w_rows *= s
+        param_shape = [w_rows, size]
+        w = helper.create_parameter(attr=p_attr, shape=param_shape, dtype=dtype)
+        tmp = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(
+            type="mul",
+            inputs={"X": [input_var], "Y": [w]},
+            outputs={"Out": [tmp]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(tmp)
+
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(dtype)
+        helper.append_op(
+            type="sum", inputs={"X": mul_results}, outputs={"Out": [pre_bias]}
+        )
+    if helper.bias_attr and helper.kwargs.get("bias_attr") is not False:
+        pre_act = helper.append_bias_op(pre_bias, dim_start=num_flatten_dims)
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act)
+
+
+def embedding(
+    input,
+    size,
+    is_sparse=False,
+    is_distributed=False,
+    padding_idx=None,
+    param_attr=None,
+    dtype="float32",
+):
+    """Embedding lookup (reference nn.py:embedding / lookup_table_op.cc).
+    ``is_sparse`` selects the SelectedRows-style sparse-gradient path;
+    ``is_distributed`` marks the table for mesh sharding (the pserver
+    remote-prefetch equivalent — see parallel/embedding docs)."""
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=size, dtype=dtype, is_bias=False
+    )
+    tmp = helper.create_variable_for_type_inference(dtype)
+    padding_idx = (
+        -1 if padding_idx is None
+        else padding_idx if padding_idx >= 0
+        else (size[0] + padding_idx)
+    )
+    helper.append_op(
+        type="lookup_table",
+        inputs={"W": [w], "Ids": [input]},
+        outputs={"Out": [tmp]},
+        attrs={"is_sparse": is_sparse, "is_distributed": is_distributed,
+               "padding_idx": padding_idx},
+    )
+    return tmp
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    mask = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="dropout",
+        inputs={"X": [x]},
+        outputs={"Out": [out], "Mask": [mask]},
+        attrs={
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "seed": seed if seed is not None else 0,
+            "dropout_implementation": dropout_implementation,
+        },
+    )
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="softmax", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"axis": axis},
+    )
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="cross_entropy",
+        inputs={"X": [input], "Label": [label]},
+        outputs={"Y": [out]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100,
+    numeric_stable_mode=True, return_softmax=False,
+):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    softmax_out = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    loss = helper.create_variable_for_type_inference(dtype=logits.dtype)
+    helper.append_op(
+        type="softmax_with_cross_entropy",
+        inputs={"Logits": [logits], "Label": [label]},
+        outputs={"Softmax": [softmax_out], "Loss": [loss]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="one_hot", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"depth": depth},
+    )
+    return out
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(dtype=input.dtype)
+    indices = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="top_k", inputs={"X": [input]},
+        outputs={"Out": [values], "Indices": [indices]}, attrs={"k": k},
+    )
+    values.stop_gradient = True
+    indices.stop_gradient = True
+    return values, indices
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="matmul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+               "alpha": float(alpha)},
+    )
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="mul", inputs={"X": [x], "Y": [y]}, outputs={"Out": [out]},
+        attrs={"x_num_col_dims": x_num_col_dims,
+               "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [label]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist]
+    helper.append_op(
+        type="label_smooth", inputs=inputs, outputs={"Out": [out]},
+        attrs={"epsilon": float(epsilon)},
+    )
+    return out
+
+
+def _unary_layer(op_type):
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    return layer
+
+
+log = _unary_layer("log")
+relu = _unary_layer("relu")
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="mean", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def _elementwise_layer(op_type):
+    def layer(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, name=name, act=act)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(
+            type=op_type, inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out]}, attrs={"axis": axis},
+        )
+        return helper.append_activation(out)
+
+    layer.__name__ = op_type
+    return layer
+
+
+elementwise_add = _elementwise_layer("elementwise_add")
+elementwise_sub = _elementwise_layer("elementwise_sub")
+elementwise_mul = _elementwise_layer("elementwise_mul")
+elementwise_div = _elementwise_layer("elementwise_div")
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    """x / sqrt(sum(x^2, axis)) (reference nn.py:l2_normalize)."""
+    from . import tensor as tensor_layers
+
+    helper = LayerHelper("l2_normalize", name=name)
+    sq = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="square", inputs={"X": [x]}, outputs={"Out": [sq]})
+    ssum = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="reduce_sum", inputs={"X": [sq]}, outputs={"Out": [ssum]},
+        attrs={"dim": [axis], "keep_dim": True, "reduce_all": False},
+    )
+    norm = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="clip", inputs={"X": [ssum]}, outputs={"Out": [norm]},
+        attrs={"min": epsilon, "max": 3.4e38},
+    )
+    rsq = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="sqrt", inputs={"X": [norm]}, outputs={"Out": [rsq]})
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="elementwise_div", inputs={"X": [x], "Y": [rsq]},
+        outputs={"Out": [out]}, attrs={"axis": 0},
+    )
+    return out
+
+
+def prelu(x, mode, param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name, param_attr=param_attr)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    elif mode == "element":
+        alpha_shape = [int(_prod(x.shape[1:]))]
+    else:
+        raise ValueError("mode must be all|channel|element")
+    from ..initializer import ConstantInitializer
+
+    alpha = helper.create_parameter(
+        attr=helper.param_attr, shape=alpha_shape, dtype=x.dtype,
+        default_initializer=ConstantInitializer(0.25),
+    )
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="prelu", inputs={"X": [x], "Alpha": [alpha]},
+        outputs={"Out": [out]}, attrs={"mode": mode},
+    )
+    return out
+
+
+def _prod(xs):
+    p = 1
+    for x in xs:
+        p *= x
+    return p
+
+
+def maxout(x, groups, name=None):
+    helper = LayerHelper("maxout", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="maxout", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"groups": groups},
+    )
+    return out
+
+
+def cos_sim(X, Y):
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(dtype=X.dtype)
+    xnorm = helper.create_variable_for_type_inference(dtype=X.dtype)
+    ynorm = helper.create_variable_for_type_inference(dtype=X.dtype)
+    helper.append_op(
+        type="cos_sim", inputs={"X": [X], "Y": [Y]},
+        outputs={"Out": [out], "XNorm": [xnorm], "YNorm": [ynorm]},
+    )
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference(dtype="int64")
+    helper.append_op(
+        type="sampling_id", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"min": min, "max": max, "seed": seed},
+    )
+    return out
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    diff = helper.create_variable_for_type_inference(dtype=x.dtype)
+    loss = helper.create_variable_for_type_inference(dtype=x.dtype)
+    inputs = {"X": [x], "Y": [y]}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = [inside_weight]
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = [outside_weight]
+    helper.append_op(
+        type="smooth_l1_loss", inputs=inputs,
+        outputs={"Diff": [diff], "Out": [loss]},
+        attrs={"sigma": sigma if sigma is not None else 1.0},
+    )
+    return loss
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="clip", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"min": float(min), "max": float(max)},
+    )
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="clip_by_norm", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"max_norm": float(max_norm)},
+    )
+    return out

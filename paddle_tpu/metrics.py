@@ -1,0 +1,149 @@
+"""Host-side metric accumulators.
+
+Parity: reference ``python/paddle/fluid/metrics.py`` (MetricBase,
+CompositeMetric, Precision, Recall, Accuracy, ChunkEvaluator, EditDistance,
+DetectionMAP, Auc) — numpy accumulation across minibatches on the host,
+fed from fetched step metrics.
+"""
+
+import numpy as np
+
+__all__ = [
+    "MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
+    "EditDistance", "Auc",
+]
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        for k, v in self.__dict__.items():
+            if k.startswith("_"):
+                continue
+            if isinstance(v, (int, float)):
+                setattr(self, k, 0)
+            elif isinstance(v, np.ndarray):
+                setattr(self, k, np.zeros_like(v))
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):
+        return [m.eval() for m in self._metrics]
+
+
+class Precision(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fp += int(np.sum((preds == 1) & (labels == 0)))
+
+    def eval(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(np.asarray(preds)).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).astype(np.int64).reshape(-1)
+        self.tp += int(np.sum((preds == 1) & (labels == 1)))
+        self.fn += int(np.sum((preds == 0) & (labels == 1)))
+
+    def eval(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+
+class Accuracy(MetricBase):
+    """Weighted streaming accuracy (feed per-batch acc + batch size)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight):
+        self.value += float(np.asarray(value).reshape(-1)[0]) * weight
+        self.weight += weight
+
+    def eval(self):
+        return self.value / self.weight if self.weight else 0.0
+
+
+class EditDistance(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances).reshape(-1)
+        self.total_distance += float(np.sum(distances))
+        self.seq_num += int(seq_num)
+        self.instance_error += int(np.sum(distances > 0))
+
+    def eval(self):
+        avg = self.total_distance / self.seq_num if self.seq_num else 0.0
+        err = self.instance_error / self.seq_num if self.seq_num else 0.0
+        return avg, err
+
+
+class Auc(MetricBase):
+    def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+        super().__init__(name)
+        self._num_thresholds = num_thresholds
+        bins = num_thresholds + 1
+        self._stat_pos = np.zeros(bins, dtype=np.int64)
+        self._stat_neg = np.zeros(bins, dtype=np.int64)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        p = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+        nb = len(self._stat_pos)
+        idx = np.clip((p * (nb - 1)).astype(np.int64), 0, nb - 1)
+        for i, lab in zip(idx, labels):
+            if lab > 0:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def eval(self):
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tot_pos = max(tp[-1], 1)
+        tot_neg = max(fp[-1], 1)
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr))

@@ -1,0 +1,19 @@
+"""Op library: importing this package registers every op with the registry.
+
+The TPU-native analog of the reference's ``paddle/fluid/operators/``
+(~314 registered op types): kernels are pure JAX functions that trace into
+the program-level jit, with Pallas bodies for selected hot ops.
+"""
+
+from . import (  # noqa: F401
+    activation,
+    creation,
+    elementwise,
+    loss,
+    manipulation,
+    math,
+    metric,
+    optimizer_ops,
+    random,
+    reduction,
+)

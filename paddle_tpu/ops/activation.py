@@ -1,0 +1,132 @@
+"""Activation ops — the reference's functor-based family
+(``activation_op.cc``, ~25 activations + parameterized variants like
+``leaky_relu``, ``elu``, ``brelu``, ``prelu_op.cc``, ``soft_relu``) —
+TPU-native: one-liner jnp/lax bodies; XLA fuses them into producers, and
+their vjp-derived gradients match the reference's analytic grad kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, same_shape_infer, set_output, in_var
+
+
+def _register_act(name, fn):
+    register_op(
+        name, ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
+        compute=lambda ins, attrs, ctx, op_index: {
+            "Out": fn(ins["X"][0], attrs)
+        },
+    )
+
+
+_SIMPLE = {
+    "relu": lambda x, a: jnp.maximum(x, 0),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "log": lambda x, a: jnp.log(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "rsqrt": lambda x, a: jax.lax.rsqrt(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "round": lambda x, a: jnp.round(x),
+    "cos": lambda x, a: jnp.cos(x),
+    "sin": lambda x, a: jnp.sin(x),
+    "square": lambda x, a: x * x,
+    "reciprocal": lambda x, a: 1.0 / x,
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: x / (1 + jnp.abs(x)),
+    "relu6": lambda x, a: jnp.clip(x, 0, a.get("threshold", 6.0)),
+    "leaky_relu": lambda x, a: jnp.where(x >= 0, x, a.get("alpha", 0.02) * x),
+    "elu": lambda x, a: jnp.where(
+        x >= 0, x, a.get("alpha", 1.0) * (jnp.exp(jnp.minimum(x, 0.0)) - 1)),
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+    "soft_relu": lambda x, a: jnp.log(
+        1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0),
+                             a.get("threshold", 40.0)))),
+    "pow": lambda x, a: jnp.power(x, a.get("factor", 1.0)),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+        a.get("scale_a", 2.0 / 3.0) * x),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+    "gelu": lambda x, a: jax.nn.gelu(x, approximate=False),
+    "thresholded_relu": lambda x, a: jnp.where(
+        x > a.get("threshold", 1.0), x, 0.0),
+    "hard_shrink": lambda x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "softshrink": lambda x, a: jnp.where(
+        x > a.get("lambda", 0.5), x - a.get("lambda", 0.5),
+        jnp.where(x < -a.get("lambda", 0.5), x + a.get("lambda", 0.5), 0.0)),
+}
+
+for _name, _fn in _SIMPLE.items():
+    _register_act(_name, _fn)
+
+
+# -- prelu (per-channel learnable alpha; prelu_op.cc) -----------------------
+
+def _prelu_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _prelu_compute(ins, attrs, ctx, op_index):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        a = alpha.reshape((1,) + x.shape[1:])
+    else:
+        a = alpha.reshape(())
+    return {"Out": jnp.where(x >= 0, x, a * x)}
+
+
+register_op("prelu", ["X", "Alpha"], ["Out"], infer=_prelu_infer,
+            compute=_prelu_compute)
+
+
+# -- softmax (softmax_op.cc: applied on the last dim) -----------------------
+
+def _softmax_compute(ins, attrs, ctx, op_index):
+    axis = attrs.get("axis", -1)
+    return {"Out": jax.nn.softmax(ins["X"][0], axis=axis)}
+
+
+register_op("softmax", ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
+            compute=_softmax_compute)
+
+
+def _log_softmax_compute(ins, attrs, ctx, op_index):
+    axis = attrs.get("axis", -1)
+    return {"Out": jax.nn.log_softmax(ins["X"][0], axis=axis)}
+
+
+register_op("log_softmax", ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
+            compute=_log_softmax_compute)
+
+
+# -- maxout (maxout_op.cc) --------------------------------------------------
+
+def _maxout_infer(op, block):
+    x = in_var(op, block, "X")
+    groups = op.attrs["groups"]
+    n, c = x.shape[0], x.shape[1]
+    set_output(op, block, "Out", (n, c // groups) + tuple(x.shape[2:]), x.dtype)
+
+
+def _maxout_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    g = attrs["groups"]
+    n, c = x.shape[0], x.shape[1]
+    x = x.reshape((n, c // g, g) + x.shape[2:])
+    return {"Out": jnp.max(x, axis=2)}
+
+
+register_op("maxout", ["X"], ["Out"], infer=_maxout_infer,
+            compute=_maxout_compute)

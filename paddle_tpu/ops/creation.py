@@ -1,0 +1,193 @@
+"""Creation / initialization / random ops.
+
+Parity: reference ``fill_constant_op.cc``, ``fill_zeros_like_op.cc``,
+``uniform_random_op.cc``, ``gaussian_random_op.cc``,
+``truncated_gaussian_random_op.cc``, ``assign_op.cc``, ``cast_op.cc``,
+``assign_value_op.cc``, ``shape_op.cc``, ``increment_op.cc``,
+``fill_constant_batch_size_like_op.cc`` — TPU-native: randomness is
+counter-based PRNG (threefry) threaded by the executor, so the whole program
+stays deterministic and jit-compatible (no global RNG state mutation).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import convert_dtype
+from ..registry import register_op, set_output, in_var
+
+
+def _attr_dtype(attrs, default="float32"):
+    return convert_dtype(attrs.get("dtype", default))
+
+
+# -- fill_constant ----------------------------------------------------------
+
+def _fill_constant_infer(op, block):
+    set_output(op, block, "Out", op.attrs["shape"], _attr_dtype(op.attrs))
+
+
+def _fill_constant_compute(ins, attrs, ctx, op_index):
+    dtype = _attr_dtype(attrs)
+    return {"Out": jnp.full(tuple(attrs["shape"]), attrs.get("value", 0.0),
+                            dtype=dtype)}
+
+
+register_op(
+    "fill_constant", [], ["Out"],
+    infer=_fill_constant_infer, compute=_fill_constant_compute, grad=None,
+)
+
+
+# -- fill_zeros_like --------------------------------------------------------
+
+def _fill_zeros_like_compute(ins, attrs, ctx, op_index):
+    return {"Out": jnp.zeros_like(ins["X"][0])}
+
+
+register_op(
+    "fill_zeros_like", ["X"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape, in_var(op, block, "X").dtype
+    ),
+    compute=_fill_zeros_like_compute, grad=None,
+)
+
+
+# -- fill_constant_batch_size_like -----------------------------------------
+
+def _fcbsl_infer(op, block):
+    shape = list(op.attrs["shape"])
+    set_output(op, block, "Out", shape, _attr_dtype(op.attrs))
+
+
+def _fcbsl_compute(ins, attrs, ctx, op_index):
+    shape = list(attrs["shape"])
+    in_dim = attrs.get("input_dim_idx", 0)
+    out_dim = attrs.get("output_dim_idx", 0)
+    shape[out_dim] = ins["Input"][0].shape[in_dim]
+    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0),
+                            dtype=_attr_dtype(attrs))}
+
+
+register_op(
+    "fill_constant_batch_size_like", ["Input"], ["Out"],
+    infer=_fcbsl_infer, compute=_fcbsl_compute, grad=None,
+)
+
+
+# -- random ops -------------------------------------------------------------
+
+def _uniform_random_compute(ins, attrs, ctx, op_index):
+    key = ctx.rng_key(op_index)
+    dtype = _attr_dtype(attrs)
+    lo = attrs.get("min", -1.0)
+    hi = attrs.get("max", 1.0)
+    return {"Out": jax.random.uniform(
+        key, tuple(attrs["shape"]), dtype=dtype, minval=lo, maxval=hi)}
+
+
+register_op(
+    "uniform_random", [], ["Out"],
+    infer=_fill_constant_infer, compute=_uniform_random_compute,
+    grad=None, stateful_random=True,
+)
+
+
+def _gaussian_random_compute(ins, attrs, ctx, op_index):
+    key = ctx.rng_key(op_index)
+    dtype = _attr_dtype(attrs)
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    return {"Out": mean + std * jax.random.normal(
+        key, tuple(attrs["shape"]), dtype=dtype)}
+
+
+register_op(
+    "gaussian_random", [], ["Out"],
+    infer=_fill_constant_infer, compute=_gaussian_random_compute,
+    grad=None, stateful_random=True,
+)
+
+
+def _truncated_gaussian_compute(ins, attrs, ctx, op_index):
+    key = ctx.rng_key(op_index)
+    dtype = _attr_dtype(attrs)
+    mean = attrs.get("mean", 0.0)
+    std = attrs.get("std", 1.0)
+    # truncated to +-2 std like the reference (truncated_gaussian_random_op.cc)
+    z = jax.random.truncated_normal(key, -2.0, 2.0, tuple(attrs["shape"]), dtype)
+    return {"Out": mean + std * z}
+
+
+register_op(
+    "truncated_gaussian_random", [], ["Out"],
+    infer=_fill_constant_infer, compute=_truncated_gaussian_compute,
+    grad=None, stateful_random=True,
+)
+
+
+# -- assign / cast / shape / increment -------------------------------------
+
+register_op(
+    "assign", ["X"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape, in_var(op, block, "X").dtype
+    ),
+    compute=lambda ins, attrs, ctx, op_index: {"Out": ins["X"][0]},
+    grad="auto",
+)
+
+
+def _assign_value_compute(ins, attrs, ctx, op_index):
+    dtype = _attr_dtype(attrs)
+    vals = np.asarray(attrs["values"], dtype=dtype).reshape(tuple(attrs["shape"]))
+    return {"Out": jnp.asarray(vals)}
+
+
+register_op(
+    "assign_value", [], ["Out"],
+    infer=_fill_constant_infer, compute=_assign_value_compute, grad=None,
+)
+
+
+def _cast_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, convert_dtype(op.attrs["out_dtype"]))
+
+
+def _cast_compute(ins, attrs, ctx, op_index):
+    return {"Out": ins["X"][0].astype(convert_dtype(attrs["out_dtype"]))}
+
+
+register_op("cast", ["X"], ["Out"], infer=_cast_infer, compute=_cast_compute,
+            grad="auto")
+
+
+def _shape_infer(op, block):
+    x = in_var(op, block, "Input")
+    set_output(op, block, "Out", (len(x.shape),), np.int64)
+
+
+register_op(
+    "shape", ["Input"], ["Out"],
+    infer=_shape_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.asarray(ins["Input"][0].shape, dtype=jnp.int64)
+    },
+    grad=None,
+)
+
+
+def _increment_compute(ins, attrs, ctx, op_index):
+    return {"Out": ins["X"][0] + attrs.get("step", 1.0)}
+
+
+register_op(
+    "increment", ["X"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape, in_var(op, block, "X").dtype
+    ),
+    compute=_increment_compute, grad=None,
+)

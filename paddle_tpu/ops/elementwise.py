@@ -1,0 +1,109 @@
+"""Elementwise binary ops with Fluid's axis-broadcast semantics.
+
+Parity: reference ``elementwise_{add,sub,mul,div,max,min,pow}_op.cc`` and
+the comparison/logical families (``compare_op.cc``, ``logical_op.cc``) —
+TPU-native: plain jnp broadcasting; XLA fuses these into neighboring
+matmuls/convolutions so they cost no extra HBM round-trip.
+
+Fluid's ``axis`` attribute aligns a lower-rank Y against X starting at
+``axis`` (elementwise_op_function.h); we reproduce it by right-padding Y
+with singleton dims.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var, broadcast_shapes
+
+
+def _align_y(x, y, axis):
+    if y.ndim == x.ndim:
+        return y
+    if axis == -1 or axis is None:
+        axis = x.ndim - y.ndim
+    pad = x.ndim - axis - y.ndim
+    if pad > 0:
+        y = y.reshape(y.shape + (1,) * pad)
+    return y
+
+
+def _ew_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    axis = op.attrs.get("axis", -1)
+    ys = list(y.shape)
+    if len(ys) < len(x.shape):
+        a = axis if axis != -1 else len(x.shape) - len(ys)
+        ys = [1] * a + ys + [1] * (len(x.shape) - a - len(ys))
+    out = broadcast_shapes(tuple(x.shape), tuple(ys))
+    set_output(op, block, "Out", out, x.dtype)
+
+
+def _make_ew(name, fn):
+    def compute(ins, attrs, ctx, op_index):
+        x, y = ins["X"][0], ins["Y"][0]
+        y = _align_y(x, y, attrs.get("axis", -1))
+        return {"Out": fn(x, y)}
+
+    register_op(name, ["X", "Y"], ["Out"], infer=_ew_infer, compute=compute)
+
+
+_make_ew("elementwise_add", lambda x, y: x + y)
+_make_ew("elementwise_sub", lambda x, y: x - y)
+_make_ew("elementwise_mul", lambda x, y: x * y)
+_make_ew("elementwise_div", lambda x, y: x / y)
+_make_ew("elementwise_max", jnp.maximum)
+_make_ew("elementwise_min", jnp.minimum)
+_make_ew("elementwise_pow", jnp.power)
+_make_ew("elementwise_mod", jnp.mod)
+_make_ew("elementwise_floordiv", jnp.floor_divide)
+
+
+# -- comparisons (compare_op.cc) -- outputs bool, not differentiable --------
+
+def _cmp_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    out = broadcast_shapes(tuple(x.shape), tuple(y.shape))
+    set_output(op, block, "Out", out, np.bool_)
+
+
+def _make_cmp(name, fn):
+    register_op(
+        name, ["X", "Y"], ["Out"], infer=_cmp_infer,
+        compute=lambda ins, attrs, ctx, op_index: {
+            "Out": fn(ins["X"][0], ins["Y"][0])
+        },
+        grad=None,
+    )
+
+
+_make_cmp("less_than", lambda x, y: x < y)
+_make_cmp("less_equal", lambda x, y: x <= y)
+_make_cmp("greater_than", lambda x, y: x > y)
+_make_cmp("greater_equal", lambda x, y: x >= y)
+_make_cmp("equal", lambda x, y: x == y)
+_make_cmp("not_equal", lambda x, y: x != y)
+
+
+# -- logical ops (logical_op.cc) --------------------------------------------
+
+def _make_logical(name, fn, unary=False):
+    slots = ["X"] if unary else ["X", "Y"]
+    register_op(
+        name, slots, ["Out"],
+        infer=(lambda op, block: set_output(
+            op, block, "Out", in_var(op, block, "X").shape, np.bool_))
+        if unary else _cmp_infer,
+        compute=(lambda ins, attrs, ctx, op_index: {"Out": fn(ins["X"][0])})
+        if unary else (lambda ins, attrs, ctx, op_index: {
+            "Out": fn(ins["X"][0], ins["Y"][0])}),
+        grad=None,
+    )
+
+
+_make_logical("logical_and", jnp.logical_and)
+_make_logical("logical_or", jnp.logical_or)
+_make_logical("logical_xor", jnp.logical_xor)
+_make_logical("logical_not", jnp.logical_not, unary=True)

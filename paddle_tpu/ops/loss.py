@@ -1,0 +1,206 @@
+"""Loss ops.
+
+Parity: reference ``cross_entropy_op.cc``,
+``softmax_with_cross_entropy_op.cc`` (the fused hot op named in the north
+star), ``sigmoid_cross_entropy_with_logits_op.cc``, ``huber_loss_op.cc``,
+``smooth_l1_loss_op.cc``, ``hinge_loss_op.cc``, ``log_loss_op.cc``,
+``rank_loss_op.cc``, ``margin_rank_loss_op.cc`` — TPU-native: the fused
+softmax+CE is written as logsumexp-based log-softmax so its vjp is exactly
+the numerically-stable ``softmax - onehot`` kernel the reference hand-writes.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var, same_shape_infer
+
+
+def _rowwise_out_infer(op, block, x_slot="X"):
+    x = in_var(op, block, x_slot)
+    set_output(op, block, "Out" if "Out" in op.outputs else "Loss",
+               tuple(x.shape[:-1]) + (1,), x.dtype)
+
+
+# -- cross_entropy (takes probabilities; cross_entropy_op.cc) ---------------
+
+def _cross_entropy_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Y", tuple(x.shape[:-1]) + (1,), x.dtype)
+
+
+def _cross_entropy_compute(ins, attrs, ctx, op_index):
+    x, label = ins["X"][0], ins["Label"][0]
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x), axis=-1, keepdims=True)
+    else:
+        idx = label.reshape(label.shape[:-1] + (1,)) if label.shape[-1] == 1 \
+            else label[..., None]
+        picked = jnp.take_along_axis(x, idx.astype(jnp.int32), axis=-1)
+        loss = -jnp.log(picked)
+        loss = loss.reshape(x.shape[:-1] + (1,))
+    return {"Y": loss}
+
+
+register_op(
+    "cross_entropy", ["X", "Label"], ["Y"], infer=_cross_entropy_infer,
+    compute=_cross_entropy_compute, no_grad_inputs=("Label",),
+)
+
+
+# -- softmax_with_cross_entropy (fused; the hot op) -------------------------
+
+def _swce_infer(op, block):
+    logits = in_var(op, block, "Logits")
+    set_output(op, block, "Softmax", logits.shape, logits.dtype)
+    set_output(op, block, "Loss", tuple(logits.shape[:-1]) + (1,), logits.dtype)
+
+
+def _swce_compute(ins, attrs, ctx, op_index):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    log_sm = jax.nn.log_softmax(logits, axis=-1)
+    softmax = jnp.exp(log_sm)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * log_sm, axis=-1, keepdims=True)
+    else:
+        idx = label if label.shape[-1] == 1 else label[..., None]
+        picked = jnp.take_along_axis(log_sm, idx.astype(jnp.int32), axis=-1)
+        ignore = attrs.get("ignore_index", -100)
+        loss = -picked
+        if ignore >= 0:
+            loss = jnp.where(idx == ignore, 0.0, loss)
+    return {"Softmax": softmax, "Loss": loss}
+
+
+register_op(
+    "softmax_with_cross_entropy", ["Logits", "Label"], ["Softmax", "Loss"],
+    infer=_swce_infer, compute=_swce_compute, no_grad_inputs=("Label",),
+)
+
+
+# -- sigmoid_cross_entropy_with_logits --------------------------------------
+
+def _scewl_compute(ins, attrs, ctx, op_index):
+    x, label = ins["X"][0], ins["Label"][0]
+    # stable: max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = attrs.get("ignore_index", -100)
+    if ignore != -100:
+        loss = jnp.where(label == ignore, 0.0, loss)
+    return {"Out": loss}
+
+
+register_op(
+    "sigmoid_cross_entropy_with_logits", ["X", "Label"], ["Out"],
+    infer=same_shape_infer("X", "Out"), compute=_scewl_compute,
+    no_grad_inputs=("Label",),
+)
+
+
+# -- huber / smooth_l1 / hinge / log_loss / rank losses ---------------------
+
+def _huber_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Residual", x.shape, x.dtype)
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _huber_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    loss = jnp.where(jnp.abs(r) <= d, 0.5 * r * r, d * (jnp.abs(r) - 0.5 * d))
+    return {"Residual": r, "Out": loss}
+
+
+register_op("huber_loss", ["X", "Y"], ["Residual", "Out"],
+            infer=_huber_infer, compute=_huber_compute,
+            no_grad_inputs=("Y",))
+
+
+def _smooth_l1_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Diff", x.shape, x.dtype)
+    set_output(op, block, "Out", (x.shape[0], 1), x.dtype)
+
+
+def _smooth_l1_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight") and ins["InsideWeight"][0] is not None:
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if ins.get("OutsideWeight") and ins["OutsideWeight"][0] is not None:
+        loss = loss * ins["OutsideWeight"][0]
+    out = jnp.sum(loss.reshape(x.shape[0], -1), axis=1, keepdims=True)
+    return {"Diff": diff, "Out": out}
+
+
+register_op(
+    "smooth_l1_loss", ["X", "Y", "InsideWeight", "OutsideWeight"],
+    ["Diff", "Out"], infer=_smooth_l1_infer, compute=_smooth_l1_compute,
+    no_grad_inputs=("Y", "InsideWeight", "OutsideWeight"),
+)
+
+
+def _hinge_compute(ins, attrs, ctx, op_index):
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0)}
+
+
+register_op("hinge_loss", ["Logits", "Labels"], ["Loss"],
+            infer=lambda op, block: set_output(
+                op, block, "Loss", in_var(op, block, "Logits").shape,
+                in_var(op, block, "Logits").dtype),
+            compute=_hinge_compute, no_grad_inputs=("Labels",))
+
+
+def _log_loss_compute(ins, attrs, ctx, op_index):
+    p, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    loss = -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps)
+    return {"Loss": loss}
+
+
+register_op("log_loss", ["Predicted", "Labels"], ["Loss"],
+            infer=lambda op, block: set_output(
+                op, block, "Loss", in_var(op, block, "Predicted").shape,
+                in_var(op, block, "Predicted").dtype),
+            compute=_log_loss_compute, no_grad_inputs=("Labels",))
+
+
+def _rank_loss_compute(ins, attrs, ctx, op_index):
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": jnp.log1p(jnp.exp(d)) - label * d}
+
+
+register_op("rank_loss", ["Label", "Left", "Right"], ["Out"],
+            infer=lambda op, block: set_output(
+                op, block, "Out", in_var(op, block, "Left").shape,
+                in_var(op, block, "Left").dtype),
+            compute=_rank_loss_compute, no_grad_inputs=("Label",))
+
+
+def _margin_rank_loss_compute(ins, attrs, ctx, op_index):
+    label, x1, x2 = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    act = (out > 0).astype(x1.dtype)
+    return {"Out": out, "Activated": act}
+
+
+register_op(
+    "margin_rank_loss", ["Label", "X1", "X2"], ["Out", "Activated"],
+    infer=lambda op, block: (
+        set_output(op, block, "Out", in_var(op, block, "X1").shape,
+                   in_var(op, block, "X1").dtype),
+        set_output(op, block, "Activated", in_var(op, block, "X1").shape,
+                   in_var(op, block, "X1").dtype),
+    ),
+    compute=_margin_rank_loss_compute, no_grad_inputs=("Label",),
+)

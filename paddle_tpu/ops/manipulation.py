@@ -1,0 +1,434 @@
+"""Tensor manipulation ops: reshape, transpose, concat, split, slice, ...
+
+Parity: reference ``reshape_op.cc``, ``transpose_op.cc``, ``concat_op.cc``,
+``split_op.cc``, ``squeeze/unsqueeze``, ``flatten_op.cc``, ``slice_op.cc``,
+``expand_op.cc``, ``stack/unstack``, ``gather_op.cc``, ``scatter_op.cc``,
+``pad_op.cc``, ``reverse_op.cc``, ``one_hot_op.cc``, ``top_k_op.cc``,
+``lookup_table_op.cc``, ``multiplex_op.cc``, ``label_smooth_op.cc`` —
+all shape-static so XLA can lay out and fuse freely.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import convert_dtype
+from ..registry import register_op, set_output, in_var
+
+
+# -- reshape ----------------------------------------------------------------
+
+def _resolve_reshape(in_shape, spec):
+    out = []
+    for i, s in enumerate(spec):
+        if s == 0:
+            out.append(in_shape[i])
+        else:
+            out.append(s)
+    if -1 in out:
+        known = 1
+        for s in out:
+            if s != -1:
+                known *= s
+        total = 1
+        for s in in_shape:
+            total *= s
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+def _reshape_infer(op, block):
+    x = in_var(op, block, "X")
+    spec = list(op.attrs["shape"])
+    if -1 not in x.shape:
+        out = _resolve_reshape(x.shape, spec)
+    else:
+        out = tuple(spec)
+    set_output(op, block, "Out", out, x.dtype)
+
+
+def _reshape_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    return {"Out": x.reshape(_resolve_reshape(x.shape, list(attrs["shape"])))}
+
+
+register_op("reshape", ["X"], ["Out"], infer=_reshape_infer,
+            compute=_reshape_compute)
+
+
+def _flatten_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attrs.get("axis", 1)
+    lead = 1
+    for s in x.shape[:axis]:
+        lead *= s
+    rest = 1
+    for s in x.shape[axis:]:
+        rest *= s
+    set_output(op, block, "Out", (lead, rest), x.dtype)
+
+
+register_op(
+    "flatten", ["X"], ["Out"], infer=_flatten_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": ins["X"][0].reshape(
+            int(np.prod(ins["X"][0].shape[: attrs.get("axis", 1)] or (1,))),
+            -1,
+        )
+    },
+)
+
+
+def _squeeze_infer(op, block):
+    x = in_var(op, block, "X")
+    axes = op.attrs.get("axes", [])
+    if axes:
+        axes = [a % len(x.shape) for a in axes]
+        out = tuple(s for i, s in enumerate(x.shape) if i not in axes or s != 1)
+    else:
+        out = tuple(s for s in x.shape if s != 1)
+    set_output(op, block, "Out", out, x.dtype)
+
+
+def _squeeze_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if axes:
+        axes = tuple(a % x.ndim for a in axes if x.shape[a % x.ndim] == 1)
+        return {"Out": jnp.squeeze(x, axis=axes)}
+    return {"Out": jnp.squeeze(x)}
+
+
+register_op("squeeze", ["X"], ["Out"], infer=_squeeze_infer,
+            compute=_squeeze_compute)
+
+
+def _unsqueeze_infer(op, block):
+    x = in_var(op, block, "X")
+    out = list(x.shape)
+    for a in sorted(op.attrs["axes"]):
+        out.insert(a if a >= 0 else a + len(out) + 1, 1)
+    set_output(op, block, "Out", out, x.dtype)
+
+
+def _unsqueeze_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a if a >= 0 else a + x.ndim + 1)
+    return {"Out": x}
+
+
+register_op("unsqueeze", ["X"], ["Out"], infer=_unsqueeze_infer,
+            compute=_unsqueeze_compute)
+
+
+# -- transpose --------------------------------------------------------------
+
+def _transpose_infer(op, block):
+    x = in_var(op, block, "X")
+    perm = op.attrs["axis"]
+    set_output(op, block, "Out", tuple(x.shape[p] for p in perm), x.dtype)
+
+
+register_op(
+    "transpose", ["X"], ["Out"], infer=_transpose_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.transpose(ins["X"][0], attrs["axis"])
+    },
+)
+
+
+# -- concat / split / stack -------------------------------------------------
+
+def _concat_infer(op, block):
+    xs = [block.var_recursive(n) for n in op.inputs["X"]]
+    axis = op.attrs.get("axis", 0) % len(xs[0].shape)
+    out = list(xs[0].shape)
+    out[axis] = sum(v.shape[axis] for v in xs)
+    set_output(op, block, "Out", out, xs[0].dtype)
+
+
+register_op(
+    "concat", ["X"], ["Out"], infer=_concat_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))
+    },
+)
+
+
+def _split_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attrs.get("axis", 0) % len(x.shape)
+    sections = op.attrs.get("sections", [])
+    num = op.attrs.get("num", 0)
+    outs = op.outputs["Out"]
+    if sections:
+        sizes = sections
+    else:
+        n = num or len(outs)
+        sizes = [x.shape[axis] // n] * n
+    for name, size in zip(outs, sizes):
+        shape = list(x.shape)
+        shape[axis] = size
+        v = block._find_var_recursive(name) or block.create_var(name=name)
+        v.shape = tuple(shape)
+        v.dtype = x.dtype
+
+
+def _split_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0) % x.ndim
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        return {"Out": jnp.split(x, idx, axis=axis)}
+    n = attrs.get("num", 0) or attrs["__num_outputs__"]
+    return {"Out": jnp.split(x, n, axis=axis)}
+
+
+register_op("split", ["X"], ["Out"], infer=_split_infer,
+            compute=_split_compute)
+
+
+def _stack_infer(op, block):
+    xs = [block.var_recursive(n) for n in op.inputs["X"]]
+    axis = op.attrs.get("axis", 0)
+    out = list(xs[0].shape)
+    out.insert(axis if axis >= 0 else axis + len(out) + 1, len(xs))
+    set_output(op, block, "Y", out, xs[0].dtype)
+
+
+register_op(
+    "stack", ["X"], ["Y"], infer=_stack_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Y": jnp.stack(ins["X"], axis=attrs.get("axis", 0))
+    },
+)
+
+
+# -- slice / expand / reverse / pad ----------------------------------------
+
+def _slice_infer(op, block):
+    x = in_var(op, block, "Input")
+    shape = list(x.shape)
+    for ax, st, en in zip(op.attrs["axes"], op.attrs["starts"],
+                          op.attrs["ends"]):
+        dim = shape[ax]
+        st2 = max(st + dim, 0) if st < 0 else min(st, dim)
+        en2 = max(en + dim, 0) if en < 0 else min(en, dim)
+        shape[ax] = max(en2 - st2, 0)
+    set_output(op, block, "Out", shape, x.dtype)
+
+
+def _slice_compute(ins, attrs, ctx, op_index):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(attrs["axes"], attrs["starts"], attrs["ends"]):
+        idx[ax] = slice(st, en)
+    return {"Out": x[tuple(idx)]}
+
+
+register_op("slice", ["Input"], ["Out"], infer=_slice_infer,
+            compute=_slice_compute)
+
+
+def _expand_infer(op, block):
+    x = in_var(op, block, "X")
+    times = op.attrs["expand_times"]
+    set_output(op, block, "Out",
+               tuple(s * t for s, t in zip(x.shape, times)), x.dtype)
+
+
+register_op(
+    "expand", ["X"], ["Out"], infer=_expand_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.tile(ins["X"][0], attrs["expand_times"])
+    },
+)
+
+register_op(
+    "reverse", ["X"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.flip(ins["X"][0], axis=tuple(attrs["axis"]))
+    },
+)
+
+
+def _pad_infer(op, block):
+    x = in_var(op, block, "X")
+    p = op.attrs["paddings"]
+    out = [s + p[2 * i] + p[2 * i + 1] for i, s in enumerate(x.shape)]
+    set_output(op, block, "Out", out, x.dtype)
+
+
+def _pad_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))}
+
+
+register_op("pad", ["X"], ["Out"], infer=_pad_infer, compute=_pad_compute)
+
+
+# -- gather / scatter -------------------------------------------------------
+
+def _gather_infer(op, block):
+    x = in_var(op, block, "X")
+    ids = in_var(op, block, "Index")
+    set_output(op, block, "Out", (ids.shape[0],) + tuple(x.shape[1:]), x.dtype)
+
+
+register_op(
+    "gather", ["X", "Index"], ["Out"], infer=_gather_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.take(ins["X"][0], ins["Index"][0].reshape(-1), axis=0)
+    },
+    no_grad_inputs=("Index",),
+)
+
+
+def _scatter_compute(ins, attrs, ctx, op_index):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.reshape(-1)
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    return {"Out": out}
+
+
+register_op(
+    "scatter", ["X", "Ids", "Updates"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=_scatter_compute, no_grad_inputs=("Ids",),
+)
+
+
+# -- one_hot / label_smooth / multiplex ------------------------------------
+
+def _one_hot_infer(op, block):
+    x = in_var(op, block, "X")
+    depth = op.attrs["depth"]
+    shape = tuple(x.shape[:-1]) + (depth,) if x.shape[-1] == 1 else \
+        tuple(x.shape) + (depth,)
+    set_output(op, block, "Out", shape, np.float32)
+
+
+def _one_hot_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    if x.shape and x.shape[-1] == 1:
+        x = x.reshape(x.shape[:-1])
+    return {"Out": jax.nn.one_hot(x, attrs["depth"], dtype=jnp.float32)}
+
+
+register_op("one_hot", ["X"], ["Out"], infer=_one_hot_infer,
+            compute=_one_hot_compute, grad=None)
+
+
+def _label_smooth_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    if ins.get("PriorDist") and ins["PriorDist"][0] is not None:
+        prior = ins["PriorDist"][0]
+        return {"Out": (1 - eps) * x + eps * prior}
+    return {"Out": (1 - eps) * x + eps / x.shape[-1]}
+
+
+register_op(
+    "label_smooth", ["X", "PriorDist"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=_label_smooth_compute,
+)
+
+
+def _multiplex_compute(ins, attrs, ctx, op_index):
+    ids = ins["Ids"][0].reshape(-1)
+    stacked = jnp.stack(ins["X"], axis=0)  # [n, batch, ...]
+    return {"Out": jnp.take_along_axis(
+        stacked, ids[None, :, None].astype(jnp.int32), axis=0
+    )[0] if stacked.ndim == 3 else stacked[ids, jnp.arange(ids.shape[0])]}
+
+
+register_op(
+    "multiplex", ["X", "Ids"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=_multiplex_compute, no_grad_inputs=("Ids",),
+)
+
+
+# -- top_k ------------------------------------------------------------------
+
+def _top_k_infer(op, block):
+    x = in_var(op, block, "X")
+    k = op.attrs["k"]
+    out = tuple(x.shape[:-1]) + (k,)
+    set_output(op, block, "Out", out, x.dtype)
+    set_output(op, block, "Indices", out, np.int64)
+
+
+def _top_k_compute(ins, attrs, ctx, op_index):
+    vals, idx = jax.lax.top_k(ins["X"][0], attrs["k"])
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+register_op("top_k", ["X"], ["Out", "Indices"], infer=_top_k_infer,
+            compute=_top_k_compute, grad=None)
+
+
+# -- argsort ----------------------------------------------------------------
+
+def _argsort_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+    set_output(op, block, "Indices", x.shape, np.int64)
+
+
+def _argsort_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(jnp.int64)}
+
+
+register_op("argsort", ["X"], ["Out", "Indices"], infer=_argsort_infer,
+            compute=_argsort_compute, grad=None)
+
+
+# -- lookup_table (embedding; lookup_table_op.cc) ---------------------------
+
+def _lookup_table_infer(op, block):
+    w = in_var(op, block, "W")
+    ids = in_var(op, block, "Ids")
+    shape = tuple(ids.shape[:-1]) + (w.shape[1],) if ids.shape[-1] == 1 \
+        else tuple(ids.shape) + (w.shape[1],)
+    set_output(op, block, "Out", shape, w.dtype)
+
+
+def _lookup_table_compute(ins, attrs, ctx, op_index):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    squeeze = ids.shape and ids.shape[-1] == 1
+    flat = ids.reshape(-1)
+    out = jnp.take(w, flat, axis=0)
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        mask = (flat != pad)[:, None]
+        out = out * mask.astype(out.dtype)
+    shape = (ids.shape[:-1] if squeeze else ids.shape) + (w.shape[1],)
+    return {"Out": out.reshape(shape)}
+
+
+register_op(
+    "lookup_table", ["W", "Ids"], ["Out"], infer=_lookup_table_infer,
+    compute=_lookup_table_compute, no_grad_inputs=("Ids",),
+)

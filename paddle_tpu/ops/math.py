@@ -1,0 +1,263 @@
+"""Linear-algebra and scalar math ops: mul, matmul, sum, scale, mean, clip...
+
+Parity: reference ``mul_op.cc``, ``matmul_op.cc``, ``sum_op.cc``,
+``scale_op.cc``, ``mean_op.cc``, ``clip_op.cc``, ``clip_by_norm_op.cc``,
+``squared_l2_norm_op.cc``, ``l1_norm_op.cc``, ``sign_op.cc``,
+``minus_op.cc``, ``cos_sim_op.cc``, ``isfinite_op.cc`` — TPU-native: every
+matmul lowers to a single ``jnp.matmul``/``lax.dot_general`` so XLA tiles it
+onto the MXU; bf16/fp16 inputs keep fp32 accumulation via
+``preferred_element_type``.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import convert_dtype, dtype_is_floating
+from ..registry import register_op, set_output, in_var, same_shape_infer
+
+
+def _flatten_to_2d(x, num_col_dims):
+    lead = 1
+    for s in x.shape[:num_col_dims]:
+        lead *= s
+    rest = 1
+    for s in x.shape[num_col_dims:]:
+        rest *= s
+    return x.reshape(lead, rest)
+
+
+def _mm_accum_dtype(a, b):
+    if a.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+# -- mul (fc's matmul: flatten then 2-D gemm; mul_op.cc) --------------------
+
+def _mul_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    xnc = op.attrs.get("x_num_col_dims", 1)
+    ync = op.attrs.get("y_num_col_dims", 1)
+    out_shape = tuple(x.shape[:xnc]) + tuple(y.shape[ync:])
+    set_output(op, block, "Out", out_shape, x.dtype)
+
+
+def _mul_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = attrs.get("x_num_col_dims", 1)
+    ync = attrs.get("y_num_col_dims", 1)
+    x2 = _flatten_to_2d(x, xnc)
+    y2 = _flatten_to_2d(y, ync)
+    out = jnp.matmul(x2, y2, preferred_element_type=_mm_accum_dtype(x2, y2))
+    out = out.astype(x.dtype)
+    return {"Out": out.reshape(tuple(x.shape[:xnc]) + tuple(y.shape[ync:]))}
+
+
+register_op("mul", ["X", "Y"], ["Out"], infer=_mul_infer, compute=_mul_compute)
+
+
+# -- matmul (batched, with transpose flags; matmul_op.cc) -------------------
+
+def _matmul_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    tx = op.attrs.get("transpose_X", False)
+    ty = op.attrs.get("transpose_Y", False)
+    xs, ys = list(x.shape), list(y.shape)
+    if len(xs) == 1:
+        xs = [1, xs[0]]
+    if len(ys) == 1:
+        ys = [ys[0], 1]
+    if tx:
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if ty:
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    batch = xs[:-2] if len(xs) > len(ys) else ys[:-2]
+    out = tuple(batch) + (xs[-2], ys[-1])
+    if len(x.shape) == 1 and len(y.shape) == 1:
+        out = (1,)
+    set_output(op, block, "Out", out, x.dtype)
+
+
+def _matmul_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]
+    tx = attrs.get("transpose_X", False)
+    ty = attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    squeeze_out = x.ndim == 1 and y.ndim == 1
+    if x.ndim == 1:
+        x = x[None, :]
+    if y.ndim == 1:
+        y = y[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=_mm_accum_dtype(x, y))
+    out = out.astype(ins["X"][0].dtype)
+    if alpha != 1.0:
+        out = out * alpha
+    if squeeze_out:
+        out = out.reshape(1)
+    return {"Out": out}
+
+
+register_op("matmul", ["X", "Y"], ["Out"], infer=_matmul_infer,
+            compute=_matmul_compute)
+
+
+# -- sum (variadic add; sum_op.cc) ------------------------------------------
+
+def _sum_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _sum_compute(ins, attrs, ctx, op_index):
+    xs = [x for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+register_op("sum", ["X"], ["Out"], infer=_sum_infer, compute=_sum_compute)
+
+
+# -- scale ------------------------------------------------------------------
+
+def _scale_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": x * scale + bias}
+    return {"Out": (x + bias) * scale}
+
+
+register_op("scale", ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
+            compute=_scale_compute)
+
+
+# -- mean (scalar [1] output like mean_op.cc) -------------------------------
+
+def _mean_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", (1,), x.dtype)
+
+
+register_op(
+    "mean", ["X"], ["Out"], infer=_mean_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.mean(ins["X"][0]).reshape(1)
+    },
+)
+
+
+# -- minus / sign -----------------------------------------------------------
+
+register_op(
+    "minus", ["X", "Y"], ["Out"], infer=same_shape_infer("X", "Out"),
+    compute=lambda ins, attrs, ctx, op_index: {"Out": ins["X"][0] - ins["Y"][0]},
+)
+
+register_op(
+    "sign", ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
+    compute=lambda ins, attrs, ctx, op_index: {"Out": jnp.sign(ins["X"][0])},
+)
+
+
+# -- clip family ------------------------------------------------------------
+
+def _clip_compute(ins, attrs, ctx, op_index):
+    return {"Out": jnp.clip(ins["X"][0], attrs["min"], attrs["max"])}
+
+
+register_op("clip", ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
+            compute=_clip_compute)
+
+
+def _clip_by_norm_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale.astype(x.dtype)}
+
+
+register_op("clip_by_norm", ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
+            compute=_clip_by_norm_compute)
+
+
+def _scalar_out_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", (1,), x.dtype)
+
+
+register_op(
+    "squared_l2_norm", ["X"], ["Out"], infer=_scalar_out_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.sum(ins["X"][0] * ins["X"][0]).reshape(1)
+    },
+)
+
+register_op(
+    "l1_norm", ["X"], ["Out"], infer=_scalar_out_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.sum(jnp.abs(ins["X"][0])).reshape(1)
+    },
+)
+
+register_op(
+    "squared_l2_distance", ["X", "Y"], ["sub_result", "Out"],
+    infer=lambda op, block: (
+        set_output(op, block, "sub_result", in_var(op, block, "X").shape,
+                   in_var(op, block, "X").dtype),
+        set_output(op, block, "Out", (in_var(op, block, "X").shape[0], 1),
+                   in_var(op, block, "X").dtype),
+    ),
+    compute=lambda ins, attrs, ctx, op_index: (
+        lambda sub: {"sub_result": sub,
+                     "Out": jnp.sum(sub * sub, axis=tuple(range(1, sub.ndim)),
+                                    keepdims=False).reshape(-1, 1)}
+    )(ins["X"][0] - ins["Y"][0]),
+)
+
+
+# -- isfinite (debugging: FLAGS_check_nan_inf parity) -----------------------
+
+register_op(
+    "isfinite", ["X"], ["Out"],
+    infer=lambda op, block: set_output(op, block, "Out", (1,), np.bool_),
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.all(
+            jnp.stack([jnp.all(jnp.isfinite(x)) for x in ins["X"]])
+        ).reshape(1)
+    },
+    grad=None,
+)
+
+
+# -- cos_sim ----------------------------------------------------------------
+
+def _cos_sim_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", (x.shape[0], 1), x.dtype)
+    set_output(op, block, "XNorm", (x.shape[0], 1), x.dtype)
+    y = in_var(op, block, "Y")
+    set_output(op, block, "YNorm", (y.shape[0], 1), y.dtype)
+
+
+def _cos_sim_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    out = jnp.sum(x * y, axis=-1, keepdims=True) / (xn * yn)
+    return {"Out": out, "XNorm": xn, "YNorm": yn}
+
+
+register_op("cos_sim", ["X", "Y"], ["Out", "XNorm", "YNorm"],
+            infer=_cos_sim_infer, compute=_cos_sim_compute)

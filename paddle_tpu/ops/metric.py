@@ -1,0 +1,102 @@
+"""In-graph metric ops: accuracy, auc, mean_iou, precision/recall support.
+
+Parity: reference ``accuracy_op.cc``, ``auc_op.cc``, ``mean_iou_op.cc`` —
+metrics run inside the jitted step (no host round-trip), accumulation
+states are persistable scope vars like the reference's evaluator states.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var
+
+
+def _accuracy_infer(op, block):
+    set_output(op, block, "Accuracy", (1,), np.float32)
+    set_output(op, block, "Correct", (1,), np.int32)
+    set_output(op, block, "Total", (1,), np.int32)
+
+
+def _accuracy_compute(ins, attrs, ctx, op_index):
+    indices = ins["Indices"][0]  # [N, k] from top_k
+    label = ins["Label"][0]      # [N, 1]
+    hit = jnp.any(indices == label.astype(indices.dtype), axis=-1)
+    correct = jnp.sum(hit.astype(jnp.int32)).reshape(1)
+    total = jnp.asarray([indices.shape[0]], dtype=jnp.int32)
+    acc = correct.astype(jnp.float32) / indices.shape[0]
+    return {"Accuracy": acc, "Correct": correct, "Total": total}
+
+
+register_op(
+    "accuracy", ["Out", "Indices", "Label"],
+    ["Accuracy", "Correct", "Total"],
+    infer=_accuracy_infer, compute=_accuracy_compute, grad=None,
+)
+
+
+def _auc_infer(op, block):
+    set_output(op, block, "AUC", (1,), np.float64)
+    bins = op.attrs.get("num_thresholds", 4095) + 1
+    set_output(op, block, "StatPosOut", (bins,), np.int64)
+    set_output(op, block, "StatNegOut", (bins,), np.int64)
+
+
+def _auc_compute(ins, attrs, ctx, op_index):
+    """Streaming AUC via threshold-bucketed TP/FP histograms
+    (auc_op.cc redesigned: vectorized bincount instead of loops)."""
+    preds = ins["Predict"][0]  # [N, 2] binary probabilities
+    label = ins["Label"][0].reshape(-1)
+    stat_pos = ins["StatPos"][0]
+    stat_neg = ins["StatNeg"][0]
+    n_bins = stat_pos.shape[0]
+    p = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 else preds.reshape(-1)
+    idx = jnp.clip((p * (n_bins - 1)).astype(jnp.int32), 0, n_bins - 1)
+    pos = (label > 0).astype(jnp.int64)
+    stat_pos = stat_pos + jnp.zeros_like(stat_pos).at[idx].add(pos)
+    stat_neg = stat_neg + jnp.zeros_like(stat_neg).at[idx].add(1 - pos)
+    # integrate ROC from histograms (descending threshold)
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tot_pos = tp[-1].astype(jnp.float64)
+    tot_neg = fp[-1].astype(jnp.float64)
+    tpr = tp.astype(jnp.float64) / jnp.maximum(tot_pos, 1)
+    fpr = fp.astype(jnp.float64) / jnp.maximum(tot_neg, 1)
+    auc = jnp.trapezoid(tpr, fpr).reshape(1)
+    return {"AUC": auc, "StatPosOut": stat_pos, "StatNegOut": stat_neg}
+
+
+register_op(
+    "auc", ["Predict", "Label", "StatPos", "StatNeg"],
+    ["AUC", "StatPosOut", "StatNegOut"],
+    infer=_auc_infer, compute=_auc_compute, grad=None,
+)
+
+
+def _mean_iou_compute(ins, attrs, ctx, op_index):
+    pred = ins["Predictions"][0].reshape(-1)
+    label = ins["Labels"][0].reshape(-1)
+    n = attrs["num_classes"]
+    inter = jnp.zeros((n,), jnp.int64).at[
+        jnp.where(pred == label, pred, n - 1)
+    ].add((pred == label).astype(jnp.int64))
+    pred_cnt = jnp.zeros((n,), jnp.int64).at[pred].add(1)
+    label_cnt = jnp.zeros((n,), jnp.int64).at[label].add(1)
+    union = pred_cnt + label_cnt - inter
+    iou = inter.astype(jnp.float32) / jnp.maximum(union, 1).astype(jnp.float32)
+    valid = (union > 0).astype(jnp.float32)
+    mean_iou = (jnp.sum(iou * valid) / jnp.maximum(jnp.sum(valid), 1)).reshape(1)
+    return {"OutMeanIou": mean_iou, "OutWrong": (label_cnt - inter).astype(jnp.int32),
+            "OutCorrect": inter.astype(jnp.int32)}
+
+
+register_op(
+    "mean_iou", ["Predictions", "Labels"],
+    ["OutMeanIou", "OutWrong", "OutCorrect"],
+    infer=lambda op, block: (
+        set_output(op, block, "OutMeanIou", (1,), np.float32),
+        set_output(op, block, "OutWrong", (op.attrs["num_classes"],), np.int32),
+        set_output(op, block, "OutCorrect", (op.attrs["num_classes"],), np.int32),
+    ),
+    compute=_mean_iou_compute, grad=None,
+)

@@ -1,0 +1,88 @@
+"""Stochastic regularization ops: dropout, random_crop, sampling_id.
+
+Parity: reference ``dropout_op.cc`` (attrs dropout_prob, is_test,
+dropout_implementation ∈ {downgrade_in_infer, upscale_in_train}),
+``sampling_id_op.cc`` — TPU-native: masks come from the executor-threaded
+counter PRNG; dropout registers a *custom* grad (consuming the saved Mask)
+since the generic vjp path would re-draw randomness.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import grad_var_name
+from ..registry import register_op, set_output, in_var
+
+
+def _dropout_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+    set_output(op, block, "Mask", x.shape, x.dtype)
+
+
+def _dropout_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if attrs.get("is_test", False):
+        if impl == "upscale_in_train":
+            return {"Out": x, "Mask": jnp.ones_like(x)}
+        return {"Out": x * (1.0 - p), "Mask": jnp.ones_like(x)}
+    key = ctx.rng_key(op_index)
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        mask = keep.astype(x.dtype) / max(1.0 - p, 1e-8)
+    else:
+        mask = keep.astype(x.dtype)
+    return {"Out": x * mask, "Mask": mask}
+
+
+def _dropout_grad_maker(op, no_grad_set):
+    # NOTE: out-grad input slots MUST use the "GRAD::" prefix so backward.py
+    # materializes (sums) accumulated contributions before this op reads them
+    x = op.inputs["X"][0]
+    if x in no_grad_set:
+        return []
+    return [dict(
+        type="dropout_mask_grad",
+        inputs={"Mask": [op.outputs["Mask"][0]],
+                "GRAD::Out": [grad_var_name(op.outputs["Out"][0])]},
+        outputs={"GRAD::X": [grad_var_name(x)]},
+        attrs={},
+    )]
+
+
+register_op(
+    "dropout", ["X"], ["Out", "Mask"], infer=_dropout_infer,
+    compute=_dropout_compute, grad=_dropout_grad_maker, stateful_random=True,
+)
+
+
+def _dropout_mask_grad_infer(op, block):
+    m = in_var(op, block, "Mask")
+    set_output(op, block, "GRAD::X", m.shape, m.dtype)
+
+
+register_op(
+    "dropout_mask_grad", ["Mask", "GRAD::Out"], ["GRAD::X"],
+    infer=_dropout_mask_grad_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "GRAD::X": ins["GRAD::Out"][0] * ins["Mask"][0]
+    },
+    grad=None,
+)
+
+
+def _sampling_id_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]  # [batch, n] probabilities
+    key = ctx.rng_key(op_index)
+    ids = jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
+    return {"Out": ids.astype(jnp.int64)}
+
+
+register_op(
+    "sampling_id", ["X"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", (in_var(op, block, "X").shape[0],), "int64"),
+    compute=_sampling_id_compute, grad=None, stateful_random=True,
+)

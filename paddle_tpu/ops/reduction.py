@@ -1,0 +1,103 @@
+"""Reduction ops: reduce_{sum,mean,max,min,prod}, argmax/argmin, cumsum.
+
+Parity: reference ``reduce_*_op.cc``, ``arg_max_op.cc``, ``arg_min_op.cc``,
+``cumsum_op.cc`` — TPU-native jnp reductions (XLA lowers to tree reductions
+on the VPU; deterministic by construction, the analog of
+FLAGS_cpu_deterministic).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var
+
+
+def _reduce_infer(op, block):
+    x = in_var(op, block, "X")
+    dims = op.attrs.get("dim", [0])
+    keep = op.attrs.get("keep_dim", False)
+    if op.attrs.get("reduce_all", False):
+        out = (1,) if not keep else (1,) * len(x.shape)
+    else:
+        dims = [d % len(x.shape) for d in dims]
+        if keep:
+            out = tuple(1 if i in dims else s for i, s in enumerate(x.shape))
+        else:
+            out = tuple(s for i, s in enumerate(x.shape) if i not in dims)
+            if not out:
+                out = (1,)
+    set_output(op, block, "Out", out, x.dtype)
+
+
+def _make_reduce(name, fn):
+    def compute(ins, attrs, ctx, op_index):
+        x = ins["X"][0]
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False):
+            out = fn(x, axis=None, keepdims=keep)
+            if not keep:
+                out = out.reshape(1)
+            return {"Out": out}
+        dims = tuple(d % x.ndim for d in attrs.get("dim", [0]))
+        out = fn(x, axis=dims, keepdims=keep)
+        if out.ndim == 0:
+            out = out.reshape(1)
+        return {"Out": out}
+
+    register_op(name, ["X"], ["Out"], infer=_reduce_infer, compute=compute)
+
+
+_make_reduce("reduce_sum", jnp.sum)
+_make_reduce("reduce_mean", jnp.mean)
+_make_reduce("reduce_max", jnp.max)
+_make_reduce("reduce_min", jnp.min)
+_make_reduce("reduce_prod", jnp.prod)
+
+
+def _arg_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attrs.get("axis", 0) % len(x.shape)
+    out = tuple(s for i, s in enumerate(x.shape) if i != axis)
+    set_output(op, block, "Out", out or (1,), np.int64)
+
+
+def _make_arg(name, fn):
+    register_op(
+        name, ["X"], ["Out"], infer=_arg_infer,
+        compute=lambda ins, attrs, ctx, op_index: {
+            "Out": fn(ins["X"][0], axis=attrs.get("axis", 0)).astype(jnp.int64)
+        },
+        grad=None,
+    )
+
+
+_make_arg("arg_max", jnp.argmax)
+_make_arg("arg_min", jnp.argmin)
+
+
+def _cumsum_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis=axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (1, 0)
+        out = jnp.pad(out, pad)[
+            tuple(slice(0, s) if i == axis % x.ndim else slice(None)
+                  for i, s in enumerate(x.shape))
+        ]
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis=axis)
+    return {"Out": out}
+
+
+register_op(
+    "cumsum", ["X"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=_cumsum_compute,
+)

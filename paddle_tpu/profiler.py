@@ -1,0 +1,149 @@
+"""Profiler: host spans + device (XLA) tracing with chrome-trace export.
+
+Parity: reference ``platform/profiler.{h,cc}`` (RecordEvent spans wrapping
+every op run), ``platform/device_tracer`` (CUPTI kernel timestamps),
+``tools/timeline.py`` (chrome://tracing export), and the Python context
+managers ``fluid/profiler.py:221`` — TPU-native: device-side tracing
+delegates to ``jax.profiler`` (XPlane/TensorBoard), host-side named spans
+are collected here and exported as chrome-trace JSON directly.
+"""
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "RecordEvent", "record_event", "profiler", "start_profiler",
+    "stop_profiler", "reset_profiler", "export_chrome_tracing",
+    "cuda_profiler", "npu_profiler",
+]
+
+_state = threading.local()
+_events = []
+_events_lock = threading.Lock()
+_enabled = [False]
+_jax_trace_dir = [None]
+
+
+def _now_us():
+    return time.perf_counter_ns() / 1000.0
+
+
+class RecordEvent:
+    """RAII span (reference profiler.h:89 RecordEvent)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.t0 = None
+
+    def __enter__(self):
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if not _enabled[0]:
+            return False
+        t1 = _now_us()
+        with _events_lock:
+            _events.append({
+                "name": self.name,
+                "ts": self.t0,
+                "dur": t1 - self.t0,
+                "ph": "X",
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+            })
+        return False
+
+
+record_event = RecordEvent
+
+
+def start_profiler(state="All", trace_dir=None):
+    """state ∈ {CPU, GPU, All} for parity; device tracing uses
+    jax.profiler when a trace_dir is given."""
+    _enabled[0] = True
+    if trace_dir and state in ("GPU", "All"):
+        import jax
+
+        _jax_trace_dir[0] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    _enabled[0] = False
+    if _jax_trace_dir[0]:
+        import jax
+
+        jax.profiler.stop_trace()
+        _jax_trace_dir[0] = None
+    if profile_path:
+        export_chrome_tracing(profile_path)
+    _print_summary(sorted_key)
+
+
+def reset_profiler():
+    with _events_lock:
+        _events.clear()
+
+
+def export_chrome_tracing(path):
+    """Write collected host spans as chrome://tracing JSON
+    (tools/timeline.py parity)."""
+    with _events_lock:
+        events = list(_events)
+    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def _print_summary(sorted_key=None):
+    with _events_lock:
+        events = list(_events)
+    if not events:
+        return
+    totals = {}
+    for e in events:
+        t = totals.setdefault(e["name"], [0.0, 0, 0.0])
+        t[0] += e["dur"]
+        t[1] += 1
+        t[2] = max(t[2], e["dur"])
+    rows = [
+        (name, tot / 1000.0, cnt, tot / cnt / 1000.0, mx / 1000.0)
+        for name, (tot, cnt, mx) in totals.items()
+    ]
+    key = {"total": 1, "calls": 2, "ave": 3, "max": 4}.get(sorted_key, 1)
+    rows.sort(key=lambda r: r[key], reverse=True)
+    print("%-40s %12s %8s %12s %12s" % ("Event", "total(ms)", "calls",
+                                        "avg(ms)", "max(ms)"))
+    for name, tot, cnt, avg, mx in rows[:50]:
+        print("%-40s %12.3f %8d %12.3f %12.3f" % (name, tot, cnt, avg, mx))
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             trace_dir=None):
+    """Context manager parity with fluid.profiler.profiler (profiler.py:221)."""
+    reset_profiler()
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):
+    """Reference nvprof hook (profiler.py:39); on TPU this aliases to the
+    jax trace-based profiler."""
+    with profiler():
+        yield
+
+
+npu_profiler = cuda_profiler

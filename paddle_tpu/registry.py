@@ -1,0 +1,360 @@
+"""Operator registry: shape/dtype inference + JAX compute + gradient makers.
+
+Capability parity with the reference's op registry stack
+(``paddle/fluid/framework/op_registry.h``, ``op_info.cc``,
+``grad_op_desc_maker.h``, and OperatorWithKernel dispatch
+``operator.h:315``), re-designed TPU-first:
+
+* An op's *kernel* is a pure JAX function ``compute(ins, attrs, ctx)`` where
+  ``ins`` maps input slot -> list of jax arrays.  There is no per-device
+  kernel dispatch (OpKernelType, operator.cc:672): XLA owns placement and
+  fusion; a single traceable function covers CPU/TPU, and Pallas kernels
+  slot in as alternative compute bodies for hot ops (see ``ops/pallas/``).
+* Gradients: instead of 300 hand-written grad kernels, the default grad maker
+  wires a generic ``<type>_grad`` op whose kernel re-runs the forward under
+  ``jax.vjp`` and applies the output cotangents.  Because the whole program
+  is one traced jaxpr, XLA CSE merges the recomputed forward with the
+  original — the recompute is free in the compiled HLO.  Ops that must not
+  be re-executed (stateful randomness like dropout) register custom grad
+  makers that consume saved forward outputs (e.g. the dropout mask), exactly
+  the cases where the reference saves intermediates too.
+* Shape inference (``infer``) runs at append time; it must handle -1 batch
+  dims.  This is the build-time half of the reference's InferShape.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core import convert_dtype, dtype_is_floating
+from .framework import grad_var_name
+
+__all__ = [
+    "OpDef",
+    "register_op",
+    "get_op_def",
+    "infer_op",
+    "compute_op",
+    "make_grad_ops",
+    "OPS",
+]
+
+OPS = {}
+
+
+class ComputeContext:
+    """Per-trace context handed to kernels: PRNG key material and flags."""
+
+    def __init__(self, key=None, is_test=False):
+        self._key = key
+        self.is_test = is_test
+
+    def rng_key(self, op_index):
+        if self._key is None:
+            raise RuntimeError(
+                "op requires randomness but the executor provided no PRNG key"
+            )
+        return jax.random.fold_in(self._key, op_index)
+
+
+class OpDef:
+    def __init__(
+        self,
+        type,
+        inputs,
+        outputs,
+        infer,
+        compute,
+        grad=None,
+        no_grad_inputs=(),
+        stateful_random=False,
+        doc="",
+    ):
+        self.type = type
+        self.input_slots = tuple(inputs)
+        self.output_slots = tuple(outputs)
+        self.infer = infer
+        self.compute = compute
+        # grad: None => not differentiable; "auto" => generic vjp;
+        #       callable(op, block, no_grad_set) -> list of op-spec dicts
+        self.grad = grad
+        self.no_grad_inputs = frozenset(no_grad_inputs)
+        self.stateful_random = stateful_random
+        self.doc = doc
+
+
+def register_op(
+    type,
+    inputs,
+    outputs,
+    infer,
+    compute,
+    grad="auto",
+    no_grad_inputs=(),
+    stateful_random=False,
+    doc="",
+):
+    if type in OPS:
+        raise ValueError("op type %r already registered" % type)
+    OPS[type] = OpDef(
+        type, inputs, outputs, infer, compute, grad, no_grad_inputs,
+        stateful_random, doc,
+    )
+    return OPS[type]
+
+
+def get_op_def(type):
+    if type not in OPS:
+        raise KeyError("op type %r is not registered" % type)
+    return OPS[type]
+
+
+def infer_op(op, block):
+    """Run build-time shape/dtype inference for ``op`` in ``block``."""
+    d = get_op_def(op.type)
+    if d.infer is not None:
+        d.infer(op, block)
+
+
+def compute_op(op, env, ctx, op_index=0):
+    """Execute one op inside a trace: read inputs from env, write outputs."""
+    d = get_op_def(op.type)
+    # empty names are "holes" (e.g. pruned grad slots): pass/collect None
+    ins = {
+        slot: [env[n] if n else None for n in names]
+        for slot, names in op.inputs.items()
+    }
+    outs = d.compute(ins, op.attrs, ctx, op_index)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        for name, val in zip(names, vals):
+            if name:
+                env[name] = val
+    return env
+
+
+# --------------------------------------------------------------------------
+# Generic gradient machinery
+# --------------------------------------------------------------------------
+
+GENERIC_GRAD_SUFFIX = "_grad"
+
+
+def make_grad_ops(op, no_grad_set):
+    """Return a list of grad-op specs for a forward op, or [] if none.
+
+    A spec is a dict(type=..., inputs=..., outputs=..., attrs=...) with
+    variable *names*.  Mirrors the reference's GradOpDescMaker protocol
+    (grad_op_desc_maker.h) driven from backward.py.
+    """
+    d = get_op_def(op.type)
+    if d.grad is None:
+        return []
+    if callable(d.grad):
+        return d.grad(op, no_grad_set)
+    if d.grad == "auto":
+        return _auto_grad_maker(op, no_grad_set)
+    raise ValueError("bad grad spec for op %r" % op.type)
+
+
+def _auto_grad_maker(op, no_grad_set):
+    """Default grad maker: one ``<type>_grad`` op taking all forward inputs,
+    forward outputs, and output grads; producing input grads."""
+    d = get_op_def(op.type)
+    g_inputs = {}
+    for slot, names in op.inputs.items():
+        g_inputs[slot] = list(names)
+    for slot, names in op.outputs.items():
+        g_inputs["Out::" + slot] = list(names)
+        g_inputs["GRAD::" + slot] = [grad_var_name(n) for n in names]
+    g_outputs = {}
+    any_grad = False
+    for slot, names in op.inputs.items():
+        if slot in d.no_grad_inputs:
+            continue
+        outs = []
+        for n in names:
+            if n in no_grad_set:
+                outs.append("")  # hole: grad not needed
+            else:
+                outs.append(grad_var_name(n))
+                any_grad = True
+        g_outputs["GRAD::" + slot] = outs
+    if not any_grad:
+        return []
+    attrs = dict(op.attrs)
+    attrs["__fwd_type__"] = op.type
+    return [
+        dict(
+            type=op.type + GENERIC_GRAD_SUFFIX,
+            inputs=g_inputs,
+            outputs=g_outputs,
+            attrs=attrs,
+        )
+    ]
+
+
+def _generic_grad_infer(gop, block):
+    """Grad vars mirror the shape/dtype of their forward vars."""
+    fwd_slots = [s for s in gop.inputs if not s.startswith(("Out::", "GRAD::"))]
+    for slot in fwd_slots:
+        out_slot = "GRAD::" + slot
+        if out_slot not in gop.outputs:
+            continue
+        for fwd_name, g_name in zip(gop.inputs[slot], gop.outputs[out_slot]):
+            if not g_name:
+                continue
+            fwd_var = block._find_var_recursive(fwd_name)
+            if fwd_var is None:
+                continue
+            block.create_var(
+                name=g_name,
+                shape=fwd_var.shape,
+                dtype=fwd_var.dtype,
+                persistable=False,
+            )
+
+
+def _generic_grad_compute(ins, attrs, ctx, op_index):
+    fwd_type = attrs["__fwd_type__"]
+    fwd_def = get_op_def(fwd_type)
+    fwd_attrs = {k: v for k, v in attrs.items() if k != "__fwd_type__"}
+
+    primal_ins = {
+        slot: vals
+        for slot, vals in ins.items()
+        if not slot.startswith(("Out::", "GRAD::"))
+    }
+    # differentiate only w.r.t. floating-point inputs
+    diff_slots = []
+    for slot, vals in primal_ins.items():
+        if slot in fwd_def.no_grad_inputs:
+            continue
+        if all(dtype_is_floating(v.dtype) for v in vals) and vals:
+            diff_slots.append(slot)
+
+    def fwd_fn(diff_vals):
+        full = dict(primal_ins)
+        full.update(diff_vals)
+        outs = fwd_def.compute(full, fwd_attrs, ctx, op_index)
+        # canonicalize: slot -> list
+        canon = {}
+        for slot in fwd_def.output_slots:
+            v = outs.get(slot)
+            if v is None:
+                continue
+            canon[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+        return canon
+
+    diff_vals = {slot: primal_ins[slot] for slot in diff_slots}
+    outs, vjp_fn = jax.vjp(fwd_fn, diff_vals)
+
+    # build cotangents: use provided GRAD:: slots, zeros elsewhere
+    cts = {}
+    for slot, vals in outs.items():
+        gslot = "GRAD::" + slot
+        if gslot in ins and ins[gslot]:
+            gvals = ins[gslot]
+            cts[slot] = [
+                g if g is not None else jnp.zeros_like(v)
+                for g, v in zip(gvals, vals)
+            ]
+        else:
+            cts[slot] = [jnp.zeros_like(v) for v in vals]
+
+    (grads,) = vjp_fn(cts)
+
+    result = {}
+    for slot in diff_slots:
+        result["GRAD::" + slot] = grads[slot]
+    return result
+
+
+class _GenericGradRegistrar:
+    """Lazily register ``<type>_grad`` op defs the first time they appear."""
+
+    @staticmethod
+    def ensure(grad_type):
+        if grad_type in OPS:
+            return
+        if not grad_type.endswith(GENERIC_GRAD_SUFFIX):
+            raise KeyError(grad_type)
+        fwd_type = grad_type[: -len(GENERIC_GRAD_SUFFIX)]
+        if fwd_type not in OPS:
+            raise KeyError(grad_type)
+        OPS[grad_type] = OpDef(
+            grad_type,
+            inputs=(),
+            outputs=(),
+            infer=_generic_grad_infer,
+            compute=_generic_grad_compute,
+            grad=None,
+            doc="auto-vjp gradient of %s" % fwd_type,
+        )
+
+
+_orig_get = get_op_def
+
+
+def get_op_def(type):  # noqa: F811 — wraps to lazily add _grad defs
+    if type not in OPS and type.endswith(GENERIC_GRAD_SUFFIX):
+        try:
+            _GenericGradRegistrar.ensure(type)
+        except KeyError:
+            pass
+    if type not in OPS:
+        raise KeyError("op type %r is not registered" % type)
+    return OPS[type]
+
+
+# --------------------------------------------------------------------------
+# Shape-inference helpers shared by op definitions
+# --------------------------------------------------------------------------
+
+def set_output(op, block, slot, shape, dtype, lod_level=0):
+    """Create/refresh the output var for slot (single-var slots)."""
+    names = op.outputs.get(slot, [])
+    for name in names:
+        v = block._find_var_recursive(name)
+        if v is None:
+            v = block.create_var(name=name)
+        v.shape = tuple(int(s) for s in shape) if shape is not None else None
+        v.dtype = convert_dtype(dtype) if dtype is not None else None
+        v.lod_level = lod_level
+
+
+def in_var(op, block, slot, idx=0):
+    names = op.inputs.get(slot, [])
+    if not names:
+        return None
+    return block._find_var_recursive(names[idx])
+
+
+def same_shape_infer(in_slot, out_slot):
+    def infer(op, block):
+        x = in_var(op, block, in_slot)
+        set_output(op, block, out_slot, x.shape, x.dtype, x.lod_level)
+
+    return infer
+
+
+def broadcast_shapes(s1, s2):
+    """Numpy-style broadcast of shapes with -1 (dynamic) dims propagated."""
+    out = []
+    for a, b in zip(reversed(s1), reversed(s2)):
+        if a == -1 or b == -1:
+            out.append(-1 if (a in (-1, 1) and b in (-1, 1)) else max(a, b))
+        elif a == 1:
+            out.append(b)
+        elif b == 1 or a == b:
+            out.append(a)
+        else:
+            raise ValueError("cannot broadcast %s with %s" % (s1, s2))
+    longer = s1 if len(s1) > len(s2) else s2
+    out.extend(reversed(longer[: abs(len(s1) - len(s2))]))
+    return tuple(reversed(out))

@@ -1,0 +1,84 @@
+"""Scope: the runtime store of variable values.
+
+Capability parity with the reference's hierarchical Scope
+(``paddle/fluid/framework/scope.h:41``: name->Variable map with parent
+lookup and kid scopes) — TPU-native: values are jax Arrays (committed to
+devices by the executor), the map is a plain dict, and kid scopes are used
+for executor-local temporaries.
+"""
+
+import contextlib
+
+__all__ = ["Scope", "global_scope", "scope_guard"]
+
+
+class Scope:
+    def __init__(self, parent=None):
+        self._vars = {}
+        self.parent = parent
+        self.kids = []
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self.kids = []
+
+    def set_var(self, name, value):
+        self._vars[name] = value
+
+    def has_var(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return True
+            s = s.parent
+        return False
+
+    def find_var(self, name):
+        """Find in this scope or ancestors (scope.h FindVar)."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def var(self, name):
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError("variable %r not found in scope" % name)
+        return v
+
+    def erase(self, name):
+        self._vars.pop(name, None)
+
+    def local_var_names(self):
+        return list(self._vars.keys())
+
+    def items(self):
+        return self._vars.items()
+
+    def __contains__(self, name):
+        return self.has_var(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Temporarily swap the global scope (reference executor.py:47)."""
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev

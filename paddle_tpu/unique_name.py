@@ -1,0 +1,50 @@
+"""Unique name generator for program variables and ops.
+
+Capability parity with the reference's ``python/paddle/fluid/unique_name.py``
+(name uniquifying with prefix counters, guard-based scoping) — re-designed, not
+ported: a plain counter map per generator with context-manager switching.
+"""
+
+import contextlib
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class UniqueNameGenerator:
+    """Generates names like ``fc_0.w_0``, ``tmp_3`` from per-prefix counters."""
+
+    def __init__(self, prefix=""):
+        self.prefix = prefix
+        self.ids = {}
+
+    def __call__(self, key):
+        if key not in self.ids:
+            self.ids[key] = 0
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key):
+    return generator(key)
+
+
+def switch(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

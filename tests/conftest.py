@@ -1,0 +1,34 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so
+multi-chip sharding tests run without TPU hardware (SURVEY.md §4 TPU
+translation of the reference's multi-device test strategy)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Isolate each test: fresh default programs, scope, and name counter
+    (the reference achieves this with new Program() per test; we reset the
+    singletons)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import framework, unique_name
+    from paddle_tpu.scope import Scope
+
+    old_main = framework.switch_main_program(fluid.Program())
+    old_startup = framework.switch_startup_program(fluid.Program())
+    old_gen = unique_name.switch()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        yield
+    framework.switch_main_program(old_main)
+    framework.switch_startup_program(old_startup)
+    unique_name.switch(old_gen)

@@ -1,0 +1,160 @@
+"""OpTest: the golden per-op test harness.
+
+Replicates the reference's ``python/paddle/fluid/tests/unittests/op_test.py``
+pattern (op_test.py:131): build a one-op program from numpy inputs, check
+forward against a numpy oracle (check_output), and check analytic gradients
+(program-level append_backward) against numeric central differences
+(check_grad, get_numeric_gradient:43) — parameterized over places.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import grad_var_name
+
+
+class OpTest:
+    """Subclass sets: op_type, inputs {slot: ndarray | [(name, ndarray)]},
+    attrs {}, outputs {slot: ndarray | [(name, ndarray)]}."""
+
+    op_type = None
+    inputs = {}
+    attrs = {}
+    outputs = {}
+
+    # ------------------------------------------------------------------
+    def _canon(self, mapping):
+        out = {}
+        for slot, v in mapping.items():
+            if isinstance(v, (list, tuple)) and v and isinstance(v[0], tuple):
+                out[slot] = [(name, np.asarray(a)) for name, a in v]
+            elif v is None:
+                out[slot] = []
+            else:
+                out[slot] = [("%s__%s" % (self.op_type, slot), np.asarray(v))]
+        return out
+
+    def _build(self, stop_gradient_all=False):
+        program = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(program, startup):
+            block = program.global_block()
+            ins = self._canon(self.inputs)
+            outs = self._canon(self.outputs)
+            in_map = {}
+            feed = {}
+            for slot, pairs in ins.items():
+                names = []
+                for name, arr in pairs:
+                    block.create_var(
+                        name=name, shape=arr.shape, dtype=arr.dtype,
+                        stop_gradient=stop_gradient_all, is_data=True,
+                    )
+                    feed[name] = arr
+                    names.append(name)
+                in_map[slot] = names
+            out_map = {
+                slot: [name for name, _ in pairs]
+                for slot, pairs in outs.items()
+            }
+            block.append_op(
+                type=self.op_type, inputs=in_map, outputs=out_map,
+                attrs=dict(self.attrs),
+            )
+        return program, startup, feed, outs
+
+    # ------------------------------------------------------------------
+    def check_output(self, atol=1e-5, rtol=1e-4, place=None):
+        program, startup, feed, outs = self._build(stop_gradient_all=True)
+        exe = fluid.Executor(place or fluid.CPUPlace())
+        fetch_names = [n for pairs in outs.values() for n, _ in pairs]
+        expected = [a for pairs in outs.values() for _, a in pairs]
+        results = exe.run(program, feed=feed, fetch_list=fetch_names)
+        for name, got, want in zip(fetch_names, results, expected):
+            np.testing.assert_allclose(
+                np.asarray(got, dtype=np.float64),
+                np.asarray(want, dtype=np.float64),
+                atol=atol, rtol=rtol,
+                err_msg="output %r mismatch for op %s" % (name, self.op_type),
+            )
+
+    # ------------------------------------------------------------------
+    def check_grad(self, inputs_to_check, output_name,
+                   max_relative_error=0.005, delta=5e-3, place=None,
+                   no_grad_set=None):
+        """Numeric central-difference d(sum(output))/d(input) vs the
+        analytic program gradient (reference op_test.py:check_grad)."""
+        program, startup, feed, _ = self._build(stop_gradient_all=False)
+        exe = fluid.Executor(place or fluid.CPUPlace())
+
+        # weight the output with a fixed random cotangent so the scalar loss
+        # is sensitive to every output element (plain sum is degenerate for
+        # e.g. softmax); same trick as the reference's user_defined_grads.
+        out_shape = self._canon(self.outputs)
+        shape_by_name = {
+            n: a.shape for pairs in out_shape.values() for n, a in pairs
+        }
+        w = np.random.RandomState(99).uniform(
+            0.5, 1.5, shape_by_name[output_name]).astype("float32")
+
+        def _append_loss(block):
+            block.append_op(
+                type="assign_value", outputs={"Out": ["__ct__"]},
+                attrs={"shape": list(w.shape), "dtype": "float32",
+                       "values": w.reshape(-1).tolist()},
+            )
+            block.var("__ct__").stop_gradient = True
+            block.append_op(
+                type="elementwise_mul",
+                inputs={"X": [output_name], "Y": ["__ct__"]},
+                outputs={"Out": ["__weighted__"]}, attrs={"axis": -1},
+            )
+            block.append_op(
+                type="reduce_sum", inputs={"X": ["__weighted__"]},
+                outputs={"Out": ["__loss__"]},
+                attrs={"dim": [0], "keep_dim": False, "reduce_all": True},
+            )
+
+        with fluid.program_guard(program, startup):
+            block = program.global_block()
+            _append_loss(block)
+            fluid.append_backward(block.var("__loss__"),
+                                  no_grad_set=no_grad_set)
+
+        grad_names = [grad_var_name(n) for n in inputs_to_check]
+        analytic = exe.run(program, feed=feed, fetch_list=grad_names)
+
+        # numeric gradients on the forward-only program
+        fwd_program, fwd_startup, _, _ = self._build(stop_gradient_all=True)
+        with fluid.program_guard(fwd_program, fwd_startup):
+            _append_loss(fwd_program.global_block())
+        exe2 = fluid.Executor(place or fluid.CPUPlace())
+
+        def loss_at(feed_override):
+            (val,) = exe2.run(fwd_program, feed=feed_override,
+                              fetch_list=["__loss__"])
+            return float(np.asarray(val).reshape(-1)[0])
+
+        for name, analytic_grad in zip(inputs_to_check, analytic):
+            base = feed[name].astype(np.float64)
+            numeric = np.zeros_like(base, dtype=np.float64)
+            flat = base.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                f = {k: v.copy() for k, v in feed.items()}
+                f[name] = base.copy().astype(feed[name].dtype)
+                f[name].reshape(-1)[i] = orig + delta
+                hi = loss_at(f)
+                f[name].reshape(-1)[i] = orig - delta
+                lo = loss_at(f)
+                numeric.reshape(-1)[i] = (hi - lo) / (2 * delta)
+            a = np.asarray(analytic_grad, dtype=np.float64)
+            abs_a = np.abs(a).max()
+            denom = max(abs_a, np.abs(numeric).max(), 1e-3)
+            max_diff = np.abs(a - numeric).max()
+            assert max_diff / denom <= max_relative_error, (
+                "gradient of %r wrong for op %s: max diff %g (rel %g)\n"
+                "analytic=%s\nnumeric=%s"
+                % (name, self.op_type, max_diff, max_diff / denom,
+                   a.reshape(-1)[:8], numeric.reshape(-1)[:8])
+            )

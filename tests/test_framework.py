@@ -1,0 +1,104 @@
+"""Framework-behavior tests (reference test_program.py /
+test_operator_desc.py pattern, SURVEY §4.3)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Program
+
+
+def _build_mlp():
+    img = fluid.layers.data("img", shape=[8])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(img, size=4, act="relu")
+    pred = fluid.layers.fc(h, size=3, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    return img, label, pred, loss
+
+
+def test_program_build_and_shapes():
+    img, label, pred, loss = _build_mlp()
+    main = fluid.default_main_program()
+    assert pred.shape == (-1, 3)
+    assert loss.shape == (1,)
+    op_types = [op.type for op in main.global_block().ops]
+    assert "mul" in op_types and "cross_entropy" in op_types
+    params = main.global_block().all_parameters()
+    assert len(params) == 4  # 2 weights + 2 biases
+
+
+def test_program_serialization_roundtrip():
+    _build_mlp()
+    main = fluid.default_main_program()
+    restored = Program.from_json(main.to_json())
+    assert [op.type for op in restored.global_block().ops] == [
+        op.type for op in main.global_block().ops
+    ]
+    for name, v in main.global_block().vars.items():
+        rv = restored.global_block().var(name)
+        assert rv.shape == v.shape
+        assert rv.persistable == v.persistable
+
+
+def test_clone_for_test_disables_dropout():
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.dropout(x, dropout_prob=0.5)
+    main = fluid.default_main_program()
+    test_prog = main.clone(for_test=True)
+    (dropout_op,) = [
+        op for op in test_prog.global_block().ops if op.type == "dropout"
+    ]
+    assert dropout_op.attrs["is_test"] is True
+    # original untouched
+    (orig_op,) = [
+        op for op in main.global_block().ops if op.type == "dropout"
+    ]
+    assert orig_op.attrs.get("is_test", False) is False
+
+
+def test_prune_feed_fetch():
+    img, label, pred, loss = _build_mlp()
+    main = fluid.default_main_program()
+    pruned = main.prune_feed_fetch(["img"], [pred.name])
+    types = [op.type for op in pruned.global_block().ops]
+    assert "cross_entropy" not in types
+    assert "mul" in types
+
+
+def test_executor_runs_pruned_inference():
+    img, label, pred, loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    infer = fluid.default_main_program().prune_feed_fetch(["img"], [pred.name])
+    x = np.random.RandomState(0).rand(5, 8).astype("float32")
+    (out,) = exe.run(infer, feed={"img": x}, fetch_list=[pred.name])
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out.sum(axis=1), np.ones(5), rtol=1e-5)
+
+
+def test_scope_hierarchy():
+    s = fluid.Scope()
+    s.set_var("a", np.ones(3))
+    kid = s.new_scope()
+    assert kid.find_var("a") is not None
+    kid.set_var("b", np.zeros(2))
+    assert s.find_var("b") is None
+
+
+def test_operator_repr_and_io():
+    x = fluid.layers.data("x", shape=[4])
+    out = fluid.layers.fc(x, size=2)
+    main = fluid.default_main_program()
+    mul_op = [op for op in main.global_block().ops if op.type == "mul"][0]
+    assert mul_op.input("X") == [x.name]
+    assert len(mul_op.output("Out")) == 1
+
+
+def test_program_guard_isolation():
+    p1 = fluid.Program()
+    s1 = fluid.Program()
+    with fluid.program_guard(p1, s1):
+        fluid.layers.data("z", shape=[2])
+        assert fluid.default_main_program() is p1
+    assert fluid.default_main_program() is not p1
+    assert "z" in p1.global_block().vars

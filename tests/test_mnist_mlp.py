@@ -1,0 +1,101 @@
+"""End-to-end model test: MNIST-style MLP trains and converges
+(reference tests/book/test_recognize_digits.py pattern — the BASELINE
+config-1 minimum slice)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _synthetic_mnist(rng, n):
+    x = rng.rand(n, 784).astype("float32")
+    # learnable synthetic rule: class = argmax of 10 fixed projections
+    proj = np.linspace(-1, 1, 7840).reshape(784, 10).astype("float32")
+    y = (x @ proj).argmax(axis=1).astype("int64").reshape(-1, 1)
+    return x, y
+
+
+def test_mnist_mlp_trains():
+    img = fluid.layers.data("img", shape=[784])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h1 = fluid.layers.fc(img, size=64, act="relu")
+    h2 = fluid.layers.fc(h1, size=64, act="relu")
+    pred = fluid.layers.fc(h2, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    acc = fluid.layers.accuracy(pred, label)
+    opt = fluid.optimizer.Adam(learning_rate=1e-3)
+    opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(42)
+    first_loss = last_loss = None
+    accs = []
+    for step in range(60):
+        x, y = _synthetic_mnist(rng, 64)
+        lv, av = exe.run(feed={"img": x, "label": y},
+                         fetch_list=[loss, acc])
+        if step == 0:
+            first_loss = float(lv[0])
+        last_loss = float(lv[0])
+        accs.append(float(av[0]))
+    assert last_loss < first_loss * 0.8, (first_loss, last_loss)
+    assert np.mean(accs[-10:]) > np.mean(accs[:10])
+
+
+def test_mnist_mlp_save_load_inference(tmp_path):
+    img = fluid.layers.data("img", shape=[16])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(img, size=4, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(7)
+    x = rng.rand(8, 16).astype("float32")
+    y = rng.randint(0, 4, (8, 1)).astype("int64")
+    exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+    (ref_pred,) = exe.run(
+        fluid.default_main_program().prune_feed_fetch(["img"], [pred.name]),
+        feed={"img": x}, fetch_list=[pred.name])
+
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["img"], [pred], exe)
+
+    # fresh scope: load and compare
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        program, feed_names, fetch_vars = fluid.io.load_inference_model(
+            model_dir, exe2)
+        (loaded_pred,) = exe2.run(
+            program, feed={feed_names[0]: x},
+            fetch_list=[v.name for v in fetch_vars])
+    np.testing.assert_allclose(ref_pred, loaded_pred, rtol=1e-5)
+
+
+def test_checkpoint_save_load(tmp_path):
+    img = fluid.layers.data("img", shape=[8])
+    pred = fluid.layers.fc(img, size=2)
+    loss = fluid.layers.mean(pred)
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.RandomState(3).rand(4, 8).astype("float32")
+    for _ in range(3):
+        exe.run(feed={"img": x}, fetch_list=[loss])
+    ckpt = str(tmp_path / "ckpt")
+    fluid.io.save_checkpoint(exe, ckpt, serial=5)
+    names = [
+        v.name for v in fluid.default_main_program().list_vars()
+        if v.persistable
+    ]
+    snapshot = {n: np.asarray(fluid.global_scope().var(n)) for n in names}
+
+    with fluid.scope_guard(fluid.Scope()):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        ok = fluid.io.load_checkpoint(exe2, ckpt)
+        assert ok
+        for n, want in snapshot.items():
+            got = np.asarray(fluid.global_scope().var(n))
+            np.testing.assert_allclose(got, want)

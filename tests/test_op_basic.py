@@ -1,0 +1,366 @@
+"""Per-op golden tests (the reference's test_*_op.py pattern, SURVEY §4.2)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _softmax_np(x):
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+class TestMulOp(OpTest):
+    op_type = "mul"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(1)
+        self.inputs = {
+            "X": rng.uniform(-1, 1, (4, 5)).astype("float32"),
+            "Y": rng.uniform(-1, 1, (5, 3)).astype("float32"),
+        }
+        self.attrs = {}
+        self.outputs = {"Out": self.inputs["X"] @ self.inputs["Y"]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["mul__X", "mul__Y"], "mul__Out",
+                        max_relative_error=0.02)
+
+
+class TestMulOpFlatten(OpTest):
+    op_type = "mul"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(2)
+        x = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+        y = rng.uniform(-1, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 2, "y_num_col_dims": 1}
+        self.outputs = {"Out": (x.reshape(6, 4) @ y).reshape(2, 3, 5)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(3)
+        x = rng.uniform(-1, 1, (2, 4, 5)).astype("float32")
+        y = rng.uniform(-1, 1, (2, 3, 5)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_Y": True}
+        self.outputs = {"Out": x @ y.transpose(0, 2, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["matmul__X", "matmul__Y"], "matmul__Out",
+                        max_relative_error=0.02)
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    op_type = "elementwise_add"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(4)
+        x = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+        y = rng.uniform(-1, 1, (3,)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["elementwise_add__X", "elementwise_add__Y"],
+                        "elementwise_add__Out", max_relative_error=0.02)
+
+
+class TestElementwiseDiv(OpTest):
+    op_type = "elementwise_div"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(5)
+        x = rng.uniform(0.5, 2, (3, 4)).astype("float32")
+        y = rng.uniform(0.5, 2, (3, 4)).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["elementwise_div__X", "elementwise_div__Y"],
+                        "elementwise_div__Out", max_relative_error=0.02)
+
+
+@pytest.mark.parametrize(
+    "act,fn",
+    [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+        ("exp", np.exp),
+        ("square", lambda x: x * x),
+        ("softsign", lambda x: x / (1 + np.abs(x))),
+        ("softplus", lambda x: np.log1p(np.exp(x))),
+        ("abs", np.abs),
+    ],
+)
+def test_activation_forward(act, fn):
+    class T(OpTest):
+        op_type = act
+
+    t = T()
+    rng = np.random.RandomState(6)
+    x = rng.uniform(-2, 2, (3, 7)).astype("float32")
+    # keep away from relu/abs kink for numeric stability
+    x[np.abs(x) < 0.05] = 0.5
+    t.inputs = {"X": x}
+    t.outputs = {"Out": fn(x)}
+    t.check_output()
+    t.check_grad(["%s__X" % act], "%s__Out" % act, max_relative_error=0.03)
+
+
+class TestSoftmaxOp(OpTest):
+    op_type = "softmax"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(7)
+        x = rng.uniform(-1, 1, (5, 9)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": _softmax_np(x)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["softmax__X"], "softmax__Out",
+                        max_relative_error=0.03)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(8)
+        logits = rng.uniform(-1, 1, (6, 10)).astype("float32")
+        label = rng.randint(0, 10, (6, 1)).astype("int64")
+        sm = _softmax_np(logits)
+        loss = -np.log(sm[np.arange(6), label.reshape(-1)]).reshape(6, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(
+            ["softmax_with_cross_entropy__Logits"],
+            "softmax_with_cross_entropy__Loss", max_relative_error=0.03,
+        )
+
+
+class TestCrossEntropy(OpTest):
+    op_type = "cross_entropy"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(9)
+        x = _softmax_np(rng.uniform(-1, 1, (4, 6)).astype("float32"))
+        label = rng.randint(0, 6, (4, 1)).astype("int64")
+        y = -np.log(x[np.arange(4), label.reshape(-1)]).reshape(4, 1)
+        self.inputs = {"X": x.astype("float32"), "Label": label}
+        self.outputs = {"Y": y}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMeanOp(OpTest):
+    op_type = "mean"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(10)
+        x = rng.uniform(-1, 1, (4, 5)).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray([x.mean()], dtype="float32")}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["mean__X"], "mean__Out", max_relative_error=0.02)
+
+
+class TestSumOp(OpTest):
+    op_type = "sum"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(11)
+        a = rng.uniform(-1, 1, (3, 4)).astype("float32")
+        b = rng.uniform(-1, 1, (3, 4)).astype("float32")
+        c = rng.uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": [("sum_a", a), ("sum_b", b), ("sum_c", c)]}
+        self.outputs = {"Out": a + b + c}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["sum_a", "sum_b"], "sum__Out",
+                        max_relative_error=0.02)
+
+
+@pytest.mark.parametrize(
+    "op,np_fn",
+    [
+        ("reduce_sum", np.sum),
+        ("reduce_mean", np.mean),
+        ("reduce_max", np.max),
+        ("reduce_min", np.min),
+    ],
+)
+def test_reduce_ops(op, np_fn):
+    class T(OpTest):
+        op_type = op
+
+    t = T()
+    rng = np.random.RandomState(12)
+    x = rng.uniform(-1, 1, (3, 4, 5)).astype("float32")
+    t.inputs = {"X": x}
+    t.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+    t.outputs = {"Out": np_fn(x, axis=1)}
+    t.check_output()
+
+
+class TestReshape(OpTest):
+    op_type = "reshape"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(13)
+        x = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, -1]}
+        self.outputs = {"Out": x.reshape(2, 12)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(14)
+        x = rng.uniform(-1, 1, (2, 3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [1, 0, 2]}
+        self.outputs = {"Out": x.transpose(1, 0, 2)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["transpose__X"], "transpose__Out",
+                        max_relative_error=0.02)
+
+
+class TestConcat(OpTest):
+    op_type = "concat"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(15)
+        a = rng.uniform(-1, 1, (2, 3)).astype("float32")
+        b = rng.uniform(-1, 1, (2, 5)).astype("float32")
+        self.inputs = {"X": [("cat_a", a), ("cat_b", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["cat_a", "cat_b"], "concat__Out",
+                        max_relative_error=0.02)
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(16)
+        w = rng.uniform(-1, 1, (10, 4)).astype("float32")
+        ids = rng.randint(0, 10, (5, 1)).astype("int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids.reshape(-1)]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["lookup_table__W"], "lookup_table__Out",
+                        max_relative_error=0.02)
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(17)
+        x = rng.uniform(-1, 1, (4, 8)).astype("float32")
+        k = 3
+        idx = np.argsort(-x, axis=1)[:, :k]
+        vals = np.take_along_axis(x, idx, axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"k": k}
+        self.outputs = {
+            "Out": vals, "Indices": idx.astype("int64"),
+        }
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSigmoidCrossEntropyWithLogits(OpTest):
+    op_type = "sigmoid_cross_entropy_with_logits"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(18)
+        x = rng.uniform(-2, 2, (4, 5)).astype("float32")
+        label = rng.uniform(0, 1, (4, 5)).astype("float32")
+        loss = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": loss}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["sigmoid_cross_entropy_with_logits__X"],
+                        "sigmoid_cross_entropy_with_logits__Out",
+                        max_relative_error=0.03)
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def setup_method(self, method):
+        rng = np.random.RandomState(19)
+        x = rng.uniform(-1, 1, (3, 4)).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 0.5}
+        self.outputs = {"Out": x * 2.5 + 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["scale__X"], "scale__Out", max_relative_error=0.02)

@@ -1,0 +1,113 @@
+"""Optimizer tests: each optimizer decreases a quadratic loss and matches
+hand-computed first-step updates where cheap (reference
+test_optimizer.py pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _quadratic_problem(optimizer):
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.fc(
+        x, size=1, bias_attr=False,
+        param_attr=fluid.ParamAttr(
+            name="w0",
+            initializer=fluid.initializer.ConstantInitializer(1.0)),
+    )
+    loss = fluid.layers.mean(fluid.layers.square(y))
+    optimizer.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe, loss
+
+
+OPTIMIZERS = [
+    lambda: fluid.optimizer.SGD(learning_rate=0.05),
+    lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+    lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                     use_nesterov=True),
+    lambda: fluid.optimizer.Adagrad(learning_rate=0.3),
+    lambda: fluid.optimizer.Adam(learning_rate=0.1),
+    lambda: fluid.optimizer.Adamax(learning_rate=0.1),
+    lambda: fluid.optimizer.DecayedAdagrad(learning_rate=0.3),
+    lambda: fluid.optimizer.Adadelta(learning_rate=1.0, rho=0.95),
+    lambda: fluid.optimizer.RMSProp(learning_rate=0.05),
+    lambda: fluid.optimizer.Ftrl(learning_rate=0.5),
+]
+
+
+@pytest.mark.parametrize("make_opt", OPTIMIZERS,
+                         ids=[f().__class__.__name__ + str(i)
+                              for i, f in enumerate(OPTIMIZERS)])
+def test_optimizer_decreases_loss(make_opt):
+    exe, loss = _quadratic_problem(make_opt())
+    rng = np.random.RandomState(0)
+    xv = rng.uniform(0.5, 1.5, (16, 4)).astype("float32")
+    losses = []
+    for _ in range(25):
+        (lv,) = exe.run(feed={"x": xv}, fetch_list=[loss])
+        losses.append(float(lv[0]))
+    assert losses[-1] < losses[0] * 0.9, losses[:3] + losses[-3:]
+
+
+def test_sgd_first_step_matches_formula():
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    x = fluid.layers.data("x", shape=[2])
+    y = fluid.layers.fc(
+        x, size=1, bias_attr=False,
+        param_attr=fluid.ParamAttr(
+            name="w1",
+            initializer=fluid.initializer.ConstantInitializer(2.0)),
+    )
+    loss = fluid.layers.mean(y)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.ones((4, 2), dtype="float32")
+    exe.run(feed={"x": xv}, fetch_list=[loss])
+    w = np.asarray(fluid.global_scope().var("w1"))
+    # grad of mean(x@w) wrt w = x.mean(0) = 1 -> w = 2 - 0.1
+    np.testing.assert_allclose(w, np.full((2, 1), 1.9), rtol=1e-5)
+
+
+def test_adam_first_step_matches_formula():
+    opt = fluid.optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                               epsilon=1e-8)
+    x = fluid.layers.data("x", shape=[2])
+    y = fluid.layers.fc(
+        x, size=1, bias_attr=False,
+        param_attr=fluid.ParamAttr(
+            name="w2",
+            initializer=fluid.initializer.ConstantInitializer(2.0)),
+    )
+    loss = fluid.layers.mean(y)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.ones((4, 2), dtype="float32")
+    exe.run(feed={"x": xv}, fetch_list=[loss])
+    w = np.asarray(fluid.global_scope().var("w2"))
+    # bias-corrected adam first step with g=1: update = lr * 1 ≈ 0.1
+    np.testing.assert_allclose(w, np.full((2, 1), 1.9), rtol=1e-4)
+
+
+def test_learning_rate_variable():
+    lr = fluid.layers.tensor.create_global_var(
+        shape=[1], value=0.5, dtype="float32", persistable=True, name="lr0")
+    opt = fluid.optimizer.SGD(learning_rate=lr)
+    x = fluid.layers.data("x", shape=[2])
+    y = fluid.layers.fc(
+        x, size=1, bias_attr=False,
+        param_attr=fluid.ParamAttr(
+            name="w3",
+            initializer=fluid.initializer.ConstantInitializer(1.0)),
+    )
+    loss = fluid.layers.mean(y)
+    opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"x": np.ones((2, 2), "float32")}, fetch_list=[loss])
+    w = np.asarray(fluid.global_scope().var("w3"))
+    np.testing.assert_allclose(w, np.full((2, 1), 0.5), rtol=1e-5)

@@ -107,6 +107,37 @@ def _feed_signature(feed):
     )
 
 
+def trace_program(program, feed_names, state_names, writeback, fetch_names):
+    """Build the pure step function for ``program``'s global block:
+    ``fn(feed_vals, state_vals, key) -> (fetches, new_state)``.
+
+    This is the single lowering point shared by the single-device Executor,
+    the mesh ParallelExecutor, and ``__graft_entry__`` — a Program becomes
+    one traceable JAX function that pjit/jit compile to one HLO module.
+    Returns ``(fn, state_in, state_out)``.
+    """
+    block = program.global_block()
+    ops = list(block.ops)
+    state_in = list(state_names)
+    # every read state var is also returned so XLA donation never leaves
+    # a dangling (invalidated) buffer in the scope
+    state_out = list(dict.fromkeys(list(state_names) + list(writeback)))
+
+    def fn(feed_vals, state_vals, key):
+        env = {}
+        env.update(zip(feed_names, feed_vals))
+        env.update(zip(state_in, state_vals))
+        ctx = ComputeContext(key=key)
+        ctx.program = program
+        for i, op in enumerate(ops):
+            registry.compute_op(op, env, ctx, op_index=i)
+        fetches = [env[n] for n in fetch_names]
+        new_state = [env[n] for n in state_out]
+        return fetches, new_state
+
+    return fn, state_in, state_out
+
+
 class _CompiledProgram:
     """One lowered+jitted (program, feed-signature) entry."""
 
@@ -166,25 +197,9 @@ class Executor:
         return state, writeback
 
     def _lower(self, program, feed_names, state_names, writeback, fetch_names):
-        block = program.global_block()
-        ops = list(block.ops)
-        state_in = list(state_names)
-        # every read state var is also returned so XLA donation never leaves
-        # a dangling (invalidated) buffer in the scope
-        state_out = list(dict.fromkeys(state_names + writeback))
-
-        def fn(feed_vals, state_vals, key):
-            env = {}
-            env.update(zip(feed_names, feed_vals))
-            env.update(zip(state_in, state_vals))
-            ctx = ComputeContext(key=key)
-            ctx.program = program
-            for i, op in enumerate(ops):
-                registry.compute_op(op, env, ctx, op_index=i)
-            fetches = [env[n] for n in fetch_names]
-            new_state = [env[n] for n in state_out]
-            return fetches, new_state
-
+        fn, state_in, state_out = trace_program(
+            program, feed_names, state_names, writeback, fetch_names
+        )
         jitted = jax.jit(fn, donate_argnums=(1,))
         return _CompiledProgram(jitted, feed_names, state_in, state_out,
                                 fetch_names)

@@ -225,13 +225,19 @@ class Executor:
             v.name if isinstance(v, Variable) else v for v in fetch_list
         ]
         feed_names = sorted(feed.keys())
-        # cast feeds to declared var dtype when the program declares one
+        # cast feeds to declared var dtype when the program declares one;
+        # jax arrays already on device pass through untouched (the input-
+        # pipeline fast path: py_reader/double-buffer feeds stay device-
+        # resident instead of re-crossing the host link every step)
         block = program.global_block()
         feed_vals = []
         for n in feed_names:
-            v = np.asarray(feed[n])
+            v = feed[n]
+            if not isinstance(v, jax.Array):
+                v = np.asarray(v)
             pv = block._find_var_recursive(n)
-            if pv is not None and pv.dtype is not None and v.dtype != pv.dtype:
+            if pv is not None and pv.dtype is not None and \
+                    np.dtype(v.dtype) != np.dtype(pv.dtype):
                 v = v.astype(pv.dtype)
             feed_vals.append(v)
 

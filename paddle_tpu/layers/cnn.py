@@ -1,0 +1,364 @@
+"""CNN layers: conv2d/3d, conv2d_transpose, pool2d/3d, batch_norm,
+layer_norm, group_norm, lrn, image_resize.
+
+Parity: reference ``python/paddle/fluid/layers/nn.py`` (conv2d:1585,
+pool2d, batch_norm, layer_norm, conv2d_transpose, lrn, image_resize) —
+same signatures/semantics (NCHW, OIHW filters, groups, fused act), with
+the compute re-designed as single XLA ops (see ops/conv.py, ops/pool.py,
+ops/norm.py).
+"""
+
+from ..framework import Variable
+from ..initializer import ConstantInitializer, NormalInitializer
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "conv2d",
+    "conv3d",
+    "conv2d_transpose",
+    "pool2d",
+    "pool3d",
+    "batch_norm",
+    "layer_norm",
+    "group_norm",
+    "lrn",
+    "image_resize",
+    "resize_bilinear",
+]
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv_nd(nd, op_type, input, num_filters, filter_size, stride, padding,
+             dilation, groups, param_attr, bias_attr, use_cudnn, act, name):
+    helper = LayerHelper(op_type, input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    groups = groups or 1
+    if num_channels is not None and num_channels > 0 and \
+            num_channels % groups != 0:
+        raise ValueError("num_channels must be divisible by groups")
+
+    filter_size = _pair(filter_size, nd)
+    stride = _pair(stride, nd)
+    padding = _pair(padding, nd)
+    dilation = _pair(dilation, nd)
+
+    filter_shape = [num_filters, num_channels // groups] + filter_size
+    # reference conv2d default: Normal(0, (2/fan_in)^0.5) MSRA-style
+    fan_in = (num_channels // groups) * 1
+    for k in filter_size:
+        fan_in *= k
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        default_initializer=NormalInitializer(0.0, std),
+    )
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type=op_type,
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "use_cudnn": use_cudnn,
+        },
+    )
+    if helper.bias_attr is not None and \
+            helper.kwargs.get("bias_attr") is not False:
+        pre_act = _channel_bias(helper, pre_bias)
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act)
+
+
+def _channel_bias(helper, input_var):
+    """Per-output-channel bias on axis 1 (NCHW)."""
+    c = input_var.shape[1]
+    b = helper.create_parameter(
+        attr=helper.bias_attr, shape=[c], dtype=input_var.dtype, is_bias=True
+    )
+    tmp = helper.create_variable_for_type_inference(dtype=input_var.dtype)
+    helper.append_op(
+        type="elementwise_add",
+        inputs={"X": [input_var], "Y": [b]},
+        outputs={"Out": [tmp]},
+        attrs={"axis": 1},
+    )
+    return tmp
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    op = "depthwise_conv2d" if (
+        groups and input.shape[1] == groups and groups == num_filters
+    ) else "conv2d"
+    return _conv_nd(2, op, input, num_filters, filter_size, stride, padding,
+                    dilation, groups, param_attr, bias_attr, use_cudnn, act,
+                    name)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    return _conv_nd(3, "conv3d", input, num_filters, filter_size, stride,
+                    padding, dilation, groups, param_attr, bias_attr,
+                    use_cudnn, act, name)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", input=input,
+                         param_attr=param_attr, bias_attr=bias_attr, act=act,
+                         name=name)
+    dtype = helper.input_dtype()
+    num_channels = input.shape[1]
+    groups = groups or 1
+    stride = _pair(stride, 2)
+    padding = _pair(padding, 2)
+    dilation = _pair(dilation, 2)
+
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size must be set")
+        output_size = _pair(output_size, 2)
+        filter_size = []
+        for i in range(2):
+            in_s = input.shape[2 + i]
+            filter_size.append(
+                (output_size[i] - (in_s - 1) * stride[i] + 2 * padding[i]
+                 - 1) // dilation[i] + 1
+            )
+    else:
+        filter_size = _pair(filter_size, 2)
+
+    filter_shape = [num_channels, num_filters // groups] + filter_size
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    pre_bias = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv2d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [pre_bias]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+            "use_cudnn": use_cudnn,
+        },
+    )
+    if helper.bias_attr is not None and \
+            helper.kwargs.get("bias_attr") is not False:
+        pre_act = _channel_bias(helper, pre_bias)
+    else:
+        pre_act = pre_bias
+    return helper.append_activation(pre_act)
+
+
+def _pool_nd(nd, input, pool_size, pool_type, pool_stride, pool_padding,
+             global_pooling, use_cudnn, ceil_mode, exclusive, name):
+    if pool_type not in ("max", "avg"):
+        raise ValueError("pool_type must be 'max' or 'avg'")
+    helper = LayerHelper("pool%dd" % nd, input=input, name=name)
+    dtype = helper.input_dtype()
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="pool%dd" % nd,
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": _pair(pool_size, nd),
+            "global_pooling": global_pooling,
+            "strides": _pair(pool_stride, nd),
+            "paddings": _pair(pool_padding, nd),
+            "use_cudnn": use_cudnn,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    return _pool_nd(2, input, pool_size, pool_type, pool_stride, pool_padding,
+                    global_pooling, use_cudnn, ceil_mode, exclusive, name)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    return _pool_nd(3, input, pool_size, pool_type, pool_stride, pool_padding,
+                    global_pooling, use_cudnn, ceil_mode, exclusive, name)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=False,
+               fuse_with_relu=False, use_global_stats=False):
+    helper = LayerHelper("batch_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    param_shape = [c]
+
+    scale = helper.create_parameter(
+        attr=helper.param_attr, shape=param_shape, dtype=dtype,
+        default_initializer=ConstantInitializer(1.0),
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=param_shape, dtype=dtype, is_bias=True
+    )
+    mean = helper.create_parameter(
+        attr=ParamAttr(name=moving_mean_name,
+                       initializer=ConstantInitializer(0.0), trainable=False),
+        shape=param_shape, dtype=dtype)
+    variance = helper.create_parameter(
+        attr=ParamAttr(name=moving_variance_name,
+                       initializer=ConstantInitializer(1.0), trainable=False),
+        shape=param_shape, dtype=dtype)
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+
+    saved_mean = helper.create_variable_for_type_inference(dtype)
+    saved_variance = helper.create_variable_for_type_inference(dtype)
+    # in_place is accepted for API parity but never aliases: reusing the
+    # input name would make the auto-vjp grad re-read the normalized value
+    # as X and silently corrupt upstream gradients. XLA buffer-reuses the
+    # dead input anyway, so there is no memory win to alias at this level.
+    out = helper.create_variable_for_type_inference(dtype)
+
+    helper.append_op(
+        type="batch_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias],
+                "Mean": [mean], "Variance": [variance]},
+        outputs={"Y": [out], "MeanOut": [mean], "VarianceOut": [variance],
+                 "SavedMean": [saved_mean],
+                 "SavedVariance": [saved_variance]},
+        attrs={"momentum": momentum, "epsilon": epsilon, "is_test": is_test,
+               "data_layout": data_layout,
+               "use_global_stats": use_global_stats},
+    )
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    param_shape = [1]
+    for s in input.shape[begin_norm_axis:]:
+        param_shape[0] *= s
+
+    inputs = {"X": [input]}
+    if scale:
+        s = helper.create_parameter(
+            attr=helper.param_attr, shape=param_shape, dtype=dtype,
+            default_initializer=ConstantInitializer(1.0),
+        )
+        inputs["Scale"] = [s]
+    if shift:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=param_shape, dtype=dtype,
+            is_bias=True,
+        )
+        inputs["Bias"] = [b]
+
+    mean_out = helper.create_variable_for_type_inference(dtype)
+    var_out = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="layer_norm", inputs=inputs,
+        outputs={"Y": [out], "Mean": [mean_out], "Variance": [var_out]},
+        attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
+    )
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(
+        attr=helper.param_attr, shape=[c], dtype=dtype,
+        default_initializer=ConstantInitializer(1.0),
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[c], dtype=dtype, is_bias=True
+    )
+    mean_out = helper.create_variable_for_type_inference(dtype)
+    var_out = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="group_norm",
+        inputs={"X": [input], "Scale": [scale], "Bias": [bias]},
+        outputs={"Y": [out], "Mean": [mean_out], "Variance": [var_out]},
+        attrs={"epsilon": epsilon, "groups": groups,
+               "data_layout": data_layout},
+    )
+    return helper.append_activation(out)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", input=input, name=name)
+    dtype = helper.input_dtype()
+    mid = helper.create_variable_for_type_inference(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="lrn", inputs={"X": [input]},
+        outputs={"Out": [out], "MidOut": [mid]},
+        attrs={"n": n, "k": k, "alpha": alpha, "beta": beta},
+    )
+    return out
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR"):
+    resample_methods = {"BILINEAR": "bilinear_interp",
+                        "NEAREST": "nearest_interp"}
+    if resample not in resample_methods:
+        raise ValueError("resample must be BILINEAR or NEAREST")
+    if out_shape is None and scale is None:
+        raise ValueError("one of out_shape and scale must be set")
+    if out_shape is not None:
+        if isinstance(out_shape, Variable):
+            raise NotImplementedError(
+                "dynamic out_shape requires static shapes under XLA"
+            )
+        out_h, out_w = int(out_shape[0]), int(out_shape[1])
+    else:
+        out_h = int(input.shape[2] * scale)
+        out_w = int(input.shape[3] * scale)
+    helper = LayerHelper("image_resize", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type=resample_methods[resample],
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"out_h": out_h, "out_w": out_w},
+    )
+    return out
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None):
+    return image_resize(input, out_shape, scale, name, "BILINEAR")

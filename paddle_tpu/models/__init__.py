@@ -1,0 +1,7 @@
+"""Model zoo mirroring the reference benchmark suite's model set
+(``benchmark/fluid/models/``: mnist, vgg, resnet, se_resnext,
+machine_translation, stacked_dynamic_lstm) — built from the paddle_tpu
+layers DSL, TPU-first (bfloat16-friendly, MXU-sized matmuls/convs).
+"""
+
+from . import mnist, resnet, se_resnext, vgg  # noqa: F401

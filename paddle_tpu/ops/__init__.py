@@ -7,13 +7,16 @@ the program-level jit, with Pallas bodies for selected hot ops.
 
 from . import (  # noqa: F401
     activation,
+    conv,
     creation,
     elementwise,
     loss,
     manipulation,
     math,
     metric,
+    norm,
     optimizer_ops,
+    pool,
     random,
     reduction,
 )

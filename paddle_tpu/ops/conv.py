@@ -1,0 +1,168 @@
+"""Convolution ops: conv2d, conv3d, conv2d_transpose, depthwise (groups).
+
+Parity: reference ``paddle/fluid/operators/conv_op.cc`` (+ cuDNN kernel
+``conv_cudnn_op.cu.cc``, ``math/im2col``), ``conv_transpose_op.cc``,
+``math/depthwise_conv.cu`` — TPU-native: one ``lax.conv_general_dilated``
+per op; XLA lowers it straight onto the MXU (no im2col materialization,
+no per-library kernel dispatch).  Layouts follow the reference's NCHW/OIHW
+API contract; XLA's layout assignment re-tiles internally for the MXU.
+
+Gradients come from the registry's auto-vjp maker — the conv transpose /
+filter-grad convs the reference hand-registers (conv2d_grad) are exactly
+what ``jax.vjp`` of ``conv_general_dilated`` emits.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _conv_out_dim(in_size, k, pad, stride, dilation):
+    if in_size is None or in_size < 0:
+        return -1
+    eff_k = dilation * (k - 1) + 1
+    return (in_size + 2 * pad - eff_k) // stride + 1
+
+
+def _conv_infer_nd(nd):
+    def infer(op, block):
+        x = in_var(op, block, "Input")
+        w = in_var(op, block, "Filter")
+        strides = _pair(op.attrs.get("strides", 1), nd)
+        pads = _pair(op.attrs.get("paddings", 0), nd)
+        dils = _pair(op.attrs.get("dilations", 1), nd)
+        out_c = w.shape[0]
+        spatial = [
+            _conv_out_dim(x.shape[2 + i], w.shape[2 + i], pads[i], strides[i],
+                          dils[i])
+            for i in range(nd)
+        ]
+        set_output(op, block, "Output",
+                   (x.shape[0], out_c, *spatial), x.dtype)
+    return infer
+
+
+def _conv_compute_nd(nd):
+    dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
+
+    def compute(ins, attrs, ctx, op_index):
+        x, w = ins["Input"][0], ins["Filter"][0]
+        strides = _pair(attrs.get("strides", 1), nd)
+        pads = _pair(attrs.get("paddings", 0), nd)
+        dils = _pair(attrs.get("dilations", 1), nd)
+        groups = attrs.get("groups", 1) or 1
+        out = lax.conv_general_dilated(
+            x, w,
+            window_strides=strides,
+            padding=[(p, p) for p in pads],
+            rhs_dilation=dils,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        return {"Output": out}
+    return compute
+
+
+register_op("conv2d", ["Input", "Filter"], ["Output"],
+            infer=_conv_infer_nd(2), compute=_conv_compute_nd(2))
+register_op("conv3d", ["Input", "Filter"], ["Output"],
+            infer=_conv_infer_nd(3), compute=_conv_compute_nd(3))
+# depthwise_conv2d is conv2d with groups == in_channels; separate op type
+# for API parity with the reference's registration
+register_op("depthwise_conv2d", ["Input", "Filter"], ["Output"],
+            infer=_conv_infer_nd(2), compute=_conv_compute_nd(2))
+
+
+# -- conv2d_transpose -------------------------------------------------------
+
+def _convt_infer(op, block):
+    x = in_var(op, block, "Input")
+    w = in_var(op, block, "Filter")  # [in_c, out_c/groups, kh, kw]
+    nd = 2
+    strides = _pair(op.attrs.get("strides", 1), nd)
+    pads = _pair(op.attrs.get("paddings", 0), nd)
+    dils = _pair(op.attrs.get("dilations", 1), nd)
+    groups = op.attrs.get("groups", 1) or 1
+    out_c = w.shape[1] * groups
+    spatial = []
+    for i in range(nd):
+        if x.shape[2 + i] is None or x.shape[2 + i] < 0:
+            spatial.append(-1)
+        else:
+            spatial.append(
+                (x.shape[2 + i] - 1) * strides[i] - 2 * pads[i]
+                + dils[i] * (w.shape[2 + i] - 1) + 1
+            )
+    set_output(op, block, "Output", (x.shape[0], out_c, *spatial), x.dtype)
+
+
+def _convt_compute(ins, attrs, ctx, op_index):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    nd = 2
+    strides = _pair(attrs.get("strides", 1), nd)
+    pads = _pair(attrs.get("paddings", 0), nd)
+    dils = _pair(attrs.get("dilations", 1), nd)
+    groups = attrs.get("groups", 1) or 1
+
+    def one_group(xg, wg):
+        # wg: [in_c/g, out_c/g, kh, kw] -> rotate spatially, swap I/O
+        wt = jnp.flip(wg, axis=(2, 3)).transpose(1, 0, 2, 3)
+        k = [wt.shape[2 + i] for i in range(nd)]
+        pad = [
+            (dils[i] * (k[i] - 1) - pads[i], dils[i] * (k[i] - 1) - pads[i])
+            for i in range(nd)
+        ]
+        return lax.conv_general_dilated(
+            xg, wt,
+            window_strides=[1] * nd,
+            padding=pad,
+            lhs_dilation=strides,
+            rhs_dilation=dils,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+
+    if groups == 1:
+        out = one_group(x, w)
+    else:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        out = jnp.concatenate(
+            [one_group(xg, wg) for xg, wg in zip(xs, ws)], axis=1
+        )
+    return {"Output": out}
+
+
+register_op("conv2d_transpose", ["Input", "Filter"], ["Output"],
+            infer=_convt_infer, compute=_convt_compute)
+
+
+# -- conv_shift (circular 1-D correlation, conv_shift_op.cc) ----------------
+
+def _conv_shift_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _conv_shift_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]  # x: [B, M], y: [B, N] (N odd, N<=M)
+    m, n = x.shape[1], y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    # out[b, i] = sum_j x[b, (i+j-half) % m] * y[b, j]
+    gathered = x[:, idx]                      # [B, M, N]
+    out = jnp.einsum("bmn,bn->bm", gathered, y)
+    return {"Out": out}
+
+
+register_op("conv_shift", ["X", "Y"], ["Out"],
+            infer=_conv_shift_infer, compute=_conv_shift_compute)

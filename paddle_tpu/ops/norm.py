@@ -1,0 +1,269 @@
+"""Normalization ops: batch_norm, layer_norm, lrn, norm (L2), group_norm.
+
+Parity: reference ``paddle/fluid/operators/batch_norm_op.{cc,cu.cc}``
+(train/infer modes, momentum moving stats, NCHW/NHWC data_layout),
+``layer_norm_op.cc`` (begin_norm_axis), ``lrn_op.cc``, ``norm_op.cc`` —
+TPU-native: each is a handful of jnp reductions that XLA fuses into one
+kernel; gradients via auto-vjp reproduce the saved-stat backward the
+reference hand-writes (vjp through rsqrt of the saved variance).
+
+batch_norm's moving-average update is part of the same traced program, so
+MeanOut/VarianceOut write back to the persistable stat vars in the scope
+(the reference does this in-place through the same-name output trick,
+python/paddle/fluid/layers/nn.py batch_norm).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+
+# -- batch_norm -------------------------------------------------------------
+
+def _bn_infer(op, block):
+    x = in_var(op, block, "X")
+    c = x.shape[1] if op.attrs.get("data_layout", "NCHW") == "NCHW" \
+        else x.shape[-1]
+    set_output(op, block, "Y", x.shape, x.dtype)
+    set_output(op, block, "MeanOut", (c,), x.dtype)
+    set_output(op, block, "VarianceOut", (c,), x.dtype)
+    set_output(op, block, "SavedMean", (c,), x.dtype)
+    set_output(op, block, "SavedVariance", (c,), x.dtype)
+
+
+def _bn_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    layout = attrs.get("data_layout", "NCHW")
+    is_test = attrs.get("is_test", False) or attrs.get("use_global_stats",
+                                                       False)
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+
+    if is_test:
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        use_mean = jnp.mean(x, axis=red_axes)
+        # two-pass variance: E[(x-mean)^2]; the one-pass E[x^2]-E[x]^2 form
+        # cancels catastrophically in f32 for un-centered inputs and can go
+        # negative -> rsqrt NaN
+        use_var = jnp.mean(
+            jnp.square(x - use_mean.reshape(bshape)), axis=red_axes
+        )
+        mean_out = momentum * mean + (1.0 - momentum) * use_mean
+        var_out = momentum * var + (1.0 - momentum) * use_var
+        saved_mean = use_mean
+        saved_var = use_var
+
+    inv_std = lax.rsqrt(use_var + eps)
+    y = (x - use_mean.reshape(bshape)) * \
+        (inv_std * scale).reshape(bshape) + bias.reshape(bshape)
+    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+            "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+
+register_op(
+    "batch_norm", ["X", "Scale", "Bias", "Mean", "Variance"],
+    ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    infer=_bn_infer, compute=_bn_compute,
+    no_grad_inputs=("Mean", "Variance"),
+)
+
+
+# -- layer_norm -------------------------------------------------------------
+
+def _ln_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attrs.get("begin_norm_axis", 1)
+    rows = x.shape[:axis]
+    set_output(op, block, "Y", x.shape, x.dtype)
+    set_output(op, block, "Mean", rows, x.dtype)
+    set_output(op, block, "Variance", rows, x.dtype)
+
+
+def _ln_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None \
+        else None
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    axis = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    red = tuple(range(axis, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=red, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    if scale is not None:
+        y = y * scale.reshape((1,) * axis + x.shape[axis:])
+    if bias is not None:
+        y = y + bias.reshape((1,) * axis + x.shape[axis:])
+    return {"Y": y, "Mean": mean.reshape(x.shape[:axis]),
+            "Variance": var.reshape(x.shape[:axis])}
+
+
+register_op(
+    "layer_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"],
+    infer=_ln_infer, compute=_ln_compute,
+)
+
+
+# -- group_norm (parity extension; reference gained it right after 0.15) ----
+
+def _gn_infer(op, block):
+    x = in_var(op, block, "X")
+    g = op.attrs.get("groups", 1)
+    set_output(op, block, "Y", x.shape, x.dtype)
+    set_output(op, block, "Mean", (x.shape[0], g), x.dtype)
+    set_output(op, block, "Variance", (x.shape[0], g), x.dtype)
+
+
+def _gn_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    scale = ins["Scale"][0] if ins.get("Scale") and ins["Scale"][0] is not None \
+        else None
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    g = attrs.get("groups", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    if attrs.get("data_layout", "NCHW") == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=red, keepdims=True)
+    y = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(bshape)
+    if bias is not None:
+        y = y + bias.reshape(bshape)
+    if attrs.get("data_layout", "NCHW") == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return {"Y": y, "Mean": mean.reshape(n, g), "Variance": var.reshape(n, g)}
+
+
+register_op(
+    "group_norm", ["X", "Scale", "Bias"], ["Y", "Mean", "Variance"],
+    infer=_gn_infer, compute=_gn_compute,
+)
+
+
+# -- lrn (local response normalization across channels) ---------------------
+
+def _lrn_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+    set_output(op, block, "MidOut", x.shape, x.dtype)
+
+
+def _lrn_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]  # NCHW
+    n = attrs.get("n", 5)
+    k = attrs.get("k", 2.0)
+    alpha = attrs.get("alpha", 1e-4)
+    beta = attrs.get("beta", 0.75)
+    half = n // 2
+    sq = jnp.square(x)
+    # sliding window sum over the channel axis
+    window_sum = lax.reduce_window(
+        sq, 0.0, lax.add, (1, n, 1, 1), (1, 1, 1, 1),
+        [(0, 0), (half, n - 1 - half), (0, 0), (0, 0)],
+    )
+    mid = k + alpha * window_sum
+    return {"Out": x * jnp.power(mid, -beta), "MidOut": mid}
+
+
+register_op("lrn", ["X"], ["Out", "MidOut"],
+            infer=_lrn_infer, compute=_lrn_compute)
+
+
+# -- norm (L2 normalize along axis; norm_op.cc) -----------------------------
+
+def _norm_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attrs.get("axis", 1)
+    nshape = list(x.shape)
+    nshape[axis] = 1
+    set_output(op, block, "Out", x.shape, x.dtype)
+    set_output(op, block, "Norm", tuple(nshape), x.dtype)
+
+
+def _norm_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / norm, "Norm": norm}
+
+
+register_op("norm", ["X"], ["Out", "Norm"],
+            infer=_norm_infer, compute=_norm_compute)
+
+
+# -- bilinear_interp (align_corners=True era semantics) ---------------------
+
+def _interp_infer(op, block):
+    x = in_var(op, block, "X")
+    oh = op.attrs.get("out_h", -1)
+    ow = op.attrs.get("out_w", -1)
+    set_output(op, block, "Out", (x.shape[0], x.shape[1], oh, ow), x.dtype)
+
+
+def _bilinear_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]  # NCHW
+    if ins.get("OutSize") and ins["OutSize"][0] is not None:
+        raise NotImplementedError(
+            "dynamic OutSize needs static shapes under XLA; set out_h/out_w"
+        )
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    n, c, h, w = x.shape
+    # align_corners=True ratios (reference bilinear_interp_op.cc at 0.15)
+    rh = (h - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    rw = (w - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    ys = jnp.arange(oh) * rh
+    xs = jnp.arange(ow) * rw
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    wy = (ys - y0).astype(x.dtype)
+    wx = (xs - x0).astype(x.dtype)
+    top = x[:, :, y0, :][:, :, :, x0] * (1 - wx) + \
+        x[:, :, y0, :][:, :, :, x1] * wx
+    bot = x[:, :, y1, :][:, :, :, x0] * (1 - wx) + \
+        x[:, :, y1, :][:, :, :, x1] * wx
+    out = top * (1 - wy)[None, None, :, None] + bot * wy[None, None, :, None]
+    return {"Out": out}
+
+
+register_op("bilinear_interp", ["X", "OutSize"], ["Out"],
+            infer=_interp_infer, compute=_bilinear_compute,
+            no_grad_inputs=("OutSize",))
+
+
+def _nearest_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    oh, ow = attrs["out_h"], attrs["out_w"]
+    n, c, h, w = x.shape
+    rh = (h - 1.0) / (oh - 1.0) if oh > 1 else 0.0
+    rw = (w - 1.0) / (ow - 1.0) if ow > 1 else 0.0
+    ys = jnp.round(jnp.arange(oh) * rh).astype(jnp.int32)
+    xs = jnp.round(jnp.arange(ow) * rw).astype(jnp.int32)
+    return {"Out": x[:, :, ys, :][:, :, :, xs]}
+
+
+register_op("nearest_interp", ["X", "OutSize"], ["Out"],
+            infer=_interp_infer, compute=_nearest_compute,
+            no_grad_inputs=("OutSize",))

@@ -1,0 +1,221 @@
+"""Pooling ops: pool2d, pool3d (max/avg, global, adaptive, ceil_mode).
+
+Parity: reference ``paddle/fluid/operators/pool_op.cc`` (+
+``pool_cudnn_op.cu.cc``, ``math/pooling.{cc,cu}``), ``spp_op.cc`` — the
+TPU-native kernel is one ``lax.reduce_window`` (XLA pools natively; the
+avg-pool ``exclusive`` mode divides by a second reduce_window over ones,
+matching the reference's exclude-padding counting).  Gradients come from
+auto-vjp (XLA emits select-and-scatter for max pool).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+
+def _seq(v, n):
+    if isinstance(v, (list, tuple)):
+        return list(v)
+    return [v] * n
+
+
+def _pool_out_dim(in_size, k, pad, stride, ceil_mode):
+    if in_size is None or in_size < 0:
+        return -1
+    if ceil_mode:
+        return -(-(in_size + 2 * pad - k) // stride) + 1
+    return (in_size + 2 * pad - k) // stride + 1
+
+
+def _pool_infer_nd(nd):
+    def infer(op, block):
+        x = in_var(op, block, "X")
+        attrs = op.attrs
+        if attrs.get("global_pooling", False):
+            spatial = [1] * nd
+        elif attrs.get("adaptive", False):
+            spatial = _seq(attrs.get("ksize"), nd)
+        else:
+            ks = _seq(attrs.get("ksize"), nd)
+            strides = _seq(attrs.get("strides", 1), nd)
+            pads = _seq(attrs.get("paddings", 0), nd)
+            ceil = attrs.get("ceil_mode", False)
+            spatial = [
+                _pool_out_dim(x.shape[2 + i], ks[i], pads[i], strides[i], ceil)
+                for i in range(nd)
+            ]
+        set_output(op, block, "Out", tuple(x.shape[:2]) + tuple(spatial),
+                   x.dtype)
+    return infer
+
+
+def _adaptive_pool(x, out_sizes, nd, is_max):
+    """Adaptive pooling: output cell i covers [floor(i*L/out), ceil((i+1)*L/out))."""
+    # pool one spatial axis at a time with static window boundaries
+    for d in range(nd):
+        axis = 2 + d
+        in_size, out_size = x.shape[axis], out_sizes[d]
+        starts = [(i * in_size) // out_size for i in range(out_size)]
+        ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+        pieces = []
+        for s, e in zip(starts, ends):
+            sl = lax.slice_in_dim(x, s, e, axis=axis)
+            red = (jnp.max if is_max else jnp.mean)(sl, axis=axis,
+                                                   keepdims=True)
+            pieces.append(red)
+        x = jnp.concatenate(pieces, axis=axis)
+    return x
+
+
+def _pool_compute_nd(nd):
+    def compute(ins, attrs, ctx, op_index):
+        x = ins["X"][0]
+        is_max = attrs.get("pooling_type", "max") == "max"
+        if attrs.get("global_pooling", False):
+            axes = tuple(range(2, 2 + nd))
+            out = (jnp.max if is_max else jnp.mean)(x, axis=axes,
+                                                    keepdims=True)
+            return {"Out": out}
+        if attrs.get("adaptive", False):
+            return {"Out": _adaptive_pool(x, _seq(attrs.get("ksize"), nd),
+                                          nd, is_max)}
+
+        ks = _seq(attrs.get("ksize"), nd)
+        strides = _seq(attrs.get("strides", 1), nd)
+        pads = _seq(attrs.get("paddings", 0), nd)
+        ceil = attrs.get("ceil_mode", False)
+        # explicit (lo, hi) padding; ceil_mode extends hi so the last window
+        # fits (reference math/pooling.cc ceil semantics)
+        pad_cfg = [(0, 0), (0, 0)]
+        for i in range(nd):
+            in_size = x.shape[2 + i]
+            out_size = _pool_out_dim(in_size, ks[i], pads[i], strides[i], ceil)
+            needed = (out_size - 1) * strides[i] + ks[i]
+            hi = max(needed - in_size - pads[i], pads[i])
+            pad_cfg.append((pads[i], hi))
+
+        window = (1, 1) + tuple(ks)
+        stride = (1, 1) + tuple(strides)
+        if is_max:
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+                jnp.iinfo(x.dtype).min
+            out = lax.reduce_window(x, init, lax.max, window, stride, pad_cfg)
+        else:
+            summed = lax.reduce_window(x, 0.0, lax.add, window, stride,
+                                       pad_cfg)
+            if attrs.get("exclusive", True):
+                ones = jnp.ones(x.shape[2:], x.dtype)
+                cnt = lax.reduce_window(
+                    ones, 0.0, lax.add, tuple(ks), tuple(strides),
+                    pad_cfg[2:]
+                )
+                out = summed / cnt[None, None]
+            else:
+                out = summed / float(int(np.prod(ks)))
+        return {"Out": out}
+    return compute
+
+
+register_op("pool2d", ["X"], ["Out"],
+            infer=_pool_infer_nd(2), compute=_pool_compute_nd(2))
+register_op("pool3d", ["X"], ["Out"],
+            infer=_pool_infer_nd(3), compute=_pool_compute_nd(3))
+
+
+# -- pool2d with argmax index (pool_with_index_op.cc) -----------------------
+
+def _pool_idx_infer(op, block):
+    x = in_var(op, block, "X")
+    nd = 2
+    ks = _seq(op.attrs.get("ksize"), nd)
+    if op.attrs.get("global_pooling", False):
+        spatial = [1] * nd
+    else:
+        strides = _seq(op.attrs.get("strides", 1), nd)
+        pads = _seq(op.attrs.get("paddings", 0), nd)
+        spatial = [
+            _pool_out_dim(x.shape[2 + i], ks[i], pads[i], strides[i], False)
+            for i in range(nd)
+        ]
+    shape = tuple(x.shape[:2]) + tuple(spatial)
+    set_output(op, block, "Out", shape, x.dtype)
+    set_output(op, block, "Mask", shape, "int32")
+
+
+def _pool_idx_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    nd = 2
+    ks = _seq(attrs.get("ksize"), nd)
+    if attrs.get("global_pooling", False):
+        ks = list(x.shape[2:])
+        strides, pads = ks, [0, 0]
+    else:
+        strides = _seq(attrs.get("strides", 1), nd)
+        pads = _seq(attrs.get("paddings", 0), nd)
+    n, c, h, w = x.shape
+    # index map of flattened H*W positions, padded with -1
+    flat_idx = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    window = (1, 1) + tuple(ks)
+    stride = (1, 1) + tuple(strides)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, mask = lax.reduce_window(
+        (x, flat_idx), (jnp.asarray(neg, x.dtype), jnp.asarray(-1, jnp.int32)),
+        reducer, window, stride, pad_cfg,
+    )
+    return {"Out": out, "Mask": mask}
+
+
+def _pool_idx_grad(op, no_grad_set):
+    from ..framework import grad_var_name
+    x = op.inputs["X"][0]
+    if x in no_grad_set:
+        return []
+    return [dict(
+        type="max_pool_with_index_grad",
+        inputs={"X": [x], "Mask": list(op.outputs["Mask"]),
+                "GRAD::Out": [grad_var_name(op.outputs["Out"][0])]},
+        outputs={"GRAD::X": [grad_var_name(x)]},
+        attrs=dict(op.attrs),
+    )]
+
+
+def _pool_idx_grad_infer(gop, block):
+    x = in_var(gop, block, "X")
+    set_output(gop, block, "GRAD::X", x.shape, x.dtype)
+
+
+def _pool_idx_grad_compute(ins, attrs, ctx, op_index):
+    x, mask, og = ins["X"][0], ins["Mask"][0], ins["GRAD::Out"][0]
+    n, c, h, w = x.shape
+    flat = jnp.zeros((n, c, h * w), x.dtype)
+    m = mask.reshape(n, c, -1)
+    g = og.reshape(n, c, -1)
+    valid = m >= 0
+    m_safe = jnp.where(valid, m, 0)
+    contrib = jnp.where(valid, g, 0)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], m_safe
+    ].add(contrib)
+    return {"GRAD::X": flat.reshape(x.shape)}
+
+
+register_op("max_pool2d_with_index", ["X"], ["Out", "Mask"],
+            infer=_pool_idx_infer, compute=_pool_idx_compute,
+            grad=_pool_idx_grad)
+register_op("max_pool_with_index_grad", ["X", "Mask", "GRAD::Out"],
+            ["GRAD::X"], infer=_pool_idx_grad_infer,
+            compute=_pool_idx_grad_compute, grad=None)

@@ -1,0 +1,455 @@
+"""CNN op tests: numpy-oracle forward + numeric-vs-analytic gradients
+(reference test_conv2d_op.py, test_pool2d_op.py, test_batch_norm_op.py,
+test_layer_norm_op.py, test_lrn_op.py, test_bilinear_interp_op.py pattern).
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def np_conv2d(x, w, stride, pad, dilation=(1, 1), groups=1):
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilation
+    ekh, ekw = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+    oh = (h + 2 * ph - ekh) // sh + 1
+    ow = (wd + 2 * pw - ekw) // sw + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    out = np.zeros((n, cout, oh, ow), dtype=x.dtype)
+    cout_g = cout // groups
+    for g in range(groups):
+        for oc in range(g * cout_g, (g + 1) * cout_g):
+            for i in range(oh):
+                for j in range(ow):
+                    patch = xp[:, g * cin_g:(g + 1) * cin_g,
+                               i * sh:i * sh + ekh:dh,
+                               j * sw:j * sw + ekw:dw]
+                    out[:, oc, i, j] = np.sum(
+                        patch * w[oc][None], axis=(1, 2, 3))
+    return out
+
+
+class TestConv2d(OpTest):
+    op_type = "conv2d"
+
+    def setup(self, stride=(1, 1), pad=(0, 0), dilation=(1, 1), groups=1,
+              cin=4, cout=6, k=3):
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, cin, 7, 7).astype("float32")
+        w = rng.rand(cout, cin // groups, k, k).astype("float32") - 0.5
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": list(stride), "paddings": list(pad),
+                      "dilations": list(dilation), "groups": groups}
+        self.outputs = {
+            "Output": np_conv2d(x, w, stride, pad, dilation, groups)
+        }
+
+    def test_basic(self):
+        self.setup()
+        self.check_output()
+
+    def test_stride_pad(self):
+        self.setup(stride=(2, 2), pad=(1, 1))
+        self.check_output()
+
+    def test_dilation(self):
+        self.setup(dilation=(2, 2))
+        self.check_output()
+
+    def test_groups(self):
+        self.setup(groups=2, cin=4, cout=6)
+        self.check_output()
+
+    def test_depthwise(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(2, 4, 6, 6).astype("float32")
+        w = rng.rand(4, 1, 3, 3).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 4}
+        self.outputs = {"Output": np_conv2d(x, w, (1, 1), (1, 1), (1, 1), 4)}
+        self.op_type = "depthwise_conv2d"
+        self.check_output()
+        self.op_type = "conv2d"
+
+    def test_grad(self):
+        rng = np.random.RandomState(2)
+        x = rng.rand(2, 2, 5, 5).astype("float32")
+        w = rng.rand(3, 2, 3, 3).astype("float32") - 0.5
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": np_conv2d(x, w, (1, 1), (1, 1))}
+        self.check_grad(["conv2d__Input", "conv2d__Filter"], "conv2d__Output",
+                        max_relative_error=0.02)
+
+
+class TestConv2dTranspose(OpTest):
+    op_type = "conv2d_transpose"
+
+    def test_output(self):
+        """deconv oracle: scatter each input pixel times the kernel."""
+        rng = np.random.RandomState(3)
+        n, cin, h, w_ = 2, 3, 4, 4
+        cout, k, stride, pad = 5, 3, 2, 1
+        x = rng.rand(n, cin, h, w_).astype("float32")
+        w = rng.rand(cin, cout, k, k).astype("float32") - 0.5
+        oh = (h - 1) * stride - 2 * pad + k
+        ow = (w_ - 1) * stride - 2 * pad + k
+        full = np.zeros((n, cout, oh + 2 * pad, ow + 2 * pad), "float32")
+        for i in range(h):
+            for j in range(w_):
+                contrib = np.einsum("nc,cokl->nokl", x[:, :, i, j], w)
+                full[:, :, i * stride:i * stride + k,
+                     j * stride:j * stride + k] += contrib
+        want = full[:, :, pad:pad + oh, pad:pad + ow]
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [stride, stride], "paddings": [pad, pad],
+                      "dilations": [1, 1], "groups": 1}
+        self.outputs = {"Output": want}
+        self.check_output()
+
+
+def np_pool2d(x, ksize, stride, pad, ptype="max", ceil=False, exclusive=True):
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = stride
+    ph, pw = pad
+    rnd = (lambda v: int(np.ceil(v))) if ceil else (lambda v: int(np.floor(v)))
+    oh = rnd((h + 2 * ph - kh) / sh) + 1
+    ow = rnd((w + 2 * pw - kw) / sw) + 1
+    out = np.zeros((n, c, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            hs, ws = i * sh - ph, j * sw - pw
+            he, we = min(hs + kh, h), min(ws + kw, w)
+            hs, ws = max(hs, 0), max(ws, 0)
+            patch = x[:, :, hs:he, ws:we]
+            if ptype == "max":
+                out[:, :, i, j] = patch.max(axis=(2, 3))
+            elif exclusive:
+                out[:, :, i, j] = patch.mean(axis=(2, 3))
+            else:
+                out[:, :, i, j] = patch.sum(axis=(2, 3)) / (kh * kw)
+    return out
+
+
+class TestPool2d(OpTest):
+    op_type = "pool2d"
+
+    def _run(self, ptype, ksize=(2, 2), stride=(2, 2), pad=(0, 0),
+             ceil=False, exclusive=True, shape=(2, 3, 6, 6)):
+        rng = np.random.RandomState(4)
+        x = rng.rand(*shape).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": ptype, "ksize": list(ksize),
+                      "strides": list(stride), "paddings": list(pad),
+                      "ceil_mode": ceil, "exclusive": exclusive}
+        self.outputs = {"Out": np_pool2d(x, ksize, stride, pad, ptype, ceil,
+                                         exclusive)}
+        self.check_output()
+
+    def test_max(self):
+        self._run("max")
+
+    def test_avg(self):
+        self._run("avg")
+
+    def test_max_pad(self):
+        self._run("max", ksize=(3, 3), stride=(2, 2), pad=(1, 1))
+
+    def test_avg_pad_exclusive(self):
+        self._run("avg", ksize=(3, 3), stride=(2, 2), pad=(1, 1),
+                  exclusive=True)
+
+    def test_avg_pad_inclusive(self):
+        self._run("avg", ksize=(3, 3), stride=(2, 2), pad=(1, 1),
+                  exclusive=False)
+
+    def test_ceil_mode(self):
+        self._run("max", ksize=(3, 3), stride=(2, 2), pad=(0, 0), ceil=True,
+                  shape=(2, 3, 7, 7))
+
+    def test_global(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(2, 3, 5, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                      "global_pooling": True}
+        self.outputs = {"Out": x.mean(axis=(2, 3), keepdims=True)}
+        self.check_output()
+
+    def test_adaptive(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(1, 2, 6, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [3, 3],
+                      "adaptive": True}
+        want = x.reshape(1, 2, 3, 2, 3, 2).mean(axis=(3, 5))
+        self.outputs = {"Out": want}
+        self.check_output()
+
+    def test_grad_max(self):
+        rng = np.random.RandomState(7)
+        x = rng.rand(1, 2, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": np_pool2d(x, (2, 2), (2, 2), (0, 0), "max")}
+        self.check_grad(["pool2d__X"], "pool2d__Out", max_relative_error=0.02)
+
+    def test_grad_avg(self):
+        rng = np.random.RandomState(8)
+        x = rng.rand(1, 2, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"pooling_type": "avg", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0]}
+        self.outputs = {"Out": np_pool2d(x, (2, 2), (2, 2), (0, 0), "avg")}
+        self.check_grad(["pool2d__X"], "pool2d__Out", max_relative_error=0.02)
+
+
+class TestMaxPoolWithIndex(OpTest):
+    op_type = "max_pool2d_with_index"
+
+    def test_output(self):
+        rng = np.random.RandomState(9)
+        x = rng.rand(2, 3, 4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+        out = np_pool2d(x, (2, 2), (2, 2), (0, 0), "max")
+        mask = np.zeros_like(out, dtype="int32")
+        for i in range(2):
+            for j in range(2):
+                patch = x[:, :, i * 2:i * 2 + 2, j * 2:j * 2 + 2]
+                flat = patch.reshape(*patch.shape[:2], -1)
+                am = flat.argmax(-1)
+                r, c = am // 2, am % 2
+                mask[:, :, i, j] = (i * 2 + r) * 4 + (j * 2 + c)
+        self.outputs = {"Out": out, "Mask": mask}
+        self.check_output()
+
+
+def np_batch_norm(x, scale, bias, eps):
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    xn = (x - mean[None, :, None, None]) / np.sqrt(
+        var[None, :, None, None] + eps)
+    return xn * scale[None, :, None, None] + bias[None, :, None, None], \
+        mean, var
+
+
+class TestBatchNorm(OpTest):
+    op_type = "batch_norm"
+
+    def _setup(self, is_test=False):
+        rng = np.random.RandomState(10)
+        x = rng.rand(3, 4, 5, 5).astype("float32")
+        scale = rng.rand(4).astype("float32") + 0.5
+        bias = rng.rand(4).astype("float32")
+        mean = rng.rand(4).astype("float32")
+        var = rng.rand(4).astype("float32") + 0.5
+        eps, momentum = 1e-5, 0.9
+        if is_test:
+            y = (x - mean[None, :, None, None]) / np.sqrt(
+                var[None, :, None, None] + eps)
+            y = y * scale[None, :, None, None] + bias[None, :, None, None]
+            mean_out, var_out = mean, var
+            saved_mean, saved_var = mean, var
+        else:
+            y, bm, bv = np_batch_norm(x, scale, bias, eps)
+            mean_out = momentum * mean + (1 - momentum) * bm
+            var_out = momentum * var + (1 - momentum) * bv
+            saved_mean, saved_var = bm, bv
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"epsilon": eps, "momentum": momentum,
+                      "is_test": is_test}
+        self.outputs = {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
+                        "SavedMean": saved_mean, "SavedVariance": saved_var}
+
+    def test_train(self):
+        self._setup(is_test=False)
+        self.check_output(atol=1e-4)
+
+    def test_infer(self):
+        self._setup(is_test=True)
+        self.check_output(atol=1e-4)
+
+    def test_uncentered_input_stable(self):
+        """Regression: one-pass E[x^2]-E[x]^2 variance cancels in f32 for
+        un-centered inputs (e.g. raw 0-255 images) and can go negative."""
+        rng = np.random.RandomState(20)
+        x = (1000.0 + 0.01 * rng.randn(16, 4, 4, 4)).astype("float32")
+        scale = np.ones(4, "float32")
+        bias = np.zeros(4, "float32")
+        mean = np.zeros(4, "float32")
+        var = np.ones(4, "float32")
+        eps = 1e-5
+        x64 = x.astype(np.float64)
+        y, bm, bv = np_batch_norm(x64, scale.astype(np.float64),
+                                  bias.astype(np.float64), eps)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.attrs = {"epsilon": eps, "momentum": 0.9, "is_test": False}
+        self.outputs = {"Y": y.astype("float32"),
+                        "MeanOut": (0.9 * mean + 0.1 * bm).astype("float32"),
+                        "VarianceOut": (0.9 * var + 0.1 * bv).astype(
+                            "float32"),
+                        "SavedMean": bm.astype("float32"),
+                        "SavedVariance": bv.astype("float32")}
+        self.check_output(atol=5e-2, rtol=5e-2)
+
+    def test_grad(self):
+        self._setup(is_test=False)
+        self.check_grad(["batch_norm__X", "batch_norm__Scale", "batch_norm__Bias"], "batch_norm__Y",
+                        max_relative_error=0.02,
+                        no_grad_set={"batch_norm__Mean",
+                                     "batch_norm__Variance"})
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test_output_and_grad(self):
+        rng = np.random.RandomState(11)
+        x = rng.rand(3, 4, 5).astype("float32")
+        scale = rng.rand(20).astype("float32") + 0.5
+        bias = rng.rand(20).astype("float32")
+        eps, axis = 1e-5, 1
+        flat = x.reshape(3, -1)
+        mean = flat.mean(-1)
+        var = flat.var(-1)
+        yn = (flat - mean[:, None]) / np.sqrt(var[:, None] + eps)
+        y = (yn * scale[None] + bias[None]).reshape(x.shape)
+        self.inputs = {"X": x,
+                       "Scale": scale.reshape(4, 5),
+                       "Bias": bias.reshape(4, 5)}
+        self.attrs = {"epsilon": eps, "begin_norm_axis": axis}
+        self.outputs = {"Y": y, "Mean": mean, "Variance": var}
+        self.check_output(atol=1e-4)
+        self.check_grad(["layer_norm__X", "layer_norm__Scale", "layer_norm__Bias"], "layer_norm__Y",
+                        max_relative_error=0.02)
+
+
+class TestGroupNorm(OpTest):
+    op_type = "group_norm"
+
+    def test_output(self):
+        rng = np.random.RandomState(12)
+        x = rng.rand(2, 4, 3, 3).astype("float32")
+        scale = rng.rand(4).astype("float32") + 0.5
+        bias = rng.rand(4).astype("float32")
+        g, eps = 2, 1e-5
+        xg = x.reshape(2, g, 2, 3, 3)
+        mean = xg.mean(axis=(2, 3, 4), keepdims=True)
+        var = xg.var(axis=(2, 3, 4), keepdims=True)
+        y = ((xg - mean) / np.sqrt(var + eps)).reshape(x.shape)
+        y = y * scale[None, :, None, None] + bias[None, :, None, None]
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"groups": g, "epsilon": eps}
+        self.outputs = {"Y": y, "Mean": mean.reshape(2, g),
+                        "Variance": var.reshape(2, g)}
+        self.check_output(atol=1e-4)
+
+    def test_nhwc(self):
+        rng = np.random.RandomState(21)
+        x = rng.rand(2, 3, 3, 4).astype("float32")  # NHWC
+        scale = rng.rand(4).astype("float32") + 0.5
+        bias = rng.rand(4).astype("float32")
+        g, eps = 2, 1e-5
+        xc = np.moveaxis(x, -1, 1)
+        xg = xc.reshape(2, g, 2, 3, 3)
+        mean = xg.mean(axis=(2, 3, 4), keepdims=True)
+        var = xg.var(axis=(2, 3, 4), keepdims=True)
+        y = ((xg - mean) / np.sqrt(var + eps)).reshape(xc.shape)
+        y = y * scale[None, :, None, None] + bias[None, :, None, None]
+        y = np.moveaxis(y, 1, -1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"groups": g, "epsilon": eps, "data_layout": "NHWC"}
+        self.outputs = {"Y": y, "Mean": mean.reshape(2, g),
+                        "Variance": var.reshape(2, g)}
+        self.check_output(atol=1e-4)
+
+
+class TestLRN(OpTest):
+    op_type = "lrn"
+
+    def test_output(self):
+        rng = np.random.RandomState(13)
+        x = rng.rand(2, 6, 4, 4).astype("float32")
+        n, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+        half = n // 2
+        sq = np.square(x)
+        mid = np.full_like(x, k)
+        for c in range(6):
+            lo, hi = max(0, c - half), min(6, c + n - half)
+            mid[:, c] += alpha * sq[:, lo:hi].sum(axis=1)
+        self.inputs = {"X": x}
+        self.attrs = {"n": n, "k": k, "alpha": alpha, "beta": beta}
+        self.outputs = {"Out": x * np.power(mid, -beta), "MidOut": mid}
+        self.check_output(atol=1e-5)
+
+
+class TestNormOp(OpTest):
+    op_type = "norm"
+
+    def test_output(self):
+        rng = np.random.RandomState(14)
+        x = rng.rand(2, 5, 3).astype("float32")
+        eps = 1e-10
+        norm = np.sqrt((x * x).sum(axis=1, keepdims=True) + eps)
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "epsilon": eps}
+        self.outputs = {"Out": x / norm, "Norm": norm}
+        self.check_output()
+
+
+class TestBilinearInterp(OpTest):
+    op_type = "bilinear_interp"
+
+    def test_output(self):
+        rng = np.random.RandomState(15)
+        x = rng.rand(2, 3, 4, 4).astype("float32")
+        oh, ow = 7, 7
+        h, w = 4, 4
+        rh, rw = (h - 1) / (oh - 1), (w - 1) / (ow - 1)
+        out = np.zeros((2, 3, oh, ow), "float32")
+        for i in range(oh):
+            for j in range(ow):
+                fy, fx = i * rh, j * rw
+                y0, x0 = int(np.floor(fy)), int(np.floor(fx))
+                y1, x1 = min(y0 + 1, h - 1), min(x0 + 1, w - 1)
+                wy, wx = fy - y0, fx - x0
+                out[:, :, i, j] = (
+                    x[:, :, y0, x0] * (1 - wy) * (1 - wx)
+                    + x[:, :, y0, x1] * (1 - wy) * wx
+                    + x[:, :, y1, x0] * wy * (1 - wx)
+                    + x[:, :, y1, x1] * wy * wx
+                )
+        self.inputs = {"X": x}
+        self.attrs = {"out_h": oh, "out_w": ow}
+        self.outputs = {"Out": out}
+        self.check_output(atol=1e-5)
+
+
+class TestConvShift(OpTest):
+    op_type = "conv_shift"
+
+    def test_output(self):
+        rng = np.random.RandomState(16)
+        x = rng.rand(3, 8).astype("float32")
+        y = rng.rand(3, 3).astype("float32")
+        m, n = 8, 3
+        half = n // 2
+        out = np.zeros_like(x)
+        for b in range(3):
+            for i in range(m):
+                for j in range(n):
+                    out[b, i] += x[b, (i + j - half) % m] * y[b, j]
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": out}
+        self.check_output()

@@ -1,0 +1,100 @@
+"""Model-zoo integration tests (reference tests/book pattern: build the
+real model, train a few steps, assert loss decreases / stays finite)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import mnist, resnet, se_resnext, vgg
+
+
+def _train_steps(loss, feed_fn, steps=4, lr=0.01):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for _ in range(steps):
+        (lv,) = exe.run(feed=feed_fn(), fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses
+
+
+def test_mnist_cnn_trains():
+    img = fluid.layers.data("img", shape=[1, 28, 28])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    pred = mnist.cnn_model(img, class_dim=10)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    rng = np.random.RandomState(0)
+    base = rng.rand(10, 64, 1, 28, 28).astype("float32")
+
+    def feed():
+        i = feed.step % 10
+        feed.step += 1
+        x = base[i]
+        y = (x.mean(axis=(1, 2, 3), keepdims=False) * 10).astype(
+            "int64").reshape(-1, 1) % 10
+        return {"img": x, "label": y}
+    feed.step = 0
+
+    losses = _train_steps(loss, feed, steps=12)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_resnet_cifar10_trains():
+    img = fluid.layers.data("img", shape=[3, 16, 16])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    pred = resnet.resnet_cifar10(img, class_dim=10, depth=8)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+
+    rng = np.random.RandomState(1)
+
+    def feed():
+        x = rng.rand(8, 3, 16, 16).astype("float32")
+        y = rng.randint(0, 10, (8, 1)).astype("int64")
+        return {"img": x, "label": y}
+
+    losses = _train_steps(loss, feed, steps=3)
+    assert all(np.isfinite(losses)), losses
+
+
+def test_resnet_imagenet_builds_and_runs():
+    img = fluid.layers.data("img", shape=[3, 64, 64])
+    pred = resnet.resnet_imagenet(img, class_dim=100, depth=18, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    test_prog = fluid.default_main_program().prune_feed_fetch(
+        ["img"], [pred.name])
+    x = np.random.RandomState(2).rand(2, 3, 64, 64).astype("float32")
+    (out,) = exe.run(test_prog, feed={"img": x}, fetch_list=[pred.name])
+    assert out.shape == (2, 100)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_vgg16_builds_and_runs():
+    img = fluid.layers.data("img", shape=[3, 32, 32])
+    pred = vgg.vgg16_bn_drop(img, class_dim=10, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    test_prog = fluid.default_main_program().prune_feed_fetch(
+        ["img"], [pred.name])
+    x = np.random.RandomState(3).rand(2, 3, 32, 32).astype("float32")
+    (out,) = exe.run(test_prog, feed={"img": x}, fetch_list=[pred.name])
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_se_resnext_builds_and_runs():
+    img = fluid.layers.data("img", shape=[3, 64, 64])
+    pred = se_resnext.SE_ResNeXt(img, class_dim=10, depth=50, cardinality=8,
+                                 reduction_ratio=4, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    test_prog = fluid.default_main_program().prune_feed_fetch(
+        ["img"], [pred.name])
+    x = np.random.RandomState(4).rand(2, 3, 64, 64).astype("float32")
+    (out,) = exe.run(test_prog, feed={"img": x}, fetch_list=[pred.name])
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)

@@ -34,6 +34,9 @@ from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
 from . import io
 from . import profiler
+from . import parallel
+from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
+from .parallel.mesh import make_mesh
 
 __version__ = "0.1.0"
 
@@ -44,5 +47,6 @@ __all__ = [
     "optimizer", "metrics", "nets", "append_backward", "calc_gradient",
     "Executor", "CPUPlace", "TPUPlace", "CUDAPlace", "Scope",
     "global_scope", "scope_guard", "ParamAttr", "WeightNormParamAttr",
-    "DataFeeder", "io", "profiler",
+    "DataFeeder", "io", "profiler", "parallel", "ParallelExecutor",
+    "BuildStrategy", "ExecutionStrategy", "make_mesh",
 ]

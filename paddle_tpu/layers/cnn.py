@@ -12,6 +12,7 @@ from ..framework import Variable
 from ..initializer import ConstantInitializer, NormalInitializer
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
+from ..registry import int_list as _pair
 
 __all__ = [
     "conv2d",
@@ -27,11 +28,6 @@ __all__ = [
     "resize_bilinear",
 ]
 
-
-def _pair(v, n):
-    if isinstance(v, (list, tuple)):
-        return list(v)
-    return [v] * n
 
 
 def _conv_nd(nd, op_type, input, num_filters, filter_size, stride, padding,

@@ -1,0 +1,187 @@
+"""In-graph learning-rate schedules (reference
+``python/paddle/fluid/layers/learning_rate_scheduler.py`` 345 LoC:
+noam/exponential/natural_exp/inverse_time/polynomial/piecewise decay —
+each emits ops into the main program so the LR updates inside the same
+jitted training step).
+
+A global step counter var increments once per step (the reference's
+``_decay_step_counter``); every schedule is a pure function of it built
+from registered ops, so it fuses into the step's HLO.
+"""
+
+import math
+
+from ..framework import default_main_program, default_startup_program
+from ..initializer import ConstantInitializer
+from ..layer_helper import LayerHelper
+from .. import unique_name
+
+__all__ = [
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+    "piecewise_decay",
+    "noam_decay",
+]
+
+
+def _decay_step_counter(begin=0):
+    helper = LayerHelper("global_step_counter")
+    # one counter per `begin` value: schedules with different origins
+    # (e.g. noam starts at 1) must not share a var or they shift each other
+    counter_name = "@LR_DECAY_COUNTER@" if begin == 0 else \
+        "@LR_DECAY_COUNTER@begin=%d" % begin
+    block = default_main_program().global_block()
+    counter = block._find_var_recursive(counter_name)
+    if counter is None:
+        counter = block.create_var(
+            name=counter_name, shape=(1,), dtype="float32", persistable=True)
+        startup = default_startup_program().global_block()
+        sv = startup.create_var(
+            name=counter_name, shape=(1,), dtype="float32", persistable=True)
+        ConstantInitializer(float(begin - 1))(sv, startup)
+        helper.append_op(
+            type="increment", inputs={"X": [counter]},
+            outputs={"Out": [counter]}, attrs={"step": 1.0})
+        counter.stop_gradient = True
+    return counter
+
+
+def _scalar(helper, value, like):
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="fill_constant", outputs={"Out": [out]},
+        attrs={"shape": [1], "value": float(value), "dtype": "float32",
+               "force_cpu": False})
+    out.stop_gradient = True
+    return out
+
+
+def _binary(helper, op_type, x, y):
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(type=op_type, inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]}, attrs={"axis": -1})
+    out.stop_gradient = True
+    return out
+
+
+def _unary(helper, op_type, x, **attrs):
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(type=op_type, inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    out.stop_gradient = True
+    return out
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate ^ (step / decay_steps)"""
+    helper = LayerHelper("exponential_decay")
+    step = _decay_step_counter()
+    div = _unary(helper, "scale", step, scale=1.0 / decay_steps, bias=0.0,
+                 bias_after_scale=True)
+    if staircase:
+        div = _unary(helper, "floor", div)
+    # rate^x = exp(x * ln rate)
+    expo = _unary(helper, "scale", div, scale=math.log(decay_rate), bias=0.0,
+                  bias_after_scale=True)
+    factor = _unary(helper, "exp", expo)
+    return _unary(helper, "scale", factor, scale=float(learning_rate),
+                  bias=0.0, bias_after_scale=True)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step / decay_steps)"""
+    helper = LayerHelper("natural_exp_decay")
+    step = _decay_step_counter()
+    div = _unary(helper, "scale", step, scale=1.0 / decay_steps, bias=0.0,
+                 bias_after_scale=True)
+    if staircase:
+        div = _unary(helper, "floor", div)
+    expo = _unary(helper, "scale", div, scale=-float(decay_rate), bias=0.0,
+                  bias_after_scale=True)
+    factor = _unary(helper, "exp", expo)
+    return _unary(helper, "scale", factor, scale=float(learning_rate),
+                  bias=0.0, bias_after_scale=True)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step / decay_steps)"""
+    helper = LayerHelper("inverse_time_decay")
+    step = _decay_step_counter()
+    div = _unary(helper, "scale", step, scale=1.0 / decay_steps, bias=0.0,
+                 bias_after_scale=True)
+    if staircase:
+        div = _unary(helper, "floor", div)
+    denom = _unary(helper, "scale", div, scale=float(decay_rate), bias=1.0,
+                   bias_after_scale=True)
+    recip = _unary(helper, "reciprocal", denom)
+    return _unary(helper, "scale", recip, scale=float(learning_rate),
+                  bias=0.0, bias_after_scale=True)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=1e-4,
+                     power=1.0, cycle=False):
+    """(lr - end_lr) * (1 - min(step, decay_steps)/decay_steps)^power + end_lr
+    (cycle=True restarts with a growing decay_steps; reference
+    learning_rate_scheduler.py polynomial_decay)"""
+    helper = LayerHelper("polynomial_decay")
+    step = _decay_step_counter()
+    if cycle:
+        ratio = _unary(helper, "scale", step, scale=1.0 / decay_steps,
+                       bias=0.0, bias_after_scale=True)
+        ceilv = _unary(helper, "ceil", ratio)
+        # ensure at least one period after step 0: max(ceil(ratio), 1)
+        one = _scalar(helper, 1.0, step)
+        ceilv = _binary(helper, "elementwise_max", ceilv, one)
+        cur_decay = _unary(helper, "scale", ceilv, scale=float(decay_steps),
+                           bias=0.0, bias_after_scale=True)
+        frac = _binary(helper, "elementwise_div", step, cur_decay)
+    else:
+        cap = _scalar(helper, float(decay_steps), step)
+        capped = _binary(helper, "elementwise_min", step, cap)
+        frac = _unary(helper, "scale", capped, scale=1.0 / decay_steps,
+                      bias=0.0, bias_after_scale=True)
+    base = _unary(helper, "scale", frac, scale=-1.0, bias=1.0,
+                  bias_after_scale=True)
+    # clamp: float rounding can push 1 - step/decay_steps a hair below 0,
+    # and power of a negative base is NaN
+    base = _unary(helper, "clip", base, min=0.0, max=1.0)
+    powed = _binary(helper, "elementwise_pow", base,
+                    _scalar(helper, float(power), step))
+    return _unary(helper, "scale", powed,
+                  scale=float(learning_rate) - float(end_learning_rate),
+                  bias=float(end_learning_rate), bias_after_scale=True)
+
+
+def piecewise_decay(boundaries, values):
+    """Step-function schedule (reference piecewise_decay)."""
+    if len(values) != len(boundaries) + 1:
+        raise ValueError("len(values) must be len(boundaries) + 1")
+    helper = LayerHelper("piecewise_decay")
+    step = _decay_step_counter()
+    out = helper.create_variable_for_type_inference(dtype="float32")
+    helper.append_op(
+        type="piecewise_lr", inputs={"Step": [step]},
+        outputs={"Out": [out]},
+        attrs={"boundaries": [float(b) for b in boundaries],
+               "values": [float(v) for v in values]})
+    out.stop_gradient = True
+    return out
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """d_model^-0.5 * min(step^-0.5, step * warmup^-1.5) (Transformer LR;
+    reference noam_decay)."""
+    helper = LayerHelper("noam_decay")
+    step = _decay_step_counter(begin=1)
+    a = _unary(helper, "rsqrt", step)
+    b = _unary(helper, "scale", step, scale=float(warmup_steps) ** -1.5,
+               bias=0.0, bias_after_scale=True)
+    m = _binary(helper, "elementwise_min", a, b)
+    return _unary(helper, "scale", m,
+                  scale=float(learning_rate) * float(d_model) ** -0.5,
+                  bias=0.0, bias_after_scale=True)

@@ -16,15 +16,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..registry import register_op, set_output, in_var
+from ..registry import register_op, set_output, in_var, int_list
 
 __all__ = []
 
-
-def _pair(v, n):
-    if isinstance(v, (list, tuple)):
-        return list(v)
-    return [v] * n
 
 
 def _conv_out_dim(in_size, k, pad, stride, dilation):
@@ -38,9 +33,9 @@ def _conv_infer_nd(nd):
     def infer(op, block):
         x = in_var(op, block, "Input")
         w = in_var(op, block, "Filter")
-        strides = _pair(op.attrs.get("strides", 1), nd)
-        pads = _pair(op.attrs.get("paddings", 0), nd)
-        dils = _pair(op.attrs.get("dilations", 1), nd)
+        strides = int_list(op.attrs.get("strides", 1), nd)
+        pads = int_list(op.attrs.get("paddings", 0), nd)
+        dils = int_list(op.attrs.get("dilations", 1), nd)
         out_c = w.shape[0]
         spatial = [
             _conv_out_dim(x.shape[2 + i], w.shape[2 + i], pads[i], strides[i],
@@ -57,9 +52,9 @@ def _conv_compute_nd(nd):
 
     def compute(ins, attrs, ctx, op_index):
         x, w = ins["Input"][0], ins["Filter"][0]
-        strides = _pair(attrs.get("strides", 1), nd)
-        pads = _pair(attrs.get("paddings", 0), nd)
-        dils = _pair(attrs.get("dilations", 1), nd)
+        strides = int_list(attrs.get("strides", 1), nd)
+        pads = int_list(attrs.get("paddings", 0), nd)
+        dils = int_list(attrs.get("dilations", 1), nd)
         groups = attrs.get("groups", 1) or 1
         out = lax.conv_general_dilated(
             x, w,
@@ -89,9 +84,9 @@ def _convt_infer(op, block):
     x = in_var(op, block, "Input")
     w = in_var(op, block, "Filter")  # [in_c, out_c/groups, kh, kw]
     nd = 2
-    strides = _pair(op.attrs.get("strides", 1), nd)
-    pads = _pair(op.attrs.get("paddings", 0), nd)
-    dils = _pair(op.attrs.get("dilations", 1), nd)
+    strides = int_list(op.attrs.get("strides", 1), nd)
+    pads = int_list(op.attrs.get("paddings", 0), nd)
+    dils = int_list(op.attrs.get("dilations", 1), nd)
     groups = op.attrs.get("groups", 1) or 1
     out_c = w.shape[1] * groups
     spatial = []
@@ -109,9 +104,9 @@ def _convt_infer(op, block):
 def _convt_compute(ins, attrs, ctx, op_index):
     x, w = ins["Input"][0], ins["Filter"][0]
     nd = 2
-    strides = _pair(attrs.get("strides", 1), nd)
-    pads = _pair(attrs.get("paddings", 0), nd)
-    dils = _pair(attrs.get("dilations", 1), nd)
+    strides = int_list(attrs.get("strides", 1), nd)
+    pads = int_list(attrs.get("paddings", 0), nd)
+    dils = int_list(attrs.get("dilations", 1), nd)
     groups = attrs.get("groups", 1) or 1
 
     def one_group(xg, wg):

@@ -261,3 +261,25 @@ def _cos_sim_compute(ins, attrs, ctx, op_index):
 
 register_op("cos_sim", ["X", "Y"], ["Out", "XNorm", "YNorm"],
             infer=_cos_sim_infer, compute=_cos_sim_compute)
+
+
+# -- piecewise_lr (in-graph step-function LR; layers.piecewise_decay) -------
+
+def _piecewise_lr_compute(ins, attrs, ctx, op_index):
+    step = ins["Step"][0]
+    boundaries = attrs["boundaries"]
+    values = attrs["values"]
+    out = jnp.full_like(step, values[-1])
+    # walk from the right so earlier boundaries win
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        out = jnp.where(step < b, v, out)
+    return {"Out": out}
+
+
+register_op(
+    "piecewise_lr", ["Step"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "Step").shape, "float32"
+    ),
+    compute=_piecewise_lr_compute, grad=None,
+)

@@ -12,15 +12,10 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
-from ..registry import register_op, set_output, in_var
+from ..registry import register_op, set_output, in_var, int_list
 
 __all__ = []
 
-
-def _seq(v, n):
-    if isinstance(v, (list, tuple)):
-        return list(v)
-    return [v] * n
 
 
 def _pool_out_dim(in_size, k, pad, stride, ceil_mode):
@@ -38,11 +33,11 @@ def _pool_infer_nd(nd):
         if attrs.get("global_pooling", False):
             spatial = [1] * nd
         elif attrs.get("adaptive", False):
-            spatial = _seq(attrs.get("ksize"), nd)
+            spatial = int_list(attrs.get("ksize"), nd)
         else:
-            ks = _seq(attrs.get("ksize"), nd)
-            strides = _seq(attrs.get("strides", 1), nd)
-            pads = _seq(attrs.get("paddings", 0), nd)
+            ks = int_list(attrs.get("ksize"), nd)
+            strides = int_list(attrs.get("strides", 1), nd)
+            pads = int_list(attrs.get("paddings", 0), nd)
             ceil = attrs.get("ceil_mode", False)
             spatial = [
                 _pool_out_dim(x.shape[2 + i], ks[i], pads[i], strides[i], ceil)
@@ -81,12 +76,12 @@ def _pool_compute_nd(nd):
                                                     keepdims=True)
             return {"Out": out}
         if attrs.get("adaptive", False):
-            return {"Out": _adaptive_pool(x, _seq(attrs.get("ksize"), nd),
+            return {"Out": _adaptive_pool(x, int_list(attrs.get("ksize"), nd),
                                           nd, is_max)}
 
-        ks = _seq(attrs.get("ksize"), nd)
-        strides = _seq(attrs.get("strides", 1), nd)
-        pads = _seq(attrs.get("paddings", 0), nd)
+        ks = int_list(attrs.get("ksize"), nd)
+        strides = int_list(attrs.get("strides", 1), nd)
+        pads = int_list(attrs.get("paddings", 0), nd)
         ceil = attrs.get("ceil_mode", False)
         # explicit (lo, hi) padding; ceil_mode extends hi so the last window
         # fits (reference math/pooling.cc ceil semantics)
@@ -131,12 +126,12 @@ register_op("pool3d", ["X"], ["Out"],
 def _pool_idx_infer(op, block):
     x = in_var(op, block, "X")
     nd = 2
-    ks = _seq(op.attrs.get("ksize"), nd)
+    ks = int_list(op.attrs.get("ksize"), nd)
     if op.attrs.get("global_pooling", False):
         spatial = [1] * nd
     else:
-        strides = _seq(op.attrs.get("strides", 1), nd)
-        pads = _seq(op.attrs.get("paddings", 0), nd)
+        strides = int_list(op.attrs.get("strides", 1), nd)
+        pads = int_list(op.attrs.get("paddings", 0), nd)
         spatial = [
             _pool_out_dim(x.shape[2 + i], ks[i], pads[i], strides[i], False)
             for i in range(nd)
@@ -149,13 +144,13 @@ def _pool_idx_infer(op, block):
 def _pool_idx_compute(ins, attrs, ctx, op_index):
     x = ins["X"][0]
     nd = 2
-    ks = _seq(attrs.get("ksize"), nd)
+    ks = int_list(attrs.get("ksize"), nd)
     if attrs.get("global_pooling", False):
         ks = list(x.shape[2:])
         strides, pads = ks, [0, 0]
     else:
-        strides = _seq(attrs.get("strides", 1), nd)
-        pads = _seq(attrs.get("paddings", 0), nd)
+        strides = int_list(attrs.get("strides", 1), nd)
+        pads = int_list(attrs.get("paddings", 0), nd)
     n, c, h, w = x.shape
     # index map of flattened H*W positions, padded with -1
     flat_idx = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)

@@ -1,0 +1,13 @@
+"""Mesh runtime: the TPU-native replacement for the reference's
+multi-device/multi-node stack (ParallelExecutor + MultiDevSSAGraphBuilder +
+NCCL op handles + DistributeTranspiler; SURVEY.md §2.4).
+
+Instead of replicating the program per device and inserting allreduce
+handles, a Program is traced once (executor.trace_program) and pjit-
+compiled over a ``jax.sharding.Mesh``; XLA GSPMD inserts the ICI
+collectives the reference hand-schedules through NCCL.
+"""
+
+from .mesh import make_mesh  # noqa: F401
+from .strategy import BuildStrategy, ExecutionStrategy  # noqa: F401
+from .parallel_executor import ParallelExecutor  # noqa: F401

@@ -1,0 +1,213 @@
+"""ParallelExecutor: pjit a Program over a device mesh.
+
+Capability parity with the reference's single-process multi-GPU runtime
+(``framework/parallel_executor.cc:58-247``: per-device scopes, NCCL
+context map, SSA-graph replication with allreduce handles, threaded
+dataflow executor) — re-designed TPU-first:
+
+* The program is traced ONCE into a pure step function
+  (executor.trace_program) and jit-compiled with
+  ``in_shardings``/``out_shardings`` over a named Mesh.  XLA GSPMD
+  partitions the computation and inserts ICI collectives — the psum of
+  data-parallel gradients replaces ``all_reduce_op_handle.cc``; the
+  reduce-scatter/all-gather pair of the kReduce strategy replaces
+  ``reduce_op_handle.cc`` + ``broadcast_op_handle.cc``.
+* Gradient averaging needs no explicit scale_loss_grad op: the batch is
+  sharded over ``dp`` and mean-reduced losses psum partial means, which
+  is exactly CoeffNumDevice semantics.
+* Feeds: one global batch dict (sharded on dim 0 over ``dp``), or the
+  reference's per-device list-of-dicts form (concatenated).
+* State lives in the Scope as global jax Arrays; between steps sharded
+  params stay resident on their devices (no host round-trip) — the analog
+  of the reference's persistent per-device scopes.
+* Multi-host ("NCCL2 mode", ``num_trainers``/``trainer_id``): initialize
+  ``jax.distributed`` first; the same mesh then spans hosts and XLA
+  routes collectives over ICI/DCN (replaces gen_nccl_id + flat NCCL
+  world, parallel_executor.cc:94-103).
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import registry  # noqa: F401  (op registry must be loaded)
+from ..executor import trace_program, Executor
+from ..framework import Variable, default_main_program
+from ..scope import global_scope
+from .mesh import make_mesh, AXIS_DP
+from .strategy import BuildStrategy, ExecutionStrategy
+
+__all__ = ["ParallelExecutor"]
+
+
+class _Compiled:
+    def __init__(self, fn, feed_names, state_in, state_out, fetch_names,
+                 feed_shardings, state_shardings, out_state_shardings):
+        self.fn = fn
+        self.feed_names = feed_names
+        self.state_in = state_in
+        self.state_out = state_out
+        self.fetch_names = fetch_names
+        self.feed_shardings = feed_shardings
+        self.state_shardings = state_shardings
+        self.out_state_shardings = out_state_shardings
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None, mesh=None):
+        self._mesh = mesh if mesh is not None else make_mesh()
+        if AXIS_DP not in self._mesh.axis_names:
+            raise ValueError("mesh must have a %r axis" % AXIS_DP)
+        self._program = main_program
+        self._scope = scope
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = exec_strategy or ExecutionStrategy()
+        self._loss_name = loss_name
+        self._num_trainers = num_trainers
+        self._trainer_id = trainer_id
+        self._cache = {}
+        self._run_counter = 0
+        if share_vars_from is not None:
+            # parity with PE(share_vars_from=train_exe): same scope object
+            self._scope = share_vars_from._actual_scope()
+
+    # ------------------------------------------------------------------
+    @property
+    def device_count(self):
+        return int(np.prod(self._mesh.devices.shape))
+
+    def _actual_scope(self):
+        return self._scope if self._scope is not None else global_scope()
+
+    def _dp_size(self):
+        idx = self._mesh.axis_names.index(AXIS_DP)
+        return self._mesh.devices.shape[idx]
+
+    # ------------------------------------------------------------------
+    def _state_spec(self, name, val):
+        """Sharding spec for a persistable state array."""
+        strat = self._build_strategy.reduce_strategy
+        if strat == BuildStrategy.ReduceStrategy.Reduce:
+            # ZeRO-style: shard dim 0 over dp when it divides evenly.
+            # Read shape only — np.asarray here would download every param
+            # from device HBM at compile time.
+            shape = tuple(getattr(val, "shape", ()))
+            if len(shape) >= 1 and shape[0] > 0 \
+                    and shape[0] % self._dp_size() == 0:
+                return P(AXIS_DP)
+        return P()
+
+    def _compile(self, program, feed_names, fetch_names, scope, feed_vals):
+        exe = Executor.__new__(Executor)  # reuse its analyzer only
+        state_names, writeback = Executor._analyze(
+            exe, program, feed_names, scope)
+        fn, state_in, state_out = trace_program(
+            program, feed_names, state_names, writeback, fetch_names)
+
+        mesh = self._mesh
+        batch_spec = P(AXIS_DP)
+        feed_shardings = []
+        dp = self._dp_size()
+        for n, v in zip(feed_names, feed_vals):
+            arr = np.asarray(v) if not isinstance(v, jax.Array) else v
+            if arr.ndim >= 1 and arr.shape[0] % dp == 0 and arr.shape[0] > 0:
+                feed_shardings.append(NamedSharding(mesh, batch_spec))
+            else:
+                raise ValueError(
+                    "feed %r batch dim %s is not divisible by the dp mesh "
+                    "size %d" % (n, arr.shape[:1], dp)
+                )
+
+        state_vals = [scope.var(n) for n in state_in]
+        spec_by_name = {
+            n: self._state_spec(n, v) for n, v in zip(state_in, state_vals)
+        }
+        state_shardings = [
+            NamedSharding(mesh, spec_by_name[n]) for n in state_in
+        ]
+        out_state_shardings = [
+            NamedSharding(mesh, spec_by_name.get(n, P()))
+            for n in state_out
+        ]
+
+        if self._build_strategy.remat:
+            fn = jax.checkpoint(fn)
+
+        donate = (1,) if self._build_strategy.donate_state else ()
+        jitted = jax.jit(
+            fn,
+            in_shardings=(feed_shardings, state_shardings, None),
+            out_shardings=(None, out_state_shardings),
+            donate_argnums=donate,
+        )
+        return _Compiled(jitted, feed_names, state_in, state_out,
+                         fetch_names, feed_shardings, state_shardings,
+                         out_state_shardings)
+
+    # ------------------------------------------------------------------
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        program = self._program or default_main_program()
+        scope = self._actual_scope()
+        feed = feed if feed is not None else feed_dict
+        if isinstance(feed, (list, tuple)):
+            # reference per-device feed list: concatenate along batch
+            merged = {}
+            for k in feed[0]:
+                merged[k] = np.concatenate(
+                    [np.asarray(d[k]) for d in feed], axis=0)
+            feed = merged
+        feed = dict(feed or {})
+
+        fetch_names = [
+            v.name if isinstance(v, Variable) else v for v in fetch_list
+        ]
+        feed_names = sorted(feed.keys())
+        block = program.global_block()
+        feed_vals = []
+        for n in feed_names:
+            v = feed[n]
+            if not isinstance(v, jax.Array):
+                v = np.asarray(v)
+            pv = block._find_var_recursive(n)
+            if pv is not None and pv.dtype is not None and \
+                    np.dtype(v.dtype) != np.dtype(pv.dtype):
+                v = v.astype(pv.dtype)
+            feed_vals.append(v)
+
+        feed_sig = tuple(
+            (n, tuple(v.shape), str(v.dtype))
+            for n, v in zip(feed_names, feed_vals)
+        )
+        key = (id(program), program._version, feed_sig, tuple(fetch_names),
+               id(scope), self._build_strategy.reduce_strategy)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            compiled = self._compile(program, feed_names, fetch_names, scope,
+                                     feed_vals)
+            self._cache[key] = compiled
+
+        feed_dev = [
+            jax.device_put(v, s)
+            for v, s in zip(feed_vals, compiled.feed_shardings)
+        ]
+        state_dev = [
+            jax.device_put(scope.var(n), s)
+            for n, s in zip(compiled.state_in, compiled.state_shardings)
+        ]
+        seed = program.random_seed or 0
+        rng = jax.random.key(
+            np.uint32(seed) if seed else np.random.randint(0, 2**31 - 1))
+        rng = jax.random.fold_in(rng, self._run_counter)
+        self._run_counter += 1
+
+        fetches, new_state = compiled.fn(feed_dev, state_dev, rng)
+
+        for n, v in zip(compiled.state_out, new_state):
+            scope.set_var(n, v)
+        if return_numpy:
+            fetches = [np.asarray(f) for f in fetches]
+        return fetches

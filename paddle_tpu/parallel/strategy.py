@@ -1,0 +1,42 @@
+"""Execution/Build strategy objects (reference
+``framework/details/build_strategy.h:55`` and ``execution_strategy.h``).
+
+The reference's BuildStrategy selects how gradients are combined across
+devices (kAllReduce: replicate optimizer everywhere; kReduce: shard the
+optimizer work per device, then broadcast params).  The TPU translation:
+
+* kAllReduce -> params/opt-state replicated on the mesh; XLA psums grads.
+* kReduce    -> params/opt-state sharded over the dp axis (ZeRO-style);
+  XLA reduce-scatters grads and all-gathers params, which is exactly the
+  reduce+broadcast pair the reference schedules by hand.
+"""
+
+__all__ = ["BuildStrategy", "ExecutionStrategy"]
+
+
+class BuildStrategy:
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0   # scale loss grad by 1/num_devices
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+        self.debug_graphviz_path = ""
+        # TPU-specific knobs
+        self.donate_state = True          # in-place param updates (XLA)
+        self.remat = False                # jax.checkpoint the whole step
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 0              # XLA owns scheduling; kept for API
+        self.allow_op_delay = False
+        self.num_iteration_per_drop_scope = 100
+        self.use_experimental_executor = False

@@ -358,3 +358,15 @@ def broadcast_shapes(s1, s2):
     longer = s1 if len(s1) > len(s2) else s2
     out.extend(reversed(longer[: abs(len(s1) - len(s2))]))
     return tuple(reversed(out))
+
+
+def int_list(v, n):
+    """Normalize a scalar-or-sequence attr (strides/paddings/ksize...) to a
+    length-n list (shared by conv/pool ops and CNN layers)."""
+    if isinstance(v, (list, tuple)):
+        if len(v) != n:
+            raise ValueError(
+                "expected %d values, got %r" % (n, list(v))
+            )
+        return list(v)
+    return [v] * n

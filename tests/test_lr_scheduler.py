@@ -1,0 +1,94 @@
+"""LR schedule tests (reference test_learning_rate_scheduler.py pattern:
+run N steps, compare the in-graph LR against the python formula)."""
+
+import math
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run_schedule(lr_var, steps=8):
+    # LR vars live in the main program; a dummy op keeps the program
+    # non-empty even though the schedule itself already adds ops
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    vals = []
+    for _ in range(steps):
+        (v,) = exe.run(feed={}, fetch_list=[lr_var])
+        vals.append(float(np.asarray(v).ravel()[0]))
+    return vals
+
+
+def test_exponential_decay():
+    lr = fluid.layers.exponential_decay(0.1, decay_steps=4, decay_rate=0.5)
+    got = _run_schedule(lr)
+    want = [0.1 * 0.5 ** (s / 4.0) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_exponential_decay_staircase():
+    lr = fluid.layers.exponential_decay(0.1, 4, 0.5, staircase=True)
+    got = _run_schedule(lr)
+    want = [0.1 * 0.5 ** (s // 4) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_natural_exp_decay():
+    lr = fluid.layers.natural_exp_decay(0.1, 4, 0.5)
+    got = _run_schedule(lr)
+    want = [0.1 * math.exp(-0.5 * s / 4.0) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_inverse_time_decay():
+    lr = fluid.layers.inverse_time_decay(0.1, 4, 0.5)
+    got = _run_schedule(lr)
+    want = [0.1 / (1 + 0.5 * s / 4.0) for s in range(8)]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_polynomial_decay():
+    lr = fluid.layers.polynomial_decay(0.1, decay_steps=5,
+                                       end_learning_rate=0.01, power=2.0)
+    got = _run_schedule(lr)
+    want = [
+        (0.1 - 0.01) * (1 - min(s, 5) / 5.0) ** 2 + 0.01 for s in range(8)
+    ]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_piecewise_decay():
+    lr = fluid.layers.piecewise_decay([3, 6], [0.1, 0.01, 0.001])
+    got = _run_schedule(lr, steps=9)
+    want = [0.1] * 3 + [0.01] * 3 + [0.001] * 3
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_noam_decay():
+    lr = fluid.layers.noam_decay(d_model=64, warmup_steps=4)
+    got = _run_schedule(lr)
+    want = [
+        64 ** -0.5 * min((s + 1) ** -0.5, (s + 1) * 4 ** -1.5)
+        for s in range(8)
+    ]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_optimizer_with_decayed_lr_trains():
+    img = fluid.layers.data("img", shape=[8])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(img, size=4, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    lr = fluid.layers.exponential_decay(0.1, decay_steps=10, decay_rate=0.5)
+    fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 8).astype("float32")
+    y = rng.randint(0, 4, (16, 1)).astype("int64")
+    losses = []
+    for _ in range(10):
+        (lv,) = exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0]

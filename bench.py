@@ -101,6 +101,45 @@ def bench_resnet50(args):
             "unit": "images/sec", "vs_baseline": round(ips / target, 4)}
 
 
+def bench_transformer(args):
+    """Transformer-base fwd+bwd+Adam tokens/sec (BASELINE config 3).
+    Target: 0.9x A100 Transformer-base NMT training ~ 95k tok/s
+    (transformer-base, fp16, effective bs~12k tokens) => 85.5k tok/s."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer as tfm
+
+    batch = args.batch_size or 64
+    seq_len = 64
+    vocab = 32000
+    src = fluid.layers.data("src_word", shape=[1], dtype="int64", lod_level=1)
+    tgt = fluid.layers.data("tgt_word", shape=[1], dtype="int64", lod_level=1)
+    label = fluid.layers.data("lbl_word", shape=[1], dtype="int64",
+                              lod_level=1)
+    cost, _ = tfm.transformer(src, tgt, label, seq_len, seq_len, vocab,
+                              vocab, n_layer=6, n_head=8, d_model=512,
+                              d_inner=2048, dropout_rate=0.1)
+    lr = fluid.layers.noam_decay(512, 4000)
+    fluid.optimizer.Adam(learning_rate=lr, beta1=0.9, beta2=0.997,
+                         epsilon=1e-9).minimize(cost)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(2, vocab, (batch, seq_len, 1)).astype("int64")
+    lens = np.full((batch,), seq_len, "int32")
+    feed = {"src_word": ids, "src_word@LEN": lens,
+            "tgt_word": ids, "tgt_word@LEN": lens,
+            "lbl_word": ids, "lbl_word@LEN": lens}
+
+    step_time = _bench_program(
+        fluid.default_main_program(), fluid.default_startup_program(),
+        lambda: feed, cost,
+        _place(args), args.iterations, args.skip_batch_num)
+    tps = batch * seq_len / step_time
+    target = 95000.0 * 0.9
+    return {"metric": "transformer_base_tokens_per_sec",
+            "value": round(tps, 2), "unit": "tokens/sec",
+            "vs_baseline": round(tps / target, 4)}
+
+
 def _place(args):
     import jax
     import paddle_tpu as fluid
@@ -114,7 +153,7 @@ def _place(args):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="auto",
-                   choices=["auto", "mlp", "resnet50"])
+                   choices=["auto", "mlp", "resnet50", "transformer"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -134,7 +173,8 @@ def main():
             model = "resnet50"
         except ImportError:
             model = "mlp"
-    result = bench_resnet50(args) if model == "resnet50" else bench_mlp(args)
+    result = {"resnet50": bench_resnet50, "transformer": bench_transformer,
+              "mlp": bench_mlp}[model](args)
     print(json.dumps(result))
 
 

@@ -37,12 +37,48 @@ class _Converter:
         return arr
 
 
+class _SequenceConverter:
+    """Ragged rows -> padded [batch, T, ...] + int32 [batch] lengths (the
+    LoD replacement; ``pad_to`` fixes T for static-shape friendliness —
+    per-batch max otherwise, which recompiles per distinct T)."""
+
+    def __init__(self, shape, dtype, pad_to=None):
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.pad_to = pad_to
+        self.rows = []
+
+    def feed(self, item):
+        arr = np.asarray(item, dtype=self.dtype)
+        # scalar-per-step shape [D]=[1] declared: accept [T] and lift to [T,1]
+        if self.shape is not None:
+            trailing = tuple(s for s in self.shape[2:])
+            if trailing == (1,) and arr.ndim == 1:
+                arr = arr[:, None]
+        self.rows.append(arr)
+
+    def done(self):
+        lens = np.asarray([r.shape[0] for r in self.rows], dtype=np.int32)
+        t = int(self.pad_to) if self.pad_to else int(lens.max() if len(lens)
+                                                     else 0)
+        if len(self.rows) and any(r.shape[0] > t for r in self.rows):
+            raise ValueError(
+                "sequence longer than pad_to=%d" % t)
+        trailing = self.rows[0].shape[1:] if self.rows else ()
+        out = np.zeros((len(self.rows), t) + trailing, self.dtype)
+        for i, r in enumerate(self.rows):
+            out[i, :r.shape[0]] = r
+        return out, lens
+
+
 class DataFeeder:
-    def __init__(self, feed_list, place=None, program=None):
+    def __init__(self, feed_list, place=None, program=None, pad_to=None):
         self.feed_dtypes = []
         self.feed_names = []
         self.feed_shapes = []
+        self.feed_lod_levels = []
         self.place = place
+        self.pad_to = pad_to
         if program is None:
             program = default_main_program()
         for v in feed_list:
@@ -52,12 +88,16 @@ class DataFeeder:
             self.feed_names.append(v.name)
             self.feed_dtypes.append(v.dtype)
             self.feed_shapes.append(v.shape)
+            self.feed_lod_levels.append(v.lod_level or 0)
 
     def feed(self, iterable):
-        """rows of tuples -> {name: batched ndarray}."""
+        """rows of tuples -> {name: batched ndarray}; sequence fields
+        (lod_level>=1) additionally produce the '<name>@LEN' array."""
         converters = [
-            _Converter(shape, dtype)
-            for shape, dtype in zip(self.feed_shapes, self.feed_dtypes)
+            _SequenceConverter(shape, dtype, pad_to=self.pad_to)
+            if lod >= 1 else _Converter(shape, dtype)
+            for shape, dtype, lod in zip(
+                self.feed_shapes, self.feed_dtypes, self.feed_lod_levels)
         ]
         for each_sample in iterable:
             assert len(each_sample) == len(converters), (
@@ -66,10 +106,16 @@ class DataFeeder:
             )
             for item, conv in zip(each_sample, converters):
                 conv.feed(item)
-        return {
-            name: conv.done()
-            for name, conv in zip(self.feed_names, converters)
-        }
+        out = {}
+        for name, conv, lod in zip(self.feed_names, converters,
+                                   self.feed_lod_levels):
+            if lod >= 1:
+                arr, lens = conv.done()
+                out[name] = arr
+                out[name + "@LEN"] = lens
+            else:
+                out[name] = conv.done()
+        return out
 
     def feed_parallel(self, iterable, num_places=None):
         """Split one batch into per-device feeds (reference
@@ -80,4 +126,18 @@ class DataFeeder:
         rows = list(iterable)
         n = num_places or 1
         per = math.ceil(len(rows) / n)
-        return [self.feed(rows[i * per:(i + 1) * per]) for i in range(n)]
+        old_pad = self.pad_to
+        try:
+            if old_pad is None and any(l >= 1 for l in self.feed_lod_levels):
+                # pad every slice to the global max so the per-device dicts
+                # concatenate/stack consistently
+                global_max = 0
+                for row in rows:
+                    for item, lod in zip(row, self.feed_lod_levels):
+                        if lod >= 1:
+                            global_max = max(global_max,
+                                             np.asarray(item).shape[0])
+                self.pad_to = global_max or None
+            return [self.feed(rows[i * per:(i + 1) * per]) for i in range(n)]
+        finally:
+            self.pad_to = old_pad

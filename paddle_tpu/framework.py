@@ -110,6 +110,9 @@ class Variable:
         self.initializer = initializer
         # op that produced this var most recently (set by append_op)
         self.op = None
+        # name of the companion [batch] int32 length var for padded
+        # sequences (the LoD replacement; see ops/sequence.py)
+        self._seq_len_name = None
 
     # ---- properties used throughout layers --------------------------------
     @property
@@ -355,10 +358,21 @@ class Block:
 
         self.program._version += 1
         infer_op(op, self)
+        # propagate the sequence-length companion (the padded-batch analog
+        # of the reference's LoD propagation through ops): outputs inherit
+        # the first input's length var unless they set their own
+        seq_len = None
+        for name in op.input_arg_names:
+            v = self._find_var_recursive(name) if name else None
+            if v is not None and getattr(v, "_seq_len_name", None):
+                seq_len = v._seq_len_name
+                break
         for name in op.output_arg_names:
             v = self._find_var_recursive(name)
             if v is not None:
                 v.op = op
+                if seq_len and not getattr(v, "_seq_len_name", None):
+                    v._seq_len_name = seq_len
 
     def to_dict(self):
         return {

@@ -24,9 +24,14 @@ def data(
 ):
     helper_block = default_main_program().current_block()
     shape = list(shape)
-    if append_batch_size:
+    if lod_level >= 1:
+        # padded-batch sequence representation (TPU replacement for LoD):
+        # [batch, time, *shape] plus a companion int32 [batch] length var
+        # named "<name>@LEN" that DataFeeder fills and sequence ops consume
+        shape = [-1, -1] + shape
+    elif append_batch_size:
         shape = [-1] + shape
-    return helper_block.create_var(
+    var = helper_block.create_var(
         name=name,
         shape=shape,
         dtype=dtype,
@@ -35,3 +40,13 @@ def data(
         lod_level=lod_level,
         is_data=True,
     )
+    if lod_level >= 1:
+        len_var = helper_block.create_var(
+            name=name + "@LEN",
+            shape=[-1],
+            dtype="int32",
+            stop_gradient=True,
+            is_data=True,
+        )
+        var._seq_len_name = len_var.name
+    return var

@@ -1,0 +1,339 @@
+"""Sequence & recurrent layers over padded batches.
+
+Parity: reference ``python/paddle/fluid/layers/nn.py`` dynamic_lstm,
+dynamic_lstmp, dynamic_gru, sequence_conv, sequence_pool(+first/last
+step), sequence_softmax, sequence_expand, sequence_reverse, row_conv,
+sequence_mask, sequence_concat, sequence_erase, sequence_enumerate,
+sequence_slice — the LoD input contract becomes the padded-batch +
+``<name>@LEN`` companion convention (see ops/sequence.py).  Lengths
+propagate through ops automatically (framework.Block._infer_and_mark);
+every wrapper also accepts an explicit ``length=`` Variable.
+"""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "dynamic_lstm",
+    "dynamic_lstmp",
+    "dynamic_gru",
+    "sequence_conv",
+    "sequence_pool",
+    "sequence_first_step",
+    "sequence_last_step",
+    "sequence_softmax",
+    "sequence_expand",
+    "sequence_reverse",
+    "sequence_mask",
+    "sequence_concat",
+    "sequence_erase",
+    "sequence_enumerate",
+    "sequence_length",
+    "causal_mask",
+    "padding_attn_bias",
+    "padding_mask",
+    "row_conv",
+]
+
+
+def sequence_length(x, block=None):
+    """The companion length Variable of a padded sequence var."""
+    name = getattr(x, "_seq_len_name", None)
+    if name is None:
+        raise ValueError(
+            "variable %r has no sequence-length companion; create it with "
+            "layers.data(lod_level=1) or pass length= explicitly" % x.name)
+    blk = block if block is not None else x.block
+    return blk._find_var_recursive(name)
+
+
+def _len_of(helper, x, length):
+    if length is not None:
+        return length
+    return sequence_length(x)
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 length=None):
+    """LSTM over a padded sequence batch; ``input`` is [B, T, 4*size]
+    (pre-projected, reference nn.py:dynamic_lstm contract)."""
+    helper = LayerHelper("dynamic_lstm", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    size = size // 4 * 4
+    h = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[h, 4 * h], dtype=dtype)
+    bias_size = [1, 7 * h if use_peepholes else 4 * h]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias],
+              "Length": [_len_of(helper, input, length)]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    helper.append_op(
+        type="lstm", inputs=inputs,
+        outputs={"Hidden": [hidden], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation})
+    return hidden, cell
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, length=None):
+    helper = LayerHelper("dynamic_lstmp", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    h = size // 4
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[proj_size, 4 * h], dtype=dtype)
+    proj_weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[h, proj_size], dtype=dtype)
+    bias_size = [1, 7 * h if use_peepholes else 4 * h]
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=bias_size, dtype=dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="lstmp",
+        inputs={"Input": [input], "Weight": [weight],
+                "ProjWeight": [proj_weight], "Bias": [bias],
+                "Length": [_len_of(helper, input, length)]},
+        outputs={"Projection": [proj], "Cell": [cell]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    return proj, cell
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None,
+                length=None):
+    """GRU over a padded batch; ``input`` is [B, T, 3*size]."""
+    helper = LayerHelper("dynamic_gru", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = helper.input_dtype()
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype)
+    # bias folds into the pre-projected input for parity the reference adds
+    # bias inside the op; we add it to input via elementwise_add
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[3 * size], dtype=dtype, is_bias=True)
+    biased = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="elementwise_add", inputs={"X": [input], "Y": [bias]},
+        outputs={"Out": [biased]}, attrs={"axis": 2})
+    hidden = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [biased], "Weight": [weight],
+              "Length": [_len_of(helper, input, length)]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    helper.append_op(
+        type="gru", inputs=inputs, outputs={"Hidden": [hidden]},
+        attrs={"is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "activation": candidate_activation})
+    return hidden
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None, length=None):
+    helper = LayerHelper("sequence_conv", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = helper.input_dtype()
+    d = input.shape[-1]
+    filter_shape = [filter_size * d, num_filters]
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_conv",
+        inputs={"X": [input], "Filter": [w],
+                "Length": [_len_of(helper, input, length)]},
+        outputs={"Out": [out]},
+        attrs={"contextLength": filter_size,
+               "contextStart": -((filter_size - 1) // 2),
+               "contextStride": filter_stride})
+    if helper.bias_attr is not None and \
+            helper.kwargs.get("bias_attr") is not False:
+        out = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(out)
+
+
+def sequence_pool(input, pool_type, length=None):
+    helper = LayerHelper("sequence_pool", input=input)
+    dtype = helper.input_dtype()
+    out = helper.create_variable_for_type_inference(dtype)
+    max_index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_pool",
+        inputs={"X": [input], "Length": [_len_of(helper, input, length)]},
+        outputs={"Out": [out], "MaxIndex": [max_index]},
+        attrs={"pooltype": pool_type.upper()})
+    out._seq_len_name = None  # pooled away the time axis
+    return out
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    helper = LayerHelper("sequence_softmax", input=input, name=name)
+    out = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        type="sequence_softmax",
+        inputs={"X": [input], "Length": [_len_of(helper, input, length)]},
+        outputs={"Out": [out]})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, length=None):
+    helper = LayerHelper("sequence_expand", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ln = length if length is not None else sequence_length(y)
+    helper.append_op(
+        type="sequence_expand",
+        inputs={"X": [x], "Y": [y], "Length": [ln]},
+        outputs={"Out": [out]})
+    out._seq_len_name = ln.name
+    return out
+
+
+def sequence_reverse(x, name=None, length=None):
+    helper = LayerHelper("sequence_reverse", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_reverse",
+        inputs={"X": [x], "Length": [_len_of(helper, x, length)]},
+        outputs={"Out": [out]})
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """x: [batch] lengths -> [batch, maxlen] 0/1 mask."""
+    if maxlen is None or (isinstance(maxlen, Variable)):
+        raise ValueError("sequence_mask requires a static int maxlen on TPU")
+    helper = LayerHelper("sequence_mask", input=x, name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
+        attrs={"maxlen": int(maxlen), "out_dtype": dtype})
+    return out
+
+
+def sequence_concat(input, name=None, lengths=None):
+    helper = LayerHelper("sequence_concat", input=input, name=name)
+    xs = list(input)
+    lens = lengths or [sequence_length(v) for v in xs]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_concat",
+        inputs={"X": xs, "Length": lens},
+        outputs={"Out": [out], "OutLength": [out_len]})
+    out._seq_len_name = out_len.name
+    return out
+
+
+def sequence_erase(input, tokens, name=None, length=None):
+    helper = LayerHelper("sequence_erase", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_erase",
+        inputs={"X": [input], "Length": [_len_of(helper, input, length)]},
+        outputs={"Out": [out], "OutLength": [out_len]},
+        attrs={"tokens": list(tokens)})
+    out._seq_len_name = out_len.name
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None, length=None):
+    helper = LayerHelper("sequence_enumerate", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_enumerate",
+        inputs={"X": [input], "Length": [_len_of(helper, input, length)]},
+        outputs={"Out": [out]},
+        attrs={"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None, length=None):
+    helper = LayerHelper("row_conv", input=input, param_attr=param_attr,
+                         act=act, name=name)
+    dtype = helper.input_dtype()
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
+                                dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="row_conv",
+        inputs={"X": [input], "Filter": [w],
+                "Length": [_len_of(helper, input, length)]},
+        outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+def causal_mask(ref=None, seq_len=-1, mask_value=-1e9, dtype="float32",
+                name=None):
+    """[T, T] additive causal bias (0 on/below diagonal, mask_value above)
+    for decoder self-attention; T from ``ref``'s time axis (runtime pad
+    length) or a static ``seq_len``. (Transformer support; no reference
+    analog — the reference predates attention.)"""
+    helper = LayerHelper("causal_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Ref": [ref]} if ref is not None else {}
+    helper.append_op(
+        type="causal_mask", inputs=inputs, outputs={"Out": [out]},
+        attrs={"seq_len": int(seq_len), "mask_value": float(mask_value),
+               "dtype": dtype})
+    out.stop_gradient = True
+    out._seq_len_name = None
+    return out
+
+
+def padding_attn_bias(length, ref, mask_value=-1e9, dtype="float32",
+                      name=None):
+    """[B] lengths -> [B, 1, 1, T] additive attention bias, T from ``ref``."""
+    helper = LayerHelper("padding_attn_bias", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="padding_attn_bias", inputs={"Length": [length], "Ref": [ref]},
+        outputs={"Out": [out]},
+        attrs={"mask_value": float(mask_value), "dtype": dtype})
+    out.stop_gradient = True
+    out._seq_len_name = None
+    return out
+
+
+def padding_mask(length, ref, dtype="float32", name=None):
+    """[B] lengths -> [B, T] 0/1 mask, T from ``ref``'s time axis."""
+    helper = LayerHelper("padding_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="padding_mask", inputs={"Length": [length], "Ref": [ref]},
+        outputs={"Out": [out]}, attrs={"dtype": dtype})
+    out.stop_gradient = True
+    out._seq_len_name = None
+    return out

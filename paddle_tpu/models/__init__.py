@@ -4,4 +4,5 @@ machine_translation, stacked_dynamic_lstm) — built from the paddle_tpu
 layers DSL, TPU-first (bfloat16-friendly, MXU-sized matmuls/convs).
 """
 
-from . import mnist, resnet, se_resnext, vgg  # noqa: F401
+from . import (mnist, resnet, se_resnext, stacked_dynamic_lstm,  # noqa: F401
+               transformer, vgg)

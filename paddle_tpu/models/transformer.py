@@ -1,0 +1,189 @@
+"""Transformer-base NMT (BASELINE config 3; reference
+``benchmark/fluid/models/machine_translation.py`` +
+``python/paddle/fluid/tests/unittests/dist_transformer.py`` capability).
+
+Built entirely from the layers DSL over padded sequences: every attention
+projection and the QK^T/PV products are MXU gemms; masks come from the
+``<name>@LEN`` companions (sequence_mask) and the causal_mask op.  The
+whole encoder-decoder fwd+bwd+Adam step compiles to one HLO module.
+
+Architecture: post-norm Transformer (Vaswani et al.) — d_model 512,
+n_head 8, 6+6 layers, ffn 2048, shared-nothing embeddings, label
+smoothing + noam LR (wired by the caller).
+"""
+
+import numpy as np
+
+from .. import layers
+from ..initializer import NumpyArrayInitializer
+from ..param_attr import ParamAttr
+
+__all__ = ["transformer", "wrap_encoder", "wrap_decoder",
+           "position_encoding_init"]
+
+
+def position_encoding_init(n_position, d_model):
+    """Sinusoid position encoding table [n_position, d_model]."""
+    pos = np.arange(n_position)[:, None].astype("float64")
+    dim = np.arange(d_model // 2)[None, :].astype("float64")
+    angle = pos / np.power(10000.0, 2 * dim / d_model)
+    enc = np.zeros((n_position, d_model))
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle)
+    return enc.astype("float32")
+
+
+def _multi_head_attention(queries, keys, values, attn_bias, d_model, n_head,
+                          dropout_rate, is_test, cache_name):
+    d_key = d_model // n_head
+    q = layers.fc(queries, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  name=cache_name + "_q")
+    k = layers.fc(keys, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  name=cache_name + "_k")
+    v = layers.fc(values, size=d_model, num_flatten_dims=2, bias_attr=False,
+                  name=cache_name + "_v")
+
+    def split_heads(x):
+        r = layers.reshape(x, shape=[0, 0, n_head, d_key])
+        return layers.transpose(r, perm=[0, 2, 1, 3])
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    q = layers.scale(q, scale=d_key ** -0.5)
+    product = layers.matmul(q, k, transpose_y=True)   # [B, H, Tq, Tk]
+    if attn_bias is not None:
+        product = layers.elementwise_add(product, attn_bias)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate,
+                                 is_test=is_test)
+    ctx = layers.matmul(weights, v)                   # [B, H, Tq, dk]
+    ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
+    ctx = layers.reshape(ctx, shape=[0, 0, d_model])
+    return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False,
+                     name=cache_name + "_o")
+
+
+def _ffn(x, d_inner, d_model, is_test, dropout_rate, name):
+    h = layers.fc(x, size=d_inner, num_flatten_dims=2, act="relu",
+                  name=name + "_fc1")
+    if dropout_rate:
+        h = layers.dropout(h, dropout_prob=dropout_rate, is_test=is_test)
+    return layers.fc(h, size=d_model, num_flatten_dims=2, name=name + "_fc2")
+
+
+def _post_process(prev, sublayer_out, dropout_rate, is_test):
+    if dropout_rate:
+        sublayer_out = layers.dropout(sublayer_out,
+                                      dropout_prob=dropout_rate,
+                                      is_test=is_test)
+    added = layers.elementwise_add(prev, sublayer_out)
+    return layers.layer_norm(added, begin_norm_axis=2)
+
+
+def _prepare_embedding(word, pos_table_name, vocab_size, d_model, max_len,
+                       dropout_rate, is_test, name):
+    emb = layers.embedding(
+        word, size=[vocab_size, d_model],
+        param_attr=ParamAttr(name=name + "_word_emb"))
+    emb = layers.scale(emb, scale=d_model ** 0.5)
+    pos_enc = position_encoding_init(max_len, d_model)
+    pos_param = ParamAttr(
+        name=pos_table_name,
+        initializer=NumpyArrayInitializer(pos_enc),
+        trainable=False)
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper(name + "_posenc")
+    table = helper.create_parameter(
+        attr=pos_param, shape=[max_len, d_model], dtype="float32")
+    out = helper.create_variable_for_type_inference("float32")
+    # table[:T] added at trace time (T is the runtime pad length)
+    helper.append_op(
+        type="add_position_encoding",
+        inputs={"X": [emb], "Table": [table]},
+        outputs={"Out": [out]})
+    if dropout_rate:
+        out = layers.dropout(out, dropout_prob=dropout_rate, is_test=is_test)
+    out._seq_len_name = word._seq_len_name
+    return out
+
+
+def _attn_bias_from_len(len_var, ref, n_head):
+    """[B] lengths -> [B, 1, 1, T] additive bias (0 valid / -1e9 pad)."""
+    return layers.padding_attn_bias(len_var, ref)
+
+
+def wrap_encoder(src_word, src_max_len, vocab_size, n_layer=6, n_head=8,
+                 d_model=512, d_inner=2048, dropout_rate=0.1, is_test=False):
+    src_len = src_word.block._find_var_recursive(src_word._seq_len_name)
+    enc_in = _prepare_embedding(src_word, "src_pos_enc", vocab_size, d_model,
+                                src_max_len, dropout_rate, is_test, "src")
+    bias = _attn_bias_from_len(src_len, enc_in, n_head)
+    x = enc_in
+    for i in range(n_layer):
+        attn = _multi_head_attention(x, x, x, bias, d_model, n_head,
+                                     dropout_rate, is_test,
+                                     "enc%d_attn" % i)
+        x = _post_process(x, attn, dropout_rate, is_test)
+        ffn = _ffn(x, d_inner, d_model, is_test, dropout_rate,
+                   "enc%d_ffn" % i)
+        x = _post_process(x, ffn, dropout_rate, is_test)
+    x._seq_len_name = src_word._seq_len_name
+    return x
+
+
+def wrap_decoder(tgt_word, enc_out, tgt_max_len, vocab_size, n_layer=6,
+                 n_head=8, d_model=512, d_inner=2048, dropout_rate=0.1,
+                 is_test=False):
+    tgt_len = tgt_word.block._find_var_recursive(tgt_word._seq_len_name)
+    src_len = enc_out.block._find_var_recursive(enc_out._seq_len_name)
+    dec_in = _prepare_embedding(tgt_word, "tgt_pos_enc", vocab_size, d_model,
+                                tgt_max_len, dropout_rate, is_test, "tgt")
+    self_bias = _attn_bias_from_len(tgt_len, dec_in, n_head)
+    causal = layers.causal_mask(ref=dec_in)
+    self_bias = layers.elementwise_add(self_bias, causal)
+    cross_bias = _attn_bias_from_len(src_len, enc_out, n_head)
+
+    x = dec_in
+    for i in range(n_layer):
+        self_attn = _multi_head_attention(x, x, x, self_bias, d_model,
+                                          n_head, dropout_rate, is_test,
+                                          "dec%d_self" % i)
+        x = _post_process(x, self_attn, dropout_rate, is_test)
+        cross = _multi_head_attention(x, enc_out, enc_out, cross_bias,
+                                      d_model, n_head, dropout_rate,
+                                      is_test, "dec%d_cross" % i)
+        x = _post_process(x, cross, dropout_rate, is_test)
+        ffn = _ffn(x, d_inner, d_model, is_test, dropout_rate,
+                   "dec%d_ffn" % i)
+        x = _post_process(x, ffn, dropout_rate, is_test)
+    logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
+                       name="dec_logits")
+    return logits
+
+
+def transformer(src_word, tgt_word, label, src_max_len, tgt_max_len,
+                src_vocab_size, tgt_vocab_size, n_layer=6, n_head=8,
+                d_model=512, d_inner=2048, dropout_rate=0.1,
+                label_smooth_eps=0.1, is_test=False):
+    """Full train graph: returns (avg_cost, logits)."""
+    enc_out = wrap_encoder(src_word, src_max_len, src_vocab_size, n_layer,
+                           n_head, d_model, d_inner, dropout_rate, is_test)
+    logits = wrap_decoder(tgt_word, enc_out, tgt_max_len, tgt_vocab_size,
+                          n_layer, n_head, d_model, d_inner, dropout_rate,
+                          is_test)
+    # label: [B, T, 1] int64 ids (padded); mask from tgt lengths
+    tgt_len = tgt_word.block._find_var_recursive(tgt_word._seq_len_name)
+    if label_smooth_eps:
+        oh = layers.one_hot(label, depth=tgt_vocab_size)
+        soft = layers.label_smooth(oh, epsilon=label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(logits, soft,
+                                                 soft_label=True)
+    else:
+        cost = layers.softmax_with_cross_entropy(logits, label)
+    mask = layers.padding_mask(tgt_len, logits)  # [B,T]
+    mask3 = layers.unsqueeze(mask, axes=[2])
+    masked = layers.elementwise_mul(cost, mask3)
+    total = layers.reduce_sum(masked)
+    n_tok = layers.reduce_sum(mask)
+    avg_cost = layers.elementwise_div(total, n_tok)
+    return avg_cost, logits

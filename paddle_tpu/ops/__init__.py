@@ -19,4 +19,6 @@ from . import (  # noqa: F401
     pool,
     random,
     reduction,
+    rnn,
+    sequence,
 )

@@ -44,7 +44,12 @@ def _reshape_infer(op, block):
     if -1 not in x.shape:
         out = _resolve_reshape(x.shape, spec)
     else:
-        out = tuple(spec)
+        # dynamic dims present: resolve what we can — 0 copies the input
+        # dim (possibly -1), -1 stays symbolic
+        out = tuple(
+            (x.shape[i] if i < len(x.shape) else -1) if s == 0 else s
+            for i, s in enumerate(spec)
+        )
     set_output(op, block, "Out", out, x.dtype)
 
 

@@ -1,0 +1,288 @@
+"""Recurrent ops: dynamic_lstm, dynamic_lstmp, dynamic_gru, lstm_unit,
+gru_unit.
+
+Parity: reference ``lstm_op.cc`` / ``lstmp_op.cc`` / ``gru_op.cc`` /
+``lstm_unit_op.cc`` / ``gru_unit_op.cc`` (+ ``math/lstm_compute``,
+``math/gru_compute``, ``math/sequence2batch`` batch reordering) —
+TPU-native: one ``lax.scan`` over the time axis of the padded batch; the
+per-step compute is a single fused gate matmul on the MXU.  The
+reference's LoD->batch reordering machinery (sequence2batch.cc) is
+unnecessary: masking freezes finished sequences' carry instead.
+
+Gate layouts follow the reference: LSTM projections are ``[B, T, 4H]``
+with gate order (c, i, f, o) as documented in lstm_op.cc
+(Weight = {W_ch, W_ih, W_fh, W_oh}, Bias = {b_c, b_i, b_f, b_o}); GRU is
+``[B, T, 3H]`` with (u, r, c).  Peephole weights live in the 7H-wide Bias
+(lstm_op.cc use_peepholes).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda v: v,
+}
+
+
+def _lstm_infer(op, block):
+    x = in_var(op, block, "Input")     # [B, T, 4H]
+    h = x.shape[2] // 4
+    set_output(op, block, "Hidden", (x.shape[0], x.shape[1], h), x.dtype)
+    set_output(op, block, "Cell", (x.shape[0], x.shape[1], h), x.dtype)
+
+
+def _lstm_compute(ins, attrs, ctx, op_index):
+    x = ins["Input"][0]                      # [B, T, 4H] (x @ W_x + b_x)
+    w = ins["Weight"][0]                     # [H, 4H] recurrent
+    bias = ins["Bias"][0]                    # [1, 4H] or [1, 7H] peepholes
+    length = ins["Length"][0]
+    h0 = ins.get("H0", [None])[0]
+    c0 = ins.get("C0", [None])[0]
+    b, t, h4 = x.shape
+    h = h4 // 4
+    use_peep = attrs.get("use_peepholes", True) and bias.shape[-1] == 7 * h
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    reverse = attrs.get("is_reverse", False)
+
+    gb = bias[..., :4 * h].reshape(4 * h)
+    if use_peep:
+        w_ic = bias[..., 4 * h:5 * h].reshape(h)
+        w_fc = bias[..., 5 * h:6 * h].reshape(h)
+        w_oc = bias[..., 6 * h:7 * h].reshape(h)
+
+    xs = jnp.swapaxes(x, 0, 1)               # [T, B, 4H]
+    if reverse:
+        xs = xs[::-1]
+    steps = jnp.arange(t)
+    if reverse:
+        steps = steps[::-1]
+
+    h_prev0 = h0 if h0 is not None else jnp.zeros((b, h), x.dtype)
+    c_prev0 = c0 if c0 is not None else jnp.zeros((b, h), x.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, tidx = inp
+        gates = xt + h_prev @ w + gb
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            i = gate_act(gi + c_prev * w_ic)
+            f = gate_act(gf + c_prev * w_fc)
+        else:
+            i = gate_act(gi)
+            f = gate_act(gf)
+        c = f * c_prev + i * cand_act(gc)
+        if use_peep:
+            o = gate_act(go + c * w_oc)
+        else:
+            o = gate_act(go)
+        hh = o * cell_act(c)
+        valid = (tidx < length)[:, None]
+        c = jnp.where(valid, c, c_prev)
+        hh_keep = jnp.where(valid, hh, 0)
+        h_new = jnp.where(valid, hh, h_prev)
+        return (h_new, c), (hh_keep, jnp.where(valid, c, 0))
+
+    (_, _), (hs, cs) = lax.scan(step, (h_prev0, c_prev0), (xs, steps))
+    if reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    return {"Hidden": jnp.swapaxes(hs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+register_op(
+    "lstm", ["Input", "Weight", "Bias", "Length", "H0", "C0"],
+    ["Hidden", "Cell"], infer=_lstm_infer, compute=_lstm_compute,
+    no_grad_inputs=("Length",),
+)
+
+
+# -- dynamic_lstmp (lstm with projection, lstmp_op.cc) ----------------------
+
+def _lstmp_infer(op, block):
+    x = in_var(op, block, "Input")
+    w_proj = in_var(op, block, "ProjWeight")  # [H, P]
+    p = w_proj.shape[1]
+    h = x.shape[2] // 4
+    set_output(op, block, "Projection", (x.shape[0], x.shape[1], p), x.dtype)
+    set_output(op, block, "Cell", (x.shape[0], x.shape[1], h), x.dtype)
+
+
+def _lstmp_compute(ins, attrs, ctx, op_index):
+    x = ins["Input"][0]
+    w = ins["Weight"][0]                     # [P, 4H]
+    w_proj = ins["ProjWeight"][0]            # [H, P]
+    bias = ins["Bias"][0]
+    length = ins["Length"][0]
+    b, t, h4 = x.shape
+    h = h4 // 4
+    p = w_proj.shape[1]
+    use_peep = attrs.get("use_peepholes", True) and bias.shape[-1] == 7 * h
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    proj_act = _ACT[attrs.get("proj_activation", "tanh")]
+    reverse = attrs.get("is_reverse", False)
+
+    gb = bias[..., :4 * h].reshape(4 * h)
+    if use_peep:
+        w_ic = bias[..., 4 * h:5 * h].reshape(h)
+        w_fc = bias[..., 5 * h:6 * h].reshape(h)
+        w_oc = bias[..., 6 * h:7 * h].reshape(h)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    steps = jnp.arange(t)
+    if reverse:
+        xs, steps = xs[::-1], steps[::-1]
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        xt, tidx = inp
+        gates = xt + r_prev @ w + gb
+        gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
+        if use_peep:
+            i = gate_act(gi + c_prev * w_ic)
+            f = gate_act(gf + c_prev * w_fc)
+        else:
+            i, f = gate_act(gi), gate_act(gf)
+        c = f * c_prev + i * cand_act(gc)
+        o = gate_act(go + c * w_oc) if use_peep else gate_act(go)
+        hh = o * cell_act(c)
+        r = proj_act(hh @ w_proj)
+        valid = (tidx < length)[:, None]
+        c = jnp.where(valid, c, c_prev)
+        r_new = jnp.where(valid, r, r_prev)
+        return (r_new, c), (jnp.where(valid, r, 0), jnp.where(valid, c, 0))
+
+    init = (jnp.zeros((b, p), x.dtype), jnp.zeros((b, h), x.dtype))
+    _, (rs, cs) = lax.scan(step, init, (xs, steps))
+    if reverse:
+        rs, cs = rs[::-1], cs[::-1]
+    return {"Projection": jnp.swapaxes(rs, 0, 1),
+            "Cell": jnp.swapaxes(cs, 0, 1)}
+
+
+register_op(
+    "lstmp", ["Input", "Weight", "ProjWeight", "Bias", "Length"],
+    ["Projection", "Cell"], infer=_lstmp_infer, compute=_lstmp_compute,
+    no_grad_inputs=("Length",),
+)
+
+
+# -- dynamic_gru (gru_op.cc) ------------------------------------------------
+
+def _gru_infer(op, block):
+    x = in_var(op, block, "Input")     # [B, T, 3H]
+    h = x.shape[2] // 3
+    set_output(op, block, "Hidden", (x.shape[0], x.shape[1], h), x.dtype)
+
+
+def _gru_compute(ins, attrs, ctx, op_index):
+    x = ins["Input"][0]                     # [B, T, 3H] = x@W_x + b
+    w = ins["Weight"][0]                    # [H, 3H]: [W_u, W_r | W_c]
+    length = ins["Length"][0]
+    h0 = ins.get("H0", [None])[0]
+    b, t, h3 = x.shape
+    h = h3 // 3
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    reverse = attrs.get("is_reverse", False)
+    w_g = w[:, :2 * h]                      # update+reset recurrent
+    w_c = w[:, 2 * h:]                      # candidate recurrent
+
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = xs[::-1]
+    steps = jnp.arange(t)
+    if reverse:
+        steps = steps[::-1]
+    h_prev0 = h0 if h0 is not None else jnp.zeros((b, h), x.dtype)
+
+    def step(h_prev, inp):
+        xt, tidx = inp
+        xg, xc = xt[:, :2 * h], xt[:, 2 * h:]
+        g = gate_act(xg + h_prev @ w_g)
+        u, r = g[:, :h], g[:, h:]
+        c = cand_act(xc + (r * h_prev) @ w_c)
+        # paddle gru: h = u * h_prev + (1 - u) * c
+        hh = u * h_prev + (1.0 - u) * c
+        valid = (tidx < length)[:, None]
+        h_new = jnp.where(valid, hh, h_prev)
+        return h_new, jnp.where(valid, hh, 0)
+
+    _, hs = lax.scan(step, h_prev0, (xs, steps))
+    if reverse:
+        hs = hs[::-1]
+    return {"Hidden": jnp.swapaxes(hs, 0, 1)}
+
+
+register_op(
+    "gru", ["Input", "Weight", "Length", "H0"], ["Hidden"],
+    infer=_gru_infer, compute=_gru_compute, no_grad_inputs=("Length",),
+)
+
+
+# -- single-step units (lstm_unit_op.cc / gru_unit_op.cc) -------------------
+
+def _lstm_unit_infer(op, block):
+    x = in_var(op, block, "X")         # [B, 4H]
+    h = x.shape[-1] // 4
+    set_output(op, block, "H", (x.shape[0], h), x.dtype)
+    set_output(op, block, "C", (x.shape[0], h), x.dtype)
+
+
+def _lstm_unit_compute(ins, attrs, ctx, op_index):
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    h = x.shape[-1] // 4
+    gi, gc, gf, go = jnp.split(x, 4, axis=-1)
+    i = jax.nn.sigmoid(gi)
+    f = jax.nn.sigmoid(gf + forget_bias)
+    c = f * c_prev + i * jnp.tanh(gc)
+    o = jax.nn.sigmoid(go)
+    return {"H": o * jnp.tanh(c), "C": c}
+
+
+register_op("lstm_unit", ["X", "C_prev"], ["H", "C"],
+            infer=_lstm_unit_infer, compute=_lstm_unit_compute)
+
+
+def _gru_unit_infer(op, block):
+    x = in_var(op, block, "Input")     # [B, 3H]
+    h = x.shape[-1] // 3
+    set_output(op, block, "Hidden", (x.shape[0], h), x.dtype)
+    set_output(op, block, "Gate", (x.shape[0], 3 * h), x.dtype)
+    set_output(op, block, "ResetHiddenPrev", (x.shape[0], h), x.dtype)
+
+
+def _gru_unit_compute(ins, attrs, ctx, op_index):
+    x, h_prev, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
+    bias = ins.get("Bias", [None])[0]
+    h = x.shape[-1] // 3
+    if bias is not None:
+        x = x + bias.reshape(-1)
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    xg, xc = x[:, :2 * h], x[:, 2 * h:]
+    g = gate_act(xg + h_prev @ w[:, :2 * h])
+    u, r = g[:, :h], g[:, h:]
+    rhp = r * h_prev
+    c = cand_act(xc + rhp @ w[:, 2 * h:])
+    hh = u * h_prev + (1.0 - u) * c
+    return {"Hidden": hh, "Gate": jnp.concatenate([g, c], axis=-1),
+            "ResetHiddenPrev": rhp}
+
+
+register_op("gru_unit", ["Input", "HiddenPrev", "Weight", "Bias"],
+            ["Hidden", "Gate", "ResetHiddenPrev"],
+            infer=_gru_unit_infer, compute=_gru_unit_compute)

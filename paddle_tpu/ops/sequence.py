@@ -1,0 +1,456 @@
+"""Sequence ops over padded batches with explicit lengths.
+
+Parity: the reference's LoD sequence family
+(``paddle/fluid/operators/sequence_*_op.cc``, ``math/sequence_pooling.cc``,
+``row_conv_op.cc``, ``sequence_conv_op.cc`` + ``math/im2sequence``) —
+re-designed for XLA's static shapes: a "sequence batch" is a dense
+``[batch, time, ...]`` array plus an int32 ``[batch]`` length vector
+(SURVEY.md §5 long-context: segment/mask-based packing instead of LoD
+offset vectors).  Every op takes the lengths through a ``Length`` slot
+(wired automatically by the layer wrappers from the ``<name>@LEN``
+companion var created by ``layers.data(lod_level>=1)``).
+
+Masked positions (t >= length) are zeros on output; gradients through
+auto-vjp respect the mask because it is part of the traced math.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+
+def _time_mask(length, t, extra_dims=0):
+    """[B, T] (+ extra trailing singleton dims) validity mask."""
+    m = jnp.arange(t)[None, :] < length[:, None]
+    return m.reshape(m.shape + (1,) * extra_dims)
+
+
+# -- sequence_mask ----------------------------------------------------------
+
+def _seq_mask_infer(op, block):
+    x = in_var(op, block, "X")
+    maxlen = op.attrs.get("maxlen", -1)
+    t = maxlen if maxlen > 0 else -1
+    set_output(op, block, "Y", tuple(x.shape) + (t,),
+               op.attrs.get("out_dtype", "float32"))
+
+
+def _seq_mask_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen <= 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen under XLA (got %r)" % maxlen)
+    dtype = attrs.get("out_dtype", "float32")
+    mask = jnp.arange(maxlen)[None, :] < x[..., None]
+    return {"Y": mask.astype(dtype)}
+
+
+register_op("sequence_mask", ["X"], ["Y"], infer=_seq_mask_infer,
+            compute=_seq_mask_compute, grad=None)
+
+
+# -- sequence_pool ----------------------------------------------------------
+
+def _seq_pool_infer(op, block):
+    x = in_var(op, block, "X")
+    out_shape = (x.shape[0],) + tuple(x.shape[2:])
+    set_output(op, block, "Out", out_shape, x.dtype)
+    set_output(op, block, "MaxIndex", out_shape, "int32")
+
+
+def _seq_pool_compute(ins, attrs, ctx, op_index):
+    x, length = ins["X"][0], ins["Length"][0]
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    t = x.shape[1]
+    mask = _time_mask(length, t, x.ndim - 2)
+    denom = jnp.maximum(length, 1).astype(x.dtype)
+    denom = denom.reshape((-1,) + (1,) * (x.ndim - 2))
+    idx = None
+    if ptype == "AVERAGE":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / denom
+    elif ptype == "SUM":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1)
+    elif ptype == "SQRT":
+        out = jnp.sum(jnp.where(mask, x, 0), axis=1) / jnp.sqrt(denom)
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min
+        masked = jnp.where(mask, x, neg)
+        out = jnp.max(masked, axis=1)
+        idx = jnp.argmax(masked, axis=1).astype(jnp.int32)
+        # empty sequences pool to 0
+        valid0 = (length > 0).reshape(denom.shape)
+        out = jnp.where(valid0, out, 0)
+    elif ptype == "LAST":
+        last = jnp.maximum(length - 1, 0)
+        out = jnp.take_along_axis(
+            x, last.reshape((-1, 1) + (1,) * (x.ndim - 2)).astype(jnp.int32),
+            axis=1).squeeze(1)
+        out = jnp.where((length > 0).reshape(denom.shape), out, 0)
+    elif ptype == "FIRST":
+        out = jnp.where((length > 0).reshape(denom.shape), x[:, 0], 0)
+    else:
+        raise ValueError("unknown pooltype %r" % ptype)
+    res = {"Out": out}
+    if idx is not None:
+        res["MaxIndex"] = idx
+    return res
+
+
+register_op("sequence_pool", ["X", "Length"], ["Out", "MaxIndex"],
+            infer=_seq_pool_infer, compute=_seq_pool_compute,
+            no_grad_inputs=("Length",))
+
+
+# -- sequence_softmax -------------------------------------------------------
+
+def _seq_softmax_compute(ins, attrs, ctx, op_index):
+    x, length = ins["X"][0], ins["Length"][0]
+    t = x.shape[1]
+    extra = x.ndim - 2
+    mask = _time_mask(length, t, extra)
+    neg = jnp.finfo(x.dtype).min
+    logits = jnp.where(mask, x, neg)
+    sm = jax.nn.softmax(logits, axis=1)
+    return {"Out": jnp.where(mask, sm, 0)}
+
+
+register_op(
+    "sequence_softmax", ["X", "Length"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=_seq_softmax_compute, no_grad_inputs=("Length",),
+)
+
+
+# -- sequence_expand --------------------------------------------------------
+
+def _seq_expand_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    set_output(op, block, "Out",
+               (x.shape[0], y.shape[1]) + tuple(x.shape[1:]), x.dtype)
+
+
+def _seq_expand_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]               # [B, ...] one row per sequence
+    y = ins["Y"][0]               # [B, T, ...] provides the time extent
+    length = ins["Length"][0]     # lengths of y
+    t = y.shape[1]
+    expanded = jnp.broadcast_to(
+        x[:, None], (x.shape[0], t) + x.shape[1:])
+    mask = _time_mask(length, t, expanded.ndim - 2)
+    return {"Out": jnp.where(mask, expanded, 0)}
+
+
+register_op("sequence_expand", ["X", "Y", "Length"], ["Out"],
+            infer=_seq_expand_infer, compute=_seq_expand_compute,
+            no_grad_inputs=("Y", "Length"))
+
+
+# -- sequence_concat (along time) -------------------------------------------
+
+def _seq_concat_infer(op, block):
+    xs = [block._find_var_recursive(n) for n in op.inputs["X"]]
+    dims = [v.shape[1] for v in xs]
+    # any dynamic time dim makes the concat time dim dynamic
+    t = -1 if any(d is None or d < 0 for d in dims) else sum(dims)
+    set_output(op, block, "Out", (xs[0].shape[0], t) + tuple(xs[0].shape[2:]),
+               xs[0].dtype)
+    set_output(op, block, "OutLength", (xs[0].shape[0],), "int32")
+
+
+def _seq_concat_compute(ins, attrs, ctx, op_index):
+    xs = ins["X"]
+    lens = ins["Length"]
+    b = xs[0].shape[0]
+    total_t = sum(x.shape[1] for x in xs)
+    out_len = sum(lens)
+    # scatter each sequence's valid prefix at its running offset
+    out = jnp.zeros((b, total_t) + xs[0].shape[2:], xs[0].dtype)
+    offset = jnp.zeros((b,), jnp.int32)
+    for x, ln in zip(xs, lens):
+        t = x.shape[1]
+        pos = offset[:, None] + jnp.arange(t)[None, :]          # [B, T_i]
+        valid = jnp.arange(t)[None, :] < ln[:, None]
+        pos = jnp.where(valid, pos, total_t)  # out-of-range drops
+        bidx = jnp.broadcast_to(jnp.arange(b)[:, None], pos.shape)
+        out = out.at[bidx, pos].add(
+            jnp.where(valid.reshape(valid.shape + (1,) * (x.ndim - 2)),
+                      x, 0),
+            mode="drop")
+        offset = offset + ln
+    return {"Out": out, "OutLength": out_len.astype(jnp.int32)}
+
+
+register_op("sequence_concat", ["X", "Length"], ["Out", "OutLength"],
+            infer=_seq_concat_infer, compute=_seq_concat_compute,
+            no_grad_inputs=("Length",))
+
+
+# -- sequence_reverse -------------------------------------------------------
+
+def _seq_reverse_compute(ins, attrs, ctx, op_index):
+    x, length = ins["X"][0], ins["Length"][0]
+    t = x.shape[1]
+    # index t -> len-1-t for valid positions, identity elsewhere
+    ar = jnp.arange(t)[None, :]
+    idx = jnp.where(ar < length[:, None], length[:, None] - 1 - ar, ar)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32)
+    out = jnp.take_along_axis(x, jnp.broadcast_to(idx, x.shape), axis=1)
+    return {"Out": out}
+
+
+register_op(
+    "sequence_reverse", ["X", "Length"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=_seq_reverse_compute, no_grad_inputs=("Length",),
+)
+
+
+# -- sequence_conv (context-window fc, sequence_conv_op.cc) -----------------
+
+def _seq_conv_infer(op, block):
+    x = in_var(op, block, "X")
+    w = in_var(op, block, "Filter")   # [ctx * D, out]
+    set_output(op, block, "Out", (x.shape[0], x.shape[1], w.shape[1]),
+               x.dtype)
+
+
+def _seq_conv_compute(ins, attrs, ctx, op_index):
+    x, w = ins["X"][0], ins["Filter"][0]
+    length = ins["Length"][0]
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -((ctx_len - 1) // 2))
+    b, t, d = x.shape
+    mask = _time_mask(length, t, 1)
+    xm = jnp.where(mask, x, 0)
+    # gather the context window per step: rows [t+ctx_start, ...]
+    cols = []
+    for j in range(ctx_len):
+        shift = ctx_start + j
+        rolled = jnp.roll(xm, -shift, axis=1)
+        ar = jnp.arange(t)
+        valid = (ar + shift >= 0) & (ar + shift < t)
+        cols.append(jnp.where(valid[None, :, None], rolled, 0))
+    ctx_mat = jnp.concatenate(cols, axis=-1)          # [B, T, ctx*D]
+    out = jnp.einsum("btc,co->bto", ctx_mat, w)
+    return {"Out": jnp.where(mask, out, 0)}
+
+
+register_op("sequence_conv", ["X", "Filter", "Length"], ["Out"],
+            infer=_seq_conv_infer, compute=_seq_conv_compute,
+            no_grad_inputs=("Length",))
+
+
+# -- row_conv (lookahead conv, row_conv_op.cc) ------------------------------
+
+def _row_conv_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _row_conv_compute(ins, attrs, ctx, op_index):
+    x, w = ins["X"][0], ins["Filter"][0]   # x [B,T,D], w [k, D]
+    length = ins["Length"][0]
+    k = w.shape[0]
+    t = x.shape[1]
+    mask = _time_mask(length, t, 1)
+    xm = jnp.where(mask, x, 0)
+    out = jnp.zeros_like(x)
+    for j in range(k):
+        rolled = jnp.roll(xm, -j, axis=1)
+        valid = (jnp.arange(t) + j < t)
+        out = out + jnp.where(valid[None, :, None], rolled, 0) * w[j][None,
+                                                                     None]
+    return {"Out": jnp.where(mask, out, 0)}
+
+
+register_op("row_conv", ["X", "Filter", "Length"], ["Out"],
+            infer=_row_conv_infer, compute=_row_conv_compute,
+            no_grad_inputs=("Length",))
+
+
+# -- sequence_erase (drop tokens, int sequences) ----------------------------
+
+def _seq_erase_compute(ins, attrs, ctx, op_index):
+    x, length = ins["X"][0], ins["Length"][0]
+    squeeze = x.ndim == 3 and x.shape[-1] == 1   # [B,T,1] id layout
+    if squeeze:
+        x = x[..., 0]
+    tokens = attrs.get("tokens", [])
+    t = x.shape[1]
+    keep = _time_mask(length, t)
+    for tok in tokens:
+        keep = keep & (x != tok)
+    # stable-compact the kept tokens to the left
+    new_pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    new_pos = jnp.where(keep, new_pos, t)
+    out = jnp.zeros_like(x)
+    bidx = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None], x.shape)
+    out = out.at[bidx, new_pos].add(jnp.where(keep, x, 0), mode="drop")
+    new_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    if squeeze:
+        out = out[..., None]
+    return {"Out": out, "OutLength": new_len}
+
+
+register_op(
+    "sequence_erase", ["X", "Length"], ["Out", "OutLength"],
+    infer=lambda op, block: (
+        set_output(op, block, "Out", in_var(op, block, "X").shape,
+                   in_var(op, block, "X").dtype),
+        set_output(op, block, "OutLength",
+                   (in_var(op, block, "X").shape[0],), "int32"),
+    ),
+    compute=_seq_erase_compute, grad=None,
+)
+
+
+# -- sequence_enumerate (win_size n-grams of int ids) -----------------------
+
+def _seq_enum_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out",
+               tuple(x.shape[:2]) + (op.attrs.get("win_size", 2),), x.dtype)
+
+
+def _seq_enum_compute(ins, attrs, ctx, op_index):
+    x, length = ins["X"][0], ins["Length"][0]
+    if x.ndim == 3 and x.shape[-1] == 1:          # [B,T,1] id layout
+        x = x[..., 0]
+    win = attrs.get("win_size", 2)
+    pad = attrs.get("pad_value", 0)
+    t = x.shape[1]
+    outs = []
+    for j in range(win):
+        rolled = jnp.roll(x, -j, axis=1)
+        valid = (jnp.arange(t)[None, :] + j) < length[:, None]
+        outs.append(jnp.where(valid, rolled, pad))
+    return {"Out": jnp.stack(outs, axis=-1)}
+
+
+register_op("sequence_enumerate", ["X", "Length"], ["Out"],
+            infer=_seq_enum_infer, compute=_seq_enum_compute, grad=None)
+
+
+# -- sequence_slice / sequence_reshape: geometric utilities -----------------
+
+def _seq_slice_compute(ins, attrs, ctx, op_index):
+    x, length = ins["X"][0], ins["Length"][0]
+    offset, size = ins["Offset"][0], ins["Size"][0]
+    t = x.shape[1]
+    off = offset.reshape(-1).astype(jnp.int32)
+    sz = size.reshape(-1).astype(jnp.int32)
+    ar = jnp.arange(t)[None, :]
+    idx = (off[:, None] + ar)
+    valid = ar < sz[:, None]
+    idx = jnp.clip(idx, 0, t - 1)
+    gathered = jnp.take_along_axis(
+        x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+        axis=1)
+    mask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(mask, gathered, 0), "OutLength": sz}
+
+
+register_op(
+    "sequence_slice", ["X", "Offset", "Size", "Length"],
+    ["Out", "OutLength"],
+    infer=lambda op, block: (
+        set_output(op, block, "Out", in_var(op, block, "X").shape,
+                   in_var(op, block, "X").dtype),
+        set_output(op, block, "OutLength",
+                   (in_var(op, block, "X").shape[0],), "int32"),
+    ),
+    compute=_seq_slice_compute,
+    no_grad_inputs=("Offset", "Size", "Length"),
+)
+
+
+# -- causal_mask (decoder self-attention bias; transformer support) ---------
+
+def _causal_mask_infer(op, block):
+    t = op.attrs.get("seq_len", -1)
+    if op.inputs.get("Ref"):
+        ref = in_var(op, block, "Ref")
+        t = ref.shape[1]
+    set_output(op, block, "Out", (t, t), op.attrs.get("dtype", "float32"))
+
+
+def _causal_mask_compute(ins, attrs, ctx, op_index):
+    ref = ins.get("Ref", [None])[0]
+    t = ref.shape[1] if ref is not None else attrs["seq_len"]
+    neg = attrs.get("mask_value", -1e9)
+    m = jnp.triu(jnp.full((t, t), neg, attrs.get("dtype", "float32")), k=1)
+    return {"Out": m}
+
+
+register_op("causal_mask", ["Ref"], ["Out"], infer=_causal_mask_infer,
+            compute=_causal_mask_compute, grad=None)
+
+
+# -- padding_attn_bias ([B] lengths + Ref[B,T,...] -> [B,1,1,T] bias) -------
+
+def _pad_bias_infer(op, block):
+    ref = in_var(op, block, "Ref")
+    set_output(op, block, "Out", (ref.shape[0], 1, 1, ref.shape[1]),
+               op.attrs.get("dtype", "float32"))
+
+
+def _pad_bias_compute(ins, attrs, ctx, op_index):
+    length, ref = ins["Length"][0], ins["Ref"][0]
+    t = ref.shape[1]
+    neg = attrs.get("mask_value", -1e9)
+    valid = jnp.arange(t)[None, :] < length[:, None]
+    bias = jnp.where(valid, 0.0, neg).astype(attrs.get("dtype", "float32"))
+    return {"Out": bias[:, None, None, :]}
+
+
+register_op("padding_attn_bias", ["Length", "Ref"], ["Out"],
+            infer=_pad_bias_infer, compute=_pad_bias_compute, grad=None)
+
+
+# -- add_position_encoding (X[B,T,D] + Table[:T]; transformer support) ------
+
+def _add_pos_enc_compute(ins, attrs, ctx, op_index):
+    x, table = ins["X"][0], ins["Table"][0]
+    t = x.shape[1]
+    return {"Out": x + table[:t][None]}
+
+
+register_op(
+    "add_position_encoding", ["X", "Table"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=_add_pos_enc_compute, no_grad_inputs=("Table",),
+)
+
+
+# -- padding_mask ([B] lengths + Ref[B,T,...] -> [B,T] 0/1) -----------------
+
+def _padding_mask_infer(op, block):
+    ref = in_var(op, block, "Ref")
+    set_output(op, block, "Out", (ref.shape[0], ref.shape[1]),
+               op.attrs.get("dtype", "float32"))
+
+
+def _padding_mask_compute(ins, attrs, ctx, op_index):
+    length, ref = ins["Length"][0], ins["Ref"][0]
+    t = ref.shape[1]
+    valid = jnp.arange(t)[None, :] < length[:, None]
+    return {"Out": valid.astype(attrs.get("dtype", "float32"))}
+
+
+register_op("padding_mask", ["Length", "Ref"], ["Out"],
+            infer=_padding_mask_infer, compute=_padding_mask_compute,
+            grad=None)

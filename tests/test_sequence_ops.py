@@ -1,0 +1,400 @@
+"""Sequence/RNN op tests: numpy oracles over padded batches + lengths
+(reference test_sequence_pool.py, test_lstm_op.py, test_gru_op.py,
+test_sequence_conv.py, test_row_conv_op.py patterns translated to the
+padded representation)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+LENS = np.array([3, 5, 1, 4], dtype="int32")
+
+
+def _seq(d=6, t=5, b=4, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(b, t, d).astype("float32")
+    for i, ln in enumerate(LENS):
+        x[i, ln:] = 0
+    return x
+
+
+class TestSequencePool(OpTest):
+    op_type = "sequence_pool"
+
+    def _run(self, ptype, oracle):
+        x = _seq()
+        self.inputs = {"X": x, "Length": [("len", LENS)]}
+        self.attrs = {"pooltype": ptype}
+        out = np.stack([oracle(x[i, :LENS[i]]) for i in range(len(LENS))])
+        self.outputs = {"Out": out.astype("float32")}
+        self.check_output()
+
+    def test_average(self):
+        self._run("AVERAGE", lambda s: s.mean(0))
+
+    def test_sum(self):
+        self._run("SUM", lambda s: s.sum(0))
+
+    def test_sqrt(self):
+        self._run("SQRT", lambda s: s.sum(0) / np.sqrt(len(s)))
+
+    def test_max(self):
+        self._run("MAX", lambda s: s.max(0))
+
+    def test_last(self):
+        self._run("LAST", lambda s: s[-1])
+
+    def test_first(self):
+        self._run("FIRST", lambda s: s[0])
+
+    def test_grad_average(self):
+        x = _seq(d=3, t=4)
+        self.inputs = {"X": x, "Length": [("len", LENS)]}
+        self.attrs = {"pooltype": "AVERAGE"}
+        out = np.stack(
+            [x[i, :LENS[i]].mean(0) for i in range(len(LENS))])
+        self.outputs = {"Out": out.astype("float32")}
+        self.check_grad(["sequence_pool__X"], "sequence_pool__Out",
+                        no_grad_set={"len"}, max_relative_error=0.02)
+
+
+class TestSequenceSoftmax(OpTest):
+    op_type = "sequence_softmax"
+
+    def test_output(self):
+        x = _seq(d=1).squeeze(-1)  # [B, T]
+        self.inputs = {"X": x, "Length": [("len", LENS)]}
+        out = np.zeros_like(x)
+        for i, ln in enumerate(LENS):
+            e = np.exp(x[i, :ln] - x[i, :ln].max())
+            out[i, :ln] = e / e.sum()
+        self.outputs = {"Out": out}
+        self.check_output()
+
+
+class TestSequenceExpand(OpTest):
+    op_type = "sequence_expand"
+
+    def test_output(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(4, 6).astype("float32")
+        y = _seq()
+        self.inputs = {"X": x, "Y": y, "Length": [("len", LENS)]}
+        out = np.zeros((4, 5, 6), "float32")
+        for i, ln in enumerate(LENS):
+            out[i, :ln] = x[i]
+        self.outputs = {"Out": out}
+        self.check_output()
+
+
+class TestSequenceReverse(OpTest):
+    op_type = "sequence_reverse"
+
+    def test_output(self):
+        x = _seq()
+        self.inputs = {"X": x, "Length": [("len", LENS)]}
+        out = x.copy()
+        for i, ln in enumerate(LENS):
+            out[i, :ln] = x[i, :ln][::-1]
+        self.outputs = {"Out": out}
+        self.check_output()
+
+
+class TestSequenceMask(OpTest):
+    op_type = "sequence_mask"
+
+    def test_output(self):
+        self.inputs = {"X": LENS}
+        self.attrs = {"maxlen": 6, "out_dtype": "float32"}
+        out = (np.arange(6)[None, :] < LENS[:, None]).astype("float32")
+        self.outputs = {"Y": out}
+        self.check_output()
+
+
+class TestSequenceConcat(OpTest):
+    op_type = "sequence_concat"
+
+    def test_output(self):
+        rng = np.random.RandomState(2)
+        lens_a = np.array([3, 4, 1, 4], "int32")
+        lens_b = np.array([2, 1, 4, 3], "int32")
+        a = rng.rand(4, 4, 2).astype("float32")
+        b = rng.rand(4, 5, 2).astype("float32")
+        for i in range(4):
+            a[i, lens_a[i]:] = 0
+            b[i, lens_b[i]:] = 0
+        self.inputs = {"X": [("a", a), ("b", b)],
+                       "Length": [("la", lens_a), ("lb", lens_b)]}
+        total = lens_a + lens_b
+        t = 9
+        out = np.zeros((4, t, 2), "float32")
+        for i in range(4):
+            out[i, :lens_a[i]] = a[i, :lens_a[i]]
+            out[i, lens_a[i]:total[i]] = b[i, :lens_b[i]]
+        self.outputs = {"Out": out, "OutLength": total.astype("int32")}
+        self.check_output()
+
+
+class TestSequenceErase(OpTest):
+    op_type = "sequence_erase"
+
+    def test_output(self):
+        x = np.array([[1, 2, 3, 2, 1],
+                      [2, 2, 2, 2, 2],
+                      [5, 0, 0, 0, 0],
+                      [1, 5, 2, 5, 0]], dtype="int64")
+        lens = np.array([5, 5, 1, 4], "int32")
+        self.inputs = {"X": x, "Length": [("len", lens)]}
+        self.attrs = {"tokens": [2]}
+        out = np.zeros_like(x)
+        out_len = []
+        for i, ln in enumerate(lens):
+            kept = [v for v in x[i, :ln] if v != 2]
+            out[i, :len(kept)] = kept
+            out_len.append(len(kept))
+        self.outputs = {"Out": out,
+                        "OutLength": np.array(out_len, "int32")}
+        self.check_output()
+
+
+class TestSequenceConv(OpTest):
+    op_type = "sequence_conv"
+
+    def test_output(self):
+        d, ctx, nf = 3, 3, 4
+        x = _seq(d=d, t=5, seed=4)
+        rng = np.random.RandomState(5)
+        w = rng.rand(ctx * d, nf).astype("float32") - 0.5
+        self.inputs = {"X": x, "Filter": w, "Length": [("len", LENS)]}
+        self.attrs = {"contextLength": ctx, "contextStart": -1}
+        out = np.zeros((4, 5, nf), "float32")
+        for i, ln in enumerate(LENS):
+            for t in range(ln):
+                row = []
+                for j in range(ctx):
+                    p = t - 1 + j
+                    row.append(x[i, p] if 0 <= p < ln else np.zeros(d))
+                out[i, t] = np.concatenate(row) @ w
+        self.outputs = {"Out": out}
+        self.check_output(atol=1e-5)
+
+
+class TestRowConv(OpTest):
+    op_type = "row_conv"
+
+    def test_output(self):
+        d, k = 3, 2
+        x = _seq(d=d, t=5, seed=6)
+        rng = np.random.RandomState(7)
+        w = rng.rand(k, d).astype("float32") - 0.5
+        self.inputs = {"X": x, "Filter": w, "Length": [("len", LENS)]}
+        out = np.zeros_like(x)
+        for i, ln in enumerate(LENS):
+            for t in range(ln):
+                for j in range(k):
+                    if t + j < ln:
+                        out[i, t] += x[i, t + j] * w[j]
+        self.outputs = {"Out": out}
+        self.check_output(atol=1e-5)
+
+
+def _np_lstm(x, w, b, lens, peep=True):
+    """Oracle for the lstm op: gate order (c, i, f, o) per lstm_op.cc."""
+    bt, t, h4 = x.shape
+    h = h4 // 4
+    gb = b[0, :4 * h]
+    if peep:
+        w_ic, w_fc, w_oc = (b[0, 4 * h:5 * h], b[0, 5 * h:6 * h],
+                            b[0, 6 * h:7 * h])
+    hs = np.zeros((bt, t, h), "float64")
+    cs = np.zeros((bt, t, h), "float64")
+    for bi in range(bt):
+        hp = np.zeros(h)
+        cp = np.zeros(h)
+        for ti in range(lens[bi]):
+            g = x[bi, ti] + hp @ w + gb
+            gc, gi, gf, go = np.split(g, 4)
+            sig = lambda v: 1 / (1 + np.exp(-v))
+            if peep:
+                i = sig(gi + cp * w_ic)
+                f = sig(gf + cp * w_fc)
+            else:
+                i, f = sig(gi), sig(gf)
+            c = f * cp + i * np.tanh(gc)
+            o = sig(go + c * w_oc) if peep else sig(go)
+            hh = o * np.tanh(c)
+            hs[bi, ti] = hh
+            cs[bi, ti] = c
+            hp, cp = hh, c
+    return hs.astype("float32"), cs.astype("float32")
+
+
+class TestLSTM(OpTest):
+    op_type = "lstm"
+
+    def _setup(self, peep):
+        h = 4
+        rng = np.random.RandomState(8)
+        x = _seq(d=4 * h, t=5, seed=8)
+        w = (rng.rand(h, 4 * h).astype("float32") - 0.5) * 0.5
+        b = (rng.rand(1, 7 * h if peep else 4 * h).astype("float32")
+             - 0.5) * 0.5
+        hs, cs = _np_lstm(x.astype("float64"), w.astype("float64"),
+                          b.astype("float64"), LENS, peep)
+        self.inputs = {"Input": x, "Weight": w, "Bias": b,
+                       "Length": [("len", LENS)]}
+        self.attrs = {"use_peepholes": peep}
+        self.outputs = {"Hidden": hs, "Cell": cs}
+
+    def test_peephole(self):
+        self._setup(True)
+        self.check_output(atol=1e-4)
+
+    def test_no_peephole(self):
+        self._setup(False)
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self._setup(False)
+        self.check_grad(["lstm__Input", "lstm__Weight", "lstm__Bias"],
+                        "lstm__Hidden", no_grad_set={"len"},
+                        max_relative_error=0.03, delta=1e-2)
+
+
+def _np_gru(x, w, lens):
+    bt, t, h3 = x.shape
+    h = h3 // 3
+    hs = np.zeros((bt, t, h), "float64")
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    for bi in range(bt):
+        hp = np.zeros(h)
+        for ti in range(lens[bi]):
+            xt = x[bi, ti]
+            g = sig(xt[:2 * h] + hp @ w[:, :2 * h])
+            u, r = g[:h], g[h:]
+            c = np.tanh(xt[2 * h:] + (r * hp) @ w[:, 2 * h:])
+            hp = u * hp + (1 - u) * c
+            hs[bi, ti] = hp
+    return hs.astype("float32")
+
+
+class TestGRU(OpTest):
+    op_type = "gru"
+
+    def test_output(self):
+        h = 4
+        rng = np.random.RandomState(9)
+        x = _seq(d=3 * h, t=5, seed=9)
+        w = (rng.rand(h, 3 * h).astype("float32") - 0.5) * 0.5
+        hs = _np_gru(x.astype("float64"), w.astype("float64"), LENS)
+        self.inputs = {"Input": x, "Weight": w, "Length": [("len", LENS)]}
+        self.outputs = {"Hidden": hs}
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        h = 3
+        rng = np.random.RandomState(10)
+        x = _seq(d=3 * h, t=5, seed=10)
+        w = (rng.rand(h, 3 * h).astype("float32") - 0.5) * 0.5
+        hs = _np_gru(x.astype("float64"), w.astype("float64"), LENS)
+        self.inputs = {"Input": x, "Weight": w, "Length": [("len", LENS)]}
+        self.outputs = {"Hidden": hs}
+        self.check_grad(["gru__Input", "gru__Weight"], "gru__Hidden",
+                        no_grad_set={"len"}, max_relative_error=0.03,
+                        delta=1e-2)
+
+
+class TestSequenceLayersEndToEnd:
+    """Layer-level: LSTM text classifier trains on padded sequences fed
+    through DataFeeder (the stacked_dynamic_lstm benchmark slice)."""
+
+    def test_lstm_classifier_trains(self):
+        dict_size, emb_dim, hid = 50, 16, 16
+        word = fluid.layers.data("word", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(word, size=[dict_size, emb_dim])
+        proj = fluid.layers.fc(emb, size=hid * 4, num_flatten_dims=2)
+        h, c = fluid.layers.dynamic_lstm(proj, size=hid * 4)
+        pooled = fluid.layers.sequence_pool(h, "max")
+        pred = fluid.layers.fc(pooled, size=2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+        feeder = fluid.DataFeeder(feed_list=[word, label], pad_to=8)
+        rng = np.random.RandomState(0)
+
+        def batch():
+            rows = []
+            for _ in range(8):
+                ln = rng.randint(1, 9)
+                seq = rng.randint(0, dict_size, (ln,)).astype("int64")
+                y = np.int64(seq.max() > dict_size // 2)
+                rows.append((seq, [y]))
+            return feeder.feed(rows)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for _ in range(30):
+            (lv,) = exe.run(feed=batch(), fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+        assert all(np.isfinite(losses))
+        assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+    def test_gru_pool_expand_pipeline(self):
+        word = fluid.layers.data("w", shape=[4], dtype="float32", lod_level=1)
+        proj = fluid.layers.fc(word, size=6 * 3, num_flatten_dims=2)
+        h = fluid.layers.dynamic_gru(proj, size=6)
+        pooled = fluid.layers.sequence_pool(h, "average")
+        back = fluid.layers.sequence_expand(pooled, h)
+        assert back.shape[1] == h.shape[1]
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        feeder = fluid.DataFeeder(feed_list=[word], pad_to=5)
+        rows = [(np.random.rand(3, 4).astype("float32"),),
+                (np.random.rand(5, 4).astype("float32"),)]
+        (out,) = exe.run(feed=feeder.feed(rows), fetch_list=[back])
+        assert out.shape == (2, 5, 6)
+        assert np.all(out[0, 3:] == 0)  # masked tail
+
+
+class TestLSTMReverse:
+    def test_reverse_differs_and_matches_flipped(self):
+        """is_reverse=True on full-length sequences == flip(forward(flip(x)))."""
+        import paddle_tpu as fluid
+        h, b, t = 3, 2, 4
+        rng = np.random.RandomState(12)
+        x = rng.rand(b, t, 4 * h).astype("float32")
+        w = (rng.rand(h, 4 * h).astype("float32") - 0.5) * 0.5
+        bias = (rng.rand(1, 4 * h).astype("float32") - 0.5) * 0.5
+        lens = np.full((b,), t, "int32")
+
+        def run(xv, reverse):
+            prog, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(prog, startup):
+                blk = prog.global_block()
+                for name, arr in [("x", xv), ("w", w), ("b", bias),
+                                  ("len", lens)]:
+                    blk.create_var(name=name, shape=arr.shape,
+                                   dtype=arr.dtype, is_data=True,
+                                   stop_gradient=True)
+                blk.append_op(
+                    type="lstm",
+                    inputs={"Input": ["x"], "Weight": ["w"], "Bias": ["b"],
+                            "Length": ["len"]},
+                    outputs={"Hidden": ["hid"], "Cell": ["cell"]},
+                    attrs={"use_peepholes": False, "is_reverse": reverse})
+            exe = fluid.Executor(fluid.CPUPlace())
+            (out,) = exe.run(prog, feed={"x": xv, "w": w, "b": bias,
+                                         "len": lens}, fetch_list=["hid"])
+            return np.asarray(out)
+
+        fwd = run(x, False)
+        rev = run(x, True)
+        assert not np.allclose(fwd, rev)
+        flipped = run(x[:, ::-1].copy(), False)[:, ::-1]
+        np.testing.assert_allclose(rev, flipped, rtol=1e-5, atol=1e-6)

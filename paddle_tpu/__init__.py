@@ -35,6 +35,9 @@ from .data_feeder import DataFeeder
 from . import io
 from . import profiler
 from . import parallel
+from . import reader
+from . import dataset
+from .reader import batch
 from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
 from .parallel.mesh import make_mesh
 
@@ -48,5 +51,6 @@ __all__ = [
     "Executor", "CPUPlace", "TPUPlace", "CUDAPlace", "Scope",
     "global_scope", "scope_guard", "ParamAttr", "WeightNormParamAttr",
     "DataFeeder", "io", "profiler", "parallel", "ParallelExecutor",
-    "BuildStrategy", "ExecutionStrategy", "make_mesh",
+    "BuildStrategy", "ExecutionStrategy", "make_mesh", "reader",
+    "dataset", "batch",
 ]

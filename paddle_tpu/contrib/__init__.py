@@ -1,0 +1,8 @@
+"""contrib package (reference ``python/paddle/fluid/contrib/``: the
+high-level Trainer/Inferencer moved here at release 0.15)."""
+
+from .trainer import (  # noqa: F401
+    Trainer, CheckpointConfig,
+    BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent,
+)
+from .inferencer import Inferencer  # noqa: F401

@@ -1,0 +1,247 @@
+"""High-level Trainer (reference ``python/paddle/fluid/contrib/trainer.py``:
+Trainer:169 — build programs from train_func, optimizer_func; event-driven
+train loop; CheckpointConfig:100 periodic save + auto-resume; cluster role
+wiring via PADDLE_TRAINING_ROLE env).
+
+TPU redesign notes: the executor is the whole-program jit Executor (or the
+mesh ParallelExecutor with ``parallel=True``); the pserver training role
+is subsumed by mesh sharding, so PADDLE_TRAINING_ROLE=PSERVER raises with
+guidance instead of transpiling (SURVEY.md §2.4)."""
+
+import os
+
+import numpy as np
+
+from .. import io as fluid_io
+from ..data_feeder import DataFeeder
+from ..executor import CPUPlace, Executor, TPUPlace
+from ..framework import Program, default_main_program, \
+    default_startup_program, program_guard
+from ..optimizer import Optimizer
+from ..parallel import ParallelExecutor
+from ..scope import Scope, scope_guard
+
+__all__ = [
+    "Trainer", "CheckpointConfig",
+    "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent", "EndStepEvent",
+]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """reference contrib/trainer.py:100"""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), "checkpoints")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(int(epoch_interval), 1)
+        self.step_interval = max(int(step_interval), 1)
+        self.epoch_id = 0
+        self.step_id = 0
+        self.load_serial = None
+
+
+class Trainer:
+    """reference contrib/trainer.py:169.
+
+    ``train_func`` builds the model and returns the loss Variable (or a
+    list whose first element is the loss); ``optimizer_func`` returns an
+    Optimizer.
+    """
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None,
+                 mesh=None):
+        self.__stop = False
+        self.parallel = parallel
+        self.place = self._check_place(place)
+        self._mesh = mesh
+
+        if checkpoint_config is not None and not isinstance(
+                checkpoint_config, CheckpointConfig):
+            raise TypeError(
+                "checkpoint_config must be a CheckpointConfig instance")
+        self.checkpoint_cfg = checkpoint_config
+
+        self.scope = Scope()
+        self.startup_program = Program()
+        self.train_program = Program()
+
+        with program_guard(self.train_program, self.startup_program):
+            program_func_outs = train_func()
+            self.train_func_outputs = (
+                program_func_outs if isinstance(program_func_outs, list)
+                else [program_func_outs])
+            # test program: forward only, before optimizer ops
+            self.test_program = self.train_program.clone(for_test=True)
+            if not isinstance(optimizer_func, type(lambda: None)) and \
+                    not callable(optimizer_func):
+                raise TypeError("optimizer_func must be callable")
+            optimizer = optimizer_func()
+            if not isinstance(optimizer, Optimizer):
+                raise TypeError(
+                    "optimizer_func must return a paddle_tpu Optimizer")
+            loss = self.train_func_outputs[0]
+            optimizer.minimize(loss)
+        self._loss_name = loss.name
+
+        self._dist_transpile_if_necessary()
+
+        with scope_guard(self.scope):
+            exe = Executor(self.place)
+            exe.run(self.startup_program)
+
+        if param_path is not None:
+            with scope_guard(self.scope):
+                fluid_io.load_persistables(
+                    Executor(self.place), param_path,
+                    main_program=self.startup_program)
+
+        if self.checkpoint_cfg is not None:
+            with scope_guard(self.scope):
+                serial = fluid_io.get_latest_checkpoint_serial(
+                    self.checkpoint_cfg.checkpoint_dir)
+                if serial >= 0:
+                    self.checkpoint_cfg.load_serial = serial
+                    fluid_io.load_checkpoint(
+                        Executor(self.place),
+                        self.checkpoint_cfg.checkpoint_dir)
+
+    # ------------------------------------------------------------------
+    def _check_place(self, place):
+        if place is not None:
+            return place
+        import jax
+        has_tpu = any(d.platform != "cpu" for d in jax.devices())
+        return TPUPlace(0) if has_tpu else CPUPlace()
+
+    def _dist_transpile_if_necessary(self):
+        role = os.getenv("PADDLE_TRAINING_ROLE")
+        if role is None or role == "TRAINER":
+            return
+        if role == "PSERVER":
+            raise RuntimeError(
+                "parameter-server roles do not exist on the TPU runtime: "
+                "parameters live sharded on the mesh (use parallel=True "
+                "with a Mesh spanning your hosts via jax.distributed)")
+        raise ValueError("unknown PADDLE_TRAINING_ROLE %r" % role)
+
+    def stop(self):
+        self.__stop = True
+
+    # ------------------------------------------------------------------
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        with scope_guard(self.scope):
+            if self.parallel:
+                executor = ParallelExecutor(
+                    loss_name=self._loss_name,
+                    main_program=self.train_program, mesh=self._mesh)
+                run = lambda feed, fetch: executor.run(
+                    feed=feed, fetch_list=fetch)
+            else:
+                executor = Executor(self.place)
+                run = lambda feed, fetch: executor.run(
+                    self.train_program, feed=feed, fetch_list=fetch)
+            feeder = self._feeder(feed_order)
+            ckpt_exe = Executor(self.place)
+            for epoch_id in range(num_epochs):
+                if self.__stop:
+                    break
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self.__stop:
+                        break
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    fetch = [v.name for v in self.train_func_outputs] \
+                        if begin.fetch_metrics else []
+                    metrics = run(feeder.feed(data), fetch)
+                    metrics = [np.asarray(m) for m in metrics]
+                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                    self._maybe_save_checkpoint(ckpt_exe, epoch_id, step_id)
+                event_handler(EndEpochEvent(epoch_id))
+
+    def test(self, reader, feed_order=None):
+        """Average the train_func outputs over the test reader."""
+        with scope_guard(self.scope):
+            executor = Executor(self.place)
+            feeder = self._feeder(feed_order, program=self.test_program)
+            accumulated = None
+            count = 0
+            for data in reader():
+                outs = executor.run(
+                    self.test_program, feed=feeder.feed(data),
+                    fetch_list=[v.name for v in self.train_func_outputs])
+                outs = [float(np.asarray(o).mean()) for o in outs]
+                accumulated = outs if accumulated is None else [
+                    a + o for a, o in zip(accumulated, outs)]
+                count += 1
+            if count == 0:
+                return accumulated
+            return [a / count for a in accumulated]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(
+                Executor(self.place), param_path,
+                main_program=self.train_program)
+
+    def save_inference_model(self, param_path, feeded_var_names,
+                             target_var_indexes):
+        with scope_guard(self.scope):
+            fluid_io.save_inference_model(
+                param_path, feeded_var_names,
+                [self.train_func_outputs[i] for i in target_var_indexes],
+                Executor(self.place), main_program=self.train_program)
+
+    # ------------------------------------------------------------------
+    def _feeder(self, feed_order, program=None):
+        program = program or self.train_program
+        if feed_order is None:
+            feed_order = [
+                v.name for v in program.global_block().vars.values()
+                if getattr(v, "is_data", False)
+                and not v.name.endswith("@LEN")
+            ]
+        feed_list = [
+            program.global_block().var(name) for name in feed_order
+        ]
+        return DataFeeder(feed_list=feed_list, place=self.place,
+                          program=program)
+
+    def _maybe_save_checkpoint(self, exe, epoch_id, step_id):
+        cfg = self.checkpoint_cfg
+        if cfg is None:
+            return
+        if epoch_id % cfg.epoch_interval == 0 and \
+                step_id % cfg.step_interval == 0:
+            serial = (cfg.load_serial or 0) + epoch_id + 1
+            fluid_io.save_checkpoint(
+                exe, cfg.checkpoint_dir, serial=serial,
+                main_program=self.train_program,
+                max_num_checkpoints=cfg.max_num_checkpoints)

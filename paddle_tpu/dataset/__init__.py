@@ -1,0 +1,10 @@
+"""Dataset package (reference ``python/paddle/dataset/``: mnist, cifar,
+imdb, uci_housing, imikolov, movielens, wmt14/16, flowers... with
+download+cache).  Loaders parse the standard archives from the cache dir
+(common.DATA_HOME); ``synthetic`` provides offline generators."""
+
+from . import common, mnist, cifar, imdb, uci_housing, imikolov  # noqa: F401
+from . import synthetic  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "imdb", "uci_housing", "imikolov",
+           "synthetic"]

@@ -1,0 +1,50 @@
+"""Dataset download/cache machinery (reference
+``python/paddle/dataset/common.py``: DATA_HOME, download with md5 check,
+cached unpacking).  In egress-restricted environments place files in
+``$PADDLE_TPU_DATA_HOME`` (default ``~/.cache/paddle_tpu/dataset``)
+manually; ``download`` verifies and reuses them."""
+
+import hashlib
+import os
+import shutil
+
+__all__ = ["DATA_HOME", "download", "md5file"]
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_HOME",
+    os.path.expanduser("~/.cache/paddle_tpu/dataset"))
+
+
+def must_mkdirs(path):
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(4096), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    dirname = must_mkdirs(os.path.join(DATA_HOME, module_name))
+    filename = os.path.join(
+        dirname, save_name if save_name else url.split("/")[-1])
+    if os.path.exists(filename) and (md5sum is None or
+                                     md5file(filename) == md5sum):
+        return filename
+    try:
+        import urllib.request
+        tmp = filename + ".part"
+        urllib.request.urlretrieve(url, tmp)
+        if md5sum is not None and md5file(tmp) != md5sum:
+            os.remove(tmp)
+            raise IOError("md5 mismatch downloading %s" % url)
+        shutil.move(tmp, filename)
+        return filename
+    except Exception as e:
+        raise IOError(
+            "cannot download %s (%s). In offline environments place the "
+            "file at %s manually." % (url, e, filename))

@@ -1,0 +1,68 @@
+"""IMDB sentiment reader creators (reference
+``python/paddle/dataset/imdb.py``: aclImdb tar parsing, word-freq dict,
+(ids, 0/1) samples)."""
+
+import re
+import string
+import tarfile
+
+from . import common
+
+__all__ = ["train", "test", "word_dict"]
+
+URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+
+def tokenize(pattern):
+    path = common.download(URL, "imdb", MD5)
+    with tarfile.open(path) as tarf:
+        tf = tarf.next()
+        while tf is not None:
+            if bool(pattern.match(tf.name)):
+                data = tarf.extractfile(tf).read().decode("latin-1")
+                yield data.lower().translate(
+                    str.maketrans("", "", string.punctuation)).split()
+            tf = tarf.next()
+
+
+def build_dict(pattern, cutoff):
+    word_freq = {}
+    for doc in tokenize(pattern):
+        for word in doc:
+            word_freq[word] = word_freq.get(word, 0) + 1
+    word_freq = [x for x in word_freq.items() if x[1] > cutoff]
+    dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+    words, _ = list(zip(*dictionary))
+    word_idx = dict(list(zip(words, range(len(words)))))
+    word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def reader_creator(pos_pattern, neg_pattern, word_idx):
+    unk = word_idx["<unk>"]
+
+    def reader():
+        for doc in tokenize(pos_pattern):
+            yield [word_idx.get(w, unk) for w in doc], 0
+        for doc in tokenize(neg_pattern):
+            yield [word_idx.get(w, unk) for w in doc], 1
+    return reader
+
+
+def train(word_idx):
+    return reader_creator(
+        re.compile(r"aclImdb/train/pos/.*\.txt$"),
+        re.compile(r"aclImdb/train/neg/.*\.txt$"), word_idx)
+
+
+def test(word_idx):
+    return reader_creator(
+        re.compile(r"aclImdb/test/pos/.*\.txt$"),
+        re.compile(r"aclImdb/test/neg/.*\.txt$"), word_idx)
+
+
+def word_dict(cutoff=150):
+    return build_dict(
+        re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$"),
+        cutoff)

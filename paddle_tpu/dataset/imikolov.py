@@ -1,0 +1,81 @@
+"""PTB language-model reader creators (reference
+``python/paddle/dataset/imikolov.py``: n-gram and seq modes over the
+tarball's train/valid splits)."""
+
+import collections
+import tarfile
+
+from . import common
+
+__all__ = ["train", "test", "build_dict"]
+
+URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+MD5 = "30177ea32e27c525793142b6bf2c8e2d"
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def word_count(f, word_freq=None):
+    if word_freq is None:
+        word_freq = collections.defaultdict(int)
+    for line in f:
+        words = line.decode().strip().split()
+        for w in words:
+            word_freq[w] += 1
+        word_freq["<s>"] += 1
+        word_freq["<e>"] += 1
+    return word_freq
+
+
+def build_dict(min_word_freq=50):
+    path = common.download(URL, "imikolov", MD5)
+    with tarfile.open(path) as tf:
+        train_f = tf.extractfile("./simple-examples/data/ptb.train.txt")
+        word_freq = word_count(train_f)
+        word_freq.pop("<unk>", None)
+        word_freq = [x for x in word_freq.items() if x[1] > min_word_freq]
+        dictionary = sorted(word_freq, key=lambda x: (-x[1], x[0]))
+        words, _ = list(zip(*dictionary))
+        word_idx = dict(list(zip(words, range(len(words)))))
+        word_idx["<unk>"] = len(words)
+    return word_idx
+
+
+def reader_creator(filename, word_idx, n, data_type):
+    def reader():
+        path = common.download(URL, "imikolov", MD5)
+        with tarfile.open(path) as tf:
+            f = tf.extractfile(filename)
+            unk = word_idx["<unk>"]
+            for line in f:
+                if DataType.NGRAM == data_type:
+                    assert n > -1, "n must be set for ngram mode"
+                    line = ["<s>"] + line.decode().strip().split() + ["<e>"]
+                    if len(line) >= n:
+                        line = [word_idx.get(w, unk) for w in line]
+                        for i in range(n, len(line) + 1):
+                            yield tuple(line[i - n:i])
+                elif DataType.SEQ == data_type:
+                    line = line.decode().strip().split()
+                    ids = [word_idx.get(w, unk) for w in line]
+                    src_seq = [word_idx["<s>"]] + ids
+                    trg_seq = ids + [word_idx["<e>"]]
+                    if n > 0 and len(ids) > n:
+                        continue
+                    yield src_seq, trg_seq
+                else:
+                    raise ValueError("unknown data type")
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator("./simple-examples/data/ptb.train.txt", word_idx,
+                          n, data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return reader_creator("./simple-examples/data/ptb.valid.txt", word_idx,
+                          n, data_type)

@@ -1,0 +1,57 @@
+"""MNIST reader creators (reference ``python/paddle/dataset/mnist.py``:
+idx-format parsing, train/test creators yielding (image[784] in [-1,1],
+label))."""
+
+import gzip
+import struct
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test"]
+
+URL_PREFIX = "http://yann.lecun.com/exdb/mnist/"
+TRAIN_IMAGE_MD5 = "f68b3c2dcbeaaa9fbdd348bbdeb94873"
+TRAIN_LABEL_MD5 = "d53e105ee54ea40749a09fcbcd1e9432"
+TEST_IMAGE_MD5 = "9fb629c4189551a2d022fa330f9573f3"
+TEST_LABEL_MD5 = "ec29112dd5afa0611ce80d1b7f02629c"
+
+
+def reader_creator(image_filename, label_filename, buffer_size=100):
+    def reader():
+        with gzip.open(image_filename, "rb") as imgf, \
+                gzip.open(label_filename, "rb") as lblf:
+            magic, n, rows, cols = struct.unpack(">IIII", imgf.read(16))
+            magic_l, n_l = struct.unpack(">II", lblf.read(8))
+            assert n == n_l
+            per = rows * cols
+            for _ in range(0, n, buffer_size):
+                count = min(buffer_size, n)
+                imgs = np.frombuffer(
+                    imgf.read(count * per), dtype="uint8"
+                ).reshape(-1, per)
+                if imgs.shape[0] == 0:
+                    break
+                labels = np.frombuffer(lblf.read(imgs.shape[0]),
+                                       dtype="uint8")
+                imgs = imgs.astype("float32") / 255.0 * 2.0 - 1.0
+                for im, lb in zip(imgs, labels):
+                    yield im, int(lb)
+    return reader
+
+
+def train():
+    return reader_creator(
+        common.download(URL_PREFIX + "train-images-idx3-ubyte.gz", "mnist",
+                        TRAIN_IMAGE_MD5),
+        common.download(URL_PREFIX + "train-labels-idx1-ubyte.gz", "mnist",
+                        TRAIN_LABEL_MD5))
+
+
+def test():
+    return reader_creator(
+        common.download(URL_PREFIX + "t10k-images-idx3-ubyte.gz", "mnist",
+                        TEST_IMAGE_MD5),
+        common.download(URL_PREFIX + "t10k-labels-idx1-ubyte.gz", "mnist",
+                        TEST_LABEL_MD5))

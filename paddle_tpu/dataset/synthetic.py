@@ -1,0 +1,41 @@
+"""Synthetic dataset creators for offline benchmarking/testing (no
+reference analog; the reference benchmark's --use_fake_data flag covers
+the same need, benchmark/fluid/args.py)."""
+
+import numpy as np
+
+__all__ = ["images", "sequences", "regression"]
+
+
+def images(n=1024, shape=(3, 32, 32), classes=10, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        proj = rng.rand(int(np.prod(shape)))
+        for _ in range(n):
+            x = rng.rand(*shape).astype("float32")
+            y = int(x.reshape(-1) @ proj * classes /
+                    proj.sum()) % classes
+            yield x, y
+    return reader
+
+
+def sequences(n=1024, vocab=100, max_len=20, classes=2, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            ln = rng.randint(1, max_len + 1)
+            seq = rng.randint(0, vocab, (ln,)).astype("int64")
+            y = int(seq.mean() > vocab / 2)
+            yield seq, y
+    return reader
+
+
+def regression(n=1024, dim=13, seed=0):
+    def reader():
+        rng = np.random.RandomState(seed)
+        w = rng.rand(dim)
+        for _ in range(n):
+            x = rng.rand(dim).astype("float32")
+            y = np.float32(x @ w + 0.1 * rng.randn())
+            yield x, np.array([y], "float32")
+    return reader

@@ -1,0 +1,53 @@
+"""UCI housing reader creators (reference
+``python/paddle/dataset/uci_housing.py``: whitespace table, feature
+normalization over the train split, 80/20 train/test split)."""
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_range"]
+
+URL = "http://paddlemodels.bj.bcebos.com/uci_housing/housing.data"
+MD5 = "d4accdce7a25600298819f8e28e8d593"
+FEATURE_NUM = 14
+TRAIN_RATIO = 0.8
+
+_cache = {}
+
+
+def _load():
+    if "data" in _cache:
+        return _cache["data"]
+    path = common.download(URL, "uci_housing", MD5)
+    data = np.loadtxt(path).reshape(-1, FEATURE_NUM)
+    maxs = data.max(axis=0)
+    mins = data.min(axis=0)
+    avgs = data.mean(axis=0)
+    split = int(data.shape[0] * TRAIN_RATIO)
+    for i in range(FEATURE_NUM - 1):
+        data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+    _cache["data"] = (data, split)
+    return _cache["data"]
+
+
+def feature_range(maximums, minimums):
+    pass  # plotting helper in the reference; intentionally a no-op
+
+
+def train():
+    def reader():
+        data, split = _load()
+        for row in data[:split]:
+            yield row[:-1].astype("float32"), \
+                np.array(row[-1:], "float32")
+    return reader
+
+
+def test():
+    def reader():
+        data, split = _load()
+        for row in data[split:]:
+            yield row[:-1].astype("float32"), \
+                np.array(row[-1:], "float32")
+    return reader

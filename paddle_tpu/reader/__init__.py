@@ -1,0 +1,89 @@
+"""Reader framework: decorators + device-prefetching PyReader.
+
+Parity: reference ``python/paddle/reader/`` + the py_reader op family
+(``operators/reader/create_py_reader_op.cc``,
+``create_double_buffer_reader_op.cc``, ``lod_tensor_blocking_queue.h``) —
+TPU-native: PyReader is a host thread that stages feed dicts onto the
+device ahead of the training loop (double buffering over the host link),
+not an in-graph op chain; under jit the executor consumes device-resident
+arrays with zero extra copies.
+"""
+
+import queue
+import threading
+
+from .decorator import *  # noqa: F401,F403
+from . import decorator  # noqa: F401
+
+__all__ = decorator.__all__ + ["PyReader", "batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (reference
+    python/paddle/v2/minibatch.py / paddle.batch)."""
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+class PyReader:
+    """Host->device prefetch pipeline.
+
+    ``decorate_batch_reader(reader, feeder, place)``: reader yields lists
+    of samples; feeder converts them to feed dicts; a daemon thread
+    device_puts up to ``capacity`` batches ahead.  Iterate to get
+    device-resident feed dicts for Executor.run.
+    """
+
+    def __init__(self, capacity=4):
+        self.capacity = capacity
+        self._reader = None
+        self._feeder = None
+        self._place = None
+
+    def decorate_batch_reader(self, reader, feeder, place=None):
+        self._reader = reader
+        self._feeder = feeder
+        self._place = place
+        return self
+
+    def decorate_paddle_reader(self, reader, feeder, place=None):
+        # reference alias
+        return self.decorate_batch_reader(reader, feeder, place)
+
+    def __iter__(self):
+        import jax
+
+        if self._reader is None:
+            raise RuntimeError("call decorate_batch_reader first")
+        dev = self._place.jax_device() if self._place is not None else None
+        q = queue.Queue(maxsize=self.capacity)
+        end = object()
+
+        def producer():
+            try:
+                for rows in self._reader():
+                    feed = self._feeder.feed(rows)
+                    if dev is not None:
+                        feed = {
+                            k: jax.device_put(v, dev)
+                            for k, v in feed.items()
+                        }
+                    q.put(feed)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item

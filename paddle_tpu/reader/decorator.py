@@ -1,0 +1,220 @@
+"""Reader decorators: composable python-generator transforms.
+
+Parity: reference ``python/paddle/reader/decorator.py`` (map_readers,
+shuffle:58, buffered, compose, chain, firstn, xmap_readers:243,
+multiprocess_reader:338, cache) — same contract: a *reader creator* is a
+zero-arg callable returning an iterator over samples.
+"""
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = [
+    "map_readers",
+    "buffered",
+    "compose",
+    "chain",
+    "shuffle",
+    "firstn",
+    "xmap_readers",
+    "multiprocess_reader",
+    "cache",
+]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    """func applied across the zip of readers' samples."""
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Pool-based shuffle (reference decorator.py:58)."""
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            for b in buf:
+                yield b
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers end to end."""
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into tuple samples; check_alignment validates equal
+    lengths (reference ComposeNotAligned)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        if isinstance(x, tuple):
+            return x
+        return (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum(list(map(make_tuple, outputs)), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum(list(map(make_tuple, outputs)), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch buffer (reference buffered)."""
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for d in r:
+                    q.put(d)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return data_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel-thread map over a reader (reference xmap_readers:243)."""
+    end = object()
+
+    def data_reader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+        out_order = [0]
+
+        def read_worker():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample) if order else sample)
+            in_q.put(end)
+
+        def map_worker():
+            sample = in_q.get()
+            while sample is not end:
+                if order:
+                    order_id, data = sample
+                    result = mapper(data)
+                    while order_id != out_order[0]:
+                        threading.Event().wait(0.001)
+                    out_q.put(result)
+                    out_order[0] += 1
+                else:
+                    out_q.put(mapper(sample))
+                sample = in_q.get()
+            in_q.put(end)  # relay for sibling workers
+            out_q.put(end)
+
+        t_read = threading.Thread(target=read_worker, daemon=True)
+        t_read.start()
+        workers = []
+        for _ in range(process_num):
+            t = threading.Thread(target=map_worker, daemon=True)
+            t.start()
+            workers.append(t)
+
+        finished = 0
+        while finished < process_num:
+            sample = out_q.get()
+            if sample is end:
+                finished += 1
+            else:
+                yield sample
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Fork one OS process per reader (reference multiprocess_reader:338).
+    Samples interleave in arrival order."""
+    import multiprocessing as mp
+    import pickle
+
+    def queue_reader():
+        q = mp.Queue(queue_size)
+
+        def worker(r):
+            try:
+                for sample in r():
+                    q.put(pickle.dumps(sample))
+            finally:
+                q.put(None)
+
+        procs = [mp.Process(target=worker, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is None:
+                finished += 1
+            else:
+                yield pickle.loads(item)
+        for p in procs:
+            p.join()
+    return queue_reader
+
+
+def cache(reader):
+    """Materialize once, replay from memory."""
+    all_data = []
+    state = {"cached": False}
+
+    def data_reader():
+        if not state["cached"]:
+            for item in reader():
+                all_data.append(item)
+                yield item
+            state["cached"] = True
+        else:
+            for item in all_data:
+                yield item
+    return data_reader

@@ -1,0 +1,94 @@
+"""Reader decorator + PyReader tests (reference
+python/paddle/reader/tests/decorator_test.py pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as rd
+from paddle_tpu.dataset import synthetic
+
+
+def _count_reader(n=10):
+    def reader():
+        yield from range(n)
+    return reader
+
+
+def test_batch():
+    b = rd.batch(_count_reader(10), 3)
+    batches = list(b())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    b = rd.batch(_count_reader(10), 3, drop_last=True)
+    assert len(list(b())) == 3
+
+
+def test_shuffle_preserves_multiset():
+    out = list(rd.shuffle(_count_reader(20), 5)())
+    assert sorted(out) == list(range(20))
+
+
+def test_chain_compose_firstn_map():
+    c = rd.chain(_count_reader(3), _count_reader(2))
+    assert list(c()) == [0, 1, 2, 0, 1]
+    comp = rd.compose(_count_reader(3), _count_reader(3))
+    assert list(comp()) == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(rd.decorator.ComposeNotAligned):
+        list(rd.compose(_count_reader(3), _count_reader(2))())
+    f = rd.firstn(_count_reader(100), 4)
+    assert list(f()) == [0, 1, 2, 3]
+    m = rd.map_readers(lambda a, b: a + b, _count_reader(3),
+                       _count_reader(3))
+    assert list(m()) == [0, 2, 4]
+
+
+def test_buffered_and_xmap():
+    out = list(rd.buffered(_count_reader(10), 2)())
+    assert out == list(range(10))
+    x = rd.xmap_readers(lambda v: v * 2, _count_reader(10), 3, 4)
+    assert sorted(x()) == [2 * i for i in range(10)]
+
+
+def test_cache():
+    calls = []
+
+    def creator():
+        def reader():
+            calls.append(1)
+            yield from range(5)
+        return reader
+
+    cached = rd.cache(creator())
+    assert list(cached()) == list(range(5))
+    assert list(cached()) == list(range(5))
+    assert len(calls) == 1
+
+
+def test_synthetic_datasets():
+    imgs = list(synthetic.images(n=5)())
+    assert imgs[0][0].shape == (3, 32, 32)
+    seqs = list(synthetic.sequences(n=5)())
+    assert seqs[0][0].ndim == 1
+    regs = list(synthetic.regression(n=5)())
+    assert regs[0][0].shape == (13,)
+
+
+def test_pyreader_trains_model():
+    x = fluid.layers.data("x", shape=[13])
+    y = fluid.layers.data("y", shape=[1])
+    pred = fluid.layers.fc(x, size=1)
+    loss = fluid.layers.mean(fluid.layers.smooth_l1(pred, y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    feeder = fluid.DataFeeder(feed_list=[x, y])
+    train_reader = rd.batch(synthetic.regression(n=64), 16)
+    py_reader = rd.PyReader(capacity=2).decorate_batch_reader(
+        train_reader, feeder, fluid.CPUPlace())
+    losses = []
+    for epoch in range(4):
+        for feed in py_reader:
+            (lv,) = exe.run(feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0]

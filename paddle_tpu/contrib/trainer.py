@@ -13,6 +13,7 @@ import os
 import numpy as np
 
 from .. import io as fluid_io
+from .. import unique_name
 from ..data_feeder import DataFeeder
 from ..executor import CPUPlace, Executor, TPUPlace
 from ..framework import Program, default_main_program, \
@@ -25,6 +26,15 @@ __all__ = [
     "Trainer", "CheckpointConfig",
     "BeginEpochEvent", "EndEpochEvent", "BeginStepEvent", "EndStepEvent",
 ]
+
+
+def _default_place(place=None):
+    """Pick TPU if one is attached, else CPU (shared by Trainer/Inferencer)."""
+    if place is not None:
+        return place
+    import jax
+    has_tpu = any(d.platform != "cpu" for d in jax.devices())
+    return TPUPlace(0) if has_tpu else CPUPlace()
 
 
 class BeginEpochEvent:
@@ -79,7 +89,7 @@ class Trainer:
                  mesh=None):
         self.__stop = False
         self.parallel = parallel
-        self.place = self._check_place(place)
+        self.place = _default_place(place)
         self._mesh = mesh
 
         if checkpoint_config is not None and not isinstance(
@@ -92,7 +102,12 @@ class Trainer:
         self.startup_program = Program()
         self.train_program = Program()
 
-        with program_guard(self.train_program, self.startup_program):
+        # fresh name generator so parameter names (fc_0.w_0, ...) are
+        # reproducible regardless of what this process built before —
+        # Inferencer rebuilds the net under the same guard and must get
+        # identical names to match the saved files
+        with unique_name.guard(), \
+                program_guard(self.train_program, self.startup_program):
             program_func_outs = train_func()
             self.train_func_outputs = (
                 program_func_outs if isinstance(program_func_outs, list)
@@ -133,13 +148,6 @@ class Trainer:
                         self.checkpoint_cfg.checkpoint_dir)
 
     # ------------------------------------------------------------------
-    def _check_place(self, place):
-        if place is not None:
-            return place
-        import jax
-        has_tpu = any(d.platform != "cpu" for d in jax.devices())
-        return TPUPlace(0) if has_tpu else CPUPlace()
-
     def _dist_transpile_if_necessary(self):
         role = os.getenv("PADDLE_TRAINING_ROLE")
         if role is None or role == "TRAINER":

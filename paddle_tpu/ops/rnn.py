@@ -214,8 +214,9 @@ def _gru_compute(ins, attrs, ctx, op_index):
         g = gate_act(xg + h_prev @ w_g)
         u, r = g[:, :h], g[:, h:]
         c = cand_act(xc + (r * h_prev) @ w_c)
-        # paddle gru: h = u * h_prev + (1 - u) * c
-        hh = u * h_prev + (1.0 - u) * c
+        # reference gru kernel (math/detail/gru_kernel.h:62):
+        # h = (1 - u) * h_prev + u * c
+        hh = (1.0 - u) * h_prev + u * c
         valid = (tidx < length)[:, None]
         h_new = jnp.where(valid, hh, h_prev)
         return h_new, jnp.where(valid, hh, 0)
@@ -278,7 +279,8 @@ def _gru_unit_compute(ins, attrs, ctx, op_index):
     u, r = g[:, :h], g[:, h:]
     rhp = r * h_prev
     c = cand_act(xc + rhp @ w[:, 2 * h:])
-    hh = u * h_prev + (1.0 - u) * c
+    # gru_unit_op.h:116: h = (1 - u) * h_prev + u * c
+    hh = (1.0 - u) * h_prev + u * c
     return {"Hidden": hh, "Gate": jnp.concatenate([g, c], axis=-1),
             "ResetHiddenPrev": rhp}
 

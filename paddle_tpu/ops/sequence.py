@@ -80,7 +80,9 @@ def _seq_pool_compute(ins, attrs, ctx, op_index):
     elif ptype == "SQRT":
         out = jnp.sum(jnp.where(mask, x, 0), axis=1) / jnp.sqrt(denom)
     elif ptype == "MAX":
-        neg = jnp.finfo(x.dtype).min
+        neg = (jnp.finfo(x.dtype).min
+               if jnp.issubdtype(x.dtype, jnp.floating)
+               else jnp.iinfo(x.dtype).min)
         masked = jnp.where(mask, x, neg)
         out = jnp.max(masked, axis=1)
         idx = jnp.argmax(masked, axis=1).astype(jnp.int32)
@@ -115,7 +117,9 @@ def _seq_softmax_compute(ins, attrs, ctx, op_index):
     t = x.shape[1]
     extra = x.ndim - 2
     mask = _time_mask(length, t, extra)
-    neg = jnp.finfo(x.dtype).min
+    neg = (jnp.finfo(x.dtype).min
+           if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
     logits = jnp.where(mask, x, neg)
     sm = jax.nn.softmax(logits, axis=1)
     return {"Out": jnp.where(mask, sm, 0)}

@@ -204,15 +204,22 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
 
 
 def cache(reader):
-    """Materialize once, replay from memory."""
+    """Materialize once, replay from memory.
+
+    An interrupted first pass (early break, ``firstn`` wrapper) must not
+    poison the cache, so each uncached pass rebuilds from scratch and only
+    a fully-consumed pass is kept.
+    """
     all_data = []
     state = {"cached": False}
 
     def data_reader():
         if not state["cached"]:
+            fresh = []
             for item in reader():
-                all_data.append(item)
+                fresh.append(item)
                 yield item
+            all_data[:] = fresh
             state["cached"] = True
         else:
             for item in all_data:

@@ -276,7 +276,7 @@ def _np_gru(x, w, lens):
             g = sig(xt[:2 * h] + hp @ w[:, :2 * h])
             u, r = g[:h], g[h:]
             c = np.tanh(xt[2 * h:] + (r * hp) @ w[:, 2 * h:])
-            hp = u * hp + (1 - u) * c
+            hp = (1 - u) * hp + u * c
             hs[bi, ti] = hp
     return hs.astype("float32")
 

@@ -11,7 +11,6 @@ engine to manage.
 
 import os
 
-import numpy as np
 
 from .. import io as fluid_io
 from .. import unique_name
@@ -77,10 +76,7 @@ class Inferencer:
             raise ValueError(
                 "inputs should be a map of {'input_name': input_var}")
         with scope_guard(self.scope):
-            results = self.exe.run(
+            return self.exe.run(
                 self.inference_program, feed=inputs,
                 fetch_list=[v.name for v in self.predict_vars],
                 return_numpy=return_numpy)
-        if return_numpy:
-            results = [np.asarray(r) for r in results]
-        return results

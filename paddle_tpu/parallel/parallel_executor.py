@@ -88,8 +88,43 @@ class ParallelExecutor:
         return self._mesh.devices.shape[idx]
 
     # ------------------------------------------------------------------
+    def _axis_size(self, axis):
+        if axis not in self._mesh.axis_names:
+            return 1
+        return self._mesh.devices.shape[self._mesh.axis_names.index(axis)]
+
+    def _spec_fits(self, spec, shape):
+        """True iff every named axis in ``spec`` divides its dim of shape."""
+        entries = tuple(spec)
+        if len(entries) > len(shape):
+            return False
+        for dim, entry in zip(shape, entries):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, tuple) else (entry,)
+            total = 1
+            for ax in axes:
+                if ax not in self._mesh.axis_names:
+                    return False
+                total *= self._axis_size(ax)
+            if total > 1 and (dim <= 0 or dim % total != 0):
+                return False
+        return True
+
     def _state_spec(self, name, val):
         """Sharding spec for a persistable state array."""
+        custom = self._build_strategy.param_sharding_fn
+        if custom is not None:
+            spec = custom(name, tuple(getattr(val, "shape", ())))
+            if spec is not None:
+                if not self._spec_fits(spec, tuple(val.shape)):
+                    raise ValueError(
+                        "param_sharding_fn spec %r does not divide %r of "
+                        "shape %s on mesh %s"
+                        % (spec, name, tuple(val.shape),
+                           dict(zip(self._mesh.axis_names,
+                                    self._mesh.devices.shape))))
+                return spec
         strat = self._build_strategy.reduce_strategy
         if strat == BuildStrategy.ReduceStrategy.Reduce:
             # ZeRO-style: shard dim 0 over dp when it divides evenly.
@@ -112,9 +147,20 @@ class ParallelExecutor:
         batch_spec = P(AXIS_DP)
         feed_shardings = []
         dp = self._dp_size()
+        custom_feed = self._build_strategy.feed_sharding_fn
         for n, v in zip(feed_names, feed_vals):
             arr = np.asarray(v) if not isinstance(v, jax.Array) else v
-            if arr.ndim >= 1 and arr.shape[0] % dp == 0 and arr.shape[0] > 0:
+            spec = None
+            if custom_feed is not None:
+                spec = custom_feed(n, tuple(arr.shape))
+            if spec is not None:
+                if not self._spec_fits(spec, tuple(arr.shape)):
+                    raise ValueError(
+                        "feed_sharding_fn spec %r does not divide feed %r "
+                        "of shape %s" % (spec, n, tuple(arr.shape)))
+                feed_shardings.append(NamedSharding(mesh, spec))
+            elif arr.ndim >= 1 and arr.shape[0] % dp == 0 \
+                    and arr.shape[0] > 0:
                 feed_shardings.append(NamedSharding(mesh, batch_spec))
             else:
                 raise ValueError(
@@ -182,8 +228,12 @@ class ParallelExecutor:
             (n, tuple(v.shape), str(v.dtype))
             for n, v in zip(feed_names, feed_vals)
         )
+        # policy fns go in the key as objects (kept alive by the cache, so
+        # no id()-reuse aliasing after GC)
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               id(scope), self._build_strategy.reduce_strategy)
+               id(scope), self._build_strategy.reduce_strategy,
+               self._build_strategy.param_sharding_fn,
+               self._build_strategy.feed_sharding_fn)
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._compile(program, feed_names, fetch_names, scope,

@@ -32,6 +32,14 @@ class BuildStrategy:
         # TPU-specific knobs
         self.donate_state = True          # in-place param updates (XLA)
         self.remat = False                # jax.checkpoint the whole step
+        # sharding-policy hooks (the DistributeTranspiler analog: decide
+        # where each tensor lives on the mesh; GSPMD inserts collectives)
+        #   param_sharding_fn(name, shape) -> PartitionSpec or None
+        #   feed_sharding_fn(name, shape)  -> PartitionSpec or None
+        # None falls back to the built-in rule (params: Reduce-strategy dp
+        # sharding or replicate; feeds: batch dim over dp).
+        self.param_sharding_fn = None
+        self.feed_sharding_fn = None
 
 
 class ExecutionStrategy:

@@ -116,6 +116,59 @@ def test_parallel_rejects_indivisible_batch():
         pe.run(feed=bad, fetch_list=[loss])
 
 
+def test_parallel_tensor_parallel_policy():
+    """param_sharding_fn: shard fc weight out-columns over tp; loss must
+    match the single-device run exactly (GSPMD only changes layout)."""
+    from jax.sharding import PartitionSpec as P
+
+    batches = _data(batch=8)
+    loss = _build_mlp()
+    single = _run_single(batches, loss)
+
+    def param_spec(name, shape):
+        if len(shape) == 2 and shape[1] % 2 == 0:
+            return P(None, "tp")
+        return None
+
+    bs = fluid.BuildStrategy()
+    bs.param_sharding_fn = param_spec
+    mesh = make_mesh((4, 2), ("dp", "tp"))
+    with fluid.scope_guard(fluid.Scope()):
+        par = _run_parallel(batches, loss, build_strategy=bs, mesh=mesh)
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
+
+
+def test_parallel_bad_policy_spec_raises():
+    from jax.sharding import PartitionSpec as P
+
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    bs = fluid.BuildStrategy()
+    # 64 columns not divisible by mesh size 8 on dim 0 of shape (32, 64)?
+    # use a spec that cannot divide: shard the 8-wide output over dp=8
+    # after slicing to odd size via the bias (1-D shape 9 impossible) —
+    # simplest: shard dim0 of the [32,64] weight over a 5-way product
+    bs.param_sharding_fn = lambda name, shape: (
+        P(("dp", "tp")) if len(shape) == 1 and shape[0] % 16 != 0 else None)
+    mesh = make_mesh((4, 2), ("dp", "tp"))
+    pe = fluid.ParallelExecutor(loss_name=loss.name, build_strategy=bs,
+                                mesh=mesh)
+    b = _data(steps=1, batch=8)[0]
+    with pytest.raises(ValueError, match="does not divide"):
+        pe.run(feed=b, fetch_list=[loss])
+
+
+def test_graft_entry_dryrun_inprocess():
+    """The driver's multichip dryrun runs in-process on the virtual mesh."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__ as graft
+    graft.dryrun_multichip(8)
+
+
 def test_make_mesh_shapes():
     m = make_mesh()
     assert m.devices.size == len(jax.devices())

@@ -48,6 +48,14 @@ __all__ = [
     "isfinite",
     "less_than",
     "equal",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
+    "not_equal",
+    "logical_and",
+    "logical_or",
+    "logical_xor",
+    "logical_not",
 ]
 
 
@@ -407,3 +415,28 @@ def _cmp_layer(op_type):
 
 less_than = _cmp_layer("less_than")
 equal = _cmp_layer("equal")
+less_equal = _cmp_layer("less_equal")
+greater_than = _cmp_layer("greater_than")
+greater_equal = _cmp_layer("greater_equal")
+not_equal = _cmp_layer("not_equal")
+
+
+def _logical_layer(op_type, binary=True):
+    def layer(x, y=None, out=None, name=None):
+        helper = LayerHelper(op_type, name=name)
+        if out is None:
+            out = helper.create_variable_for_type_inference(dtype="bool")
+        inputs = {"X": [x]}
+        if binary:
+            inputs["Y"] = [y]
+        helper.append_op(type=op_type, inputs=inputs,
+                         outputs={"Out": [out]})
+        return out
+
+    return layer
+
+
+logical_and = _logical_layer("logical_and")
+logical_or = _logical_layer("logical_or")
+logical_xor = _logical_layer("logical_xor")
+logical_not = _logical_layer("logical_not", binary=False)

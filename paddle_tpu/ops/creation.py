@@ -181,7 +181,9 @@ register_op(
 
 
 def _increment_compute(ins, attrs, ctx, op_index):
-    return {"Out": ins["X"][0] + attrs.get("step", 1.0)}
+    x = ins["X"][0]
+    # preserve dtype: int loop counters must stay int under while_loop
+    return {"Out": x + jnp.asarray(attrs.get("step", 1.0), x.dtype)}
 
 
 register_op(

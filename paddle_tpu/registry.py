@@ -120,11 +120,26 @@ def infer_op(op, block):
 def compute_op(op, env, ctx, op_index=0):
     """Execute one op inside a trace: read inputs from env, write outputs."""
     d = get_op_def(op.type)
-    # empty names are "holes" (e.g. pruned grad slots): pass/collect None
-    ins = {
-        slot: [env[n] if n else None for n in names]
-        for slot, names in op.inputs.items()
-    }
+    # empty names are "holes" (e.g. pruned grad slots): pass/collect None.
+    # Out:: slots of grad ops are lenient — an optional forward output
+    # (e.g. sequence_pool MaxIndex under "last") may never have been
+    # produced.  A GRAD:: name is only lenient when its forward output is
+    # itself absent; a missing gradient for a produced output is a real
+    # wiring bug and must stay a loud KeyError, not silent zeros.
+    ins = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if not n:
+                vals.append(None)
+            elif slot.startswith("Out::"):
+                vals.append(env.get(n))
+            elif slot.startswith("GRAD::"):
+                fwd = n[: -len("@GRAD")] if n.endswith("@GRAD") else n
+                vals.append(env.get(n) if fwd not in env else env[n])
+            else:
+                vals.append(env[n])
+        ins[slot] = vals
     outs = d.compute(ins, op.attrs, ctx, op_index)
     for slot, names in op.outputs.items():
         vals = outs.get(slot)

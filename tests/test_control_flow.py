@@ -1,0 +1,440 @@
+"""Control-flow construct tests (reference test_recurrent_op.py,
+test_while_op.py, test_dyn_rnn.py, test_ifelse.py, test_switch.py,
+test_beam_search_op.py patterns: numpy oracles + trainability)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import grad_var_name
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN
+# ---------------------------------------------------------------------------
+
+def test_static_rnn_accumulator_oracle():
+    t_len, b, d = 5, 3, 4
+    x = fluid.layers.data("x", shape=[t_len, b, d], dtype="float32",
+                          append_batch_size=False)
+    h0 = fluid.layers.data("h0", shape=[b, d], dtype="float32",
+                           append_batch_size=False)
+
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_pre = rnn.memory(init=h0)
+        h = fluid.layers.elementwise_add(
+            fluid.layers.scale(h_pre, scale=0.5), x_t)
+        rnn.update_memory(h_pre, h)
+        rnn.step_output(h)
+    out = rnn()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(t_len, b, d).astype("float32")
+    h0v = rng.rand(b, d).astype("float32")
+    (ov,) = exe.run(feed={"x": xv, "h0": h0v}, fetch_list=[out])
+
+    ref = np.zeros_like(xv)
+    h = h0v.copy()
+    for t in range(t_len):
+        h = 0.5 * h + xv[t]
+        ref[t] = h
+    np.testing.assert_allclose(ov, ref, rtol=1e-5)
+
+
+def test_static_rnn_grad_numeric():
+    """Analytic grad through lax.scan matches central differences."""
+    t_len, b, d = 4, 2, 3
+    x = fluid.layers.data("x", shape=[t_len, b, d], dtype="float32",
+                          append_batch_size=False, stop_gradient=False)
+    h0 = fluid.layers.data("h0", shape=[b, d], dtype="float32",
+                           append_batch_size=False)
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_pre = rnn.memory(init=h0)
+        h = fluid.layers.tanh(
+            fluid.layers.elementwise_add(
+                fluid.layers.scale(h_pre, scale=0.7), x_t))
+        rnn.update_memory(h_pre, h)
+        rnn.step_output(h)
+    out = rnn()
+    loss = fluid.layers.reduce_sum(out)
+    fluid.append_backward(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    xv = rng.rand(t_len, b, d).astype("float32") * 0.5
+    h0v = rng.rand(b, d).astype("float32") * 0.5
+
+    lv, gx = exe.run(feed={"x": xv, "h0": h0v},
+                     fetch_list=[loss, grad_var_name("x")])
+
+    eps = 1e-3
+    num = np.zeros_like(xv)
+    for idx in np.ndindex(*xv.shape):
+        for sgn in (1, -1):
+            xp = xv.copy()
+            xp[idx] += sgn * eps
+            (l2,) = exe.run(feed={"x": xp, "h0": h0v}, fetch_list=[loss])
+            num[idx] += sgn * float(np.asarray(l2).ravel()[0])
+    num /= 2 * eps
+    np.testing.assert_allclose(gx, num, rtol=5e-2, atol=5e-3)
+
+
+def test_static_rnn_with_params_trains():
+    """fc inside the step block: weight grads flow through the scan."""
+    fluid.default_main_program().random_seed = 11
+    fluid.default_startup_program().random_seed = 11
+    t_len, b, d, h_dim = 6, 4, 5, 5
+    x = fluid.layers.data("x", shape=[t_len, b, d], dtype="float32",
+                          append_batch_size=False)
+    label = fluid.layers.data("label", shape=[b, 1], dtype="int64",
+                              append_batch_size=False)
+
+    h0 = fluid.layers.fill_constant(shape=[b, h_dim], dtype="float32",
+                                    value=0.0)
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h_pre = rnn.memory(init=h0)
+        h = fluid.layers.fc(
+            fluid.layers.concat([x_t, h_pre], axis=1), size=h_dim,
+            act="tanh")
+        rnn.update_memory(h_pre, h)
+        rnn.step_output(h)
+    out = rnn()
+    last = fluid.layers.slice(out, axes=[0], starts=[t_len - 1],
+                              ends=[t_len])
+    last = fluid.layers.reshape(last, shape=[b, h_dim])
+    pred = fluid.layers.fc(last, size=3, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=5e-2).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    xv = rng.rand(t_len, b, d).astype("float32")
+    yv = rng.randint(0, 3, (b, 1)).astype("int64")
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_static_rnn_mixed_dtype_inputs_keep_grads():
+    """An int64 step input (token ids) must not disqualify the float step
+    input from differentiation."""
+    t_len, b, d, v = 3, 2, 4, 6
+    x = fluid.layers.data("x", shape=[t_len, b, d], dtype="float32",
+                          append_batch_size=False, stop_gradient=False)
+    ids = fluid.layers.data("ids", shape=[t_len, b, 1], dtype="int64",
+                            append_batch_size=False)
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        id_t = rnn.step_input(ids)
+        emb = fluid.layers.embedding(id_t, size=[v, d])
+        h_pre = rnn.memory(shape=[d], batch_ref=x_t, init_value=0.0)
+        h = fluid.layers.tanh(fluid.layers.elementwise_add(
+            fluid.layers.elementwise_add(h_pre, x_t), emb))
+        rnn.update_memory(h_pre, h)
+        rnn.step_output(h)
+    out = rnn()
+    loss = fluid.layers.reduce_sum(out)
+    fluid.append_backward(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(12)
+    xv = rng.rand(t_len, b, d).astype("float32") * 0.1
+    iv = rng.randint(0, v, (t_len, b, 1)).astype("int64")
+    lv, gx = exe.run(feed={"x": xv, "ids": iv},
+                     fetch_list=[loss, grad_var_name("x")])
+    assert np.isfinite(gx).all()
+    assert np.abs(gx).sum() > 0   # gradient actually flows
+
+
+# ---------------------------------------------------------------------------
+# DynamicRNN
+# ---------------------------------------------------------------------------
+
+def test_dynamic_rnn_masks_padding():
+    b, t_len, d = 3, 5, 2
+    x = fluid.layers.data("x", shape=[d], dtype="float32", lod_level=1)
+
+    drnn = fluid.layers.DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(x)
+        h_pre = drnn.memory(shape=[d], value=0.0)
+        h = fluid.layers.elementwise_add(h_pre, x_t)
+        drnn.update_memory(h_pre, h)
+        drnn.output(h)
+    out = drnn()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    xv = rng.rand(b, t_len, d).astype("float32")
+    lens = np.array([5, 2, 3], "int32")
+    (ov,) = exe.run(feed={"x": xv, "x@LEN": lens}, fetch_list=[out])
+
+    ref = np.zeros((b, t_len, d), "float32")
+    for bi in range(b):
+        acc = np.zeros(d, "float32")
+        for t in range(lens[bi]):
+            acc = acc + xv[bi, t]
+            ref[bi, t] = acc
+    np.testing.assert_allclose(ov, ref, rtol=1e-5)
+    # final memory holds at length; outputs past length are zero
+    assert np.all(ov[1, 2:] == 0) and np.all(ov[2, 3:] == 0)
+
+
+def test_dynamic_rnn_trains_sequence_sum():
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    d, h_dim = 3, 8
+    x = fluid.layers.data("x", shape=[d], dtype="float32", lod_level=1)
+    y = fluid.layers.data("y", shape=[1], dtype="float32")
+
+    drnn = fluid.layers.DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(x)
+        h_pre = drnn.memory(shape=[h_dim], value=0.0)
+        h = fluid.layers.fc(fluid.layers.concat([x_t, h_pre], axis=1),
+                            size=h_dim, act="tanh")
+        drnn.update_memory(h_pre, h)
+        drnn.output(h)
+    out = drnn()
+    last = fluid.layers.sequence_pool(out, "last")
+    pred = fluid.layers.fc(last, size=1, act=None)
+    loss = fluid.layers.mean(fluid.layers.square(
+        fluid.layers.elementwise_sub(pred, y)))
+    fluid.optimizer.Adam(learning_rate=2e-2).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(5)
+    losses = []
+    for _ in range(40):
+        xv = rng.rand(8, 6, d).astype("float32")
+        lens = rng.randint(2, 7, (8,)).astype("int32")
+        yv = np.array([
+            xv[i, :lens[i]].sum(axis=(0, 1), keepdims=False).sum()
+            for i in range(8)], "float32").reshape(-1, 1) / 6.0
+        (lv,) = exe.run(feed={"x": xv, "x@LEN": lens, "y": yv},
+                        fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.5, losses
+
+
+# ---------------------------------------------------------------------------
+# While + arrays
+# ---------------------------------------------------------------------------
+
+def test_while_loop_sums_array():
+    t_len, d = 4, 3
+    x = fluid.layers.data("x", shape=[t_len, d], dtype="float32",
+                          append_batch_size=False)
+    # array of per-step rows, while-accumulated sum
+    arr = fluid.layers.create_array("float32", capacity=t_len,
+                                    element_shape=[d])
+    i0 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=t_len)
+    acc = fluid.layers.fill_constant(shape=[d], dtype="float32", value=0.0)
+    # write rows into the array first (outside the loop)
+    for t in range(t_len):
+        it = fluid.layers.fill_constant(shape=[1], dtype="int64", value=t)
+        row = fluid.layers.reshape(
+            fluid.layers.slice(x, axes=[0], starts=[t], ends=[t + 1]),
+            shape=[d])
+        arr = fluid.layers.array_write(row, it, array=arr)
+
+    i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond)
+    with w.block():
+        row = fluid.layers.array_read(arr, i)
+        fluid.layers.assign(fluid.layers.elementwise_add(acc, row),
+                            output=acc)
+        fluid.layers.increment(i, value=1)
+        fluid.layers.less_than(i, n, cond=cond)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(4)
+    xv = rng.rand(t_len, d).astype("float32")
+    (accv,) = exe.run(feed={"x": xv}, fetch_list=[acc])
+    np.testing.assert_allclose(accv, xv.sum(0), rtol=1e-5)
+
+
+def test_while_requires_cond_update():
+    i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond)
+    with pytest.raises(ValueError, match="condition"):
+        with w.block():
+            fluid.layers.increment(i, value=1)
+
+
+# ---------------------------------------------------------------------------
+# IfElse / Switch / ConditionalBlock
+# ---------------------------------------------------------------------------
+
+def test_ifelse_row_select():
+    b, d = 6, 3
+    x = fluid.layers.data("x", shape=[d])
+    limit = fluid.layers.fill_constant(shape=[1], dtype="float32", value=1.5)
+    row_sum = fluid.layers.reduce_sum(x, dim=1, keep_dim=True)
+    cond = fluid.layers.less_than(row_sum, limit)
+
+    ie = fluid.layers.IfElse(cond)
+    with ie.true_block():
+        d_in = ie.input(x)
+        ie.output(fluid.layers.scale(d_in, scale=2.0))
+    with ie.false_block():
+        d_in = ie.input(x)
+        ie.output(fluid.layers.scale(d_in, scale=-1.0))
+    out = ie()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(6)
+    xv = rng.rand(b, d).astype("float32")
+    (ov,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    mask = xv.sum(1, keepdims=True) < 1.5
+    ref = np.where(mask, 2.0 * xv, -1.0 * xv)
+    np.testing.assert_allclose(ov, ref, rtol=1e-5)
+
+
+def test_conditional_block_scalar():
+    x = fluid.layers.data("x", shape=[1], dtype="float32",
+                          append_batch_size=False)
+    thresh = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                        value=0.5)
+    out = fluid.layers.fill_constant(shape=[1], dtype="float32", value=-1.0)
+    cond = fluid.layers.less_than(x, thresh)
+    cb = fluid.layers.ConditionalBlock([cond])
+    with cb.block():
+        fluid.layers.assign(fluid.layers.scale(x, scale=10.0), output=out)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    (o1,) = exe.run(feed={"x": np.array([0.2], "float32")},
+                    fetch_list=[out])
+    (o2,) = exe.run(feed={"x": np.array([0.9], "float32")},
+                    fetch_list=[out])
+    np.testing.assert_allclose(np.asarray(o1).ravel(), [2.0], rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(o2).ravel(), [-1.0], rtol=1e-5)
+
+
+def test_switch_piecewise():
+    """The piecewise-LR pattern: value by step range."""
+    step = fluid.layers.data("step", shape=[1], dtype="float32",
+                             append_batch_size=False)
+    lr = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    b1 = fluid.layers.fill_constant(shape=[1], dtype="float32", value=10.0)
+    b2 = fluid.layers.fill_constant(shape=[1], dtype="float32", value=20.0)
+
+    with fluid.layers.Switch() as switch:
+        with switch.case(fluid.layers.less_than(step, b1)):
+            fluid.layers.assign(
+                fluid.layers.fill_constant([1], "float32", 1.0), output=lr)
+        with switch.case(fluid.layers.less_than(step, b2)):
+            fluid.layers.assign(
+                fluid.layers.fill_constant([1], "float32", 0.5), output=lr)
+        with switch.default():
+            fluid.layers.assign(
+                fluid.layers.fill_constant([1], "float32", 0.1), output=lr)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    for sv, expect in [(5.0, 1.0), (15.0, 0.5), (25.0, 0.1)]:
+        (lv,) = exe.run(feed={"step": np.array([sv], "float32")},
+                        fetch_list=[lr])
+        np.testing.assert_allclose(np.asarray(lv).ravel(), [expect],
+                                   rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# beam search
+# ---------------------------------------------------------------------------
+
+def _np_beam_step(pre_ids, pre_scores, scores, end_id):
+    b, k, v = scores.shape
+    out_ids = np.zeros((b, k), "int64")
+    out_scores = np.zeros((b, k), scores.dtype)
+    out_parent = np.zeros((b, k), "int64")
+    for bi in range(b):
+        cands = []
+        for ki in range(k):
+            if pre_ids[bi, ki] == end_id:
+                cands.append((pre_scores[bi, ki], ki, end_id))
+                continue
+            for vi in range(v):
+                cands.append(
+                    (pre_scores[bi, ki] + scores[bi, ki, vi], ki, vi))
+        cands.sort(key=lambda c: -c[0])
+        for j in range(k):
+            s, ki, vi = cands[j]
+            out_scores[bi, j] = s
+            out_parent[bi, j] = ki
+            out_ids[bi, j] = vi
+    return out_ids, out_scores, out_parent
+
+
+def test_beam_search_step_oracle():
+    b, k, v, end_id = 2, 3, 7, 0
+    rng = np.random.RandomState(8)
+    pre_ids = np.array([[3, 0, 2], [1, 4, 0]], "int64")   # some finished
+    pre_scores = rng.rand(b, k).astype("float32") * -1.0
+    scores = np.log(rng.dirichlet(np.ones(v), size=(b, k))
+                    .astype("float32") + 1e-9)
+
+    p_ids = fluid.layers.data("pre_ids", shape=[b, k], dtype="int64",
+                              append_batch_size=False)
+    p_sc = fluid.layers.data("pre_scores", shape=[b, k], dtype="float32",
+                             append_batch_size=False)
+    sc = fluid.layers.data("scores", shape=[b, k, v], dtype="float32",
+                           append_batch_size=False)
+    ids, out_sc, parent = fluid.layers.beam_search(
+        p_ids, p_sc, sc, beam_size=k, end_id=end_id)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    iv, sv, pv = exe.run(
+        feed={"pre_ids": pre_ids, "pre_scores": pre_scores,
+              "scores": scores},
+        fetch_list=[ids, out_sc, parent])
+
+    ref_ids, ref_scores, ref_parent = _np_beam_step(
+        pre_ids, pre_scores, scores, end_id)
+    np.testing.assert_allclose(sv, ref_scores, rtol=1e-4)
+    np.testing.assert_array_equal(iv, ref_ids)
+    np.testing.assert_array_equal(pv, ref_parent)
+
+
+def test_beam_search_decode_backtrack():
+    # T=3, B=1, K=2; beam 0 path: a->c->e; beam 1 final came via parents
+    ids = np.array([[[5, 6]], [[7, 8]], [[9, 10]]], "int64")   # [T,1,2]
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], "int64")
+    scores = np.array([[0.9, 0.4]], "float32")
+
+    idv = fluid.layers.data("ids", shape=[3, 1, 2], dtype="int64",
+                            append_batch_size=False)
+    pav = fluid.layers.data("parents", shape=[3, 1, 2], dtype="int64",
+                            append_batch_size=False)
+    scv = fluid.layers.data("scores", shape=[1, 2], dtype="float32",
+                            append_batch_size=False)
+    sent, out_sc = fluid.layers.beam_search_decode(
+        idv, pav, scv, beam_size=2, end_id=0)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    sv, scv_out = exe.run(
+        feed={"ids": ids, "parents": parents, "scores": scores},
+        fetch_list=[sent, out_sc])
+    # beam 0 at T-1 token 9, parent 1 -> step1 beam1 token 8, parent 0
+    # -> step0 beam0 token 5
+    np.testing.assert_array_equal(sv[0, 0], [5, 8, 9])
+    # beam 1 at T-1 token 10, parent 0 -> step1 beam0 token 7 -> token 5
+    np.testing.assert_array_equal(sv[0, 1], [5, 7, 10])
+    np.testing.assert_allclose(scv_out, scores)

@@ -135,7 +135,8 @@ class StaticRNN:
         return step_var
 
     def memory(self, init=None, shape=None, batch_ref=None,
-               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1,
+               dtype="float32"):
         self._assert_in_rnn_block("memory")
         if init is None:
             if shape is None or batch_ref is None:
@@ -157,7 +158,7 @@ class StaticRNN:
             try:
                 init = tensor_layers.fill_constant_batch_size_like(
                     input=batch_ref, shape=[-1] + list(shape),
-                    dtype="float32", value=init_value,
+                    dtype=dtype, value=init_value,
                     input_dim_idx=ref_batch_dim_idx,
                     output_dim_idx=init_batch_dim_idx)
             finally:
@@ -306,9 +307,10 @@ class DynamicRNN(StaticRNN):
         if init is None and shape is not None and self.inputs:
             kwargs.setdefault("batch_ref", self.inputs[0][0])
             kwargs.setdefault("ref_batch_dim_idx", 0)
-            return super().memory(shape=shape, init_value=value, **kwargs)
+            return super().memory(shape=shape, init_value=value,
+                                  dtype=dtype, **kwargs)
         return super().memory(init=init, shape=shape, init_value=value,
-                              **kwargs)
+                              dtype=dtype, **kwargs)
 
     def _append_recurrent(self, parent):
         super()._append_recurrent(parent)
@@ -382,6 +384,11 @@ class ConditionalBlock:
 
     def __init__(self, inputs, is_scalar_condition=True, name=None):
         self.helper = LayerHelper("conditional_block", name=name)
+        if not is_scalar_condition:
+            raise NotImplementedError(
+                "per-row (non-scalar) conditions are served by IfElse "
+                "(predicated row merge); ConditionalBlock lowers to "
+                "lax.cond over a scalar predicate")
         for x in inputs:
             if not isinstance(x, Variable):
                 raise TypeError("ConditionalBlock inputs must be Variables")

@@ -21,5 +21,6 @@ from . import (  # noqa: F401
     random,
     reduction,
     rnn,
+    selected_rows,
     sequence,
 )

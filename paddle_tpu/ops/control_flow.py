@@ -29,14 +29,11 @@ TPU-first:
   is exactly what the TPU vector units want.
 """
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from .. import registry
-from ..core import convert_dtype
 from ..registry import ComputeContext, register_op, set_output, in_var
 
 
@@ -231,11 +228,21 @@ def _while_compute(ins, attrs, ctx, op_index):
     return {"Out": list(out)}
 
 
+def _while_grad_maker(op, no_grad_set):
+    # reached only when a live gradient actually flows into the loop's
+    # outputs — fail loudly instead of silently freezing the weights
+    raise RuntimeError(
+        "cannot differentiate through a While loop: XLA cannot "
+        "reverse-differentiate an unbounded lax.while_loop. Use "
+        "StaticRNN/DynamicRNN (lax.scan) for trainable recurrence; While "
+        "is the inference/decoding construct.")
+
+
 register_op(
     "while",
     ["Condition", "LoopVars", "Params", "Consts"],
     ["Out"],
-    infer=None, compute=_while_compute, grad=None,
+    infer=None, compute=_while_compute, grad=_while_grad_maker,
 )
 
 

@@ -433,7 +433,14 @@ def _lookup_table_compute(ins, attrs, ctx, op_index):
     return {"Out": out.reshape(shape)}
 
 
+def _lookup_table_grad(op, no_grad_set):
+    # sparse path (is_sparse attr) emits a SelectedRows gradient
+    from .selected_rows import lookup_table_grad_maker
+    return lookup_table_grad_maker(op, no_grad_set)
+
+
 register_op(
     "lookup_table", ["W", "Ids"], ["Out"], infer=_lookup_table_infer,
-    compute=_lookup_table_compute, no_grad_inputs=("Ids",),
+    compute=_lookup_table_compute, grad=_lookup_table_grad,
+    no_grad_inputs=("Ids",),
 )

@@ -117,9 +117,22 @@ def _sum_infer(op, block):
 
 
 def _sum_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows, to_dense
+    import jax.numpy as _jnp
+
     xs = [x for x in ins["X"] if x is not None]
-    out = xs[0]
-    for x in xs[1:]:
+    sparse = [x for x in xs if isinstance(x, SelectedRows)]
+    dense = [x for x in xs if not isinstance(x, SelectedRows)]
+    if sparse and not dense:
+        # all-sparse: concatenation IS addition (reference sum_op
+        # SelectedRows kernel appends row lists)
+        rows = _jnp.concatenate([s.rows for s in sparse])
+        vals = _jnp.concatenate([s.values for s in sparse])
+        return {"Out": SelectedRows(rows, vals, sparse[0].height)}
+    if sparse:
+        dense = dense + [to_dense(s) for s in sparse]
+    out = dense[0]
+    for x in dense[1:]:
         out = out + x
     return {"Out": out}
 

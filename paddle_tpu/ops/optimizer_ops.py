@@ -29,8 +29,15 @@ def _mirror_infer(*pairs):
 
 
 def _sgd_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows
+
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
-    return {"ParamOut": p - lr.astype(p.dtype) * g.astype(p.dtype)}
+    lr = lr.astype(p.dtype)
+    if isinstance(g, SelectedRows):
+        # sparse kernel (sgd_op.cc SelectedRows path): scatter-add only
+        # the touched rows; duplicates sum naturally
+        return {"ParamOut": p.at[g.rows].add(-lr * g.values.astype(p.dtype))}
+    return {"ParamOut": p - lr * g.astype(p.dtype)}
 
 
 register_op(
@@ -41,9 +48,24 @@ register_op(
 
 
 def _momentum_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows, merge_rows, scatter_update_rows
+
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     lr = ins["LearningRate"][0].astype(p.dtype)
     mu = attrs["mu"]
+    if isinstance(g, SelectedRows):
+        # lazy sparse kernel: only touched rows' velocity/param move
+        uniq, gm, valid = merge_rows(g)
+        safe = jnp.where(valid, uniq, 0)
+        v_r, p_r = v[safe], p[safe]
+        v_new = mu * v_r + gm
+        if attrs.get("use_nesterov", False):
+            p_new = p_r - (gm + mu * v_new) * lr
+        else:
+            p_new = p_r - lr * v_new
+        return {"ParamOut": scatter_update_rows(p, uniq, valid, p_new, p_r),
+                "VelocityOut": scatter_update_rows(v, uniq, valid, v_new,
+                                                   v_r)}
     v_out = mu * v + g
     if attrs.get("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr
@@ -61,15 +83,31 @@ register_op(
 
 
 def _adam_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows, merge_rows, scatter_update_rows
+
     p, g = ins["Param"][0], ins["Grad"][0]
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
     b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
     lr = ins["LearningRate"][0].astype(p.dtype)
     b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    if isinstance(g, SelectedRows):
+        # lazy adam (adam_op.cc SelectedRows kernel): untouched rows'
+        # moments and params are bit-identical across the step
+        uniq, gm, valid = merge_rows(g)
+        safe = jnp.where(valid, uniq, 0)
+        m1_r, m2_r, p_r = m1[safe], m2[safe], p[safe]
+        m1_new = b1 * m1_r + (1 - b1) * gm
+        m2_new = b2 * m2_r + (1 - b2) * gm * gm
+        p_new = p_r - lr_t * m1_new / (jnp.sqrt(m2_new) + eps)
+        return {
+            "ParamOut": scatter_update_rows(p, uniq, valid, p_new, p_r),
+            "Moment1Out": scatter_update_rows(m1, uniq, valid, m1_new, m1_r),
+            "Moment2Out": scatter_update_rows(m2, uniq, valid, m2_new, m2_r),
+        }
     m1_out = b1 * m1 + (1 - b1) * g
     m2_out = b2 * m2 + (1 - b2) * g * g
-    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
     return {"ParamOut": p_out, "Moment1Out": m1_out, "Moment2Out": m2_out}
 
@@ -86,9 +124,20 @@ register_op(
 
 
 def _adagrad_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows, merge_rows, scatter_update_rows
+
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     lr = ins["LearningRate"][0].astype(p.dtype)
     eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        uniq, gm, valid = merge_rows(g)
+        safe = jnp.where(valid, uniq, 0)
+        mom_r, p_r = mom[safe], p[safe]
+        mom_new = mom_r + gm * gm
+        p_new = p_r - lr * gm / (jnp.sqrt(mom_new) + eps)
+        return {"ParamOut": scatter_update_rows(p, uniq, valid, p_new, p_r),
+                "MomentOut": scatter_update_rows(mom, uniq, valid, mom_new,
+                                                 mom_r)}
     mom_out = mom + g * g
     p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
     return {"ParamOut": p_out, "MomentOut": mom_out}

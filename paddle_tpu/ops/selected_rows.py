@@ -1,0 +1,156 @@
+"""SelectedRows: sparse row-slice gradients.
+
+Parity: reference ``framework/selected_rows.h:32`` (row-index list + value
+tensor), ``operators/lookup_table_op.cc`` (sparse grad kernel),
+``math/selected_rows_functor.cc`` (merge-add), and the SelectedRows
+kernels registered by every optimizer (``sgd_op.cc``, ``adam_op.cc``...)
+— re-designed TPU-first:
+
+* A SelectedRows value is a jax pytree ``(rows int32[N], values [N, D])``
+  with the table height as static aux data, so it flows through the
+  traced program, jit, and pjit like any other value.  ``N`` equals the
+  number of looked-up ids (static), never the table height: the backward
+  of a lookup touches O(batch·seq) rows, not O(vocab) — the
+  correctness-of-scale property the reference gets from SelectedRows.
+* Duplicate row merging (reference MergeAdd) uses ``jnp.unique`` with a
+  static ``size=`` so it stays jit-compatible: the deduped row list is
+  padded with a ``height`` sentinel and updates are applied as masked
+  scatter-adds of deltas (duplicate-safe).
+* Optimizer sparse kernels implement the reference's *lazy* semantics:
+  only touched rows' moments/params move (adam_op.cc SelectedRows kernel);
+  untouched rows are bit-identical across the step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var
+from ..framework import grad_var_name
+
+__all__ = ["SelectedRows", "merge_rows", "to_dense"]
+
+
+class SelectedRows:
+    """rows: int32[N] indices into dim 0 of a [height, ...] table;
+    values: [N, ...] gradient slices; height: static table height."""
+
+    def __init__(self, rows, values, height):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def __repr__(self):
+        return "SelectedRows(rows=%s, values=%s, height=%d)" % (
+            self.rows.shape, self.values.shape, self.height)
+
+
+jax.tree_util.register_pytree_node(
+    SelectedRows,
+    lambda sr: ((sr.rows, sr.values), sr.height),
+    lambda height, leaves: SelectedRows(leaves[0], leaves[1], height),
+)
+
+
+def merge_rows(sr):
+    """Reference MergeAdd: combine duplicate rows (static-shape dedupe).
+
+    Returns (uniq_rows int32[N] padded with ``height`` sentinel,
+    merged_values [N, ...], valid bool[N]).
+    """
+    n = sr.rows.shape[0]
+    uniq, inv = jnp.unique(
+        sr.rows, size=n, fill_value=sr.height, return_inverse=True)
+    merged = jnp.zeros_like(sr.values).at[inv.reshape(-1)].add(sr.values)
+    valid = uniq < sr.height
+    return uniq.astype(jnp.int32), merged, valid
+
+
+def to_dense(sr):
+    """Densify (reference SelectedRows::Get / scatter semantics)."""
+    dense = jnp.zeros((sr.height,) + tuple(sr.values.shape[1:]),
+                      sr.values.dtype)
+    return dense.at[sr.rows].add(sr.values)
+
+
+def scatter_update_rows(table, uniq, valid, new_rows, old_rows):
+    """table[uniq] <- new_rows where valid, duplicate-sentinel-safe:
+    applied as += (new - old) masked to zero on sentinel entries."""
+    from .control_flow import _mask_to
+
+    safe = jnp.where(valid, uniq, 0)
+    delta = jnp.where(_mask_to(valid, new_rows), new_rows - old_rows, 0)
+    return table.at[safe].add(delta)
+
+
+# ---------------------------------------------------------------------------
+# lookup_table sparse grad (reference lookup_table_op.cc grad SelectedRows
+# kernel; selected by the layer's is_sparse attr)
+# ---------------------------------------------------------------------------
+
+def lookup_table_grad_maker(op, no_grad_set):
+    """Custom grad maker: sparse path emits lookup_table_sparse_grad."""
+    from ..registry import _auto_grad_maker
+
+    if not op.attrs.get("is_sparse", False):
+        return _auto_grad_maker(op, no_grad_set)
+    w_name = op.inputs["W"][0]
+    if w_name in no_grad_set:
+        return []
+    return [dict(
+        type="lookup_table_sparse_grad",
+        inputs={
+            "W": list(op.inputs["W"]),
+            "Ids": list(op.inputs["Ids"]),
+            "GRAD::Out": [grad_var_name(n) for n in op.outputs["Out"]],
+        },
+        outputs={"GRAD::W": [grad_var_name(w_name)]},
+        attrs=dict(op.attrs),
+    )]
+
+
+def _lookup_sparse_grad_infer(op, block):
+    w = in_var(op, block, "W")
+    for g_name in op.outputs.get("GRAD::W", []):
+        if not g_name:
+            continue
+        block.create_var(name=g_name, shape=w.shape, dtype=w.dtype,
+                         persistable=False)
+
+
+def _lookup_sparse_grad_compute(ins, attrs, ctx, op_index):
+    w, ids, gout = ins["W"][0], ins["Ids"][0], ins["GRAD::Out"][0]
+    height = w.shape[0]
+    flat = ids.reshape(-1).astype(jnp.int32)
+    values = gout.reshape(flat.shape[0], w.shape[1])
+    pad = attrs.get("padding_idx", -1)
+    if pad is not None and pad != -1:
+        values = values * (flat != pad)[:, None].astype(values.dtype)
+    return {"GRAD::W": SelectedRows(flat, values, height)}
+
+
+register_op(
+    "lookup_table_sparse_grad", ["W", "Ids", "GRAD::Out"], ["GRAD::W"],
+    infer=_lookup_sparse_grad_infer, compute=_lookup_sparse_grad_compute,
+    grad=None, no_grad_inputs=("Ids",),
+)
+
+
+# ---------------------------------------------------------------------------
+# get_tensor_from_selected_rows (reference
+# get_tensor_from_selected_rows_op.cc): densify for fetching/inspection
+# ---------------------------------------------------------------------------
+
+def _get_tensor_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        return {"Out": to_dense(x)}
+    return {"Out": x}
+
+
+register_op(
+    "get_tensor_from_selected_rows", ["X"], ["Out"],
+    infer=lambda op, block: set_output(
+        op, block, "Out", in_var(op, block, "X").shape,
+        in_var(op, block, "X").dtype),
+    compute=_get_tensor_compute, grad=None,
+)

@@ -1,0 +1,49 @@
+"""Sharded embedding tables on the mesh.
+
+Parity: the reference's distributed lookup tables — params sliced across
+pservers with remote prefetch (``transpiler/distribute_transpiler.py``
+lookup-table handling, ``operators/lookup_table_op.cc`` remote_prefetch,
+``split_ids_op.cc`` / ``merge_ids_op.cc``) — re-designed TPU-first:
+a table marked ``is_distributed`` by ``layers.embedding`` is row-sharded
+over a mesh axis and GSPMD turns the lookups into gather collectives over
+ICI; there is no server role, no RPC, and no prefetch op — the "remote"
+rows are one all-gather away.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+from .mesh import AXIS_DP, AXIS_EP
+
+__all__ = ["distributed_embedding_sharding_fn"]
+
+
+def _distributed_tables(program):
+    """Names of lookup_table W params marked is_distributed."""
+    names = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type == "lookup_table" and \
+                    op.attrs.get("is_distributed", False):
+                names.update(op.inputs.get("W", []))
+    return names
+
+
+def distributed_embedding_sharding_fn(program, mesh, axis=None):
+    """Build a BuildStrategy.param_sharding_fn that row-shards every
+    ``is_distributed`` embedding table over ``axis`` (default: the mesh's
+    ``ep`` axis if present, else ``dp``).
+
+    Compose with another policy by chaining: the returned fn yields None
+    for non-table params so a wrapper can fall through.
+    """
+    if axis is None:
+        axis = AXIS_EP if AXIS_EP in mesh.axis_names else AXIS_DP
+    size = mesh.devices.shape[mesh.axis_names.index(axis)]
+    tables = _distributed_tables(program)
+
+    def fn(name, shape):
+        if name in tables and shape and shape[0] % size == 0:
+            return P(axis)
+        return None
+
+    return fn

@@ -40,6 +40,14 @@ from . import dataset
 from .reader import batch
 from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
 from .parallel.mesh import make_mesh
+from . import transpiler
+from .transpiler import (
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+    InferenceTranspiler,
+    memory_optimize,
+    release_memory,
+)
 
 __version__ = "0.1.0"
 
@@ -52,5 +60,7 @@ __all__ = [
     "global_scope", "scope_guard", "ParamAttr", "WeightNormParamAttr",
     "DataFeeder", "io", "profiler", "parallel", "ParallelExecutor",
     "BuildStrategy", "ExecutionStrategy", "make_mesh", "reader",
-    "dataset", "batch",
+    "dataset", "batch", "transpiler", "DistributeTranspiler",
+    "DistributeTranspilerConfig", "InferenceTranspiler",
+    "memory_optimize", "release_memory",
 ]

@@ -52,10 +52,12 @@ class Place:
 
 class CPUPlace(Place):
     def jax_device(self):
+        # local_devices: under multi-host (jax.distributed) the first
+        # GLOBAL device may belong to another process
         try:
-            return jax.devices("cpu")[0]
+            return jax.local_devices(backend="cpu")[0]
         except RuntimeError:
-            return jax.devices()[0]
+            return jax.local_devices()[0]
 
     def __eq__(self, other):
         return isinstance(other, CPUPlace)
@@ -71,9 +73,9 @@ class TPUPlace(Place):
         self.device_id = device_id
 
     def jax_device(self):
-        devs = [d for d in jax.devices() if d.platform != "cpu"]
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"]
         if not devs:
-            devs = jax.devices()
+            devs = jax.local_devices()
         return devs[self.device_id % len(devs)]
 
     def __eq__(self, other):

@@ -1,0 +1,74 @@
+"""Multi-host bootstrap: the TPU replacement for the reference's NCCL2
+rendezvous and pserver role wiring.
+
+Parity: ``operators/distributed/gen_nccl_id_op.cc:31`` (rank 0 creates an
+NCCL unique id and serves it to peers over gRPC) and the cluster role env
+vars consumed by ``contrib/trainer.py:324`` / ``benchmark/fluid/README``
+(PADDLE_TRAINERS, PADDLE_TRAINER_ID, PADDLE_CURRENT_IP...) — re-designed
+TPU-first: ``jax.distributed.initialize`` IS the rendezvous (a gRPC
+coordination service exactly like gen_nccl_id's exchange); after it, the
+same Mesh spans every host's devices and XLA routes collectives over
+ICI/DCN.  There is no pserver role: parameters live sharded on the mesh.
+"""
+
+import os
+
+import jax
+
+__all__ = ["init_distributed", "is_initialized", "process_count",
+           "process_id", "barrier"]
+
+_initialized = False
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, local_device_ids=None):
+    """Join the multi-host world.  Arguments fall back to the reference's
+    cluster env vars, then to JAX's own:
+
+    * coordinator_address <- PADDLE_COORDINATOR (host:port; the analog of
+      the pserver endpoint the reference serves the NCCL id from)
+    * num_processes       <- PADDLE_TRAINERS
+    * process_id          <- PADDLE_TRAINER_ID
+
+    Call before any jax computation, once per process.  On real TPU pods
+    with a TPU runtime the arguments are auto-detected and may be None.
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or \
+        os.getenv("PADDLE_COORDINATOR")
+    if num_processes is None and os.getenv("PADDLE_TRAINERS"):
+        num_processes = int(os.environ["PADDLE_TRAINERS"])
+    if process_id is None and os.getenv("PADDLE_TRAINER_ID"):
+        process_id = int(os.environ["PADDLE_TRAINER_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def is_initialized():
+    """Whether init_distributed ran in THIS process.  Deliberately does
+    NOT query jax.process_count(): that would initialize the XLA backend
+    and make a later init_distributed() impossible."""
+    return _initialized
+
+
+def process_count():
+    return jax.process_count()
+
+
+def process_id():
+    return jax.process_index()
+
+
+def barrier(name="paddle_tpu_barrier"):
+    """Host barrier over the coordination service (the analog of the
+    reference's send_barrier/fetch_barrier RPC round)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -38,6 +38,10 @@ def distributed_embedding_sharding_fn(program, mesh, axis=None):
     """
     if axis is None:
         axis = AXIS_EP if AXIS_EP in mesh.axis_names else AXIS_DP
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            "mesh %r has no %r axis to shard embedding tables over; pass "
+            "axis= naming one of its axes" % (tuple(mesh.axis_names), axis))
     size = mesh.devices.shape[mesh.axis_names.index(axis)]
     tables = _distributed_tables(program)
 

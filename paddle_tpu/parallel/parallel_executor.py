@@ -93,12 +93,14 @@ class ParallelExecutor:
             return 1
         return self._mesh.devices.shape[self._mesh.axis_names.index(axis)]
 
-    def _spec_fits(self, spec, shape):
-        """True iff every named axis in ``spec`` divides its dim of shape."""
+    def _spec_fits(self, spec, shape, local_batch=False):
+        """True iff every named axis in ``spec`` divides its dim of shape.
+        With ``local_batch`` (multi-host feeds), dim 0 holds only this
+        process's slice, so its divisor shrinks by the process count."""
         entries = tuple(spec)
         if len(entries) > len(shape):
             return False
-        for dim, entry in zip(shape, entries):
+        for i, (dim, entry) in enumerate(zip(shape, entries)):
             if entry is None:
                 continue
             axes = entry if isinstance(entry, tuple) else (entry,)
@@ -107,6 +109,8 @@ class ParallelExecutor:
                 if ax not in self._mesh.axis_names:
                     return False
                 total *= self._axis_size(ax)
+            if local_batch and i == 0:
+                total = max(1, total // jax.process_count())
             if total > 1 and (dim <= 0 or dim % total != 0):
                 return False
         return True
@@ -147,6 +151,9 @@ class ParallelExecutor:
         batch_spec = P(AXIS_DP)
         feed_shardings = []
         dp = self._dp_size()
+        # multi-host: each process feeds its local slice, so the local
+        # batch only needs to cover this process's share of the dp axis
+        dp = max(1, dp // jax.process_count())
         custom_feed = self._build_strategy.feed_sharding_fn
         for n, v in zip(feed_names, feed_vals):
             arr = np.asarray(v) if not isinstance(v, jax.Array) else v
@@ -154,7 +161,8 @@ class ParallelExecutor:
             if custom_feed is not None:
                 spec = custom_feed(n, tuple(arr.shape))
             if spec is not None:
-                if not self._spec_fits(spec, tuple(arr.shape)):
+                if not self._spec_fits(spec, tuple(arr.shape),
+                                       local_batch=jax.process_count() > 1):
                     raise ValueError(
                         "feed_sharding_fn spec %r does not divide feed %r "
                         "of shape %s" % (spec, n, tuple(arr.shape)))
@@ -184,15 +192,31 @@ class ParallelExecutor:
             fn = jax.checkpoint(fn)
 
         donate = (1,) if self._build_strategy.donate_state else ()
+        # multi-host: fetches are forced replicated so every process can
+        # read them (np.asarray on a non-addressable array would throw)
+        fetch_shardings = None
+        if jax.process_count() > 1:
+            fetch_shardings = [NamedSharding(mesh, P())] * len(fetch_names)
         jitted = jax.jit(
             fn,
             in_shardings=(feed_shardings, state_shardings, None),
-            out_shardings=(None, out_state_shardings),
+            out_shardings=(fetch_shardings, out_state_shardings),
             donate_argnums=donate,
         )
         return _Compiled(jitted, feed_names, state_in, state_out,
                          fetch_names, feed_shardings, state_shardings,
                          out_state_shardings)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _global_state(val, sharding):
+        """Lift a host-local state value (identical on every process, by
+        deterministic seeded startup) into a global array on ``sharding``."""
+        if isinstance(val, jax.Array) and len(val.sharding.device_set) > 1:
+            return val          # already global (previous step's output)
+        host = np.asarray(val)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
 
     # ------------------------------------------------------------------
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
@@ -240,14 +264,29 @@ class ParallelExecutor:
                                      feed_vals)
             self._cache[key] = compiled
 
-        feed_dev = [
-            jax.device_put(v, s)
-            for v, s in zip(feed_vals, compiled.feed_shardings)
-        ]
-        state_dev = [
-            jax.device_put(scope.var(n), s)
-            for n, s in zip(compiled.state_in, compiled.state_shardings)
-        ]
+        multihost = jax.process_count() > 1
+        if multihost:
+            # NCCL2-mode parity: each trainer process feeds its LOCAL
+            # shard of the global batch; the global array spans hosts
+            # (parallel_executor.cc:102 flat world of trainer ranks)
+            feed_dev = [
+                v if isinstance(v, jax.Array) and len(v.sharding.device_set)
+                > 1 else jax.make_array_from_process_local_data(s, v)
+                for v, s in zip(feed_vals, compiled.feed_shardings)
+            ]
+            state_dev = [
+                self._global_state(scope.var(n), s)
+                for n, s in zip(compiled.state_in, compiled.state_shardings)
+            ]
+        else:
+            feed_dev = [
+                jax.device_put(v, s)
+                for v, s in zip(feed_vals, compiled.feed_shardings)
+            ]
+            state_dev = [
+                jax.device_put(scope.var(n), s)
+                for n, s in zip(compiled.state_in, compiled.state_shardings)
+            ]
         seed = program.random_seed or 0
         rng = jax.random.key(
             np.uint32(seed) if seed else np.random.randint(0, 2**31 - 1))
@@ -259,5 +298,13 @@ class ParallelExecutor:
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
         if return_numpy:
-            fetches = [np.asarray(f) for f in fetches]
+            fetches = [self._fetch_to_np(f) for f in fetches]
         return fetches
+
+    @staticmethod
+    def _fetch_to_np(f):
+        if isinstance(f, jax.Array) and not f.is_fully_addressable:
+            # multi-host: fetches are compiled with replicated
+            # out_shardings, so the local shard IS the global value
+            return np.asarray(f.addressable_shards[0].data)
+        return np.asarray(f)

@@ -1,0 +1,23 @@
+"""Program→program rewrites (reference ``python/paddle/fluid/transpiler/``).
+
+On TPU most of the reference transpilers' work moved into the compiler:
+
+* DistributeTranspiler → a *sharding plan* (mesh + BuildStrategy policy
+  fns); there are no separate trainer/pserver programs to generate.
+* memory_optimization_transpiler → XLA liveness analysis + buffer
+  donation (Executor donates state buffers already); memory_optimize is
+  kept as an API no-op that reports what XLA does instead.
+* inference_transpiler → ``Program.clone(for_test=True)`` + XLA fusion
+  (BN folding, conv+relu fusion happen in the compiler).
+"""
+
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig)
+from .memory_optimization_transpiler import (  # noqa: F401
+    memory_optimize, release_memory)
+from .inference_transpiler import InferenceTranspiler  # noqa: F401
+
+__all__ = [
+    "DistributeTranspiler", "DistributeTranspilerConfig",
+    "memory_optimize", "release_memory", "InferenceTranspiler",
+]

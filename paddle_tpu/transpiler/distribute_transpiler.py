@@ -1,0 +1,145 @@
+"""DistributeTranspiler: the sharding-plan rewriter.
+
+Parity: reference ``transpiler/distribute_transpiler.py:144,237`` — there
+it slices each param/grad into blocks (``slice_variable:79``), rewrites
+the trainer program with send/recv ops and generates a pserver program
+of optimize sub-blocks.  TPU-first redesign: parameters never leave the
+mesh, so "transpiling" means deciding *where each tensor lives*:
+
+* large params (numel >= min_block_size, the reference's slicing
+  threshold) are sharded over the dp axis (ZeRO-style, the kReduce
+  analog of pserver-sharded optimizer state);
+* ``is_distributed`` embedding tables row-shard over ep/dp
+  (the sharded lookup-table path);
+* everything else is replicated.
+
+``transpile()`` returns the plan; ``get_trainer_program()`` returns the
+original program (nothing to rewrite — GSPMD inserts the collectives),
+and ``get_pserver_program()`` raises: there is no server role.
+"""
+
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from ..framework import default_main_program
+from ..parallel.mesh import AXIS_DP, AXIS_EP
+from ..parallel.strategy import BuildStrategy
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+
+
+class DistributeTranspilerConfig:
+    """reference distribute_transpiler.py:125."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = "RoundRobin"   # kept for API parity; unused
+        self.min_block_size = 8192         # reference's slicing threshold
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._program = None
+        self._plan = None
+
+    # ------------------------------------------------------------------
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, mesh=None):
+        """Build the sharding plan.  ``pservers``/``sync_mode`` are taken
+        for API parity; async pserver SGD has no TPU analog (every update
+        is a synchronous mesh-wide step) and pserver endpoints are
+        subsumed by the mesh."""
+        if not sync_mode:
+            raise NotImplementedError(
+                "async pserver SGD has no TPU analog: updates are "
+                "synchronous mesh-wide steps (SURVEY.md §2.4)")
+        self._program = program or default_main_program()
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self._mesh = mesh
+
+        from ..parallel.embedding import _distributed_tables
+        dist_tables = _distributed_tables(self._program)
+
+        plan = {}
+        for p in self._program.all_parameters():
+            shape = tuple(p.shape or ())
+            numel = int(np.prod(shape)) if shape else 0
+            if p.name in dist_tables:
+                plan[p.name] = ("table", P(AXIS_EP))
+            elif self.config.slice_var_up and shape and \
+                    numel >= self.config.min_block_size:
+                plan[p.name] = ("sliced", P(AXIS_DP))
+            else:
+                plan[p.name] = ("replicated", P())
+        self._plan = plan
+        return self
+
+    # ------------------------------------------------------------------
+    def sharding_plan(self):
+        """{param name: (kind, PartitionSpec)} — inspectable, like the
+        reference's transpiler tests inspect generated programs."""
+        if self._plan is None:
+            raise RuntimeError("call transpile() first")
+        return dict(self._plan)
+
+    def build_strategy(self, mesh):
+        """A BuildStrategy whose param_sharding_fn applies the plan,
+        degrading to replication when a dim doesn't divide the mesh."""
+        if self._plan is None:
+            raise RuntimeError("call transpile() first")
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        plan = self._plan
+
+        def fn(name, shape):
+            kind_spec = plan.get(name)
+            if kind_spec is None:
+                return None
+            _, spec = kind_spec
+            entries = tuple(spec)
+            if not entries:
+                return P()
+            # substitute dp for axes this mesh lacks FIRST, then check
+            # divisibility against the axes actually used — an
+            # indivisible dim degrades to replication, never to an
+            # invalid spec
+            fixed = tuple(
+                (a if a in axis_sizes else AXIS_DP) if a else None
+                for a in entries)
+            for dim, axis in zip(shape, fixed):
+                if axis is None:
+                    continue
+                size = axis_sizes.get(axis, 1)
+                if size > 1 and (dim <= 0 or dim % size != 0):
+                    return P()
+            return P(*fixed)
+
+        bs = BuildStrategy()
+        bs.reduce_strategy = BuildStrategy.ReduceStrategy.Reduce
+        bs.param_sharding_fn = fn
+        return bs
+
+    # ------------------------------------------------------------------
+    def get_trainer_program(self):
+        """The program is NOT rewritten: GSPMD inserts the collectives
+        the reference expressed as send/recv ops."""
+        if self._program is None:
+            raise RuntimeError("call transpile() first")
+        return self._program
+
+    def get_pserver_program(self, endpoint):
+        raise RuntimeError(
+            "there is no parameter-server role on the TPU runtime: "
+            "parameters live sharded on the mesh (use build_strategy(mesh) "
+            "with a ParallelExecutor; multi-host joins via "
+            "parallel.distributed.init_distributed)")
+
+    get_pserver_programs = get_pserver_program
+
+    def get_startup_program(self, endpoint=None, pserver_program=None):
+        raise RuntimeError(
+            "no pserver startup program exists: run the normal startup "
+            "program on every host (deterministic seeded init gives "
+            "identical parameters)")

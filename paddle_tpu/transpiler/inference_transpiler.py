@@ -1,0 +1,16 @@
+"""InferenceTranspiler (reference ``transpiler/inference_transpiler.py``:
+BN folding into conv/fc weights, conv+relu fusion for MKLDNN).
+
+TPU redesign: XLA fuses conv+bias+BN+relu chains in the compiled module,
+so the arithmetic rewrites are unnecessary; what remains semantically is
+switching train-mode ops to inference (the clone(for_test) rewrite).
+"""
+
+__all__ = ["InferenceTranspiler"]
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place=None, scope=None):
+        """Return an inference-mode copy of ``program`` (dropout/BN to
+        is_test); numeric fusion is left to XLA."""
+        return program.clone(for_test=True)

@@ -1,0 +1,40 @@
+"""memory_optimize / release_memory (reference
+``transpiler/memory_optimization_transpiler.py``: liveness-based var
+reuse rewriting var names in the program).
+
+TPU redesign: XLA's buffer assignment performs the same liveness
+analysis on the fused HLO module, and the Executor donates state buffers
+(in-place updates).  Rewriting the Program would at best duplicate and
+at worst fight the compiler, so these are audited no-ops that return the
+would-be savings for observability.
+"""
+
+import numpy as np
+
+from ..framework import default_main_program
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
+                    level=0):
+    """No-op on TPU (XLA owns buffer reuse); returns an estimate of the
+    non-persistable temporary footprint the compiler will recycle."""
+    program = input_program or default_main_program()
+    skip = set(skip_opt_set or ())
+    total = 0
+    for v in program.list_vars():
+        if v.persistable or v.name in skip or not v.shape:
+            continue
+        if any(d is None or d < 0 for d in v.shape):
+            continue
+        total += int(np.prod(v.shape)) * 4
+    if print_log:
+        print("memory_optimize: ~%d bytes of temporaries left to XLA "
+              "buffer reuse (no program rewrite on TPU)" % total)
+    return total
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    """No-op: temporaries die inside the jitted step (no GC to trigger)."""
+    return 0

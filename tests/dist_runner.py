@@ -1,0 +1,75 @@
+"""Subprocess entry for distributed tests (the reference's
+``test_dist_base.py`` trainer-process body).  Each process joins the
+jax.distributed world, builds the same model, feeds its LOCAL half of
+every global batch through the ParallelExecutor, and prints the losses.
+
+Run: python dist_runner.py <process_id> <num_processes> <coordinator>
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    coordinator = sys.argv[3]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import distributed
+
+    distributed.init_distributed(
+        coordinator_address=coordinator, num_processes=nproc,
+        process_id=pid)
+    assert jax.process_count() == nproc
+
+    import numpy as np
+
+    # same model + data as the single-process reference run in the test
+    fluid.default_main_program().random_seed = 21
+    fluid.default_startup_program().random_seed = 21
+    img = fluid.layers.data("img", shape=[32])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(img, size=64, act="relu")
+    pred = fluid.layers.fc(h, size=8, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    # the transpiler-produced sharding plan drives the PE
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=pid, trainers=nproc)
+    mesh = fluid.make_mesh()            # all 8 global devices
+    bs = t.build_strategy(mesh)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    pe = fluid.ParallelExecutor(loss_name=loss.name, build_strategy=bs,
+                                mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    proj = rng.rand(32, 8).astype("float32")
+    losses = []
+    for _ in range(6):
+        x = rng.rand(16, 32).astype("float32")
+        y = (x @ proj).argmax(1).astype("int64").reshape(-1, 1)
+        # local slice: this trainer's half of the global batch
+        lo = pid * (16 // nproc)
+        hi = lo + 16 // nproc
+        (lv,) = pe.run(feed={"img": x[lo:hi], "label": y[lo:hi]},
+                       fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    print("DIST_LOSSES", json.dumps(losses))
+
+
+if __name__ == "__main__":
+    main()

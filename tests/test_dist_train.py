@@ -1,0 +1,124 @@
+"""Localhost multi-process distributed training test (reference
+``test_dist_base.py:31``: fork real OS processes, run N steps, assert
+trainer losses match a local single-process reference run)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_reference():
+    """Same model/data as dist_runner.py on the in-process 8-device mesh."""
+    fluid.default_main_program().random_seed = 21
+    fluid.default_startup_program().random_seed = 21
+    img = fluid.layers.data("img", shape=[32])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(img, size=64, act="relu")
+    pred = fluid.layers.fc(h, size=8, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    proj = rng.rand(32, 8).astype("float32")
+    losses = []
+    for _ in range(6):
+        x = rng.rand(16, 32).astype("float32")
+        y = (x @ proj).argmax(1).astype("int64").reshape(-1, 1)
+        (lv,) = exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    return losses
+
+
+def test_two_process_dist_matches_local():
+    ref = _single_process_reference()
+
+    port = _free_port()
+    coordinator = "127.0.0.1:%d" % port
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dist_runner.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # runner sets its own device count
+    procs = [
+        subprocess.Popen(
+            [sys.executable, runner, str(i), "2", coordinator],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, (out[-2000:], err[-4000:])
+        outs.append(out)
+
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("DIST_LOSSES")]
+        assert line, out[-2000:]
+        losses = json.loads(line[0][len("DIST_LOSSES "):])
+        np.testing.assert_allclose(losses, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_transpiler_sharding_plan():
+    """Plan inspection (the reference's test_dist_transpiler pattern)."""
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.param_attr import ParamAttr
+
+    ids = fluid.layers.data("ids", shape=[4, 1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[64, 16], is_distributed=True,
+                                 param_attr=ParamAttr(name="table_w"))
+    big = fluid.layers.fc(fluid.layers.reduce_mean(emb, dim=1), size=1024,
+                          param_attr=ParamAttr(name="big_w"),
+                          bias_attr=ParamAttr(name="small_b"))
+    loss = fluid.layers.mean(big)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, trainers=2)
+    plan = t.sharding_plan()
+    assert plan["table_w"] == ("table", P("ep"))
+    assert plan["big_w"][0] == "sliced"        # 16*1024 = 16384 >= 8192
+    assert plan["small_b"][0] == "replicated"
+
+    mesh = fluid.make_mesh((8,), ("dp",))
+    bs = t.build_strategy(mesh)
+    # table axis 'ep' not on this mesh -> falls back to dp; 64 % 8 == 0
+    assert bs.param_sharding_fn("table_w", (64, 16)) == P("dp")
+    assert bs.param_sharding_fn("small_b", (1024,)) == P()
+    # indivisible dim degrades to replication
+    assert bs.param_sharding_fn("big_w", (15, 1024)) == P()
+    # indivisible AFTER ep->dp substitution also degrades (63 % 8 != 0)
+    assert bs.param_sharding_fn("table_w", (63, 16)) == P()
+
+    with pytest.raises(RuntimeError, match="no parameter-server role"):
+        t.get_pserver_program("127.0.0.1:7164")
+    with pytest.raises(NotImplementedError, match="async"):
+        fluid.DistributeTranspiler().transpile(
+            trainer_id=0, trainers=2, sync_mode=False)
+
+
+def test_memory_optimize_reports():
+    x = fluid.layers.data("x", shape=[16])
+    y = fluid.layers.fc(x, size=32)
+    fluid.layers.mean(y)
+    saved = fluid.memory_optimize(print_log=False)
+    assert saved >= 0
+    assert fluid.release_memory() == 0

@@ -1,0 +1,46 @@
+"""Elastic training coordinator — the TPU rebuild of the reference's Go
+cloud layer (``go/master/service.go``, ``go/pserver/service.go`` and the
+Python client ``python/paddle/v2/master/client.py``).
+
+Capabilities reproduced (SURVEY.md §2.4 "Go cloud layer", §5 failure
+recovery):
+
+* task-lease queue over data shards: todo/pending/done/failed queues,
+  timeout requeue, ``failure_max`` discard (``go/master/service.go:140``,
+  ``:341 checkTimeoutFunc``, ``:455 TaskFailed``, ``:313
+  processFailedTask``);
+* state snapshot/recover through a pluggable Store — the etcd analog
+  (``go/master/service.go:207 snapshot``, ``:166 recover``);
+* checkpoint/save-model arbitration so exactly one live trainer saves
+  (``go/master/service.go:481 RequestSaveModel``,
+  ``python/paddle/v2/master/client.py:38-56``);
+* a host-side TCP service + client for multi-process jobs — the gRPC
+  master service analog; collectives stay on ICI/DCN via XLA, this is
+  control-plane only.
+
+TPU-first redesign notes: timeouts are *persisted deadlines* checked
+lazily under the service lock instead of in-flight goroutine timers, so
+a recovered master (new process, old Store) keeps honoring leases the
+dead master granted — the reference loses its ``time.AfterFunc`` timers
+on restart.
+"""
+
+from .master import (  # noqa: F401
+    MasterService,
+    Task,
+    NoMoreAvailable,
+    PassBefore,
+    PassAfter,
+    AllTasksFailed,
+    partition,
+)
+from .store import InMemStore, FileStore  # noqa: F401
+from .server import MasterServer, MasterClient  # noqa: F401
+from .reader import master_reader  # noqa: F401
+
+__all__ = [
+    "MasterService", "Task", "partition",
+    "NoMoreAvailable", "PassBefore", "PassAfter", "AllTasksFailed",
+    "InMemStore", "FileStore", "MasterServer", "MasterClient",
+    "master_reader",
+]

@@ -1,0 +1,275 @@
+"""The elastic master's task-lease state machine.
+
+Parity spec: ``go/master/service.go`` —
+
+* ``masterState`` (``:80``): Todo / Pending / Done / Failed + CurPass;
+* ``partition`` (``:106``): chunks -> tasks of ``chunks_per_task``;
+* ``GetTask`` (``:368``): pass-count handshake (ErrPassBefore /
+  ErrPassAfter / ErrNoMoreAvailable / ErrAllTaskFailed), lease with
+  timeout, epoch bump per dispatch;
+* ``TaskFinished`` (``:411``): done queue, pass rollover when todo and
+  pending drain (failed tasks are re-queued for the next pass);
+* ``TaskFailed`` (``:455``) / ``processFailedTask`` (``:313``): requeue
+  up to ``failure_max`` then discard to Failed;
+* ``checkTimeoutFunc`` (``:341``): lease timeout requeue, guarded by the
+  task's dispatch epoch so a stale timeout can't kill a fresh lease;
+* ``RequestSaveModel`` (``:481``): single-saver arbitration with a
+  blocking window.
+
+TPU-first redesign: deadlines live *in the snapshotted state* and are
+enforced lazily under the lock (`_expire_stale`), so recovery from the
+Store preserves live leases AND their timeouts; the Go original re-arms
+nothing after recovery.  Chunks are opaque JSON values (file spans,
+recordio chunk descriptors, shard indices) rather than recordio-only.
+"""
+
+import json
+import threading
+import time
+
+__all__ = ["MasterService", "Task", "partition", "NoMoreAvailable",
+           "PassBefore", "PassAfter", "AllTasksFailed"]
+
+
+class PassBefore(Exception):
+    """Client's pass is behind the master's (go ErrPassBefore)."""
+
+
+class PassAfter(Exception):
+    """Client ran ahead of the master's pass (go ErrPassAfter): wait."""
+
+
+class NoMoreAvailable(Exception):
+    """Todo drained but pending leases outstanding (go ErrNoMoreAvailable)."""
+
+
+class AllTasksFailed(Exception):
+    """Every task of the pass is in Failed (go ErrAllTaskFailed)."""
+
+
+class Task:
+    """A leased unit of work: a list of opaque chunks + lease metadata.
+
+    Mirrors go ``Task{Meta{ID, Epoch}, Chunks}``.
+    """
+
+    __slots__ = ("task_id", "epoch", "chunks", "num_failure", "deadline")
+
+    def __init__(self, task_id, chunks, epoch=0, num_failure=0,
+                 deadline=0.0):
+        self.task_id = task_id
+        self.epoch = epoch
+        self.chunks = list(chunks)
+        self.num_failure = num_failure
+        self.deadline = deadline
+
+    def to_dict(self):
+        return {"task_id": self.task_id, "epoch": self.epoch,
+                "chunks": self.chunks, "num_failure": self.num_failure,
+                "deadline": self.deadline}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["task_id"], d["chunks"], d["epoch"],
+                   d["num_failure"], d["deadline"])
+
+    def __repr__(self):
+        return (f"Task(id={self.task_id}, epoch={self.epoch}, "
+                f"chunks={len(self.chunks)}, failures={self.num_failure})")
+
+
+def partition(chunks, chunks_per_task=1):
+    """Group chunks into tasks (go/master/service.go:106).
+
+    IDs are dense ints (the Go original uses time+rand uniqueness with a
+    FIXME asking for something better; dense ids are deterministic and
+    snapshot-friendly)."""
+    if chunks_per_task <= 0:
+        chunks_per_task = 1
+    return [Task(i // chunks_per_task, chunks[i:i + chunks_per_task])
+            for i in range(0, len(chunks), chunks_per_task)]
+
+
+class MasterService:
+    """Single-coordinator task-lease service (go/master/service.go:140)."""
+
+    def __init__(self, store=None, chunks_per_task=1, timeout=60.0,
+                 failure_max=3, clock=time.monotonic, ready_timeout=10.0):
+        from .store import InMemStore
+
+        self.store = store or InMemStore()
+        self.chunks_per_task = chunks_per_task
+        self.timeout = timeout
+        self.failure_max = failure_max
+        self._clock = clock
+        self._ready_timeout = ready_timeout
+        self._mu = threading.RLock()
+        self._ready = threading.Event()
+
+        # masterState (go :80)
+        self.todo = []
+        self.pending = {}          # task_id -> Task
+        self.done = []
+        self.failed = []
+        self.cur_pass = 0
+
+        # transient, like go's savingTrainer (go :101)
+        self._saving_trainer = ""
+        self._saving_until = 0.0
+
+        snap = self.store.load()
+        if snap:
+            self._restore(snap)
+            self._ready.set()
+
+    # -- snapshot / recover (go :207 snapshot, :166 recover) ------------
+    def _snapshot(self):
+        state = {
+            "todo": [t.to_dict() for t in self.todo],
+            "pending": {str(k): v.to_dict() for k, v in
+                        self.pending.items()},
+            "done": [t.to_dict() for t in self.done],
+            "failed": [t.to_dict() for t in self.failed],
+            "cur_pass": self.cur_pass,
+        }
+        self.store.save(json.dumps(state).encode("utf-8"))
+
+    def _restore(self, blob):
+        state = json.loads(blob.decode("utf-8"))
+        self.todo = [Task.from_dict(d) for d in state["todo"]]
+        self.pending = {int(k): Task.from_dict(v)
+                        for k, v in state["pending"].items()}
+        self.done = [Task.from_dict(d) for d in state["done"]]
+        self.failed = [Task.from_dict(d) for d in state["failed"]]
+        self.cur_pass = state["cur_pass"]
+
+    # -- dataset registration (go SetDataset :270) ----------------------
+    def set_dataset(self, chunks):
+        """Register the job's chunk list.  Idempotent after recovery:
+        if a snapshot already restored state, later set_dataset calls
+        are no-ops (go: initDone guard)."""
+        with self._mu:
+            if self._ready.is_set():
+                return
+            self.todo = partition(chunks, self.chunks_per_task)
+            self._snapshot()
+            self._ready.set()
+
+    @property
+    def ready(self):
+        return self._ready.is_set()
+
+    # -- lease lifecycle ------------------------------------------------
+    def _expire_stale(self):
+        """Lazy lease-timeout sweep (replaces go's AfterFunc timers,
+        :341).  Must hold the lock."""
+        now = self._clock()
+        expired = [t for t in self.pending.values() if t.deadline <= now]
+        for t in expired:
+            self._process_failed(t, t.epoch)
+
+    def _process_failed(self, t, epoch):
+        """go processFailedTask (:313).  Must hold the lock."""
+        cur = self.pending.get(t.task_id)
+        if cur is None or cur.epoch != epoch:
+            return  # stale report: the lease was re-dispatched since
+        del self.pending[t.task_id]
+        t.num_failure += 1
+        if t.num_failure > self.failure_max:
+            self.failed.append(t)
+            # the discard may drain the pass (e.g. the last pending
+            # lease died for good while other tasks already finished);
+            # without this the job would spin in NoMoreAvailable forever
+            self._maybe_roll_pass()
+        else:
+            self.todo.append(t)
+        self._snapshot()
+
+    def _maybe_roll_pass(self):
+        """Pass rollover when todo+pending drain (go TaskFinished :427).
+        Must hold the lock."""
+        if not self.todo and not self.pending and self.done:
+            self.cur_pass += 1
+            self.todo = self.done + self.failed
+            self.done = []
+            self.failed = []
+
+    def get_task(self, pass_id=None):
+        """Lease the next task (go GetTask :368).
+
+        ``pass_id`` is the client's pass counter; None skips the
+        handshake (single-pass jobs).
+
+        Blocks until ``set_dataset`` runs (go GetTask waits on
+        ``<-s.ready``), bounded by ``ready_timeout`` so a misconfigured
+        job errors instead of hanging trainer threads forever."""
+        if not self._ready.wait(timeout=self._ready_timeout):
+            raise RuntimeError("dataset not set; call set_dataset first")
+        with self._mu:
+            self._expire_stale()
+            if pass_id is not None:
+                if pass_id < self.cur_pass:
+                    raise PassBefore(
+                        f"client pass {pass_id} < master {self.cur_pass}")
+                if pass_id > self.cur_pass:
+                    raise PassAfter(
+                        f"client pass {pass_id} > master {self.cur_pass}")
+            if not self.todo:
+                if not self.done and not self.pending:
+                    raise AllTasksFailed("all tasks of this pass failed")
+                raise NoMoreAvailable("todo drained; leases outstanding")
+            t = self.todo.pop(0)
+            t.epoch += 1
+            t.deadline = self._clock() + self.timeout
+            self.pending[t.task_id] = t
+            self._snapshot()
+            return Task(t.task_id, t.chunks, t.epoch, t.num_failure,
+                        t.deadline)
+
+    def task_finished(self, task_id):
+        """go TaskFinished (:411); rolls the pass when drained."""
+        with self._mu:
+            self._expire_stale()
+            t = self.pending.pop(task_id, None)
+            if t is None:
+                return  # late report after timeout requeue: ignore
+            t.num_failure = 0
+            self.done.append(t)
+            self._maybe_roll_pass()
+            self._snapshot()
+
+    def task_failed(self, task_id, epoch):
+        """go TaskFailed (:455), epoch-guarded."""
+        with self._mu:
+            t = self.pending.get(task_id)
+            if t is None:
+                return
+            self._process_failed(t, epoch)
+
+    # -- save-model arbitration (go RequestSaveModel :481) --------------
+    def request_save_model(self, trainer_id, block_secs):
+        """Return True iff *this* trainer should save the checkpoint.
+
+        Conventionally trainer 0 saves, but any trainer can be
+        preempted; the master elects one saver for a ``block_secs``
+        window (python/paddle/v2/master/client.py:38-56)."""
+        if trainer_id is None or trainer_id == "":
+            raise ValueError("trainer id is empty")
+        trainer_id = str(trainer_id)
+        with self._mu:
+            now = self._clock()
+            if self._saving_until <= now:
+                self._saving_trainer = ""
+            need = (self._saving_trainer == "" or
+                    self._saving_trainer == trainer_id)
+            if need:
+                self._saving_trainer = trainer_id
+                self._saving_until = now + block_secs
+            return need
+
+    # -- observability --------------------------------------------------
+    def stats(self):
+        with self._mu:
+            return {"todo": len(self.todo), "pending": len(self.pending),
+                    "done": len(self.done), "failed": len(self.failed),
+                    "cur_pass": self.cur_pass}

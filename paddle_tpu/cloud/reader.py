@@ -1,0 +1,61 @@
+"""master_reader: a reader decorator that pulls data through the
+elastic master's task-lease queue.
+
+Parity: the v2 ``cloud_reader`` pattern — trainers are stateless task
+consumers that lease record chunks from the master and report
+completion/failure (``python/paddle/v2/master/client.py``,
+``go/master/service.go:368 GetTask``).  A trainer that dies mid-task
+simply never reports; the lease times out and another trainer re-reads
+the same chunks, giving at-least-once (exactly-once-ish across passes)
+sample delivery.
+"""
+
+from .master import AllTasksFailed, NoMoreAvailable, PassAfter, PassBefore
+
+__all__ = ["master_reader"]
+
+
+def master_reader(client, chunk_reader, pass_id=None, wait=0.05,
+                  max_waits=2000):
+    """Build a sample reader over leased tasks.
+
+    ``client``: a MasterClient (or MasterService — same surface).
+    ``chunk_reader(chunk) -> iterable of samples`` materializes one
+    opaque chunk descriptor.  The reader ends when the master rolls to
+    the next pass (PassBefore) or the pass's data is exhausted.
+    ``pass_id=None`` reads exactly the master's *current* pass (queried
+    at iteration start) — without pinning a pass the rollover would
+    refill todo and the reader would re-yield the dataset forever.
+    """
+    import time as _time
+
+    def reader():
+        waits = 0
+        pid = pass_id if pass_id is not None else \
+            client.stats()["cur_pass"]
+        while True:
+            try:
+                task = client.get_task(pid)
+            except (PassBefore, AllTasksFailed):
+                return
+            except (NoMoreAvailable, PassAfter):
+                # other trainers hold the remaining leases: wait for
+                # either a timeout-requeue or the pass rollover
+                waits += 1
+                if waits > max_waits:
+                    return
+                _time.sleep(wait)
+                continue
+            waits = 0
+            try:
+                for chunk in task.chunks:
+                    for sample in chunk_reader(chunk):
+                        yield sample
+            except GeneratorExit:
+                raise
+            except Exception:
+                client.task_failed(task.task_id, task.epoch)
+                raise
+            client.task_finished(task.task_id)
+
+    return reader

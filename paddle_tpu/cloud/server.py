@@ -1,0 +1,193 @@
+"""Control-plane RPC for the elastic master: TCP server + client.
+
+Parity: the Go master is a net/rpc service discovered via etcd
+(``go/master/service.go``, ``go/master/client.go``) and consumed from
+Python through cgo bindings (``python/paddle/v2/master/client.py:29``).
+Here the transport is newline-delimited JSON over TCP — control-plane
+only (task leases, barriers, save-model votes); all tensor traffic
+stays on ICI/DCN via XLA collectives, so a heavyweight RPC stack buys
+nothing.
+
+The client retries with backoff on connection failures, mirroring the
+Go client's reconnect-on-error loop: a trainer that outlives a master
+restart keeps working as long as the new master recovered from the same
+Store.
+"""
+
+import json
+import socket
+import socketserver
+import threading
+import time
+
+from .master import (AllTasksFailed, NoMoreAvailable, PassAfter,
+                     PassBefore, Task)
+
+__all__ = ["MasterServer", "MasterClient"]
+
+_ERRORS = {
+    "PassBefore": PassBefore,
+    "PassAfter": PassAfter,
+    "NoMoreAvailable": NoMoreAvailable,
+    "AllTasksFailed": AllTasksFailed,
+}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        svc = self.server.service
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                return
+            try:
+                req = json.loads(line.decode("utf-8"))
+                method = req["method"]
+                args = req.get("args", [])
+                if method == "get_task":
+                    t = svc.get_task(*args)
+                    resp = {"ok": True, "result": t.to_dict()}
+                elif method == "task_finished":
+                    svc.task_finished(*args)
+                    resp = {"ok": True, "result": None}
+                elif method == "task_failed":
+                    svc.task_failed(*args)
+                    resp = {"ok": True, "result": None}
+                elif method == "request_save_model":
+                    resp = {"ok": True,
+                            "result": svc.request_save_model(*args)}
+                elif method == "set_dataset":
+                    svc.set_dataset(*args)
+                    resp = {"ok": True, "result": None}
+                elif method == "stats":
+                    resp = {"ok": True, "result": svc.stats()}
+                elif method == "ping":
+                    resp = {"ok": True, "result": "pong"}
+                else:
+                    resp = {"ok": False, "error": "Unknown",
+                            "message": f"no method {method!r}"}
+            except tuple(_ERRORS.values()) as e:
+                resp = {"ok": False, "error": type(e).__name__,
+                        "message": str(e)}
+            except Exception as e:  # noqa: BLE001 — marshalled to client
+                resp = {"ok": False, "error": "RuntimeError",
+                        "message": f"{type(e).__name__}: {e}"}
+            self.wfile.write(json.dumps(resp).encode("utf-8") + b"\n")
+            self.wfile.flush()
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class MasterServer:
+    """Serve a MasterService on host:port in background threads."""
+
+    def __init__(self, service, host="127.0.0.1", port=0):
+        self.service = service
+        self._srv = _TCPServer((host, port), _Handler)
+        self._srv.service = service
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    @property
+    def address(self):
+        host, port = self._srv.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class MasterClient:
+    """Trainer-side client (python/paddle/v2/master/client.py parity).
+
+    ``get_task``/``task_finished``/``task_failed``/``request_save_model``
+    mirror the cgo client's surface; transient socket errors trigger
+    reconnect+retry so trainers ride out master restarts.
+    """
+
+    def __init__(self, address, timeout=30.0, retry_interval=0.2,
+                 max_retries=50):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self._timeout = timeout
+        self._retry = retry_interval
+        self._max_retries = max_retries
+        self._sock = None
+        self._file = None
+        self._mu = threading.Lock()
+
+    def _connect(self):
+        self.close()
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+        self._file = self._sock.makefile("rwb")
+
+    def _call(self, method, *args):
+        with self._mu:
+            last_err = None
+            for _ in range(self._max_retries):
+                try:
+                    if self._file is None:
+                        self._connect()
+                    payload = json.dumps(
+                        {"method": method, "args": list(args)})
+                    self._file.write(payload.encode("utf-8") + b"\n")
+                    self._file.flush()
+                    line = self._file.readline()
+                    if not line:
+                        raise ConnectionError("master closed connection")
+                    resp = json.loads(line.decode("utf-8"))
+                    if resp["ok"]:
+                        return resp["result"]
+                    exc = _ERRORS.get(resp["error"], RuntimeError)
+                    raise exc(resp.get("message", ""))
+                except (OSError, ConnectionError, json.JSONDecodeError) \
+                        as e:
+                    last_err = e
+                    self.close()
+                    time.sleep(self._retry)
+            raise ConnectionError(
+                f"master at {self._addr} unreachable: {last_err}")
+
+    def get_task(self, pass_id=None):
+        return Task.from_dict(self._call("get_task", pass_id))
+
+    def task_finished(self, task_id):
+        self._call("task_finished", task_id)
+
+    def task_failed(self, task_id, epoch):
+        self._call("task_failed", task_id, epoch)
+
+    def request_save_model(self, trainer_id, block_secs):
+        return self._call("request_save_model", trainer_id, block_secs)
+
+    def set_dataset(self, chunks):
+        self._call("set_dataset", chunks)
+
+    def stats(self):
+        return self._call("stats")
+
+    def ping(self):
+        return self._call("ping")
+
+    def close(self):
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

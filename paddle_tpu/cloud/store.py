@@ -1,0 +1,70 @@
+"""Snapshot stores for the elastic master — the etcd analog.
+
+Parity: ``go/master/etcd_client.go`` (etcd-backed Save/Load under a
+leader lock) and ``go/master/inmem_store.go`` (in-memory Save/Load used
+by the Go unit tests).  Here the durable variant is a file with an
+atomic rename, which is what a single-coordinator TPU job actually
+needs; swapping in a real etcd/consul client only requires implementing
+``save``/``load``.
+"""
+
+import os
+import tempfile
+import threading
+
+__all__ = ["InMemStore", "FileStore"]
+
+
+class InMemStore:
+    """go/master/inmem_store.go parity: process-local snapshot buffer."""
+
+    def __init__(self):
+        self._buf = None
+        self._mu = threading.Lock()
+
+    def save(self, data: bytes):
+        with self._mu:
+            self._buf = data
+
+    def load(self):
+        with self._mu:
+            return self._buf
+
+    def shutdown(self):
+        pass
+
+
+class FileStore:
+    """Durable snapshot store: atomic-rename file writes.
+
+    The recovery contract matches ``go/master/service.go:166`` — a new
+    master process constructed over the same store resumes the previous
+    master's state (current pass, pending leases, failure counts).
+    """
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._mu = threading.Lock()
+
+    def save(self, data: bytes):
+        with self._mu:
+            d = os.path.dirname(self.path) or "."
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, prefix=".master_snap_")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, self.path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+
+    def load(self):
+        with self._mu:
+            if not os.path.exists(self.path):
+                return None
+            with open(self.path, "rb") as f:
+                return f.read()
+
+    def shutdown(self):
+        pass

@@ -71,6 +71,7 @@ class ParallelExecutor:
         self._trainer_id = trainer_id
         self._cache = {}
         self._run_counter = 0
+        self._auto_seed_val = None
         if share_vars_from is not None:
             # parity with PE(share_vars_from=train_exe): same scope object
             self._scope = share_vars_from._actual_scope()
@@ -288,8 +289,7 @@ class ParallelExecutor:
                 for n, s in zip(compiled.state_in, compiled.state_shardings)
             ]
         seed = program.random_seed or 0
-        rng = jax.random.key(
-            np.uint32(seed) if seed else np.random.randint(0, 2**31 - 1))
+        rng = jax.random.key(np.uint32(seed) if seed else self._auto_seed())
         rng = jax.random.fold_in(rng, self._run_counter)
         self._run_counter += 1
 
@@ -300,6 +300,20 @@ class ParallelExecutor:
         if return_numpy:
             fetches = [self._fetch_to_np(f) for f in fetches]
         return fetches
+
+    def _auto_seed(self):
+        """Seed for programs with no explicit random_seed.  Drawn once
+        per executor and, on multi-host jobs, broadcast from process 0:
+        SPMD requires every process to feed the *same* rng key or
+        nominally-replicated state silently diverges across hosts."""
+        if self._auto_seed_val is None:
+            seed = np.random.randint(0, 2**31 - 1)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+                seed = int(multihost_utils.broadcast_one_to_all(
+                    np.int64(seed)))
+            self._auto_seed_val = np.uint32(seed)
+        return self._auto_seed_val
 
     @staticmethod
     def _fetch_to_np(f):

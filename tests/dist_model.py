@@ -1,0 +1,43 @@
+"""Shared model + data for the distributed loss-parity tests.
+
+Both the in-process reference run (test_dist_train.py) and the
+subprocess trainers (dist_runner.py) import THIS module so the two
+sides can never drift apart — the loss-equality assertion is only
+meaningful if they build byte-identical programs and batches.
+"""
+
+import numpy as np
+
+SEED = 21
+BATCH = 16
+STEPS = 6
+IN_DIM = 32
+HIDDEN = 64
+CLASSES = 8
+LR = 0.1
+
+
+def build_model(fluid):
+    """Emit the test model into the default programs; returns loss."""
+    fluid.default_main_program().random_seed = SEED
+    fluid.default_startup_program().random_seed = SEED
+    img = fluid.layers.data("img", shape=[IN_DIM])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(img, size=HIDDEN, act="relu")
+    pred = fluid.layers.fc(h, size=CLASSES, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+    return loss
+
+
+def batches():
+    """Deterministic global batches: [(x, y)] * STEPS."""
+    rng = np.random.RandomState(0)
+    proj = rng.rand(IN_DIM, CLASSES).astype("float32")
+    out = []
+    for _ in range(STEPS):
+        x = rng.rand(BATCH, IN_DIM).astype("float32")
+        y = (x @ proj).argmax(1).astype("int64").reshape(-1, 1)
+        out.append((x, y))
+    return out

@@ -33,17 +33,10 @@ def main():
     assert jax.process_count() == nproc
 
     import numpy as np
+    import dist_model
 
     # same model + data as the single-process reference run in the test
-    fluid.default_main_program().random_seed = 21
-    fluid.default_startup_program().random_seed = 21
-    img = fluid.layers.data("img", shape=[32])
-    label = fluid.layers.data("label", shape=[1], dtype="int64")
-    h = fluid.layers.fc(img, size=64, act="relu")
-    pred = fluid.layers.fc(h, size=8, act=None)
-    loss = fluid.layers.mean(
-        fluid.layers.softmax_with_cross_entropy(pred, label))
-    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    loss = dist_model.build_model(fluid)
 
     # the transpiler-produced sharding plan drives the PE
     t = fluid.DistributeTranspiler()
@@ -56,15 +49,11 @@ def main():
     pe = fluid.ParallelExecutor(loss_name=loss.name, build_strategy=bs,
                                 mesh=mesh)
 
-    rng = np.random.RandomState(0)
-    proj = rng.rand(32, 8).astype("float32")
     losses = []
-    for _ in range(6):
-        x = rng.rand(16, 32).astype("float32")
-        y = (x @ proj).argmax(1).astype("int64").reshape(-1, 1)
-        # local slice: this trainer's half of the global batch
-        lo = pid * (16 // nproc)
-        hi = lo + 16 // nproc
+    for x, y in dist_model.batches():
+        # local slice: this trainer's share of the global batch
+        lo = pid * (dist_model.BATCH // nproc)
+        hi = lo + dist_model.BATCH // nproc
         (lv,) = pe.run(feed={"img": x[lo:hi], "label": y[lo:hi]},
                        fetch_list=[loss])
         losses.append(float(np.asarray(lv).ravel()[0]))

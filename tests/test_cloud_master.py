@@ -1,0 +1,302 @@
+"""Elastic coordinator tests — the Go master's test matrix rebuilt
+(go/master/service_internal_test.go + the fault-tolerance behavior the
+design docs specify: timeout requeue, failure_max, snapshot recover,
+save-model arbitration, dead-consumer recovery)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.cloud import (AllTasksFailed, FileStore, InMemStore,
+                              MasterClient, MasterServer, MasterService,
+                              NoMoreAvailable, PassAfter, PassBefore,
+                              master_reader, partition)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_service(**kw):
+    kw.setdefault("store", InMemStore())
+    kw.setdefault("timeout", 60.0)
+    svc = MasterService(**kw)
+    return svc
+
+
+def test_partition_groups_chunks():
+    tasks = partition(list(range(7)), chunks_per_task=3)
+    assert [t.chunks for t in tasks] == [[0, 1, 2], [3, 4, 5], [6]]
+    assert [t.task_id for t in tasks] == [0, 1, 2]
+
+
+def test_lease_lifecycle_and_pass_rollover():
+    svc = make_service(chunks_per_task=1)
+    svc.set_dataset(["a", "b"])
+    t0 = svc.get_task(0)
+    t1 = svc.get_task(0)
+    with pytest.raises(NoMoreAvailable):
+        svc.get_task(0)
+    svc.task_finished(t0.task_id)
+    svc.task_finished(t1.task_id)
+    # all done => pass rolled, done requeued as todo
+    assert svc.stats() == {"todo": 2, "pending": 0, "done": 0,
+                           "failed": 0, "cur_pass": 1}
+    # pass handshake
+    with pytest.raises(PassBefore):
+        svc.get_task(0)
+    with pytest.raises(PassAfter):
+        svc.get_task(2)
+    t = svc.get_task(1)
+    assert t.chunks in (["a"], ["b"])
+
+
+def test_failure_requeue_until_failure_max():
+    svc = make_service(failure_max=2)
+    svc.set_dataset(["only"])
+    for expected_failures in (1, 2):
+        t = svc.get_task(0)
+        svc.task_failed(t.task_id, t.epoch)
+        assert svc.stats()["todo"] == 1
+        assert svc.todo[0].num_failure == expected_failures
+    # third failure exceeds failure_max=2 -> discarded
+    t = svc.get_task(0)
+    svc.task_failed(t.task_id, t.epoch)
+    assert svc.stats()["failed"] == 1
+    with pytest.raises(AllTasksFailed):
+        svc.get_task(0)
+
+
+def test_pass_rolls_when_last_pending_lease_is_discarded():
+    """A lease that dies for good (num_failure > failure_max) while all
+    other tasks are done must still roll the pass — otherwise every
+    trainer spins in NoMoreAvailable forever."""
+    svc = make_service(failure_max=0)
+    svc.set_dataset(["good", "bad"])
+    ta = svc.get_task(0)
+    tb = svc.get_task(0)
+    svc.task_finished(ta.task_id)
+    svc.task_failed(tb.task_id, tb.epoch)   # failure_max=0: discard
+    st = svc.stats()
+    assert st["cur_pass"] == 1 and st["todo"] == 2
+
+
+def test_timeout_requeues_lease_with_epoch_guard():
+    clk = FakeClock()
+    svc = make_service(timeout=10.0, clock=clk)
+    svc.set_dataset(["x"])
+    t = svc.get_task(0)
+    clk.advance(11.0)   # lease expires
+    t2 = svc.get_task(0)  # sweep requeues, then re-leases
+    assert t2.task_id == t.task_id and t2.epoch == t.epoch + 1
+    # a stale failure report from the dead consumer must be ignored
+    svc.task_failed(t.task_id, t.epoch)
+    assert svc.stats()["pending"] == 1
+    svc.task_finished(t2.task_id)
+    assert svc.stats()["cur_pass"] == 1
+
+
+def test_late_finish_after_timeout_is_ignored():
+    clk = FakeClock()
+    svc = make_service(timeout=10.0, clock=clk)
+    svc.set_dataset(["x", "y"])
+    t = svc.get_task(0)
+    clk.advance(11.0)
+    svc.task_finished(t.task_id)  # sweep expires it first; finish is late
+    st = svc.stats()
+    assert st["done"] == 0 and st["todo"] == 2 and st["pending"] == 0
+
+
+def test_snapshot_recover_preserves_leases_and_deadlines(tmp_path):
+    clk = FakeClock()
+    store = FileStore(tmp_path / "snap.json")
+    svc = MasterService(store=store, timeout=30.0, clock=clk)
+    svc.set_dataset(["a", "b", "c"])
+    ta = svc.get_task(0)
+    svc.task_finished(ta.task_id)
+    tb = svc.get_task(0)
+
+    # master dies; new master over the same store (go recover :166)
+    svc2 = MasterService(store=store, timeout=30.0, clock=clk)
+    assert svc2.ready  # set_dataset not needed after recovery
+    st = svc2.stats()
+    assert st == {"todo": 1, "pending": 1, "done": 1, "failed": 0,
+                  "cur_pass": 0}
+    # the recovered lease keeps its ORIGINAL deadline: advancing past it
+    # requeues tb even though the granting master is gone
+    clk.advance(31.0)
+    ids = {svc2.get_task(0).task_id, svc2.get_task(0).task_id}
+    assert tb.task_id in ids
+
+
+def test_set_dataset_idempotent_after_recovery(tmp_path):
+    store = FileStore(tmp_path / "snap.json")
+    svc = MasterService(store=store)
+    svc.set_dataset(["a"])
+    t = svc.get_task(0)
+    svc2 = MasterService(store=store)
+    svc2.set_dataset(["a"])  # must NOT reset the in-flight lease
+    assert svc2.stats()["pending"] == 1
+    svc2.task_finished(t.task_id)
+    assert svc2.stats()["cur_pass"] == 1
+
+
+def test_request_save_model_single_saver():
+    clk = FakeClock()
+    svc = make_service(clock=clk)
+    svc.set_dataset(["x"])
+    assert svc.request_save_model("trainer-3", 10.0) is True
+    assert svc.request_save_model("trainer-0", 10.0) is False
+    assert svc.request_save_model("trainer-3", 10.0) is True  # re-ask ok
+    clk.advance(11.0)  # window expired: next asker wins
+    assert svc.request_save_model("trainer-0", 10.0) is True
+    with pytest.raises(ValueError):
+        svc.request_save_model("", 1.0)
+
+
+def test_tcp_server_client_roundtrip_and_dead_consumer():
+    svc = MasterService(store=InMemStore(), timeout=0.5)
+    svc.set_dataset([[i] for i in range(4)])
+    server = MasterServer(svc).start()
+    try:
+        c = MasterClient(server.address)
+        assert c.ping() == "pong"
+        # consumer 1 leases a task and "dies" (never reports)
+        dead = c.get_task(0)
+        # consumer 2 drains everything else
+        c2 = MasterClient(server.address)
+        got = []
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                t = c2.get_task(0)
+            except NoMoreAvailable:
+                time.sleep(0.1)   # waiting for the dead lease to expire
+                continue
+            got.append(t.task_id)
+            c2.task_finished(t.task_id)
+            if svc.stats()["cur_pass"] == 1:
+                break
+        assert svc.stats()["cur_pass"] == 1
+        assert dead.task_id in got  # requeued lease completed by c2
+        c.close()
+        c2.close()
+    finally:
+        server.shutdown()
+
+
+def test_get_task_blocks_until_dataset_registered():
+    svc = MasterService(store=InMemStore(), ready_timeout=5.0)
+    import threading
+    result = {}
+
+    def late_consumer():
+        result["task"] = svc.get_task(0)
+
+    th = threading.Thread(target=late_consumer)
+    th.start()
+    time.sleep(0.2)
+    svc.set_dataset(["x"])          # arrives after the consumer asked
+    th.join(timeout=5)
+    assert result["task"].chunks == ["x"]
+
+    fast = MasterService(store=InMemStore(), ready_timeout=0.05)
+    with pytest.raises(RuntimeError):
+        fast.get_task(0)            # bounded wait, then a clear error
+
+
+def test_master_reader_default_pass_reads_exactly_one_pass():
+    svc = MasterService(store=InMemStore(), timeout=5.0)
+    svc.set_dataset([[0], [1]])
+
+    def chunk_reader(chunk):
+        return iter(chunk)
+
+    # pass_id=None pins the current pass: one full epoch, then stop
+    assert sorted(master_reader(svc, chunk_reader)()) == [0, 1]
+    assert svc.stats()["cur_pass"] == 1
+    assert sorted(master_reader(svc, chunk_reader)()) == [0, 1]
+    assert svc.stats()["cur_pass"] == 2
+
+
+def test_master_reader_yields_all_samples():
+    svc = MasterService(store=InMemStore(), timeout=5.0)
+    chunks = [{"lo": 0, "hi": 3}, {"lo": 3, "hi": 7}]
+    svc.set_dataset(chunks)
+
+    def chunk_reader(chunk):
+        return iter(range(chunk["lo"], chunk["hi"]))
+
+    reader = master_reader(svc, chunk_reader, pass_id=0)
+    assert sorted(reader()) == list(range(7))
+    assert svc.stats()["cur_pass"] == 1
+
+
+WORKER_SRC = r"""
+import sys, time
+from paddle_tpu.cloud import MasterClient, NoMoreAvailable, PassBefore, \
+    AllTasksFailed
+addr, mode = sys.argv[1], sys.argv[2]
+c = MasterClient(addr)
+if mode == "hang":          # lease one task, then hang until killed
+    t = c.get_task(0)
+    print("LEASED", t.task_id, flush=True)
+    time.sleep(600)
+else:                        # drain
+    done = []
+    while True:
+        try:
+            t = c.get_task(0)
+        except (PassBefore, AllTasksFailed):
+            break
+        except NoMoreAvailable:
+            time.sleep(0.1)
+            continue
+        done.append(t.task_id)
+        c.task_finished(t.task_id)
+        if c.stats()["cur_pass"] >= 1:
+            break
+    print("DONE", *done, flush=True)
+"""
+
+
+def test_subprocess_worker_killed_midtask_job_completes(tmp_path):
+    """Fault injection with a real OS process (test_dist_base.py pattern:
+    kill via signal, assert the surviving worker finishes the pass)."""
+    svc = MasterService(store=InMemStore(), timeout=1.0)
+    svc.set_dataset([[i] for i in range(3)])
+    server = MasterServer(svc).start()
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER_SRC)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo_root)
+    try:
+        hanger = subprocess.Popen(
+            [sys.executable, str(worker_py), server.address, "hang"],
+            stdout=subprocess.PIPE, text=True, env=env)
+        line = hanger.stdout.readline()
+        assert line.startswith("LEASED")
+        leased_id = int(line.split()[1])
+        hanger.send_signal(signal.SIGKILL)
+        hanger.wait(timeout=10)
+
+        drainer = subprocess.run(
+            [sys.executable, str(worker_py), server.address, "drain"],
+            stdout=subprocess.PIPE, text=True, env=env, timeout=60)
+        finished = [int(x) for x in
+                    drainer.stdout.strip().split()[1:]]
+        assert svc.stats()["cur_pass"] == 1
+        assert leased_id in finished
+    finally:
+        server.shutdown()

@@ -23,26 +23,15 @@ def _free_port():
 
 
 def _single_process_reference():
-    """Same model/data as dist_runner.py on the in-process 8-device mesh."""
-    fluid.default_main_program().random_seed = 21
-    fluid.default_startup_program().random_seed = 21
-    img = fluid.layers.data("img", shape=[32])
-    label = fluid.layers.data("label", shape=[1], dtype="int64")
-    h = fluid.layers.fc(img, size=64, act="relu")
-    pred = fluid.layers.fc(h, size=8, act=None)
-    loss = fluid.layers.mean(
-        fluid.layers.softmax_with_cross_entropy(pred, label))
-    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    """Same model/data as dist_runner.py (shared via dist_model)."""
+    import dist_model
 
+    loss = dist_model.build_model(fluid)
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
 
-    rng = np.random.RandomState(0)
-    proj = rng.rand(32, 8).astype("float32")
     losses = []
-    for _ in range(6):
-        x = rng.rand(16, 32).astype("float32")
-        y = (x @ proj).argmax(1).astype("int64").reshape(-1, 1)
+    for x, y in dist_model.batches():
         (lv,) = exe.run(feed={"img": x, "label": y}, fetch_list=[loss])
         losses.append(float(np.asarray(lv).ravel()[0]))
     return losses

@@ -48,6 +48,8 @@ from .transpiler import (
     memory_optimize,
     release_memory,
 )
+from . import cloud
+from .flags import set_flags, get_flags
 
 __version__ = "0.1.0"
 
@@ -62,5 +64,5 @@ __all__ = [
     "BuildStrategy", "ExecutionStrategy", "make_mesh", "reader",
     "dataset", "batch", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "InferenceTranspiler",
-    "memory_optimize", "release_memory",
+    "memory_optimize", "release_memory", "cloud", "set_flags", "get_flags",
 ]

@@ -94,7 +94,10 @@ class MasterService:
     """Single-coordinator task-lease service (go/master/service.go:140)."""
 
     def __init__(self, store=None, chunks_per_task=1, timeout=60.0,
-                 failure_max=3, clock=time.monotonic, ready_timeout=10.0):
+                 failure_max=3, clock=time.time, ready_timeout=10.0):
+        # NOTE: the clock must be WALL time, not monotonic — lease
+        # deadlines are persisted in the snapshot and must stay
+        # comparable after a master restart on a rebooted/different host
         from .store import InMemStore
 
         self.store = store or InMemStore()

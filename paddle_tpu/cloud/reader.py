@@ -33,10 +33,19 @@ def master_reader(client, chunk_reader, pass_id=None, wait=0.05,
         waits = 0
         pid = pass_id if pass_id is not None else \
             client.stats()["cur_pass"]
+        consumed = 0
         while True:
             try:
                 task = client.get_task(pid)
-            except (PassBefore, AllTasksFailed):
+            except PassBefore:
+                if pass_id is None and consumed == 0:
+                    # the pass rolled between our stats() probe and the
+                    # first lease: re-pin to the new current pass rather
+                    # than silently yielding an empty epoch
+                    pid = client.stats()["cur_pass"]
+                    continue
+                return
+            except AllTasksFailed:
                 return
             except (NoMoreAvailable, PassAfter):
                 # other trainers hold the remaining leases: wait for
@@ -47,6 +56,7 @@ def master_reader(client, chunk_reader, pass_id=None, wait=0.05,
                 _time.sleep(wait)
                 continue
             waits = 0
+            consumed += 1
             try:
                 for chunk in task.chunks:
                     for sample in chunk_reader(chunk):

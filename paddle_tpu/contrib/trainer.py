@@ -20,6 +20,7 @@ from ..framework import Program, default_main_program, \
     default_startup_program, program_guard
 from ..optimizer import Optimizer
 from ..parallel import ParallelExecutor
+from ..profiler import RecordEvent
 from ..scope import Scope, scope_guard
 
 __all__ = [
@@ -188,10 +189,13 @@ class Trainer:
                     event_handler(begin)
                     fetch = [v.name for v in self.train_func_outputs] \
                         if begin.fetch_metrics else []
-                    metrics = run(feeder.feed(data), fetch)
-                    metrics = [np.asarray(m) for m in metrics]
+                    with RecordEvent("trainer/step"):
+                        metrics = run(feeder.feed(data), fetch)
+                        metrics = [np.asarray(m) for m in metrics]
                     event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                    self._maybe_save_checkpoint(ckpt_exe, epoch_id, step_id)
+                    with RecordEvent("trainer/checkpoint"):
+                        self._maybe_save_checkpoint(ckpt_exe, epoch_id,
+                                                    step_id)
                 event_handler(EndEpochEvent(epoch_id))
 
     def test(self, reader, feed_order=None):

@@ -25,13 +25,15 @@ TPU-first:
   remaining reproducible (replaces the reference's per-op seed attrs).
 """
 
+import time
+
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
-from . import registry
+from . import flags, registry
 from .framework import Program, Variable, default_main_program
+from .profiler import RecordEvent
 from .registry import ComputeContext
 from .scope import Scope, global_scope
 
@@ -250,10 +252,14 @@ class Executor:
         key = self._program_key(program, feed_sig, fetch_names, scope)
         compiled = self._cache.get(key)
         if compiled is None:
-            state_names, writeback = self._analyze(program, feed_names, scope)
-            compiled = self._lower(
-                program, feed_names, state_names, writeback, fetch_names
-            )
+            # the reference wraps op instantiation in RecordBlock
+            # (executor.cc Prepare); here the analog is the trace+jit
+            with RecordEvent("executor/compile"):
+                state_names, writeback = self._analyze(
+                    program, feed_names, scope)
+                compiled = self._lower(
+                    program, feed_names, state_names, writeback, fetch_names
+                )
             self._cache[key] = compiled
 
         dev = self.place.jax_device()
@@ -267,14 +273,42 @@ class Executor:
         rng = jax.random.fold_in(rng, self._run_counter)
         self._run_counter += 1
 
-        with jax.default_device(dev):
-            fetches, new_state = compiled.fn(
-                [jax.device_put(v, dev) for v in feed_vals], state_vals, rng
-            )
+        t0 = time.perf_counter() if flags.flag("benchmark") else None
+        with RecordEvent("executor/run"):
+            with jax.default_device(dev):
+                fetches, new_state = compiled.fn(
+                    [jax.device_put(v, dev) for v in feed_vals], state_vals,
+                    rng
+                )
 
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
 
+        if flags.flag("check_nan_inf"):
+            _check_finite(zip(compiled.fetch_names, fetches))
+            _check_finite(zip(compiled.state_out, new_state))
+        if t0 is not None:
+            jax.block_until_ready(new_state if new_state else fetches)
+            print("[benchmark] step %.3f ms"
+                  % ((time.perf_counter() - t0) * 1e3))
+
         if return_numpy:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
+
+
+def _check_finite(named_vals):
+    """FLAGS_check_nan_inf parity (operator.cc:31,717): verify every
+    floating output of the step; raise naming the first bad variable."""
+    from .core import bfloat16
+
+    for name, v in named_vals:
+        a = np.asarray(v)
+        if bfloat16 is not None and a.dtype == bfloat16:
+            a = a.astype(np.float32)  # np.isfinite lacks a bf16 loop
+        if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+            bad = "nan" if np.isnan(a).any() else "inf"
+            raise RuntimeError(
+                "check_nan_inf: variable %r contains %s after step "
+                "(enable FLAGS_debug_nans to localize the producing op)"
+                % (name, bad))

@@ -31,8 +31,9 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .. import registry  # noqa: F401  (op registry must be loaded)
-from ..executor import trace_program, Executor
+from .. import flags, registry  # noqa: F401  (op registry must be loaded)
+from ..executor import trace_program, Executor, _check_finite
+from ..profiler import RecordEvent
 from ..framework import Variable, default_main_program
 from ..scope import global_scope
 from .mesh import make_mesh, AXIS_DP
@@ -261,8 +262,9 @@ class ParallelExecutor:
                self._build_strategy.feed_sharding_fn)
         compiled = self._cache.get(key)
         if compiled is None:
-            compiled = self._compile(program, feed_names, fetch_names, scope,
-                                     feed_vals)
+            with RecordEvent("parallel_executor/compile"):
+                compiled = self._compile(program, feed_names, fetch_names,
+                                         scope, feed_vals)
             self._cache[key] = compiled
 
         multihost = jax.process_count() > 1
@@ -293,10 +295,16 @@ class ParallelExecutor:
         rng = jax.random.fold_in(rng, self._run_counter)
         self._run_counter += 1
 
-        fetches, new_state = compiled.fn(feed_dev, state_dev, rng)
+        with RecordEvent("parallel_executor/run"):
+            fetches, new_state = compiled.fn(feed_dev, state_dev, rng)
 
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
+        if flags.flag("check_nan_inf"):
+            # fetches only: state may span hosts (not fully addressable).
+            # Convert once and reuse for the return value.
+            fetches = [self._fetch_to_np(f) for f in fetches]
+            _check_finite(zip(compiled.fetch_names, fetches))
         if return_numpy:
             fetches = [self._fetch_to_np(f) for f in fetches]
         return fetches

@@ -1,0 +1,121 @@
+"""Profiler wiring + numeric-debugging flag tests (SURVEY §5: tracing,
+race/numeric debugging).  The reference wraps every op run in RecordEvent
+(operator.cc:153) and exports chrome traces (tools/timeline.py); here the
+executor step/compile and trainer step are the spanned units, and
+FLAGS_check_nan_inf raises on non-finite step outputs (operator.cc:717)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+
+
+def _build_mlp():
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.fc(x, size=3, act="relu")
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_executor_spans_appear_in_chrome_trace(tmp_path, fresh_programs):
+    loss = _build_mlp()
+    path = str(tmp_path / "trace.json")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.rand(8, 4).astype("float32")
+    with profiler.profiler("All", profile_path=path):
+        for _ in range(3):
+            exe.run(feed={"x": x}, fetch_list=[loss])
+    trace = json.load(open(path))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "executor/compile" in names
+    assert names.count("executor/run") == 3
+    for e in trace["traceEvents"]:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_record_event_outside_profiler_is_dropped(fresh_programs):
+    profiler.reset_profiler()
+    with profiler.RecordEvent("unprofiled"):
+        pass
+    with profiler._events_lock:
+        assert not profiler._events
+
+
+def test_check_nan_inf_catches_injected_nan(fresh_programs):
+    x = fluid.layers.data("x", shape=[2])
+    out = fluid.layers.log(x)          # log(-1) -> nan
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        exe.run(feed={"x": np.ones((2, 2), "float32")}, fetch_list=[out])
+        with pytest.raises(RuntimeError, match="contains nan"):
+            exe.run(feed={"x": -np.ones((2, 2), "float32")},
+                    fetch_list=[out])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+    # flag off: silently returns the nan (reference default behavior)
+    (v,) = exe.run(feed={"x": -np.ones((2, 2), "float32")},
+                   fetch_list=[out])
+    assert np.isnan(v).all()
+
+
+def test_check_nan_inf_names_state_var(fresh_programs):
+    x = fluid.layers.data("x", shape=[2])
+    h = fluid.layers.fc(x, size=2, act=None)
+    loss = fluid.layers.mean(fluid.layers.log(h))
+    fluid.optimizer.SGD(learning_rate=1e30).minimize(loss)  # diverges
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="check_nan_inf"):
+            for _ in range(5):
+                exe.run(feed={"x": np.random.rand(4, 2).astype("float32")},
+                        fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_flags_api_roundtrip_and_unknown():
+    fluid.set_flags({"FLAGS_benchmark": True})
+    assert fluid.get_flags("FLAGS_benchmark")["FLAGS_benchmark"] is True
+    fluid.set_flags({"benchmark": False})   # bare spelling accepted
+    assert fluid.get_flags(["benchmark"])["benchmark"] is False
+    with pytest.raises(KeyError):
+        fluid.set_flags({"FLAGS_no_such_flag": 1})
+    with pytest.raises(KeyError):
+        fluid.get_flags("nope")
+
+
+def test_trainer_step_spans(tmp_path, fresh_programs):
+    from paddle_tpu.contrib import Trainer
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[4])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(x, size=2, act="softmax")
+        return fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+
+    def optimizer_func():
+        return fluid.optimizer.SGD(learning_rate=0.01)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            yield rng.rand(4).astype("float32"), np.array([1], "int64")
+
+    trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                      optimizer_func=optimizer_func)
+    path = str(tmp_path / "t.json")
+    with profiler.profiler(profile_path=path):
+        trainer.train(num_epochs=1, event_handler=lambda e: None,
+                      reader=fluid.batch(reader, batch_size=2),
+                      feed_order=["x", "label"])
+    names = [e["name"] for e in json.load(open(path))["traceEvents"]]
+    assert names.count("trainer/step") == 2
+    assert "executor/run" in names

@@ -6,3 +6,4 @@ from .trainer import (  # noqa: F401
     BeginEpochEvent, EndEpochEvent, BeginStepEvent, EndStepEvent,
 )
 from .inferencer import Inferencer  # noqa: F401
+from . import mixed_precision  # noqa: F401

@@ -1,0 +1,149 @@
+"""Automatic mixed precision (bf16) — the TPU rebuild of the reference's
+fp16 story (``contrib/float16/float16_transpiler.py``: rewrites a program
+to fp16 by inserting casts and retyping vars).
+
+TPU-first redesign: bfloat16 is the MXU's native input format and shares
+float32's exponent range, so — unlike fp16 on GPUs — **no loss scaling is
+required** and there is no transpiler pass inserting cast ops into the
+program.  Instead the policy is applied at *trace time*: ops on the white
+list (the MXU-bound FLOPs: matmuls/convs) compute in bf16, ops on the
+black list (numerically sensitive: losses, norms, optimizer updates)
+compute in fp32, everything else follows its inputs' promotion.  Master
+weights stay fp32 automatically: parameters live fp32 in the scope and
+only their *use* inside whitelisted ops is cast, while the (blacklisted)
+optimizer ops update the fp32 originals.
+
+API parity targets: ``fluid.contrib.mixed_precision.decorate(optimizer)``
+and the float16 transpiler's program rewrite
+(``contrib/float16/float16_transpiler.py``); ``init_loss_scaling`` is
+accepted for signature parity and ignored (bf16 needs none — documented
+SURVEY.md §2.6 float16 demo row).
+"""
+
+import jax.numpy as jnp
+
+from ..core import bfloat16
+
+__all__ = ["AutoMixedPrecisionLists", "AMPPolicy", "decorate",
+           "bf16_program_guard", "cast_parameters_to_bf16"]
+
+
+class AutoMixedPrecisionLists:
+    """White/black op lists (the reference AMP concept; the float16
+    transpiler's implicit op partition made explicit)."""
+
+    # MXU-bound: cast fp32 inputs to bf16
+    WHITE = {
+        "matmul", "mul", "conv2d", "conv3d", "depthwise_conv2d",
+        "conv2d_transpose", "bilinear_tensor_product",
+    }
+    # numerically sensitive: force fp32 compute
+    BLACK = {
+        "softmax_with_cross_entropy", "cross_entropy", "mean",
+        "reduce_sum", "reduce_mean", "layer_norm", "batch_norm",
+        "group_norm", "lrn", "norm", "exp", "log", "softmax",
+        "log_softmax", "sigmoid_cross_entropy_with_logits",
+        # optimizer updates read/write fp32 master weights
+        "sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
+        "rmsprop", "ftrl", "decayed_adagrad", "proximal_gd",
+        "proximal_adagrad", "sum", "clip_by_norm", "squared_l2_norm",
+        "isfinite",
+    }
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = (set(self.WHITE) | set(custom_white_list or ())) \
+            - set(custom_black_list or ())
+        self.black_list = (set(self.BLACK) | set(custom_black_list or ())) \
+            - set(custom_white_list or ())
+
+
+class AMPPolicy:
+    """Trace-time dtype policy consulted by registry.compute_op."""
+
+    def __init__(self, amp_lists=None):
+        self.lists = amp_lists or AutoMixedPrecisionLists()
+
+    def cast_inputs(self, op_type, ins):
+        """Return ``ins`` with float32<->bf16 casts applied per the lists.
+        Grad ops follow their forward op's color (the generic auto-vjp
+        grad re-runs the forward, so the same cast yields the same
+        bf16 compute in the backward pass)."""
+        if bfloat16 is None:  # pragma: no cover - ml_dtypes always present
+            return ins
+        base = op_type[:-5] if op_type.endswith("_grad") else op_type
+        if base in self.lists.white_list:
+            target, source = jnp.bfloat16, jnp.float32
+        elif base in self.lists.black_list:
+            target, source = jnp.float32, jnp.bfloat16
+        else:
+            return ins
+        out = {}
+        for slot, vals in ins.items():
+            out[slot] = [
+                v.astype(target)
+                if hasattr(v, "dtype") and v.dtype == source else v
+                for v in vals
+            ]
+        return out
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=1.0,
+             use_dynamic_loss_scaling=False):
+    """Wrap an optimizer so that ``minimize(loss)`` marks the loss's
+    program for bf16 mixed-precision execution.
+
+    ``init_loss_scaling``/``use_dynamic_loss_scaling`` are accepted for
+    API parity with the GPU fp16 recipe and ignored: bf16 keeps fp32's
+    exponent range, so gradients cannot underflow the way fp16's do.
+    """
+
+    class _AMPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+            self._amp_policy = AMPPolicy(amp_lists)
+
+        def minimize(self, loss, startup_program=None, **kw):
+            result = self._inner.minimize(
+                loss, startup_program=startup_program, **kw)
+            loss.block.program._amp_policy = self._amp_policy
+            return result
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    return _AMPOptimizer(optimizer)
+
+
+class bf16_program_guard:
+    """Context manager marking ``program`` for bf16 execution without an
+    optimizer — the inference-side analog of the float16 transpiler
+    (``float16_transpiler.py`` rewrites inference programs)."""
+
+    def __init__(self, program, amp_lists=None):
+        self.program = program
+        self.policy = AMPPolicy(amp_lists)
+        self._prior = None
+
+    def __enter__(self):
+        self._prior = getattr(self.program, "_amp_policy", None)
+        self.program._amp_policy = self.policy
+        return self.program
+
+    def __exit__(self, *exc):
+        self.program._amp_policy = self._prior
+        return False
+
+
+def cast_parameters_to_bf16(program, scope):
+    """Hard-cast persistable fp32 params in ``scope`` to bf16 — the
+    float16 transpiler's var-retyping path, for inference deployments
+    that want bf16 weights at rest (half the HBM footprint)."""
+    import numpy as np
+
+    for var in program.global_block().vars.values():
+        if not getattr(var, "persistable", False):
+            continue
+        if scope.has_var(var.name):
+            v = scope.var(var.name)
+            if hasattr(v, "dtype") and np.dtype(v.dtype) == np.float32:
+                scope.set_var(var.name, jnp.asarray(v, dtype=jnp.bfloat16))

@@ -133,6 +133,7 @@ def trace_program(program, feed_names, state_names, writeback, fetch_names):
         env.update(zip(state_in, state_vals))
         ctx = ComputeContext(key=key)
         ctx.program = program
+        ctx.amp = getattr(program, '_amp_policy', None)
         for i, op in enumerate(ops):
             registry.compute_op(op, env, ctx, op_index=i)
         fetches = [env[n] for n in fetch_names]
@@ -169,8 +170,11 @@ class Executor:
         # program._version bumps on structural mutation (op append/insert,
         # rename_var) so stale compiled functions are not reused; direct
         # attr edits on existing ops are NOT tracked — clone() instead.
+        # the policy object itself goes in the key (kept alive by the
+        # cache) — id() could alias a recycled address after GC
         return (id(program), program._version, program.random_seed, feed_sig,
-                tuple(fetch_names), id(scope))
+                tuple(fetch_names), id(scope),
+                getattr(program, '_amp_policy', None))
 
     def _analyze(self, program, feed_names, scope):
         """Split program vars into feeds / state-from-scope / temporaries."""

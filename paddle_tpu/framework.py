@@ -406,6 +406,8 @@ class Program:
         self._op_role_stack = []
         # fingerprint cache for executor compile caching
         self._version = 0
+        # trace-time mixed-precision policy (contrib.mixed_precision)
+        self._amp_policy = None
 
     # ---- block management --------------------------------------------------
     def global_block(self):

@@ -37,6 +37,9 @@ __all__ = [
     "elementwise_sub",
     "elementwise_mul",
     "elementwise_div",
+    "nce",
+    "hsigmoid",
+    "bilinear_tensor_product",
 ]
 
 
@@ -408,3 +411,80 @@ def clip_by_norm(x, max_norm, name=None):
         attrs={"max_norm": float(max_norm)},
     )
     return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=None, name=None):
+    """Noise-contrastive estimation loss (reference nn.py:3968 /
+    nce_op.cc): per-sample cost [B, 1] over the true classes plus
+    ``num_neg_samples`` uniform negatives."""
+    helper = LayerHelper("nce", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    num_true = label.shape[-1] if len(label.shape) > 1 else 1
+    num_neg = int(num_neg_samples) if num_neg_samples else 10
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=input.dtype)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[num_total_classes, 1],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    logits = helper.create_variable_for_type_inference(input.dtype)
+    labels_out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [logits],
+                 "SampleLabels": [labels_out]},
+        attrs={"num_total_classes": int(num_total_classes),
+               "num_neg_samples": num_neg, "num_true": int(num_true)})
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None):
+    """Hierarchical sigmoid loss over a complete binary tree (reference
+    nn.py:4065 / hierarchical_sigmoid_op.cc): per-sample cost [B, 1]."""
+    helper = LayerHelper("hsigmoid", input=input, param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[num_classes - 1, dim],
+                                dtype=input.dtype)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[num_classes - 1, 1],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="hierarchical_sigmoid", inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"num_classes": int(num_classes)})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x . W_k . y (reference bilinear_tensor_product_op.cc)."""
+    helper = LayerHelper("bilinear_tensor_product", input=x,
+                         param_attr=param_attr, bias_attr=bias_attr,
+                         act=act, name=name)
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[size, dx, dy], dtype=x.dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(attr=helper.bias_attr, shape=[1, size],
+                                    dtype=x.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)

@@ -33,6 +33,18 @@ __all__ = [
     "padding_attn_bias",
     "padding_mask",
     "row_conv",
+    "linear_chain_crf",
+    "crf_decoding",
+    "chunk_eval",
+    "warpctc",
+    "ctc_greedy_decoder",
+    "edit_distance",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_reshape",
+    "sequence_expand_as",
+    "sequence_scatter",
+    "im2sequence",
 ]
 
 
@@ -336,4 +348,252 @@ def padding_mask(length, ref, dtype="float32", name=None):
         outputs={"Out": [out]}, attrs={"dtype": dtype})
     out.stop_gradient = True
     out._seq_len_name = None
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """Linear-chain CRF cost (reference nn.py:850 / linear_chain_crf_op.cc).
+
+    ``input``: [B, T, D] padded emissions (lod_level=1 data or RNN/fc
+    output); ``label``: [B, T, 1] int64 gold tags.  Creates the
+    [D+2, D] transition parameter (rows: start, end, D tag->tag rows)
+    and returns the per-sequence negative log-likelihood [B, 1] —
+    ``mean()`` of it is the training cost, as in the reference's
+    label_semantic_roles config.
+    """
+    helper = LayerHelper("linear_chain_crf", input=input,
+                         param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size],
+        dtype=helper.input_dtype())
+    log_likelihood = helper.create_variable_for_type_inference(
+        helper.input_dtype())
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input],
+                "Length": [_len_of(helper, input, length)],
+                "Transition": [transition], "Label": [label]},
+        outputs={"LogLikelihood": [log_likelihood]})
+    log_likelihood._seq_len_name = None
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None):
+    """Viterbi decode (reference crf_decoding_op.cc).  With ``label``,
+    returns the per-position correctness mask instead of the path
+    (crf_decoding_op.h:61)."""
+    helper = LayerHelper("crf_decoding", input=input, param_attr=param_attr)
+    # the transition parameter was created by linear_chain_crf under
+    # param_attr.name — look it up rather than re-creating it
+    transition = helper.main_program.global_block()._find_var_recursive(
+        helper.param_attr.name)
+    if transition is None:
+        raise ValueError(
+            "crf_decoding: transition parameter %r not found; pass the "
+            "same param_attr used by linear_chain_crf"
+            % helper.param_attr.name)
+    path = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input],
+              "Length": [_len_of(helper, input, length)],
+              "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [path]})
+    path.stop_gradient = True
+    path._seq_len_name = getattr(input, "_seq_len_name", None)
+    return path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, length=None):
+    """Chunk precision/recall/F1 (reference chunk_eval_op.cc).  Returns
+    (precision, recall, f1, num_infer, num_label, num_correct)."""
+    helper = LayerHelper("chunk_eval", input=input)
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1 = helper.create_variable_for_type_inference("float32")
+    num_infer = helper.create_variable_for_type_inference("int64")
+    num_label = helper.create_variable_for_type_inference("int64")
+    num_correct = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="chunk_eval",
+        inputs={"Inference": [input], "Label": [label],
+                "Length": [_len_of(helper, input, length)]},
+        outputs={"Precision": [precision], "Recall": [recall],
+                 "F1-Score": [f1], "NumInferChunks": [num_infer],
+                 "NumLabelChunks": [num_label],
+                 "NumCorrectChunks": [num_correct]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": int(num_chunk_types),
+               "excluded_chunk_types": list(excluded_chunk_types or [])})
+    for v in (precision, recall, f1, num_infer, num_label, num_correct):
+        v.stop_gradient = True
+        v._seq_len_name = None
+    return precision, recall, f1, num_infer, num_label, num_correct
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    """CTC loss (reference warpctc_op.cc, nn.py warpctc): ``input`` is
+    [B, T, num_classes+1] unscaled logits (padded sequence), ``label``
+    [B, U, 1] int tokens.  Returns per-sequence loss [B, 1]."""
+    helper = LayerHelper("warpctc", input=input)
+    loss = helper.create_variable_for_type_inference(helper.input_dtype())
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input],
+                "LogitsLength": [_len_of(helper, input, input_length)],
+                "Label": [label],
+                "LabelLength": [_len_of(helper, label, label_length)]},
+        outputs={"Loss": [loss]},
+        attrs={"blank": int(blank), "norm_by_times": bool(norm_by_times)})
+    loss._seq_len_name = None
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Greedy CTC decode (reference nn.py ctc_greedy_decoder =
+    argmax + ctc_align): merge repeated tokens, drop blanks."""
+    helper = LayerHelper("ctc_greedy_decoder", input=input, name=name)
+    # argmax over classes
+    from .tensor import argmax  # local import to avoid cycles
+    best = argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference("int64")
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="ctc_align",
+        inputs={"Input": [best],
+                "Length": [_len_of(helper, input, input_length)]},
+        outputs={"Output": [out], "OutputLength": [out_len]},
+        attrs={"blank": int(blank), "merge_repeated": True})
+    out.stop_gradient = True
+    out._seq_len_name = out_len.name
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per sequence pair (reference
+    edit_distance_op.cc, nn.py edit_distance).  Returns (distance [B,1]
+    float32, sequence_num [1] int64)."""
+    helper = LayerHelper("edit_distance", input=input)
+    if ignored_tokens:
+        input = sequence_erase(input, tokens=list(ignored_tokens),
+                               length=input_length)
+        label = sequence_erase(label, tokens=list(ignored_tokens),
+                               length=label_length)
+        input_length = label_length = None
+    out = helper.create_variable_for_type_inference("float32")
+    seq_num = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="edit_distance",
+        inputs={"Hyps": [input],
+                "HypsLength": [_len_of(helper, input, input_length)],
+                "Refs": [label],
+                "RefsLength": [_len_of(helper, label, label_length)]},
+        outputs={"Out": [out], "SequenceNum": [seq_num]},
+        attrs={"normalized": bool(normalized)})
+    out.stop_gradient = True
+    seq_num.stop_gradient = True
+    out._seq_len_name = None
+    seq_num._seq_len_name = None
+    return out, seq_num
+
+
+def sequence_pad(x, pad_value=None, maxlen=None, name=None, length=None):
+    """Pad a sequence batch to dense [B, T, ...] (reference
+    sequence_pad_op.cc).  Returns (out, lengths[int64])."""
+    helper = LayerHelper("sequence_pad", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    seq_len = helper.create_variable_for_type_inference("int64")
+    inputs = {"X": [x], "Length": [_len_of(helper, x, length)]}
+    if pad_value is not None:
+        inputs["PadValue"] = [pad_value]
+    helper.append_op(
+        type="sequence_pad", inputs=inputs,
+        outputs={"Out": [out], "SeqLength": [seq_len]},
+        attrs={"padded_length": int(maxlen) if maxlen else -1})
+    out._seq_len_name = None          # dense output
+    seq_len.stop_gradient = True
+    return out, seq_len
+
+
+def sequence_unpad(x, length, name=None):
+    """Dense [B, T, ...] + lengths -> sequence batch (reference
+    sequence_unpad_op.cc)."""
+    helper = LayerHelper("sequence_unpad", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_unpad", inputs={"X": [x], "Length": [length]},
+        outputs={"Out": [out], "OutLength": [out_len]})
+    out._seq_len_name = out_len.name
+    return out
+
+
+def sequence_reshape(input, new_dim, length=None):
+    """Re-chunk each sequence to rows of ``new_dim`` (reference
+    sequence_reshape_op.cc)."""
+    helper = LayerHelper("sequence_reshape", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_reshape",
+        inputs={"X": [input], "Length": [_len_of(helper, input, length)]},
+        outputs={"Out": [out], "OutLength": [out_len]},
+        attrs={"new_dim": int(new_dim)})
+    out._seq_len_name = out_len.name
+    return out
+
+
+def sequence_expand_as(x, y, name=None, y_length=None):
+    """Repeat row i of ``x`` to y's sequence-i length (reference
+    sequence_expand_as_op.cc)."""
+    helper = LayerHelper("sequence_expand_as", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_expand_as",
+        inputs={"X": [x], "Y": [y],
+                "YLength": [_len_of(helper, y, y_length)]},
+        outputs={"Out": [out], "OutLength": [out_len]})
+    out._seq_len_name = out_len.name
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None, length=None):
+    """Scatter-add update sequences into dense rows (reference
+    sequence_scatter_op.cc)."""
+    helper = LayerHelper("sequence_scatter", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_scatter",
+        inputs={"X": [input], "Ids": [index], "Updates": [updates],
+                "Length": [_len_of(helper, index, length)]},
+        outputs={"Out": [out]})
+    out._seq_len_name = None
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+    """Image -> patch sequence (reference im2sequence_op.cc)."""
+    helper = LayerHelper("im2sequence", input=input, name=name)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    elif len(padding) == 2:
+        padding = list(padding) * 2
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="im2sequence", inputs={"X": [input]},
+        outputs={"Out": [out], "OutLength": [out_len]},
+        attrs={"kernels": list(filter_size), "strides": list(stride),
+               "paddings": list(padding)})
+    out._seq_len_name = out_len.name
     return out

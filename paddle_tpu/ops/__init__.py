@@ -10,6 +10,8 @@ from . import (  # noqa: F401
     control_flow,
     conv,
     creation,
+    crf,
+    ctc,
     elementwise,
     loss,
     manipulation,
@@ -18,7 +20,9 @@ from . import (  # noqa: F401
     norm,
     optimizer_ops,
     pool,
+    quantize,
     random,
+    sampled_loss,
     reduction,
     rnn,
     selected_rows,

@@ -1,0 +1,202 @@
+"""CTC family: warpctc loss, ctc_align, edit_distance.
+
+Parity: reference ``operators/warpctc_op.{cc,h}`` (dynloaded warp-ctc
+library over LoD sequences), ``ctc_align_op.{cc,cu}`` (merge repeated
+then drop blanks), ``edit_distance_op.{cc,cu}`` (Levenshtein over LoD
+label pairs).
+
+TPU-first redesign: no external warp-ctc — the CTC forward-backward is
+the standard extended-label (blank-interleaved) alpha recursion in log
+space as a ``lax.scan`` over time, ``vmap`` over the batch; gradients
+fall out of auto-vjp of that recursion (warp-ctc's hand-written beta
+pass is unnecessary under autodiff).  Sequences are padded ``[B, T, C]``
+logits and ``[B, U]`` labels with explicit lengths.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+_NEG_INF = -1e30
+
+
+# -- warpctc ----------------------------------------------------------------
+
+def _ctc_loss_single(logits, t_len, label, u_len, blank):
+    """CTC NLL of one sequence: logits [T, C], label [U] int32."""
+    t_max, _ = logits.shape
+    u_max = label.shape[0]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+
+    s_max = 2 * u_max + 1
+    # extended label: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((s_max,), blank, dtype=jnp.int32)
+    ext = ext.at[1::2].set(label.astype(jnp.int32))
+    s_idx = jnp.arange(s_max)
+    # skip-transition allowed at odd s (labels) when label != previous label
+    prev_lbl = jnp.concatenate(
+        [jnp.array([-1], jnp.int32), label[:-1].astype(jnp.int32)])
+    can_skip = jnp.zeros((s_max,), bool).at[1::2].set(
+        label.astype(jnp.int32) != prev_lbl)
+
+    s_eff = 2 * u_len + 1                       # true extended length
+    valid_s = s_idx < s_eff
+
+    alpha0 = jnp.full((s_max,), _NEG_INF)
+    alpha0 = alpha0.at[0].set(logp[0, blank])
+    alpha0 = jnp.where((s_idx == 1) & (u_len > 0),
+                       logp[0, ext[1]], alpha0)
+
+    def step(alpha, inp):
+        lp_t, valid_t = inp
+        a1 = jnp.concatenate([jnp.array([_NEG_INF]), alpha[:-1]])
+        a2 = jnp.concatenate([jnp.full((2,), _NEG_INF), alpha[:-2]])
+        a2 = jnp.where(can_skip, a2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        nxt = merged + lp_t[ext]
+        nxt = jnp.where(valid_s, nxt, _NEG_INF)
+        alpha = jnp.where(valid_t, nxt, alpha)
+        return alpha, None
+
+    t_valid = jnp.arange(1, t_max) < t_len
+    alpha, _ = lax.scan(step, alpha0, (logp[1:], t_valid))
+    final = jnp.logaddexp(alpha[jnp.maximum(s_eff - 1, 0)],
+                          jnp.where(u_len > 0,
+                                    alpha[jnp.maximum(s_eff - 2, 0)],
+                                    _NEG_INF))
+    return -final
+
+
+def _warpctc_infer(op, block):
+    x = in_var(op, block, "Logits")
+    set_output(op, block, "Loss", (x.shape[0], 1), x.dtype)
+
+
+def _warpctc_compute(ins, attrs, ctx, op_index):
+    logits = ins["Logits"][0]                   # [B, T, C]
+    logits_len = ins["LogitsLength"][0]
+    label = ins["Label"][0]
+    if label.ndim == 3:
+        label = label[:, :, 0]
+    label_len = ins["LabelLength"][0]
+    blank = int(attrs.get("blank", 0))
+    loss = jax.vmap(_ctc_loss_single, in_axes=(0, 0, 0, 0, None))(
+        logits.astype(jnp.float32), logits_len, label, label_len, blank)
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(logits_len, 1).astype(loss.dtype)
+    return {"Loss": loss[:, None]}
+
+
+register_op(
+    "warpctc", ["Logits", "LogitsLength", "Label", "LabelLength"],
+    ["Loss"],
+    infer=_warpctc_infer, compute=_warpctc_compute,
+    no_grad_inputs=("LogitsLength", "Label", "LabelLength"),
+)
+
+
+# -- ctc_align --------------------------------------------------------------
+
+def _ctc_align_infer(op, block):
+    x = in_var(op, block, "Input")
+    set_output(op, block, "Output", x.shape, x.dtype, lod_level=1)
+    set_output(op, block, "OutputLength", (x.shape[0],), "int32")
+
+
+def _ctc_align_compute(ins, attrs, ctx, op_index):
+    x = ins["Input"][0]                          # [B, T] or [B, T, 1]
+    squeeze = x.ndim == 3
+    if squeeze:
+        x = x[:, :, 0]
+    length = ins["Length"][0]
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    t_max = x.shape[1]
+    valid = jnp.arange(t_max)[None, :] < length[:, None]
+    prev = jnp.concatenate([jnp.full((x.shape[0], 1), -1, x.dtype),
+                            x[:, :-1]], axis=1)
+    keep = (x != blank) & valid
+    if merge:
+        keep = keep & (x != prev)
+    # stable compaction: target position = exclusive cumsum of keep;
+    # dropped tokens scatter to the out-of-bounds slot (mode="drop")
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    b_idx = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None], x.shape)
+    out = jnp.zeros_like(x).at[
+        b_idx, jnp.where(keep, pos, t_max)].set(x, mode="drop")
+    new_len = jnp.sum(keep.astype(jnp.int32), axis=1)
+    if squeeze:
+        out = out[:, :, None]
+    return {"Output": out, "OutputLength": new_len}
+
+
+register_op(
+    "ctc_align", ["Input", "Length"], ["Output", "OutputLength"],
+    infer=_ctc_align_infer, compute=_ctc_align_compute, grad=None,
+)
+
+
+# -- edit_distance ----------------------------------------------------------
+
+def _edit_distance_single(hyp, h_len, ref, r_len):
+    """Levenshtein DP; returns distance at (h_len, r_len)."""
+    u1 = hyp.shape[0]
+    u2 = ref.shape[0]
+    row0 = jnp.arange(u2 + 1, dtype=jnp.float32)
+
+    def outer(row, inp):
+        i, h_tok = inp
+
+        def inner(left, inp2):
+            j, up, upleft, r_tok = inp2
+            cost = jnp.where(h_tok == r_tok, 0.0, 1.0)
+            d = jnp.minimum(jnp.minimum(up + 1.0, left + 1.0),
+                            upleft + cost)
+            return d, d
+
+        j_idx = jnp.arange(1, u2 + 1)
+        _, rest = lax.scan(
+            inner, i.astype(jnp.float32),
+            (j_idx, row[1:], row[:-1], ref))
+        new_row = jnp.concatenate([i.astype(jnp.float32)[None], rest])
+        return new_row, new_row
+
+    i_idx = jnp.arange(1, u1 + 1)
+    _, rows = lax.scan(outer, row0, (i_idx, hyp))
+    table = jnp.concatenate([row0[None], rows])   # [U1+1, U2+1]
+    return table[h_len, r_len]
+
+
+def _edit_distance_infer(op, block):
+    h = in_var(op, block, "Hyps")
+    set_output(op, block, "Out", (h.shape[0], 1), "float32")
+    set_output(op, block, "SequenceNum", (1,), "int64")
+
+
+def _edit_distance_compute(ins, attrs, ctx, op_index):
+    hyps = ins["Hyps"][0]
+    refs = ins["Refs"][0]
+    if hyps.ndim == 3:
+        hyps = hyps[:, :, 0]
+    if refs.ndim == 3:
+        refs = refs[:, :, 0]
+    h_len = ins["HypsLength"][0]
+    r_len = ins["RefsLength"][0]
+    d = jax.vmap(_edit_distance_single)(hyps, h_len, refs, r_len)
+    if attrs.get("normalized", True):
+        d = d / jnp.maximum(r_len, 1).astype(d.dtype)
+    n = jnp.asarray([hyps.shape[0]], dtype=jnp.int64)
+    return {"Out": d[:, None], "SequenceNum": n}
+
+
+register_op(
+    "edit_distance", ["Hyps", "HypsLength", "Refs", "RefsLength"],
+    ["Out", "SequenceNum"],
+    infer=_edit_distance_infer, compute=_edit_distance_compute, grad=None,
+)

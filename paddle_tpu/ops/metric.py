@@ -100,3 +100,78 @@ register_op(
     ),
     compute=_mean_iou_compute, grad=None,
 )
+
+
+# -- precision_recall -------------------------------------------------------
+
+def _pr_metrics(states):
+    """ComputeMetrics (precision_recall_op.h:124): macro/micro P, R, F1
+    from per-class [C, 4] TP/FP/TN/FN counts."""
+    tp, fp, fn = states[:, 0], states[:, 1], states[:, 3]
+
+    def calc(num, den_extra):
+        any_ = (num > 0) | (den_extra > 0)
+        return jnp.where(any_, num / jnp.maximum(num + den_extra, 1e-20),
+                         1.0)
+
+    prec = calc(tp, fp)
+    rec = calc(tp, fn)
+    macro_p = jnp.mean(prec)
+    macro_r = jnp.mean(rec)
+
+    def f1(p, r):
+        return jnp.where((p > 0) | (r > 0),
+                         2 * p * r / jnp.maximum(p + r, 1e-20), 0.0)
+
+    t_tp, t_fp, t_fn = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    micro_p = calc(t_tp, t_fp)
+    micro_r = calc(t_tp, t_fn)
+    return jnp.stack([macro_p, macro_r, f1(macro_p, macro_r),
+                      micro_p, micro_r, f1(micro_p, micro_r)])
+
+
+def _precision_recall_infer(op, block):
+    c = int(op.attrs["class_number"])
+    set_output(op, block, "BatchMetrics", (6,), "float32")
+    set_output(op, block, "AccumMetrics", (6,), "float32")
+    set_output(op, block, "AccumStatesInfo", (c, 4), "float32")
+
+
+def _precision_recall_compute(ins, attrs, ctx, op_index):
+    """Streaming multiclass precision/recall (precision_recall_op.h:54-98):
+    per-sample TP/FP/TN/FN scatter, batch metrics from this batch's
+    counts, accumulated metrics after merging StatesInfo."""
+    ids = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    labels = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    ws = ins.get("Weights")
+    w = ws[0].reshape(-1) if ws and ws[0] is not None else \
+        jnp.ones(ids.shape, jnp.float32)
+    c = int(attrs["class_number"])
+
+    correct = ids == labels
+    batch = jnp.zeros((c, 4), jnp.float32)
+    # TP[idx] += w where correct
+    batch = batch.at[ids, 0].add(jnp.where(correct, w, 0.0))
+    # FP[idx] += w ; FN[label] += w where wrong
+    batch = batch.at[ids, 1].add(jnp.where(correct, 0.0, w))
+    batch = batch.at[labels, 3].add(jnp.where(correct, 0.0, w))
+    # TN: every class gets +w per sample, minus the involved classes
+    batch = batch.at[:, 2].add(jnp.sum(w))
+    batch = batch.at[ids, 2].add(-w)
+    batch = batch.at[labels, 2].add(jnp.where(correct, 0.0, -w))
+
+    states = ins.get("StatesInfo")
+    prev = states[0] if states and states[0] is not None else None
+    accum = batch if prev is None else batch + prev
+    return {"BatchMetrics": _pr_metrics(batch),
+            "AccumMetrics": _pr_metrics(accum),
+            "AccumStatesInfo": accum}
+
+
+register_op(
+    "precision_recall", ["MaxProbs", "Indices", "Labels", "Weights",
+                         "StatesInfo"],
+    ["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+    infer=_precision_recall_infer, compute=_precision_recall_compute,
+    grad=None,
+)

@@ -1,0 +1,130 @@
+"""Fake quantization ops for quantization-aware training.
+
+Parity: reference ``operators/fake_quantize_op.cc`` (fake_quantize_abs_max,
+fake_quantize_range_abs_max) and ``operators/fake_dequantize_op.cc``
+(fake_dequantize_max_abs).  Quantize-dequantize in one op ("fake"): the
+tensor stays float but carries int8-grid rounding error, so training
+learns quantization-robust weights.
+
+TPU-first notes: gradients use the straight-through estimator (identity
+through the rounding), implemented as a custom grad instead of the
+reference's GradOpDescMaker pair; the range_abs_max sliding window
+collapses to a running max state var (window bookkeeping is host-side
+bookkeeping the XLA graph does not need — the max over the window is
+what the quantizer consumes).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var
+from ..framework import grad_var_name
+
+__all__ = []
+
+
+def _quant_range(bit_length):
+    return float((1 << (int(bit_length) - 1)) - 1)
+
+
+def _abs_max_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+    set_output(op, block, "OutScale", (1,), x.dtype)
+
+
+def _abs_max_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    rng = _quant_range(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x)).reshape(1)
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.round(x / scale * rng)
+    q = jnp.clip(q, -rng, rng)
+    return {"Out": q * scale / rng, "OutScale": scale}
+
+
+def _ste_grad_infer(op, block):
+    g = in_var(op, block, "GRAD::Out")
+    set_output(op, block, "GRAD::X", g.shape, g.dtype)
+
+
+register_op(
+    "ste_identity_grad", ["GRAD::Out"], ["GRAD::X"],
+    infer=_ste_grad_infer,
+    compute=lambda ins, attrs, ctx, op_index: {
+        "GRAD::X": ins["GRAD::Out"][0]},
+    grad=None,
+)
+
+
+def _quant_grad_maker(op, no_grad_set):
+    """Straight-through estimator: dL/dX = dL/dOut (identity through
+    the rounding), the standard QAT gradient."""
+    x_name = op.inputs["X"][0]
+    if x_name in no_grad_set:
+        return []
+    out_name = op.outputs["Out"][0]
+    return [{
+        "type": "ste_identity_grad",
+        "inputs": {"GRAD::Out": [grad_var_name(out_name)]},
+        "outputs": {"GRAD::X": [grad_var_name(x_name)]},
+        "attrs": {},
+    }]
+
+
+register_op(
+    "fake_quantize_abs_max", ["X"], ["Out", "OutScale"],
+    infer=_abs_max_infer, compute=_abs_max_compute,
+    grad=_quant_grad_maker,
+)
+
+
+def _range_abs_max_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+    set_output(op, block, "OutScale", (1,), x.dtype)
+
+
+def _range_abs_max_compute(ins, attrs, ctx, op_index):
+    """Running-max variant: in training the scale is
+    max(current |x|_max, InScale) — the monotone envelope of the
+    reference's window max; in test mode InScale is used as-is."""
+    x = ins["X"][0]
+    in_scales = ins.get("InScale")
+    in_scale = in_scales[0] if in_scales and in_scales[0] is not None \
+        else jnp.zeros((1,), x.dtype)
+    rng = _quant_range(attrs.get("bit_length", 8))
+    if attrs.get("is_test", False) or ctx.is_test:
+        scale = jnp.maximum(in_scale.reshape(1), 1e-12)
+    else:
+        cur = jnp.max(jnp.abs(x)).reshape(1)
+        scale = jnp.maximum(jnp.maximum(cur, in_scale.reshape(1)), 1e-12)
+    q = jnp.clip(jnp.round(x / scale * rng), -rng, rng)
+    return {"Out": q * scale / rng, "OutScale": scale}
+
+
+register_op(
+    "fake_quantize_range_abs_max", ["X", "InScale"], ["Out", "OutScale"],
+    infer=_range_abs_max_infer, compute=_range_abs_max_compute,
+    grad=_quant_grad_maker, no_grad_inputs=("InScale",),
+)
+
+
+def _dequant_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _dequant_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    max_range = float(attrs["max_range"])
+    return {"Out": x * scale.reshape(()) / max_range}
+
+
+register_op(
+    "fake_dequantize_max_abs", ["X", "Scale"], ["Out"],
+    infer=_dequant_infer, compute=_dequant_compute,
+    no_grad_inputs=("Scale",),
+)

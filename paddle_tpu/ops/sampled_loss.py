@@ -1,0 +1,160 @@
+"""Sampled / tree-structured classification losses: nce,
+hierarchical_sigmoid, bilinear_tensor_product.
+
+Parity: reference ``operators/nce_op.{cc,h}`` (NCE with uniform negative
+sampling; cost -log(o/(o+b)) for true and -log(b/(o+b)) for sampled
+classes, b = num_neg/num_classes, nce_op.h:94-135),
+``operators/hierarchical_sigmoid_op.{cc,h}`` + ``math/matrix_bit_code.cc``
+(complete-binary-tree sigmoid path loss via SimpleCode bit arithmetic),
+``operators/bilinear_tensor_product_op.cc``.
+
+TPU-first: the per-element Eigen loops become batched gathers + einsums;
+negative samples are drawn from the trace-time PRNG key (deterministic
+per step, so the auto-vjp recompute sees identical samples).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+
+# -- nce --------------------------------------------------------------------
+
+def _nce_infer(op, block):
+    x = in_var(op, block, "Input")
+    label = in_var(op, block, "Label")
+    num_true = label.shape[1] if len(label.shape) > 1 and \
+        label.shape[1] not in (-1, None) else 1
+    num_neg = int(op.attrs.get("num_neg_samples", 10))
+    set_output(op, block, "Cost", (x.shape[0], 1), x.dtype)
+    set_output(op, block, "SampleLogits",
+               (x.shape[0], num_true + num_neg), x.dtype)
+    set_output(op, block, "SampleLabels",
+               (x.shape[0], num_true + num_neg), "int64")
+
+
+def _nce_compute(ins, attrs, ctx, op_index):
+    x = ins["Input"][0]                       # [B, D]
+    label = ins["Label"][0]                   # [B, num_true]
+    if label.ndim == 1:
+        label = label[:, None]
+    weight = ins["Weight"][0]                 # [C, D]
+    biases = ins.get("Bias")
+    bias = biases[0] if biases and biases[0] is not None else None
+    sw = ins.get("SampleWeight")
+    sample_weight = sw[0] if sw and sw[0] is not None else None
+    num_classes = int(attrs["num_total_classes"])
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    b_const = float(num_neg) / num_classes    # nce_op.h:94
+
+    bsz, num_true = x.shape[0], label.shape[1]
+    key = ctx.rng_key(op_index)
+    negs = jax.random.randint(key, (bsz, num_neg), 0, num_classes)
+    samples = jnp.concatenate([label.astype(jnp.int32),
+                               negs.astype(jnp.int32)], axis=1)
+
+    w_rows = weight[samples]                  # [B, S, D]
+    logits = jnp.einsum("bd,bsd->bs", x, w_rows)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+    o = jax.nn.sigmoid(logits)
+    eps = 1e-12
+    cost_true = -jnp.log(o[:, :num_true] /
+                         (o[:, :num_true] + b_const) + eps)
+    cost_neg = -jnp.log(b_const / (o[:, num_true:] + b_const) + eps)
+    cost = jnp.sum(cost_true, 1) + jnp.sum(cost_neg, 1)
+    if sample_weight is not None:
+        cost = cost * sample_weight.reshape(-1)
+    return {"Cost": cost[:, None], "SampleLogits": o,
+            "SampleLabels": samples.astype(jnp.int64)}
+
+
+register_op(
+    "nce", ["Input", "Label", "Weight", "Bias", "SampleWeight"],
+    ["Cost", "SampleLogits", "SampleLabels"],
+    infer=_nce_infer, compute=_nce_compute,
+    no_grad_inputs=("Label", "SampleWeight"), stateful_random=True,
+)
+
+
+# -- hierarchical_sigmoid ---------------------------------------------------
+
+def _hsigmoid_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", (x.shape[0], 1), x.dtype)
+
+
+def _hsigmoid_compute(ins, attrs, ctx, op_index):
+    """SimpleCode tree (math/matrix_bit_code.h): for label l the code is
+    c = l + num_classes; path node j has row index (c >> (len-j)) - 1
+    and target bit (c >> (len-1-j)) & 1, where len = floor(log2(c)).
+    Loss = sum_j BCE-with-logits(x.w_j + b_j, bit_j)."""
+    x = ins["X"][0]                           # [B, D]
+    w = ins["W"][0]                           # [C-1, D]
+    label = ins["Label"][0].reshape(-1)       # [B]
+    biases = ins.get("Bias")
+    bias = biases[0] if biases and biases[0] is not None else None
+    num_classes = int(attrs["num_classes"])
+    max_len = int(np.ceil(np.log2(max(num_classes, 2))))
+
+    code = label.astype(jnp.int32) + num_classes  # [B]
+    # floor(log2(code)): code < 2*num_classes <= 2^(max_len+1)
+    clen = (jnp.floor(jnp.log2(code.astype(jnp.float32) + 0.5))
+            ).astype(jnp.int32)
+
+    j = jnp.arange(max_len + 1)[None, :]      # [1, J]
+    active = j < clen[:, None]                # [B, J]
+    shift_idx = jnp.maximum(clen[:, None] - j, 0)
+    node = jnp.right_shift(code[:, None], shift_idx) - 1
+    node = jnp.clip(node, 0, w.shape[0] - 1)
+    bit_shift = jnp.maximum(clen[:, None] - 1 - j, 0)
+    bit = jnp.bitwise_and(jnp.right_shift(code[:, None], bit_shift), 1)
+
+    w_rows = w[node]                          # [B, J, D]
+    pre = jnp.einsum("bd,bjd->bj", x, w_rows)
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[node]
+    # BCE with logits, target = bit
+    losses = jax.nn.softplus(pre) - bit.astype(pre.dtype) * pre
+    out = jnp.sum(jnp.where(active, losses, 0.0), axis=1)
+    return {"Out": out[:, None]}
+
+
+register_op(
+    "hierarchical_sigmoid", ["X", "W", "Label", "Bias"], ["Out"],
+    infer=_hsigmoid_infer, compute=_hsigmoid_compute,
+    no_grad_inputs=("Label",),
+)
+
+
+# -- bilinear_tensor_product ------------------------------------------------
+
+def _btp_infer(op, block):
+    x = in_var(op, block, "X")
+    w = in_var(op, block, "Weight")
+    set_output(op, block, "Out", (x.shape[0], w.shape[0]), x.dtype)
+
+
+def _btp_compute(ins, attrs, ctx, op_index):
+    """out[b, k] = x[b] . W[k] . y[b] (+ bias[k])
+    (bilinear_tensor_product_op.cc) — one einsum on the MXU."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    w = ins["Weight"][0]                      # [K, Dx, Dy]
+    biases = ins.get("Bias")
+    bias = biases[0] if biases and biases[0] is not None else None
+    out = jnp.einsum("bi,kij,bj->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    return {"Out": out}
+
+
+register_op(
+    "bilinear_tensor_product", ["X", "Y", "Weight", "Bias"], ["Out"],
+    infer=_btp_infer, compute=_btp_compute,
+)

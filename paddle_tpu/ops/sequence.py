@@ -458,3 +458,195 @@ def _padding_mask_compute(ins, attrs, ctx, op_index):
 register_op("padding_mask", ["Length", "Ref"], ["Out"],
             infer=_padding_mask_infer, compute=_padding_mask_compute,
             grad=None)
+
+
+# -- sequence_pad (reference sequence_pad_op.cc: LoD seq -> padded dense) ----
+
+def _seq_pad_infer(op, block):
+    x = in_var(op, block, "X")
+    maxlen = op.attrs.get("padded_length", -1)
+    t = maxlen if maxlen and maxlen > 0 else x.shape[1]
+    set_output(op, block, "Out", (x.shape[0], t) + tuple(x.shape[2:]),
+               x.dtype)
+    set_output(op, block, "SeqLength", (x.shape[0],), "int64")
+
+
+def _seq_pad_compute(ins, attrs, ctx, op_index):
+    """Our sequences are already padded arrays; padding re-materializes
+    the tail with ``pad_value`` and (optionally) re-times to
+    ``padded_length`` (sequence_pad_op.cc contract: output is dense,
+    plus the original lengths)."""
+    x, length = ins["X"][0], ins["Length"][0]
+    pad_value = ins["PadValue"][0] if ins.get("PadValue") and \
+        ins["PadValue"][0] is not None else jnp.zeros((), x.dtype)
+    t_in = x.shape[1]
+    target = int(attrs.get("padded_length", -1))
+    if target <= 0:
+        target = t_in
+    if target > t_in:
+        pad_widths = [(0, 0), (0, target - t_in)] + \
+            [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pad_widths)
+    elif target < t_in:
+        x = x[:, :target]
+    mask = _time_mask(length, target, x.ndim - 2)
+    out = jnp.where(mask, x, jnp.asarray(pad_value, x.dtype))
+    return {"Out": out, "SeqLength": length.astype(jnp.int64)}
+
+
+register_op("sequence_pad", ["X", "Length", "PadValue"],
+            ["Out", "SeqLength"],
+            infer=_seq_pad_infer, compute=_seq_pad_compute,
+            no_grad_inputs=("Length", "PadValue"))
+
+
+# -- sequence_unpad (reference sequence_unpad_op.cc: dense -> LoD seq) -------
+
+def _seq_unpad_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype, lod_level=1)
+
+
+def _seq_unpad_compute(ins, attrs, ctx, op_index):
+    """Dense [B,T,...] + lengths -> padded-sequence representation: the
+    data is unchanged, the tail is zeroed so downstream masked ops see
+    canonical padding."""
+    x, length = ins["X"][0], ins["Length"][0]
+    mask = _time_mask(length, x.shape[1], x.ndim - 2)
+    return {"Out": jnp.where(mask, x, 0), "OutLength":
+            length.astype(jnp.int32)}
+
+
+register_op("sequence_unpad", ["X", "Length"], ["Out", "OutLength"],
+            infer=_seq_unpad_infer, compute=_seq_unpad_compute,
+            no_grad_inputs=("Length",))
+
+
+# -- sequence_reshape (reference sequence_reshape_op.cc) ---------------------
+
+def _seq_reshape_infer(op, block):
+    x = in_var(op, block, "X")
+    new_dim = int(op.attrs["new_dim"])
+    d = x.shape[-1]
+    t = x.shape[1]
+    new_t = -1 if t in (-1, None) or d in (-1, None) \
+        else (t * d) // new_dim
+    set_output(op, block, "Out", (x.shape[0], new_t, new_dim), x.dtype,
+               lod_level=1)
+
+
+def _seq_reshape_compute(ins, attrs, ctx, op_index):
+    """Per-sequence reshape: each sequence's len*D elements re-chunk to
+    rows of ``new_dim`` (len*D must divide).  On padded batches this is
+    a plain reshape because sequences are time-contiguous and the tail
+    is zeros."""
+    x, length = ins["X"][0], ins["Length"][0]
+    b, t, d = x.shape
+    new_dim = int(attrs["new_dim"])
+    out = x.reshape(b, (t * d) // new_dim, new_dim)
+    new_len = (length * d) // new_dim
+    return {"Out": out, "OutLength": new_len.astype(jnp.int32)}
+
+
+register_op("sequence_reshape", ["X", "Length"], ["Out", "OutLength"],
+            infer=_seq_reshape_infer, compute=_seq_reshape_compute,
+            no_grad_inputs=("Length",))
+
+
+# -- sequence_expand_as (reference sequence_expand_as_op.cc) -----------------
+
+def _seq_expand_as_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    set_output(op, block, "Out", (x.shape[0], y.shape[1]) +
+               tuple(x.shape[1:]), x.dtype, lod_level=1)
+
+
+def _seq_expand_as_compute(ins, attrs, ctx, op_index):
+    """Row i of X repeats to Y's sequence-i length: [B, D] + Y lengths
+    -> [B, Ty, D] (zeros past each length)."""
+    x = ins["X"][0]
+    y_len = ins["YLength"][0]
+    t = ins["Y"][0].shape[1]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    mask = _time_mask(y_len, t, out.ndim - 2)
+    return {"Out": jnp.where(mask, out, 0),
+            "OutLength": y_len.astype(jnp.int32)}
+
+
+register_op("sequence_expand_as", ["X", "Y", "YLength"],
+            ["Out", "OutLength"],
+            infer=_seq_expand_as_infer, compute=_seq_expand_as_compute,
+            no_grad_inputs=("Y", "YLength"))
+
+
+# -- sequence_scatter (reference sequence_scatter_op.cc) ---------------------
+
+def _seq_scatter_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _seq_scatter_compute(ins, attrs, ctx, op_index):
+    """out[b, ids[b, u]] += updates[b, u] for u < len(b): per-sequence
+    scatter-add of update sequences into dense rows (the reference adds
+    sequence i's updates into X row i)."""
+    x = ins["X"][0]                               # [B, D]
+    ids = ins["Ids"][0]
+    upd = ins["Updates"][0]
+    if ids.ndim == 3:
+        ids = ids[:, :, 0]
+    if upd.ndim == 3:
+        upd = upd[:, :, 0]
+    length = ins["Length"][0]
+    u_max = ids.shape[1]
+    valid = jnp.arange(u_max)[None, :] < length[:, None]
+    b_idx = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None], ids.shape)
+    safe_ids = jnp.where(valid, ids, x.shape[-1])   # OOB -> dropped
+    return {"Out": x.at[b_idx, safe_ids].add(
+        jnp.where(valid, upd, 0).astype(x.dtype), mode="drop")}
+
+
+register_op("sequence_scatter", ["X", "Ids", "Updates", "Length"],
+            ["Out"],
+            infer=_seq_scatter_infer, compute=_seq_scatter_compute,
+            no_grad_inputs=("Ids", "Length"))
+
+
+# -- im2sequence (reference im2sequence_op.cc / math/im2col) -----------------
+
+def _im2sequence_infer(op, block):
+    x = in_var(op, block, "X")
+    b, c, h, w = x.shape
+    kh, kw = op.attrs["kernels"]
+    sh, sw = op.attrs.get("strides", [1, 1])
+    p = op.attrs.get("paddings", [0, 0, 0, 0])
+    if h in (-1, None) or w in (-1, None):
+        t = -1
+    else:
+        oh = (h + p[0] + p[2] - kh) // sh + 1
+        ow = (w + p[1] + p[3] - kw) // sw + 1
+        t = oh * ow
+    d = None if c in (-1, None) else c * kh * kw
+    set_output(op, block, "Out", (b, t, d), x.dtype, lod_level=1)
+
+
+def _im2sequence_compute(ins, attrs, ctx, op_index):
+    """[B, C, H, W] -> [B, oh*ow, C*kh*kw] patch sequence; every batch
+    item has the same length oh*ow (im2sequence_op.cc semantics)."""
+    x = ins["X"][0]
+    kh, kw = attrs["kernels"]
+    sh, sw = attrs.get("strides", [1, 1])
+    p = attrs.get("paddings", [0, 0, 0, 0])
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        [(p[0], p[2]), (p[1], p[3])],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    b, d, oh, ow = patches.shape
+    out = patches.reshape(b, d, oh * ow).transpose(0, 2, 1)
+    lengths = jnp.full((b,), oh * ow, jnp.int32)
+    return {"Out": out, "OutLength": lengths}
+
+
+register_op("im2sequence", ["X"], ["Out", "OutLength"],
+            infer=_im2sequence_infer, compute=_im2sequence_compute)

@@ -257,7 +257,8 @@ class ParallelExecutor:
         # policy fns go in the key as objects (kept alive by the cache, so
         # no id()-reuse aliasing after GC)
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               id(scope), self._build_strategy.reduce_strategy,
+               id(scope), getattr(program, '_amp_policy', None),
+               self._build_strategy.reduce_strategy,
                self._build_strategy.param_sharding_fn,
                self._build_strategy.feed_sharding_fn)
         compiled = self._cache.get(key)

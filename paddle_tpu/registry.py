@@ -49,6 +49,7 @@ class ComputeContext:
     def __init__(self, key=None, is_test=False):
         self._key = key
         self.is_test = is_test
+        self.amp = None  # AMPPolicy (contrib.mixed_precision) or None
 
     def rng_key(self, op_index):
         if self._key is None:
@@ -140,6 +141,8 @@ def compute_op(op, env, ctx, op_index=0):
             else:
                 vals.append(env[n])
         ins[slot] = vals
+    if ctx.amp is not None:
+        ins = ctx.amp.cast_inputs(op.type, ins)
     outs = d.compute(ins, op.attrs, ctx, op_index)
     for slot, names in op.outputs.items():
         vals = outs.get(slot)
@@ -275,8 +278,11 @@ def _generic_grad_compute(ins, attrs, ctx, op_index):
         gslot = "GRAD::" + slot
         if gslot in ins and ins[gslot]:
             gvals = ins[gslot]
+            # cotangents must match the recomputed forward's output dtype:
+            # under the AMP policy a white-listed forward yields bf16 while
+            # the incoming cotangent may be fp32 (or vice versa)
             cts[slot] = [
-                g if g is not None else jnp.zeros_like(v)
+                g.astype(v.dtype) if g is not None else jnp.zeros_like(v)
                 for g, v in zip(gvals, vals)
             ]
         else:

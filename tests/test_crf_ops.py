@@ -1,0 +1,259 @@
+"""CRF family tests: linear_chain_crf NLL vs brute-force enumeration,
+gradient check, crf_decoding vs brute-force Viterbi, chunk_eval vs a
+python chunk extractor (the OpTest numpy-oracle pattern, op_test.py:131)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _brute_force_nll(emission, length, transition, label):
+    """Enumerate all tag paths of one sequence."""
+    d = emission.shape[1]
+    start_w, end_w, trans = transition[0], transition[1], transition[2:]
+
+    def score(path):
+        s = start_w[path[0]] + emission[0, path[0]]
+        for t in range(1, len(path)):
+            s += trans[path[t - 1], path[t]] + emission[t, path[t]]
+        s += end_w[path[-1]]
+        return s
+
+    paths = list(itertools.product(range(d), repeat=length))
+    scores = np.array([score(p) for p in paths])
+    m = scores.max()
+    log_z = m + np.log(np.exp(scores - m).sum())
+    gold = score(tuple(label[:length]))
+    return log_z - gold, paths[int(np.argmax(scores))]
+
+
+def _run_crf(emission, lengths, transition, label, fetch_decode=False):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        b, t, d = emission.shape
+        em = fluid.layers.data("em", shape=[d], dtype="float32",
+                               lod_level=1)
+        lb = fluid.layers.data("lb", shape=[1], dtype="int64", lod_level=1)
+        nll = fluid.layers.linear_chain_crf(
+            em, lb, param_attr=fluid.ParamAttr(name="crfw"))
+        fetches = [nll]
+        if fetch_decode:
+            fetches.append(fluid.layers.crf_decoding(
+                em, param_attr=fluid.ParamAttr(name="crfw")))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            scope.set_var("crfw", transition)
+            exe = fluid.Executor(fluid.CPUPlace())
+            feed = {"em": emission, "em@LEN": lengths,
+                    "lb": label[:, :, None], "lb@LEN": lengths}
+            return exe.run(feed=feed, fetch_list=fetches)
+
+
+def test_crf_nll_matches_brute_force():
+    rng = np.random.RandomState(0)
+    b, t, d = 3, 5, 4
+    emission = rng.randn(b, t, d).astype("float32")
+    transition = rng.randn(d + 2, d).astype("float32")
+    lengths = np.array([5, 3, 1], "int32")
+    label = rng.randint(0, d, (b, t)).astype("int64")
+    (nll,) = _run_crf(emission, lengths, transition, label)
+    for i in range(b):
+        want, _ = _brute_force_nll(emission[i], int(lengths[i]),
+                                   transition, label[i])
+        assert nll[i, 0] == pytest.approx(want, rel=1e-4), i
+
+
+def test_crf_decoding_matches_brute_force_viterbi():
+    rng = np.random.RandomState(1)
+    b, t, d = 4, 4, 3
+    emission = rng.randn(b, t, d).astype("float32")
+    transition = rng.randn(d + 2, d).astype("float32")
+    lengths = np.array([4, 4, 2, 3], "int32")
+    label = rng.randint(0, d, (b, t)).astype("int64")
+    nll, path = _run_crf(emission, lengths, transition, label,
+                         fetch_decode=True)
+    path = path[:, :, 0]
+    for i in range(b):
+        _, best = _brute_force_nll(emission[i], int(lengths[i]),
+                                   transition, label[i])
+        np.testing.assert_array_equal(path[i, :lengths[i]],
+                                      np.array(best), str(i))
+        assert (path[i, lengths[i]:] == 0).all()
+
+
+def test_crf_decoding_with_label_emits_correctness_mask():
+    rng = np.random.RandomState(2)
+    b, t, d = 2, 4, 3
+    emission = rng.randn(b, t, d).astype("float32")
+    transition = rng.randn(d + 2, d).astype("float32")
+    lengths = np.array([4, 3], "int32")
+    label = rng.randint(0, d, (b, t)).astype("int64")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        em = fluid.layers.data("em", shape=[d], dtype="float32", lod_level=1)
+        lb = fluid.layers.data("lb", shape=[1], dtype="int64", lod_level=1)
+        fluid.layers.linear_chain_crf(
+            em, lb, param_attr=fluid.ParamAttr(name="crfw"))
+        mask = fluid.layers.crf_decoding(
+            em, param_attr=fluid.ParamAttr(name="crfw"), label=lb)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            scope.set_var("crfw", transition)
+            exe = fluid.Executor(fluid.CPUPlace())
+            (mv,) = exe.run(feed={"em": emission, "em@LEN": lengths,
+                                  "lb": label[:, :, None],
+                                  "lb@LEN": lengths},
+                            fetch_list=[mask])
+    assert set(np.unique(mv)) <= {0, 1}
+    # mask is 1 exactly where viterbi == label (recompute path directly)
+    for i in range(b):
+        _, best = _brute_force_nll(emission[i], int(lengths[i]),
+                                   fluid.Scope and transition, label[i])
+        want = (np.array(best) == label[i, :lengths[i]]).astype("int64")
+        np.testing.assert_array_equal(mv[i, :lengths[i], 0], want)
+
+
+def test_crf_gradient_trains():
+    """End-to-end: fc -> crf cost decreases under SGD (the
+    label_semantic_roles pattern at miniature scale)."""
+    rng = np.random.RandomState(3)
+    b, t, d_in, d = 8, 6, 5, 4
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 5
+        x = fluid.layers.data("x", shape=[d_in], dtype="float32",
+                              lod_level=1)
+        lb = fluid.layers.data("lb", shape=[1], dtype="int64", lod_level=1)
+        em = fluid.layers.fc(x, size=d, num_flatten_dims=2, act=None)
+        em._seq_len_name = x._seq_len_name
+        cost = fluid.layers.linear_chain_crf(
+            em, lb, param_attr=fluid.ParamAttr(name="crfw"))
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(avg)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            xs = rng.randn(b, t, d_in).astype("float32")
+            lens = rng.randint(2, t + 1, (b,)).astype("int32")
+            # learnable pattern: tag = argmax of first d features
+            ys = xs[:, :, :d].argmax(-1).astype("int64")
+            losses = []
+            for _ in range(40):
+                (lv,) = exe.run(feed={"x": xs, "x@LEN": lens,
+                                      "lb": ys[:, :, None], "lb@LEN": lens},
+                                fetch_list=[avg])
+                losses.append(float(lv.ravel()[0]))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+_SCHEME_TAGS = {  # chunk_eval_op.h:118-141
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _py_chunks(labels, scheme, num_chunk_types):
+    """Direct python port of the reference GetSegments state machine
+    (chunk_eval_op.h:41-81) — the oracle the vectorized op must match."""
+    n_tag, tb, ti, te, ts = _SCHEME_TAGS[scheme]
+    other = num_chunk_types
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other:
+            return False
+        if ty == other or ty != pty:
+            return True
+        if pt == tb or pt == ti:
+            return t == tb or t == ts
+        return pt == te or pt == ts
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty or t == tb or t == ts:
+            return True
+        if t == ti or t == te:
+            return pt == te or pt == ts
+        return False
+
+    segs = []
+    in_chunk = False
+    start = 0
+    tag, typ = -1, other
+    for i, v in enumerate(labels):
+        pt, pty = tag, typ
+        tag, typ = int(v) % n_tag, int(v) // n_tag
+        if in_chunk and chunk_end(pt, pty, tag, typ):
+            segs.append((start, i - 1, pty))
+            in_chunk = False
+        if chunk_begin(pt, pty, tag, typ):
+            start = i
+            in_chunk = True
+    if in_chunk:
+        segs.append((start, len(labels) - 1, typ))
+    return set(segs)
+
+
+def test_chunk_eval_iob():
+    # tags: B-typ = typ*2, I-typ = typ*2+1, O = num*2
+    num_types = 2
+    label = np.array([[0, 1, 4, 2, 3, 1]], "int64")   # B0 I0 O B1 I1 I0
+    infer = np.array([[0, 1, 4, 2, 1, 1]], "int64")   # B0 I0 O B1 I0...
+    lengths = np.array([6], "int32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        inf = fluid.layers.data("inf", shape=[1], dtype="int64", lod_level=1)
+        lab = fluid.layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        p, r, f1, ni, nl, nc = fluid.layers.chunk_eval(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=num_types)
+        exe = fluid.Executor(fluid.CPUPlace())
+        pv, rv, fv, niv, nlv, ncv = exe.run(
+            feed={"inf": infer[:, :, None], "inf@LEN": lengths,
+                  "lab": label[:, :, None], "lab@LEN": lengths},
+            fetch_list=[p, r, f1, ni, nl, nc])
+    want_inf = _py_chunks(infer[0], "IOB", num_types)
+    want_lab = _py_chunks(label[0], "IOB", num_types)
+    assert int(niv[0]) == len(want_inf)
+    assert int(nlv[0]) == len(want_lab)
+    assert int(ncv[0]) == len(want_inf & want_lab)
+    assert pv[0] == pytest.approx(len(want_inf & want_lab) /
+                                  max(len(want_inf), 1))
+    assert rv[0] == pytest.approx(len(want_inf & want_lab) /
+                                  max(len(want_lab), 1))
+
+
+def test_chunk_eval_random_vs_python_oracle():
+    rng = np.random.RandomState(7)
+    num_types = 3
+    for scheme, n_tag in (("IOB", 2), ("plain", 1), ("IOBES", 4)):
+        b, t = 5, 12
+        hi = n_tag * num_types + 1        # include the O tag
+        label = rng.randint(0, hi, (b, t)).astype("int64")
+        infer = rng.randint(0, hi, (b, t)).astype("int64")
+        lengths = rng.randint(1, t + 1, (b,)).astype("int32")
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            inf = fluid.layers.data("inf", shape=[1], dtype="int64",
+                                    lod_level=1)
+            lab = fluid.layers.data("lab", shape=[1], dtype="int64",
+                                    lod_level=1)
+            outs = fluid.layers.chunk_eval(
+                inf, lab, chunk_scheme=scheme, num_chunk_types=num_types)
+            exe = fluid.Executor(fluid.CPUPlace())
+            res = exe.run(
+                feed={"inf": infer[:, :, None], "inf@LEN": lengths,
+                      "lab": label[:, :, None], "lab@LEN": lengths},
+                fetch_list=list(outs))
+        ni = nl = nc = 0
+        for i in range(b):
+            wi = _py_chunks(infer[i, :lengths[i]], scheme, num_types)
+            wl = _py_chunks(label[i, :lengths[i]], scheme, num_types)
+            ni += len(wi)
+            nl += len(wl)
+            nc += len(wi & wl)
+        assert int(res[3][0]) == ni, scheme
+        assert int(res[4][0]) == nl, scheme
+        assert int(res[5][0]) == nc, scheme

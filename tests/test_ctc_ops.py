@@ -1,0 +1,146 @@
+"""CTC family tests: warpctc loss vs brute-force path enumeration,
+training smoke, ctc_align and edit_distance vs python oracles."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _brute_force_ctc_nll(logits, t_len, label, blank):
+    """-log P(label | logits) by enumerating all alignment paths."""
+    p = np.exp(logits[:t_len] - logits[:t_len].max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    c = logits.shape[1]
+    total = 0.0
+    for path in itertools.product(range(c), repeat=t_len):
+        # collapse: merge repeats then drop blanks
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev and s != blank:
+                collapsed.append(s)
+            prev = s
+        if collapsed == list(label):
+            prob = 1.0
+            for t, s in enumerate(path):
+                prob *= p[t, s]
+            total += prob
+    return -np.log(total)
+
+
+def test_warpctc_matches_brute_force():
+    rng = np.random.RandomState(0)
+    b, t, c = 3, 4, 3          # classes incl. blank=0
+    logits = rng.randn(b, t, c).astype("float32")
+    t_lens = np.array([4, 3, 4], "int32")
+    labels = np.array([[1, 2], [1, 0], [2, 2]], "int64")
+    u_lens = np.array([2, 1, 2], "int32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[c], dtype="float32", lod_level=1)
+        lb = fluid.layers.data("lb", shape=[1], dtype="int64", lod_level=1)
+        loss = fluid.layers.warpctc(x, lb, blank=0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        (lv,) = exe.run(feed={"x": logits, "x@LEN": t_lens,
+                              "lb": labels[:, :, None], "lb@LEN": u_lens},
+                        fetch_list=[loss])
+    for i in range(b):
+        want = _brute_force_ctc_nll(logits[i], int(t_lens[i]),
+                                    labels[i, :u_lens[i]], 0)
+        assert lv[i, 0] == pytest.approx(want, rel=1e-4), i
+
+
+def test_warpctc_trains():
+    rng = np.random.RandomState(1)
+    b, t, c = 8, 10, 5
+    xs = rng.randn(b, t, 6).astype("float32")
+    t_lens = np.full((b,), t, "int32")
+    labels = rng.randint(1, c, (b, 4)).astype("int64")
+    u_lens = np.full((b,), 4, "int32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 3
+        x = fluid.layers.data("x", shape=[6], dtype="float32", lod_level=1)
+        lb = fluid.layers.data("lb", shape=[1], dtype="int64", lod_level=1)
+        logits = fluid.layers.fc(x, size=c, num_flatten_dims=2, act=None)
+        logits._seq_len_name = x._seq_len_name
+        cost = fluid.layers.mean(fluid.layers.warpctc(logits, lb))
+        fluid.optimizer.Adam(learning_rate=5e-2).minimize(cost)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = []
+            for _ in range(30):
+                (lv,) = exe.run(
+                    feed={"x": xs, "x@LEN": t_lens,
+                          "lb": labels[:, :, None], "lb@LEN": u_lens},
+                    fetch_list=[cost])
+                losses.append(float(lv.ravel()[0]))
+    assert losses[-1] < losses[0] * 0.7
+
+
+def test_ctc_align_merge_and_blank_removal():
+    x = np.array([[0, 1, 1, 0, 2, 2, 0, 3],
+                  [1, 1, 2, 0, 0, 3, 3, 1]], "int64")
+    lens = np.array([8, 6], "int32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xin = fluid.layers.data("x", shape=[1], dtype="int64", lod_level=1)
+        probs = fluid.layers.one_hot(xin, depth=4)
+        dec = fluid.layers.ctc_greedy_decoder(probs, blank=0)
+        ln = fluid.layers.sequence_length(dec)
+        exe = fluid.Executor(fluid.CPUPlace())
+        out, out_len = exe.run(
+            feed={"x": x[:, :, None], "x@LEN": lens},
+            fetch_list=[dec, ln])
+    # seq 0 (len 8): 0 1 1 0 2 2 0 3 -> 1 2 3
+    np.testing.assert_array_equal(out[0, :3].ravel(), [1, 2, 3])
+    assert out_len[0] == 3
+    # seq 1 (len 6): 1 1 2 0 0 3 -> 1 2 3
+    np.testing.assert_array_equal(out[1, :3].ravel(), [1, 2, 3])
+    assert out_len[1] == 3
+    assert (out[0, 3:] == 0).all() and (out[1, 3:] == 0).all()
+
+
+def _py_edit_distance(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1))
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + cost)
+    return d[m, n]
+
+
+def test_edit_distance_vs_python_oracle():
+    rng = np.random.RandomState(2)
+    b = 6
+    hyps = rng.randint(0, 5, (b, 7)).astype("int64")
+    refs = rng.randint(0, 5, (b, 9)).astype("int64")
+    h_lens = rng.randint(1, 8, (b,)).astype("int32")
+    r_lens = rng.randint(1, 10, (b,)).astype("int32")
+    for normalized in (False, True):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            h = fluid.layers.data("h", shape=[1], dtype="int64",
+                                  lod_level=1)
+            r = fluid.layers.data("r", shape=[1], dtype="int64",
+                                  lod_level=1)
+            dist, seq_num = fluid.layers.edit_distance(
+                h, r, normalized=normalized)
+            exe = fluid.Executor(fluid.CPUPlace())
+            dv, nv = exe.run(
+                feed={"h": hyps[:, :, None], "h@LEN": h_lens,
+                      "r": refs[:, :, None], "r@LEN": r_lens},
+                fetch_list=[dist, seq_num])
+        assert int(nv[0]) == b
+        for i in range(b):
+            want = _py_edit_distance(hyps[i, :h_lens[i]],
+                                     refs[i, :r_lens[i]])
+            if normalized:
+                want /= max(r_lens[i], 1)
+            assert dv[i, 0] == pytest.approx(want, rel=1e-5), \
+                (normalized, i)

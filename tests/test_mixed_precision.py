@@ -1,0 +1,130 @@
+"""bf16 automatic-mixed-precision tests (contrib.mixed_precision — the
+TPU rebuild of contrib/float16/float16_transpiler.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import mixed_precision as amp
+from paddle_tpu.core import bfloat16
+
+
+def test_whitelisted_matmul_computes_in_bf16(fresh_programs):
+    x = fluid.layers.data("x", shape=[4])
+    w = fluid.layers.data("w", shape=[4, 3], append_batch_size=False)
+    y = fluid.layers.matmul(x, w)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.random.rand(2, 4).astype("float32")
+    wv = np.random.rand(4, 3).astype("float32")
+    feed = {"x": xv, "w": wv}
+
+    (out_fp32,) = exe.run(feed=feed, fetch_list=[y], return_numpy=False)
+    assert jnp.asarray(out_fp32).dtype == jnp.float32
+    with amp.bf16_program_guard(prog):
+        (out_bf16,) = exe.run(feed=feed, fetch_list=[y],
+                              return_numpy=False)
+    assert jnp.asarray(out_bf16).dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out_bf16, dtype=np.float32), np.asarray(out_fp32),
+        rtol=2e-2)
+
+
+def test_blacklisted_loss_stays_fp32(fresh_programs):
+    x = fluid.layers.data("x", shape=[4])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    logits = fluid.layers.fc(x, size=3, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+    prog = fluid.default_main_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with amp.bf16_program_guard(prog):
+        (lv,) = exe.run(
+            feed={"x": np.random.rand(2, 4).astype("float32"),
+                  "label": np.array([[0], [1]], "int64")},
+            fetch_list=[loss], return_numpy=False)
+    assert jnp.asarray(lv).dtype == jnp.float32
+
+
+def test_decorated_optimizer_trains_and_keeps_fp32_master_weights(
+        fresh_programs):
+    x = fluid.layers.data("x", shape=[8])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=16, act="relu")
+    pred = fluid.layers.fc(h, size=4, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    opt = amp.decorate(fluid.optimizer.Adam(learning_rate=1e-2))
+    opt.minimize(loss)
+    assert fluid.default_main_program()._amp_policy is not None
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    proj = rng.rand(8, 4).astype("float32")
+    losses = []
+    for _ in range(30):
+        xv = rng.rand(32, 8).astype("float32")
+        yv = (xv @ proj).argmax(1).astype("int64").reshape(-1, 1)
+        (lv,) = exe.run(feed={"x": xv, "label": yv}, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.8
+    # master weights stay fp32 in the scope
+    scope = fluid.global_scope()
+    for p in fluid.default_main_program().global_block().all_parameters():
+        assert np.dtype(scope.var(p.name).dtype) == np.float32, p.name
+
+
+def test_amp_matches_fp32_within_bf16_tolerance(fresh_programs):
+    def build():
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(x, size=4, act="softmax",
+                               param_attr=fluid.ParamAttr(name="w"),
+                               bias_attr=fluid.ParamAttr(name="b"))
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        return loss
+
+    rng = np.random.RandomState(1)
+    xv = rng.rand(16, 8).astype("float32")
+    yv = rng.randint(0, 4, (16, 1)).astype("int64")
+
+    results = {}
+    for use_amp in (False, True):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            fluid.default_startup_program().random_seed = 7
+            fluid.default_main_program().random_seed = 7
+            loss = build()
+            opt = fluid.optimizer.SGD(learning_rate=0.1)
+            if use_amp:
+                opt = amp.decorate(opt)
+            opt.minimize(loss)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(fluid.default_startup_program())
+                for _ in range(5):
+                    (lv,) = exe.run(feed={"x": xv, "label": yv},
+                                    fetch_list=[loss])
+                results[use_amp] = float(np.asarray(lv).ravel()[0])
+    assert results[True] == pytest.approx(results[False], rel=0.05)
+
+
+def test_cast_parameters_to_bf16(fresh_programs):
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.fc(x, size=2, act=None)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    amp.cast_parameters_to_bf16(fluid.default_main_program(), scope)
+    params = fluid.default_main_program().global_block().all_parameters()
+    assert params
+    for p in params:
+        assert jnp.asarray(scope.var(p.name)).dtype == jnp.bfloat16
+    # inference still runs (gray ops follow input promotion)
+    (out,) = exe.run(feed={"x": np.random.rand(2, 4).astype("float32")},
+                     fetch_list=[y], return_numpy=False)
+    assert np.isfinite(np.asarray(out, dtype=np.float32)).all()

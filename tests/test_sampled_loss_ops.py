@@ -1,0 +1,212 @@
+"""nce, hierarchical_sigmoid, bilinear_tensor_product, fake_quantize,
+precision_recall tests (numpy oracles + training smoke)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_nce_cost_formula_and_training():
+    rng = np.random.RandomState(0)
+    b, d, c = 16, 8, 20
+    xs = rng.rand(b, d).astype("float32")
+    ys = rng.randint(0, c, (b, 1)).astype("int64")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 1
+        fluid.default_main_program().random_seed = 1
+        x = fluid.layers.data("x", shape=[d])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        cost = fluid.layers.nce(x, label, num_total_classes=c,
+                                num_neg_samples=5)
+        avg = fluid.layers.mean(cost)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(avg)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = [float(exe.run(feed={"x": xs, "label": ys},
+                                    fetch_list=[avg])[0].ravel()[0])
+                      for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.8
+    assert all(np.isfinite(losses))
+
+
+def _py_hsigmoid(x, w, bias, label, num_classes):
+    """Oracle from matrix_bit_code.h SimpleCode: c = label + num_classes,
+    len = floor(log2(c)); node(bit) = (c >> (bit+1)) - 1, target = bit-th
+    LSB of c."""
+    out = np.zeros((x.shape[0], 1), "float64")
+    for i in range(x.shape[0]):
+        c = int(label[i]) + num_classes
+        length = int(np.floor(np.log2(c)))
+        for bit in range(length):
+            node = (c >> (bit + 1)) - 1
+            target = (c >> bit) & 1
+            pre = x[i] @ w[node] + (bias[node, 0] if bias is not None
+                                    else 0.0)
+            out[i, 0] += np.log1p(np.exp(pre)) - target * pre
+    return out
+
+
+def test_hsigmoid_matches_bitcode_oracle():
+    rng = np.random.RandomState(2)
+    b, d, c = 6, 5, 7
+    xs = rng.randn(b, d).astype("float32")
+    ys = rng.randint(0, c, (b, 1)).astype("int64")
+    wv = rng.randn(c - 1, d).astype("float32")
+    bv = rng.randn(c - 1, 1).astype("float32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[d])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        out = fluid.layers.hsigmoid(
+            x, label, num_classes=c,
+            param_attr=fluid.ParamAttr(name="hs_w"),
+            bias_attr=fluid.ParamAttr(name="hs_b"))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            scope.set_var("hs_w", wv)
+            scope.set_var("hs_b", bv)
+            exe = fluid.Executor(fluid.CPUPlace())
+            (ov,) = exe.run(feed={"x": xs, "label": ys}, fetch_list=[out])
+    want = _py_hsigmoid(xs, wv, bv, ys[:, 0], c)
+    np.testing.assert_allclose(ov, want, rtol=2e-4)
+
+
+def test_hsigmoid_trains():
+    rng = np.random.RandomState(3)
+    b, d, c = 32, 6, 8
+    xs = rng.randn(b, d).astype("float32")
+    ys = (xs[:, :3].argmax(1)).astype("int64")[:, None]
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 4
+        x = fluid.layers.data("x", shape=[d])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        cost = fluid.layers.mean(fluid.layers.hsigmoid(x, label, c))
+        fluid.optimizer.Adam(learning_rate=0.1).minimize(cost)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = [float(exe.run(feed={"x": xs, "label": ys},
+                                    fetch_list=[cost])[0].ravel()[0])
+                      for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_bilinear_tensor_product_oracle():
+    rng = np.random.RandomState(4)
+    b, dx, dy, k = 3, 4, 5, 2
+    xs = rng.randn(b, dx).astype("float32")
+    ys = rng.randn(b, dy).astype("float32")
+    wv = rng.randn(k, dx, dy).astype("float32")
+    bv = rng.randn(1, k).astype("float32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[dx])
+        y = fluid.layers.data("y", shape=[dy])
+        out = fluid.layers.bilinear_tensor_product(
+            x, y, size=k, param_attr=fluid.ParamAttr(name="btp_w"),
+            bias_attr=fluid.ParamAttr(name="btp_b"))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            scope.set_var("btp_w", wv)
+            scope.set_var("btp_b", bv)
+            exe = fluid.Executor(fluid.CPUPlace())
+            (ov,) = exe.run(feed={"x": xs, "y": ys}, fetch_list=[out])
+    want = np.einsum("bi,kij,bj->bk", xs, wv, ys) + bv
+    np.testing.assert_allclose(ov, want, rtol=1e-4)
+
+
+def test_fake_quantize_dequantize_roundtrip_and_ste_grad():
+    rng = np.random.RandomState(5)
+    xs = (rng.randn(4, 6) * 3).astype("float32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[6])
+        x.stop_gradient = False
+        helper_out = []
+        from paddle_tpu.layer_helper import LayerHelper
+        helper = LayerHelper("fake_quantize_abs_max")
+        out = helper.create_variable_for_type_inference("float32")
+        scale = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="fake_quantize_abs_max",
+                         inputs={"X": [x]},
+                         outputs={"Out": [out], "OutScale": [scale]},
+                         attrs={"bit_length": 8})
+        loss = fluid.layers.reduce_sum(out)
+        grads = fluid.calc_gradient(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        ov, sv, gv = exe.run(feed={"x": xs},
+                             fetch_list=[out, scale, grads[0]])
+    s = np.abs(xs).max()
+    want = np.round(xs / s * 127) * s / 127
+    np.testing.assert_allclose(ov, want, rtol=1e-5)
+    assert sv[0] == pytest.approx(s, rel=1e-6)
+    # straight-through estimator: grad of sum(out) w.r.t. x is all-ones
+    np.testing.assert_allclose(gv, np.ones_like(xs))
+    # max quantization error is scale/range/2
+    assert np.abs(ov - xs).max() <= s / 127 / 2 + 1e-6
+
+
+def test_precision_recall_streaming_vs_sklearn_style_oracle():
+    rng = np.random.RandomState(6)
+    c = 4
+    ids1 = rng.randint(0, c, (10, 1)).astype("int32")
+    lab1 = rng.randint(0, c, (10, 1)).astype("int32")
+    ids2 = rng.randint(0, c, (8, 1)).astype("int32")
+    lab2 = rng.randint(0, c, (8, 1)).astype("int32")
+
+    def np_states(ids, labels):
+        st = np.zeros((c, 4))
+        for i, l in zip(ids[:, 0], labels[:, 0]):
+            if i == l:
+                st[i, 0] += 1
+                st[:, 2] += 1
+                st[i, 2] -= 1
+            else:
+                st[i, 1] += 1
+                st[l, 3] += 1
+                st[:, 2] += 1
+                st[i, 2] -= 1
+                st[l, 2] -= 1
+        return st
+
+    def np_metrics(st):
+        def calc(a, b):
+            return a / (a + b) if (a > 0 or b > 0) else 1.0
+        precs = [calc(st[i, 0], st[i, 1]) for i in range(c)]
+        recs = [calc(st[i, 0], st[i, 3]) for i in range(c)]
+        mp, mr = np.mean(precs), np.mean(recs)
+
+        def f1(p, r):
+            return 2 * p * r / (p + r) if (p > 0 or r > 0) else 0.0
+        tp, fp, fn = st[:, 0].sum(), st[:, 1].sum(), st[:, 3].sum()
+        up, ur = calc(tp, fp), calc(tp, fn)
+        return [mp, mr, f1(mp, mr), up, ur, f1(up, ur)]
+
+    from paddle_tpu.layer_helper import LayerHelper
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        idx = fluid.layers.data("idx", shape=[1], dtype="int32")
+        lab = fluid.layers.data("lab", shape=[1], dtype="int32")
+        states = fluid.layers.data("states", shape=[c, 4],
+                                   append_batch_size=False)
+        helper = LayerHelper("precision_recall")
+        bm = helper.create_variable_for_type_inference("float32")
+        am = helper.create_variable_for_type_inference("float32")
+        ast = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="precision_recall",
+            inputs={"MaxProbs": [idx], "Indices": [idx], "Labels": [lab],
+                    "StatesInfo": [states]},
+            outputs={"BatchMetrics": [bm], "AccumMetrics": [am],
+                     "AccumStatesInfo": [ast]},
+            attrs={"class_number": c})
+        exe = fluid.Executor(fluid.CPUPlace())
+        st1 = np_states(ids1, lab1)
+        bmv, amv, astv = exe.run(
+            feed={"idx": ids2, "lab": lab2,
+                  "states": st1.astype("float32")},
+            fetch_list=[bm, am, ast])
+    st2 = np_states(ids2, lab2)
+    np.testing.assert_allclose(astv, st1 + st2, atol=1e-5)
+    np.testing.assert_allclose(bmv, np_metrics(st2), rtol=1e-5)
+    np.testing.assert_allclose(amv, np_metrics(st1 + st2), rtol=1e-5)

@@ -1,0 +1,151 @@
+"""sequence_pad/unpad/reshape/expand_as/scatter + im2sequence tests
+(numpy oracles, OpTest pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(build, feed):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fetches = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        return exe.run(feed=feed, fetch_list=list(fetches))
+
+
+def test_sequence_pad_pads_and_reports_lengths():
+    x = np.arange(24, dtype="float32").reshape(2, 4, 3)
+    lens = np.array([2, 4], "int32")
+
+    def build():
+        xi = fluid.layers.data("x", shape=[3], dtype="float32", lod_level=1)
+        out, slen = fluid.layers.sequence_pad(xi, maxlen=6)
+        return out, slen
+
+    out, slen = _run(build, {"x": x, "x@LEN": lens})
+    assert out.shape == (2, 6, 3)
+    np.testing.assert_array_equal(out[0, :2], x[0, :2])
+    assert (out[0, 2:] == 0).all()        # pad_value default 0
+    np.testing.assert_array_equal(out[1, :4], x[1])
+    np.testing.assert_array_equal(slen, [2, 4])
+
+
+def test_sequence_unpad_roundtrip():
+    x = np.random.rand(3, 5, 2).astype("float32")
+    lens = np.array([5, 1, 3], "int32")
+
+    def build():
+        xi = fluid.layers.data("x", shape=[5, 2], dtype="float32",
+                               append_batch_size=False)
+        xi.shape = (-1, 5, 2)
+        ln = fluid.layers.data("ln", shape=[], dtype="int32",
+                               append_batch_size=False)
+        ln.shape = (-1,)
+        seq = fluid.layers.sequence_unpad(xi, ln)
+        pooled = fluid.layers.sequence_pool(seq, "sum")
+        return seq, pooled
+
+    seq, pooled = _run(build, {"x": x, "ln": lens})
+    for i, l in enumerate(lens):
+        np.testing.assert_allclose(seq[i, :l], x[i, :l], rtol=1e-6)
+        assert (seq[i, l:] == 0).all()
+        np.testing.assert_allclose(pooled[i], x[i, :l].sum(0), rtol=1e-5)
+
+
+def test_sequence_reshape_rechunks():
+    x = np.arange(2 * 4 * 6, dtype="float32").reshape(2, 4, 6)
+    lens = np.array([2, 4], "int32")
+
+    def build():
+        xi = fluid.layers.data("x", shape=[6], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_reshape(xi, new_dim=3)
+        ln = fluid.layers.sequence_length(out)
+        return out, ln
+
+    out, ln = _run(build, {"x": x, "x@LEN": lens})
+    assert out.shape == (2, 8, 3)
+    np.testing.assert_array_equal(ln, [4, 8])
+    np.testing.assert_array_equal(out[0, :4].ravel(), x[0, :2].ravel())
+
+
+def test_sequence_expand_as_repeats_rows():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    y = np.zeros((2, 5, 1), "float32")
+    y_lens = np.array([3, 5], "int32")
+
+    def build():
+        xi = fluid.layers.data("x", shape=[2])
+        yi = fluid.layers.data("y", shape=[1], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_expand_as(xi, yi)
+        return (out,)
+
+    (out,) = _run(build, {"x": x, "y": y, "y@LEN": y_lens})
+    for t in range(3):
+        np.testing.assert_array_equal(out[0, t], x[0])
+    assert (out[0, 3:] == 0).all()
+    for t in range(5):
+        np.testing.assert_array_equal(out[1, t], x[1])
+
+
+def test_sequence_scatter_adds_updates():
+    x = np.zeros((2, 6), "float32")
+    ids = np.array([[1, 3, 1], [0, 5, 0]], "int64")
+    upd = np.array([[1.0, 2.0, 4.0], [7.0, 8.0, 9.0]], "float32")
+    lens = np.array([3, 2], "int32")
+
+    def build():
+        xi = fluid.layers.data("x", shape=[6])
+        ii = fluid.layers.data("ids", shape=[1], dtype="int64", lod_level=1)
+        ui = fluid.layers.data("upd", shape=[1], dtype="float32",
+                               lod_level=1)
+        out = fluid.layers.sequence_scatter(xi, ii, ui)
+        return (out,)
+
+    (out,) = _run(build, {"x": x, "ids": ids[:, :, None], "ids@LEN": lens,
+                          "upd": upd[:, :, None], "upd@LEN": lens})
+    want0 = np.zeros(6)
+    want0[1] = 1 + 4
+    want0[3] = 2
+    np.testing.assert_allclose(out[0], want0)
+    want1 = np.zeros(6)
+    want1[0] = 7
+    want1[5] = 8                          # third update beyond len=2 ignored
+    np.testing.assert_allclose(out[1], want1)
+
+
+def test_im2sequence_matches_numpy_patches():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 6, 6).astype("float32")
+
+    def build():
+        xi = fluid.layers.data("img", shape=[3, 6, 6])
+        out = fluid.layers.im2sequence(xi, filter_size=2, stride=2)
+        ln = fluid.layers.sequence_length(out)
+        return out, ln
+
+    out, ln = _run(build, {"img": x})
+    assert out.shape == (2, 9, 12)
+    np.testing.assert_array_equal(ln, [9, 9])
+    # oracle: patch at (i, j) -> features ordered (c, kh, kw)
+    for b in range(2):
+        for i in range(3):
+            for j in range(3):
+                patch = x[b, :, 2 * i:2 * i + 2, 2 * j:2 * j + 2].ravel()
+                np.testing.assert_allclose(out[b, i * 3 + j], patch,
+                                           rtol=1e-6)
+
+
+def test_sequence_pad_grad_flows():
+    x = np.random.rand(2, 4, 3).astype("float32")
+    lens = np.array([2, 3], "int32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        xi = fluid.layers.data("x", shape=[3], dtype="float32", lod_level=1)
+        xi.stop_gradient = False
+        out, _ = fluid.layers.sequence_pad(xi, maxlen=5)
+        loss = fluid.layers.reduce_sum(out * out)
+        grads = fluid.calc_gradient(loss, [xi])
+        exe = fluid.Executor(fluid.CPUPlace())
+        (gv,) = exe.run(feed={"x": x, "x@LEN": lens}, fetch_list=grads)
+    mask = np.arange(4)[None, :, None] < lens[:, None, None]
+    np.testing.assert_allclose(gv, np.where(mask, 2 * x, 0), rtol=1e-5)

@@ -42,33 +42,24 @@ def _bench_program(main, startup, feed_fn, fetch, place, iterations,
         exe.run(startup)
         dev = place.jax_device()
         if per_step_feed:
+            # fresh host batches cross the host->device link every step
             feeds = [feed_fn() for _ in range(max(4, skip_batch_num))]
-            for i in range(skip_batch_num):
-                exe.run(main, feed=feeds[i % len(feeds)],
-                        fetch_list=[fetch], return_numpy=False)
-            t0 = time.perf_counter()
-            last = None
-            for i in range(iterations):
-                last = exe.run(main, feed=feeds[i % len(feeds)],
-                               fetch_list=[fetch], return_numpy=False)
-            jax.block_until_ready(last)
-            elapsed = time.perf_counter() - t0
         else:
-            # stage the feed on device once — the input pipeline's job;
-            # keeps the measured loop free of host-link transfers
-            feed = {k: jax.device_put(v, dev)
-                    for k, v in feed_fn().items()}
-            for i in range(skip_batch_num):
-                exe.run(main, feed=feed, fetch_list=[fetch],
-                        return_numpy=False)
-            t0 = time.perf_counter()
-            last = None
-            for i in range(iterations):
-                # async dispatch: loss stays on device; sync at the end
-                last = exe.run(main, feed=feed, fetch_list=[fetch],
-                               return_numpy=False)
-            jax.block_until_ready(last)
-            elapsed = time.perf_counter() - t0
+            # stage one feed on device — the input pipeline's job; keeps
+            # the measured loop free of host-link transfers
+            feeds = [{k: jax.device_put(v, dev)
+                      for k, v in feed_fn().items()}]
+        for i in range(skip_batch_num):
+            exe.run(main, feed=feeds[i % len(feeds)], fetch_list=[fetch],
+                    return_numpy=False)
+        t0 = time.perf_counter()
+        last = None
+        for i in range(iterations):
+            # async dispatch: loss stays on device; sync once at the end
+            last = exe.run(main, feed=feeds[i % len(feeds)],
+                           fetch_list=[fetch], return_numpy=False)
+        jax.block_until_ready(last)
+        elapsed = time.perf_counter() - t0
     assert np.isfinite(
         np.asarray(last[0], dtype=np.float32)).all()
     return elapsed / iterations

@@ -45,6 +45,7 @@ class _GradAccumulator:
     def __init__(self, block):
         self.block = block
         self.pending = {}  # fwd var name -> [grad var names]
+        self._clipped = set()  # fwd vars whose grad got an error clip
 
     def new_contribution_name(self, fwd_name):
         cs = self.pending.setdefault(fwd_name, [])
@@ -72,12 +73,28 @@ class _GradAccumulator:
                     type="assign", inputs={"X": [cs[0]]}, outputs={"Out": [target]}
                 )
             self.pending[fwd_name] = [target]
+            self._maybe_error_clip(fwd_name, target)
             return target
         self.block.append_op(
             type="sum", inputs={"X": list(cs)}, outputs={"Out": [target]}
         )
         self.pending[fwd_name] = [target]
+        self._maybe_error_clip(fwd_name, target)
         return target
+
+    def _maybe_error_clip(self, fwd_name, grad_name):
+        """Apply the forward var's ``error_clip`` to its summed gradient,
+        once, before any consumer reads it (the reference applies
+        error_clip_callback to every appended grad op,
+        backward.py:469 callbacks=[error_clip_callback])."""
+        if fwd_name in self._clipped:
+            return
+        self._clipped.add(fwd_name)
+        fwd_var = self.block._find_var_recursive(fwd_name)
+        error_clip = getattr(fwd_var, "error_clip", None) if fwd_var \
+            else None
+        if error_clip is not None:
+            error_clip._append_clip_op(self.block, grad_name)
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None,
@@ -130,6 +147,11 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             continue
         specs = make_grad_ops(op, no_grad)
         for spec in specs:
+            # record the forward op's position so generic grad recompute
+            # folds the SAME PRNG key the forward used (registry.py
+            # _generic_grad_compute)
+            if spec["type"].endswith("_grad"):
+                spec["attrs"].setdefault("__fwd_op_index__", i)
             # wire out-grad inputs: materialize sums / leave holes
             for slot, names in list(spec["inputs"].items()):
                 if not slot.startswith("GRAD::"):

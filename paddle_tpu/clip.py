@@ -130,20 +130,19 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
             "handled by append_gradient_clip_ops group logic")
 
 
-_gradient_clip_attr = [None]
-
-
 def set_gradient_clip(clip, param_list=None, program=None):
-    """Set a global/default gradient clip (reference clip.py:
-    set_gradient_clip)."""
+    """Set a per-program default gradient clip (reference clip.py:
+    set_gradient_clip).  Without ``param_list`` the clip attaches to the
+    *program* (not process-global state, which would leak into unrelated
+    programs built later in the same process)."""
+    program = program or default_main_program()
     if param_list is not None:
-        program = program or default_main_program()
         for p in param_list:
             if isinstance(p, str):
                 p = program.global_block().var(p)
             p.gradient_clip_attr = clip
     else:
-        _gradient_clip_attr[0] = clip
+        program._gradient_clip_attr = clip
 
 
 def append_gradient_clip_ops(param_grads):
@@ -155,8 +154,9 @@ def append_gradient_clip_ops(param_grads):
         if g is None:
             clips.append((p, g, None))
             continue
+        prog_clip = getattr(p.block.program, "_gradient_clip_attr", None)
         clip_attr = getattr(p, "gradient_clip_attr", None) or \
-            _gradient_clip_attr[0] or NullGradientClipAttr()
+            prog_clip or NullGradientClipAttr()
         clip_attr._process_context(context, p, g)
         clips.append((p, g, clip_attr))
 

@@ -146,7 +146,8 @@ class Trainer:
                     self.checkpoint_cfg.load_serial = serial
                     fluid_io.load_checkpoint(
                         Executor(self.place),
-                        self.checkpoint_cfg.checkpoint_dir)
+                        self.checkpoint_cfg.checkpoint_dir,
+                        main_program=self.train_program)
 
     # ------------------------------------------------------------------
     def _dist_transpile_if_necessary(self):

@@ -153,7 +153,11 @@ def load_inference_model(dirname, executor, model_filename=None,
 # ---- trainer-level checkpoints (reference io.py save_checkpoint family) ---
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0, main_program=None,
-                    serial=0, max_num_checkpoints=3):
+                    serial=None, max_num_checkpoints=3):
+    """``serial=None`` auto-increments past the latest existing serial
+    (reference io.py save_checkpoint: serial = latest + 1)."""
+    if serial is None:
+        serial = get_latest_checkpoint_serial(checkpoint_dir) + 1
     d = os.path.join(checkpoint_dir, "checkpoint_%d" % serial,
                      "trainer_%d" % trainer_id)
     save_persistables(executor, d, main_program, filename="persistables.npz")

@@ -427,7 +427,7 @@ def nce(input, label, num_total_classes, sample_weight=None,
                                 shape=[num_total_classes, dim],
                                 dtype=input.dtype)
     inputs = {"Input": [input], "Label": [label], "Weight": [w]}
-    if helper.bias_attr is not False:
+    if helper.kwargs.get("bias_attr") is not False:
         b = helper.create_parameter(attr=helper.bias_attr,
                                     shape=[num_total_classes, 1],
                                     dtype=input.dtype, is_bias=True)
@@ -457,7 +457,7 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
                                 shape=[num_classes - 1, dim],
                                 dtype=input.dtype)
     inputs = {"X": [input], "W": [w], "Label": [label]}
-    if helper.bias_attr is not False:
+    if helper.kwargs.get("bias_attr") is not False:
         b = helper.create_parameter(attr=helper.bias_attr,
                                     shape=[num_classes - 1, 1],
                                     dtype=input.dtype, is_bias=True)
@@ -480,7 +480,7 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
     w = helper.create_parameter(attr=helper.param_attr,
                                 shape=[size, dx, dy], dtype=x.dtype)
     inputs = {"X": [x], "Y": [y], "Weight": [w]}
-    if helper.bias_attr is not False:
+    if helper.kwargs.get("bias_attr") is not False:
         b = helper.create_parameter(attr=helper.bias_attr, shape=[1, size],
                                     dtype=x.dtype, is_bias=True)
         inputs["Bias"] = [b]

@@ -103,9 +103,10 @@ def _hsigmoid_compute(ins, attrs, ctx, op_index):
     max_len = int(np.ceil(np.log2(max(num_classes, 2))))
 
     code = label.astype(jnp.int32) + num_classes  # [B]
-    # floor(log2(code)): code < 2*num_classes <= 2^(max_len+1)
-    clen = (jnp.floor(jnp.log2(code.astype(jnp.float32) + 0.5))
-            ).astype(jnp.int32)
+    # bit length - 1 == floor(log2(code)), in integer arithmetic:
+    # float log2 misrounds near powers of two for codes >= 2^23
+    bits = jnp.arange(1, 32)
+    clen = jnp.sum((code[:, None] >> bits) > 0, axis=1).astype(jnp.int32)
 
     j = jnp.arange(max_len + 1)[None, :]      # [1, J]
     active = j < clen[:, None]                # [B, J]

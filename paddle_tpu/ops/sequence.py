@@ -529,6 +529,12 @@ def _seq_reshape_infer(op, block):
     new_dim = int(op.attrs["new_dim"])
     d = x.shape[-1]
     t = x.shape[1]
+    if d not in (-1, None) and t not in (-1, None) and \
+            (t * d) % new_dim != 0:
+        raise ValueError(
+            "sequence_reshape: T*D = %d*%d is not divisible by new_dim %d "
+            "(reference sequence_reshape_op.cc enforces divisibility)"
+            % (t, d, new_dim))
     new_t = -1 if t in (-1, None) or d in (-1, None) \
         else (t * d) // new_dim
     set_output(op, block, "Out", (x.shape[0], new_t, new_dim), x.dtype,

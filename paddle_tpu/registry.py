@@ -241,7 +241,12 @@ def _generic_grad_infer(gop, block):
 def _generic_grad_compute(ins, attrs, ctx, op_index):
     fwd_type = attrs["__fwd_type__"]
     fwd_def = get_op_def(fwd_type)
-    fwd_attrs = {k: v for k, v in attrs.items() if k != "__fwd_type__"}
+    fwd_attrs = {k: v for k, v in attrs.items()
+                 if k not in ("__fwd_type__", "__fwd_op_index__")}
+    # stateful-random forwards (nce sampling, dropout without its custom
+    # grad) must re-draw the SAME randomness in the recompute: use the
+    # forward op's trace index for the PRNG fold, not the grad op's
+    op_index = attrs.get("__fwd_op_index__", op_index)
 
     primal_ins = {
         slot: vals

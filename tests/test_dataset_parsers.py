@@ -1,0 +1,170 @@
+"""Dataset parser tests on synthetic fixtures (no network): mnist idx
+files, cifar pickled tars, uci_housing table, imikolov ptb tar, imdb
+aclImdb tar, synthetic — VERDICT weak item 5 (dataset/ untested)."""
+
+import gzip
+import io
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu.dataset as dataset
+
+
+def test_mnist_idx_parser(tmp_path):
+    from paddle_tpu.dataset import mnist
+
+    n, rows, cols = 7, 4, 4
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (n, rows * cols), dtype=np.uint8)
+    labels = rng.randint(0, 10, (n,), dtype=np.uint8)
+    img_path = tmp_path / "img.gz"
+    lbl_path = tmp_path / "lbl.gz"
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, rows, cols))
+        f.write(imgs.tobytes())
+    with gzip.open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+
+    samples = list(mnist.reader_creator(str(img_path), str(lbl_path),
+                                        buffer_size=3)())
+    assert len(samples) == n
+    for (im, lb), want_img, want_lbl in zip(samples, imgs, labels):
+        assert lb == want_lbl
+        np.testing.assert_allclose(
+            im, want_img.astype("float32") / 255.0 * 2.0 - 1.0,
+            rtol=1e-6)
+        assert im.min() >= -1.0 and im.max() <= 1.0
+
+
+def _make_cifar_tar(path, sub_names, n=5, label_key=b"labels"):
+    rng = np.random.RandomState(1)
+    with tarfile.open(path, "w:gz") as tf:
+        for name in sub_names:
+            batch = {b"data": rng.randint(0, 256, (n, 3072),
+                                          dtype=np.uint8),
+                     label_key: rng.randint(0, 10, (n,)).tolist()}
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+
+def test_cifar_tar_parser(tmp_path):
+    from paddle_tpu.dataset import cifar
+
+    p = tmp_path / "cifar.tar.gz"
+    _make_cifar_tar(p, ["cifar/data_batch_1", "cifar/data_batch_2",
+                        "cifar/test_batch"], n=4)
+    train = list(cifar.reader_creator(str(p), "data_batch")())
+    test = list(cifar.reader_creator(str(p), "test_batch")())
+    assert len(train) == 8 and len(test) == 4
+    for im, lb in train:
+        assert im.shape == (3072,) and im.dtype == np.float32
+        assert 0.0 <= im.min() and im.max() <= 1.0
+        assert 0 <= lb < 10
+
+
+def test_uci_housing_split_and_normalization(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import uci_housing
+
+    rng = np.random.RandomState(2)
+    table = rng.rand(10, uci_housing.FEATURE_NUM) * 100
+    data_path = tmp_path / "housing.data"
+    np.savetxt(data_path, table)
+    monkeypatch.setattr(uci_housing.common, "download",
+                        lambda url, mod, md5: str(data_path))
+    uci_housing._cache.clear()
+    try:
+        train = list(uci_housing.train()())
+        test = list(uci_housing.test()())
+    finally:
+        uci_housing._cache.clear()
+    assert len(train) == 8 and len(test) == 2
+    x0, y0 = train[0]
+    assert x0.shape == (uci_housing.FEATURE_NUM - 1,)
+    assert y0.shape == (1,)
+    # feature normalization: (v - avg) / (max - min) of the whole table
+    maxs, mins, avgs = table.max(0), table.min(0), table.mean(0)
+    np.testing.assert_allclose(
+        x0, ((table[0, :-1] - avgs[:-1]) / (maxs[:-1] - mins[:-1]))
+        .astype("float32"), rtol=1e-5)
+
+
+def test_imikolov_ngram_and_seq(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import imikolov
+
+    text = b"the cat sat\nthe dog sat\n"
+    tar_path = tmp_path / "ptb.tgz"
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for split in ("train", "valid"):
+            info = tarfile.TarInfo(
+                "./simple-examples/data/ptb.%s.txt" % split)
+            info.size = len(text)
+            tf.addfile(info, io.BytesIO(text))
+    monkeypatch.setattr(imikolov.common, "download",
+                        lambda url, mod, md5: str(tar_path))
+
+    word_idx = imikolov.build_dict(min_word_freq=0)
+    assert "<s>" in word_idx and "<e>" in word_idx and "<unk>" in word_idx
+    n = 3
+    grams = list(imikolov.train(word_idx, n)())
+    # each line has 3 words + <s>/<e> = 5 tokens -> 3 trigram windows
+    assert len(grams) == 6
+    assert all(len(g) == n for g in grams)
+    seqs = list(imikolov.train(word_idx, 20,
+                               imikolov.DataType.SEQ)())
+    assert len(seqs) == 2
+    src, trg = seqs[0]
+    assert src[0] == word_idx["<s>"]
+    assert trg[-1] == word_idx["<e>"]
+    assert src[1:] == trg[:-1]
+
+
+def test_imdb_tar_parser(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import imdb
+    import re
+
+    tar_path = tmp_path / "aclImdb.tgz"
+    docs = {
+        "aclImdb/train/pos/0_9.txt": b"great movie loved it",
+        "aclImdb/train/pos/1_8.txt": b"great fun",
+        "aclImdb/train/neg/0_2.txt": b"terrible movie hated it",
+    }
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name, blob in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    monkeypatch.setattr(imdb.common, "download",
+                        lambda url, mod, md5: str(tar_path))
+
+    word_idx = imdb.build_dict(re.compile(r"aclImdb/train/.*\.txt$"), 0)
+    assert "great" in word_idx and "<unk>" in word_idx
+    samples = list(imdb.train(word_idx)())
+    assert len(samples) == 3
+    labels = [lb for _, lb in samples]
+    assert labels.count(0) == 2 and labels.count(1) == 1  # pos=0, neg=1
+    ids, _ = samples[0]
+    assert all(isinstance(i, int) for i in ids)
+
+
+def test_synthetic_dataset_shapes():
+    from paddle_tpu.dataset import synthetic
+
+    r = synthetic.images(n=5, shape=(3, 8, 8), classes=4, seed=0)
+    samples = list(r())
+    assert len(samples) == 5
+    im, lb = samples[0]
+    assert im.shape == (3, 8, 8) and 0 <= lb < 4
+    # deterministic per seed
+    again = list(synthetic.images(n=5, shape=(3, 8, 8), classes=4,
+                                  seed=0)())
+    np.testing.assert_array_equal(im, again[0][0])
+    xs, ys = next(iter(synthetic.regression(n=2, dim=6, seed=1)()))
+    assert xs.shape == (6,) and np.asarray(ys).size == 1

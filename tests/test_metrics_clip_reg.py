@@ -1,0 +1,198 @@
+"""Tests for metrics.py (streaming metric classes), clip.py (gradient
+clipping numerics), regularizer.py (L1/L2 decay) — VERDICT weak item 5
+named these untested."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics
+
+
+# ---------------------------------------------------------------- metrics
+
+def test_precision_recall_streaming():
+    p = metrics.Precision()
+    r = metrics.Recall()
+    preds1 = np.array([1, 1, 0, 1])
+    labels1 = np.array([1, 0, 1, 1])
+    preds2 = np.array([0, 1])
+    labels2 = np.array([0, 1])
+    for m in (p, r):
+        m.update(preds1, labels1)
+        m.update(preds2, labels2)
+    # tp=3, fp=1, fn=1
+    assert p.eval() == pytest.approx(3 / 4)
+    assert r.eval() == pytest.approx(3 / 4)
+
+
+def test_accuracy_weighted():
+    a = metrics.Accuracy()
+    a.update(0.5, 10)
+    a.update(1.0, 30)
+    assert a.eval() == pytest.approx((0.5 * 10 + 1.0 * 30) / 40)
+    with pytest.raises(Exception):
+        metrics.Accuracy().update(value=None, weight=None)
+
+
+def test_edit_distance_metric():
+    m = metrics.EditDistance()
+    m.update(np.array([[2.0], [0.0]]), 2)
+    m.update(np.array([[1.0]]), 1)
+    avg, err = m.eval()
+    assert avg == pytest.approx(3.0 / 3)
+    assert err == pytest.approx(2.0 / 3)
+
+
+def test_auc_against_sklearn_style_oracle():
+    rng = np.random.RandomState(0)
+    n = 500
+    labels = rng.randint(0, 2, n)
+    # informative scores
+    scores = np.clip(labels * 0.3 + rng.rand(n) * 0.7, 0, 1)
+    m = metrics.Auc(num_thresholds=4095)
+    m.update(scores, labels)
+    got = m.eval()
+
+    # oracle: exact ROC AUC via rank statistic
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    cmp = (pos[:, None] > neg[None, :]).sum() + \
+        0.5 * (pos[:, None] == neg[None, :]).sum()
+    want = cmp / (len(pos) * len(neg))
+    assert got == pytest.approx(want, abs=5e-3)
+
+
+def test_composite_metric():
+    c = metrics.CompositeMetric()
+    c.add_metric(metrics.Precision())
+    c.add_metric(metrics.Recall())
+    c.update(np.array([1, 0]), np.array([1, 1]))
+    prec, rec = c.eval()
+    assert prec == pytest.approx(1.0)
+    assert rec == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------- clipping
+
+def _train_once_with_clip(clip, lr=1.0):
+    """One SGD step on a linear model; returns (w_before, w_after, grad)."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 9
+        x = fluid.layers.data("x", shape=[3])
+        y = fluid.layers.fc(
+            x, size=1, act=None,
+            param_attr=fluid.ParamAttr(name="w_clip"),
+            bias_attr=False)
+        # big loss scale so unclipped grads exceed the thresholds
+        loss = fluid.layers.reduce_sum(y) * 100.0
+        if clip is not None:
+            fluid.clip.set_gradient_clip(clip)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            w0 = np.asarray(scope.var("w_clip")).copy()
+            xv = np.ones((2, 3), "float32")
+            exe.run(feed={"x": xv}, fetch_list=[loss])
+            w1 = np.asarray(scope.var("w_clip"))
+    # effective applied grad = (w0 - w1) / lr
+    return w0, w1, (w0 - w1) / lr
+
+
+def test_gradient_clip_by_value():
+    # unclipped grad of each w element = 100 * sum_b x_b = 200
+    _, _, g = _train_once_with_clip(
+        fluid.clip.GradientClipByValue(max=5.0))
+    np.testing.assert_allclose(g, np.full((3, 1), 5.0), rtol=1e-5)
+
+
+def test_gradient_clip_by_norm():
+    _, _, g = _train_once_with_clip(
+        fluid.clip.GradientClipByNorm(clip_norm=3.0))
+    assert np.linalg.norm(g) == pytest.approx(3.0, rel=1e-5)
+    # direction preserved: proportional to all-200 vector
+    np.testing.assert_allclose(g / np.linalg.norm(g),
+                               np.full((3, 1), 1 / np.sqrt(3)), rtol=1e-5)
+
+
+def test_gradient_clip_by_global_norm():
+    _, _, g = _train_once_with_clip(
+        fluid.clip.GradientClipByGlobalNorm(clip_norm=1.0))
+    assert np.linalg.norm(g) == pytest.approx(1.0, rel=1e-4)
+
+
+def test_no_clip_baseline():
+    _, _, g = _train_once_with_clip(None)
+    np.testing.assert_allclose(g, np.full((3, 1), 200.0), rtol=1e-4)
+
+
+def test_error_clip_by_value():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[2])
+        x.stop_gradient = False
+        y = x * 100.0
+        loss = fluid.layers.reduce_sum(y)
+        prog = fluid.default_main_program()
+        y_var = prog.global_block().var(y.name)
+        y_var.error_clip = fluid.clip.ErrorClipByValue(max=7.0)
+        grads = fluid.calc_gradient(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        (gx,) = exe.run(feed={"x": np.ones((2, 2), "float32")},
+                        fetch_list=grads)
+    # dloss/dy = 1 -> clip(1, 7) = 1 -> dx = 100; with max=0.005 it clips
+    np.testing.assert_allclose(gx, np.full((2, 2), 100.0), rtol=1e-5)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[2])
+        x.stop_gradient = False
+        y = x * 100.0
+        loss = fluid.layers.reduce_sum(y) * 5.0
+        prog = fluid.default_main_program()
+        prog.global_block().var(y.name).error_clip = \
+            fluid.clip.ErrorClipByValue(max=2.0)
+        grads = fluid.calc_gradient(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        (gx,) = exe.run(feed={"x": np.ones((2, 2), "float32")},
+                        fetch_list=grads)
+    # dloss/dy = 5 -> clipped to 2 -> dx = 200
+    np.testing.assert_allclose(gx, np.full((2, 2), 200.0), rtol=1e-5)
+
+
+# ------------------------------------------------------------ regularizer
+
+def _sgd_step_with_reg(reg, lr=0.1):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 10
+        x = fluid.layers.data("x", shape=[3])
+        y = fluid.layers.fc(x, size=1, act=None,
+                            param_attr=fluid.ParamAttr(
+                                name="w_reg", regularizer=reg),
+                            bias_attr=False)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            w0 = np.asarray(scope.var("w_reg")).copy()
+            xv = np.zeros((2, 3), "float32")   # data grad = 0
+            exe.run(feed={"x": xv}, fetch_list=[loss])
+            w1 = np.asarray(scope.var("w_reg"))
+    return w0, w1, lr
+
+
+def test_l2_decay_regularizer():
+    coeff = 0.5
+    w0, w1, lr = _sgd_step_with_reg(
+        fluid.regularizer.L2DecayRegularizer(regularization_coeff=coeff))
+    # zero data grad: w1 = w0 - lr * coeff * w0
+    np.testing.assert_allclose(w1, w0 * (1 - lr * coeff), rtol=1e-5)
+
+
+def test_l1_decay_regularizer():
+    coeff = 0.5
+    w0, w1, lr = _sgd_step_with_reg(
+        fluid.regularizer.L1DecayRegularizer(regularization_coeff=coeff))
+    np.testing.assert_allclose(w1, w0 - lr * coeff * np.sign(w0),
+                               rtol=1e-5)

@@ -32,6 +32,42 @@ def test_nce_cost_formula_and_training():
     assert all(np.isfinite(losses))
 
 
+def test_nce_backward_uses_same_samples_as_forward():
+    """The weight gradient must be nonzero ONLY on rows the forward
+    sampled (generic auto-vjp recompute must re-draw identical
+    negatives via the forward op's PRNG index)."""
+    rng = np.random.RandomState(7)
+    b, d, c = 4, 5, 30
+    xs = rng.rand(b, d).astype("float32")
+    ys = rng.randint(0, c, (b, 1)).astype("int64")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 2
+        fluid.default_main_program().random_seed = 2
+        x = fluid.layers.data("x", shape=[d])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        cost = fluid.layers.nce(x, label, num_total_classes=c,
+                                num_neg_samples=3, bias_attr=False,
+                                param_attr=fluid.ParamAttr(name="nce_w"))
+        avg = fluid.layers.mean(cost)
+        prog = fluid.default_main_program()
+        grads = fluid.calc_gradient(avg, [prog.global_block().var("nce_w")])
+        sample_labels = [op.outputs["SampleLabels"][0]
+                         for op in prog.global_block().ops
+                         if op.type == "nce"][0]
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            samples, gw = exe.run(feed={"x": xs, "label": ys},
+                                  fetch_list=[sample_labels, grads[0]])
+    sampled_rows = set(np.asarray(samples).ravel().tolist())
+    grad_rows = set(np.nonzero(np.abs(gw).sum(1) > 1e-12)[0].tolist())
+    assert grad_rows <= sampled_rows, (grad_rows, sampled_rows)
+    # bias_attr=False must not create a bias parameter
+    pnames = [p.name for p in prog.global_block().all_parameters()]
+    assert pnames == ["nce_w"], pnames
+
+
 def _py_hsigmoid(x, w, bias, label, num_classes):
     """Oracle from matrix_bit_code.h SimpleCode: c = label + num_classes,
     len = floor(log2(c)); node(bit) = (c >> (bit+1)) - 1, target = bit-th

@@ -174,7 +174,8 @@ class Executor:
         # cache) — id() could alias a recycled address after GC
         return (id(program), program._version, program.random_seed, feed_sig,
                 tuple(fetch_names), id(scope),
-                getattr(program, '_amp_policy', None))
+                getattr(program, '_amp_policy', None),
+                flags.flag("pallas_kernels"))  # trace-time kernel choice
 
     def _analyze(self, program, feed_names, scope):
         """Split program vars into feeds / state-from-scope / temporaries."""

@@ -105,6 +105,8 @@ def _on_cpu_deterministic(val):
 
 
 register_flag("check_nan_inf", False, bool)
+# opt-in hand-tiled Pallas kernels for hot ops (ops/pallas/)
+register_flag("pallas_kernels", False, bool)
 register_flag("debug_nans", False, bool, _on_debug_nans)
 register_flag("benchmark", False, bool)
 register_flag("cpu_deterministic", False, bool, _on_cpu_deterministic)

@@ -59,6 +59,18 @@ def _swce_infer(op, block):
 
 def _swce_compute(ins, attrs, ctx, op_index):
     logits, label = ins["Logits"][0], ins["Label"][0]
+    if not attrs.get("soft_label", False) and \
+            attrs.get("ignore_index", -100) < 0:
+        from ..flags import flag
+        if flag("pallas_kernels"):
+            # opt-in hand-tiled kernel (ops/pallas/softmax_xent.py)
+            from .pallas import interpret_mode, softmax_xent as px
+            flat = logits.reshape(-1, logits.shape[-1])
+            lbl = label.reshape(-1)
+            loss, softmax = px.softmax_xent(flat, lbl,
+                                            interpret_mode())
+            return {"Softmax": softmax.reshape(logits.shape),
+                    "Loss": loss.reshape(logits.shape[:-1] + (1,))}
     log_sm = jax.nn.log_softmax(logits, axis=-1)
     softmax = jnp.exp(log_sm)
     if attrs.get("soft_label", False):

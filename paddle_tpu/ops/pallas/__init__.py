@@ -1,0 +1,61 @@
+"""Pallas TPU kernels — alternative compute bodies for hot ops.
+
+The op registry's kernels are pure JAX (``registry.py``); the modules
+here provide hand-tiled Pallas implementations for ops where explicit
+VMEM staging/fusion can beat XLA's automatic fusion (SURVEY.md §7 hot-op
+list: softmax_with_cross_entropy, layer_norm).
+
+Selection: ``enabled()`` is controlled by the ``pallas_kernels`` runtime
+flag (FLAGS_pallas_kernels env); default off — measurements on v5e
+(see bench notes in each module) show XLA's fused code is already at
+parity for these shapes, so the Pallas path is an opt-in escape hatch
+and the reference implementation for writing further kernels (ring
+attention etc.).  On CPU the kernels run in interpreter mode, which the
+tests use for numerical parity checks.
+"""
+
+import jax
+
+from ... import flags  # flag "pallas_kernels" is declared in flags.py
+
+
+def on_tpu():
+    try:
+        return any(d.platform == "tpu" for d in jax.local_devices())
+    except RuntimeError:  # backend not initialized yet
+        return False
+
+
+def enabled():
+    return flags.flag("pallas_kernels")
+
+
+def interpret_mode():
+    """Interpreter fallback for non-TPU backends (tests on CPU)."""
+    return not on_tpu()
+
+
+def block_rows(n, row_bytes, max_rows, vmem_budget=4 * 1024 * 1024):
+    """Pick a row-block size and the padded row count for a [n, ...]
+    kernel: fit ``row_bytes`` per row into the VMEM budget, then pad n
+    UP to a multiple of the block (an exact-divisor search would
+    degenerate to 1-row blocks for prime n).  Returns (bn, n_padded);
+    callers zero-pad inputs to n_padded and slice outputs back to n.
+    """
+    bn = max(1, vmem_budget // max(row_bytes, 1))
+    bn = min(bn, max(n, 1), max_rows)
+    n_padded = ((n + bn - 1) // bn) * bn
+    return bn, n_padded
+
+
+def pad_rows(a, n_padded):
+    """Zero-pad dim 0 of ``a`` to n_padded rows."""
+    import jax.numpy as jnp
+
+    n = a.shape[0]
+    if n == n_padded:
+        return a
+    return jnp.pad(a, [(0, n_padded - n)] + [(0, 0)] * (a.ndim - 1))
+
+
+from . import softmax_xent, layer_norm  # noqa: E402,F401

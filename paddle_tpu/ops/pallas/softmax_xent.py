@@ -1,0 +1,109 @@
+"""Fused softmax + cross-entropy Pallas kernel.
+
+Parity target: reference ``softmax_with_cross_entropy_op.cc`` (the fused
+hot op) — forward emits per-row loss AND the softmax; backward is the
+hand-fused kernel combining the loss cotangent path
+``(softmax - onehot) * dloss`` (``softmax_with_cross_entropy_op.cu``)
+with the softmax-output cotangent path
+``softmax * (dsm - sum(dsm * softmax))`` so downstream consumers of the
+Softmax output (e.g. entropy regularizers) differentiate correctly.
+
+Kernel design (pallas_guide.md): grid over row-blocks; each step stages
+a ``[BN, C]`` logits tile in VMEM, computes max/exp/sum on the VPU and
+writes loss + softmax without an HBM round-trip between the stages XLA
+would otherwise schedule separately.  Rows are zero-padded up to a block
+multiple and sliced back (see __init__.block_rows).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import block_rows, pad_rows
+
+
+def _fwd_kernel(logits_ref, label_ref, loss_ref, softmax_ref):
+    x = logits_ref[...]                      # [BN, C]
+    lbl = label_ref[...]                     # [BN]
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    s = jnp.sum(e, axis=-1, keepdims=True)
+    softmax = e / s
+    log_z = jnp.log(s) + m                   # [BN, 1]
+    c = x.shape[-1]
+    onehot = lbl[:, None] == jax.lax.broadcasted_iota(jnp.int32,
+                                                      (1, c), 1)
+    picked = jnp.sum(jnp.where(onehot, x, 0.0), axis=-1, keepdims=True)
+    loss_ref[...] = log_z - picked
+    softmax_ref[...] = softmax
+
+
+def _bwd_kernel(softmax_ref, label_ref, dloss_ref, dsm_ref, dlogits_ref):
+    sm = softmax_ref[...]
+    lbl = label_ref[...]
+    g = dloss_ref[...]                       # [BN, 1]
+    dsm = dsm_ref[...]                       # [BN, C]
+    c = sm.shape[-1]
+    onehot = (lbl[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, c),
+                                                       1)).astype(sm.dtype)
+    # loss path + softmax-output path (softmax Jacobian-vector product)
+    inner = jnp.sum(dsm * sm, axis=-1, keepdims=True)
+    dlogits_ref[...] = (sm - onehot) * g + sm * (dsm - inner)
+
+
+def _specs(bn, c):
+    return [pl.BlockSpec((bn, c), lambda i: (i, 0)),
+            pl.BlockSpec((bn,), lambda i: (i,))]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def softmax_xent(logits, label, interpret=False):
+    loss, softmax = _fwd(logits, label, interpret)[0]
+    return loss, softmax
+
+
+def _fwd(logits, label, interpret):
+    n, c = logits.shape
+    if n == 0:
+        z = jnp.zeros((0, 1), logits.dtype), jnp.zeros((0, c),
+                                                       logits.dtype)
+        return z, (z[1], label)
+    bn, n_pad = block_rows(n, row_bytes=2 * c * 4, max_rows=256)
+    loss, softmax = pl.pallas_call(
+        _fwd_kernel,
+        grid=(n_pad // bn,),
+        in_specs=_specs(bn, c),
+        out_specs=[pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((bn, c), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n_pad, 1), logits.dtype),
+                   jax.ShapeDtypeStruct((n_pad, c), logits.dtype)],
+        interpret=interpret,
+    )(pad_rows(logits, n_pad), pad_rows(label.astype(jnp.int32), n_pad))
+    loss, softmax = loss[:n], softmax[:n]
+    return (loss, softmax), (softmax, label)
+
+
+def _bwd(interpret, res, cts):
+    softmax, label = res
+    dloss, dsm = cts
+    n, c = softmax.shape
+    if n == 0:
+        return jnp.zeros((0, c), softmax.dtype), None
+    bn, n_pad = block_rows(n, row_bytes=3 * c * 4, max_rows=256)
+    dlogits = pl.pallas_call(
+        _bwd_kernel,
+        grid=(n_pad // bn,),
+        in_specs=_specs(bn, c) + [
+            pl.BlockSpec((bn, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bn, c), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bn, c), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_pad, c), softmax.dtype),
+        interpret=interpret,
+    )(pad_rows(softmax, n_pad), pad_rows(label.astype(jnp.int32), n_pad),
+      pad_rows(dloss, n_pad), pad_rows(dsm, n_pad))
+    return dlogits[:n], None
+
+
+softmax_xent.defvjp(_fwd, _bwd)

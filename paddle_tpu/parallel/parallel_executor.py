@@ -258,6 +258,7 @@ class ParallelExecutor:
         # no id()-reuse aliasing after GC)
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
                id(scope), getattr(program, '_amp_policy', None),
+               flags.flag("pallas_kernels"),
                self._build_strategy.reduce_strategy,
                self._build_strategy.param_sharding_fn,
                self._build_strategy.feed_sharding_fn)

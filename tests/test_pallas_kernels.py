@@ -1,0 +1,165 @@
+"""Pallas kernel parity tests: the hand-tiled softmax_with_cross_entropy
+and layer_norm bodies (ops/pallas/) must match the pure-JAX registry
+kernels bit-for-tolerance, forward and backward, on the CPU interpreter
+(pallas interpret mode)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _train_step_losses(use_pallas, steps=5):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 3
+        x = fluid.layers.data("x", shape=[32])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=64, act=None)
+        h = fluid.layers.layer_norm(h)
+        h = fluid.layers.relu(h)
+        logits = fluid.layers.fc(h, size=10, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        rng = np.random.RandomState(0)
+        xs = rng.rand(16, 32).astype("float32")
+        ys = rng.randint(0, 10, (16, 1)).astype("int64")
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            fluid.set_flags({"FLAGS_pallas_kernels": use_pallas})
+            try:
+                losses = [float(exe.run(feed={"x": xs, "label": ys},
+                                        fetch_list=[loss])[0].ravel()[0])
+                          for _ in range(steps)]
+            finally:
+                fluid.set_flags({"FLAGS_pallas_kernels": False})
+    return losses
+
+
+def test_pallas_training_matches_xla_path():
+    ref = _train_step_losses(False)
+    pal = _train_step_losses(True)
+    np.testing.assert_allclose(pal, ref, rtol=1e-4)
+
+
+def test_pallas_softmax_xent_forward_backward_parity():
+    from paddle_tpu.ops.pallas import softmax_xent as px
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    logits = rng.randn(24, 50).astype("float32") * 3
+    label = rng.randint(0, 50, (24,))
+
+    def pallas_loss(lg):
+        loss, _ = px.softmax_xent(lg, jnp.asarray(label), True)
+        return jnp.sum(loss)
+
+    def ref_loss(lg):
+        ls = jax.nn.log_softmax(lg, axis=-1)
+        return -jnp.sum(jnp.take_along_axis(ls, jnp.asarray(label)[:, None],
+                                            axis=-1))
+
+    lv_p, g_p = jax.value_and_grad(pallas_loss)(jnp.asarray(logits))
+    lv_r, g_r = jax.value_and_grad(ref_loss)(jnp.asarray(logits))
+    assert float(lv_p) == pytest.approx(float(lv_r), rel=1e-5)
+    np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_r),
+                               atol=1e-5)
+
+
+def test_pallas_softmax_cotangent_through_softmax_output():
+    """Gradient must be right when the SOFTMAX output (not just the
+    loss) is consumed downstream — the Jacobian-vector-product path."""
+    from paddle_tpu.ops.pallas import softmax_xent as px
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(3)
+    logits = rng.randn(6, 9).astype("float32")
+    label = jnp.asarray(rng.randint(0, 9, (6,)))
+
+    def pallas_obj(lg):
+        loss, sm = px.softmax_xent(lg, label, True)
+        return jnp.sum(loss) + jnp.sum(sm ** 2)
+
+    def ref_obj(lg):
+        ls = jax.nn.log_softmax(lg, axis=-1)
+        sm = jnp.exp(ls)
+        loss = -jnp.take_along_axis(ls, label[:, None], axis=-1)
+        return jnp.sum(loss) + jnp.sum(sm ** 2)
+
+    g_p = jax.grad(pallas_obj)(jnp.asarray(logits))
+    g_r = jax.grad(ref_obj)(jnp.asarray(logits))
+    np.testing.assert_allclose(np.asarray(g_p), np.asarray(g_r),
+                               atol=1e-5)
+
+
+def test_pallas_handles_odd_and_empty_row_counts():
+    from paddle_tpu.ops.pallas import layer_norm as pln
+    from paddle_tpu.ops.pallas import softmax_xent as px
+    import jax.numpy as jnp
+
+    # prime row count must not degenerate or crash (padding path)
+    x = np.random.RandomState(4).randn(13, 20).astype("float32")
+    g = np.ones(20, "float32")
+    b = np.zeros(20, "float32")
+    y = pln.layer_norm(jnp.asarray(x), jnp.asarray(g), jnp.asarray(b),
+                       1e-5, True)
+    mu = x.mean(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(y), want, atol=1e-5)
+    # empty batch returns empty outputs, no ZeroDivisionError
+    loss, sm = px.softmax_xent(jnp.zeros((0, 7)), jnp.zeros((0,),
+                                                            jnp.int32),
+                               True)
+    assert loss.shape == (0, 1) and sm.shape == (0, 7)
+    assert pln.layer_norm(jnp.zeros((0, 5)), jnp.ones(5), jnp.zeros(5),
+                          1e-5, True).shape == (0, 5)
+
+
+def test_flag_toggle_recompiles_cached_program():
+    """Toggling FLAGS_pallas_kernels must not reuse the stale compiled
+    function (the flag is part of the executor cache key)."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[4])
+        out = fluid.layers.softmax(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = np.random.rand(2, 4).astype("float32")
+        exe.run(feed={"x": xv}, fetch_list=[out])
+        n_before = len(exe._cache)
+        fluid.set_flags({"FLAGS_pallas_kernels": True})
+        try:
+            exe.run(feed={"x": xv}, fetch_list=[out])
+        finally:
+            fluid.set_flags({"FLAGS_pallas_kernels": False})
+        assert len(exe._cache) == n_before + 1
+
+
+def test_pallas_layer_norm_forward_backward_parity():
+    from paddle_tpu.ops.pallas import layer_norm as pln
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(16, 40).astype("float32")
+    gamma = rng.rand(40).astype("float32") + 0.5
+    beta = rng.randn(40).astype("float32")
+
+    def pallas_fn(x_, g_, b_):
+        return jnp.sum(pln.layer_norm(x_, g_, b_, 1e-5, True) ** 2)
+
+    def ref_fn(x_, g_, b_):
+        mu = jnp.mean(x_, -1, keepdims=True)
+        var = jnp.mean((x_ - mu) ** 2, -1, keepdims=True)
+        y = (x_ - mu) * jax.lax.rsqrt(var + 1e-5) * g_ + b_
+        return jnp.sum(y ** 2)
+
+    args = (jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta))
+    v_p, g_p = jax.value_and_grad(pallas_fn, argnums=(0, 1, 2))(*args)
+    v_r, g_r = jax.value_and_grad(ref_fn, argnums=(0, 1, 2))(*args)
+    assert float(v_p) == pytest.approx(float(v_r), rel=1e-5)
+    for a, b in zip(g_p, g_r):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4)

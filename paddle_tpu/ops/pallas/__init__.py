@@ -5,8 +5,9 @@ here provide hand-tiled Pallas implementations for ops where explicit
 VMEM staging/fusion can beat XLA's automatic fusion (SURVEY.md §7 hot-op
 list: softmax_with_cross_entropy, layer_norm).
 
-Selection: ``enabled()`` is controlled by the ``pallas_kernels`` runtime
-flag (FLAGS_pallas_kernels env); default off — measurements on v5e
+Selection: gated at each call site by the ``pallas_kernels`` runtime
+flag (``flags.flag("pallas_kernels")`` / FLAGS_pallas_kernels env, part
+of the executor compile-cache key); default off — measurements on v5e
 (see bench notes in each module) show XLA's fused code is already at
 parity for these shapes, so the Pallas path is an opt-in escape hatch
 and the reference implementation for writing further kernels (ring
@@ -24,10 +25,6 @@ def on_tpu():
         return any(d.platform == "tpu" for d in jax.local_devices())
     except RuntimeError:  # backend not initialized yet
         return False
-
-
-def enabled():
-    return flags.flag("pallas_kernels")
 
 
 def interpret_mode():

@@ -12,3 +12,4 @@ from .mesh import make_mesh  # noqa: F401
 from .strategy import BuildStrategy, ExecutionStrategy  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from .embedding import distributed_embedding_sharding_fn  # noqa: F401
+from . import checkpoint  # noqa: F401

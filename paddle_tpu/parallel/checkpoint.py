@@ -1,0 +1,152 @@
+"""Sharded / async checkpointing for the mesh runtime (orbax-backed).
+
+Parity: the reference's checkpoint tier at distributed scale —
+``save_op``/``load_op`` + ``fluid/io.py`` handle host tensors
+(mirrored by ``paddle_tpu.io``); the *distributed* story there is
+pserver-side shard checkpoints triggered by ``checkpoint_notify_op.cc``
+and the Go pserver's periodic shard snapshots
+(``go/pserver/service.go:346 checkpoint``, ``:175 LoadCheckpoint``).
+TPU-native redesign: parameters live sharded on the mesh, so the
+checkpoint IS the sharded artifact — orbax writes each host's shards in
+parallel (OCDBT), restore re-shards onto the current mesh (even a mesh
+of a different shape/size, the elastic-resume case), and saves can be
+async so the train loop overlaps the write (the pserver's
+"snapshot while serving" behavior).
+
+Works with the Scope/Program model: persistable vars are the pytree.
+"""
+
+import os
+
+import jax
+import numpy as np
+
+from ..scope import global_scope
+
+__all__ = ["save_sharded", "load_sharded", "ShardedCheckpointManager"]
+
+
+def _persistable_state(scope, program=None):
+    """dict name -> array of the checkpointable vars."""
+    from ..framework import default_main_program
+
+    program = program or default_main_program()
+    state = {}
+    for var in program.global_block().vars.values():
+        if getattr(var, "persistable", False) and scope.has_var(var.name):
+            state[var.name] = scope.var(var.name)
+    return state
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.StandardCheckpointer()
+
+
+def _require_state(state, action):
+    if not state:
+        raise ValueError(
+            "no persistable state in scope to %s: run the startup "
+            "program first so the var set and shapes/dtypes exist"
+            % action)
+
+
+def _abstract_state(state, shardings):
+    """ShapeDtypeStruct restore targets (optionally mesh-placed)."""
+
+    def one(name, v):
+        arr = np.asarray(v) if not isinstance(v, jax.Array) else v
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype,
+                                    sharding=(shardings or {}).get(name))
+
+    return {n: one(n, v) for n, v in state.items()}
+
+
+def save_sharded(dirname, scope=None, program=None):
+    """Write the persistable state as a sharded orbax checkpoint.
+    Each process writes only its addressable shards (multi-host safe)."""
+    scope = scope or global_scope()
+    state = _persistable_state(scope, program)
+    _require_state(state, "save")
+    ckptr = _checkpointer()
+    ckptr.save(os.path.abspath(dirname), state, force=True)
+    ckptr.wait_until_finished()
+    return sorted(state)
+
+
+def load_sharded(dirname, scope=None, program=None, shardings=None):
+    """Restore a sharded checkpoint into the scope.
+
+    ``shardings``: optional dict name -> jax.sharding.Sharding to place
+    restored arrays directly onto the current mesh (possibly a different
+    topology than the one that saved — the elastic-resume case).
+    Without it arrays restore as host-local numpy."""
+    import orbax.checkpoint as ocp
+
+    scope = scope or global_scope()
+    state = _persistable_state(scope, program)
+    _require_state(state, "restore into")
+    ckptr = _checkpointer()
+    restored = ckptr.restore(os.path.abspath(dirname),
+                             _abstract_state(state, shardings))
+    for name, val in restored.items():
+        scope.set_var(name, val)
+    return sorted(restored)
+
+
+class ShardedCheckpointManager:
+    """Step-indexed async checkpoint rotation (CheckpointConfig's
+    epoch/step-interval + max_num_checkpoints at mesh scale;
+    go/pserver periodic-shard-checkpoint parity)."""
+
+    def __init__(self, dirname, max_to_keep=3, save_interval_steps=1,
+                 async_save=True):
+        import orbax.checkpoint as ocp
+
+        self._options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save)
+        self._mgr = ocp.CheckpointManager(
+            os.path.abspath(dirname), options=self._options)
+
+    def save(self, step, scope=None, program=None):
+        """Maybe-save (interval-gated) at ``step``; async by default."""
+        import orbax.checkpoint as ocp
+
+        state = _persistable_state(scope or global_scope(), program)
+        _require_state(state, "save")
+        return self._mgr.save(step, args=ocp.args.StandardSave(state))
+
+    def restore(self, scope=None, program=None, step=None,
+                shardings=None):
+        """Restore ``step`` (default: latest). Returns the step or None
+        if no checkpoint exists."""
+        import orbax.checkpoint as ocp
+
+        scope = scope or global_scope()
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return None
+        state = _persistable_state(scope, program)
+        _require_state(state, "restore into")
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(
+                _abstract_state(state, shardings)))
+        for name, val in restored.items():
+            scope.set_var(name, val)
+        return step
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()

@@ -49,6 +49,8 @@ from .transpiler import (
     release_memory,
 )
 from . import cloud
+from . import recordio
+from . import recordio_writer
 from .flags import set_flags, get_flags
 
 __version__ = "0.1.0"
@@ -65,4 +67,5 @@ __all__ = [
     "dataset", "batch", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "InferenceTranspiler",
     "memory_optimize", "release_memory", "cloud", "set_flags", "get_flags",
+    "recordio", "recordio_writer",
 ]

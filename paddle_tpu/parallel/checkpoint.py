@@ -115,6 +115,8 @@ class ShardedCheckpointManager:
         """Maybe-save (interval-gated) at ``step``; async by default."""
         import orbax.checkpoint as ocp
 
+        if not self._mgr.should_save(step):
+            return False  # interval-gated: skip the state walk entirely
         state = _persistable_state(scope or global_scope(), program)
         _require_state(state, "save")
         return self._mgr.save(step, args=ocp.args.StandardSave(state))

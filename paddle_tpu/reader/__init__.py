@@ -13,6 +13,7 @@ import queue
 import threading
 
 from .decorator import *  # noqa: F401,F403
+from . import creator  # noqa: F401
 from . import decorator  # noqa: F401
 
 __all__ = decorator.__all__ + ["PyReader", "batch"]

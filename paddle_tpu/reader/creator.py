@@ -1,0 +1,45 @@
+"""Reader creators (reference ``python/paddle/reader/creator.py:19``:
+np_array, text_file, recordio)."""
+
+import pickle
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+
+def np_array(x):
+    """Yield rows of a numpy array."""
+    import numpy as np
+
+    arr = np.asarray(x)
+
+    def reader():
+        yield from arr
+
+    return reader
+
+
+def text_file(path):
+    """Yield lines of a text file, newline-stripped."""
+
+    def reader():
+        with open(path, "r") as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Yield unpickled samples from record files written by
+    ``recordio.convert_reader_to_recordio_file``, read ahead through a
+    ``buf_size`` buffer thread (reference creator.py:60)."""
+    from .. import recordio as rio
+    from .decorator import buffered
+
+    raw = rio.reader_creator(paths)
+
+    def reader():
+        for rec in raw():
+            yield pickle.loads(rec)
+
+    return buffered(reader, buf_size)

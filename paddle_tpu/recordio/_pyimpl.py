@@ -1,0 +1,105 @@
+"""Pure-python codec of the SAME on-disk chunk format as
+``librecordio.cpp`` — compiler-free fallback and the cross-check oracle
+for the native path (tests write with one and read with the other).
+
+Layout (see librecordio.cpp):
+  chunk := magic:u32 | compressor:u32 | num_records:u32
+           | uncompressed_len:u64 | payload_len:u64 | crc32:u32
+           | payload
+  payload (raw) := (len:u32 | bytes)*
+"""
+
+import struct
+import zlib
+
+MAGIC = 0x50545230
+_HDR = struct.Struct("<IIIQQI")
+
+
+class PyWriter:
+    def __init__(self, path, compressor=1, max_chunk_bytes=1 << 20):
+        self._f = open(path, "wb")
+        self._comp = compressor
+        self._max = max_chunk_bytes
+        self._buf = bytearray()
+        self._n = 0
+
+    def write(self, record):
+        self._buf += struct.pack("<I", len(record))
+        self._buf += record
+        self._n += 1
+        if len(self._buf) >= self._max:
+            self.flush_chunk()
+
+    def flush_chunk(self):
+        if not self._n:
+            return
+        raw = bytes(self._buf)
+        payload = zlib.compress(raw) if self._comp == 1 else raw
+        self._f.write(_HDR.pack(MAGIC, self._comp, self._n, len(raw),
+                                len(payload),
+                                zlib.crc32(payload) & 0xFFFFFFFF))
+        self._f.write(payload)
+        self._buf = bytearray()
+        self._n = 0
+
+    def close(self):
+        self.flush_chunk()
+        self._f.close()
+
+
+def _read_header(f):
+    blob = f.read(_HDR.size)
+    if not blob:
+        return None
+    if len(blob) != _HDR.size:
+        raise IOError("truncated chunk header")
+    magic, comp, n, raw_len, payload_len, crc = _HDR.unpack(blob)
+    if magic != MAGIC:
+        raise IOError("bad magic: not a recordio file")
+    return comp, n, raw_len, payload_len, crc
+
+
+class PyScanner:
+    def __init__(self, path, skip_chunks=0):
+        self._f = open(path, "rb")
+        for _ in range(skip_chunks):
+            h = _read_header(self._f)
+            if h is None:
+                break
+            self._f.seek(h[3], 1)
+
+    def __iter__(self):
+        while True:
+            h = _read_header(self._f)
+            if h is None:
+                return
+            comp, n, raw_len, payload_len, crc = h
+            payload = self._f.read(payload_len)
+            if len(payload) != payload_len:
+                raise IOError("truncated chunk payload")
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise IOError("chunk crc mismatch")
+            raw = zlib.decompress(payload) if comp == 1 else payload
+            if len(raw) != raw_len:
+                raise IOError("chunk length mismatch")
+            pos = 0
+            for _ in range(n):
+                (rec_len,) = struct.unpack_from("<I", raw, pos)
+                pos += 4
+                yield raw[pos:pos + rec_len]
+                pos += rec_len
+
+    def close(self):
+        self._f.close()
+
+
+def py_num_chunks(path):
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            h = _read_header(f)
+            if h is None:
+                return n
+            f.seek(h[3], 1)
+            n += 1

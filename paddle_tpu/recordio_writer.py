@@ -1,0 +1,7 @@
+"""fluid.recordio_writer API parity
+(reference ``python/paddle/fluid/recordio_writer.py``): thin re-export
+over the native record-file codec in ``paddle_tpu.recordio``."""
+
+from .recordio import Writer, convert_reader_to_recordio_file  # noqa: F401
+
+__all__ = ["Writer", "convert_reader_to_recordio_file"]

@@ -1,10 +1,14 @@
 """Dataset package (reference ``python/paddle/dataset/``: mnist, cifar,
-imdb, uci_housing, imikolov, movielens, wmt14/16, flowers... with
-download+cache).  Loaders parse the standard archives from the cache dir
-(common.DATA_HOME); ``synthetic`` provides offline generators."""
+imdb, uci_housing, imikolov, movielens, wmt14/16, conll05, flowers,
+sentiment, voc2012 with download+cache).  Loaders parse the standard
+archives from the cache dir (common.DATA_HOME); ``synthetic`` provides
+offline generators."""
 
 from . import common, mnist, cifar, imdb, uci_housing, imikolov  # noqa: F401
+from . import conll05, movielens, wmt14, wmt16  # noqa: F401
+from . import flowers, sentiment, voc2012  # noqa: F401
 from . import synthetic  # noqa: F401
 
 __all__ = ["common", "mnist", "cifar", "imdb", "uci_housing", "imikolov",
-           "synthetic"]
+           "conll05", "movielens", "wmt14", "wmt16", "flowers",
+           "sentiment", "voc2012", "synthetic"]

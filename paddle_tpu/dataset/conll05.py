@@ -1,0 +1,179 @@
+"""CoNLL-2005 semantic-role-labeling reader (reference
+``python/paddle/dataset/conll05.py``: gzipped words/props column files
+inside a tarball; prop bracket tags expand to B-/I-/O sequences; samples
+are the 8 SRL feature sequences + label ids)."""
+
+import gzip
+import tarfile
+
+from . import common
+
+__all__ = ["test", "get_dict", "get_embedding", "corpus_reader",
+           "reader_creator"]
+
+DATA_URL = ("http://paddlemodels.bj.bcebos.com/conll05st/"
+            "conll05st-tests.tar.gz")
+DATA_MD5 = "387719152ae52d60422c016e92a742fc"
+WORDDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st/wordDict.txt"
+WORDDICT_MD5 = "ea7fb7d4c75cc6254716f0177a506baa"
+VERBDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st/verbDict.txt"
+VERBDICT_MD5 = "0d2977293bbb6cbefab5b0f97db1e77c"
+TRGDICT_URL = "http://paddlemodels.bj.bcebos.com/conll05st/targetDict.txt"
+TRGDICT_MD5 = "d8c7f03ceb5fc2e5a0fa7503a4353751"
+EMB_URL = "http://paddlemodels.bj.bcebos.com/conll05st/emb"
+EMB_MD5 = "bf436eb0faa1f6f9103017f8be57cdb7"
+
+UNK_IDX = 0
+
+
+def load_label_dict(filename):
+    """Expand the label list: B-x/I-x for starred tags, O (reference
+    load_label_dict, conll05.py:48)."""
+    d = {}
+    tag_dict = set()
+    with open(filename) as f:
+        for line in f:
+            line = line.strip()
+            if line.startswith("B-"):
+                tag_dict.add(line[2:])
+            elif line.startswith("I-"):
+                tag_dict.add(line[2:])
+    index = 0
+    for tag in sorted(tag_dict):
+        d["B-" + tag] = index
+        index += 1
+        d["I-" + tag] = index
+        index += 1
+    d["O"] = index
+    return d
+
+
+def load_dict(filename):
+    d = {}
+    with open(filename) as f:
+        for i, line in enumerate(f):
+            d[line.strip()] = i
+    return d
+
+
+def _expand_bracket_labels(lbl):
+    """One predicate's prop column -> B-/I-/O tag sequence (reference
+    corpus_reader's bracket state machine, conll05.py:110-133)."""
+    out = []
+    cur_tag = "O"
+    in_bracket = False
+    for token in lbl:
+        if token == "*" and not in_bracket:
+            out.append("O")
+        elif token == "*" and in_bracket:
+            out.append("I-" + cur_tag)
+        elif token == "*)":
+            out.append("I-" + cur_tag)
+            in_bracket = False
+        elif "(" in token and ")" in token:
+            cur_tag = token[1:token.find("*")]
+            out.append("B-" + cur_tag)
+            in_bracket = False
+        elif "(" in token:
+            cur_tag = token[1:token.find("*")]
+            out.append("B-" + cur_tag)
+            in_bracket = True
+        else:
+            raise RuntimeError("unexpected label token %r" % token)
+    return out
+
+
+def corpus_reader(data_path, words_name, props_name):
+    """Yield (sentence words, verb, B/I/O tag sequence) per predicate."""
+
+    def reader():
+        with tarfile.open(data_path) as tf, \
+                gzip.GzipFile(fileobj=tf.extractfile(words_name)) as wf, \
+                gzip.GzipFile(fileobj=tf.extractfile(props_name)) as pf:
+            sentence = []
+            prop_cols = []
+            for wline, pline in zip(wf, pf):
+                word = wline.decode("utf-8").strip()
+                props = pline.decode("utf-8").strip().split()
+                if not props:  # sentence boundary
+                    if prop_cols:
+                        n_cols = len(prop_cols[0])
+                        cols = [[row[i] for row in prop_cols]
+                                for i in range(n_cols)]
+                        verbs = [v for v in cols[0] if v != "-"]
+                        for i, lbl in enumerate(cols[1:]):
+                            yield (sentence, verbs[i],
+                                   _expand_bracket_labels(lbl))
+                    sentence = []
+                    prop_cols = []
+                else:
+                    sentence.append(word)
+                    prop_cols.append(props)
+
+    return reader
+
+
+def reader_creator(corpus_rdr, word_dict=None, verb_dict=None,
+                   label_dict=None):
+    """Map corpus samples to the 8 SRL input sequences + label ids
+    (reference reader_creator, conll05.py:150): word, ctx_n2/n1/0/p1/p2,
+    verb, mark, label."""
+    w = word_dict or {}
+    v = verb_dict or {}
+    lbl = label_dict or {}
+
+    def reader():
+        for sentence, predicate, labels in corpus_rdr():
+            sen_len = len(sentence)
+            verb_index = labels.index("B-V")
+            mark = [0] * sen_len
+            # context window around the predicate
+            if verb_index > 0:
+                mark[verb_index - 1] = 1
+            mark[verb_index] = 1
+            if verb_index < sen_len - 1:
+                mark[verb_index + 1] = 1
+
+            ctx_n2 = sentence[verb_index - 2] if verb_index > 1 else "bos"
+            ctx_n1 = sentence[verb_index - 1] if verb_index > 0 else "bos"
+            ctx_0 = sentence[verb_index]
+            ctx_p1 = sentence[verb_index + 1] \
+                if verb_index < sen_len - 1 else "eos"
+            ctx_p2 = sentence[verb_index + 2] \
+                if verb_index < sen_len - 2 else "eos"
+
+            word_idx = [w.get(x, UNK_IDX) for x in sentence]
+            pred_idx = [v.get(predicate, UNK_IDX)] * sen_len
+            label_idx = [lbl[x] for x in labels]
+            yield (word_idx,
+                   [w.get(ctx_n2, UNK_IDX)] * sen_len,
+                   [w.get(ctx_n1, UNK_IDX)] * sen_len,
+                   [w.get(ctx_0, UNK_IDX)] * sen_len,
+                   [w.get(ctx_p1, UNK_IDX)] * sen_len,
+                   [w.get(ctx_p2, UNK_IDX)] * sen_len,
+                   pred_idx, mark, label_idx)
+
+    return reader
+
+
+def get_dict():
+    word_dict = load_dict(
+        common.download(WORDDICT_URL, "conll05st", WORDDICT_MD5))
+    verb_dict = load_dict(
+        common.download(VERBDICT_URL, "conll05st", VERBDICT_MD5))
+    label_dict = load_label_dict(
+        common.download(TRGDICT_URL, "conll05st", TRGDICT_MD5))
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    return common.download(EMB_URL, "conll05st", EMB_MD5)
+
+
+def test():
+    word_dict, verb_dict, label_dict = get_dict()
+    data = common.download(DATA_URL, "conll05st", DATA_MD5)
+    words = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+    props = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+    return reader_creator(corpus_reader(data, words, props),
+                          word_dict, verb_dict, label_dict)

@@ -1,0 +1,90 @@
+"""Oxford-102 flowers reader creators (reference
+``python/paddle/dataset/flowers.py``: jpeg tarball + imagelabels.mat +
+setid.mat; samples are (float32 CHW image in [0,1], label int in
+[0,101]))."""
+
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "valid", "reader_creator"]
+
+DATA_URL = ("http://paddlemodels.bj.bcebos.com/flowers/102flowers.tgz")
+DATA_MD5 = "52808999861908f626f3c1f4e79d11fa"
+LABEL_URL = ("http://paddlemodels.bj.bcebos.com/flowers/imagelabels.mat")
+LABEL_MD5 = "e0620be6f572b9609742df49c70aed4d"
+SETID_URL = ("http://paddlemodels.bj.bcebos.com/flowers/setid.mat")
+SETID_MD5 = "a5357ecc9cb78c4bef273ce3793fc85c"
+
+# NOTE: deliberately swapped, matching the reference (flowers.py:59-60):
+# the 6149-image 'tstid' split is used for TRAINING and the 1020-image
+# 'trnid' split for testing
+TRAIN_FLAG = "tstid"
+TEST_FLAG = "trnid"
+VALID_FLAG = "valid"
+
+
+def _load_image(blob, resize=96):
+    """jpeg bytes -> float32 CHW in [0,1], center-cropped square then
+    resized (reference simple_transform capability via PIL)."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(blob)).convert("RGB")
+    w, h = img.size
+    s = min(w, h)
+    img = img.crop(((w - s) // 2, (h - s) // 2,
+                    (w + s) // 2, (h + s) // 2))
+    img = img.resize((resize, resize))
+    arr = np.asarray(img, dtype="float32") / 255.0
+    return arr.transpose(2, 0, 1)
+
+
+def reader_creator(data_file, label_file, setid_file, flag, resize=96,
+                   sample_limit=None):
+    """Iterate the split's image ids from setid.mat, read jpegs from the
+    tar, labels (1..102 -> 0..101) from imagelabels.mat."""
+    import scipy.io
+
+    def reader():
+        ids = scipy.io.loadmat(setid_file)[flag].ravel()
+        labels = scipy.io.loadmat(label_file)["labels"].ravel()
+        with tarfile.open(data_file) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            count = 0
+            for image_id in ids:
+                name = "jpg/image_%05d.jpg" % image_id
+                if name not in members:
+                    continue
+                blob = tf.extractfile(members[name]).read()
+                yield (_load_image(blob, resize),
+                       int(labels[image_id - 1]) - 1)
+                count += 1
+                if sample_limit and count >= sample_limit:
+                    return
+
+    return reader
+
+
+def _files():
+    return (common.download(DATA_URL, "flowers", DATA_MD5),
+            common.download(LABEL_URL, "flowers", LABEL_MD5),
+            common.download(SETID_URL, "flowers", SETID_MD5))
+
+
+def train(resize=96):
+    data, label, setid = _files()
+    return reader_creator(data, label, setid, TRAIN_FLAG, resize)
+
+
+def test(resize=96):
+    data, label, setid = _files()
+    return reader_creator(data, label, setid, TEST_FLAG, resize)
+
+
+def valid(resize=96):
+    data, label, setid = _files()
+    return reader_creator(data, label, setid, VALID_FLAG, resize)

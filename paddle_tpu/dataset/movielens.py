@@ -1,0 +1,162 @@
+"""MovieLens-1M reader creators (reference
+``python/paddle/dataset/movielens.py``: ml-1m.zip with
+movies.dat/users.dat/ratings.dat '::'-separated tables; samples are
+user features + movie features + normalized rating; deterministic
+90/10 train/test split)."""
+
+import random
+import re
+import zipfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "get_movie_title_dict", "max_movie_id",
+           "max_user_id", "max_job_id", "age_table", "movie_categories",
+           "user_info", "movie_info", "MovieInfo", "UserInfo"]
+
+URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+MD5 = "c4d9eecfca2ab87c1945afe126590906"
+
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, title_dict):
+        return [self.index,
+                [categories_dict[c] for c in self.categories],
+                [title_dict[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return "<MovieInfo id(%d), title(%s), categories(%s)>" % (
+            self.index, self.title, self.categories)
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [self.index, 0 if self.is_male else 1, self.age,
+                self.job_id]
+
+    def __repr__(self):
+        return "<UserInfo id(%d), gender(%s), age(%d), job(%d)>" % (
+            self.index, "M" if self.is_male else "F",
+            age_table[self.age], self.job_id)
+
+
+class _Meta:
+    """Parsed tables + vocabularies (reference __initialize_meta_info__)."""
+
+    def __init__(self, zip_path):
+        pattern = re.compile(r"^(\d+)::(.*)::(.*)$")
+        self.movies = {}
+        self.users = {}
+        self.ratings = []
+        categories = set()
+        title_words = set()
+        with zipfile.ZipFile(zip_path) as z:
+            base = z.namelist()[0].split("/")[0]
+            with z.open("%s/movies.dat" % base) as f:
+                for line in f:
+                    m = pattern.match(line.decode("latin-1").strip())
+                    if not m:
+                        continue
+                    idx, title, cats = m.groups()
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    title = re.sub(r"\(\d{4}\)$", "", title).strip()
+                    title_words.update(w.lower() for w in title.split())
+                    self.movies[int(idx)] = MovieInfo(idx, cats, title)
+            with z.open("%s/users.dat" % base) as f:
+                for line in f:
+                    parts = line.decode("latin-1").strip().split("::")
+                    if len(parts) < 4:
+                        continue
+                    uid, gender, age, job = parts[:4]
+                    self.users[int(uid)] = UserInfo(uid, gender, age, job)
+            with z.open("%s/ratings.dat" % base) as f:
+                for line in f:
+                    parts = line.decode("latin-1").strip().split("::")
+                    if len(parts) < 4:
+                        continue
+                    uid, mid, rating = int(parts[0]), int(parts[1]), \
+                        float(parts[2])
+                    if uid in self.users and mid in self.movies:
+                        self.ratings.append((uid, mid, rating))
+        self.categories_dict = {c: i for i, c in
+                                enumerate(sorted(categories))}
+        self.title_dict = {w: i for i, w in enumerate(sorted(title_words))}
+
+    def sample(self, uid, mid, rating):
+        # rating normalized to [-3, 5]: r*2-5 (reference movielens.py:163)
+        return (self.users[uid].value() +
+                self.movies[mid].value(self.categories_dict,
+                                       self.title_dict) +
+                [[rating * 2 - 5.0]])
+
+
+_meta_cache = {}
+
+
+def _meta():
+    if "m" not in _meta_cache:
+        _meta_cache["m"] = _Meta(common.download(URL, "movielens", MD5))
+    return _meta_cache["m"]
+
+
+def _reader(is_test, test_ratio=0.1, rand_seed=0):
+    def reader():
+        meta = _meta()
+        rng = random.Random(rand_seed)
+        for uid, mid, rating in meta.ratings:
+            if (rng.random() < test_ratio) == is_test:
+                yield meta.sample(uid, mid, rating)
+
+    return reader
+
+
+def train():
+    return _reader(is_test=False)
+
+
+def test():
+    return _reader(is_test=True)
+
+
+def get_movie_title_dict():
+    return _meta().title_dict
+
+
+def movie_categories():
+    return _meta().categories_dict
+
+
+def max_movie_id():
+    return max(_meta().movies)
+
+
+def max_user_id():
+    return max(_meta().users)
+
+
+def max_job_id():
+    return max(u.job_id for u in _meta().users.values())
+
+
+def movie_info():
+    return _meta().movies
+
+
+def user_info():
+    return _meta().users

@@ -1,0 +1,84 @@
+"""NLTK movie-reviews sentiment reader creators (reference
+``python/paddle/dataset/sentiment.py``: 2000 labeled reviews, word-freq
+vocabulary, 1600/400 train/test split; samples are (word ids, 0/1)).
+
+The corpus loader is separated from the sample pipeline so the pipeline
+is testable with injected documents (the reference hard-wires nltk;
+nltk may be absent in this image — ``train``/``test`` raise a clear
+ImportError in that case)."""
+
+__all__ = ["train", "test", "get_word_dict", "build_samples",
+           "NUM_TRAINING_INSTANCES", "NUM_TOTAL_INSTANCES"]
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+
+def _load_corpus():
+    try:
+        import nltk
+        from nltk.corpus import movie_reviews
+    except ImportError as e:  # pragma: no cover - env-dependent
+        raise ImportError(
+            "paddle_tpu.dataset.sentiment needs nltk's movie_reviews "
+            "corpus; install nltk and run "
+            "nltk.download('movie_reviews')") from e
+    docs = [(list(movie_reviews.words(fid)), cat)
+            for cat in movie_reviews.categories()
+            for fid in movie_reviews.fileids(cat)]
+    return docs
+
+
+def build_word_dict(documents):
+    """Frequency-sorted word -> id (reference get_word_dict)."""
+    freq = {}
+    for words, _ in documents:
+        for w in words:
+            w = w.lower()
+            freq[w] = freq.get(w, 0) + 1
+    ranked = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+    return {w: i for i, (w, _) in enumerate(ranked)}
+
+
+def build_samples(documents, word_dict=None, shuffle_seed=0):
+    """(word ids, label) pairs, deterministically shuffled; label 0 =
+    negative, 1 = positive (reference sorted_label convention)."""
+    import random
+
+    word_dict = word_dict or build_word_dict(documents)
+    cats = sorted({c for _, c in documents})
+    label_of = {c: i for i, c in enumerate(cats)}
+    samples = [([word_dict[w.lower()] for w in words], label_of[cat])
+               for words, cat in documents]
+    random.Random(shuffle_seed).shuffle(samples)
+    return samples
+
+
+_cache = {}
+
+
+def _samples():
+    if "s" not in _cache:
+        docs = _load_corpus()
+        _cache["d"] = build_word_dict(docs)
+        _cache["s"] = build_samples(docs, _cache["d"])
+    return _cache["s"]
+
+
+def get_word_dict():
+    _samples()
+    return _cache["d"]
+
+
+def train():
+    def reader():
+        yield from _samples()[:NUM_TRAINING_INSTANCES]
+
+    return reader
+
+
+def test():
+    def reader():
+        yield from _samples()[NUM_TRAINING_INSTANCES:]
+
+    return reader

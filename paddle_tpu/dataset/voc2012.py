@@ -1,0 +1,61 @@
+"""VOC2012 segmentation reader creators (reference
+``python/paddle/dataset/voc2012.py``: tarball with ImageSets lists,
+JPEGImages and SegmentationClass PNGs; samples are (HWC uint8 image,
+HW uint8 label mask))."""
+
+import io
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "val", "reader_creator"]
+
+VOC_URL = ("http://host.robots.ox.ac.uk/pascal/VOC/voc2012/"
+           "VOCtrainval_11-May-2012.tar")
+VOC_MD5 = "6cd6e144f989b92b3379bac3b3de84fd"
+
+SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+
+
+def reader_creator(filename, sub_name):
+    from PIL import Image
+
+    def reader():
+        with tarfile.open(filename) as tf:
+            members = {m.name: m for m in tf.getmembers()}
+            set_member = members[SET_FILE.format(sub_name)]
+            for line in tf.extractfile(set_member):
+                name = line.decode("utf-8").strip()
+                if not name:
+                    continue
+                img_blob = tf.extractfile(
+                    members[DATA_FILE.format(name)]).read()
+                lbl_blob = tf.extractfile(
+                    members[LABEL_FILE.format(name)]).read()
+                img = np.asarray(Image.open(io.BytesIO(img_blob))
+                                 .convert("RGB"), dtype="uint8")
+                lbl = np.asarray(Image.open(io.BytesIO(lbl_blob)),
+                                 dtype="uint8")
+                yield img, lbl
+
+    return reader
+
+
+def _tar():
+    return common.download(VOC_URL, "voc2012", VOC_MD5)
+
+
+def train():
+    return reader_creator(_tar(), "trainval")
+
+
+def test():
+    return reader_creator(_tar(), "train")
+
+
+def val():
+    return reader_creator(_tar(), "val")

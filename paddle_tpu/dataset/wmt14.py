@@ -1,0 +1,85 @@
+"""WMT14 en->fr reader creators (reference
+``python/paddle/dataset/wmt14.py``: tarball of tab-separated parallel
+text + src.dict/trg.dict files; samples are (src_ids, trg_ids,
+trg_ids_next) with <s>/<e>/<unk> conventions and the >80-token filter).
+"""
+
+import tarfile
+
+from . import common
+
+__all__ = ["train", "test", "get_dict", "reader_creator"]
+
+URL_TRAIN = ("http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz")
+MD5_TRAIN = "0791583d57d5beb693b9414c5b36798c"
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+MAX_LEN = 80
+
+
+def _dicts_from_tar(tar_path, dict_size):
+    """First ``dict_size`` lines of the *.src.dict / *.trg.dict members;
+    line number = word id."""
+    out = {}
+    with tarfile.open(tar_path) as tf:
+        for kind in ("src", "trg"):
+            names = [n for n in tf.getnames()
+                     if n.endswith("%s.dict" % kind)]
+            assert len(names) == 1, names
+            d = {}
+            for i, line in enumerate(tf.extractfile(names[0])):
+                if i >= dict_size:
+                    break
+                d[line.decode("utf-8").strip()] = i
+            out[kind] = d
+    return out["src"], out["trg"]
+
+
+def reader_creator(tar_path, file_name, dict_size):
+    def reader():
+        src_dict, trg_dict = _dicts_from_tar(tar_path, dict_size)
+        with tarfile.open(tar_path) as tf:
+            names = [n for n in tf.getnames() if n.endswith(file_name)]
+            for name in names:
+                for line in tf.extractfile(name):
+                    parts = line.decode("utf-8").strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src_words = [START] + parts[0].split() + [END]
+                    src_ids = [src_dict.get(w, UNK_IDX)
+                               for w in src_words]
+                    trg_words = parts[1].split()
+                    trg_ids = [trg_dict.get(w, UNK_IDX)
+                               for w in trg_words]
+                    if len(src_ids) > MAX_LEN or len(trg_ids) > MAX_LEN:
+                        continue
+                    trg_ids_next = trg_ids + [trg_dict[END]]
+                    trg_ids = [trg_dict[START]] + trg_ids
+                    yield src_ids, trg_ids, trg_ids_next
+
+    return reader
+
+
+def _tar():
+    return common.download(URL_TRAIN, "wmt14", MD5_TRAIN)
+
+
+def train(dict_size):
+    return reader_creator(_tar(), "train/train", dict_size)
+
+
+def test(dict_size):
+    return reader_creator(_tar(), "test/test", dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict); reversed (id->word) by default, matching
+    the reference's decode-time usage."""
+    src, trg = _dicts_from_tar(_tar(), dict_size)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

@@ -1,0 +1,120 @@
+"""WMT16 en<->de reader creators (reference
+``python/paddle/dataset/wmt16.py``: BPE-processed tarball with a
+``wmt16/{train,test,val}`` member of tab-separated pairs; dictionaries
+are built from the training split on first use and cached; samples are
+(src_ids, trg_ids, trg_ids_next))."""
+
+import os
+import tarfile
+from collections import defaultdict
+
+from . import common
+
+__all__ = ["train", "test", "validation", "get_dict", "reader_creator",
+           "fetch"]
+
+URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+MD5 = "0c38af81d9e3a6f689eba04fbf1a47ba"
+
+TOTAL_EN_WORDS = 11250
+TOTAL_DE_WORDS = 19220
+
+START_MARK = "<s>"
+END_MARK = "<e>"
+UNK_MARK = "<unk>"
+
+
+def _build_dict(tar_path, dict_size, lang):
+    freq = defaultdict(int)
+    with tarfile.open(tar_path) as tf:
+        for line in tf.extractfile("wmt16/train"):
+            parts = line.decode("utf-8").strip().split("\t")
+            if len(parts) != 2:
+                continue
+            sen = parts[0] if lang == "en" else parts[1]
+            for w in sen.split():
+                freq[w] += 1
+    words = [w for w, _ in
+             sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))]
+    vocab = [START_MARK, END_MARK, UNK_MARK] + words[:dict_size - 3]
+    return {w: i for i, w in enumerate(vocab)}
+
+
+def _dict_cache_path(dict_size, lang):
+    return os.path.join(common.DATA_HOME, "wmt16",
+                        "%s_%d.dict" % (lang, dict_size))
+
+
+def _load_dict(tar_path, dict_size, lang, reverse=False):
+    path = _dict_cache_path(dict_size, lang)
+    if not os.path.exists(path):
+        d = _build_dict(tar_path, dict_size, lang)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            for w, _ in sorted(d.items(), key=lambda kv: kv[1]):
+                f.write(w + "\n")
+    d = {}
+    with open(path) as f:
+        for i, line in enumerate(f):
+            d[line.rstrip("\n")] = i
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d
+
+
+def _clip_sizes(src_dict_size, trg_dict_size, src_lang):
+    src_total = TOTAL_EN_WORDS if src_lang == "en" else TOTAL_DE_WORDS
+    trg_total = TOTAL_DE_WORDS if src_lang == "en" else TOTAL_EN_WORDS
+    return min(src_dict_size, src_total), min(trg_dict_size, trg_total)
+
+
+def reader_creator(tar_path, file_name, src_dict_size, trg_dict_size,
+                   src_lang):
+    def reader():
+        src_dict = _load_dict(tar_path, src_dict_size, src_lang)
+        trg_dict = _load_dict(tar_path, trg_dict_size,
+                              "de" if src_lang == "en" else "en")
+        start, end, unk = (src_dict[START_MARK], src_dict[END_MARK],
+                           src_dict[UNK_MARK])
+        src_col = 0 if src_lang == "en" else 1
+        with tarfile.open(tar_path) as tf:
+            for line in tf.extractfile(file_name):
+                parts = line.decode("utf-8").strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src_ids = [start] + [src_dict.get(w, unk)
+                                     for w in parts[src_col].split()] \
+                    + [end]
+                trg_words = parts[1 - src_col].split()
+                trg_ids = [trg_dict.get(w, unk) for w in trg_words]
+                yield (src_ids, [start] + trg_ids, trg_ids + [end])
+
+    return reader
+
+
+def _tar():
+    return common.download(URL, "wmt16", MD5, save_name="wmt16.tar.gz")
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    s, t = _clip_sizes(src_dict_size, trg_dict_size, src_lang)
+    return reader_creator(_tar(), "wmt16/train", s, t, src_lang)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    s, t = _clip_sizes(src_dict_size, trg_dict_size, src_lang)
+    return reader_creator(_tar(), "wmt16/test", s, t, src_lang)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    s, t = _clip_sizes(src_dict_size, trg_dict_size, src_lang)
+    return reader_creator(_tar(), "wmt16/val", s, t, src_lang)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    total = TOTAL_EN_WORDS if lang == "en" else TOTAL_DE_WORDS
+    return _load_dict(_tar(), min(dict_size, total), lang, reverse)
+
+
+def fetch():
+    _tar()

@@ -238,6 +238,10 @@ def convert_reader_to_recordio_file(filename, reader_creator_fn,
     defaults to pickle."""
     import pickle
 
+    if feeder is not None:
+        raise NotImplementedError(
+            "feeder-driven serialization is not supported; pass a "
+            "serializer(sample)->bytes instead (default: pickle)")
     serializer = serializer or pickle.dumps
     n = 0
     with Writer(filename, compressor, max_chunk_bytes) as w:

@@ -1,0 +1,237 @@
+"""Parser tests for the second dataset batch (wmt14, wmt16, conll05,
+movielens, flowers, voc2012, sentiment) on synthetic fixtures — no
+network."""
+
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+
+def _add(tf, name, blob):
+    info = tarfile.TarInfo(name)
+    info.size = len(blob)
+    tf.addfile(info, io.BytesIO(blob))
+
+
+def test_wmt14_parser(tmp_path):
+    from paddle_tpu.dataset import wmt14
+
+    tar = tmp_path / "wmt14.tgz"
+    src_dict = b"<s>\n<e>\n<unk>\nhello\nworld\n"
+    trg_dict = b"<s>\n<e>\n<unk>\nbonjour\nmonde\n"
+    train = (b"hello world\tbonjour monde\n"
+             b"hello unknownword\tbonjour\n"
+             b"badline\n")
+    with tarfile.open(tar, "w:gz") as tf:
+        _add(tf, "wmt14/train/src.dict", src_dict)
+        _add(tf, "wmt14/train/trg.dict", trg_dict)
+        _add(tf, "wmt14/train/train", train)
+    samples = list(wmt14.reader_creator(str(tar), "train/train", 100)())
+    assert len(samples) == 2          # bad line dropped
+    src_ids, trg_ids, trg_next = samples[0]
+    assert src_ids == [0, 3, 4, 1]    # <s> hello world <e>
+    assert trg_ids == [0, 3, 4]       # <s> bonjour monde
+    assert trg_next == [3, 4, 1]      # bonjour monde <e>
+    # unknown word maps to UNK_IDX=2
+    assert samples[1][0] == [0, 3, 2, 1]
+
+
+def test_wmt16_dict_build_and_reader(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common, wmt16
+
+    tar = tmp_path / "wmt16.tar.gz"
+    train = (b"the cat\tdie katze\n"
+             b"the dog\tder hund\n")
+    with tarfile.open(tar, "w:gz") as tf:
+        _add(tf, "wmt16/train", train)
+        _add(tf, "wmt16/test", b"the cat\tdie katze\n")
+        _add(tf, "wmt16/val", b"the dog\tder hund\n")
+    monkeypatch.setattr(wmt16.common, "download",
+                        lambda *a, **k: str(tar))
+    monkeypatch.setattr(wmt16.common, "DATA_HOME", str(tmp_path))
+
+    en = wmt16.get_dict("en", 100)
+    assert en["<s>"] == 0 and en["<e>"] == 1 and en["<unk>"] == 2
+    assert en["the"] == 3              # most frequent first
+    samples = list(wmt16.test(100, 100, "en")())
+    assert len(samples) == 1
+    src_ids, trg_ids, trg_next = samples[0]
+    de = wmt16.get_dict("de", 100)
+    assert src_ids == [0, en["the"], en["cat"], 1]
+    assert trg_ids == [0, de["die"], de["katze"]]
+    assert trg_next == [de["die"], de["katze"], 1]
+
+
+def test_conll05_bracket_expansion_and_reader(tmp_path):
+    from paddle_tpu.dataset import conll05
+
+    # two-predicate sentence in the conll prop format
+    words = b"The\ncat\nsat\n\n"
+    props = (b"-  (A0*\n"
+             b"-  *)\n"
+             b"sit  (V*)\n"
+             b"\n")
+    tar = tmp_path / "c.tgz"
+    with tarfile.open(tar, "w:gz") as tf:
+        _add(tf, "rel/words.gz", gzip.compress(words))
+        _add(tf, "rel/props.gz", gzip.compress(props))
+
+    corpus = conll05.corpus_reader(str(tar), "rel/words.gz",
+                                   "rel/props.gz")
+    got = list(corpus())
+    assert len(got) == 1
+    sentence, verb, labels = got[0]
+    assert sentence == ["The", "cat", "sat"]
+    assert verb == "sit"
+    assert labels == ["B-A0", "I-A0", "B-V"]
+
+    word_dict = {"The": 1, "cat": 2, "sat": 3, "bos": 4, "eos": 5}
+    verb_dict = {"sit": 1}
+    label_map = {"B-A0": 0, "I-A0": 1, "B-V": 2, "O": 3}
+    rdr = conll05.reader_creator(corpus, word_dict, verb_dict, label_map)
+    (sample,) = list(rdr())
+    word_idx, n2, n1, c0, p1, p2, pred, mark, label_idx = sample
+    assert word_idx == [1, 2, 3]
+    assert pred == [1, 1, 1]
+    assert mark == [0, 1, 1]          # window around verb at index 2
+    assert label_idx == [0, 1, 2]
+    assert c0 == [3, 3, 3]            # ctx_0 = 'sat'
+    assert p1 == [word_dict["eos"]] * 3
+
+
+def test_conll05_label_dict_loader(tmp_path):
+    from paddle_tpu.dataset import conll05
+
+    f = tmp_path / "target.txt"
+    f.write_text("B-A0\nI-A0\nB-V\nI-V\nO\n")
+    d = conll05.load_label_dict(str(f))
+    assert d["O"] == max(d.values())
+    assert set(d) == {"B-A0", "I-A0", "B-V", "I-V", "O"}
+    # B-x and I-x adjacent
+    assert d["I-A0"] == d["B-A0"] + 1
+
+
+def test_movielens_parser(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import movielens
+
+    zp = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(zp, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::15::12345\n2::F::35::7::67890\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n2::2::3::978302109\n"
+                   "1::2::4::978301968\n")
+    monkeypatch.setattr(movielens.common, "download",
+                        lambda *a, **k: str(zp))
+    movielens._meta_cache.clear()
+    try:
+        all_samples = list(movielens.train()()) + \
+            list(movielens.test()())
+        assert len(all_samples) == 3
+        s = all_samples[0]
+        # [uid, gender, age_idx, job, mid, categories, title_words, [r]]
+        assert len(s) == 8
+        # ratings normalized r*2-5: raw 3..5 -> 1..5
+        assert all(-3.0 <= smp[-1][0] <= 5.0 for smp in all_samples)
+        assert {smp[-1][0] for smp in all_samples} == {5.0, 1.0, 3.0}
+        assert movielens.max_user_id() == 2
+        assert movielens.max_movie_id() == 2
+        assert movielens.max_job_id() == 15
+        cats = movielens.movie_categories()
+        assert set(cats) == {"Animation", "Comedy", "Adventure"}
+        titles = movielens.get_movie_title_dict()
+        assert "toy" in titles and "jumanji" in titles
+        m = movielens.movie_info()[1]
+        assert "Toy Story" in m.title
+    finally:
+        movielens._meta_cache.clear()
+
+
+def test_flowers_parser(tmp_path):
+    import scipy.io
+    from PIL import Image
+    from paddle_tpu.dataset import flowers
+
+    n = 4
+    tar = tmp_path / "102flowers.tgz"
+    with tarfile.open(tar, "w:gz") as tf:
+        rng = np.random.RandomState(0)
+        for i in range(1, n + 1):
+            img = Image.fromarray(
+                rng.randint(0, 255, (20, 30, 3), dtype=np.uint8))
+            buf = io.BytesIO()
+            img.save(buf, format="JPEG")
+            _add(tf, "jpg/image_%05d.jpg" % i, buf.getvalue())
+    labels = np.array([[5, 6, 7, 8]])
+    setid = {"trnid": np.array([[1, 3]]), "tstid": np.array([[2]]),
+             "valid": np.array([[4]])}
+    scipy.io.savemat(tmp_path / "imagelabels.mat", {"labels": labels})
+    scipy.io.savemat(tmp_path / "setid.mat", setid)
+
+    # TRAIN_FLAG is 'tstid' (reference's deliberate swap)
+    assert flowers.TRAIN_FLAG == "tstid" and flowers.TEST_FLAG == "trnid"
+    rdr = flowers.reader_creator(
+        str(tar), str(tmp_path / "imagelabels.mat"),
+        str(tmp_path / "setid.mat"), "trnid", resize=16)
+    samples = list(rdr())
+    assert len(samples) == 2
+    img, lbl = samples[0]
+    assert img.shape == (3, 16, 16) and img.dtype == np.float32
+    assert 0.0 <= img.min() and img.max() <= 1.0
+    assert lbl == 4                   # label 5 -> zero-based 4
+    tst = list(flowers.reader_creator(
+        str(tar), str(tmp_path / "imagelabels.mat"),
+        str(tmp_path / "setid.mat"), "tstid", resize=16)())
+    assert len(tst) == 1 and tst[0][1] == 5
+
+
+def test_voc2012_parser(tmp_path):
+    from PIL import Image
+    from paddle_tpu.dataset import voc2012
+
+    tar = tmp_path / "voc.tar"
+    with tarfile.open(tar, "w") as tf:
+        _add(tf, voc2012.SET_FILE.format("trainval"), b"img1\nimg2\n")
+        rng = np.random.RandomState(1)
+        for name in ("img1", "img2"):
+            im = Image.fromarray(
+                rng.randint(0, 255, (12, 10, 3), dtype=np.uint8))
+            buf = io.BytesIO()
+            im.save(buf, format="JPEG")
+            _add(tf, voc2012.DATA_FILE.format(name), buf.getvalue())
+            mask = Image.fromarray(
+                rng.randint(0, 20, (12, 10), dtype=np.uint8), mode="P")
+            buf2 = io.BytesIO()
+            mask.save(buf2, format="PNG")
+            _add(tf, voc2012.LABEL_FILE.format(name), buf2.getvalue())
+    samples = list(voc2012.reader_creator(str(tar), "trainval")())
+    assert len(samples) == 2
+    img, lbl = samples[0]
+    assert img.shape == (12, 10, 3) and img.dtype == np.uint8
+    assert lbl.shape == (12, 10) and lbl.max() < 21
+
+
+def test_sentiment_pipeline_with_injected_corpus():
+    from paddle_tpu.dataset import sentiment
+
+    docs = [(["good", "movie", "good"], "pos"),
+            (["bad", "movie"], "neg"),
+            (["good"], "pos")]
+    wd = sentiment.build_word_dict(docs)
+    assert wd["good"] == 0            # most frequent
+    samples = sentiment.build_samples(docs, wd)
+    assert len(samples) == 3
+    labels = sorted(lbl for _, lbl in samples)
+    assert labels == [0, 1, 1]        # neg=0 (x1), pos=1 (x2)
+    ids, _ = samples[0]
+    assert all(isinstance(i, int) for i in ids)
+    # deterministic shuffle
+    assert samples == sentiment.build_samples(docs, wd)

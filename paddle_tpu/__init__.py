@@ -49,6 +49,7 @@ from .transpiler import (
     release_memory,
 )
 from . import cloud
+from . import inference
 from . import recordio
 from . import recordio_writer
 from .flags import set_flags, get_flags
@@ -67,5 +68,5 @@ __all__ = [
     "dataset", "batch", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "InferenceTranspiler",
     "memory_optimize", "release_memory", "cloud", "set_flags", "get_flags",
-    "recordio", "recordio_writer",
+    "recordio", "recordio_writer", "inference",
 ]

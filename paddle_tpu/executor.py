@@ -157,8 +157,14 @@ class _CompiledProgram:
 class Executor:
     """Runs Programs on a Place (reference executor.py:256 / executor.cc:85)."""
 
-    def __init__(self, place=None):
+    def __init__(self, place=None, donate_state=True):
+        """``donate_state=False`` keeps input state buffers alive after
+        the step (no XLA donation): required when several executors
+        share one scope concurrently (inference predictor clones) —
+        donation would delete the weight buffers under the other
+        executors.  Training keeps the default in-place donation."""
         self.place = place if place is not None else TPUPlace(0)
+        self.donate_state = donate_state
         self._cache = {}
         self._run_counter = 0
 
@@ -209,7 +215,8 @@ class Executor:
         fn, state_in, state_out = trace_program(
             program, feed_names, state_names, writeback, fetch_names
         )
-        jitted = jax.jit(fn, donate_argnums=(1,))
+        donate = (1,) if self.donate_state else ()
+        jitted = jax.jit(fn, donate_argnums=donate)
         return _CompiledProgram(jitted, feed_names, state_in, state_out,
                                 fetch_names)
 

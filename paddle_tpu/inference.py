@@ -1,0 +1,177 @@
+"""Deployment predictor API.
+
+Parity: reference ``paddle/fluid/inference/api/paddle_inference_api.h``
+— ``PaddleTensor`` (:95), ``NativeConfig`` (:183), ``AnalysisConfig``
+(:255), ``PaddlePredictor::Run``/``Clone`` (:141) and the
+``CreatePaddlePredictor`` factory; implementations
+``api_impl.cc`` (NativePaddlePredictor over NaiveExecutor) and
+``analysis_predictor.cc`` (ir passes then execute).
+
+TPU-native redesign: the predictor wraps a saved inference model
+(``io.save_inference_model``'s pruned program + params) in a dedicated
+scope and runs it through the jit Executor — the first Run compiles one
+fused HLO per input signature, after which Run is a single device
+dispatch.  ``AnalysisConfig``'s ir-pass pipeline maps to the
+InferenceTranspiler's inference-mode rewrite (numeric fusions are XLA's
+job).  ``Clone()`` shares the immutable weights but gets its own
+executor cache, matching the reference's clone-per-thread deployment
+pattern.
+"""
+
+import threading
+
+import numpy as np
+
+from . import io as fluid_io
+from .executor import CPUPlace, Executor, TPUPlace
+from .scope import Scope
+
+__all__ = ["PaddleTensor", "NativeConfig", "AnalysisConfig",
+           "PaddlePredictor", "create_paddle_predictor"]
+
+
+class PaddleTensor:
+    """In/out tensor of the predictor ABI (paddle_inference_api.h:95).
+    ``data`` is a numpy array; ``name`` must match a feed/fetch var for
+    inputs (outputs are filled by Run).  ``lod`` carries per-sequence
+    lengths for lod_level>=1 inputs (the @LEN companion)."""
+
+    def __init__(self, name="", data=None, shape=None, dtype=None,
+                 lod=None):
+        self.name = name
+        if data is not None:
+            data = np.asarray(data, dtype=dtype)
+            if shape:
+                data = data.reshape(shape)
+        self.data = data
+        self.shape = tuple(data.shape) if data is not None else \
+            tuple(shape or ())
+        self.dtype = str(data.dtype) if data is not None else dtype
+        self.lod = lod
+
+    def __repr__(self):
+        return "PaddleTensor(name=%r, shape=%s, dtype=%s)" % (
+            self.name, self.shape, self.dtype)
+
+
+class NativeConfig:
+    """paddle_inference_api.h:183 — where the model lives and on what
+    device it runs.  ``use_gpu``/``fraction_of_gpu_memory`` are accepted
+    for parity; the accelerator here is the TPU (XLA manages memory)."""
+
+    def __init__(self, model_dir="", prog_file=None, param_file=None,
+                 use_gpu=True, device=0, fraction_of_gpu_memory=-1.0):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.param_file = param_file
+        self.use_gpu = use_gpu
+        self.device = device
+        self.fraction_of_gpu_memory = fraction_of_gpu_memory
+
+    def _place(self):
+        import jax
+
+        accel = any(d.platform != "cpu" for d in jax.local_devices())
+        if self.use_gpu and accel:
+            return TPUPlace(self.device)
+        return CPUPlace()
+
+
+class AnalysisConfig(NativeConfig):
+    """paddle_inference_api.h:255 — NativeConfig + the ir-optimization
+    pipeline.  On this framework the pipeline is inherently applied:
+    save_inference_model already writes an inference-mode (for_test)
+    program and XLA performs the numeric fusions the reference's ir
+    passes hand-roll, so AnalysisConfig is API parity with identical
+    runtime behavior; ``enable_ir_optim`` is recorded but has nothing
+    left to do."""
+
+    def __init__(self, *args, enable_ir_optim=True, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.enable_ir_optim = enable_ir_optim
+
+
+class PaddlePredictor:
+    """paddle_inference_api.h:141 — Run(inputs) -> outputs, Clone()."""
+
+    def __init__(self, config, _shared=None):
+        self._config = config
+        self._place = config._place()
+        # no state donation: clones run concurrently over shared weights
+        self._exe = Executor(self._place, donate_state=False)
+        if _shared is not None:
+            # Clone(): share program + weights, own executor cache
+            self._program, self._feed_names, self._fetch_vars, \
+                self._scope = _shared
+        else:
+            self._scope = Scope()
+            from .scope import scope_guard
+
+            with scope_guard(self._scope):
+                self._program, self._feed_names, self._fetch_vars = \
+                    fluid_io.load_inference_model(
+                        config.model_dir, self._exe,
+                        model_filename=config.prog_file,
+                        params_filename=config.param_file)
+        self._mu = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def run(self, inputs):
+        """List of PaddleTensor (or name->array dict) in, list of
+        PaddleTensor out, ordered like the saved fetch targets."""
+        feed = {}
+        if isinstance(inputs, dict):
+            items = inputs.items()
+        else:
+            items = [(t.name, t.data) for t in inputs]
+            for t in inputs:
+                if t.lod is not None:
+                    feed[t.name + "@LEN"] = np.asarray(t.lod, "int32")
+        for name, data in items:
+            if name not in self._feed_names and \
+                    not name.endswith("@LEN"):
+                raise ValueError(
+                    "input %r is not a feed target of this model "
+                    "(expected %s)" % (name, self._feed_names))
+            if data is None:
+                raise ValueError(
+                    "input %r has no data (PaddleTensor.data is None)"
+                    % name)
+            feed[name] = data
+        missing = [n for n in self._feed_names if n not in feed]
+        if missing:
+            raise ValueError("missing inputs: %s" % missing)
+        # scope passed explicitly — scope_guard's global stack is not
+        # thread-safe and clones run concurrently
+        with self._mu:
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_vars,
+                                 scope=self._scope)
+        return [PaddleTensor(name=v.name, data=o)
+                for v, o in zip(self._fetch_vars, outs)]
+
+    # reference spells it Run/Clone; keep both casings
+    Run = run
+
+    def clone(self):
+        """Per-thread copy sharing the immutable weights
+        (api_impl.cc Clone)."""
+        return PaddlePredictor(
+            self._config,
+            _shared=(self._program, self._feed_names, self._fetch_vars,
+                     self._scope))
+
+    Clone = clone
+
+    @property
+    def feed_names(self):
+        return list(self._feed_names)
+
+    @property
+    def fetch_names(self):
+        return [v.name for v in self._fetch_vars]
+
+
+def create_paddle_predictor(config):
+    """CreatePaddlePredictor<Config> factory."""
+    return PaddlePredictor(config)

@@ -41,7 +41,9 @@ def test_mnist_mlp_trains():
         last_loss = float(lv[0])
         accs.append(float(av[0]))
     assert last_loss < first_loss * 0.8, (first_loss, last_loss)
-    assert np.mean(accs[-10:]) > np.mean(accs[:10])
+    # >= : with a lucky init the model can saturate accuracy 1.0 inside
+    # the first 10 steps, making strict > flaky
+    assert np.mean(accs[-10:]) >= np.mean(accs[:10])
 
 
 def test_mnist_mlp_save_load_inference(tmp_path):

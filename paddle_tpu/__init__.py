@@ -50,6 +50,7 @@ from .transpiler import (
 )
 from . import cloud
 from . import inference
+from . import debugger
 from . import recordio
 from . import recordio_writer
 from .flags import set_flags, get_flags
@@ -68,5 +69,5 @@ __all__ = [
     "dataset", "batch", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "InferenceTranspiler",
     "memory_optimize", "release_memory", "cloud", "set_flags", "get_flags",
-    "recordio", "recordio_writer", "inference",
+    "recordio", "recordio_writer", "inference", "debugger",
 ]

@@ -1,0 +1,176 @@
+"""Detection layer DSL (reference ``python/paddle/fluid/layers/
+detection.py``: prior_box, anchor_generator, box_coder, iou_similarity,
+bipartite_match, target_assign, multiclass NMS via detection_output,
+roi_pool, polygon_box_transform)."""
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "prior_box",
+    "anchor_generator",
+    "box_coder",
+    "iou_similarity",
+    "bipartite_match",
+    "target_assign",
+    "multiclass_nms",
+    "detection_output",
+    "roi_pool",
+    "polygon_box_transform",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=None,
+              variance=None, flip=False, clip=False, steps=None,
+              offset=0.5, min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes over a feature map (reference detection.py
+    prior_box / prior_box_op.h).  Returns (boxes, variances), each
+    [H, W, num_priors, 4]."""
+    helper = LayerHelper("prior_box", input=input, name=name)
+    boxes = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    steps = steps or [0.0, 0.0]
+    helper.append_op(
+        type="prior_box", inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [boxes], "Variances": [variances]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios or [1.0]),
+               "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+               "flip": bool(flip), "clip": bool(clip),
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": float(offset),
+               "min_max_aspect_ratios_order":
+                   bool(min_max_aspect_ratios_order)})
+    for v in (boxes, variances):
+        v.stop_gradient = True
+    return boxes, variances
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variance=None,
+                     stride=None, offset=0.5, name=None):
+    """RPN anchors (reference anchor_generator_op.h).  Returns
+    (anchors, variances) [H, W, num_anchors, 4]."""
+    helper = LayerHelper("anchor_generator", input=input, name=name)
+    anchors = helper.create_variable_for_type_inference("float32")
+    variances = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="anchor_generator", inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [variances]},
+        attrs={"anchor_sizes": [float(s) for s in anchor_sizes],
+               "aspect_ratios": [float(a) for a in aspect_ratios],
+               "variances": list(variance or [0.1, 0.1, 0.2, 0.2]),
+               "stride": [float(s) for s in (stride or [16.0, 16.0])],
+               "offset": float(offset)})
+    for v in (anchors, variances):
+        v.stop_gradient = True
+    return anchors, variances
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    helper = LayerHelper("box_coder", input=target_box, name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="box_coder", inputs=inputs, outputs={"OutputBox": [out]},
+        attrs={"code_type": code_type,
+               "box_normalized": bool(box_normalized)})
+    return out
+
+
+def iou_similarity(x, y, name=None):
+    helper = LayerHelper("iou_similarity", input=x, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="iou_similarity", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Returns (match_indices [B, P] int32, match_dist [B, P]).
+    ``match_type='per_prediction'`` additionally matches unmatched
+    columns whose best dist >= ``dist_threshold`` (default 0.5)."""
+    helper = LayerHelper("bipartite_match", input=dist_matrix, name=name)
+    match = helper.create_variable_for_type_inference("int32")
+    mdist = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        type="bipartite_match", inputs={"DistMat": [dist_matrix]},
+        outputs={"ColToRowMatchIndices": [match],
+                 "ColToRowMatchDist": [mdist]},
+        attrs={"match_type": match_type or "bipartite",
+               "dist_threshold": float(dist_threshold or 0.5)})
+    match.stop_gradient = True
+    mdist.stop_gradient = True
+    return match, mdist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Returns (out [B, P, K], out_weight [B, P, 1])."""
+    helper = LayerHelper("target_assign", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_weight = helper.create_variable_for_type_inference("float32")
+    inputs = {"X": [input], "MatchIndices": [matched_indices]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices]
+    helper.append_op(
+        type="target_assign", inputs=inputs,
+        outputs={"Out": [out], "OutWeight": [out_weight]},
+        attrs={"mismatch_value": int(mismatch_value)})
+    out.stop_gradient = True
+    out_weight.stop_gradient = True
+    return out, out_weight
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0,
+                   nms_top_k=-1, nms_threshold=0.3, keep_top_k=-1,
+                   normalized=True, background_label=0, name=None):
+    """Per-class NMS; returns detections [B, keep_top_k, 6]
+    ((label, score, x1, y1, x2, y2), -1-labeled rows are padding) with
+    a per-image count companion (sequence-length convention)."""
+    helper = LayerHelper("multiclass_nms", input=bboxes, name=name)
+    out = helper.create_variable_for_type_inference("float32")
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "OutLength": [out_len]},
+        attrs={"score_threshold": float(score_threshold),
+               "nms_top_k": int(nms_top_k),
+               "nms_threshold": float(nms_threshold),
+               "keep_top_k": int(keep_top_k),
+               "normalized": bool(normalized),
+               "background_label": int(background_label)})
+    out.stop_gradient = True
+    out._seq_len_name = out_len.name
+    return out
+
+
+detection_output = multiclass_nms  # reference alias: decode+nms tail
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_batch=None, name=None):
+    helper = LayerHelper("roi_pool", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch]
+    helper.append_op(
+        type="roi_pool", inputs=inputs, outputs={"Out": [out]},
+        attrs={"pooled_height": int(pooled_height),
+               "pooled_width": int(pooled_width),
+               "spatial_scale": float(spatial_scale)})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", input=input, name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="polygon_box_transform",
+                     inputs={"X": [input]}, outputs={"Out": [out]})
+    return out

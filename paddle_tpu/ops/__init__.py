@@ -12,6 +12,7 @@ from . import (  # noqa: F401
     creation,
     crf,
     ctc,
+    detection,
     elementwise,
     loss,
     manipulation,

@@ -1,0 +1,544 @@
+"""Detection op suite: prior_box, anchor_generator, box_coder,
+iou_similarity, bipartite_match, target_assign, multiclass_nms,
+roi_pool, polygon_box_transform.
+
+Parity: reference ``operators/detection/`` (prior_box_op.h:96-160 prior
+layout incl. the min/max/aspect-ratio ordering flag,
+anchor_generator_op.h:40-90 stride-area anchors, box_coder_op.h
+encode/decode center-size with prior variances, iou_similarity_op,
+bipartite_match_op.cc:61-115 greedy bipartite + per-prediction argmax
+fill, target_assign_op.h scatter with mismatch_value, multiclass_nms_op
+per-class NMS with score/nms/keep thresholds) and ``roi_pool_op.cc``.
+
+TPU-first: every per-pixel/per-box loop is a broadcasted tensor
+expression; the greedy NMS/bipartite selections are ``lax.fori_loop``
+over fixed trip counts with masking (XLA-friendly static shapes);
+LoD-style outputs become padded arrays + explicit counts.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+_BIG_NEG = -1e9
+
+
+# -- iou_similarity ---------------------------------------------------------
+
+def _iou_matrix(a, b, normalized=True):
+    """a [N,4], b [M,4] -> [N,M] IoU (xmin,ymin,xmax,ymax)."""
+    off = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def _iou_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    if len(x.shape) == 3:  # batched: [B, N, 4] -> [B, N, M]
+        set_output(op, block, "Out",
+                   (x.shape[0], x.shape[1], y.shape[-2]), x.dtype)
+    else:
+        set_output(op, block, "Out", (x.shape[0], y.shape[0]), x.dtype)
+
+
+def _iou_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]
+    if x.ndim == 3:  # batched [B,N,4] x [M,4] or [B,M,4]
+        if y.ndim == 3:
+            return {"Out": jax.vmap(_iou_matrix)(x, y)}
+        return {"Out": jax.vmap(lambda a: _iou_matrix(a, y))(x)}
+    return {"Out": _iou_matrix(x, y)}
+
+
+register_op("iou_similarity", ["X", "Y"], ["Out"],
+            infer=_iou_infer, compute=_iou_compute, grad=None)
+
+
+# -- prior_box --------------------------------------------------------------
+
+def _prior_box_shapes(attrs):
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", []) or []]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", []) or []:
+        ar = float(ar)
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if attrs.get("flip", False) and \
+                    not any(abs(1.0 / ar - e) < 1e-6 for e in ars):
+                ars.append(1.0 / ar)
+    n = len(ars) * len(min_sizes) + len(max_sizes)
+    return min_sizes, max_sizes, ars, n
+
+
+def _prior_box_wh(attrs):
+    """Per-prior (half_w, half_h) in pixels, in the reference's
+    emission order (prior_box_op.h:110-160; default order: aspect
+    ratios of each min_size first, then the sqrt(min*max) square)."""
+    min_sizes, max_sizes, ars, _ = _prior_box_shapes(attrs)
+    order_flag = attrs.get("min_max_aspect_ratios_order", False)
+    ws, hs = [], []
+    for s_i, ms in enumerate(min_sizes):
+        if order_flag:
+            ws.append(ms / 2.0)
+            hs.append(ms / 2.0)
+            if max_sizes:
+                m = np.sqrt(ms * max_sizes[s_i]) / 2.0
+                ws.append(m)
+                hs.append(m)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                ws.append(ms * np.sqrt(ar) / 2.0)
+                hs.append(ms / np.sqrt(ar) / 2.0)
+        else:
+            for ar in ars:
+                ws.append(ms * np.sqrt(ar) / 2.0)
+                hs.append(ms / np.sqrt(ar) / 2.0)
+            if max_sizes:
+                m = np.sqrt(ms * max_sizes[s_i]) / 2.0
+                ws.append(m)
+                hs.append(m)
+    return np.asarray(ws, np.float32), np.asarray(hs, np.float32)
+
+
+def _prior_box_infer(op, block):
+    x = in_var(op, block, "Input")
+    _, _, _, n = _prior_box_shapes(op.attrs)
+    h, w = x.shape[2], x.shape[3]
+    set_output(op, block, "Boxes", (h, w, n, 4), "float32")
+    set_output(op, block, "Variances", (h, w, n, 4), "float32")
+
+
+def _prior_box_compute(ins, attrs, ctx, op_index):
+    fmap = ins["Input"][0]       # [N, C, H, W]
+    image = ins["Image"][0]      # [N, C, Hi, Wi]
+    h, w = fmap.shape[2], fmap.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    step_w = float(attrs.get("step_w", 0) or 0) or img_w / w
+    step_h = float(attrs.get("step_h", 0) or 0) or img_h / h
+    offset = float(attrs.get("offset", 0.5))
+    half_w, half_h = _prior_box_wh(attrs)
+    cx = (jnp.arange(w) + offset) * step_w      # [W]
+    cy = (jnp.arange(h) + offset) * step_h      # [H]
+    cx = cx[None, :, None]
+    cy = cy[:, None, None]
+    hw = jnp.asarray(half_w)[None, None, :]
+    hh = jnp.asarray(half_h)[None, None, :]
+    boxes = jnp.stack([
+        jnp.broadcast_to((cx - hw) / img_w, (h, w, hw.shape[-1])),
+        jnp.broadcast_to((cy - hh) / img_h, (h, w, hw.shape[-1])),
+        jnp.broadcast_to((cx + hw) / img_w, (h, w, hw.shape[-1])),
+        jnp.broadcast_to((cy + hh) / img_h, (h, w, hw.shape[-1])),
+    ], axis=-1)
+    if attrs.get("clip", False):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    variances = jnp.asarray(attrs.get("variances",
+                                      [0.1, 0.1, 0.2, 0.2]), jnp.float32)
+    variances = jnp.broadcast_to(variances, boxes.shape)
+    return {"Boxes": boxes.astype(jnp.float32), "Variances": variances}
+
+
+register_op("prior_box", ["Input", "Image"], ["Boxes", "Variances"],
+            infer=_prior_box_infer, compute=_prior_box_compute, grad=None)
+
+
+# -- anchor_generator -------------------------------------------------------
+
+def _anchor_gen_infer(op, block):
+    x = in_var(op, block, "Input")
+    n = len(op.attrs["anchor_sizes"]) * len(op.attrs["aspect_ratios"])
+    h, w = x.shape[2], x.shape[3]
+    set_output(op, block, "Anchors", (h, w, n, 4), "float32")
+    set_output(op, block, "Variances", (h, w, n, 4), "float32")
+
+
+def _anchor_gen_compute(ins, attrs, ctx, op_index):
+    fmap = ins["Input"][0]
+    h, w = fmap.shape[2], fmap.shape[3]
+    stride = attrs.get("stride", [16.0, 16.0])
+    sw, sh = float(stride[0]), float(stride[1])
+    offset = float(attrs.get("offset", 0.5))
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ars = [float(a) for a in attrs["aspect_ratios"]]
+    # anchor_generator_op.h:57-75: rounded base box from stride area
+    aws, ahs = [], []
+    for ar in ars:
+        for s in sizes:
+            base_w = np.round(np.sqrt(sw * sh / ar))
+            base_h = np.round(base_w * ar)
+            aws.append(s / sw * base_w)
+            ahs.append(s / sh * base_h)
+    aw = jnp.asarray(aws, jnp.float32)[None, None, :]
+    ah = jnp.asarray(ahs, jnp.float32)[None, None, :]
+    x_ctr = (jnp.arange(w) * sw + offset * (sw - 1))[None, :, None]
+    y_ctr = (jnp.arange(h) * sh + offset * (sh - 1))[:, None, None]
+    n = aw.shape[-1]
+    anchors = jnp.stack([
+        jnp.broadcast_to(x_ctr - 0.5 * (aw - 1), (h, w, n)),
+        jnp.broadcast_to(y_ctr - 0.5 * (ah - 1), (h, w, n)),
+        jnp.broadcast_to(x_ctr + 0.5 * (aw - 1), (h, w, n)),
+        jnp.broadcast_to(y_ctr + 0.5 * (ah - 1), (h, w, n)),
+    ], axis=-1)
+    variances = jnp.broadcast_to(
+        jnp.asarray(attrs.get("variances", [0.1, 0.1, 0.2, 0.2]),
+                    jnp.float32), anchors.shape)
+    return {"Anchors": anchors.astype(jnp.float32),
+            "Variances": variances}
+
+
+register_op("anchor_generator", ["Input"], ["Anchors", "Variances"],
+            infer=_anchor_gen_infer, compute=_anchor_gen_compute,
+            grad=None)
+
+
+# -- box_coder --------------------------------------------------------------
+
+def _center_form(b, off):
+    w = b[..., 2] - b[..., 0] + off
+    h = b[..., 3] - b[..., 1] + off
+    cx = (b[..., 2] + b[..., 0]) / 2
+    cy = (b[..., 3] + b[..., 1]) / 2
+    return cx, cy, w, h
+
+
+def _box_coder_infer(op, block):
+    t = in_var(op, block, "TargetBox")
+    p = in_var(op, block, "PriorBox")
+    if op.attrs.get("code_type", "encode_center_size") \
+            .endswith("encode_center_size"):
+        set_output(op, block, "OutputBox",
+                   (t.shape[0], p.shape[0], 4), "float32")
+    else:
+        set_output(op, block, "OutputBox", t.shape, "float32")
+
+
+def _box_coder_compute(ins, attrs, ctx, op_index):
+    tb = ins["TargetBox"][0]
+    pb = ins["PriorBox"][0]
+    pvs = ins.get("PriorBoxVar")
+    pv = pvs[0] if pvs and pvs[0] is not None else None
+    off = 0.0 if attrs.get("box_normalized", True) else 1.0
+    code = attrs.get("code_type", "encode_center_size")
+    pcx, pcy, pw, ph = _center_form(pb, off)           # [M]
+    if code.endswith("encode_center_size"):
+        tcx, tcy, tw, th = _center_form(tb, off)       # [N]
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :],
+            jnp.log(jnp.abs(tw[:, None] / pw[None, :])),
+            jnp.log(jnp.abs(th[:, None] / ph[None, :])),
+        ], axis=-1)                                     # [N, M, 4]
+        if pv is not None:
+            out = out / pv[None, :, :]
+    else:  # decode_center_size: tb [N, M, 4] against prior j per column
+        t = tb
+        if pv is not None:
+            t = t * pv[None, :, :]
+        cx = t[..., 0] * pw[None, :] + pcx[None, :]
+        cy = t[..., 1] * ph[None, :] + pcy[None, :]
+        w = jnp.exp(t[..., 2]) * pw[None, :]
+        h = jnp.exp(t[..., 3]) * ph[None, :]
+        out = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - off, cy + h / 2 - off], axis=-1)
+    return {"OutputBox": out.astype(jnp.float32)}
+
+
+register_op("box_coder", ["TargetBox", "PriorBox", "PriorBoxVar"],
+            ["OutputBox"],
+            infer=_box_coder_infer, compute=_box_coder_compute, grad=None)
+
+
+# -- bipartite_match --------------------------------------------------------
+
+def _bipartite_match_single(dist, per_prediction=False,
+                            dist_threshold=0.5):
+    """dist [G, P] -> (col_to_row [P] int32, col_dist [P]).  Greedy
+    global-max bipartite (bipartite_match_op.cc:65-105); with
+    match_type='per_prediction' (bipartite_match_op.cc:199-243),
+    unmatched columns whose best dist >= dist_threshold take their
+    argmax row."""
+    g, p = dist.shape
+    match = jnp.full((p,), -1, jnp.int32)
+    mdist = jnp.zeros((p,), dist.dtype)
+    row_used = jnp.zeros((g,), bool)
+    col_used = jnp.zeros((p,), bool)
+
+    def body(_, carry):
+        match, mdist, row_used, col_used = carry
+        masked = jnp.where(row_used[:, None] | col_used[None, :],
+                           _BIG_NEG, dist)
+        flat = jnp.argmax(masked)
+        i, j = flat // p, flat % p
+        best = masked[i, j]
+        ok = best > 0
+        match = jnp.where(ok, match.at[j].set(i.astype(jnp.int32)),
+                          match)
+        mdist = jnp.where(ok, mdist.at[j].set(best), mdist)
+        row_used = jnp.where(ok, row_used.at[i].set(True), row_used)
+        col_used = jnp.where(ok, col_used.at[j].set(True), col_used)
+        return match, mdist, row_used, col_used
+
+    match, mdist, _, _ = lax.fori_loop(
+        0, min(g, p), body, (match, mdist, row_used, col_used))
+    if per_prediction:
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        fill = (match == -1) & (best_val >= dist_threshold)
+        match = jnp.where(fill, best_row, match)
+        mdist = jnp.where(fill, best_val, mdist)
+    return match, mdist
+
+
+def _bipartite_infer(op, block):
+    d = in_var(op, block, "DistMat")
+    b = d.shape[0] if len(d.shape) == 3 else 1
+    p = d.shape[-1]
+    set_output(op, block, "ColToRowMatchIndices", (b, p), "int32")
+    set_output(op, block, "ColToRowMatchDist", (b, p), "float32")
+
+
+def _bipartite_compute(ins, attrs, ctx, op_index):
+    dist = ins["DistMat"][0]
+    if dist.ndim == 2:
+        dist = dist[None]
+    per_pred = attrs.get("match_type", "bipartite") == "per_prediction"
+    thresh = float(attrs.get("dist_threshold", 0.5))
+    match, mdist = jax.vmap(
+        lambda d: _bipartite_match_single(d, per_pred, thresh))(dist)
+    return {"ColToRowMatchIndices": match,
+            "ColToRowMatchDist": mdist.astype(jnp.float32)}
+
+
+register_op("bipartite_match", ["DistMat"],
+            ["ColToRowMatchIndices", "ColToRowMatchDist"],
+            infer=_bipartite_infer, compute=_bipartite_compute, grad=None)
+
+
+# -- target_assign ----------------------------------------------------------
+
+def _target_assign_infer(op, block):
+    x = in_var(op, block, "X")
+    m = in_var(op, block, "MatchIndices")
+    k = x.shape[-1]
+    set_output(op, block, "Out", (m.shape[0], m.shape[1], k), x.dtype)
+    set_output(op, block, "OutWeight", (m.shape[0], m.shape[1], 1),
+               "float32")
+
+
+def _target_assign_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]                       # [B, G, K] per-image gt rows
+    match = ins["MatchIndices"][0]        # [B, P] gt row or -1
+    mismatch = float(attrs.get("mismatch_value", 0))
+    safe = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(
+        x, safe[:, :, None].astype(jnp.int32), axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    weight = matched.astype(jnp.float32)
+    negs = ins.get("NegIndices")
+    if negs and negs[0] is not None:
+        neg = negs[0]                     # [B, Q] prior ids (or -1 pad)
+        b_idx = jnp.broadcast_to(jnp.arange(neg.shape[0])[:, None],
+                                 neg.shape)
+        tgt = jnp.where(neg >= 0, neg, weight.shape[1])
+        weight = weight.at[b_idx, tgt, 0].set(1.0, mode="drop")
+    return {"Out": out, "OutWeight": weight}
+
+
+register_op("target_assign", ["X", "MatchIndices", "NegIndices"],
+            ["Out", "OutWeight"],
+            infer=_target_assign_infer, compute=_target_assign_compute,
+            grad=None)
+
+
+# -- multiclass_nms ---------------------------------------------------------
+
+def _nms_class(boxes, scores, score_thresh, nms_thresh, top_k,
+               normalized):
+    """One class: boxes [M,4], scores [M] -> keep mask [M] (greedy NMS
+    over the top_k highest scores)."""
+    m = boxes.shape[0]
+    k = min(top_k, m) if top_k > 0 else m
+    order = jnp.argsort(-scores)
+    sboxes = boxes[order]
+    sscores = scores[order]
+    iou = _iou_matrix(sboxes, sboxes, normalized)
+    valid = sscores > score_thresh
+
+    def body(i, keep):
+        # suppressed iff any already-kept earlier box overlaps > thresh
+        earlier_kept = jnp.where(jnp.arange(m) < i, keep, False)
+        sup = jnp.any(earlier_kept & (iou[:, i] > nms_thresh))
+        ok = valid[i] & (i < k) & ~sup
+        return keep.at[i].set(ok)
+
+    keep_sorted = lax.fori_loop(0, m, body, jnp.zeros((m,), bool))
+    keep = jnp.zeros((m,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def _multiclass_nms_single(bboxes, scores, attrs):
+    """bboxes [M,4], scores [C,M] -> out [keep_top_k, 6], count."""
+    c, m = scores.shape
+    bg = int(attrs.get("background_label", 0))
+    score_thresh = float(attrs.get("score_threshold", 0.0))
+    nms_thresh = float(attrs.get("nms_threshold", 0.3))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    normalized = bool(attrs.get("normalized", True))
+
+    def per_class(cls_scores):
+        return _nms_class(bboxes, cls_scores, score_thresh, nms_thresh,
+                          nms_top_k, normalized)
+
+    keep = jax.vmap(per_class)(scores)           # [C, M]
+    if 0 <= bg < c:
+        keep = keep.at[bg].set(False)
+    flat_scores = jnp.where(keep, scores, _BIG_NEG).reshape(-1)  # [C*M]
+    total = keep_top_k if keep_top_k > 0 else c * m
+    total = min(total, c * m)
+    top_scores, top_idx = lax.top_k(flat_scores, total)
+    cls_ids = (top_idx // m).astype(jnp.float32)
+    box_ids = top_idx % m
+    sel_boxes = bboxes[box_ids]
+    valid = top_scores > _BIG_NEG / 2
+    out = jnp.concatenate([
+        jnp.where(valid, cls_ids, -1.0)[:, None],
+        jnp.where(valid, top_scores, 0.0)[:, None],
+        jnp.where(valid[:, None], sel_boxes, 0.0),
+    ], axis=1)
+    return out, jnp.sum(valid.astype(jnp.int32))
+
+
+def _multiclass_nms_infer(op, block):
+    s = in_var(op, block, "Scores")
+    b = s.shape[0]
+    keep = int(op.attrs.get("keep_top_k", -1))
+    m = s.shape[-1]
+    n = keep if keep > 0 else (None if m in (None, -1) else
+                               s.shape[1] * m)
+    set_output(op, block, "Out", (b, n, 6), "float32", lod_level=1)
+    set_output(op, block, "OutLength", (b,), "int32")
+
+
+def _multiclass_nms_compute(ins, attrs, ctx, op_index):
+    bboxes = ins["BBoxes"][0]             # [B, M, 4]
+    scores = ins["Scores"][0]             # [B, C, M]
+    out, count = jax.vmap(
+        lambda b, s: _multiclass_nms_single(b, s, attrs))(bboxes, scores)
+    return {"Out": out, "OutLength": count}
+
+
+register_op("multiclass_nms", ["BBoxes", "Scores"], ["Out", "OutLength"],
+            infer=_multiclass_nms_infer, compute=_multiclass_nms_compute,
+            grad=None)
+
+
+# -- roi_pool ---------------------------------------------------------------
+
+def _roi_pool_infer(op, block):
+    x = in_var(op, block, "X")
+    rois = in_var(op, block, "ROIs")
+    set_output(op, block, "Out",
+               (rois.shape[0], x.shape[1],
+                int(op.attrs["pooled_height"]),
+                int(op.attrs["pooled_width"])), x.dtype)
+
+
+def _roi_pool_compute(ins, attrs, ctx, op_index):
+    """Max-pool each ROI into a fixed [ph, pw] grid
+    (roi_pool_op.cc semantics; ROIs are [R, 4] pixel coords with a
+    companion RoisBatch [R] image index, replacing the LoD)."""
+    x = ins["X"][0]                       # [N, C, H, W]
+    rois = ins["ROIs"][0]                 # [R, 4]
+    rbs = ins.get("RoisBatch")
+    roi_batch = rbs[0] if rbs and rbs[0] is not None else \
+        jnp.zeros((rois.shape[0],), jnp.int32)
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi, b):
+        x1 = jnp.round(roi[0] * scale)
+        y1 = jnp.round(roi[1] * scale)
+        x2 = jnp.round(roi[2] * scale)
+        y2 = jnp.round(roi[3] * scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        img = x[b]                        # [C, H, W]
+
+        def pool_bin(py, px):
+            y_lo = y1 + jnp.floor(py * bin_h)
+            y_hi = y1 + jnp.ceil((py + 1) * bin_h)
+            x_lo = x1 + jnp.floor(px * bin_w)
+            x_hi = x1 + jnp.ceil((px + 1) * bin_w)
+            ymask = (ys >= y_lo) & (ys < jnp.maximum(y_hi, y_lo + 1)) \
+                & (ys >= 0) & (ys < h)
+            xmask = (xs >= x_lo) & (xs < jnp.maximum(x_hi, x_lo + 1)) \
+                & (xs >= 0) & (xs < w)
+            mask = ymask[:, None] & xmask[None, :]
+            return jnp.max(jnp.where(mask[None], img, _BIG_NEG),
+                           axis=(1, 2))
+
+        grid = jax.vmap(lambda py: jax.vmap(
+            lambda px: pool_bin(py, px))(jnp.arange(pw)))(jnp.arange(ph))
+        # grid [ph, pw, C] -> [C, ph, pw]; empty bins -> 0
+        grid = jnp.where(grid <= _BIG_NEG / 2, 0.0, grid)
+        return grid.transpose(2, 0, 1)
+
+    out = jax.vmap(one_roi)(rois.astype(jnp.float32),
+                            roi_batch.astype(jnp.int32))
+    return {"Out": out.astype(x.dtype)}
+
+
+register_op("roi_pool", ["X", "ROIs", "RoisBatch"], ["Out"],
+            infer=_roi_pool_infer, compute=_roi_pool_compute,
+            no_grad_inputs=("ROIs", "RoisBatch"))
+
+
+# -- polygon_box_transform --------------------------------------------------
+
+def _pbt_compute(ins, attrs, ctx, op_index):
+    """polygon_box_transform_op.cc:43-48: even channels out = col - in,
+    odd channels out = row - in, on a [N, C, H, W] geometry map."""
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    cols = jnp.arange(w, dtype=x.dtype)
+    rows = jnp.arange(h, dtype=x.dtype)
+    ch = jnp.arange(c)
+    base = jnp.where((ch % 2 == 0)[None, :, None, None],
+                     jnp.broadcast_to(cols[None, None, None, :],
+                                      (1, c, h, w)),
+                     jnp.broadcast_to(rows[None, None, :, None],
+                                      (1, c, h, w)))
+    return {"Out": base - x}
+
+
+register_op("polygon_box_transform", ["X"], ["Out"],
+            infer=lambda op, block: set_output(
+                op, block, "Out", in_var(op, block, "X").shape,
+                in_var(op, block, "X").dtype),
+            compute=_pbt_compute, grad=None)

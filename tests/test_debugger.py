@@ -1,0 +1,44 @@
+"""Program printer + graphviz export tests (reference debugger.py /
+graphviz.py parity)."""
+
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import debugger
+
+
+def _build():
+    x = fluid.layers.data("x", shape=[4])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(x, size=3, act="softmax",
+                           param_attr=fluid.ParamAttr(name="dbg_w"))
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_pprint_program(fresh_programs):
+    _build()
+    text = debugger.pprint_program_codes()
+    assert "param dbg_w" in text
+    assert "mul(" in text and "softmax" in text
+    assert "_grad" not in text              # backward hidden by default
+    full = debugger.pprint_program_codes(show_backward=True)
+    assert "_grad" in full and "sgd" in full
+
+
+def test_draw_block_graphviz(tmp_path, fresh_programs):
+    _build()
+    path = str(tmp_path / "g.dot")
+    out = debugger.draw_block_graphviz(
+        fluid.default_main_program().global_block(),
+        highlights=["dbg_w"], path=path)
+    assert out == path and os.path.exists(path)
+    dot = open(path).read()
+    assert dot.startswith("digraph G {") and dot.rstrip().endswith("}")
+    assert '"var_dbg_w"' in dot and "orange" in dot
+    assert '[label="mul" shape=box' in dot
+    # edges connect vars to ops
+    assert '"var_dbg_w" -> "op_' in dot

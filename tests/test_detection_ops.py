@@ -1,0 +1,239 @@
+"""Detection op tests vs numpy oracles: iou, prior_box, anchor_generator,
+box_coder encode/decode round-trip, bipartite_match, target_assign,
+multiclass_nms, roi_pool, polygon_box_transform."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(build, feed):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fetches = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        return exe.run(feed=feed, fetch_list=list(fetches))
+
+
+def _np_iou(a, b):
+    out = np.zeros((len(a), len(b)))
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            ix1, iy1 = max(x[0], y[0]), max(x[1], y[1])
+            ix2, iy2 = min(x[2], y[2]), min(x[3], y[3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            ua = ((x[2] - x[0]) * (x[3] - x[1]) +
+                  (y[2] - y[0]) * (y[3] - y[1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+def test_iou_similarity():
+    rng = np.random.RandomState(0)
+    a = np.sort(rng.rand(5, 4).astype("float32"), -1)[:, [0, 1, 2, 3]]
+    a = np.stack([a[:, 0], a[:, 1], a[:, 0] + a[:, 2] + 0.1,
+                  a[:, 1] + a[:, 3] + 0.1], -1).astype("float32")
+    b = np.stack([a[:, 0] + 0.05, a[:, 1] + 0.05, a[:, 2] + 0.05,
+                  a[:, 3] + 0.05], -1)[:3].astype("float32")
+
+    def build():
+        x = fluid.layers.data("x", shape=[4], append_batch_size=False)
+        x.shape = (-1, 4)
+        y = fluid.layers.data("y", shape=[4], append_batch_size=False)
+        y.shape = (-1, 4)
+        return (fluid.layers.iou_similarity(x, y),)
+
+    (got,) = _run(build, {"x": a, "y": b})
+    np.testing.assert_allclose(got, _np_iou(a, b), atol=1e-5)
+
+
+def test_prior_box_layout_and_values():
+    img = np.zeros((1, 3, 32, 32), "float32")
+    fmap = np.zeros((1, 8, 4, 4), "float32")
+
+    def build():
+        i = fluid.layers.data("img", shape=[3, 32, 32])
+        f = fluid.layers.data("fmap", shape=[8, 4, 4])
+        boxes, variances = fluid.layers.prior_box(
+            f, i, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        return boxes, variances
+
+    boxes, variances = _run(build, {"img": img, "fmap": fmap})
+    # priors: ars {1, 2, 0.5} x 1 min_size + 1 max_size = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert variances.shape == boxes.shape
+    np.testing.assert_allclose(variances[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    # first prior at (0,0): center (0.5*8, 0.5*8)=(4,4), ar=1 size 8
+    np.testing.assert_allclose(
+        boxes[0, 0, 0], [0.0, 0.0, 8.0 / 32, 8.0 / 32], atol=1e-6)
+    # max_size prior: sqrt(8*16)/2 half-size
+    hs = np.sqrt(8 * 16) / 2
+    np.testing.assert_allclose(
+        boxes[0, 0, 3], [max(0, (4 - hs) / 32), max(0, (4 - hs) / 32),
+                         (4 + hs) / 32, (4 + hs) / 32], atol=1e-6)
+    assert boxes.min() >= 0 and boxes.max() <= 1  # clipped
+
+
+def test_anchor_generator_matches_reference_formula():
+    fmap = np.zeros((1, 8, 2, 3), "float32")
+
+    def build():
+        f = fluid.layers.data("fmap", shape=[8, 2, 3])
+        anchors, variances = fluid.layers.anchor_generator(
+            f, anchor_sizes=[32.0], aspect_ratios=[1.0, 2.0],
+            stride=[16.0, 16.0])
+        return anchors, variances
+
+    anchors, variances = _run(build, {"fmap": fmap})
+    assert anchors.shape == (2, 3, 2, 4)
+    # reference formula for ar=1, size=32, stride 16: base=16, scale=2
+    # -> w=h=32; center at offset*(stride-1)=7.5
+    np.testing.assert_allclose(
+        anchors[0, 0, 0], [7.5 - 15.5, 7.5 - 15.5, 7.5 + 15.5,
+                           7.5 + 15.5], atol=1e-5)
+    # ar=2: base_w=round(sqrt(256/2))=11, base_h=22 -> w=22, h=44
+    np.testing.assert_allclose(
+        anchors[0, 0, 1],
+        [7.5 - 0.5 * 21, 7.5 - 0.5 * 43, 7.5 + 0.5 * 21, 7.5 + 0.5 * 43],
+        atol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = np.abs(rng.rand(6, 4)).astype("float32")
+    priors[:, 2:] = priors[:, :2] + 0.2 + priors[:, 2:] * 0.3
+    pvar = np.full((6, 4), 0.1, "float32")
+    targets = np.abs(rng.rand(3, 4)).astype("float32")
+    targets[:, 2:] = targets[:, :2] + 0.15 + targets[:, 2:] * 0.2
+
+    def build():
+        p = fluid.layers.data("p", shape=[4], append_batch_size=False)
+        p.shape = (-1, 4)
+        pv = fluid.layers.data("pv", shape=[4], append_batch_size=False)
+        pv.shape = (-1, 4)
+        t = fluid.layers.data("t", shape=[4], append_batch_size=False)
+        t.shape = (-1, 4)
+        enc = fluid.layers.box_coder(p, pv, t, "encode_center_size")
+        dec = fluid.layers.box_coder(p, pv, enc, "decode_center_size")
+        return enc, dec
+
+    enc, dec = _run(build, {"p": priors, "pv": pvar, "t": targets})
+    assert enc.shape == (3, 6, 4)
+    # decoding the encoding recovers each target against every prior
+    for j in range(6):
+        np.testing.assert_allclose(dec[:, j, :], targets, atol=1e-4)
+
+
+def test_bipartite_match_greedy_and_per_prediction():
+    dist = np.array([[[0.9, 0.2, 0.0, 0.6],
+                      [0.8, 0.7, 0.0, 0.1]]], "float32")  # [1, 2, 4]
+
+    def build():
+        d = fluid.layers.data("d", shape=[2, 4], append_batch_size=False)
+        d.shape = (-1, 2, 4)
+        m, md = fluid.layers.bipartite_match(d)
+        m2, md2 = fluid.layers.bipartite_match(
+            d, match_type="per_prediction", dist_threshold=0.5)
+        return m, md, m2, md2
+
+    m, md, m2, md2 = _run(build, {"d": dist})
+    # greedy: global max 0.9 -> col0=row0; next best unused 0.7 -> col1=row1
+    assert m[0, 0] == 0 and m[0, 1] == 1
+    # pure bipartite mode leaves remaining columns unmatched
+    assert m[0, 2] == -1 and m[0, 3] == -1
+    np.testing.assert_allclose(md[0], [0.9, 0.7, 0.0, 0.0], atol=1e-6)
+    # per_prediction fills col3 (best dist 0.6 >= 0.5) but NOT col2 (0.0)
+    assert m2[0, 3] == 0 and m2[0, 2] == -1
+    np.testing.assert_allclose(md2[0], [0.9, 0.7, 0.0, 0.6], atol=1e-6)
+
+
+def test_target_assign_scatter():
+    x = np.arange(12, dtype="float32").reshape(1, 3, 4)  # 3 gt rows
+    match = np.array([[1, -1, 2, 0]], "int32")
+
+    def build():
+        xi = fluid.layers.data("x", shape=[3, 4], append_batch_size=False)
+        xi.shape = (-1, 3, 4)
+        mi = fluid.layers.data("m", shape=[4], dtype="int32",
+                               append_batch_size=False)
+        mi.shape = (-1, 4)
+        out, w = fluid.layers.target_assign(xi, mi, mismatch_value=-7)
+        return out, w
+
+    out, w = _run(build, {"x": x, "m": match})
+    np.testing.assert_allclose(out[0, 0], x[0, 1])
+    np.testing.assert_allclose(out[0, 1], [-7] * 4)
+    np.testing.assert_allclose(out[0, 2], x[0, 2])
+    np.testing.assert_allclose(w[0, :, 0], [1, 0, 1, 1])
+
+
+def test_multiclass_nms_suppresses_overlaps():
+    # 4 boxes: 0 and 1 overlap heavily; 2 is separate; 3 low score
+    boxes = np.array([[[0.0, 0.0, 0.4, 0.4],
+                       [0.02, 0.02, 0.42, 0.42],
+                       [0.6, 0.6, 0.9, 0.9],
+                       [0.0, 0.6, 0.2, 0.9]]], "float32")
+    scores = np.zeros((1, 2, 4), "float32")
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.01]   # class 1 (class 0 = bg)
+
+    def build():
+        b = fluid.layers.data("b", shape=[4, 4], append_batch_size=False)
+        b.shape = (-1, 4, 4)
+        s = fluid.layers.data("s", shape=[2, 4], append_batch_size=False)
+        s.shape = (-1, 2, 4)
+        out = fluid.layers.multiclass_nms(
+            b, s, score_threshold=0.05, nms_threshold=0.5, keep_top_k=4)
+        ln = fluid.layers.sequence_length(out)
+        return out, ln
+
+    out, ln = _run(build, {"b": boxes, "s": scores})
+    assert ln[0] == 2                       # box1 suppressed, box3 cut
+    np.testing.assert_allclose(out[0, 0, :2], [1, 0.9], atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 2:], boxes[0, 0], atol=1e-6)
+    np.testing.assert_allclose(out[0, 1, :2], [1, 0.7], atol=1e-6)
+    assert (out[0, 2:, 0] == -1).all()      # padding rows labeled -1
+
+
+def test_roi_pool_max_pooling():
+    x = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0],
+                     [4.0, 4.0, 7.0, 7.0]], "float32")
+
+    def build():
+        xi = fluid.layers.data("x", shape=[1, 8, 8])
+        r = fluid.layers.data("rois", shape=[4], append_batch_size=False)
+        r.shape = (-1, 4)
+        out = fluid.layers.roi_pool(xi, r, pooled_height=2,
+                                    pooled_width=2)
+        return (out,)
+
+    (out,) = _run(build, {"x": x, "rois": rois})
+    assert out.shape == (2, 1, 2, 2)
+    img = x[0, 0]
+    # roi 0 covers rows/cols 0..3, 2x2 bins of 2x2 pixels each: max =
+    # bottom-right element of each bin
+    np.testing.assert_allclose(out[0, 0],
+                               [[img[1, 1], img[1, 3]],
+                                [img[3, 1], img[3, 3]]])
+    np.testing.assert_allclose(out[1, 0],
+                               [[img[5, 5], img[5, 7]],
+                                [img[7, 5], img[7, 7]]])
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 8, 2, 2), "float32")
+    x[0, 0, 0, 1] = 1.0    # channel 0 (x-offset), pixel (0,1)
+    x[0, 1, 1, 0] = 2.0    # channel 1 (y-offset), pixel (1,0)
+
+    def build():
+        xi = fluid.layers.data("x", shape=[8, 2, 2])
+        return (fluid.layers.polygon_box_transform(xi),)
+
+    (out,) = _run(build, {"x": x})
+    # reference (polygon_box_transform_op.cc:43-48): even ch -> col - in,
+    # odd ch -> row - in
+    assert out[0, 0, 0, 1] == pytest.approx(1 - 1.0)
+    assert out[0, 1, 1, 0] == pytest.approx(1 - 2.0)
+    assert out[0, 0, 1, 1] == pytest.approx(1.0)   # col 1, offset 0
+    assert out[0, 1, 0, 0] == pytest.approx(0.0)   # row 0, offset 0

@@ -16,6 +16,8 @@ __all__ = [
     "detection_output",
     "roi_pool",
     "polygon_box_transform",
+    "mine_hard_examples",
+    "ssd_loss",
 ]
 
 
@@ -174,3 +176,96 @@ def polygon_box_transform(input, name=None):
     helper.append_op(type="polygon_box_transform",
                      inputs={"X": [input]}, outputs={"Out": [out]})
     return out
+
+
+def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_dist_threshold=0.5,
+                       mining_type="max_negative", sample_size=None,
+                       name=None):
+    """Hard-negative mining (mine_hard_examples_op.cc, max_negative
+    mode).  Returns (neg_indices [B, P] -1-padded, updated_match)."""
+    helper = LayerHelper("mine_hard_examples", input=cls_loss, name=name)
+    neg = helper.create_variable_for_type_inference("int32")
+    neg_count = helper.create_variable_for_type_inference("int32")
+    updated = helper.create_variable_for_type_inference("int32")
+    inputs = {"ClsLoss": [cls_loss], "MatchIndices": [match_indices],
+              "MatchDist": [match_dist]}
+    if loc_loss is not None:
+        inputs["LocLoss"] = [loc_loss]
+    helper.append_op(
+        type="mine_hard_examples", inputs=inputs,
+        outputs={"NegIndices": [neg], "NegCount": [neg_count],
+                 "UpdatedMatchIndices": [updated]},
+        attrs={"neg_pos_ratio": float(neg_pos_ratio),
+               "neg_dist_threshold": float(neg_dist_threshold),
+               "mining_type": mining_type,
+               "sample_size": int(sample_size or -1)})
+    for v in (neg, neg_count, updated):
+        v.stop_gradient = True
+    return neg, updated
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             normalize=True, sample_size=None):
+    """SSD multibox loss (reference detection.py:662 ssd_loss): match
+    priors to ground truth, mine hard negatives, and combine smooth-L1
+    localization loss with softmax confidence loss.
+
+    Shapes (padded-batch convention): location [B, P, 4], confidence
+    [B, P, C], gt_box [B, G, 4], gt_label [B, G, 1] (pad gt rows with
+    boxes of zero area), prior_box [P, 4].  Returns the per-prior
+    weighted loss [B, P, 1].
+    """
+    from .. import layers as L  # composite of existing layers/ops
+
+    # 1. match: iou [B, G, P] -> per-prior matched gt row
+    iou = iou_similarity(gt_box, prior_box)
+    matched, match_dist = bipartite_match(iou, match_type,
+                                          overlap_threshold)
+
+    # 2. confidence targets for mining: background where unmatched
+    tgt_label, _ = target_assign(gt_label, matched,
+                                 mismatch_value=background_label)
+    tgt_label = L.cast(tgt_label, "int64")
+    mining_conf_loss = L.softmax_with_cross_entropy(confidence, tgt_label)
+
+    # 3. hard-negative mining
+    neg_indices, updated = mine_hard_examples(
+        L.reshape(mining_conf_loss, shape=[0, -1]), matched, match_dist,
+        neg_pos_ratio=neg_pos_ratio, neg_dist_threshold=neg_overlap,
+        mining_type=mining_type, sample_size=sample_size)
+
+    # 4. confidence loss weighted over positives + mined negatives
+    # (reuses the mining pass's cross-entropy — same op, same inputs)
+    _, conf_wt = target_assign(gt_label, matched,
+                               negative_indices=neg_indices,
+                               mismatch_value=background_label)
+    conf_loss = L.elementwise_mul(mining_conf_loss, conf_wt)
+
+    # 5. localization targets: encode gt against priors, gather matched
+    gt_flat = L.reshape(gt_box, shape=[-1, 4])
+    enc = box_coder(prior_box, prior_box_var, gt_flat,
+                    "encode_center_size")           # [B*G, P, 4]
+    enc = L.reshape(
+        enc, shape=[-1, gt_box.shape[1], prior_box.shape[0], 4])
+    loc_target, loc_wt = target_assign(enc, matched, mismatch_value=0)
+    # per-prior smooth-L1 via clip identity: with m = clip(|d|, 0, 1),
+    # 0.5*m^2 + (|d| - m) equals 0.5 d^2 for |d|<1 and |d|-0.5 beyond
+    ad = L.abs(L.elementwise_sub(location, loc_target))
+    m = L.clip(ad, min=0.0, max=1.0)
+    sl1 = L.elementwise_add(
+        L.scale(L.elementwise_mul(m, m), scale=0.5),
+        L.elementwise_sub(ad, m))
+    loc_loss = L.reduce_sum(sl1, dim=-1, keep_dim=True)
+    loc_loss = L.elementwise_mul(loc_loss, loc_wt)
+
+    loss = L.elementwise_add(L.scale(loc_loss, scale=loc_loss_weight),
+                             L.scale(conf_loss, scale=conf_loss_weight))
+    if normalize:
+        num_matched = L.reduce_sum(loc_wt) + 1e-6
+        loss = L.elementwise_div(loss, num_matched)
+    return loss

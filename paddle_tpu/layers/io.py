@@ -4,7 +4,9 @@ Parity: reference ``python/paddle/fluid/layers/io.py:37 data`` — declares a
 feedable program input.  ``append_batch_size=True`` prepends a -1 batch dim
 like the reference; on TPU the executor specializes the jit per concrete
 batch size (bucketing handles variance — see data layer docs).
-py_reader / double_buffer equivalents live in ``paddle_tpu.data.pipeline``.
+py_reader / double_buffer equivalents live in ``paddle_tpu.reader``
+(``PyReader``: host thread staging feed dicts onto the device ahead of
+the training loop).
 """
 
 from ..core import VarType

@@ -10,7 +10,7 @@ import numpy as np
 
 __all__ = [
     "MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
-    "EditDistance", "Auc",
+    "EditDistance", "Auc", "DetectionMAP",
 ]
 
 
@@ -147,3 +147,88 @@ class Auc(MetricBase):
         tpr = tp / tot_pos
         fpr = fp / tot_neg
         return float(np.trapezoid(tpr, fpr))
+
+
+class DetectionMAP(MetricBase):
+    """Streaming mean-average-precision for detection (reference
+    fluid/metrics.py DetectionMAP / detection_map_op.cc) — host-side
+    accumulation (mAP evaluation has no MXU work; keeping it off-graph
+    is the TPU-appropriate split).
+
+    update(detections, gt_boxes, gt_labels): detections [N, 6]
+    (label, score, x1, y1, x2, y2) from multiclass_nms; gt per image.
+    eval() returns mAP over accumulated images (11-point or integral).
+    """
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 ap_version="integral", class_num=None):
+        super().__init__(name)
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self._scores = {}   # class -> list of (score, is_tp)
+        self._n_gt = {}     # class -> gt count
+
+    @staticmethod
+    def _iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        inter = max(ix2 - ix1, 0.0) * max(iy2 - iy1, 0.0)
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def reset(self):
+        """Clear accumulated detections/counts; thresholds are config,
+        not state (MetricBase.reset would zero them)."""
+        self._scores = {}
+        self._n_gt = {}
+
+    def update(self, detections, gt_boxes, gt_labels):
+        detections = np.asarray(detections, dtype=np.float64)
+        gt_boxes = np.asarray(gt_boxes, dtype=np.float64)
+        gt_labels = np.asarray(gt_labels).reshape(-1)
+        for c in np.unique(gt_labels):
+            self._n_gt[int(c)] = self._n_gt.get(int(c), 0) + \
+                int(np.sum(gt_labels == c))
+        used = np.zeros(len(gt_boxes), bool)
+        order = np.argsort(-detections[:, 1]) if len(detections) else []
+        for i in order:
+            lbl, score = int(detections[i, 0]), detections[i, 1]
+            if lbl < 0:
+                continue
+            box = detections[i, 2:6]
+            best, best_j = 0.0, -1
+            for j, (gb, gl) in enumerate(zip(gt_boxes, gt_labels)):
+                if int(gl) != lbl or used[j]:
+                    continue
+                ov = self._iou(box, gb)
+                if ov > best:
+                    best, best_j = ov, j
+            tp = best >= self.overlap_threshold and best_j >= 0
+            if tp:
+                used[best_j] = True
+            self._scores.setdefault(lbl, []).append((score, tp))
+
+    def eval(self):
+        aps = []
+        for c, n_gt in self._n_gt.items():
+            recs = sorted(self._scores.get(c, []), reverse=True)
+            if not recs or n_gt == 0:
+                aps.append(0.0)
+                continue
+            tps = np.cumsum([1.0 if t else 0.0 for _, t in recs])
+            fps = np.cumsum([0.0 if t else 1.0 for _, t in recs])
+            recall = tps / n_gt
+            precision = tps / np.maximum(tps + fps, 1e-12)
+            if self.ap_version == "11point":
+                ap = np.mean([
+                    np.max(precision[recall >= t], initial=0.0)
+                    for t in np.linspace(0, 1, 11)])
+            else:  # integral
+                ap = 0.0
+                prev_r = 0.0
+                for r, p in zip(recall, precision):
+                    ap += (r - prev_r) * p
+                    prev_r = r
+            aps.append(float(ap))
+        return float(np.mean(aps)) if aps else 0.0

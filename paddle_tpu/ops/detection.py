@@ -343,12 +343,20 @@ def _target_assign_infer(op, block):
 
 
 def _target_assign_compute(ins, attrs, ctx, op_index):
-    x = ins["X"][0]                       # [B, G, K] per-image gt rows
+    x = ins["X"][0]          # [B, G, K] gt rows, or [B, G, P, K]
     match = ins["MatchIndices"][0]        # [B, P] gt row or -1
     mismatch = float(attrs.get("mismatch_value", 0))
-    safe = jnp.maximum(match, 0)
-    out = jnp.take_along_axis(
-        x, safe[:, :, None].astype(jnp.int32), axis=1)
+    safe = jnp.maximum(match, 0).astype(jnp.int32)
+    if x.ndim == 4:
+        # per-(gt, prior) attributes (target_assign_op.h x[i][j][k]):
+        # out[b, p] = x[b, match[b,p], p]
+        b_idx = jnp.broadcast_to(jnp.arange(x.shape[0])[:, None],
+                                 match.shape)
+        p_idx = jnp.broadcast_to(jnp.arange(match.shape[1])[None, :],
+                                 match.shape)
+        out = x[b_idx, safe, p_idx]
+    else:
+        out = jnp.take_along_axis(x, safe[:, :, None], axis=1)
     matched = (match >= 0)[:, :, None]
     out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
     weight = matched.astype(jnp.float32)
@@ -542,3 +550,66 @@ register_op("polygon_box_transform", ["X"], ["Out"],
                 op, block, "Out", in_var(op, block, "X").shape,
                 in_var(op, block, "X").dtype),
             compute=_pbt_compute, grad=None)
+
+
+# -- mine_hard_examples -----------------------------------------------------
+
+def _mine_hard_infer(op, block):
+    m = in_var(op, block, "MatchIndices")
+    set_output(op, block, "NegIndices", m.shape, "int32")
+    set_output(op, block, "NegCount", (m.shape[0],), "int32")
+    set_output(op, block, "UpdatedMatchIndices", m.shape, "int32")
+
+
+def _mine_hard_compute(ins, attrs, ctx, op_index):
+    """max_negative mining (mine_hard_examples_op.cc:29-80): per image,
+    eligible negatives are unmatched priors with match_dist below
+    neg_dist_threshold; the num_pos*neg_pos_ratio highest-conf-loss ones
+    are selected.  NegIndices is a compacted, -1-padded [N, P] index
+    array + NegCount (the LoD replacement)."""
+    cls_loss = ins["ClsLoss"][0]                 # [N, P]
+    match = ins["MatchIndices"][0]               # [N, P]
+    mdist = ins["MatchDist"][0]
+    locs = ins.get("LocLoss")
+    if locs and locs[0] is not None:
+        cls_loss = cls_loss + locs[0]
+    mining_type = attrs.get("mining_type", "max_negative")
+    if mining_type != "max_negative":
+        raise NotImplementedError(
+            "mine_hard_examples: only mining_type='max_negative' is "
+            "implemented (the reference's hard_example mode, "
+            "mine_hard_examples_op.cc:34, is not)")
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    thresh = float(attrs.get("neg_dist_threshold", 0.5))
+    sample_size = int(attrs.get("sample_size", -1) or -1)
+
+    n, p = match.shape
+    eligible = (match == -1) & (mdist < thresh)
+    num_pos = jnp.sum((match != -1).astype(jnp.int32), axis=1)
+    num_neg = jnp.minimum(
+        (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32),
+        jnp.sum(eligible.astype(jnp.int32), axis=1))
+    if sample_size > 0:
+        num_neg = jnp.minimum(num_neg, sample_size)
+
+    masked = jnp.where(eligible, cls_loss, _BIG_NEG)
+    order = jnp.argsort(-masked, axis=1)         # loss-desc prior ids
+    rank = jnp.argsort(order, axis=1)            # rank of each prior
+    sel = eligible & (rank < num_neg[:, None])
+
+    # compact selected prior ids (ascending) into the left of each row
+    pos = jnp.cumsum(sel.astype(jnp.int32), axis=1) - 1
+    b_idx = jnp.broadcast_to(jnp.arange(n)[:, None], (n, p))
+    prior_ids = jnp.broadcast_to(jnp.arange(p)[None, :], (n, p))
+    neg = jnp.full((n, p), -1, jnp.int32).at[
+        b_idx, jnp.where(sel, pos, p)].set(
+        prior_ids.astype(jnp.int32), mode="drop")
+    return {"NegIndices": neg, "NegCount": num_neg.astype(jnp.int32),
+            "UpdatedMatchIndices": match.astype(jnp.int32)}
+
+
+register_op("mine_hard_examples",
+            ["ClsLoss", "LocLoss", "MatchIndices", "MatchDist"],
+            ["NegIndices", "NegCount", "UpdatedMatchIndices"],
+            infer=_mine_hard_infer, compute=_mine_hard_compute,
+            grad=None)

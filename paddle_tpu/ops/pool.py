@@ -214,3 +214,73 @@ register_op("max_pool2d_with_index", ["X"], ["Out", "Mask"],
 register_op("max_pool_with_index_grad", ["X", "Mask", "GRAD::Out"],
             ["GRAD::X"], infer=_pool_idx_grad_infer,
             compute=_pool_idx_grad_compute, grad=None)
+
+
+# -- spp (spatial pyramid pooling, reference spp_op.cc) ---------------------
+
+def _spp_infer(op, block):
+    x = in_var(op, block, "X")
+    levels = int(op.attrs.get("pyramid_height", 1))
+    c = x.shape[1]
+    d = None if c in (None, -1) else \
+        c * sum(4 ** l for l in range(levels))
+    set_output(op, block, "Out", (x.shape[0], d), x.dtype)
+
+
+def _spp_compute(ins, attrs, ctx, op_index):
+    """Concat adaptive 2^l x 2^l poolings of each level, flattened
+    (spp_op.cc: per-level adaptive kernel/stride/pad then concat)."""
+    x = ins["X"][0]                                # [N, C, H, W]
+    levels = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    n = x.shape[0]
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        pooled = _adaptive_pool(x, (bins, bins), 2, ptype == "max")
+        outs.append(pooled.reshape(n, -1))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
+register_op("spp", ["X"], ["Out"], infer=_spp_infer,
+            compute=_spp_compute)
+
+
+# -- unpool (max unpooling with indices, reference unpool_op.cc) ------------
+
+def _unpool_out_hw(shape, attrs):
+    ks = attrs.get("ksize", [2, 2])
+    st = attrs.get("strides", ks)
+    pads = attrs.get("paddings", [0, 0])
+    dims = []
+    for i in range(2):
+        d = shape[2 + i]
+        dims.append(None if d in (None, -1)
+                    else (d - 1) * st[i] - 2 * pads[i] + ks[i])
+    return dims
+
+
+def _unpool_infer(op, block):
+    x = in_var(op, block, "X")
+    h, w = _unpool_out_hw(x.shape, op.attrs)
+    set_output(op, block, "Out", (x.shape[0], x.shape[1], h, w), x.dtype)
+
+
+def _unpool_compute(ins, attrs, ctx, op_index):
+    """Scatter pooled values back to their argmax positions (Indices
+    from max_pool2d_with_index, flattened H*W offsets)."""
+    x = ins["X"][0]                                # [N, C, h, w]
+    idx = ins["Indices"][0].astype(jnp.int32)
+    n, c, h, w = x.shape
+    oh, ow = _unpool_out_hw(x.shape, attrs)
+    flat_out = jnp.zeros((n, c, oh * ow), x.dtype)
+    out = flat_out.at[
+        jnp.arange(n)[:, None, None],
+        jnp.arange(c)[None, :, None],
+        idx.reshape(n, c, -1)].set(x.reshape(n, c, -1), mode="drop")
+    return {"Out": out.reshape(n, c, oh, ow)}
+
+
+register_op("unpool", ["X", "Indices"], ["Out"],
+            infer=_unpool_infer, compute=_unpool_compute,
+            no_grad_inputs=("Indices",))

@@ -1,0 +1,26 @@
+"""API-stability gate (reference tools/print_signatures.py +
+tools/diff_api.py CI pattern): the public surface must match the golden
+list; intentional changes run ``python tools/print_signatures.py
+--update`` and commit the diff."""
+
+import os
+import subprocess
+import sys
+
+
+def test_public_api_matches_golden():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(root, "tools"))
+    try:
+        import print_signatures
+        current = print_signatures.collect()
+        golden = open(print_signatures.GOLDEN).read().splitlines()
+    finally:
+        sys.path.pop(0)
+    cur_set, gold_set = set(current), set(golden)
+    removed = sorted(gold_set - cur_set)
+    added = sorted(cur_set - gold_set)
+    assert not removed and not added, (
+        "public API drifted; run `python tools/print_signatures.py "
+        "--update` if intentional.\nremoved: %s\nadded: %s"
+        % (removed[:10], added[:10]))

@@ -1,0 +1,164 @@
+"""Second detection batch: mine_hard_examples, ssd_loss end-to-end,
+spp, unpool, DetectionMAP metric."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.layer_helper import LayerHelper
+
+
+def _run(build, feed):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fetches = build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        return exe.run(feed=feed, fetch_list=list(fetches))
+
+
+def test_mine_hard_examples_max_negative():
+    # 1 positive (prior 0), ratio 2 -> pick the 2 highest-loss eligible
+    # negatives; prior 3 ineligible (dist >= threshold)
+    cls_loss = np.array([[0.1, 0.9, 0.5, 2.0, 0.7]], "float32")
+    match = np.array([[0, -1, -1, -1, -1]], "int32")
+    mdist = np.array([[0.8, 0.1, 0.2, 0.6, 0.3]], "float32")
+
+    def build():
+        cl = fluid.layers.data("cl", shape=[5], append_batch_size=False)
+        cl.shape = (-1, 5)
+        m = fluid.layers.data("m", shape=[5], dtype="int32",
+                              append_batch_size=False)
+        m.shape = (-1, 5)
+        d = fluid.layers.data("d", shape=[5], append_batch_size=False)
+        d.shape = (-1, 5)
+        neg, updated = fluid.layers.mine_hard_examples(
+            cl, m, d, neg_pos_ratio=2.0, neg_dist_threshold=0.5)
+        return neg, updated
+
+    neg, updated = _run(build, {"cl": cls_loss, "m": match, "d": mdist})
+    # eligible: priors 1 (0.9), 2 (0.5), 4 (0.7); top-2 by loss: 1, 4
+    assert set(neg[0, :2].tolist()) == {1, 4}
+    assert (neg[0, 2:] == -1).all()
+    np.testing.assert_array_equal(updated, match)
+
+
+def test_ssd_loss_trains():
+    """End-to-end: ssd_loss decreases when location/confidence heads
+    learn the synthetic targets."""
+    B, P, G, C = 4, 8, 2, 3
+    rng = np.random.RandomState(0)
+    priors = np.stack([np.linspace(0, 0.7, P)] * 2 +
+                      [np.linspace(0.3, 1.0, P)] * 2, -1).astype(
+        "float32")
+    gtb = np.tile(np.array([[[0.0, 0.0, 0.35, 0.35],
+                             [0.5, 0.5, 0.95, 0.95]]], "float32"),
+                  (B, 1, 1))
+    gtl = np.tile(np.array([[[1], [2]]], "int64"), (B, 1, 1))
+    feats = rng.rand(B, 16).astype("float32")
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 5
+        x = fluid.layers.data("x", shape=[16])
+        gb = fluid.layers.data("gb", shape=[G, 4],
+                               append_batch_size=False)
+        gb.shape = (-1, G, 4)
+        gl = fluid.layers.data("gl", shape=[G, 1], dtype="int64",
+                               append_batch_size=False)
+        gl.shape = (-1, G, 1)
+        pb = fluid.layers.data("pb", shape=[P, 4],
+                               append_batch_size=False)
+        pb.shape = (P, 4)
+        loc = fluid.layers.reshape(
+            fluid.layers.fc(x, size=P * 4, act=None), shape=[-1, P, 4])
+        conf = fluid.layers.reshape(
+            fluid.layers.fc(x, size=P * C, act=None), shape=[-1, P, C])
+        loss = fluid.layers.mean(fluid.layers.ssd_loss(
+            loc, conf, gb, gl, pb, None))
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            losses = []
+            for _ in range(25):
+                (lv,) = exe.run(
+                    feed={"x": feats, "gb": gtb, "gl": gtl, "pb": priors},
+                    fetch_list=[loss])
+                losses.append(float(lv.ravel()[0]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+
+
+def test_spp_levels_and_values():
+    x = np.arange(2 * 3 * 4 * 4, dtype="float32").reshape(2, 3, 4, 4)
+
+    def build():
+        xi = fluid.layers.data("x", shape=[3, 4, 4])
+        helper = LayerHelper("spp")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(type="spp", inputs={"X": [xi]},
+                         outputs={"Out": [out]},
+                         attrs={"pyramid_height": 2,
+                                "pooling_type": "max"})
+        return (out,)
+
+    (out,) = _run(build, {"x": x})
+    # level0: 1 bin, level1: 4 bins -> (1+4)*C = 15 features
+    assert out.shape == (2, 15)
+    np.testing.assert_allclose(out[0, :3], x[0].max((1, 2)))
+    # level-1 first bin of channel 0 = max of top-left 2x2
+    np.testing.assert_allclose(out[0, 3], x[0, 0, :2, :2].max())
+
+
+def test_unpool_scatters_to_argmax_positions():
+    x = np.array([[[[1.0, 3.0], [7.0, 5.0]]]], "float32")
+
+    def build():
+        xi = fluid.layers.data("img", shape=[1, 4, 4])
+        helper = LayerHelper("max_pool2d_with_index")
+        pooled = helper.create_variable_for_type_inference("float32")
+        mask = helper.create_variable_for_type_inference("int32")
+        helper.append_op(type="max_pool2d_with_index",
+                         inputs={"X": [xi]},
+                         outputs={"Out": [pooled], "Mask": [mask]},
+                         attrs={"ksize": [2, 2], "strides": [2, 2]})
+        helper2 = LayerHelper("unpool")
+        out = helper2.create_variable_for_type_inference("float32")
+        helper2.append_op(type="unpool",
+                          inputs={"X": [pooled], "Indices": [mask]},
+                          outputs={"Out": [out]},
+                          attrs={"ksize": [2, 2], "strides": [2, 2]})
+        return pooled, out
+
+    rng = np.random.RandomState(1)
+    img = rng.rand(1, 1, 4, 4).astype("float32")
+    pooled, out = _run(build, {"img": img})
+    assert out.shape == (1, 1, 4, 4)
+    # unpooled contains each pooled max at its original position
+    np.testing.assert_allclose(sorted(out[out != 0]),
+                               sorted(pooled.ravel()))
+    for v in pooled.ravel():
+        pos = np.argwhere(img[0, 0] == v)
+        assert len(pos) >= 1
+        i, j = pos[0]
+        assert out[0, 0, i, j] == pytest.approx(v)
+
+
+def test_detection_map_metric():
+    from paddle_tpu.metrics import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=0.5)
+    gt = np.array([[0.0, 0.0, 1.0, 1.0], [2.0, 2.0, 3.0, 3.0]])
+    gt_labels = np.array([1, 2])
+    dets = np.array([
+        [1, 0.9, 0.0, 0.0, 1.0, 1.0],    # TP class 1
+        [1, 0.8, 5.0, 5.0, 6.0, 6.0],    # FP class 1
+        [2, 0.7, 2.0, 2.0, 3.0, 3.0],    # TP class 2
+    ])
+    m.update(dets, gt, gt_labels)
+    # class1 AP (integral): recall hits 1.0 at precision 1.0 -> 1.0;
+    # class2 AP = 1.0 -> mAP 1.0
+    assert m.eval() == pytest.approx(1.0)
+
+    m2 = DetectionMAP()
+    m2.update(dets[[1]], gt, gt_labels)   # only the FP
+    assert m2.eval() == pytest.approx(0.0)

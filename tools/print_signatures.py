@@ -1,0 +1,100 @@
+"""Public-API signature dump — the API-drift gate.
+
+Parity: reference ``tools/print_signatures.py`` + ``tools/diff_api.py``
+(CI diffs the printed signatures against a checked-in golden list so
+accidental API breaks fail the build, paddle_build.sh).
+
+Usage:
+    python tools/print_signatures.py            # print to stdout
+    python tools/print_signatures.py --update   # rewrite the golden file
+
+The golden file is ``tools/api_signatures.txt``;
+``tests/test_api_signatures.py`` enforces the match.
+"""
+
+import argparse
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "api_signatures.txt")
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.metrics",
+    "paddle_tpu.nets",
+    "paddle_tpu.io",
+    "paddle_tpu.inference",
+    "paddle_tpu.profiler",
+    "paddle_tpu.debugger",
+    "paddle_tpu.recordio",
+    "paddle_tpu.reader",
+    "paddle_tpu.reader.creator",
+    "paddle_tpu.cloud",
+    "paddle_tpu.parallel",
+    "paddle_tpu.parallel.checkpoint",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.contrib",
+    "paddle_tpu.contrib.mixed_precision",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def collect():
+    import importlib
+
+    lines = []
+    for mod_name in MODULES:
+        mod = importlib.import_module(mod_name)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                lines.append("%s.%s%s" % (mod_name, name,
+                                          _sig(obj.__init__)))
+                for m_name, m in sorted(inspect.getmembers(obj)):
+                    if m_name.startswith("_"):
+                        continue
+                    if inspect.isfunction(m):
+                        lines.append("%s.%s.%s%s" % (mod_name, name,
+                                                     m_name, _sig(m)))
+            elif callable(obj):
+                lines.append("%s.%s%s" % (mod_name, name, _sig(obj)))
+    return lines
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--update", action="store_true",
+                   help="rewrite the golden file")
+    args = p.parse_args()
+    lines = collect()
+    if args.update:
+        with open(GOLDEN, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print("wrote %d signatures to %s" % (len(lines), GOLDEN))
+    else:
+        print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()

@@ -202,6 +202,8 @@ def mine_hard_examples(cls_loss, match_indices, match_dist, loc_loss=None,
                "sample_size": int(sample_size or -1)})
     for v in (neg, neg_count, updated):
         v.stop_gradient = True
+    # the count rides as neg's length companion (padded-array convention)
+    neg._seq_len_name = neg_count.name
     return neg, updated
 
 

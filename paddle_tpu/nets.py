@@ -12,6 +12,7 @@ __all__ = [
     "img_conv_group",
     "glu",
     "scaled_dot_product_attention",
+    "sequence_conv_pool",
 ]
 
 
@@ -133,3 +134,18 @@ def scaled_dot_product_attention(
                                  is_test=False)
     ctx_multiheads = layers.matmul(weights, v)
     return __combine_heads(ctx_multiheads)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, act="sigmoid",
+                       pool_type="max", param_attr=None, bias_attr=None,
+                       length=None):
+    """sequence_conv followed by sequence_pool (reference nets.py:238).
+    ``input`` is a padded sequence batch [B, T, D] with a @LEN
+    companion; returns the pooled [B, num_filters] features."""
+    from . import layers
+
+    conv = layers.sequence_conv(input, num_filters=num_filters,
+                                filter_size=filter_size, act=act,
+                                param_attr=param_attr,
+                                bias_attr=bias_attr, length=length)
+    return layers.sequence_pool(conv, pool_type, length=length)

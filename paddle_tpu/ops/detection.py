@@ -570,9 +570,9 @@ def _mine_hard_compute(ins, attrs, ctx, op_index):
     cls_loss = ins["ClsLoss"][0]                 # [N, P]
     match = ins["MatchIndices"][0]               # [N, P]
     mdist = ins["MatchDist"][0]
-    locs = ins.get("LocLoss")
-    if locs and locs[0] is not None:
-        cls_loss = cls_loss + locs[0]
+    # NOTE: LocLoss and sample_size are hard_example-mode inputs in the
+    # reference (mine_hard_examples_op.cc); max_negative ranks by
+    # cls_loss alone and ignores both
     mining_type = attrs.get("mining_type", "max_negative")
     if mining_type != "max_negative":
         raise NotImplementedError(
@@ -581,7 +581,6 @@ def _mine_hard_compute(ins, attrs, ctx, op_index):
             "mine_hard_examples_op.cc:34, is not)")
     ratio = float(attrs.get("neg_pos_ratio", 3.0))
     thresh = float(attrs.get("neg_dist_threshold", 0.5))
-    sample_size = int(attrs.get("sample_size", -1) or -1)
 
     n, p = match.shape
     eligible = (match == -1) & (mdist < thresh)
@@ -589,8 +588,6 @@ def _mine_hard_compute(ins, attrs, ctx, op_index):
     num_neg = jnp.minimum(
         (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32),
         jnp.sum(eligible.astype(jnp.int32), axis=1))
-    if sample_size > 0:
-        num_neg = jnp.minimum(num_neg, sample_size)
 
     masked = jnp.where(eligible, cls_loss, _BIG_NEG)
     order = jnp.argsort(-masked, axis=1)         # loss-desc prior ids

@@ -26,9 +26,11 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
     for v in program.list_vars():
         if v.persistable or v.name in skip or not v.shape:
             continue
-        if any(d is None or d < 0 for d in v.shape):
+        # dynamic (batch) dims count as 1: the estimate is per-sample
+        dims = [d for d in v.shape if d is not None and d > 0]
+        if not dims:
             continue
-        total += int(np.prod(v.shape)) * 4
+        total += int(np.prod(dims)) * 4
     if print_log:
         print("memory_optimize: ~%d bytes of temporaries left to XLA "
               "buffer reuse (no program rewrite on TPU)" % total)

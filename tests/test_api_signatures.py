@@ -10,13 +10,16 @@ import sys
 
 def test_public_api_matches_golden():
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, os.path.join(root, "tools"))
+    tools_dir = os.path.join(root, "tools")
+    sys.path.insert(0, tools_dir)
     try:
         import print_signatures
         current = print_signatures.collect()
         golden = open(print_signatures.GOLDEN).read().splitlines()
     finally:
-        sys.path.pop(0)
+        # remove by value: importing print_signatures inserts the repo
+        # root at index 0, so pop(0) would evict the wrong entry
+        sys.path.remove(tools_dir)
     cur_set, gold_set = set(current), set(golden)
     removed = sorted(gold_set - cur_set)
     added = sorted(cur_set - gold_set)

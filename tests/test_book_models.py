@@ -1,0 +1,143 @@
+"""Book-style end-to-end configs (reference tests/book/test_*.py: build
+the real model, train a few iterations, assert the loss drops) for the
+configs not covered elsewhere: fit_a_line, word2vec,
+recommender_system, understand_sentiment (conv).  Data is synthetic
+(the book tests' assertion pattern, offline)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _train(loss, feeds, steps, lr=0.01, opt="sgd", seed=1):
+    fluid.default_startup_program().random_seed = seed
+    optimizer = {"sgd": fluid.optimizer.SGD,
+                 "adam": fluid.optimizer.Adam}[opt](learning_rate=lr)
+    optimizer.minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for i in range(steps):
+            (lv,) = exe.run(feed=feeds[i % len(feeds)],
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).ravel()[0]))
+    return losses
+
+
+def test_fit_a_line():
+    """Linear regression (book/test_fit_a_line.py) on uci_housing-shaped
+    synthetic data."""
+    from paddle_tpu.dataset import synthetic
+
+    samples = list(synthetic.regression(n=128, dim=13, seed=0)())
+    xs = np.stack([s[0] for s in samples]).astype("float32")
+    ys = np.stack([np.ravel(s[1]) for s in samples]).astype("float32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[13])
+        y = fluid.layers.data("y", shape=[1])
+        pred = fluid.layers.fc(x, size=1, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(pred, y)))
+        losses = _train(loss, [{"x": xs, "y": ys}], steps=60, lr=0.05)
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_word2vec_ngram():
+    """N-gram LM (book/test_word2vec.py): 4 context embeddings concat ->
+    fc -> softmax over the vocab."""
+    vocab, emb, n = 40, 16, 5
+    rng = np.random.RandomState(2)
+    # learnable pattern: next word = (sum of context) % vocab
+    ctx = rng.randint(0, vocab, (256, n - 1)).astype("int64")
+    nxt = (ctx.sum(1) % vocab).astype("int64")[:, None]
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        words = [fluid.layers.data("w%d" % i, shape=[1], dtype="int64")
+                 for i in range(n - 1)]
+        label = fluid.layers.data("nextw", shape=[1], dtype="int64")
+        embs = [fluid.layers.embedding(
+                    w, size=[vocab, emb],
+                    param_attr=fluid.ParamAttr(name="shared_emb"))
+                for w in words]
+        concat = fluid.layers.concat(embs, axis=-1)
+        concat = fluid.layers.reshape(concat, shape=[-1, emb * (n - 1)])
+        hidden = fluid.layers.fc(concat, size=64, act="relu")
+        pred = fluid.layers.fc(hidden, size=vocab, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(pred, label))
+        feed = {"nextw": nxt}
+        for i in range(n - 1):
+            feed["w%d" % i] = ctx[:, i:i + 1]
+        losses = _train(loss, [feed], steps=80, lr=5e-3, opt="adam")
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_recommender_system():
+    """Two-tower rating model (book/test_recommender_system.py): user
+    and item embeddings -> cos_sim -> scaled square loss."""
+    n_users, n_items, emb = 30, 50, 8
+    rng = np.random.RandomState(3)
+    u = rng.randint(0, n_users, (256, 1)).astype("int64")
+    it = rng.randint(0, n_items, (256, 1)).astype("int64")
+    # synthetic preference: rating from hashed pair, in [0, 5]
+    r = (((u * 13 + it * 7) % 11) / 2.0).astype("float32")
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        usr = fluid.layers.data("usr", shape=[1], dtype="int64")
+        mov = fluid.layers.data("mov", shape=[1], dtype="int64")
+        rating = fluid.layers.data("rating", shape=[1])
+        usr_emb = fluid.layers.reshape(
+            fluid.layers.embedding(usr, size=[n_users, emb]),
+            shape=[-1, emb])
+        mov_emb = fluid.layers.reshape(
+            fluid.layers.embedding(mov, size=[n_items, emb]),
+            shape=[-1, emb])
+        usr_feat = fluid.layers.fc(usr_emb, size=32, act="relu")
+        mov_feat = fluid.layers.fc(mov_emb, size=32, act="relu")
+        sim = fluid.layers.cos_sim(usr_feat, mov_feat)
+        pred = fluid.layers.scale(sim, scale=5.0)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, rating)))
+        losses = _train(loss, [{"usr": u, "mov": it, "rating": r}],
+                        steps=60, lr=1e-2, opt="adam")
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_understand_sentiment_conv():
+    """Text classification via sequence_conv+pool
+    (book/test_understand_sentiment.py convolution_net)."""
+    vocab, emb, t = 60, 16, 12
+    rng = np.random.RandomState(4)
+    ids = rng.randint(1, vocab, (128, t)).astype("int64")
+    lens = rng.randint(4, t + 1, (128,)).astype("int32")
+    # label = whether token 7 appears within the valid prefix
+    lbl = np.array([1 if 7 in row[:l] else 0
+                    for row, l in zip(ids, lens)], "int64")[:, None]
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        data = fluid.layers.data("words", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        embedded = fluid.layers.embedding(data, size=[vocab, emb])
+        embedded._seq_len_name = data._seq_len_name
+        conv = fluid.nets.sequence_conv_pool(
+            input=embedded, num_filters=32, filter_size=3,
+            act="tanh", pool_type="max")
+        pred = fluid.layers.fc(conv, size=2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        feed = {"words": ids[:, :, None], "words@LEN": lens,
+                "label": lbl}
+        losses = _train(loss, [feed], steps=60, lr=5e-3, opt="adam")
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_memory_optimize_reports_footprint():
+    """memory_optimize is deliberately a no-op rewrite on TPU (XLA owns
+    buffer reuse) but must report the recyclable temp footprint."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[64])
+        h = fluid.layers.fc(x, size=128, act="relu")
+        fluid.layers.fc(h, size=8, act="softmax")
+        n = fluid.memory_optimize(fluid.default_main_program())
+        assert n > 0
+        assert fluid.release_memory(fluid.default_main_program()) == 0

@@ -183,11 +183,9 @@ def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
     if helper.bias_attr is not None and \
             helper.kwargs.get("bias_attr") is not False:
         out = helper.append_bias_op(out, dim_start=2)
-    out = helper.append_activation(out)
-    # bias/activation rebuild the output var: re-attach the length
-    # companion so downstream sequence ops keep working without length=
-    out._seq_len_name = getattr(input, "_seq_len_name", None)
-    return out
+    # (the length companion propagates through bias/activation ops via
+    # Block._infer_and_mark)
+    return helper.append_activation(out)
 
 
 def sequence_pool(input, pool_type, length=None):

@@ -136,14 +136,13 @@ def scaled_dot_product_attention(
     return __combine_heads(ctx_multiheads)
 
 
-def sequence_conv_pool(input, num_filters, filter_size, act="sigmoid",
-                       pool_type="max", param_attr=None, bias_attr=None,
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
                        length=None):
-    """sequence_conv followed by sequence_pool (reference nets.py:238).
-    ``input`` is a padded sequence batch [B, T, D] with a @LEN
-    companion; returns the pooled [B, num_filters] features."""
-    from . import layers
-
+    """sequence_conv followed by sequence_pool (reference nets.py:238,
+    same positional parameter order).  ``input`` is a padded sequence
+    batch [B, T, D] with a @LEN companion; returns the pooled
+    [B, num_filters] features."""
     conv = layers.sequence_conv(input, num_filters=num_filters,
                                 filter_size=filter_size, act=act,
                                 param_attr=param_attr,

@@ -119,7 +119,6 @@ def test_understand_sentiment_conv():
                                  lod_level=1)
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         embedded = fluid.layers.embedding(data, size=[vocab, emb])
-        embedded._seq_len_name = data._seq_len_name
         conv = fluid.nets.sequence_conv_pool(
             input=embedded, num_filters=32, filter_size=3,
             act="tanh", pool_type="max")

@@ -55,8 +55,11 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None):
     idx = lax.axis_index(axis_name)
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    in_dtype = q.dtype
     scale = scale if scale is not None else 1.0 / (d ** 0.5)
-    q = q * scale
+    # scores and online-softmax statistics accumulate in fp32: bf16
+    # inputs (the AMP path) would drift across the n ring steps
+    q = q.astype(jnp.float32) * scale
 
     # ring: at step i we hold the K/V block originally owned by shard
     # (idx + i) mod n; send to the previous neighbor each step so the
@@ -68,7 +71,8 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None):
     def step(i, carry):
         k_blk, v_blk, m, l, o = carry
         kv_owner = (idx + i) % n
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32)
         if causal:
             k_pos = kv_owner * tk + jnp.arange(tk)
             mask = q_pos[:, None] >= k_pos[None, :]
@@ -78,7 +82,8 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None):
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+        o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk,
+                                  preferred_element_type=jnp.float32)
 
         def rotate(blks):
             return tuple(lax.ppermute(x, axis_name, perm) for x in blks)
@@ -89,24 +94,29 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None):
                                 lambda blks: blks, (k_blk, v_blk))
         return k_blk, v_blk, m_new, l, o
 
-    m0 = jnp.full((b, h, tq, 1), _NEG_INF, q.dtype)
-    l0 = jnp.zeros((b, h, tq, 1), q.dtype)
-    o0 = jnp.zeros((b, h, tq, d), q.dtype)
+    m0 = jnp.full((b, h, tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tq, 1), jnp.float32)
+    o0 = jnp.zeros((b, h, tq, d), jnp.float32)
     _, _, m, l, o = lax.fori_loop(0, n, step, (k, v, m0, l0, o0))
-    return o / jnp.maximum(l, 1e-20)
+    return (o / jnp.maximum(l, 1e-20)).astype(in_dtype)
 
 
 def ring_attention(q, k, v, mesh, axis=AXIS_SP, causal=False,
-                   scale=None):
+                   scale=None, batch_axis=None):
     """Context-parallel attention over ``mesh``'s ``axis``.
 
     q/k/v: [B, H, T, D] with T divisible by the axis size.  Returns
-    [B, H, T, D] sharded the same way (time over ``axis``)."""
+    [B, H, T, D] sharded the same way (time over ``axis``).
+    ``batch_axis`` optionally shards the batch dim over another mesh
+    axis (dp composition); without it the batch replicates across the
+    non-sp axes."""
     if axis not in mesh.axis_names:
         raise ValueError("mesh has no axis %r (axes: %s)"
                          % (axis, mesh.axis_names))
-    spec = P(None, None, axis, None)
-    # every other mesh axis sees the arrays replicated
+    if batch_axis is not None and batch_axis not in mesh.axis_names:
+        raise ValueError("mesh has no axis %r (axes: %s)"
+                         % (batch_axis, mesh.axis_names))
+    spec = P(batch_axis, None, axis, None)
     body = functools.partial(ring_attention_shard, axis_name=axis,
                              causal=causal, scale=scale)
     # jax >= 0.8 spells the replication check check_vma; older check_rep

@@ -74,9 +74,27 @@ def test_ring_attention_with_dp_axis():
     v = rng.randn(b, h, t, d).astype("float32")
     mesh = make_mesh((2, 4), ("dp", "sp"))
     out = ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
-                         mesh, axis="sp")
+                         mesh, axis="sp", batch_axis="dp")
+    assert len(out.sharding.device_set) == 8
     np.testing.assert_allclose(np.asarray(out),
                                _full_attention(q, k, v), atol=2e-5)
+
+
+def test_ring_attention_bf16_accumulates_in_fp32():
+    rng = np.random.RandomState(5)
+    b, h, t, d = 1, 2, 32, 8
+    q = rng.randn(b, h, t, d).astype("float32")
+    k = rng.randn(b, h, t, d).astype("float32")
+    v = rng.randn(b, h, t, d).astype("float32")
+    mesh = make_mesh((8,), ("sp",))
+    out = ring_attention(jnp.asarray(q, jnp.bfloat16),
+                         jnp.asarray(k, jnp.bfloat16),
+                         jnp.asarray(v, jnp.bfloat16), mesh)
+    assert out.dtype == jnp.bfloat16
+    want = _full_attention(q, k, v)
+    # bf16 inputs, fp32 accumulation: error bounded by input precision
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), want, atol=0.05)
 
 
 def test_ring_attention_rejects_unknown_axis():

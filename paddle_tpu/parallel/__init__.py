@@ -14,3 +14,4 @@ from .parallel_executor import ParallelExecutor  # noqa: F401
 from .embedding import distributed_embedding_sharding_fn  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .ring_attention import ring_attention, ring_attention_shard  # noqa: F401,E501
+from .pipeline import pipeline  # noqa: F401

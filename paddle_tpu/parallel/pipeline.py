@@ -1,0 +1,105 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution over
+the ``pp`` mesh axis.
+
+The reference has NO pipeline parallelism (SURVEY.md §2.4: absent);
+this module is the TPU-native capability extension that makes the
+``pp`` axis real: layers are grouped into S stages whose parameters are
+stacked on a leading stage dim and sharded over ``pp`` (each device
+holds one stage), the batch splits into M microbatches, and activations
+flow stage-to-stage with ``ppermute`` — the classic GPipe schedule run
+as a single ``lax.fori_loop`` of M + S - 1 ticks where every device
+computes every tick (bubble fraction (S-1)/(M+S-1)).
+
+Surface:
+
+* ``pipeline(stage_fn, stage_params, x, mesh, axis='pp',
+  microbatches=M)`` — ``stage_fn(params, x) -> y`` is ONE stage's
+  computation (inter-stage activations must share x's shape);
+  ``stage_params`` is a pytree whose leaves have leading dim S.
+  Returns the pipelined equivalent of folding all S stages over x.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_PP, shard_map_norep
+
+__all__ = ["pipeline"]
+
+
+def _pipeline_shard(params, x, axis_name, stage_fn, microbatches):
+    """Per-device body: params [1, ...] (this stage's slice), x [B, ...]
+    (full batch, replicated).  Returns [B, ...] final-stage outputs,
+    valid on every device (broadcast from the last stage)."""
+    s = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+
+    m = microbatches
+    b = x.shape[0]
+    mb = b // m
+    # carries run in the stage output dtype (may differ from x, e.g.
+    # fp32 params over bf16 activations promote)
+    out_dtype = jax.eval_shape(
+        stage_fn, my_params,
+        jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype)).dtype
+    x_mb = x.reshape((m, mb) + x.shape[1:]).astype(out_dtype)
+
+    # send each stage's output to the next stage (ring without wrap: the
+    # last stage's output would wrap to stage 0, which ignores it)
+    perm = [(j, (j + 1) % s) for j in range(s)]
+
+    def tick(t, carry):
+        cur_in, outs = carry
+        # stage 0 ingests microbatch t (zeros past the schedule tail)
+        mb_idx = jnp.clip(t, 0, m - 1)
+        fresh = x_mb[mb_idx]
+        cur_in = jnp.where(stage == 0, fresh, cur_in)
+        out = stage_fn(my_params, cur_in)
+        # the last stage completes microbatch t-(s-1) at tick t
+        done_idx = t - (s - 1)
+        take = (stage == s - 1) & (done_idx >= 0) & (done_idx < m)
+        updated = lax.dynamic_update_index_in_dim(
+            outs, out, jnp.clip(done_idx, 0, m - 1), 0)
+        outs = jnp.where(take, updated, outs)
+        nxt = lax.ppermute(out, axis_name, perm)
+        return nxt, outs
+
+    outs0 = jnp.zeros((m, mb) + x.shape[1:], out_dtype)
+    cur0 = jnp.zeros((mb,) + x.shape[1:], out_dtype)
+    _, outs = lax.fori_loop(0, m + s - 1, tick, (cur0, outs0))
+    # broadcast the last stage's collected outputs to every device
+    mask = (stage == s - 1).astype(outs.dtype)
+    outs = lax.psum(outs * mask, axis_name)
+    return outs.reshape((b,) + x.shape[1:])
+
+
+def pipeline(stage_fn, stage_params, x, mesh, axis=AXIS_PP,
+             microbatches=None):
+    """Run ``stage_fn`` as an S-stage GPipe pipeline over ``mesh``'s
+    ``axis``.  ``stage_params`` leaves carry a leading stage dim equal
+    to the axis size; returns stage_{S-1}(... stage_0(x))."""
+    if axis not in mesh.axis_names:
+        raise ValueError("mesh has no axis %r (axes: %s)"
+                         % (axis, mesh.axis_names))
+    s = mesh.devices.shape[mesh.axis_names.index(axis)]
+    microbatches = microbatches or s
+    if x.shape[0] % microbatches != 0:
+        raise ValueError(
+            "microbatches (%d) must divide the batch (%d)"
+            % (microbatches, x.shape[0]))
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis), stage_params)
+    # replicate x; stage params shard their leading stage dim over pp
+    fn = shard_map_norep(
+        functools.partial(_pipeline_shard, axis_name=axis,
+                          stage_fn=stage_fn, microbatches=microbatches),
+        mesh, in_specs=(param_specs, P()), out_specs=P())
+    stage_params = jax.tree_util.tree_map(
+        lambda p, sp: jax.device_put(p, NamedSharding(mesh, sp)),
+        stage_params, param_specs)
+    return fn(stage_params, x)

@@ -29,15 +29,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-try:
-    from jax import shard_map           # jax >= 0.8
-    _NEW_SHARD_MAP = True
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-    _NEW_SHARD_MAP = False
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .mesh import AXIS_SP
+from .mesh import AXIS_SP, shard_map_norep
 
 __all__ = ["ring_attention", "ring_attention_shard"]
 
@@ -113,16 +107,18 @@ def ring_attention(q, k, v, mesh, axis=AXIS_SP, causal=False,
     if axis not in mesh.axis_names:
         raise ValueError("mesh has no axis %r (axes: %s)"
                          % (axis, mesh.axis_names))
-    if batch_axis is not None and batch_axis not in mesh.axis_names:
-        raise ValueError("mesh has no axis %r (axes: %s)"
-                         % (batch_axis, mesh.axis_names))
+    if batch_axis is not None:
+        if batch_axis not in mesh.axis_names:
+            raise ValueError("mesh has no axis %r (axes: %s)"
+                             % (batch_axis, mesh.axis_names))
+        if batch_axis == axis:
+            raise ValueError(
+                "batch_axis must differ from the sequence axis %r" % axis)
     spec = P(batch_axis, None, axis, None)
     body = functools.partial(ring_attention_shard, axis_name=axis,
                              causal=causal, scale=scale)
-    # jax >= 0.8 spells the replication check check_vma; older check_rep
-    kw = {"check_vma": False} if _NEW_SHARD_MAP else {"check_rep": False}
-    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, **kw)
+    fn = shard_map_norep(body, mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)
     sharding = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
     return fn(q, k, v)

@@ -102,3 +102,8 @@ def test_ring_attention_rejects_unknown_axis():
     with pytest.raises(ValueError, match="no axis"):
         ring_attention(jnp.zeros((1, 1, 8, 4)), jnp.zeros((1, 1, 8, 4)),
                        jnp.zeros((1, 1, 8, 4)), mesh, axis="sp")
+    sp = make_mesh((8,), ("sp",))
+    with pytest.raises(ValueError, match="must differ"):
+        ring_attention(jnp.zeros((1, 1, 8, 4)), jnp.zeros((1, 1, 8, 4)),
+                       jnp.zeros((1, 1, 8, 4)), sp, axis="sp",
+                       batch_axis="sp")

@@ -243,16 +243,24 @@ def main():
                    "--skip_batch_num", str(args.skip_batch_num)] + extra
             if args.batch_size:
                 cmd += ["--batch_size", str(args.batch_size)]
-            try:
-                out = subprocess.run(
-                    cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                    text=True, timeout=1800, check=True).stdout
-                results.append(json.loads(out.strip().splitlines()[-1]))
-            except Exception as e:  # noqa: BLE001 — partial ladder beats none
-                detail = str(e)
-                stderr = getattr(e, "stderr", None)
-                if stderr:
-                    detail += " | stderr: " + stderr[-400:]
+            detail = None
+            for attempt in range(2):   # one retry: tunnel errors are
+                try:                   # transient (remote_compile drops)
+                    out = subprocess.run(
+                        cmd, stdout=subprocess.PIPE,
+                        stderr=subprocess.PIPE, text=True, timeout=1800,
+                        check=True).stdout
+                    results.append(
+                        json.loads(out.strip().splitlines()[-1]))
+                    detail = None
+                    break
+                except Exception as e:  # noqa: BLE001 — keep the ladder
+                    detail = str(e)
+                    stderr = getattr(e, "stderr", None)
+                    if stderr:
+                        detail += " | stderr: " + stderr[-400:]
+                    time.sleep(20)
+            if detail is not None:
                 results.append({"metric": "%s%s_error" % (model,
                                 "".join(extra).replace("--", "_")),
                                 "value": 0.0, "unit": "error",

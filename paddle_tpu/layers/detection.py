@@ -18,6 +18,8 @@ __all__ = [
     "polygon_box_transform",
     "mine_hard_examples",
     "ssd_loss",
+    "generate_proposals",
+    "rpn_target_assign",
 ]
 
 
@@ -271,3 +273,62 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
         num_matched = L.reduce_sum(loc_wt) + 1e-6
         loss = L.elementwise_div(loss, num_matched)
     return loss
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposal generation (reference generate_proposals_op.cc):
+    decode top-scoring anchor deltas, clip to the image, drop tiny
+    boxes, NMS.  Returns (rois [B, post_n, 4], probs [B, post_n, 1])
+    with the per-image count as rois' length companion.  ``eta`` is
+    accepted for API parity; only eta=1.0 (fixed-threshold NMS) is
+    implemented and other values raise at run time."""
+    helper = LayerHelper("generate_proposals", input=scores, name=name)
+    rois = helper.create_variable_for_type_inference("float32")
+    probs = helper.create_variable_for_type_inference("float32")
+    count = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores], "BboxDeltas": [bbox_deltas],
+                "ImInfo": [im_info], "Anchors": [anchors],
+                "Variances": [variances]},
+        outputs={"RpnRois": [rois], "RpnRoiProbs": [probs],
+                 "RpnRoisLength": [count]},
+        attrs={"pre_nms_topN": int(pre_nms_top_n),
+               "post_nms_topN": int(post_nms_top_n),
+               "nms_thresh": float(nms_thresh),
+               "min_size": float(min_size), "eta": float(eta)})
+    for v in (rois, probs, count):
+        v.stop_gradient = True
+    rois._seq_len_name = count.name
+    return rois, probs
+
+
+def rpn_target_assign(anchor, gt_boxes, rpn_batch_size_per_im=256,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, gt_length=None,
+                      name=None):
+    """RPN training targets (reference rpn_target_assign_op.cc),
+    static-shape form: per-anchor labels [B, A] (1 fg / 0 bg / -1
+    ignore), encoded regression targets [B, A, 4], and fg weights
+    [B, A, 1] — mask-based instead of the reference's index lists
+    (deterministic first-k subsampling replaces reservoir sampling)."""
+    helper = LayerHelper("rpn_target_assign", input=anchor, name=name)
+    labels = helper.create_variable_for_type_inference("int32")
+    tgt = helper.create_variable_for_type_inference("float32")
+    weight = helper.create_variable_for_type_inference("float32")
+    inputs = {"Anchor": [anchor], "GtBoxes": [gt_boxes]}
+    if gt_length is not None:
+        inputs["GtLength"] = [gt_length]
+    helper.append_op(
+        type="rpn_target_assign", inputs=inputs,
+        outputs={"ScoreLabels": [labels], "TargetBBox": [tgt],
+                 "BBoxWeight": [weight]},
+        attrs={"rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+               "rpn_fg_fraction": float(rpn_fg_fraction),
+               "rpn_positive_overlap": float(rpn_positive_overlap),
+               "rpn_negative_overlap": float(rpn_negative_overlap)})
+    for v in (labels, tgt, weight):
+        v.stop_gradient = True
+    return labels, tgt, weight

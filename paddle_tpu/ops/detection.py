@@ -610,3 +610,198 @@ register_op("mine_hard_examples",
             ["NegIndices", "NegCount", "UpdatedMatchIndices"],
             infer=_mine_hard_infer, compute=_mine_hard_compute,
             grad=None)
+
+
+# -- generate_proposals -----------------------------------------------------
+
+def _gen_proposals_infer(op, block):
+    s = in_var(op, block, "Scores")
+    post = int(op.attrs.get("post_nms_topN", 1000))
+    b = s.shape[0]
+    set_output(op, block, "RpnRois", (b, post, 4), "float32",
+               lod_level=1)
+    set_output(op, block, "RpnRoiProbs", (b, post, 1), "float32")
+    set_output(op, block, "RpnRoisLength", (b,), "int32")
+
+
+def _gen_proposals_single(scores, deltas, im_info, anchors, variances,
+                          attrs):
+    """One image (generate_proposals_op.cc ProposalForOneImage):
+    top-preN scores -> decode deltas on anchors -> clip to image ->
+    drop tiny boxes -> NMS -> top-postN."""
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.5))
+    min_size = float(attrs.get("min_size", 0.1))
+
+    a = scores.shape[0]
+    k = min(pre_n, a)
+    top_scores, top_idx = lax.top_k(scores, k)
+    anc = anchors[top_idx]
+    var = variances[top_idx]
+    d = deltas[top_idx] * var
+    # decode (anchor coords are corner-inclusive like anchor_generator)
+    aw = anc[:, 2] - anc[:, 0] + 1.0
+    ah = anc[:, 3] - anc[:, 1] + 1.0
+    acx = anc[:, 0] + aw / 2
+    acy = anc[:, 1] + ah / 2
+    cx = d[:, 0] * aw + acx
+    cy = d[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah
+    boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                       cx + w / 2 - 1.0, cy + h / 2 - 1.0], axis=-1)
+    img_h, img_w = im_info[0], im_info[1]
+    boxes = jnp.stack([
+        jnp.clip(boxes[:, 0], 0.0, img_w - 1.0),
+        jnp.clip(boxes[:, 1], 0.0, img_h - 1.0),
+        jnp.clip(boxes[:, 2], 0.0, img_w - 1.0),
+        jnp.clip(boxes[:, 3], 0.0, img_h - 1.0)], axis=-1)
+    scale = im_info[2]
+    keep_size = ((boxes[:, 2] - boxes[:, 0] + 1.0 >= min_size * scale) &
+                 (boxes[:, 3] - boxes[:, 1] + 1.0 >= min_size * scale))
+    eff_scores = jnp.where(keep_size, top_scores, _BIG_NEG)
+    keep = _nms_class(boxes, eff_scores, _BIG_NEG / 2, nms_thresh,
+                      k, normalized=False)
+    final_scores = jnp.where(keep, eff_scores, _BIG_NEG)
+    n_out = min(post_n, k)
+    sel_scores, sel = lax.top_k(final_scores, n_out)
+    rois = boxes[sel]
+    valid = sel_scores > _BIG_NEG / 2
+    rois = jnp.where(valid[:, None], rois, 0.0)
+    probs = jnp.where(valid, sel_scores, 0.0)[:, None]
+    if n_out < post_n:
+        pad = post_n - n_out
+        rois = jnp.pad(rois, ((0, pad), (0, 0)))
+        probs = jnp.pad(probs, ((0, pad), (0, 0)))
+        valid = jnp.pad(valid, (0, pad))
+    return rois, probs, jnp.sum(valid.astype(jnp.int32))
+
+
+def _gen_proposals_compute(ins, attrs, ctx, op_index):
+    """Accepted layouts: scores [B, A_total] / deltas [B, A_total, 4]
+    already in the anchors' flattening order ((H, W, A)-major, matching
+    anchor_generator's [H, W, A, 4] output), or the reference conv-head
+    NCHW form scores [B, A, H, W] / deltas [B, 4A, H, W] (transposed to
+    (H, W, A)-major here, generate_proposals_op.cc Transpose)."""
+    if float(attrs.get("eta", 1.0)) != 1.0:
+        raise NotImplementedError(
+            "generate_proposals: adaptive NMS (eta != 1) is not "
+            "implemented; use eta=1.0")
+    scores = ins["Scores"][0]
+    deltas = ins["BboxDeltas"][0]
+    im_info = ins["ImInfo"][0]        # [B, 3]
+    anchors = ins["Anchors"][0].reshape(-1, 4)
+    variances = ins["Variances"][0].reshape(-1, 4)
+    if scores.ndim == 4:              # [B, A, H, W] -> [B, H*W*A]
+        scores = scores.transpose(0, 2, 3, 1).reshape(scores.shape[0], -1)
+    elif scores.ndim != 2:
+        raise ValueError(
+            "generate_proposals: scores must be [B, A_total] "
+            "(anchor-flattening order) or NCHW [B, A, H, W]; got ndim=%d"
+            % scores.ndim)
+    if deltas.ndim == 4:              # [B, 4A, H, W] -> [B, H*W*A, 4]
+        b_, c4, hh, ww = deltas.shape
+        deltas = deltas.reshape(b_, c4 // 4, 4, hh, ww)             .transpose(0, 3, 4, 1, 2).reshape(b_, -1, 4)
+    elif deltas.ndim != 3:
+        raise ValueError(
+            "generate_proposals: bbox_deltas must be [B, A_total, 4] or "
+            "NCHW [B, 4A, H, W]; got ndim=%d" % deltas.ndim)
+    rois, probs, count = jax.vmap(
+        lambda s, d, i: _gen_proposals_single(s, d, i, anchors,
+                                              variances, attrs))(
+        scores, deltas, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs,
+            "RpnRoisLength": count}
+
+
+register_op("generate_proposals",
+            ["Scores", "BboxDeltas", "ImInfo", "Anchors", "Variances"],
+            ["RpnRois", "RpnRoiProbs", "RpnRoisLength"],
+            infer=_gen_proposals_infer, compute=_gen_proposals_compute,
+            grad=None)
+
+
+# -- rpn_target_assign ------------------------------------------------------
+
+def _rpn_assign_infer(op, block):
+    a = in_var(op, block, "Anchor")
+    g = in_var(op, block, "GtBoxes")
+    b = g.shape[0] if len(g.shape) == 3 else 1
+    # anchors may arrive as anchor_generator's [H, W, A, 4]: the count
+    # is the product of every dim but the last
+    dims = [d for d in a.shape[:-1]]
+    n = None if any(d in (None, -1) for d in dims) else int(np.prod(dims))
+    set_output(op, block, "ScoreLabels", (b, n), "int32")
+    set_output(op, block, "TargetBBox", (b, n, 4), "float32")
+    set_output(op, block, "BBoxWeight", (b, n, 1), "float32")
+
+
+def _rpn_assign_single(anchors, gt, gt_len, attrs):
+    """One image (rpn_target_assign_op.cc ScoreAssign):
+    fg = best anchor per gt + anchors with max-overlap >= pos_thresh;
+    bg = max-overlap < neg_thresh; fg capped at
+    fg_fraction*batch_size_per_im, bg at the remainder (deterministic
+    first-k in place of reservoir sampling — static shapes)."""
+    batch_per_im = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_th = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_th = float(attrs.get("rpn_negative_overlap", 0.3))
+
+    a = anchors.shape[0]
+    g = gt.shape[0]
+    gt_valid = jnp.arange(g) < gt_len
+    iou = _iou_matrix(anchors, gt, normalized=False)        # [A, G]
+    iou = jnp.where(gt_valid[None, :], iou, 0.0)
+    max_per_anchor = jnp.max(iou, axis=1)
+    argmax_gt = jnp.argmax(iou, axis=1)
+    # anchors that are the best for some gt are fg regardless of thresh
+    best_per_gt = jnp.max(iou, axis=0)                      # [G]
+    is_best = jnp.any((iou == best_per_gt[None, :]) & (iou > 0) &
+                      gt_valid[None, :], axis=1)
+    fg = is_best | (max_per_anchor >= pos_th)
+    bg = (~fg) & (max_per_anchor < neg_th)
+
+    fg_cap = int(fg_frac * batch_per_im)
+    fg_rank = jnp.cumsum(fg.astype(jnp.int32)) - 1
+    fg = fg & (fg_rank < fg_cap)
+    n_fg = jnp.sum(fg.astype(jnp.int32))
+    bg_rank = jnp.cumsum(bg.astype(jnp.int32)) - 1
+    bg = bg & (bg_rank < batch_per_im - n_fg)
+
+    labels = jnp.where(fg, 1, jnp.where(bg, 0, -1)).astype(jnp.int32)
+
+    # encoded regression targets for fg anchors (no variances in RPN)
+    matched = gt[argmax_gt]
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    gw = matched[:, 2] - matched[:, 0] + 1.0
+    gh = matched[:, 3] - matched[:, 1] + 1.0
+    gcx = matched[:, 0] + gw / 2
+    gcy = matched[:, 1] + gh / 2
+    tgt = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                     jnp.log(gw / aw), jnp.log(gh / ah)], axis=-1)
+    tgt = jnp.where(fg[:, None], tgt, 0.0)
+    weight = fg.astype(jnp.float32)[:, None]
+    return labels, tgt, weight
+
+
+def _rpn_assign_compute(ins, attrs, ctx, op_index):
+    anchors = ins["Anchor"][0].reshape(-1, 4)
+    gt = ins["GtBoxes"][0]            # [B, G, 4] padded
+    lens = ins.get("GtLength")
+    if lens and lens[0] is not None:
+        gt_len = lens[0]
+    else:
+        gt_len = jnp.full((gt.shape[0],), gt.shape[1], jnp.int32)
+    labels, tgt, w = jax.vmap(
+        lambda g, l: _rpn_assign_single(anchors, g, l, attrs))(gt, gt_len)
+    return {"ScoreLabels": labels, "TargetBBox": tgt, "BBoxWeight": w}
+
+
+register_op("rpn_target_assign", ["Anchor", "GtBoxes", "GtLength"],
+            ["ScoreLabels", "TargetBBox", "BBoxWeight"],
+            infer=_rpn_assign_infer, compute=_rpn_assign_compute,
+            grad=None)

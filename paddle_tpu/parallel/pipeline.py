@@ -87,6 +87,12 @@ def pipeline(stage_fn, stage_params, x, mesh, axis=AXIS_PP,
         raise ValueError("mesh has no axis %r (axes: %s)"
                          % (axis, mesh.axis_names))
     s = mesh.devices.shape[mesh.axis_names.index(axis)]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != s:
+            raise ValueError(
+                "stage_params leading dim %d must equal the %r axis "
+                "size %d (one stage per device)"
+                % (leaf.shape[0], axis, s))
     microbatches = microbatches or s
     if x.shape[0] % microbatches != 0:
         raise ValueError(

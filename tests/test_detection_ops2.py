@@ -162,3 +162,72 @@ def test_detection_map_metric():
     m2 = DetectionMAP()
     m2.update(dets[[1]], gt, gt_labels)   # only the FP
     assert m2.eval() == pytest.approx(0.0)
+
+
+def test_generate_proposals_decodes_clips_and_nms():
+    # 4 anchors on a 20x20 image; deltas zero -> proposals = anchors
+    anchors = np.array([[0, 0, 7, 7], [1, 1, 8, 8],
+                        [12, 12, 19, 19], [30, 30, 37, 37]], "float32")
+    variances = np.ones((4, 4), "float32")
+    scores = np.array([[0.9, 0.8, 0.7, 0.6]], "float32")
+    deltas = np.zeros((1, 4, 4), "float32")
+    im_info = np.array([[20.0, 20.0, 1.0]], "float32")
+
+    def build():
+        s = fluid.layers.data("s", shape=[4], append_batch_size=False)
+        s.shape = (-1, 4)
+        d = fluid.layers.data("d", shape=[4, 4], append_batch_size=False)
+        d.shape = (-1, 4, 4)
+        ii = fluid.layers.data("ii", shape=[3], append_batch_size=False)
+        ii.shape = (-1, 3)
+        a = fluid.layers.data("a", shape=[4, 4], append_batch_size=False)
+        a.shape = (4, 4)
+        va = fluid.layers.data("va", shape=[4, 4],
+                               append_batch_size=False)
+        va.shape = (4, 4)
+        rois, probs = fluid.layers.generate_proposals(
+            s, d, ii, a, va, post_nms_top_n=4, nms_thresh=0.5,
+            min_size=1.0)
+        ln = fluid.layers.sequence_length(rois)
+        return rois, probs, ln
+
+    rois, probs, ln = _run(build, {"s": scores, "d": deltas,
+                                   "ii": im_info, "a": anchors,
+                                   "va": variances})
+    # anchor1 suppressed by anchor0 (IoU ~0.53 > 0.5); anchor3 clipped
+    # to the image boundary then kept (degenerate corner box)
+    n = int(ln[0])
+    kept = rois[0, :n]
+    assert probs[0, 0, 0] == pytest.approx(0.9)
+    np.testing.assert_allclose(kept[0], anchors[0])
+    assert not any(np.allclose(kept[i], anchors[1]) for i in range(n))
+    assert (kept[:, 2] <= 19.0).all() and (kept[:, 3] <= 19.0).all()
+
+
+def test_rpn_target_assign_labels_and_targets():
+    anchors = np.array([[0, 0, 9, 9], [10, 10, 19, 19],
+                        [0, 0, 4, 4], [50, 50, 59, 59]], "float32")
+    gt = np.array([[[0, 0, 9, 9], [0, 0, 0, 0]]], "float32")
+    gt_len = np.array([1], "int32")
+
+    def build():
+        a = fluid.layers.data("a", shape=[4, 4], append_batch_size=False)
+        a.shape = (4, 4)
+        g = fluid.layers.data("g", shape=[2, 4], append_batch_size=False)
+        g.shape = (-1, 2, 4)
+        gl = fluid.layers.data("gl", shape=[], dtype="int32",
+                               append_batch_size=False)
+        gl.shape = (-1,)
+        labels, tgt, w = fluid.layers.rpn_target_assign(
+            a, g, rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+            gt_length=gl)
+        return labels, tgt, w
+
+    labels, tgt, w = _run(build, {"a": anchors, "g": gt, "gl": gt_len})
+    assert labels[0, 0] == 1          # perfect-overlap anchor -> fg
+    assert labels[0, 1] == 0          # zero overlap -> bg
+    assert labels[0, 3] == 0          # far anchor -> bg
+    # fg anchor's regression target is zero (anchor == gt)
+    np.testing.assert_allclose(tgt[0, 0], np.zeros(4), atol=1e-6)
+    np.testing.assert_allclose(w[0, :, 0], (labels[0] == 1).astype(
+        np.float32))

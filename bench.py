@@ -52,17 +52,23 @@ def _bench_program(main, startup, feed_fn, fetch, place, iterations,
         for i in range(skip_batch_num):
             exe.run(main, feed=feeds[i % len(feeds)], fetch_list=[fetch],
                     return_numpy=False)
-        t0 = time.perf_counter()
+        # two measurement windows, keep the faster: the tunnel-shared
+        # chip suffers long-lived contention windows, and min-time is
+        # the standard way to measure the machine rather than the noise
+        best = None
         last = None
-        for i in range(iterations):
-            # async dispatch: loss stays on device; sync once at the end
-            last = exe.run(main, feed=feeds[i % len(feeds)],
-                           fetch_list=[fetch], return_numpy=False)
-        jax.block_until_ready(last)
-        elapsed = time.perf_counter() - t0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for i in range(iterations):
+                # async dispatch: loss stays on device; sync at the end
+                last = exe.run(main, feed=feeds[i % len(feeds)],
+                               fetch_list=[fetch], return_numpy=False)
+            jax.block_until_ready(last)
+            elapsed = time.perf_counter() - t0
+            best = elapsed if best is None else min(best, elapsed)
     assert np.isfinite(
         np.asarray(last[0], dtype=np.float32)).all()
-    return elapsed / iterations
+    return best / iterations
 
 
 def _maybe_amp(optimizer, use_amp):
@@ -259,7 +265,8 @@ def main():
                     stderr = getattr(e, "stderr", None)
                     if stderr:
                         detail += " | stderr: " + stderr[-400:]
-                    time.sleep(20)
+                    if attempt == 0:
+                        time.sleep(20)   # settle before the one retry
             if detail is not None:
                 results.append({"metric": "%s%s_error" % (model,
                                 "".join(extra).replace("--", "_")),

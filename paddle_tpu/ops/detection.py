@@ -790,7 +790,9 @@ def _rpn_assign_single(anchors, gt, gt_len, attrs):
 
 def _rpn_assign_compute(ins, attrs, ctx, op_index):
     anchors = ins["Anchor"][0].reshape(-1, 4)
-    gt = ins["GtBoxes"][0]            # [B, G, 4] padded
+    gt = ins["GtBoxes"][0]            # [B, G, 4] padded (or [G, 4])
+    if gt.ndim == 2:
+        gt = gt[None]                 # the unbatched form infer allows
     lens = ins.get("GtLength")
     if lens and lens[0] is not None:
         gt_len = lens[0]

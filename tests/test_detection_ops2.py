@@ -231,3 +231,19 @@ def test_rpn_target_assign_labels_and_targets():
     np.testing.assert_allclose(tgt[0, 0], np.zeros(4), atol=1e-6)
     np.testing.assert_allclose(w[0, :, 0], (labels[0] == 1).astype(
         np.float32))
+
+
+def test_rpn_target_assign_unbatched_gt():
+    anchors = np.array([[0, 0, 9, 9], [30, 30, 39, 39]], "float32")
+    gt2d = np.array([[0, 0, 9, 9]], "float32")   # [G, 4], no batch dim
+
+    def build():
+        a = fluid.layers.data("a", shape=[2, 4], append_batch_size=False)
+        a.shape = (2, 4)
+        g = fluid.layers.data("g", shape=[1, 4], append_batch_size=False)
+        g.shape = (1, 4)
+        return fluid.layers.rpn_target_assign(a, g)
+
+    labels, tgt, w = _run(build, {"a": anchors, "g": gt2d})
+    assert labels.shape == (1, 2)
+    assert labels[0, 0] == 1 and labels[0, 1] == 0

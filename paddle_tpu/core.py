@@ -55,6 +55,39 @@ def convert_dtype(dtype):
     return np.dtype(dtype)
 
 
+def long_dtype():
+    """The canonical wide-integer dtype for in-graph index/count outputs.
+
+    The reference emits int64 everywhere (framework.proto VarType INT64);
+    under JAX with x64 disabled an explicit int64 request silently truncates
+    to int32 and raises a UserWarning per call.  Policy: declared program
+    dtype stays ``int64`` for API parity, but compute paths materialize
+    ``int64`` only when x64 is enabled and ``int32`` otherwise — explicit,
+    warning-free, and exact for every in-range value (ids/counts < 2^31).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
+def materialize_dtype(dtype):
+    """Dtype to materialize arrays with under the current x64 mode.
+
+    64-bit requests (declared program dtypes keep int64/float64 for API
+    parity with the reference) degrade explicitly to their 32-bit siblings
+    when x64 is disabled, instead of relying on JAX's warn-and-truncate."""
+    import jax
+
+    d = convert_dtype(dtype)
+    if not jax.config.jax_enable_x64:
+        degrade = {np.dtype(np.int64): np.dtype(np.int32),
+                   np.dtype(np.uint64): np.dtype(np.uint32),
+                   np.dtype(np.float64): np.dtype(np.float32)}
+        return degrade.get(d, d)
+    return d
+
+
 def dtype_is_floating(dtype):
     d = convert_dtype(dtype)
     if bfloat16 is not None and d == bfloat16:

@@ -18,6 +18,7 @@ __all__ = [
     "conv2d",
     "conv3d",
     "conv2d_transpose",
+    "conv3d_transpose",
     "pool2d",
     "pool3d",
     "batch_norm",
@@ -112,40 +113,39 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                     use_cudnn, act, name)
 
 
-def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
-                     padding=0, stride=1, dilation=1, groups=None,
-                     param_attr=None, bias_attr=None, use_cudnn=True,
-                     act=None, name=None):
-    helper = LayerHelper("conv2d_transpose", input=input,
+def _conv_transpose_nd(nd, op_type, input, num_filters, output_size,
+                       filter_size, padding, stride, dilation, groups,
+                       param_attr, bias_attr, use_cudnn, act, name):
+    helper = LayerHelper(op_type, input=input,
                          param_attr=param_attr, bias_attr=bias_attr, act=act,
                          name=name)
     dtype = helper.input_dtype()
     num_channels = input.shape[1]
     groups = groups or 1
-    stride = _pair(stride, 2)
-    padding = _pair(padding, 2)
-    dilation = _pair(dilation, 2)
+    stride = _pair(stride, nd)
+    padding = _pair(padding, nd)
+    dilation = _pair(dilation, nd)
 
     if filter_size is None:
         if output_size is None:
             raise ValueError("output_size or filter_size must be set")
-        output_size = _pair(output_size, 2)
+        output_size = _pair(output_size, nd)
         filter_size = []
-        for i in range(2):
+        for i in range(nd):
             in_s = input.shape[2 + i]
             filter_size.append(
                 (output_size[i] - (in_s - 1) * stride[i] + 2 * padding[i]
                  - 1) // dilation[i] + 1
             )
     else:
-        filter_size = _pair(filter_size, 2)
+        filter_size = _pair(filter_size, nd)
 
     filter_shape = [num_channels, num_filters // groups] + filter_size
     w = helper.create_parameter(attr=helper.param_attr, shape=filter_shape,
                                 dtype=dtype)
     pre_bias = helper.create_variable_for_type_inference(dtype)
     helper.append_op(
-        type="conv2d_transpose",
+        type=op_type,
         inputs={"Input": [input], "Filter": [w]},
         outputs={"Output": [pre_bias]},
         attrs={
@@ -162,6 +162,28 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     else:
         pre_act = pre_bias
     return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    return _conv_transpose_nd(2, "conv2d_transpose", input, num_filters,
+                              output_size, filter_size, padding, stride,
+                              dilation, groups, param_attr, bias_attr,
+                              use_cudnn, act, name)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """Transposed 3-D conv (reference nn.py:conv3d_transpose /
+    conv_transpose_op.cc:303)."""
+    return _conv_transpose_nd(3, "conv3d_transpose", input, num_filters,
+                              output_size, filter_size, padding, stride,
+                              dilation, groups, param_attr, bias_attr,
+                              use_cudnn, act, name)
 
 
 def _pool_nd(nd, input, pool_size, pool_type, pool_stride, pool_padding,

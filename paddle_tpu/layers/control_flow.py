@@ -26,6 +26,7 @@ __all__ = [
     "StaticRNN", "DynamicRNN", "While", "IfElse", "Switch",
     "ConditionalBlock", "array_write", "array_read", "array_length",
     "create_array", "beam_search", "beam_search_decode",
+    "Print", "is_empty",
 ]
 
 
@@ -638,3 +639,41 @@ def beam_search_decode(ids, parents, scores, beam_size, end_id, name=None):
         outputs={"SentenceIds": [sent], "SentenceScores": [sc]},
         attrs={"beam_size": beam_size, "end_id": end_id})
     return sent, sc
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """In-graph tensor printing (reference control_flow.py:146 Print /
+    print_op.cc), lowered to ``jax.debug.print`` — fires every execution
+    (``first_n``/``summarize`` are accepted for API parity; XLA has no
+    cross-step counter for first_n without threading state)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={
+            "first_n": first_n,
+            "message": message or "",
+            "summarize": summarize,
+            "print_tensor_name": print_tensor_name,
+            "print_tensor_type": print_tensor_type,
+            "print_tensor_shape": print_tensor_shape,
+            "print_tensor_lod": print_tensor_lod,
+            "print_phase": print_phase.upper(),
+            "__var_name__": input.name,
+        })
+    return out
+
+
+def is_empty(x, cond=None):
+    """Whether ``x`` has zero elements (reference control_flow.py:1936 /
+    is_empty_op.cc).  Shapes are static under XLA, so the result is a
+    compile-time constant materialized as a [1] bool tensor."""
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [cond]})
+    return cond

@@ -20,6 +20,8 @@ __all__ = [
     "ssd_loss",
     "generate_proposals",
     "rpn_target_assign",
+    "generate_proposal_labels",
+    "roi_perspective_transform",
 ]
 
 
@@ -332,3 +334,71 @@ def rpn_target_assign(anchor, gt_boxes, rpn_batch_size_per_im=256,
     for v in (labels, tgt, weight):
         v.stop_gradient = True
     return labels, tgt, weight
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             rpn_rois_length=None, gt_length=None):
+    """Sample RoIs + classification/regression targets for Faster-RCNN
+    training (reference detection.py:1401 /
+    generate_proposal_labels_op.cc).  Padded-batch convention: inputs are
+    [B, ...]; outputs carry a fixed ``batch_size_per_im`` rows per image
+    with RoisNum as the valid-count companion.
+
+    Returns (rois, labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights)."""
+    helper = LayerHelper("generate_proposal_labels", input=rpn_rois)
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference("int32")
+    targets = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    inside = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    outside = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    inputs = {"RpnRois": [rpn_rois], "GtClasses": [gt_classes],
+              "IsCrowd": [is_crowd], "GtBoxes": [gt_boxes],
+              "ImInfo": [im_info]}
+    if rpn_rois_length is not None:
+        inputs["RpnRoisLength"] = [rpn_rois_length]
+    elif getattr(rpn_rois, "_seq_len_name", None):
+        inputs["RpnRoisLength"] = [rpn_rois._seq_len_name]
+    if gt_length is not None:
+        inputs["GtLength"] = [gt_length]
+    helper.append_op(
+        type="generate_proposal_labels", inputs=inputs,
+        outputs={"Rois": [rois], "LabelsInt32": [labels],
+                 "BboxTargets": [targets], "BboxInsideWeights": [inside],
+                 "BboxOutsideWeights": [outside], "RoisNum": [num]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums, "use_random": use_random})
+    for v in (rois, labels, targets, inside, outside, num):
+        v.stop_gradient = True
+    rois._seq_len_name = num.name
+    return rois, labels, targets, inside, outside
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_image_id=None):
+    """Warp quadrilateral ROIs to rectangles (reference detection.py:1353
+    / roi_perspective_transform_op.cc).  ``rois`` is [R, 8] corner
+    coords; ``rois_image_id`` maps each ROI to its batch image (the LoD
+    replacement; defaults to image 0)."""
+    helper = LayerHelper("roi_perspective_transform", input=input)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_image_id is not None:
+        inputs["RoisImageId"] = [rois_image_id]
+    helper.append_op(
+        type="roi_perspective_transform", inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale})
+    return out

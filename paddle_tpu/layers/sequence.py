@@ -45,6 +45,7 @@ __all__ = [
     "sequence_expand_as",
     "sequence_scatter",
     "im2sequence",
+    "lod_reset",
 ]
 
 
@@ -598,4 +599,26 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
         attrs={"kernels": list(filter_size), "strides": list(stride),
                "paddings": list(padding)})
     out._seq_len_name = out_len.name
+    return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Replace the sequence-length companion of ``x`` (reference
+    nn.py:4773 lod_reset / lod_reset_op.cc).  ``y``'s data is read as
+    level-0 offsets; otherwise ``target_lod`` (offsets) is required."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64")
+    inputs = {"X": [x]}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = [y]
+    elif target_lod is not None:
+        attrs["target_lod"] = [int(v) for v in target_lod]
+    else:
+        raise ValueError("lod_reset needs y or target_lod")
+    helper.append_op(type="lod_reset", inputs=inputs,
+                     outputs={"Out": [out], "Length": [length]},
+                     attrs=attrs)
+    out._seq_len_name = length.name
     return out

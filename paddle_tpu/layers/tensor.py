@@ -43,6 +43,13 @@ __all__ = [
     "gather",
     "scatter",
     "pad",
+    "pad2d",
+    "pad_constant_like",
+    "crop",
+    "random_crop",
+    "unstack",
+    "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like",
     "cumsum",
     "increment",
     "isfinite",
@@ -440,3 +447,118 @@ logical_and = _logical_layer("logical_and")
 logical_or = _logical_layer("logical_or")
 logical_xor = _logical_layer("logical_xor")
 logical_not = _logical_layer("logical_not", binary=False)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop ``x`` to ``shape`` at ``offsets`` (reference nn.py:crop /
+    crop_op.cc).  ``shape``/``offsets`` may be lists or Variables."""
+    helper = LayerHelper("crop", name=name)
+    inputs = {"X": [x]}
+    attrs = {}
+    if isinstance(shape, Variable):
+        inputs["Y"] = [shape]
+    elif shape is not None:
+        attrs["shape"] = list(shape)
+    if isinstance(offsets, Variable):
+        inputs["Offsets"] = [offsets]
+    elif offsets is not None:
+        attrs["offsets"] = list(offsets)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="crop", inputs=inputs, outputs={"Out": [out]},
+                     attrs=attrs)
+    return out
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """Pad images [top, bottom, left, right] in constant/reflect/edge mode
+    (reference nn.py:pad2d / pad2d_op.cc)."""
+    helper = LayerHelper("pad2d", name=name)
+    out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="pad2d", inputs={"X": [input]}, outputs={"Out": [out]},
+        attrs={"paddings": list(paddings), "mode": mode,
+               "pad_value": float(pad_value), "data_format": data_format},
+    )
+    return out
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad ``y`` up to the shape of ``x`` (reference nn.py:pad_constant_like
+    / pad_constant_like_op.cc)."""
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(dtype=y.dtype)
+    helper.append_op(
+        type="pad_constant_like", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]}, attrs={"pad_value": float(pad_value)},
+    )
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    """Per-instance random crop to ``shape`` (reference nn.py:random_crop /
+    random_crop_op.cc).  ``seed`` is accepted for API parity; randomness
+    comes from the executor's counter PRNG."""
+    helper = LayerHelper("random_crop")
+    inputs = {"X": [x]}
+    outputs = {"Out": [helper.create_variable_for_type_inference(x.dtype)]}
+    if isinstance(seed, Variable):
+        inputs["Seed"] = [seed]
+        outputs["SeedOut"] = [
+            helper.create_variable_for_type_inference("int64")]
+    startup = seed if isinstance(seed, int) else 0
+    helper.append_op(type="random_crop", inputs=inputs, outputs=outputs,
+                     attrs={"shape": list(shape), "startup_seed": startup})
+    return outputs["Out"][0]
+
+
+def unstack(x, axis=0, num=None):
+    """Unstack ``x`` into ``num`` tensors along ``axis`` (reference
+    nn.py:unstack / unstack_op.h)."""
+    helper = LayerHelper("unstack")
+    if num is None:
+        num = x.shape[axis]
+    if num is None or num < 0:
+        raise ValueError(
+            "unstack: dim %d of %r is dynamic; pass num= explicitly"
+            % (axis, x.name))
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(num)]
+    helper.append_op(type="unstack", inputs={"X": [x]},
+                     outputs={"Y": outs},
+                     attrs={"axis": axis, "num": num})
+    return outs
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    """Uniform random tensor whose batch dim copies ``input``'s (reference
+    nn.py:uniform_random_batch_size_like)."""
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="uniform_random_batch_size_like", inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "min": float(min),
+               "max": float(max), "seed": seed, "dtype": dtype},
+    )
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    """Gaussian random tensor whose batch dim copies ``input``'s (reference
+    nn.py:gaussian_random_batch_size_like)."""
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="gaussian_random_batch_size_like", inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "mean": float(mean),
+               "std": float(std), "seed": seed, "dtype": dtype},
+    )
+    return out

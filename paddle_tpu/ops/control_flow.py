@@ -35,6 +35,7 @@ from jax import lax
 
 from .. import registry
 from ..registry import ComputeContext, register_op, set_output, in_var
+from ..core import long_dtype
 
 
 def _sub_ctx(ctx, salt):
@@ -296,7 +297,7 @@ register_op(
 
 
 def _array_length_compute(ins, attrs, ctx, op_index):
-    return {"Out": jnp.full((1,), ins["X"][0].shape[0], jnp.int64)}
+    return {"Out": jnp.full((1,), ins["X"][0].shape[0], long_dtype())}
 
 
 register_op(
@@ -377,8 +378,8 @@ def _beam_search_compute(ins, attrs, ctx, op_index):
     total = pre_scores[:, :, None] + step  # [B, K, V]
     flat = total.reshape(total.shape[0], k * v)
     top_scores, top_idx = lax.top_k(flat, k)
-    parent = (top_idx // v).astype(jnp.int64)
-    token = (top_idx % v).astype(jnp.int64)
+    parent = (top_idx // v).astype(long_dtype())
+    token = (top_idx % v).astype(long_dtype())
     return {"SelectedIds": token, "SelectedScores": top_scores,
             "ParentIdx": parent}
 
@@ -403,7 +404,7 @@ def _beam_search_decode_compute(ins, attrs, ctx, op_index):
     parents = ins["Parents"][0]           # [T, B, K] beam backpointers
     scores = ins["Scores"][0]             # [B, K] final beam scores
     t, b, k = ids.shape
-    beam0 = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int64), (b, k))
+    beam0 = jnp.broadcast_to(jnp.arange(k, dtype=long_dtype()), (b, k))
 
     def back(carry, xs):
         beam = carry                      # [B, K] position at step t

@@ -80,65 +80,80 @@ register_op("depthwise_conv2d", ["Input", "Filter"], ["Output"],
 
 # -- conv2d_transpose -------------------------------------------------------
 
-def _convt_infer(op, block):
-    x = in_var(op, block, "Input")
-    w = in_var(op, block, "Filter")  # [in_c, out_c/groups, kh, kw]
-    nd = 2
-    strides = int_list(op.attrs.get("strides", 1), nd)
-    pads = int_list(op.attrs.get("paddings", 0), nd)
-    dils = int_list(op.attrs.get("dilations", 1), nd)
-    groups = op.attrs.get("groups", 1) or 1
-    out_c = w.shape[1] * groups
-    spatial = []
-    for i in range(nd):
-        if x.shape[2 + i] is None or x.shape[2 + i] < 0:
-            spatial.append(-1)
-        else:
-            spatial.append(
-                (x.shape[2 + i] - 1) * strides[i] - 2 * pads[i]
-                + dils[i] * (w.shape[2 + i] - 1) + 1
+
+def _convt_infer_nd(nd):
+    def infer(op, block):
+        x = in_var(op, block, "Input")
+        w = in_var(op, block, "Filter")  # [in_c, out_c/groups, *k]
+        strides = int_list(op.attrs.get("strides", 1), nd)
+        pads = int_list(op.attrs.get("paddings", 0), nd)
+        dils = int_list(op.attrs.get("dilations", 1), nd)
+        groups = op.attrs.get("groups", 1) or 1
+        out_c = w.shape[1] * groups
+        spatial = []
+        for i in range(nd):
+            if x.shape[2 + i] is None or x.shape[2 + i] < 0:
+                spatial.append(-1)
+            else:
+                spatial.append(
+                    (x.shape[2 + i] - 1) * strides[i] - 2 * pads[i]
+                    + dils[i] * (w.shape[2 + i] - 1) + 1
+                )
+        set_output(op, block, "Output", (x.shape[0], out_c, *spatial),
+                   x.dtype)
+    return infer
+
+
+def _convt_compute_nd(nd):
+    dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    spatial_axes = tuple(range(2, 2 + nd))
+
+    def compute(ins, attrs, ctx, op_index):
+        x, w = ins["Input"][0], ins["Filter"][0]
+        strides = int_list(attrs.get("strides", 1), nd)
+        pads = int_list(attrs.get("paddings", 0), nd)
+        dils = int_list(attrs.get("dilations", 1), nd)
+        groups = attrs.get("groups", 1) or 1
+
+        def one_group(xg, wg):
+            # wg: [in_c/g, out_c/g, *k] -> rotate spatially, swap I/O
+            wt = jnp.flip(wg, axis=spatial_axes).transpose(
+                (1, 0) + spatial_axes)
+            k = [wt.shape[2 + i] for i in range(nd)]
+            pad = [
+                (dils[i] * (k[i] - 1) - pads[i],
+                 dils[i] * (k[i] - 1) - pads[i])
+                for i in range(nd)
+            ]
+            return lax.conv_general_dilated(
+                xg, wt,
+                window_strides=[1] * nd,
+                padding=pad,
+                lhs_dilation=strides,
+                rhs_dilation=dils,
+                dimension_numbers=dn,
             )
-    set_output(op, block, "Output", (x.shape[0], out_c, *spatial), x.dtype)
 
-
-def _convt_compute(ins, attrs, ctx, op_index):
-    x, w = ins["Input"][0], ins["Filter"][0]
-    nd = 2
-    strides = int_list(attrs.get("strides", 1), nd)
-    pads = int_list(attrs.get("paddings", 0), nd)
-    dils = int_list(attrs.get("dilations", 1), nd)
-    groups = attrs.get("groups", 1) or 1
-
-    def one_group(xg, wg):
-        # wg: [in_c/g, out_c/g, kh, kw] -> rotate spatially, swap I/O
-        wt = jnp.flip(wg, axis=(2, 3)).transpose(1, 0, 2, 3)
-        k = [wt.shape[2 + i] for i in range(nd)]
-        pad = [
-            (dils[i] * (k[i] - 1) - pads[i], dils[i] * (k[i] - 1) - pads[i])
-            for i in range(nd)
-        ]
-        return lax.conv_general_dilated(
-            xg, wt,
-            window_strides=[1] * nd,
-            padding=pad,
-            lhs_dilation=strides,
-            rhs_dilation=dils,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        )
-
-    if groups == 1:
-        out = one_group(x, w)
-    else:
-        xs = jnp.split(x, groups, axis=1)
-        ws = jnp.split(w, groups, axis=0)
-        out = jnp.concatenate(
-            [one_group(xg, wg) for xg, wg in zip(xs, ws)], axis=1
-        )
-    return {"Output": out}
+        if groups == 1:
+            out = one_group(x, w)
+        else:
+            xs = jnp.split(x, groups, axis=1)
+            ws = jnp.split(w, groups, axis=0)
+            out = jnp.concatenate(
+                [one_group(xg, wg) for xg, wg in zip(xs, ws)], axis=1
+            )
+        return {"Output": out}
+    return compute
 
 
 register_op("conv2d_transpose", ["Input", "Filter"], ["Output"],
-            infer=_convt_infer, compute=_convt_compute)
+            infer=_convt_infer_nd(2), compute=_convt_compute_nd(2))
+register_op("conv3d_transpose", ["Input", "Filter"], ["Output"],
+            infer=_convt_infer_nd(3), compute=_convt_compute_nd(3))
+# depthwise transpose = grouped transpose; separate type for registration
+# parity (reference conv_transpose_op.cc:335)
+register_op("depthwise_conv2d_transpose", ["Input", "Filter"], ["Output"],
+            infer=_convt_infer_nd(2), compute=_convt_compute_nd(2))
 
 
 # -- conv_shift (circular 1-D correlation, conv_shift_op.cc) ----------------

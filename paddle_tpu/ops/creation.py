@@ -14,8 +14,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core import convert_dtype
-from ..registry import register_op, set_output, in_var
+from ..core import convert_dtype, long_dtype, materialize_dtype
+from ..registry import register_op, set_output, in_var, same_shape_infer
 
 
 def _attr_dtype(attrs, default="float32"):
@@ -29,7 +29,7 @@ def _fill_constant_infer(op, block):
 
 
 def _fill_constant_compute(ins, attrs, ctx, op_index):
-    dtype = _attr_dtype(attrs)
+    dtype = materialize_dtype(_attr_dtype(attrs))
     return {"Out": jnp.full(tuple(attrs["shape"]), attrs.get("value", 0.0),
                             dtype=dtype)}
 
@@ -62,13 +62,17 @@ def _fcbsl_infer(op, block):
     set_output(op, block, "Out", shape, _attr_dtype(op.attrs))
 
 
-def _fcbsl_compute(ins, attrs, ctx, op_index):
+def _bsl_shape(ins, attrs):
+    """*_batch_size_like shape rule: copy the input's batch dim."""
     shape = list(attrs["shape"])
-    in_dim = attrs.get("input_dim_idx", 0)
-    out_dim = attrs.get("output_dim_idx", 0)
-    shape[out_dim] = ins["Input"][0].shape[in_dim]
-    return {"Out": jnp.full(tuple(shape), attrs.get("value", 0.0),
-                            dtype=_attr_dtype(attrs))}
+    shape[attrs.get("output_dim_idx", 0)] = \
+        ins["Input"][0].shape[attrs.get("input_dim_idx", 0)]
+    return tuple(shape)
+
+
+def _fcbsl_compute(ins, attrs, ctx, op_index):
+    return {"Out": jnp.full(_bsl_shape(ins, attrs), attrs.get("value", 0.0),
+                            dtype=materialize_dtype(_attr_dtype(attrs)))}
 
 
 register_op(
@@ -81,7 +85,7 @@ register_op(
 
 def _uniform_random_compute(ins, attrs, ctx, op_index):
     key = ctx.rng_key(op_index)
-    dtype = _attr_dtype(attrs)
+    dtype = materialize_dtype(_attr_dtype(attrs))
     lo = attrs.get("min", -1.0)
     hi = attrs.get("max", 1.0)
     return {"Out": jax.random.uniform(
@@ -97,7 +101,7 @@ register_op(
 
 def _gaussian_random_compute(ins, attrs, ctx, op_index):
     key = ctx.rng_key(op_index)
-    dtype = _attr_dtype(attrs)
+    dtype = materialize_dtype(_attr_dtype(attrs))
     mean = attrs.get("mean", 0.0)
     std = attrs.get("std", 1.0)
     return {"Out": mean + std * jax.random.normal(
@@ -113,7 +117,7 @@ register_op(
 
 def _truncated_gaussian_compute(ins, attrs, ctx, op_index):
     key = ctx.rng_key(op_index)
-    dtype = _attr_dtype(attrs)
+    dtype = materialize_dtype(_attr_dtype(attrs))
     mean = attrs.get("mean", 0.0)
     std = attrs.get("std", 1.0)
     # truncated to +-2 std like the reference (truncated_gaussian_random_op.cc)
@@ -158,7 +162,8 @@ def _cast_infer(op, block):
 
 
 def _cast_compute(ins, attrs, ctx, op_index):
-    return {"Out": ins["X"][0].astype(convert_dtype(attrs["out_dtype"]))}
+    return {"Out": ins["X"][0].astype(
+        materialize_dtype(attrs["out_dtype"]))}
 
 
 register_op("cast", ["X"], ["Out"], infer=_cast_infer, compute=_cast_compute,
@@ -174,7 +179,7 @@ register_op(
     "shape", ["Input"], ["Out"],
     infer=_shape_infer,
     compute=lambda ins, attrs, ctx, op_index: {
-        "Out": jnp.asarray(ins["Input"][0].shape, dtype=jnp.int64)
+        "Out": jnp.asarray(ins["Input"][0].shape, dtype=long_dtype())
     },
     grad=None,
 )
@@ -192,4 +197,87 @@ register_op(
         op, block, "Out", in_var(op, block, "X").shape, in_var(op, block, "X").dtype
     ),
     compute=_increment_compute, grad=None,
+)
+
+
+# -- *_batch_size_like randoms (reference *_batch_size_like_op.cc) ----------
+
+def _uniform_bsl_compute(ins, attrs, ctx, op_index):
+    key = ctx.rng_key(op_index)
+    dtype = materialize_dtype(_attr_dtype(attrs))
+    return {"Out": jax.random.uniform(
+        key, _bsl_shape(ins, attrs), dtype=dtype,
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0))}
+
+
+register_op(
+    "uniform_random_batch_size_like", ["Input"], ["Out"],
+    infer=_fcbsl_infer, compute=_uniform_bsl_compute,
+    grad=None, stateful_random=True,
+)
+
+
+def _gaussian_bsl_compute(ins, attrs, ctx, op_index):
+    key = ctx.rng_key(op_index)
+    dtype = materialize_dtype(_attr_dtype(attrs))
+    return {"Out": attrs.get("mean", 0.0) + attrs.get("std", 1.0)
+            * jax.random.normal(key, _bsl_shape(ins, attrs), dtype=dtype)}
+
+
+register_op(
+    "gaussian_random_batch_size_like", ["Input"], ["Out"],
+    infer=_fcbsl_infer, compute=_gaussian_bsl_compute,
+    grad=None, stateful_random=True,
+)
+
+
+# -- print (reference print_op.cc -> jax.debug.print lowering) --------------
+
+def _print_compute(ins, attrs, ctx, op_index):
+    x = ins["In"][0]
+    msg = attrs.get("message", "")
+    phase = attrs.get("print_phase", "FORWARD")
+    if phase in ("FORWARD", "BOTH"):
+        def esc(s):  # user text must not hit the format engine
+            return str(s).replace("{", "{{").replace("}", "}}")
+
+        parts = []
+        if msg:
+            parts.append(esc(msg))
+        if attrs.get("print_tensor_name", True):
+            parts.append(esc(attrs.get("__var_name__", "")))
+        if attrs.get("print_tensor_shape", True):
+            parts.append("shape=%s" % (tuple(x.shape),))
+        if attrs.get("print_tensor_type", True):
+            parts.append("dtype=%s" % x.dtype)
+        parts.append("value={v}")
+        jax.debug.print(" ".join(parts), v=x, ordered=False)
+    return {"Out": x}
+
+
+def _print_grad(op, no_grad_set):
+    # pass the cotangent straight through (auto-vjp would re-run the
+    # forward and print twice); print it when the phase asks for it,
+    # mirroring print_op.cc's backward registration
+    from ..framework import grad_var_name
+    x = op.inputs["In"][0]
+    if x in no_grad_set:
+        return []
+    g_out = grad_var_name(op.outputs["Out"][0])
+    g_in = grad_var_name(x)
+    phase = op.attrs.get("print_phase", "FORWARD")
+    if phase in ("BACKWARD", "BOTH"):
+        attrs = dict(op.attrs)
+        attrs["print_phase"] = "FORWARD"  # fire on this (grad) tensor
+        attrs["__var_name__"] = g_out
+        return [dict(type="print", inputs={"In": [g_out]},
+                     outputs={"Out": [g_in]}, attrs=attrs)]
+    return [dict(type="assign", inputs={"X": [g_out]},
+                 outputs={"Out": [g_in]}, attrs={})]
+
+
+register_op(
+    "print", ["In"], ["Out"],
+    infer=same_shape_infer("In", "Out"),
+    compute=_print_compute, grad=_print_grad,
 )

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..registry import register_op, set_output, in_var
+from ..core import long_dtype
 
 __all__ = []
 
@@ -145,7 +146,7 @@ def _crf_decoding_compute(ins, attrs, ctx, op_index):
     transition = ins["Transition"][0]
     path, valid = jax.vmap(_viterbi_path, in_axes=(0, 0, None))(
         emission, length, transition)
-    path = path.astype(jnp.int64)
+    path = path.astype(long_dtype())
     labels = ins.get("Label", [None])
     label = labels[0] if labels else None
     if label is not None:
@@ -153,8 +154,8 @@ def _crf_decoding_compute(ins, attrs, ctx, op_index):
             label = label[:, :, 0]
         # reference crf_decoding_op.h:61 — with Label, emit the per-
         # position correctness mask instead of the path
-        path = jnp.where(valid, (path == label.astype(jnp.int64))
-                         .astype(jnp.int64), 0)
+        path = jnp.where(valid, (path == label.astype(long_dtype()))
+                         .astype(long_dtype()), 0)
     return {"ViterbiPath": path[:, :, None]}
 
 
@@ -263,8 +264,8 @@ def _chunk_eval_compute(ins, attrs, ctx, op_index):
             ib = ib & (ityp != ex)
             lb = lb & (ltyp != ex)
 
-        n_inf = jnp.sum((ib & val).astype(jnp.int64))
-        n_lab = jnp.sum((lb & val).astype(jnp.int64))
+        n_inf = jnp.sum((ib & val).astype(long_dtype()))
+        n_lab = jnp.sum((lb & val).astype(long_dtype()))
 
         # a predicted chunk (start j) is correct iff the label also
         # starts a chunk at j with the same type and both chunks close
@@ -282,7 +283,7 @@ def _chunk_eval_compute(ins, attrs, ctx, op_index):
         ie_pos = first_end(ie_at)
         le_pos = first_end(le_at)
         correct_start = ib & lb & val & (ityp == ltyp) & (ie_pos == le_pos)
-        n_correct = jnp.sum(correct_start.astype(jnp.int64))
+        n_correct = jnp.sum(correct_start.astype(long_dtype()))
         return n_inf, n_lab, n_correct
 
     n_inf, n_lab, n_correct = jax.vmap(one_seq)(inference, label, valid)

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..registry import register_op, set_output, in_var
+from ..core import long_dtype
 
 __all__ = []
 
@@ -191,7 +192,7 @@ def _edit_distance_compute(ins, attrs, ctx, op_index):
     d = jax.vmap(_edit_distance_single)(hyps, h_len, refs, r_len)
     if attrs.get("normalized", True):
         d = d / jnp.maximum(r_len, 1).astype(d.dtype)
-    n = jnp.asarray([hyps.shape[0]], dtype=jnp.int64)
+    n = jnp.asarray([hyps.shape[0]], dtype=long_dtype())
     return {"Out": d[:, None], "SequenceNum": n}
 
 

@@ -379,9 +379,12 @@ register_op("target_assign", ["X", "MatchIndices", "NegIndices"],
 # -- multiclass_nms ---------------------------------------------------------
 
 def _nms_class(boxes, scores, score_thresh, nms_thresh, top_k,
-               normalized):
+               normalized, eta=1.0):
     """One class: boxes [M,4], scores [M] -> keep mask [M] (greedy NMS
-    over the top_k highest scores)."""
+    over the top_k highest scores).  ``eta < 1`` decays the overlap
+    threshold after each kept box while it stays above 0.5 — the
+    reference's adaptive NMS (multiclass_nms_op.cc NMSFast,
+    generate_proposals_op.cc eta attr)."""
     m = boxes.shape[0]
     k = min(top_k, m) if top_k > 0 else m
     order = jnp.argsort(-scores)
@@ -390,14 +393,20 @@ def _nms_class(boxes, scores, score_thresh, nms_thresh, top_k,
     iou = _iou_matrix(sboxes, sboxes, normalized)
     valid = sscores > score_thresh
 
-    def body(i, keep):
+    def body(i, carry):
+        keep, thresh = carry
         # suppressed iff any already-kept earlier box overlaps > thresh
+        # (thresh is the adaptive threshold at this candidate's turn)
         earlier_kept = jnp.where(jnp.arange(m) < i, keep, False)
-        sup = jnp.any(earlier_kept & (iou[:, i] > nms_thresh))
+        sup = jnp.any(earlier_kept & (iou[:, i] > thresh))
         ok = valid[i] & (i < k) & ~sup
-        return keep.at[i].set(ok)
+        if eta < 1.0:
+            thresh = jnp.where(ok & (thresh > 0.5), thresh * eta, thresh)
+        return keep.at[i].set(ok), thresh
 
-    keep_sorted = lax.fori_loop(0, m, body, jnp.zeros((m,), bool))
+    keep_sorted, _ = lax.fori_loop(
+        0, m, body,
+        (jnp.zeros((m,), bool), jnp.asarray(nms_thresh, jnp.float32)))
     keep = jnp.zeros((m,), bool).at[order].set(keep_sorted)
     return keep
 
@@ -414,7 +423,8 @@ def _multiclass_nms_single(bboxes, scores, attrs):
 
     def per_class(cls_scores):
         return _nms_class(bboxes, cls_scores, score_thresh, nms_thresh,
-                          nms_top_k, normalized)
+                          nms_top_k, normalized,
+                          eta=float(attrs.get("nms_eta", 1.0)))
 
     keep = jax.vmap(per_class)(scores)           # [C, M]
     if 0 <= bg < c:
@@ -562,37 +572,62 @@ def _mine_hard_infer(op, block):
 
 
 def _mine_hard_compute(ins, attrs, ctx, op_index):
-    """max_negative mining (mine_hard_examples_op.cc:29-80): per image,
-    eligible negatives are unmatched priors with match_dist below
-    neg_dist_threshold; the num_pos*neg_pos_ratio highest-conf-loss ones
-    are selected.  NegIndices is a compacted, -1-padded [N, P] index
-    array + NegCount (the LoD replacement)."""
+    """Hard-negative mining (mine_hard_examples_op.cc:29-80), both modes.
+
+    max_negative: eligible negatives are unmatched priors with match_dist
+    below neg_dist_threshold; the num_pos*neg_pos_ratio highest-conf-loss
+    ones are selected.  hard_example: every prior competes on
+    cls_loss+loc_loss, the top sample_size survive — mined unmatched
+    priors become negatives, unmined matched priors lose their match.
+    NegIndices is a compacted, -1-padded [N, P] index array + NegCount
+    (the LoD replacement)."""
     cls_loss = ins["ClsLoss"][0]                 # [N, P]
     match = ins["MatchIndices"][0]               # [N, P]
     mdist = ins["MatchDist"][0]
-    # NOTE: LocLoss and sample_size are hard_example-mode inputs in the
-    # reference (mine_hard_examples_op.cc); max_negative ranks by
-    # cls_loss alone and ignores both
     mining_type = attrs.get("mining_type", "max_negative")
-    if mining_type != "max_negative":
-        raise NotImplementedError(
-            "mine_hard_examples: only mining_type='max_negative' is "
-            "implemented (the reference's hard_example mode, "
-            "mine_hard_examples_op.cc:34, is not)")
+    if mining_type not in ("max_negative", "hard_example"):
+        raise ValueError(
+            "mine_hard_examples: unknown mining_type %r" % mining_type)
     ratio = float(attrs.get("neg_pos_ratio", 3.0))
     thresh = float(attrs.get("neg_dist_threshold", 0.5))
 
     n, p = match.shape
-    eligible = (match == -1) & (mdist < thresh)
-    num_pos = jnp.sum((match != -1).astype(jnp.int32), axis=1)
-    num_neg = jnp.minimum(
-        (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32),
-        jnp.sum(eligible.astype(jnp.int32), axis=1))
+    unmatched = match == -1
+    if mining_type == "hard_example":
+        # every prior is eligible; rank by cls+loc loss, cap at
+        # sample_size (mine_hard_examples_op.cc kHardExample)
+        eligible = jnp.ones((n, p), bool)
+        loss = cls_loss
+        loc = ins.get("LocLoss")
+        if loc and loc[0] is not None:
+            loss = loss + loc[0]
+        num_neg = jnp.full((n,), min(int(attrs.get("sample_size", 0)), p),
+                           jnp.int32)
+    else:
+        # eligible negatives: unmatched priors with match_dist below the
+        # threshold; rank by cls_loss alone, cap at num_pos * ratio
+        eligible = unmatched & (mdist < thresh)
+        loss = cls_loss
+        num_pos = jnp.sum((~unmatched).astype(jnp.int32), axis=1)
+        num_neg = jnp.minimum(
+            (num_pos.astype(jnp.float32) * ratio).astype(jnp.int32),
+            jnp.sum(eligible.astype(jnp.int32), axis=1))
 
-    masked = jnp.where(eligible, cls_loss, _BIG_NEG)
+    masked = jnp.where(eligible, loss, _BIG_NEG)
     order = jnp.argsort(-masked, axis=1)         # loss-desc prior ids
     rank = jnp.argsort(order, axis=1)            # rank of each prior
-    sel = eligible & (rank < num_neg[:, None])
+    hard = eligible & (rank < num_neg[:, None])  # the mined set
+
+    if mining_type == "hard_example":
+        # matched priors not mined are dropped from matching; mined
+        # unmatched priors become the negatives
+        updated = jnp.where(~unmatched & ~hard, -1, match)
+        sel = hard & unmatched
+        num_out = jnp.sum(sel.astype(jnp.int32), axis=1)
+    else:
+        updated = match
+        sel = hard
+        num_out = num_neg
 
     # compact selected prior ids (ascending) into the left of each row
     pos = jnp.cumsum(sel.astype(jnp.int32), axis=1) - 1
@@ -601,8 +636,8 @@ def _mine_hard_compute(ins, attrs, ctx, op_index):
     neg = jnp.full((n, p), -1, jnp.int32).at[
         b_idx, jnp.where(sel, pos, p)].set(
         prior_ids.astype(jnp.int32), mode="drop")
-    return {"NegIndices": neg, "NegCount": num_neg.astype(jnp.int32),
-            "UpdatedMatchIndices": match.astype(jnp.int32)}
+    return {"NegIndices": neg, "NegCount": num_out.astype(jnp.int32),
+            "UpdatedMatchIndices": updated.astype(jnp.int32)}
 
 
 register_op("mine_hard_examples",
@@ -662,7 +697,8 @@ def _gen_proposals_single(scores, deltas, im_info, anchors, variances,
                  (boxes[:, 3] - boxes[:, 1] + 1.0 >= min_size * scale))
     eff_scores = jnp.where(keep_size, top_scores, _BIG_NEG)
     keep = _nms_class(boxes, eff_scores, _BIG_NEG / 2, nms_thresh,
-                      k, normalized=False)
+                      k, normalized=False,
+                      eta=float(attrs.get("eta", 1.0)))
     final_scores = jnp.where(keep, eff_scores, _BIG_NEG)
     n_out = min(post_n, k)
     sel_scores, sel = lax.top_k(final_scores, n_out)
@@ -684,10 +720,6 @@ def _gen_proposals_compute(ins, attrs, ctx, op_index):
     anchor_generator's [H, W, A, 4] output), or the reference conv-head
     NCHW form scores [B, A, H, W] / deltas [B, 4A, H, W] (transposed to
     (H, W, A)-major here, generate_proposals_op.cc Transpose)."""
-    if float(attrs.get("eta", 1.0)) != 1.0:
-        raise NotImplementedError(
-            "generate_proposals: adaptive NMS (eta != 1) is not "
-            "implemented; use eta=1.0")
     scores = ins["Scores"][0]
     deltas = ins["BboxDeltas"][0]
     im_info = ins["ImInfo"][0]        # [B, 3]
@@ -807,3 +839,290 @@ register_op("rpn_target_assign", ["Anchor", "GtBoxes", "GtLength"],
             ["ScoreLabels", "TargetBBox", "BBoxWeight"],
             infer=_rpn_assign_infer, compute=_rpn_assign_compute,
             grad=None)
+
+
+# -- generate_proposal_labels -----------------------------------------------
+# Reference: detection/generate_proposal_labels_op.cc (SampleRoisForOneImage)
+# TPU redesign: padded [B, ...] batch with per-image vmap and STATIC
+# batch_size_per_im output rows (the reference emits dynamic fg+bg rows;
+# here padding rows carry label 0 and zero weights, and RoisNum reports the
+# valid count per image — same masking contract as generate_proposals).
+
+def _gpl_infer(op, block):
+    rois = in_var(op, block, "RpnRois")
+    b = rois.shape[0]
+    s = int(op.attrs["batch_size_per_im"])
+    if op.attrs.get("class_nums") is None:
+        raise ValueError(
+            "generate_proposal_labels: class_nums is required (the number "
+            "of detection classes incl. background)")
+    c = int(op.attrs["class_nums"])
+    set_output(op, block, "Rois", (b, s, 4), "float32", lod_level=1)
+    set_output(op, block, "LabelsInt32", (b, s, 1), "int32")
+    set_output(op, block, "BboxTargets", (b, s, 4 * c), "float32")
+    set_output(op, block, "BboxInsideWeights", (b, s, 4 * c), "float32")
+    set_output(op, block, "BboxOutsideWeights", (b, s, 4 * c), "float32")
+    set_output(op, block, "RoisNum", (b,), "int32")
+
+
+def _box_to_delta(ex, gt, weights):
+    """bbox_util.h BoxToDelta (normalized=False, per-row weights divide)."""
+    ew = ex[:, 2] - ex[:, 0] + 1.0
+    eh = ex[:, 3] - ex[:, 1] + 1.0
+    ecx = ex[:, 0] + 0.5 * ew
+    ecy = ex[:, 1] + 0.5 * eh
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + 0.5 * gw
+    gcy = gt[:, 1] + 0.5 * gh
+    t = jnp.stack([(gcx - ecx) / ew, (gcy - ecy) / eh,
+                   jnp.log(jnp.maximum(gw / ew, 1e-10)),
+                   jnp.log(jnp.maximum(gh / eh, 1e-10))], axis=-1)
+    return t / jnp.asarray(weights, t.dtype)[None, :]
+
+
+def _gpl_single(rois, roi_len, gt_cls, is_crowd, gt, gt_len, im_info,
+                key, attrs):
+    s = int(attrs["batch_size_per_im"])
+    c = int(attrs["class_nums"])
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_th = float(attrs.get("fg_thresh", 0.25))  # layer-level default
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    weights = list(attrs.get("bbox_reg_weights", [1.0, 1.0, 1.0, 1.0]))
+    use_random = bool(attrs.get("use_random", True))
+
+    g = gt.shape[0]
+    r = rois.shape[0]
+    p = g + r
+    gt_valid = jnp.arange(g) < gt_len
+    roi_valid = jnp.arange(r) < roi_len
+    # proposals = gt boxes first, then scale-corrected rpn rois
+    im_scale = im_info[2]
+    boxes = jnp.concatenate([gt, rois / im_scale], axis=0)       # [P, 4]
+    box_valid = jnp.concatenate([gt_valid, roi_valid])
+
+    iou = _iou_matrix(boxes, gt, normalized=False)               # [P, G]
+    iou = jnp.where(gt_valid[None, :] & box_valid[:, None], iou, 0.0)
+    max_ov = jnp.max(iou, axis=1)
+    gt_ind = jnp.argmax(iou, axis=1)
+    # crowd gt rows are excluded from sampling entirely
+    crowd_row = jnp.concatenate(
+        [(is_crowd > 0) & gt_valid, jnp.zeros((r,), bool)])
+    max_ov = jnp.where(crowd_row, -1.0, max_ov)
+
+    fg = box_valid & (max_ov > fg_th)
+    bg = box_valid & ~fg & (max_ov >= bg_lo) & (max_ov < bg_hi)
+
+    if use_random:
+        # random subset selection: rank candidates by a random key
+        # (reservoir-sampling equivalent distribution, static shapes)
+        order = jax.random.uniform(key, (p,))
+    else:
+        order = jnp.arange(p, dtype=jnp.float32) / p
+    fg_order = jnp.where(fg, order, 2.0)
+    fg_rank = jnp.argsort(jnp.argsort(fg_order))                 # dense rank
+    fg_cap = int(np.floor(s * fg_frac))
+    fg_sel = fg & (fg_rank < fg_cap)
+    n_fg = jnp.sum(fg_sel.astype(jnp.int32))
+    bg_order = jnp.where(bg, order, 2.0)
+    bg_rank = jnp.argsort(jnp.argsort(bg_order))
+    bg_sel = bg & (bg_rank < s - n_fg)
+    n_bg = jnp.sum(bg_sel.astype(jnp.int32))
+
+    # slot layout: fg rows occupy [0, n_fg), bg rows [n_fg, n_fg+n_bg)
+    fg_slot = jnp.cumsum(fg_sel.astype(jnp.int32)) - 1
+    bg_slot = n_fg + jnp.cumsum(bg_sel.astype(jnp.int32)) - 1
+    slot = jnp.where(fg_sel, fg_slot, jnp.where(bg_sel, bg_slot, s))
+
+    smp_boxes = jnp.zeros((s, 4)).at[slot].set(boxes, mode="drop")
+    labels = jnp.zeros((s,), jnp.int32).at[slot].set(
+        jnp.where(fg_sel, gt_cls[gt_ind].astype(jnp.int32), 0),
+        mode="drop")
+    smp_gts = jnp.zeros((s, 4)).at[slot].set(gt[gt_ind], mode="drop")
+
+    deltas = _box_to_delta(smp_boxes, smp_gts, weights)          # [S, 4]
+    cls_of = labels                                              # [S]
+    col = 4 * cls_of[:, None] + jnp.arange(4)[None, :]           # [S, 4]
+    is_fg_slot = cls_of > 0
+    targets = jnp.zeros((s, 4 * c)).at[
+        jnp.arange(s)[:, None], jnp.where(is_fg_slot[:, None], col, 0)
+    ].set(jnp.where(is_fg_slot[:, None], deltas, 0.0), mode="drop")
+    inside = jnp.zeros((s, 4 * c)).at[
+        jnp.arange(s)[:, None], jnp.where(is_fg_slot[:, None], col, 0)
+    ].set(jnp.where(is_fg_slot[:, None], 1.0, 0.0), mode="drop")
+
+    out_rois = smp_boxes * im_scale
+    return (out_rois.astype(jnp.float32), labels[:, None],
+            targets.astype(jnp.float32), inside.astype(jnp.float32),
+            inside.astype(jnp.float32), (n_fg + n_bg).astype(jnp.int32))
+
+
+def _gpl_compute(ins, attrs, ctx, op_index):
+    rois = ins["RpnRois"][0]          # [B, R, 4]
+    gt_cls = ins["GtClasses"][0]      # [B, G]
+    crowd = ins["IsCrowd"][0]         # [B, G]
+    gt = ins["GtBoxes"][0]            # [B, G, 4]
+    im_info = ins["ImInfo"][0]        # [B, 3]
+    b = rois.shape[0]
+    rl = ins.get("RpnRoisLength")
+    roi_len = rl[0] if rl and rl[0] is not None else \
+        jnp.full((b,), rois.shape[1], jnp.int32)
+    gl = ins.get("GtLength")
+    gt_len = gl[0] if gl and gl[0] is not None else \
+        jnp.full((b,), gt.shape[1], jnp.int32)
+    keys = jax.random.split(ctx.rng_key(op_index), b)
+    rois_o, labels, tgts, inw, outw, num = jax.vmap(
+        lambda _rois, _rlen, _cls, _crowd, _gt, _glen, _info, _k:
+        _gpl_single(_rois, _rlen, _cls, _crowd, _gt, _glen, _info, _k,
+                    attrs))(rois, roi_len, gt_cls, crowd, gt, gt_len,
+                            im_info, keys)
+    return {"Rois": rois_o, "LabelsInt32": labels, "BboxTargets": tgts,
+            "BboxInsideWeights": inw, "BboxOutsideWeights": outw,
+            "RoisNum": num}
+
+
+register_op(
+    "generate_proposal_labels",
+    ["RpnRois", "RpnRoisLength", "GtClasses", "IsCrowd", "GtBoxes",
+     "GtLength", "ImInfo"],
+    ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+     "BboxOutsideWeights", "RoisNum"],
+    infer=_gpl_infer, compute=_gpl_compute, grad=None,
+    stateful_random=True,
+)
+
+
+# -- roi_perspective_transform ----------------------------------------------
+# Reference: detection/roi_perspective_transform_op.cc — warp each
+# quadrilateral ROI to a [th, tw] rectangle via the projective transform
+# whose matrix maps output coords to source coords, sampling the feature
+# map bilinearly.  TPU redesign: one dense gather per ROI (vmap over ROIs,
+# broadcast over channels) instead of the reference's per-pixel loops.
+
+def _roi_persp_infer(op, block):
+    x = in_var(op, block, "X")
+    rois = in_var(op, block, "ROIs")
+    th = int(op.attrs.get("transformed_height", 1))
+    tw = int(op.attrs.get("transformed_width", 1))
+    set_output(op, block, "Out", (rois.shape[0], x.shape[1], th, tw),
+               x.dtype)
+
+
+def _persp_matrix(rx, ry, th, tw):
+    """get_transform_matrix (roi_perspective_transform_op.cc:109)."""
+    x0, x1, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+    y0, y1, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+    len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+    len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+    len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+    len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+    est_h = (len2 + len4) / 2.0
+    est_w = (len1 + len3) / 2.0
+    norm_h = th
+    norm_w = jnp.minimum(
+        jnp.round(est_w * (norm_h - 1) / jnp.maximum(est_h, 1e-6)) + 1.0,
+        float(tw))
+    dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+    dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+    den = dx1 * dy2 - dx2 * dy1
+    den = jnp.where(jnp.abs(den) < 1e-10, 1e-10, den)
+    m6 = (dx3 * dy2 - dx2 * dy3) / den / (norm_w - 1)
+    m7 = (dx1 * dy3 - dx3 * dy1) / den / (norm_h - 1)
+    m3 = (y1 - y0 + m6 * (norm_w - 1) * y1) / (norm_w - 1)
+    m4 = (y3 - y0 + m7 * (norm_h - 1) * y3) / (norm_h - 1)
+    m0 = (x1 - x0 + m6 * (norm_w - 1) * x1) / (norm_w - 1)
+    m1 = (x3 - x0 + m7 * (norm_h - 1) * x3) / (norm_h - 1)
+    return m0, m1, x0, m3, m4, y0, m6, m7
+
+
+def _in_quad(px, py, rx, ry):
+    """Vectorized in_quad (roi_perspective_transform_op.cc:45): on-edge
+    OR odd ray-crossing count.  px/py are [th, tw] grids."""
+    eps = 1e-4
+    on_edge = jnp.zeros_like(px, bool)
+    n_cross = jnp.zeros_like(px, jnp.int32)
+    for i in range(4):
+        xs, ys = rx[i], ry[i]
+        xe, ye = rx[(i + 1) % 4], ry[(i + 1) % 4]
+        horiz = jnp.abs(ys - ye) < eps
+        on_h = (jnp.abs(py - ys) < eps) & (jnp.abs(py - ye) < eps) & \
+            (px >= jnp.minimum(xs, xe) - eps) & \
+            (px <= jnp.maximum(xs, xe) + eps)
+        ix = (py - ys) * (xe - xs) / jnp.where(horiz, 1.0, ye - ys) + xs
+        on_v = (jnp.abs(ix - px) < eps) & \
+            (py >= jnp.minimum(ys, ye) - eps) & \
+            (py <= jnp.maximum(ys, ye) + eps)
+        on_edge |= jnp.where(horiz, on_h, on_v)
+        in_span = ~(py <= jnp.minimum(ys, ye) + eps) & \
+            ~(py - jnp.maximum(ys, ye) > eps)
+        crosses = (~horiz) & in_span & (ix - px > eps)
+        n_cross += crosses.astype(jnp.int32)
+    return on_edge | (n_cross % 2 == 1)
+
+
+def _bilinear_at(img, in_w, in_h):
+    """bilinear_interpolate semantics incl. boundary handling; img [H, W],
+    in_w/in_h [th, tw] source coords."""
+    h, w = img.shape
+    oob = (in_w < -0.5) | (in_w > w - 0.5) | (in_h < -0.5) | \
+        (in_h > h - 0.5)
+    iw = jnp.clip(in_w, 0.0, None)
+    ih = jnp.clip(in_h, 0.0, None)
+    wf = jnp.floor(iw)
+    hf = jnp.floor(ih)
+    at_right = wf >= w - 1
+    at_bottom = hf >= h - 1
+    wf = jnp.where(at_right, float(w - 1), wf)
+    hf = jnp.where(at_bottom, float(h - 1), hf)
+    iw = jnp.where(at_right, wf, iw)
+    ih = jnp.where(at_bottom, hf, ih)
+    wc = jnp.where(at_right, wf, wf + 1)
+    hc = jnp.where(at_bottom, hf, hf + 1)
+    fw = iw - wf
+    fh = ih - hf
+    wfi, hfi = wf.astype(jnp.int32), hf.astype(jnp.int32)
+    wci, hci = wc.astype(jnp.int32), hc.astype(jnp.int32)
+    v1 = img[hfi, wfi]
+    v2 = img[hci, wfi]
+    v3 = img[hci, wci]
+    v4 = img[hfi, wci]
+    val = (1 - fw) * (1 - fh) * v1 + (1 - fw) * fh * v2 + \
+        fw * fh * v3 + (1 - fh) * fw * v4
+    return jnp.where(oob, 0.0, val)
+
+
+def _roi_persp_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]                    # [N, C, H, W]
+    rois = ins["ROIs"][0]              # [R, 8]
+    scale = float(attrs.get("spatial_scale", 1.0))
+    th = int(attrs.get("transformed_height", 1))
+    tw = int(attrs.get("transformed_width", 1))
+    roi2im_in = ins.get("RoisImageId")
+    if roi2im_in and roi2im_in[0] is not None:
+        roi2im = roi2im_in[0].reshape(-1).astype(jnp.int32)
+    else:
+        roi2im = jnp.zeros((rois.shape[0],), jnp.int32)
+
+    out_w = jnp.arange(tw, dtype=x.dtype)[None, :].repeat(th, 0)
+    out_h = jnp.arange(th, dtype=x.dtype)[:, None].repeat(tw, 1)
+
+    def one_roi(roi, im_id):
+        rx = roi[0::2] * scale
+        ry = roi[1::2] * scale
+        m0, m1, m2, m3, m4, m5, m6, m7 = _persp_matrix(rx, ry, th, tw)
+        wq = m6 * out_w + m7 * out_h + 1.0
+        in_w = (m0 * out_w + m1 * out_h + m2) / wq
+        in_h = (m3 * out_w + m4 * out_h + m5) / wq
+        inside = _in_quad(in_w, in_h, rx, ry)
+        img = x[im_id]                                   # [C, H, W]
+        vals = jax.vmap(lambda ch: _bilinear_at(ch, in_w, in_h))(img)
+        return jnp.where(inside[None], vals, 0.0)        # [C, th, tw]
+
+    out = jax.vmap(one_roi)(rois, roi2im)
+    return {"Out": out}
+
+
+register_op("roi_perspective_transform", ["X", "ROIs", "RoisImageId"],
+            ["Out"], infer=_roi_persp_infer, compute=_roi_persp_compute,
+            no_grad_inputs=("ROIs", "RoisImageId"))

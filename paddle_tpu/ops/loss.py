@@ -60,7 +60,9 @@ def _swce_infer(op, block):
 def _swce_compute(ins, attrs, ctx, op_index):
     logits, label = ins["Logits"][0], ins["Label"][0]
     if not attrs.get("soft_label", False) and \
-            attrs.get("ignore_index", -100) < 0:
+            attrs.get("ignore_index", -100) == -100:
+        # Pallas path has no ignore mask; only take it when no index is
+        # ignored (-100 is the "none" sentinel, matching the sigmoid variant).
         from ..flags import flag
         if flag("pallas_kernels"):
             # opt-in hand-tiled kernel (ops/pallas/softmax_xent.py)
@@ -80,7 +82,11 @@ def _swce_compute(ins, attrs, ctx, op_index):
         picked = jnp.take_along_axis(log_sm, idx.astype(jnp.int32), axis=-1)
         ignore = attrs.get("ignore_index", -100)
         loss = -picked
-        if ignore >= 0:
+        if ignore != -100:
+            # any index (including negative ones like -1) may be ignored;
+            # -100 is the "none" sentinel (matches the sigmoid variant).
+            # Negative ignored labels wrap through take_along_axis but the
+            # picked value is discarded by this mask, so the loss is exact.
             loss = jnp.where(idx == ignore, 0.0, loss)
     return {"Softmax": softmax, "Loss": loss}
 
@@ -216,3 +222,23 @@ register_op(
     ),
     compute=_margin_rank_loss_compute, no_grad_inputs=("Label",),
 )
+
+
+# -- modified_huber_loss (reference modified_huber_loss_op.cc) --------------
+
+def _mhl_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "IntermediateVal", x.shape, x.dtype)
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _mhl_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]  # y in {0, 1}
+    inter = x * (2.0 * y - 1.0)      # x * y' with y' in {-1, +1}
+    loss = jnp.where(inter < -1.0, -4.0 * inter,
+                     jnp.where(inter < 1.0, (1.0 - inter) ** 2, 0.0))
+    return {"IntermediateVal": inter, "Out": loss}
+
+
+register_op("modified_huber_loss", ["X", "Y"], ["IntermediateVal", "Out"],
+            infer=_mhl_infer, compute=_mhl_compute, no_grad_inputs=("Y",))

@@ -13,7 +13,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core import convert_dtype
+from ..core import convert_dtype, long_dtype, materialize_dtype
 from ..registry import register_op, set_output, in_var
 
 
@@ -384,7 +384,7 @@ def _top_k_infer(op, block):
 
 def _top_k_compute(ins, attrs, ctx, op_index):
     vals, idx = jax.lax.top_k(ins["X"][0], attrs["k"])
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(long_dtype())}
 
 
 register_op("top_k", ["X"], ["Out", "Indices"], infer=_top_k_infer,
@@ -403,7 +403,7 @@ def _argsort_compute(ins, attrs, ctx, op_index):
     x = ins["X"][0]
     axis = attrs.get("axis", -1)
     idx = jnp.argsort(x, axis=axis)
-    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(jnp.int64)}
+    return {"Out": jnp.sort(x, axis=axis), "Indices": idx.astype(long_dtype())}
 
 
 register_op("argsort", ["X"], ["Out", "Indices"], infer=_argsort_infer,
@@ -444,3 +444,164 @@ register_op(
     compute=_lookup_table_compute, grad=_lookup_table_grad,
     no_grad_inputs=("Ids",),
 )
+
+
+# -- crop (reference crop_op.cc) --------------------------------------------
+
+def _crop_infer(op, block):
+    x = in_var(op, block, "X")
+    shape = op.attrs.get("shape") or None
+    if not shape:
+        y = in_var(op, block, "Y")
+        shape = y.shape
+    set_output(op, block, "Out", tuple(shape), x.dtype)
+
+
+def _crop_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    shape = attrs.get("shape") or None
+    if not shape:
+        shape = ins["Y"][0].shape
+    offsets_in = ins.get("Offsets")
+    if offsets_in and offsets_in[0] is not None:
+        if attrs.get("offsets"):
+            raise ValueError(
+                "crop: runtime input Offsets and attr offsets are mutually "
+                "exclusive (crop_op.cc contract)")
+        offs = [offsets_in[0][i] for i in range(x.ndim)]
+        static_offs = None
+    else:
+        offs = list(attrs.get("offsets") or [0] * x.ndim)
+        static_offs = offs
+    if any(s == -1 for s in shape):
+        # -1 = "rest of the dim from the offset" (batch-dim convention);
+        # needs static offsets since XLA slice sizes are compile-time
+        if static_offs is None:
+            raise ValueError(
+                "crop: shape dims of -1 require attr offsets, not the "
+                "runtime Offsets input (slice sizes are static under XLA)")
+        shape = [x.shape[i] - static_offs[i] if s == -1 else s
+                 for i, s in enumerate(shape)]
+    out = jax.lax.dynamic_slice(x, offs, tuple(shape))
+    return {"Out": out}
+
+
+register_op("crop", ["X", "Y", "Offsets"], ["Out"],
+            infer=_crop_infer, compute=_crop_compute,
+            no_grad_inputs=("Y", "Offsets"))
+
+
+# -- pad2d (reference pad2d_op.cc: constant / reflect / edge modes) ---------
+
+def _pad2d_infer(op, block):
+    x = in_var(op, block, "X")
+    p = op.attrs["paddings"]  # [top, bottom, left, right]
+    fmt = op.attrs.get("data_format", "NCHW")
+    n, a, b, c = x.shape
+    if fmt == "NCHW":
+        out = (n, a, b + p[0] + p[1], c + p[2] + p[3])
+    else:  # NHWC
+        out = (n, a + p[0] + p[1], b + p[2] + p[3], c)
+    set_output(op, block, "Out", out, x.dtype)
+
+
+def _pad2d_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    fmt = attrs.get("data_format", "NCHW")
+    mode = attrs.get("mode", "constant")
+    hw = [(p[0], p[1]), (p[2], p[3])]
+    pads = [(0, 0), (0, 0)] + hw if fmt == "NCHW" else \
+        [(0, 0)] + hw + [(0, 0)]
+    if mode == "constant":
+        out = jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pads, mode="reflect")
+    elif mode == "edge":
+        out = jnp.pad(x, pads, mode="edge")
+    else:
+        raise ValueError("pad2d: unknown mode %r" % mode)
+    return {"Out": out}
+
+
+register_op("pad2d", ["X"], ["Out"], infer=_pad2d_infer,
+            compute=_pad2d_compute)
+
+
+# -- pad_constant_like (reference pad_constant_like_op.cc) ------------------
+
+def _pad_const_like_infer(op, block):
+    x = in_var(op, block, "X")
+    y = in_var(op, block, "Y")
+    set_output(op, block, "Out", x.shape, y.dtype)
+
+
+def _pad_const_like_compute(ins, attrs, ctx, op_index):
+    x, y = ins["X"][0], ins["Y"][0]
+    pads = [(0, sx - sy) for sx, sy in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads,
+                           constant_values=attrs.get("pad_value", 0.0))}
+
+
+register_op("pad_constant_like", ["X", "Y"], ["Out"],
+            infer=_pad_const_like_infer, compute=_pad_const_like_compute,
+            no_grad_inputs=("X",))
+
+
+# -- unstack (reference unstack_op.h) ---------------------------------------
+
+def _unstack_infer(op, block):
+    x = in_var(op, block, "X")
+    axis = op.attrs.get("axis", 0)
+    if axis < 0:
+        axis += len(x.shape)
+    out_shape = tuple(x.shape[:axis]) + tuple(x.shape[axis + 1:])
+    for name in op.outputs.get("Y", []):
+        v = block._find_var_recursive(name) or block.create_var(name=name)
+        v.shape = out_shape
+        v.dtype = x.dtype
+
+
+def _unstack_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    if axis < 0:
+        axis += x.ndim
+    n = x.shape[axis]
+    parts = jnp.split(x, n, axis=axis)
+    return {"Y": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+register_op("unstack", ["X"], ["Y"], infer=_unstack_infer,
+            compute=_unstack_compute)
+
+
+# -- is_empty (reference is_empty_op.cc) ------------------------------------
+
+register_op(
+    "is_empty", ["X"], ["Out"],
+    infer=lambda op, block: set_output(op, block, "Out", (1,), "bool"),
+    compute=lambda ins, attrs, ctx, op_index: {
+        # shape is static under XLA: the answer is a trace-time constant
+        "Out": jnp.full((1,), ins["X"][0].size == 0, jnp.bool_)
+    },
+    grad=None,
+)
+
+
+# -- fill (reference fill_op.cc: row-major float values + dtype attr) -------
+
+def _fill_infer(op, block):
+    set_output(op, block, "Out", op.attrs["shape"],
+               op.attrs.get("dtype", "float32"))
+
+
+def _fill_compute(ins, attrs, ctx, op_index):
+    dtype = materialize_dtype(attrs.get("dtype", "float32"))
+    vals = np.asarray(attrs["value"], dtype=np.float64).reshape(
+        tuple(attrs["shape"]))
+    return {"Out": jnp.asarray(vals.astype(dtype))}
+
+
+register_op("fill", [], ["Out"], infer=_fill_infer, compute=_fill_compute,
+            grad=None)

@@ -10,6 +10,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..registry import register_op, set_output, in_var
+from ..core import long_dtype
 
 
 def _accuracy_infer(op, block):
@@ -52,7 +53,7 @@ def _auc_compute(ins, attrs, ctx, op_index):
     n_bins = stat_pos.shape[0]
     p = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 else preds.reshape(-1)
     idx = jnp.clip((p * (n_bins - 1)).astype(jnp.int32), 0, n_bins - 1)
-    pos = (label > 0).astype(jnp.int64)
+    pos = (label > 0).astype(long_dtype())
     stat_pos = stat_pos + jnp.zeros_like(stat_pos).at[idx].add(pos)
     stat_neg = stat_neg + jnp.zeros_like(stat_neg).at[idx].add(1 - pos)
     # integrate ROC from histograms (descending threshold)
@@ -77,11 +78,11 @@ def _mean_iou_compute(ins, attrs, ctx, op_index):
     pred = ins["Predictions"][0].reshape(-1)
     label = ins["Labels"][0].reshape(-1)
     n = attrs["num_classes"]
-    inter = jnp.zeros((n,), jnp.int64).at[
+    inter = jnp.zeros((n,), long_dtype()).at[
         jnp.where(pred == label, pred, n - 1)
-    ].add((pred == label).astype(jnp.int64))
-    pred_cnt = jnp.zeros((n,), jnp.int64).at[pred].add(1)
-    label_cnt = jnp.zeros((n,), jnp.int64).at[label].add(1)
+    ].add((pred == label).astype(long_dtype()))
+    pred_cnt = jnp.zeros((n,), long_dtype()).at[pred].add(1)
+    label_cnt = jnp.zeros((n,), long_dtype()).at[label].add(1)
     union = pred_cnt + label_cnt - inter
     iou = inter.astype(jnp.float32) / jnp.maximum(union, 1).astype(jnp.float32)
     valid = (union > 0).astype(jnp.float32)
@@ -174,4 +175,57 @@ register_op(
     ["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
     infer=_precision_recall_infer, compute=_precision_recall_compute,
     grad=None,
+)
+
+
+# -- positive_negative_pair (reference positive_negative_pair_op.cc) --------
+
+def _pnp_infer(op, block):
+    s = in_var(op, block, "Score")
+    for slot in ("PositivePair", "NegativePair", "NeutralPair"):
+        set_output(op, block, slot, (1,), s.dtype)
+
+
+def _pnp_compute(ins, attrs, ctx, op_index):
+    score, label, query = ins["Score"][0], ins["Label"][0], ins["QueryID"][0]
+    col = attrs.get("column", 0)
+    if col < 0:
+        col += score.shape[1]
+    s = score[:, col]
+    lbl = label.reshape(-1)
+    q = query.reshape(-1)
+    w_in = ins.get("Weight")
+    w = w_in[0].reshape(-1) if w_in and w_in[0] is not None \
+        else jnp.ones_like(s)
+    # all ordered pairs i<j within the same query whose labels differ;
+    # O(B^2) pairwise mask — a metrics-only op, B is a minibatch
+    same_q = q[:, None] == q[None, :]
+    upper = jnp.arange(s.shape[0])[:, None] < jnp.arange(s.shape[0])[None, :]
+    differ = lbl[:, None] != lbl[None, :]
+    valid = same_q & upper & differ
+    pair_w = 0.5 * (w[:, None] + w[None, :])
+    tie = s[:, None] == s[None, :]
+    # a tied pair counts as neutral AND negative: the reference kernel has
+    # no else-if (positive_negative_pair_op.h — the tie falls through the
+    # ternary into neg), and this op reproduces that behavior exactly
+    agree = (s[:, None] - s[None, :]) * (lbl[:, None] - lbl[None, :]) > 0
+    pos = jnp.sum(jnp.where(valid & agree, pair_w, 0.0))
+    neg = jnp.sum(jnp.where(valid & ~agree, pair_w, 0.0))
+    neu = jnp.sum(jnp.where(valid & tie, pair_w, 0.0))
+
+    def acc(slot, v):
+        a = ins.get(slot)
+        return v + a[0].reshape(()) if a and a[0] is not None else v
+
+    return {"PositivePair": acc("AccumulatePositivePair", pos)[None],
+            "NegativePair": acc("AccumulateNegativePair", neg)[None],
+            "NeutralPair": acc("AccumulateNeutralPair", neu)[None]}
+
+
+register_op(
+    "positive_negative_pair",
+    ["Score", "Label", "QueryID", "AccumulatePositivePair",
+     "AccumulateNegativePair", "AccumulateNeutralPair", "Weight"],
+    ["PositivePair", "NegativePair", "NeutralPair"],
+    infer=_pnp_infer, compute=_pnp_compute, grad=None,
 )

@@ -319,3 +319,64 @@ register_op(
     infer=_mirror_infer(("Param", "ParamOut"), ("Moment", "MomentOut")),
     compute=_proximal_adagrad_compute, grad=None,
 )
+
+
+# -- average_accumulates (reference average_accumulates_op.h) ---------------
+# Drives ModelAverage: three staggered sum buffers avoid precision loss over
+# long runs; window restarts keep a bounded trailing average.
+
+_K_MAX_NUM_ACCUMULATES = 16384
+
+
+def _avg_acc_compute(ins, attrs, ctx, op_index):
+    param = ins["param"][0]
+    s1, s2, s3 = ins["in_sum_1"][0], ins["in_sum_2"][0], ins["in_sum_3"][0]
+    num_acc = ins["in_num_accumulates"][0]
+    old_num_acc = ins["in_old_num_accumulates"][0]
+    num_upd = ins["in_num_updates"][0]
+    avg_window = attrs.get("average_window", 0.0)
+    max_w = attrs["max_average_window"]
+    min_w = attrs.get("min_average_window", 10000)
+
+    num_upd = num_upd + 1
+    num_acc = num_acc + 1
+    out1 = s1 + param
+    out2, out3 = s2, s3
+
+    # roll sum_1 into sum_2 every kMax updates (precision guard); the
+    # reference rolls the *pre-update* buffers (average_accumulates_op.h)
+    roll = (num_upd % _K_MAX_NUM_ACCUMULATES) == 0
+    out2 = jnp.where(roll, s2 + s1, out2)
+    out1 = jnp.where(roll, jnp.zeros_like(out1), out1)
+
+    # restart the window once it exceeds min(max_w, num_upd * avg_window)
+    limit = jnp.minimum(
+        jnp.asarray(max_w, num_acc.dtype),
+        (num_upd.astype(jnp.float32) * avg_window).astype(num_acc.dtype))
+    done = (num_acc >= min_w) & (num_acc >= limit)
+    out3 = jnp.where(done, s1 + s2, out3)
+    out1 = jnp.where(done, jnp.zeros_like(out1), out1)
+    out2 = jnp.where(done, jnp.zeros_like(out2), out2)
+    old_num_acc = jnp.where(done, num_acc, old_num_acc)
+    num_acc = jnp.where(done, jnp.zeros_like(num_acc), num_acc)
+
+    return {"out_sum_1": out1, "out_sum_2": out2, "out_sum_3": out3,
+            "out_num_accumulates": num_acc,
+            "out_old_num_accumulates": old_num_acc,
+            "out_num_updates": num_upd}
+
+
+register_op(
+    "average_accumulates",
+    ["param", "in_sum_1", "in_sum_2", "in_sum_3", "in_num_accumulates",
+     "in_old_num_accumulates", "in_num_updates"],
+    ["out_sum_1", "out_sum_2", "out_sum_3", "out_num_accumulates",
+     "out_old_num_accumulates", "out_num_updates"],
+    infer=_mirror_infer(
+        ("in_sum_1", "out_sum_1"), ("in_sum_2", "out_sum_2"),
+        ("in_sum_3", "out_sum_3"),
+        ("in_num_accumulates", "out_num_accumulates"),
+        ("in_old_num_accumulates", "out_old_num_accumulates"),
+        ("in_num_updates", "out_num_updates")),
+    compute=_avg_acc_compute, grad=None,
+)

@@ -108,7 +108,10 @@ def _pool_compute_nd(nd):
                     ones, 0.0, lax.add, tuple(ks), tuple(strides),
                     pad_cfg[2:]
                 )
-                out = summed / cnt[None, None]
+                # ceil_mode can create windows lying wholly in the extension
+                # padding (cnt == 0); the reference clamps window extents so
+                # the divisor is always >= 1 (math/pooling.cc).
+                out = summed / jnp.maximum(cnt, 1.0)[None, None]
             else:
                 out = summed / float(int(np.prod(ks)))
         return {"Out": out}
@@ -123,55 +126,60 @@ register_op("pool3d", ["X"], ["Out"],
 
 # -- pool2d with argmax index (pool_with_index_op.cc) -----------------------
 
-def _pool_idx_infer(op, block):
-    x = in_var(op, block, "X")
-    nd = 2
-    ks = int_list(op.attrs.get("ksize"), nd)
-    if op.attrs.get("global_pooling", False):
-        spatial = [1] * nd
-    else:
-        strides = int_list(op.attrs.get("strides", 1), nd)
-        pads = int_list(op.attrs.get("paddings", 0), nd)
-        spatial = [
-            _pool_out_dim(x.shape[2 + i], ks[i], pads[i], strides[i], False)
-            for i in range(nd)
-        ]
-    shape = tuple(x.shape[:2]) + tuple(spatial)
-    set_output(op, block, "Out", shape, x.dtype)
-    set_output(op, block, "Mask", shape, "int32")
+def _pool_idx_infer_nd(nd):
+    def infer(op, block):
+        x = in_var(op, block, "X")
+        ks = int_list(op.attrs.get("ksize"), nd)
+        if op.attrs.get("global_pooling", False):
+            spatial = [1] * nd
+        else:
+            strides = int_list(op.attrs.get("strides", 1), nd)
+            pads = int_list(op.attrs.get("paddings", 0), nd)
+            spatial = [
+                _pool_out_dim(x.shape[2 + i], ks[i], pads[i], strides[i],
+                              False)
+                for i in range(nd)
+            ]
+        shape = tuple(x.shape[:2]) + tuple(spatial)
+        set_output(op, block, "Out", shape, x.dtype)
+        set_output(op, block, "Mask", shape, "int32")
+    return infer
 
 
-def _pool_idx_compute(ins, attrs, ctx, op_index):
-    x = ins["X"][0]
-    nd = 2
-    ks = int_list(attrs.get("ksize"), nd)
-    if attrs.get("global_pooling", False):
-        ks = list(x.shape[2:])
-        strides, pads = ks, [0, 0]
-    else:
-        strides = int_list(attrs.get("strides", 1), nd)
-        pads = int_list(attrs.get("paddings", 0), nd)
-    n, c, h, w = x.shape
-    # index map of flattened H*W positions, padded with -1
-    flat_idx = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
-    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
-    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
-        else jnp.iinfo(x.dtype).min
-    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
-    window = (1, 1) + tuple(ks)
-    stride = (1, 1) + tuple(strides)
+def _pool_idx_compute_nd(nd):
+    def compute(ins, attrs, ctx, op_index):
+        x = ins["X"][0]
+        ks = int_list(attrs.get("ksize"), nd)
+        if attrs.get("global_pooling", False):
+            ks = list(x.shape[2:])
+            strides, pads = ks, [0] * nd
+        else:
+            strides = int_list(attrs.get("strides", 1), nd)
+            pads = int_list(attrs.get("paddings", 0), nd)
+        spatial = x.shape[2:]
+        # index map of flattened spatial positions, padded with -1
+        flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.int32).reshape(
+            (1, 1) + tuple(spatial))
+        flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+        window = (1, 1) + tuple(ks)
+        stride = (1, 1) + tuple(strides)
 
-    def reducer(a, b):
-        av, ai = a
-        bv, bi = b
-        take_b = bv > av
-        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+        def reducer(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
 
-    out, mask = lax.reduce_window(
-        (x, flat_idx), (jnp.asarray(neg, x.dtype), jnp.asarray(-1, jnp.int32)),
-        reducer, window, stride, pad_cfg,
-    )
-    return {"Out": out, "Mask": mask}
+        out, mask = lax.reduce_window(
+            (x, flat_idx),
+            (jnp.asarray(neg, x.dtype), jnp.asarray(-1, jnp.int32)),
+            reducer, window, stride, pad_cfg,
+        )
+        return {"Out": out, "Mask": mask}
+    return compute
 
 
 def _pool_idx_grad(op, no_grad_set):
@@ -195,8 +203,8 @@ def _pool_idx_grad_infer(gop, block):
 
 def _pool_idx_grad_compute(ins, attrs, ctx, op_index):
     x, mask, og = ins["X"][0], ins["Mask"][0], ins["GRAD::Out"][0]
-    n, c, h, w = x.shape
-    flat = jnp.zeros((n, c, h * w), x.dtype)
+    n, c = x.shape[:2]
+    flat = jnp.zeros((n, c, int(np.prod(x.shape[2:]))), x.dtype)
     m = mask.reshape(n, c, -1)
     g = og.reshape(n, c, -1)
     valid = m >= 0
@@ -209,7 +217,10 @@ def _pool_idx_grad_compute(ins, attrs, ctx, op_index):
 
 
 register_op("max_pool2d_with_index", ["X"], ["Out", "Mask"],
-            infer=_pool_idx_infer, compute=_pool_idx_compute,
+            infer=_pool_idx_infer_nd(2), compute=_pool_idx_compute_nd(2),
+            grad=_pool_idx_grad)
+register_op("max_pool3d_with_index", ["X"], ["Out", "Mask"],
+            infer=_pool_idx_infer_nd(3), compute=_pool_idx_compute_nd(3),
             grad=_pool_idx_grad)
 register_op("max_pool_with_index_grad", ["X", "Mask", "GRAD::Out"],
             ["GRAD::X"], infer=_pool_idx_grad_infer,

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 
 from ..framework import grad_var_name
 from ..registry import register_op, set_output, in_var
+from ..core import long_dtype
 
 
 def _dropout_infer(op, block):
@@ -77,7 +78,7 @@ def _sampling_id_compute(ins, attrs, ctx, op_index):
     x = ins["X"][0]  # [batch, n] probabilities
     key = ctx.rng_key(op_index)
     ids = jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)), axis=-1)
-    return {"Out": ids.astype(jnp.int64)}
+    return {"Out": ids.astype(long_dtype())}
 
 
 register_op(
@@ -85,4 +86,49 @@ register_op(
     infer=lambda op, block: set_output(
         op, block, "Out", (in_var(op, block, "X").shape[0],), "int64"),
     compute=_sampling_id_compute, grad=None, stateful_random=True,
+)
+
+
+# -- random_crop (reference random_crop_op.cc) ------------------------------
+# Per-instance uniform crop offsets.  The reference threads an explicit
+# Seed->SeedOut chain; here randomness comes from the executor's counter
+# PRNG (deterministic per step), and SeedOut echoes Seed for API parity.
+
+def _random_crop_infer(op, block):
+    x = in_var(op, block, "X")
+    shape = tuple(op.attrs["shape"])
+    out = tuple(x.shape[:len(x.shape) - len(shape)]) + shape
+    set_output(op, block, "Out", out, x.dtype)
+    seed = in_var(op, block, "Seed")
+    if seed is not None:
+        set_output(op, block, "SeedOut", seed.shape, seed.dtype)
+
+
+def _random_crop_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    crop = tuple(attrs["shape"])
+    batch_dims = x.ndim - len(crop)
+    key = ctx.rng_key(op_index)
+
+    def crop_one(inst, k):
+        maxs = jnp.asarray([inst.shape[i] - crop[i]
+                            for i in range(len(crop))])
+        offs = jax.random.randint(k, (len(crop),), 0, maxs + 1)
+        return jax.lax.dynamic_slice(inst, offs, crop)
+
+    flat = x.reshape((-1,) + x.shape[batch_dims:])
+    keys = jax.random.split(key, flat.shape[0])
+    out = jax.vmap(crop_one)(flat, keys)
+    out = out.reshape(x.shape[:batch_dims] + crop)
+    res = {"Out": out}
+    seed = ins.get("Seed")
+    if seed and seed[0] is not None:
+        res["SeedOut"] = seed[0]
+    return res
+
+
+register_op(
+    "random_crop", ["X", "Seed"], ["Out", "SeedOut"],
+    infer=_random_crop_infer, compute=_random_crop_compute,
+    grad=None, stateful_random=True, no_grad_inputs=("Seed",),
 )

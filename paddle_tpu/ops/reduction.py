@@ -11,6 +11,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..registry import register_op, set_output, in_var
+from ..core import long_dtype
 
 
 def _reduce_infer(op, block):
@@ -66,7 +67,7 @@ def _make_arg(name, fn):
     register_op(
         name, ["X"], ["Out"], infer=_arg_infer,
         compute=lambda ins, attrs, ctx, op_index: {
-            "Out": fn(ins["X"][0], axis=attrs.get("axis", 0)).astype(jnp.int64)
+            "Out": fn(ins["X"][0], axis=attrs.get("axis", 0)).astype(long_dtype())
         },
         grad=None,
     )

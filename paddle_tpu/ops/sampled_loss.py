@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..registry import register_op, set_output, in_var
+from ..core import long_dtype
 
 __all__ = []
 
@@ -71,7 +72,7 @@ def _nce_compute(ins, attrs, ctx, op_index):
     if sample_weight is not None:
         cost = cost * sample_weight.reshape(-1)
     return {"Cost": cost[:, None], "SampleLogits": o,
-            "SampleLabels": samples.astype(jnp.int64)}
+            "SampleLabels": samples.astype(long_dtype())}
 
 
 register_op(

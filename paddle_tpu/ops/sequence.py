@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..registry import register_op, set_output, in_var
+from ..core import long_dtype
 
 __all__ = []
 
@@ -491,7 +492,7 @@ def _seq_pad_compute(ins, attrs, ctx, op_index):
         x = x[:, :target]
     mask = _time_mask(length, target, x.ndim - 2)
     out = jnp.where(mask, x, jnp.asarray(pad_value, x.dtype))
-    return {"Out": out, "SeqLength": length.astype(jnp.int64)}
+    return {"Out": out, "SeqLength": length.astype(long_dtype())}
 
 
 register_op("sequence_pad", ["X", "Length", "PadValue"],
@@ -656,3 +657,42 @@ def _im2sequence_compute(ins, attrs, ctx, op_index):
 
 register_op("im2sequence", ["X"], ["Out", "OutLength"],
             infer=_im2sequence_infer, compute=_im2sequence_compute)
+
+
+# -- lod_reset (reference lod_reset_op.cc) ----------------------------------
+# In the padded-batch representation "resetting the LoD" keeps the data and
+# replaces the length companion: the target level-0 offsets (from Y's data
+# or attr target_lod) become a fresh [B] length vector.
+
+def _lod_reset_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype, lod_level=1)
+    set_output(op, block, "Length", (x.shape[0],), "int64")
+
+
+def _lod_reset_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    y = ins.get("Y")
+    if y and y[0] is not None:
+        offsets = y[0].reshape(-1)
+        lengths = (offsets[1:] - offsets[:-1]).astype(long_dtype())
+    else:
+        tl = attrs.get("target_lod")
+        if not tl:
+            raise ValueError(
+                "lod_reset needs input Y or attr target_lod "
+                "(lod_reset_op.cc contract)")
+        lengths = jnp.asarray(
+            [tl[i + 1] - tl[i] for i in range(len(tl) - 1)],
+            dtype=long_dtype())
+    if lengths.shape[0] != x.shape[0]:
+        raise ValueError(
+            "lod_reset: %d target sequences but the padded batch has %d "
+            "rows; the padded representation cannot change the sequence "
+            "count" % (lengths.shape[0], x.shape[0]))
+    return {"Out": x, "Length": lengths}
+
+
+register_op("lod_reset", ["X", "Y"], ["Out", "Length"],
+            infer=_lod_reset_infer, compute=_lod_reset_compute,
+            no_grad_inputs=("Y",))

@@ -500,8 +500,13 @@ class FtrlOptimizer(Optimizer):
 
 
 class ModelAverage(Optimizer):
-    """Running parameter average for eval (reference optimizer.py:1209) —
-    maintains sum accumulators and provides apply/restore context."""
+    """Running parameter average for eval (reference optimizer.py:1209),
+    driven by the ``average_accumulates`` op (average_accumulates_op.h):
+    three staggered sum buffers (precision-guarded roll every 16384
+    updates) plus a restartable trailing window, exactly the reference's
+    accumulator protocol.  ``apply()`` swaps
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates) into the
+    scope."""
 
     def __init__(self, average_window_rate=0.15, min_average_window=10000,
                  max_average_window=10000, **kwargs):
@@ -517,15 +522,33 @@ class ModelAverage(Optimizer):
         for p in block.all_parameters():
             if p.name in self._avg_sums:
                 continue
-            self._avg_sums[p.name] = (
-                self._add_accumulator("sum", p),
-                self._add_accumulator("count", p, shape=[1]),
+            sums = (self._add_accumulator("sum_1", p),
+                    self._add_accumulator("sum_2", p),
+                    self._add_accumulator("sum_3", p))
+            counts = (
+                self._add_accumulator("num_accumulates", p, shape=[1],
+                                      dtype="int64"),
+                self._add_accumulator("old_num_accumulates", p, shape=[1],
+                                      dtype="int64"),
+                self._add_accumulator("num_updates", p, shape=[1],
+                                      dtype="int64"),
             )
-            s, c = self._avg_sums[p.name]
-            block.append_op(type="sum", inputs={"X": [s, p]},
-                            outputs={"Out": [s]})
-            block.append_op(type="increment", inputs={"X": [c]},
-                            outputs={"Out": [c]}, attrs={"step": 1.0})
+            self._avg_sums[p.name] = sums + counts
+            s1, s2, s3, na, ona, nu = self._avg_sums[p.name]
+            block.append_op(
+                type="average_accumulates",
+                inputs={"param": [p], "in_sum_1": [s1], "in_sum_2": [s2],
+                        "in_sum_3": [s3], "in_num_accumulates": [na],
+                        "in_old_num_accumulates": [ona],
+                        "in_num_updates": [nu]},
+                outputs={"out_sum_1": [s1], "out_sum_2": [s2],
+                         "out_sum_3": [s3], "out_num_accumulates": [na],
+                         "out_old_num_accumulates": [ona],
+                         "out_num_updates": [nu]},
+                attrs={"average_window": self.average_window,
+                       "min_average_window": self.min_average_window,
+                       "max_average_window": self.max_average_window},
+            )
 
     def apply(self, executor, scope=None):
         """Swap averaged params into the scope (context manager)."""
@@ -540,11 +563,15 @@ class ModelAverage(Optimizer):
         @contextlib.contextmanager
         def _ctx():
             saved = {}
-            for name, (s, c) in self._avg_sums.items():
+            for name, accs in self._avg_sums.items():
+                s1, s2, s3, na, ona, _ = accs
                 saved[name] = scope.var(name)
-                total = np.asarray(scope.var(s.name))
-                cnt = float(np.asarray(scope.var(c.name))[0]) or 1.0
-                scope.set_var(name, total / cnt)
+                total = (np.asarray(scope.var(s1.name))
+                         + np.asarray(scope.var(s2.name))
+                         + np.asarray(scope.var(s3.name)))
+                cnt = float(np.asarray(scope.var(na.name))[0]
+                            + np.asarray(scope.var(ona.name))[0]) or 1.0
+                scope.set_var(name, (total / cnt).astype(total.dtype))
             try:
                 yield
             finally:

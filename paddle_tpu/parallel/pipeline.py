@@ -98,6 +98,16 @@ def pipeline(stage_fn, stage_params, x, mesh, axis=AXIS_PP,
         raise ValueError(
             "microbatches (%d) must divide the batch (%d)"
             % (microbatches, x.shape[0]))
+    mb_shape = (x.shape[0] // microbatches,) + tuple(x.shape[1:])
+    stage0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+    out_shape = jax.eval_shape(
+        stage_fn, stage0, jax.ShapeDtypeStruct(mb_shape, x.dtype)).shape
+    if tuple(out_shape) != mb_shape:
+        raise ValueError(
+            "stage_fn must preserve the activation shape so microbatches "
+            "can flow stage-to-stage: input %s -> output %s. Reshape "
+            "inside the stage (or use heterogeneous stages via "
+            "program_pipeline)" % (mb_shape, tuple(out_shape)))
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis), stage_params)
     # replicate x; stage params shard their leading stage dim over pp

@@ -72,8 +72,13 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None):
             mask = q_pos[:, None] >= k_pos[None, :]
             s = jnp.where(mask[None, None], s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # guard fully-masked rows: exp(-inf - -inf) -> use finite floor
         p = jnp.exp(s - m_new)
+        if causal:
+            # a fully-masked row keeps m_new == _NEG_INF, so exp(s - m_new)
+            # is 1.0 per masked key; zero them explicitly rather than rely
+            # on the diagonal block (tq == tk at step 0) being seen first —
+            # ring_attention guarantees that, standalone shard use may not
+            p = jnp.where(mask[None, None], p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk,

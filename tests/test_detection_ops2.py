@@ -247,3 +247,55 @@ def test_rpn_target_assign_unbatched_gt():
     labels, tgt, w = _run(build, {"a": anchors, "g": gt2d})
     assert labels.shape == (1, 2)
     assert labels[0, 0] == 1 and labels[0, 1] == 0
+
+
+def test_mine_hard_examples_hard_example_mode():
+    """hard_example mode (mine_hard_examples_op.cc kHardExample): every
+    prior competes on cls+loc loss, top sample_size survive; unmined
+    matched priors lose their match, mined unmatched become negatives."""
+    cls_loss = np.array([[0.1, 0.9, 0.5, 2.0, 0.7]], "float32")
+    loc_loss = np.array([[0.0, 0.0, 1.6, 0.0, 0.0]], "float32")
+    match = np.array([[0, -1, -1, 1, -1]], "int32")
+    mdist = np.zeros((1, 5), "float32")
+
+    def build():
+        cl = fluid.layers.data("cl", shape=[5], append_batch_size=False)
+        cl.shape = (-1, 5)
+        ll = fluid.layers.data("ll", shape=[5], append_batch_size=False)
+        ll.shape = (-1, 5)
+        m = fluid.layers.data("m", shape=[5], dtype="int32",
+                              append_batch_size=False)
+        m.shape = (-1, 5)
+        d = fluid.layers.data("d", shape=[5], append_batch_size=False)
+        d.shape = (-1, 5)
+        neg, updated = fluid.layers.mine_hard_examples(
+            cl, m, d, loc_loss=ll, mining_type="hard_example",
+            sample_size=2)
+        return neg, updated
+
+    neg, updated = _run(build, {"cl": cls_loss, "ll": loc_loss,
+                                "m": match, "d": mdist})
+    # combined loss: [0.1, 0.9, 2.1, 2.0, 0.7] -> top-2 = priors 2, 3
+    # prior 3 is matched (kept); prior 2 unmatched -> negative;
+    # prior 0 matched but unmined -> match dropped to -1
+    assert neg[0, 0] == 2 and (neg[0, 1:] == -1).all()
+    np.testing.assert_array_equal(updated[0], [-1, -1, -1, 1, -1])
+
+
+def test_adaptive_nms_eta():
+    """eta < 1 decays the NMS threshold after each kept box
+    (multiclass_nms_op.cc NMSFast adaptive_threshold)."""
+    # three boxes in score order with IoU(0,1) ~ 0.55, IoU(1,2) ~ 0.55:
+    # plain nms_thresh=0.6 keeps all three; eta=0.7 decays the threshold
+    # to 0.42 after the first keep, suppressing the later overlaps
+    from paddle_tpu.ops.detection import _nms_class
+    import jax.numpy as jnp
+    boxes = jnp.asarray([[0.0, 0.0, 10.0, 10.0],
+                         [3.0, 0.0, 13.0, 10.0],
+                         [6.0, 0.0, 16.0, 10.0]])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    keep_plain = np.asarray(_nms_class(boxes, scores, 0.0, 0.6, -1, True))
+    keep_adapt = np.asarray(_nms_class(boxes, scores, 0.0, 0.6, -1, True,
+                                       eta=0.7))
+    assert keep_plain.tolist() == [True, True, True]
+    assert keep_adapt.tolist() == [True, False, True]

@@ -1,0 +1,586 @@
+"""OpTest coverage for the round-3 op-gap closure: crop, pad2d,
+pad_constant_like, random_crop, unstack, lod_reset, is_empty,
+modified_huber_loss, conv3d_transpose, depthwise_conv2d_transpose,
+max_pool3d_with_index, positive_negative_pair, average_accumulates,
+uniform/gaussian_random_batch_size_like, print, fill.
+
+Reference oracles follow the corresponding ``paddle/fluid/operators/*.cc``
+kernels (cited per test).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import OpTest
+
+
+# -- crop (crop_op.cc) ------------------------------------------------------
+
+class TestCropAttr(OpTest):
+    op_type = "crop"
+
+    def setup(self):
+        x = np.random.RandomState(0).rand(4, 5, 6).astype("float32")
+        offs, shp = [1, 0, 2], [2, 4, 3]
+        self.inputs = {"X": x}
+        self.attrs = {"offsets": offs, "shape": shp}
+        self.outputs = {"Out": x[1:3, 0:4, 2:5]}
+
+    def test_forward(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["crop__X"], "crop__Out")
+
+
+def test_crop_runtime_offsets():
+    """crop with the runtime Offsets input (crop_op.cc case 1)."""
+    x = np.arange(24, dtype="float32").reshape(4, 6)
+    offs = np.array([1, 2], dtype="int32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", shape=[4, 6], append_batch_size=False)
+        ov = fluid.layers.data("offs", shape=[2], dtype="int32",
+                               append_batch_size=False)
+        out = fluid.layers.crop(xv, shape=[2, 3], offsets=ov)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={"x": x, "offs": offs},
+                     fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(got), x[1:3, 2:5])
+
+
+def test_crop_batch_dim_minus_one():
+    """crop with a -1 (batch) dim takes the rest of the dim from the
+    offset — the common layers.data(-1 batch) pattern."""
+    x = np.random.rand(5, 6, 6).astype("float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", shape=[6, 6])
+        out = fluid.layers.crop(xv, shape=[-1, 4, 4], offsets=[0, 1, 1])
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={"x": x}, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(got), x[:, 1:5, 1:5])
+
+
+def test_crop_shape_from_y():
+    x = np.random.rand(5, 5).astype("float32")
+    y = np.zeros((3, 2), "float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", shape=[5, 5], append_batch_size=False)
+        yv = fluid.layers.data("y", shape=[3, 2], append_batch_size=False)
+        out = fluid.layers.crop(xv, shape=yv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={"x": x, "y": y}, fetch_list=[out.name])
+    np.testing.assert_allclose(np.asarray(got), x[:3, :2])
+
+
+# -- pad2d (pad2d_op.cc) ----------------------------------------------------
+
+class TestPad2dConstant(OpTest):
+    op_type = "pad2d"
+
+    def setup(self, mode="constant", fmt="NCHW"):
+        x = np.random.RandomState(1).rand(2, 3, 4, 5).astype("float32")
+        p = [1, 2, 0, 3]  # top, bottom, left, right
+        np_mode = {"constant": "constant", "reflect": "reflect",
+                   "edge": "edge"}[mode]
+        pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])] if fmt == "NCHW" \
+            else [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+        kw = {"constant_values": 0.25} if mode == "constant" else {}
+        self.inputs = {"X": x}
+        self.attrs = {"paddings": p, "mode": mode, "pad_value": 0.25,
+                      "data_format": fmt}
+        self.outputs = {"Out": np.pad(x, pads, mode=np_mode, **kw)}
+
+    @pytest.mark.parametrize("mode", ["constant", "reflect", "edge"])
+    def test_forward(self, mode):
+        self.setup(mode)
+        self.check_output()
+
+    def test_nhwc(self):
+        self.setup("constant", fmt="NHWC")
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["pad2d__X"], "pad2d__Out")
+
+
+# -- pad_constant_like (pad_constant_like_op.cc) ----------------------------
+
+class TestPadConstantLike(OpTest):
+    op_type = "pad_constant_like"
+
+    def setup(self):
+        x = np.zeros((4, 3, 5), "float32")
+        y = np.random.RandomState(2).rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"pad_value": 1.5}
+        self.outputs = {
+            "Out": np.pad(y, [(0, 2), (0, 0), (0, 1)],
+                          constant_values=1.5)}
+
+    def test_forward(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["pad_constant_like__Y"], "pad_constant_like__Out",
+                        no_grad_set={"pad_constant_like__X"})
+
+
+# -- unstack (unstack_op.h) -------------------------------------------------
+
+class TestUnstack(OpTest):
+    op_type = "unstack"
+
+    def setup(self, axis=1):
+        x = np.random.RandomState(3).rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": axis, "num": x.shape[axis]}
+        self.outputs = {"Y": [
+            ("y%d" % i, np.squeeze(a, axis))
+            for i, a in enumerate(np.split(x, x.shape[axis], axis))]}
+
+    @pytest.mark.parametrize("axis", [0, 1, -1])
+    def test_forward(self, axis):
+        self.setup(axis)
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["unstack__X"], "y1")
+
+
+# -- is_empty (is_empty_op.cc) ----------------------------------------------
+
+def test_is_empty():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=[3, 2], append_batch_size=False)
+        e = fluid.layers.data("e", shape=[0, 2], append_batch_size=False)
+        c1 = fluid.layers.is_empty(x)
+        c2 = fluid.layers.is_empty(e)
+    exe = fluid.Executor(fluid.CPUPlace())
+    r1, r2 = exe.run(prog, feed={"x": np.ones((3, 2), "float32"),
+                                 "e": np.ones((0, 2), "float32")},
+                     fetch_list=[c1.name, c2.name])
+    assert not bool(np.asarray(r1)[0])
+    assert bool(np.asarray(r2)[0])
+
+
+# -- fill (fill_op.cc) ------------------------------------------------------
+
+def test_fill_op():
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        block = prog.global_block()
+        block.append_op(type="fill", outputs={"Out": ["filled"]},
+                        attrs={"shape": [2, 3], "dtype": "float32",
+                               "value": [1, 2, 3, 4, 5, 6]})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={}, fetch_list=["filled"])
+    np.testing.assert_allclose(
+        np.asarray(got), np.arange(1, 7, dtype="float32").reshape(2, 3))
+
+
+# -- modified_huber_loss (modified_huber_loss_op.h) -------------------------
+
+def _mhl_oracle(x, y):
+    inter = x * (2 * y - 1)
+    return np.where(inter < -1, -4 * inter,
+                    np.where(inter < 1, (1 - inter) ** 2, 0.0))
+
+
+class TestModifiedHuberLoss(OpTest):
+    op_type = "modified_huber_loss"
+
+    def setup(self):
+        rs = np.random.RandomState(4)
+        # keep x*y' away from the +-1 kinks so numeric grads are clean
+        x = rs.uniform(-2.0, 2.0, (8, 1)).astype("float32")
+        x[np.abs(np.abs(x) - 1.0) < 0.15] = 0.5
+        y = (rs.rand(8, 1) > 0.5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {
+            "IntermediateVal": x * (2 * y - 1),
+            "Out": _mhl_oracle(x, y).astype("float32")}
+
+    def test_forward(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["modified_huber_loss__X"],
+                        "modified_huber_loss__Out")
+
+
+# -- conv transpose 3d / depthwise (conv_transpose_op.cc:303,335) -----------
+
+def _convt_oracle(x, w, strides, pads, dils):
+    """Scatter-style transposed-conv oracle, any spatial rank."""
+    n, cin = x.shape[:2]
+    cout = w.shape[1]
+    nd = x.ndim - 2
+    out_sp = [(x.shape[2 + i] - 1) * strides[i] - 2 * pads[i]
+              + dils[i] * (w.shape[2 + i] - 1) + 1 for i in range(nd)]
+    full = [out_sp[i] + 2 * pads[i] for i in range(nd)]
+    out = np.zeros([n, cout] + full, dtype=np.float64)
+    for b in range(n):
+        for ci in range(cin):
+            for co in range(cout):
+                for in_idx in np.ndindex(*x.shape[2:]):
+                    for k_idx in np.ndindex(*w.shape[2:]):
+                        pos = tuple(in_idx[i] * strides[i]
+                                    + dils[i] * k_idx[i]
+                                    for i in range(nd))
+                        out[(b, co) + pos] += \
+                            x[(b, ci) + in_idx] * w[(ci, co) + k_idx]
+    slc = tuple(slice(pads[i], pads[i] + out_sp[i]) for i in range(nd))
+    return out[(slice(None), slice(None)) + slc].astype("float32")
+
+
+class TestConv3dTranspose(OpTest):
+    op_type = "conv3d_transpose"
+
+    def setup(self):
+        rs = np.random.RandomState(5)
+        x = rs.rand(1, 2, 3, 3, 2).astype("float32")
+        w = rs.rand(2, 2, 2, 2, 2).astype("float32")
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 1, 1], "paddings": [1, 0, 1],
+                      "dilations": [1, 1, 1]}
+        self.outputs = {"Output": _convt_oracle(
+            x, w, [2, 1, 1], [1, 0, 1], [1, 1, 1])}
+
+    def test_forward(self):
+        self.setup()
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["conv3d_transpose__Input",
+                         "conv3d_transpose__Filter"],
+                        "conv3d_transpose__Output",
+                        max_relative_error=0.02, delta=1e-2)
+
+
+class TestDepthwiseConv2dTranspose(OpTest):
+    op_type = "depthwise_conv2d_transpose"
+
+    def setup(self):
+        rs = np.random.RandomState(6)
+        c = 3
+        x = rs.rand(2, c, 4, 4).astype("float32")
+        w = rs.rand(c, 1, 3, 3).astype("float32")
+        # groups == channels: each channel transposed independently
+        per = [_convt_oracle(x[:, i:i + 1], w[i:i + 1], [2, 2], [1, 1],
+                             [1, 1]) for i in range(c)]
+        self.inputs = {"Input": x, "Filter": w}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": c}
+        self.outputs = {"Output": np.concatenate(per, axis=1)}
+
+    def test_forward(self):
+        self.setup()
+        self.check_output(atol=1e-4)
+
+
+# -- max_pool3d_with_index (pool_with_index_op.cc) --------------------------
+
+class TestMaxPool3dWithIndex(OpTest):
+    op_type = "max_pool3d_with_index"
+
+    def setup(self):
+        rs = np.random.RandomState(7)
+        # well-separated values so delta-perturbation never flips an argmax
+        x = (rs.permutation(2 * 2 * 4 * 4 * 4).astype("float32") * 0.1) \
+            .reshape(2, 2, 4, 4, 4)
+        ks, st = [2, 2, 2], [2, 2, 2]
+        n, c, d, h, w = x.shape
+        od, oh, ow = d // 2, h // 2, w // 2
+        out = np.zeros((n, c, od, oh, ow), "float32")
+        mask = np.zeros((n, c, od, oh, ow), "int32")
+        for idx in np.ndindex(n, c, od, oh, ow):
+            b, ch, i, j, k = idx
+            win = x[b, ch, 2 * i:2 * i + 2, 2 * j:2 * j + 2,
+                    2 * k:2 * k + 2]
+            out[idx] = win.max()
+            loc = np.unravel_index(win.argmax(), win.shape)
+            mask[idx] = ((2 * i + loc[0]) * h + 2 * j + loc[1]) * w \
+                + 2 * k + loc[2]
+        self.inputs = {"X": x}
+        self.attrs = {"ksize": ks, "strides": st, "paddings": [0, 0, 0]}
+        self.outputs = {"Out": out, "Mask": mask}
+
+    def test_forward(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["max_pool3d_with_index__X"],
+                        "max_pool3d_with_index__Out",
+                        max_relative_error=0.02, delta=1e-3)
+
+
+# -- positive_negative_pair (positive_negative_pair_op.h) -------------------
+
+def _pnp_oracle(score, label, query, weight=None, col=0):
+    s = score[:, col]
+    lbl, q = label.reshape(-1), query.reshape(-1)
+    w = weight.reshape(-1) if weight is not None else np.ones_like(s)
+    pos = neg = neu = 0.0
+    for i in range(len(s)):
+        for j in range(i + 1, len(s)):
+            if q[i] != q[j] or lbl[i] == lbl[j]:
+                continue
+            pw = 0.5 * (w[i] + w[j])
+            if s[i] == s[j]:
+                neu += pw
+            if (s[i] - s[j]) * (lbl[i] - lbl[j]) > 0:
+                pos += pw
+            else:
+                neg += pw
+    return pos, neg, neu
+
+
+class TestPositiveNegativePair(OpTest):
+    op_type = "positive_negative_pair"
+
+    def setup(self, with_weight=False):
+        rs = np.random.RandomState(8)
+        n = 12
+        score = rs.rand(n, 3).astype("float32")
+        label = rs.randint(0, 3, (n, 1)).astype("float32")
+        query = rs.randint(0, 3, (n, 1)).astype("int32")
+        weight = rs.rand(n, 1).astype("float32") if with_weight else None
+        pos, neg, neu = _pnp_oracle(score, label, query, weight, col=1)
+        self.inputs = {"Score": score, "Label": label, "QueryID": query}
+        if with_weight:
+            self.inputs["Weight"] = weight
+        self.attrs = {"column": 1}
+        self.outputs = {"PositivePair": np.array([pos], "float32"),
+                        "NegativePair": np.array([neg], "float32"),
+                        "NeutralPair": np.array([neu], "float32")}
+
+    @pytest.mark.parametrize("with_weight", [False, True])
+    def test_forward(self, with_weight):
+        self.setup(with_weight)
+        self.check_output()
+
+    def test_tied_scores(self):
+        """A tied pair is neutral AND negative — the reference kernel's
+        if-without-elif falls through the ternary into neg
+        (positive_negative_pair_op.h)."""
+        self.op_type = "positive_negative_pair"
+        self.inputs = {
+            "Score": np.array([[0.5], [0.5]], "float32"),
+            "Label": np.array([[1.0], [0.0]], "float32"),
+            "QueryID": np.array([[7], [7]], "int32")}
+        self.attrs = {"column": 0}
+        self.outputs = {"PositivePair": np.array([0.0], "float32"),
+                        "NegativePair": np.array([1.0], "float32"),
+                        "NeutralPair": np.array([1.0], "float32")}
+        self.check_output()
+
+    def test_accumulate(self):
+        self.setup()
+        self.inputs["AccumulatePositivePair"] = np.array([10.0], "float32")
+        self.inputs["AccumulateNegativePair"] = np.array([20.0], "float32")
+        self.inputs["AccumulateNeutralPair"] = np.array([30.0], "float32")
+        self.outputs = {
+            "PositivePair": self.outputs["PositivePair"] + 10.0,
+            "NegativePair": self.outputs["NegativePair"] + 20.0,
+            "NeutralPair": self.outputs["NeutralPair"] + 30.0}
+        self.check_output()
+
+
+# -- average_accumulates (average_accumulates_op.h) + ModelAverage ----------
+
+def test_average_accumulates_window_restart():
+    """Window restarts once num_accumulates reaches
+    min(max_average_window, num_updates*average_window) and >= min_w."""
+    param = np.full((3,), 2.0, "float32")
+    s1 = np.ones((3,), "float32")
+    s2 = np.zeros((3,), "float32")
+    s3 = np.zeros((3,), "float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        block = prog.global_block()
+        names = {}
+        for nm, arr in [("param", param), ("s1", s1), ("s2", s2),
+                        ("s3", s3)]:
+            block.create_var(name=nm, shape=arr.shape, dtype=arr.dtype,
+                             is_data=True)
+            names[nm] = arr
+        for nm in ("na", "ona", "nu"):
+            block.create_var(name=nm, shape=(1,), dtype="int64",
+                             is_data=True)
+        block.append_op(
+            type="average_accumulates",
+            inputs={"param": ["param"], "in_sum_1": ["s1"],
+                    "in_sum_2": ["s2"], "in_sum_3": ["s3"],
+                    "in_num_accumulates": ["na"],
+                    "in_old_num_accumulates": ["ona"],
+                    "in_num_updates": ["nu"]},
+            outputs={"out_sum_1": ["o1"], "out_sum_2": ["o2"],
+                     "out_sum_3": ["o3"], "out_num_accumulates": ["ona2"],
+                     "out_old_num_accumulates": ["oona"],
+                     "out_num_updates": ["onu"]},
+            attrs={"average_window": 1.0, "min_average_window": 2,
+                   "max_average_window": 2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = dict(names, na=np.array([1], "int64"),
+                ona=np.array([0], "int64"), nu=np.array([5], "int64"))
+    o1, o2, o3, na2, oona, onu = exe.run(
+        prog, feed=feed,
+        fetch_list=["o1", "o2", "o3", "ona2", "oona", "onu"])
+    # num_acc 1->2 hits the window (min_w=2): restart with s3 = s1+s2
+    np.testing.assert_allclose(np.asarray(o3), s1 + s2)
+    np.testing.assert_allclose(np.asarray(o1), 0.0)
+    np.testing.assert_allclose(np.asarray(o2), 0.0)
+    assert int(np.asarray(na2)[0]) == 0
+    assert int(np.asarray(oona)[0]) == 2
+    assert int(np.asarray(onu)[0]) == 6
+
+
+def test_model_average_apply():
+    """ModelAverage accumulates via average_accumulates and apply() swaps
+    the trailing mean in (reference optimizer.py:1209)."""
+    import paddle_tpu.optimizer as opt
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=1, bias_attr=False)
+        loss = fluid.layers.mean(y)
+        sgd = opt.SGD(learning_rate=0.1)
+        sgd.minimize(loss)
+        ma = opt.ModelAverage(average_window_rate=1.0,
+                              min_average_window=10000,
+                              max_average_window=10000)
+        ma._ensure_accumulators(prog)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rs = np.random.RandomState(9)
+    params = []
+    pname = prog.global_block().all_parameters()[0].name
+    from paddle_tpu.scope import global_scope
+    for _ in range(4):
+        exe.run(prog, feed={"x": rs.rand(2, 4).astype("float32")},
+                fetch_list=[loss.name])
+        params.append(np.asarray(global_scope().var(pname)).copy())
+    expect = np.mean(params, axis=0)
+    with ma.apply(exe):
+        np.testing.assert_allclose(
+            np.asarray(global_scope().var(pname)), expect,
+            rtol=1e-5, atol=1e-6)
+    # restored after the context
+    np.testing.assert_allclose(
+        np.asarray(global_scope().var(pname)), params[-1])
+
+
+# -- batch_size_like randoms ------------------------------------------------
+
+@pytest.mark.parametrize("op", ["uniform_random_batch_size_like",
+                                "gaussian_random_batch_size_like"])
+def test_random_batch_size_like(op):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ref = fluid.layers.data("ref", shape=[7, 3],
+                                append_batch_size=False)
+        layer = getattr(fluid.layers, op)
+        out = layer(ref, shape=[-1, 5])
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={"ref": np.zeros((7, 3), "float32")},
+                     fetch_list=[out.name])
+    got = np.asarray(got)
+    assert got.shape == (7, 5)
+    if op.startswith("uniform"):
+        assert got.min() >= -1.0 and got.max() <= 1.0
+    assert got.std() > 0.05  # actually random
+
+
+# -- random_crop ------------------------------------------------------------
+
+def test_random_crop():
+    rs = np.random.RandomState(10)
+    x = rs.rand(6, 8, 8).astype("float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", shape=[6, 8, 8],
+                               append_batch_size=False)
+        out = fluid.layers.random_crop(xv, shape=[5, 5])
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={"x": x}, fetch_list=[out.name])
+    got = np.asarray(got)
+    assert got.shape == (6, 5, 5)
+    # every cropped instance must be a contiguous window of its source
+    for b in range(6):
+        found = any(
+            np.allclose(got[b], x[b, i:i + 5, j:j + 5])
+            for i in range(4) for j in range(4))
+        assert found, "instance %d is not a crop of its source" % b
+
+
+# -- lod_reset --------------------------------------------------------------
+
+def test_lod_reset_target_lod():
+    x = np.random.rand(3, 6, 2).astype("float32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", shape=[3, 6, 2],
+                               append_batch_size=False)
+        out = fluid.layers.lod_reset(xv, target_lod=[0, 2, 5, 6])
+        from paddle_tpu.layers.sequence import sequence_length
+        ln = sequence_length(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    got, lens = exe.run(prog, feed={"x": x},
+                        fetch_list=[out.name, ln.name])
+    np.testing.assert_allclose(np.asarray(got), x)
+    np.testing.assert_array_equal(np.asarray(lens), [2, 3, 1])
+
+
+def test_lod_reset_from_y():
+    x = np.random.rand(2, 4).astype("float32")
+    offsets = np.array([0, 3, 4], "int32")
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", shape=[2, 4], append_batch_size=False)
+        yv = fluid.layers.data("y", shape=[3], dtype="int32",
+                               append_batch_size=False)
+        out = fluid.layers.lod_reset(xv, y=yv)
+        from paddle_tpu.layers.sequence import sequence_length
+        ln = sequence_length(out)
+    exe = fluid.Executor(fluid.CPUPlace())
+    _, lens = exe.run(prog, feed={"x": x, "y": offsets},
+                      fetch_list=[out.name, ln.name])
+    np.testing.assert_array_equal(np.asarray(lens), [3, 1])
+
+
+# -- print ------------------------------------------------------------------
+
+def test_print_passthrough(capfd):
+    x = np.arange(4, dtype="float32").reshape(2, 2)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        xv = fluid.layers.data("x", shape=[2, 2], append_batch_size=False)
+        xv.stop_gradient = False
+        out = fluid.layers.Print(xv, message="dbg:")
+        loss = fluid.layers.mean(out)
+        fluid.append_backward(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    from paddle_tpu.framework import grad_var_name
+    got, g = exe.run(prog, feed={"x": x},
+                     fetch_list=[out.name, grad_var_name(xv.name)])
+    np.testing.assert_allclose(np.asarray(got), x)
+    np.testing.assert_allclose(np.asarray(g), np.full((2, 2), 0.25))
+    captured = capfd.readouterr()
+    assert "dbg:" in captured.out or "dbg:" in captured.err

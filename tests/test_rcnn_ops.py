@@ -1,0 +1,305 @@
+"""Faster-RCNN training ops: generate_proposal_labels +
+roi_perspective_transform (reference detection/generate_proposal_labels_op.cc
+and detection/roi_perspective_transform_op.cc).
+
+Oracles are direct numpy ports of the reference CPU kernels.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+# -- generate_proposal_labels ----------------------------------------------
+
+def _iou(a, b):
+    """Inclusive-pixel IoU (bbox_util.h BboxOverlaps)."""
+    aa = (a[2] - a[0] + 1) * (a[3] - a[1] + 1)
+    ab = (b[2] - b[0] + 1) * (b[3] - b[1] + 1)
+    iw = max(min(a[2], b[2]) - max(a[0], b[0]) + 1, 0)
+    ih = max(min(a[3], b[3]) - max(a[1], b[1]) + 1, 0)
+    inter = iw * ih
+    return inter / (aa + ab - inter) if aa + ab - inter > 0 else 0.0
+
+
+def _run_gpl(rois, gt_cls, crowd, gt, im_info, attrs, roi_len=None,
+             gt_len=None):
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        block = prog.global_block()
+        feeds = {"RpnRois": rois, "GtClasses": gt_cls, "IsCrowd": crowd,
+                 "GtBoxes": gt, "ImInfo": im_info}
+        if roi_len is not None:
+            feeds["RpnRoisLength"] = roi_len
+        if gt_len is not None:
+            feeds["GtLength"] = gt_len
+        for name, arr in feeds.items():
+            block.create_var(name=name, shape=arr.shape, dtype=arr.dtype,
+                             is_data=True)
+        ins = {k: [k] for k in feeds}
+        outs = ["Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+                "BboxOutsideWeights", "RoisNum"]
+        block.append_op(type="generate_proposal_labels", inputs=ins,
+                        outputs={k: [k] for k in outs}, attrs=attrs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    return [np.asarray(v) for v in
+            exe.run(prog, feed=feeds, fetch_list=outs)], outs
+
+
+def test_generate_proposal_labels_basic():
+    """gt boxes (prepended as proposals, IoU=1 with themselves) become fg
+    with zero deltas; a far-away roi becomes bg; crowd gt is excluded."""
+    gt = np.array([[[0, 0, 9, 9], [20, 20, 29, 29]]], "float32")
+    gt_cls = np.array([[3, 5]], "int32")
+    crowd = np.array([[0, 1]], "int32")           # second gt is crowd
+    rois = np.array([[[0, 0, 9, 9],               # dup of gt0 -> fg
+                      [40, 40, 49, 49],           # no overlap -> bg
+                      [41, 40, 50, 49]]], "float32")  # no overlap -> bg
+    im_info = np.array([[60, 60, 1.0]], "float32")
+    attrs = {"batch_size_per_im": 6, "fg_fraction": 0.5, "fg_thresh": 0.5,
+             "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+             "bbox_reg_weights": [1.0, 1.0, 1.0, 1.0], "class_nums": 8,
+             "use_random": False}
+    (rois_o, labels, tgts, inw, outw, num), _ = _run_gpl(
+        rois, gt_cls, crowd, gt, im_info, attrs)
+    n = int(num[0])
+    # fg: gt0-as-proposal and the duplicate roi (both IoU 1 with gt0);
+    # crowd gt1 is excluded from everything; 2 far rois are bg
+    labels0 = labels[0, :, 0]
+    fg_labels = labels0[:2]
+    assert sorted(fg_labels.tolist()) == [3, 3]
+    assert n == 4
+    assert (labels0[2:n] == 0).all()              # bg slots
+    assert (labels0[n:] == 0).all()               # padding
+    # fg rows matched to an identical gt: deltas are exactly zero but the
+    # inside weights are 1 at the label's 4 columns
+    for i in range(2):
+        lbl = labels0[i]
+        cols = slice(4 * lbl, 4 * lbl + 4)
+        np.testing.assert_allclose(tgts[0, i, cols], 0.0, atol=1e-5)
+        np.testing.assert_allclose(inw[0, i, cols], 1.0)
+        assert inw[0, i].sum() == 4.0             # only those columns
+    assert (inw[0, 2:] == 0).all()
+    np.testing.assert_allclose(outw, inw)
+
+
+def test_generate_proposal_labels_deltas_and_scale():
+    """A shifted fg proposal gets the BoxToDelta regression target divided
+    by bbox_reg_weights; rois are emitted back at im_scale."""
+    gt = np.array([[[10, 10, 29, 29]]], "float32")
+    gt_cls = np.array([[2]], "int32")
+    crowd = np.array([[0]], "int32")
+    # proposal at scale 2: after /scale it's [11,11,30,30] -> IoU ~0.8 fg
+    rois = np.array([[[22, 22, 60, 60]]], "float32")
+    im_info = np.array([[100, 100, 2.0]], "float32")
+    w = [10.0, 10.0, 5.0, 5.0]
+    attrs = {"batch_size_per_im": 4, "fg_fraction": 0.5, "fg_thresh": 0.5,
+             "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+             "bbox_reg_weights": w, "class_nums": 4, "use_random": False}
+    (rois_o, labels, tgts, inw, _, num), _ = _run_gpl(
+        rois, gt_cls, crowd, gt, im_info, attrs)
+    labels0 = labels[0, :, 0]
+    # slot 0 = gt-as-proposal (fg, label 2); slot 1 = the shifted roi
+    assert labels0[0] == 2 and labels0[1] == 2
+    ex = np.array([11.0, 11.0, 30.0, 30.0])
+    g = np.array([10.0, 10.0, 29.0, 29.0])
+    ew, eh = ex[2] - ex[0] + 1, ex[3] - ex[1] + 1
+    gw, gh = g[2] - g[0] + 1, g[3] - g[1] + 1
+    expect = np.array([
+        ((g[0] + gw / 2) - (ex[0] + ew / 2)) / ew / w[0],
+        ((g[1] + gh / 2) - (ex[1] + eh / 2)) / eh / w[1],
+        np.log(gw / ew) / w[2], np.log(gh / eh) / w[3]])
+    np.testing.assert_allclose(tgts[0, 1, 8:12], expect, atol=1e-5)
+    # rois scaled back up by im_scale
+    np.testing.assert_allclose(rois_o[0, 1], ex * 2.0, atol=1e-4)
+
+
+def test_generate_proposal_labels_fg_cap_random():
+    """With use_random=True the fg sample is capped at
+    floor(S*fg_fraction) and slots stay fg-first."""
+    g = np.array([[[0, 0, 9, 9]]], "float32")
+    gt_cls = np.array([[1]], "int32")
+    crowd = np.array([[0]], "int32")
+    # 6 near-duplicates of the gt: all fg candidates
+    rois = np.tile(np.array([[0, 0, 9, 9]], "float32"), (6, 1))[None]
+    im_info = np.array([[50, 50, 1.0]], "float32")
+    attrs = {"batch_size_per_im": 4, "fg_fraction": 0.5, "fg_thresh": 0.5,
+             "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0,
+             "bbox_reg_weights": [1.0] * 4, "class_nums": 2,
+             "use_random": True}
+    (rois_o, labels, *_rest, num), _ = _run_gpl(
+        rois, gt_cls, crowd, g, im_info, attrs)
+    labels0 = labels[0, :, 0]
+    assert (labels0[:2] == 1).all()               # fg cap = floor(4*0.5)
+    assert (labels0[2:] == 0).all()               # nothing else qualifies
+    assert int(num[0]) == 2
+
+
+# -- roi_perspective_transform ---------------------------------------------
+
+def _ref_roi_persp(x, rois, roi2im, scale, th, tw):
+    """Direct port of the reference CPU kernel
+    (roi_perspective_transform_op.cc)."""
+    eps = 1e-4
+
+    def gt(a, b):
+        return (a - b) > eps
+
+    def gte(a, b):
+        return (a > b) or abs(a - b) < eps
+
+    def lte(a, b):
+        return (a < b) or abs(a - b) < eps
+
+    def in_quad(px, py, rx, ry):
+        for i in range(4):
+            xs, ys = rx[i], ry[i]
+            xe, ye = rx[(i + 1) % 4], ry[(i + 1) % 4]
+            if abs(ys - ye) < eps:
+                if abs(py - ys) < eps and abs(py - ye) < eps and \
+                        gte(px, min(xs, xe)) and lte(px, max(xs, xe)):
+                    return True
+            else:
+                ix = (py - ys) * (xe - xs) / (ye - ys) + xs
+                if abs(ix - px) < eps and gte(py, min(ys, ye)) and \
+                        lte(py, max(ys, ye)):
+                    return True
+        n_cross = 0
+        for i in range(4):
+            xs, ys = rx[i], ry[i]
+            xe, ye = rx[(i + 1) % 4], ry[(i + 1) % 4]
+            if abs(ys - ye) < eps:
+                continue
+            if lte(py, min(ys, ye)) or gt(py, max(ys, ye)):
+                continue
+            ix = (py - ys) * (xe - xs) / (ye - ys) + xs
+            if abs(ix - px) < eps:
+                return True
+            if gt(ix, px):
+                n_cross += 1
+        return n_cross % 2 == 1
+
+    def matrix(rx, ry):
+        x0, x1, x2, x3 = rx
+        y0, y1, y2, y3 = ry
+        l1 = np.hypot(x0 - x1, y0 - y1)
+        l2 = np.hypot(x1 - x2, y1 - y2)
+        l3 = np.hypot(x2 - x3, y2 - y3)
+        l4 = np.hypot(x3 - x0, y3 - y0)
+        est_h = (l2 + l4) / 2.0
+        est_w = (l1 + l3) / 2.0
+        nh = th
+        nw = min(int(round(est_w * (nh - 1) / est_h)) + 1, tw)
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        m = np.zeros(9)
+        m[6] = (dx3 * dy2 - dx2 * dy3) / (dx1 * dy2 - dx2 * dy1) / (nw - 1)
+        m[7] = (dx1 * dy3 - dx3 * dy1) / (dx1 * dy2 - dx2 * dy1) / (nh - 1)
+        m[8] = 1
+        m[3] = (y1 - y0 + m[6] * (nw - 1) * y1) / (nw - 1)
+        m[4] = (y3 - y0 + m[7] * (nh - 1) * y3) / (nh - 1)
+        m[5] = y0
+        m[0] = (x1 - x0 + m[6] * (nw - 1) * x1) / (nw - 1)
+        m[1] = (x3 - x0 + m[7] * (nh - 1) * x3) / (nh - 1)
+        m[2] = x0
+        return m
+
+    def bilinear(img, in_w, in_h):
+        hgt, wid = img.shape
+        if gt(-0.5, in_w) or gt(in_w, wid - 0.5) or gt(-0.5, in_h) or \
+                gt(in_h, hgt - 0.5):
+            return 0.0
+        in_w = max(in_w, 0.0)
+        in_h = max(in_h, 0.0)
+        wf, hf = int(np.floor(in_w)), int(np.floor(in_h))
+        if wf >= wid - 1:
+            wc = wf = wid - 1
+            in_w = float(wf)
+        else:
+            wc = wf + 1
+        if hf >= hgt - 1:
+            hc = hf = hgt - 1
+            in_h = float(hf)
+        else:
+            hc = hf + 1
+        fw, fh = in_w - wf, in_h - hf
+        return ((1 - fw) * (1 - fh) * img[hf, wf]
+                + (1 - fw) * fh * img[hc, wf]
+                + fw * fh * img[hc, wc] + (1 - fh) * fw * img[hf, wc])
+
+    r, c = rois.shape[0], x.shape[1]
+    out = np.zeros((r, c, th, tw), "float32")
+    for n in range(r):
+        rx = rois[n, 0::2] * scale
+        ry = rois[n, 1::2] * scale
+        m = matrix(rx, ry)
+        for ch in range(c):
+            img = x[roi2im[n], ch]
+            for oh in range(th):
+                for ow in range(tw):
+                    u = m[0] * ow + m[1] * oh + m[2]
+                    v = m[3] * ow + m[4] * oh + m[5]
+                    wq = m[6] * ow + m[7] * oh + m[8]
+                    iw, ih = u / wq, v / wq
+                    if in_quad(iw, ih, rx, ry):
+                        out[n, ch, oh, ow] = bilinear(img, iw, ih)
+    return out
+
+
+def test_roi_perspective_transform_matches_reference():
+    rs = np.random.RandomState(11)
+    x = rs.rand(2, 3, 8, 8).astype("float32")
+    # one axis-aligned box + one genuine quadrilateral, on different images
+    rois = np.array([
+        [1, 1, 6, 1, 6, 6, 1, 6],
+        [2, 1, 7, 2, 6, 7, 1, 5],
+    ], "float32")
+    roi2im = np.array([0, 1], "int32")
+    th, tw, scale = 4, 4, 1.0
+    expect = _ref_roi_persp(x, rois, roi2im, scale, th, tw)
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        block = prog.global_block()
+        for name, arr in [("X", x), ("ROIs", rois),
+                          ("RoisImageId", roi2im)]:
+            block.create_var(name=name, shape=arr.shape, dtype=arr.dtype,
+                             is_data=True)
+        block.append_op(
+            type="roi_perspective_transform",
+            inputs={"X": ["X"], "ROIs": ["ROIs"],
+                    "RoisImageId": ["RoisImageId"]},
+            outputs={"Out": ["Out"]},
+            attrs={"spatial_scale": scale, "transformed_height": th,
+                   "transformed_width": tw})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={"X": x, "ROIs": rois,
+                                 "RoisImageId": roi2im},
+                     fetch_list=["Out"])
+    np.testing.assert_allclose(np.asarray(got), expect, atol=1e-4)
+
+
+def test_roi_perspective_transform_spatial_scale():
+    x = np.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+    rois = np.array([[2, 2, 10, 2, 10, 10, 2, 10]], "float32")
+    roi2im = np.array([0], "int32")
+    expect = _ref_roi_persp(x, rois, roi2im, 0.5, 3, 3)
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        block = prog.global_block()
+        for name, arr in [("X", x), ("ROIs", rois),
+                          ("RoisImageId", roi2im)]:
+            block.create_var(name=name, shape=arr.shape, dtype=arr.dtype,
+                             is_data=True)
+        block.append_op(
+            type="roi_perspective_transform",
+            inputs={"X": ["X"], "ROIs": ["ROIs"],
+                    "RoisImageId": ["RoisImageId"]},
+            outputs={"Out": ["Out"]},
+            attrs={"spatial_scale": 0.5, "transformed_height": 3,
+                   "transformed_width": 3})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={"X": x, "ROIs": rois,
+                                 "RoisImageId": roi2im},
+                     fetch_list=["Out"])
+    np.testing.assert_allclose(np.asarray(got), expect, atol=1e-4)

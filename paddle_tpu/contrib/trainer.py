@@ -179,25 +179,80 @@ class Trainer:
                     self.train_program, feed=feed, fetch_list=fetch)
             feeder = self._feeder(feed_order)
             ckpt_exe = Executor(self.place)
-            for epoch_id in range(num_epochs):
-                if self.__stop:
-                    break
-                event_handler(BeginEpochEvent(epoch_id))
-                for step_id, data in enumerate(reader()):
+            with self._signal_guard():
+                for epoch_id in range(num_epochs):
                     if self.__stop:
                         break
-                    begin = BeginStepEvent(epoch_id, step_id)
-                    event_handler(begin)
-                    fetch = [v.name for v in self.train_func_outputs] \
-                        if begin.fetch_metrics else []
-                    with RecordEvent("trainer/step"):
-                        metrics = run(feeder.feed(data), fetch)
-                        metrics = [np.asarray(m) for m in metrics]
-                    event_handler(EndStepEvent(epoch_id, step_id, metrics))
-                    with RecordEvent("trainer/checkpoint"):
-                        self._maybe_save_checkpoint(ckpt_exe, epoch_id,
-                                                    step_id)
-                event_handler(EndEpochEvent(epoch_id))
+                    event_handler(BeginEpochEvent(epoch_id))
+                    for step_id, data in enumerate(reader()):
+                        if self.__stop:
+                            break
+                        begin = BeginStepEvent(epoch_id, step_id)
+                        event_handler(begin)
+                        fetch = [v.name for v in self.train_func_outputs] \
+                            if begin.fetch_metrics else []
+                        with RecordEvent("trainer/step"):
+                            metrics = run(feeder.feed(data), fetch)
+                            metrics = [np.asarray(m) for m in metrics]
+                        event_handler(EndStepEvent(epoch_id, step_id,
+                                                   metrics))
+                        with RecordEvent("trainer/checkpoint"):
+                            self._maybe_save_checkpoint(ckpt_exe, epoch_id,
+                                                        step_id)
+                        if self.__preempted:
+                            break
+                    event_handler(EndEpochEvent(epoch_id))
+                    if self.__preempted:
+                        break
+                if self.__preempted and self.checkpoint_cfg is not None:
+                    # flush at the step boundary, then let the signal's
+                    # default behavior proceed (SURVEY §5
+                    # checkpoint-on-signal; reference analog:
+                    # listen_and_serv_op.cc signal handler)
+                    self._flush_checkpoint(ckpt_exe, epoch_id)
+
+    def _signal_guard(self):
+        """While training, SIGTERM/SIGINT request a graceful stop: the
+        current step finishes, a checkpoint is flushed, and the signal
+        is re-raised with its original handler."""
+        import contextlib
+        import signal as _signal
+
+        self.__preempted = None
+
+        @contextlib.contextmanager
+        def _ctx():
+            prev = {}
+
+            def handler(signum, frame):
+                self.__preempted = signum
+                self.__stop = True
+
+            try:
+                for s in (_signal.SIGTERM, _signal.SIGINT):
+                    prev[s] = _signal.signal(s, handler)
+            except ValueError:      # not the main thread
+                yield
+                return
+            try:
+                yield
+            finally:
+                for s, h in prev.items():
+                    _signal.signal(s, h)
+                if self.__preempted is not None:
+                    _signal.raise_signal(self.__preempted)
+
+        return _ctx()
+
+    def _flush_checkpoint(self, exe, epoch_id):
+        cfg = self.checkpoint_cfg
+        # one past the periodic serial for this epoch, so resume picks
+        # the preemption flush as latest
+        serial = (cfg.load_serial or 0) + epoch_id + 2
+        fluid_io.save_checkpoint(
+            exe, cfg.checkpoint_dir, serial=serial,
+            main_program=self.train_program,
+            max_num_checkpoints=cfg.max_num_checkpoints)
 
     def test(self, reader, feed_order=None):
         """Average the train_func outputs over the test reader."""

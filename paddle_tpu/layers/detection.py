@@ -22,6 +22,7 @@ __all__ = [
     "rpn_target_assign",
     "generate_proposal_labels",
     "roi_perspective_transform",
+    "detection_map",
 ]
 
 
@@ -402,3 +403,43 @@ def roi_perspective_transform(input, rois, transformed_height,
                "transformed_width": transformed_width,
                "spatial_scale": spatial_scale})
     return out
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral", detect_res_length=None,
+                  gt_length=None):
+    """In-graph mean-average-precision (reference detection.py:399 /
+    detection_map_op.h) over one mini-batch.  ``detect_res`` [B, D, 6]
+    (label, score, x1..y2) with its count companion, ``label`` [B, G, 5|6]
+    gt rows.  ``input_states``/``out_states`` (the reference's streaming
+    accumulation, dynamic-length LoD state) are not supported in-graph —
+    use ``metrics.DetectionMAP`` host-side for multi-batch accumulation.
+
+    Returns the [1] mAP tensor."""
+    if input_states is not None or out_states is not None or \
+            has_state is not None:
+        raise ValueError(
+            "detection_map: in-graph streaming state is unsupported "
+            "(variable-length state; see metrics.DetectionMAP)")
+    helper = LayerHelper("detection_map", input=detect_res)
+    m = helper.create_variable_for_type_inference("float32")
+    pos = helper.create_variable_for_type_inference("int32")
+    inputs = {"DetectRes": [detect_res], "Label": [label]}
+    if detect_res_length is not None:
+        inputs["DetectResLength"] = [detect_res_length]
+    elif getattr(detect_res, "_seq_len_name", None):
+        inputs["DetectResLength"] = [detect_res._seq_len_name]
+    if gt_length is not None:
+        inputs["GtLength"] = [gt_length]
+    helper.append_op(
+        type="detection_map", inputs=inputs,
+        outputs={"MAP": [m], "AccumPosCount": [pos]},
+        attrs={"class_num": class_num,
+               "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version})
+    m.stop_gradient = True
+    return m

@@ -196,15 +196,18 @@ class DetectionMAP(MetricBase):
             lbl, score = int(detections[i, 0]), detections[i, 1]
             if lbl < 0:
                 continue
-            box = detections[i, 2:6]
-            best, best_j = 0.0, -1
+            # dets clip to [0, 1] before overlap; the best gt is found
+            # over ALL gts of the class (used or not) and a used best is
+            # an FP — exactly detection_map_op.h CalcTrueAndFalsePositive
+            box = np.clip(detections[i, 2:6], 0.0, 1.0)
+            best, best_j = -1.0, -1
             for j, (gb, gl) in enumerate(zip(gt_boxes, gt_labels)):
-                if int(gl) != lbl or used[j]:
+                if int(gl) != lbl:
                     continue
                 ov = self._iou(box, gb)
                 if ov > best:
                     best, best_j = ov, j
-            tp = best >= self.overlap_threshold and best_j >= 0
+            tp = best > self.overlap_threshold and not used[best_j]
             if tp:
                 used[best_j] = True
             self._scores.setdefault(lbl, []).append((score, tp))
@@ -214,7 +217,8 @@ class DetectionMAP(MetricBase):
         for c, n_gt in self._n_gt.items():
             recs = sorted(self._scores.get(c, []), reverse=True)
             if not recs or n_gt == 0:
-                aps.append(0.0)
+                # classes with no detections are skipped, not zeroed
+                # (detection_map_op.h CalcMAP true_pos.find == end)
                 continue
             tps = np.cumsum([1.0 if t else 0.0 for _, t in recs])
             fps = np.cumsum([0.0 if t else 1.0 for _, t in recs])

@@ -596,13 +596,17 @@ def _mine_hard_compute(ins, attrs, ctx, op_index):
     if mining_type == "hard_example":
         # every prior is eligible; rank by cls+loc loss, cap at
         # sample_size (mine_hard_examples_op.cc kHardExample)
+        sample_size = int(attrs.get("sample_size") or 0)
+        if sample_size <= 0:
+            raise ValueError(
+                "mine_hard_examples: mining_type='hard_example' needs "
+                "sample_size > 0 (mine_hard_examples_op.cc enforces it)")
         eligible = jnp.ones((n, p), bool)
         loss = cls_loss
         loc = ins.get("LocLoss")
         if loc and loc[0] is not None:
             loss = loss + loc[0]
-        num_neg = jnp.full((n,), min(int(attrs.get("sample_size", 0)), p),
-                           jnp.int32)
+        num_neg = jnp.full((n,), min(sample_size, p), jnp.int32)
     else:
         # eligible negatives: unmatched priors with match_dist below the
         # threshold; rank by cls_loss alone, cap at num_pos * ratio

@@ -7,7 +7,9 @@ states are persistable scope vars like the reference's evaluator states.
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..registry import register_op, set_output, in_var
 from ..core import long_dtype
@@ -228,4 +230,174 @@ register_op(
      "AccumulateNegativePair", "AccumulateNeutralPair", "Weight"],
     ["PositivePair", "NegativePair", "NeutralPair"],
     infer=_pnp_infer, compute=_pnp_compute, grad=None,
+)
+
+
+# -- detection_map (reference detection_map_op.h) ---------------------------
+# In-graph mAP so SSD eval runs inside the program like the reference.
+# TPU redesign: padded [B, D, 6] detections + [B, G, 5|6] labels with
+# length companions instead of LoD; the whole evaluation (greedy per-class
+# matching, score-ordered PR curve, integral / 11-point AP) is traced.
+# Streaming multi-batch accumulation (the reference's PosCount/TruePos/
+# FalsePos recursion, dynamic-length state) stays HOST-side in
+# ``metrics.DetectionMAP`` by design: the state is variable-length and
+# branch-heavy, the wrong shape for XLA; this op evaluates one mini-batch
+# (the reference's empty-PosCount path).
+
+def _dmap_infer(op, block):
+    c = int(op.attrs["class_num"])
+    set_output(op, block, "MAP", (1,), "float32")
+    set_output(op, block, "AccumPosCount", (c, 1), "int32")
+
+
+def _dmap_match_image(dets, dlen, gts, glen, thresh, eval_difficult):
+    """Per-image greedy matching (CalcTrueAndFalsePositive): dets
+    [D, 6] (label, score, x1, y1, x2, y2), gts [G, 6] (label, x1..y2,
+    difficult).  Returns (tp, fp, counted) [D] each."""
+    d, g = dets.shape[0], gts.shape[0]
+    det_valid = (jnp.arange(d) < dlen) & (dets[:, 0] >= 0)
+    gt_valid = jnp.arange(g) < glen
+    order = jnp.argsort(-dets[:, 1])          # score desc
+    sdets = dets[order]
+    svalid = det_valid[order]
+
+    # det boxes are clipped to [0, 1] before overlap (ClipBBox)
+    box = jnp.clip(sdets[:, 2:6], 0.0, 1.0)
+    gbox = gts[:, 1:5]
+    ix1 = jnp.maximum(box[:, None, 0], gbox[None, :, 0])
+    iy1 = jnp.maximum(box[:, None, 1], gbox[None, :, 1])
+    ix2 = jnp.minimum(box[:, None, 2], gbox[None, :, 2])
+    iy2 = jnp.minimum(box[:, None, 3], gbox[None, :, 3])
+    # JaccardOverlap: 0 when disjoint, signed product otherwise
+    disjoint = (gbox[None, :, 0] > box[:, None, 2]) | \
+        (gbox[None, :, 2] < box[:, None, 0]) | \
+        (gbox[None, :, 1] > box[:, None, 3]) | \
+        (gbox[None, :, 3] < box[:, None, 1])
+    inter = (ix2 - ix1) * (iy2 - iy1)
+    area_d = (box[:, 2] - box[:, 0]) * (box[:, 3] - box[:, 1])
+    area_g = (gbox[:, 2] - gbox[:, 0]) * (gbox[:, 3] - gbox[:, 1])
+    union = area_d[:, None] + area_g[None, :] - inter
+    iou = jnp.where(disjoint | (union <= 0), 0.0,
+                    inter / jnp.where(union <= 0, 1.0, union))
+    same_cls = sdets[:, 0, None] == gts[None, :, 0]
+    iou = jnp.where(same_cls & gt_valid[None, :], iou, -1.0)
+
+    difficult = gts[:, 5] > 0
+
+    def body(i, carry):
+        visited, tp, fp, counted = carry
+        ov = iou[i]
+        max_ov = jnp.max(ov)
+        max_idx = jnp.argmax(ov)
+        matched = max_ov > thresh
+        diff_skip = (~eval_difficult) & difficult[max_idx] & matched
+        fresh = matched & ~visited[max_idx] & ~diff_skip
+        is_tp = fresh
+        is_fp = ~diff_skip & ~fresh
+        ok = svalid[i]
+        visited = visited.at[max_idx].set(
+            visited[max_idx] | (fresh & ok))
+        tp = tp.at[i].set(is_tp & ok)
+        fp = fp.at[i].set(is_fp & ok)
+        counted = counted.at[i].set(ok & ~diff_skip)
+        return visited, tp, fp, counted
+
+    z = jnp.zeros((d,), bool)
+    _, tp, fp, counted = lax.fori_loop(
+        0, d, body, (jnp.zeros((g,), bool), z, z, z))
+    # undo the score sort so outputs align with input rows
+    inv = jnp.argsort(order)
+    return tp[inv], fp[inv], counted[inv]
+
+
+def _dmap_compute(ins, attrs, ctx, op_index):
+    dets = ins["DetectRes"][0]                # [B, D, 6]
+    labels = ins["Label"][0]                  # [B, G, 5|6]
+    c = int(attrs["class_num"])
+    bg = int(attrs.get("background_label", 0))
+    thresh = float(attrs.get("overlap_threshold", 0.5))
+    eval_diff = bool(attrs.get("evaluate_difficult", True))
+    ap_type = attrs.get("ap_type", "integral")
+    if ap_type not in ("integral", "11point"):
+        raise ValueError("detection_map: ap_type must be integral or "
+                         "11point, got %r" % ap_type)
+    b, d = dets.shape[:2]
+    g = labels.shape[1]
+    if labels.shape[-1] == 5:                 # no difficult column
+        labels = jnp.concatenate(
+            [labels, jnp.zeros(labels.shape[:-1] + (1,), labels.dtype)],
+            axis=-1)
+    dl = ins.get("DetectResLength")
+    dlen = dl[0] if dl and dl[0] is not None else \
+        jnp.full((b,), d, jnp.int32)
+    gl = ins.get("GtLength")
+    glen = gl[0] if gl and gl[0] is not None else \
+        jnp.full((b,), g, jnp.int32)
+
+    gt_valid = jnp.arange(g)[None, :] < glen[:, None]
+    gt_counted = gt_valid if eval_diff else gt_valid & (labels[..., 5] <= 0)
+    cls_ids = jnp.arange(c, dtype=labels.dtype)
+    pos_count = jnp.sum(
+        (labels[:, :, 0][None] == cls_ids[:, None, None])
+        & gt_counted[None], axis=(1, 2))      # [C]
+
+    tp, fp, counted = jax.vmap(
+        lambda dd, dn, gg, gn: _dmap_match_image(
+            dd, dn, gg, gn, thresh, jnp.asarray(eval_diff)))(
+        dets, dlen, labels, glen)
+    scores = dets[..., 1].reshape(-1)
+    det_cls = dets[..., 0].reshape(-1)
+    tp = tp.reshape(-1)
+    fp = fp.reshape(-1)
+    counted = counted.reshape(-1)
+
+    order = jnp.argsort(-scores)              # global score-desc order
+    s_cls = det_cls[order]
+    s_tp = tp[order].astype(jnp.float32)
+    s_fp = fp[order].astype(jnp.float32)
+    s_cnt = counted[order]
+
+    def ap_for_class(cid, npos):
+        mask = s_cnt & (s_cls == cid.astype(s_cls.dtype))
+        tpk = jnp.where(mask, s_tp, 0.0)
+        fpk = jnp.where(mask, s_fp, 0.0)
+        tp_cum = jnp.cumsum(tpk)
+        fp_cum = jnp.cumsum(fpk)
+        denom = jnp.maximum(tp_cum + fp_cum, 1.0)
+        precision = tp_cum / denom
+        recall = tp_cum / jnp.maximum(npos.astype(jnp.float32), 1.0)
+        if ap_type == "integral":
+            # recall moves only at TP rows: each contributes
+            # precision * 1/npos (CalcMAP kIntegral)
+            return jnp.sum(jnp.where(mask & (tpk > 0), precision, 0.0)
+                           / jnp.maximum(npos.astype(jnp.float32), 1.0))
+        # 11point: interpolated max precision at recall >= j/10
+        pts = jnp.arange(11, dtype=jnp.float32) / 10.0
+        interp = jnp.max(
+            jnp.where(mask[None, :] & (recall[None, :] >= pts[:, None]),
+                      precision[None, :], 0.0), axis=1)
+        return jnp.sum(interp) / 11.0
+
+    aps = jax.vmap(ap_for_class)(jnp.arange(c), pos_count)
+    # reference CalcMAP: a class contributes only if it has positives,
+    # appears among the detections (true_pos.find == end -> skipped,
+    # detection_map_op.h:423), and — a reference quirk — its positive
+    # COUNT differs from background_label (with the default bg=0 this
+    # reduces to "has positives")
+    has_det = jax.vmap(
+        lambda cid: jnp.any(s_cnt & (s_cls == cid.astype(s_cls.dtype))))(
+        jnp.arange(c))
+    contributing = (pos_count > 0) & (pos_count != bg) & has_det
+    n = jnp.sum(contributing.astype(jnp.int32))
+    mean_ap = jnp.sum(jnp.where(contributing, aps, 0.0)) / \
+        jnp.maximum(n, 1).astype(jnp.float32)
+    return {"MAP": mean_ap[None].astype(jnp.float32),
+            "AccumPosCount": pos_count[:, None].astype(jnp.int32)}
+
+
+register_op(
+    "detection_map",
+    ["DetectRes", "DetectResLength", "Label", "GtLength"],
+    ["MAP", "AccumPosCount"],
+    infer=_dmap_infer, compute=_dmap_compute, grad=None,
 )

@@ -141,6 +141,28 @@ class ShardedCheckpointManager:
             scope.set_var(name, val)
         return step
 
+    def save_now(self, step, scope=None, program=None):
+        """Forced synchronous save, ignoring the interval gate — the
+        flush-before-exit path (preemption / SIGTERM).
+
+        Callers decide WHEN this is safe: flush at a step boundary, and
+        in a multi-process world agree on ``step`` first (the
+        ``distributed.any_process_flagged`` vote) since every host must
+        join this collective write.  ``contrib.Trainer`` wires the
+        single-process flow (signal -> finish step -> flush);
+        ``tests/dist_runner.py`` shows the multi-process protocol."""
+        import orbax.checkpoint as ocp
+
+        # drain any in-flight async periodic save before starting the
+        # forced one (CheckpointManager.save is not reentrant)
+        self._mgr.wait_until_finished()
+        state = _persistable_state(scope or global_scope(), program)
+        _require_state(state, "save")
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               force=True)
+        self._mgr.wait_until_finished()
+        return saved
+
     def latest_step(self):
         return self._mgr.latest_step()
 

@@ -16,7 +16,7 @@ import os
 import jax
 
 __all__ = ["init_distributed", "is_initialized", "process_count",
-           "process_id", "barrier"]
+           "process_id", "barrier", "any_process_flagged"]
 
 _initialized = False
 
@@ -72,3 +72,25 @@ def barrier(name="paddle_tpu_barrier"):
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(name)
+
+
+def any_process_flagged(flag):
+    """Collective OR over processes — the preemption vote.
+
+    Each process passes its local signal flag; every process learns, at
+    the SAME point in its step loop, whether any host was signaled.
+    This is the coordination that makes checkpoint-on-signal safe for
+    sharded state: the actual save is a collective (every host writes
+    its shards for one step id), so hosts must agree on the flush step
+    rather than each flushing whenever its own handler fired.  Analog:
+    the reference pserver exits its serve loop on a barriered condition
+    (listen_and_serv_op.cc rpc_service_->IsExit), not mid-RPC.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return bool(flag)
+    gathered = multihost_utils.process_allgather(
+        np.asarray([bool(flag)]))
+    return bool(np.asarray(gathered).any())

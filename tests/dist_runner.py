@@ -4,10 +4,18 @@ jax.distributed world, builds the same model, feeds its LOCAL half of
 every global batch through the ParallelExecutor, and prints the losses.
 
 Run: python dist_runner.py <process_id> <num_processes> <coordinator>
+         [ckpt_dir]
+
+With ``ckpt_dir`` the run is preemption-aware: it resumes from the
+latest sharded checkpoint, and on SIGTERM all processes agree on a
+flush step via the preemption vote (distributed.any_process_flagged),
+write a collective checkpoint, and exit 0 — the fault-injection
+protocol of the checkpoint-on-signal test.
 """
 
 import json
 import os
+import signal
 import sys
 
 
@@ -15,6 +23,7 @@ def main():
     pid = int(sys.argv[1])
     nproc = int(sys.argv[2])
     coordinator = sys.argv[3]
+    ckpt_dir = sys.argv[4] if len(sys.argv) > 4 else None
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
@@ -46,18 +55,47 @@ def main():
 
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
+
+    mgr = None
+    start = 0
+    if ckpt_dir:
+        from paddle_tpu.parallel.checkpoint import ShardedCheckpointManager
+
+        mgr = ShardedCheckpointManager(ckpt_dir, async_save=False)
+        restored = mgr.restore()
+        if restored is not None:
+            start = restored
+            print("RESUMED", start, flush=True)
+
     pe = fluid.ParallelExecutor(loss_name=loss.name, build_strategy=bs,
                                 mesh=mesh)
 
+    flagged = []
+
+    def on_term(signum, frame):
+        flagged.append(signum)
+
+    if ckpt_dir:
+        signal.signal(signal.SIGTERM, on_term)
+
     losses = []
-    for x, y in dist_model.batches():
-        # local slice: this trainer's share of the global batch
+    data = dist_model.batches()
+    for i in range(start, len(data)):
+        if mgr is not None and distributed.any_process_flagged(flagged):
+            # collective flush: every process saves its shards for the
+            # agreed step, then exits cleanly (preemption drain)
+            mgr.save_now(i)
+            print("CKPT_SAVED", i, flush=True)
+            print("DIST_LOSSES", json.dumps(losses), flush=True)
+            return
+        x, y = data[i]
         lo = pid * (dist_model.BATCH // nproc)
         hi = lo + dist_model.BATCH // nproc
         (lv,) = pe.run(feed={"img": x[lo:hi], "label": y[lo:hi]},
                        fetch_list=[loss])
         losses.append(float(np.asarray(lv).ravel()[0]))
-    print("DIST_LOSSES", json.dumps(losses))
+        print("STEP", i, flush=True)
+    print("DIST_LOSSES", json.dumps(losses), flush=True)
 
 
 if __name__ == "__main__":

@@ -146,13 +146,15 @@ def test_unpool_scatters_to_argmax_positions():
 def test_detection_map_metric():
     from paddle_tpu.metrics import DetectionMAP
 
+    # coords are normalized [0, 1] (the op contract: dets are clipped,
+    # detection_map_op.h ClipBBox)
     m = DetectionMAP(overlap_threshold=0.5)
-    gt = np.array([[0.0, 0.0, 1.0, 1.0], [2.0, 2.0, 3.0, 3.0]])
+    gt = np.array([[0.0, 0.0, 0.3, 0.3], [0.5, 0.5, 0.8, 0.8]])
     gt_labels = np.array([1, 2])
     dets = np.array([
-        [1, 0.9, 0.0, 0.0, 1.0, 1.0],    # TP class 1
-        [1, 0.8, 5.0, 5.0, 6.0, 6.0],    # FP class 1
-        [2, 0.7, 2.0, 2.0, 3.0, 3.0],    # TP class 2
+        [1, 0.9, 0.0, 0.0, 0.3, 0.3],    # TP class 1
+        [1, 0.8, 0.6, 0.0, 0.9, 0.3],    # FP class 1
+        [2, 0.7, 0.5, 0.5, 0.8, 0.8],    # TP class 2
     ])
     m.update(dets, gt, gt_labels)
     # class1 AP (integral): recall hits 1.0 at precision 1.0 -> 1.0;
@@ -299,3 +301,120 @@ def test_adaptive_nms_eta():
                                        eta=0.7))
     assert keep_plain.tolist() == [True, True, True]
     assert keep_adapt.tolist() == [True, False, True]
+
+
+def test_detection_map_op_matches_host_metric():
+    """The in-graph detection_map op agrees with the host-side streaming
+    DetectionMAP metric on a single batch (the op is the reference's
+    empty-state path, detection_map_op.h)."""
+    from paddle_tpu.metrics import DetectionMAP
+
+    # image 0: det0 hits gt0 (label 1), det1 misses; image 1: det for
+    # label 2 hits, plus a duplicate (second match -> FP)
+    dets = np.array([
+        [[1, 0.9, 0.1, 0.1, 0.4, 0.4],
+         [1, 0.7, 0.6, 0.6, 0.9, 0.9],
+         [-1, 0.0, 0, 0, 0, 0]],
+        [[2, 0.8, 0.2, 0.2, 0.5, 0.5],
+         [2, 0.6, 0.21, 0.2, 0.5, 0.5],
+         [-1, 0.0, 0, 0, 0, 0]],
+    ], "float32")
+    dlen = np.array([2, 2], "int32")
+    gts = np.array([
+        [[1, 0.1, 0.1, 0.4, 0.4, 0], [1, 0.0, 0.6, 0.2, 0.9, 0]],
+        [[2, 0.2, 0.2, 0.5, 0.5, 0], [0, 0, 0, 0, 0, 0]],
+    ], "float32")
+    glen = np.array([2, 1], "int32")
+
+    for ap in ("integral", "11point"):
+        host = DetectionMAP(overlap_threshold=0.5, ap_version=ap)
+        for i in range(2):
+            d = dets[i][:dlen[i]]
+            host.update(d, gts[i][:glen[i], 1:5], gts[i][:glen[i], 0])
+        expect = host.eval()
+
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            block = prog.global_block()
+            for name, arr in [("d", dets), ("dl", dlen), ("g", gts),
+                              ("gl", glen)]:
+                block.create_var(name=name, shape=arr.shape,
+                                 dtype=arr.dtype, is_data=True)
+            block.append_op(
+                type="detection_map",
+                inputs={"DetectRes": ["d"], "DetectResLength": ["dl"],
+                        "Label": ["g"], "GtLength": ["gl"]},
+                outputs={"MAP": ["map"], "AccumPosCount": ["pc"]},
+                attrs={"class_num": 3, "overlap_threshold": 0.5,
+                       "ap_type": ap})
+        exe = fluid.Executor(fluid.CPUPlace())
+        m, pc = exe.run(prog, feed={"d": dets, "dl": dlen, "g": gts,
+                                    "gl": glen},
+                        fetch_list=["map", "pc"])
+        np.testing.assert_allclose(float(np.asarray(m)[0]), expect,
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg="ap_type=%s" % ap)
+        np.testing.assert_array_equal(np.asarray(pc).ravel(), [0, 2, 1])
+
+
+def test_detection_map_layer_with_nms_output():
+    """layers.detection_map consumes multiclass_nms's padded output +
+    count companion end-to-end."""
+    scores = np.zeros((1, 3, 3), "float32")   # [B, C, M]
+    scores[0, 1, 0] = 0.9
+    scores[0, 2, 1] = 0.8
+    boxes = np.array([[[0.1, 0.1, 0.4, 0.4],
+                       [0.5, 0.5, 0.8, 0.8],
+                       [0.0, 0.0, 0.1, 0.1]]], "float32")
+    gts = np.array([[[1, 0.1, 0.1, 0.4, 0.4, 0],
+                     [2, 0.5, 0.5, 0.8, 0.8, 0]]], "float32")
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        b = fluid.layers.data("b", shape=[1, 3, 4],
+                              append_batch_size=False)
+        s = fluid.layers.data("s", shape=[1, 3, 3],
+                              append_batch_size=False)
+        g = fluid.layers.data("g", shape=[1, 2, 6],
+                              append_batch_size=False)
+        out = fluid.layers.multiclass_nms(b, s, score_threshold=0.1,
+                                          nms_threshold=0.5,
+                                          keep_top_k=5)
+        m = fluid.layers.detection_map(out, g, class_num=3,
+                                       overlap_threshold=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (got,) = exe.run(prog, feed={"b": boxes, "s": scores, "g": gts},
+                     fetch_list=[m.name])
+    # both detections hit their gt exactly: mAP = 1
+    np.testing.assert_allclose(float(np.asarray(got)[0]), 1.0, atol=1e-6)
+
+
+def test_detection_map_skips_undetected_classes():
+    """A class with ground truth but zero detections is SKIPPED, not
+    averaged as AP=0 (detection_map_op.h CalcMAP: true_pos.find ==
+    end -> continue) — in both the op and the host metric."""
+    from paddle_tpu.metrics import DetectionMAP
+
+    dets = np.array([[[1, 0.9, 0.1, 0.1, 0.4, 0.4]]], "float32")
+    gts = np.array([[[1, 0.1, 0.1, 0.4, 0.4, 0],
+                     [2, 0.6, 0.6, 0.9, 0.9, 0]]], "float32")
+
+    host = DetectionMAP(overlap_threshold=0.5)
+    host.update(dets[0], gts[0, :, 1:5], gts[0, :, 0])
+    assert host.eval() == 1.0          # class 2 skipped, not halved
+
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        block = prog.global_block()
+        for name, arr in [("d", dets), ("g", gts)]:
+            block.create_var(name=name, shape=arr.shape, dtype=arr.dtype,
+                             is_data=True)
+        block.append_op(
+            type="detection_map",
+            inputs={"DetectRes": ["d"], "Label": ["g"]},
+            outputs={"MAP": ["map"], "AccumPosCount": ["pc"]},
+            attrs={"class_num": 3, "overlap_threshold": 0.5,
+                   "ap_type": "integral"})
+    exe = fluid.Executor(fluid.CPUPlace())
+    (m,) = exe.run(prog, feed={"d": dets, "g": gts}, fetch_list=["map"])
+    np.testing.assert_allclose(float(np.asarray(m)[0]), 1.0, atol=1e-6)

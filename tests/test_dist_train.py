@@ -111,3 +111,71 @@ def test_memory_optimize_reports():
     saved = fluid.memory_optimize(print_log=False)
     assert saved >= 0
     assert fluid.release_memory() == 0
+
+
+def test_dist_trainer_kill_and_resume(tmp_path):
+    """Fault injection (SURVEY §5 checkpoint-on-signal, restart-resume):
+    SIGTERM both trainer processes mid-run — they agree on a flush step
+    via the preemption vote, write a collective sharded checkpoint, and
+    exit 0; a restarted run resumes from it and the combined losses
+    reproduce the uninterrupted single-process reference."""
+    ref = _single_process_reference()
+    ckpt = str(tmp_path / "preempt_ckpt")
+    runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "dist_runner.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+
+    def launch(port):
+        coordinator = "127.0.0.1:%d" % port
+        return [
+            subprocess.Popen(
+                [sys.executable, runner, str(i), "2", coordinator, ckpt],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                bufsize=1, env=env)
+            for i in range(2)
+        ]
+
+    # run 1: kill after the first completed steps
+    procs = launch(_free_port())
+    import signal as _signal
+    seen_step = False
+    for line in procs[0].stdout:
+        if line.startswith("STEP"):
+            seen_step = True
+            for p in procs:
+                p.send_signal(_signal.SIGTERM)
+            break
+    assert seen_step, procs[0].stderr.read()[-4000:]
+    outs1 = []
+    for p in procs:
+        rest = p.stdout.read()
+        err = p.stderr.read()
+        p.wait(timeout=420)
+        assert p.returncode == 0, err[-4000:]
+        outs1.append(rest)
+    # both processes flushed the SAME agreed step
+    saved = [l for l in outs1[0].splitlines() if l.startswith("CKPT_SAVED")]
+    assert saved, outs1[0][-2000:]
+    flush_step = int(saved[0].split()[1])
+    assert flush_step >= 1
+
+    losses1 = json.loads(
+        [l for l in outs1[0].splitlines()
+         if l.startswith("DIST_LOSSES")][0][len("DIST_LOSSES "):])
+    assert len(losses1) == flush_step
+
+    # run 2: fresh processes resume from the flushed checkpoint
+    procs = launch(_free_port())
+    outs2 = []
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, (out[-2000:], err[-4000:])
+        outs2.append(out)
+    assert any(l.startswith("RESUMED %d" % flush_step)
+               for l in outs2[0].splitlines()), outs2[0][-2000:]
+    losses2 = json.loads(
+        [l for l in outs2[0].splitlines()
+         if l.startswith("DIST_LOSSES")][0][len("DIST_LOSSES "):])
+    np.testing.assert_allclose(losses1 + losses2, ref,
+                               rtol=1e-4, atol=1e-5)

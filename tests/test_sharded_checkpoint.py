@@ -126,3 +126,25 @@ def test_restore_before_startup_raises(tmp_path, fresh_programs):
     with fluid.scope_guard(empty):
         with pytest.raises(ValueError, match="startup"):
             ck.load_sharded(str(tmp_path / "ck3"), empty)
+
+
+def test_save_now_bypasses_interval(tmp_path):
+    """save_now flushes regardless of save_interval_steps (the
+    preemption path); restore picks it up."""
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel.checkpoint import ShardedCheckpointManager
+
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.fc(x, size=2)
+    fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    mgr = ShardedCheckpointManager(str(tmp_path / "m"), async_save=False,
+                                   save_interval_steps=100)
+    assert mgr.save(step=0)                   # first save always lands
+    assert mgr.save(step=3) is False          # interval-gated
+    assert mgr.save_now(step=3)               # forced flush
+    assert mgr.latest_step() == 3
+    assert mgr.restore() == 3
+    mgr.close()

@@ -26,49 +26,105 @@ import numpy as np
 RESNET_TARGET = 2900.0 * 0.9
 TRANSFORMER_TARGET = 95000.0 * 0.9
 
+# chip peak for the est_mfu observability field (VERDICT r2 #7): bf16
+# matmul peak in TFLOP/s; default is v5e (197).  Override for other chips.
+import os
+PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+
+# model step-FLOPs estimates (fwd+bwd+update ~= 3x fwd), used only for
+# the est_mfu observability field
+FLOPS_PER_ITEM = {
+    # ResNet-50 @224: ~4.1 GFLOP fwd/image
+    "resnet50": 3 * 4.1e9,
+    # Transformer-base enc-dec: active matmul params ~60.5M (enc 18.9M +
+    # dec 25.2M + logits 16.4M) -> 2*60.5M fwd FLOPs/token
+    "transformer": 3 * 2 * 60.5e6,
+    "mlp": 3 * 2 * (784 * 256 + 256 * 256 + 256 * 10),
+}
+
+N_WINDOWS = 3
+
+
+class _PassthroughFeeder:
+    """PyReader feeder adapter: the bench reader already yields feed
+    dicts (DataFeeder's job is sample->batch conversion, done here at
+    pool-build time)."""
+
+    def feed(self, rows):
+        return rows
+
 
 def _bench_program(main, startup, feed_fn, fetch, place, iterations,
-                   skip_batch_num, per_step_feed=False):
-    """Measure mean step seconds.  ``per_step_feed`` re-feeds a fresh
-    host batch every iteration (reader-included methodology,
-    fluid_benchmark.py --use_reader_op); otherwise the feed is staged on
-    device once and the loop measures pure compute."""
+                   skip_batch_num, per_step_feed=False, model="",
+                   batch=0):
+    """Measure step seconds over N_WINDOWS windows; returns a stats dict.
+
+    ``per_step_feed`` = reader-included methodology (fluid_benchmark.py
+    --use_reader_op): fresh host batches cross the host->device link
+    every step, staged ahead by the framework's own PyReader
+    double-buffer thread so the transfer overlaps compute (the
+    create_double_buffer_reader_op.cc capability).  Otherwise one feed
+    is staged on device and the loop measures pure compute."""
     import paddle_tpu as fluid
 
     import jax
     scope = fluid.Scope()
+    times = []
     with fluid.scope_guard(scope):
         exe = fluid.Executor(place)
         exe.run(startup)
         dev = place.jax_device()
+        last = None
         if per_step_feed:
-            # fresh host batches cross the host->device link every step
-            feeds = [feed_fn() for _ in range(max(4, skip_batch_num))]
+            pool = [feed_fn() for _ in range(4)]
+            total = skip_batch_num + N_WINDOWS * iterations
+
+            def reader():
+                for i in range(total):
+                    yield pool[i % len(pool)]
+
+            pyreader = fluid.reader.PyReader(capacity=4)
+            pyreader.decorate_batch_reader(reader, _PassthroughFeeder(),
+                                           place)
+            stream = iter(pyreader)
+            for _ in range(skip_batch_num):
+                last = exe.run(main, feed=next(stream), fetch_list=[fetch],
+                               return_numpy=False)
+            for _ in range(N_WINDOWS):
+                t0 = time.perf_counter()
+                for _ in range(iterations):
+                    last = exe.run(main, feed=next(stream),
+                                   fetch_list=[fetch], return_numpy=False)
+                jax.block_until_ready(last)
+                times.append(time.perf_counter() - t0)
         else:
-            # stage one feed on device — the input pipeline's job; keeps
-            # the measured loop free of host-link transfers
             feeds = [{k: jax.device_put(v, dev)
                       for k, v in feed_fn().items()}]
-        for i in range(skip_batch_num):
-            exe.run(main, feed=feeds[i % len(feeds)], fetch_list=[fetch],
-                    return_numpy=False)
-        # two measurement windows, keep the faster: the tunnel-shared
-        # chip suffers long-lived contention windows, and min-time is
-        # the standard way to measure the machine rather than the noise
-        best = None
-        last = None
-        for _ in range(2):
-            t0 = time.perf_counter()
-            for i in range(iterations):
-                # async dispatch: loss stays on device; sync at the end
-                last = exe.run(main, feed=feeds[i % len(feeds)],
-                               fetch_list=[fetch], return_numpy=False)
-            jax.block_until_ready(last)
-            elapsed = time.perf_counter() - t0
-            best = elapsed if best is None else min(best, elapsed)
+            for i in range(skip_batch_num):
+                last = exe.run(main, feed=feeds[0], fetch_list=[fetch],
+                               return_numpy=False)
+            # several measurement windows; min is the machine, the spread
+            # is the (shared, tunneled) chip's noise — both are reported
+            for _ in range(N_WINDOWS):
+                t0 = time.perf_counter()
+                for i in range(iterations):
+                    # async dispatch: loss stays on device; sync at end
+                    last = exe.run(main, feed=feeds[0],
+                                   fetch_list=[fetch], return_numpy=False)
+                jax.block_until_ready(last)
+                times.append(time.perf_counter() - t0)
     assert np.isfinite(
         np.asarray(last[0], dtype=np.float32)).all()
-    return best / iterations
+    per_step = sorted(t / iterations for t in times)
+    best = per_step[0]
+    stats = {"min_step_s": round(best, 6),
+             "median_step_s": round(per_step[len(per_step) // 2], 6),
+             "n_windows": len(per_step)}
+    if model in FLOPS_PER_ITEM and batch:
+        items_per_sec = batch / best
+        stats["est_mfu"] = round(
+            FLOPS_PER_ITEM[model] * items_per_sec / (PEAK_TFLOPS * 1e12), 4)
+    return best, stats
 
 
 def _maybe_amp(optimizer, use_amp):
@@ -98,15 +154,15 @@ def bench_mlp(args, use_amp=False, per_step_feed=False):
             return {"img": rng.rand(batch, 784).astype("float32"),
                     "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
 
-        step_time = _bench_program(
+        step_time, stats = _bench_program(
             fluid.default_main_program(), fluid.default_startup_program(),
             feed_fn, loss, _place(args), args.iterations,
-            args.skip_batch_num, per_step_feed)
+            args.skip_batch_num, per_step_feed, model="mlp", batch=batch)
     ips = batch / step_time
-    return {"metric": "mnist_mlp_images_per_sec" + _suffix(use_amp,
-                                                           per_step_feed),
-            "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": 1.0}
+    return dict({"metric": "mnist_mlp_images_per_sec" + _suffix(
+                     use_amp, per_step_feed),
+                 "value": round(ips, 2), "unit": "images/sec",
+                 "vs_baseline": 1.0}, **stats)
 
 
 def bench_resnet50(args, use_amp=False, per_step_feed=False):
@@ -115,7 +171,17 @@ def bench_resnet50(args, use_amp=False, per_step_feed=False):
 
     batch = args.batch_size or 128
     with fluid.program_guard(fluid.Program(), fluid.Program()):
-        img = fluid.layers.data("img", shape=[3, 224, 224])
+        if per_step_feed:
+            # reader-included path: feed uint8 (4x fewer host->device
+            # bytes than fp32) and normalize on device, like a real input
+            # pipeline — decode/augment produce uint8, the cast+scale
+            # fuses into the compiled step
+            raw = fluid.layers.data("img", shape=[3, 224, 224],
+                                    dtype="uint8")
+            img = fluid.layers.scale(
+                fluid.layers.cast(raw, "float32"), scale=1.0 / 255.0)
+        else:
+            img = fluid.layers.data("img", shape=[3, 224, 224])
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         pred = resnet_imagenet(img, class_dim=1000, depth=50)
         loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
@@ -127,20 +193,24 @@ def bench_resnet50(args, use_amp=False, per_step_feed=False):
         rng = np.random.RandomState(0)
 
         def feed_fn():
-            return {
-                "img": rng.rand(batch, 3, 224, 224).astype("float32"),
-                "label": rng.randint(0, 1000, (batch, 1)).astype("int64"),
-            }
+            if per_step_feed:
+                im = rng.randint(0, 256, (batch, 3, 224, 224), "uint8")
+            else:
+                im = rng.rand(batch, 3, 224, 224).astype("float32")
+            return {"img": im,
+                    "label": rng.randint(0, 1000, (batch, 1)).astype(
+                        "int64")}
 
-        step_time = _bench_program(
+        step_time, stats = _bench_program(
             fluid.default_main_program(), fluid.default_startup_program(),
             feed_fn, loss, _place(args), args.iterations,
-            args.skip_batch_num, per_step_feed)
+            args.skip_batch_num, per_step_feed, model="resnet50",
+            batch=batch)
     ips = batch / step_time
-    return {"metric": "resnet50_images_per_sec" + _suffix(use_amp,
-                                                          per_step_feed),
-            "value": round(ips, 2), "unit": "images/sec",
-            "vs_baseline": round(ips / RESNET_TARGET, 4)}
+    return dict({"metric": "resnet50_images_per_sec" + _suffix(
+                     use_amp, per_step_feed),
+                 "value": round(ips, 2), "unit": "images/sec",
+                 "vs_baseline": round(ips / RESNET_TARGET, 4)}, **stats)
 
 
 def bench_transformer(args, use_amp=False, per_step_feed=False):
@@ -175,15 +245,17 @@ def bench_transformer(args, use_amp=False, per_step_feed=False):
                     "tgt_word": ids, "tgt_word@LEN": lens,
                     "lbl_word": ids, "lbl_word@LEN": lens}
 
-        step_time = _bench_program(
+        step_time, stats = _bench_program(
             fluid.default_main_program(), fluid.default_startup_program(),
             feed_fn, cost, _place(args), args.iterations,
-            args.skip_batch_num, per_step_feed)
+            args.skip_batch_num, per_step_feed, model="transformer",
+            batch=batch * seq_len)
     tps = batch * seq_len / step_time
-    return {"metric": "transformer_base_tokens_per_sec" + _suffix(
-                use_amp, per_step_feed),
-            "value": round(tps, 2), "unit": "tokens/sec",
-            "vs_baseline": round(tps / TRANSFORMER_TARGET, 4)}
+    return dict({"metric": "transformer_base_tokens_per_sec" + _suffix(
+                     use_amp, per_step_feed),
+                 "value": round(tps, 2), "unit": "tokens/sec",
+                 "vs_baseline": round(tps / TRANSFORMER_TARGET, 4)},
+                **stats)
 
 
 def _suffix(use_amp, per_step_feed):
@@ -214,9 +286,19 @@ def main():
     p.add_argument("--fp32_only", action="store_true")
     p.add_argument("--with_reader", action="store_true",
                    help="re-feed fresh host batches every step")
+    p.add_argument("--pallas", action="store_true",
+                   help="enable FLAGS_pallas_kernels (flash attention etc.)")
     args = p.parse_args()
 
+    if args.pallas:
+        import paddle_tpu as fluid
+        fluid.set_flags({"FLAGS_pallas_kernels": True})
+
     import jax
+    if args.device == "cpu":
+        # the axon TPU plugin overrides JAX_PLATFORMS at import time; the
+        # config update wins over it (same trick as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
     if args.device == "auto":
         args.device = (
             "tpu" if any(d.platform != "cpu" for d in jax.devices()) else "cpu"
@@ -235,8 +317,10 @@ def main():
         runs = [
             ("resnet50", []),
             ("resnet50", ["--fp32_only"]),
-            ("transformer", []),
-            ("transformer", ["--fp32_only"]),
+            # flash-attention Pallas kernel: measured 2.2x over the XLA
+            # attention under identical conditions (r3 A/B on the chip)
+            ("transformer", ["--pallas"]),
+            ("transformer", ["--fp32_only", "--pallas"]),
             ("resnet50", ["--with_reader"]),
         ]
         results = []
@@ -281,6 +365,9 @@ def main():
           "mlp": bench_mlp}[args.model]
     result = fn(args, use_amp=not args.fp32_only,
                 per_step_feed=args.with_reader)
+    # record the kernel choice so XLA-vs-Pallas A/Bs stay distinguishable
+    # in the artifact (metric names stay stable across rounds)
+    result["pallas"] = bool(args.pallas)
     print(json.dumps(result))
 
 

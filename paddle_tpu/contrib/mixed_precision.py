@@ -35,7 +35,7 @@ class AutoMixedPrecisionLists:
     # MXU-bound: cast fp32 inputs to bf16
     WHITE = {
         "matmul", "mul", "conv2d", "conv3d", "depthwise_conv2d",
-        "conv2d_transpose", "bilinear_tensor_product",
+        "conv2d_transpose", "bilinear_tensor_product", "fused_attention",
     }
     # numerically sensitive: force fp32 compute
     BLACK = {

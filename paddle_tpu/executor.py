@@ -111,14 +111,17 @@ def _feed_signature(feed):
     )
 
 
-def trace_program(program, feed_names, state_names, writeback, fetch_names):
+def trace_program(program, feed_names, state_names, writeback, fetch_names,
+                  platform=None, mesh=None):
     """Build the pure step function for ``program``'s global block:
     ``fn(feed_vals, state_vals, key) -> (fetches, new_state)``.
 
     This is the single lowering point shared by the single-device Executor,
     the mesh ParallelExecutor, and ``__graft_entry__`` — a Program becomes
     one traceable JAX function that pjit/jit compile to one HLO module.
-    Returns ``(fn, state_in, state_out)``.
+    ``platform`` names the executing device platform ("cpu"/"tpu") so
+    Pallas call sites pick mosaic vs interpret.  Returns
+    ``(fn, state_in, state_out)``.
     """
     block = program.global_block()
     ops = list(block.ops)
@@ -131,7 +134,7 @@ def trace_program(program, feed_names, state_names, writeback, fetch_names):
         env = {}
         env.update(zip(feed_names, feed_vals))
         env.update(zip(state_in, state_vals))
-        ctx = ComputeContext(key=key)
+        ctx = ComputeContext(key=key, platform=platform, mesh=mesh)
         ctx.program = program
         ctx.amp = getattr(program, '_amp_policy', None)
         for i, op in enumerate(ops):
@@ -213,7 +216,8 @@ class Executor:
 
     def _lower(self, program, feed_names, state_names, writeback, fetch_names):
         fn, state_in, state_out = trace_program(
-            program, feed_names, state_names, writeback, fetch_names
+            program, feed_names, state_names, writeback, fetch_names,
+            platform=self.place.jax_device().platform,
         )
         donate = (1,) if self.donate_state else ()
         jitted = jax.jit(fn, donate_argnums=donate)

@@ -17,6 +17,7 @@ __all__ = [
     "softmax",
     "cross_entropy",
     "softmax_with_cross_entropy",
+    "fused_attention",
     "one_hot",
     "topk",
     "matmul",
@@ -168,8 +169,12 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
 
 def softmax_with_cross_entropy(
     logits, label, soft_label=False, ignore_index=-100,
-    numeric_stable_mode=True, return_softmax=False,
+    numeric_stable_mode=True, return_softmax=False, label_smooth_eps=0.0,
 ):
+    """``label_smooth_eps`` is a TPU-side extension: uniform label smoothing
+    fused into the loss kernel (loss = (1-eps)*nll + eps*(lse - mean logits))
+    so the [N, C] one-hot/soft-label tensor the reference materializes
+    (one_hot + label_smooth + soft_label CE) never exists in HBM."""
     helper = LayerHelper("softmax_with_cross_entropy")
     softmax_out = helper.create_variable_for_type_inference(dtype=logits.dtype)
     loss = helper.create_variable_for_type_inference(dtype=logits.dtype)
@@ -177,11 +182,37 @@ def softmax_with_cross_entropy(
         type="softmax_with_cross_entropy",
         inputs={"Logits": [logits], "Label": [label]},
         outputs={"Softmax": [softmax_out], "Loss": [loss]},
-        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index,
+               "label_smooth_eps": float(label_smooth_eps)},
     )
     if return_softmax:
         return loss, softmax_out
     return loss
+
+
+def fused_attention(q, k, v, k_len=None, causal=False, dropout_rate=0.0,
+                    is_test=False, scale=None, name=None):
+    """Flash attention over head-split tensors q/k/v [B, H, T, D].
+
+    ``k_len`` [B] int masks padded key positions; ``causal`` adds the
+    autoregressive mask.  Never materializes the [B, H, Tq, Tk] score
+    matrix (reference ``nets.scaled_dot_product_attention`` does); runs
+    the Pallas kernel under FLAGS_pallas_kernels, an XLA fallback with
+    identical semantics otherwise."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(dtype=q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if k_len is not None:
+        inputs["KLen"] = [k_len]
+    attrs = {"causal": causal, "dropout_rate": float(dropout_rate),
+             "is_test": is_test}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(
+        type="fused_attention", inputs=inputs, outputs={"Out": [out]},
+        attrs=attrs,
+    )
+    return out
 
 
 def one_hot(input, depth):

@@ -33,8 +33,8 @@ def position_encoding_init(n_position, d_model):
     return enc.astype("float32")
 
 
-def _multi_head_attention(queries, keys, values, attn_bias, d_model, n_head,
-                          dropout_rate, is_test, cache_name):
+def _multi_head_attention(queries, keys, values, k_len, causal, d_model,
+                          n_head, dropout_rate, is_test, cache_name):
     d_key = d_model // n_head
     q = layers.fc(queries, size=d_model, num_flatten_dims=2, bias_attr=False,
                   name=cache_name + "_q")
@@ -48,15 +48,12 @@ def _multi_head_attention(queries, keys, values, attn_bias, d_model, n_head,
         return layers.transpose(r, perm=[0, 2, 1, 3])
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    q = layers.scale(q, scale=d_key ** -0.5)
-    product = layers.matmul(q, k, transpose_y=True)   # [B, H, Tq, Tk]
-    if attn_bias is not None:
-        product = layers.elementwise_add(product, attn_bias)
-    weights = layers.softmax(product)
-    if dropout_rate:
-        weights = layers.dropout(weights, dropout_prob=dropout_rate,
-                                 is_test=is_test)
-    ctx = layers.matmul(weights, v)                   # [B, H, Tq, dk]
+    # fused flash attention: structural masks (k_len padding + causal)
+    # instead of a materialized [B, H, Tq, Tk] additive bias; weight
+    # dropout happens inside the kernel (ops/attention.py)
+    ctx = layers.fused_attention(q, k, v, k_len=k_len, causal=causal,
+                                 dropout_rate=dropout_rate, is_test=is_test,
+                                 scale=d_key ** -0.5)
     ctx = layers.transpose(ctx, perm=[0, 2, 1, 3])
     ctx = layers.reshape(ctx, shape=[0, 0, d_model])
     return layers.fc(ctx, size=d_model, num_flatten_dims=2, bias_attr=False,
@@ -107,21 +104,15 @@ def _prepare_embedding(word, pos_table_name, vocab_size, d_model, max_len,
     return out
 
 
-def _attn_bias_from_len(len_var, ref, n_head):
-    """[B] lengths -> [B, 1, 1, T] additive bias (0 valid / -1e9 pad)."""
-    return layers.padding_attn_bias(len_var, ref)
-
-
 def wrap_encoder(src_word, src_max_len, vocab_size, n_layer=6, n_head=8,
                  d_model=512, d_inner=2048, dropout_rate=0.1, is_test=False):
     src_len = src_word.block._find_var_recursive(src_word._seq_len_name)
     enc_in = _prepare_embedding(src_word, "src_pos_enc", vocab_size, d_model,
                                 src_max_len, dropout_rate, is_test, "src")
-    bias = _attn_bias_from_len(src_len, enc_in, n_head)
     x = enc_in
     for i in range(n_layer):
-        attn = _multi_head_attention(x, x, x, bias, d_model, n_head,
-                                     dropout_rate, is_test,
+        attn = _multi_head_attention(x, x, x, src_len, False, d_model,
+                                     n_head, dropout_rate, is_test,
                                      "enc%d_attn" % i)
         x = _post_process(x, attn, dropout_rate, is_test)
         ffn = _ffn(x, d_inner, d_model, is_test, dropout_rate,
@@ -138,18 +129,13 @@ def wrap_decoder(tgt_word, enc_out, tgt_max_len, vocab_size, n_layer=6,
     src_len = enc_out.block._find_var_recursive(enc_out._seq_len_name)
     dec_in = _prepare_embedding(tgt_word, "tgt_pos_enc", vocab_size, d_model,
                                 tgt_max_len, dropout_rate, is_test, "tgt")
-    self_bias = _attn_bias_from_len(tgt_len, dec_in, n_head)
-    causal = layers.causal_mask(ref=dec_in)
-    self_bias = layers.elementwise_add(self_bias, causal)
-    cross_bias = _attn_bias_from_len(src_len, enc_out, n_head)
-
     x = dec_in
     for i in range(n_layer):
-        self_attn = _multi_head_attention(x, x, x, self_bias, d_model,
+        self_attn = _multi_head_attention(x, x, x, tgt_len, True, d_model,
                                           n_head, dropout_rate, is_test,
                                           "dec%d_self" % i)
         x = _post_process(x, self_attn, dropout_rate, is_test)
-        cross = _multi_head_attention(x, enc_out, enc_out, cross_bias,
+        cross = _multi_head_attention(x, enc_out, enc_out, src_len, False,
                                       d_model, n_head, dropout_rate,
                                       is_test, "dec%d_cross" % i)
         x = _post_process(x, cross, dropout_rate, is_test)
@@ -173,13 +159,11 @@ def transformer(src_word, tgt_word, label, src_max_len, tgt_max_len,
                           is_test)
     # label: [B, T, 1] int64 ids (padded); mask from tgt lengths
     tgt_len = tgt_word.block._find_var_recursive(tgt_word._seq_len_name)
-    if label_smooth_eps:
-        oh = layers.one_hot(label, depth=tgt_vocab_size)
-        soft = layers.label_smooth(oh, epsilon=label_smooth_eps)
-        cost = layers.softmax_with_cross_entropy(logits, soft,
-                                                 soft_label=True)
-    else:
-        cost = layers.softmax_with_cross_entropy(logits, label)
+    # uniform smoothing fused into the loss kernel: the reference's
+    # one_hot + label_smooth + soft-label CE materializes a [B, T, V]
+    # soft-label tensor (0.5 GB at the benchmark shapes) three times
+    cost = layers.softmax_with_cross_entropy(
+        logits, label, label_smooth_eps=label_smooth_eps)
     mask = layers.padding_mask(tgt_len, logits)  # [B,T]
     mask3 = layers.unsqueeze(mask, axes=[2])
     masked = layers.elementwise_mul(cost, mask3)

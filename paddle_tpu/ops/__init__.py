@@ -7,6 +7,7 @@ the program-level jit, with Pallas bodies for selected hot ops.
 
 from . import (  # noqa: F401
     activation,
+    attention,
     control_flow,
     conv,
     creation,

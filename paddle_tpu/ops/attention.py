@@ -1,0 +1,131 @@
+"""Fused attention op — the single-chip flash-attention surface.
+
+Capability parity target: the reference's only attention implementation,
+``nets.scaled_dot_product_attention`` (``python/paddle/fluid/nets.py:323``) —
+batched QK^T, softmax, optional dropout on the weights, PV.  TPU-first
+redesign: one op whose kernel never materializes the [B, H, Tq, Tk] score
+matrix.  Under ``FLAGS_pallas_kernels`` it runs the hand-tiled blockwise
+kernel (``ops/pallas/flash_attention.py``); otherwise an XLA fallback with
+identical semantics (same structural masks, same counter-hash dropout mask),
+so the flag changes schedule, not math.
+
+Masking is structural: an optional per-batch valid-key count ``KLen`` [B]
+(the ``<name>@LEN`` companion of the key sequence) and a ``causal`` attr —
+the two shapes every Transformer mask reduces to.  Eval-time dropout follows
+the reference's ``downgrade_in_infer``: weights scale by (1 - p), which
+commutes with the PV matmul into a single output scale.
+"""
+
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var
+
+
+def _fused_attention_infer(op, block):
+    q = in_var(op, block, "Q")
+    set_output(op, block, "Out", q.shape, q.dtype)
+
+
+def _fused_attention_compute(ins, attrs, ctx, op_index):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    k_len = ins.get("KLen", [None])[0]
+    causal = attrs.get("causal", False)
+    rate = float(attrs.get("dropout_rate", 0.0))
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    scale = attrs.get("scale", None)
+    seed = None
+    if rate and not is_test:
+        import jax
+        kd = jax.random.key_data(ctx.rng_key(op_index)).astype(jnp.uint32)
+        seed = kd.reshape(-1)[0] ^ kd.reshape(-1)[-1]
+
+    from .pallas import flash_attention as fa
+
+    if rate and is_test:
+        # downgrade_in_infer: weights *= (1-p) == output *= (1-p)
+        post = 1.0 - rate
+        rate = 0.0
+    else:
+        post = None
+
+    mesh = getattr(ctx, "mesh", None)
+    if mesh is not None and _ring_applicable(mesh, q.shape, k.shape, causal):
+        out = _ring_attention(mesh, q, k, v, k_len, seed, causal, rate,
+                              scale)
+    else:
+        from ..flags import flag
+        if flag("pallas_kernels") and fa.supported(q.shape, k.shape,
+                                                   q.dtype):
+            from .pallas import interpret_mode
+            out = fa.flash_attention(q, k, v, k_len, seed, causal, rate,
+                                     scale, interpret_mode(ctx))
+        else:
+            out = fa.reference_attention(q, k, v, k_len, seed, causal, rate,
+                                         scale)
+    if post is not None:
+        out = out * jnp.asarray(post, out.dtype)
+    return {"Out": out}
+
+
+def _ring_applicable(mesh, q_shape, k_shape, causal):
+    """Ring attention lowers this op when the mesh has a populated ``sp``
+    axis and the sequence dims divide it (the ParallelExecutor threads the
+    mesh into the trace exactly when its BuildStrategy allows sp)."""
+    from ..parallel.mesh import AXIS_SP
+
+    if AXIS_SP not in mesh.axis_names:
+        return False
+    sp = mesh.shape[AXIS_SP]
+    if sp <= 1:
+        return False
+    b, _, tq, _ = q_shape
+    tk = k_shape[2]
+    if tq % sp or tk % sp:
+        return False
+    if causal and tq != tk:
+        return False
+    return True
+
+
+def _ring_attention(mesh, q, k, v, k_len, seed, causal, rate, scale):
+    """Lower to sequence-parallel ring attention over the mesh's ``sp``
+    axis (parallel/ring_attention.py), composing with ``dp`` batch
+    sharding when the batch divides it.  Masks and dropout use GLOBAL
+    positions, so the result is loss-parity-exact with the single-chip
+    kernel."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_DP, AXIS_SP, shard_map_norep
+    from ..parallel.ring_attention import ring_attention_shard
+
+    b = q.shape[0]
+    tk = k.shape[2]
+    bspec = None
+    if AXIS_DP in mesh.axis_names and mesh.shape[AXIS_DP] > 1 \
+            and b % mesh.shape[AXIS_DP] == 0:
+        bspec = AXIS_DP
+    if k_len is None:
+        k_len = jnp.full((b,), tk, jnp.int32)
+    if seed is None:
+        seed = jnp.zeros((), jnp.uint32)
+    body = functools.partial(
+        ring_attention_shard, axis_name=AXIS_SP, causal=causal, scale=scale,
+        dropout_rate=rate, batch_axis_name=bspec)
+
+    def shard_body(q, k, v, klen, seed):
+        return body(q, k, v, k_len=klen, seed=seed)
+
+    spec = P(bspec, None, AXIS_SP, None)
+    fn = shard_map_norep(
+        shard_body, mesh,
+        in_specs=(spec, spec, spec, P(bspec), P()), out_specs=spec)
+    return fn(q, k, v, k_len.astype(jnp.int32), seed.astype(jnp.uint32))
+
+
+register_op(
+    "fused_attention", ["Q", "K", "V", "KLen"], ["Out"],
+    infer=_fused_attention_infer, compute=_fused_attention_compute,
+    no_grad_inputs=("KLen",), stateful_random=True,
+)

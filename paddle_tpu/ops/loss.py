@@ -59,6 +59,22 @@ def _swce_infer(op, block):
 
 def _swce_compute(ins, attrs, ctx, op_index):
     logits, label = ins["Logits"][0], ins["Label"][0]
+    eps = float(attrs.get("label_smooth_eps", 0.0))
+    if eps and not attrs.get("soft_label", False):
+        # fused uniform label smoothing: target = (1-eps)*onehot + eps/C;
+        # loss = (1-eps)*nll + eps*(lse - mean(logits)).  Keeps the [N, C]
+        # soft-label tensor out of HBM (vs one_hot + label_smooth +
+        # soft_label CE, which materializes it three times).
+        lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+        log_sm = logits - lse
+        idx = label if label.shape[-1] == 1 else label[..., None]
+        picked = jnp.take_along_axis(log_sm, idx.astype(jnp.int32), axis=-1)
+        uniform = lse[..., 0:1] - jnp.mean(logits, axis=-1, keepdims=True)
+        loss = (1.0 - eps) * -picked + eps * uniform
+        ignore = attrs.get("ignore_index", -100)
+        if ignore != -100:
+            loss = jnp.where(idx == ignore, 0.0, loss)
+        return {"Softmax": jnp.exp(log_sm), "Loss": loss}
     if not attrs.get("soft_label", False) and \
             attrs.get("ignore_index", -100) == -100:
         # Pallas path has no ignore mask; only take it when no index is
@@ -70,7 +86,7 @@ def _swce_compute(ins, attrs, ctx, op_index):
             flat = logits.reshape(-1, logits.shape[-1])
             lbl = label.reshape(-1)
             loss, softmax = px.softmax_xent(flat, lbl,
-                                            interpret_mode())
+                                            interpret_mode(ctx))
             return {"Softmax": softmax.reshape(logits.shape),
                     "Loss": loss.reshape(logits.shape[:-1] + (1,))}
     log_sm = jax.nn.log_softmax(logits, axis=-1)

@@ -5,8 +5,10 @@ Parity: reference ``mul_op.cc``, ``matmul_op.cc``, ``sum_op.cc``,
 ``squared_l2_norm_op.cc``, ``l1_norm_op.cc``, ``sign_op.cc``,
 ``minus_op.cc``, ``cos_sim_op.cc``, ``isfinite_op.cc`` — TPU-native: every
 matmul lowers to a single ``jnp.matmul``/``lax.dot_general`` so XLA tiles it
-onto the MXU; bf16/fp16 inputs keep fp32 accumulation via
-``preferred_element_type``.
+onto the MXU.  fp16 inputs request explicit fp32 accumulation via
+``preferred_element_type``; bf16 inputs keep bf16 outputs (the MXU
+accumulates partial products in fp32 internally) so backward cotangents
+stay bf16 — see ``_mm_accum_dtype``.
 """
 
 import numpy as np
@@ -29,7 +31,12 @@ def _flatten_to_2d(x, num_col_dims):
 
 
 def _mm_accum_dtype(a, b):
-    if a.dtype in (jnp.bfloat16, jnp.float16):
+    # bf16 operands keep bf16 outputs: the TPU MXU accumulates partial
+    # products in fp32 internally regardless, and requesting an explicit
+    # fp32 output (then downcasting) makes every backward cotangent fp32
+    # — the transposed dots then run as fp32*bf16, off the fast bf16 MXU
+    # pipeline.  fp16 (GPU-style AMP) still gets explicit fp32 accumulation.
+    if a.dtype == jnp.float16:
         return jnp.float32
     return None
 

@@ -261,24 +261,14 @@ def _dmap_match_image(dets, dlen, gts, glen, thresh, eval_difficult):
     sdets = dets[order]
     svalid = det_valid[order]
 
-    # det boxes are clipped to [0, 1] before overlap (ClipBBox)
+    # det boxes are clipped to [0, 1] before overlap (ClipBBox); shared
+    # pairwise-IoU kernel (clamped intersection = 0 for disjoint boxes,
+    # matching JaccardOverlap)
+    from .detection import _iou_matrix
+
     box = jnp.clip(sdets[:, 2:6], 0.0, 1.0)
     gbox = gts[:, 1:5]
-    ix1 = jnp.maximum(box[:, None, 0], gbox[None, :, 0])
-    iy1 = jnp.maximum(box[:, None, 1], gbox[None, :, 1])
-    ix2 = jnp.minimum(box[:, None, 2], gbox[None, :, 2])
-    iy2 = jnp.minimum(box[:, None, 3], gbox[None, :, 3])
-    # JaccardOverlap: 0 when disjoint, signed product otherwise
-    disjoint = (gbox[None, :, 0] > box[:, None, 2]) | \
-        (gbox[None, :, 2] < box[:, None, 0]) | \
-        (gbox[None, :, 1] > box[:, None, 3]) | \
-        (gbox[None, :, 3] < box[:, None, 1])
-    inter = (ix2 - ix1) * (iy2 - iy1)
-    area_d = (box[:, 2] - box[:, 0]) * (box[:, 3] - box[:, 1])
-    area_g = (gbox[:, 2] - gbox[:, 0]) * (gbox[:, 3] - gbox[:, 1])
-    union = area_d[:, None] + area_g[None, :] - inter
-    iou = jnp.where(disjoint | (union <= 0), 0.0,
-                    inter / jnp.where(union <= 0, 1.0, union))
+    iou = _iou_matrix(box, gbox)
     same_cls = sdets[:, 0, None] == gts[None, :, 0]
     iou = jnp.where(same_cls & gt_valid[None, :], iou, -1.0)
 

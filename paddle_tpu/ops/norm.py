@@ -110,7 +110,7 @@ def _ln_compute(ins, attrs, ctx, op_index):
             d = int(np.prod(x.shape[axis:]))
             flat = x.reshape(-1, d)
             y = pln.layer_norm(flat, scale.reshape(d), bias.reshape(d),
-                               float(eps), interpret_mode())
+                               float(eps), interpret_mode(ctx))
             # Mean/Variance side outputs recomputed cheaply (fetch-only
             # parity outputs; XLA dead-code-eliminates them when unused)
             red = tuple(range(axis, x.ndim))

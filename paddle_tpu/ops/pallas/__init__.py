@@ -27,8 +27,17 @@ def on_tpu():
         return False
 
 
-def interpret_mode():
-    """Interpreter fallback for non-TPU backends (tests on CPU)."""
+def interpret_mode(ctx=None):
+    """Interpreter fallback for non-TPU execution.
+
+    The decision must follow the device the *executor* places the step on
+    (``ctx.platform``, threaded from the Place at trace time), not global
+    device presence: a CPUPlace run on a machine whose TPU plugin is loaded
+    would otherwise emit Mosaic kernels into a CPU-lowered module and fail.
+    """
+    platform = getattr(ctx, "platform", None) if ctx is not None else None
+    if platform is not None:
+        return platform != "tpu"
     return not on_tpu()
 
 

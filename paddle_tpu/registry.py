@@ -46,10 +46,17 @@ OPS = {}
 class ComputeContext:
     """Per-trace context handed to kernels: PRNG key material and flags."""
 
-    def __init__(self, key=None, is_test=False):
+    def __init__(self, key=None, is_test=False, platform=None, mesh=None):
         self._key = key
         self.is_test = is_test
         self.amp = None  # AMPPolicy (contrib.mixed_precision) or None
+        # the executing device's platform ("cpu"/"tpu"), threaded from the
+        # executor's Place so Pallas call sites pick mosaic vs interpret
+        self.platform = platform
+        # the ParallelExecutor's device mesh (None single-device): ops with
+        # mesh-aware lowerings (fused_attention -> ring attention over sp)
+        # consult it at trace time
+        self.mesh = mesh
 
     def rng_key(self, op_index):
         if self._key is None:

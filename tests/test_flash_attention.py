@@ -1,0 +1,248 @@
+"""Flash-attention kernel + fused_attention op tests.
+
+Parity oracle: a plain materialized softmax-attention (the reference's
+``nets.scaled_dot_product_attention`` math, ``nets.py:323``) — the Pallas
+kernel (interpret mode on CPU) and the XLA fallback must both match it
+forward and backward, under padding masks, causal masks, and dropout
+(the dropout mask is a shared counter hash, so the two paths agree
+exactly)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.pallas import flash_attention as fa
+
+
+def _oracle(q, k, v, k_len=None, causal=False, scale=None):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / (d ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q * scale, k)
+    mask = jnp.ones((b, 1, tq, tk), bool)
+    if k_len is not None:
+        mask = jnp.arange(tk)[None, None, None, :] < k_len.reshape(b, 1, 1, 1)
+    if causal:
+        mask = mask & (jnp.arange(tq)[:, None] >=
+                       jnp.arange(tk)[None, :])[None, None]
+    s = jnp.where(mask, s, -1e30)
+    y = jax.nn.softmax(s, axis=-1)
+    y = jnp.where(mask, y, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", y, v)
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randn(*shape).astype("float32"))
+
+
+@pytest.mark.parametrize("tq,tk,causal", [
+    (16, 16, False), (16, 16, True),
+    (24, 40, False),          # non-multiple-of-block lengths, cross shape
+    (64, 64, True),
+])
+def test_fwd_parity(tq, tk, causal):
+    if causal and tq != tk:
+        pytest.skip("causal needs tq == tk")
+    q = _rand((2, 3, tq, 8), 0)
+    k = _rand((2, 3, tk, 8), 1)
+    v = _rand((2, 3, tk, 8), 2)
+    out = fa.flash_attention(q, k, v, None, None, causal, 0.0, None, True)
+    ref = _oracle(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    # XLA fallback agrees too
+    fb = fa.reference_attention(q, k, v, None, None, causal, 0.0, None)
+    np.testing.assert_allclose(fb, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fwd_klen_padding():
+    q, k, v = _rand((3, 2, 16, 8), 0), _rand((3, 2, 16, 8), 1), \
+        _rand((3, 2, 16, 8), 2)
+    k_len = jnp.asarray([16, 7, 1], jnp.int32)
+    out = fa.flash_attention(q, k, v, k_len, None, False, 0.0, None, True)
+    ref = _oracle(q, k, v, k_len=k_len)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fully_masked_rows_are_zero_and_grad_safe():
+    # causal + k_len=0 would be degenerate; here: k_len smaller than some
+    # query positions under causal gives rows with zero valid keys only if
+    # k_len == 0 — use k_len 0 on one batch element
+    q, k, v = _rand((2, 1, 8, 4), 0), _rand((2, 1, 8, 4), 1), \
+        _rand((2, 1, 8, 4), 2)
+    k_len = jnp.asarray([8, 0], jnp.int32)
+
+    def f(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, k_len, None, False, 0.0,
+                                          None, True) ** 2)
+
+    out = fa.flash_attention(q, k, v, k_len, None, False, 0.0, None, True)
+    assert np.all(np.asarray(out[1]) == 0.0)
+    grads = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grad_parity(causal):
+    q, k, v = _rand((2, 2, 16, 8), 0), _rand((2, 2, 16, 8), 1), \
+        _rand((2, 2, 16, 8), 2)
+    k_len = jnp.asarray([16, 11], jnp.int32)
+    w = _rand((2, 2, 16, 8), 3)   # nonuniform cotangent
+
+    def f_flash(q, k, v):
+        return jnp.sum(w * fa.flash_attention(q, k, v, k_len, None, causal,
+                                              0.0, None, True))
+
+    def f_ref(q, k, v):
+        return jnp.sum(w * _oracle(q, k, v, k_len=k_len, causal=causal))
+
+    gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
+
+def test_dropout_fwd_and_grad_match_fallback():
+    """Pallas path and XLA fallback share the counter-hash dropout mask:
+    outputs and gradients agree exactly (same math, different schedule)."""
+    q, k, v = _rand((2, 2, 16, 8), 0), _rand((2, 2, 16, 8), 1), \
+        _rand((2, 2, 16, 8), 2)
+    seed = jnp.asarray(1234, jnp.uint32)
+    rate = 0.4
+
+    def f_pl(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, None, seed, False, rate,
+                                          None, True) ** 2)
+
+    def f_fb(q, k, v):
+        return jnp.sum(fa.reference_attention(q, k, v, None, seed, False,
+                                              rate) ** 2)
+
+    out_pl = fa.flash_attention(q, k, v, None, seed, False, rate, None, True)
+    out_fb = fa.reference_attention(q, k, v, None, seed, False, rate)
+    np.testing.assert_allclose(out_pl, out_fb, rtol=1e-5, atol=1e-5)
+    g_pl = jax.grad(f_pl, argnums=(0, 1, 2))(q, k, v)
+    g_fb = jax.grad(f_fb, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pl, g_fb):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+    # different seeds give different masks
+    out2 = fa.flash_attention(q, k, v, None, seed + 1, False, rate, None,
+                              True)
+    assert not np.allclose(out_pl, out2)
+
+
+def test_dropout_expectation_matches_infer_scale():
+    """downgrade_in_infer: E[train dropout(y)] = (1-p)*y, which is exactly
+    the (1-p) scale the op applies at eval — train/eval consistent."""
+    q, k, v = _rand((1, 1, 32, 8), 0), _rand((1, 1, 32, 8), 1), \
+        _rand((1, 1, 32, 8), 2)
+    rate = 0.3
+    outs = [fa.reference_attention(q, k, v, None,
+                                   jnp.asarray(s, jnp.uint32), False, rate)
+            for s in range(40)]
+    mean = np.mean([np.asarray(o) for o in outs], axis=0)
+    base = (1.0 - rate) * np.asarray(_oracle(q, k, v))
+    np.testing.assert_allclose(mean, base, rtol=0.3, atol=0.12)
+
+
+def _attention_program(use_fused, dropout_rate=0.0):
+    """fused_attention op vs the manual matmul+softmax composition."""
+    b, h, t, d = 2, 2, 8, 4
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        q = fluid.layers.data("q", shape=[h, t, d])
+        k = fluid.layers.data("k", shape=[h, t, d])
+        v = fluid.layers.data("vv", shape=[h, t, d])
+        klen = fluid.layers.data("klen", shape=[], dtype="int32")
+        if use_fused:
+            out = fluid.layers.fused_attention(
+                q, k, v, k_len=klen, causal=True,
+                dropout_rate=dropout_rate)
+        else:
+            s = fluid.layers.matmul(q, k, transpose_y=True)
+            s = fluid.layers.scale(s, scale=d ** -0.5)
+            # padding_attn_bias/causal_mask take T from ref dim 1
+            ref = fluid.layers.transpose(q, perm=[0, 2, 1, 3])  # [B,T,H,D]
+            bias = fluid.layers.padding_attn_bias(klen, ref)
+            s = fluid.layers.elementwise_add(s, bias)
+            causal = fluid.layers.causal_mask(ref=ref)
+            s = fluid.layers.elementwise_add(s, causal)
+            w = fluid.layers.softmax(s)
+            out = fluid.layers.matmul(w, v)
+        rng = np.random.RandomState(7)
+        feed = {"q": rng.randn(b, h, t, d).astype("float32"),
+                "k": rng.randn(b, h, t, d).astype("float32"),
+                "vv": rng.randn(b, h, t, d).astype("float32"),
+                "klen": np.asarray([t, t - 3], "int32")}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            return exe.run(feed=feed, fetch_list=[out])[0]
+
+
+def test_fused_attention_op_matches_composition():
+    fused = _attention_program(True)
+    manual = _attention_program(False)
+    np.testing.assert_allclose(fused, manual, rtol=1e-4, atol=1e-4)
+
+
+def test_fused_attention_op_pallas_flag():
+    base = _attention_program(True)
+    fluid.set_flags({"FLAGS_pallas_kernels": True})
+    try:
+        pallas = _attention_program(True)
+    finally:
+        fluid.set_flags({"FLAGS_pallas_kernels": False})
+    np.testing.assert_allclose(base, pallas, rtol=1e-4, atol=1e-4)
+
+
+def test_label_smooth_fused_matches_composition():
+    n, c, eps = 6, 11, 0.1
+    rng = np.random.RandomState(0)
+    logits_np = rng.randn(n, c).astype("float32")
+    label_np = rng.randint(0, c, (n, 1)).astype("int64")
+
+    def run(fused):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            logits = fluid.layers.data("logits", shape=[c])
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            if fused:
+                loss = fluid.layers.softmax_with_cross_entropy(
+                    logits, label, label_smooth_eps=eps)
+            else:
+                oh = fluid.layers.one_hot(label, depth=c)
+                soft = fluid.layers.label_smooth(oh, epsilon=eps)
+                loss = fluid.layers.softmax_with_cross_entropy(
+                    logits, soft, soft_label=True)
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(fluid.default_startup_program())
+                return exe.run(feed={"logits": logits_np, "label": label_np},
+                               fetch_list=[loss])[0]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
+
+
+def test_transformer_emits_fused_attention():
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        from paddle_tpu.models import transformer as tfm
+        src = fluid.layers.data("src_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        tgt = fluid.layers.data("tgt_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        lbl = fluid.layers.data("lbl_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        cost, _ = tfm.transformer(src, tgt, lbl, 16, 16, 64, 64, n_layer=2,
+                                  n_head=2, d_model=16, d_inner=32,
+                                  dropout_rate=0.1)
+        ops = [op.type for op in
+               fluid.default_main_program().global_block().ops]
+        # 2 enc self + 2 dec self + 2 cross = 6 fused attentions
+        assert ops.count("fused_attention") == 6
+        # the fused label-smoothing path: no [B, T, V] one_hot materialized
+        assert "one_hot" not in ops

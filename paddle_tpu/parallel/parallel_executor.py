@@ -147,7 +147,10 @@ class ParallelExecutor:
         state_names, writeback = Executor._analyze(
             exe, program, feed_names, scope)
         fn, state_in, state_out = trace_program(
-            program, feed_names, state_names, writeback, fetch_names)
+            program, feed_names, state_names, writeback, fetch_names,
+            platform=self._mesh.devices.flat[0].platform,
+            mesh=self._mesh if self._build_strategy.sequence_parallel
+            else None)
 
         mesh = self._mesh
         batch_spec = P(AXIS_DP)

@@ -38,12 +38,23 @@ __all__ = ["ring_attention", "ring_attention_shard"]
 _NEG_INF = -1e30
 
 
-def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None):
+def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None,
+                         k_len=None, dropout_rate=0.0, seed=None,
+                         batch_axis_name=None):
     """Per-device ring attention body (run under shard_map).
 
     q [B, H, Tq, D] local query block; k/v [B, H, Tk, D] local key/value
     blocks.  Streams K/V around the ``axis_name`` ring; returns the
     local attention output [B, H, Tq, D].
+
+    ``k_len`` [B] masks padded key positions (global valid-key counts for
+    this shard's batch rows); ``dropout_rate``/``seed`` apply the same
+    counter-hash weight dropout as the single-chip fused_attention op
+    (``ops/pallas/flash_attention._keep_mask`` on GLOBAL positions, so a
+    ring run reproduces a single-chip run's mask bit-for-bit —
+    downgrade_in_infer semantics: masked, not upscaled).
+    ``batch_axis_name`` names the mesh axis the batch is sharded over, so
+    the hash's global (batch*head) index stays correct under dp.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -61,26 +72,47 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None):
     perm = [(j, (j - 1) % n) for j in range(n)]
 
     q_pos = idx * tq + jnp.arange(tq)             # global query positions
+    masked = causal or k_len is not None
+    if dropout_rate:
+        from ..ops.pallas.flash_attention import _keep_mask
+        if seed is None:
+            seed = jnp.zeros((), jnp.uint32)
+        b_off = 0
+        if batch_axis_name is not None:
+            b_off = lax.axis_index(batch_axis_name) * b
+        # global (batch*head) index per row, same layout as single-chip
+        bh_idx = ((b_off + jnp.arange(b))[:, None] * h +
+                  jnp.arange(h)[None, :])[:, :, None, None]
 
     def step(i, carry):
         k_blk, v_blk, m, l, o = carry
         kv_owner = (idx + i) % n
+        k_pos = kv_owner * tk + jnp.arange(tk)    # global key positions
         s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
                        preferred_element_type=jnp.float32)
-        if causal:
-            k_pos = kv_owner * tk + jnp.arange(tk)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, _NEG_INF)
+        if masked:
+            valid = jnp.ones((b, 1, tq, tk), bool)
+            if k_len is not None:
+                valid = k_pos[None, None, None, :] < \
+                    k_len.astype(jnp.int32)[:, None, None, None]
+            if causal:
+                valid = valid & \
+                    (q_pos[:, None] >= k_pos[None, :])[None, None]
+            s = jnp.where(valid, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if masked:
             # a fully-masked row keeps m_new == _NEG_INF, so exp(s - m_new)
             # is 1.0 per masked key; zero them explicitly rather than rely
             # on the diagonal block (tq == tk at step 0) being seen first —
             # ring_attention guarantees that, standalone shard use may not
-            p = jnp.where(mask[None, None], p, 0.0)
+            p = jnp.where(valid, p, 0.0)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate:
+            keep = _keep_mask(seed.astype(jnp.uint32), bh_idx,
+                              q_pos[:, None], k_pos[None, :], dropout_rate)
+            p = jnp.where(keep, p, 0.0)
         o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk,
                                   preferred_element_type=jnp.float32)
 

@@ -40,6 +40,10 @@ class BuildStrategy:
         # sharding or replicate; feeds: batch dim over dp).
         self.param_sharding_fn = None
         self.feed_sharding_fn = None
+        # sp: lower fused_attention ops to ring attention (context
+        # parallelism) when the mesh has a populated `sp` axis.  On by
+        # default — it only activates when an sp axis exists.
+        self.sequence_parallel = True
 
 
 class ExecutionStrategy:

@@ -112,7 +112,7 @@ def _feed_signature(feed):
 
 
 def trace_program(program, feed_names, state_names, writeback, fetch_names,
-                  platform=None, mesh=None):
+                  platform=None, mesh=None, sequence_parallel=True):
     """Build the pure step function for ``program``'s global block:
     ``fn(feed_vals, state_vals, key) -> (fetches, new_state)``.
 
@@ -135,6 +135,7 @@ def trace_program(program, feed_names, state_names, writeback, fetch_names,
         env.update(zip(feed_names, feed_vals))
         env.update(zip(state_in, state_vals))
         ctx = ComputeContext(key=key, platform=platform, mesh=mesh)
+        ctx.sequence_parallel = sequence_parallel
         ctx.program = program
         ctx.amp = getattr(program, '_amp_policy', None)
         for i, op in enumerate(ops):

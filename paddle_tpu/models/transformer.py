@@ -105,43 +105,76 @@ def _prepare_embedding(word, pos_table_name, vocab_size, d_model, max_len,
 
 
 def wrap_encoder(src_word, src_max_len, vocab_size, n_layer=6, n_head=8,
-                 d_model=512, d_inner=2048, dropout_rate=0.1, is_test=False):
+                 d_model=512, d_inner=2048, dropout_rate=0.1, is_test=False,
+                 pipeline_microbatches=None):
+    """``pipeline_microbatches``: stage each encoder layer into a
+    ``layers.Pipeline`` region (one stage per layer) so the model runs as
+    a GPipe schedule when the ParallelExecutor's mesh has a ``pp`` axis
+    of size ``n_layer`` — same losses either way."""
     src_len = src_word.block._find_var_recursive(src_word._seq_len_name)
     enc_in = _prepare_embedding(src_word, "src_pos_enc", vocab_size, d_model,
                                 src_max_len, dropout_rate, is_test, "src")
-    x = enc_in
-    for i in range(n_layer):
+
+    def enc_layer(x, i):
         attn = _multi_head_attention(x, x, x, src_len, False, d_model,
                                      n_head, dropout_rate, is_test,
                                      "enc%d_attn" % i)
         x = _post_process(x, attn, dropout_rate, is_test)
         ffn = _ffn(x, d_inner, d_model, is_test, dropout_rate,
                    "enc%d_ffn" % i)
-        x = _post_process(x, ffn, dropout_rate, is_test)
+        return _post_process(x, ffn, dropout_rate, is_test)
+
+    x = enc_in
+    if pipeline_microbatches:
+        pipe = layers.Pipeline(microbatches=pipeline_microbatches)
+        for i in range(n_layer):
+            with pipe.stage():
+                h = pipe.carry(x if i == 0 else None)
+                pipe.side(src_len)
+                pipe.emit(enc_layer(h, i))
+        x = pipe()
+    else:
+        for i in range(n_layer):
+            x = enc_layer(x, i)
     x._seq_len_name = src_word._seq_len_name
     return x
 
 
 def wrap_decoder(tgt_word, enc_out, tgt_max_len, vocab_size, n_layer=6,
                  n_head=8, d_model=512, d_inner=2048, dropout_rate=0.1,
-                 is_test=False):
+                 is_test=False, pipeline_microbatches=None):
     tgt_len = tgt_word.block._find_var_recursive(tgt_word._seq_len_name)
     src_len = enc_out.block._find_var_recursive(enc_out._seq_len_name)
     dec_in = _prepare_embedding(tgt_word, "tgt_pos_enc", vocab_size, d_model,
                                 tgt_max_len, dropout_rate, is_test, "tgt")
-    x = dec_in
-    for i in range(n_layer):
+
+    def dec_layer(x, enc, i):
         self_attn = _multi_head_attention(x, x, x, tgt_len, True, d_model,
                                           n_head, dropout_rate, is_test,
                                           "dec%d_self" % i)
         x = _post_process(x, self_attn, dropout_rate, is_test)
-        cross = _multi_head_attention(x, enc_out, enc_out, src_len, False,
+        cross = _multi_head_attention(x, enc, enc, src_len, False,
                                       d_model, n_head, dropout_rate,
                                       is_test, "dec%d_cross" % i)
         x = _post_process(x, cross, dropout_rate, is_test)
         ffn = _ffn(x, d_inner, d_model, is_test, dropout_rate,
                    "dec%d_ffn" % i)
-        x = _post_process(x, ffn, dropout_rate, is_test)
+        return _post_process(x, ffn, dropout_rate, is_test)
+
+    x = dec_in
+    if pipeline_microbatches:
+        pipe = layers.Pipeline(microbatches=pipeline_microbatches)
+        for i in range(n_layer):
+            with pipe.stage():
+                h = pipe.carry(x if i == 0 else None)
+                pipe.side(tgt_len)
+                pipe.side(src_len)
+                enc = pipe.side(enc_out)   # per-microbatch cross K/V
+                pipe.emit(dec_layer(h, enc, i))
+        x = pipe()
+    else:
+        for i in range(n_layer):
+            x = dec_layer(x, enc_out, i)
     logits = layers.fc(x, size=vocab_size, num_flatten_dims=2,
                        name="dec_logits")
     return logits
@@ -150,13 +183,18 @@ def wrap_decoder(tgt_word, enc_out, tgt_max_len, vocab_size, n_layer=6,
 def transformer(src_word, tgt_word, label, src_max_len, tgt_max_len,
                 src_vocab_size, tgt_vocab_size, n_layer=6, n_head=8,
                 d_model=512, d_inner=2048, dropout_rate=0.1,
-                label_smooth_eps=0.1, is_test=False):
-    """Full train graph: returns (avg_cost, logits)."""
+                label_smooth_eps=0.1, is_test=False,
+                pipeline_microbatches=None):
+    """Full train graph: returns (avg_cost, logits).
+
+    ``pipeline_microbatches`` stages the encoder and decoder stacks into
+    two GPipe regions (one stage per layer) for ``pp`` meshes."""
     enc_out = wrap_encoder(src_word, src_max_len, src_vocab_size, n_layer,
-                           n_head, d_model, d_inner, dropout_rate, is_test)
+                           n_head, d_model, d_inner, dropout_rate, is_test,
+                           pipeline_microbatches)
     logits = wrap_decoder(tgt_word, enc_out, tgt_max_len, tgt_vocab_size,
                           n_layer, n_head, d_model, d_inner, dropout_rate,
-                          is_test)
+                          is_test, pipeline_microbatches)
     # label: [B, T, 1] int64 ids (padded); mask from tgt lengths
     tgt_len = tgt_word.block._find_var_recursive(tgt_word._seq_len_name)
     # uniform smoothing fused into the loss kernel: the reference's

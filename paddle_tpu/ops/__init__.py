@@ -21,6 +21,7 @@ from . import (  # noqa: F401
     metric,
     norm,
     optimizer_ops,
+    pipeline_region,
     pool,
     quantize,
     random,

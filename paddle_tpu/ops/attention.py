@@ -49,7 +49,8 @@ def _fused_attention_compute(ins, attrs, ctx, op_index):
         post = None
 
     mesh = getattr(ctx, "mesh", None)
-    if mesh is not None and _ring_applicable(mesh, q.shape, k.shape, causal):
+    if mesh is not None and getattr(ctx, "sequence_parallel", True) \
+            and _ring_applicable(mesh, q.shape, k.shape, causal):
         out = _ring_attention(mesh, q, k, v, k_len, seed, causal, rate,
                               scale)
     else:

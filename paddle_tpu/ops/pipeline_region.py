@@ -1,0 +1,264 @@
+"""pipeline_region op — GPipe over the ``pp`` mesh axis, from the Program.
+
+Lowering of ``layers.Pipeline`` (no reference analog; SURVEY.md §2.4 lists
+pipeline parallelism as absent upstream).  The op owns a sub-block whose
+ops are partitioned into S structurally-identical stages.  Two kernels:
+
+* single-device (or no populated ``pp`` axis): run the stages
+  sequentially per microbatch — the semantic ground truth.
+* mesh with ``pp`` axis of size S (threaded by ParallelExecutor as
+  ``ctx.mesh``): classic GPipe — per-stage parameters stack on a leading
+  stage dim sharded over ``pp``, activations flow stage-to-stage with
+  ``ppermute``, one ``lax.fori_loop`` of M + S - 1 ticks.
+
+Both kernels execute the SAME stage template (stage 0's op list bound to
+stage s's parameters) with the SAME per-stage PRNG fold, so dropout masks
+— and therefore losses — are bit-identical between the sequential and
+pipelined schedules when the batch is not dp-sharded inside the region
+(dp == 1) or the region draws no randomness.  With dp > 1 the microbatch
+slices shard over dp (each replica pipelines its own slice — no redundant
+compute) and in-stage random draws decorrelate per dp shard.  Microbatches
+share one dropout mask by design (the mask is drawn per stage, not per
+microbatch) in both modes.
+
+Gradients ride the registry's generic auto-vjp: the backward op re-runs
+this kernel under ``jax.vjp``, which differentiates the fori_loop +
+ppermute schedule — microbatch gradient accumulation IS the autodiff of
+the loop.  Inside stages the mesh is NOT re-exposed (no nested sp ring
+inside pp; sequence parallelism composes with dp instead).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import registry
+from ..registry import ComputeContext, register_op, set_output, in_var
+
+
+def _pipeline_infer(op, block):
+    c = in_var(op, block, "Carry")
+    set_output(op, block, "Out", c.shape, c.dtype)
+
+
+def _stage_ctx(ctx, base_key, stage_idx):
+    sub = ComputeContext(
+        key=None if base_key is None else jax.random.fold_in(base_key,
+                                                             stage_idx),
+        is_test=getattr(ctx, "is_test", False),
+        platform=getattr(ctx, "platform", None))
+    sub.program = ctx.program
+    sub.amp = getattr(ctx, "amp", None)
+    return sub
+
+
+def _stage_param_names(ops, param_set):
+    seen, out = set(), []
+    for o in ops:
+        for n in o.input_arg_names:
+            if n in param_set and n not in seen:
+                seen.add(n)
+                out.append(n)
+    return out
+
+
+def _stage_signature(ops, carry_in, carry_out, stage_params, side_names,
+                     const_set):
+    """Canonical structure of one stage: op types, attrs, and each
+    input/output name's ROLE (not its spelling)."""
+    pidx = {n: j for j, n in enumerate(stage_params)}
+    sides = set(side_names)
+    local = {}                      # name -> (producer op idx, slot, pos)
+
+    def role(n):
+        if n == carry_in:
+            return ("carry",)
+        if n in pidx:
+            return ("param", pidx[n])
+        if n in sides:
+            return ("side", n)      # sides are shared: names must match
+        if n in const_set:
+            return ("const", n)
+        if n in local:
+            return ("local",) + local[n]
+        return ("extern", n)
+
+    sig = []
+    for i, o in enumerate(ops):
+        ins_sig = tuple(
+            (slot, tuple(role(n) for n in names))
+            for slot, names in sorted(o.inputs.items()))
+        attrs_sig = tuple(sorted(
+            (k, tuple(v) if isinstance(v, list) else v)
+            for k, v in o.attrs.items()))
+        sig.append((o.type, ins_sig, attrs_sig))
+        for slot, names in sorted(o.outputs.items()):
+            for pos, n in enumerate(names):
+                if n:
+                    local[n] = (i, slot, pos)
+    sig.append(("__carry_out__", role(carry_out)))
+    return sig
+
+
+def _pipeline_compute(ins, attrs, ctx, op_index):
+    program = ctx.program
+    sub = program.block(attrs["sub_block"])
+    s_count = attrs["stages"]
+    bounds = attrs["stage_bounds"]
+    carry0 = ins["Carry"][0]
+    b = carry0.shape[0]
+    m = attrs.get("microbatches") or s_count
+    if b % m:
+        raise ValueError(
+            "pipeline_region: microbatches (%d) must divide the batch (%d)"
+            % (m, b))
+    mb = b // m
+
+    side_names = list(attrs["side_names"]) + \
+        list(attrs.get("int_side_names", []))
+    side_vals = list(ins.get("Sides", [])) + list(ins.get("IntSides", []))
+    param_names = attrs["param_names"]
+    param_vals = dict(zip(param_names, ins.get("Params", [])))
+    const_env = dict(zip(attrs["const_names"], ins.get("Consts", [])))
+
+    ranges = [(0 if i == 0 else bounds[i - 1], bounds[i])
+              for i in range(s_count)]
+    stage_ops = [sub.ops[a:e] for a, e in ranges]
+    param_set = set(param_names)
+    per_stage = [_stage_param_names(ops, param_set) for ops in stage_ops]
+    t_ops = stage_ops[0]
+    t_params = per_stage[0]
+    # structural identity is checked on a FULL signature — op types,
+    # attrs, and the role of every input/output name (carry / param slot /
+    # side / const / stage-local producer).  Type-only comparison would
+    # let e.g. per-stage dropout rates or a side-var swap silently run
+    # stage 0's template with wrong math on every stage.
+    sigs = [_stage_signature(stage_ops[s], attrs["carry_in_names"][s],
+                             attrs["carry_out_names"][s], per_stage[s],
+                             side_names, set(attrs["const_names"]))
+            for s in range(s_count)]
+    for s in range(1, s_count):
+        if sigs[s] != sigs[0]:
+            for j, (a, b2) in enumerate(zip(sigs[s], sigs[0])):
+                if a != b2:
+                    raise ValueError(
+                        "pipeline_region stages must be structurally "
+                        "identical: stage %d differs from stage 0 at "
+                        "element %d:\n  stage %d: %s\n  stage 0: %s"
+                        % (s, j, s, a, b2))
+            raise ValueError(
+                "pipeline_region: stage %d signature length differs "
+                "from stage 0" % s)
+    stacked = []
+    for j in range(len(t_params)):
+        vals = [param_vals[per_stage[s][j]] for s in range(s_count)]
+        shapes = {tuple(v.shape) for v in vals}
+        if len(shapes) != 1:
+            raise ValueError(
+                "param %r (slot %d) has mismatched shapes across stages: "
+                "%s" % (t_params[j], j, sorted(shapes)))
+        stacked.append(jnp.stack(vals))
+
+    carry_in0 = attrs["carry_in_names"][0]
+    carry_out0 = attrs["carry_out_names"][0]
+    base_key = None
+    try:
+        base_key = ctx.rng_key(op_index)
+    except RuntimeError:
+        pass
+
+    def stage_fn(stage_idx, pvals, carry, sides_mb, key_extra=None):
+        env = dict(const_env)
+        env.update(zip(t_params, pvals))
+        env.update(zip(side_names, sides_mb))
+        env[carry_in0] = carry
+        key = base_key
+        if key is not None and key_extra is not None:
+            # dp-sharded schedule: decorrelate in-stage random draws per
+            # dp shard (each shard sees a different batch slice)
+            key = jax.random.fold_in(key, key_extra)
+        sctx = _stage_ctx(ctx, key, stage_idx)
+        for j, o in enumerate(t_ops):
+            registry.compute_op(o, env, sctx, op_index=j)
+        return env[carry_out0].astype(carry0.dtype)
+
+    side_mb = [v.reshape((m, mb) + tuple(v.shape[1:])) for v in side_vals]
+    x_mb = carry0.reshape((m, mb) + tuple(carry0.shape[1:]))
+
+    mesh = getattr(ctx, "mesh", None)
+    pp_ok = False
+    if mesh is not None:
+        from ..parallel.mesh import AXIS_PP
+        pp_ok = AXIS_PP in mesh.axis_names and \
+            mesh.shape[AXIS_PP] == s_count and s_count > 1
+    if not pp_ok:
+        # sequential ground truth: same template, same PRNG folds
+        outs = []
+        for t in range(m):
+            c = x_mb[t]
+            for s in range(s_count):
+                c = stage_fn(s, [p[s] for p in stacked], c,
+                             [sv[t] for sv in side_mb])
+            outs.append(c)
+        out = jnp.stack(outs).reshape(carry0.shape)
+        return {"Out": out}
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_DP, AXIS_PP, shard_map_norep
+
+    # shard the microbatch batch dim over dp so dp replicas process their
+    # own batch slices through the pipeline (instead of redundantly
+    # recomputing the full batch); in-stage random draws then differ per
+    # dp shard (sequential parity remains exact when dp == 1 or the
+    # region draws no randomness)
+    dp = mesh.shape.get(AXIS_DP, 1) if hasattr(mesh.shape, "get") else (
+        mesh.shape[AXIS_DP] if AXIS_DP in mesh.axis_names else 1)
+    dp_sharded = AXIS_DP in mesh.axis_names and dp > 1 and mb % dp == 0
+    mb_spec = P(None, AXIS_DP) if dp_sharded else P()
+
+    def body(stacked_local, x_mb, side_mb):
+        s_idx = lax.axis_index(AXIS_PP)
+        my_params = [p[0] for p in stacked_local]
+        extra = lax.axis_index(AXIS_DP) if dp_sharded else None
+
+        def tick(t, st):
+            cur, outs = st
+            fresh = x_mb[jnp.clip(t, 0, m - 1)]
+            cur = jnp.where(s_idx == 0, fresh, cur)
+            my_mb = jnp.clip(t - s_idx, 0, m - 1)
+            sides_t = [lax.dynamic_index_in_dim(v, my_mb, 0,
+                                                keepdims=False)
+                       for v in side_mb]
+            out = stage_fn(s_idx, my_params, cur, sides_t, extra)
+            done = t - (s_count - 1)
+            take = (s_idx == s_count - 1) & (done >= 0)
+            updated = lax.dynamic_update_index_in_dim(
+                outs, out, jnp.clip(done, 0, m - 1), 0)
+            outs = jnp.where(take, updated, outs)
+            nxt = lax.ppermute(out, AXIS_PP,
+                               [(j, (j + 1) % s_count)
+                                for j in range(s_count)])
+            return nxt, outs
+
+        outs0 = jnp.zeros_like(x_mb)
+        cur0 = jnp.zeros_like(x_mb[0])
+        _, outs = lax.fori_loop(0, m + s_count - 1, tick, (cur0, outs0))
+        # broadcast the last stage's collected outputs to every device
+        mask = (s_idx == s_count - 1).astype(outs.dtype)
+        return lax.psum(outs * mask, AXIS_PP)
+
+    fn = shard_map_norep(
+        body, mesh,
+        in_specs=([P(AXIS_PP)] * len(stacked), mb_spec,
+                  [mb_spec] * len(side_mb)),
+        out_specs=mb_spec)
+    outs = fn(stacked, x_mb, side_mb)
+    return {"Out": outs.reshape(carry0.shape)}
+
+
+register_op(
+    "pipeline_region", ["Carry", "Sides", "IntSides", "Params", "Consts"],
+    ["Out"], infer=_pipeline_infer, compute=_pipeline_compute,
+    no_grad_inputs=("IntSides",), stateful_random=True,
+)

@@ -148,9 +148,8 @@ class ParallelExecutor:
             exe, program, feed_names, scope)
         fn, state_in, state_out = trace_program(
             program, feed_names, state_names, writeback, fetch_names,
-            platform=self._mesh.devices.flat[0].platform,
-            mesh=self._mesh if self._build_strategy.sequence_parallel
-            else None)
+            platform=self._mesh.devices.flat[0].platform, mesh=self._mesh,
+            sequence_parallel=self._build_strategy.sequence_parallel)
 
         mesh = self._mesh
         batch_spec = P(AXIS_DP)

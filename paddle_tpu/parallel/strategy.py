@@ -42,7 +42,9 @@ class BuildStrategy:
         self.feed_sharding_fn = None
         # sp: lower fused_attention ops to ring attention (context
         # parallelism) when the mesh has a populated `sp` axis.  On by
-        # default — it only activates when an sp axis exists.
+        # default — it only activates when an sp axis exists.  Gates ONLY
+        # the attention ring lowering; other mesh-aware lowerings
+        # (pipeline_region over pp) always see the mesh.
         self.sequence_parallel = True
 
 

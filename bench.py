@@ -317,8 +317,10 @@ def main():
         runs = [
             ("resnet50", []),
             ("resnet50", ["--fp32_only"]),
-            # flash-attention Pallas kernel: measured 2.2x over the XLA
-            # attention under identical conditions (r3 A/B on the chip)
+            # flash-attention + fused-CE Pallas kernels: ~10% over the XLA
+            # path at these shapes in same-conditions A/B (150.5k vs
+            # 135.9k tok/s, r3); the kernels' bigger role is avoiding
+            # O(T^2)/[B,T,V] HBM intermediates
             ("transformer", ["--pallas"]),
             ("transformer", ["--fp32_only", "--pallas"]),
             ("resnet50", ["--with_reader"]),

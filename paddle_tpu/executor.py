@@ -285,7 +285,8 @@ class Executor:
         ]
         seed = program.random_seed or 0
         rng = jax.random.key(
-            np.uint32(seed) if seed else np.random.randint(0, 2**31 - 1)
+            np.uint32(seed) if seed else np.random.randint(0, 2**31 - 1),
+            impl="rbg" if flags.flag("fast_prng") else None,
         )
         rng = jax.random.fold_in(rng, self._run_counter)
         self._run_counter += 1

@@ -107,6 +107,15 @@ def _on_cpu_deterministic(val):
 register_flag("check_nan_inf", False, bool)
 # opt-in hand-tiled Pallas kernels for hot ops (ops/pallas/)
 register_flag("pallas_kernels", False, bool)
+# rbg counter PRNG for in-graph randomness (dropout masks etc.):
+# cheaper random bits on TPU than the default threefry; different (but
+# still deterministic-per-seed) random streams.  Measured neutral on the
+# bench transformer — kept as an opt-in knob.
+register_flag("fast_prng", False, bool)
+# sequence-length gate for the flash-attention Pallas kernel: longer
+# sequences fall back to the XLA attention (see
+# ops/pallas/flash_attention.supported)
+register_flag("pallas_attention_max_seq", 2048, int)
 register_flag("debug_nans", False, bool, _on_debug_nans)
 register_flag("benchmark", False, bool)
 register_flag("cpu_deterministic", False, bool, _on_cpu_deterministic)

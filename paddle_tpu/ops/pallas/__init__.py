@@ -50,6 +50,10 @@ def block_rows(n, row_bytes, max_rows, vmem_budget=4 * 1024 * 1024):
     """
     bn = max(1, vmem_budget // max(row_bytes, 1))
     bn = min(bn, max(n, 1), max_rows)
+    # Mosaic requires the sublane (second-to-last) block dim be a multiple
+    # of 8 (or equal the array dim): round down to 8-aligned, minimum 8 —
+    # tiny n still pads up to one 8-row block
+    bn = max(8, (bn // 8) * 8)
     n_padded = ((n + bn - 1) // bn) * bn
     return bn, n_padded
 

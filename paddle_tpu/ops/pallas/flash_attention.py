@@ -233,17 +233,27 @@ def supported(q_shape, k_shape, dtype):
     tk = k_shape[2]
     if tq < 1 or tk < 1 or d < 1 or d > 512:
         return False
+    from ...flags import flag
+
+    # beyond this length the whole-model compile through the remote TPU
+    # compile service has been observed to fail even though the kernel
+    # alone compiles (verified to T=4096); the XLA fallback handles long
+    # single-chip sequences and ring attention (sp) scales further
+    if max(tq, tk) > flag("pallas_attention_max_seq"):
+        return False
     bq, tq_pad, bk, tk_pad = _pick_blocks(tq, tk)
     itemsize = 2 if dtype == jnp.bfloat16 else 4
     # the worst resident set is the dK/dV kernel: full K/V blocks plus the
     # full padded Q, dO, lse, delta per (b, h) grid step — budget THAT,
     # not just the forward (a Tq >> Tk cross-attention would otherwise
-    # pass the gate and blow VMEM at backward compile time)
+    # pass the gate and blow VMEM at backward compile time).  Pallas
+    # DOUBLE-BUFFERS every grid block (including the whole-row K/V
+    # "blocks"), so the resident set counts twice.
     resident = 2 * tk_pad * d * itemsize              # K + V per (b, h)
     resident += 2 * tq_pad * d * itemsize             # Q + dO (dkv kernel)
     resident += 2 * tq_pad * 4                        # lse + delta
     blocks = (3 * bq * d + 2 * bq * bk) * 4           # O block + scores
-    return resident + blocks < 10 * 1024 * 1024
+    return 2 * (resident + blocks) < 10 * 1024 * 1024
 
 
 def _pad_t(x, t_pad):

@@ -24,9 +24,9 @@ from jax.experimental import pallas as pl
 from . import block_rows, pad_rows
 
 
-def _fwd_kernel(logits_ref, label_ref, loss_ref, softmax_ref):
+def _fwd_kernel(logits_ref, label_ref, loss_ref, softmax_ref, *, eps):
     x = logits_ref[...]                      # [BN, C]
-    lbl = label_ref[...]                     # [BN]
+    lbl = label_ref[...][:, 0]               # [BN, 1] -> [BN]
     m = jnp.max(x, axis=-1, keepdims=True)
     e = jnp.exp(x - m)
     s = jnp.sum(e, axis=-1, keepdims=True)
@@ -36,35 +36,50 @@ def _fwd_kernel(logits_ref, label_ref, loss_ref, softmax_ref):
     onehot = lbl[:, None] == jax.lax.broadcasted_iota(jnp.int32,
                                                       (1, c), 1)
     picked = jnp.sum(jnp.where(onehot, x, 0.0), axis=-1, keepdims=True)
-    loss_ref[...] = log_z - picked
+    if eps:
+        # fused uniform label smoothing: target (1-eps)*onehot + eps/C
+        # -> loss = (1-eps)*(logZ - picked) + eps*(logZ - mean(x))
+        mean_x = jnp.mean(x, axis=-1, keepdims=True)
+        loss_ref[...] = (1.0 - eps) * (log_z - picked) + \
+            eps * (log_z - mean_x)
+    else:
+        loss_ref[...] = log_z - picked
     softmax_ref[...] = softmax
 
 
-def _bwd_kernel(softmax_ref, label_ref, dloss_ref, dsm_ref, dlogits_ref):
+def _bwd_kernel(softmax_ref, label_ref, dloss_ref, dsm_ref, dlogits_ref, *,
+                eps):
     sm = softmax_ref[...]
-    lbl = label_ref[...]
+    lbl = label_ref[...][:, 0]               # [BN, 1] -> [BN]
     g = dloss_ref[...]                       # [BN, 1]
     dsm = dsm_ref[...]                       # [BN, C]
     c = sm.shape[-1]
     onehot = (lbl[:, None] == jax.lax.broadcasted_iota(jnp.int32, (1, c),
                                                        1)).astype(sm.dtype)
+    if eps:
+        target = (1.0 - eps) * onehot + eps / c
+    else:
+        target = onehot
     # loss path + softmax-output path (softmax Jacobian-vector product)
     inner = jnp.sum(dsm * sm, axis=-1, keepdims=True)
-    dlogits_ref[...] = (sm - onehot) * g + sm * (dsm - inner)
+    dlogits_ref[...] = (sm - target) * g + sm * (dsm - inner)
 
 
 def _specs(bn, c):
+    # label rides as [N, 1] (2-D): Mosaic requires the last two block dims
+    # be (8, 128)-aligned or equal to the array dims — a 1-D (bn,) block
+    # over [N] fails that check on real TPU
     return [pl.BlockSpec((bn, c), lambda i: (i, 0)),
-            pl.BlockSpec((bn,), lambda i: (i,))]
+            pl.BlockSpec((bn, 1), lambda i: (i, 0))]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def softmax_xent(logits, label, interpret=False):
-    loss, softmax = _fwd(logits, label, interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_xent(logits, label, interpret=False, label_smooth_eps=0.0):
+    loss, softmax = _fwd(logits, label, interpret, label_smooth_eps)[0]
     return loss, softmax
 
 
-def _fwd(logits, label, interpret):
+def _fwd(logits, label, interpret, eps=0.0):
     n, c = logits.shape
     if n == 0:
         z = jnp.zeros((0, 1), logits.dtype), jnp.zeros((0, c),
@@ -72,7 +87,7 @@ def _fwd(logits, label, interpret):
         return z, (z[1], label)
     bn, n_pad = block_rows(n, row_bytes=2 * c * 4, max_rows=256)
     loss, softmax = pl.pallas_call(
-        _fwd_kernel,
+        functools.partial(_fwd_kernel, eps=eps),
         grid=(n_pad // bn,),
         in_specs=_specs(bn, c),
         out_specs=[pl.BlockSpec((bn, 1), lambda i: (i, 0)),
@@ -80,12 +95,13 @@ def _fwd(logits, label, interpret):
         out_shape=[jax.ShapeDtypeStruct((n_pad, 1), logits.dtype),
                    jax.ShapeDtypeStruct((n_pad, c), logits.dtype)],
         interpret=interpret,
-    )(pad_rows(logits, n_pad), pad_rows(label.astype(jnp.int32), n_pad))
+    )(pad_rows(logits, n_pad),
+      pad_rows(label.astype(jnp.int32).reshape(n, 1), n_pad))
     loss, softmax = loss[:n], softmax[:n]
     return (loss, softmax), (softmax, label)
 
 
-def _bwd(interpret, res, cts):
+def _bwd(interpret, eps, res, cts):
     softmax, label = res
     dloss, dsm = cts
     n, c = softmax.shape
@@ -93,7 +109,7 @@ def _bwd(interpret, res, cts):
         return jnp.zeros((0, c), softmax.dtype), None
     bn, n_pad = block_rows(n, row_bytes=3 * c * 4, max_rows=256)
     dlogits = pl.pallas_call(
-        _bwd_kernel,
+        functools.partial(_bwd_kernel, eps=eps),
         grid=(n_pad // bn,),
         in_specs=_specs(bn, c) + [
             pl.BlockSpec((bn, 1), lambda i: (i, 0)),
@@ -101,7 +117,8 @@ def _bwd(interpret, res, cts):
         out_specs=pl.BlockSpec((bn, c), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n_pad, c), softmax.dtype),
         interpret=interpret,
-    )(pad_rows(softmax, n_pad), pad_rows(label.astype(jnp.int32), n_pad),
+    )(pad_rows(softmax, n_pad),
+      pad_rows(label.astype(jnp.int32).reshape(n, 1), n_pad),
       pad_rows(dloss, n_pad), pad_rows(dsm, n_pad))
     return dlogits[:n], None
 

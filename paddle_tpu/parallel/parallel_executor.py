@@ -295,7 +295,9 @@ class ParallelExecutor:
                 for n, s in zip(compiled.state_in, compiled.state_shardings)
             ]
         seed = program.random_seed or 0
-        rng = jax.random.key(np.uint32(seed) if seed else self._auto_seed())
+        rng = jax.random.key(
+            np.uint32(seed) if seed else self._auto_seed(),
+            impl="rbg" if flags.flag("fast_prng") else None)
         rng = jax.random.fold_in(rng, self._run_counter)
         self._run_counter += 1
 

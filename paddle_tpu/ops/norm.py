@@ -36,50 +36,143 @@ def _bn_infer(op, block):
     set_output(op, block, "SavedVariance", (c,), x.dtype)
 
 
+def _bn_axes(x, attrs):
+    """(c_axis, reduction axes, broadcast shape) for a BN input under the
+    op's data_layout — shared by forward and the fused backward so the
+    two can never disagree on reduction axes."""
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = [1] * x.ndim
+    bshape[c_axis] = x.shape[c_axis]
+    return c_axis, red_axes, bshape
+
+
 def _bn_compute(ins, attrs, ctx, op_index):
     x = ins["X"][0]
     scale, bias = ins["Scale"][0], ins["Bias"][0]
     mean, var = ins["Mean"][0], ins["Variance"][0]
     eps = attrs.get("epsilon", 1e-5)
     momentum = attrs.get("momentum", 0.9)
-    layout = attrs.get("data_layout", "NCHW")
     is_test = attrs.get("is_test", False) or attrs.get("use_global_stats",
                                                        False)
-    c_axis = 1 if layout == "NCHW" else x.ndim - 1
-    red_axes = tuple(i for i in range(x.ndim) if i != c_axis)
-    bshape = [1] * x.ndim
-    bshape[c_axis] = x.shape[c_axis]
+    c_axis, red_axes, bshape = _bn_axes(x, attrs)
 
+    # statistics accumulate in fp32 INSIDE the kernel regardless of the
+    # activation dtype, so bf16 activations flow through unconverted (the
+    # op is AMP-gray: blacklisting it would cost two full-activation cast
+    # passes around every conv) while running stats stay accurate.  XLA
+    # fuses the f32 cast into the reduction — no fp32 materialization.
+    xf = x.astype(jnp.float32)
     if is_test:
         use_mean, use_var = mean, var
         mean_out, var_out = mean, var
         saved_mean = mean
         saved_var = var
     else:
-        use_mean = jnp.mean(x, axis=red_axes)
+        use_mean = jnp.mean(xf, axis=red_axes)
         # two-pass variance: E[(x-mean)^2]; the one-pass E[x^2]-E[x]^2 form
         # cancels catastrophically in f32 for un-centered inputs and can go
         # negative -> rsqrt NaN
         use_var = jnp.mean(
-            jnp.square(x - use_mean.reshape(bshape)), axis=red_axes
+            jnp.square(xf - use_mean.reshape(bshape)), axis=red_axes
         )
         mean_out = momentum * mean + (1.0 - momentum) * use_mean
         var_out = momentum * var + (1.0 - momentum) * use_var
         saved_mean = use_mean
         saved_var = use_var
 
-    inv_std = lax.rsqrt(use_var + eps)
-    y = (x - use_mean.reshape(bshape)) * \
-        (inv_std * scale).reshape(bshape) + bias.reshape(bshape)
-    return {"Y": y, "MeanOut": mean_out, "VarianceOut": var_out,
-            "SavedMean": saved_mean, "SavedVariance": saved_var}
+    inv_std = lax.rsqrt(use_var.astype(jnp.float32) + eps)
+    y = (xf - use_mean.reshape(bshape).astype(jnp.float32)) * \
+        (inv_std * scale.astype(jnp.float32)).reshape(bshape) + \
+        bias.astype(jnp.float32).reshape(bshape)
+    return {"Y": y.astype(x.dtype), "MeanOut": mean_out,
+            "VarianceOut": var_out, "SavedMean": saved_mean,
+            "SavedVariance": saved_var}
+
+
+def _bn_grad_maker(op, no_grad_set):
+    """Hand-written fused BN backward (reference ``batch_norm_op.cu``'s
+    three-term kernel) instead of the generic vjp: differentiating the
+    recomputed two-pass variance costs ~2x the activation traffic of the
+    closed-form dx/dgamma/dbeta."""
+    from ..framework import grad_var_name
+
+    x = op.inputs["X"][0]
+    outs = {}
+    for slot, names in (("GRAD::X", op.inputs["X"]),
+                        ("GRAD::Scale", op.inputs["Scale"]),
+                        ("GRAD::Bias", op.inputs["Bias"])):
+        outs[slot] = ["" if n in no_grad_set else grad_var_name(n)
+                      for n in names]
+    if not any(n for ns in outs.values() for n in ns):
+        return []
+    return [dict(
+        type="batch_norm_grad",
+        inputs={"X": [x], "Scale": op.inputs["Scale"],
+                "Out::SavedMean": op.outputs["SavedMean"],
+                "Out::SavedVariance": op.outputs["SavedVariance"],
+                "GRAD::Y": [grad_var_name(op.outputs["Y"][0])]},
+        outputs=outs,
+        attrs=dict(op.attrs),
+    )]
+
+
+def _bn_grad_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    scale = ins["Scale"][0]
+    mean = ins["Out::SavedMean"][0]
+    var = ins["Out::SavedVariance"][0]
+    dy = ins["GRAD::Y"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    c_axis, red, bshape = _bn_axes(x, attrs)
+    n = 1
+    for i in red:
+        n *= x.shape[i]
+
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    mu = mean.astype(jnp.float32).reshape(bshape)
+    rstd = lax.rsqrt(var.astype(jnp.float32) + eps).reshape(bshape)
+    xhat = (xf - mu) * rstd
+    dbeta = jnp.sum(dyf, axis=red)
+    dgamma = jnp.sum(dyf * xhat, axis=red)
+    g = scale.astype(jnp.float32).reshape(bshape) * rstd
+    if attrs.get("is_test", False) or attrs.get("use_global_stats", False):
+        # running stats are constants w.r.t. x
+        dx = g * dyf
+    else:
+        # classic fused form: dx = g*(dy - mean(dy) - xhat*mean(dy*xhat))
+        dx = g * (dyf - (dbeta / n).reshape(bshape)
+                  - xhat * (dgamma / n).reshape(bshape))
+    return {"GRAD::X": dx.astype(x.dtype),
+            "GRAD::Scale": dgamma.astype(scale.dtype),
+            "GRAD::Bias": dbeta.astype(scale.dtype)}
 
 
 register_op(
     "batch_norm", ["X", "Scale", "Bias", "Mean", "Variance"],
     ["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
-    infer=_bn_infer, compute=_bn_compute,
+    infer=_bn_infer, compute=_bn_compute, grad=_bn_grad_maker,
     no_grad_inputs=("Mean", "Variance"),
+)
+
+def _bn_grad_infer(gop, block):
+    x = in_var(gop, block, "X")
+    scale = in_var(gop, block, "Scale")
+    for slot, ref in (("GRAD::X", x), ("GRAD::Scale", scale),
+                      ("GRAD::Bias", scale)):
+        for name in gop.outputs.get(slot, []):
+            if name:
+                block.create_var(name=name, shape=ref.shape,
+                                 dtype=ref.dtype, persistable=False)
+
+
+register_op(
+    "batch_norm_grad",
+    ["X", "Scale", "Out::SavedMean", "Out::SavedVariance", "GRAD::Y"],
+    ["GRAD::X", "GRAD::Scale", "GRAD::Bias"],
+    infer=_bn_grad_infer, compute=_bn_grad_compute, grad=None,
 )
 
 
@@ -120,15 +213,21 @@ def _ln_compute(ins, attrs, ctx, op_index):
                 axis=red)
             return {"Y": y.reshape(x.shape), "Mean": mean,
                     "Variance": var}
+    # statistics in fp32 regardless of activation dtype (AMP-gray op:
+    # bf16 activations pass through; XLA fuses the casts into the
+    # reduction/normalize chain)
     red = tuple(range(axis, x.ndim))
-    mean = jnp.mean(x, axis=red, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=red, keepdims=True)
-    y = (x - mean) * lax.rsqrt(var + eps)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=red, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + eps)
     if scale is not None:
-        y = y * scale.reshape((1,) * axis + x.shape[axis:])
+        y = y * scale.astype(jnp.float32).reshape(
+            (1,) * axis + x.shape[axis:])
     if bias is not None:
-        y = y + bias.reshape((1,) * axis + x.shape[axis:])
-    return {"Y": y, "Mean": mean.reshape(x.shape[:axis]),
+        y = y + bias.astype(jnp.float32).reshape(
+            (1,) * axis + x.shape[axis:])
+    return {"Y": y.astype(x.dtype), "Mean": mean.reshape(x.shape[:axis]),
             "Variance": var.reshape(x.shape[:axis])}
 
 

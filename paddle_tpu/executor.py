@@ -185,7 +185,9 @@ class Executor:
         return (id(program), program._version, program.random_seed, feed_sig,
                 tuple(fetch_names), id(scope),
                 getattr(program, '_amp_policy', None),
-                flags.flag("pallas_kernels"))  # trace-time kernel choice
+                # trace-time choices must key the cache: kernel selection
+                # and the BN variance form are both baked into the jaxpr
+                flags.flag("pallas_kernels"), flags.flag("bn_two_pass"))
 
     def _analyze(self, program, feed_names, scope):
         """Split program vars into feeds / state-from-scope / temporaries."""

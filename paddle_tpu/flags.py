@@ -113,6 +113,10 @@ register_flag("pallas_kernels", False, bool)
 # bench transformer: +34% tokens/s (threefry dropout masks were ~25% of
 # the step) — the bench enables it; default off for stream stability.
 register_flag("fast_prng", False, bool)
+# exact two-pass batch_norm variance (E[(x-mean)^2]) instead of the
+# default fused one-pass E[x^2]-E[x]^2 form; costs one extra full
+# activation read per BN (see ops/norm.py)
+register_flag("bn_two_pass", False, bool)
 # sequence-length gate for the flash-attention Pallas kernel: longer
 # sequences fall back to the XLA attention (see
 # ops/pallas/flash_attention.supported)

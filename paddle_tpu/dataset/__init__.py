@@ -7,8 +7,9 @@ offline generators."""
 from . import common, mnist, cifar, imdb, uci_housing, imikolov  # noqa: F401
 from . import conll05, movielens, wmt14, wmt16  # noqa: F401
 from . import flowers, sentiment, voc2012  # noqa: F401
+from . import image, mq2007  # noqa: F401
 from . import synthetic  # noqa: F401
 
 __all__ = ["common", "mnist", "cifar", "imdb", "uci_housing", "imikolov",
            "conll05", "movielens", "wmt14", "wmt16", "flowers",
-           "sentiment", "voc2012", "synthetic"]
+           "sentiment", "voc2012", "image", "mq2007", "synthetic"]

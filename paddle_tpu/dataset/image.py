@@ -1,0 +1,181 @@
+"""Image decode/augment helpers for the dataset readers.
+
+The input-pipeline preprocessing vocabulary of the reference
+(``python/paddle/dataset/image.py``: batch_images_from_tar,
+load_image/load_image_bytes, resize_short, center/random crop, flip,
+to_chw, simple_transform, load_and_transform) — original
+implementation.  Decoding uses cv2 when importable with a numpy/PIL
+fallback; the geometric transforms are pure numpy so the host-side
+pipeline (reader/decorator.py workers) has no hard native dependency.
+
+All functions take/return HWC uint8-or-float numpy arrays (color images
+BGR like the reference's cv2 convention) except ``to_chw``.
+"""
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+try:
+    import cv2
+except ImportError:  # pragma: no cover - cv2 present in this image
+    cv2 = None
+
+__all__ = [
+    "batch_images_from_tar", "load_image_bytes", "load_image",
+    "resize_short", "to_chw", "center_crop", "random_crop",
+    "left_right_flip", "simple_transform", "load_and_transform",
+]
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """Pack raw image bytes + labels from a tar into pickled batch files;
+    returns the meta file listing the batch paths (resumable: an existing
+    output directory short-circuits)."""
+    batch_dir = data_file + "_batch"
+    out_path = os.path.join(batch_dir, dataset_name)
+    meta_file = os.path.join(batch_dir, dataset_name + ".txt")
+    # the meta file is written LAST, so its existence is the completion
+    # marker; a run interrupted mid-pack leaves out_path without it and
+    # repacking resumes cleanly (overwriting the partial batches)
+    if os.path.exists(meta_file):
+        return meta_file
+    os.makedirs(out_path, exist_ok=True)
+
+    data, labels, file_id = [], [], 0
+
+    def flush():
+        nonlocal file_id, data, labels
+        if not data:
+            return
+        with open(os.path.join(out_path, "batch_%d" % file_id), "wb") as f:
+            pickle.dump({"label": labels, "data": data}, f, protocol=2)
+        file_id += 1
+        data, labels = [], []
+
+    with tarfile.open(data_file) as tf:
+        for mem in tf.getmembers():
+            if mem.name not in img2label:
+                continue
+            data.append(tf.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                flush()
+    flush()
+    with open(meta_file, "w") as meta:
+        for fn in sorted(os.listdir(out_path)):
+            meta.write(os.path.abspath(os.path.join(out_path, fn)) + "\n")
+    return meta_file
+
+
+def load_image_bytes(bytes, is_color=True):  # noqa: A002 - reference name
+    """Decode an encoded image buffer to an HWC (or HW) uint8 array."""
+    if cv2 is not None:
+        flag = cv2.IMREAD_COLOR if is_color else cv2.IMREAD_GRAYSCALE
+        buf = np.frombuffer(bytes, dtype=np.uint8)
+        return cv2.imdecode(buf, flag)
+    import io
+
+    from PIL import Image
+
+    im = Image.open(io.BytesIO(bytes))
+    im = im.convert("RGB" if is_color else "L")
+    arr = np.asarray(im)
+    return arr[:, :, ::-1] if is_color else arr  # match cv2's BGR
+
+
+def load_image(file, is_color=True):  # noqa: A002 - reference name
+    """Load an image file to an HWC (or HW) uint8 array."""
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color)
+
+
+def _resize(im, h, w):
+    if cv2 is not None:
+        return cv2.resize(im, (w, h), interpolation=cv2.INTER_LANCZOS4)
+    # numpy bilinear fallback
+    ih, iw = im.shape[:2]
+    ys = (np.arange(h) + 0.5) * ih / h - 0.5
+    xs = (np.arange(w) + 0.5) * iw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, ih - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, iw - 1)
+    y1 = np.minimum(y0 + 1, ih - 1)
+    x1 = np.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0).clip(0, 1)
+    wx = (xs - x0).clip(0, 1)
+    imf = im.astype(np.float32)
+    if im.ndim == 2:
+        top = imf[y0][:, x0] * (1 - wx) + imf[y0][:, x1] * wx
+        bot = imf[y1][:, x0] * (1 - wx) + imf[y1][:, x1] * wx
+    else:
+        wx = wx[:, None]
+        top = imf[y0][:, x0] * (1 - wx) + imf[y0][:, x1] * wx
+        bot = imf[y1][:, x0] * (1 - wx) + imf[y1][:, x1] * wx
+    wy = wy[:, None] if im.ndim == 2 else wy[:, None, None]
+    out = top * (1 - wy) + bot * wy
+    return out.astype(im.dtype)
+
+
+def resize_short(im, size):
+    """Resize so the shorter edge becomes ``size`` (aspect preserved)."""
+    h, w = im.shape[:2]
+    if h > w:
+        h, w = int(round(size * h / w)), size
+    else:
+        h, w = size, int(round(size * w / h))
+    return _resize(im, h, w)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """HWC -> CHW (the layout the NCHW feed path expects)."""
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    y = (h - size) // 2
+    x = (w - size) // 2
+    return im[y:y + size, x:x + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    y = np.random.randint(0, h - size + 1)
+    x = np.random.randint(0, w - size + 1)
+    return im[y:y + size, x:x + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize-short -> (random crop + coin-flip mirror | center crop) ->
+    CHW float32, optionally mean-subtracted (per-channel or
+    elementwise)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.array(mean, dtype=np.float32)
+        if mean.ndim == 1 and is_color:
+            mean = mean[:, np.newaxis, np.newaxis]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

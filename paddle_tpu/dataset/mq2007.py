@@ -1,0 +1,233 @@
+"""MQ2007 learning-to-rank dataset (LETOR 4.0, TREC 2007 Million Query).
+
+Reader creators over the LETOR text format
+(``<relevance> qid:<id> 1:<v> 2:<v> ... # comment``), yielding
+point-wise, pair-wise, or list-wise samples per query.
+
+Parity: reference ``python/paddle/dataset/mq2007.py`` (same public
+surface: Query/QueryList, gen_plain_txt/gen_point/gen_pair/gen_list,
+query_filter, load_from_text, train/test creators).  The parser and
+generators are original; the archive is a .rar, and this environment has
+no rar extractor, so ``fetch`` downloads the archive and extraction is
+the caller's (documented) responsibility unless the extracted tree
+already exists.
+"""
+
+import functools
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "fetch", "load_from_text", "query_filter",
+           "gen_plain_txt", "gen_point", "gen_pair", "gen_list",
+           "Query", "QueryList"]
+
+URL = ("http://www.bigdatalab.ac.cn/benchmark/upload/download_source/"
+       "7b6dbbe2-842c-11e4-a536-bcaec51b9163_MQ2007.rar")
+MD5 = "7be1640ae95c6408dab0ae7207bdc706"
+
+FEATURE_DIM = 46
+
+
+class Query(object):
+    """One query-document pair: relevance score, query id, dense feature
+    vector, and the trailing comment of its LETOR line."""
+
+    def __init__(self, query_id=-1, relevance_score=-1, feature_vector=None,
+                 description=""):
+        self.query_id = query_id
+        self.relevance_score = relevance_score
+        self.feature_vector = feature_vector if feature_vector is not None \
+            else []
+        self.description = description
+
+    def __str__(self):
+        feats = " ".join(
+            "%d:%s" % (i + 1, v)
+            for i, v in enumerate(self.feature_vector))
+        s = "%s qid:%d %s" % (self.relevance_score, self.query_id, feats)
+        if self.description:
+            s += " #" + self.description   # keep the line re-parseable
+        return s
+
+    @staticmethod
+    def parse(line, fill_missing=-1):
+        """Parse one LETOR line; returns a Query or None on a malformed
+        line.  Missing feature slots are filled with ``fill_missing``."""
+        line = line.strip()
+        if not line:
+            return None
+        body, _, comment = line.partition("#")
+        parts = body.split()
+        if len(parts) < 2 or not parts[1].startswith("qid:"):
+            return None
+        try:
+            rel = int(parts[0])
+            qid = int(parts[1][len("qid:"):])
+        except ValueError:
+            return None
+        feats = {}
+        for tok in parts[2:]:
+            idx, _, val = tok.partition(":")
+            try:
+                feats[int(idx)] = float(val)
+            except ValueError:
+                return None
+        dim = max(feats) if feats else 0
+        vec = [feats.get(i + 1, fill_missing) for i in range(dim)]
+        return Query(query_id=qid, relevance_score=rel, feature_vector=vec,
+                     description=comment.strip())
+
+    # reference-API spelling
+    def _parse_(self, line, fill_missing=-1):
+        return Query.parse(line, fill_missing)
+
+
+class QueryList(object):
+    """All documents of one query, ordered by relevance for the
+    list-wise generators."""
+
+    def __init__(self, querylist=None):
+        self.querylist = list(querylist) if querylist else []
+        self.query_id = self.querylist[0].query_id if self.querylist else -1
+
+    def __iter__(self):
+        return iter(self.querylist)
+
+    def __len__(self):
+        return len(self.querylist)
+
+    def __getitem__(self, i):
+        return self.querylist[i]
+
+    def _add_query(self, query):
+        if self.query_id == -1:
+            self.query_id = query.query_id
+        elif query.query_id != self.query_id:
+            raise ValueError(
+                "query id %d does not match list id %d"
+                % (query.query_id, self.query_id))
+        self.querylist.append(query)
+
+    def _correct_ranking_(self):
+        self.querylist.sort(key=lambda q: q.relevance_score, reverse=True)
+
+
+def gen_plain_txt(querylist):
+    """Yield (query_id, relevance, feature_vector) per document."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    for query in querylist:
+        yield querylist.query_id, query.relevance_score, \
+            np.array(query.feature_vector)
+
+
+def gen_point(querylist):
+    """Point-wise samples: (relevance, feature_vector)."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    for query in querylist:
+        yield query.relevance_score, np.array(query.feature_vector)
+
+
+def gen_pair(querylist, partial_order="full"):
+    """Pair-wise samples: (label=[1], higher_doc, lower_doc) over all
+    C(n,2) pairs with differing relevance ("full") or only adjacent
+    ranks ("neighbour")."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    n = len(querylist)
+    pairs = ((i, j) for i in range(n) for j in range(i + 1, n)) \
+        if partial_order == "full" else \
+        ((i, i + 1) for i in range(n - 1))
+    for i, j in pairs:
+        left, right = querylist[i], querylist[j]
+        if left.relevance_score == right.relevance_score:
+            continue
+        hi, lo = (left, right) \
+            if left.relevance_score > right.relevance_score else (right, left)
+        yield np.array([1]), np.array(hi.feature_vector), \
+            np.array(lo.feature_vector)
+
+
+def gen_list(querylist):
+    """List-wise sample: (relevance column, feature matrix) per query."""
+    if not isinstance(querylist, QueryList):
+        querylist = QueryList(querylist)
+    querylist._correct_ranking_()
+    labels = np.array([[q.relevance_score] for q in querylist])
+    feats = np.array([q.feature_vector for q in querylist])
+    yield labels, feats
+
+
+def query_filter(querylists):
+    """Drop queries whose documents are all relevance 0 (no ranking
+    signal)."""
+    return [ql for ql in querylists
+            if sum(q.relevance_score for q in ql) != 0]
+
+
+def load_from_text(filepath, shuffle=False, fill_missing=-1, data_dir=None):
+    """Parse a LETOR file into a list of QueryList.  ``filepath`` may be
+    absolute or relative to ``data_dir`` (default: the extracted MQ2007
+    tree next to the downloaded archive)."""
+    if not os.path.isabs(filepath):
+        base = data_dir if data_dir is not None else _data_home()
+        filepath = os.path.join(base, filepath)
+    querylists = []
+    current = None
+    with open(filepath) as f:
+        for line in f:
+            q = Query.parse(line, fill_missing)
+            if q is None:
+                continue
+            if current is None or q.query_id != current.query_id:
+                if current is not None:
+                    querylists.append(current)
+                current = QueryList()
+            current._add_query(q)
+    if current is not None:
+        querylists.append(current)
+    if shuffle:
+        np.random.shuffle(querylists)
+    return querylists
+
+
+def _data_home():
+    return os.path.dirname(fetch())
+
+
+def __reader__(filepath, format="pairwise", shuffle=False, fill_missing=-1):
+    querylists = query_filter(
+        load_from_text(filepath, shuffle=shuffle, fill_missing=fill_missing))
+    for querylist in querylists:
+        if format == "plain_txt":
+            yield next(gen_plain_txt(querylist))
+        elif format == "pointwise":
+            yield next(gen_point(querylist))
+        elif format == "pairwise":
+            for pair in gen_pair(querylist):
+                yield pair
+        elif format == "listwise":
+            yield next(gen_list(querylist))
+        else:
+            raise ValueError("unknown format %r" % format)
+
+
+train = functools.partial(__reader__,
+                          filepath="MQ2007/MQ2007/Fold1/train.txt")
+test = functools.partial(__reader__, filepath="MQ2007/MQ2007/Fold1/test.txt")
+
+
+def fetch():
+    """Download the MQ2007 archive; returns its path.  The archive is a
+    .rar — this environment ships no rar extractor, so if the extracted
+    ``MQ2007/`` tree is not already present next to the archive the
+    caller must unrar it (``unrar x MQ2007.rar``) before using the
+    readers."""
+    return common.download(URL, "MQ2007", MD5)

@@ -23,6 +23,29 @@ from ..registry import register_op, set_output, in_var
 
 def _fused_attention_infer(op, block):
     q = in_var(op, block, "Q")
+    k = in_var(op, block, "K")
+    v = in_var(op, block, "V")
+    if len(q.shape) != 4 or len(k.shape) != 4 or len(v.shape) != 4:
+        raise ValueError(
+            "fused_attention expects [B, H, T, D] Q/K/V, got %s/%s/%s"
+            % (q.shape, k.shape, v.shape))
+    if q.shape[3] != k.shape[3]:
+        raise ValueError(
+            "fused_attention Q/K head dims disagree: %s vs %s"
+            % (q.shape, k.shape))
+    if v.shape[2] != k.shape[2] or v.shape[3] != q.shape[3]:
+        raise ValueError(
+            "fused_attention V must be [B, H, Tk, D] matching K's length "
+            "and Q's head dim: got Q %s, K %s, V %s"
+            % (q.shape, k.shape, v.shape))
+    if op.attrs.get("causal", False) and q.shape[2] != k.shape[2]:
+        # the kernels' causal masks assume self-attention alignment; a
+        # decode-style suffix query (Tq != Tk) would silently get a
+        # top-aligned mask instead of the standard bottom-aligned one
+        raise ValueError(
+            "fused_attention: causal=True requires Tq == Tk (got %d vs "
+            "%d); slice the output of a full-length causal call instead"
+            % (q.shape[2], k.shape[2]))
     set_output(op, block, "Out", q.shape, q.dtype)
 
 

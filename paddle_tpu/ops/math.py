@@ -30,13 +30,19 @@ def _flatten_to_2d(x, num_col_dims):
     return x.reshape(lead, rest)
 
 
-def _mm_accum_dtype(a, b):
-    # bf16 operands keep bf16 outputs: the TPU MXU accumulates partial
+def _mm_accum_dtype(a, b, ctx=None):
+    # bf16 operands keep bf16 outputs ON TPU: the MXU accumulates partial
     # products in fp32 internally regardless, and requesting an explicit
     # fp32 output (then downcasting) makes every backward cotangent fp32
     # — the transposed dots then run as fp32*bf16, off the fast bf16 MXU
-    # pipeline.  fp16 (GPU-style AMP) still gets explicit fp32 accumulation.
+    # pipeline.  Off-TPU backends (the CPU test suite, mainly) give no
+    # such accumulation guarantee for bf16 dots, so they request fp32
+    # explicitly — numerics stay backend-independent.  fp16 (GPU-style
+    # AMP) always gets explicit fp32 accumulation.
     if a.dtype == jnp.float16:
+        return jnp.float32
+    if a.dtype == jnp.bfloat16 and ctx is not None and \
+            getattr(ctx, "platform", None) != "tpu":
         return jnp.float32
     return None
 
@@ -58,7 +64,8 @@ def _mul_compute(ins, attrs, ctx, op_index):
     ync = attrs.get("y_num_col_dims", 1)
     x2 = _flatten_to_2d(x, xnc)
     y2 = _flatten_to_2d(y, ync)
-    out = jnp.matmul(x2, y2, preferred_element_type=_mm_accum_dtype(x2, y2))
+    out = jnp.matmul(x2, y2,
+                     preferred_element_type=_mm_accum_dtype(x2, y2, ctx))
     out = out.astype(x.dtype)
     return {"Out": out.reshape(tuple(x.shape[:xnc]) + tuple(y.shape[ync:]))}
 
@@ -103,7 +110,7 @@ def _matmul_compute(ins, attrs, ctx, op_index):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y, preferred_element_type=_mm_accum_dtype(x, y))
+    out = jnp.matmul(x, y, preferred_element_type=_mm_accum_dtype(x, y, ctx))
     out = out.astype(ins["X"][0].dtype)
     if alpha != 1.0:
         out = out * alpha

@@ -17,9 +17,10 @@ stage s's parameters) with the SAME per-stage PRNG fold, so dropout masks
 pipelined schedules when the batch is not dp-sharded inside the region
 (dp == 1) or the region draws no randomness.  With dp > 1 the microbatch
 slices shard over dp (each replica pipelines its own slice — no redundant
-compute) and in-stage random draws decorrelate per dp shard.  Microbatches
-share one dropout mask by design (the mask is drawn per stage, not per
-microbatch) in both modes.
+compute) and in-stage random draws decorrelate per dp shard.  Dropout
+masks are drawn per (stage, microbatch) — both schedules fold the stage
+key by the microbatch index identically, so regularization statistics
+match the unpipelined model and schedule parity stays exact.
 
 Gradients ride the registry's generic auto-vjp: the backward op re-runs
 this kernel under ``jax.vjp``, which differentiates the fori_loop +
@@ -167,12 +168,20 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
     except RuntimeError:
         pass
 
-    def stage_fn(stage_idx, pvals, carry, sides_mb, key_extra=None):
+    def stage_fn(stage_idx, pvals, carry, sides_mb, key_extra=None,
+                 mb_idx=None):
         env = dict(const_env)
         env.update(zip(t_params, pvals))
         env.update(zip(side_names, sides_mb))
         env[carry_in0] = carry
         key = base_key
+        if key is not None and mb_idx is not None:
+            # decorrelate in-stage random draws per MICROBATCH: without
+            # this every microbatch in the region shares one dropout
+            # mask, a correlated-regularization divergence from the
+            # unpipelined model.  Both schedules fold by the same
+            # microbatch index, so sequential/GPipe parity is exact.
+            key = jax.random.fold_in(key, mb_idx)
         if key is not None and key_extra is not None:
             # dp-sharded schedule: decorrelate in-stage random draws per
             # dp shard (each shard sees a different batch slice)
@@ -198,7 +207,7 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
             c = x_mb[t]
             for s in range(s_count):
                 c = stage_fn(s, [p[s] for p in stacked], c,
-                             [sv[t] for sv in side_mb])
+                             [sv[t] for sv in side_mb], mb_idx=t)
             outs.append(c)
         out = jnp.stack(outs).reshape(carry0.shape)
         return {"Out": out}
@@ -230,7 +239,8 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
             sides_t = [lax.dynamic_index_in_dim(v, my_mb, 0,
                                                 keepdims=False)
                        for v in side_mb]
-            out = stage_fn(s_idx, my_params, cur, sides_t, extra)
+            out = stage_fn(s_idx, my_params, cur, sides_t, extra,
+                           mb_idx=my_mb)
             done = t - (s_count - 1)
             take = (s_idx == s_count - 1) & (done >= 0)
             updated = lax.dynamic_update_index_in_dim(

@@ -235,3 +235,90 @@ def test_sentiment_pipeline_with_injected_corpus():
     assert all(isinstance(i, int) for i in ids)
     # deterministic shuffle
     assert samples == sentiment.build_samples(docs, wd)
+
+
+def test_mq2007_letor_parsing_and_generators(tmp_path):
+    from paddle_tpu.dataset import mq2007
+
+    lines = [
+        "2 qid:10 1:0.1 2:0.5 3:0.0 #docid = GX1",
+        "0 qid:10 1:0.0 2:0.2 3:0.4 #docid = GX2",
+        "1 qid:10 1:0.3 2:0.1 3:0.9 #docid = GX3",
+        "0 qid:11 1:0.0 2:0.0 3:0.0 #docid = GX4",   # all-zero: filtered
+        "not a letor line",
+        "1 qid:12 1:0.7 2:0.7 3:0.7",
+        "0 qid:12 1:0.1 2:0.2 3:0.3",
+    ]
+    f = tmp_path / "train.txt"
+    f.write_text("\n".join(lines))
+    qls = mq2007.load_from_text(str(f))
+    assert [ql.query_id for ql in qls] == [10, 11, 12]
+    assert len(qls[0]) == 3
+    kept = mq2007.query_filter(qls)
+    assert [ql.query_id for ql in kept] == [10, 12]
+
+    # pointwise: ranked by relevance descending
+    pts = list(mq2007.gen_point(qls[0]))
+    assert [p[0] for p in pts] == [2, 1, 0]
+    np.testing.assert_allclose(pts[0][1], [0.1, 0.5, 0.0])
+
+    # pairwise: all differing-relevance pairs, higher doc first
+    pairs = list(mq2007.gen_pair(qls[0]))
+    assert len(pairs) == 3
+    for label, hi, lo in pairs:
+        assert label == np.array([1])
+    # listwise: one (labels, features) matrix per query
+    lbl, feats = next(mq2007.gen_list(qls[2]))
+    assert lbl.tolist() == [[1], [0]] and feats.shape == (2, 3)
+
+    # missing feature slots fill with -1 (LETOR default)
+    q = mq2007.Query.parse("1 qid:5 2:0.5")
+    assert q.feature_vector == [-1, 0.5]
+
+
+def test_image_transform_pipeline(tmp_path):
+    from paddle_tpu.dataset import image as dimage
+
+    rng = np.random.RandomState(3)
+    im = rng.randint(0, 255, (40, 60, 3), dtype=np.uint8)
+    r = dimage.resize_short(im, 20)
+    assert min(r.shape[:2]) == 20 and r.shape[1] == 30
+    c = dimage.center_crop(r, 16)
+    assert c.shape[:2] == (16, 16)
+    rc = dimage.random_crop(r, 16)
+    assert rc.shape[:2] == (16, 16)
+    fl = dimage.left_right_flip(c)
+    np.testing.assert_array_equal(fl[:, 0], c[:, -1])
+    chw = dimage.to_chw(c)
+    assert chw.shape == (3, 16, 16)
+    out = dimage.simple_transform(im, 24, 16, is_train=True,
+                                  mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32
+
+    # encode/decode round-trip + batch_images_from_tar over a tiny tar
+    import io
+    import pickle
+    import tarfile
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(im[:, :, ::-1]).save(buf, format="PNG")
+    decoded = dimage.load_image_bytes(buf.getvalue())
+    assert decoded.shape == (40, 60, 3)
+    np.testing.assert_array_equal(decoded, im)   # PNG is lossless
+
+    tar = tmp_path / "imgs.tar"
+    with tarfile.open(str(tar), "w") as tf:
+        for name in ("a.png", "b.png"):
+            ti = tarfile.TarInfo(name)
+            ti.size = len(buf.getvalue())
+            tf.addfile(ti, io.BytesIO(buf.getvalue()))
+    meta = dimage.batch_images_from_tar(str(tar), "train",
+                                        {"a.png": 0, "b.png": 1},
+                                        num_per_batch=1)
+    batches = [ln.strip() for ln in open(meta)]
+    assert len(batches) == 2
+    blob = pickle.load(open(batches[0], "rb"))
+    assert blob["label"] in ([0], [1])
+    assert dimage.load_image_bytes(blob["data"][0]).shape == (40, 60, 3)

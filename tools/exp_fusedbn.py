@@ -280,8 +280,7 @@ def main():
         gb = (m * k + m * n) * x.dtype.itemsize / 1e9
         print("M%7d K%5d N%5d: xla %.3f ms (%.0f GB/s)  pallas %.3f ms "
               "(%.0f GB/s)  speedup %.2fx" %
-              (m, k, n, tx * 1e3, gb / tx * (3 if True else 1),
-               tp * 1e3, gb / tp, tx / tp))
+              (m, k, n, tx * 1e3, gb / tx, tp * 1e3, gb / tp, tx / tp))
     if tot_p:
         print("TOTAL: xla %.3f ms  pallas %.3f ms  speedup %.2fx" %
               (tot_x * 1e3, tot_p * 1e3, tot_x / tot_p))

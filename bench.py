@@ -207,6 +207,11 @@ def bench_resnet50(args, use_amp=False, per_step_feed=False):
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         pred = resnet_imagenet(img, class_dim=1000, depth=50)
         loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        if args.fuse_conv_bn:
+            import sys
+            n = fluid.transpiler.fuse_conv_bn(fluid.default_main_program())
+            print("# fuse_conv_bn: %d batch_norms decomposed" % n,
+                  file=sys.stderr)
         # small lr: benchmark data is random noise; higher rates diverge
         _maybe_amp(fluid.optimizer.Momentum(learning_rate=1e-3,
                                             momentum=0.9),
@@ -315,6 +320,9 @@ def main():
                    help="re-feed fresh host batches every step")
     p.add_argument("--pallas", action="store_true",
                    help="enable FLAGS_pallas_kernels (flash attention etc.)")
+    p.add_argument("--fuse_conv_bn", action="store_true",
+                   help="apply transpiler.fuse_conv_bn to the ResNet "
+                        "program (fused Pallas 1x1-conv+BN kernels)")
     p.add_argument("--fast_prng", action="store_true",
                    help="rbg counter PRNG for in-graph randomness")
     args = p.parse_args()
@@ -402,6 +410,8 @@ def main():
     # artifact (metric names stay stable across rounds)
     result["pallas"] = bool(args.pallas)
     result["fast_prng"] = bool(args.fast_prng)
+    # recorded unconditionally; the pass only applies to the resnet model
+    result["fuse_conv_bn"] = bool(args.fuse_conv_bn)
     print(json.dumps(result))
 
 

@@ -15,6 +15,7 @@ from . import (  # noqa: F401
     ctc,
     detection,
     elementwise,
+    fused_conv_bn,
     loss,
     manipulation,
     math,

@@ -39,11 +39,17 @@ from ..core import long_dtype
 
 
 def _sub_ctx(ctx, salt):
-    """A ComputeContext for a sub-block with decorrelated RNG."""
+    """A ComputeContext for a sub-block with decorrelated RNG.  Platform
+    and mesh thread through: platform-keyed choices (bf16 matmul
+    accumulation, Pallas mosaic-vs-interpret) must not change inside a
+    While/cond body."""
     key = getattr(ctx, "_key", None)
     if key is not None:
         key = jax.random.fold_in(key, salt)
-    sub = ComputeContext(key=key, is_test=getattr(ctx, "is_test", False))
+    sub = ComputeContext(key=key, is_test=getattr(ctx, "is_test", False),
+                         platform=getattr(ctx, "platform", None),
+                         mesh=getattr(ctx, "mesh", None))
+    sub.amp = getattr(ctx, "amp", None)
     sub.program = ctx.program
     return sub
 

@@ -1,0 +1,379 @@
+"""Fused conv+BN op family — targets of ``transpiler.fusion.fuse_conv_bn``.
+
+The pass decomposes train-mode ``batch_norm`` ops and absorbs eligible
+1x1 convolutions so each activation is touched the minimum number of
+times (see ``ops/pallas/conv_bn.py`` for the kernel and the traffic
+accounting):
+
+* ``batch_stats``      — one-pass fp32 per-channel mean/var of a raw
+                         activation (when no producer supplies stats).
+* ``stats_finalize``   — mean/var from a producer kernel's fused
+                         sum/sumsq outputs ([C] arithmetic, no
+                         activation pass at all).
+* ``bn_update_stats``  — the momentum moving-average update
+                         (MeanOut/VarianceOut writeback contract of the
+                         original batch_norm op).
+* ``bn_apply``         — normalize(+act) from explicit batch stats, for
+                         consumers that stay un-fused (3x3 conv inputs,
+                         residual adds).
+* ``bn_act_conv2d``    — normalize(+act) -> 1x1 conv -> output stats in
+                         one Pallas kernel (XLA-composed fallback off
+                         TPU / for unsupported shapes), with a
+                         hand-fused single-kernel backward.
+
+Gradient structure: BatchMean/BatchVar are explicit graph values, so
+the BN three-term backward emerges from the chain
+consumer -> stats_finalize -> producer sum/sumsq cotangents instead of
+being hand-wired inside one op (reference
+``batch_norm_op.cu.cc:1``'s fused kernel, re-derived for the
+one-jaxpr world).
+
+Parity: cuDNN fused conv+BN epilogues
+(``paddle/fluid/operators/conv_cudnn_op.cu.cc:1``,
+``batch_norm_op.cu.cc:1``).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..registry import register_op, set_output, in_var
+
+__all__ = []
+
+
+def _to_3d(x):
+    # NCHW -> [B, C, HW]: a free reshape — the kernels are NCHW-native
+    # (channels are the contraction dim), so no transpose materializes
+    b, c, h, w = x.shape
+    return x.reshape(b, c, h * w)
+
+
+# -- batch_stats ------------------------------------------------------------
+
+def _batch_stats_infer(op, block):
+    x = in_var(op, block, "X")
+    c = x.shape[1]
+    set_output(op, block, "BatchMean", (c,), "float32")
+    set_output(op, block, "BatchVar", (c,), "float32")
+
+
+def _batch_stats_compute(ins, attrs, ctx, op_index):
+    from ..flags import flag
+
+    x = ins["X"][0]
+    red = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = [1] * x.ndim
+    bshape[1] = x.shape[1]
+    xf = x.astype(jnp.float32)
+    if flag("bn_two_pass"):
+        # exact two-pass form (same escape hatch as ops/norm.py)
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.mean(jnp.square(xf - mean.reshape(bshape)), axis=red)
+        return {"BatchMean": mean, "BatchVar": var}
+    # shifted one-pass (the norm.py form): Shift is the BN's running
+    # mean, wired by the fusion pass — it kills the E[x^2]-E[x]^2
+    # cancellation whenever running stats track batch stats
+    shift = ins.get("Shift", [None])[0]
+    if shift is not None:
+        s32 = shift.astype(jnp.float32)
+        xs = xf - s32.reshape(bshape)
+    else:
+        s32 = 0.0
+        xs = xf
+    m1 = jnp.mean(xs, axis=red)
+    var = jnp.maximum(jnp.mean(jnp.square(xs), axis=red) - jnp.square(m1),
+                      0.0)
+    return {"BatchMean": m1 + s32, "BatchVar": var}
+
+
+register_op("batch_stats", ["X", "Shift"], ["BatchMean", "BatchVar"],
+            infer=_batch_stats_infer, compute=_batch_stats_compute,
+            no_grad_inputs=("Shift",))
+
+
+# -- stats_finalize ---------------------------------------------------------
+
+def _stats_finalize_infer(op, block):
+    s = in_var(op, block, "Sum")
+    set_output(op, block, "BatchMean", s.shape, "float32")
+    set_output(op, block, "BatchVar", s.shape, "float32")
+
+
+def _stats_finalize_compute(ins, attrs, ctx, op_index):
+    # sum/sumsq come from a producer kernel's fp32 epilogue, accumulated
+    # SHIFTED by the consumer bn's running mean (sum(z-rm), sum((z-rm)^2)
+    # — the same cancellation guard as ops/norm.py's shifted one-pass
+    # variance).  When FLAGS_bn_two_pass demands exact numerics, the
+    # fusion pass leaves the original batch_norm in place instead of
+    # emitting this op, so the flag's contract holds on the fused path.
+    s = ins["Sum"][0].astype(jnp.float32)
+    ss = ins["SumSq"][0].astype(jnp.float32)
+    shift = ins.get("Shift", [None])[0]
+    ref = ins.get("CountFrom", [None])[0]
+    if ref is not None:
+        # per-channel element count from the referenced activation's
+        # trace-time shape (the batch dim is -1 at transpile time)
+        cnt = 1.0
+        for i, d in enumerate(ref.shape):
+            if i != 1:
+                cnt *= d
+    else:
+        cnt = float(attrs["count"])
+    m1 = s / cnt
+    var = jnp.maximum(ss / cnt - jnp.square(m1), 0.0)
+    mean = m1 + shift.astype(jnp.float32) if shift is not None else m1
+    return {"BatchMean": mean, "BatchVar": var}
+
+
+register_op("stats_finalize", ["Sum", "SumSq", "CountFrom", "Shift"],
+            ["BatchMean", "BatchVar"],
+            infer=_stats_finalize_infer, compute=_stats_finalize_compute,
+            no_grad_inputs=("CountFrom", "Shift"))
+
+
+# -- bn_update_stats --------------------------------------------------------
+
+def _update_stats_infer(op, block):
+    m = in_var(op, block, "Mean")
+    set_output(op, block, "MeanOut", m.shape, m.dtype)
+    set_output(op, block, "VarianceOut", m.shape, m.dtype)
+
+
+def _update_stats_compute(ins, attrs, ctx, op_index):
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    bm, bv = ins["BatchMean"][0], ins["BatchVar"][0]
+    mom = attrs.get("momentum", 0.9)
+    return {"MeanOut": mom * mean + (1.0 - mom) * bm.astype(mean.dtype),
+            "VarianceOut": mom * var + (1.0 - mom) * bv.astype(var.dtype)}
+
+
+register_op("bn_update_stats", ["Mean", "Variance", "BatchMean", "BatchVar"],
+            ["MeanOut", "VarianceOut"],
+            infer=_update_stats_infer, compute=_update_stats_compute,
+            grad=None,
+            no_grad_inputs=("Mean", "Variance", "BatchMean", "BatchVar"))
+
+
+# -- bn_apply ---------------------------------------------------------------
+
+def _bn_apply_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Y", x.shape, x.dtype)
+
+
+def _bn_apply_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    mean = ins["BatchMean"][0].astype(jnp.float32)
+    var = ins["BatchVar"][0].astype(jnp.float32)
+    gamma = ins["Scale"][0].astype(jnp.float32)
+    beta = ins["Bias"][0].astype(jnp.float32)
+    eps = attrs.get("epsilon", 1e-5)
+    bshape = [1] * x.ndim
+    bshape[1] = x.shape[1]
+    rstd = lax.rsqrt(var + eps)
+    y = (x.astype(jnp.float32) - mean.reshape(bshape)) \
+        * (rstd * gamma).reshape(bshape) + beta.reshape(bshape)
+    if attrs.get("act", "") == "relu":
+        y = jnp.maximum(y, 0.0)
+    return {"Y": y.astype(x.dtype)}
+
+
+register_op("bn_apply", ["X", "BatchMean", "BatchVar", "Scale", "Bias"],
+            ["Y"], infer=_bn_apply_infer, compute=_bn_apply_compute)
+
+
+# -- bn_act_conv2d ----------------------------------------------------------
+
+def _bac_infer(op, block):
+    x = in_var(op, block, "X")
+    w = in_var(op, block, "Filter")
+    o = w.shape[0]
+    set_output(op, block, "Out", (x.shape[0], o, x.shape[2], x.shape[3]),
+               x.dtype)
+    set_output(op, block, "SumOut", (o,), "float32")
+    set_output(op, block, "SumSqOut", (o,), "float32")
+
+
+def _bac_args(ins, attrs):
+    x = ins["X"][0]
+    filt = ins["Filter"][0]
+    c, o = x.shape[1], filt.shape[0]
+    apply_bn = bool(attrs.get("apply_bn", True))
+    if apply_bn:
+        mean = ins["BatchMean"][0].astype(jnp.float32)
+        var = ins["BatchVar"][0].astype(jnp.float32)
+        gamma = ins["Scale"][0].astype(jnp.float32)
+        beta = ins["Bias"][0].astype(jnp.float32)
+    else:
+        mean = jnp.zeros((c,), jnp.float32)
+        var = jnp.ones((c,), jnp.float32)
+        gamma = jnp.ones((c,), jnp.float32)
+        beta = jnp.zeros((c,), jnp.float32)
+    w2 = filt.reshape(o, c).astype(x.dtype)
+    shift = ins.get("StatsShift", [None])[0]
+    shift = jnp.zeros((o,), jnp.float32) if shift is None \
+        else jax.lax.stop_gradient(shift.astype(jnp.float32))
+    return x, w2, mean, var, gamma, beta, shift, apply_bn
+
+
+def _bac_compute(ins, attrs, ctx, op_index):
+    from .pallas import conv_bn, interpret_mode
+    x, w2, mean, var, gamma, beta, shift, apply_bn = _bac_args(ins, attrs)
+    b, c, h, wd = x.shape
+    o = w2.shape[0]
+    act = attrs.get("act", "")
+    with_stats = bool(attrs.get("with_stats", True))
+    eps = attrs.get("epsilon", 1e-5)
+    if conv_bn.supported(b, c, o, h * wd, x.dtype):
+        z3, s, ss = conv_bn.bn_act_matmul(
+            _to_3d(x), w2, mean, var, gamma, beta, shift, eps, act,
+            apply_bn, with_stats, interpret_mode(ctx))
+        return {"Out": z3.reshape(b, o, h, wd), "SumOut": s,
+                "SumSqOut": ss}
+    # XLA-composed fallback (same math, still one-pass stats)
+    z, s, ss = _bac_xla_fwd(x, w2, mean, var, gamma, beta, shift, eps,
+                            act, apply_bn, with_stats)
+    return {"Out": z, "SumOut": s, "SumSqOut": ss}
+
+
+def _bac_xla_fwd(x, w2, mean, var, gamma, beta, shift, eps, act, apply_bn,
+                 with_stats):
+    b, c, h, wd = x.shape
+    o = w2.shape[0]
+    if apply_bn:
+        bshape = (1, c, 1, 1)
+        rstd = lax.rsqrt(var + eps)
+        xn = (x.astype(jnp.float32) - mean.reshape(bshape)) \
+            * (rstd * gamma).reshape(bshape) + beta.reshape(bshape)
+        if act == "relu":
+            xn = jnp.maximum(xn, 0.0)
+        xn = xn.astype(x.dtype)
+    else:
+        xn = jnp.maximum(x, jnp.zeros_like(x)) if act == "relu" else x
+    # contraction over the channel dim, NCHW-native (no transposes)
+    z3 = jax.lax.dot_general(
+        w2, _to_3d(xn), (((1,), (1,)), ((), ())),
+        preferred_element_type=x.dtype)            # [O, B, HW]
+    z = jnp.swapaxes(z3, 0, 1).reshape(b, o, h, wd)
+    if with_stats:
+        zf = z3.astype(jnp.float32) - shift.reshape(o, 1, 1)
+        s = jnp.sum(zf, axis=(1, 2))
+        ss = jnp.sum(zf * zf, axis=(1, 2))
+    else:
+        s = jnp.zeros((o,), jnp.float32)
+        ss = jnp.zeros((o,), jnp.float32)
+    return z, s, ss
+
+
+def _bac_grad_maker(op, no_grad_set):
+    """Hand-fused backward consuming the saved forward output (the raw z
+    the stats cotangents fold over) — avoids re-running the forward
+    kernel the generic auto-vjp recompute would."""
+    from ..framework import grad_var_name
+
+    outs = {}
+    for slot in ("X", "Filter", "BatchMean", "BatchVar", "Scale", "Bias"):
+        names = op.inputs.get(slot, [])
+        outs["GRAD::" + slot] = ["" if n in no_grad_set else grad_var_name(n)
+                                 for n in names]
+    if not any(n for ns in outs.values() for n in ns):
+        return []
+    g_inputs = {slot: list(op.inputs.get(slot, []))
+                for slot in ("X", "Filter", "BatchMean", "BatchVar",
+                             "Scale", "Bias", "StatsShift")}
+    g_inputs["Out::Out"] = list(op.outputs["Out"])
+    g_inputs["GRAD::Out"] = [grad_var_name(n) for n in op.outputs["Out"]]
+    if op.attrs.get("with_stats", True):
+        # stat cotangents exist only when the stats have a (diff)
+        # consumer; a with_stats=False op's SumOut is dead zeros and
+        # demanding its grad var would be a wiring error
+        for slot in ("SumOut", "SumSqOut"):
+            g_inputs["GRAD::" + slot] = [grad_var_name(n)
+                                         for n in op.outputs[slot]]
+    return [dict(type="bn_act_conv2d_grad", inputs=g_inputs, outputs=outs,
+                 attrs=dict(op.attrs))]
+
+
+def _bac_grad_infer(gop, block):
+    for slot in ("X", "Filter", "BatchMean", "BatchVar", "Scale", "Bias"):
+        names = gop.inputs.get(slot, [])
+        gnames = gop.outputs.get("GRAD::" + slot, [])
+        for n, g in zip(names, gnames):
+            if not g:
+                continue
+            v = block._find_var_recursive(n)
+            if v is not None:
+                block.create_var(name=g, shape=v.shape, dtype=v.dtype,
+                                 persistable=False)
+
+
+def _bac_grad_compute(ins, attrs, ctx, op_index):
+    from .pallas import conv_bn, interpret_mode
+    x, w2, mean, var, gamma, beta, shift, apply_bn = _bac_args(ins, attrs)
+    b, c, h, wd = x.shape
+    o = w2.shape[0]
+    act = attrs.get("act", "")
+    with_stats = bool(attrs.get("with_stats", True))
+    eps = attrs.get("epsilon", 1e-5)
+    filt = ins["Filter"][0]
+    z4 = ins["Out::Out"][0]
+    dz4 = ins["GRAD::Out"][0]
+    dsum = ins.get("GRAD::SumOut", [None])[0]
+    dsumsq = ins.get("GRAD::SumSqOut", [None])[0]
+    have_stats_ct = dsum is not None or dsumsq is not None
+    if dsum is None:
+        dsum = jnp.zeros((o,), jnp.float32)
+    if dsumsq is None:
+        dsumsq = jnp.zeros((o,), jnp.float32)
+    if dz4 is None:
+        dz4 = jnp.zeros_like(z4)
+
+    if conv_bn.supported(b, c, o, h * wd, x.dtype):
+        rstd = lax.rsqrt(var + eps)
+        dx3, dw, dgamma, dbeta = conv_bn._bwd_call(
+            _to_3d(x), w2, _to_3d(z4), _to_3d(dz4).astype(x.dtype),
+            dsum, dsumsq, mean, rstd, gamma, beta, shift, act, apply_bn,
+            with_stats and have_stats_ct, interpret_mode(ctx))
+        dx = dx3.reshape(b, c, h, wd)
+        dmean, dvar = conv_bn.stats_grads(apply_bn, gamma, rstd, dgamma,
+                                          dbeta)
+    else:
+        def fwd(x, w2, mean, var, gamma, beta):
+            return _bac_xla_fwd(x, w2, mean, var, gamma, beta, shift, eps,
+                                act, apply_bn, with_stats)
+
+        _, vjp = jax.vjp(fwd, x, w2, mean, var, gamma, beta)
+        dx, dw, dmean, dvar, dgamma, dbeta = vjp(
+            (dz4, dsum, dsumsq))
+    dfilt = dw.reshape(o, c, 1, 1).astype(filt.dtype)
+    out = {"GRAD::X": dx, "GRAD::Filter": dfilt}
+    if apply_bn:
+        sdt = ins["Scale"][0].dtype
+        out["GRAD::BatchMean"] = dmean.astype(sdt)
+        out["GRAD::BatchVar"] = dvar.astype(sdt)
+        out["GRAD::Scale"] = dgamma.astype(sdt)
+        out["GRAD::Bias"] = dbeta.astype(sdt)
+    return out
+
+
+register_op(
+    "bn_act_conv2d",
+    ["X", "Filter", "BatchMean", "BatchVar", "Scale", "Bias",
+     "StatsShift"],
+    ["Out", "SumOut", "SumSqOut"],
+    infer=_bac_infer, compute=_bac_compute, grad=_bac_grad_maker,
+    no_grad_inputs=("StatsShift",),
+)
+
+register_op(
+    "bn_act_conv2d_grad",
+    ["X", "Filter", "BatchMean", "BatchVar", "Scale", "Bias",
+     "StatsShift", "Out::Out", "GRAD::Out", "GRAD::SumOut",
+     "GRAD::SumSqOut"],
+    ["GRAD::X", "GRAD::Filter", "GRAD::BatchMean", "GRAD::BatchVar",
+     "GRAD::Scale", "GRAD::Bias"],
+    infer=_bac_grad_infer, compute=_bac_grad_compute, grad=None,
+)

@@ -31,18 +31,20 @@ def _flatten_to_2d(x, num_col_dims):
 
 
 def _mm_accum_dtype(a, b, ctx=None):
-    # bf16 operands keep bf16 outputs ON TPU: the MXU accumulates partial
+    # bf16 operands keep bf16 outputs: the TPU MXU accumulates partial
     # products in fp32 internally regardless, and requesting an explicit
     # fp32 output (then downcasting) makes every backward cotangent fp32
     # — the transposed dots then run as fp32*bf16, off the fast bf16 MXU
-    # pipeline.  Off-TPU backends (the CPU test suite, mainly) give no
-    # such accumulation guarantee for bf16 dots, so they request fp32
-    # explicitly — numerics stay backend-independent.  fp16 (GPU-style
-    # AMP) always gets explicit fp32 accumulation.
+    # pipeline.  KNOWN BACKEND DIVERGENCE: off-TPU backends give no such
+    # fp32-accumulation guarantee for bf16 dots, so bf16 numerics on the
+    # CPU backend may accumulate at lower precision than the same program
+    # on TPU.  Requesting fp32 outputs off-TPU was tried and rejected:
+    # the fp32 cotangent cascade changes the emitted backward HLO
+    # everywhere (the exact pessimization described above), a worse
+    # trade than the documented precision gap — bf16-AMP on CPU is a
+    # test-suite configuration, not a deployment target.  fp16
+    # (GPU-style AMP) always gets explicit fp32 accumulation.
     if a.dtype == jnp.float16:
-        return jnp.float32
-    if a.dtype == jnp.bfloat16 and ctx is not None and \
-            getattr(ctx, "platform", None) != "tpu":
         return jnp.float32
     return None
 

@@ -16,8 +16,10 @@ from .distribute_transpiler import (  # noqa: F401
 from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory)
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
+from .fusion import fuse_conv_bn, apply_pass  # noqa: F401
 
 __all__ = [
     "DistributeTranspiler", "DistributeTranspilerConfig",
     "memory_optimize", "release_memory", "InferenceTranspiler",
+    "fuse_conv_bn", "apply_pass",
 ]

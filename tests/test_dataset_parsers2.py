@@ -257,10 +257,13 @@ def test_mq2007_letor_parsing_and_generators(tmp_path):
     kept = mq2007.query_filter(qls)
     assert [ql.query_id for ql in kept] == [10, 12]
 
-    # pointwise: ranked by relevance descending
+    # pointwise: ranked by relevance descending; vectors are fixed-width
+    # (LETOR's 46 features) with missing slots filled with -1
     pts = list(mq2007.gen_point(qls[0]))
     assert [p[0] for p in pts] == [2, 1, 0]
-    np.testing.assert_allclose(pts[0][1], [0.1, 0.5, 0.0])
+    assert pts[0][1].shape == (mq2007.FEATURE_DIM,)
+    np.testing.assert_allclose(pts[0][1][:3], [0.1, 0.5, 0.0])
+    np.testing.assert_allclose(pts[0][1][3:], -1.0)
 
     # pairwise: all differing-relevance pairs, higher doc first
     pairs = list(mq2007.gen_pair(qls[0]))
@@ -269,11 +272,13 @@ def test_mq2007_letor_parsing_and_generators(tmp_path):
         assert label == np.array([1])
     # listwise: one (labels, features) matrix per query
     lbl, feats = next(mq2007.gen_list(qls[2]))
-    assert lbl.tolist() == [[1], [0]] and feats.shape == (2, 3)
+    assert lbl.tolist() == [[1], [0]]
+    assert feats.shape == (2, mq2007.FEATURE_DIM)
 
-    # missing feature slots fill with -1 (LETOR default)
+    # ragged lines (trailing features omitted) still stack uniformly
     q = mq2007.Query.parse("1 qid:5 2:0.5")
-    assert q.feature_vector == [-1, 0.5]
+    assert len(q.feature_vector) == mq2007.FEATURE_DIM
+    assert q.feature_vector[:3] == [-1, 0.5, -1]
 
 
 def test_image_transform_pipeline(tmp_path):

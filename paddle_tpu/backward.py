@@ -146,6 +146,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
         if not live:
             continue
         specs = make_grad_ops(op, no_grad)
+        appended_any = False
         for spec in specs:
             # record the forward op's position so generic grad recompute
             # folds the SAME PRNG key the forward used (registry.py
@@ -183,6 +184,19 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 outputs=spec["outputs"],
                 attrs=spec["attrs"],
             )
+            appended_any = True
+        # once this op's grad ops have consumed its outputs' cotangents,
+        # clear them so an EARLIER producer of the same name (in-place
+        # aliasing — the while op's Out carries) cannot re-consume the
+        # already-routed gradient and double-count.  Only when grad ops
+        # were actually appended: a grad-less in-place op (increment)
+        # must keep letting the cotangent flow through the shared name.
+        # materialize first so var@GRAD stays fetchable/optimizer-visible.
+        if appended_any:
+            for n in op.output_arg_names:
+                if n and acc.pending.get(n):
+                    acc.materialize(n)
+                    acc.pending[n] = []
 
     # materialize every accumulated gradient so var@GRAD is always the
     # summed value (fetchable, optimizer-consumable)
@@ -210,19 +224,54 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
 def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     """Gradients of ``targets`` w.r.t. ``inputs`` (reference
-    backward.py:calc_gradient).  Returns list of grad Variables (or None)."""
+    backward.py:calc_gradient).  Returns list of grad Variables (or None).
+
+    Multiple targets compose into the scalar sum_i <target_i, tg_i>
+    (tg_i defaulting to ones), whose gradient w.r.t. each input is
+    exactly the requested vjp — one backward walk serves every target,
+    like the reference's multi-target support."""
     if isinstance(targets, Variable):
         targets = [targets]
     if isinstance(inputs, Variable):
         inputs = [inputs]
-    assert len(targets) == 1, "calc_gradient currently supports one target"
-    loss = targets[0]
-    block = loss.block
-    if target_gradients is not None:
-        if isinstance(target_gradients, Variable):
-            target_gradients = [target_gradients]
-        loss_grad_input = target_gradients[0]
+    if target_gradients is not None and isinstance(target_gradients,
+                                                   Variable):
+        target_gradients = [target_gradients]
+    if target_gradients is not None and \
+            len(target_gradients) != len(targets):
+        raise ValueError(
+            "target_gradients must match targets (%d vs %d)"
+            % (len(target_gradients), len(targets)))
+    block = targets[0].block
+
+    if len(targets) == 1:
+        loss = targets[0]
+        loss_grad_input = target_gradients[0] if target_gradients else None
     else:
+        from . import unique_name
+
+        parts = []
+        for i, t in enumerate(targets):
+            tg = target_gradients[i] if target_gradients else None
+            val = t
+            if tg is not None:
+                prod = block.create_var(
+                    name=unique_name.generate("calc_grad_prod"))
+                block.append_op(type="elementwise_mul",
+                                inputs={"X": [t.name], "Y": [tg.name]},
+                                outputs={"Out": [prod.name]}, attrs={})
+                val = prod
+            part = block.create_var(
+                name=unique_name.generate("calc_grad_part"))
+            block.append_op(type="reduce_sum",
+                            inputs={"X": [val.name]},
+                            outputs={"Out": [part.name]},
+                            attrs={"reduce_all": True, "keep_dim": False})
+            parts.append(part.name)
+        loss = block.create_var(
+            name=unique_name.generate("calc_grad_total"))
+        block.append_op(type="sum", inputs={"X": parts},
+                        outputs={"Out": [loss.name]}, attrs={})
         loss_grad_input = None
     # reuse append_backward machinery but finalize for `inputs`
     pg = append_backward(loss, parameter_list=None, no_grad_set=no_grad_set,

@@ -75,11 +75,13 @@ class Query(object):
                 feats[int(idx)] = float(val)
             except ValueError:
                 return None
-        # fixed-width vectors (LETOR 4.0 has 46 features): lines that
-        # omit trailing features still yield uniform-length vectors, so
-        # gen_list/gen_pair can stack documents within a query
-        dim = max(max(feats) if feats else 0, FEATURE_DIM)
-        vec = [feats.get(i + 1, fill_missing) for i in range(dim)]
+        # fixed-width FEATURE_DIM vectors (LETOR 4.0 has 46 features):
+        # lines that omit trailing features still yield uniform-length
+        # vectors so gen_list/gen_pair can stack documents within a
+        # query; an out-of-range index means the line is not MQ2007
+        if feats and max(feats) > FEATURE_DIM:
+            return None
+        vec = [feats.get(i + 1, fill_missing) for i in range(FEATURE_DIM)]
         return Query(query_id=qid, relevance_score=rel, feature_vector=vec,
                      description=comment.strip())
 

@@ -332,13 +332,25 @@ class While:
     the block must update it (e.g. ``layers.less_than(i, n, cond=cond)``).
     Vars written inside the block that already exist outside are the loop
     carry; their shapes must be loop-invariant (use fixed-capacity arrays
-    from ``create_array``/``array_write``).  Forward-only."""
+    from ``create_array``/``array_write``).
 
-    def __init__(self, cond, name=None):
+    Gradients: an unbounded ``lax.while_loop`` cannot be reverse-
+    differentiated by XLA.  Declaring ``max_trip_count=N`` lowers the
+    loop to a bounded, predicated ``lax.scan`` (each of the N steps
+    either runs the body or passes the carry through once the condition
+    has gone false) — functionally identical for any execution taking
+    <= N trips, and differentiable, matching the reference's WhileGrad
+    capability (``while_op.cc:101``).  Without it, a backward through
+    the loop raises with this explanation."""
+
+    def __init__(self, cond, name=None, max_trip_count=None):
         self.helper = LayerHelper("while", name=name)
         if not isinstance(cond, Variable):
             raise TypeError("cond must be a Variable")
+        if max_trip_count is not None and int(max_trip_count) <= 0:
+            raise ValueError("max_trip_count must be positive")
         self.cond_var = cond
+        self.max_trip_count = max_trip_count
 
     @contextlib.contextmanager
     def block(self):
@@ -356,11 +368,26 @@ class While:
                 "While block must update the condition var %r (e.g. "
                 "layers.less_than(..., cond=cond))" % self.cond_var.name)
         params, consts = _classify_externals(sub, set(carried))
+        # snapshot the initial carry under distinct names: the op's
+        # outputs alias the carried vars (the reference's in-place while
+        # contract), so without snapshots a later grad op reading
+        # LoopVars from the trace env would see the FINAL values — the
+        # re-run loop's condition would already be false and every
+        # gradient through the loop would silently be zero
+        snaps = []
+        for c in carried:
+            snap = c + "@LOOP_IN"
+            cv = parent._find_var_recursive(c)
+            parent.create_var(name=snap, shape=cv.shape, dtype=cv.dtype,
+                              persistable=False)
+            parent.append_op(type="assign", inputs={"X": [c]},
+                             outputs={"Out": [snap]}, attrs={})
+            snaps.append(snap)
         parent.append_op(
             type="while",
             inputs={
-                "Condition": [self.cond_var.name],
-                "LoopVars": list(carried),
+                "Condition": [self.cond_var.name + "@LOOP_IN"],
+                "LoopVars": snaps,
                 "Params": params,
                 "Consts": consts,
             },
@@ -371,6 +398,7 @@ class While:
                 "cond_name": self.cond_var.name,
                 "param_names": params,
                 "const_names": consts,
+                "max_trip_count": int(self.max_trip_count or 0),
             })
 
 

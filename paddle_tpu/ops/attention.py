@@ -121,27 +121,34 @@ def _ring_attention(mesh, q, k, v, k_len, seed, causal, rate, scale):
 
     from jax.sharding import PartitionSpec as P
 
-    from ..parallel.mesh import AXIS_DP, AXIS_SP, shard_map_norep
+    from ..parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP, shard_map_norep
     from ..parallel.ring_attention import ring_attention_shard
 
-    b = q.shape[0]
+    b, h = q.shape[0], q.shape[1]
     tk = k.shape[2]
     bspec = None
     if AXIS_DP in mesh.axis_names and mesh.shape[AXIS_DP] > 1 \
             and b % mesh.shape[AXIS_DP] == 0:
         bspec = AXIS_DP
+    # heads shard over tp when present (tensor-parallel QKV projections
+    # leave Q/K/V head-sharded; the ring treats heads as batch, so the
+    # composition is a pure spec change plus the dropout head offset)
+    hspec = None
+    if AXIS_TP in mesh.axis_names and mesh.shape[AXIS_TP] > 1 \
+            and h % mesh.shape[AXIS_TP] == 0:
+        hspec = AXIS_TP
     if k_len is None:
         k_len = jnp.full((b,), tk, jnp.int32)
     if seed is None:
         seed = jnp.zeros((), jnp.uint32)
     body = functools.partial(
         ring_attention_shard, axis_name=AXIS_SP, causal=causal, scale=scale,
-        dropout_rate=rate, batch_axis_name=bspec)
+        dropout_rate=rate, batch_axis_name=bspec, head_axis_name=hspec)
 
     def shard_body(q, k, v, klen, seed):
         return body(q, k, v, k_len=klen, seed=seed)
 
-    spec = P(bspec, None, AXIS_SP, None)
+    spec = P(bspec, hspec, AXIS_SP, None)
     fn = shard_map_norep(
         shard_body, mesh,
         in_specs=(spec, spec, spec, P(bspec), P()), out_specs=spec)

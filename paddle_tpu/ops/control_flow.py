@@ -213,6 +213,7 @@ def _while_compute(ins, attrs, ctx, op_index):
     sub = program.block(attrs["sub_block"])
     carried = attrs["carried_names"]
     cond_name = attrs["cond_name"]
+    max_trips = attrs.get("max_trip_count", 0)
 
     base_env = {}
     base_env.update(zip(attrs.get("param_names", []), ins.get("Params", [])))
@@ -222,27 +223,133 @@ def _while_compute(ins, attrs, ctx, op_index):
     idx = {n: i for i, n in enumerate(carried)}
     sub_ctx = _sub_ctx(ctx, 1299709 + attrs["sub_block"])
 
-    def cond_fn(carry):
-        return jnp.all(carry[idx[cond_name]])
-
-    def body_fn(carry):
+    def body_env(carry):
         env = dict(base_env)
         env.update(zip(carried, carry))
         _run_block(sub, env, sub_ctx)
         return tuple(env[n] for n in carried)
 
-    out = lax.while_loop(cond_fn, body_fn, carry0)
+    if max_trips:
+        # bounded, predicated scan: differentiable (the WhileGrad
+        # capability, reference while_op.cc:101).  Every step computes
+        # the body and selects it only while the condition holds, so any
+        # execution taking <= max_trip_count trips matches the unbounded
+        # loop exactly; trade-off is max_trip_count body evaluations
+        # regardless of the actual trip count.
+        def step(carry, _):
+            pred = jnp.all(carry[idx[cond_name]])
+            new = body_env(carry)
+            out = tuple(jnp.where(pred, n, c) for n, c in zip(new, carry))
+            return out, None
+
+        out, _ = lax.scan(step, carry0, None, length=int(max_trips))
+        return {"Out": list(out)}
+
+    def cond_fn(carry):
+        return jnp.all(carry[idx[cond_name]])
+
+    out = lax.while_loop(cond_fn, body_env, carry0)
     return {"Out": list(out)}
 
 
 def _while_grad_maker(op, no_grad_set):
-    # reached only when a live gradient actually flows into the loop's
-    # outputs — fail loudly instead of silently freezing the weights
-    raise RuntimeError(
-        "cannot differentiate through a While loop: XLA cannot "
-        "reverse-differentiate an unbounded lax.while_loop. Use "
-        "StaticRNN/DynamicRNN (lax.scan) for trainable recurrence; While "
-        "is the inference/decoding construct.")
+    from ..framework import grad_var_name
+
+    if not op.attrs.get("max_trip_count", 0):
+        # reached only when a live gradient actually flows into the
+        # loop's outputs — fail loudly instead of silently freezing the
+        # weights
+        raise RuntimeError(
+            "cannot differentiate through a While loop without a "
+            "declared bound: XLA cannot reverse-differentiate an "
+            "unbounded lax.while_loop. Pass While(cond, "
+            "max_trip_count=N) to lower the loop to a bounded, "
+            "predicated (and differentiable) scan, or use "
+            "StaticRNN/DynamicRNN for recurrence over a sequence.")
+    g_inputs = {slot: list(op.inputs.get(slot, []))
+                for slot in ("Condition", "LoopVars", "Params", "Consts")}
+    # Out names alias LoopVars (the reference's in-place while contract):
+    # their grad names therefore alias too — the grad op reads the
+    # output-side grads and overwrites them with the input-side grads
+    g_inputs["GRAD::Out"] = [grad_var_name(n) for n in op.outputs["Out"]]
+    g_outputs = {}
+    any_grad = False
+    for slot in ("LoopVars", "Params"):
+        outs = []
+        for n in op.inputs.get(slot, []):
+            if n in no_grad_set:
+                outs.append("")
+            else:
+                outs.append(grad_var_name(n))
+                any_grad = True
+        g_outputs["GRAD::" + slot] = outs
+    if not any_grad:
+        return []
+    return [dict(type="while_grad", inputs=g_inputs, outputs=g_outputs,
+                 attrs=dict(op.attrs))]
+
+
+def _while_grad_infer(gop, block):
+    for slot in ("LoopVars", "Params"):
+        for n, g in zip(gop.inputs.get(slot, []),
+                        gop.outputs.get("GRAD::" + slot, [])):
+            if not g:
+                continue
+            v = block._find_var_recursive(n)
+            if v is not None:
+                block.create_var(name=g, shape=v.shape, dtype=v.dtype,
+                                 persistable=False)
+
+
+def _while_grad_compute(ins, attrs, ctx, op_index):
+    """Re-run the bounded scan under jax.vjp, differentiating w.r.t. the
+    floating loop vars and params individually (the slots mix bool/int
+    counters with float carries, so the generic per-slot maker cannot
+    serve)."""
+    from ..core import dtype_is_floating
+
+    loopvars = list(ins.get("LoopVars", []))
+    params = list(ins.get("Params", []))
+    d_lv = [i for i, v in enumerate(loopvars)
+            if v is not None and dtype_is_floating(v.dtype)]
+    d_pr = [i for i, v in enumerate(params)
+            if v is not None and dtype_is_floating(v.dtype)]
+
+    fwd_attrs = {k: v for k, v in attrs.items()}
+
+    def fwd(lv_diff, pr_diff):
+        lv = list(loopvars)
+        for i, v in zip(d_lv, lv_diff):
+            lv[i] = v
+        pr = list(params)
+        for i, v in zip(d_pr, pr_diff):
+            pr[i] = v
+        full = {"Condition": ins.get("Condition", []),
+                "LoopVars": lv, "Params": pr,
+                "Consts": ins.get("Consts", [])}
+        outs = _while_compute(full, fwd_attrs, ctx, op_index)
+        # only the floating outputs (same positions as the floating
+        # carries — carry dtypes are loop-invariant): bool/int outputs
+        # would demand float0 cotangents
+        return [outs["Out"][i] for i in d_lv]
+
+    outs, vjp = jax.vjp(fwd, [loopvars[i] for i in d_lv],
+                        [params[i] for i in d_pr])
+    gouts = ins.get("GRAD::Out", [])
+    cts = []
+    for i, o in zip(d_lv, outs):
+        g = gouts[i] if i < len(gouts) else None
+        cts.append(g.astype(o.dtype) if g is not None
+                   else jnp.zeros_like(o))
+    d_lv_vals, d_pr_vals = vjp(cts)
+
+    g_lv = [None] * len(loopvars)
+    for i, v in zip(d_lv, d_lv_vals):
+        g_lv[i] = v
+    g_pr = [None] * len(params)
+    for i, v in zip(d_pr, d_pr_vals):
+        g_pr[i] = v
+    return {"GRAD::LoopVars": g_lv, "GRAD::Params": g_pr}
 
 
 register_op(
@@ -250,6 +357,13 @@ register_op(
     ["Condition", "LoopVars", "Params", "Consts"],
     ["Out"],
     infer=None, compute=_while_compute, grad=_while_grad_maker,
+)
+
+register_op(
+    "while_grad",
+    ["Condition", "LoopVars", "Params", "Consts", "GRAD::Out"],
+    ["GRAD::LoopVars", "GRAD::Params"],
+    infer=_while_grad_infer, compute=_while_grad_compute, grad=None,
 )
 
 

@@ -62,6 +62,7 @@ def _batch_stats_infer(op, block):
 
 def _batch_stats_compute(ins, attrs, ctx, op_index):
     from ..flags import flag
+    from .norm import shifted_one_pass_stats
 
     x = ins["X"][0]
     red = tuple(i for i in range(x.ndim) if i != 1)
@@ -73,20 +74,10 @@ def _batch_stats_compute(ins, attrs, ctx, op_index):
         mean = jnp.mean(xf, axis=red)
         var = jnp.mean(jnp.square(xf - mean.reshape(bshape)), axis=red)
         return {"BatchMean": mean, "BatchVar": var}
-    # shifted one-pass (the norm.py form): Shift is the BN's running
-    # mean, wired by the fusion pass — it kills the E[x^2]-E[x]^2
-    # cancellation whenever running stats track batch stats
+    # Shift is the BN's running mean, wired by the fusion pass
     shift = ins.get("Shift", [None])[0]
-    if shift is not None:
-        s32 = shift.astype(jnp.float32)
-        xs = xf - s32.reshape(bshape)
-    else:
-        s32 = 0.0
-        xs = xf
-    m1 = jnp.mean(xs, axis=red)
-    var = jnp.maximum(jnp.mean(jnp.square(xs), axis=red) - jnp.square(m1),
-                      0.0)
-    return {"BatchMean": m1 + s32, "BatchVar": var}
+    mean, var = shifted_one_pass_stats(xf, shift, red, bshape)
+    return {"BatchMean": mean, "BatchVar": var}
 
 
 register_op("batch_stats", ["X", "Shift"], ["BatchMean", "BatchVar"],

@@ -36,6 +36,31 @@ def _bn_infer(op, block):
     set_output(op, block, "SavedVariance", (c,), x.dtype)
 
 
+def shifted_one_pass_stats(xf, shift, red_axes, bshape=None):
+    """Per-channel (mean, var) in ONE fused HBM pass: both reductions of
+    E[(x-c)^2]-(E[x-c])^2 are independent so XLA fuses them (the
+    two-pass exact form needs a second full read after the mean
+    barrier).  ``shift`` (fp32 [C] or None) — typically the running mean
+    — kills the catastrophic cancellation of the naive E[x^2]-E[x]^2
+    whenever it tracks the batch mean.  Clamped at 0.  Shared by
+    batch_norm and the fused-conv-BN decomposition (transpiler.fusion)
+    so the two paths cannot drift numerically."""
+    if shift is not None:
+        s32 = shift.astype(jnp.float32)
+        if bshape is None:
+            bshape = [1] * xf.ndim
+            c_axis = [i for i in range(xf.ndim) if i not in red_axes][0]
+            bshape[c_axis] = xf.shape[c_axis]
+        xs = xf - s32.reshape(bshape)
+    else:
+        s32 = 0.0
+        xs = xf
+    m1 = jnp.mean(xs, axis=red_axes)
+    var = jnp.maximum(jnp.mean(jnp.square(xs), axis=red_axes)
+                      - jnp.square(m1), 0.0)
+    return m1 + s32, var
+
+
 def _bn_axes(x, attrs):
     """(c_axis, reduction axes, broadcast shape) for a BN input under the
     op's data_layout — shared by forward and the fused backward so the
@@ -80,23 +105,11 @@ def _bn_compute(ins, attrs, ctx, op_index):
                 jnp.square(xf - use_mean.reshape(bshape)), axis=red_axes
             )
         else:
-            # one-pass variance (cuDNN's form), shifted by the running
-            # mean: E[(x-rm)^2]-(E[x-rm])^2.  Both reductions are
-            # independent of each other so XLA fuses them with the mean
-            # into a single HBM pass over the activation — measured ~8%
-            # off a ResNet-50 step on a v5e vs the two-pass form.  The
-            # running-mean shift is free (fuses into the same pass) and
-            # kills the catastrophic cancellation of the naive
-            # E[x^2]-E[x]^2 whenever running stats track batch stats —
-            # i.e. all of training past the first steps.  Clamped at 0;
-            # FLAGS_bn_two_pass restores the exact form.
-            shift = mean.astype(jnp.float32)
-            xs = xf - shift.reshape(bshape)
-            m1 = jnp.mean(xs, axis=red_axes)
-            use_var = jnp.maximum(
-                jnp.mean(jnp.square(xs), axis=red_axes) - jnp.square(m1),
-                0.0)
-            use_mean = m1 + shift
+            # one-pass variance shifted by the running mean (cuDNN's
+            # form) — measured ~8% off a ResNet-50 step on a v5e vs the
+            # two-pass form; FLAGS_bn_two_pass restores the exact form
+            use_mean, use_var = shifted_one_pass_stats(
+                xf, mean, red_axes, bshape)
         mean_out = momentum * mean + (1.0 - momentum) * use_mean
         var_out = momentum * var + (1.0 - momentum) * use_var
         saved_mean = use_mean

@@ -40,7 +40,7 @@ _NEG_INF = -1e30
 
 def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None,
                          k_len=None, dropout_rate=0.0, seed=None,
-                         batch_axis_name=None):
+                         batch_axis_name=None, head_axis_name=None):
     """Per-device ring attention body (run under shard_map).
 
     q [B, H, Tq, D] local query block; k/v [B, H, Tk, D] local key/value
@@ -55,6 +55,10 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None,
     downgrade_in_infer semantics: masked, not upscaled).
     ``batch_axis_name`` names the mesh axis the batch is sharded over, so
     the hash's global (batch*head) index stays correct under dp.
+    ``head_axis_name`` likewise names the axis the HEAD dim is sharded
+    over (tensor parallelism composing with the sequence ring): heads
+    attend independently, so tp sharding is transparent to the math, and
+    the head offset keeps dropout masks identical to a single-chip run.
     """
     n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
@@ -80,9 +84,14 @@ def ring_attention_shard(q, k, v, axis_name, causal=False, scale=None,
         b_off = 0
         if batch_axis_name is not None:
             b_off = lax.axis_index(batch_axis_name) * b
+        h_off = 0
+        h_total = h
+        if head_axis_name is not None:
+            h_off = lax.axis_index(head_axis_name) * h
+            h_total = h * lax.psum(1, head_axis_name)
         # global (batch*head) index per row, same layout as single-chip
-        bh_idx = ((b_off + jnp.arange(b))[:, None] * h +
-                  jnp.arange(h)[None, :])[:, :, None, None]
+        bh_idx = ((b_off + jnp.arange(b))[:, None] * h_total +
+                  (h_off + jnp.arange(h))[None, :])[:, :, None, None]
 
     def step(i, carry):
         k_blk, v_blk, m, l, o = carry

@@ -186,8 +186,9 @@ def fuse_conv_bn(program):
     new_ops = []
     fused = 0
     for i, op in enumerate(ops):
-        if i in absorbed_relu:
-            continue
+        # absorbed relu ops are RE-EMITTED (not skipped): their output
+        # var may be fetched or read elsewhere; they read the bn_apply'd
+        # Y and are dead code XLA eliminates when nothing consumes them
         if i in absorbed_conv or i in stats_conv:
             emit_fused_conv(i, new_ops)
             continue

@@ -159,3 +159,53 @@ def test_gradient_clip_by_global_norm():
     for _ in range(3):
         (lv,) = exe.run(feed={"x": xv}, fetch_list=[loss])
     assert np.isfinite(lv).all()
+
+
+def test_calc_gradient_multiple_targets():
+    """Multi-target calc_gradient: d(sum_i <t_i, tg_i>)/dx."""
+    x = fluid.layers.data("x", shape=[3])
+    x.stop_gradient = False
+    y1 = fluid.layers.scale(x, scale=2.0)
+    y2 = fluid.layers.scale(x, scale=-3.0)
+    (gx,) = fluid.calc_gradient([y1, y2], x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 3), dtype="float32")
+    (g,) = exe.run(feed={"x": xv}, fetch_list=[gx.name])
+    # d/dx (sum(2x) + sum(-3x)) = -1 everywhere
+    np.testing.assert_allclose(g, np.full((2, 3), -1.0), rtol=1e-5)
+
+
+def test_calc_gradient_multiple_targets_with_cotangents():
+    x = fluid.layers.data("x", shape=[2])
+    x.stop_gradient = False
+    y1 = fluid.layers.scale(x, scale=2.0)
+    y2 = fluid.layers.elementwise_mul(x, x)   # x^2
+    ct1 = fluid.layers.data("ct1", shape=[2])
+    ct2 = fluid.layers.data("ct2", shape=[2])
+    (gx,) = fluid.calc_gradient([y1, y2], x, target_gradients=[ct1, ct2])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1.0, 2.0]], dtype="float32")
+    c1 = np.array([[1.0, 1.0]], dtype="float32")
+    c2 = np.array([[3.0, 0.5]], dtype="float32")
+    (g,) = exe.run(feed={"x": xv, "ct1": c1, "ct2": c2},
+                   fetch_list=[gx.name])
+    # d/dx (<2x, c1> + <x^2, c2>) = 2*c1 + 2*x*c2
+    np.testing.assert_allclose(g, 2 * c1 + 2 * xv * c2, rtol=1e-5)
+
+
+def test_gradless_inplace_op_passes_cotangent_through():
+    """Regression: the producer-side pending clear must not fire for ops
+    that appended no grad ops — a grad-less in-place op (increment)
+    shares its output name with its input, and the cotangent must keep
+    flowing through that name to the real producer."""
+    x = fluid.layers.data("x", shape=[6])
+    x.stop_gradient = False
+    y = fluid.layers.scale(x, scale=2.0)
+    fluid.layers.increment(y, value=1.0, in_place=True)
+    loss = fluid.layers.reduce_mean(y)
+    (gx,) = fluid.calc_gradient(loss, x)
+    assert gx is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    (g,) = exe.run(feed={"x": np.ones((1, 6), "float32")},
+                   fetch_list=[gx.name])
+    np.testing.assert_allclose(g, np.full((1, 6), 2 / 6), rtol=1e-5)

@@ -438,3 +438,97 @@ def test_beam_search_decode_backtrack():
     # beam 1 at T-1 token 10, parent 0 -> step1 beam0 token 7 -> token 5
     np.testing.assert_array_equal(sv[0, 1], [5, 7, 10])
     np.testing.assert_allclose(scv_out, scores)
+
+
+# ---------------------------------------------------------------------------
+# While backward (bounded max_trip_count -> predicated scan, WhileGrad)
+# ---------------------------------------------------------------------------
+
+def test_while_backward_trains_through_loop():
+    """A training step whose loss path crosses a While: iteratively apply
+    y <- tanh(y @ W) for a data-dependent number of trips (bounded), and
+    train W by gradient descent.  Gradients are checked against the
+    jax reference of the unrolled computation."""
+    import jax
+    import jax.numpy as jnp
+
+    d, trips = 3, 3
+    x = fluid.layers.data("x", shape=[d])
+    y = fluid.layers.assign(x)
+    i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=trips)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond, max_trip_count=8)
+    with w.block():
+        fluid.layers.assign(
+            fluid.layers.fc(y, size=d, bias_attr=False, act="tanh",
+                            param_attr=fluid.ParamAttr(name="while_w")),
+            output=y)
+        fluid.layers.increment(i, value=1)
+        fluid.layers.less_than(i, n, cond=cond)
+    loss = fluid.layers.reduce_mean(fluid.layers.square(y))
+    sgd = fluid.optimizer.SGD(learning_rate=0.1)
+    sgd.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    xv = rng.rand(4, d).astype("float32")
+    scope = fluid.global_scope()
+    w0 = np.array(scope.var("while_w"))
+
+    (lv,) = exe.run(feed={"x": xv}, fetch_list=[loss])
+    w1 = np.array(scope.var("while_w"))
+
+    # jax reference: same trips unrolled
+    def ref_loss(wv):
+        yv = jnp.asarray(xv)
+        for _ in range(trips):
+            yv = jnp.tanh(yv @ wv)
+        return jnp.mean(jnp.square(yv))
+
+    g = jax.grad(ref_loss)(jnp.asarray(w0))
+    np.testing.assert_allclose(np.asarray(lv)[0], ref_loss(jnp.asarray(w0)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(w1, w0 - 0.1 * np.asarray(g), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_while_backward_without_bound_raises():
+    d = 2
+    x = fluid.layers.data("x", shape=[d])
+    y = fluid.layers.assign(x)
+    i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=2)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond)
+    with w.block():
+        fluid.layers.assign(fluid.layers.scale(y, scale=0.5), output=y)
+        fluid.layers.increment(i, value=1)
+        fluid.layers.less_than(i, n, cond=cond)
+    loss = fluid.layers.reduce_mean(y)
+    with pytest.raises(RuntimeError, match="max_trip_count"):
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+
+def test_while_upstream_producer_gradient_not_double_counted():
+    """Regression: the loop carry's upstream producer must receive ONLY
+    the through-loop gradient — the name-based grad accumulator used to
+    also leak the post-loop cotangent into it (in-place Out aliasing)."""
+    x = fluid.layers.data("x", shape=[6])
+    x.stop_gradient = False
+    y = fluid.layers.scale(x, scale=1.0)
+    i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=3)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond, max_trip_count=8)
+    with w.block():
+        fluid.layers.assign(fluid.layers.scale(y, scale=0.5), output=y)
+        fluid.layers.increment(i, value=1)
+        fluid.layers.less_than(i, n, cond=cond)
+    loss = fluid.layers.reduce_mean(y)
+    (gx,) = fluid.calc_gradient(loss, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((1, 6), dtype="float32")
+    (g,) = exe.run(feed={"x": xv}, fetch_list=[gx.name])
+    np.testing.assert_allclose(g, np.full((1, 6), 0.5 ** 3 / 6), rtol=1e-6)

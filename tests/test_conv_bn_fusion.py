@@ -167,3 +167,22 @@ def test_bn_act_matmul_kernel_parity_interpret(hw):
             np.asarray(a), np.asarray(bb), rtol=1e-3, atol=5e-2,
             err_msg="cotangent %s mismatch" % nm)
     assert all(np.isfinite(np.asarray(g)).all() for g in gk)
+
+
+def test_fused_program_keeps_relu_output_fetchable():
+    """Regression: the absorbed relu's output var (what layers.batch_norm
+    returns to the user) must survive the rewrite for fetching."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[8, 4, 4])
+        c1 = fluid.layers.conv2d(img, 8, 1, bias_attr=False)
+        b = fluid.layers.batch_norm(c1, act="relu")
+        fluid.layers.conv2d(b, 8, 1, bias_attr=False)
+        assert fluid.transpiler.fuse_conv_bn(main) == 1
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        bv, = exe.run(main,
+                      feed={"img": np.random.rand(2, 8, 4, 4
+                                                  ).astype("float32")},
+                      fetch_list=[b.name])
+        assert np.isfinite(bv).all() and (bv >= 0).all()

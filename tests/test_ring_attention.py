@@ -107,3 +107,43 @@ def test_ring_attention_rejects_unknown_axis():
         ring_attention(jnp.zeros((1, 1, 8, 4)), jnp.zeros((1, 1, 8, 4)),
                        jnp.zeros((1, 1, 8, 4)), sp, axis="sp",
                        batch_axis="sp")
+
+
+def test_ring_attention_with_tp_sharded_heads():
+    """sp composes with tp: heads sharded over tp inside the ring
+    (ops/attention.py passes head_axis_name), batch over dp."""
+    from paddle_tpu.ops.attention import _ring_attention
+
+    rng = np.random.RandomState(7)
+    b, h, t, d = 2, 4, 4, 4
+    q = rng.randn(b, h, t, d).astype("float32")
+    k = rng.randn(b, h, t, d).astype("float32")
+    v = rng.randn(b, h, t, d).astype("float32")
+    mesh = make_mesh((2, 2, 2), ("dp", "tp", "sp"))
+    out = _ring_attention(mesh, jnp.asarray(q), jnp.asarray(k),
+                          jnp.asarray(v), None, None, False, 0.0, None)
+    assert len(out.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(out),
+                               _full_attention(q, k, v), atol=2e-5)
+
+
+def test_ring_attention_tp_heads_dropout_mask_parity():
+    """The dropout hash must use GLOBAL head indices: a tp-sharded ring
+    run reproduces the single-chip mask bit-for-bit."""
+    from paddle_tpu.ops.attention import _ring_attention
+    from paddle_tpu.ops.pallas.flash_attention import reference_attention
+
+    rng = np.random.RandomState(8)
+    b, h, t, d = 2, 4, 4, 4
+    q = rng.randn(b, h, t, d).astype("float32")
+    k = rng.randn(b, h, t, d).astype("float32")
+    v = rng.randn(b, h, t, d).astype("float32")
+    seed = jnp.asarray(12345, jnp.uint32)
+    mesh = make_mesh((2, 2, 2), ("dp", "tp", "sp"))
+    out = _ring_attention(mesh, jnp.asarray(q), jnp.asarray(k),
+                          jnp.asarray(v), None, seed, False, 0.3, None)
+    want = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), None, seed, False, 0.3,
+                               None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-5)

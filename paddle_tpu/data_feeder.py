@@ -90,11 +90,14 @@ class DataFeeder:
             self.feed_shapes.append(v.shape)
             self.feed_lod_levels.append(v.lod_level or 0)
 
-    def feed(self, iterable):
+    def feed(self, iterable, pad_to=None):
         """rows of tuples -> {name: batched ndarray}; sequence fields
-        (lod_level>=1) additionally produce the '<name>@LEN' array."""
+        (lod_level>=1) additionally produce the '<name>@LEN' array.
+        ``pad_to`` overrides the constructor's pad length for this batch
+        — the per-bucket pad bound of ``reader.bucket_by_length``."""
+        pad = pad_to if pad_to is not None else self.pad_to
         converters = [
-            _SequenceConverter(shape, dtype, pad_to=self.pad_to)
+            _SequenceConverter(shape, dtype, pad_to=pad)
             if lod >= 1 else _Converter(shape, dtype)
             for shape, dtype, lod in zip(
                 self.feed_shapes, self.feed_dtypes, self.feed_lod_levels)

@@ -23,6 +23,7 @@ __all__ = [
     "load_vars", "load_params", "load_persistables",
     "save_inference_model", "load_inference_model",
     "save_checkpoint", "load_checkpoint", "clean_checkpoint",
+    "save_train_program", "load_train_program",
 ]
 
 
@@ -148,6 +149,51 @@ def load_inference_model(dirname, executor, model_filename=None,
         program.global_block().var(n) for n in payload["fetch_names"]
     ]
     return program, payload["feed_names"], fetch_vars
+
+
+def save_train_program(dirname, main_program=None, startup_program=None,
+                       loss_name=None, feed_names=None):
+    """Serialize a FULL training program (forward + backward + optimizer
+    ops) plus its startup program so training can run with no python
+    graph build — the reference's train-without-python capability
+    (``paddle/fluid/train/demo/demo_trainer.cc:1`` loads ProgramDescs
+    and drives the C++ executor; here the JSON ProgramDesc analog +
+    ``tools/train_from_program.py`` / ``load_train_program``)."""
+    from .framework import default_startup_program
+
+    if main_program is None:
+        main_program = default_main_program()
+    if startup_program is None:
+        startup_program = default_startup_program()
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "__train_program__"), "w") as f:
+        json.dump({
+            "main": main_program.to_dict(),
+            "startup": startup_program.to_dict(),
+            "loss_name": loss_name,
+            "feed_names": list(feed_names or []),
+        }, f)
+
+
+def load_train_program(dirname):
+    """Returns (main_program, startup_program, loss_name, feed_names).
+    ``loss_name`` falls back to the first ``mean`` op's output when not
+    recorded — the discovery rule of the reference demo trainer."""
+    with open(os.path.join(dirname, "__train_program__")) as f:
+        payload = json.load(f)
+    main = Program.from_dict(payload["main"])
+    startup = Program.from_dict(payload["startup"])
+    loss_name = payload.get("loss_name")
+    if not loss_name:
+        for op in main.global_block().ops:
+            if op.type == "mean":
+                loss_name = op.outputs["Out"][0]
+                break
+    feed_names = payload.get("feed_names") or [
+        name for name, v in main.global_block().vars.items()
+        if getattr(v, "is_data", False)
+    ]
+    return main, startup, loss_name, feed_names
 
 
 # ---- trainer-level checkpoints (reference io.py save_checkpoint family) ---

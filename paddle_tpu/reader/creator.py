@@ -1,9 +1,11 @@
 """Reader creators (reference ``python/paddle/reader/creator.py:19``:
-np_array, text_file, recordio)."""
+np_array, text_file, recordio; plus the ``open_files`` parallel
+multi-file reader family from
+``operators/reader/open_files_op.cc`` re-designed host-side)."""
 
 import pickle
 
-__all__ = ["np_array", "text_file", "recordio"]
+__all__ = ["np_array", "text_file", "recordio", "open_recordio_files"]
 
 
 def np_array(x):
@@ -43,3 +45,55 @@ def recordio(paths, buf_size=100):
             yield pickle.loads(rec)
 
     return buffered(reader, buf_size)
+
+
+def open_recordio_files(paths, num_workers=4, chunks_per_task=1,
+                        prefetch=256, unpickle=True, mapper=None):
+    """Parallel multi-file recordio reader: the ``open_files_op.cc``
+    capability (N files scanned by M threads feeding one queue),
+    re-designed host-side with worker PROCESSES (python decode does not
+    thread) over CHUNK-RANGE shards.
+
+    Every file is split into ``chunks_per_task``-chunk tasks
+    (``recordio.Scanner(skip_chunks, max_chunks)`` — chunk skipping
+    never decodes payloads); tasks round-robin across ``num_workers``
+    processes whose outputs interleave in arrival order through a
+    ``prefetch``-deep queue.  Sample order is therefore nondeterministic
+    across workers (exactly like the reference's multi-thread reader);
+    use ``num_workers=1`` for deterministic order.
+
+    ``mapper`` (picklable sample -> sample) runs INSIDE the worker
+    processes — the decode/augment stage (jpeg decode,
+    ``dataset.image.simple_transform``) parallelizes with the scan
+    instead of serializing on the consumer.
+    """
+    from .. import recordio as rio
+    from .decorator import multiprocess_reader
+
+    if isinstance(paths, str):
+        paths = [p for p in paths.split(",") if p]
+
+    tasks = []
+    for p in paths:
+        n = rio.num_chunks(p)
+        for start in range(0, max(n, 1), chunks_per_task):
+            tasks.append((p, start, chunks_per_task))
+
+    num_workers = max(1, min(num_workers, len(tasks)))
+
+    def make_worker(worker_tasks):
+        def worker_reader():
+            for path, skip, cnt in worker_tasks:
+                with rio.Scanner(path, skip_chunks=skip,
+                                 max_chunks=cnt) as s:
+                    for rec in s:
+                        sample = pickle.loads(rec) if unpickle else rec
+                        yield mapper(sample) if mapper is not None \
+                            else sample
+        return worker_reader
+
+    workers = [make_worker(tasks[i::num_workers])
+               for i in range(num_workers)]
+    if num_workers == 1:
+        return workers[0]
+    return multiprocess_reader(workers, queue_size=prefetch)

@@ -21,6 +21,7 @@ __all__ = [
     "xmap_readers",
     "multiprocess_reader",
     "cache",
+    "bucket_by_length",
 ]
 
 
@@ -224,4 +225,65 @@ def cache(reader):
         else:
             for item in all_data:
                 yield item
+    return data_reader
+
+
+def bucket_by_length(reader, length_fn, bucket_bounds, batch_size,
+                     drop_last=False):
+    """Group variable-length samples into length buckets and yield
+    ``(bound, samples)`` batches where every sample's length fits the
+    bucket's bound.
+
+    The TPU redesign of the reference's length-bucketing machinery
+    (``lod_rank_table_op.cc`` + ``lod_tensor_to_array_op.cc`` +
+    ``reorder_lod_tensor_by_rank_op.cc``: in-graph rank tables reorder
+    LoD batches by length so RNN steps skip padding): under XLA,
+    data-dependent in-graph reordering would defeat static shapes, so
+    bucketing moves host-side — each bucket pads to its own FIXED bound,
+    giving ``len(bucket_bounds)`` jit signatures total while cutting the
+    padding waste of pad-to-max batching.  Feed a bucket's batch with
+    ``DataFeeder.feed(samples, pad_to=bound)``.
+
+    ``length_fn(sample) -> int``; samples longer than the last bound
+    raise (declare a final bound >= the true maximum).  Trailing
+    partial batches flush at end-of-stream unless ``drop_last``.
+
+    ``batch_size`` may be a per-bucket list (short buckets take larger
+    batches so tokens-per-step — and therefore step efficiency — stays
+    roughly constant across buckets, the bucket_by_sequence_length
+    recipe).
+    """
+    raw_bounds = [int(b) for b in bucket_bounds]
+    if not raw_bounds:
+        raise ValueError("bucket_bounds must be non-empty")
+    if isinstance(batch_size, (list, tuple)):
+        if len(batch_size) != len(raw_bounds):
+            raise ValueError("batch_size list must match bucket_bounds")
+        raw_sizes = [int(b) for b in batch_size]
+    else:
+        raw_sizes = [int(batch_size)] * len(raw_bounds)
+    # sizes sort WITH their bounds: callers pair them positionally
+    pairs = sorted(zip(raw_bounds, raw_sizes))
+    bounds = [b for b, _ in pairs]
+    sizes = [s for _, s in pairs]
+
+    def data_reader():
+        buckets = [[] for _ in bounds]
+        for sample in reader():
+            n = int(length_fn(sample))
+            for i, b in enumerate(bounds):
+                if n <= b:
+                    buckets[i].append(sample)
+                    if len(buckets[i]) == sizes[i]:
+                        yield bounds[i], buckets[i]
+                        buckets[i] = []
+                    break
+            else:
+                raise ValueError(
+                    "sample length %d exceeds the largest bucket bound %d"
+                    % (n, bounds[-1]))
+        if not drop_last:
+            for b, bucket in zip(bounds, buckets):
+                if bucket:
+                    yield b, bucket
     return data_reader

@@ -75,6 +75,8 @@ def _load():
             ctypes.POINTER(ctypes.c_uint64)]
         lib.rio_scanner_skip_chunk.restype = ctypes.c_int
         lib.rio_scanner_skip_chunk.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_set_max_chunks.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_uint64]
         lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
         lib.rio_num_chunks.restype = ctypes.c_int64
         lib.rio_num_chunks.argtypes = [ctypes.c_char_p]
@@ -145,7 +147,11 @@ class Scanner:
     fast-forwards whole chunks without decoding — the sharded-read path
     used with the elastic master's chunk leases."""
 
-    def __init__(self, path, skip_chunks=0):
+    def __init__(self, path, skip_chunks=0, max_chunks=0):
+        """``skip_chunks`` fast-forwards, ``max_chunks`` caps decoded
+        chunks (0 = unlimited): together they scan the chunk range
+        [skip, skip+max) — the shard unit of the parallel multi-file
+        readers and the elastic master's task leases."""
         lib = _load()
         if lib is not None:
             self._h = lib.rio_scanner_open(os.fsencode(path))
@@ -159,6 +165,8 @@ class Scanner:
                         raise IOError("corrupt recordio file %r" % path)
                     if rc == 0:
                         break
+                if max_chunks:
+                    lib.rio_scanner_set_max_chunks(self._h, max_chunks)
             except Exception:
                 lib.rio_scanner_close(self._h)
                 self._h = None
@@ -166,7 +174,7 @@ class Scanner:
         else:
             from . import _pyimpl
 
-            self._py = _pyimpl.PyScanner(path, skip_chunks)
+            self._py = _pyimpl.PyScanner(path, skip_chunks, max_chunks)
             self._h = None
 
     def __iter__(self):

@@ -61,8 +61,10 @@ def _read_header(f):
 
 
 class PyScanner:
-    def __init__(self, path, skip_chunks=0):
+    def __init__(self, path, skip_chunks=0, max_chunks=0):
         self._f = open(path, "rb")
+        self._max_chunks = max_chunks
+        self._chunks_read = 0
         for _ in range(skip_chunks):
             h = _read_header(self._f)
             if h is None:
@@ -71,9 +73,12 @@ class PyScanner:
 
     def __iter__(self):
         while True:
+            if self._max_chunks and self._chunks_read >= self._max_chunks:
+                return
             h = _read_header(self._f)
             if h is None:
                 return
+            self._chunks_read += 1
             comp, n, raw_len, payload_len, crc = h
             payload = self._f.read(payload_len)
             if len(payload) != payload_len:

@@ -57,6 +57,8 @@ struct Scanner {
   std::string decoded;       // current chunk's raw payload
   size_t pos = 0;            // cursor into decoded
   uint32_t remaining = 0;    // records left in current chunk
+  uint64_t chunks_read = 0;  // decoded chunks so far
+  uint64_t max_chunks = 0;   // 0 = unlimited; else stop after this many
   bool error = false;
 };
 
@@ -95,6 +97,9 @@ bool write_chunk(Writer* w) {
 }
 
 bool read_chunk(Scanner* s) {
+  if (s->max_chunks && s->chunks_read >= s->max_chunks) {
+    return false;  // chunk budget exhausted: clean end-of-shard
+  }
   ChunkHeader h;
   size_t got = fread(&h, 1, sizeof(h), s->f);
   if (got == 0) return false;  // clean EOF
@@ -128,6 +133,7 @@ bool read_chunk(Scanner* s) {
   }
   s->pos = 0;
   s->remaining = h.num_records;
+  s->chunks_read++;
   return true;
 }
 
@@ -226,6 +232,13 @@ int rio_scanner_skip_chunk(void* sp) {
     return -1;
   }
   return 1;
+}
+
+// Cap the scan at n decoded chunks (0 = unlimited): with skip_chunk this
+// gives [skip, skip+n) chunk-range shards — the unit the open_files-style
+// parallel readers and the elastic master's task leases partition.
+void rio_scanner_set_max_chunks(void* sp, uint64_t n) {
+  static_cast<Scanner*>(sp)->max_chunks = n;
 }
 
 void rio_scanner_close(void* sp) {

@@ -92,3 +92,72 @@ def test_pyreader_trains_model():
             (lv,) = exe.run(feed=feed, fetch_list=[loss])
             losses.append(float(np.asarray(lv).ravel()[0]))
     assert losses[-1] < losses[0]
+
+
+def test_bucket_by_length_batches_and_flush():
+    from paddle_tpu.reader import decorator as dec
+
+    lengths = [3, 15, 9, 2, 30, 14, 4, 16, 31, 1, 20, 8]
+
+    def reader():
+        for n in lengths:
+            yield (np.arange(n),)
+
+    r = dec.bucket_by_length(reader, lambda s: len(s[0]),
+                             bucket_bounds=[8, 16, 32], batch_size=2)
+    batches = list(r())
+    for bound, samples in batches:
+        assert len(samples) <= 2
+        assert all(len(s[0]) <= bound for s in samples)
+        # every sample belongs in THIS bucket, not a smaller one
+        prev = {8: 0, 16: 8, 32: 16}[bound]
+        assert all(len(s[0]) > prev for s in samples)
+    # all samples come back exactly once
+    got = sorted(len(s[0]) for _, b in batches for s in b)
+    assert got == sorted(lengths)
+    # full batches first per bucket, trailing partials flushed at end
+    r2 = dec.bucket_by_length(reader, lambda s: len(s[0]),
+                              bucket_bounds=[8, 16, 32], batch_size=2,
+                              drop_last=True)
+    got2 = [len(s[0]) for _, b in r2() for s in b]
+    assert len(got2) < len(lengths)  # partials dropped
+
+    with pytest.raises(ValueError, match="exceeds"):
+        list(dec.bucket_by_length(reader, lambda s: len(s[0]),
+                                  bucket_bounds=[8], batch_size=2)())
+
+
+def test_data_feeder_per_call_pad_to():
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        w = fluid.layers.data("w", shape=[1], dtype="int64", lod_level=1)
+        feeder = fluid.DataFeeder(feed_list=[w], place=fluid.CPUPlace())
+        batch = [(np.array([1, 2, 3]),), (np.array([4],),)]
+        out = feeder.feed(batch, pad_to=8)
+        assert out["w"].shape == (2, 8, 1)
+        np.testing.assert_array_equal(out["w@LEN"], [3, 1])
+        # constructor default unaffected
+        out2 = feeder.feed(batch)
+        assert out2["w"].shape == (2, 3, 1)
+
+
+def test_bucket_by_length_sizes_sort_with_bounds():
+    """Regression: per-bucket batch sizes pair positionally with the
+    CALLER's bound order, surviving the internal sort."""
+    from paddle_tpu.reader import decorator as dec
+
+    def reader():
+        for n in [2, 3, 2, 20, 2, 2]:
+            yield (np.arange(n),)
+
+    r = dec.bucket_by_length(reader, lambda s: len(s[0]),
+                             bucket_bounds=[64, 8], batch_size=[1, 4])
+    batches = list(r())
+    for bound, samples in batches:
+        if bound == 8:
+            assert len(samples) <= 4
+        else:
+            assert len(samples) == 1  # long bucket batches 1
+    sizes = {(b, len(s)) for b, s in batches}
+    assert (8, 4) in sizes and (64, 1) in sizes

@@ -1,0 +1,107 @@
+"""Train from a saved program — no python graph build.
+
+The reference can train a model whose graph was built elsewhere: its
+C++ demo trainer loads serialized ProgramDescs and drives the executor
+(``paddle/fluid/train/demo/demo_trainer.cc:1``).  This CLI is the
+TPU-native analog over the JSON ProgramDesc
+(``io.save_train_program``/``load_train_program``): load the FULL
+training program (forward + backward + optimizer ops), initialize or
+restore parameters, feed data, and step the jit-compiled executor.
+
+Usage:
+    python tools/train_from_program.py --model_dir DIR [--steps N]
+        [--batch_size B] [--device cpu|tpu] [--params_dir DIR]
+        [--feed data.npz] [--save_params_dir DIR]
+
+Without ``--feed``, synthetic batches are generated from the program's
+data-var shapes/dtypes (integer fields draw from {0, 1} so any
+embedding table size is valid).  ``--feed`` supplies real named arrays
+(full-batch; sliced into ``--batch_size`` chunks per step).
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def synthesize_feed(program, feed_names, batch_size, rng):
+    """One batch per data var from its declared shape/dtype."""
+    feed = {}
+    block = program.global_block()
+    for name in feed_names:
+        v = block.var(name)
+        shape = [batch_size if (s is None or s < 0) else s
+                 for s in (v.shape or (1,))]
+        dtype = str(v.dtype or "float32")
+        if "int" in dtype:
+            feed[name] = rng.randint(0, 2, shape).astype(dtype)
+        else:
+            feed[name] = rng.standard_normal(shape).astype(dtype)
+        if (v.lod_level or 0) >= 1:
+            feed[name + "@LEN"] = np.full((shape[0],), shape[1], "int32")
+    return feed
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model_dir", required=True)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--device", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--params_dir", default=None,
+                   help="restore persistables instead of running startup")
+    p.add_argument("--save_params_dir", default=None,
+                   help="save persistables after training")
+    p.add_argument("--feed", default=None,
+                   help="npz of named arrays (real data; sliced per step)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    import paddle_tpu as fluid
+
+    main_prog, startup, loss_name, feed_names = \
+        fluid.io.load_train_program(args.model_dir)
+    if not loss_name:
+        raise SystemExit("no loss found: save with loss_name or include "
+                         "a mean op in the program")
+    place = fluid.CPUPlace() if args.device == "cpu" else fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    losses = []
+    with fluid.scope_guard(scope):
+        if args.params_dir:
+            exe.run(startup)   # create optimizer state, then overwrite
+            fluid.io.load_persistables(exe, args.params_dir, main_prog)
+        else:
+            exe.run(startup)
+        rng = np.random.RandomState(args.seed)
+        data = dict(np.load(args.feed)) if args.feed else None
+        for step in range(args.steps):
+            if data is not None:
+                n = next(iter(data.values())).shape[0]
+                lo = (step * args.batch_size) % max(n - args.batch_size + 1,
+                                                   1)
+                feed = {k: v[lo:lo + args.batch_size]
+                        for k, v in data.items()}
+            else:
+                feed = synthesize_feed(main_prog, feed_names,
+                                       args.batch_size, rng)
+            (lv,) = exe.run(main_prog, feed=feed, fetch_list=[loss_name])
+            val = float(np.asarray(lv).ravel()[0])
+            losses.append(val)
+            print("step: %d loss: %.6f" % (step, val), flush=True)
+        if args.save_params_dir:
+            fluid.io.save_persistables(exe, args.save_params_dir,
+                                       main_prog)
+    if not all(np.isfinite(losses)):
+        raise SystemExit("non-finite loss")
+    return losses
+
+
+if __name__ == "__main__":
+    main()

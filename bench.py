@@ -48,7 +48,9 @@ FLOPS_PER_ITEM = {
     "mlp": 3 * 2 * (784 * 256 + 256 * 256 + 256 * 10),
 }
 
-N_WINDOWS = 3
+# min-of-windows is the estimator; the shared tunneled chip's noise is
+# +/-2% between invocations, so more windows tightens the min's variance
+N_WINDOWS = 5
 
 
 class _PassthroughFeeder:
@@ -271,7 +273,9 @@ def _jpeg_pipeline(batch, rng):
     tmp = tempfile.mkdtemp(prefix="bench_rio_")
     atexit.register(shutil.rmtree, tmp, ignore_errors=True)
     path = tmp + "/train.rio"
-    n_images = 512
+    # large enough that the per-epoch worker-pool restart amortizes
+    # (an epoch = n_images/batch steps)
+    n_images = 2048
     with rio.Writer(path, max_chunk_bytes=1 << 20) as w:
         for i in range(n_images):
             im = rng.randint(0, 256, (224, 224, 3), "uint8")
@@ -286,18 +290,21 @@ def _jpeg_pipeline(batch, rng):
         return im.transpose(2, 0, 1), label   # CHW uint8
 
     def batch_reader():
-        while True:   # epoch loop: the bench consumes a fixed step count
-            r = open_recordio_files([path], num_workers=8,
-                                    chunks_per_task=1, mapper=decode)
-            imgs, labels = [], []
-            for im, lbl in r():
-                imgs.append(im)
-                labels.append(lbl)
-                if len(imgs) == batch:
-                    yield {"img": np.stack(imgs),
-                           "label": np.asarray(labels,
-                                               "int64").reshape(-1, 1)}
-                    imgs, labels = [], []
+        # repeat=True: one persistent worker pool streams epochs forever
+        # (no per-epoch re-fork inside the timed windows); the daemon
+        # workers die with the bench process
+        r = open_recordio_files([path], num_workers=8,
+                                chunks_per_task=1, mapper=decode,
+                                repeat=True)
+        imgs, labels = [], []
+        for im, lbl in r():
+            imgs.append(im)
+            labels.append(lbl)
+            if len(imgs) == batch:
+                yield {"img": np.stack(imgs),
+                       "label": np.asarray(labels,
+                                           "int64").reshape(-1, 1)}
+                imgs, labels = [], []
     return batch_reader
 
 
@@ -396,15 +403,15 @@ def bench_transformer_realdist(args, use_amp=True):
                 n = int(np.clip(rng.lognormal(3.2, 0.55), 4, max_len))
                 yield (rng.randint(2, vocab, (n, 1)).astype("int64"),)
 
+        # batches feed through the framework's own bucket integration
+        # path: DataFeeder.feed(samples, pad_to=bound)
+        feeder = fluid.DataFeeder(feed_list=[src, tgt, label],
+                                  place=_place(args))
+
         def make_feed(samples, pad_to):
-            arr = np.zeros((len(samples), pad_to, 1), "int64")
-            lens = np.zeros((len(samples),), "int32")
-            for i, (s,) in enumerate(samples):
-                arr[i, :len(s)] = s
-                lens[i] = len(s)
-            return {"src_word": arr, "src_word@LEN": lens,
-                    "tgt_word": arr, "tgt_word@LEN": lens,
-                    "lbl_word": arr, "lbl_word@LEN": lens}, int(lens.sum())
+            triple = [(s, s, s) for (s,) in samples]
+            feed = feeder.feed(triple, pad_to=pad_to)
+            return feed, int(feed["src_word@LEN"].sum())
 
         # pre-build feed pools (fixed: pad to max; bucketed: per-bound)
         stream = sample_stream()
@@ -473,6 +480,103 @@ def bench_transformer_realdist(args, use_amp=True):
                     results["bucketed"] / results["fixed_pad_max"], 3))
 
 
+def bench_longctx(args, use_amp=True):
+    """Long-context decoder-only LM step (T=4k/8k, single chip): the
+    regime the Pallas flash-attention kernel exists for — XLA's batched
+    attention materializes [B, H, T, T] scores (T=8192, H=8: 1GB bf16
+    per direction per layer), the blockwise kernel never does.  Measures
+    tokens/sec with the XLA fallback vs FLAGS_pallas_kernels at each T
+    and reports both (VERDICT r3 #4: prove the kernel's regime or
+    demote it)."""
+    import paddle_tpu as fluid
+
+    d_model, n_head, n_layer = 512, 8, 2
+    vocab = 32000
+    results = {}
+    for seq_len, batch in ((4096, 2), (8192, 1)):
+        fluid.set_flags({"FLAGS_pallas_attention_max_seq": seq_len})
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            ids = fluid.layers.data("ids", shape=[seq_len, 1],
+                                    dtype="int64")
+            emb = fluid.layers.embedding(ids, size=[vocab, d_model])
+            x = fluid.layers.reshape(emb, shape=[-1, seq_len, d_model])
+            dh = d_model // n_head
+            for _ in range(n_layer):
+                qkv = fluid.layers.fc(x, size=3 * d_model, act=None,
+                                      num_flatten_dims=2)
+                qkv = fluid.layers.reshape(
+                    qkv, shape=[-1, seq_len, 3, n_head, dh])
+                qkv = fluid.layers.transpose(qkv, perm=[2, 0, 3, 1, 4])
+                q = fluid.layers.reshape(
+                    fluid.layers.slice(qkv, axes=[0], starts=[0],
+                                       ends=[1]),
+                    shape=[-1, n_head, seq_len, dh])
+                k = fluid.layers.reshape(
+                    fluid.layers.slice(qkv, axes=[0], starts=[1],
+                                       ends=[2]),
+                    shape=[-1, n_head, seq_len, dh])
+                v = fluid.layers.reshape(
+                    fluid.layers.slice(qkv, axes=[0], starts=[2],
+                                       ends=[3]),
+                    shape=[-1, n_head, seq_len, dh])
+                att = fluid.layers.fused_attention(q, k, v, causal=True)
+                att = fluid.layers.reshape(
+                    fluid.layers.transpose(att, perm=[0, 2, 1, 3]),
+                    shape=[-1, seq_len, d_model])
+                x = fluid.layers.elementwise_add(
+                    x, fluid.layers.fc(att, size=d_model,
+                                       num_flatten_dims=2))
+                x = fluid.layers.elementwise_add(
+                    x, fluid.layers.fc(
+                        fluid.layers.fc(x, size=2 * d_model, act="relu",
+                                        num_flatten_dims=2),
+                        size=d_model, num_flatten_dims=2))
+            pool = fluid.layers.reduce_mean(x, dim=1)
+            logits = fluid.layers.fc(pool, size=vocab, act=None)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square(logits))
+            _maybe_amp(fluid.optimizer.Adam(learning_rate=1e-4),
+                       use_amp).minimize(loss)
+
+            rng = np.random.RandomState(0)
+
+            def feed_fn():
+                return {"ids": rng.randint(
+                    2, vocab, (batch, seq_len, 1)).astype("int64")}
+
+            for pallas in (False, True):
+                fluid.set_flags({"FLAGS_pallas_kernels": pallas})
+                try:
+                    step_time, _ = _bench_program(
+                        fluid.default_main_program(),
+                        fluid.default_startup_program(),
+                        feed_fn, loss, _place(args), args.iterations,
+                        args.skip_batch_num)
+                    tps = batch * seq_len / step_time
+                    results["T%d_%s" % (seq_len,
+                                        "pallas" if pallas else "xla")] = \
+                        round(tps, 2)
+                except Exception as e:  # noqa: BLE001 — record the rung
+                    results["T%d_%s_error" % (
+                        seq_len, "pallas" if pallas else "xla")] = \
+                        str(e)[:200]
+            fluid.set_flags({"FLAGS_pallas_kernels": False})
+    for t in (4096, 8192):
+        p = results.get("T%d_pallas" % t)
+        x = results.get("T%d_xla" % t)
+        if isinstance(p, float) and isinstance(x, float) and x > 0:
+            results["T%d_pallas_vs_xla" % t] = round(p / x, 3)
+    # the primary is PINNED to the T=4096 Pallas rung so the metric's
+    # meaning is stable across rounds; vs_baseline for this entry is the
+    # pallas/xla ratio at that T (there is no era-hardware target)
+    val = results.get("T4096_pallas")
+    return dict({"metric": "longctx_decoder_tokens_per_sec_pallas",
+                 "value": val if isinstance(val, float) else 0.0,
+                 "unit": "tokens/sec",
+                 "vs_baseline": results.get("T4096_pallas_vs_xla", 0.0)},
+                **results)
+
+
 def _suffix(use_amp, per_step_feed):
     s = "_bf16" if use_amp else ""
     if per_step_feed:
@@ -494,7 +598,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="auto",
                    choices=["auto", "mlp", "resnet50", "transformer",
-                            "transformer_realdist"])
+                            "transformer_realdist", "longctx"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -548,6 +652,8 @@ def main():
             ("transformer", ["--fp32_only", "--fast_prng"]),
             ("resnet50", ["--with_reader"]),
             ("transformer_realdist", ["--fast_prng"]),
+            # compile-heavy (4 programs); steps themselves are fast
+            ("longctx", ["--iterations", "8", "--skip_batch_num", "2"]),
         ]
         results = []
         for i, (model, extra) in enumerate(runs):
@@ -564,7 +670,7 @@ def main():
                 try:                   # transient (remote_compile drops)
                     out = subprocess.run(
                         cmd, stdout=subprocess.PIPE,
-                        stderr=subprocess.PIPE, text=True, timeout=1800,
+                        stderr=subprocess.PIPE, text=True, timeout=2400,
                         check=True).stdout
                     results.append(
                         json.loads(out.strip().splitlines()[-1]))
@@ -590,6 +696,8 @@ def main():
     if args.model == "transformer_realdist":
         result = bench_transformer_realdist(args,
                                             use_amp=not args.fp32_only)
+    elif args.model == "longctx":
+        result = bench_longctx(args, use_amp=not args.fp32_only)
     else:
         fn = {"resnet50": bench_resnet50, "transformer": bench_transformer,
               "mlp": bench_mlp}[args.model]

@@ -48,7 +48,8 @@ def recordio(paths, buf_size=100):
 
 
 def open_recordio_files(paths, num_workers=4, chunks_per_task=1,
-                        prefetch=256, unpickle=True, mapper=None):
+                        prefetch=256, unpickle=True, mapper=None,
+                        repeat=False):
     """Parallel multi-file recordio reader: the ``open_files_op.cc``
     capability (N files scanned by M threads feeding one queue),
     re-designed host-side with worker PROCESSES (python decode does not
@@ -66,6 +67,11 @@ def open_recordio_files(paths, num_workers=4, chunks_per_task=1,
     processes — the decode/augment stage (jpeg decode,
     ``dataset.image.simple_transform``) parallelizes with the scan
     instead of serializing on the consumer.
+
+    ``repeat=True`` makes each worker loop its task list forever (the
+    steady-state epoch loop): the worker pool persists instead of
+    re-forking per epoch — the consumer takes as many samples as it
+    needs and abandons the (daemon) workers when done.
     """
     from .. import recordio as rio
     from .decorator import multiprocess_reader
@@ -83,13 +89,17 @@ def open_recordio_files(paths, num_workers=4, chunks_per_task=1,
 
     def make_worker(worker_tasks):
         def worker_reader():
-            for path, skip, cnt in worker_tasks:
-                with rio.Scanner(path, skip_chunks=skip,
-                                 max_chunks=cnt) as s:
-                    for rec in s:
-                        sample = pickle.loads(rec) if unpickle else rec
-                        yield mapper(sample) if mapper is not None \
-                            else sample
+            while True:
+                for path, skip, cnt in worker_tasks:
+                    with rio.Scanner(path, skip_chunks=skip,
+                                     max_chunks=cnt) as s:
+                        for rec in s:
+                            sample = pickle.loads(rec) if unpickle \
+                                else rec
+                            yield mapper(sample) if mapper is not None \
+                                else sample
+                if not repeat:
+                    return
         return worker_reader
 
     workers = [make_worker(tasks[i::num_workers])

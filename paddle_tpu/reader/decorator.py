@@ -174,9 +174,14 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
 
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     """Fork one OS process per reader (reference multiprocess_reader:338).
-    Samples interleave in arrival order."""
+    Samples interleave in arrival order.  A worker that DIES re-raises in
+    the consumer — a crashed shard must never read as a clean (truncated)
+    end-of-stream."""
     import multiprocessing as mp
     import pickle
+    import traceback
+
+    _ERR = "__mp_reader_worker_error__"
 
     def queue_reader():
         q = mp.Queue(queue_size)
@@ -185,8 +190,10 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             try:
                 for sample in r():
                     q.put(pickle.dumps(sample))
-            finally:
-                q.put(None)
+            except BaseException:  # noqa: BLE001 — relayed to the consumer
+                q.put((_ERR, traceback.format_exc()))
+                return
+            q.put(None)
 
         procs = [mp.Process(target=worker, args=(r,), daemon=True)
                  for r in readers]
@@ -197,6 +204,11 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             item = q.get()
             if item is None:
                 finished += 1
+            elif isinstance(item, tuple) and item and item[0] == _ERR:
+                for p in procs:
+                    p.terminate()
+                raise RuntimeError(
+                    "multiprocess_reader worker failed:\n" + item[1])
             else:
                 yield pickle.loads(item)
         for p in procs:

@@ -161,3 +161,39 @@ def test_bucket_by_length_sizes_sort_with_bounds():
             assert len(samples) == 1  # long bucket batches 1
     sizes = {(b, len(s)) for b, s in batches}
     assert (8, 4) in sizes and (64, 1) in sizes
+
+
+def test_multiprocess_reader_worker_crash_raises():
+    """Regression: a dead worker must raise, never read as a clean
+    (silently truncated) end-of-stream."""
+    from paddle_tpu.reader import decorator as dec
+
+    def good():
+        yield from range(3)
+
+    def bad():
+        yield 100
+        raise IOError("shard corrupt")
+
+    r = dec.multiprocess_reader([good, bad])
+    with pytest.raises(RuntimeError, match="worker failed"):
+        list(r())
+
+
+def test_open_recordio_files_repeat_streams_epochs():
+    import pickle
+    import itertools
+    import tempfile
+
+    from paddle_tpu import recordio as rio
+    from paddle_tpu.reader.creator import open_recordio_files
+
+    tmp = tempfile.mkdtemp()
+    p = tmp + "/r.rio"
+    with rio.Writer(p, max_chunk_bytes=64) as w:
+        for i in range(5):
+            w.write(pickle.dumps(i))
+    r = open_recordio_files([p], num_workers=1, repeat=True)
+    got = list(itertools.islice(r(), 12))   # > 2 epochs, no exhaustion
+    assert sorted(set(got)) == [0, 1, 2, 3, 4]
+    assert len(got) == 12

@@ -95,11 +95,13 @@ def main(argv=None):
             val = float(np.asarray(lv).ravel()[0])
             losses.append(val)
             print("step: %d loss: %.6f" % (step, val), flush=True)
+            if not np.isfinite(val):
+                # fail BEFORE publishing parameters: a diverged run must
+                # not leave NaN weights in --save_params_dir
+                raise SystemExit("non-finite loss at step %d" % step)
         if args.save_params_dir:
             fluid.io.save_persistables(exe, args.save_params_dir,
                                        main_prog)
-    if not all(np.isfinite(losses)):
-        raise SystemExit("non-finite loss")
     return losses
 
 

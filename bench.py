@@ -358,6 +358,92 @@ def bench_transformer(args, use_amp=False, per_step_feed=False):
                 **stats)
 
 
+def _bench_image_model(args, model_fn, metric_name, use_amp,
+                       per_step_feed, default_batch=128):
+    """Shared harness for the fluid_benchmark image models (vgg,
+    se_resnext): synthetic ImageNet-shaped feeds, Momentum, bf16 AMP."""
+    import paddle_tpu as fluid
+
+    batch = args.batch_size or default_batch
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        img = fluid.layers.data("img", shape=[3, 224, 224])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = model_fn(img)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        _maybe_amp(fluid.optimizer.Momentum(learning_rate=1e-3,
+                                            momentum=0.9),
+                   use_amp).minimize(loss)
+        rng = np.random.RandomState(0)
+
+        def feed_fn():
+            return {"img": rng.rand(batch, 3, 224, 224).astype("float32"),
+                    "label": rng.randint(0, 1000, (batch, 1)).astype(
+                        "int64")}
+
+        step_time, stats = _bench_program(
+            fluid.default_main_program(), fluid.default_startup_program(),
+            feed_fn, loss, _place(args), args.iterations,
+            args.skip_batch_num, per_step_feed)
+    ips = batch / step_time
+    return dict({"metric": metric_name + _suffix(use_amp, per_step_feed),
+                 "value": round(ips, 2), "unit": "images/sec",
+                 "vs_baseline": 1.0}, **stats)
+
+
+def bench_vgg(args, use_amp=False, per_step_feed=False):
+    """VGG-16 (fluid_benchmark models/vgg.py config)."""
+    from paddle_tpu.models.vgg import vgg16_bn_drop
+
+    return _bench_image_model(
+        args, lambda img: vgg16_bn_drop(img, class_dim=1000),
+        "vgg16_images_per_sec", use_amp, per_step_feed)
+
+
+def bench_se_resnext(args, use_amp=False, per_step_feed=False):
+    """SE-ResNeXt-50 (fluid_benchmark models/se_resnext.py config)."""
+    from paddle_tpu.models.se_resnext import se_resnext_50
+
+    return _bench_image_model(
+        args, lambda img: se_resnext_50(img, class_dim=1000),
+        "se_resnext50_images_per_sec", use_amp, per_step_feed)
+
+
+def bench_stacked_lstm(args, use_amp=False, per_step_feed=False):
+    """Stacked dynamic LSTM sentiment net (fluid_benchmark
+    models/stacked_dynamic_lstm.py config; the scan-based recurrence)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models.stacked_dynamic_lstm import stacked_lstm_net
+
+    batch = args.batch_size or 64
+    seq = 80
+    dict_dim = 5147
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        word = fluid.layers.data("word", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = stacked_lstm_net(word, dict_dim)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        _maybe_amp(fluid.optimizer.Adam(learning_rate=1e-3),
+                   use_amp).minimize(loss)
+        rng = np.random.RandomState(0)
+
+        def feed_fn():
+            ids = rng.randint(0, dict_dim, (batch, seq, 1)).astype("int64")
+            lens = rng.randint(seq // 2, seq + 1, (batch,)).astype("int32")
+            return {"word": ids, "word@LEN": lens,
+                    "label": rng.randint(0, 2, (batch, 1)).astype("int64")}
+
+        step_time, stats = _bench_program(
+            fluid.default_main_program(), fluid.default_startup_program(),
+            feed_fn, loss, _place(args), args.iterations,
+            args.skip_batch_num, per_step_feed)
+    wps = batch * seq / step_time
+    return dict({"metric": "stacked_lstm_words_per_sec" + _suffix(
+                     use_amp, per_step_feed),
+                 "value": round(wps, 2), "unit": "words/sec",
+                 "vs_baseline": 1.0}, **stats)
+
+
 def bench_transformer_realdist(args, use_amp=True):
     """Transformer tokens/sec on a REALISTIC (wmt16-like, skewed) length
     distribution: pad-to-max vs length-bucketed batching (VERDICT r3 #5).
@@ -598,7 +684,8 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="auto",
                    choices=["auto", "mlp", "resnet50", "transformer",
-                            "transformer_realdist", "longctx"])
+                            "transformer_realdist", "longctx", "vgg",
+                            "se_resnext", "stacked_lstm"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -700,7 +787,9 @@ def main():
         result = bench_longctx(args, use_amp=not args.fp32_only)
     else:
         fn = {"resnet50": bench_resnet50, "transformer": bench_transformer,
-              "mlp": bench_mlp}[args.model]
+              "mlp": bench_mlp, "vgg": bench_vgg,
+              "se_resnext": bench_se_resnext,
+              "stacked_lstm": bench_stacked_lstm}[args.model]
         result = fn(args, use_amp=not args.fp32_only,
                     per_step_feed=args.with_reader)
     # record the kernel/PRNG choices so A/Bs stay distinguishable in the

@@ -1,16 +1,120 @@
 """InferenceTranspiler (reference ``transpiler/inference_transpiler.py``:
-BN folding into conv/fc weights, conv+relu fusion for MKLDNN).
+BN folding into conv weights, conv+relu fusion for MKLDNN).
 
-TPU redesign: XLA fuses conv+bias+BN+relu chains in the compiled module,
-so the arithmetic rewrites are unnecessary; what remains semantically is
-switching train-mode ops to inference (the clone(for_test) rewrite).
+TPU semantics: XLA already fuses the normalize+relu ELEMENTWISE chain
+into the compiled module, but an inference-mode batch_norm still costs a
+full per-channel affine pass over the conv output every run.  Folding
+the (frozen) BN statistics INTO the convolution weights removes the op
+entirely — the same arithmetic rewrite the reference performs:
+
+    W' = W * gamma / sqrt(var + eps)        (per output channel)
+    b' = beta - mean * gamma / sqrt(var + eps)
+
+Relu fusion stays with XLA (it is free there).
 """
+
+import numpy as np
+
+from ..framework import Operator, Program
+from ..registry import infer_op
+from ..scope import global_scope
 
 __all__ = ["InferenceTranspiler"]
 
 
 class InferenceTranspiler:
     def transpile(self, program, place=None, scope=None):
-        """Return an inference-mode copy of ``program`` (dropout/BN to
-        is_test); numeric fusion is left to XLA."""
-        return program.clone(for_test=True)
+        """Return an inference-optimized COPY of ``program``: train-mode
+        ops switch to is_test (``clone(for_test=True)``), then frozen
+        batch_norm stats fold into preceding conv weights (new
+        ``@BNFOLD`` parameter values written to ``scope``).  The input
+        program is never mutated — use the return value."""
+        if not isinstance(program, Program):
+            raise TypeError("program should be a Program")
+        scope = scope if scope is not None else global_scope()
+        cloned = program.clone(for_test=True)
+        self._fuse_batch_norm(cloned, scope)
+        return cloned
+
+    # ------------------------------------------------------------------
+    def _fuse_batch_norm(self, program, scope):
+        block = program.global_block()
+        ops = block.ops
+        consumers = {}
+        producer = {}
+        for i, op in enumerate(ops):
+            for n in op.input_arg_names:
+                if n:
+                    consumers.setdefault(n, []).append(i)
+            for n in op.output_arg_names:
+                if n:
+                    producer[n] = i
+
+        folded = set()      # bn op indices folded away
+        rewires = {}        # bn op index -> (conv_out, bias_name, y_name)
+        for i, op in enumerate(ops):
+            if op.type != "batch_norm":
+                continue
+            if not (op.attrs.get("is_test") or
+                    op.attrs.get("use_global_stats")):
+                continue
+            x = op.inputs["X"][0]
+            p = producer.get(x)
+            if p is None or ops[p].type != "conv2d":
+                continue
+            if consumers.get(x, []) != [i]:
+                continue   # conv output used elsewhere: keep the bn
+            conv = ops[p]
+            w_name = conv.inputs["Filter"][0]
+            if not scope.has_var(w_name):
+                continue   # parameters not materialized: nothing to fold
+            gamma = np.asarray(scope.var(op.inputs["Scale"][0]),
+                               dtype=np.float64)
+            beta = np.asarray(scope.var(op.inputs["Bias"][0]),
+                              dtype=np.float64)
+            mean = np.asarray(scope.var(op.inputs["Mean"][0]),
+                              dtype=np.float64)
+            var = np.asarray(scope.var(op.inputs["Variance"][0]),
+                             dtype=np.float64)
+            eps = op.attrs.get("epsilon", 1e-5)
+            w = np.asarray(scope.var(w_name))
+            scale = gamma / np.sqrt(var + eps)          # [O]
+            w_f = (w.astype(np.float64)
+                   * scale[:, None, None, None]).astype(w.dtype)
+            b_f = (beta - mean * scale).astype(w.dtype)
+
+            # unique per BN (a SHARED filter followed by different BNs
+            # must fold to different values)
+            y_name = op.outputs["Y"][0]
+            folded_w = "%s@BNFOLD@%s" % (w_name, y_name)
+            folded_b = "%s@BNFOLD_BIAS@%s" % (w_name, y_name)
+            wv = block._find_var_recursive(w_name)
+            block.create_var(name=folded_w, shape=wv.shape, dtype=wv.dtype,
+                             persistable=True)
+            block.create_var(name=folded_b, shape=(w.shape[0],),
+                             dtype=wv.dtype, persistable=True)
+            scope.set_var(folded_w, w_f)
+            scope.set_var(folded_b, b_f)
+            conv.inputs["Filter"] = [folded_w]
+            # the bn disappears; its Y is now conv_out + b_f (one
+            # elementwise_add the consumer fuses), wired in the rebuild
+            folded.add(i)
+            rewires[i] = (x, folded_b, y_name)
+
+        if not folded:
+            return 0
+        new_ops = []
+        for i, op in enumerate(ops):
+            if i in folded:
+                conv_out, bias_name, y = rewires[i]
+                add = Operator(block, type="elementwise_add",
+                               inputs={"X": [conv_out], "Y": [bias_name]},
+                               outputs={"Out": [y]},
+                               attrs={"axis": 1})
+                infer_op(add, block)
+                new_ops.append(add)
+                continue
+            new_ops.append(op)
+        block.ops = new_ops
+        program._version += 1
+        return len(folded)

@@ -98,3 +98,54 @@ def test_predictor_sequence_input_with_lod(tmp_path, fresh_programs):
         "int64")
     (o,) = pred.run([PaddleTensor(name="ids", data=idv, lod=[5, 3])])
     assert o.shape == (2, 2)
+
+
+def test_inference_transpiler_folds_bn_into_conv():
+    """BN folding: the optimized program has NO batch_norm ops and
+    produces the same outputs as the un-optimized inference program."""
+    import numpy as np
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8])
+        c1 = fluid.layers.conv2d(img, 8, 3, padding=1, bias_attr=False)
+        b1 = fluid.layers.batch_norm(c1, act="relu")
+        c2 = fluid.layers.conv2d(b1, 4, 1, bias_attr=False)
+        b2 = fluid.layers.batch_norm(c2, act=None)
+        out = fluid.layers.reduce_mean(b2, dim=[2, 3])
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # make running stats non-trivial so the fold is a real test
+        for op in main.global_block().ops:
+            if op.type == "batch_norm":
+                rng = np.random.RandomState(1)
+                scope.set_var(op.inputs["Mean"][0],
+                              rng.rand(*np.asarray(
+                                  scope.var(op.inputs["Mean"][0])).shape
+                                       ).astype("float32"))
+                scope.set_var(op.inputs["Variance"][0],
+                              (rng.rand(*np.asarray(scope.var(
+                                  op.inputs["Variance"][0])).shape)
+                               + 0.5).astype("float32"))
+        infer = main.clone(for_test=True)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(2, 3, 8, 8).astype("float32")
+        (ref,) = exe.run(infer, feed={"img": xv}, fetch_list=[out.name])
+
+        t = fluid.InferenceTranspiler()
+        opt = t.transpile(infer, fluid.CPUPlace(), scope)
+        types = [op.type for op in opt.global_block().ops]
+        assert "batch_norm" not in types, types
+        # the input program is untouched (use the return value)
+        assert any(op.type == "batch_norm"
+                   for op in infer.global_block().ops)
+        (got,) = exe.run(opt, feed={"img": xv}, fetch_list=[out.name])
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        # a TRAIN program transpiles too (is_test flip happens inside)
+        opt2 = t.transpile(main, fluid.CPUPlace(), scope)
+        assert not any(op.type == "batch_norm"
+                       for op in opt2.global_block().ops)

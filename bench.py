@@ -429,7 +429,9 @@ def bench_stacked_lstm(args, use_amp=False, per_step_feed=False):
 
         def feed_fn():
             ids = rng.randint(0, dict_dim, (batch, seq, 1)).astype("int64")
-            lens = rng.randint(seq // 2, seq + 1, (batch,)).astype("int32")
+            # full-length sequences: words/sec = batch*seq/step exactly
+            # (variable lengths would overstate by the padding fraction)
+            lens = np.full((batch,), seq, "int32")
             return {"word": ids, "word@LEN": lens,
                     "label": rng.randint(0, 2, (batch, 1)).astype("int64")}
 

@@ -26,6 +26,8 @@ from . import regularizer
 from . import clip
 from . import optimizer
 from . import metrics
+from . import evaluator
+from .evaluator import Evaluator
 from . import nets
 from .backward import append_backward, calc_gradient
 from .executor import Executor, CPUPlace, TPUPlace, CUDAPlace
@@ -37,6 +39,7 @@ from . import profiler
 from . import parallel
 from . import reader
 from . import dataset
+from . import contrib
 from .reader import batch
 from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
 from .parallel.mesh import make_mesh

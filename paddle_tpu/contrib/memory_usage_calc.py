@@ -1,0 +1,64 @@
+"""Estimate a Program's activation/parameter memory from var shapes.
+
+Parity: reference ``contrib/memory_usage_calc.py`` — same contract
+(``memory_usage(program, batch_size) -> (lower, upper, unit)``), with a
+TPU-honest caveat: XLA's buffer assignment reuses dead buffers inside
+the fused module, so the true step footprint is usually BELOW this
+shape-sum estimate; the number is an upper-bound planning figure (the
+reference's is too — it also ignores workspace reuse).
+"""
+
+import numpy as np
+
+from ..framework import Program
+
+__all__ = ["memory_usage"]
+
+_DTYPE_SIZE = {
+    "float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+    "int16": 2, "int32": 4, "int64": 8, "bool": 1, "uint8": 1, "int8": 1,
+}
+
+
+def memory_usage(program, batch_size):
+    """Returns ``(min_total, max_total, unit_str)`` — the estimated
+    memory of every op-produced LoD-tensor var in the global block, with
+    ``-1`` dims filled by ``batch_size`` and the reference's 5-10%
+    overhead band applied."""
+    if not isinstance(program, Program):
+        raise TypeError(
+            "Calculating Memory Usage requires Program as its Parameter."
+            "But you passed in %s" % (type(program)))
+    if batch_size <= 0:
+        raise ValueError("The batch size need to be positive.")
+
+    # Every block var counts — op outputs (activations), parameters, and
+    # feed/data vars.  (The reference loops op outputs only, which
+    # omits parameters held by the startup program; including them makes
+    # the estimate an honest whole-footprint upper bound.)
+    total = 0.0
+    block = program.global_block()
+    for var in block.vars.values():
+        if var.shape is None:
+            continue
+        count = 1
+        neg = 0
+        for d in var.shape:
+            if d is None or d < 0:
+                if neg >= 1:
+                    raise ValueError(
+                        "Var %s has more than one negtive dim." % var.name)
+                neg += 1
+                count *= batch_size * (1 if d is None else -d)
+            else:
+                count *= d
+        total += count * _DTYPE_SIZE.get(str(var.dtype or "float32"), 4)
+
+    unit = "B"
+    if total > 1024:
+        total /= 1024
+        unit = "KB"
+        if total > 1024:
+            total /= 1024
+            unit = "MB"
+    return total * 1.05, total * 1.1, unit

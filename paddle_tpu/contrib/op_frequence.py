@@ -1,0 +1,46 @@
+"""Op-frequency statistics over a Program.
+
+Parity: reference ``contrib/op_frequence.py`` — same contract
+(``op_freq_statistic(program) -> (uni_op_freq, adj_2_op_freq)``):
+single-op counts plus adjacent-producer pair counts (which op feeds
+which), both sorted most-frequent first.
+"""
+
+from collections import OrderedDict
+
+from ..framework import Program
+
+__all__ = ["op_freq_statistic"]
+
+
+def op_freq_statistic(program):
+    """Returns ``(uni_op_freq, adj_2_op_freq)`` OrderedDicts sorted by
+    descending count; pair keys are ``"producer_type consumer_type"``."""
+    if not isinstance(program, Program):
+        raise TypeError("The input type should be Porgram."
+                        "But you passed in %s" % (type(program)))
+
+    block = program.global_block()
+    params = {p.name for p in block.all_parameters()}
+
+    uni = {}
+    producer_of = {}
+    pair = {}
+    for op in block.ops:
+        uni[op.type] = uni.get(op.type, 0) + 1
+        for name in op.input_arg_names:
+            if not name or name in params:
+                continue
+            src = producer_of.get(name)
+            if src is not None:
+                key = "%s %s" % (src, op.type)
+                pair[key] = pair.get(key, 0) + 1
+        for name in op.output_arg_names:
+            if name:
+                producer_of[name] = op.type
+
+    uni_sorted = OrderedDict(
+        sorted(uni.items(), key=lambda kv: kv[1], reverse=True))
+    pair_sorted = OrderedDict(
+        sorted(pair.items(), key=lambda kv: kv[1], reverse=True))
+    return uni_sorted, pair_sorted

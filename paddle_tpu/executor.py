@@ -189,7 +189,7 @@ class Executor:
                 # and the BN variance form are both baked into the jaxpr
                 flags.flag("pallas_kernels"), flags.flag("bn_two_pass"))
 
-    def _analyze(self, program, feed_names, scope):
+    def _analyze(self, program, feed_names, scope, fetch_names=()):
         """Split program vars into feeds / state-from-scope / temporaries."""
         block = program.global_block()
         produced = set(feed_names)
@@ -208,6 +208,12 @@ class Executor:
             for n in op.output_arg_names:
                 if n:
                     produced.add(n)
+        # fetch targets no op produces but the scope holds (evaluator
+        # state reads, plain var inspection) load like any other state
+        for n in fetch_names:
+            if n and n not in produced and n not in state \
+                    and scope.has_var(n):
+                state.append(n)
         # persistable outputs must be written back even if never read
         writeback = []
         for op in block.ops:
@@ -275,7 +281,7 @@ class Executor:
             # (executor.cc Prepare); here the analog is the trace+jit
             with RecordEvent("executor/compile"):
                 state_names, writeback = self._analyze(
-                    program, feed_names, scope)
+                    program, feed_names, scope, fetch_names)
                 compiled = self._lower(
                     program, feed_names, state_names, writeback, fetch_names
                 )

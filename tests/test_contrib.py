@@ -154,3 +154,22 @@ trainer.train(num_epochs=10**6, event_handler=handler, reader=reader,
                           env=env)
     assert out2.returncode == 0, out2.stderr[-3000:]
     assert "RESUMED" in out2.stdout, out2.stdout[-2000:]
+
+
+def test_memory_usage_calc_and_op_frequence():
+    import paddle_tpu as fluid
+    from paddle_tpu.contrib import memory_usage_calc, op_frequence
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16])
+        h = fluid.layers.fc(x, size=8, act="relu")
+        fluid.layers.mean(h)
+    lo, hi, unit = memory_usage_calc.memory_usage(main, batch_size=32)
+    assert 0 < lo < hi and unit in ("B", "KB", "MB")
+    uni, adj = op_frequence.op_freq_statistic(main)
+    assert uni.get("mul", 0) >= 1 and uni.get("relu", 0) >= 1
+    assert any(k.endswith(" relu") for k in adj)
+    import pytest
+    with pytest.raises(ValueError):
+        memory_usage_calc.memory_usage(main, batch_size=0)

@@ -1,0 +1,77 @@
+"""In-program evaluators (reference evaluator.py): cross-batch counter
+accumulation, reset, and final-metric computation."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_edit_distance_evaluator_accumulates_and_resets():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        hyp = fluid.layers.data("hyp", shape=[1], dtype="int64",
+                                lod_level=1)
+        ref = fluid.layers.data("ref", shape=[1], dtype="int64",
+                                lod_level=1)
+        ev = fluid.evaluator.EditDistance(hyp, ref)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ev.reset(exe)
+
+            def feed(h, r):
+                return {
+                    "hyp": np.array(h, "int64").reshape(1, -1, 1),
+                    "hyp@LEN": np.array([len(h)], "int32"),
+                    "ref": np.array(r, "int64").reshape(1, -1, 1),
+                    "ref@LEN": np.array([len(r)], "int32"),
+                }
+
+            # batch 1: distance 1 (one substitution); batch 2: exact
+            exe.run(main, feed=feed([1, 2, 3], [1, 9, 3]),
+                    fetch_list=[ev.metrics[0]])
+            exe.run(main, feed=feed([4, 5], [4, 5]),
+                    fetch_list=[ev.metrics[0]])
+            dist, err = ev.eval(exe)
+            # normalized distances (reference default): (1/3 + 0) / 2
+            np.testing.assert_allclose(dist, [1 / 6], rtol=1e-5)
+            np.testing.assert_allclose(err, [0.5])    # 1 of 2 wrong
+
+            ev.reset(exe)
+            dist, err = ev.eval(exe)
+            np.testing.assert_allclose(dist, [0.0])
+
+
+def test_chunk_evaluator_accumulates():
+    # IOB with 1 chunk type: B=0, I=1, O=2
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = fluid.layers.data("inf", shape=[1], dtype="int64",
+                                lod_level=1)
+        lab = fluid.layers.data("lab", shape=[1], dtype="int64",
+                                lod_level=1)
+        ev = fluid.evaluator.ChunkEvaluator(inf, lab, chunk_scheme="IOB",
+                                            num_chunk_types=1)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ev.reset(exe)
+
+            def feed(i, l):
+                return {"inf": np.array(i, "int64").reshape(1, -1, 1),
+                        "inf@LEN": np.array([len(i)], "int32"),
+                        "lab": np.array(l, "int64").reshape(1, -1, 1),
+                        "lab@LEN": np.array([len(l)], "int32")}
+
+            # one perfectly-predicted chunk
+            exe.run(main, feed=feed([0, 1, 2], [0, 1, 2]),
+                    fetch_list=[ev.metrics[0]])
+            # one missed chunk (predict O everywhere)
+            exe.run(main, feed=feed([2, 2, 2], [0, 1, 2]),
+                    fetch_list=[ev.metrics[0]])
+            p, r, f1 = ev.eval(exe)
+            np.testing.assert_allclose(p, [1.0])      # 1 inferred, 1 right
+            np.testing.assert_allclose(r, [0.5])      # 2 labeled, 1 found
+            np.testing.assert_allclose(f1, [2 / 3], rtol=1e-6)

@@ -10,3 +10,5 @@ from . import mixed_precision  # noqa: F401
 from . import memory_usage_calc, op_frequence  # noqa: F401,E402
 from .memory_usage_calc import memory_usage  # noqa: F401,E402
 from .op_frequence import op_freq_statistic  # noqa: F401,E402
+from . import quantize  # noqa: F401,E402
+from .quantize import QuantizeTranspiler  # noqa: F401,E402

@@ -1,0 +1,3 @@
+from .quantize_transpiler import QuantizeTranspiler  # noqa: F401
+
+__all__ = ["QuantizeTranspiler"]

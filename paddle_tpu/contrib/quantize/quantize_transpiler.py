@@ -1,0 +1,189 @@
+"""Quantization-aware-training program rewrite.
+
+Parity: reference ``contrib/quantize/quantize_transpiler.py`` — insert
+fake-quantization ops around quantizable ops so training learns
+int8-robust weights, then freeze for inference and convert weights to
+int8 storage.
+
+TPU-first redesign: the reference transpiles a program that ALREADY has
+gradient ops, so it must also rewire every grad op's inputs to the
+quantized tensors.  Here ``training_transpile`` runs BEFORE
+``append_backward`` (the same contract as ``transpiler.fuse_conv_bn``):
+the framework's registry derives gradients from the rewritten forward —
+the fake-quant ops' straight-through-estimator grads (ops/quantize.py)
+flow automatically and no backward rewiring exists to get wrong.  The
+``range_abs_max`` running scale is a persistable state var updated
+in-graph via the executor's writeback contract (the reference's
+window/global-step machinery collapses into a running max envelope).
+"""
+
+import numpy as np
+
+from ...framework import (Operator, Parameter, default_main_program,
+                          default_startup_program)
+from ...registry import infer_op
+from ...scope import global_scope
+from ... import unique_name
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE_OP_TYPES = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+_QUANT_TYPES = ("abs_max", "range_abs_max")
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="abs_max",
+                 weight_quantize_type="abs_max", window_size=10000):
+        if weight_quantize_type not in _QUANT_TYPES:
+            raise ValueError(
+                "Unknown weight_quantize_type: %r" % weight_quantize_type)
+        if activation_quantize_type not in _QUANT_TYPES:
+            raise ValueError(
+                "Unknown activation_quantize_type: %r"
+                % activation_quantize_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.window_size = window_size   # accepted for API parity
+
+    # ------------------------------------------------------------------
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake quant-dequant ops on every input of every
+        quantizable op.  MUST run before append_backward/minimize (the
+        registry then derives STE gradients from the rewritten ops).
+        Returns the number of fake-quant ops inserted."""
+        program = program or default_main_program()
+        startup = startup_program or default_startup_program()
+        for blk in program.blocks:
+            if any(op.type.endswith("_grad") for op in blk.ops):
+                raise ValueError(
+                    "training_transpile must run BEFORE append_backward: "
+                    "gradients are derived from the rewritten forward")
+
+        params = {p.name
+                  for p in program.global_block().all_parameters()}
+        inserted = 0
+        # every block: quantizable ops inside While/conditional
+        # sub-blocks must see quantization error too
+        for block in program.blocks:
+            quantized = {}   # var name -> fake-quantized var name
+            new_ops = []
+            for op in block.ops:
+                if op.type in _QUANTIZABLE_OP_TYPES:
+                    for slot, names in list(op.inputs.items()):
+                        renamed = []
+                        for name in names:
+                            var = block._find_var_recursive(name)
+                            if var is None or var.dtype is None or \
+                                    "float" not in str(var.dtype):
+                                renamed.append(name)
+                                continue
+                            if name not in quantized:
+                                qname, qops = self._make_quant_ops(
+                                    block, startup, name, name in params)
+                                new_ops.extend(qops)
+                                inserted += len(qops)
+                                quantized[name] = qname
+                            renamed.append(quantized[name])
+                        op.inputs[slot] = renamed
+                new_ops.append(op)
+            block.ops = new_ops
+        program._version += 1
+        return inserted
+
+    def _make_quant_ops(self, block, startup, name, is_weight):
+        bits = self.weight_bits if is_weight else self.activation_bits
+        qtype = self.weight_quantize_type if is_weight \
+            else self.activation_quantize_type
+        var = block._find_var_recursive(name)
+        qname = name + ".quantized.dequantized"
+        scale_name = name + ".scale"
+        block.create_var(name=qname, shape=var.shape, dtype=var.dtype,
+                         persistable=False)
+        ops = []
+        if qtype == "abs_max":
+            block.create_var(name=scale_name, shape=(1,), dtype=var.dtype,
+                             persistable=False)
+            op = Operator(block, type="fake_quantize_abs_max",
+                          inputs={"X": [name]},
+                          outputs={"Out": [qname],
+                                   "OutScale": [scale_name]},
+                          attrs={"bit_length": bits})
+        else:
+            # running-scale state: persistable, zero-initialized by the
+            # startup program, updated in place every step (OutScale
+            # writes back over InScale via the executor's writeback)
+            block.create_var(name=scale_name, shape=(1,), dtype=var.dtype,
+                             persistable=True)
+            sblock = startup.global_block()
+            sblock.create_var(name=scale_name, shape=(1,),
+                              dtype=var.dtype, persistable=True)
+            init = Operator(sblock, type="fill_constant", inputs={},
+                            outputs={"Out": [scale_name]},
+                            attrs={"shape": [1], "value": 0.0,
+                                   "dtype": str(var.dtype),
+                                   "force_cpu": False})
+            infer_op(init, sblock)
+            sblock.ops.append(init)
+            startup._version += 1
+            op = Operator(block, type="fake_quantize_range_abs_max",
+                          inputs={"X": [name], "InScale": [scale_name]},
+                          outputs={"Out": [qname],
+                                   "OutScale": [scale_name]},
+                          attrs={"bit_length": bits})
+        infer_op(op, block)
+        ops.append(op)
+        return qname, ops
+
+    # ------------------------------------------------------------------
+    def freeze_program(self, program, place=None, fuse_bn=False,
+                       scope=None):
+        """Return the inference version of a quantize-transpiled
+        program: ``clone(for_test=True)`` flips the fake-quant ops to
+        test mode, where ``range_abs_max`` consumes its trained running
+        scale as-is.  ``fuse_bn`` additionally folds frozen BN via
+        InferenceTranspiler."""
+        frozen = program.clone(for_test=True)
+        if fuse_bn:
+            from ...transpiler import InferenceTranspiler
+
+            frozen = InferenceTranspiler().transpile(frozen, place,
+                                                     scope)
+        return frozen
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """Store every quantized weight as int8 in the scope
+        (``<name>.int8`` plus ``<name>.int8_scale``) — the deployment
+        size reduction; returns {weight name: (int8 name, scale)}."""
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+        rng = float((1 << (self.weight_bits - 1)) - 1)
+        out = {}
+        for op in block.ops:
+            if op.type not in ("fake_quantize_abs_max",
+                               "fake_quantize_range_abs_max"):
+                continue
+            name = op.inputs["X"][0]
+            var = block._find_var_recursive(name)
+            if not isinstance(var, Parameter) or not scope.has_var(name):
+                continue
+            w = np.asarray(scope.var(name), dtype=np.float64)
+            if op.type == "fake_quantize_range_abs_max" and \
+                    scope.has_var(op.inputs["InScale"][0]):
+                # the TRAINED running scale IS the grid QAT optimized
+                # against — recomputing abs-max here would deploy a
+                # different grid than the one the weights learned
+                scale = max(float(np.asarray(
+                    scope.var(op.inputs["InScale"][0])).ravel()[0]),
+                    1e-12)
+            else:
+                scale = max(float(np.max(np.abs(w))), 1e-12)
+            q = np.clip(np.round(w / scale * rng), -rng, rng).astype(
+                np.int8)
+            scope.set_var(name + ".int8", q)
+            scope.set_var(name + ".int8_scale",
+                          np.asarray([scale], np.float32))
+            out[name] = (name + ".int8", scale)
+        return out

@@ -535,6 +535,8 @@ class Program:
 _TEST_MODE_OPS = {
     "dropout": ("is_test",),
     "batch_norm": ("is_test",),
+    # test mode consumes the TRAINED running scale instead of updating it
+    "fake_quantize_range_abs_max": ("is_test",),
 }
 
 

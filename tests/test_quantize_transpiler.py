@@ -1,0 +1,111 @@
+"""Quantization-aware training via QuantizeTranspiler: rewrite before
+backward, train (STE grads), freeze for inference, convert to int8."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.quantize import QuantizeTranspiler
+
+
+def _build(qt=None):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 6
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[1, 8, 8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(img, 4, 3, padding=1, act="relu",
+                                   bias_attr=False)
+        pool = fluid.layers.pool2d(conv, 8, pool_type="avg",
+                                   global_pooling=True)
+        pred = fluid.layers.fc(pool, size=3, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        if qt is not None:
+            n = qt.training_transpile(main, startup)
+            assert n >= 4   # conv Input+Filter, fc mul X+Y
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss, pred
+
+
+def test_qat_trains_and_freezes():
+    qt = QuantizeTranspiler(activation_quantize_type="range_abs_max")
+    main, startup, loss, pred = _build(qt)
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_quantize_range_abs_max" in types
+    assert "fake_quantize_abs_max" in types      # weights stay abs_max
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        first = None
+        for i in range(25):
+            x = rng.rand(8, 1, 8, 8).astype("float32")
+            y = (x.mean(axis=(1, 2, 3)) > 0.5).astype("int64"
+                                                      ).reshape(-1, 1)
+            l, = exe.run(main, feed={"img": x, "label": y},
+                         fetch_list=[loss])
+            if first is None:
+                first = float(l[0])
+        assert np.isfinite(l).all()
+        assert float(l[0]) < first   # STE grads flow; training moves
+
+        # running activation scale was learned (nonzero persistable)
+        scale_names = [op.inputs["InScale"][0]
+                       for op in main.global_block().ops
+                       if op.type == "fake_quantize_range_abs_max"]
+        assert scale_names
+        assert float(np.asarray(scope.var(scale_names[0]))[0]) > 0
+
+        frozen = qt.freeze_program(main, fluid.CPUPlace(), scope=scope)
+        (p,) = exe.run(frozen, feed={"img": x, "label": y},
+                       fetch_list=[pred.name])
+        assert np.isfinite(p).all()
+
+        # int8 conversion stores int8 weights + scales in the scope
+        converted = qt.convert_to_int8(main, scope=scope)
+        assert converted
+        for name, (iname, scale) in converted.items():
+            q = np.asarray(scope.var(iname))
+            assert q.dtype == np.int8 and scale > 0
+            w = np.asarray(scope.var(name))
+            np.testing.assert_allclose(
+                q.astype(np.float32) * scale / 127.0, w, atol=scale / 100)
+
+
+def test_transpile_after_backward_rejected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        with pytest.raises(ValueError, match="BEFORE append_backward"):
+            QuantizeTranspiler().training_transpile(main, startup)
+
+
+def test_frozen_program_scale_is_immutable():
+    """Regression (review repro): the frozen program must CONSUME the
+    trained running scale, never update it from serving data."""
+    qt = QuantizeTranspiler(activation_quantize_type="range_abs_max")
+    main, startup, loss, pred = _build(qt)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"img": rng.rand(4, 1, 8, 8).astype("float32"),
+                "label": np.zeros((4, 1), "int64")}
+        exe.run(main, feed=feed, fetch_list=[loss])
+        names = [op.inputs["InScale"][0]
+                 for op in main.global_block().ops
+                 if op.type == "fake_quantize_range_abs_max"]
+        trained = float(np.asarray(scope.var(names[0]))[0])
+        assert trained > 0
+
+        frozen = qt.freeze_program(main, fluid.CPUPlace(), scope=scope)
+        big = {"img": 100.0 * rng.rand(4, 1, 8, 8).astype("float32"),
+               "label": np.zeros((4, 1), "int64")}
+        exe.run(frozen, feed=big, fetch_list=[pred.name])
+        after = float(np.asarray(scope.var(names[0]))[0])
+        assert after == trained, (trained, after)

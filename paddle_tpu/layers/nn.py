@@ -16,6 +16,7 @@ __all__ = [
     "dropout",
     "softmax",
     "cross_entropy",
+    "square_error_cost",
     "softmax_with_cross_entropy",
     "fused_attention",
     "one_hot",
@@ -165,6 +166,26 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
         attrs={"soft_label": soft_label, "ignore_index": ignore_index},
     )
     return out
+
+
+def square_error_cost(input, label):
+    """Per-sample squared error (input - label)^2 (reference
+    nn.py:1083 square_error_cost / squared_l2_distance_op.cc)."""
+    helper = LayerHelper("square_error_cost", input=input)
+    minus_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="elementwise_sub",
+        inputs={"X": [input], "Y": [label]},
+        outputs={"Out": [minus_out]},
+        attrs={"axis": -1},
+    )
+    square_out = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="square",
+        inputs={"X": [minus_out]},
+        outputs={"Out": [square_out]},
+    )
+    return square_out
 
 
 def softmax_with_cross_entropy(

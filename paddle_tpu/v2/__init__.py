@@ -1,0 +1,87 @@
+"""paddle_tpu.v2 — the legacy v2 API dialect, re-hosted on the TPU stack.
+
+The reference ships two frameworks (SURVEY.md §2.5): Fluid and the older
+v2 engine (`python/paddle/v2/` config DSL -> ModelConfig proto -> swig
+GradientMachine + legacy C++ layers/Matrix/pserver,
+`legacy/gserver/gradientmachines/GradientMachine.h:75`).  This package
+is the deliberate TPU-first fold: the v2 *API* (layer DSL, Parameters,
+trainer.SGD, events, infer) is preserved, but every call builds the same
+Program IR the fluid-parity stack jit-compiles — there is one engine.
+The 144k LoC of legacy CUDA/Matrix machinery is absorbed by XLA exactly
+as the fluid C++ operator library is.
+
+Usage (reference v2 book style)::
+
+    from paddle_tpu import v2 as paddle
+    paddle.init(use_gpu=False)
+    img = paddle.layer.data(name='img',
+                            type=paddle.data_type.dense_vector(784))
+    fc = paddle.layer.fc(input=img, size=10,
+                         act=paddle.activation.Softmax())
+    lbl = paddle.layer.data(name='lbl',
+                            type=paddle.data_type.integer_value(10))
+    cost = paddle.layer.classification_cost(input=fc, label=lbl)
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 paddle.optimizer.Momentum(momentum=0.9))
+    trainer.train(paddle.batch(reader, 128), num_passes=2,
+                  event_handler=handler)
+"""
+
+from .. import dataset    # noqa: F401 — same dataset suite serves both APIs
+from .. import reader     # noqa: F401 — reader decorators are shared
+from ..dataset import image  # noqa: F401
+from . import activation  # noqa: F401
+from . import attr        # noqa: F401
+from . import config      # noqa: F401
+from . import data_type   # noqa: F401
+from . import evaluator   # noqa: F401
+from . import event       # noqa: F401
+from . import inference   # noqa: F401
+from . import layer       # noqa: F401
+from . import minibatch   # noqa: F401
+from . import networks    # noqa: F401
+from . import optimizer   # noqa: F401
+from . import parameters  # noqa: F401
+from . import plot        # noqa: F401
+from . import pooling     # noqa: F401
+from . import topology    # noqa: F401
+from . import trainer     # noqa: F401
+from .inference import infer  # noqa: F401
+from .minibatch import batch  # noqa: F401
+
+__all__ = [
+    "init", "layer", "activation", "parameters", "trainer", "event",
+    "data_type", "attr", "pooling", "topology", "networks", "evaluator",
+    "inference", "infer", "batch", "minibatch", "optimizer", "plot",
+    "reader", "dataset", "image", "master", "reset",
+]
+
+reset = config.reset
+
+# the Go master's task-lease client lives in cloud/ (reference
+# python/paddle/v2/master/client.py -> go/master/service.go)
+from .. import cloud as master  # noqa: F401,E402
+
+
+_default_place = None
+
+
+def init(use_gpu=False, trainer_count=1, **kwargs):
+    """Process init (reference v2/__init__.py init -> swig initPaddle).
+
+    ``use_gpu=True`` selects the accelerator (TPU here); trainer_count>1
+    maps to the mesh runtime rather than per-thread trainers — use
+    paddle_tpu.ParallelExecutor for data parallelism.
+    """
+    global _default_place
+    from ..executor import CPUPlace, TPUPlace
+
+    _default_place = TPUPlace() if use_gpu else CPUPlace()
+    return _default_place
+
+
+def default_place():
+    from ..executor import CPUPlace
+
+    return _default_place or CPUPlace()

@@ -1,0 +1,78 @@
+"""v2 activation objects (reference python/paddle/v2/activation.py:1
+wrapping trainer_config_helpers/activations.py).  Each maps to the
+activation-op name the fluid-parity LayerHelper appends."""
+
+__all__ = [
+    "Base", "Tanh", "Sigmoid", "Softmax", "Identity", "Linear", "Relu",
+    "BRelu", "SoftRelu", "STanh", "Abs", "Square", "Exp", "Log",
+]
+
+
+class Base(object):
+    name = None  # fluid-parity activation op type; None = linear
+
+    def __repr__(self):
+        return "activation.%s()" % type(self).__name__
+
+
+class Tanh(Base):
+    name = "tanh"
+
+
+class Sigmoid(Base):
+    name = "sigmoid"
+
+
+class Softmax(Base):
+    name = "softmax"
+
+
+class Identity(Base):
+    name = None
+
+
+Linear = Identity
+
+
+class Relu(Base):
+    name = "relu"
+
+
+class BRelu(Base):
+    name = "brelu"
+
+
+class SoftRelu(Base):
+    name = "soft_relu"
+
+
+class STanh(Base):
+    name = "stanh"
+
+
+class Abs(Base):
+    name = "abs"
+
+
+class Square(Base):
+    name = "square"
+
+
+class Exp(Base):
+    name = "exp"
+
+
+class Log(Base):
+    name = "log"
+
+
+def act_name(act):
+    """activation object (or None / raw string) -> op-type string."""
+    if act is None or isinstance(act, str):
+        return act
+    if isinstance(act, Base):
+        return act.name
+    if isinstance(act, type) and issubclass(act, Base):
+        return act.name
+    raise TypeError("expected a paddle_tpu.v2.activation object, got %r"
+                    % (act,))

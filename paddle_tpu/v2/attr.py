@@ -1,0 +1,70 @@
+"""v2 parameter attributes (reference python/paddle/v2/attr.py:1).
+
+``Param``/``ParamAttr`` forward onto the fluid-parity ``ParamAttr``;
+``Extra``/``ExtraAttr`` carries layer-level extras (only ``drop_rate``
+is meaningful on this stack — the rest were GPU scheduling hints)."""
+
+from ..param_attr import ParamAttr as _FluidParamAttr
+
+__all__ = ["Param", "ParamAttr", "Extra", "ExtraAttr", "Hook", "HookAttr"]
+
+
+def ParamAttr(name=None, initial_std=None, initial_mean=None, is_static=None,
+              l1_rate=None, l2_rate=None, learning_rate=None, momentum=None,
+              gradient_clipping_threshold=None, sparse_update=None,
+              initializer=None):
+    """Build a fluid-parity ParamAttr from v2 keyword names.
+
+    initial_mean/initial_std -> Normal initializer; l2_rate -> L2 decay;
+    is_static -> trainable=False; sparse_update -> marks the consuming
+    embedding for the SelectedRows sparse-grad path (the layer reads it).
+    """
+    from .. import initializer as init_mod
+    from .. import regularizer
+
+    kw = {}
+    if name is not None:
+        kw["name"] = name
+    if initializer is not None:
+        kw["initializer"] = initializer
+    elif initial_std is not None or initial_mean is not None:
+        kw["initializer"] = init_mod.NormalInitializer(
+            loc=initial_mean or 0.0, scale=initial_std
+            if initial_std is not None else 0.01)
+    if learning_rate is not None:
+        kw["learning_rate"] = learning_rate
+    if l2_rate is not None:
+        kw["regularizer"] = regularizer.L2DecayRegularizer(l2_rate)
+    elif l1_rate is not None:
+        kw["regularizer"] = regularizer.L1DecayRegularizer(l1_rate)
+    if is_static:
+        kw["trainable"] = False
+    attr = _FluidParamAttr(**kw)
+    attr.sparse_update = bool(sparse_update)
+    return attr
+
+
+Param = ParamAttr
+
+
+class ExtraAttr(object):
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+
+Extra = ExtraAttr
+
+
+class HookAttr(object):
+    """Parameter hook (reference attr.py HookAttribute) — pruning hooks
+    are not supported on this stack; kept for signature parity."""
+
+    def __init__(self, type=None, sparsity_ratio=None):
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+
+
+Hook = HookAttr

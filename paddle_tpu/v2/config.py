@@ -1,0 +1,85 @@
+"""Internal model-config state for the v2 API.
+
+The reference v2 stack parses layer configs into a global ``ModelConfig``
+proto (``python/paddle/trainer/config_parser.py`` ``g_config`` /
+``python/paddle/v2/layer.py:1``).  Here the "config" IS the Program IR:
+every ``paddle_tpu.v2.layer`` call appends ops to one process-global
+Program pair, and ``Topology``/``Trainer``/``infer`` prune or clone it.
+This replaces the v2 proto + GradientMachine pipeline with the same
+Program objects the fluid-parity stack executes — one engine, two API
+dialects (the fold README.md documents).
+"""
+
+import contextlib
+
+from .. import framework
+
+
+class Graph:
+    """The v2 analog of config_parser's ``g_config``: one main+startup
+    Program pair, the ordered data layers, and registered evaluators."""
+
+    def __init__(self):
+        self.main = framework.Program()
+        self.startup = framework.Program()
+        self.data_layers = []    # Layer objects for data inputs, in order
+        self.evaluators = []     # (metric_name, Variable, transform) tuples
+
+
+_graph = None
+
+
+def graph():
+    global _graph
+    if _graph is None:
+        _graph = Graph()
+    return _graph
+
+
+def reset():
+    """Drop the global graph (tests / building a second model)."""
+    global _graph
+    _graph = None
+
+
+@contextlib.contextmanager
+def build():
+    """Route fluid-parity layer calls into the v2 graph's programs."""
+    g = graph()
+    with framework.program_guard(g.main, g.startup):
+        yield g
+
+
+class Layer:
+    """What every ``paddle_tpu.v2.layer.*`` call returns: a handle on the
+    Variable the layer produced (reference ``v2/config_base.py`` Layer).
+    ``v2_dim`` carries the logical width (data-type dim for data layers,
+    output size for computed layers) so e.g. ``embedding`` can read its
+    vocabulary size off its input, as the v2 API requires."""
+
+    def __init__(self, var, data_type=None, v2_dim=None, parents=()):
+        self.__var__ = var
+        self.name = var.name
+        self.data_type = data_type
+        self.v2_dim = v2_dim
+        self.parents = list(parents)
+
+    @property
+    def var(self):
+        return self.__var__
+
+    def __repr__(self):
+        return "<v2.Layer %s>" % self.name
+
+
+def unwrap(x):
+    """Layer -> Variable (lists map elementwise)."""
+    if isinstance(x, (list, tuple)):
+        return [unwrap(i) for i in x]
+    return x.var if isinstance(x, Layer) else x
+
+
+def as_layers(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]

@@ -1,0 +1,70 @@
+"""v2 input-type declarations (reference python/paddle/v2/data_type.py:1
+re-exporting trainer/PyDataProvider2.py InputType).
+
+A data type = (dim, seq_type, value kind).  On TPU, sequence inputs
+become padded ``[batch, time, ...]`` arrays with an ``@LEN`` companion
+(see layers/io.py data); the InputType here records which conversion
+``DataFeeder`` must apply and which shape/dtype the data layer declares.
+"""
+
+DENSE = 0
+SPARSE_BINARY = 1
+SPARSE_FLOAT = 2
+INDEX = 3
+
+NO_SEQUENCE = 0
+SEQUENCE = 1
+SUB_SEQUENCE = 2
+
+__all__ = [
+    "InputType", "dense_vector", "dense_array", "sparse_binary_vector",
+    "sparse_float_vector", "integer_value", "dense_vector_sequence",
+    "integer_value_sequence", "sparse_binary_vector_sequence",
+    "sparse_float_vector_sequence",
+]
+
+
+class InputType(object):
+    def __init__(self, dim, seq_type, tp):
+        self.dim = dim
+        self.seq_type = seq_type
+        self.type = tp
+
+    def __repr__(self):
+        return "InputType(dim=%d, seq_type=%d, type=%d)" % (
+            self.dim, self.seq_type, self.type)
+
+
+def dense_vector(dim, seq_type=NO_SEQUENCE):
+    return InputType(dim, seq_type, DENSE)
+
+
+dense_array = dense_vector
+
+
+def sparse_binary_vector(dim, seq_type=NO_SEQUENCE):
+    return InputType(dim, seq_type, SPARSE_BINARY)
+
+
+def sparse_float_vector(dim, seq_type=NO_SEQUENCE):
+    return InputType(dim, seq_type, SPARSE_FLOAT)
+
+
+def integer_value(value_range, seq_type=NO_SEQUENCE):
+    return InputType(value_range, seq_type, INDEX)
+
+
+def dense_vector_sequence(dim):
+    return dense_vector(dim, seq_type=SEQUENCE)
+
+
+def integer_value_sequence(value_range):
+    return integer_value(value_range, seq_type=SEQUENCE)
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, seq_type=SEQUENCE)
+
+
+def sparse_float_vector_sequence(dim):
+    return sparse_float_vector(dim, seq_type=SEQUENCE)

@@ -1,0 +1,37 @@
+"""v2 evaluators (reference python/paddle/v2/evaluator.py:1 wrapping
+trainer_config_helpers/evaluators.py).  An evaluator registers an
+in-graph metric op whose per-batch value the trainer surfaces through
+``event.metrics``."""
+
+from .. import layers as fl
+from . import config as cfg
+
+__all__ = ["classification_error", "auc", "value_printer"]
+
+
+def classification_error(input, label, name=None, **kwargs):
+    """Error rate = 1 - accuracy (reference
+    classification_error_evaluator)."""
+    with cfg.build() as g:
+        acc = fl.accuracy(input=input.var, label=label.var)
+        g.evaluators.append(
+            (name or "classification_error_evaluator", acc, "one_minus"))
+    return cfg.Layer(acc, parents=[input, label])
+
+
+def auc(input, label, name=None, **kwargs):
+    with cfg.build() as g:
+        auc_var, _ = fl.auc(input=input.var, label=label.var)
+        g.evaluators.append((name or "auc_evaluator", auc_var, None))
+    return cfg.Layer(auc_var, parents=[input, label])
+
+
+def value_printer(input, name=None):
+    """Register a layer's mean value as a metric (reference
+    value_printer_evaluator prints activations; here it reports the
+    batch mean through event.metrics)."""
+    with cfg.build() as g:
+        m = fl.mean(input.var)
+        g.evaluators.append((name or ("value_printer_" + input.name), m,
+                             None))
+    return cfg.Layer(m, parents=[input])

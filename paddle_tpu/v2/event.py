@@ -1,0 +1,58 @@
+"""Training/testing events (reference python/paddle/v2/event.py:1).
+
+``metrics`` is a plain name->float dict computed from the in-graph
+evaluator ops the cost layers registered (the reference reads them off
+a swig Evaluator; there is no gm object on this stack, so ``gm`` is
+kept as an attribute but is always None)."""
+
+__all__ = [
+    "EndIteration", "BeginIteration", "BeginPass", "EndPass", "TestResult",
+    "EndForwardBackward",
+]
+
+
+class WithMetric(object):
+    def __init__(self, metrics):
+        self.metrics = dict(metrics or {})
+
+
+class TestResult(WithMetric):
+    """What trainer.test returns."""
+
+    def __init__(self, metrics, cost):
+        super(TestResult, self).__init__(metrics)
+        self.cost = cost
+
+
+class BeginPass(object):
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, metrics=None, gm=None):
+        self.pass_id = pass_id
+        self.gm = gm
+        WithMetric.__init__(self, metrics)
+
+
+class BeginIteration(object):
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndForwardBackward(object):
+    def __init__(self, pass_id, batch_id, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.gm = gm
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, metrics=None, gm=None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        self.gm = gm
+        WithMetric.__init__(self, metrics)

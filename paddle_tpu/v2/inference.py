@@ -1,0 +1,76 @@
+"""v2 inference (reference python/paddle/v2/inference.py:1).
+
+``infer(output_layer=..., parameters=..., input=...)`` prunes the v2
+graph to the requested outputs, feeds the batch, and returns numpy
+results — the GradientMachine forward pass replaced by one jit-compiled
+pruned Program."""
+
+import numpy as np
+
+from ..data_feeder import DataFeeder
+from ..executor import CPUPlace, Executor
+from . import config as cfg
+from .topology import Topology
+
+__all__ = ["infer", "Inference"]
+
+
+class Inference(object):
+    def __init__(self, output_layer, parameters, place=None):
+        self.outputs = cfg.as_layers(output_layer)
+        topo = Topology(self.outputs)
+        self.topology = topo
+        self.parameters = parameters
+        if place is None:
+            from . import default_place
+            place = default_place()
+        self.place = place
+        if parameters._topology is None:
+            parameters.attach(topo, place=self.place)
+
+        out_names = [l.name for l in self.outputs]
+        all_feed = []
+        for l in topo.data_layers:
+            all_feed.append(l.name)
+            if getattr(l.var, "_seq_len_name", None):
+                all_feed.append(l.var._seq_len_name)
+        pruned = topo.program.clone(for_test=True).prune_feed_fetch(
+            all_feed, out_names)
+        # only data layers some op in the pruned program actually consumes
+        # are fed (prune keeps all feed vars in the block, even orphans)
+        consumed = set()
+        for op in pruned.global_block().ops:
+            consumed.update(op.input_arg_names)
+        self.data_layers = [
+            l for l in topo.data_layers if l.name in consumed
+        ]
+        self.program = pruned
+        self.exe = Executor(self.place)
+
+    def infer(self, input, feeding=None, field="value"):
+        if field not in ("value", None):
+            raise NotImplementedError(
+                "only field='value' is supported; take argmax of the "
+                "returned probabilities for ids (reference field='id')")
+        layers = self.data_layers
+        if feeding is None:
+            plan = list(zip(layers, range(len(layers))))
+        else:
+            by_name = {l.name: l for l in layers}
+            plan = sorted(((by_name[n], i) for n, i in feeding.items()
+                           if n in by_name), key=lambda p: p[1])
+        feeder = DataFeeder(feed_list=[l.var for l, _ in plan],
+                            place=self.place, program=self.topology.program)
+        rows = [tuple(row[idx] for _, idx in plan) for row in input]
+        feed = feeder.feed(rows)
+        outs = self.exe.run(
+            self.program, feed=feed,
+            fetch_list=[l.name for l in self.outputs],
+            scope=self.parameters.scope)
+        outs = [np.asarray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(
+        input, feeding=feeding, field=field)

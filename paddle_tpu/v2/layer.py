@@ -1,0 +1,370 @@
+"""The v2 layer DSL (reference python/paddle/v2/layer.py:1, which
+auto-wraps trainer_config_helpers/layers.py).
+
+Each function appends fluid-parity ops to the process-global v2 graph
+(see config.py) and returns a ``Layer`` handle — the TPU-native redesign
+of the v2 proto-config pipeline: instead of emitting a ``ModelConfig``
+proto interpreted by the legacy GradientMachine
+(``legacy/gserver/gradientmachines/GradientMachine.h:75``), the calls
+build the same Program IR the rest of this framework jit-compiles.
+
+The surface is the curated subset the v2 book/demo models use; layer
+math (``+``/``-``/``*``) works through the underlying Variables.
+"""
+
+import math
+
+from .. import layers as fl
+from .. import nets as fnets
+from . import config as cfg
+from .activation import act_name
+from .data_type import (DENSE, INDEX, NO_SEQUENCE, SEQUENCE,
+                        SPARSE_BINARY, SPARSE_FLOAT)
+from .pooling import Max as _MaxPool
+from .pooling import img_pool_type, seq_pool_type
+
+__all__ = [
+    "data", "fc", "embedding", "img_conv", "img_pool", "batch_norm",
+    "dropout", "concat", "addto", "pooling", "first_seq", "last_seq",
+    "max_id", "classification_cost", "cross_entropy_cost",
+    "multi_binary_label_cross_entropy_cost", "square_error_cost",
+    "mse_cost", "regression_cost", "nce", "hsigmoid", "crf",
+    "crf_decoding", "ctc", "lstmemory", "grumemory",
+    "parse_network", "reset",
+]
+
+reset = cfg.reset
+
+
+def _seq(dt):
+    return dt is not None and dt.seq_type != NO_SEQUENCE
+
+
+def data(name, type, height=None, width=None, **kwargs):
+    """Input layer (reference v2/layer.py:105 __data_layer__).
+
+    ``height``/``width`` hint the image geometry for ``img_conv`` on
+    flat dense vectors (the v1 config carried them on the proto)."""
+    if type.type in (SPARSE_BINARY, SPARSE_FLOAT):
+        raise NotImplementedError(
+            "sparse input vectors are a pserver-era format; feed dense "
+            "vectors (SURVEY.md §2.4 sparse-input ruling)")
+    with cfg.build() as g:
+        if type.type == INDEX:
+            var = fl.data(name, shape=[1], dtype="int64",
+                          lod_level=1 if _seq(type) else 0)
+        else:
+            var = fl.data(name, shape=[type.dim], dtype="float32",
+                          lod_level=1 if _seq(type) else 0)
+        layer = cfg.Layer(var, data_type=type, v2_dim=type.dim)
+        layer.height, layer.width = height, width
+        g.data_layers.append(layer)
+    return layer
+
+
+def fc(input, size, act=None, param_attr=None, bias_attr=None, name=None,
+       layer_attr=None):
+    """reference trainer_config_helpers fc_layer -> fluid-parity fc."""
+    inputs = cfg.as_layers(input)
+    # v1 fc flattens everything after the batch axis — except sequence
+    # inputs [B, T, D], where the projection applies per timestep
+    nfd = 2 if _any_seq(inputs) else 1
+    with cfg.build():
+        var = fl.fc([l.var for l in inputs], size=size,
+                    num_flatten_dims=nfd,
+                    act=act_name(act), param_attr=param_attr,
+                    bias_attr=bias_attr, name=name)
+    return cfg.Layer(var, v2_dim=size, parents=inputs)
+
+
+def _any_seq(layers):
+    return any(getattr(l.var, "lod_level", 0) or
+               getattr(l.var, "_seq_len_name", None) for l in layers)
+
+
+def embedding(input, size, param_attr=None, name=None, layer_attr=None):
+    """Table lookup; vocabulary = the input data layer's integer range
+    (reference v2 embedding reads dim off the input's data type)."""
+    if input.v2_dim is None:
+        raise ValueError("embedding input must be an integer_value(_sequence)"
+                         " data layer carrying its vocabulary size")
+    sparse = bool(getattr(param_attr, "sparse_update", False))
+    with cfg.build():
+        var = fl.embedding(input.var, size=[input.v2_dim, size],
+                           is_sparse=sparse, param_attr=param_attr)
+    return cfg.Layer(var, v2_dim=size, parents=[input])
+
+
+def _as_image(layer, num_channels):
+    """Reshape a flat dense-vector layer to NCHW for conv/pool layers.
+    Uses the data layer's height/width hints, else assumes square."""
+    var = layer.var
+    if len(var.shape) == 4:
+        return var, var.shape[1]
+    dim = layer.v2_dim
+    c = num_channels or 1
+    h = getattr(layer, "height", None)
+    w = getattr(layer, "width", None)
+    if not (h and w):
+        hw = int(round(math.sqrt(dim // c)))
+        if c * hw * hw != dim:
+            raise ValueError(
+                "cannot infer image shape from dim=%d channels=%d; pass "
+                "height=/width= to layer.data" % (dim, c))
+        h = w = hw
+    return fl.reshape(var, shape=[-1, c, h, w]), c
+
+
+def img_conv(input, filter_size, num_filters, num_channels=None, stride=1,
+             padding=0, act=None, param_attr=None, bias_attr=None,
+             groups=1, name=None, layer_attr=None):
+    """reference img_conv_layer -> conv2d (NCHW surface; XLA lays out)."""
+    with cfg.build():
+        img, _c = _as_image(input, num_channels)
+        var = fl.conv2d(img, num_filters=num_filters,
+                        filter_size=filter_size, stride=stride,
+                        padding=padding, groups=groups, act=act_name(act),
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        name=name)
+    out = cfg.Layer(var, parents=[input])
+    out.v2_dim = None
+    return out
+
+
+def img_pool(input, pool_size, num_channels=None, pool_type=None, stride=1,
+             padding=0, name=None, layer_attr=None):
+    with cfg.build():
+        img, _c = _as_image(input, num_channels)
+        var = fl.pool2d(img, pool_size=pool_size,
+                        pool_type=img_pool_type(pool_type or _MaxPool()),
+                        pool_stride=stride, pool_padding=padding, name=name)
+    return cfg.Layer(var, parents=[input])
+
+
+def batch_norm(input, act=None, name=None, num_channels=None,
+               param_attr=None, bias_attr=None, use_global_stats=None,
+               moving_average_fraction=0.9, layer_attr=None):
+    with cfg.build():
+        var = fl.batch_norm(input.var, act=act_name(act), name=name,
+                            param_attr=param_attr, bias_attr=bias_attr,
+                            momentum=moving_average_fraction,
+                            use_global_stats=bool(use_global_stats))
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input])
+
+
+def dropout(input, dropout_rate, name=None):
+    with cfg.build():
+        var = fl.dropout(input.var, dropout_prob=dropout_rate, name=name)
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input])
+
+
+def concat(input, act=None, name=None, layer_attr=None):
+    inputs = cfg.as_layers(input)
+    with cfg.build():
+        var = fl.concat([l.var for l in inputs], axis=-1)
+        if act_name(act):
+            var = getattr(fl, act_name(act))(var)
+    dims = [l.v2_dim for l in inputs]
+    return cfg.Layer(var, v2_dim=sum(dims) if all(dims) else None,
+                     parents=inputs)
+
+
+def addto(input, act=None, bias_attr=None, name=None, layer_attr=None):
+    if bias_attr:
+        raise NotImplementedError("addto bias is not supported; add a "
+                                  "fc(size=same, bias_attr=...) instead")
+    inputs = cfg.as_layers(input)
+    with cfg.build():
+        var = fl.sums([l.var for l in inputs]) if len(inputs) > 1 \
+            else inputs[0].var
+        if act_name(act):
+            var = getattr(fl, act_name(act))(var)
+    return cfg.Layer(var, v2_dim=inputs[0].v2_dim, parents=inputs)
+
+
+def pooling(input, pooling_type=None, agg_level=None, name=None,
+            layer_attr=None):
+    """Sequence pooling over the padded time axis (reference
+    pooling_layer; LoD-free — the @LEN companion masks padding)."""
+    with cfg.build():
+        var = fl.sequence_pool(
+            input.var, pool_type=seq_pool_type(pooling_type or _MaxPool()))
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input])
+
+
+def first_seq(input, name=None, **kwargs):
+    with cfg.build():
+        var = fl.sequence_first_step(input.var)
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input])
+
+
+def last_seq(input, name=None, **kwargs):
+    with cfg.build():
+        var = fl.sequence_last_step(input.var)
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input])
+
+
+def max_id(input, name=None, layer_attr=None):
+    """reference maxid_layer -> argmax over the class axis."""
+    with cfg.build():
+        var = fl.argmax(input.var, axis=-1)
+    return cfg.Layer(var, parents=[input])
+
+
+def lstmemory(input, size=None, reverse=False, act=None, gate_act=None,
+              state_act=None, bias_attr=None, param_attr=None, name=None,
+              layer_attr=None):
+    """reference lstmemory (legacy hl_cuda_lstm.cu fused kernel) ->
+    scan-based dynamic_lstm.  v2 feeds it a pre-projected input of
+    4*size width (the mixed/fc layer before it)."""
+    size = size or (input.v2_dim // 4 if input.v2_dim else None)
+    if size is None:
+        raise ValueError("lstmemory needs size= or a sized input layer")
+    with cfg.build():
+        h, _c = fl.dynamic_lstm(
+            input.var, size=size * 4, is_reverse=reverse,
+            param_attr=param_attr, bias_attr=bias_attr,
+            candidate_activation=act_name(act) or "tanh",
+            gate_activation=act_name(gate_act) or "sigmoid",
+            cell_activation=act_name(state_act) or "tanh")
+    return cfg.Layer(h, v2_dim=size, parents=[input])
+
+
+def grumemory(input, size=None, reverse=False, act=None, gate_act=None,
+              bias_attr=None, param_attr=None, name=None, layer_attr=None):
+    size = size or (input.v2_dim // 3 if input.v2_dim else None)
+    if size is None:
+        raise ValueError("grumemory needs size= or a sized input layer")
+    with cfg.build():
+        h = fl.dynamic_gru(
+            input.var, size=size, is_reverse=reverse,
+            param_attr=param_attr, bias_attr=bias_attr,
+            candidate_activation=act_name(act) or "tanh",
+            gate_activation=act_name(gate_act) or "sigmoid")
+    return cfg.Layer(h, v2_dim=size, parents=[input])
+
+
+# ---- cost layers ----------------------------------------------------------
+
+def _register_classification_error(g, input, label, name):
+    acc = fl.accuracy(input=input.var, label=label.var)
+    g.evaluators.append((name or "classification_error_evaluator", acc,
+                         "one_minus"))
+
+
+def classification_cost(input, label, weight=None, name=None,
+                        evaluator=None, layer_attr=None):
+    """Softmax-input cross entropy + auto-registered classification-error
+    evaluator (reference trainer_config_helpers classification_cost)."""
+    if weight is not None:
+        raise NotImplementedError("weighted classification_cost")
+    with cfg.build() as g:
+        ce = fl.cross_entropy(input=input.var, label=label.var)
+        cost = fl.mean(ce)
+        _register_classification_error(g, input, label, None)
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def cross_entropy_cost(input, label, name=None, coeff=1.0, weight=None,
+                       layer_attr=None):
+    with cfg.build():
+        ce = fl.cross_entropy(input=input.var, label=label.var)
+        cost = fl.mean(ce)
+        if coeff != 1.0:
+            cost = cost * coeff
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def multi_binary_label_cross_entropy_cost(input, label, name=None,
+                                          coeff=1.0, layer_attr=None):
+    from ..layer_helper import LayerHelper
+    with cfg.build():
+        helper = LayerHelper("multi_binary_label_cross_entropy")
+        ce = helper.create_variable_for_type_inference(input.var.dtype)
+        helper.append_op(
+            type="sigmoid_cross_entropy_with_logits",
+            inputs={"X": [input.var], "Label": [label.var]},
+            outputs={"Out": [ce]},
+        )
+        cost = fl.mean(ce)
+        if coeff != 1.0:
+            cost = cost * coeff
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def square_error_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    with cfg.build():
+        sq = fl.square_error_cost(input=input.var, label=label.var)
+        cost = fl.mean(sq)
+        if coeff != 1.0:
+            cost = cost * coeff
+    return cfg.Layer(cost, parents=[input, label])
+
+
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+
+
+def nce(input, label, num_classes, param_attr=None, weight=None,
+        num_neg_samples=10, neg_distribution=None, bias_attr=None,
+        name=None, layer_attr=None):
+    if weight is not None or neg_distribution is not None:
+        raise NotImplementedError(
+            "nce weight=/neg_distribution= (uniform sampling only, as "
+            "ops/sampled_loss.py implements)")
+    inputs = cfg.as_layers(input)
+    with cfg.build():
+        x = fl.concat([l.var for l in inputs], axis=-1) \
+            if len(inputs) > 1 else inputs[0].var
+        cost = fl.nce(input=x, label=label.var, num_total_classes=num_classes,
+                      param_attr=param_attr, bias_attr=bias_attr,
+                      num_neg_samples=num_neg_samples)
+        cost = fl.mean(cost)
+    return cfg.Layer(cost, parents=inputs + [label])
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, layer_attr=None):
+    inputs = cfg.as_layers(input)
+    with cfg.build():
+        x = fl.concat([l.var for l in inputs], axis=-1) \
+            if len(inputs) > 1 else inputs[0].var
+        cost = fl.hsigmoid(input=x, label=label.var,
+                           num_classes=num_classes, param_attr=param_attr,
+                           bias_attr=bias_attr)
+        cost = fl.mean(cost)
+    return cfg.Layer(cost, parents=inputs + [label])
+
+
+def crf(input, label, size=None, param_attr=None, name=None,
+        layer_attr=None):
+    with cfg.build():
+        ll = fl.linear_chain_crf(input=input.var, label=label.var,
+                                 param_attr=param_attr)
+        cost = fl.mean(ll)
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def crf_decoding(input, size=None, label=None, param_attr=None, name=None,
+                 layer_attr=None):
+    with cfg.build():
+        path = fl.crf_decoding(
+            input=input.var, param_attr=param_attr,
+            label=None if label is None else label.var)
+    return cfg.Layer(path, parents=[input] + ([label] if label else []))
+
+
+def ctc(input, label, size=None, name=None, norm_by_times=False,
+        layer_attr=None):
+    with cfg.build():
+        cost = fl.warpctc(input=input.var, label=label.var,
+                          norm_by_times=norm_by_times)
+        cost = fl.mean(cost)
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def parse_network(*outputs):
+    """Return the Program holding the given output layers (reference
+    v2/layer.py parse_network returns the pruned ModelConfig proto)."""
+    from .topology import Topology
+    return Topology(list(outputs)).program

@@ -1,0 +1,20 @@
+"""Batched reader (reference python/paddle/v2/minibatch.py:17)."""
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=True):
+    """Group a sample reader into lists of ``batch_size`` samples.
+    Note the reference's surprising default drop_last=True is kept."""
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if not drop_last and b:
+            yield b
+
+    return batch_reader

@@ -1,0 +1,84 @@
+"""v2 composite networks (reference python/paddle/v2/networks.py:1
+wrapping trainer_config_helpers/networks.py)."""
+
+from .. import layers as fl
+from .. import nets as fnets
+from . import config as cfg
+from . import layer as v2_layer
+from .activation import act_name
+
+__all__ = [
+    "simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+    "simple_lstm", "simple_gru", "bidirectional_lstm",
+]
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride, act=None, num_channel=None,
+                         pool_type="max", **kwargs):
+    """conv + pool block (reference networks.py simple_img_conv_pool)."""
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channel)
+        var = fnets.simple_img_conv_pool(
+            img, num_filters=num_filters, filter_size=filter_size,
+            pool_size=pool_size, pool_stride=pool_stride,
+            act=act_name(act), pool_type=pool_type)
+    return cfg.Layer(var, parents=[input])
+
+
+def img_conv_group(input, conv_num_filter, conv_filter_size=3,
+                   pool_size=2, pool_stride=2, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   num_channels=None, pool_type="max", **kwargs):
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        var = fnets.img_conv_group(
+            img, conv_num_filter=conv_num_filter,
+            conv_filter_size=conv_filter_size, pool_size=pool_size,
+            pool_stride=pool_stride, conv_act=act_name(conv_act),
+            conv_with_batchnorm=conv_with_batchnorm,
+            conv_batchnorm_drop_rate=conv_batchnorm_drop_rate,
+            pool_type=pool_type)
+    return cfg.Layer(var, parents=[input])
+
+
+def sequence_conv_pool(input, context_len, hidden_size, act=None,
+                       pool_type="max", **kwargs):
+    """text conv block (reference networks.py sequence_conv_pool);
+    context_len/hidden_size follow the v1 argument names."""
+    with cfg.build():
+        var = fnets.sequence_conv_pool(
+            input.var, num_filters=hidden_size, filter_size=context_len,
+            act=act_name(act) or "tanh", pool_type=pool_type)
+    return cfg.Layer(var, v2_dim=hidden_size, parents=[input])
+
+
+def simple_lstm(input, size, reverse=False, act=None, gate_act=None,
+                state_act=None, mat_param_attr=None, bias_param_attr=None,
+                inner_param_attr=None, **kwargs):
+    """fc projection + lstmemory (reference networks.py simple_lstm)."""
+    mixed = v2_layer.fc(input, size=size * 4, act=None,
+                        param_attr=mat_param_attr, bias_attr=False)
+    return v2_layer.lstmemory(
+        mixed, size=size, reverse=reverse, act=act, gate_act=gate_act,
+        state_act=state_act, param_attr=inner_param_attr,
+        bias_attr=bias_param_attr)
+
+
+def simple_gru(input, size, reverse=False, act=None, gate_act=None,
+               **kwargs):
+    mixed = v2_layer.fc(input, size=size * 3, act=None, bias_attr=False)
+    return v2_layer.grumemory(mixed, size=size, reverse=reverse, act=act,
+                              gate_act=gate_act)
+
+
+def bidirectional_lstm(input, size, return_seq=False, **kwargs):
+    """fwd + bwd simple_lstm, concatenated (reference
+    networks.py bidirectional_lstm)."""
+    fwd = simple_lstm(input, size=size)
+    bwd = simple_lstm(input, size=size, reverse=True)
+    if return_seq:
+        return v2_layer.concat([fwd, bwd])
+    f_last = v2_layer.last_seq(fwd)
+    b_last = v2_layer.first_seq(bwd)
+    return v2_layer.concat([f_last, b_last])

@@ -1,0 +1,143 @@
+"""v2 optimizers (reference python/paddle/v2/optimizer.py:1, wrapping the
+legacy ``ParameterUpdater``/swig path).  Each maps onto the fluid-parity
+optimizer op family; ``regularization`` and
+``gradient_clipping_threshold`` translate to the regularizer/clip hooks
+``Optimizer.minimize`` already applies."""
+
+from .. import optimizer as fluid_opt
+from .. import regularizer as fluid_reg
+
+__all__ = [
+    "Optimizer", "Momentum", "Adam", "Adamax", "AdaGrad", "DecayedAdaGrad",
+    "AdaDelta", "RMSProp", "L1Regularization", "L2Regularization",
+    "ModelAverage",
+]
+
+
+class L2Regularization(object):
+    """settings(regularization=L2Regularization(rate)) analog."""
+
+    def __init__(self, rate=0.0):
+        self.rate = rate
+
+    def to_regularizer(self):
+        return fluid_reg.L2DecayRegularizer(self.rate)
+
+
+class L1Regularization(object):
+    def __init__(self, rate=0.0):
+        self.rate = rate
+
+    def to_regularizer(self):
+        return fluid_reg.L1DecayRegularizer(self.rate)
+
+
+class ModelAverage(object):
+    """Marker matching reference ModelAverage(average_window=...); the
+    trainer applies it via the fluid-parity contrib ModelAverage when
+    requested (reference v2/optimizer.py ModelAverage settings)."""
+
+    def __init__(self, average_window=0.15, max_average_window=None,
+                 min_average_window=10000):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+        self.min_average_window = min_average_window
+
+
+class Optimizer(object):
+    def __init__(self, learning_rate=1e-3, regularization=None,
+                 model_average=None, gradient_clipping_threshold=None,
+                 learning_rate_decay_a=None, learning_rate_decay_b=None,
+                 learning_rate_schedule=None, **extra):
+        self.learning_rate = learning_rate
+        self.regularization = regularization
+        self.model_average = model_average
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        self.extra = extra
+
+    def _regularizer(self):
+        if self.regularization is None:
+            return None
+        return self.regularization.to_regularizer()
+
+    def to_optimizer(self):
+        """Build the fluid-parity optimizer instance."""
+        raise NotImplementedError
+
+    # kept for signature parity with the reference (swig enable_types)
+    def enable_types(self):
+        return []
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=0.9, sparse=False, **kw):
+        super(Momentum, self).__init__(**kw)
+        self.momentum = momentum
+
+    def to_optimizer(self):
+        return fluid_opt.MomentumOptimizer(
+            learning_rate=self.learning_rate, momentum=self.momentum,
+            regularization=self._regularizer())
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super(Adam, self).__init__(**kw)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def to_optimizer(self):
+        return fluid_opt.AdamOptimizer(
+            learning_rate=self.learning_rate, beta1=self.beta1,
+            beta2=self.beta2, epsilon=self.epsilon,
+            regularization=self._regularizer())
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, **kw):
+        super(Adamax, self).__init__(**kw)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def to_optimizer(self):
+        return fluid_opt.AdamaxOptimizer(
+            learning_rate=self.learning_rate, beta1=self.beta1,
+            beta2=self.beta2, regularization=self._regularizer())
+
+
+class AdaGrad(Optimizer):
+    def to_optimizer(self):
+        return fluid_opt.AdagradOptimizer(
+            learning_rate=self.learning_rate,
+            regularization=self._regularizer())
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        super(DecayedAdaGrad, self).__init__(**kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_optimizer(self):
+        return fluid_opt.DecayedAdagradOptimizer(
+            learning_rate=self.learning_rate, decay=self.rho,
+            epsilon=self.epsilon, regularization=self._regularizer())
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        super(AdaDelta, self).__init__(**kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_optimizer(self):
+        return fluid_opt.AdadeltaOptimizer(
+            learning_rate=self.learning_rate, rho=self.rho,
+            epsilon=self.epsilon, regularization=self._regularizer())
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kw):
+        super(RMSProp, self).__init__(**kw)
+        self.rho, self.epsilon = rho, epsilon
+
+    def to_optimizer(self):
+        return fluid_opt.RMSPropOptimizer(
+            learning_rate=self.learning_rate, rho=self.rho,
+            epsilon=self.epsilon, regularization=self._regularizer())

@@ -1,0 +1,156 @@
+"""v2 Parameters: a dict-like view of the model's trainable parameters
+(reference python/paddle/v2/parameters.py:44).
+
+The reference object mirrors GradientMachine parameter buffers; here it
+owns a Scope — the same store the executors run against — so trainer
+updates are visible through it with no copying.  ``to_tar``/``from_tar``
+keep the v2 archive workflow (one member per parameter; numpy .npy
+replaces the v1 binary layout, documented in the archive's meta member).
+"""
+
+import io as _io
+import json
+import tarfile
+
+import numpy as np
+
+from ..executor import CPUPlace, Executor
+from ..scope import Scope
+
+__all__ = ["Parameters", "create"]
+
+_META_MEMBER = "__meta__.json"
+
+
+def create(*layers):
+    """Create Parameters for the topology ending at ``layers`` (reference
+    parameters.py:create): initializes every trainable parameter by
+    running the topology's startup program."""
+    from .topology import Topology
+
+    topo = Topology(list(layers))
+    params = Parameters()
+    params.attach(topo)
+    return params
+
+
+class Parameters(object):
+    def __init__(self):
+        self._scope = Scope()
+        self._topology = None
+        self._param_names = []
+        self._pending = {}   # values set/loaded before a topology attaches
+
+    # -- wiring ------------------------------------------------------------
+
+    def attach(self, topology, place=None):
+        """Bind to a topology: run its startup program for any scope var
+        not already present (so re-attaching after an optimizer added
+        accumulators only fills the new ones), then apply pending values."""
+        self._topology = topology
+        self._param_names = [
+            p.name for p in topology.program.global_block().all_parameters()
+        ]
+        exe = Executor(place or CPUPlace())
+        tmp = Scope()
+        exe.run(topology.startup, scope=tmp)
+        for name, val in tmp.items():
+            if self._scope.find_var(name) is None:
+                self._scope.set_var(name, val)
+        for name, val in list(self._pending.items()):
+            if self._scope.find_var(name) is not None:
+                del self._pending[name]
+                self.set(name, val)   # same shape check / dtype cast
+        return self
+
+    @property
+    def scope(self):
+        return self._scope
+
+    # -- dict surface ------------------------------------------------------
+
+    def names(self):
+        """Topology parameters plus any loaded values still awaiting a
+        topology — so to_tar after a partial attach loses nothing."""
+        extra = [n for n in sorted(self._pending) if n not in
+                 self._param_names]
+        return list(self._param_names) + extra
+
+    keys = names
+
+    def has_key(self, name):
+        return name in self.names()
+
+    def __iter__(self):
+        return iter(self.names())
+
+    def __len__(self):
+        return len(self.names())
+
+    def __contains__(self, name):
+        return self.has_key(name)
+
+    def get(self, name):
+        v = self._scope.find_var(name)
+        if v is not None:
+            return np.asarray(v)
+        if name in self._pending:
+            return np.asarray(self._pending[name])
+        raise KeyError("no parameter %r" % name)
+
+    __getitem__ = get
+
+    def get_shape(self, name):
+        return tuple(self.get(name).shape)
+
+    def set(self, name, value):
+        value = np.asarray(value)
+        if self._scope.find_var(name) is not None:
+            cur = np.asarray(self._scope.find_var(name))
+            if cur.shape != value.shape:
+                raise ValueError("shape mismatch for %r: %s vs %s"
+                                 % (name, cur.shape, value.shape))
+            self._scope.set_var(name, value.astype(cur.dtype))
+        else:
+            self._pending[name] = value
+
+    __setitem__ = set
+
+    # -- tar archive (reference parameters.py to_tar/from_tar) -------------
+
+    def to_tar(self, f):
+        names = self.names()
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            meta = json.dumps({"format": "paddle_tpu.v2", "version": 1,
+                               "names": names}).encode()
+            info = tarfile.TarInfo(_META_MEMBER)
+            info.size = len(meta)
+            tar.addfile(info, _io.BytesIO(meta))
+            for name in names:
+                buf = _io.BytesIO()
+                np.save(buf, self.get(name), allow_pickle=False)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name + ".npy")
+                info.size = len(data)
+                tar.addfile(info, _io.BytesIO(data))
+
+    @staticmethod
+    def from_tar(f):
+        params = Parameters()
+        params.init_from_tar(f)
+        return params
+
+    def init_from_tar(self, f):
+        """Merge values from an archive into this object (reference
+        parameters.py:init_from_tar): unknown names are held pending
+        until a topology with those parameters attaches."""
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                if member.name == _META_MEMBER:
+                    continue
+                if not member.name.endswith(".npy"):
+                    continue
+                name = member.name[:-len(".npy")]
+                data = tar.extractfile(member).read()
+                arr = np.load(_io.BytesIO(data), allow_pickle=False)
+                self.set(name, arr)

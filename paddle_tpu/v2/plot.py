@@ -1,0 +1,70 @@
+"""Training-curve plotter (reference python/paddle/v2/plot/plot.py:1).
+
+Collects (step, value) series per title; ``plot()`` renders with
+matplotlib when available and DISABLE_PLOT is unset, else is a no-op
+(the reference gates identically for headless CI)."""
+
+import os
+
+__all__ = ["Ploter"]
+
+
+class PlotData(object):
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter(object):
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {t: PlotData() for t in args}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT", "False")
+        try:
+            if not self.__plot_is_disabled__():
+                import matplotlib.pyplot as plt
+                from IPython import display
+                self.plt = plt
+                self.display = display
+        except ImportError:
+            self.__disable_plot__ = "True"
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__ == "True"
+
+    def append(self, title, step, value):
+        assert isinstance(title, str)
+        assert title in self.__plot_data__
+        self.__plot_data__[title].append(step, value)
+
+    def data(self, title):
+        return self.__plot_data__[title]
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        titles = []
+        for title in self.__args__:
+            data = self.__plot_data__[title]
+            if len(data.step) > 0:
+                self.plt.plot(data.step, data.value)
+                titles.append(title)
+        self.plt.legend(titles, loc="upper left")
+        if path is None:
+            self.display.clear_output(wait=True)
+            self.display.display(self.plt.gcf())
+        else:
+            self.plt.savefig(path)
+        self.plt.gcf().clear()
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

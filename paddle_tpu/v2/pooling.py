@@ -1,0 +1,48 @@
+"""v2 pooling objects (reference python/paddle/v2/pooling.py:1 wrapping
+trainer_config_helpers/poolings.py).  Used both for sequence pooling
+(``layer.pooling``) and image pooling (``layer.img_pool``)."""
+
+__all__ = ["BasePool", "Max", "Avg", "Sum", "CudnnMax", "CudnnAvg"]
+
+
+class BasePool(object):
+    seq_type = None   # sequence_pool pooltype
+    img_type = None   # pool2d pool_type
+
+    def __repr__(self):
+        return "pooling.%s()" % type(self).__name__
+
+
+class Max(BasePool):
+    seq_type = "max"
+    img_type = "max"
+
+
+class Avg(BasePool):
+    seq_type = "average"
+    img_type = "avg"
+
+
+class Sum(BasePool):
+    seq_type = "sum"
+    img_type = "avg"  # no sum image pooling; reference maps via avg*N
+
+
+CudnnMax = Max
+CudnnAvg = Avg
+
+
+def seq_pool_type(p):
+    if isinstance(p, type) and issubclass(p, BasePool):
+        p = p()
+    if not isinstance(p, BasePool):
+        raise TypeError("expected a paddle_tpu.v2.pooling object, got %r" % p)
+    return p.seq_type
+
+
+def img_pool_type(p):
+    if isinstance(p, type) and issubclass(p, BasePool):
+        p = p()
+    if not isinstance(p, BasePool):
+        raise TypeError("expected a paddle_tpu.v2.pooling object, got %r" % p)
+    return p.img_type

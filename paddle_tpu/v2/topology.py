@@ -1,0 +1,45 @@
+"""Topology: the set of layers reachable from the output layers
+(reference python/paddle/v2/topology.py:1, which serializes a pruned
+ModelConfig proto).  Here it is a view over the global v2 graph's
+Program plus the ordered data layers — pruning happens lazily via
+``Program.prune_feed_fetch`` when a trainer/inferencer compiles."""
+
+from . import config as cfg
+
+__all__ = ["Topology"]
+
+
+class Topology(object):
+    def __init__(self, layers, extra_layers=None):
+        self.layers = cfg.as_layers(layers) + cfg.as_layers(extra_layers)
+        if not self.layers:
+            raise ValueError("Topology needs at least one output layer")
+        g = cfg.graph()
+        for l in self.layers:
+            if l.var.block.program is not g.main:
+                raise ValueError(
+                    "layer %s belongs to a reset v2 graph; rebuild the "
+                    "model after v2.layer.reset()" % l.name)
+        self.graph = g
+        self.program = g.main
+        self.startup = g.startup
+        self.data_layers = list(g.data_layers)
+
+    def data_type(self):
+        """[(name, InputType)] in declaration order (reference
+        topology.py:data_type) — the default feeding order."""
+        return [(l.name, l.data_type) for l in self.data_layers]
+
+    def data_layer_names(self):
+        return [l.name for l in self.data_layers]
+
+    def get_layer(self, name):
+        for l in self.layers + self.data_layers:
+            if l.name == name:
+                return l
+        return None
+
+    def proto(self):
+        """Serializable form (the ProgramDesc JSON replaces the v2
+        ModelConfig proto)."""
+        return self.program.to_dict()

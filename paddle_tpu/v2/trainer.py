@@ -1,0 +1,190 @@
+"""v2 trainer (reference python/paddle/v2/trainer.py:37 SGD).
+
+The reference SGD drives a swig GradientMachine + ParameterUpdater;
+here it compiles the v2 graph's Program with the fluid-parity Executor
+(one jit-compiled step function) and runs the same
+pass/batch/event loop.  Updates land in the Parameters' scope, so the
+user's Parameters object always reflects the trained weights."""
+
+import collections.abc
+
+import numpy as np
+
+from ..clip import GradientClipByGlobalNorm, set_gradient_clip
+from ..data_feeder import DataFeeder
+from ..executor import CPUPlace, Executor
+from . import config as cfg
+from . import event as v2_event
+from . import optimizer as v2_optimizer
+from . import parameters as v2_parameters
+from .topology import Topology
+
+__all__ = ["SGD"]
+
+
+def default_event_handler(event):
+    pass
+
+
+class SGD(object):
+    """Trainer combining data reader, topology and update rule
+    (reference v2/trainer.py:37).  ``is_local=False`` pserver modes are
+    a fold into the mesh runtime — use paddle_tpu.ParallelExecutor /
+    the distribute transpiler for multi-host training (SURVEY §2.4)."""
+
+    def __init__(self, cost, parameters, update_equation, extra_layers=None,
+                 is_local=True, pserver_spec=None, use_etcd=True,
+                 place=None):
+        if not isinstance(parameters, v2_parameters.Parameters):
+            raise TypeError("parameters should be parameters")
+        if not isinstance(update_equation, v2_optimizer.Optimizer):
+            raise TypeError("update equation parameter must be "
+                            "paddle_tpu.v2.optimizer.Optimizer")
+        if not is_local:
+            raise NotImplementedError(
+                "pserver mode is folded into the mesh runtime; see "
+                "transpiler.DistributeTranspiler (SURVEY §2.4)")
+
+        topology = Topology(cost, extra_layers=extra_layers)
+        self.__topology__ = topology
+        self.__parameters__ = parameters
+        self.__optimizer__ = update_equation
+        if place is None:
+            from . import default_place
+            place = default_place()
+        self.__place__ = place
+
+        # snapshot the forward graph for test()/infer before optimizer ops
+        self.__test_program__ = topology.program.clone(for_test=True)
+
+        if update_equation.gradient_clipping_threshold:
+            set_gradient_clip(
+                GradientClipByGlobalNorm(
+                    update_equation.gradient_clipping_threshold),
+                program=topology.program)
+        opt = update_equation.to_optimizer()
+        from ..framework import program_guard
+        with program_guard(topology.program, topology.startup):
+            opt.minimize(cost.var, startup_program=topology.startup)
+
+        # startup now also initializes optimizer state; fill missing vars
+        parameters.attach(topology, place=self.__place__)
+        self.__exe__ = Executor(self.__place__)
+        self.__cost__ = cost
+
+    def get_topology_proto(self):
+        return self.__topology__.proto()
+
+    # -- feeding ----------------------------------------------------------
+
+    def __feed_plan__(self, feeding):
+        """[(data_layer, column_index)] ordered by column index."""
+        layers = self.__topology__.data_layers
+        if feeding is None:
+            plan = list(zip(layers, range(len(layers))))
+        else:
+            by_name = {l.name: l for l in layers}
+            plan = []
+            for name, idx in feeding.items():
+                if name not in by_name:
+                    raise KeyError("feeding names unknown data layer %r"
+                                   % name)
+                plan.append((by_name[name], idx))
+            plan.sort(key=lambda p: p[1])
+        return plan
+
+    def __make_feeder__(self, plan):
+        return DataFeeder(
+            feed_list=[l.var for l in plan_layers(plan)],
+            place=self.__place__, program=self.__topology__.program)
+
+    @staticmethod
+    def __make_feed__(feeder, plan, data_batch):
+        rows = [tuple(row[idx] for _, idx in plan) for row in data_batch]
+        return feeder.feed(rows)
+
+    def __evaluator_fetches__(self):
+        return [(name, var, tr) for name, var, tr
+                in self.__topology__.graph.evaluators]
+
+    # -- training loop (reference trainer.py:137) --------------------------
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        if event_handler is None:
+            event_handler = default_event_handler
+        __check_train_args__(reader, event_handler)
+
+        plan = self.__feed_plan__(feeding)
+        feeder = self.__make_feeder__(plan)
+        evals = self.__evaluator_fetches__()
+        fetch_list = [self.__cost__.var.name] + [v.name for _, v, _ in evals]
+        scope = self.__parameters__.scope
+
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            pass_metrics, pass_n = {}, 0
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(v2_event.BeginIteration(pass_id, batch_id))
+                feed = self.__make_feed__(feeder, plan, data_batch)
+                outs = self.__exe__.run(
+                    self.__topology__.program, feed=feed,
+                    fetch_list=fetch_list, scope=scope)
+                cost = float(np.mean(np.asarray(outs[0])))
+                metrics = {}
+                for (name, _, tr), val in zip(evals, outs[1:]):
+                    v = float(np.mean(np.asarray(val)))
+                    metrics[name] = 1.0 - v if tr == "one_minus" else v
+                event_handler(v2_event.EndForwardBackward(pass_id, batch_id))
+                n = len(data_batch)
+                pass_n += n
+                for k, v in metrics.items():
+                    pass_metrics[k] = pass_metrics.get(k, 0.0) + v * n
+                event_handler(v2_event.EndIteration(
+                    pass_id, batch_id, cost, metrics))
+            event_handler(v2_event.EndPass(
+                pass_id,
+                metrics={k: v / max(pass_n, 1)
+                         for k, v in pass_metrics.items()}))
+
+    # -- evaluation (reference trainer.py:test) ----------------------------
+
+    def test(self, reader, feeding=None):
+        plan = self.__feed_plan__(feeding)
+        feeder = self.__make_feeder__(plan)
+        evals = self.__evaluator_fetches__()
+        fetch_list = [self.__cost__.var.name] + [v.name for _, v, _ in evals]
+        scope = self.__parameters__.scope
+
+        total_cost, total_metrics, num_samples = 0.0, {}, 0
+        for data_batch in reader():
+            feed = self.__make_feed__(feeder, plan, data_batch)
+            outs = self.__exe__.run(
+                self.__test_program__, feed=feed, fetch_list=fetch_list,
+                scope=scope)
+            n = len(data_batch)
+            num_samples += n
+            total_cost += float(np.mean(np.asarray(outs[0]))) * n
+            for (name, _, tr), val in zip(evals, outs[1:]):
+                v = float(np.mean(np.asarray(val)))
+                v = 1.0 - v if tr == "one_minus" else v
+                total_metrics[name] = total_metrics.get(name, 0.0) + v * n
+        num_samples = max(num_samples, 1)
+        return v2_event.TestResult(
+            metrics={k: v / num_samples for k, v in total_metrics.items()},
+            cost=total_cost / num_samples)
+
+    def save_parameter_to_tar(self, f):
+        self.__parameters__.to_tar(f)
+
+
+def plan_layers(plan):
+    return [l for l, _ in plan]
+
+
+def __check_train_args__(reader, event_handler):
+    if not callable(reader) or not isinstance(
+            reader(), collections.abc.Iterator):
+        raise TypeError("train_data_reader should be a function "
+                        "which returns an iterator")
+    if not callable(event_handler):
+        raise TypeError("event handler should be a function")

@@ -1,0 +1,254 @@
+"""The v2 API dialect (reference python/paddle/v2/: layer DSL ->
+Parameters -> trainer.SGD -> events/infer), re-hosted on the TPU stack.
+Mirrors the reference's v2 book usage: build layers, create parameters,
+train with a batched reader + event handler, test, infer, tar round-trip.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from paddle_tpu import v2 as paddle
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    paddle.reset()
+    yield
+    paddle.reset()
+
+
+def _mnist_like(n=256, dim=64, classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, dim).astype("float32")
+    ys = rng.randint(0, classes, size=n)
+    xs = centers[ys] + 0.1 * rng.randn(n, dim).astype("float32")
+    return xs.astype("float32"), ys.astype("int64")
+
+
+def _reader(xs, ys):
+    def r():
+        for x, y in zip(xs, ys):
+            yield x, int(y)
+    return r
+
+
+def test_v2_classification_end_to_end():
+    """layer DSL + classification_cost + Momentum: cost falls, events
+    fire in order, metrics carry classification_error_evaluator."""
+    xs, ys = _mnist_like()
+    img = paddle.layer.data(name="img",
+                            type=paddle.data_type.dense_vector(64))
+    hidden = paddle.layer.fc(input=img, size=32,
+                             act=paddle.activation.Relu())
+    pred = paddle.layer.fc(input=hidden, size=10,
+                           act=paddle.activation.Softmax())
+    lbl = paddle.layer.data(name="lbl",
+                            type=paddle.data_type.integer_value(10))
+    cost = paddle.layer.classification_cost(input=pred, label=lbl)
+
+    params = paddle.parameters.create(cost)
+    assert any("fc" in n or "w" in n.lower() for n in params.names())
+
+    trainer = paddle.trainer.SGD(
+        cost, params,
+        paddle.optimizer.Momentum(momentum=0.9, learning_rate=0.1))
+
+    events = []
+    costs = []
+
+    def handler(e):
+        events.append(type(e).__name__)
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+            assert "classification_error_evaluator" in e.metrics
+            assert 0.0 <= e.metrics["classification_error_evaluator"] <= 1.0
+
+    trainer.train(paddle.batch(_reader(xs, ys), 64), num_passes=4,
+                  event_handler=handler)
+
+    assert events[0] == "BeginPass" and events[-1] == "EndPass"
+    assert "EndForwardBackward" in events
+    assert costs[-1] < costs[0] * 0.7, (costs[0], costs[-1])
+
+    result = trainer.test(paddle.batch(_reader(xs, ys), 64))
+    assert result.cost < costs[0]
+    assert result.metrics["classification_error_evaluator"] < 0.5
+
+    probs = paddle.infer(output_layer=pred, parameters=params,
+                         input=[(x,) for x in xs[:16]])
+    assert probs.shape == (16, 10)
+    np.testing.assert_allclose(np.sum(probs, axis=1), np.ones(16),
+                               rtol=1e-4)
+    acc = np.mean(np.argmax(probs, axis=1) == ys[:16])
+    assert acc > 0.5
+
+
+def test_v2_regression_and_tar_roundtrip():
+    rng = np.random.RandomState(1)
+    w = rng.randn(8, 1).astype("float32")
+    xs = rng.randn(512, 8).astype("float32")
+    ys = xs @ w + 0.01 * rng.randn(512, 1).astype("float32")
+
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    pred = paddle.layer.fc(input=x, size=1)
+    cost = paddle.layer.mse_cost(input=pred, label=y)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, paddle.optimizer.Adam(learning_rate=0.1))
+
+    def reader():
+        for i in range(512):
+            yield xs[i], ys[i]
+
+    trainer.train(paddle.batch(reader, 64), num_passes=20)
+
+    out = paddle.infer(output_layer=pred, parameters=params,
+                       input=[(x_,) for x_ in xs[:32]])
+    mse = float(np.mean((out - ys[:32]) ** 2))
+    assert mse < 0.1, mse
+
+    # tar round-trip (reference parameters.py to_tar/from_tar)
+    buf = io.BytesIO()
+    trainer.save_parameter_to_tar(buf)
+    buf.seek(0)
+    loaded = paddle.parameters.Parameters.from_tar(buf)
+    for name in params.names():
+        np.testing.assert_array_equal(loaded.get(name), params.get(name))
+
+    # mutate, then restore via init_from_tar: inference must match
+    params.set(params.names()[0],
+               np.zeros_like(params.get(params.names()[0])))
+    buf.seek(0)
+    params.init_from_tar(buf)
+    out2 = paddle.infer(output_layer=pred, parameters=params,
+                        input=[(x_,) for x_ in xs[:32]])
+    np.testing.assert_allclose(out, out2, rtol=1e-5)
+
+
+def test_v2_sequence_model():
+    """embedding + sequence pooling over integer_value_sequence input
+    (the v2 text-classification shape)."""
+    rng = np.random.RandomState(2)
+    vocab, n = 50, 192
+    seqs, labels = [], []
+    for _ in range(n):
+        L = rng.randint(3, 12)
+        s = rng.randint(0, vocab, size=L).tolist()
+        labels.append(1 if (7 in s) else 0)
+        seqs.append(s)
+
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(vocab))
+    emb = paddle.layer.embedding(input=words, size=16)
+    pooled = paddle.layer.pooling(input=emb,
+                                  pooling_type=paddle.pooling.Max())
+    pred = paddle.layer.fc(input=pooled, size=2,
+                           act=paddle.activation.Softmax())
+    lbl = paddle.layer.data(name="lbl",
+                            type=paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=pred, label=lbl)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, paddle.optimizer.Adam(learning_rate=0.05))
+
+    def reader():
+        for s, y in zip(seqs, labels):
+            yield s, y
+
+    costs = []
+    trainer.train(
+        paddle.batch(reader, 32), num_passes=8,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.7, (costs[0], costs[-1])
+
+
+def test_v2_conv_network_and_feeding():
+    """networks.simple_img_conv_pool on a flat dense vector + explicit
+    feeding order (label column first)."""
+    xs, ys = _mnist_like(n=96, dim=64, classes=4, seed=3)
+
+    img = paddle.layer.data(name="pixel",
+                            type=paddle.data_type.dense_vector(64))
+    conv = paddle.networks.simple_img_conv_pool(
+        input=img, filter_size=3, num_filters=4, num_channel=1,
+        pool_size=2, pool_stride=2, act=paddle.activation.Relu())
+    pred = paddle.layer.fc(input=conv, size=4,
+                           act=paddle.activation.Softmax())
+    lbl = paddle.layer.data(name="label",
+                            type=paddle.data_type.integer_value(4))
+    cost = paddle.layer.classification_cost(input=pred, label=lbl)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, paddle.optimizer.Adam(learning_rate=0.02))
+
+    def reader():  # label first: exercises the feeding map
+        for x, y in zip(xs, ys):
+            yield int(y), x
+
+    costs = []
+    trainer.train(
+        paddle.batch(reader, 32), num_passes=4,
+        feeding={"pixel": 1, "label": 0},
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0], (costs[0], costs[-1])
+
+
+def test_v2_batch_drop_last():
+    r = paddle.batch(lambda: iter(range(10)), 3)
+    assert [len(b) for b in r()] == [3, 3, 3]
+    r2 = paddle.batch(lambda: iter(range(10)), 3, drop_last=False)
+    assert [len(b) for b in r2()] == [3, 3, 3, 1]
+
+
+def test_v2_topology_and_parse_network():
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    h = paddle.layer.fc(input=x, size=8, act=paddle.activation.Tanh())
+    prog = paddle.layer.parse_network(h)
+    ops = [op.type for op in prog.global_block().ops]
+    assert "mul" in ops and "tanh" in ops
+
+    from paddle_tpu.v2.topology import Topology
+    topo = Topology(h)
+    assert topo.data_layer_names() == ["x"]
+    (name, tp), = topo.data_type()
+    assert name == "x" and tp.dim == 4
+    d = topo.proto()
+    assert isinstance(d, dict) and d.get("blocks")
+
+
+def test_v2_lstm_network():
+    """networks.simple_lstm trains on a toy last-token task."""
+    rng = np.random.RandomState(4)
+    vocab = 12
+    seqs = [rng.randint(0, vocab, size=rng.randint(3, 8)).tolist()
+            for _ in range(128)]
+    labels = [s[-1] % 2 for s in seqs]
+
+    words = paddle.layer.data(
+        name="w", type=paddle.data_type.integer_value_sequence(vocab))
+    emb = paddle.layer.embedding(input=words, size=8)
+    lstm = paddle.networks.simple_lstm(input=emb, size=8)
+    last = paddle.layer.last_seq(input=lstm)
+    pred = paddle.layer.fc(input=last, size=2,
+                           act=paddle.activation.Softmax())
+    lbl = paddle.layer.data(name="y", type=paddle.data_type.integer_value(2))
+    cost = paddle.layer.classification_cost(input=pred, label=lbl)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, paddle.optimizer.Adam(learning_rate=0.05))
+
+    costs = []
+    trainer.train(
+        paddle.batch(lambda: iter(zip(seqs, labels)), 32), num_passes=6,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration) else None)
+    assert costs[-1] < costs[0] * 0.9, (costs[0], costs[-1])

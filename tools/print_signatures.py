@@ -45,6 +45,19 @@ MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.contrib",
     "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.v2",
+    "paddle_tpu.v2.layer",
+    "paddle_tpu.v2.networks",
+    "paddle_tpu.v2.optimizer",
+    "paddle_tpu.v2.data_type",
+    "paddle_tpu.v2.parameters",
+    "paddle_tpu.v2.event",
+    "paddle_tpu.v2.evaluator",
+    "paddle_tpu.v2.trainer",
+    "paddle_tpu.v2.inference",
+    "paddle_tpu.v2.activation",
+    "paddle_tpu.v2.pooling",
+    "paddle_tpu.v2.attr",
 ]
 
 

@@ -12,3 +12,5 @@ from .memory_usage_calc import memory_usage  # noqa: F401,E402
 from .op_frequence import op_freq_statistic  # noqa: F401,E402
 from . import quantize  # noqa: F401,E402
 from .quantize import QuantizeTranspiler  # noqa: F401,E402
+from . import float16  # noqa: F401,E402
+from .float16 import Bfloat16Transpiler, Float16Transpiler  # noqa: F401,E402

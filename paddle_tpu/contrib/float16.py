@@ -1,0 +1,198 @@
+"""Half-precision inference transpiler.
+
+Parity: reference ``paddle/contrib/float16/float16_transpiler.py:21``
+(Float16Transpiler) — rewrites a *trained fp32 inference program* so it
+runs in half precision while the user still feeds and fetches fp32
+tensors.  TPU-first redesign: the half type is **bfloat16** (the MXU's
+native half format; fp16 on TPU buys nothing and loses exponent range),
+and instead of swapping per-op kernels the rewrite only touches the
+boundaries —
+
+1. trained parameters in the scope are cast to bf16 in place (the
+   reference creates ``@FP16`` twins; XLA consumes the converted arrays
+   directly, so twins would just double scope memory),
+2. a ``cast`` op is prepended per feed var (user feeds fp32, graph
+   computes bf16),
+3. each fetch target's producer is renamed to a ``@BF16`` twin and a
+   ``cast`` back to fp32 is appended under the original name, so
+   fetch dtypes are unchanged.
+
+Numerically-sensitive ops keep fp32 compute exactly as training AMP
+does (softmax & friends — ``contrib.mixed_precision`` black list): the
+rewrite inserts a fp32 cast before each and returns to bf16 after,
+mirroring the reference's "no fp16 kernel" fallback for such ops.
+"""
+
+import numpy as np
+
+from .. import core
+from ..framework import Program
+from ..scope import global_scope
+
+__all__ = ["Bfloat16Transpiler", "Float16Transpiler"]
+
+# ops whose inputs must stay fp32 (subset of the AMP black list that can
+# appear in inference programs)
+_FP32_OPS = {
+    "softmax", "log_softmax", "exp", "log", "norm", "lrn", "group_norm",
+    "reduce_sum", "reduce_mean", "mean", "cross_entropy",
+    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+}
+
+_SKIP_RENAME = {"cast", "feed", "fetch"}
+
+
+class Bfloat16Transpiler:
+    """Rewrite an inference program + scope for bf16 execution."""
+
+    def transpile(self, program, place=None, scope=None, fetch_targets=None):
+        """``fetch_targets``: Variables/names whose fetched dtype must
+        remain fp32 (reference reads them off the fetch ops; this stack
+        keeps fetch lists outside the program, so callers pass them —
+        load_inference_model's fetch_targets slot in).
+        """
+        if not isinstance(program, Program):
+            raise TypeError("program should be a Program")
+        scope = scope if scope is not None else global_scope()
+        block = program.global_block()
+        self._block = block
+        self._input_map = {}
+
+        self._convert_params(block, scope)
+        self._cast_feeds(block)
+        self._adjust_inputs(block)
+        self._repropagate(block)
+        self._guard_fp32_ops(block)
+        self._repropagate(block)
+        self._cast_fetches(block, fetch_targets or [])
+        self._repropagate(block)
+        return program
+
+    @staticmethod
+    def _repropagate(block):
+        """Re-run shape/dtype inference in op order so the var metadata
+        reflects the rewritten boundaries (bf16 flows forward; fp32
+        islands re-promote downstream exactly as the runtime will)."""
+        from ..registry import infer_op
+
+        for op in block.ops:
+            infer_op(op, block)
+
+    # -- 1. parameters ------------------------------------------------------
+
+    def _convert_params(self, block, scope):
+        bf16 = core.convert_dtype("bfloat16")
+        for var in list(block.vars.values()):
+            if not getattr(var, "persistable", False):
+                continue
+            if core.convert_dtype(var.dtype) != np.dtype(np.float32):
+                continue
+            val = scope.find_var(var.name)
+            if val is None:
+                continue
+            import jax.numpy as jnp
+
+            scope.set_var(var.name, jnp.asarray(val).astype(jnp.bfloat16))
+            var.dtype = bf16
+
+    # -- 2. feed boundary ---------------------------------------------------
+
+    def _cast_feeds(self, block):
+        idx = 0
+        for var in list(block.vars.values()):
+            if not getattr(var, "is_data", False):
+                continue
+            if core.convert_dtype(var.dtype) != np.dtype(np.float32):
+                continue  # ids/labels stay integer
+            twin_name = var.name + "@BF16"
+            twin = block.create_var(
+                name=twin_name, shape=var.shape, dtype="bfloat16",
+                stop_gradient=True)
+            if getattr(var, "_seq_len_name", None):
+                twin._seq_len_name = var._seq_len_name
+            block.insert_op(
+                idx, type="cast",
+                inputs={"X": [var.name]}, outputs={"Out": [twin_name]},
+                attrs={"out_dtype": "bfloat16"})
+            idx += 1
+            self._input_map[var.name] = twin_name
+
+    def _adjust_inputs(self, block):
+        """Rewire consumers onto the cast twins (reference
+        _adjust_input, skipping the cast ops themselves)."""
+        for op in block.ops:
+            if op.type in _SKIP_RENAME:
+                continue
+            for slot, names in op.inputs.items():
+                op.inputs[slot] = [self._input_map.get(n, n) for n in names]
+
+    # -- 3. fp32 islands ----------------------------------------------------
+
+    def _guard_fp32_ops(self, block):
+        """Insert bf16->fp32 casts before black-listed ops and retype
+        their outputs fp32; the next bf16 consumer simply computes in
+        fp32 inputs' promoted dtype, matching AMP's black-list rule."""
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type in _FP32_OPS:
+                for slot, names in list(op.inputs.items()):
+                    new_names = []
+                    for n in names:
+                        v = block._find_var_recursive(n)
+                        if v is not None and core.convert_dtype(v.dtype) == \
+                                core.convert_dtype("bfloat16"):
+                            cast_name = n + "@FP32"
+                            if block._find_var_recursive(cast_name) is None:
+                                block.create_var(
+                                    name=cast_name, shape=v.shape,
+                                    dtype="float32", stop_gradient=True)
+                                block.insert_op(
+                                    i, type="cast", inputs={"X": [n]},
+                                    outputs={"Out": [cast_name]},
+                                    attrs={"out_dtype": "float32"})
+                                i += 1
+                            new_names.append(cast_name)
+                        else:
+                            new_names.append(n)
+                    op.inputs[slot] = new_names
+            i += 1
+
+    # -- 4. fetch boundary --------------------------------------------------
+
+    def _cast_fetches(self, block, fetch_targets):
+        for t in fetch_targets:
+            name = t if isinstance(t, str) else t.name
+            var = block._find_var_recursive(name)
+            if var is None:
+                raise KeyError("fetch target %r not in program" % name)
+            if core.convert_dtype(var.dtype) == np.dtype(np.float32):
+                continue  # already fp32 (e.g. a guarded softmax output)
+            producer = None
+            for op in block.ops:
+                if name in op.output_arg_names:
+                    producer = op
+            if producer is None or producer.type == "cast":
+                continue
+            twin_name = name + "@BF16"
+            twin = block.create_var(
+                name=twin_name, shape=var.shape, dtype="bfloat16",
+                stop_gradient=True)
+            for slot, names in producer.outputs.items():
+                producer.outputs[slot] = [
+                    twin_name if n == name else n for n in names]
+            # consumers between producer and fetch read the twin too
+            for op in block.ops:
+                if op is producer:
+                    continue
+                for slot, names in op.inputs.items():
+                    op.inputs[slot] = [
+                        twin_name if n == name else n for n in names]
+            block.append_op(
+                type="cast", inputs={"X": [twin_name]},
+                outputs={"Out": [name]}, attrs={"out_dtype": "float32"})
+            var.dtype = core.convert_dtype("float32")
+
+
+# the reference name; on TPU "float16" means bfloat16
+Float16Transpiler = Bfloat16Transpiler

@@ -12,10 +12,10 @@ __all__ = ["classification_error", "auc", "value_printer"]
 def classification_error(input, label, name=None, **kwargs):
     """Error rate = 1 - accuracy (reference
     classification_error_evaluator)."""
+    from .layer import _register_classification_error
+
     with cfg.build() as g:
-        acc = fl.accuracy(input=input.var, label=label.var)
-        g.evaluators.append(
-            (name or "classification_error_evaluator", acc, "one_minus"))
+        acc = _register_classification_error(g, input, label, name)
     return cfg.Layer(acc, parents=[input, label])
 
 

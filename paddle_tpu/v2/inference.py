@@ -8,7 +8,7 @@ pruned Program."""
 import numpy as np
 
 from ..data_feeder import DataFeeder
-from ..executor import CPUPlace, Executor
+from ..executor import Executor
 from . import config as cfg
 from .topology import Topology
 
@@ -56,7 +56,13 @@ class Inference(object):
         if feeding is None:
             plan = list(zip(layers, range(len(layers))))
         else:
+            known = {l.name for l in self.topology.data_layers}
+            unknown = set(feeding) - known
+            if unknown:
+                raise KeyError("feeding names unknown data layer(s) %s"
+                               % sorted(unknown))
             by_name = {l.name: l for l in layers}
+            # names pruned away (e.g. the label column) are dropped
             plan = sorted(((by_name[n], i) for n, i in feeding.items()
                            if n in by_name), key=lambda p: p[1])
         feeder = DataFeeder(feed_list=[l.var for l, _ in plan],

@@ -15,11 +15,9 @@ math (``+``/``-``/``*``) works through the underlying Variables.
 import math
 
 from .. import layers as fl
-from .. import nets as fnets
 from . import config as cfg
 from .activation import act_name
-from .data_type import (DENSE, INDEX, NO_SEQUENCE, SEQUENCE,
-                        SPARSE_BINARY, SPARSE_FLOAT)
+from .data_type import INDEX, NO_SEQUENCE, SPARSE_BINARY, SPARSE_FLOAT
 from .pooling import Max as _MaxPool
 from .pooling import img_pool_type, seq_pool_type
 
@@ -247,9 +245,13 @@ def grumemory(input, size=None, reverse=False, act=None, gate_act=None,
 # ---- cost layers ----------------------------------------------------------
 
 def _register_classification_error(g, input, label, name):
+    name = name or "classification_error_evaluator"
     acc = fl.accuracy(input=input.var, label=label.var)
-    g.evaluators.append((name or "classification_error_evaluator", acc,
-                         "one_minus"))
+    # last registration under a name wins (re-registering the same metric
+    # must not fetch two accuracy subgraphs per step)
+    g.evaluators = [e for e in g.evaluators if e[0] != name]
+    g.evaluators.append((name, acc, "one_minus"))
+    return acc
 
 
 def classification_cost(input, label, weight=None, name=None,

@@ -5,16 +5,8 @@ __all__ = ["batch"]
 
 def batch(reader, batch_size, drop_last=True):
     """Group a sample reader into lists of ``batch_size`` samples.
-    Note the reference's surprising default drop_last=True is kept."""
+    Delegates to the shared reader decorator; only the reference's
+    surprising drop_last=True default differs."""
+    from ..reader import batch as _batch
 
-    def batch_reader():
-        b = []
-        for instance in reader():
-            b.append(instance)
-            if len(b) == batch_size:
-                yield b
-                b = []
-        if not drop_last and b:
-            yield b
-
-    return batch_reader
+    return _batch(reader, batch_size, drop_last=drop_last)

@@ -12,7 +12,7 @@ import numpy as np
 
 from ..clip import GradientClipByGlobalNorm, set_gradient_clip
 from ..data_feeder import DataFeeder
-from ..executor import CPUPlace, Executor
+from ..executor import Executor
 from . import config as cfg
 from . import event as v2_event
 from . import optimizer as v2_optimizer

@@ -1,0 +1,93 @@
+"""Bfloat16 inference transpiler (reference
+paddle/contrib/float16/float16_transpiler.py:21): an fp32 inference
+program + scope is rewritten in place to compute in bf16 while the user
+still feeds fp32 and fetches fp32."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import Bfloat16Transpiler, Float16Transpiler
+
+
+def _build_and_train(tmp_path, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(5, 16).astype("float32")
+    ys = rng.randint(0, 5, 256)
+    xs = (centers[ys] + 0.15 * rng.randn(256, 16)).astype("float32")
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[16])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        pred = fluid.layers.fc(h, size=5, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            for i in range(0, 256, 64):
+                exe.run(feed={"x": xs[i:i + 64],
+                              "label": ys[i:i + 64, None].astype("int64")},
+                        fetch_list=[loss])
+            fluid.io.save_inference_model(
+                str(tmp_path / "m"), ["x"], [pred], exe)
+    return xs, ys
+
+
+def test_bf16_transpile_matches_fp32(tmp_path):
+    xs, ys = _build_and_train(tmp_path)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        prog, feed_names, fetch_vars = fluid.io.load_inference_model(
+            str(tmp_path / "m"), exe)
+        ref, = exe.run(prog, feed={"x": xs[:64]},
+                       fetch_list=[fetch_vars[0].name])
+
+        t = Bfloat16Transpiler()
+        t.transpile(prog, fluid.CPUPlace(), scope=scope,
+                    fetch_targets=fetch_vars)
+
+        # params in the scope are bf16 now
+        blk = prog.global_block()
+        w_names = [p.name for p in blk.all_parameters()]
+        assert w_names
+        for n in w_names:
+            assert str(np.asarray(scope.find_var(n)).dtype) == "bfloat16", n
+
+        # user still feeds fp32 and fetches fp32
+        out, = exe.run(prog, feed={"x": xs[:64]},
+                       fetch_list=[fetch_vars[0].name])
+        out = np.asarray(out)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(
+            np.sum(out, axis=1), np.ones(64), rtol=2e-2)
+        # bf16 has ~8 mantissa bits: probabilities close, argmax identical
+        np.testing.assert_allclose(out, np.asarray(ref), atol=0.03)
+        assert np.array_equal(np.argmax(out, 1), np.argmax(np.asarray(ref), 1))
+
+
+def test_bf16_fp32_islands_and_alias(tmp_path):
+    """softmax (AMP black list) keeps fp32 inputs via inserted casts;
+    Float16Transpiler is the reference-named alias."""
+    assert Float16Transpiler is Bfloat16Transpiler
+    xs, _ = _build_and_train(tmp_path, seed=1)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        prog, _, fetch_vars = fluid.io.load_inference_model(
+            str(tmp_path / "m"), exe)
+        Bfloat16Transpiler().transpile(
+            prog, fluid.CPUPlace(), scope=scope, fetch_targets=fetch_vars)
+        blk = prog.global_block()
+        sm = [op for op in blk.ops if op.type == "softmax"]
+        assert sm, "model should contain softmax"
+        for op in sm:
+            for n in op.input_arg_names:
+                v = blk._find_var_recursive(n)
+                assert str(np.dtype(v.dtype)) != "bfloat16", \
+                    "softmax input should be fp32-guarded, got bf16 %r" % n
+        casts = [op for op in blk.ops if op.type == "cast"]
+        assert len(casts) >= 2  # feed cast + fp32 guard at least

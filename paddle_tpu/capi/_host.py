@@ -1,0 +1,165 @@
+"""Embedded-runtime host functions for the C ABI.
+
+The native side (``paddle_capi.cpp``) embeds CPython and calls ONLY the
+flat functions in this module, marshalling tensors as
+``(name, dtype_str, shape_tuple, data_bytes)`` quads — the narrowest
+possible boundary, so the C layer needs no numpy/jax knowledge.
+
+Parity map: reference ``paddle/capi/capi.h`` (gradient-machine C ABI for
+deployment) + ``paddle/fluid/train/demo/demo_trainer.cc:1`` (train from
+a saved ProgramDesc with no Python graph build).  Here the saved JSON
+ProgramDesc is the exchange format and the jit-compiled Executor is the
+engine the C ABI drives.
+"""
+
+import json
+import threading
+
+import numpy as np
+
+from .. import inference as _inference
+from .. import io as _io
+from ..executor import CPUPlace, Executor, TPUPlace
+from ..scope import Scope, scope_guard
+
+_lock = threading.Lock()
+_handles = {}
+_next_id = 1
+
+
+def _register(obj):
+    global _next_id
+    with _lock:
+        h = _next_id
+        _next_id += 1
+        _handles[h] = obj
+    return h
+
+
+def _get(h):
+    obj = _handles.get(h)
+    if obj is None:
+        raise KeyError("invalid handle %d" % h)
+    return obj
+
+
+def _release(h):
+    with _lock:
+        _handles.pop(h, None)
+
+
+def _decode(feeds):
+    out = {}
+    for name, dtype, shape, data in feeds or []:
+        arr = np.frombuffer(data, dtype=np.dtype(dtype)).reshape(
+            tuple(int(s) for s in shape))
+        out[name] = arr
+    return out
+
+
+def _encode(name, arr):
+    arr = np.ascontiguousarray(np.asarray(arr))
+    return (name, str(arr.dtype), tuple(int(s) for s in arr.shape),
+            arr.tobytes())
+
+
+def _place(device):
+    return TPUPlace() if device == "tpu" else CPUPlace()
+
+
+# -- predictor ---------------------------------------------------------------
+
+def predictor_create(model_dir, device="cpu"):
+    cfg = _inference.NativeConfig(model_dir=model_dir,
+                                  use_gpu=(device == "tpu"))
+    return _register(_inference.create_paddle_predictor(cfg))
+
+
+def predictor_io_json(h):
+    """JSON of feed/fetch metadata so a C driver can synthesize inputs
+    without knowing the model."""
+    p = _get(h)
+    blk = p._program.global_block()
+    feeds = []
+    for n in p.feed_names:
+        v = blk.var(n)
+        feeds.append({"name": n,
+                      "shape": [int(s) if s and s > 0 else -1
+                                for s in (v.shape or [])],
+                      "dtype": str(np.dtype(v.dtype or "float32")),
+                      "lod_level": int(v.lod_level or 0)})
+    return json.dumps({"feeds": feeds, "fetches": p.fetch_names})
+
+
+def predictor_run(h, feeds):
+    p = _get(h)
+    feed = _decode(feeds)
+    outs = p.run(feed)
+    return [_encode(t.name, t.data) for t in outs]
+
+
+def predictor_destroy(h):
+    _release(h)
+
+
+# -- trainer (train-from-saved-program) --------------------------------------
+
+class _Trainer:
+    def __init__(self, model_dir, params_dir=None, device="cpu"):
+        self.main, self.startup, self.loss_name, self.feed_names = \
+            _io.load_train_program(model_dir)
+        self.scope = Scope()
+        self.exe = Executor(_place(device))
+        if params_dir:
+            with scope_guard(self.scope):
+                _io.load_persistables(self.exe, params_dir, self.main)
+        else:
+            self.exe.run(self.startup, scope=self.scope)
+        self.rng = np.random.RandomState(0)
+
+    def synth_feed(self, batch_size):
+        feed = {}
+        blk = self.main.global_block()
+        for name in self.feed_names:
+            v = blk.var(name)
+            shape = [batch_size if (s is None or s < 0) else s
+                     for s in (v.shape or (1,))]
+            dtype = str(np.dtype(v.dtype or "float32"))
+            if "int" in dtype:
+                feed[name] = self.rng.randint(0, 2, shape).astype(dtype)
+            else:
+                feed[name] = self.rng.standard_normal(shape).astype(dtype)
+            if (v.lod_level or 0) >= 1:
+                feed[name + "@LEN"] = np.full((shape[0],), shape[1],
+                                              "int32")
+        return feed
+
+    def step(self, feed):
+        loss, = self.exe.run(self.main, feed=feed,
+                             fetch_list=[self.loss_name],
+                             scope=self.scope)
+        return float(np.asarray(loss).reshape(-1)[0])
+
+
+def trainer_create(model_dir, params_dir="", device="cpu"):
+    return _register(_Trainer(model_dir, params_dir or None, device))
+
+
+def trainer_step(h, feeds):
+    t = _get(h)
+    return t.step(_decode(feeds))
+
+
+def trainer_step_synth(h, batch_size):
+    t = _get(h)
+    return t.step(t.synth_feed(int(batch_size)))
+
+
+def trainer_save(h, dirname):
+    t = _get(h)
+    with scope_guard(t.scope):
+        _io.save_persistables(t.exe, dirname, t.main)
+
+
+def trainer_destroy(h):
+    _release(h)

@@ -1,0 +1,144 @@
+/* Native inference demo — reference paddle/capi deployment flow
+ * (capi/examples) re-hosted on the TPU stack's C ABI.
+ *
+ * Usage: demo_predictor <model_dir> [python_exe]
+ *
+ * Reads the model's feed metadata through pd_predictor_io_json, feeds a
+ * deterministic ramp into every float input (batch of 4), runs, and
+ * prints each output's name/shape and first values — a pure C++
+ * process exercising create -> introspect -> run -> release.
+ */
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "paddle_capi.h"
+
+/* minimal parse of the io JSON: find "feeds" entries' shape arrays.
+ * (The demo avoids a JSON dependency; shapes are read with sscanf over
+ * the known emitter format.) */
+struct FeedInfo {
+  std::string name;
+  std::vector<int64_t> shape;
+  std::string dtype;
+};
+
+static std::vector<FeedInfo> parse_feeds(const std::string& js) {
+  std::vector<FeedInfo> feeds;
+  size_t pos = 0;
+  while ((pos = js.find("{\"name\": \"", pos)) != std::string::npos) {
+    FeedInfo f;
+    pos += 10;
+    size_t e = js.find('"', pos);
+    f.name = js.substr(pos, e - pos);
+    size_t sh = js.find("\"shape\": [", pos);
+    if (sh == std::string::npos) break;
+    sh += 10;
+    size_t sh_end = js.find(']', sh);
+    std::string nums = js.substr(sh, sh_end - sh);
+    const char* c = nums.c_str();
+    while (*c != '\0') {
+      long long v = strtoll(c, const_cast<char**>(&c), 10);
+      f.shape.push_back(v);
+      while (*c == ',' || *c == ' ') ++c;
+    }
+    size_t dt = js.find("\"dtype\": \"", pos);
+    if (dt != std::string::npos) {
+      dt += 10;
+      f.dtype = js.substr(dt, js.find('"', dt) - dt);
+    }
+    feeds.push_back(f);
+    pos = sh_end;
+  }
+  return feeds;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_dir> [python_exe]\n", argv[0]);
+    return 2;
+  }
+  if (pd_init(argc > 2 ? argv[2] : nullptr) != 0) {
+    fprintf(stderr, "init failed: %s\n", pd_last_error());
+    return 1;
+  }
+  pd_predictor* p = pd_predictor_create(argv[1], "cpu");
+  if (p == nullptr) {
+    fprintf(stderr, "create failed: %s\n", pd_last_error());
+    return 1;
+  }
+  char* js = pd_predictor_io_json(p);
+  if (js == nullptr) {
+    fprintf(stderr, "io_json failed: %s\n", pd_last_error());
+    return 1;
+  }
+  std::vector<FeedInfo> feeds = parse_feeds(js);
+  pd_free(js);
+
+  const int64_t batch = 4;
+  std::vector<pd_tensor> ins;
+  std::vector<std::vector<float>> buffers;
+  std::vector<std::vector<int64_t>> shapes;
+  buffers.reserve(feeds.size());
+  shapes.reserve(feeds.size());
+  for (const FeedInfo& f : feeds) {
+    if (f.dtype != "float32") {
+      fprintf(stderr, "demo feeds float32 models only (got %s for %s)\n",
+              f.dtype.c_str(), f.name.c_str());
+      return 1;
+    }
+    std::vector<int64_t> shape = f.shape;
+    int64_t numel = 1;
+    for (size_t d = 0; d < shape.size(); ++d) {
+      if (shape[d] < 0) shape[d] = batch;
+      numel *= shape[d];
+    }
+    buffers.emplace_back(static_cast<size_t>(numel));
+    std::vector<float>& buf = buffers.back();
+    for (int64_t i = 0; i < numel; ++i) {
+      buf[static_cast<size_t>(i)] =
+          static_cast<float>(i % 17) / 17.0f - 0.5f;
+    }
+    shapes.push_back(shape);
+    pd_tensor t;
+    memset(&t, 0, sizeof(t));
+    t.name = const_cast<char*>(f.name.c_str());
+    t.dtype = PD_FLOAT32;
+    t.shape = shapes.back().data();
+    t.rank = static_cast<int32_t>(shapes.back().size());
+    t.data = buf.data();
+    t.data_size = numel * static_cast<int64_t>(sizeof(float));
+    ins.push_back(t);
+  }
+
+  pd_tensor* outs = nullptr;
+  int32_t n_out = 0;
+  if (pd_predictor_run(p, ins.data(), static_cast<int32_t>(ins.size()),
+                       &outs, &n_out) != 0) {
+    fprintf(stderr, "run failed: %s\n", pd_last_error());
+    return 1;
+  }
+  for (int32_t i = 0; i < n_out; ++i) {
+    printf("output %s shape=[", outs[i].name);
+    int64_t numel = 1;
+    for (int32_t d = 0; d < outs[i].rank; ++d) {
+      printf("%s%lld", d ? "," : "",
+             static_cast<long long>(outs[i].shape[d]));
+      numel *= outs[i].shape[d];
+    }
+    printf("] first=");
+    const float* vals = static_cast<const float*>(outs[i].data);
+    for (int64_t j = 0; j < (numel < 5 ? numel : 5); ++j) {
+      printf("%s%.4f", j ? "," : "", vals[j]);
+    }
+    printf("\n");
+    pd_tensor_release(&outs[i]);
+  }
+  pd_free(outs);
+  pd_predictor_destroy(p);
+  printf("OK\n");
+  return 0;
+}

@@ -1,0 +1,334 @@
+/* C ABI implementation: embeds CPython and drives paddle_tpu.capi._host.
+ *
+ * Design (see paddle_capi.h): the only Python surface touched is the
+ * flat functions of _host.py with (name, dtype, shape, bytes) tensor
+ * quads, so this file is pure CPython-API marshalling — no numpy
+ * headers, no pybind11 (not available in this image; the CPython API
+ * is the binding layer, like recordio uses a C ABI + ctypes).
+ *
+ * GIL protocol: pd_init releases the GIL after bootstrapping; every ABI
+ * call brackets itself with PyGILState_Ensure/Release, which also makes
+ * the library safe to load into an already-running Python process
+ * (tests drive it via ctypes that way).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "paddle_capi.h"
+
+namespace {
+
+thread_local std::string g_last_error;
+PyObject* g_host = nullptr;        /* paddle_tpu.capi._host */
+PyThreadState* g_main_ts = nullptr;
+bool g_we_initialized = false;
+
+void set_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject* host() {
+  if (g_host == nullptr) {
+    g_host = PyImport_ImportModule("paddle_tpu.capi._host");
+    if (g_host == nullptr) set_error_from_python();
+  }
+  return g_host;
+}
+
+const char* dtype_name(pd_dtype d) {
+  switch (d) {
+    case PD_FLOAT32: return "float32";
+    case PD_FLOAT64: return "float64";
+    case PD_INT32: return "int32";
+    case PD_INT64: return "int64";
+  }
+  return "float32";
+}
+
+int dtype_enum(const std::string& s, pd_dtype* out) {
+  if (s == "float32") { *out = PD_FLOAT32; return 0; }
+  if (s == "float64") { *out = PD_FLOAT64; return 0; }
+  if (s == "int32") { *out = PD_INT32; return 0; }
+  if (s == "int64") { *out = PD_INT64; return 0; }
+  return -1;
+}
+
+/* pd_tensor[] -> list[(name, dtype, shape, bytes)] */
+PyObject* tensors_to_py(const pd_tensor* ins, int32_t n) {
+  PyObject* list = PyList_New(n);
+  if (list == nullptr) return nullptr;
+  for (int32_t i = 0; i < n; ++i) {
+    const pd_tensor& t = ins[i];
+    PyObject* shape = PyTuple_New(t.rank);
+    for (int32_t d = 0; d < t.rank; ++d) {
+      PyTuple_SET_ITEM(shape, d, PyLong_FromLongLong(t.shape[d]));
+    }
+    PyObject* quad = Py_BuildValue(
+        "(s s N y#)", t.name, dtype_name(t.dtype), shape,
+        static_cast<const char*>(t.data),
+        static_cast<Py_ssize_t>(t.data_size));
+    if (quad == nullptr) {
+      Py_DECREF(list);
+      return nullptr;
+    }
+    PyList_SET_ITEM(list, i, quad);
+  }
+  return list;
+}
+
+/* list[(name, dtype, shape, bytes)] -> malloc'd pd_tensor[] */
+int tensors_from_py(PyObject* list, pd_tensor** outs, int32_t* n_out) {
+  if (!PyList_Check(list)) {
+    g_last_error = "host returned non-list";
+    return -1;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(list);
+  pd_tensor* arr =
+      static_cast<pd_tensor*>(calloc(static_cast<size_t>(n > 0 ? n : 1),
+                                     sizeof(pd_tensor)));
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* quad = PyList_GET_ITEM(list, i);
+    const char* name = nullptr;
+    const char* dtype = nullptr;
+    PyObject* shape = nullptr;
+    const char* data = nullptr;
+    Py_ssize_t data_len = 0;
+    if (!PyArg_ParseTuple(quad, "ssOy#", &name, &dtype, &shape, &data,
+                          &data_len)) {
+      set_error_from_python();
+      for (Py_ssize_t j = 0; j < i; ++j) pd_tensor_release(&arr[j]);
+      free(arr);
+      return -1;
+    }
+    pd_tensor& t = arr[i];
+    t.name = strdup(name);
+    if (dtype_enum(dtype, &t.dtype) != 0) {
+      g_last_error = std::string("unsupported output dtype ") + dtype;
+      for (Py_ssize_t j = 0; j <= i; ++j) pd_tensor_release(&arr[j]);
+      free(arr);
+      return -1;
+    }
+    t.rank = static_cast<int32_t>(PyTuple_GET_SIZE(shape));
+    t.shape = static_cast<int64_t*>(
+        malloc(sizeof(int64_t) * static_cast<size_t>(t.rank)));
+    for (int32_t d = 0; d < t.rank; ++d) {
+      t.shape[d] = PyLong_AsLongLong(PyTuple_GET_ITEM(shape, d));
+    }
+    t.data_size = static_cast<int64_t>(data_len);
+    t.data = malloc(static_cast<size_t>(data_len));
+    memcpy(t.data, data, static_cast<size_t>(data_len));
+  }
+  *outs = arr;
+  *n_out = static_cast<int32_t>(n);
+  return 0;
+}
+
+/* call host fn; returns new ref or nullptr with error set */
+PyObject* call_host(const char* fn, PyObject* args) {
+  PyObject* mod = host();
+  if (mod == nullptr) {
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* f = PyObject_GetAttrString(mod, fn);
+  if (f == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (r == nullptr) set_error_from_python();
+  return r;
+}
+
+int64_t handle_of(void* p) {
+  return static_cast<int64_t>(reinterpret_cast<intptr_t>(p));
+}
+
+}  // namespace
+
+extern "C" {
+
+int pd_init(const char* python_exe) {
+  if (Py_IsInitialized()) return 0; /* loaded into a live process */
+  if (g_we_initialized) return 0;
+
+  const char* exe = python_exe;
+  if (exe == nullptr || exe[0] == '\0') exe = getenv("PD_PYTHON");
+  if (exe == nullptr || exe[0] == '\0') exe = "python3";
+
+  PyConfig config;
+  PyConfig_InitPythonConfig(&config);
+  /* pointing program_name at the venv python makes site resolve the
+   * venv via pyvenv.cfg, exactly like launching that interpreter */
+  PyStatus st = PyConfig_SetBytesString(&config, &config.program_name, exe);
+  if (PyStatus_Exception(st)) {
+    g_last_error = "PyConfig program_name failed";
+    PyConfig_Clear(&config);
+    return -1;
+  }
+  st = Py_InitializeFromConfig(&config);
+  PyConfig_Clear(&config);
+  if (PyStatus_Exception(st)) {
+    g_last_error = "Py_InitializeFromConfig failed";
+    return -1;
+  }
+  g_we_initialized = true;
+  /* release the GIL so every ABI call can take it uniformly */
+  g_main_ts = PyEval_SaveThread();
+  return 0;
+}
+
+const char* pd_last_error(void) { return g_last_error.c_str(); }
+
+/* ---- predictor ---- */
+
+pd_predictor* pd_predictor_create(const char* model_dir,
+                                  const char* device) {
+  Gil gil;
+  PyObject* r = call_host(
+      "predictor_create",
+      Py_BuildValue("(ss)", model_dir, device ? device : "cpu"));
+  if (r == nullptr) return nullptr;
+  long long h = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return reinterpret_cast<pd_predictor*>(static_cast<intptr_t>(h));
+}
+
+char* pd_predictor_io_json(pd_predictor* p) {
+  Gil gil;
+  PyObject* r = call_host("predictor_io_json",
+                          Py_BuildValue("(L)", handle_of(p)));
+  if (r == nullptr) return nullptr;
+  const char* s = PyUnicode_AsUTF8(r);
+  char* out = s ? strdup(s) : nullptr;
+  Py_DECREF(r);
+  return out;
+}
+
+int pd_predictor_run(pd_predictor* p, const pd_tensor* ins, int32_t n_in,
+                     pd_tensor** outs, int32_t* n_out) {
+  Gil gil;
+  PyObject* feeds = tensors_to_py(ins, n_in);
+  if (feeds == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = call_host("predictor_run",
+                          Py_BuildValue("(LN)", handle_of(p), feeds));
+  if (r == nullptr) return -1;
+  int rc = tensors_from_py(r, outs, n_out);
+  Py_DECREF(r);
+  return rc;
+}
+
+void pd_predictor_destroy(pd_predictor* p) {
+  if (p == nullptr || !Py_IsInitialized()) return;
+  Gil gil;
+  PyObject* r =
+      call_host("predictor_destroy", Py_BuildValue("(L)", handle_of(p)));
+  Py_XDECREF(r);
+}
+
+/* ---- trainer ---- */
+
+pd_trainer* pd_trainer_create(const char* model_dir,
+                              const char* params_dir,
+                              const char* device) {
+  Gil gil;
+  PyObject* r = call_host(
+      "trainer_create",
+      Py_BuildValue("(sss)", model_dir, params_dir ? params_dir : "",
+                    device ? device : "cpu"));
+  if (r == nullptr) return nullptr;
+  long long h = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return reinterpret_cast<pd_trainer*>(static_cast<intptr_t>(h));
+}
+
+int pd_trainer_step(pd_trainer* t, const pd_tensor* ins, int32_t n_in,
+                    double* loss) {
+  Gil gil;
+  PyObject* feeds = tensors_to_py(ins, n_in);
+  if (feeds == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = call_host("trainer_step",
+                          Py_BuildValue("(LN)", handle_of(t), feeds));
+  if (r == nullptr) return -1;
+  *loss = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int pd_trainer_step_synth(pd_trainer* t, int32_t batch_size,
+                          double* loss) {
+  Gil gil;
+  PyObject* r = call_host(
+      "trainer_step_synth",
+      Py_BuildValue("(Li)", handle_of(t), static_cast<int>(batch_size)));
+  if (r == nullptr) return -1;
+  *loss = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int pd_trainer_save(pd_trainer* t, const char* dirname) {
+  Gil gil;
+  PyObject* r = call_host("trainer_save",
+                          Py_BuildValue("(Ls)", handle_of(t), dirname));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+void pd_trainer_destroy(pd_trainer* t) {
+  if (t == nullptr || !Py_IsInitialized()) return;
+  Gil gil;
+  PyObject* r =
+      call_host("trainer_destroy", Py_BuildValue("(L)", handle_of(t)));
+  Py_XDECREF(r);
+}
+
+/* ---- memory ---- */
+
+void pd_tensor_release(pd_tensor* t) {
+  if (t == nullptr) return;
+  free(t->name);
+  free(t->shape);
+  free(t->data);
+  t->name = nullptr;
+  t->shape = nullptr;
+  t->data = nullptr;
+}
+
+void pd_free(void* p) { free(p); }
+
+}  /* extern "C" */

@@ -1,0 +1,111 @@
+/* paddle_tpu C ABI — native deployment + train-from-saved-program.
+ *
+ * Parity: reference paddle/capi/capi.h (C inference ABI for
+ * embedded/mobile deployment) and paddle/fluid/train/demo/
+ * demo_trainer.cc:1 (train from serialized ProgramDescs with no Python
+ * graph build).  TPU-first redesign: the engine behind this ABI is the
+ * jit-compiling Executor; the library embeds a CPython runtime the way
+ * the reference's PyDataProvider2 embedded one inside the C++ trainer
+ * — the native surface is real, the compute path is XLA.
+ *
+ * Thread-safety: calls may come from any thread; the implementation
+ * takes the GIL per call.  When loaded INTO an existing Python process
+ * (e.g. via ctypes for testing) pd_init detects the live interpreter
+ * and becomes a no-op.
+ */
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum {
+  PD_FLOAT32 = 0,
+  PD_FLOAT64 = 1,
+  PD_INT32 = 2,
+  PD_INT64 = 3,
+} pd_dtype;
+
+/* Row-major dense tensor crossing the ABI.  For inputs, all pointers are
+ * caller-owned.  For outputs, the library allocates name/shape/data;
+ * release with pd_tensor_release. */
+typedef struct {
+  char* name;
+  pd_dtype dtype;
+  int64_t* shape;
+  int32_t rank;
+  void* data;
+  int64_t data_size; /* bytes */
+} pd_tensor;
+
+/* Start the embedded runtime.  python_exe: path of the (venv) python
+ * whose site-packages hold paddle_tpu, e.g. "/opt/venv/bin/python3";
+ * NULL uses the PD_PYTHON env var, else "python3".  Returns 0 on
+ * success.  No-op (returns 0) inside a live Python process. */
+int pd_init(const char* python_exe);
+
+/* Last error message of this thread's most recent failed call. */
+const char* pd_last_error(void);
+
+/* ---- inference (reference capi gradient-machine ABI) ---- */
+
+typedef struct pd_predictor pd_predictor;
+
+/* model_dir: directory written by fluid io.save_inference_model.
+ * device: "cpu" or "tpu".  NULL on failure (see pd_last_error). */
+pd_predictor* pd_predictor_create(const char* model_dir,
+                                  const char* device);
+
+/* malloc'd JSON {"feeds":[{name,shape,dtype,lod_level}...],
+ * "fetches":[...]}; caller frees with pd_free. */
+char* pd_predictor_io_json(pd_predictor* p);
+
+/* Run inference: n_out gets the number of outputs written to *outs
+ * (library-allocated array; release each tensor with
+ * pd_tensor_release then the array with pd_free).  Returns 0 on
+ * success. */
+int pd_predictor_run(pd_predictor* p, const pd_tensor* ins, int32_t n_in,
+                     pd_tensor** outs, int32_t* n_out);
+
+void pd_predictor_destroy(pd_predictor* p);
+
+/* ---- trainer (reference train/demo/demo_trainer.cc capability) ---- */
+
+typedef struct pd_trainer pd_trainer;
+
+/* model_dir: directory written by io.save_train_program (full forward+
+ * backward+optimizer program).  params_dir: restore persistables from a
+ * save_persistables dir instead of running the startup program; may be
+ * NULL/"".  device: "cpu" or "tpu". */
+pd_trainer* pd_trainer_create(const char* model_dir,
+                              const char* params_dir,
+                              const char* device);
+
+/* One training step on caller-provided feeds; *loss gets the fetched
+ * loss scalar.  Returns 0 on success. */
+int pd_trainer_step(pd_trainer* t, const pd_tensor* ins, int32_t n_in,
+                    double* loss);
+
+/* One training step on synthesized feeds derived from the program's
+ * data vars (the demo path; reference demo_trainer fabricates its
+ * input the same way). */
+int pd_trainer_step_synth(pd_trainer* t, int32_t batch_size,
+                          double* loss);
+
+/* Save persistables (params + optimizer state) to dirname. */
+int pd_trainer_save(pd_trainer* t, const char* dirname);
+
+void pd_trainer_destroy(pd_trainer* t);
+
+/* ---- memory ---- */
+
+void pd_tensor_release(pd_tensor* t); /* frees members, not t itself */
+void pd_free(void* p);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_CAPI_H */

@@ -31,13 +31,16 @@ from ..scope import global_scope
 
 __all__ = ["Bfloat16Transpiler", "Float16Transpiler"]
 
-# ops whose inputs must stay fp32 (subset of the AMP black list that can
-# appear in inference programs)
-_FP32_OPS = {
-    "softmax", "log_softmax", "exp", "log", "norm", "lrn", "group_norm",
-    "reduce_sum", "reduce_mean", "mean", "cross_entropy",
-    "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
-}
+# ops whose inputs must stay fp32: the AMP black list minus optimizer
+# updates (which never appear in inference programs) — derived, so new
+# sensitive ops added there are guarded here automatically
+def _fp32_ops():
+    from .mixed_precision import AutoMixedPrecisionLists
+
+    opt = {"sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
+           "rmsprop", "ftrl", "decayed_adagrad", "proximal_gd",
+           "proximal_adagrad"}
+    return set(AutoMixedPrecisionLists.BLACK) - opt
 
 _SKIP_RENAME = {"cast", "feed", "fetch"}
 
@@ -81,6 +84,11 @@ class Bfloat16Transpiler:
     # -- 1. parameters ------------------------------------------------------
 
     def _convert_params(self, block, scope):
+        """Scope cast delegates to AMP's cast_parameters_to_bf16; this
+        pass then retypes the program vars to match."""
+        from .mixed_precision import cast_parameters_to_bf16
+
+        cast_parameters_to_bf16(block.program, scope)
         bf16 = core.convert_dtype("bfloat16")
         for var in list(block.vars.values()):
             if not getattr(var, "persistable", False):
@@ -90,17 +98,21 @@ class Bfloat16Transpiler:
             val = scope.find_var(var.name)
             if val is None:
                 continue
-            import jax.numpy as jnp
-
-            scope.set_var(var.name, jnp.asarray(val).astype(jnp.bfloat16))
             var.dtype = bf16
 
     # -- 2. feed boundary ---------------------------------------------------
 
     def _cast_feeds(self, block):
+        # only data vars some op actually consumes: prune_feed_fetch
+        # keeps orphan feed vars in the block, and casting one would
+        # turn an optional input into a required one
+        consumed = set()
+        for op in block.ops:
+            consumed.update(op.input_arg_names)
         idx = 0
         for var in list(block.vars.values()):
-            if not getattr(var, "is_data", False):
+            if not getattr(var, "is_data", False) or \
+                    var.name not in consumed:
                 continue
             if core.convert_dtype(var.dtype) != np.dtype(np.float32):
                 continue  # ids/labels stay integer
@@ -132,10 +144,11 @@ class Bfloat16Transpiler:
         """Insert bf16->fp32 casts before black-listed ops and retype
         their outputs fp32; the next bf16 consumer simply computes in
         fp32 inputs' promoted dtype, matching AMP's black-list rule."""
+        fp32_ops = _fp32_ops()
         i = 0
         while i < len(block.ops):
             op = block.ops[i]
-            if op.type in _FP32_OPS:
+            if op.type in fp32_ops:
                 for slot, names in list(op.inputs.items()):
                     new_names = []
                     for n in names:

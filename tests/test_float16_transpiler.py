@@ -69,6 +69,32 @@ def test_bf16_transpile_matches_fp32(tmp_path):
         assert np.array_equal(np.argmax(out, 1), np.argmax(np.asarray(ref), 1))
 
 
+def test_bf16_orphan_feed_var_not_required(tmp_path):
+    """A feed var the pruned program keeps but no op consumes must not
+    gain a cast op (it would turn an optional input into a required
+    one)."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[4])
+        fluid.layers.data("aux", shape=[4])  # never consumed
+        pred = fluid.layers.fc(x, size=2, act="softmax")
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            fluid.io.save_inference_model(
+                str(tmp_path / "m2"), ["x", "aux"], [pred], exe)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        prog, _, fetch_vars = fluid.io.load_inference_model(
+            str(tmp_path / "m2"), exe)
+        Bfloat16Transpiler().transpile(
+            prog, fluid.CPUPlace(), scope=scope, fetch_targets=fetch_vars)
+        out, = exe.run(prog, feed={"x": np.zeros((3, 4), "float32")},
+                       fetch_list=[fetch_vars[0].name])
+        assert np.asarray(out).shape == (3, 2)
+
+
 def test_bf16_fp32_islands_and_alias(tmp_path):
     """softmax (AMP black list) keeps fp32 inputs via inserted casts;
     Float16Transpiler is the reference-named alias."""

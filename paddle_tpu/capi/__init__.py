@@ -45,8 +45,9 @@ def _python_link_flags():
 
 def build_lib(force=False):
     """Compile the shared library; returns its path."""
+    src_mtime = max(os.path.getmtime(_SRC), os.path.getmtime(_HDR))
     if not force and os.path.exists(_LIB_PATH) and \
-            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC):
+            os.path.getmtime(_LIB_PATH) >= src_mtime:
         return _LIB_PATH
     cflags, ldflags = _python_link_flags()
     fd, tmp = tempfile.mkstemp(dir=_HERE, prefix="_libcapi_", suffix=".so")

@@ -37,10 +37,17 @@ __all__ = ["Bfloat16Transpiler", "Float16Transpiler"]
 def _fp32_ops():
     from .mixed_precision import AutoMixedPrecisionLists
 
-    opt = {"sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
-           "rmsprop", "ftrl", "decayed_adagrad", "proximal_gd",
-           "proximal_adagrad"}
-    return set(AutoMixedPrecisionLists.BLACK) - opt
+    # optimizer updates and gradient-infrastructure ops never appear in
+    # (or must not widen) inference programs: `sum` is residual adds
+    # here, not grad accumulation, and clip/norm/isfinite guards are
+    # training machinery
+    train_only = {
+        "sgd", "momentum", "adam", "adamax", "adagrad", "adadelta",
+        "rmsprop", "ftrl", "decayed_adagrad", "proximal_gd",
+        "proximal_adagrad", "sum", "clip_by_norm", "squared_l2_norm",
+        "isfinite",
+    }
+    return set(AutoMixedPrecisionLists.BLACK) - train_only
 
 _SKIP_RENAME = {"cast", "feed", "fetch"}
 

@@ -33,13 +33,7 @@ exact two-pass variance.
 from ..framework import Operator
 from ..registry import infer_op, int_list
 
-__all__ = ["fuse_conv_bn", "apply_pass"]
-
-
-def apply_pass(program, pass_fn, *args, **kwargs):
-    """Run a pass function over ``program``; returns the pass's result.
-    (The hook point for registering further program-rewrite passes.)"""
-    return pass_fn(program, *args, **kwargs)
+__all__ = ["fuse_conv_bn"]
 
 
 def _is_conv1x1_s1(op, block):

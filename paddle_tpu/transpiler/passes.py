@@ -1,0 +1,189 @@
+"""Pass registry + builder — the reference's ir pass infrastructure.
+
+Parity: ``paddle/fluid/framework/ir/pass.h`` (REGISTER_PASS + Pass::
+Apply), ``pass_builder.cc`` (ordered pass pipelines selected by
+BuildStrategy), and ``graph_pattern_detector.cc`` (subgraph matching
+that fusion passes build on).
+
+TPU-first redesign: a pass is a function over the *Program* (the single
+IR of this stack — there is no separate ir::Graph because XLA owns the
+post-lowering graph), registered by name so strategy objects and user
+code can compose pipelines declaratively::
+
+    from paddle_tpu.transpiler import PassBuilder
+    pb = PassBuilder()
+    pb.append_pass("fuse_conv_bn")
+    pb.append_pass("graph_viz", path="/tmp/g.dot")
+    pb.apply(program)
+
+Passes mutate in place and return a pass-specific result (match count,
+cloned program, dot text...).  ``find_chain`` is the pattern-matching
+helper new fusion passes build on (the GraphPatternDetector analog for
+straight-line producer->consumer chains, which is what every shipped
+reference fusion pass matches).
+"""
+
+__all__ = ["register_pass", "get_pass", "list_passes", "apply_pass",
+           "PassBuilder", "find_chain"]
+
+_PASSES = {}
+
+
+def register_pass(name, fn=None, doc=None):
+    """Register ``fn`` as a program pass (decorator when fn is None).
+    Reference REGISTER_PASS(name, class)."""
+    def deco(f):
+        if name in _PASSES:
+            raise KeyError("pass %r already registered" % name)
+        _PASSES[name] = f
+        return f
+
+    if fn is not None:
+        if doc:
+            fn.__doc__ = doc
+        return deco(fn)
+    return deco
+
+
+def get_pass(name):
+    if name not in _PASSES:
+        raise KeyError("unknown pass %r (registered: %s)"
+                       % (name, sorted(_PASSES)))
+    return _PASSES[name]
+
+
+def list_passes():
+    return sorted(_PASSES)
+
+
+def apply_pass(program, pass_or_fn, *args, **kwargs):
+    """Run one pass (by registered name or as a raw function) over
+    ``program``; returns the pass's result."""
+    fn = get_pass(pass_or_fn) if isinstance(pass_or_fn, str) \
+        else pass_or_fn
+    return fn(program, *args, **kwargs)
+
+
+class PassBuilder:
+    """Ordered pass pipeline (reference pass_builder.cc: AppendPass/
+    InsertPass/RemovePass then apply in order)."""
+
+    def __init__(self):
+        self._pipeline = []   # (name, kwargs)
+
+    def append_pass(self, name, **kwargs):
+        get_pass(name)  # fail fast on unknown names
+        self._pipeline.append((name, kwargs))
+        return self
+
+    def insert_pass(self, idx, name, **kwargs):
+        get_pass(name)
+        self._pipeline.insert(idx, (name, kwargs))
+        return self
+
+    def remove_pass(self, idx):
+        self._pipeline.pop(idx)
+        return self
+
+    def all_passes(self):
+        return [n for n, _ in self._pipeline]
+
+    def apply(self, program):
+        """Apply the pipeline in order; returns {pass_name: result}
+        (last invocation wins for a repeated pass; the full ordered
+        [(name, result)] history is under "__history__").  A pass
+        returning a new Program (e.g. inference_optimize) feeds that
+        program to the passes after it; the final program is under
+        "__program__"."""
+        from ..framework import Program
+
+        results = {}
+        history = []
+        current = program
+        for name, kwargs in self._pipeline:
+            r = apply_pass(current, name, **kwargs)
+            results[name] = r
+            history.append((name, r))
+            if isinstance(r, Program):
+                current = r
+        results["__program__"] = current
+        results["__history__"] = history
+        return results
+
+
+def find_chain(block, op_types):
+    """Match straight-line chains ``op_types[0] -> ... -> op_types[-1]``
+    where each op's first output feeds the next op's first data input
+    and has no other consumer (the fusion-safety condition every
+    reference fuse pass checks).  Returns a list of op-index tuples.
+
+    The GraphPatternDetector analog for the chain shapes the shipped
+    reference passes match (conv+bn, fc+act, seqconv+pool...).
+    """
+    ops = block.ops
+    consumers = {}
+    for i, op in enumerate(ops):
+        for n in op.input_arg_names:
+            if n:
+                consumers.setdefault(n, []).append(i)
+
+    def out0(i):
+        for names in ops[i].outputs.values():
+            if names:
+                return names[0]
+        return None
+
+    chains = []
+    for start, op in enumerate(ops):
+        if op.type != op_types[0]:
+            continue
+        chain = [start]
+        ok = True
+        for want in op_types[1:]:
+            prev = chain[-1]
+            o = out0(prev)
+            use = consumers.get(o, [])
+            # sole consumer, of the wanted type, fed through an input
+            if o is None or len(use) != 1 or ops[use[0]].type != want:
+                ok = False
+                break
+            chain.append(use[0])
+        if ok:
+            chains.append(tuple(chain))
+    return chains
+
+
+# ---- built-in registrations ------------------------------------------------
+
+def _register_builtins():
+    from ..debugger import draw_block_graphviz
+    from .fusion import fuse_conv_bn
+    from .inference_transpiler import InferenceTranspiler
+    from .memory_optimization_transpiler import memory_optimize
+
+    register_pass("fuse_conv_bn", fuse_conv_bn)
+    register_pass("memory_optimize", memory_optimize)
+
+    @register_pass("inference_optimize")
+    def _inference_optimize(program, place=None, scope=None):
+        """clone(for_test) + frozen-BN folding; returns the NEW
+        program (InferenceTranspiler as a pass)."""
+        return InferenceTranspiler().transpile(program, place, scope)
+
+    @register_pass("bfloat16")
+    def _bfloat16(program, place=None, scope=None, fetch_targets=None):
+        """contrib.float16's bf16 inference rewrite as a pass."""
+        from ..contrib.float16 import Bfloat16Transpiler
+
+        return Bfloat16Transpiler().transpile(
+            program, place, scope=scope, fetch_targets=fetch_targets)
+
+    @register_pass("graph_viz")
+    def _graph_viz(program, path="./temp.dot", render=False):
+        """Dump the program graph as graphviz dot (reference
+        ir/graph_viz_pass.cc); returns the written path."""
+        return draw_block_graphviz(program.global_block(), path=path,
+                                   render=render)
+
+
+_register_builtins()

@@ -1,0 +1,99 @@
+"""Pass registry / PassBuilder / chain matcher (reference
+framework/ir/pass.h REGISTER_PASS, pass_builder.cc, and
+graph_pattern_detector.cc): named program-rewrite passes composed into
+ordered pipelines."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import (PassBuilder, apply_pass, find_chain,
+                                   get_pass, list_passes, register_pass)
+
+
+def _conv_bn_program():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8])
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        b = fluid.layers.batch_norm(c, act="relu", is_test=True,
+                                    use_global_stats=True)
+        pred = fluid.layers.fc(b, size=2, act="softmax")
+    return main, startup, pred
+
+
+def test_registry_and_builtins():
+    names = list_passes()
+    for expected in ("fuse_conv_bn", "inference_optimize", "bfloat16",
+                     "graph_viz", "memory_optimize"):
+        assert expected in names, names
+    assert callable(get_pass("fuse_conv_bn"))
+    with pytest.raises(KeyError):
+        get_pass("no_such_pass")
+    with pytest.raises(KeyError):
+        register_pass("fuse_conv_bn", lambda p: p)  # duplicate
+
+
+def test_find_chain_matches_conv_bn():
+    main, _, _ = _conv_bn_program()
+    blk = main.global_block()
+    chains = find_chain(blk, ["conv2d", "batch_norm"])
+    assert len(chains) == 1
+    i, j = chains[0]
+    assert blk.ops[i].type == "conv2d" and blk.ops[j].type == "batch_norm"
+    # a chain whose head output has >1 consumer must NOT match
+    assert find_chain(blk, ["batch_norm", "conv2d"]) == []
+
+
+def test_custom_pass_and_builder_pipeline(tmp_path):
+    calls = []
+
+    @register_pass("count_ops_test")
+    def _count(program, tag=""):
+        calls.append(tag)
+        return len(program.global_block().ops)
+
+    try:
+        main, startup, pred = _conv_bn_program()
+        n = apply_pass(main, "count_ops_test", tag="direct")
+        assert n == len(main.global_block().ops)
+
+        pb = (PassBuilder()
+              .append_pass("count_ops_test", tag="in_pipeline")
+              .append_pass("graph_viz", path=str(tmp_path / "g.dot")))
+        assert pb.all_passes() == ["count_ops_test", "graph_viz"]
+        results = pb.apply(main)
+        assert results["count_ops_test"] == n
+        assert (tmp_path / "g.dot").exists()
+        assert calls == ["direct", "in_pipeline"]
+    finally:
+        from paddle_tpu.transpiler import passes as _p
+
+        _p._PASSES.pop("count_ops_test", None)
+
+
+def test_pipeline_program_chaining():
+    """A pass returning a new Program (inference_optimize) feeds it to
+    later passes: the graph_viz dot of the result has no train-only
+    state."""
+    rng = np.random.RandomState(0)
+    main, startup, pred = _conv_bn_program()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pb = (PassBuilder()
+              .append_pass("inference_optimize", scope=scope)
+              .append_pass("memory_optimize"))
+        results = pb.apply(main)
+        optimized = results["__program__"]
+        assert optimized is not main
+        # folded program still runs and matches the original forward
+        x = rng.rand(2, 3, 8, 8).astype("float32")
+        ref, = exe.run(main.clone(for_test=True), feed={"img": x},
+                       fetch_list=[pred.name])
+        out, = exe.run(optimized, feed={"img": x},
+                       fetch_list=[pred.name])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)

@@ -54,6 +54,10 @@ from .transpiler import (
 from . import cloud
 from . import inference
 from . import debugger
+from . import average
+from . import lod_tensor
+from . import net_drawer
+from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
 from . import recordio
 from . import recordio_writer
 from .flags import set_flags, get_flags
@@ -73,4 +77,6 @@ __all__ = [
     "DistributeTranspilerConfig", "InferenceTranspiler",
     "memory_optimize", "release_memory", "cloud", "set_flags", "get_flags",
     "recordio", "recordio_writer", "inference", "debugger",
+    "average", "lod_tensor", "net_drawer", "create_lod_tensor",
+    "create_random_int_lodtensor",
 ]

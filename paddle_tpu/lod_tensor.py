@@ -1,0 +1,117 @@
+"""LoD tensor construction helpers (reference
+python/paddle/fluid/lod_tensor.py:23,92).
+
+TPU-native redesign: there is no LoDTensor runtime type — variable-
+length sequences are padded ``[batch, time, ...]`` arrays plus an
+``@LEN`` companion vector (see layers/io.py data).  ``create_lod_tensor``
+therefore returns a ``PaddedSequence`` view holding exactly those two
+arrays, and ``as_feed(name)`` yields the feed-dict entries executors
+expect.  One nesting level is supported: the padded+@LEN design
+flattens the reference's recursive LoD by construction (SURVEY §5
+long-context ruling); deeper nesting raises with that citation.
+"""
+
+import numpy as np
+
+__all__ = ["PaddedSequence", "create_lod_tensor",
+           "create_random_int_lodtensor"]
+
+
+class PaddedSequence(object):
+    """What create_lod_tensor returns: the padded batch + lengths."""
+
+    def __init__(self, data, seq_lens):
+        self.data = data
+        self.seq_lens = np.asarray(seq_lens, dtype="int32")
+
+    def recursive_sequence_lengths(self):
+        """Length-based LoD, reference LoDTensor API."""
+        return [list(int(l) for l in self.seq_lens)]
+
+    def has_valid_recursive_sequence_lengths(self):
+        return bool(np.all(self.seq_lens >= 0) and
+                    self.data.shape[1] >= int(self.seq_lens.max(initial=0)))
+
+    def shape(self):
+        return tuple(self.data.shape)
+
+    def as_feed(self, name):
+        """Feed-dict entries for a data var declared with lod_level=1."""
+        return {name: self.data, name + "@LEN": self.seq_lens}
+
+    def __array__(self, dtype=None):
+        a = self.data
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _check_lod(recursive_seq_lens):
+    if (not isinstance(recursive_seq_lens, (list, tuple)) or
+            len(recursive_seq_lens) == 0):
+        raise ValueError("recursive_seq_lens must be a non-empty list of "
+                         "lists, e.g. [[2, 3]]")
+    if len(recursive_seq_lens) > 1:
+        raise NotImplementedError(
+            "multi-level LoD is flattened by the padded+@LEN design "
+            "(SURVEY §5); pass one level of lengths")
+    return [int(l) for l in recursive_seq_lens[0]]
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Build a PaddedSequence from flat data + lengths (reference
+    lod_tensor.py:23).
+
+    ``data`` may be a list of per-sequence lists (word ids -> int64
+    [n, 1] as the reference does), a flat numpy array of shape
+    [sum(lens), ...], or an existing PaddedSequence (re-checked).
+    ``place`` is accepted for parity; arrays stay host-side until fed.
+    """
+    from .data_feeder import _SequenceConverter
+
+    lens = _check_lod(recursive_seq_lens)
+    if isinstance(data, PaddedSequence):
+        return create_lod_tensor(
+            _unpad(data), recursive_seq_lens, place)
+    if isinstance(data, list):
+        got = [len(seq) for seq in data]
+        if got != lens:
+            raise AssertionError(
+                "data and recursive_seq_lens do not match: %s vs %s"
+                % (got, lens))
+        # word-id lists -> int64 [n, 1], as the reference specializes
+        conv = _SequenceConverter(shape=(-1, -1, 1), dtype="int64")
+        for seq in data:
+            conv.feed(np.asarray(seq, dtype="int64").reshape(-1))
+        padded, got_lens = conv.done()
+        return PaddedSequence(padded, got_lens)
+    data = np.asarray(data)
+    if data.shape[0] != sum(lens):
+        raise AssertionError(
+            "data rows (%d) != sum of sequence lengths (%d)"
+            % (data.shape[0], sum(lens)))
+    # split the flat rows per sequence and reuse the DataFeeder padder
+    conv = _SequenceConverter(shape=None, dtype=data.dtype)
+    off = 0
+    for l in lens:
+        conv.feed(data[off:off + l])
+        off += l
+    padded, got_lens = conv.done()
+    return PaddedSequence(padded, got_lens)
+
+
+def _unpad(ps):
+    rows = []
+    for i, l in enumerate(ps.seq_lens):
+        rows.append(ps.data[i, :int(l)])
+    return np.concatenate(rows, axis=0) if rows else \
+        np.zeros((0,) + ps.data.shape[2:], ps.data.dtype)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """Random-integer sequence batch (reference lod_tensor.py:92): one
+    int64 row of shape ``base_shape`` per timestep, lengths as given."""
+    lens = _check_lod(recursive_seq_lens)
+    total = sum(lens)
+    shape = (total,) + tuple(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

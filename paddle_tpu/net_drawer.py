@@ -1,0 +1,56 @@
+"""Program graph drawing CLI/API (reference
+python/paddle/fluid/net_drawer.py:103 draw_graph — the user-facing
+graphviz tool next to debugger.py's lower-level dump)."""
+
+import argparse
+import json
+import logging
+
+from .debugger import draw_block_graphviz
+from .framework import default_main_program, default_startup_program
+
+__all__ = ["draw_graph"]
+
+logger = logging.getLogger(__name__)
+
+
+def draw_graph(startup_program=None, main_program=None, path="graph.dot",
+               startup_path=None, render=False, **kwargs):
+    """Write graphviz dot for the main (and optionally startup) program
+    (reference net_drawer.py:draw_graph, which emitted Graph objects via
+    the graphviz package; here the dot text is written directly and
+    optionally rendered when the ``dot`` binary exists)."""
+    if main_program is None:
+        main_program = default_main_program()
+    out = draw_block_graphviz(main_program.global_block(), path=path,
+                              render=render)
+    if startup_program is not None or startup_path:
+        if startup_program is None:
+            startup_program = default_startup_program()
+        if not startup_path:
+            startup_path = path + ".startup.dot"
+        draw_block_graphviz(startup_program.global_block(),
+                            path=startup_path, render=render)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser(description="draw a saved Program as dot")
+    p.add_argument("program", help="JSON ProgramDesc file "
+                   "(Program.to_json / save_train_program output)")
+    p.add_argument("--output", default="graph.dot")
+    p.add_argument("--render", action="store_true")
+    args = p.parse_args()
+    from .framework import Program
+
+    with open(args.program) as f:
+        payload = json.load(f)
+    d = payload.get("program") or payload.get("main") or payload
+    prog = Program.from_dict(d)
+    out = draw_graph(main_program=prog, path=args.output,
+                     render=args.render)
+    print(out)
+
+
+if __name__ == "__main__":
+    main()

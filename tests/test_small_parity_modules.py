@@ -1,0 +1,94 @@
+"""Small fluid-parity modules: lod_tensor helpers (reference
+lod_tensor.py:23,92 over the padded+@LEN design), average.py
+WeightedAverage, net_drawer.draw_graph."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_create_lod_tensor_from_lists_and_feed():
+    seqs = [[1, 2], [3, 4, 5]]
+    t = fluid.create_lod_tensor(seqs, [[2, 3]], fluid.CPUPlace())
+    assert t.shape() == (2, 3, 1)
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+    assert t.has_valid_recursive_sequence_lengths()
+    np.testing.assert_array_equal(t.data[0, :2, 0], [1, 2])
+    np.testing.assert_array_equal(t.data[1, :, 0], [3, 4, 5])
+    assert t.data[0, 2, 0] == 0  # padding
+
+    # feeds a lod_level=1 data var end-to-end
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        words = fluid.layers.data("words", shape=[1], dtype="int64",
+                                  lod_level=1)
+        emb = fluid.layers.embedding(words, size=[10, 4])
+        pooled = fluid.layers.sequence_pool(emb, "average")
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            out, = exe.run(feed=t.as_feed("words"),
+                           fetch_list=[pooled.name])
+    assert np.asarray(out).shape == (2, 4)
+
+    # mismatched lengths must raise, as the reference asserts
+    with pytest.raises(AssertionError):
+        fluid.create_lod_tensor(seqs, [[3, 2]], fluid.CPUPlace())
+
+
+def test_create_lod_tensor_from_flat_array_and_roundtrip():
+    flat = np.arange(10, dtype="float32").reshape(5, 2)
+    t = fluid.create_lod_tensor(flat, [[2, 3]], fluid.CPUPlace())
+    assert t.shape() == (2, 3, 2)
+    # re-wrapping an existing PaddedSequence round-trips
+    t2 = fluid.create_lod_tensor(t, [[2, 3]], fluid.CPUPlace())
+    np.testing.assert_array_equal(t.data, t2.data)
+    with pytest.raises(NotImplementedError):
+        fluid.create_lod_tensor(flat, [[1], [2, 3]], fluid.CPUPlace())
+
+
+def test_create_lod_tensor_empty_sequence():
+    """Zero-length sequences pad to all-zero rows, both input forms."""
+    t = fluid.create_lod_tensor([[1, 2], []], [[2, 0]], fluid.CPUPlace())
+    assert t.shape() == (2, 2, 1)
+    np.testing.assert_array_equal(t.seq_lens, [2, 0])
+    np.testing.assert_array_equal(t.data[1], np.zeros((2, 1)))
+    flat = np.arange(4, dtype="float32").reshape(2, 2)
+    t2 = fluid.create_lod_tensor(flat, [[2, 0]], fluid.CPUPlace())
+    np.testing.assert_array_equal(t2.seq_lens, [2, 0])
+
+
+def test_create_random_int_lodtensor():
+    t = fluid.create_random_int_lodtensor([[3, 1, 2]], [1],
+                                          fluid.CPUPlace(), 0, 7)
+    assert t.shape() == (3, 3, 1)
+    assert t.data.dtype == np.int64
+    assert t.data.min() >= 0 and t.data.max() <= 7
+    np.testing.assert_array_equal(t.seq_lens, [3, 1, 2])
+
+
+def test_weighted_average():
+    with pytest.warns(Warning):
+        avg = fluid.average.WeightedAverage()
+    avg.add(value=2.0, weight=1)
+    avg.add(value=4.0, weight=2)
+    assert abs(avg.eval() - 10.0 / 3) < 1e-9
+    avg.reset()
+    with pytest.raises(ValueError):
+        avg.eval()
+    with pytest.raises(ValueError):
+        avg.add(value="x", weight=1)
+
+
+def test_net_drawer(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        fluid.layers.fc(x, size=2)
+    out = fluid.net_drawer.draw_graph(
+        startup, main, path=str(tmp_path / "g.dot"),
+        startup_path=str(tmp_path / "s.dot"))
+    dot = open(out).read()
+    assert "digraph" in dot and "mul" in dot
+    assert (tmp_path / "s.dot").exists()

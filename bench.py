@@ -447,6 +447,48 @@ def bench_stacked_lstm(args, use_amp=False, per_step_feed=False):
                  "vs_baseline": 1.0}, **stats)
 
 
+def bench_machine_translation(args, use_amp=False, per_step_feed=False):
+    """RNN seq2seq with attention (fluid_benchmark
+    models/machine_translation.py config: bi-LSTM encoder, Bahdanau
+    attention decoder, 512-wide, 30k dicts).  Words/sec counts target
+    tokens; full-length sequences so the count is exact."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models.machine_translation import seq_to_seq_net
+
+    batch = args.batch_size or 64
+    seq = 30
+    dict_dim = 30000
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        src = fluid.layers.data("src", shape=[1], dtype="int64",
+                                lod_level=1)
+        tgt = fluid.layers.data("tgt", shape=[1], dtype="int64",
+                                lod_level=1)
+        lbl = fluid.layers.data("lbl", shape=[1], dtype="int64",
+                                lod_level=1)
+        loss, _ = seq_to_seq_net(src, tgt, lbl, dict_dim, dict_dim)
+        _maybe_amp(fluid.optimizer.Adam(learning_rate=1e-4),
+                   use_amp).minimize(loss)
+        rng = np.random.RandomState(0)
+
+        def feed_fn():
+            feed = {}
+            for name in ("src", "tgt", "lbl"):
+                feed[name] = rng.randint(
+                    1, dict_dim, (batch, seq, 1)).astype("int64")
+                feed[name + "@LEN"] = np.full((batch,), seq, "int32")
+            return feed
+
+        step_time, stats = _bench_program(
+            fluid.default_main_program(), fluid.default_startup_program(),
+            feed_fn, loss, _place(args), args.iterations,
+            args.skip_batch_num, per_step_feed)
+    wps = batch * seq / step_time
+    return dict({"metric": "machine_translation_words_per_sec" + _suffix(
+                     use_amp, per_step_feed),
+                 "value": round(wps, 2), "unit": "words/sec",
+                 "vs_baseline": 1.0}, **stats)
+
+
 def bench_transformer_realdist(args, use_amp=True):
     """Transformer tokens/sec on a REALISTIC (wmt16-like, skewed) length
     distribution: pad-to-max vs length-bucketed batching (VERDICT r3 #5).
@@ -693,7 +735,8 @@ def main():
     p.add_argument("--model", default="auto",
                    choices=["auto", "mlp", "resnet50", "transformer",
                             "transformer_realdist", "longctx", "vgg",
-                            "se_resnext", "stacked_lstm"])
+                            "se_resnext", "stacked_lstm",
+                            "machine_translation"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -801,7 +844,8 @@ def main():
         fn = {"resnet50": bench_resnet50, "transformer": bench_transformer,
               "mlp": bench_mlp, "vgg": bench_vgg,
               "se_resnext": bench_se_resnext,
-              "stacked_lstm": bench_stacked_lstm}[args.model]
+              "stacked_lstm": bench_stacked_lstm,
+              "machine_translation": bench_machine_translation}[args.model]
         result = fn(args, use_amp=not args.fp32_only,
                     per_step_feed=args.with_reader)
     # record the kernel/PRNG choices so A/Bs stay distinguishable in the

@@ -290,6 +290,14 @@ class DynamicRNN(StaticRNN):
 
     block = StaticRNN.step          # reference API name
 
+    def static_input(self, x):
+        """Reference control_flow.py DynamicRNN.static_input: expose a
+        non-stepped tensor inside the block.  The padded-batch redesign
+        needs no LoD reorder — outer vars are directly visible to the
+        sub-block — so this is an identity kept for API parity."""
+        self._assert_in_rnn_block("static_input")
+        return x
+
     def step_input(self, x, length=None):
         self._assert_in_rnn_block("step_input")
         if length is None:

@@ -4,5 +4,5 @@ machine_translation, stacked_dynamic_lstm) — built from the paddle_tpu
 layers DSL, TPU-first (bfloat16-friendly, MXU-sized matmuls/convs).
 """
 
-from . import (mnist, resnet, se_resnext, stacked_dynamic_lstm,  # noqa: F401
-               transformer, vgg)
+from . import (machine_translation, mnist, resnet,  # noqa: F401
+               se_resnext, stacked_dynamic_lstm, transformer, vgg)

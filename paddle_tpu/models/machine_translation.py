@@ -1,0 +1,113 @@
+"""RNN seq2seq NMT with Bahdanau attention — the fluid_benchmark
+``machine_translation.py`` model (reference
+``benchmark/fluid/models/machine_translation.py:53`` seq_to_seq_net):
+bi-directional LSTM encoder, attention decoder driven step-by-step with
+explicit LSTM gate math, softmax prediction per target position.
+
+TPU-first shape discipline: sequences are padded ``[B, T, ...]`` with
+``@LEN`` masks (no LoD reorder); the decoder recurrence is a
+``DynamicRNN`` (lax.scan), and the attention softmax masks padded
+source positions via ``sequence_softmax(length=...)`` instead of the
+reference's sequence_expand/sequence_softmax LoD plumbing.  All
+encoder-side projections are hoisted out of the scan (one big [B,T]
+gemm each instead of T small ones)."""
+
+from .. import layers
+from ..layer_helper import LayerHelper  # noqa: F401 (doc parity)
+
+__all__ = ["seq_to_seq_net", "lstm_step"]
+
+
+def lstm_step(x_t, hidden_t_prev, cell_t_prev, size):
+    """Explicit LSTM gate math (reference machine_translation.py:32)."""
+    def linear(inputs):
+        return layers.fc(input=inputs, size=size, bias_attr=True)
+
+    forget_gate = layers.sigmoid(linear([hidden_t_prev, x_t]))
+    input_gate = layers.sigmoid(linear([hidden_t_prev, x_t]))
+    output_gate = layers.sigmoid(linear([hidden_t_prev, x_t]))
+    cell_tilde = layers.tanh(linear([hidden_t_prev, x_t]))
+
+    cell_t = layers.sums([
+        layers.elementwise_mul(forget_gate, cell_t_prev),
+        layers.elementwise_mul(input_gate, cell_tilde),
+    ])
+    hidden_t = layers.elementwise_mul(output_gate, layers.tanh(cell_t))
+    return hidden_t, cell_t
+
+
+def _bi_lstm_encoder(src_emb, size):
+    """fwd + reverse dynamic_lstm over the pre-projected input; concat
+    hidden states (reference bi_lstm_encoder)."""
+    fwd_in = layers.fc(src_emb, size=size * 4, num_flatten_dims=2,
+                       bias_attr=False)
+    fwd, _ = layers.dynamic_lstm(fwd_in, size=size * 4)
+    rev_in = layers.fc(src_emb, size=size * 4, num_flatten_dims=2,
+                       bias_attr=False)
+    rev, _ = layers.dynamic_lstm(rev_in, size=size * 4, is_reverse=True)
+    return layers.concat([fwd, rev], axis=2), rev   # [B, T, 2H], [B, T, H]
+
+
+def seq_to_seq_net(src, tgt, label, source_dict_dim, target_dict_dim,
+                   embedding_dim=512, encoder_size=512, decoder_size=512):
+    """Training graph: returns (avg_cost, per-position predictions).
+
+    ``src``/``tgt``/``label`` are int64 ``lod_level=1`` data vars
+    ([B, T, 1] padded + @LEN).  ``label`` is ``tgt`` shifted left.
+    """
+    src_emb = layers.embedding(src, size=[source_dict_dim, embedding_dim])
+    encoded_vector, rev = _bi_lstm_encoder(src_emb, encoder_size)
+
+    # attention key projection, hoisted: one [B, T] gemm
+    encoded_proj = layers.fc(encoded_vector, size=decoder_size,
+                             num_flatten_dims=2, bias_attr=False)
+    # decoder boot = backward encoder's first state (reference takes the
+    # backward direction's first step)
+    backward_first = layers.sequence_first_step(rev)
+    decoder_boot = layers.fc(backward_first, size=decoder_size,
+                             act="tanh", bias_attr=False)
+
+    src_len = layers.sequence_length(src)
+
+    tgt_emb = layers.embedding(tgt, size=[target_dict_dim, embedding_dim])
+
+    rnn = layers.DynamicRNN()
+    with rnn.block():
+        current_word = rnn.step_input(tgt_emb)
+        enc_vec = rnn.static_input(encoded_vector)
+        enc_proj = rnn.static_input(encoded_proj)
+        hidden_mem = rnn.memory(init=decoder_boot)
+        cell_mem = rnn.memory(shape=[decoder_size], value=0.0)
+
+        # Bahdanau attention (reference simple_attention), padded form:
+        # score[b,t] = v . tanh(enc_proj[b,t] + W h[b]); masked softmax
+        dec_proj = layers.fc(hidden_mem, size=decoder_size,
+                             bias_attr=False)
+        mixed = layers.tanh(
+            layers.elementwise_add(enc_proj,
+                                   layers.unsqueeze(dec_proj, axes=[1])))
+        scores = layers.squeeze(
+            layers.fc(mixed, size=1, num_flatten_dims=2, bias_attr=False),
+            axes=[2])                                       # [B, T]
+        weights = layers.sequence_softmax(scores, length=src_len)
+        context = layers.reduce_sum(
+            layers.elementwise_mul(enc_vec,
+                                   layers.unsqueeze(weights, axes=[2])),
+            dim=1)                                          # [B, 2H]
+
+        decoder_input = layers.concat([context, current_word], axis=1)
+        h, c = lstm_step(decoder_input, hidden_mem, cell_mem,
+                         decoder_size)
+        rnn.update_memory(hidden_mem, h)
+        rnn.update_memory(cell_mem, c)
+        rnn.output(layers.fc(h, size=target_dict_dim, bias_attr=True))
+    logits = rnn()                                          # [B, T, V]
+
+    cost = layers.softmax_with_cross_entropy(logits, label)
+    tgt_len = layers.sequence_length(tgt)
+    mask = layers.padding_mask(tgt_len, logits)             # [B, T]
+    masked = layers.elementwise_mul(cost,
+                                    layers.unsqueeze(mask, axes=[2]))
+    avg_cost = layers.elementwise_div(layers.reduce_sum(masked),
+                                      layers.reduce_sum(mask))
+    return avg_cost, logits

@@ -136,7 +136,16 @@ def _recurrent_compute(ins, attrs, ctx, op_index):
         if getattr(step_ctx, "_key", None) is not None:
             step_ctx._key = jax.random.fold_in(step_ctx._key, t)
         _run_block(sub, env, step_ctx)
-        new_carry = tuple(env[n] for n in post_names)
+        # carry must be scan-dtype-stable: under AMP a black-list op in
+        # the body (e.g. a softmax in an attention cell) can promote a
+        # bf16 memory to fp32 — cast updates back to the memory's dtype
+        # (x64-degraded, so an int64 init from numpy doesn't warn)
+        from ..core import materialize_dtype as _mat
+
+        new_carry = tuple(
+            v if v.dtype == _mat(c.dtype) else v.astype(_mat(c.dtype))
+            for v, c in ((env[n], c)
+                         for n, c in zip(post_names, carry)))
         outs = tuple(env[n] for n in out_names)
         if length is not None:
             valid = t < length          # [B]

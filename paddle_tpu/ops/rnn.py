@@ -67,13 +67,23 @@ def _lstm_compute(ins, attrs, ctx, op_index):
     if reverse:
         steps = steps[::-1]
 
-    h_prev0 = h0 if h0 is not None else jnp.zeros((b, h), x.dtype)
-    c_prev0 = c0 if c0 is not None else jnp.zeros((b, h), x.dtype)
+    # the recurrence follows the INPUT's precision: under AMP the
+    # pre-projected x is bf16 while the gray lstm op's weight stays
+    # fp32 master — casting w/bias down keeps the whole scan (gates,
+    # [B,T,H] outputs, MXU steps) on the bf16 path instead of silently
+    # promoting the carry to fp32 mid-scan (a scan dtype error)
+    dt = x.dtype
+    w = w.astype(dt)
+    gb = gb.astype(dt)
+    if use_peep:
+        w_ic, w_fc, w_oc = (v.astype(dt) for v in (w_ic, w_fc, w_oc))
+    h_prev0 = h0.astype(dt) if h0 is not None else jnp.zeros((b, h), dt)
+    c_prev0 = c0.astype(dt) if c0 is not None else jnp.zeros((b, h), dt)
 
     def step(carry, inp):
         h_prev, c_prev = carry
         xt, tidx = inp
-        gates = xt + h_prev @ w + gb
+        gates = (xt + h_prev @ w + gb).astype(dt)
         gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
         if use_peep:
             i = gate_act(gi + c_prev * w_ic)
@@ -148,7 +158,7 @@ def _lstmp_compute(ins, attrs, ctx, op_index):
     def step(carry, inp):
         r_prev, c_prev = carry
         xt, tidx = inp
-        gates = xt + r_prev @ w + gb
+        gates = (xt + r_prev @ w + gb).astype(dt)
         gc, gi, gf, go = jnp.split(gates, 4, axis=-1)
         if use_peep:
             i = gate_act(gi + c_prev * w_ic)
@@ -164,7 +174,13 @@ def _lstmp_compute(ins, attrs, ctx, op_index):
         r_new = jnp.where(valid, r, r_prev)
         return (r_new, c), (jnp.where(valid, r, 0), jnp.where(valid, c, 0))
 
-    init = (jnp.zeros((b, p), x.dtype), jnp.zeros((b, h), x.dtype))
+    dt = x.dtype
+    w = w.astype(dt)
+    w_proj = w_proj.astype(dt)
+    gb = gb.astype(dt)
+    if use_peep:
+        w_ic, w_fc, w_oc = (v.astype(dt) for v in (w_ic, w_fc, w_oc))
+    init = (jnp.zeros((b, p), dt), jnp.zeros((b, h), dt))
     _, (rs, cs) = lax.scan(step, init, (xs, steps))
     if reverse:
         rs, cs = rs[::-1], cs[::-1]
@@ -206,7 +222,10 @@ def _gru_compute(ins, attrs, ctx, op_index):
     steps = jnp.arange(t)
     if reverse:
         steps = steps[::-1]
-    h_prev0 = h0 if h0 is not None else jnp.zeros((b, h), x.dtype)
+    dt = x.dtype
+    w_g = w_g.astype(dt)
+    w_c = w_c.astype(dt)
+    h_prev0 = h0.astype(dt) if h0 is not None else jnp.zeros((b, h), dt)
 
     def step(h_prev, inp):
         xt, tidx = inp
@@ -216,7 +235,7 @@ def _gru_compute(ins, attrs, ctx, op_index):
         c = cand_act(xc + (r * h_prev) @ w_c)
         # reference gru kernel (math/detail/gru_kernel.h:62):
         # h = (1 - u) * h_prev + u * c
-        hh = (1.0 - u) * h_prev + u * c
+        hh = ((1.0 - u) * h_prev + u * c).astype(dt)
         valid = (tidx < length)[:, None]
         h_new = jnp.where(valid, hh, h_prev)
         return h_new, jnp.where(valid, hh, 0)

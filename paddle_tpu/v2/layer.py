@@ -24,7 +24,7 @@ from .pooling import img_pool_type, seq_pool_type
 __all__ = [
     "data", "fc", "embedding", "img_conv", "img_pool", "batch_norm",
     "dropout", "concat", "addto", "pooling", "first_seq", "last_seq",
-    "max_id", "classification_cost", "cross_entropy_cost",
+    "cos_sim", "max_id", "classification_cost", "cross_entropy_cost",
     "multi_binary_label_cross_entropy_cost", "square_error_cost",
     "mse_cost", "regression_cost", "nce", "hsigmoid", "crf",
     "crf_decoding", "ctc", "lstmemory", "grumemory",
@@ -200,6 +200,16 @@ def last_seq(input, name=None, **kwargs):
     with cfg.build():
         var = fl.sequence_last_step(input.var)
     return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input])
+
+
+def cos_sim(a, b, scale=1, name=None, layer_attr=None):
+    """Cosine similarity (reference cos_sim layer; the v2 recommender
+    demo's matching score)."""
+    with cfg.build():
+        var = fl.cos_sim(a.var, b.var)
+        if scale != 1:
+            var = var * float(scale)
+    return cfg.Layer(var, v2_dim=1, parents=[a, b])
 
 
 def max_id(input, name=None, layer_attr=None):

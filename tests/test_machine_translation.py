@@ -1,0 +1,76 @@
+"""Attention seq2seq NMT model (reference
+benchmark/fluid/models/machine_translation.py:53 seq_to_seq_net):
+bi-LSTM encoder + Bahdanau attention decoder trains end-to-end, masks
+padded source positions in the attention softmax, and handles
+variable-length batches."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.machine_translation import seq_to_seq_net
+
+V = 16
+
+
+def _feed(rng, B, T, lens=None):
+    feed = {}
+    lens = np.asarray(lens if lens is not None else [T] * B, "int32")
+    for name in ("src", "tgt", "lbl"):
+        feed[name] = rng.randint(1, V, (B, T, 1)).astype("int64")
+        feed[name + "@LEN"] = lens
+    # copy task: label = source, target = source (teacher forcing input)
+    feed["tgt"] = feed["src"].copy()
+    feed["lbl"] = feed["src"].copy()
+    return feed
+
+
+def test_seq2seq_attention_trains():
+    rng = np.random.RandomState(0)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        src = fluid.layers.data("src", shape=[1], dtype="int64",
+                                lod_level=1)
+        tgt = fluid.layers.data("tgt", shape=[1], dtype="int64",
+                                lod_level=1)
+        lbl = fluid.layers.data("lbl", shape=[1], dtype="int64",
+                                lod_level=1)
+        cost, logits = seq_to_seq_net(src, tgt, lbl, V, V,
+                                      embedding_dim=16, encoder_size=16,
+                                      decoder_size=16)
+        fluid.optimizer.AdamOptimizer(learning_rate=0.02).minimize(cost)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            feed = _feed(rng, B=8, T=6)
+            losses = []
+            for _ in range(40):
+                l, = exe.run(feed=feed, fetch_list=[cost])
+                losses.append(float(np.asarray(l).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_seq2seq_attention_masks_padding():
+    """Padded source positions must not receive attention: the loss on
+    a short-sequence batch is invariant to garbage in the padding."""
+    rng = np.random.RandomState(1)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        src = fluid.layers.data("src", shape=[1], dtype="int64",
+                                lod_level=1)
+        tgt = fluid.layers.data("tgt", shape=[1], dtype="int64",
+                                lod_level=1)
+        lbl = fluid.layers.data("lbl", shape=[1], dtype="int64",
+                                lod_level=1)
+        cost, _ = seq_to_seq_net(src, tgt, lbl, V, V, embedding_dim=8,
+                                 encoder_size=8, decoder_size=8)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            feed = _feed(rng, B=4, T=6, lens=[3, 4, 2, 6])
+            a, = exe.run(feed=feed, fetch_list=[cost])
+            # scribble over source padding beyond each length
+            for i, l in enumerate(feed["src@LEN"]):
+                feed["src"][i, l:] = (feed["src"][i, l:] + 7) % V
+            b, = exe.run(feed=feed, fetch_list=[cost])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6)

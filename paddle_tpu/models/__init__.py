@@ -4,5 +4,6 @@ machine_translation, stacked_dynamic_lstm) — built from the paddle_tpu
 layers DSL, TPU-first (bfloat16-friendly, MXU-sized matmuls/convs).
 """
 
-from . import (machine_translation, mnist, resnet,  # noqa: F401
-               se_resnext, stacked_dynamic_lstm, transformer, vgg)
+from . import (alexnet, googlenet, machine_translation,  # noqa: F401
+               mnist, resnet, se_resnext, smallnet,
+               stacked_dynamic_lstm, transformer, vgg)

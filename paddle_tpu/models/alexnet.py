@@ -1,0 +1,44 @@
+"""AlexNet — the reference's oldest headline benchmark topology
+(``benchmark/paddle/image/alexnet.py``: 227x227 input, 5 convs with
+LRN after conv1/conv2, three 4096/4096/class FCs with dropout; the
+published number is 334 ms/batch at bs=128 on a K40m,
+``benchmark/README.md:33-38``).
+
+TPU notes: the v2 config's ``img_conv_layer`` defaults to ReLU, so every
+conv here carries act="relu"; LRN is the cross-map response norm the
+original paper used (XLA fuses its square/avg-pool/pow chain).  One
+fused HLO module end-to-end like every other model in ``models/``.
+"""
+
+from .. import layers
+
+__all__ = ["alexnet"]
+
+
+def alexnet(input, class_dim=1000, is_test=False, groups=1):
+    conv1 = layers.conv2d(input=input, num_filters=96, filter_size=11,
+                          stride=4, padding=1, act="relu")
+    norm1 = layers.lrn(input=conv1, n=5, alpha=1e-4, beta=0.75)
+    pool1 = layers.pool2d(input=norm1, pool_size=3, pool_stride=2,
+                          pool_type="max")
+
+    conv2 = layers.conv2d(input=pool1, num_filters=256, filter_size=5,
+                          stride=1, padding=2, groups=groups, act="relu")
+    norm2 = layers.lrn(input=conv2, n=5, alpha=1e-4, beta=0.75)
+    pool2 = layers.pool2d(input=norm2, pool_size=3, pool_stride=2,
+                          pool_type="max")
+
+    conv3 = layers.conv2d(input=pool2, num_filters=384, filter_size=3,
+                          stride=1, padding=1, act="relu")
+    conv4 = layers.conv2d(input=conv3, num_filters=384, filter_size=3,
+                          stride=1, padding=1, groups=groups, act="relu")
+    conv5 = layers.conv2d(input=conv4, num_filters=256, filter_size=3,
+                          stride=1, padding=1, groups=groups, act="relu")
+    pool5 = layers.pool2d(input=conv5, pool_size=3, pool_stride=2,
+                          pool_type="max")
+
+    fc6 = layers.fc(input=pool5, size=4096, act="relu")
+    drop6 = layers.dropout(x=fc6, dropout_prob=0.5, is_test=is_test)
+    fc7 = layers.fc(input=drop6, size=4096, act="relu")
+    drop7 = layers.dropout(x=fc7, dropout_prob=0.5, is_test=is_test)
+    return layers.fc(input=drop7, size=class_dim, act="softmax")

@@ -1,0 +1,30 @@
+"""SmallNet — the reference's cifar-scale era benchmark topology
+(``benchmark/paddle/image/smallnet_mnist_cifar.py``: 32x32 input, three
+5/5/3 convs with 3x3-stride-2 pools — max then two avg — then 64/10
+FCs; published 33.1 ms/batch at bs=256 on a K40m,
+``benchmark/README.md:55-59``).
+"""
+
+from .. import layers
+
+__all__ = ["smallnet"]
+
+
+def smallnet(input, class_dim=10, is_test=False):
+    conv1 = layers.conv2d(input=input, num_filters=32, filter_size=5,
+                          stride=1, padding=2, act="relu")
+    pool1 = layers.pool2d(input=conv1, pool_size=3, pool_stride=2,
+                          pool_padding=1, pool_type="max")
+
+    conv2 = layers.conv2d(input=pool1, num_filters=32, filter_size=5,
+                          stride=1, padding=2, act="relu")
+    pool2 = layers.pool2d(input=conv2, pool_size=3, pool_stride=2,
+                          pool_padding=1, pool_type="avg")
+
+    conv3 = layers.conv2d(input=pool2, num_filters=64, filter_size=3,
+                          stride=1, padding=1, act="relu")
+    pool3 = layers.pool2d(input=conv3, pool_size=3, pool_stride=2,
+                          pool_padding=1, pool_type="avg")
+
+    fc1 = layers.fc(input=pool3, size=64, act="relu")
+    return layers.fc(input=fc1, size=class_dim, act="softmax")

@@ -1,0 +1,127 @@
+"""Book e2e: label_semantic_roles — the db_lstm SRL model (reference
+``python/paddle/fluid/tests/book/test_label_semantic_roles.py``): 8
+embedded input features (word, 5 context words, predicate, mark), a
+stack of alternating-direction LSTMs with direct mix edges, and a
+linear-chain CRF cost, decoded with crf_decoding.  Miniature scale,
+same topology shape; trains until the CRF NLL drops, then decodes.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+WORD_VOCAB = 30
+PRED_VOCAB = 10
+MARK_VOCAB = 2
+WORD_DIM = 8
+MARK_DIM = 4
+HIDDEN = 32          # lstm hidden = HIDDEN // 4
+DEPTH = 4
+NUM_LABELS = 6
+FEATURES = ["word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2",
+            "predicate", "mark"]
+
+
+def _db_lstm(inputs):
+    """The 8-feature mixed bi-LSTM trunk (reference db_lstm)."""
+    word_feats = [inputs[n] for n in FEATURES[:6]]
+    embs = [fluid.layers.embedding(
+        x, size=[WORD_VOCAB, WORD_DIM],
+        param_attr=fluid.ParamAttr(name="emb")) for x in word_feats]
+    embs.append(fluid.layers.embedding(
+        inputs["predicate"], size=[PRED_VOCAB, WORD_DIM],
+        param_attr=fluid.ParamAttr(name="vemb")))
+    embs.append(fluid.layers.embedding(
+        inputs["mark"], size=[MARK_VOCAB, MARK_DIM]))
+
+    hidden_0 = fluid.layers.sums(
+        [fluid.layers.fc(e, size=HIDDEN, num_flatten_dims=2) for e in embs])
+    hidden_0._seq_len_name = inputs["word"]._seq_len_name
+    lstm_0, _ = fluid.layers.dynamic_lstm(
+        hidden_0, size=HIDDEN, candidate_activation="relu",
+        gate_activation="sigmoid", cell_activation="sigmoid")
+
+    input_tmp = [hidden_0, lstm_0]
+    for i in range(1, DEPTH):
+        mix = fluid.layers.sums([
+            fluid.layers.fc(input_tmp[0], size=HIDDEN, num_flatten_dims=2),
+            fluid.layers.fc(input_tmp[1], size=HIDDEN, num_flatten_dims=2),
+        ])
+        mix._seq_len_name = inputs["word"]._seq_len_name
+        lstm, _ = fluid.layers.dynamic_lstm(
+            mix, size=HIDDEN, candidate_activation="relu",
+            gate_activation="sigmoid", cell_activation="sigmoid",
+            is_reverse=(i % 2 == 1))
+        input_tmp = [mix, lstm]
+
+    feature_out = fluid.layers.sums([
+        fluid.layers.fc(input_tmp[0], size=NUM_LABELS, num_flatten_dims=2,
+                        act="tanh"),
+        fluid.layers.fc(input_tmp[1], size=NUM_LABELS, num_flatten_dims=2,
+                        act="tanh"),
+    ])
+    feature_out._seq_len_name = inputs["word"]._seq_len_name
+    return feature_out
+
+
+def _synthetic_batch(rng, b, t):
+    feeds = {}
+    lens = rng.randint(3, t + 1, (b,)).astype("int32")
+    for name, vocab in zip(FEATURES, [WORD_VOCAB] * 6 + [PRED_VOCAB,
+                                                         MARK_VOCAB]):
+        feeds[name] = rng.randint(0, vocab, (b, t, 1)).astype("int64")
+        feeds[name + "@LEN"] = lens
+    # learnable tagging: the label is a deterministic function of the
+    # word id (plus the mark bit), so the trunk can fit it
+    feeds["target"] = ((feeds["word"] + feeds["mark"]) %
+                       NUM_LABELS).astype("int64")
+    feeds["target@LEN"] = lens
+    return feeds
+
+
+def test_label_semantic_roles_trains_and_decodes():
+    rng = np.random.RandomState(7)
+    b, t = 8, 7
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_startup_program().random_seed = 11
+        inputs = {n: fluid.layers.data(n, shape=[1], dtype="int64",
+                                       lod_level=1) for n in FEATURES}
+        target = fluid.layers.data("target", shape=[1], dtype="int64",
+                                   lod_level=1)
+        feature_out = _db_lstm(inputs)
+        crf_cost = fluid.layers.linear_chain_crf(
+            feature_out, target,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        avg_cost = fluid.layers.mean(crf_cost)
+        # viterbi decode shares the trained transitions; built before
+        # minimize so the inference clone carries no optimizer ops
+        # (reference book flow: crf_decoding in the main program, the
+        # saved inference model pruned to it)
+        decode = fluid.layers.crf_decoding(
+            feature_out, param_attr=fluid.ParamAttr(name="crfw"))
+        infer = fluid.default_main_program().clone(
+            for_test=True).prune_feed_fetch(
+                [n for n in FEATURES] + [n + "@LEN" for n in FEATURES],
+                [decode.name])
+        # the book config uses SGD w/ decaying lr on the real dataset;
+        # plain SGD suffices at miniature scale
+        fluid.optimizer.SGD(learning_rate=0.02).minimize(avg_cost)
+
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fluid.default_startup_program())
+            batch = _synthetic_batch(rng, b, t)
+            losses = []
+            for _ in range(30):
+                (lv,) = exe.run(feed=batch, fetch_list=[avg_cost])
+                losses.append(float(np.asarray(lv).ravel()[0]))
+            assert all(np.isfinite(losses)), losses
+            assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+            (path,) = exe.run(infer, feed={
+                k: v for k, v in batch.items() if not k.startswith("target")
+            }, fetch_list=[decode.name])
+            path = np.asarray(path)
+            assert path.shape[:2] == (b, t)
+            assert path.min() >= 0 and path.max() < NUM_LABELS

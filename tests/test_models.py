@@ -98,3 +98,67 @@ def test_se_resnext_builds_and_runs():
     (out,) = exe.run(test_prog, feed={"img": x}, fetch_list=[pred.name])
     assert out.shape == (2, 10)
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_smallnet_trains():
+    """Era benchmark trio 1/3 (benchmark/paddle/image/smallnet_mnist_cifar.py)."""
+    from paddle_tpu.models import smallnet as m
+
+    img = fluid.layers.data("img", shape=[3, 32, 32])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    pred = m.smallnet(img, class_dim=10)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+
+    rng = np.random.RandomState(5)
+    base = rng.rand(4, 8, 3, 32, 32).astype("float32")
+
+    def feed():
+        i = feed.step % 4
+        feed.step += 1
+        x = base[i]
+        y = (x.mean(axis=(1, 2, 3)) * 30).astype("int64").reshape(-1, 1) % 10
+        return {"img": x, "label": y}
+    feed.step = 0
+
+    losses = _train_steps(loss, feed, steps=12)
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_alexnet_trains():
+    """Era benchmark trio 2/3 (benchmark/paddle/image/alexnet.py): full
+    227x227 topology incl. the LRN layers, tiny batch, 2 steps finite."""
+    from paddle_tpu.models import alexnet as m
+
+    img = fluid.layers.data("img", shape=[3, 227, 227])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    pred = m.alexnet(img, class_dim=1000)
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Momentum(learning_rate=1e-3, momentum=0.9).minimize(loss)
+
+    rng = np.random.RandomState(6)
+
+    def feed():
+        return {"img": rng.rand(2, 3, 227, 227).astype("float32"),
+                "label": rng.randint(0, 1000, (2, 1)).astype("int64")}
+
+    losses = _train_steps(loss, feed, steps=2)
+    assert all(np.isfinite(losses)), losses
+
+
+def test_googlenet_builds_and_runs():
+    """Era benchmark trio 3/3 (benchmark/paddle/image/googlenet.py): all
+    9 inception blocks; forward inference on a small input."""
+    from paddle_tpu.models import googlenet as m
+
+    img = fluid.layers.data("img", shape=[3, 224, 224])
+    pred = m.googlenet_v1(img, class_dim=1000, is_test=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    test_prog = fluid.default_main_program().prune_feed_fetch(
+        ["img"], [pred.name])
+    x = np.random.RandomState(7).rand(2, 3, 224, 224).astype("float32")
+    (out,) = exe.run(test_prog, feed={"img": x}, fetch_list=[pred.name])
+    assert out.shape == (2, 1000)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)

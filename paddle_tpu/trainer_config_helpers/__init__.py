@@ -1,0 +1,24 @@
+"""v1 trainer-config DSL dialect (reference
+python/paddle/trainer_config_helpers/__init__.py:1).
+
+The third API dialect served by the single TPU execution engine (after
+the fluid-parity and v2 surfaces; README.md documents the fold): v1
+configs — ``*_layer`` calls, ``mixed_layer`` projections, ``settings()``,
+``outputs()`` — build the same Program IR everything else jit-compiles.
+The legacy per-layer C++ engine they configured
+(``legacy/gserver/gradientmachines/GradientMachine.h:75``) is the part
+XLA replaces; the DSL itself is fully live, and composes with the v2
+trainer (``paddle_tpu.v2.trainer.SGD``) for execution.
+"""
+
+from .activations import *  # noqa: F401,F403
+from .attrs import *  # noqa: F401,F403
+from .config_parser_utils import *  # noqa: F401,F403
+from .data_sources import *  # noqa: F401,F403
+from .default_decorators import *  # noqa: F401,F403
+from .evaluators import *  # noqa: F401,F403
+from . import layer_math  # noqa: F401 - installs LayerOutput operators
+from .layers import *  # noqa: F401,F403
+from .networks import *  # noqa: F401,F403
+from .optimizers import *  # noqa: F401,F403
+from .poolings import *  # noqa: F401,F403

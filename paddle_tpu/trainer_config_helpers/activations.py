@@ -1,0 +1,34 @@
+"""v1 activation objects (reference
+python/paddle/trainer_config_helpers/activations.py:1).
+
+The v1 config DSL names activations ``<Kind>Activation``; the v2 API
+re-exports the same classes under short names.  Here the relationship is
+inverted — the v2 activation objects are the canonical ones (they map to
+fluid-parity activation op types), and this module aliases them under
+the v1 names so v1 configs run unchanged.
+"""
+
+from ..v2 import activation as _act
+
+__all__ = [
+    "BaseActivation", "TanhActivation", "SigmoidActivation",
+    "SoftmaxActivation", "IdentityActivation", "LinearActivation",
+    "ReluActivation", "BReluActivation", "SoftReluActivation",
+    "STanhActivation", "AbsActivation", "SquareActivation",
+    "ExpActivation", "LogActivation",
+]
+
+BaseActivation = _act.Base
+TanhActivation = _act.Tanh
+SigmoidActivation = _act.Sigmoid
+SoftmaxActivation = _act.Softmax
+IdentityActivation = _act.Identity
+LinearActivation = _act.Linear
+ReluActivation = _act.Relu
+BReluActivation = _act.BRelu
+SoftReluActivation = _act.SoftRelu
+STanhActivation = _act.STanh
+AbsActivation = _act.Abs
+SquareActivation = _act.Square
+ExpActivation = _act.Exp
+LogActivation = _act.Log

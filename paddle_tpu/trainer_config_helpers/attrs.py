@@ -1,0 +1,42 @@
+"""v1 attribute objects (reference
+python/paddle/trainer_config_helpers/attrs.py:1).
+
+``ParameterAttribute`` builds a fluid-parity ``ParamAttr`` through the
+same kwarg mapping the v2 dialect uses (initial_mean/std -> Normal
+initializer, l1/l2 rates -> regularizers, is_static -> trainable=False,
+sparse_update -> SelectedRows sparse-grad flag).  ``ExtraLayerAttribute``
+carries the layer-level extras; only ``drop_rate`` and
+``error_clipping_threshold`` are meaningful on this stack — the rest of
+the v1 fields were GPU scheduling hints absorbed by XLA.
+"""
+
+from ..v2.attr import ExtraAttr as _ExtraAttr
+from ..v2.attr import ParamAttr as _v2_param_attr
+
+__all__ = ["ParameterAttribute", "ExtraLayerAttribute",
+           "ParamAttr", "ExtraAttr"]
+
+
+def ParameterAttribute(name=None, is_static=False, initial_std=None,
+                       initial_mean=None, initial_max=None, initial_min=None,
+                       l1_rate=None, l2_rate=None, learning_rate=None,
+                       momentum=None, gradient_clipping_threshold=None,
+                       sparse_update=False, update_hooks=None,
+                       initializer=None):
+    """reference attrs.py ParameterAttribute.  initial_min/max select a
+    Uniform initializer (the v1 default was uniform over +-initial_std)."""
+    if initializer is None and initial_max is not None:
+        from .. import initializer as init_mod
+        lo = initial_min if initial_min is not None else -initial_max
+        initializer = init_mod.UniformInitializer(low=lo, high=initial_max)
+    return _v2_param_attr(
+        name=name, initial_std=initial_std, initial_mean=initial_mean,
+        is_static=is_static, l1_rate=l1_rate, l2_rate=l2_rate,
+        learning_rate=learning_rate, momentum=momentum,
+        gradient_clipping_threshold=gradient_clipping_threshold,
+        sparse_update=sparse_update, initializer=initializer)
+
+
+ExtraLayerAttribute = _ExtraAttr
+ParamAttr = ParameterAttribute
+ExtraAttr = _ExtraAttr

@@ -1,0 +1,78 @@
+"""Config parsing entry points (reference
+python/paddle/trainer_config_helpers/config_parser_utils.py:1 +
+python/paddle/trainer/config_parser.py parse_config).
+
+In the v1 pipeline these ran a config file/function under the global
+proto parser and returned ``ModelConfig``/``OptimizationConfig`` protos
+for the trainer binary.  Here a network config function builds the
+process-global Program pair (v2/config.py), and the "proto" is the
+Program's JSON-dict serialization (framework.Program.to_dict — the
+ProgramDesc analog, SURVEY §2.1); the optimizer config returns the
+recorded ``TrainingSettings``.
+"""
+
+from ..v2 import config as cfg
+from . import data_sources, optimizers
+
+__all__ = ["parse_network_config", "parse_optimizer_config",
+           "parse_trainer_config", "reset_parser"]
+
+
+def reset_parser():
+    """Fresh global state (reference config_parser_utils.reset_parser)."""
+    cfg.reset()
+    optimizers.reset_settings()
+    data_sources.reset_data_sources()
+
+
+class ParsedModel(object):
+    """What parse_network_config returns: the live Programs plus the
+    serialized model dict (the ModelConfig-proto analog)."""
+
+    def __init__(self, graph):
+        self.graph = graph
+        self.program = graph.main
+        self.startup_program = graph.startup
+        self.input_layer_names = [l.name for l in graph.data_layers]
+        out = getattr(graph, "output_layers", None) or []
+        self.output_layer_names = [l.name for l in out]
+        self.output_layers = list(out)
+
+    def to_dict(self):
+        return {
+            "program": self.program.to_dict(),
+            "startup_program": self.startup_program.to_dict(),
+            "input_layer_names": self.input_layer_names,
+            "output_layer_names": self.output_layer_names,
+        }
+
+
+def parse_network_config(network_conf, config_arg_str=""):
+    """Run a v1 network config function and return the parsed model
+    (reference config_parser_utils.parse_network_config).  The config
+    function takes no arguments; ``config_arg_str`` is accepted for
+    signature parity (v1 passed it through to the config's globals)."""
+    reset_parser()
+    network_conf()
+    return ParsedModel(cfg.graph())
+
+
+def parse_optimizer_config(optimizer_conf, config_arg_str=""):
+    """Run a settings() config function and return the recorded
+    TrainingSettings (reference parse_optimizer_config)."""
+    optimizers.reset_settings()
+    optimizer_conf()
+    st = optimizers.current_settings()
+    if st is None:
+        raise ValueError("optimizer config did not call settings()")
+    return st
+
+
+def parse_trainer_config(config_fn, config_arg_str=""):
+    """Run a full v1 trainer config (settings + data sources + network)
+    and return (ParsedModel, TrainingSettings) — the TrainerConfig-proto
+    analog."""
+    reset_parser()
+    config_fn()
+    st = optimizers.current_settings()
+    return ParsedModel(cfg.graph()), st

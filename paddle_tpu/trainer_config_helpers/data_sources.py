@@ -1,0 +1,67 @@
+"""v1 data-source declarations (reference
+python/paddle/trainer_config_helpers/data_sources.py:1).
+
+``define_py_data_sources2`` bound a PyDataProvider2 module to the
+trainer binary.  On this stack data flows through host-side readers
+(``paddle_tpu.reader``) — the declaration is recorded so
+``resolve_provider`` can import the module and hand back the generator
+functions, which a training loop feeds through ``DataFeeder`` exactly
+like any other reader.
+"""
+
+import importlib
+
+__all__ = ["define_py_data_sources2", "current_data_sources",
+           "resolve_provider", "reset_data_sources"]
+
+
+class DataSourceSpec(object):
+    def __init__(self, file_list, module, obj, args):
+        self.file_list = file_list
+        self.module = module
+        self.obj = obj
+        self.args = args
+
+
+_sources = {}
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    """Record the train/test provider bindings (reference
+    data_sources.py define_py_data_sources2).  ``obj`` may differ per
+    split via a dict {"train": ..., "test": ...} as in v1."""
+
+    def _obj(split):
+        if isinstance(obj, dict):
+            return obj[split]
+        return obj
+
+    global _sources
+    if train_list is not None:
+        _sources["train"] = DataSourceSpec(train_list, module, _obj("train"),
+                                           args)
+    if test_list is not None:
+        _sources["test"] = DataSourceSpec(test_list, module, _obj("test"),
+                                          args)
+
+
+def current_data_sources():
+    return dict(_sources)
+
+
+def reset_data_sources():
+    global _sources
+    _sources = {}
+
+
+def resolve_provider(split="train"):
+    """Import the declared provider and return ``fn(file_list, args)`` —
+    expected to be a reader-style generator factory on this stack (the
+    PyDataProvider2 decorator protocol is not re-implemented; providers
+    written for this framework are plain readers)."""
+    spec = _sources.get(split)
+    if spec is None:
+        raise KeyError("no %s data source declared" % split)
+    mod = importlib.import_module(spec.module)
+    fn = getattr(mod, spec.obj)
+    return lambda: fn(spec.file_list, spec.args)

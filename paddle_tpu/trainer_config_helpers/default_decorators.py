@@ -1,0 +1,66 @@
+"""Default-filling decorators (reference
+python/paddle/trainer_config_helpers/default_decorators.py:1).
+
+The v1 DSL wraps every layer in decorators that fill ``name``/
+``param_attr``/``bias_attr``/``act`` defaults; user extension code
+imports them to write custom layers.  Re-implemented generically: each
+returns a decorator that replaces a None (or missing) keyword with the
+default factory's value.
+"""
+
+import functools
+import inspect
+
+from .. import unique_name
+from .activations import LinearActivation
+
+__all__ = ["wrap_name_default", "wrap_param_attr_default",
+           "wrap_bias_attr_default", "wrap_act_default",
+           "wrap_param_default"]
+
+
+def wrap_param_default(param_names, default_factory, **bound):
+    """Fill each named keyword with default_factory(func) when the call
+    passes None (reference default_decorators.py wrap_param_default)."""
+
+    def decorator(func):
+        sig = inspect.signature(func)
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            ba = sig.bind_partial(*args, **kwargs)
+            for name in param_names:
+                if ba.arguments.get(name) is None:
+                    # fill through the bound arguments so a positional
+                    # None is replaced too (not a duplicate kwarg)
+                    ba.arguments[name] = default_factory(func)
+            return func(*ba.args, **ba.kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def wrap_name_default(name_prefix=None, name_param="name"):
+    prefix = name_prefix or "layer"
+    return wrap_param_default(
+        [name_param], lambda func: unique_name.generate(prefix))
+
+
+def wrap_param_attr_default(param_names=None, default_factory=None):
+    names = param_names or ["param_attr"]
+    factory = default_factory or (lambda func: None)
+    return wrap_param_default(names, factory)
+
+
+def wrap_bias_attr_default(param_names=None, default_factory=None,
+                           has_bias=True):
+    names = param_names or ["bias_attr"]
+    factory = default_factory or (lambda func: has_bias)
+    return wrap_param_default(names, factory)
+
+
+def wrap_act_default(param_names=None, act=None):
+    names = param_names or ["act"]
+    default = act if act is not None else LinearActivation()
+    return wrap_param_default(names, lambda func: default)

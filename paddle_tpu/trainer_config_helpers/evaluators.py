@@ -1,0 +1,43 @@
+"""v1 evaluator declarations (reference
+python/paddle/trainer_config_helpers/evaluators.py:1).
+
+Each registers a metric subgraph on the global v2 graph (the same
+mechanism ``v2.evaluator`` uses); the trainer fetches and reports them
+per batch.  Curated to the evaluators with in-graph metric ops on this
+stack (ops/metric.py); the printer evaluators degrade to value_printer.
+"""
+
+from ..v2 import config as cfg
+from ..v2 import evaluator as v2_eval
+
+__all__ = [
+    "classification_error_evaluator", "auc_evaluator",
+    "value_printer_evaluator", "sum_evaluator", "column_sum_evaluator",
+]
+
+classification_error_evaluator = v2_eval.classification_error
+auc_evaluator = v2_eval.auc
+value_printer_evaluator = v2_eval.value_printer
+
+
+def sum_evaluator(input, name=None, weight=None):
+    """Sum of the input over the batch (reference evaluators.py
+    sum_evaluator)."""
+    from .. import layers as fl
+    name = name or "sum_evaluator"
+    with cfg.build() as g:
+        s = fl.reduce_sum(cfg.unwrap(input))
+        g.evaluators = [e for e in g.evaluators if e[0] != name]
+        g.evaluators.append((name, s, None))
+    return s
+
+
+def column_sum_evaluator(input, name=None, weight=None):
+    """Per-column sums (reference evaluators.py column_sum_evaluator)."""
+    from .. import layers as fl
+    name = name or "column_sum_evaluator"
+    with cfg.build() as g:
+        s = fl.reduce_sum(cfg.unwrap(input), dim=0)
+        g.evaluators = [e for e in g.evaluators if e[0] != name]
+        g.evaluators.append((name, s, None))
+    return s

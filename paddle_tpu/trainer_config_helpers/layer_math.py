@@ -1,0 +1,82 @@
+"""Arithmetic operators on LayerOutput (reference
+python/paddle/trainer_config_helpers/layer_math.py:1).
+
+The reference monkey-patches ``LayerOutput.__add__``/``__sub__``/
+``__mul__`` to emit slope_intercept / addto / dotmul layers so v1
+configs can write ``0.5 * layer + bias_layer``.  Here the same
+operators are installed on the shared ``cfg.Layer`` handle (used by
+both the v1 and v2 dialects), emitting the fluid-parity ops.
+"""
+
+from ..v2 import config as cfg
+
+__all__ = []
+
+
+def _scalar(x):
+    return isinstance(x, (int, float))
+
+
+def _add(self, other):
+    from . import layers as tch
+    if _scalar(other):
+        return tch.slope_intercept_layer(self, intercept=float(other))
+    return tch.addto_layer([self, other])
+
+
+def _radd(self, other):
+    return _add(self, other)
+
+
+def _sub(self, other):
+    from . import layers as tch
+    if _scalar(other):
+        return tch.slope_intercept_layer(self, intercept=-float(other))
+    neg = tch.slope_intercept_layer(other, slope=-1.0)
+    return tch.addto_layer([self, neg])
+
+
+def _rsub(self, other):
+    from . import layers as tch
+    neg = tch.slope_intercept_layer(self, slope=-1.0)
+    if _scalar(other):
+        return tch.slope_intercept_layer(neg, intercept=float(other))
+    return tch.addto_layer([neg, other])
+
+
+def _mul(self, other):
+    from . import layers as tch
+    from .. import layers as fl
+    if _scalar(other):
+        return tch.slope_intercept_layer(self, slope=float(other))
+    with cfg.build():
+        var = fl.elementwise_mul(self.var, other.var)
+    return cfg.Layer(var, v2_dim=self.v2_dim, parents=[self, other])
+
+
+def _rmul(self, other):
+    return _mul(self, other)
+
+
+def _div(self, other):
+    from . import layers as tch
+    if not _scalar(other):
+        raise TypeError("layer / layer is not part of the v1 layer math; "
+                        "use layers.elementwise_div on the Variables")
+    return tch.slope_intercept_layer(self, slope=1.0 / float(other))
+
+
+def install():
+    """Install the operators on cfg.Layer (idempotent; imported by the
+    package __init__ the way the reference imports layer_math for its
+    side effect)."""
+    cfg.Layer.__add__ = _add
+    cfg.Layer.__radd__ = _radd
+    cfg.Layer.__sub__ = _sub
+    cfg.Layer.__rsub__ = _rsub
+    cfg.Layer.__mul__ = _mul
+    cfg.Layer.__rmul__ = _rmul
+    cfg.Layer.__truediv__ = _div
+
+
+install()

@@ -1,0 +1,408 @@
+"""v1 layer DSL (reference python/paddle/trainer_config_helpers/layers.py:1).
+
+The v1 configs call ``*_layer`` functions (plus ``mixed_layer`` with
+projections) that in the reference mutate a global ``ModelConfig`` proto
+consumed by the legacy GradientMachine engine
+(``legacy/gserver/layers/``).  Here every call appends fluid-parity ops
+to the same process-global Program the v2 dialect builds
+(``v2/config.py``) — the v1 *API surface* runs on the single TPU
+execution engine.  Curated to the layer set the v1 book/demo configs
+use; the v1 recurrence machinery (``memory``/``recurrent_group``/
+``beam_search``, reference layers.py recurrent_group) is a documented
+design boundary — its capability lives in the fluid-parity
+``DynamicRNN``/``layers.beam_search`` stack (layers/control_flow.py).
+
+``LayerOutput`` is the v2 ``Layer`` handle; the two dialects compose
+(a v1-built layer can feed a v2 call and vice versa).
+"""
+
+from .. import layers as fl
+from ..layer_helper import LayerHelper
+from ..v2 import config as cfg
+from ..v2 import data_type as dt
+from ..v2 import layer as v2_layer
+from ..v2.activation import act_name
+from .poolings import MaxPooling
+
+__all__ = [
+    "LayerOutput", "data_layer", "fc_layer", "embedding_layer",
+    "mixed_layer", "full_matrix_projection", "identity_projection",
+    "table_projection", "dotmul_projection",
+    "img_conv_layer", "img_pool_layer", "batch_norm_layer",
+    "dropout_layer", "concat_layer", "addto_layer", "pooling_layer",
+    "first_seq", "last_seq", "expand_layer", "scaling_layer",
+    "slope_intercept_layer", "power_layer", "trans_layer",
+    "dot_prod_layer", "cos_sim", "maxid_layer", "lstmemory", "grumemory",
+    "classification_cost", "cross_entropy", "square_error_cost",
+    "mse_cost", "regression_cost", "multi_binary_label_cross_entropy",
+    "smooth_l1_cost", "sum_cost", "nce_layer", "hsigmoid", "crf_layer",
+    "crf_decoding_layer", "ctc_layer", "warp_ctc_layer",
+    "memory", "recurrent_group", "beam_search", "get_output_layer",
+]
+
+LayerOutput = cfg.Layer
+
+
+def data_layer(name, size, depth=None, height=None, width=None, type=None,
+               layer_attr=None):
+    """reference layers.py data_layer.  The v1 pipeline took the value
+    kind (dense / integer / sequence) from the PyDataProvider2
+    declaration; on this stack pass ``type=`` a ``v2.data_type`` object
+    for non-dense inputs (default ``dense_vector(size)``) — the provider
+    declaration moved into the config call."""
+    return v2_layer.data(name, type or dt.dense_vector(size),
+                         height=height, width=width)
+
+
+def fc_layer(input, size, act=None, name=None, param_attr=None,
+             bias_attr=None, layer_attr=None):
+    return v2_layer.fc(input, size, act=act, param_attr=param_attr,
+                       bias_attr=bias_attr, name=name)
+
+
+def embedding_layer(input, size, name=None, param_attr=None,
+                    layer_attr=None):
+    return v2_layer.embedding(input, size, param_attr=param_attr, name=name)
+
+
+# ---- mixed_layer + projections -------------------------------------------
+#
+# v1's mixed_layer sums projection outputs (reference layers.py
+# mixed_layer / MixedLayerType); each projection here is a deferred
+# recipe producing a Variable of the mixed layer's width.
+
+class BaseProjection(object):
+    def build(self, size):
+        """Append ops; return the projected Variable of width ``size``
+        (or the input's width for identity-style projections)."""
+        raise NotImplementedError
+
+
+class full_matrix_projection(BaseProjection):
+    """input x W (reference layers.py full_matrix_projection)."""
+
+    def __init__(self, input, size=0, param_attr=None):
+        self.input, self.size, self.param_attr = input, size, param_attr
+
+    def build(self, size):
+        size = self.size or size
+        nfd = 2 if v2_layer._any_seq([self.input]) else 1
+        return fl.fc([self.input.var], size=size, num_flatten_dims=nfd,
+                     bias_attr=False, param_attr=self.param_attr)
+
+
+class identity_projection(BaseProjection):
+    """Pass-through, optionally a [offset, offset+size) column slice
+    (reference layers.py identity_projection)."""
+
+    def __init__(self, input, offset=None, size=None):
+        self.input, self.offset, self.psize = input, offset, size
+
+    def build(self, size):
+        var = self.input.var
+        if self.offset is None:
+            return var
+        width = self.psize or size
+        ax = len(var.shape) - 1
+        return fl.slice(var, axes=[ax], starts=[self.offset],
+                        ends=[self.offset + width])
+
+
+class table_projection(BaseProjection):
+    """Embedding lookup on an integer input (reference layers.py
+    table_projection)."""
+
+    def __init__(self, input, size=0, param_attr=None):
+        self.input, self.size, self.param_attr = input, size, param_attr
+
+    def build(self, size):
+        size = self.size or size
+        if self.input.v2_dim is None:
+            raise ValueError("table_projection input must carry its "
+                             "vocabulary size (an integer data layer)")
+        return fl.embedding(self.input.var, size=[self.input.v2_dim, size],
+                            param_attr=self.param_attr)
+
+
+class dotmul_projection(BaseProjection):
+    """Elementwise scale by a learned [dim] vector (reference layers.py
+    dotmul_projection)."""
+
+    def __init__(self, input, param_attr=None):
+        self.input, self.param_attr = input, param_attr
+
+    def build(self, size):
+        var = self.input.var
+        dim = int(var.shape[-1])
+        helper = LayerHelper("dotmul_projection", param_attr=self.param_attr)
+        w = helper.create_parameter(attr=helper.param_attr, shape=[dim],
+                                    dtype=var.dtype)
+        return fl.elementwise_mul(var, w)
+
+
+class MixedLayerType(object):
+    """``with mixed_layer(size) as m: m += projection`` builder
+    (reference layers.py MixedLayerType).  Also returned pre-finalized
+    when ``mixed_layer(input=[...])`` is called directly."""
+
+    def __init__(self, size, act, bias_attr, name):
+        self.size, self.act, self.bias_attr, self._name = \
+            size, act, bias_attr, name
+        self.projections = []
+        self.finalized = None
+
+    def __iadd__(self, proj):
+        if self.finalized is not None:
+            raise ValueError("mixed_layer already finalized")
+        if not isinstance(proj, BaseProjection):
+            raise TypeError("mixed_layer accepts projection objects, got %r"
+                            % (proj,))
+        self.projections.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._finalize()
+        return False
+
+    def _finalize(self):
+        if not self.projections:
+            raise ValueError("mixed_layer needs at least one projection")
+        with cfg.build():
+            vars_ = [p.build(self.size) for p in self.projections]
+            out = fl.sums(vars_) if len(vars_) > 1 else vars_[0]
+            if self.bias_attr:
+                helper = LayerHelper("mixed_bias", bias_attr=self.bias_attr)
+                b = helper.create_parameter(
+                    attr=helper.bias_attr, shape=[int(out.shape[-1])],
+                    dtype=out.dtype, is_bias=True)
+                out = fl.elementwise_add(out, b)
+            if act_name(self.act):
+                out = getattr(fl, act_name(self.act))(out)
+        parents = [p.input for p in self.projections]
+        self.finalized = cfg.Layer(out, v2_dim=self.size or None,
+                                   parents=parents)
+
+    # LayerOutput duck-typing so a finalized mixed_layer feeds other layers
+    @property
+    def var(self):
+        if self.finalized is None:
+            self._finalize()
+        return self.finalized.var
+
+    @property
+    def v2_dim(self):
+        return self.finalized.v2_dim if self.finalized else self.size
+
+    @property
+    def name(self):
+        return self.var.name
+
+
+def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
+                layer_attr=None):
+    m = MixedLayerType(size, act, bias_attr, name)
+    if input is not None:
+        for proj in input if isinstance(input, (list, tuple)) else [input]:
+            m += proj
+        m._finalize()
+        return m.finalized
+    return m
+
+
+# ---- image / common layers (delegations) ---------------------------------
+
+def img_conv_layer(input, filter_size, num_filters, num_channels=None,
+                   stride=1, padding=0, act=None, groups=1, param_attr=None,
+                   bias_attr=None, name=None, shared_biases=True,
+                   layer_attr=None, trans=False):
+    if trans:
+        raise NotImplementedError("transposed img_conv: use "
+                                  "layers.conv2d_transpose directly")
+    return v2_layer.img_conv(input, filter_size, num_filters,
+                             num_channels=num_channels, stride=stride,
+                             padding=padding, act=act, groups=groups,
+                             param_attr=param_attr, bias_attr=bias_attr,
+                             name=name)
+
+
+def img_pool_layer(input, pool_size, num_channels=None, pool_type=None,
+                   stride=1, padding=0, name=None, ceil_mode=False,
+                   pool_size_y=None, stride_y=None, padding_y=None,
+                   layer_attr=None, exclude_mode=None):
+    """reference layers.py img_pool_layer.  The geometry kwargs the v1
+    engine honored (ceil_mode, non-square *_y variants) all reach
+    pool2d — dropping any of them would silently change output dims."""
+    from ..v2.pooling import img_pool_type
+
+    def _hw(x, y):
+        # v1 *_y kwargs default to the x value; pool2d takes [H, W]
+        return x if y is None else [y, x]
+
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        var = fl.pool2d(img, pool_size=_hw(pool_size, pool_size_y),
+                        pool_type=img_pool_type(pool_type or MaxPooling()),
+                        pool_stride=_hw(stride, stride_y),
+                        pool_padding=_hw(padding, padding_y),
+                        ceil_mode=ceil_mode, name=name)
+    return cfg.Layer(var, parents=[input])
+
+
+def batch_norm_layer(input, act=None, name=None, num_channels=None,
+                     bias_attr=None, param_attr=None, layer_attr=None,
+                     use_global_stats=None, moving_average_fraction=0.9,
+                     batch_norm_type=None, mean_var_names=None):
+    return v2_layer.batch_norm(
+        input, act=act, name=name, num_channels=num_channels,
+        param_attr=param_attr, bias_attr=bias_attr,
+        use_global_stats=use_global_stats,
+        moving_average_fraction=moving_average_fraction)
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    return v2_layer.dropout(input, dropout_rate, name=name)
+
+
+def concat_layer(input, act=None, name=None, layer_attr=None,
+                 bias_attr=None):
+    return v2_layer.concat(input, act=act, name=name)
+
+
+def addto_layer(input, act=None, name=None, bias_attr=None,
+                layer_attr=None):
+    return v2_layer.addto(input, act=act, bias_attr=bias_attr, name=name)
+
+
+def pooling_layer(input, pooling_type=None, name=None, bias_attr=None,
+                  agg_level=None, layer_attr=None):
+    return v2_layer.pooling(input, pooling_type=pooling_type or
+                            MaxPooling(), agg_level=agg_level, name=name)
+
+
+first_seq = v2_layer.first_seq
+last_seq = v2_layer.last_seq
+cos_sim = v2_layer.cos_sim
+maxid_layer = v2_layer.max_id
+lstmemory = v2_layer.lstmemory
+grumemory = v2_layer.grumemory
+
+
+def expand_layer(input, expand_as, name=None, bias_attr=False,
+                 expand_level=None, layer_attr=None):
+    """Broadcast per-sequence values across the timesteps of ``expand_as``
+    (reference layers.py expand_layer -> sequence_expand)."""
+    with cfg.build():
+        var = fl.sequence_expand(input.var, expand_as.var)
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input, expand_as])
+
+
+def scaling_layer(input, weight, name=None, layer_attr=None):
+    """Per-sample scalar multiply: weight is [B, 1] (reference layers.py
+    scaling_layer)."""
+    with cfg.build():
+        var = fl.elementwise_mul(input.var, weight.var)
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input, weight])
+
+
+def slope_intercept_layer(input, name=None, slope=1.0, intercept=0.0,
+                          layer_attr=None):
+    """y = slope * x + intercept (reference layers.py
+    slope_intercept_layer; the layer_math workhorse)."""
+    with cfg.build():
+        var = fl.scale(input.var, scale=float(slope), bias=float(intercept))
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input])
+
+
+def power_layer(input, weight, name=None, layer_attr=None):
+    """y = x ** w with w a per-sample [B, 1] scalar (reference layers.py
+    power_layer)."""
+    with cfg.build():
+        helper = LayerHelper("power")
+        out = helper.create_variable_for_type_inference(input.var.dtype)
+        helper.append_op(type="elementwise_pow",
+                         inputs={"X": [input.var], "Y": [weight.var]},
+                         outputs={"Out": [out]})
+    return cfg.Layer(out, v2_dim=input.v2_dim, parents=[input, weight])
+
+
+def trans_layer(input, name=None, layer_attr=None):
+    """Matrix transpose of a [B, N] -> [N, B] layer (reference layers.py
+    trans_layer)."""
+    with cfg.build():
+        var = fl.transpose(input.var, perm=[1, 0])
+    return cfg.Layer(var, parents=[input])
+
+
+def dot_prod_layer(input1, input2, name=None, layer_attr=None):
+    """Row-wise dot product -> [B, 1] (reference layers.py
+    dot_prod_layer)."""
+    with cfg.build():
+        var = fl.reduce_sum(fl.elementwise_mul(input1.var, input2.var),
+                            dim=-1, keep_dim=True)
+    return cfg.Layer(var, v2_dim=1, parents=[input1, input2])
+
+
+# ---- cost layers ----------------------------------------------------------
+
+classification_cost = v2_layer.classification_cost
+cross_entropy = v2_layer.cross_entropy_cost
+square_error_cost = v2_layer.square_error_cost
+mse_cost = v2_layer.square_error_cost
+regression_cost = v2_layer.square_error_cost
+multi_binary_label_cross_entropy = \
+    v2_layer.multi_binary_label_cross_entropy_cost
+
+
+def smooth_l1_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    with cfg.build():
+        cost = fl.mean(fl.smooth_l1(input.var, label.var))
+        if coeff != 1.0:
+            cost = cost * coeff
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def sum_cost(input, name=None, layer_attr=None):
+    with cfg.build():
+        cost = fl.reduce_sum(input.var)
+    return cfg.Layer(cost, parents=[input])
+
+
+def nce_layer(input, label, num_classes=None, param_attr=None, weight=None,
+              num_neg_samples=10, neg_distribution=None, bias_attr=None,
+              name=None, layer_attr=None):
+    return v2_layer.nce(input, label, num_classes, param_attr=param_attr,
+                        weight=weight, num_neg_samples=num_neg_samples,
+                        neg_distribution=neg_distribution,
+                        bias_attr=bias_attr, name=name)
+
+
+hsigmoid = v2_layer.hsigmoid
+crf_layer = v2_layer.crf
+crf_decoding_layer = v2_layer.crf_decoding
+ctc_layer = v2_layer.ctc
+warp_ctc_layer = v2_layer.ctc
+
+
+# ---- v1 recurrence machinery: documented design boundary ------------------
+
+def memory(*args, **kwargs):
+    raise NotImplementedError(
+        "v1 memory/recurrent_group (reference layers.py recurrent_group) "
+        "is a design boundary: step-level recurrence on this stack is the "
+        "fluid-parity DynamicRNN/StaticRNN (layers/control_flow.py), which "
+        "compiles to lax.scan instead of per-step proto sub-models")
+
+
+recurrent_group = memory
+get_output_layer = memory
+
+
+def beam_search(*args, **kwargs):
+    raise NotImplementedError(
+        "v1 beam_search generation is served by the fluid-parity "
+        "layers.beam_search / beam_search_decode ops (ops/ beam search "
+        "family); see tests/test_rnn_encoder_decoder.py")

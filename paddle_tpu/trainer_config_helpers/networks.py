@@ -1,0 +1,53 @@
+"""v1 composite networks (reference
+python/paddle/trainer_config_helpers/networks.py:1) plus the
+``inputs()``/``outputs()`` config markers.
+
+The composites delegate to the shared v2 network builders (one
+implementation serves both dialects); ``outputs()`` records which layers
+the parsed model exposes — the v1 proto's ``output_layer_names`` — on
+the global v2 graph so ``config_parser_utils.parse_network_config`` can
+report them.
+"""
+
+from ..v2 import config as cfg
+from ..v2 import networks as v2_net
+
+__all__ = [
+    "sequence_conv_pool", "simple_img_conv_pool", "img_conv_group",
+    "simple_lstm", "simple_gru", "bidirectional_lstm",
+    "inputs", "outputs",
+]
+
+sequence_conv_pool = v2_net.sequence_conv_pool
+simple_img_conv_pool = v2_net.simple_img_conv_pool
+img_conv_group = v2_net.img_conv_group
+simple_lstm = v2_net.simple_lstm
+simple_gru = v2_net.simple_gru
+bidirectional_lstm = v2_net.bidirectional_lstm
+
+
+def _flatten(layers):
+    out = []
+    for l in layers:
+        if isinstance(l, (list, tuple)):
+            out.extend(_flatten(l))
+        else:
+            out.append(l)
+    return out
+
+
+def inputs(*layers):
+    """Declare data-layer order (reference networks.py inputs).  The v2
+    graph already records data layers in call order; this re-orders to
+    the declared order so feeding matches the v1 config."""
+    g = cfg.graph()
+    declared = _flatten(layers)
+    names = {l.name for l in declared}
+    rest = [l for l in g.data_layers if l.name not in names]
+    g.data_layers = declared + rest
+
+
+def outputs(*layers):
+    """Mark network outputs (reference networks.py outputs)."""
+    g = cfg.graph()
+    g.output_layers = _flatten(layers)

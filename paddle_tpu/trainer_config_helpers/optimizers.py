@@ -1,0 +1,194 @@
+"""v1 optimizer settings DSL (reference
+python/paddle/trainer_config_helpers/optimizers.py:1).
+
+In the v1 pipeline ``settings()`` mutated the global ``TrainerConfig``
+proto that the ``paddle_trainer`` binary consumed.  Here it records a
+``TrainingSettings`` object in module state; ``config_parser_utils.
+parse_optimizer_config`` returns it, and ``to_v2()`` converts it to the
+v2 optimizer object the (single) execution engine trains with — one
+engine, three API dialects (fluid / v2 / v1 configs).
+"""
+
+from ..v2 import optimizer as v2_opt
+
+__all__ = [
+    "Optimizer", "BaseSGDOptimizer", "MomentumOptimizer", "AdamOptimizer",
+    "AdamaxOptimizer", "AdaGradOptimizer", "DecayedAdaGradOptimizer",
+    "AdaDeltaOptimizer", "RMSPropOptimizer", "L2Regularization",
+    "L1Regularization", "ModelAverage", "GradientClippingThreshold",
+    "settings", "current_settings", "reset_settings",
+]
+
+
+class Optimizer(object):
+    """Base marker (reference optimizers.py:28)."""
+
+
+class BaseSGDOptimizer(Optimizer):
+    v2_class = None
+    kwargs = {}
+
+    def to_v2(self, **common):
+        return self.v2_class(**dict(self.kwargs, **common))
+
+
+class MomentumOptimizer(BaseSGDOptimizer):
+    """reference optimizers.py:74; sparse=True selected the sparse
+    momentum kernel in v1 — the SelectedRows path here is automatic."""
+
+    v2_class = v2_opt.Momentum
+
+    def __init__(self, momentum=None, sparse=False):
+        self.kwargs = {"momentum": momentum if momentum is not None else 0.0}
+
+
+class AdamOptimizer(BaseSGDOptimizer):
+    v2_class = v2_opt.Adam
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.kwargs = {"beta1": beta1, "beta2": beta2, "epsilon": epsilon}
+
+
+class AdamaxOptimizer(BaseSGDOptimizer):
+    v2_class = v2_opt.Adamax
+
+    def __init__(self, beta1=0.9, beta2=0.999):
+        self.kwargs = {"beta1": beta1, "beta2": beta2}
+
+
+class AdaGradOptimizer(BaseSGDOptimizer):
+    v2_class = v2_opt.AdaGrad
+
+    def __init__(self):
+        self.kwargs = {}
+
+
+class DecayedAdaGradOptimizer(BaseSGDOptimizer):
+    v2_class = v2_opt.DecayedAdaGrad
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"rho": rho, "epsilon": epsilon}
+
+
+class AdaDeltaOptimizer(BaseSGDOptimizer):
+    v2_class = v2_opt.AdaDelta
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"rho": rho, "epsilon": epsilon}
+
+
+class RMSPropOptimizer(BaseSGDOptimizer):
+    v2_class = v2_opt.RMSProp
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.kwargs = {"rho": rho, "epsilon": epsilon}
+
+
+class L2Regularization(Optimizer):
+    def __init__(self, rate):
+        self.rate = rate
+
+
+class L1Regularization(Optimizer):
+    def __init__(self, rate):
+        self.rate = rate
+
+
+class ModelAverage(Optimizer):
+    def __init__(self, average_window, max_average_window=None,
+                 do_average_in_cpu=False):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+
+
+class GradientClippingThreshold(Optimizer):
+    def __init__(self, threshold):
+        self.threshold = threshold
+
+
+class TrainingSettings(object):
+    """What ``settings()`` records: batch size, LR schedule, and the
+    update rule (the v1 TrainerConfig's optimization section)."""
+
+    def __init__(self, batch_size, learning_rate, learning_method,
+                 regularization, gradient_clipping_threshold, model_average,
+                 learning_rate_decay_a, learning_rate_decay_b,
+                 learning_rate_schedule):
+        self.batch_size = batch_size
+        self.learning_rate = learning_rate
+        self.learning_method = learning_method
+        self.regularization = regularization
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        self.model_average = model_average
+        self.learning_rate_decay_a = learning_rate_decay_a
+        self.learning_rate_decay_b = learning_rate_decay_b
+        self.learning_rate_schedule = learning_rate_schedule
+
+    def to_v2(self):
+        """Build the v2 optimizer object for the single engine."""
+        if self.learning_rate_decay_a or self.learning_rate_decay_b or \
+                self.learning_rate_schedule not in ("poly", "constant"):
+            # v1 'poly'/'discexp'/... schedules with nonzero decay have
+            # in-graph equivalents, but not through this dialect's
+            # constant-lr optimizer objects — refuse rather than train
+            # at a silently-constant rate
+            raise NotImplementedError(
+                "v1 learning_rate_schedule decay is served by the "
+                "in-graph schedulers (layers/learning_rate_scheduler.py: "
+                "exponential_decay/inverse_time_decay/polynomial_decay); "
+                "build the model through the fluid dialect to use them")
+        method = self.learning_method or MomentumOptimizer(momentum=0.0)
+        common = {"learning_rate": self.learning_rate}
+        if isinstance(self.regularization, (L2Regularization,
+                                            L1Regularization)):
+            # v2 optimizers accept the same regularization objects
+            common["regularization"] = v2_opt.L2Regularization(
+                self.regularization.rate) \
+                if isinstance(self.regularization, L2Regularization) \
+                else v2_opt.L1Regularization(self.regularization.rate)
+        if self.gradient_clipping_threshold:
+            common["gradient_clipping_threshold"] = \
+                self.gradient_clipping_threshold
+        if self.model_average is not None:
+            common["model_average"] = v2_opt.ModelAverage(
+                self.model_average.average_window,
+                self.model_average.max_average_window)
+        return method.to_v2(**common)
+
+
+_settings = None
+
+
+def settings(batch_size, learning_rate=1e-3, learning_rate_decay_a=0.0,
+             learning_rate_decay_b=0.0, learning_rate_schedule="poly",
+             learning_rate_args="", learning_method=None,
+             regularization=None, is_async=False, model_average=None,
+             gradient_clipping_threshold=None, **deprecated):
+    """reference optimizers.py:358.  ``is_async`` selected Async-SGD
+    pserver training — out of scope by the SURVEY §2.4 async ruling."""
+    if is_async:
+        raise NotImplementedError(
+            "async pserver SGD has no TPU analog (SURVEY.md §2.4); train "
+            "synchronously or use the mesh runtime")
+    if learning_method is not None and not isinstance(learning_method,
+                                                     BaseSGDOptimizer):
+        raise TypeError("learning_method must be a *Optimizer object")
+    global _settings
+    _settings = TrainingSettings(
+        batch_size=batch_size, learning_rate=learning_rate,
+        learning_method=learning_method, regularization=regularization,
+        gradient_clipping_threshold=gradient_clipping_threshold,
+        model_average=model_average,
+        learning_rate_decay_a=learning_rate_decay_a,
+        learning_rate_decay_b=learning_rate_decay_b,
+        learning_rate_schedule=learning_rate_schedule)
+    return _settings
+
+
+def current_settings():
+    return _settings
+
+
+def reset_settings():
+    global _settings
+    _settings = None

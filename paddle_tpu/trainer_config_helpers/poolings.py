@@ -1,0 +1,32 @@
+"""v1 pooling objects (reference
+python/paddle/trainer_config_helpers/poolings.py:1).  Aliases of the
+canonical v2 pooling objects, plus the sqrt-scaled sum pooling the v1
+DSL exposed for bag-of-words layers."""
+
+from ..v2 import pooling as _pool
+
+__all__ = ["BasePoolingType", "MaxPooling", "AvgPooling", "SumPooling",
+           "CudnnMaxPooling", "CudnnAvgPooling", "SquareRootNPooling",
+           "MaxWithIdPooling"]
+
+BasePoolingType = _pool.BasePool
+MaxPooling = _pool.Max
+AvgPooling = _pool.Avg
+SumPooling = _pool.Sum
+CudnnMaxPooling = _pool.CudnnMax
+CudnnAvgPooling = _pool.CudnnAvg
+
+
+class SquareRootNPooling(_pool.BasePool):
+    """sum / sqrt(len) sequence pooling (reference poolings.py
+    SquareRootNPooling); maps to the sequence_pool "sqrt" pooltype."""
+    seq_type = "sqrt"
+    img_type = "avg"
+
+
+class MaxWithIdPooling(_pool.BasePool):
+    """Max pooling that also records argmax indices in the v1 engine;
+    on this stack the indices are recomputed where needed (maxid),
+    so it degrades to plain max pooling."""
+    seq_type = "max"
+    img_type = "max"

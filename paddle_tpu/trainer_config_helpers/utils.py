@@ -1,0 +1,26 @@
+"""Misc DSL helpers (reference
+python/paddle/trainer_config_helpers/utils.py:1)."""
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(instead=None):
+    """Mark a config helper as deprecated, pointing at the replacement
+    (the reference's deprecated_wrapper logs through the config
+    parser)."""
+
+    def decorator(func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            msg = "%s is deprecated" % func.__name__
+            if instead:
+                msg += "; use %s instead" % instead
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

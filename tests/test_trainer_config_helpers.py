@@ -1,0 +1,228 @@
+"""The v1 trainer-config DSL dialect (reference
+python/paddle/trainer_config_helpers/) re-hosted on the Program IR:
+``*_layer`` calls, mixed_layer projections, layer math, settings(),
+parse_network_config, and composition with the v2 trainer for
+execution — three API dialects, one engine.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import paddle_tpu.trainer_config_helpers as tch
+from paddle_tpu import v2 as paddle
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    tch.reset_parser()
+    yield
+    tch.reset_parser()
+
+
+def test_parse_network_config_mnist_style():
+    def net():
+        img = tch.data_layer("img", size=784, height=28, width=28)
+        conv = tch.simple_img_conv_pool(img, filter_size=5, num_filters=8,
+                                        pool_size=2, pool_stride=2,
+                                        act="relu")
+        hidden = tch.fc_layer(conv, size=64, act=tch.ReluActivation())
+        pred = tch.fc_layer(hidden, size=10, act=tch.SoftmaxActivation())
+        lbl = tch.data_layer(
+            "label", size=10,
+            type=paddle.data_type.integer_value(10))
+        cost = tch.classification_cost(input=pred, label=lbl)
+        tch.outputs(cost)
+
+    model = tch.parse_network_config(net)
+    assert model.input_layer_names == ["img", "label"]
+    assert len(model.output_layer_names) == 1
+    d = model.to_dict()
+    op_types = [op["type"] for b in d["program"]["blocks"]
+                for op in b["ops"]]
+    assert "conv2d" in op_types and "cross_entropy" in op_types
+
+
+def test_mixed_layer_context_and_direct_forms():
+    x = tch.data_layer("x", size=6)
+    ids = tch.data_layer("ids", size=0,
+                         type=paddle.data_type.integer_value(20))
+    with tch.mixed_layer(size=4, bias_attr=True,
+                         act=tch.ReluActivation()) as m:
+        m += tch.full_matrix_projection(x)
+        m += tch.table_projection(ids, size=4)
+    direct = tch.mixed_layer(input=[tch.identity_projection(x, offset=2,
+                                                            size=4)])
+    assert m.var.shape[-1] == 4
+    assert direct.var.shape[-1] == 4
+    dm = tch.mixed_layer(input=tch.dotmul_projection(x))
+    assert dm.var.shape[-1] == 6
+
+
+def test_mixed_layer_rejects_bad_input():
+    x = tch.data_layer("x", size=6)
+    m = tch.mixed_layer(size=4)
+    with pytest.raises(TypeError):
+        m += x  # a Layer is not a projection
+    with pytest.raises(ValueError):
+        tch.mixed_layer(input=[])
+
+
+def test_layer_math_numerics():
+    """0.5 * x + 2 - x == 2 - 0.5 x, checked through infer."""
+    x = tch.data_layer("x", size=3)
+    y = 0.5 * x + 2 - x
+    params = paddle.parameters.create(y)
+    xs = np.arange(6, dtype="float32").reshape(2, 3)
+    out = paddle.infer(output_layer=y, parameters=params,
+                       input=[(row,) for row in xs])
+    np.testing.assert_allclose(out, 2.0 - 0.5 * xs, rtol=1e-5)
+
+
+def test_elementwise_and_seq_layers_shapes():
+    a = tch.data_layer("a", size=5)
+    b = tch.data_layer("b", size=5)
+    prod = tch.dot_prod_layer(a, b)
+    assert prod.var.shape[-1] == 1
+    mul = a * b
+    assert mul.var.shape[-1] == 5
+    sc = tch.scaling_layer(a, prod)
+    assert sc.var.shape[-1] == 5
+    cost = tch.smooth_l1_cost(a, b)
+    assert cost.var.shape in ((), (1,))
+
+
+def test_settings_maps_to_v2_optimizer():
+    st = tch.settings(
+        batch_size=32, learning_rate=0.01,
+        learning_method=tch.AdamOptimizer(beta1=0.8),
+        regularization=tch.L2Regularization(1e-4),
+        gradient_clipping_threshold=5.0,
+        model_average=tch.ModelAverage(average_window=0.5))
+    v2opt = st.to_v2()
+    assert isinstance(v2opt, paddle.optimizer.Adam)
+    assert v2opt.beta1 == 0.8
+    assert v2opt.learning_rate == 0.01
+    assert v2opt.gradient_clipping_threshold == 5.0
+    fluid_opt = v2opt.to_optimizer()
+    assert type(fluid_opt).__name__ == "AdamOptimizer"
+
+
+def test_settings_async_refused():
+    with pytest.raises(NotImplementedError):
+        tch.settings(batch_size=8, is_async=True)
+
+
+def test_settings_lr_decay_refused_not_silently_constant():
+    st = tch.settings(batch_size=8, learning_rate=0.1,
+                      learning_rate_decay_a=0.5,
+                      learning_rate_decay_b=0.75,
+                      learning_rate_schedule="discexp")
+    with pytest.raises(NotImplementedError):
+        st.to_v2()
+
+
+def test_img_pool_geometry_kwargs_honored():
+    img = tch.data_layer("im", size=1 * 7 * 7, height=7, width=7)
+    ceil = tch.img_pool_layer(img, pool_size=2, stride=2, ceil_mode=True)
+    floor = tch.img_pool_layer(img, pool_size=2, stride=2)
+    assert ceil.var.shape[-2:] == (4, 4)
+    assert floor.var.shape[-2:] == (3, 3)
+    rect = tch.img_pool_layer(img, pool_size=3, pool_size_y=2,
+                              stride=2, stride_y=1)
+    assert rect.var.shape[-2:] == (6, 3)
+
+
+def test_v1_config_trains_end_to_end():
+    """A full v1-style config (settings + network + outputs) trains
+    through the v2 trainer: the dialects share one graph + engine."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype("float32")
+    xs = rng.randn(128, 4).astype("float32")
+    ys = xs @ w + 0.01 * rng.randn(128, 1).astype("float32")
+
+    tch.settings(batch_size=32, learning_rate=0.1,
+                 learning_method=tch.MomentumOptimizer(momentum=0.9))
+    x = tch.data_layer("x", size=4)
+    pred = tch.fc_layer(x, size=1)
+    lbl = tch.data_layer("y", size=1)
+    cost = tch.square_error_cost(input=pred, label=lbl)
+    tch.outputs(cost)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 tch.current_settings().to_v2())
+
+    def reader():
+        for x_, y_ in zip(xs, ys):
+            yield x_, y_
+
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    trainer.train(paddle.batch(reader, 32), num_passes=8,
+                  event_handler=handler)
+    assert costs[-1] < costs[0] * 0.2, (costs[0], costs[-1])
+
+
+def test_data_sources_resolve():
+    mod = types.ModuleType("_tch_provider_mod")
+
+    def process(file_list, args):
+        for i in range(3):
+            yield [float(i)], [float(2 * i)]
+
+    mod.process = process
+    sys.modules["_tch_provider_mod"] = mod
+    try:
+        tch.define_py_data_sources2(
+            train_list="train.list", test_list=None,
+            module="_tch_provider_mod", obj="process")
+        make = tch.resolve_provider("train")
+        rows = list(make())
+        assert len(rows) == 3 and rows[2] == ([2.0], [4.0])
+        with pytest.raises(KeyError):
+            tch.resolve_provider("test")
+    finally:
+        del sys.modules["_tch_provider_mod"]
+
+
+def test_evaluators_register_on_graph():
+    x = tch.data_layer("x", size=8)
+    pred = tch.fc_layer(x, size=3, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer("l", size=0,
+                         type=paddle.data_type.integer_value(3))
+    tch.classification_error_evaluator(input=pred, label=lbl,
+                                       name="err")
+    tch.sum_evaluator(pred, name="s")
+    from paddle_tpu.v2 import config as cfg
+    names = [e[0] for e in cfg.graph().evaluators]
+    assert "err" in names and "s" in names
+
+
+def test_wrap_decorators():
+    @tch.wrap_name_default("mylayer")
+    @tch.wrap_act_default(act=tch.ReluActivation())
+    def custom(input, name=None, act=None):
+        return name, act
+
+    name, act = custom("in")
+    assert name.startswith("mylayer")
+    assert isinstance(act, tch.ReluActivation)
+
+    # positional None must be filled too, not produce a duplicate kwarg
+    name2, act2 = custom("in", None, None)
+    assert name2.startswith("mylayer")
+    assert isinstance(act2, tch.ReluActivation)
+
+
+def test_recurrent_group_is_design_boundary():
+    with pytest.raises(NotImplementedError):
+        tch.recurrent_group(step=None, input=[])
+    with pytest.raises(NotImplementedError):
+        tch.beam_search()

@@ -19,10 +19,16 @@ __all__ = ["parse_network_config", "parse_optimizer_config",
 
 
 def reset_parser():
-    """Fresh global state (reference config_parser_utils.reset_parser)."""
+    """Fresh global state (reference config_parser_utils.reset_parser).
+    Also resets the unique-name generator so re-parsing the identical
+    config yields the identical serialized model (parameter names are
+    the save/load keys — a drifting suffix would break re-parse +
+    load-by-name workflows)."""
+    from .. import unique_name
     cfg.reset()
     optimizers.reset_settings()
     data_sources.reset_data_sources()
+    unique_name.switch()
 
 
 class ParsedModel(object):

@@ -31,18 +31,19 @@ def define_py_data_sources2(train_list, test_list, module, obj, args=None):
     data_sources.py define_py_data_sources2).  ``obj`` may differ per
     split via a dict {"train": ..., "test": ...} as in v1."""
 
-    def _obj(split):
-        if isinstance(obj, dict):
-            return obj[split]
-        return obj
+    def _split(v, split):
+        # obj and args may each be a {"train": ..., "test": ...} dict
+        if isinstance(v, dict) and set(v) <= {"train", "test"} and v:
+            return v[split]
+        return v
 
     global _sources
     if train_list is not None:
-        _sources["train"] = DataSourceSpec(train_list, module, _obj("train"),
-                                           args)
+        _sources["train"] = DataSourceSpec(
+            train_list, module, _split(obj, "train"), _split(args, "train"))
     if test_list is not None:
-        _sources["test"] = DataSourceSpec(test_list, module, _obj("test"),
-                                          args)
+        _sources["test"] = DataSourceSpec(
+            test_list, module, _split(obj, "test"), _split(args, "test"))
 
 
 def current_data_sources():

@@ -20,24 +20,31 @@ auc_evaluator = v2_eval.auc
 value_printer_evaluator = v2_eval.value_printer
 
 
+def _register(name, default_prefix, build_fn):
+    """Unnamed evaluators get a unique name (the reference wraps these
+    in wrap_name_default) so two unnamed registrations coexist; an
+    explicit name replaces a prior registration under that name."""
+    from .. import unique_name
+    with cfg.build() as g:
+        s = build_fn()
+        if name is None:
+            name = unique_name.generate(default_prefix)
+        else:
+            g.evaluators = [e for e in g.evaluators if e[0] != name]
+        g.evaluators.append((name, s, None))
+    return s
+
+
 def sum_evaluator(input, name=None, weight=None):
     """Sum of the input over the batch (reference evaluators.py
     sum_evaluator)."""
     from .. import layers as fl
-    name = name or "sum_evaluator"
-    with cfg.build() as g:
-        s = fl.reduce_sum(cfg.unwrap(input))
-        g.evaluators = [e for e in g.evaluators if e[0] != name]
-        g.evaluators.append((name, s, None))
-    return s
+    return _register(name, "sum_evaluator",
+                     lambda: fl.reduce_sum(cfg.unwrap(input)))
 
 
 def column_sum_evaluator(input, name=None, weight=None):
     """Per-column sums (reference evaluators.py column_sum_evaluator)."""
     from .. import layers as fl
-    name = name or "column_sum_evaluator"
-    with cfg.build() as g:
-        s = fl.reduce_sum(cfg.unwrap(input), dim=0)
-        g.evaluators = [e for e in g.evaluators if e[0] != name]
-        g.evaluators.append((name, s, None))
-    return s
+    return _register(name, "column_sum_evaluator",
+                     lambda: fl.reduce_sum(cfg.unwrap(input), dim=0))

@@ -66,17 +66,17 @@ def _div(self, other):
     return tch.slope_intercept_layer(self, slope=1.0 / float(other))
 
 
-def install():
-    """Install the operators on cfg.Layer (idempotent; imported by the
-    package __init__ the way the reference imports layer_math for its
-    side effect)."""
-    cfg.Layer.__add__ = _add
-    cfg.Layer.__radd__ = _radd
-    cfg.Layer.__sub__ = _sub
-    cfg.Layer.__rsub__ = _rsub
-    cfg.Layer.__mul__ = _mul
-    cfg.Layer.__rmul__ = _rmul
-    cfg.Layer.__truediv__ = _div
+def install_on(cls):
+    """Install the operators on a LayerOutput-duck-typed class
+    (cfg.Layer here; layers.MixedLayerType installs itself too so a
+    context-manager-built mixed_layer supports layer math)."""
+    cls.__add__ = _add
+    cls.__radd__ = _radd
+    cls.__sub__ = _sub
+    cls.__rsub__ = _rsub
+    cls.__mul__ = _mul
+    cls.__rmul__ = _rmul
+    cls.__truediv__ = _div
 
 
-install()
+install_on(cfg.Layer)

@@ -43,6 +43,25 @@ __all__ = [
 LayerOutput = cfg.Layer
 
 
+def _apply_extra(layer, layer_attr):
+    """Honor ExtraLayerAttribute on a built layer: ``drop_rate`` appends
+    a dropout op; ``error_clipping_threshold`` sets the output var's
+    backward error clip (consumed by clip.error_clip_callback during
+    append_backward) — the two v1 extras that are meaningful on this
+    stack (attrs.py)."""
+    if layer_attr is None:
+        return layer
+    if getattr(layer_attr, "error_clipping_threshold", None):
+        from ..clip import ErrorClipByValue
+        layer.var.error_clip = ErrorClipByValue(
+            max=layer_attr.error_clipping_threshold)
+    if getattr(layer_attr, "drop_rate", None):
+        with cfg.build():
+            var = fl.dropout(layer.var, dropout_prob=layer_attr.drop_rate)
+        return cfg.Layer(var, v2_dim=layer.v2_dim, parents=[layer])
+    return layer
+
+
 def data_layer(name, size, depth=None, height=None, width=None, type=None,
                layer_attr=None):
     """reference layers.py data_layer.  The v1 pipeline took the value
@@ -56,13 +75,16 @@ def data_layer(name, size, depth=None, height=None, width=None, type=None,
 
 def fc_layer(input, size, act=None, name=None, param_attr=None,
              bias_attr=None, layer_attr=None):
-    return v2_layer.fc(input, size, act=act, param_attr=param_attr,
-                       bias_attr=bias_attr, name=name)
+    return _apply_extra(
+        v2_layer.fc(input, size, act=act, param_attr=param_attr,
+                    bias_attr=bias_attr, name=name), layer_attr)
 
 
 def embedding_layer(input, size, name=None, param_attr=None,
                     layer_attr=None):
-    return v2_layer.embedding(input, size, param_attr=param_attr, name=name)
+    return _apply_extra(
+        v2_layer.embedding(input, size, param_attr=param_attr, name=name),
+        layer_attr)
 
 
 # ---- mixed_layer + projections -------------------------------------------
@@ -182,9 +204,14 @@ class MixedLayerType(object):
                 out = fl.elementwise_add(out, b)
             if act_name(self.act):
                 out = getattr(fl, act_name(self.act))(out)
+            if self._name:
+                # identity op carrying the configured name into the
+                # program, so lookups by the v1 layer name resolve
+                out = fl.scale(out, scale=1.0, name=self._name)
         parents = [p.input for p in self.projections]
-        self.finalized = cfg.Layer(out, v2_dim=self.size or None,
-                                   parents=parents)
+        self.finalized = _apply_extra(
+            cfg.Layer(out, v2_dim=self.size or None, parents=parents),
+            getattr(self, "_layer_attr", None))
 
     # LayerOutput duck-typing so a finalized mixed_layer feeds other layers
     @property
@@ -202,6 +229,11 @@ class MixedLayerType(object):
         return self.var.name
 
 
+from . import layer_math as _layer_math
+
+_layer_math.install_on(MixedLayerType)
+
+
 def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
                 layer_attr=None):
     m = MixedLayerType(size, act, bias_attr, name)
@@ -209,7 +241,8 @@ def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
         for proj in input if isinstance(input, (list, tuple)) else [input]:
             m += proj
         m._finalize()
-        return m.finalized
+        return _apply_extra(m.finalized, layer_attr)
+    m._layer_attr = layer_attr
     return m
 
 
@@ -222,11 +255,12 @@ def img_conv_layer(input, filter_size, num_filters, num_channels=None,
     if trans:
         raise NotImplementedError("transposed img_conv: use "
                                   "layers.conv2d_transpose directly")
-    return v2_layer.img_conv(input, filter_size, num_filters,
-                             num_channels=num_channels, stride=stride,
-                             padding=padding, act=act, groups=groups,
-                             param_attr=param_attr, bias_attr=bias_attr,
-                             name=name)
+    return _apply_extra(
+        v2_layer.img_conv(input, filter_size, num_filters,
+                          num_channels=num_channels, stride=stride,
+                          padding=padding, act=act, groups=groups,
+                          param_attr=param_attr, bias_attr=bias_attr,
+                          name=name), layer_attr)
 
 
 def img_pool_layer(input, pool_size, num_channels=None, pool_type=None,
@@ -249,18 +283,18 @@ def img_pool_layer(input, pool_size, num_channels=None, pool_type=None,
                         pool_stride=_hw(stride, stride_y),
                         pool_padding=_hw(padding, padding_y),
                         ceil_mode=ceil_mode, name=name)
-    return cfg.Layer(var, parents=[input])
+    return _apply_extra(cfg.Layer(var, parents=[input]), layer_attr)
 
 
 def batch_norm_layer(input, act=None, name=None, num_channels=None,
                      bias_attr=None, param_attr=None, layer_attr=None,
                      use_global_stats=None, moving_average_fraction=0.9,
                      batch_norm_type=None, mean_var_names=None):
-    return v2_layer.batch_norm(
+    return _apply_extra(v2_layer.batch_norm(
         input, act=act, name=name, num_channels=num_channels,
         param_attr=param_attr, bias_attr=bias_attr,
         use_global_stats=use_global_stats,
-        moving_average_fraction=moving_average_fraction)
+        moving_average_fraction=moving_average_fraction), layer_attr)
 
 
 def dropout_layer(input, dropout_rate, name=None):
@@ -269,26 +303,59 @@ def dropout_layer(input, dropout_rate, name=None):
 
 def concat_layer(input, act=None, name=None, layer_attr=None,
                  bias_attr=None):
-    return v2_layer.concat(input, act=act, name=name)
+    return _apply_extra(v2_layer.concat(input, act=act, name=name),
+                        layer_attr)
 
 
 def addto_layer(input, act=None, name=None, bias_attr=None,
                 layer_attr=None):
-    return v2_layer.addto(input, act=act, bias_attr=bias_attr, name=name)
+    return _apply_extra(
+        v2_layer.addto(input, act=act, bias_attr=bias_attr, name=name),
+        layer_attr)
 
 
 def pooling_layer(input, pooling_type=None, name=None, bias_attr=None,
                   agg_level=None, layer_attr=None):
-    return v2_layer.pooling(input, pooling_type=pooling_type or
-                            MaxPooling(), agg_level=agg_level, name=name)
+    return _apply_extra(
+        v2_layer.pooling(input, pooling_type=pooling_type or MaxPooling(),
+                         agg_level=agg_level, name=name), layer_attr)
 
 
-first_seq = v2_layer.first_seq
-last_seq = v2_layer.last_seq
-cos_sim = v2_layer.cos_sim
-maxid_layer = v2_layer.max_id
-lstmemory = v2_layer.lstmemory
-grumemory = v2_layer.grumemory
+def first_seq(input, name=None, layer_attr=None, **kwargs):
+    return _apply_extra(v2_layer.first_seq(input, name=name, **kwargs),
+                        layer_attr)
+
+
+def last_seq(input, name=None, layer_attr=None, **kwargs):
+    return _apply_extra(v2_layer.last_seq(input, name=name, **kwargs),
+                        layer_attr)
+
+
+def cos_sim(a, b, scale=1, name=None, layer_attr=None):
+    return _apply_extra(v2_layer.cos_sim(a, b, scale=scale, name=name),
+                        layer_attr)
+
+
+def maxid_layer(input, name=None, layer_attr=None):
+    return _apply_extra(v2_layer.max_id(input, name=name), layer_attr)
+
+
+def lstmemory(input, size=None, reverse=False, act=None, gate_act=None,
+              state_act=None, bias_attr=None, param_attr=None, name=None,
+              layer_attr=None):
+    return _apply_extra(
+        v2_layer.lstmemory(input, size=size, reverse=reverse, act=act,
+                           gate_act=gate_act, state_act=state_act,
+                           bias_attr=bias_attr, param_attr=param_attr,
+                           name=name), layer_attr)
+
+
+def grumemory(input, size=None, reverse=False, act=None, gate_act=None,
+              bias_attr=None, param_attr=None, name=None, layer_attr=None):
+    return _apply_extra(
+        v2_layer.grumemory(input, size=size, reverse=reverse, act=act,
+                           gate_act=gate_act, bias_attr=bias_attr,
+                           param_attr=param_attr, name=name), layer_attr)
 
 
 def expand_layer(input, expand_as, name=None, bias_attr=False,
@@ -297,7 +364,8 @@ def expand_layer(input, expand_as, name=None, bias_attr=False,
     (reference layers.py expand_layer -> sequence_expand)."""
     with cfg.build():
         var = fl.sequence_expand(input.var, expand_as.var)
-    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input, expand_as])
+    return _apply_extra(cfg.Layer(var, v2_dim=input.v2_dim,
+                                  parents=[input, expand_as]), layer_attr)
 
 
 def scaling_layer(input, weight, name=None, layer_attr=None):
@@ -305,7 +373,8 @@ def scaling_layer(input, weight, name=None, layer_attr=None):
     scaling_layer)."""
     with cfg.build():
         var = fl.elementwise_mul(input.var, weight.var)
-    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input, weight])
+    return _apply_extra(cfg.Layer(var, v2_dim=input.v2_dim,
+                                  parents=[input, weight]), layer_attr)
 
 
 def slope_intercept_layer(input, name=None, slope=1.0, intercept=0.0,
@@ -314,7 +383,8 @@ def slope_intercept_layer(input, name=None, slope=1.0, intercept=0.0,
     slope_intercept_layer; the layer_math workhorse)."""
     with cfg.build():
         var = fl.scale(input.var, scale=float(slope), bias=float(intercept))
-    return cfg.Layer(var, v2_dim=input.v2_dim, parents=[input])
+    return _apply_extra(cfg.Layer(var, v2_dim=input.v2_dim,
+                                  parents=[input]), layer_attr)
 
 
 def power_layer(input, weight, name=None, layer_attr=None):
@@ -326,7 +396,8 @@ def power_layer(input, weight, name=None, layer_attr=None):
         helper.append_op(type="elementwise_pow",
                          inputs={"X": [input.var], "Y": [weight.var]},
                          outputs={"Out": [out]})
-    return cfg.Layer(out, v2_dim=input.v2_dim, parents=[input, weight])
+    return _apply_extra(cfg.Layer(out, v2_dim=input.v2_dim,
+                                  parents=[input, weight]), layer_attr)
 
 
 def trans_layer(input, name=None, layer_attr=None):
@@ -334,7 +405,7 @@ def trans_layer(input, name=None, layer_attr=None):
     trans_layer)."""
     with cfg.build():
         var = fl.transpose(input.var, perm=[1, 0])
-    return cfg.Layer(var, parents=[input])
+    return _apply_extra(cfg.Layer(var, parents=[input]), layer_attr)
 
 
 def dot_prod_layer(input1, input2, name=None, layer_attr=None):
@@ -343,7 +414,8 @@ def dot_prod_layer(input1, input2, name=None, layer_attr=None):
     with cfg.build():
         var = fl.reduce_sum(fl.elementwise_mul(input1.var, input2.var),
                             dim=-1, keep_dim=True)
-    return cfg.Layer(var, v2_dim=1, parents=[input1, input2])
+    return _apply_extra(cfg.Layer(var, v2_dim=1,
+                                  parents=[input1, input2]), layer_attr)
 
 
 # ---- cost layers ----------------------------------------------------------

@@ -23,6 +23,17 @@ def ParamAttr(name=None, initial_std=None, initial_mean=None, is_static=None,
     from .. import regularizer
 
     kw = {}
+    if momentum is not None:
+        raise NotImplementedError(
+            "per-parameter momentum override is a v1 updater feature with "
+            "no fluid-parity analog; set momentum on the optimizer")
+    if gradient_clipping_threshold is not None:
+        # v1 clipped each gradient element into [-t, t] (legacy updater
+        # clipping); the per-param GradientClipByValue hook is the analog
+        from .. import clip as clip_mod
+        kw["gradient_clip"] = clip_mod.GradientClipByValue(
+            max=gradient_clipping_threshold,
+            min=-gradient_clipping_threshold)
     if name is not None:
         kw["name"] = name
     if initializer is not None:

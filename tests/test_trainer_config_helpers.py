@@ -221,6 +221,69 @@ def test_wrap_decorators():
     assert isinstance(act2, tch.ReluActivation)
 
 
+def test_reset_parser_reparse_is_deterministic():
+    def net():
+        x = tch.data_layer("x", size=4)
+        pred = tch.fc_layer(x, size=2)
+        tch.outputs(pred)
+
+    d1 = tch.parse_network_config(net).to_dict()
+    d2 = tch.parse_network_config(net).to_dict()
+    assert d1 == d2  # param names are save/load keys; no drifting suffix
+
+
+def test_unnamed_evaluators_coexist():
+    a = tch.data_layer("a", size=2)
+    b = tch.data_layer("b", size=2)
+    tch.sum_evaluator(a)
+    tch.sum_evaluator(b)
+    from paddle_tpu.v2 import config as cfg
+    names = [e[0] for e in cfg.graph().evaluators]
+    assert len(names) == 2 and len(set(names)) == 2
+
+
+def test_mixed_layer_math_and_name():
+    x = tch.data_layer("x", size=4)
+    with tch.mixed_layer(size=4, name="score") as m:
+        m += tch.full_matrix_projection(x)
+    doubled = 2 * m  # layer math on a context-built mixed layer
+    assert doubled.var.shape[-1] == 4
+    assert "score" in m.name  # configured name reaches the program
+
+
+def test_layer_attr_drop_rate_and_error_clip():
+    from paddle_tpu.clip import ErrorClipByValue
+    x = tch.data_layer("x", size=4)
+    h = tch.fc_layer(x, size=8, act=tch.ReluActivation(),
+                     layer_attr=tch.ExtraAttr(drop_rate=0.5,
+                                              error_clipping_threshold=2.0))
+    # drop_rate appended a dropout op on the fc output
+    from paddle_tpu.v2 import config as cfg
+    op_types = [op.type for op in cfg.graph().main.current_block().ops]
+    assert "dropout" in op_types
+    # error clip landed on the pre-dropout var
+    clipped = h.parents[0]
+    assert isinstance(clipped.var.error_clip, ErrorClipByValue)
+
+
+def test_param_attr_gradient_clip_and_momentum():
+    from paddle_tpu.clip import GradientClipByValue
+    pa = tch.ParameterAttribute(gradient_clipping_threshold=3.0)
+    assert isinstance(pa.gradient_clip, GradientClipByValue)
+    assert pa.gradient_clip.max == 3.0 and pa.gradient_clip.min == -3.0
+    with pytest.raises(NotImplementedError):
+        tch.ParameterAttribute(momentum=0.5)
+
+
+def test_data_sources_args_split():
+    tch.define_py_data_sources2(
+        train_list="t.list", test_list="e.list", module="m", obj="process",
+        args={"train": {"f": 1}, "test": {"f": 2}})
+    src = tch.current_data_sources()
+    assert src["train"].args == {"f": 1}
+    assert src["test"].args == {"f": 2}
+
+
 def test_recurrent_group_is_design_boundary():
     with pytest.raises(NotImplementedError):
         tch.recurrent_group(step=None, input=[])

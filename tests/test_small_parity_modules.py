@@ -92,3 +92,74 @@ def test_net_drawer(tmp_path):
     dot = open(out).read()
     assert "digraph" in dot and "mul" in dot
     assert (tmp_path / "s.dot").exists()
+
+
+def test_default_scope_funcs_stack():
+    """default_scope_funcs: thread-local scope stack (reference
+    default_scope_funcs.py:1)."""
+    from paddle_tpu import default_scope_funcs as dsf
+
+    root = dsf.get_cur_scope()
+    dsf.var("x")
+    assert dsf.find_var("x") is not None
+
+    inner = dsf.enter_local_scope()
+    assert dsf.get_cur_scope() is inner
+    dsf.var("y")
+    assert dsf.find_var("x") is not None  # parent lookup
+    dsf.leave_local_scope()
+    assert dsf.get_cur_scope() is root
+    assert not root.has_var("y")
+
+    seen = []
+    dsf.scoped_function(lambda: seen.append(dsf.var("tmp")))
+    assert seen and dsf.get_cur_scope() is root
+    with pytest.raises(RuntimeError):
+        dsf.leave_local_scope()
+
+
+def test_annotations_deprecated():
+    from paddle_tpu.annotations import deprecated
+
+    @deprecated(since="0.1", instead="new_fn")
+    def old_fn(a):
+        return a + 1
+
+    import warnings
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old_fn(1) == 2
+    assert any("deprecated since 0.1" in str(x.message) for x in w)
+    assert "new_fn" in old_fn.__doc__
+
+
+def test_op_factory_builds_runnable_spec():
+    """op.Operator builds an op-spec dict the Block accepts (reference
+    op.py OperatorFactory -> OpDesc)."""
+    from paddle_tpu.op import Operator, get_all_op_protos
+
+    protos = get_all_op_protos()
+    assert len(protos) > 200 and all(p.type for p in protos)
+
+    spec = Operator("scale", X="x", Out="y", scale=3.0, bias=1.0)
+    assert spec["inputs"]["X"] == ["x"] and spec["attrs"]["scale"] == 3.0
+
+    with pytest.raises(ValueError):
+        Operator("scale", "positional")
+    with pytest.raises(KeyError):
+        Operator("not_an_op")
+
+    # the spec drives Block.append_op end-to-end
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3])
+        y = main.current_block().create_var(name="y", dtype="float32")
+        main.current_block().append_op(**Operator(
+            "scale", X=x.name, Out="y", scale=3.0, bias=1.0))
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            out, = exe.run(feed={"x": np.ones((2, 3), "float32")},
+                           fetch_list=["y"])
+    np.testing.assert_allclose(out, 4.0 * np.ones((2, 3)), rtol=1e-6)

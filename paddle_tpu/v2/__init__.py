@@ -59,9 +59,10 @@ __all__ = [
 
 reset = config.reset
 
-# the Go master's task-lease client lives in cloud/ (reference
+# the Go master's task-lease machinery lives in cloud/; v2/master.py
+# wraps it in the reference client surface (reference
 # python/paddle/v2/master/client.py -> go/master/service.go)
-from .. import cloud as master  # noqa: F401,E402
+from . import master  # noqa: F401,E402
 
 
 _default_place = None

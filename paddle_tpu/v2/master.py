@@ -1,0 +1,84 @@
+"""v2 master client (reference python/paddle/v2/master/client.py:29).
+
+The reference client is a cgo binding onto the Go master (etcd
+discovery, record-level ``next_record`` over leased chunks,
+save-model arbitration, ``go/master/service.go:368``).  Here the same
+surface wraps the TPU stack's elastic coordinator (cloud/master.py
+task-lease state machine + cloud/server.py TCP transport): etcd
+endpoints become the master's TCP address (discovery is the
+jax.distributed-era control plane; durability is the master's snapshot
+store), and records stream from recordio chunks leased per task.
+"""
+
+from ..cloud.master import (AllTasksFailed, MasterService, NoMoreAvailable,
+                            PassAfter, PassBefore)
+from ..cloud.reader import master_reader
+
+__all__ = ["client"]
+
+
+def _chunk_records(chunk):
+    """Materialize one leased chunk descriptor {'path', 'skip'}."""
+    from .. import recordio
+    with recordio.Scanner(chunk["path"], skip_chunks=chunk["skip"],
+                          max_chunks=1) as sc:
+        for rec in sc:
+            yield rec
+
+
+class client(object):
+    """Trainer-side master client (reference client.py:29).
+
+    ``addr`` is a ``host:port`` master address or an in-process
+    ``MasterService`` (the transports share one surface — the dist
+    tests drive both)."""
+
+    def __init__(self, addr, timeout_sec=30.0, buf_size=0):
+        if isinstance(addr, MasterService):
+            self.c = addr
+        else:
+            from ..cloud.server import MasterClient
+            self.c = MasterClient(addr, timeout=timeout_sec)
+        self._records = None
+
+    def release(self):
+        close = getattr(self.c, "close", None)
+        if close is not None:
+            close()
+        self.c = None
+
+    def set_dataset(self, paths):
+        """Register recordio files; each chunk becomes a lease unit
+        (reference paddle_set_dataset; chunk-per-task matches the Go
+        master's partition over recordio chunks)."""
+        from .. import recordio
+        chunks = []
+        for path in paths:
+            for i in range(recordio.num_chunks(path)):
+                chunks.append({"path": path, "skip": i})
+        self.c.set_dataset(chunks)
+
+    def paddle_start_get_records(self, pass_id):
+        """Begin streaming the given pass's records."""
+        self._records = master_reader(self.c, _chunk_records,
+                                      pass_id=pass_id)()
+
+    def next_record(self):
+        """(record, 0) per record; (None, -2) once the pass ends
+        (reference next_record's size<0 convention)."""
+        if self._records is None:
+            return None, -1
+        try:
+            return next(self._records), 0
+        except StopIteration:
+            self._records = None
+            return None, -2
+        except (PassBefore, PassAfter, NoMoreAvailable, AllTasksFailed):
+            self._records = None
+            return None, -2
+
+    def request_save_model(self, trainer_id, block_ms):
+        """1 if this trainer should save, 0 if another holds the save
+        lease (reference request_save_model's int convention)."""
+        ok = self.c.request_save_model(trainer_id, block_ms / 1000.0)
+        return 1 if ok else 0

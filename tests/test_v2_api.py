@@ -252,3 +252,36 @@ def test_v2_lstm_network():
         event_handler=lambda e: costs.append(e.cost)
         if isinstance(e, paddle.event.EndIteration) else None)
     assert costs[-1] < costs[0] * 0.9, (costs[0], costs[-1])
+
+
+def test_v2_master_client_records_and_save_arbitration(tmp_path):
+    """v2.master.client: recordio chunks -> task leases -> next_record
+    stream + save-model arbitration (reference v2/master/client.py over
+    go/master/service.go)."""
+    import paddle_tpu.recordio as recordio
+    from paddle_tpu.cloud.master import MasterService
+
+    path = str(tmp_path / "data.recordio")
+    with recordio.Writer(path, max_chunk_bytes=64) as w:
+        for i in range(20):
+            w.write(("rec-%02d" % i).encode())
+
+    svc = MasterService(chunks_per_task=1, timeout=30.0)
+    c = paddle.master.client(svc)
+    c.set_dataset([path])
+
+    c.paddle_start_get_records(0)
+    got = []
+    while True:
+        rec, err = c.next_record()
+        if err != 0:
+            assert err == -2  # pass end
+            break
+        got.append(rec)
+    assert sorted(got) == sorted(("rec-%02d" % i).encode()
+                                 for i in range(20))
+
+    # save-model arbitration: first trainer wins, second is blocked
+    assert c.request_save_model("t0", 60000) == 1
+    assert c.request_save_model("t1", 60000) == 0
+    c.release()

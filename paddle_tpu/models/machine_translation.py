@@ -13,6 +13,7 @@ encoder-side projections are hoisted out of the scan (one big [B,T]
 gemm each instead of T small ones)."""
 
 from .. import layers
+from .. import nets
 from ..layer_helper import LayerHelper  # noqa: F401 (doc parity)
 
 __all__ = ["seq_to_seq_net", "lstm_step"]
@@ -79,21 +80,10 @@ def seq_to_seq_net(src, tgt, label, source_dict_dim, target_dict_dim,
         hidden_mem = rnn.memory(init=decoder_boot)
         cell_mem = rnn.memory(shape=[decoder_size], value=0.0)
 
-        # Bahdanau attention (reference simple_attention), padded form:
-        # score[b,t] = v . tanh(enc_proj[b,t] + W h[b]); masked softmax
-        dec_proj = layers.fc(hidden_mem, size=decoder_size,
-                             bias_attr=False)
-        mixed = layers.tanh(
-            layers.elementwise_add(enc_proj,
-                                   layers.unsqueeze(dec_proj, axes=[1])))
-        scores = layers.squeeze(
-            layers.fc(mixed, size=1, num_flatten_dims=2, bias_attr=False),
-            axes=[2])                                       # [B, T]
-        weights = layers.sequence_softmax(scores, length=src_len)
-        context = layers.reduce_sum(
-            layers.elementwise_mul(enc_vec,
-                                   layers.unsqueeze(weights, axes=[2])),
-            dim=1)                                          # [B, 2H]
+        # Bahdanau attention (nets.simple_attention, the v1 seqToseq
+        # form): masked softmax over tanh(enc_proj + W h) scores
+        context = nets.simple_attention(enc_vec, enc_proj, hidden_mem,
+                                        decoder_size, length=src_len)
 
         decoder_input = layers.concat([context, current_word], axis=1)
         h, c = lstm_step(decoder_input, hidden_mem, cell_mem,

@@ -13,6 +13,8 @@ __all__ = [
     "glu",
     "scaled_dot_product_attention",
     "sequence_conv_pool",
+    "simple_attention",
+    "dot_product_attention",
 ]
 
 
@@ -148,3 +150,53 @@ def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
                                 param_attr=param_attr,
                                 bias_attr=bias_attr, length=length)
     return layers.sequence_pool(conv, pool_type, length=length)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     decoder_size, length=None):
+    """Bahdanau additive attention, padded form (reference
+    python/paddle/trainer_config_helpers/networks.py simple_attention —
+    the v1 seqToseq attention; the fluid reference has no equivalent).
+
+    ``encoded_sequence`` [B, T, H] values; ``encoded_proj`` [B, T, D]
+    pre-projected keys (hoist the key projection out of the decode loop
+    — one big gemm instead of one per step); ``decoder_state`` [B, D].
+    ``length`` masks padded timesteps (defaults to encoded_sequence's
+    @LEN companion).  Returns the context vector [B, H].
+
+    score[b,t] = v . tanh(enc_proj[b,t] + W s[b]); masked softmax over
+    t; context = sum_t w[b,t] * enc[b,t].
+    """
+    dec_proj = layers.fc(decoder_state, size=decoder_size, bias_attr=False)
+    mixed = layers.tanh(
+        layers.elementwise_add(encoded_proj,
+                               layers.unsqueeze(dec_proj, axes=[1])))
+    scores = layers.squeeze(
+        layers.fc(mixed, size=1, num_flatten_dims=2, bias_attr=False),
+        axes=[2])                                           # [B, T]
+    weights = layers.sequence_softmax(scores, length=length)
+    return layers.reduce_sum(
+        layers.elementwise_mul(encoded_sequence,
+                               layers.unsqueeze(weights, axes=[2])),
+        dim=1)
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, length=None):
+    """Single-query dot-product attention (reference
+    trainer_config_helpers/networks.py dot_product_attention).
+
+    ``encoded_sequence`` [B, T, D] keys; ``attended_sequence`` [B, T, H]
+    values; ``transformed_state`` [B, D] query (pre-projected, as the
+    reference expects).  Returns the context [B, H].
+    """
+    scores = layers.reduce_sum(
+        layers.elementwise_mul(encoded_sequence,
+                               layers.unsqueeze(transformed_state,
+                                                axes=[1])),
+        dim=2)                                              # [B, T]
+    weights = layers.sequence_softmax(scores, length=length)
+    return layers.reduce_sum(
+        layers.elementwise_mul(attended_sequence,
+                               layers.unsqueeze(weights, axes=[2])),
+        dim=1)

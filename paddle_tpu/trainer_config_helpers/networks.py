@@ -15,6 +15,7 @@ from ..v2 import networks as v2_net
 __all__ = [
     "sequence_conv_pool", "simple_img_conv_pool", "img_conv_group",
     "simple_lstm", "simple_gru", "bidirectional_lstm",
+    "simple_attention", "dot_product_attention",
     "inputs", "outputs",
 ]
 
@@ -24,6 +25,8 @@ img_conv_group = v2_net.img_conv_group
 simple_lstm = v2_net.simple_lstm
 simple_gru = v2_net.simple_gru
 bidirectional_lstm = v2_net.bidirectional_lstm
+simple_attention = v2_net.simple_attention
+dot_product_attention = v2_net.dot_product_attention
 
 
 def _flatten(layers):

@@ -10,6 +10,7 @@ from .activation import act_name
 __all__ = [
     "simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
     "simple_lstm", "simple_gru", "bidirectional_lstm",
+    "simple_attention", "dot_product_attention",
 ]
 
 
@@ -82,3 +83,33 @@ def bidirectional_lstm(input, size, return_seq=False, **kwargs):
     f_last = v2_layer.last_seq(fwd)
     b_last = v2_layer.first_seq(bwd)
     return v2_layer.concat([f_last, b_last])
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     decoder_size=None, **kwargs):
+    """Bahdanau attention context (reference networks.py
+    simple_attention; math in paddle_tpu.nets.simple_attention)."""
+    size = decoder_size or decoder_state.v2_dim
+    if size is None:
+        raise ValueError("simple_attention needs decoder_size= or a "
+                         "sized decoder_state layer")
+    with cfg.build():
+        var = fnets.simple_attention(encoded_sequence.var,
+                                     encoded_proj.var,
+                                     decoder_state.var, size)
+    return cfg.Layer(var, v2_dim=encoded_sequence.v2_dim,
+                     parents=[encoded_sequence, encoded_proj,
+                              decoder_state])
+
+
+def dot_product_attention(encoded_sequence, attended_sequence,
+                          transformed_state, **kwargs):
+    """Dot-product attention context (reference networks.py
+    dot_product_attention)."""
+    with cfg.build():
+        var = fnets.dot_product_attention(encoded_sequence.var,
+                                          attended_sequence.var,
+                                          transformed_state.var)
+    return cfg.Layer(var, v2_dim=attended_sequence.v2_dim,
+                     parents=[encoded_sequence, attended_sequence,
+                              transformed_state])

@@ -27,6 +27,7 @@ __all__ = [
     "ConditionalBlock", "array_write", "array_read", "array_length",
     "create_array", "beam_search", "beam_search_decode",
     "Print", "is_empty",
+    "lod_rank_table", "max_sequence_len", "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -713,3 +714,54 @@ def is_empty(x, cond=None):
     helper.append_op(type="is_empty", inputs={"X": [x]},
                      outputs={"Out": [cond]})
     return cond
+
+
+def lod_rank_table(x, level=0):
+    """Build a rank table over ``x``'s sequences: (index, length) rows
+    sorted by length descending, stable (reference
+    ``lod_rank_table_op.cc:1`` / control_flow.py lod_rank_table).
+
+    On the padded design the table is a plain [B, 2] int64 tensor read
+    from the @LEN companion; only ``level=0`` exists because padded
+    batches carry one nesting level (SURVEY §5 long-context ruling —
+    deeper nesting is packed host-side)."""
+    if level != 0:
+        raise NotImplementedError(
+            "lod_rank_table: only level=0 exists on the padded+@LEN "
+            "design; nested LoD levels are flattened host-side")
+    from .sequence import sequence_length
+    helper = LayerHelper("lod_rank_table", input=x)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="lod_rank_table",
+        inputs={"Length": [sequence_length(x)]},
+        outputs={"Out": [out]})
+    return out
+
+
+def max_sequence_len(rank_table):
+    """Longest sequence length recorded in a rank table (reference
+    ``max_sequence_len_op.cc:1``)."""
+    helper = LayerHelper("max_sequence_len", input=rank_table)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="max_sequence_len",
+        inputs={"RankTable": [rank_table]},
+        outputs={"Out": [out]})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """Reorder a batch by a rank table's index column — longest
+    sequences first (reference ``reorder_lod_tensor_by_rank_op.cc:1``).
+    The reordered output carries a reordered @LEN companion, so every
+    downstream sequence op masks correctly."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="reorder_lod_tensor_by_rank",
+        inputs={"X": [x], "RankTable": [rank_table]},
+        outputs={"Out": [out], "OutLength": [out_len]})
+    out._seq_len_name = out_len.name
+    return out

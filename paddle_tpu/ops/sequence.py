@@ -696,3 +696,67 @@ def _lod_reset_compute(ins, attrs, ctx, op_index):
 register_op("lod_reset", ["X", "Y"], ["Out", "Length"],
             infer=_lod_reset_infer, compute=_lod_reset_compute,
             no_grad_inputs=("Y",))
+
+
+# ---- rank-table family (reference lod_rank_table_op.cc:1,
+# max_sequence_len_op.cc:1, reorder_lod_tensor_by_rank_op.cc:1) ----------
+#
+# The reference builds a LoDRankTable (sequence indices sorted by length,
+# descending, stable) to drive length-bucketed DynamicRNN batching and
+# in-graph reorders.  On the padded [B, T, ...]+@LEN design the table is
+# an ordinary [B, 2] int64 tensor of (index, length) rows, reorders are
+# batch gathers, and the shrinking-step-batch machinery
+# (lod_tensor_to_array_op.cc) is absorbed by lax.scan RNNs + host-side
+# bucket_by_length (reader/decorator.py) — scan steps are masked, not
+# shrunk, because XLA wants static shapes.
+
+def _lod_rank_table_infer(op, block):
+    ln = in_var(op, block, "Length")
+    set_output(op, block, "Out", (ln.shape[0], 2), "int64")
+
+
+def _lod_rank_table_compute(ins, attrs, ctx, op_index):
+    lens = ins["Length"][0].reshape(-1).astype(long_dtype())
+    # stable argsort on negated lengths = descending, ties in input order
+    order = jnp.argsort(-lens, stable=True)
+    return {"Out": jnp.stack([order.astype(long_dtype()), lens[order]],
+                             axis=1)}
+
+
+register_op("lod_rank_table", ["Length"], ["Out"],
+            infer=_lod_rank_table_infer, compute=_lod_rank_table_compute,
+            grad=None)
+
+
+def _max_sequence_len_infer(op, block):
+    set_output(op, block, "Out", (), "int64")
+
+
+def _max_sequence_len_compute(ins, attrs, ctx, op_index):
+    table = ins["RankTable"][0]
+    return {"Out": table[0, 1]}
+
+
+register_op("max_sequence_len", ["RankTable"], ["Out"],
+            infer=_max_sequence_len_infer,
+            compute=_max_sequence_len_compute, grad=None)
+
+
+def _reorder_by_rank_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype,
+               lod_level=getattr(x, "lod_level", 0))
+    set_output(op, block, "OutLength", (x.shape[0],), "int64")
+
+
+def _reorder_by_rank_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    idx = table[:, 0]
+    return {"Out": jnp.take(x, idx, axis=0), "OutLength": table[:, 1]}
+
+
+register_op("reorder_lod_tensor_by_rank", ["X", "RankTable"],
+            ["Out", "OutLength"], infer=_reorder_by_rank_infer,
+            compute=_reorder_by_rank_compute,
+            no_grad_inputs=("RankTable",))

@@ -398,3 +398,48 @@ class TestLSTMReverse:
         assert not np.allclose(fwd, rev)
         flipped = run(x[:, ::-1].copy(), False)[:, ::-1]
         np.testing.assert_allclose(rev, flipped, rtol=1e-5, atol=1e-6)
+
+
+def test_lod_rank_table_family():
+    """lod_rank_table / max_sequence_len / reorder_lod_tensor_by_rank
+    (reference lod_rank_table_op.cc, max_sequence_len_op.cc,
+    reorder_lod_tensor_by_rank_op.cc on the padded+@LEN design)."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[2], lod_level=1)
+        x.stop_gradient = False  # data vars default True (fluid parity)
+        table = fluid.layers.lod_rank_table(x)
+        maxlen = fluid.layers.max_sequence_len(table)
+        reordered = fluid.layers.reorder_lod_tensor_by_rank(x, table)
+        # the reordered companion drives downstream masking
+        relen = fluid.layers.sequence_length(reordered)
+        loss = fluid.layers.mean(
+            fluid.layers.sequence_pool(reordered, "sum"))
+        grads = fluid.backward.calc_gradient(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            xv = np.arange(24, dtype="float32").reshape(3, 4, 2)
+            lens = np.array([2, 4, 3], "int64")
+            tb, ml, ro, rl, g = exe.run(
+                feed={"x": xv, "x@LEN": lens},
+                fetch_list=[table, maxlen, reordered, relen, grads[0]])
+    # stable descending sort by length: indices [1, 2, 0]
+    np.testing.assert_array_equal(tb, [[1, 4], [2, 3], [0, 2]])
+    assert int(ml) == 4
+    np.testing.assert_array_equal(ro, xv[[1, 2, 0]])
+    np.testing.assert_array_equal(rl, [4, 3, 2])
+    # grad flows back through the gather: d(loss)/dx masks padding and
+    # lands on the original row positions
+    expect = np.zeros_like(xv)
+    for b, ln in enumerate(lens):
+        expect[b, :ln, :] = 1.0 / loss_batchsize_denom(ro)
+    np.testing.assert_allclose(g, expect, rtol=1e-6)
+
+
+def loss_batchsize_denom(ro):
+    # mean over [B, D] pooled values -> each contributing element's grad
+    return ro.shape[0] * ro.shape[2]

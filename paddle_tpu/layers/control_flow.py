@@ -28,6 +28,7 @@ __all__ = [
     "create_array", "beam_search", "beam_search_decode",
     "Print", "is_empty",
     "lod_rank_table", "max_sequence_len", "reorder_lod_tensor_by_rank",
+    "lod_tensor_to_array", "array_to_lod_tensor",
 ]
 
 
@@ -762,6 +763,35 @@ def reorder_lod_tensor_by_rank(x, rank_table):
     helper.append_op(
         type="reorder_lod_tensor_by_rank",
         inputs={"X": [x], "RankTable": [rank_table]},
+        outputs={"Out": [out], "OutLength": [out_len]})
+    out._seq_len_name = out_len.name
+    return out
+
+
+def lod_tensor_to_array(x, table):
+    """[B, T, ...] batch -> time-major rank-ordered step batches
+    (reference ``lod_tensor_to_array_op.cc:1``; see the op doc for the
+    static-shape redesign of the reference's shrinking step batches)."""
+    helper = LayerHelper("lod_tensor_to_array", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="lod_tensor_to_array",
+        inputs={"X": [x], "RankTable": [table]},
+        outputs={"Out": [out], "OutLength": [out_len]})
+    out._seq_len_name = out_len.name
+    return out
+
+
+def array_to_lod_tensor(x, table):
+    """Inverse of lod_tensor_to_array (reference
+    ``array_to_lod_tensor_op.cc:1``)."""
+    helper = LayerHelper("array_to_lod_tensor", input=x)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    out_len = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="array_to_lod_tensor",
+        inputs={"X": [x], "RankTable": [table]},
         outputs={"Out": [out], "OutLength": [out_len]})
     out._seq_len_name = out_len.name
     return out

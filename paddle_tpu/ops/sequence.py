@@ -760,3 +760,58 @@ register_op("reorder_lod_tensor_by_rank", ["X", "RankTable"],
             ["Out", "OutLength"], infer=_reorder_by_rank_infer,
             compute=_reorder_by_rank_compute,
             no_grad_inputs=("RankTable",))
+
+
+def _lod_tensor_to_array_infer(op, block):
+    x = in_var(op, block, "X")
+    t = x.shape[1] if len(x.shape) > 1 else -1
+    b = x.shape[0]
+    set_output(op, block, "Out", (t, b) + tuple(x.shape[2:]), x.dtype)
+    set_output(op, block, "OutLength", (b,), "int64")
+
+
+def _lod_tensor_to_array_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    table = ins["RankTable"][0]
+    ro = jnp.take(x, table[:, 0], axis=0)          # rank order, [B, T, ...]
+    return {"Out": jnp.swapaxes(ro, 0, 1),         # time-major [T, B, ...]
+            "OutLength": table[:, 1]}
+
+
+register_op(
+    "lod_tensor_to_array", ["X", "RankTable"], ["Out", "OutLength"],
+    infer=_lod_tensor_to_array_infer, compute=_lod_tensor_to_array_compute,
+    no_grad_inputs=("RankTable",),
+    doc="""[B, T, ...] -> time-major step batches [T, B, ...] in rank
+    order (reference lod_tensor_to_array_op.cc:1).  The reference's
+    per-step SHRINKING batches (step t keeps only sequences longer than
+    t) are a dynamic-shape device; XLA wants static shapes, so steps
+    stay full-width and downstream scan ops mask via OutLength — same
+    convergence, MXU-friendly tiles (SURVEY §5).""")
+
+
+def _array_to_lod_tensor_infer(op, block):
+    x = in_var(op, block, "X")
+    b = x.shape[1] if len(x.shape) > 1 else -1
+    t = x.shape[0]
+    set_output(op, block, "Out", (b, t) + tuple(x.shape[2:]), x.dtype,
+               lod_level=1)
+    set_output(op, block, "OutLength", (b,), "int64")
+
+
+def _array_to_lod_tensor_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]                                # [T, B, ...] rank order
+    table = ins["RankTable"][0]
+    inv = jnp.argsort(table[:, 0])                 # undo the rank permute
+    bt = jnp.swapaxes(x, 0, 1)                     # [B, T, ...]
+    return {"Out": jnp.take(bt, inv, axis=0),
+            "OutLength": jnp.take(table[:, 1], inv, axis=0)}
+
+
+register_op(
+    "array_to_lod_tensor", ["X", "RankTable"], ["Out", "OutLength"],
+    infer=_array_to_lod_tensor_infer, compute=_array_to_lod_tensor_compute,
+    no_grad_inputs=("RankTable",),
+    doc="""Inverse of lod_tensor_to_array: time-major rank-ordered step
+    batches back to the original [B, T, ...] batch order with the
+    original @LEN companion (reference array_to_lod_tensor_op.cc:1).""")

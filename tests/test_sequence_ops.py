@@ -443,3 +443,35 @@ def test_lod_rank_table_family():
 def loss_batchsize_denom(ro):
     # mean over [B, D] pooled values -> each contributing element's grad
     return ro.shape[0] * ro.shape[2]
+
+
+def test_lod_tensor_array_roundtrip():
+    """lod_tensor_to_array -> array_to_lod_tensor is the identity on
+    values and lengths; intermediate is time-major in rank order
+    (reference lod_tensor_to_array_op.cc / array_to_lod_tensor_op.cc)."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[2], lod_level=1)
+        x.stop_gradient = False
+        table = fluid.layers.lod_rank_table(x)
+        arr = fluid.layers.lod_tensor_to_array(x, table)
+        back = fluid.layers.array_to_lod_tensor(arr, table)
+        backlen = fluid.layers.sequence_length(back)
+        loss = fluid.layers.mean(back)
+        g, = fluid.backward.calc_gradient(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            xv = np.arange(24, dtype="float32").reshape(3, 4, 2)
+            lens = np.array([2, 4, 3], "int64")
+            av, bv, blv, gv = exe.run(
+                feed={"x": xv, "x@LEN": lens},
+                fetch_list=[arr, back, backlen, g])
+    assert av.shape == (4, 3, 2)  # time-major
+    np.testing.assert_array_equal(av[:, 0], xv[1])  # longest seq first
+    np.testing.assert_array_equal(bv, xv)           # roundtrip identity
+    np.testing.assert_array_equal(blv, lens)
+    np.testing.assert_allclose(gv, np.full_like(xv, 1.0 / xv.size))

@@ -26,7 +26,37 @@ from ..framework import default_main_program
 from ..parallel.mesh import AXIS_DP, AXIS_EP
 from ..parallel.strategy import BuildStrategy
 
-__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig"]
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "slice_variable"]
+
+
+def slice_variable(var_list, slice_count, min_block_size=8192):
+    """Partition each variable into up to ``slice_count`` blocks
+    (reference ``transpiler/distribute_transpiler.py:79 slice_variable``
+    — there the blocks are pserver shards; here they are the ZeRO
+    dp-rank shards the kReduce strategy assigns, so the same accounting
+    answers "which rank owns which slice of optimizer state").
+
+    Returns ``[(name, block_id, block_numel)]``.  Variables under
+    ``min_block_size`` stay whole (one block); split counts never exceed
+    the first-dimension extent, and blocks differ by at most one
+    first-dim row — the even-split rule GSPMD sharding actually applies.
+    """
+    blocks = []
+    for var in var_list:
+        shape = tuple(var.shape or ())
+        numel = int(np.prod(shape)) if shape else 1
+        if numel < min_block_size or not shape or shape[0] <= 1 \
+                or slice_count <= 1:
+            blocks.append((var.name, 0, numel))
+            continue
+        k = min(slice_count, int(shape[0]))
+        row = numel // int(shape[0])
+        base, extra = divmod(int(shape[0]), k)
+        for b in range(k):
+            rows = base + (1 if b < extra else 0)
+            blocks.append((var.name, b, rows * row))
+    return blocks
 
 
 class DistributeTranspilerConfig:

@@ -104,6 +104,30 @@ def test_transpiler_sharding_plan():
             trainer_id=0, trainers=2, sync_mode=False)
 
 
+def test_slice_variable_accounting():
+    """slice_variable: ZeRO dp-rank shard accounting (reference
+    transpiler/distribute_transpiler.py:79)."""
+    from paddle_tpu.transpiler.distribute_transpiler import slice_variable
+
+    class V:
+        def __init__(self, name, shape):
+            self.name, self.shape = name, shape
+
+    blocks = slice_variable(
+        [V("big", (1000, 64)), V("small", (4, 4)), V("row", (1, 100000))],
+        slice_count=4)
+    big = [b for b in blocks if b[0] == "big"]
+    assert len(big) == 4
+    assert sum(n for _, _, n in big) == 1000 * 64
+    assert max(n for _, _, n in big) - min(n for _, _, n in big) == 0
+    # under-threshold and unsplittable vars stay whole
+    assert ("small", 0, 16) in blocks
+    assert ("row", 0, 100000) in blocks
+    # split never exceeds the first-dim extent
+    tiny = slice_variable([V("t", (3, 10000))], slice_count=8)
+    assert len(tiny) == 3
+
+
 def test_memory_optimize_reports():
     x = fluid.layers.data("x", shape=[16])
     y = fluid.layers.fc(x, size=32)

@@ -1,0 +1,200 @@
+"""Gradient sweep: numeric-vs-analytic grad checks for differentiable
+ops whose backward path no other test executed (found by a dynamic
+compute_op audit of the suite).  The generic auto-vjp grad maker makes
+most gradients correct by construction — what this sweep catches is the
+per-op plumbing: slot wiring, multiple outputs, integer side inputs
+(no_grad), and kernels whose forward isn't smoothly differentiable at
+the sampled points (inputs are chosen away from kinks, the reference
+op_test.py convention).
+"""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _r(shape, lo, hi, seed):
+    rng = np.random.RandomState(seed)
+    return (lo + (hi - lo) * rng.rand(*shape)).astype("float32")
+
+
+def _away_from(x, kinks, margin=0.15):
+    """Nudge values within `margin` of any kink point outward."""
+    for k in kinks:
+        close = np.abs(x - k) < margin
+        x = np.where(close, k + np.sign(x - k + 1e-9) * margin, x)
+    return x.astype("float32")
+
+
+# (op_type, inputs, attrs, grad_inputs, output_slot_name_suffix, no_grad)
+def ACT(op, attrs, lo, hi, kinks=()):
+    x = _away_from(_r((3, 4), lo, hi, abs(hash(op)) % 1000), kinks)
+    return (op, {"X": x}, attrs, ["X"], "Out", None)
+
+
+CASES = [
+    ACT("cos", {}, -3, 3),
+    ACT("sin", {}, -3, 3),
+    ACT("gelu", {}, -3, 3),
+    ACT("elu", {"alpha": 0.8}, -3, 3, kinks=(0.0,)),
+    ACT("reciprocal", {}, 0.5, 2),
+    ACT("rsqrt", {}, 0.5, 2),
+    ACT("sqrt", {}, 0.5, 2),
+    ACT("pow", {"factor": 2.0}, 0.5, 2),
+    ACT("tanh_shrink", {}, -3, 3),
+    ACT("hard_sigmoid", {"slope": 0.2, "offset": 0.5}, -1.4, 1.4),
+    ACT("leaky_relu", {"alpha": 0.1}, -3, 3, kinks=(0.0,)),
+    ACT("brelu", {"t_min": -1.0, "t_max": 2.0}, -0.6, 1.6),
+    ACT("relu6", {"threshold": 6.0}, 0.5, 5.0),
+    ACT("hard_shrink", {"threshold": 0.5}, -3, 3, kinks=(-0.5, 0.5)),
+    ACT("softshrink", {"lambda": 0.5}, -3, 3, kinks=(-0.5, 0.5)),
+    ACT("thresholded_relu", {"threshold": 1.0}, -3, 3, kinks=(1.0,)),
+    ACT("logsigmoid", {}, -3, 3),
+    # ---- losses / norms --------------------------------------------------
+    ("hinge_loss",
+     {"Logits": _away_from(_r((5, 1), -2, 2, 40), (1.0, -1.0)),
+      "Labels": np.array([[1], [0], [1], [0], [1]], "float32")},
+     {}, ["Logits"], "Loss", {"hinge_loss__Labels"}),
+    ("huber_loss",
+     {"X": np.zeros((4, 1), "float32"),
+      "Y": np.array([[0.3], [-0.4], [2.0], [-3.0]], "float32")},
+     {"delta": 1.0}, ["X"], "Out", {"huber_loss__Y"}),
+    ("log_loss",
+     {"Predicted": _r((4, 1), 0.2, 0.8, 41),
+      "Labels": np.array([[1], [0], [1], [0]], "float32")},
+     {"epsilon": 1e-4}, ["Predicted"], "Loss", {"log_loss__Labels"}),
+    ("rank_loss",
+     {"Label": np.array([[1.0], [0.0], [1.0]], "float32"),
+      "Left": _r((3, 1), -1, 1, 42), "Right": _r((3, 1), -1, 1, 43)},
+     {}, ["Left", "Right"], "Out", {"rank_loss__Label"}),
+    ("squared_l2_norm", {"X": _r((3, 3), -2, 2, 44)}, {}, ["X"], "Out",
+     None),
+    ("l1_norm", {"X": _away_from(_r((3, 3), -2, 2, 45), (0.0,))}, {},
+     ["X"], "Out", None),
+    ("clip_by_norm", {"X": _r((3, 3), 1, 2, 46)}, {"max_norm": 1.0},
+     ["X"], "Out", None),
+    # ---- manipulation ----------------------------------------------------
+    ("gather",
+     {"X": _r((5, 3), -2, 2, 47), "Index": np.array([4, 0, 2], "int64")},
+     {}, ["X"], "Out", {"gather__Index"}),
+    ("scatter",
+     {"X": _r((5, 3), -2, 2, 48), "Ids": np.array([1, 3], "int64"),
+      "Updates": _r((2, 3), -2, 2, 49)},
+     {"overwrite": False}, ["X", "Updates"], "Out", {"scatter__Ids"}),
+    ("flatten", {"X": _r((2, 3, 2), -2, 2, 50)}, {"axis": 2}, ["X"],
+     "Out", None),
+    ("pad", {"X": _r((2, 3), -2, 2, 51)},
+     {"paddings": [1, 0, 0, 1], "pad_value": 0.0}, ["X"], "Out", None),
+    ("reverse", {"X": _r((2, 4), -2, 2, 52)}, {"axis": [1]}, ["X"],
+     "Out", None),
+    ("cumsum", {"X": _r((2, 4), -2, 2, 53)}, {"axis": 1}, ["X"], "Out",
+     None),
+    ("minus", {"X": _r((2, 4), -2, 2, 54), "Y": _r((2, 4), -2, 2, 55)},
+     {}, ["X", "Y"], "Out", None),
+    ("label_smooth", {"X": _r((2, 5), 0, 1, 56)}, {"epsilon": 0.1},
+     ["X"], "Out", None),
+    ("cast", {"X": _r((2, 4), -2, 2, 57)},
+     {"in_dtype": "float32", "out_dtype": "float32"}, ["X"], "Out", None),
+    ("expand", {"X": _r((2, 3), -2, 2, 58)}, {"expand_times": [2, 1]},
+     ["X"], "Out", None),
+    ("norm", {"X": _r((3, 4), 0.5, 2, 59)}, {"axis": 1}, ["X"], "Out",
+     None),
+    ("elementwise_pow",
+     {"X": _r((2, 3), 0.5, 2, 60), "Y": _r((2, 3), 0.5, 2, 61)},
+     {}, ["X", "Y"], "Out", None),
+    ("multiplex",
+     {"Ids": np.array([[1], [0]], "int64"),
+      "X": [("mx0", _r((2, 3), -2, 2, 62)),
+            ("mx1", _r((2, 3), -2, 2, 63))]},
+     {}, ["mx0", "mx1"], "Out", {"multiplex__Ids"}),
+    ("reduce_prod", {"X": _r((2, 3), 0.5, 1.5, 64)}, {"dim": [1]},
+     ["X"], "Out", None),
+    # ---- conv / interp / pooling ----------------------------------------
+    ("conv2d_transpose",
+     {"Input": _r((1, 2, 3, 3), -1, 1, 65),
+      "Filter": _r((2, 2, 2, 2), -1, 1, 66)},
+     {"strides": [1, 1], "paddings": [0, 0]},
+     ["Input", "Filter"], "Output", None),
+    ("depthwise_conv2d",
+     {"Input": _r((1, 2, 4, 4), -1, 1, 67),
+      "Filter": _r((2, 1, 2, 2), -1, 1, 68)},
+     {"strides": [1, 1], "paddings": [0, 0], "groups": 2},
+     ["Input", "Filter"], "Output", None),
+    ("conv3d",
+     {"Input": _r((1, 1, 2, 3, 3), -1, 1, 69),
+      "Filter": _r((1, 1, 2, 2, 2), -1, 1, 70)},
+     {"strides": [1, 1, 1], "paddings": [0, 0, 0]},
+     ["Input", "Filter"], "Output", None),
+    ("pool3d", {"X": _r((1, 1, 2, 3, 3), -1, 1, 71)},
+     {"ksize": [2, 2, 2], "strides": [1, 1, 1], "paddings": [0, 0, 0],
+      "pooling_type": "avg"}, ["X"], "Out", None),
+    ("nearest_interp", {"X": _r((1, 1, 2, 2), -1, 1, 72)},
+     {"out_h": 4, "out_w": 4}, ["X"], "Out", None),
+    ("bilinear_interp", {"X": _r((1, 1, 2, 2), -1, 1, 73)},
+     {"out_h": 3, "out_w": 3}, ["X"], "Out", None),
+    ("bilinear_tensor_product",
+     {"X": _r((2, 3), -1, 1, 74), "Y": _r((2, 2), -1, 1, 75),
+      "Weight": _r((2, 3, 2), -1, 1, 76)},
+     {}, ["X", "Y", "Weight"], "Out", None),
+    ("im2sequence", {"X": _r((1, 1, 4, 4), -1, 1, 77)},
+     {"kernels": [2, 2], "strides": [2, 2], "paddings": [0, 0, 0, 0]},
+     ["X"], "Out", None),
+    # ---- sequence family (Length is an integer no-grad input) ------------
+    ("sequence_reverse",
+     {"X": _r((2, 3, 2), -1, 1, 78),
+      "Length": [("srl", np.array([3, 2], "int32"))]},
+     {}, ["X"], "Out", {"srl"}),
+    ("sequence_expand",
+     {"X": _r((2, 4), -1, 1, 79), "Y": _r((2, 3, 2), -1, 1, 80),
+      "Length": [("sel", np.array([3, 2], "int32"))]},
+     {}, ["X"], "Out", {"sel", "sequence_expand__Y"}),
+    ("sequence_concat",
+     {"X": [("sca", _r((2, 2, 2), -1, 1, 81)),
+            ("scb", _r((2, 2, 2), -1, 1, 82))],
+      "Length": [("scla", np.array([2, 1], "int32")),
+                 ("sclb", np.array([1, 2], "int32"))]},
+     {}, ["sca", "scb"], "Out", {"scla", "sclb"}),
+    ("sequence_unpad",
+     {"X": _r((2, 3, 2), -1, 1, 83),
+      "Length": [("sul", np.array([3, 2], "int32"))]},
+     {}, ["X"], "Out", {"sul"}),
+    ("row_conv",
+     {"X": _r((2, 3, 2), -1, 1, 84), "Filter": _r((2, 2), -1, 1, 85),
+      "Length": [("rcl", np.array([3, 2], "int32"))]},
+     {}, ["X", "Filter"], "Out", {"rcl"}),
+]
+
+
+@pytest.mark.parametrize(
+    "op_type,inputs,attrs,grad_inputs,out_slot,no_grad",
+    CASES, ids=[c[0] for c in CASES])
+def test_grad(op_type, inputs, attrs, grad_inputs, out_slot, no_grad):
+    import paddle_tpu as fluid
+    import paddle_tpu.registry as registry
+
+    t = OpTest()
+    t.op_type = op_type
+    t.inputs = inputs
+    t.attrs = dict(attrs)
+    # forward probe: declare every output slot (placeholder arrays — the
+    # probe only needs names; infer assigns real shapes), run once, and
+    # make the real output arrays the expected outputs for check_grad's
+    # cotangent shapes
+    slots = registry.OPS[op_type].output_slots
+    t.outputs = {s: np.zeros(1, "float32") for s in slots}
+    program, startup, feed, outs = t._build(stop_gradient_all=True)
+    names = {s: pairs[0][0] for s, pairs in t._canon(t.outputs).items()}
+    exe = fluid.Executor(fluid.CPUPlace())
+    vals = exe.run(program, feed=feed, fetch_list=list(names.values()))
+    t.outputs = {s: np.asarray(v) for s, v in zip(names, vals)}
+
+    # grad targets: single-array inputs are canonicalized to
+    # "<op>__<slot>"; list inputs keep their explicit names
+    list_slots = {k for k, v in inputs.items()
+                  if isinstance(v, list) and v and isinstance(v[0], tuple)}
+    targets = [g if any(g == n for s in list_slots
+                        for n, _ in inputs[s])
+               else "%s__%s" % (op_type, g) for g in grad_inputs]
+    t.check_grad(targets, names[out_slot], no_grad_set=no_grad,
+                 max_relative_error=8e-3, delta=2e-3)

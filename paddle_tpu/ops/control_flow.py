@@ -27,6 +27,15 @@ TPU-first:
   becomes predication: both branches compute on the full batch and the
   merge selects rows by mask — control flow turned into data flow, which
   is exactly what the TPU vector units want.
+* The reference's per-step scope plumbing has no analog here and is
+  deliberately absent: ``shrink_rnn_memory_op.cc`` (shrink the step
+  batch as short sequences finish) and ``rnn_memory_helper_op.cc``
+  (step-scope memory hand-off) exist to serve dynamically-shrinking
+  step batches, which XLA's static shapes forbid — scan steps stay
+  full-width and masked (ops/sequence.py rank-table family docs), and
+  scan itself carries the memories.  ``parallel_do_op.cc:114`` /
+  ``get_places_op.cc`` (deprecated per-op data parallelism) are
+  subsumed by the mesh runtime (parallel/parallel_executor.py).
 """
 
 import jax

@@ -1,0 +1,9 @@
+"""v1 trainer package namespace (reference python/paddle/trainer/).
+
+The reference package holds ``config_parser.py`` (config -> TrainerConfig
+proto) and the PyDataProvider2 protocol; the parsing surface is re-hosted
+over the Program IR in ``config_parser``, and data providers are plain
+readers on this stack (trainer_config_helpers/data_sources.py).
+"""
+
+from . import config_parser  # noqa: F401

@@ -1,0 +1,48 @@
+"""v1 config parser entry point (reference
+python/paddle/trainer/config_parser.py ``parse_config`` — the function
+that turned a trainer-config script into the ``TrainerConfig`` proto the
+``paddle_trainer`` binary consumed).
+
+Here a config is a callable (the v1 "config file" body) run under the
+trainer_config_helpers dialect, and the "proto" is the parsed model's
+Program-JSON dict plus the recorded optimizer settings — see
+``trainer_config_helpers/config_parser_utils.py`` for the machinery.
+"""
+
+from ..trainer_config_helpers.config_parser_utils import (  # noqa: F401
+    parse_network_config,
+    parse_optimizer_config,
+    parse_trainer_config,
+    reset_parser,
+)
+
+__all__ = ["parse_config", "parse_network_config",
+           "parse_optimizer_config", "reset_parser"]
+
+
+class TrainerConfig(object):
+    """What parse_config returns (reference TrainerConfig proto shape):
+    ``model_config`` (the parsed model) + ``opt_config`` (settings)."""
+
+    def __init__(self, model_config, opt_config):
+        self.model_config = model_config
+        self.opt_config = opt_config
+
+    def to_dict(self):
+        d = {"model_config": self.model_config.to_dict()}
+        if self.opt_config is not None:
+            d["opt_config"] = {
+                "batch_size": self.opt_config.batch_size,
+                "learning_rate": self.opt_config.learning_rate,
+                "learning_method": type(
+                    self.opt_config.learning_method).__name__
+                if self.opt_config.learning_method else "sgd",
+            }
+        return d
+
+
+def parse_config(trainer_conf, config_arg_str=""):
+    """Run a full v1 config callable; return a TrainerConfig-shaped
+    object (reference config_parser.parse_config)."""
+    model, settings = parse_trainer_config(trainer_conf, config_arg_str)
+    return TrainerConfig(model, settings)

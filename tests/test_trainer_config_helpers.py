@@ -289,3 +289,25 @@ def test_recurrent_group_is_design_boundary():
         tch.recurrent_group(step=None, input=[])
     with pytest.raises(NotImplementedError):
         tch.beam_search()
+
+
+def test_trainer_config_parser_module():
+    """paddle_tpu.trainer.config_parser.parse_config: the v1 entry point
+    (reference python/paddle/trainer/config_parser.py)."""
+    from paddle_tpu.trainer import config_parser
+
+    def conf():
+        tch.settings(batch_size=16, learning_rate=0.01,
+                     learning_method=tch.MomentumOptimizer(momentum=0.9))
+        x = tch.data_layer("x", size=8)
+        y = tch.fc_layer(x, size=2, act=tch.SoftmaxActivation())
+        tch.outputs(y)
+
+    tc = config_parser.parse_config(conf)
+    d = tc.to_dict()
+    assert d["opt_config"]["batch_size"] == 16
+    assert d["opt_config"]["learning_method"] == "MomentumOptimizer"
+    assert d["model_config"]["input_layer_names"] == ["x"]
+    assert any(op["type"] == "softmax"
+               for b in d["model_config"]["program"]["blocks"]
+               for op in b["ops"])

@@ -147,6 +147,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
             continue
         specs = make_grad_ops(op, no_grad)
         appended_any = False
+        consumed = {}  # fwd name -> the materialized grad name this op read
         for spec in specs:
             # record the forward op's position so generic grad recompute
             # folds the SAME PRNG key the forward used (registry.py
@@ -161,6 +162,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 for n in names:
                     fwd = n[: -len("@GRAD")] if n.endswith("@GRAD") else n
                     g = acc.materialize(fwd)
+                    if g is not None:
+                        consumed[fwd] = g
                     wired.append(g or "")
                 spec["inputs"][slot] = wired
             # rename duplicate grad outputs into fresh contribution names
@@ -185,18 +188,26 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
                 attrs=spec["attrs"],
             )
             appended_any = True
-        # once this op's grad ops have consumed its outputs' cotangents,
-        # clear them so an EARLIER producer of the same name (in-place
-        # aliasing — the while op's Out carries) cannot re-consume the
-        # already-routed gradient and double-count.  Only when grad ops
-        # were actually appended: a grad-less in-place op (increment)
-        # must keep letting the cotangent flow through the shared name.
-        # materialize first so var@GRAD stays fetchable/optimizer-visible.
+        # drop exactly the cotangent contributions this op's grad ops
+        # CONSUMED (recorded at wiring time), so an EARLIER producer of
+        # the same name (in-place aliasing: the while op's Out carries,
+        # array_write chains) cannot re-consume an already-routed
+        # gradient and double-count.  Contributions the grad ops just
+        # ADDED under the same name — the grad of an in-place *input*
+        # (the reference handles these via grad renaming on its SSA
+        # versions) — survive for the earlier producer, INCLUDING the
+        # case where they landed under the bare @GRAD name because the
+        # aliased output itself had no downstream cotangent.  Tracking
+        # consumption explicitly (not by name) is what makes those two
+        # cases distinguishable.
         if appended_any:
             for n in op.output_arg_names:
-                if n and acc.pending.get(n):
-                    acc.materialize(n)
-                    acc.pending[n] = []
+                if not (n and acc.pending.get(n)):
+                    continue
+                g = consumed.get(n)
+                if g is not None:
+                    acc.pending[n] = [c for c in acc.pending[n]
+                                      if c != g]
 
     # materialize every accumulated gradient so var@GRAD is always the
     # summed value (fetchable, optimizer-consumable)

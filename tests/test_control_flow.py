@@ -532,3 +532,90 @@ def test_while_upstream_producer_gradient_not_double_counted():
     xv = np.ones((1, 6), dtype="float32")
     (g,) = exe.run(feed={"x": xv}, fetch_list=[gx.name])
     np.testing.assert_allclose(g, np.full((1, 6), 0.5 ** 3 / 6), rtol=1e-6)
+
+
+def test_ifelse_backward():
+    """Gradients flow through IfElse's split/merge predication
+    (reference while_op.cc-era conditional backward; here
+    split_lod_tensor/merge_lod_tensor/conditional_block grads):
+    d(out)/dx is the branch's slope on each row."""
+    b, d = 6, 3
+    x = fluid.layers.data("x", shape=[d])
+    x.stop_gradient = False
+    limit = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                       value=1.5)
+    row_sum = fluid.layers.reduce_sum(x, dim=1, keep_dim=True)
+    cond = fluid.layers.less_than(row_sum, limit)
+
+    ie = fluid.layers.IfElse(cond)
+    with ie.true_block():
+        d_in = ie.input(x)
+        ie.output(fluid.layers.scale(d_in, scale=2.0))
+    with ie.false_block():
+        d_in = ie.input(x)
+        ie.output(fluid.layers.scale(d_in, scale=-1.0))
+    out = ie()
+
+    loss = fluid.layers.reduce_sum(out)
+    (gx,) = fluid.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    xv = rng.rand(b, d).astype("float32")
+    (gv,) = exe.run(feed={"x": xv}, fetch_list=[gx])
+    mask = xv.sum(1, keepdims=True) < 1.5
+    want = np.where(mask, 2.0, -1.0) * np.ones_like(xv)
+    np.testing.assert_allclose(gv, want, rtol=1e-5)
+
+
+def test_tensor_array_write_read_backward():
+    """array_write -> array_read roundtrip gradient (reference
+    tensor_array_read_write_op.cc grads): cotangents route through the
+    fixed-capacity array's dynamic slice."""
+    x = fluid.layers.data("x", shape=[4])
+    x.stop_gradient = False
+    i0 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    i1 = fluid.layers.fill_constant(shape=[1], dtype="int64", value=1)
+    arr = fluid.layers.array_write(
+        fluid.layers.scale(x, scale=3.0), i0, capacity=2)
+    arr = fluid.layers.array_write(
+        fluid.layers.scale(x, scale=5.0), i1, array=arr)
+    y0 = fluid.layers.array_read(arr, i0)
+    y1 = fluid.layers.array_read(arr, i1)
+    loss = fluid.layers.reduce_sum(
+        fluid.layers.elementwise_add(y0, y1))
+    (gx,) = fluid.calc_gradient(loss, [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 4), "float32")
+    (gv,) = exe.run(feed={"x": xv}, fetch_list=[gx])
+    np.testing.assert_allclose(gv, 8.0 * np.ones_like(xv), rtol=1e-5)
+
+
+def test_while_backward_coupled_carry_unread_var():
+    """Coupled While carries where the loss reads only ONE of them:
+    b += a each trip, loss = sum(b).  a's cotangent exists only through
+    the in-place carry (no direct downstream read), so its input-side
+    gradient lands under the bare @GRAD name — the consumed-tracking in
+    backward.py must keep it (dloss/dx = trips + 1 through b0 = x... 0
+    + per-trip a contributions)."""
+    d, trips = 3, 3
+    x = fluid.layers.data("x", shape=[d])
+    x.stop_gradient = False
+    a = fluid.layers.assign(x)
+    b = fluid.layers.assign(x)
+    i = fluid.layers.fill_constant(shape=[1], dtype="int64", value=0)
+    n = fluid.layers.fill_constant(shape=[1], dtype="int64", value=trips)
+    cond = fluid.layers.less_than(i, n)
+    w = fluid.layers.While(cond, max_trip_count=4)
+    with w.block():
+        fluid.layers.assign(fluid.layers.elementwise_add(b, a), output=b)
+        fluid.layers.increment(i, value=1)
+        fluid.layers.less_than(i, n, cond=cond)
+    loss = fluid.layers.reduce_sum(b)
+    (gx,) = fluid.calc_gradient(loss, [x])
+    assert gx is not None, "gradient through the unread coupled carry lost"
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, d), "float32")
+    (gv,) = exe.run(feed={"x": xv}, fetch_list=[gx])
+    # b_final = x + trips * a = (1 + trips) * x
+    np.testing.assert_allclose(gv, (1.0 + trips) * np.ones_like(xv),
+                               rtol=1e-5)

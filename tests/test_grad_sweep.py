@@ -198,3 +198,89 @@ def test_grad(op_type, inputs, attrs, grad_inputs, out_slot, no_grad):
                else "%s__%s" % (op_type, g) for g in grad_inputs]
     t.check_grad(targets, names[out_slot], no_grad_set=no_grad,
                  max_relative_error=8e-3, delta=2e-3)
+
+
+# ---- third wave: grouped norms, shifted convs, sequence reshapes ----------
+
+CASES2 = [
+    ("group_norm",
+     {"X": _r((2, 4, 3, 3), -1, 1, 90), "Scale": _r((4,), 0.5, 1.5, 91),
+      "Bias": _r((4,), -0.5, 0.5, 92)},
+     {"groups": 2, "epsilon": 1e-5}, ["X", "Scale", "Bias"], "Y", None),
+    ("conv_shift",
+     {"X": _r((2, 6), -1, 1, 93), "Y": _r((2, 3), -1, 1, 94)},
+     {}, ["X", "Y"], "Out", None),
+    ("sequence_reshape",
+     {"X": _r((2, 4, 2), -1, 1, 95),
+      "Length": [("srsl", np.array([4, 2], "int32"))]},
+     {"new_dim": 4}, ["X"], "Out", {"srsl"}),
+    ("sequence_expand_as",
+     {"X": _r((2, 3), -1, 1, 96), "Y": _r((2, 4, 2), -1, 1, 97),
+      "YLength": [("seal", np.array([4, 2], "int32"))]},
+     {}, ["X"], "Out", {"seal", "sequence_expand_as__Y"}),
+    ("sequence_scatter",
+     {"X": _r((2, 5), -1, 1, 98),
+      "Ids": np.array([[1, 2, 0], [0, 3, 0]], "int64"),
+      "Updates": _r((2, 3), -1, 1, 99),
+      "Length": [("sscl", np.array([3, 2], "int32"))]},
+     {}, ["X", "Updates"], "Out", {"sscl", "sequence_scatter__Ids"}),
+    ("lod_reset",
+     {"X": _r((2, 3, 2), -1, 1, 100),
+      "Y": [("lrl", np.array([0, 2, 5], "int64"))]},
+     {}, ["X"], "Out", {"lrl"}),
+    ("spp", {"X": _r((1, 2, 4, 4), -1, 1, 101)},
+     {"pyramid_height": 2, "pooling_type": "avg"}, ["X"], "Out", None),
+]
+
+
+@pytest.mark.parametrize(
+    "op_type,inputs,attrs,grad_inputs,out_slot,no_grad",
+    CASES2, ids=[c[0] for c in CASES2])
+def test_grad_wave3(op_type, inputs, attrs, grad_inputs, out_slot,
+                    no_grad):
+    test_grad(op_type, inputs, attrs, grad_inputs, out_slot, no_grad)
+
+
+def test_max_pool_with_index_unpool_chain_grad():
+    """max_pool2d_with_index -> unpool roundtrip gradient: the unpool
+    scatter must route cotangents back exactly to the argmax positions
+    (reference max_pool_with_index_op.cc + unpool_op.cc custom grads)."""
+    import paddle_tpu as fluid
+
+    rng = np.random.RandomState(102)
+    # distinct values => unique argmax (numeric diff stays off ties)
+    xv = rng.permutation(64).astype("float32").reshape(1, 1, 8, 8) / 64.0
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[1, 8, 8])
+        x.stop_gradient = False
+        block = fluid.default_main_program().current_block()
+        pooled = block.create_var(name="pooled", dtype="float32")
+        mask = block.create_var(name="mask", dtype="int64")
+        block.append_op(
+            type="max_pool2d_with_index", inputs={"X": [x]},
+            outputs={"Out": [pooled], "Mask": [mask]},
+            attrs={"ksize": [2, 2], "strides": [2, 2],
+                   "paddings": [0, 0]})
+        up = block.create_var(name="up", dtype="float32")
+        block.append_op(
+            type="unpool", inputs={"X": [pooled], "Indices": [mask]},
+            outputs={"Out": [up]},
+            attrs={"unpool_size": [8, 8], "ksize": [2, 2],
+                   "strides": [2, 2]})
+        loss = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(up, up))
+        (gx,) = fluid.calc_gradient(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            (g,) = exe.run(feed={"x": xv}, fetch_list=[gx])
+    # d(sum(up^2))/dx = 2*x at argmax positions, 0 elsewhere
+    want = np.zeros_like(xv)
+    for i in range(4):
+        for j in range(4):
+            win = xv[0, 0, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+            a, b = np.unravel_index(win.argmax(), (2, 2))
+            want[0, 0, 2 * i + a, 2 * j + b] = 2 * win.max()
+    np.testing.assert_allclose(g, want, rtol=1e-5)

@@ -109,3 +109,34 @@ def test_frozen_program_scale_is_immutable():
         exe.run(frozen, feed=big, fetch_list=[pred.name])
         after = float(np.asarray(scope.var(names[0]))[0])
         assert after == trained, (trained, after)
+
+
+def test_fake_quantize_ste_gradient():
+    """QAT straight-through estimator: d(fake_quantize)/dX is the
+    identity on the upstream cotangent — analytic, NOT numeric (the
+    rounding's true derivative is zero a.e.; STE is the designed
+    divergence, reference fake_quantize_op.cc grad)."""
+    import numpy as np
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[4])
+        x.stop_gradient = False
+        block = fluid.default_main_program().current_block()
+        out = block.create_var(name="q", dtype="float32")
+        scale = block.create_var(name="qs", dtype="float32")
+        block.append_op(
+            type="fake_quantize_abs_max", inputs={"X": [x]},
+            outputs={"Out": [out], "OutScale": [scale]},
+            attrs={"bit_length": 8})
+        loss = fluid.layers.reduce_sum(
+            fluid.layers.scale(out, scale=3.0))
+        (gx,) = fluid.calc_gradient(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        sc = fluid.Scope()
+        with fluid.scope_guard(sc):
+            exe.run(fluid.default_startup_program())
+            xv = np.random.RandomState(0).randn(2, 4).astype("float32")
+            (gv,) = exe.run(feed={"x": xv}, fetch_list=[gx])
+    # STE: gradient passes 3.0 straight through the rounding
+    np.testing.assert_allclose(gv, 3.0 * np.ones_like(xv), rtol=1e-6)

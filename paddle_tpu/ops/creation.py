@@ -255,25 +255,44 @@ def _print_compute(ins, attrs, ctx, op_index):
     return {"Out": x}
 
 
+def _print_grad_compute(ins, attrs, ctx, op_index):
+    g = ins["GRAD::Out"][0]
+    if attrs.get("print_phase") in ("BACKWARD", "BOTH"):
+        fwd_attrs = dict(attrs, print_phase="FORWARD",
+                         __var_name__=attrs.get("__grad_name__", ""))
+        _print_compute({"In": [g]}, fwd_attrs, ctx, op_index)
+    return {"GRAD::In": g}
+
+
+def _print_grad_infer(op, block):
+    from ..registry import in_var, set_output
+    g = in_var(op, block, "GRAD::Out")
+    set_output(op, block, "GRAD::In", g.shape, g.dtype)
+
+
+register_op(
+    "print_grad", ["GRAD::Out"], ["GRAD::In"],
+    infer=_print_grad_infer, compute=_print_grad_compute, grad=None,
+)
+
+
 def _print_grad(op, no_grad_set):
     # pass the cotangent straight through (auto-vjp would re-run the
     # forward and print twice); print it when the phase asks for it,
-    # mirroring print_op.cc's backward registration
+    # mirroring print_op.cc's backward registration.  Wired through
+    # GRAD:: slots so backward.py materializes (sums) the cotangent
+    # before this op reads it.
     from ..framework import grad_var_name
     x = op.inputs["In"][0]
     if x in no_grad_set:
         return []
     g_out = grad_var_name(op.outputs["Out"][0])
-    g_in = grad_var_name(x)
-    phase = op.attrs.get("print_phase", "FORWARD")
-    if phase in ("BACKWARD", "BOTH"):
-        attrs = dict(op.attrs)
-        attrs["print_phase"] = "FORWARD"  # fire on this (grad) tensor
-        attrs["__var_name__"] = g_out
-        return [dict(type="print", inputs={"In": [g_out]},
-                     outputs={"Out": [g_in]}, attrs=attrs)]
-    return [dict(type="assign", inputs={"X": [g_out]},
-                 outputs={"Out": [g_in]}, attrs={})]
+    attrs = dict(op.attrs)
+    attrs["__grad_name__"] = g_out
+    return [dict(type="print_grad",
+                 inputs={"GRAD::Out": [g_out]},
+                 outputs={"GRAD::In": [grad_var_name(x)]},
+                 attrs=attrs)]
 
 
 register_op(

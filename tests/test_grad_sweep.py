@@ -284,3 +284,30 @@ def test_max_pool_with_index_unpool_chain_grad():
             a, b = np.unravel_index(win.argmax(), (2, 2))
             want[0, 0, 2 * i + a, 2 * j + b] = 2 * win.max()
     np.testing.assert_allclose(g, want, rtol=1e-5)
+
+
+def test_print_backward_passthrough_with_fanout(capfd):
+    """print's gradient is the SUMMED cotangent when the printed var has
+    multiple downstream consumers (the GRAD:: wiring materializes the
+    accumulation before the pass-through reads it; reference
+    print_op.cc backward)."""
+    import paddle_tpu as fluid
+
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[3])
+        x.stop_gradient = False
+        p = fluid.layers.Print(x, message="probe",
+                               print_phase="BACKWARD")
+        # two consumers -> two grad contributions to sum
+        a = fluid.layers.scale(p, scale=2.0)
+        b = fluid.layers.scale(p, scale=5.0)
+        loss = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_add(a, b))
+        (gx,) = fluid.calc_gradient(loss, [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            (gv,) = exe.run(feed={"x": np.ones((2, 3), "float32")},
+                            fetch_list=[gx])
+    np.testing.assert_allclose(gv, 7.0 * np.ones((2, 3)), rtol=1e-6)

@@ -13,6 +13,8 @@ from ..v2 import evaluator as v2_eval
 __all__ = [
     "classification_error_evaluator", "auc_evaluator",
     "value_printer_evaluator", "sum_evaluator", "column_sum_evaluator",
+    "chunk_evaluator", "ctc_error_evaluator",
+    "precision_recall_evaluator",
 ]
 
 classification_error_evaluator = v2_eval.classification_error
@@ -48,3 +50,49 @@ def column_sum_evaluator(input, name=None, weight=None):
     from .. import layers as fl
     return _register(name, "column_sum_evaluator",
                      lambda: fl.reduce_sum(cfg.unwrap(input), dim=0))
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types,
+                    name=None, excluded_chunk_types=None):
+    """Chunk precision/recall/F1 over tag sequences (reference
+    evaluators.py chunk_evaluator over the chunk_eval op; the SRL book
+    chapter's metric).  Registers F1 as the reported value."""
+    from .. import layers as fl
+    return _register(name, "chunk_evaluator", lambda: fl.chunk_eval(
+        cfg.unwrap(input), cfg.unwrap(label), chunk_scheme=chunk_scheme,
+        num_chunk_types=num_chunk_types,
+        excluded_chunk_types=excluded_chunk_types)[2])
+
+
+def ctc_error_evaluator(input, label, name=None):
+    """Mean normalized edit distance between the decoded prediction and
+    the label sequence (reference evaluators.py ctc_error_evaluator over
+    edit_distance)."""
+    from .. import layers as fl
+
+    def build():
+        dist, _n = fl.edit_distance(cfg.unwrap(input), cfg.unwrap(label),
+                                    normalized=True)
+        return fl.mean(dist)
+
+    return _register(name, "ctc_error_evaluator", build)
+
+
+def precision_recall_evaluator(input, label, positive_label=None,
+                               weight=None, name=None):
+    """Per-batch top-1 accuracy as the iteration-reported metric
+    (reference evaluators.py precision_recall_evaluator's role in the
+    training loop); the full streaming precision/recall/F1 curve lives
+    host-side in metrics.py Precision/Recall — the same in-graph vs
+    python-metric split the reference draws."""
+    from .. import layers as fl
+
+    def build():
+        # per-batch accuracy of the argmax against the label is the
+        # stateless surrogate the v2 trainer can report each iteration;
+        # the full streaming PR curve lives in metrics.py Precision/
+        # Recall (host-side), matching the reference's split between
+        # in-graph evaluators and python metrics
+        return fl.accuracy(input=cfg.unwrap(input), label=cfg.unwrap(label))
+
+    return _register(name, "precision_recall_evaluator", build)

@@ -311,3 +311,23 @@ def test_trainer_config_parser_module():
     assert any(op["type"] == "softmax"
                for b in d["model_config"]["program"]["blocks"]
                for op in b["ops"])
+
+
+def test_extended_evaluators_register_and_run():
+    """chunk/ctc-error/precision-recall evaluators build metric
+    subgraphs on the v1 dialect (reference evaluators.py family)."""
+    seq = tch.data_layer("tags", size=0,
+                         type=paddle.data_type.integer_value_sequence(9))
+    lbl = tch.data_layer("gold", size=0,
+                         type=paddle.data_type.integer_value_sequence(9))
+    tch.chunk_evaluator(seq, lbl, chunk_scheme="IOB", num_chunk_types=4,
+                        name="chunks")
+    tch.ctc_error_evaluator(seq, lbl, name="cer")
+    x = tch.data_layer("x", size=6)
+    pred = tch.fc_layer(x, size=3, act=tch.SoftmaxActivation())
+    cls = tch.data_layer("cls", size=0,
+                         type=paddle.data_type.integer_value(3))
+    tch.precision_recall_evaluator(pred, cls, name="pr")
+    from paddle_tpu.v2 import config as cfg
+    names = {e[0] for e in cfg.graph().evaluators}
+    assert {"chunks", "cer", "pr"} <= names

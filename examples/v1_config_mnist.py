@@ -1,0 +1,62 @@
+"""The same model through the v1 trainer-config DSL (reference
+trainer_config_helpers usage: settings + *_layer + mixed_layer +
+outputs, parsed by trainer.config_parser), executed by the shared
+engine via the v2 trainer.
+
+Run: JAX_PLATFORMS=cpu python examples/v1_config_mnist.py
+"""
+import numpy as np
+
+import paddle_tpu.trainer_config_helpers as tch
+from paddle_tpu import v2 as paddle
+from paddle_tpu.trainer import config_parser
+
+
+def network():
+    tch.settings(batch_size=64, learning_rate=1e-3,
+                 learning_method=tch.AdamOptimizer())
+    img = tch.data_layer("img", size=784)
+    with tch.mixed_layer(size=128, bias_attr=True,
+                         act=tch.ReluActivation()) as m:
+        m += tch.full_matrix_projection(img)
+    pred = tch.fc_layer(m, size=10, act=tch.SoftmaxActivation())
+    lbl = tch.data_layer("lbl", size=0,
+                         type=paddle.data_type.integer_value(10))
+    cost = tch.classification_cost(input=pred, label=lbl)
+    tch.outputs(cost)
+    return cost
+
+
+def main():
+    tc = config_parser.parse_config(network)
+    print("parsed config:", tc.to_dict()["opt_config"])
+
+    # the parse left the built graph live: train it with the v2 trainer
+    cost = tc.model_config.output_layers[0]
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(cost, params,
+                                 tch.current_settings().to_v2())
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(10, 784).astype("float32")
+
+    def reader():
+        for _ in range(512):
+            y = int(rng.randint(0, 10))
+            yield (centers[y] + 0.3 * rng.randn(784)).astype("float32"), y
+
+    costs = []
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndIteration):
+            costs.append(e.cost)
+
+    trainer.train(paddle.batch(reader, 64), num_passes=4,
+                  event_handler=handler)
+    print("first %.3f last %.3f" % (costs[0], costs[-1]))
+    assert costs[-1] < costs[0] * 0.3
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""The same model through the v2 API dialect (reference v2 book style:
+layer DSL -> parameters -> trainer.SGD with events).
+
+Run: JAX_PLATFORMS=cpu python examples/v2_mnist.py
+"""
+import numpy as np
+
+from paddle_tpu import v2 as paddle
+
+
+def main():
+    paddle.init(use_gpu=False)
+    img = paddle.layer.data(name="img",
+                            type=paddle.data_type.dense_vector(784))
+    hidden = paddle.layer.fc(input=img, size=128,
+                             act=paddle.activation.Relu())
+    pred = paddle.layer.fc(input=hidden, size=10,
+                           act=paddle.activation.Softmax())
+    lbl = paddle.layer.data(name="lbl",
+                            type=paddle.data_type.integer_value(10))
+    cost = paddle.layer.classification_cost(input=pred, label=lbl)
+
+    params = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost, params, paddle.optimizer.Adam(learning_rate=1e-3))
+
+    rng = np.random.RandomState(0)
+    centers = rng.randn(10, 784).astype("float32")
+
+    def reader():
+        for _ in range(512):
+            y = int(rng.randint(0, 10))
+            yield (centers[y] + 0.3 * rng.randn(784)).astype("float32"), y
+
+    def handler(e):
+        if isinstance(e, paddle.event.EndPass):
+            print("pass", e.pass_id, "done")
+
+    trainer.train(paddle.batch(reader, 64), num_passes=4,
+                  event_handler=handler)
+
+    probs = paddle.infer(output_layer=pred, parameters=params,
+                         input=[(centers[i],) for i in range(10)])
+    acc = np.mean(np.argmax(probs, 1) == np.arange(10))
+    print("center acc %.2f" % acc)
+    assert acc > 0.9
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,8 @@ __all__ = [
     "multiprocess_reader",
     "cache",
     "bucket_by_length",
+    "Fake",
+    "PipeReader",
 ]
 
 
@@ -299,3 +301,63 @@ def bucket_by_length(reader, length_fn, bucket_bounds, batch_size,
                 if bucket:
                     yield b, bucket
     return data_reader
+
+
+class Fake(object):
+    """Replay the first epoch's samples forever (reference decorator.py
+    Fake — the throughput-testing reader that removes data-source cost
+    from the measurement)."""
+
+    def __init__(self):
+        self.fake_reader = None
+
+    def __call__(self, reader, length):
+        def fake():
+            if self.fake_reader is None:
+                self.fake_reader = list(
+                    item for item, _ in zip(reader(), range(length)))
+            for i in range(length):
+                yield self.fake_reader[i % len(self.fake_reader)]
+
+        return fake
+
+
+class PipeReader(object):
+    """Stream records from a shell command's stdout (reference
+    decorator.py PipeReader — the HDFS/S3 `hadoop fs -cat`-style
+    ingestion path).  ``get_line`` yields decoded lines with a bounded
+    read buffer."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        if not isinstance(command, str):
+            raise TypeError("a command string is required")
+        if file_type not in ("gzip", "plain"):
+            raise TypeError("file_type %s is not allowed" % file_type)
+        import subprocess
+        self.command = command
+        self.bufsize = bufsize
+        self.file_type = file_type
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        stream = self.process.stdout
+        if self.file_type == "gzip":
+            import gzip
+            stream = gzip.GzipFile(fileobj=stream)
+        remained = ""
+        while True:
+            buf = stream.read(self.bufsize)
+            if not buf:
+                break
+            buf = remained + buf.decode("utf-8", errors="replace")
+            if not cut_lines:
+                remained = ""
+                yield buf
+                continue
+            lines = buf.split(line_break)
+            remained = lines.pop()
+            for line in lines:
+                yield line
+        if remained:
+            yield remained

@@ -197,3 +197,32 @@ def test_open_recordio_files_repeat_streams_epochs():
     got = list(itertools.islice(r(), 12))   # > 2 epochs, no exhaustion
     assert sorted(set(got)) == [0, 1, 2, 3, 4]
     assert len(got) == 12
+
+
+def test_fake_reader_replays_first_epoch():
+    from paddle_tpu.reader.decorator import Fake
+
+    calls = []
+
+    def source():
+        calls.append(1)
+        for i in range(3):
+            yield i
+
+    fake = Fake()
+    r = fake(source, 7)
+    assert list(r()) == [0, 1, 2, 0, 1, 2, 0]
+    assert list(r()) == [0, 1, 2, 0, 1, 2, 0]
+    assert len(calls) == 1  # the source ran exactly once
+
+
+def test_pipe_reader_lines():
+    from paddle_tpu.reader.decorator import PipeReader
+
+    pr = PipeReader("printf a\\nbb\\nccc", bufsize=4)
+    assert list(pr.get_line()) == ["a", "bb", "ccc"]
+    import pytest
+    with pytest.raises(TypeError):
+        PipeReader(["not", "a", "string"])
+    with pytest.raises(TypeError):
+        PipeReader("cat x", file_type="zip")

@@ -316,6 +316,9 @@ class Fake(object):
             if self.fake_reader is None:
                 self.fake_reader = list(
                     item for item, _ in zip(reader(), range(length)))
+                if not self.fake_reader:
+                    raise ValueError(
+                        "Fake: the wrapped reader produced no samples")
             for i in range(length):
                 yield self.fake_reader[i % len(self.fake_reader)]
 
@@ -333,31 +336,38 @@ class PipeReader(object):
             raise TypeError("a command string is required")
         if file_type not in ("gzip", "plain"):
             raise TypeError("file_type %s is not allowed" % file_type)
+        import shlex
         import subprocess
         self.command = command
         self.bufsize = bufsize
         self.file_type = file_type
         self.process = subprocess.Popen(
-            command.split(" "), bufsize=bufsize, stdout=subprocess.PIPE)
+            shlex.split(command), bufsize=bufsize, stdout=subprocess.PIPE)
 
     def get_line(self, cut_lines=True, line_break="\n"):
+        import codecs
         stream = self.process.stdout
         if self.file_type == "gzip":
             import gzip
             stream = gzip.GzipFile(fileobj=stream)
+        # incremental decoder: a multi-byte UTF-8 sequence split across
+        # two reads decodes correctly instead of becoming U+FFFD pairs
+        decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
         remained = ""
         while True:
             buf = stream.read(self.bufsize)
             if not buf:
                 break
-            buf = remained + buf.decode("utf-8", errors="replace")
+            buf = remained + decoder.decode(buf)
             if not cut_lines:
                 remained = ""
-                yield buf
+                if buf:
+                    yield buf
                 continue
             lines = buf.split(line_break)
             remained = lines.pop()
             for line in lines:
                 yield line
+        remained += decoder.decode(b"", final=True)
         if remained:
             yield remained

@@ -219,10 +219,24 @@ def test_fake_reader_replays_first_epoch():
 def test_pipe_reader_lines():
     from paddle_tpu.reader.decorator import PipeReader
 
-    pr = PipeReader("printf a\\nbb\\nccc", bufsize=4)
+    pr = PipeReader("printf 'a\\nbb\\nccc'", bufsize=4)
     assert list(pr.get_line()) == ["a", "bb", "ccc"]
     import pytest
     with pytest.raises(TypeError):
         PipeReader(["not", "a", "string"])
     with pytest.raises(TypeError):
         PipeReader("cat x", file_type="zip")
+
+
+def test_pipe_reader_multibyte_across_buffer_and_quoting(tmp_path):
+    from paddle_tpu.reader.decorator import Fake, PipeReader
+
+    # a multi-byte char straddling the tiny read buffer must survive
+    p = tmp_path / "my data.txt"   # space in path: needs shlex quoting
+    p.write_text("abécd\n中文\n", encoding="utf-8")
+    pr = PipeReader('cat "%s"' % p, bufsize=3)
+    assert list(pr.get_line()) == ["abécd", "中文"]
+
+    import pytest
+    with pytest.raises(ValueError, match="no samples"):
+        list(Fake()(lambda: iter(()), 5)())

@@ -322,6 +322,54 @@ class Executor:
             fetches = [np.asarray(f) for f in fetches]
         return fetches
 
+    def cost_analysis(self, program=None, feed=None, fetch_list=None,
+                      scope=None):
+        """XLA compiled-module cost analysis for the step this
+        (program, feed signature, fetch set) lowers to: exact flops /
+        bytes-accessed per step from the compiler's own accounting (the
+        `est_mfu` heuristic's ground truth; bench.py --exact_mfu).
+        Pays one extra XLA compile of the same module."""
+        if program is None:
+            program = default_main_program()
+        feed = dict(feed or {})
+        fetch_list = fetch_list or []
+        scope = scope if scope is not None else global_scope()
+        fetch_names = [
+            v.name if isinstance(v, Variable) else v for v in fetch_list
+        ]
+        feed_names = sorted(feed.keys())
+        block = program.global_block()
+        feed_vals = []
+        for n in feed_names:
+            v = feed[n]
+            if not isinstance(v, jax.Array):
+                v = np.asarray(v)
+            pv = block._find_var_recursive(n)
+            if pv is not None and pv.dtype is not None and \
+                    np.dtype(v.dtype) != np.dtype(pv.dtype):
+                v = v.astype(pv.dtype)
+            feed_vals.append(v)
+        feed_sig = tuple(
+            (n, tuple(v.shape), str(v.dtype))
+            for n, v in zip(feed_names, feed_vals)
+        )
+        key = self._program_key(program, feed_sig, fetch_names, scope)
+        compiled = self._cache.get(key)
+        if compiled is None:
+            state_names, writeback = self._analyze(
+                program, feed_names, scope, fetch_names)
+            compiled = self._lower(
+                program, feed_names, state_names, writeback, fetch_names)
+            self._cache[key] = compiled
+        state_vals = [np.asarray(scope.var(n)) for n in compiled.state_in]
+        rng = jax.random.key(
+            0, impl="rbg" if flags.flag("fast_prng") else None)
+        lowered = compiled.fn.lower(feed_vals, state_vals, rng)
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return dict(ca)
+
 
 def _check_finite(named_vals):
     """FLAGS_check_nan_inf parity (operator.cc:31,717): verify every

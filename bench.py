@@ -926,7 +926,10 @@ def main():
         # threefry dropout-mask cost (135.9k with both).  --pallas stays
         # available for long-context/memory-bound regimes.
         runs = [
-            ("resnet50", []),
+            # headline carries the XLA-exact flops/bytes accounting
+            # (one extra compile; errors degrade to a field, not a
+            # failed rung)
+            ("resnet50", ["--exact_mfu"]),
             ("resnet50", ["--fp32_only"]),
             ("transformer", ["--fast_prng"]),
             ("transformer", ["--fp32_only", "--fast_prng"]),

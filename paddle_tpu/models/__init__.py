@@ -4,6 +4,6 @@ machine_translation, stacked_dynamic_lstm) — built from the paddle_tpu
 layers DSL, TPU-first (bfloat16-friendly, MXU-sized matmuls/convs).
 """
 
-from . import (alexnet, googlenet, machine_translation,  # noqa: F401
-               mnist, resnet, se_resnext, smallnet,
+from . import (alexnet, ctr_dnn, googlenet,  # noqa: F401
+               machine_translation, mnist, resnet, se_resnext, smallnet,
                stacked_dynamic_lstm, transformer, vgg)

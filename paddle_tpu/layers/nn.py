@@ -32,6 +32,7 @@ __all__ = [
     "cos_sim",
     "sampling_id",
     "smooth_l1",
+    "margin_rank_loss",
     "clip",
     "clip_by_norm",
     "mean",
@@ -443,6 +444,21 @@ def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
         attrs={"sigma": sigma if sigma is not None else 1.0},
     )
     return loss
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """Pairwise hinge max(0, -label*(left-right) + margin) (reference
+    margin_rank_loss_op.cc / nn.py margin_rank_loss; label is +-1)."""
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(dtype=left.dtype)
+    act = helper.create_variable_for_type_inference(dtype=left.dtype)
+    helper.append_op(
+        type="margin_rank_loss",
+        inputs={"Label": [label], "X1": [left], "X2": [right]},
+        outputs={"Out": [out], "Activated": [act]},
+        attrs={"margin": float(margin)},
+    )
+    return out
 
 
 def clip(x, min, max, name=None):

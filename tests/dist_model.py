@@ -41,3 +41,39 @@ def batches():
         y = (x @ proj).argmax(1).astype("int64").reshape(-1, 1)
         out.append((x, y))
     return out
+
+
+# ---- sparse-embedding variant (the dist_ctr-style SelectedRows path) ------
+
+EMB_V, EMB_D, IDS_PER = 128, 8, 4
+
+
+def build_model_sparse(fluid):
+    """Sparse-gradient model: embedding (SelectedRows grads) -> MLP.
+    The multi-host subtlety this exists to test: sparse row-gradients
+    from different processes' local batches must aggregate identically
+    to the single-process dense run."""
+    fluid.default_main_program().random_seed = SEED
+    fluid.default_startup_program().random_seed = SEED
+    ids = fluid.layers.data("ids", shape=[IDS_PER, 1], dtype="int64")
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(ids, size=[EMB_V, EMB_D], is_sparse=True)
+    pooled = fluid.layers.reduce_mean(emb, dim=1)
+    h = fluid.layers.fc(pooled, size=HIDDEN, act="relu")
+    pred = fluid.layers.fc(h, size=CLASSES, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+    return loss
+
+
+def batches_sparse():
+    """Deterministic global feed dicts for the sparse model."""
+    rng = np.random.RandomState(3)
+    out = []
+    for _ in range(STEPS):
+        ids = rng.randint(0, EMB_V, (BATCH, IDS_PER, 1)).astype("int64")
+        y = (ids.reshape(BATCH, IDS_PER).sum(1) % CLASSES) \
+            .astype("int64").reshape(-1, 1)
+        out.append({"ids": ids, "label": y})
+    return out

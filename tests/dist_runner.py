@@ -45,7 +45,12 @@ def main():
     import dist_model
 
     # same model + data as the single-process reference run in the test
-    loss = dist_model.build_model(fluid)
+    # (DIST_MODEL=sparse selects the SelectedRows-gradient variant)
+    model_name = os.environ.get("DIST_MODEL", "mlp")
+    if model_name == "sparse":
+        loss = dist_model.build_model_sparse(fluid)
+    else:
+        loss = dist_model.build_model(fluid)
 
     # the transpiler-produced sharding plan drives the PE
     t = fluid.DistributeTranspiler()
@@ -79,7 +84,10 @@ def main():
         signal.signal(signal.SIGTERM, on_term)
 
     losses = []
-    data = dist_model.batches()
+    if model_name == "sparse":
+        data = dist_model.batches_sparse()
+    else:
+        data = [{"img": x, "label": y} for x, y in dist_model.batches()]
     for i in range(start, len(data)):
         if mgr is not None and distributed.any_process_flagged(flagged):
             # collective flush: every process saves its shards for the
@@ -88,10 +96,9 @@ def main():
             print("CKPT_SAVED", i, flush=True)
             print("DIST_LOSSES", json.dumps(losses), flush=True)
             return
-        x, y = data[i]
         lo = pid * (dist_model.BATCH // nproc)
         hi = lo + dist_model.BATCH // nproc
-        (lv,) = pe.run(feed={"img": x[lo:hi], "label": y[lo:hi]},
+        (lv,) = pe.run(feed={k: v[lo:hi] for k, v in data[i].items()},
                        fetch_list=[loss])
         losses.append(float(np.asarray(lv).ravel()[0]))
         print("STEP", i, flush=True)

@@ -19,6 +19,9 @@ def _train_steps(loss, feed_fn, steps=4, lr=0.01):
 
 
 def test_mnist_cnn_trains():
+    # seeded init: see test_smallnet_trains
+    fluid.default_main_program().random_seed = 5
+    fluid.default_startup_program().random_seed = 5
     img = fluid.layers.data("img", shape=[1, 28, 28])
     label = fluid.layers.data("label", shape=[1], dtype="int64")
     pred = mnist.cnn_model(img, class_dim=10)
@@ -104,6 +107,10 @@ def test_smallnet_trains():
     """Era benchmark trio 1/3 (benchmark/paddle/image/smallnet_mnist_cifar.py)."""
     from paddle_tpu.models import smallnet as m
 
+    # seed the init: an unseeded program draws from the global numpy
+    # stream, making convergence depend on test collection order
+    fluid.default_main_program().random_seed = 5
+    fluid.default_startup_program().random_seed = 5
     img = fluid.layers.data("img", shape=[3, 32, 32])
     label = fluid.layers.data("label", shape=[1], dtype="int64")
     pred = m.smallnet(img, class_dim=10)

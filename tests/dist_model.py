@@ -77,3 +77,94 @@ def batches_sparse():
             .astype("int64").reshape(-1, 1)
         out.append({"ids": ids, "label": y})
     return out
+
+
+# ---- text-classification variant (the dist_text_classification net) -------
+
+TC_V, TC_T, TC_EMB, TC_FILTERS, TC_FC0, TC_CLASSES = 200, 8, 16, 32, 24, 2
+
+
+def build_model_text_cls(fluid):
+    """dist_text_classification workload (reference
+    ``tests/unittests/dist_text_classification.py`` conv_net): embedding
+    -> window-3 tanh sequence conv + max pool -> fc -> softmax fc,
+    cross_entropy loss."""
+    fluid.default_main_program().random_seed = SEED
+    fluid.default_startup_program().random_seed = SEED
+    words = fluid.layers.data("words", shape=[1], dtype="int64",
+                              lod_level=1)
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(words, size=[TC_V, TC_EMB])
+    conv = fluid.nets.sequence_conv_pool(emb, num_filters=TC_FILTERS,
+                                         filter_size=3, act="tanh",
+                                         pool_type="max")
+    fc0 = fluid.layers.fc(conv, size=TC_FC0)
+    pred = fluid.layers.fc(fc0, size=TC_CLASSES, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+    return loss
+
+
+def batches_text_cls():
+    rng = np.random.RandomState(5)
+    out = []
+    for _ in range(STEPS):
+        w = rng.randint(0, TC_V, (BATCH, TC_T, 1)).astype("int64")
+        lens = np.full(BATCH, TC_T, "int64")
+        y = (w.reshape(BATCH, TC_T).max(1) % TC_CLASSES) \
+            .astype("int64").reshape(-1, 1)
+        out.append({"words": w, "words@LEN": lens, "label": y})
+    return out
+
+
+# ---- word2vec n-gram variant (dist_word2vec: shared sparse table) ---------
+
+W2V_V, W2V_EMB, W2V_HID, W2V_N = 150, 12, 32, 5
+
+
+def build_model_word2vec(fluid):
+    """dist_word2vec workload (reference
+    ``tests/unittests/dist_word2vec.py``): four context words through ONE
+    shared sparse embedding table -> concat -> sigmoid fc -> softmax over
+    the vocab.  The multi-host subtlety: every process contributes sparse
+    row-grads to the SAME table rows (shared across the 4 slots)."""
+    fluid.default_main_program().random_seed = SEED
+    fluid.default_startup_program().random_seed = SEED
+    words = [fluid.layers.data("w%d" % i, shape=[1], dtype="int64")
+             for i in range(W2V_N - 1)]
+    label = fluid.layers.data("nextw", shape=[1], dtype="int64")
+    embs = [fluid.layers.embedding(
+                w, size=[W2V_V, W2V_EMB], is_sparse=True,
+                param_attr=fluid.ParamAttr(name="shared_w"))
+            for w in words]
+    concat = fluid.layers.concat(embs, axis=-1)
+    concat = fluid.layers.reshape(
+        concat, shape=[-1, W2V_EMB * (W2V_N - 1)])
+    hidden = fluid.layers.fc(concat, size=W2V_HID, act="sigmoid")
+    pred = fluid.layers.fc(hidden, size=W2V_V, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
+    return loss
+
+
+def batches_word2vec():
+    rng = np.random.RandomState(7)
+    out = []
+    for _ in range(STEPS):
+        ctx = rng.randint(0, W2V_V, (BATCH, W2V_N - 1)).astype("int64")
+        nxt = (ctx.sum(1) % W2V_V).astype("int64").reshape(-1, 1)
+        feed = {"w%d" % i: ctx[:, i:i + 1] for i in range(W2V_N - 1)}
+        feed["nextw"] = nxt
+        out.append(feed)
+    return out
+
+
+# name -> (builder, batches-of-feed-dicts); shared by dist_runner.py and
+# the in-process reference runs in test_dist_train.py
+MODELS = {
+    "mlp": (build_model,
+            lambda: [{"img": x, "label": y} for x, y in batches()]),
+    "sparse": (build_model_sparse, batches_sparse),
+    "text_cls": (build_model_text_cls, batches_text_cls),
+    "word2vec": (build_model_word2vec, batches_word2vec),
+}

@@ -45,12 +45,10 @@ def main():
     import dist_model
 
     # same model + data as the single-process reference run in the test
-    # (DIST_MODEL=sparse selects the SelectedRows-gradient variant)
+    # (DIST_MODEL selects the workload from dist_model.MODELS)
     model_name = os.environ.get("DIST_MODEL", "mlp")
-    if model_name == "sparse":
-        loss = dist_model.build_model_sparse(fluid)
-    else:
-        loss = dist_model.build_model(fluid)
+    build_fn, batches_fn = dist_model.MODELS[model_name]
+    loss = build_fn(fluid)
 
     # the transpiler-produced sharding plan drives the PE
     t = fluid.DistributeTranspiler()
@@ -84,10 +82,7 @@ def main():
         signal.signal(signal.SIGTERM, on_term)
 
     losses = []
-    if model_name == "sparse":
-        data = dist_model.batches_sparse()
-    else:
-        data = [{"img": x, "label": y} for x, y in dist_model.batches()]
+    data = batches_fn()
     for i in range(start, len(data)):
         if mgr is not None and distributed.any_process_flagged(flagged):
             # collective flush: every process saves its shards for the

@@ -41,6 +41,8 @@ from . import reader
 from . import dataset
 from . import contrib
 from .reader import batch
+from . import compat  # noqa: F401
+from . import utils    # noqa: F401
 from .parallel import ParallelExecutor, BuildStrategy, ExecutionStrategy
 from .parallel.mesh import make_mesh
 from . import transpiler
@@ -73,7 +75,7 @@ __all__ = [
     "global_scope", "scope_guard", "ParamAttr", "WeightNormParamAttr",
     "DataFeeder", "io", "profiler", "parallel", "ParallelExecutor",
     "BuildStrategy", "ExecutionStrategy", "make_mesh", "reader",
-    "dataset", "batch", "transpiler", "DistributeTranspiler",
+    "dataset", "batch", "compat", "utils", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "InferenceTranspiler",
     "memory_optimize", "release_memory", "cloud", "set_flags", "get_flags",
     "recordio", "recordio_writer", "inference", "debugger",

@@ -1,0 +1,41 @@
+"""Deployment / preprocessing utility suite (reference
+``python/paddle/utils/``).
+
+Each module re-imagines its reference counterpart over this framework's
+serialization surfaces (Program-JSON instead of protos, npz/tar instead
+of raw parameter streams):
+
+- ``image_util`` / ``preprocess_img`` / ``preprocess_util`` — image
+  augmentation + folder-of-images -> pickled-batch dataset creation.
+- ``dump_config`` / ``dump_v2_config`` — serialize a v1 trainer config /
+  v2 topology for embedded deployment.
+- ``merge_model`` — bundle topology + trained parameters in one file.
+- ``show_pb`` — print a dumped model config.
+- ``plotcurve`` — plot cost curves from trainer logs.
+- ``make_model_diagram`` — graphviz diagram of a v1 config.
+- ``torch2paddle`` — import torch-trained weights into Parameters
+  (reference converted lua-torch binaries; here: torch state_dicts).
+
+The reference's ``predefined_net.py`` (named-network zoo over meta
+files) is absorbed by ``trainer_config_helpers.networks`` +
+``paddle_tpu.models``, which serve the same catalog role as real code.
+"""
+
+from . import (  # noqa: F401
+    dump_config,
+    dump_v2_config,
+    image_util,
+    make_model_diagram,
+    merge_model,
+    plotcurve,
+    preprocess_img,
+    preprocess_util,
+    show_pb,
+    torch2paddle,
+)
+
+__all__ = [
+    "image_util", "preprocess_img", "preprocess_util", "dump_config",
+    "dump_v2_config", "merge_model", "show_pb", "plotcurve",
+    "make_model_diagram", "torch2paddle",
+]

@@ -1,0 +1,37 @@
+"""Dump a parsed v1 trainer config (reference
+``python/paddle/utils/dump_config.py``: parse a config file and print
+the TrainerConfig proto).  Here the "proto" is the TrainerConfig dict
+(Program-JSON model + optimizer settings) from
+``paddle_tpu.trainer.config_parser.parse_config``."""
+
+import json
+import sys
+
+from ..trainer.config_parser import parse_config
+
+__all__ = ["dump_config"]
+
+
+def dump_config(config_fn, config_arg_str="", out=None):
+    """Parse a v1 config callable and write its serialized form."""
+    conf = parse_config(config_fn, config_arg_str)
+    text = json.dumps(conf.to_dict(), indent=2, sort_keys=True)
+    (out or sys.stdout).write(text + "\n")
+    return text
+
+
+def main(argv=None):
+    """CLI: ``python -m paddle_tpu.utils.dump_config conf_module:fn
+    [config_args]`` — mirrors ``python dump_config.py conf [args]``."""
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        raise SystemExit(
+            "usage: dump_config <module:callable> [config_arg_str]")
+    mod_name, _, fn_name = argv[0].partition(":")
+    import importlib
+    fn = getattr(importlib.import_module(mod_name), fn_name or "config")
+    dump_config(fn, argv[1] if len(argv) > 1 else "")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Dump a v2 network topology for deployment (reference
+``python/paddle/utils/dump_v2_config.py``: serialize the pruned
+ModelConfig proto for the C-API).  Here the deployable form is the
+topology's Program-JSON; ``binary=True`` writes the compact encoding the
+embedded C predictor (``paddle_tpu.capi``) loads."""
+
+import json
+
+from ..v2 import config as _cfg
+from ..v2.topology import Topology
+
+__all__ = ["dump_v2_config"]
+
+
+def dump_v2_config(topology, save_path, binary=False):
+    """``topology``: one v2 output layer or a list/tuple of them; all
+    layers reachable from the outputs are dumped, others pruned."""
+    layers = _cfg.as_layers(topology)
+    if not layers:
+        raise RuntimeError("topology must be a v2 layer or a non-empty "
+                           "list/tuple of v2 layers")
+    topo = Topology(layers)
+    out_names = [l.name for l in layers]
+    feeds = [l.name for l in topo.data_layers]
+    for l in topo.data_layers:
+        if getattr(l.var, "_seq_len_name", None):
+            feeds.append(l.var._seq_len_name)
+    pruned = topo.program.clone(for_test=True).prune_feed_fetch(
+        feeds, out_names)
+    doc = {"program": pruned.to_dict(), "feed_names": feeds,
+           "fetch_names": out_names}
+    if binary:
+        with open(save_path, "wb") as f:
+            f.write(json.dumps(doc, sort_keys=True).encode("utf-8"))
+    else:
+        with open(save_path, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+    return doc

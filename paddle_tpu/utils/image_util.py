@@ -1,0 +1,166 @@
+"""Image augmentation helpers (reference
+``python/paddle/utils/image_util.py``: the v1-era CHW float pipeline —
+resize / crop / flip / mean-subtract / 10-crop oversample /
+ImageTransformer).
+
+Same function names and array conventions as the reference (color
+images travel as ``(K, H, W)`` float arrays through crop/preprocess;
+``flip`` and ``oversample`` take HWC), implemented with vectorized
+numpy + PIL.  The finer-grained HWC helpers used by the dataset readers
+live in ``paddle_tpu.dataset.image``.
+"""
+
+import io
+
+import numpy as np
+
+__all__ = [
+    "resize_image", "flip", "crop_img", "decode_jpeg", "preprocess_img",
+    "load_meta", "load_image", "oversample", "ImageTransformer",
+]
+
+
+def resize_image(img, target_size):
+    """Resize a PIL image so its shorter edge is ``target_size``."""
+    from PIL import Image
+
+    w, h = img.size
+    scale = target_size / float(min(w, h))
+    return img.resize((int(round(w * scale)), int(round(h * scale))),
+                      Image.LANCZOS)
+
+
+def flip(im):
+    """Horizontal flip: reverses the LAST axis — (H, W) for grayscale,
+    (K, H, W) for the channel-first color layout this module uses."""
+    return im[..., ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """Crop ``inner_size`` x ``inner_size`` from a (K,H,W) (color) or
+    (H,W) (gray) array, zero-padding images smaller than the crop.
+    test=True takes the center; test=False takes a random crop and
+    flips with probability 1/2."""
+    im = np.asarray(im, dtype="float32")
+    spatial = im.shape[-2:]
+    height, width = (max(inner_size, spatial[0]), max(inner_size, spatial[1]))
+    if (height, width) != spatial:
+        padded = np.zeros(im.shape[:-2] + (height, width), dtype="float32")
+        y0 = (height - spatial[0]) // 2
+        x0 = (width - spatial[1]) // 2
+        padded[..., y0:y0 + spatial[0], x0:x0 + spatial[1]] = im
+        im = padded
+    if test:
+        y0 = (height - inner_size) // 2
+        x0 = (width - inner_size) // 2
+    else:
+        y0 = np.random.randint(0, height - inner_size + 1)
+        x0 = np.random.randint(0, width - inner_size + 1)
+    pic = im[..., y0:y0 + inner_size, x0:x0 + inner_size]
+    if not test and np.random.randint(2) == 0:
+        pic = flip(pic)
+    return pic
+
+
+def decode_jpeg(jpeg_string):
+    """JPEG bytes -> (K, H, W) uint8 array (HW for grayscale)."""
+    from PIL import Image
+
+    arr = np.array(Image.open(io.BytesIO(jpeg_string)))
+    if arr.ndim == 3:
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True):
+    """Augment one (K,H,W) image: crop (random when training, center at
+    test), subtract the mean image, flatten."""
+    pic = crop_img(im.astype("float32"), crop_size, color, test=not is_train)
+    pic -= img_mean
+    return pic.ravel()
+
+
+def load_meta(meta_path, mean_img_size, crop_size, color=True):
+    """Load the dataset's mean image (written by
+    ``preprocess_util.DatasetCreater``) and center-crop it to the
+    training crop size."""
+    mean = np.load(meta_path)["data_mean"]
+    border = (mean_img_size - crop_size) // 2
+    shape = (3, mean_img_size, mean_img_size) if color \
+        else (mean_img_size, mean_img_size)
+    assert mean.size == int(np.prod(shape)), (mean.size, shape)
+    mean = mean.reshape(shape)
+    return mean[..., border:border + crop_size,
+                border:border + crop_size].astype("float32")
+
+
+def load_image(img_path, is_color=True):
+    """Open an image from disk as a PIL image (decoded eagerly),
+    converted to RGB or grayscale per ``is_color``."""
+    from PIL import Image
+
+    img = Image.open(img_path)
+    img.load()
+    return img.convert("RGB" if is_color else "L")
+
+
+def oversample(img, crop_dims):
+    """Caffe-style 10-crop: for each (H,W,K) image in ``img``, the four
+    corner crops + the center crop and their mirrors; returns
+    (10*N, ch, cw, K) float32."""
+    im_shape = np.asarray(img[0].shape)
+    ch, cw = crop_dims
+    corners = [(i, j) for i in (0, im_shape[0] - ch)
+               for j in (0, im_shape[1] - cw)]
+    cy = int(im_shape[0] / 2.0 - ch / 2.0)
+    cx = int(im_shape[1] / 2.0 - cw / 2.0)
+    corners.append((cy, cx))
+    crops = np.empty((10 * len(img), ch, cw, im_shape[-1]), dtype="float32")
+    ix = 0
+    for im in img:
+        for y0, x0 in corners:
+            crops[ix] = im[y0:y0 + ch, x0:x0 + cw, :]
+            ix += 1
+        crops[ix:ix + 5] = crops[ix - 5:ix, :, ::-1, :]   # mirrors
+        ix += 5
+    return crops
+
+
+class ImageTransformer(object):
+    """Configurable transpose / channel-swap / mean-subtract pipeline
+    (reference image_util.py:183)."""
+
+    def __init__(self, transpose=None, channel_swap=None, mean=None,
+                 is_color=True):
+        self.is_color = is_color
+        self.set_transpose(transpose)
+        self.set_channel_swap(channel_swap)
+        self.set_mean(mean)
+
+    def set_transpose(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.transpose = order
+
+    def set_channel_swap(self, order):
+        if order is not None and self.is_color:
+            assert len(order) == 3
+        self.channel_swap = order
+
+    def set_mean(self, mean):
+        if mean is not None:
+            mean = np.asarray(mean)
+            if mean.ndim == 1:
+                mean = mean[:, np.newaxis, np.newaxis]
+            elif self.is_color:
+                assert mean.ndim == 3
+        self.mean = mean
+
+    def transformer(self, data):
+        if self.transpose is not None:
+            data = data.transpose(self.transpose)
+        if self.channel_swap is not None:
+            data = data[self.channel_swap, :, :]
+        if self.mean is not None:
+            data = data - self.mean
+        return data

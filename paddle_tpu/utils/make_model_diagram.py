@@ -1,0 +1,37 @@
+"""Graphviz diagram of a v1 model config (reference
+``python/paddle/utils/make_model_diagram.py``: emit a .dot of the layer
+graph).  Parses the config through the trainer_config_helpers dialect
+and delegates drawing to ``paddle_tpu.net_drawer`` over the resulting
+Program — one drawing path for every API dialect."""
+
+import sys
+
+from ..trainer.config_parser import parse_config
+
+__all__ = ["make_diagram"]
+
+
+def make_diagram(config_fn, dot_path, config_arg_str=""):
+    """Parse a v1 config callable, write the op graph as graphviz dot.
+    Returns the dot source text."""
+    from .. import net_drawer
+
+    conf = parse_config(config_fn, config_arg_str)
+    prog = conf.model_config.program if hasattr(conf.model_config, "program") \
+        else conf.model_config
+    return net_drawer.draw_graph(main_program=prog, path=dot_path)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) < 2:
+        raise SystemExit(
+            "usage: make_model_diagram <module:callable> <out.dot>")
+    mod_name, _, fn_name = argv[0].partition(":")
+    import importlib
+    fn = getattr(importlib.import_module(mod_name), fn_name or "config")
+    make_diagram(fn, argv[1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Bundle a v2 topology + trained parameters into ONE deployable file
+(reference ``python/paddle/utils/merge_model.py`` ``merge_v2_model``:
+proto-size + ModelConfig proto + parameter streams in a single binary
+for the C-API/mobile path).
+
+Here the bundle is a tar with two members — ``__model__.json`` (the
+pruned Program-JSON written by ``dump_v2_config``) and ``params.npz``
+(name -> ndarray) — loadable by ``load_merged_model`` or unpackable by
+standard tools on the deployment host."""
+
+import io
+import json
+import os
+import tarfile
+import tempfile
+
+import numpy as np
+
+from .dump_v2_config import dump_v2_config
+
+__all__ = ["merge_v2_model", "load_merged_model"]
+
+
+def merge_v2_model(net, param_file, output_file):
+    """``net``: the v2 output layer(s); ``param_file``: a Parameters tar
+    written by ``Parameters.to_tar`` (or an open file object of one);
+    ``output_file``: bundle destination."""
+    from ..v2.parameters import Parameters
+
+    if hasattr(param_file, "read"):
+        params = Parameters.from_tar(param_file)
+    else:
+        with open(param_file, "rb") as f:
+            params = Parameters.from_tar(f)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model_path = os.path.join(tmp, "__model__.json")
+        dump_v2_config(net, model_path, binary=True)
+        npz = io.BytesIO()
+        np.savez(npz, **{name: params.get(name) for name in params.names()})
+        npz.seek(0)
+        with tarfile.open(output_file, "w") as tar:
+            tar.add(model_path, arcname="__model__.json")
+            info = tarfile.TarInfo("params.npz")
+            info.size = len(npz.getbuffer())
+            tar.addfile(info, npz)
+    return output_file
+
+
+def load_merged_model(path):
+    """Returns (model_doc, {param_name: ndarray}) from a merged bundle."""
+    with tarfile.open(path, "r") as tar:
+        doc = json.loads(tar.extractfile("__model__.json").read()
+                         .decode("utf-8"))
+        with np.load(io.BytesIO(tar.extractfile("params.npz").read())) as z:
+            params = {k: z[k] for k in z.files}
+    return doc, params

@@ -1,0 +1,74 @@
+"""Plot cost curves from trainer logs (reference
+``python/paddle/utils/plotcurve.py``: scrape ``key=value`` metrics out
+of paddle_trainer output and plot them per pass).
+
+Works on this framework's logs the same way: any line containing
+``<key>=<float>`` tokens (the v1 trainer, ``v2.trainer.SGD`` event
+prints, and the Trainer's EndStepEvent logging all emit this shape)."""
+
+import re
+import sys
+
+__all__ = ["parse_log", "plot_paddle_curve"]
+
+_TOKEN = re.compile(r"([A-Za-z_][A-Za-z0-9_.]*)=([-+0-9.eE]+)")
+
+
+def parse_log(lines, keys):
+    """{key: [values in log order]} for every requested key."""
+    out = {k: [] for k in keys}
+    for line in lines:
+        for k, v in _TOKEN.findall(line):
+            if k in out:
+                try:
+                    out[k].append(float(v))
+                except ValueError:
+                    pass
+    return out
+
+
+def plot_paddle_curve(keys, inputfile, outputfile, format="png",
+                      show_fig=False):
+    """Read a log stream, plot one curve per key.  ``inputfile`` and
+    ``outputfile`` are open file objects (reference signature)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    series = parse_log(inputfile, keys)
+    if not any(series.values()):
+        sys.stderr.write("plotcurve: no occurrence of keys %s\n" % keys)
+        return series
+    plt.figure(figsize=(8, 5))
+    for k in keys:
+        if series[k]:
+            plt.plot(range(len(series[k])), series[k], label=k)
+    plt.xlabel("step")
+    plt.legend()
+    plt.savefig(outputfile, format=format, bbox_inches="tight")
+    plt.close()
+    return series
+
+
+def main(argv=None):
+    """CLI: ``plotcurve.py -i log -o out.png key1 key2 ...`` (stdin if
+    no -i, like the reference)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("-i", "--input", default=None)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--format", default="png")
+    p.add_argument("keys", nargs="+")
+    a = p.parse_args(argv)
+    infile = open(a.input) if a.input else sys.stdin
+    try:
+        with open(a.output, "wb") as out:
+            plot_paddle_curve(a.keys, infile, out, format=a.format)
+    finally:
+        if a.input:
+            infile.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Image-classification dataset creator (reference
+``python/paddle/utils/preprocess_img.py``): resize every image in a
+labeled folder tree to a fixed short edge, store them as compact JPEG
+bytes in pickled batches, and write the mean-image meta consumed by
+``image_util.load_meta``."""
+
+import io
+import os
+
+import numpy as np
+
+from . import preprocess_util
+from .image_util import resize_image
+
+__all__ = ["DiskImage", "ImageClassificationDatasetCreater"]
+
+
+class DiskImage(object):
+    """One on-disk image, resized lazily to ``target_size`` short edge
+    (reference preprocess_img.py:37)."""
+
+    def __init__(self, path, target_size):
+        self.path = path
+        self.target_size = target_size
+        self.img = None
+
+    def read_image(self):
+        if self.img is None:
+            from PIL import Image
+
+            img = Image.open(self.path)
+            img.load()
+            self.img = resize_image(img.convert("RGB"), self.target_size)
+        return self.img
+
+    def convert_to_array(self):
+        """(K, H, W) float array."""
+        arr = np.array(self.read_image())
+        if arr.ndim == 3:
+            arr = np.transpose(arr, (2, 0, 1))
+        return arr
+
+    def convert_to_paddle_format(self):
+        """Re-encoded JPEG bytes — what the batch files store."""
+        out = io.BytesIO()
+        self.read_image().save(out, "jpeg")
+        return out.getvalue()
+
+
+class ImageClassificationDatasetCreater(preprocess_util.DatasetCreater):
+    """``data_path/{train,test}/<label>/*.jpg`` -> pickled JPEG batches
+    + mean-image meta (npz with ``data_mean`` flattened to match
+    ``image_util.load_meta``)."""
+
+    def __init__(self, data_path, batch_size=128, processed_image_size=56,
+                 output_path=None):
+        super().__init__(data_path, batch_size, output_path)
+        self.processed_image_size = processed_image_size
+
+    def process_file(self, path):
+        return DiskImage(path, self.processed_image_size) \
+            .convert_to_paddle_format()
+
+    def create_meta_file(self, samples):
+        """Mean over center-cropped square images, flattened."""
+        from PIL import Image
+
+        s = self.processed_image_size
+        acc = np.zeros((3, s, s), dtype="float64")
+        for jpeg in samples:
+            arr = np.array(Image.open(io.BytesIO(jpeg)))
+            arr = np.transpose(arr, (2, 0, 1)).astype("float64")
+            y0 = (arr.shape[1] - s) // 2
+            x0 = (arr.shape[2] - s) // 2
+            acc += arr[:, y0:y0 + s, x0:x0 + s]
+        mean = (acc / max(len(samples), 1)).astype("float32").ravel()
+        os.makedirs(self.output_path, exist_ok=True)
+        np.savez(os.path.join(self.output_path, self.meta_filename),
+                 data_mean=mean)

@@ -1,0 +1,177 @@
+"""Folder-of-files -> pickled-batch dataset machinery (reference
+``python/paddle/utils/preprocess_util.py``: the v1 offline
+preprocessing story — walk a labeled directory tree, shuffle, emit
+fixed-size pickled batches plus list/meta files).
+
+Same public surface (``save_file`` … ``DatasetCreater``); internals are
+a py3/numpy rewrite.  Concrete per-modality creators subclass
+``DatasetCreater`` (see ``preprocess_img``)."""
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = [
+    "save_file", "save_list", "exclude_pattern", "list_dirs",
+    "list_images", "list_files", "get_label_set_from_dir", "Label",
+    "Dataset", "DatasetCreater",
+]
+
+
+def save_file(data, filename):
+    """Pickle ``data`` to ``filename``."""
+    with open(filename, "wb") as f:
+        pickle.dump(data, f, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_file(filename):
+    """Inverse of save_file."""
+    with open(filename, "rb") as f:
+        return pickle.load(f)
+
+
+def save_list(l, outfile):
+    """Write one item per line."""
+    with open(outfile, "w") as f:
+        for item in l:
+            f.write("%s\n" % item)
+
+
+def exclude_pattern(f):
+    """Names starting with '.' or '_' are metadata, not data."""
+    return f.startswith(".") or f.startswith("_")
+
+
+def list_dirs(path):
+    return sorted(
+        d for d in os.listdir(path)
+        if os.path.isdir(os.path.join(path, d)) and not exclude_pattern(d))
+
+
+def list_images(path, exts=("jpg", "png", "bmp", "jpeg")):
+    return sorted(
+        f for f in os.listdir(path)
+        if os.path.isfile(os.path.join(path, f)) and not exclude_pattern(f)
+        and f.rsplit(".", 1)[-1].lower() in set(exts))
+
+
+def list_files(path):
+    return sorted(
+        f for f in os.listdir(path)
+        if os.path.isfile(os.path.join(path, f)) and not exclude_pattern(f))
+
+
+def get_label_set_from_dir(path):
+    """{label_name: integer id} from the subdirectory names (one
+    directory per class)."""
+    return {name: i for i, name in enumerate(list_dirs(path))}
+
+
+class Label(object):
+    """A (id, name) class label."""
+
+    def __init__(self, label, name):
+        self.label = int(label)
+        self.name = name
+
+    def convert_to_paddle_format(self):
+        return self.label
+
+    def __hash__(self):
+        return hash((self.label, self.name))
+
+    def __eq__(self, other):
+        return (self.label, self.name) == (other.label, other.name)
+
+    def __repr__(self):
+        return "Label(%d, %r)" % (self.label, self.name)
+
+
+class Dataset(object):
+    """An in-memory table of samples: ``data`` is a list of tuples,
+    ``keys`` names the tuple fields (e.g. ["image", "label"])."""
+
+    def __init__(self, data, keys):
+        self.data = list(data)
+        self.keys = list(keys)
+
+    def check_valid(self):
+        for item in self.data:
+            assert len(item) == len(self.keys), (item, self.keys)
+
+    def uniform_permute(self, seed=0):
+        """Uniform shuffle (the reference's class-balancing permutes are
+        subsumed: one global shuffle gives each batch the dataset's
+        label mix in expectation)."""
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self.data)
+
+    def batches(self, batch_size):
+        for i in range(0, len(self.data), batch_size):
+            yield self.data[i:i + batch_size]
+
+
+class DatasetCreater(object):
+    """Walk ``data_path/{train,test}/<label>/...``, emit shuffled
+    pickled batches + ``train.list`` / ``test.list`` + a ``meta`` file.
+
+    Subclasses implement ``process_file(path) -> sample`` (the stored
+    per-file record) and may override ``create_meta_file(samples)`` to
+    write modality statistics (e.g. the mean image)."""
+
+    def __init__(self, data_path, batch_size=128, output_path=None):
+        self.data_path = data_path
+        self.batch_size = batch_size
+        self.output_path = output_path or os.path.join(data_path, "batches")
+        self.meta_filename = "meta.npz"   # np.savez appends .npz itself
+        self.train_list_name = "train.list"
+        self.test_list_name = "test.list"
+
+    # -- subclass hooks --
+    def process_file(self, path):
+        raise NotImplementedError
+
+    def create_meta_file(self, samples):
+        pass
+
+    # -- driver --
+    def create_dataset_from_dir(self, which):
+        src = os.path.join(self.data_path, which)
+        label_set = get_label_set_from_dir(src)
+        rows = []
+        for name, label in sorted(label_set.items(), key=lambda kv: kv[1]):
+            for f in list_files(os.path.join(src, name)):
+                rows.append((self.process_file(os.path.join(src, name, f)),
+                             label))
+        ds = Dataset(rows, ["data", "label"])
+        ds.check_valid()
+        ds.uniform_permute()
+        return ds, label_set
+
+    def create_batches(self, which):
+        """Returns the list of batch files written for the split."""
+        ds, label_set = self.create_dataset_from_dir(which)
+        os.makedirs(self.output_path, exist_ok=True)
+        files = []
+        for i, batch in enumerate(ds.batches(self.batch_size)):
+            fn = os.path.join(self.output_path,
+                              "%s_batch_%03d" % (which, i))
+            save_file({"data": [b[0] for b in batch],
+                       "labels": [b[1] for b in batch],
+                       "label_set": label_set}, fn)
+            files.append(fn)
+        save_list(files, os.path.join(
+            self.output_path,
+            self.train_list_name if which == "train" else self.test_list_name))
+        if which == "train":
+            self.create_meta_file([r[0] for r in ds.data])
+        return files
+
+    def create_dataset(self):
+        """Process both splits; the standard entry point."""
+        out = {}
+        for which in ("train", "test"):
+            if os.path.isdir(os.path.join(self.data_path, which)):
+                out[which] = self.create_batches(which)
+        return out

@@ -1,0 +1,57 @@
+"""Print a dumped model config in readable form (reference
+``python/paddle/utils/show_pb.py``: parse a ModelConfig/TrainerConfig
+proto file and print its text format).  Accepts anything this framework
+serializes a model to: a ``dump_v2_config``/``merge_model`` output, a
+``save_inference_model`` directory, or a bare Program-JSON file."""
+
+import json
+import os
+import sys
+import tarfile
+
+__all__ = ["read_model", "show"]
+
+
+def read_model(path):
+    """Load the model document from any supported container."""
+    if os.path.isdir(path):                      # save_inference_model dir
+        path = os.path.join(path, "__model__")
+    if tarfile.is_tarfile(path):                 # merge_model bundle
+        with tarfile.open(path, "r") as tar:
+            return json.loads(
+                tar.extractfile("__model__.json").read().decode("utf-8"))
+    with open(path, "rb") as f:
+        return json.loads(f.read().decode("utf-8"))
+
+
+def show(path, out=None):
+    """Print the model: feeds/fetches, then one line per op."""
+    out = out or sys.stdout
+    doc = read_model(path)
+    prog = doc.get("program", doc)
+    if "feed_names" in doc:
+        out.write("feeds:   %s\n" % ", ".join(doc["feed_names"]))
+    if "fetch_names" in doc:
+        out.write("fetches: %s\n" % ", ".join(doc["fetch_names"]))
+    for bi, block in enumerate(prog.get("blocks", [])):
+        out.write("block %d (%d vars, %d ops)\n"
+                  % (bi, len(block.get("vars", [])),
+                     len(block.get("ops", []))))
+        for op in block.get("ops", []):
+            ins = "; ".join("%s=%s" % (k, v)
+                            for k, v in sorted(op.get("inputs", {}).items()))
+            outs = "; ".join(
+                "%s=%s" % (k, v)
+                for k, v in sorted(op.get("outputs", {}).items()))
+            out.write("  %-28s (%s) -> (%s)\n" % (op["type"], ins, outs))
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        raise SystemExit("usage: show_pb <model file|dir>")
+    show(argv[0])
+
+
+if __name__ == "__main__":
+    main()

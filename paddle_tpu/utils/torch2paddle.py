@@ -1,0 +1,109 @@
+"""Import torch-trained weights (reference
+``python/paddle/utils/torch2paddle.py``, which decoded lua-torch
+binaries and wrote v1 parameter files).  The modern equivalent: map a
+PyTorch ``state_dict`` (or a saved ``.pt``/``.pth`` file) onto this
+framework's parameters by name.
+
+Works against either surface:
+- ``torch2paddle(state_dict, parameters)`` — a ``v2.Parameters`` object
+  (sets each matching name via ``Parameters.set``), or
+- ``torch2paddle(state_dict, scope=scope, program=prog)`` — a fluid
+  scope (sets parameter variables directly).
+
+``name_map`` translates torch names to parameter names; unmapped names
+match verbatim.  torch ``nn.Linear`` stores weights [out, in] where
+fluid ``fc`` weights are [in, out]; ``transpose_fc=True`` transposes
+exactly the Linear weights when ``src`` is a module (detected from the
+module tree), or — for a bare state_dict, where layer types are
+unknown — the torch names listed in ``transpose_fc`` when it is an
+iterable.  ``transpose_fc=True`` with a bare state_dict transposes
+every 2-D tensor and is only safe when all of them are Linear weights
+(pass the iterable form otherwise)."""
+
+import numpy as np
+
+__all__ = ["load_state_dict", "torch2paddle"]
+
+
+def _linear_weight_names(module):
+    """Torch state_dict keys that are nn.Linear weights."""
+    import torch
+
+    return {name + ".weight" if name else "weight"
+            for name, m in module.named_modules()
+            if isinstance(m, torch.nn.Linear)}
+
+
+def load_state_dict(path_or_dict):
+    """Accept a state_dict, an nn.Module, or a path to a torch save."""
+    if isinstance(path_or_dict, dict):
+        sd = path_or_dict
+    elif hasattr(path_or_dict, "state_dict"):
+        sd = path_or_dict.state_dict()
+    else:
+        import torch
+        sd = torch.load(path_or_dict, map_location="cpu")
+        if hasattr(sd, "state_dict"):
+            sd = sd.state_dict()
+        if "state_dict" in sd and isinstance(sd["state_dict"], dict):
+            sd = sd["state_dict"]
+    out = {}
+    for k, v in sd.items():
+        out[k] = v.detach().cpu().numpy() if hasattr(v, "detach") \
+            else np.asarray(v)
+    return out
+
+
+def torch2paddle(src, parameters=None, scope=None, program=None,
+                 name_map=None, transpose_fc=False, strict=True):
+    """Copy weights from ``src`` into ``parameters`` or ``scope``.
+    Returns the list of parameter names written."""
+    sd = load_state_dict(src)
+    name_map = name_map or {}
+    if transpose_fc is True and hasattr(src, "named_modules"):
+        transpose_names = _linear_weight_names(src)
+    elif transpose_fc is True:
+        transpose_names = {k for k, v in sd.items() if v.ndim == 2}
+    elif transpose_fc:
+        transpose_names = set(transpose_fc)
+    else:
+        transpose_names = set()
+    written = []
+
+    def targets():
+        if parameters is not None:
+            names = set(parameters.names())
+
+            def setter(name, arr):
+                parameters.set(name, arr)
+        else:
+            assert scope is not None and program is not None, \
+                "pass either parameters= or scope= and program="
+            by_name = {p.name: p for p in program.global_block()
+                       .all_parameters()}
+            names = set(by_name)
+
+            def setter(name, arr):
+                expect = tuple(by_name[name].shape)
+                if tuple(arr.shape) != expect:
+                    raise ValueError(
+                        "shape mismatch for %r: torch %s vs parameter %s"
+                        % (name, arr.shape, expect))
+                scope.set_var(name, np.ascontiguousarray(arr))
+        return names, setter
+
+    names, setter = targets()
+    for tname, arr in sd.items():
+        pname = name_map.get(tname, tname)
+        if pname not in names:
+            if strict and tname in name_map:
+                raise KeyError("mapped target %r not a parameter" % pname)
+            continue
+        if tname in transpose_names and arr.ndim == 2:
+            arr = arr.T
+        setter(pname, arr.astype("float32"))
+        written.append(pname)
+    if strict and not written:
+        raise ValueError("no torch tensors matched any parameter; "
+                         "pass name_map= to translate names")
+    return written

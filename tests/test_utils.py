@@ -1,0 +1,270 @@
+"""The deployment/preprocessing utility suite (reference
+python/paddle/utils/): image_util transforms, dataset creation,
+config dumps, model merging, plotcurve, torch weight import, compat."""
+
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+import paddle_tpu as fluid
+from paddle_tpu import compat
+from paddle_tpu.utils import (
+    dump_config,
+    dump_v2_config,
+    image_util,
+    make_model_diagram,
+    merge_model,
+    plotcurve,
+    preprocess_img,
+    preprocess_util,
+    show_pb,
+    torch2paddle,
+)
+
+
+# ---------------------------------------------------------------- image_util
+
+def test_image_util_flip_and_crop():
+    im = np.arange(3 * 8 * 10, dtype="float32").reshape(3, 8, 10)
+    assert np.array_equal(image_util.flip(im), im[:, :, ::-1])
+    gray = im[0]
+    assert np.array_equal(image_util.flip(gray), gray[:, ::-1])
+
+    # center crop of an even-sized image takes the middle window
+    pic = image_util.crop_img(im, 4, color=True, test=True)
+    assert pic.shape == (3, 4, 4)
+    np.testing.assert_array_equal(pic, im[:, 2:6, 3:7])
+    # images smaller than the crop get zero-padded, content centered
+    small = np.ones((3, 2, 2), "float32")
+    padded = image_util.crop_img(small, 4, test=True)
+    assert padded.shape == (3, 4, 4)
+    assert padded.sum() == small.sum()
+    np.testing.assert_array_equal(padded[:, 1:3, 1:3], small)
+
+
+def test_image_util_jpeg_preprocess_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 255, (12, 16, 3)).astype("uint8")
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "png")
+    # decode_jpeg handles any PIL-decodable payload; returns CHW
+    chw = image_util.decode_jpeg(buf.getvalue())
+    assert chw.shape == (3, 12, 16)
+    np.testing.assert_array_equal(chw, arr.transpose(2, 0, 1))
+
+    mean = np.zeros((3, 8, 8), "float32")
+    flat = image_util.preprocess_img(chw, mean, 8, is_train=False)
+    assert flat.shape == (3 * 8 * 8,)
+    np.testing.assert_array_equal(
+        flat.reshape(3, 8, 8), chw[:, 2:10, 4:12].astype("float32"))
+
+
+def test_image_util_oversample_and_transformer():
+    img = np.random.RandomState(1).rand(8, 8, 3).astype("float32")
+    crops = image_util.oversample([img], (4, 4))
+    assert crops.shape == (10, 4, 4, 3)
+    # second half is the mirrored first half
+    np.testing.assert_array_equal(crops[5:], crops[:5][:, :, ::-1, :])
+    # center crop is the middle window
+    np.testing.assert_array_equal(crops[4], img[2:6, 2:6, :])
+
+    t = image_util.ImageTransformer(transpose=(2, 0, 1),
+                                    channel_swap=(2, 1, 0),
+                                    mean=np.array([1.0, 2.0, 3.0]))
+    out = t.transformer(img)
+    ref = img.transpose(2, 0, 1)[(2, 1, 0), :, :] \
+        - np.array([1.0, 2.0, 3.0])[:, None, None]
+    np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+# ------------------------------------------------- preprocess_{util,img}
+
+def _write_image_tree(root, n_per_label=3, size=10):
+    rng = np.random.RandomState(7)
+    for split in ("train", "test"):
+        for label in ("cat", "dog"):
+            d = os.path.join(root, split, label)
+            os.makedirs(d)
+            for i in range(n_per_label):
+                arr = rng.randint(0, 255, (size + 2, size, 3)).astype("uint8")
+                Image.fromarray(arr).save(os.path.join(d, "%d.png" % i))
+
+
+def test_image_dataset_creation(tmp_path):
+    _write_image_tree(str(tmp_path))
+    creator = preprocess_img.ImageClassificationDatasetCreater(
+        str(tmp_path), batch_size=4, processed_image_size=8)
+    out = creator.create_dataset()
+    assert set(out) == {"train", "test"}
+
+    batch = preprocess_util.load_file(out["train"][0])
+    assert batch["label_set"] == {"cat": 0, "dog": 1}
+    assert len(batch["data"]) == len(batch["labels"]) == 4
+    # stored records decode back to images
+    arr = image_util.decode_jpeg(batch["data"][0])
+    assert arr.shape[0] == 3 and min(arr.shape[1:]) == 8
+
+    # meta round-trips through image_util.load_meta
+    mean = image_util.load_meta(
+        os.path.join(creator.output_path, creator.meta_filename),
+        mean_img_size=8, crop_size=6)
+    assert mean.shape == (3, 6, 6) and np.isfinite(mean).all()
+
+    lists = open(os.path.join(creator.output_path, "train.list")).read()
+    assert len(lists.splitlines()) == len(out["train"])
+
+
+# ---------------------------------------------- config dumps + merge + show
+
+def _v1_config():
+    from paddle_tpu import trainer_config_helpers as tch
+
+    tch.settings(batch_size=8, learning_rate=0.1)
+    x = tch.data_layer(name="x", size=6)
+    h = tch.fc_layer(input=x, size=4, act=tch.ReluActivation())
+    tch.outputs(h)
+
+
+def test_dump_config_and_diagram(tmp_path):
+    out = io.StringIO()
+    text = dump_config.dump_config(_v1_config, out=out)
+    doc = json.loads(text)
+    assert doc["opt_config"]["batch_size"] == 8
+    assert any(op["type"] == "relu" or op["type"] == "mul"
+               for b in doc["model_config"]["program"]["blocks"]
+               for op in b["ops"])
+
+    dot = str(tmp_path / "net.dot")
+    make_model_diagram.make_diagram(_v1_config, dot)
+    assert "digraph" in open(dot).read()
+
+
+def test_dump_v2_merge_show(tmp_path):
+    from paddle_tpu import v2 as paddle
+
+    paddle.reset()
+    try:
+        x = paddle.layer.data(name="x",
+                              type=paddle.data_type.dense_vector(6))
+        pred = paddle.layer.fc(input=x, size=3,
+                               act=paddle.activation.Softmax())
+        params = paddle.parameters.create(pred)
+
+        model_path = str(tmp_path / "model.json")
+        doc = dump_v2_config.dump_v2_config(pred, model_path, binary=True)
+        assert doc["fetch_names"] == [pred.name]
+
+        tar_path = str(tmp_path / "params.tar")
+        with open(tar_path, "wb") as f:
+            params.to_tar(f)
+        bundle = str(tmp_path / "bundle.tar")
+        merge_model.merge_v2_model(pred, tar_path, bundle)
+
+        doc2, weights = merge_model.load_merged_model(bundle)
+        assert doc2["program"] == doc["program"]
+        assert set(weights) == set(params.names())
+
+        buf = io.StringIO()
+        show_pb.show(bundle, out=buf)
+        assert "feeds:" in buf.getvalue() and "mul" in buf.getvalue()
+    finally:
+        paddle.reset()
+
+
+# ----------------------------------------------------------------- plotcurve
+
+def test_plotcurve(tmp_path):
+    log = io.StringIO(
+        "Pass=0 Batch=0 AvgCost=2.5 Eval: err=0.9\n"
+        "garbage line\n"
+        "Pass=0 Batch=1 AvgCost=1.25 Eval: err=0.5\n")
+    out = str(tmp_path / "curve.png")
+    with open(out, "wb") as f:
+        series = plotcurve.plot_paddle_curve(["AvgCost", "err"], log, f)
+    assert series["AvgCost"] == [2.5, 1.25]
+    assert series["err"] == [0.9, 0.5]
+    assert open(out, "rb").read(4).startswith(b"\x89PNG")
+
+
+# -------------------------------------------------------------- torch2paddle
+
+def test_torch2paddle_fc_import():
+    import torch
+
+    lin = torch.nn.Linear(5, 3)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        x = fluid.layers.data("x", shape=[5])
+        y = fluid.layers.fc(x, size=3,
+                            param_attr=fluid.ParamAttr(name="fc_w"),
+                            bias_attr=fluid.ParamAttr(name="fc_b"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(fluid.default_startup_program())
+            written = torch2paddle.torch2paddle(
+                lin, scope=scope, program=fluid.default_main_program(),
+                name_map={"weight": "fc_w", "bias": "fc_b"},
+                transpose_fc=True)
+            assert sorted(written) == ["fc_b", "fc_w"]
+            xin = np.random.RandomState(3).rand(2, 5).astype("float32")
+            (out,) = exe.run(feed={"x": xin}, fetch_list=[y])
+    ref = lin(torch.tensor(xin)).detach().numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
+
+    # module-aware transpose_fc=True must NOT touch non-Linear 2-D weights
+    class EmbNet(__import__("torch").nn.Module):
+        def __init__(self):
+            import torch
+            super().__init__()
+            self.emb = torch.nn.Embedding(4, 4)
+            self.lin = torch.nn.Linear(4, 4)
+
+    net = EmbNet()
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        e = fluid.layers.embedding(ids, size=[4, 4],
+                                   param_attr=fluid.ParamAttr(name="emb_w"))
+        fluid.layers.fc(e, size=4, param_attr=fluid.ParamAttr(name="lin_w"))
+        scope2 = fluid.Scope()
+        with fluid.scope_guard(scope2):
+            fluid.Executor(fluid.CPUPlace()).run(
+                fluid.default_startup_program())
+            torch2paddle.torch2paddle(
+                net, scope=scope2, program=fluid.default_main_program(),
+                name_map={"emb.weight": "emb_w", "lin.weight": "lin_w"},
+                transpose_fc=True)
+            np.testing.assert_array_equal(
+                np.asarray(scope2.find_var("emb_w")),
+                net.emb.weight.detach().numpy())        # NOT transposed
+            np.testing.assert_array_equal(
+                np.asarray(scope2.find_var("lin_w")),
+                net.lin.weight.detach().numpy().T)      # transposed
+
+    with pytest.raises(ValueError, match="no torch tensors matched"):
+        with fluid.program_guard(fluid.Program(), fluid.Program()):
+            fluid.layers.fc(fluid.layers.data("x", shape=[5]), size=3)
+            torch2paddle.torch2paddle(
+                lin, scope=fluid.Scope(),
+                program=fluid.default_main_program())
+
+
+# -------------------------------------------------------------------- compat
+
+def test_compat():
+    assert compat.to_text(b"ab") == "ab"
+    assert compat.to_bytes("ab") == b"ab"
+    assert compat.to_text([b"a", {b"k": b"v"}]) == ["a", {"k": "v"}]
+    l = [b"x"]
+    assert compat.to_text(l, inplace=True) is l and l == ["x"]
+    assert compat.round(2.5) == 3.0 and compat.round(-2.5) == -3.0
+    assert compat.round(0.125, 2) == 0.13
+    assert compat.floor_division(7, 2) == 3
+    assert compat.get_exception_message(ValueError("boom")) == "boom"
+    # unknown types pass through untouched (reference else-branch)
+    t = (b"a", b"b")
+    assert compat.to_text(t) is t
+    assert compat.to_text(np.int64(3)) == np.int64(3)

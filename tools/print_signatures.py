@@ -43,6 +43,11 @@ MODULES = [
     "paddle_tpu.parallel",
     "paddle_tpu.parallel.checkpoint",
     "paddle_tpu.transpiler",
+    "paddle_tpu.compat",
+    "paddle_tpu.utils",
+    "paddle_tpu.utils.image_util",
+    "paddle_tpu.utils.preprocess_util",
+    "paddle_tpu.utils.torch2paddle",
     "paddle_tpu.contrib",
     "paddle_tpu.contrib.mixed_precision",
     "paddle_tpu.v2",

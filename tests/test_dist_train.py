@@ -22,10 +22,9 @@ def _free_port():
     return port
 
 
-def _run_dist_parity(workload):
-    """Single-process reference run, then 2 real trainer processes on the
-    same workload; every trainer's per-step losses must match the local
-    run (the reference's test_dist_base protocol)."""
+def _local_reference(workload):
+    """In-process single-device run of a dist_model workload; the loss
+    sequence every distributed trainer must reproduce."""
     import dist_model
 
     build_fn, batches_fn = dist_model.MODELS[workload]
@@ -36,6 +35,14 @@ def _run_dist_parity(workload):
     for feed in batches_fn():
         (lv,) = exe.run(feed=feed, fetch_list=[loss])
         ref.append(float(np.asarray(lv).ravel()[0]))
+    return ref
+
+
+def _run_dist_parity(workload):
+    """Single-process reference run, then 2 real trainer processes on the
+    same workload; every trainer's per-step losses must match the local
+    run (the reference's test_dist_base protocol)."""
+    ref = _local_reference(workload)
 
     port = _free_port()
     coordinator = "127.0.0.1:%d" % port
@@ -147,7 +154,7 @@ def test_dist_trainer_kill_and_resume(tmp_path):
     via the preemption vote, write a collective sharded checkpoint, and
     exit 0; a restarted run resumes from it and the combined losses
     reproduce the uninterrupted single-process reference."""
-    ref = _single_process_reference()
+    ref = _local_reference("mlp")
     ckpt = str(tmp_path / "preempt_ckpt")
     runner = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "dist_runner.py")

@@ -14,3 +14,6 @@ from . import quantize  # noqa: F401,E402
 from .quantize import QuantizeTranspiler  # noqa: F401,E402
 from . import float16  # noqa: F401,E402
 from .float16 import Bfloat16Transpiler, Float16Transpiler  # noqa: F401,E402
+from . import decoder  # noqa: F401,E402
+from .decoder import (  # noqa: F401,E402
+    InitState, StateCell, TrainingDecoder, BeamSearchDecoder)

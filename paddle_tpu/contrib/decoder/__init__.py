@@ -1,0 +1,7 @@
+"""General seq2seq decoder API (reference
+python/paddle/fluid/contrib/decoder/__init__.py:1)."""
+
+from . import beam_search_decoder
+from .beam_search_decoder import *  # noqa: F401,F403
+
+__all__ = beam_search_decoder.__all__

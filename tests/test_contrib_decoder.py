@@ -1,0 +1,237 @@
+"""contrib.decoder (InitState/StateCell/TrainingDecoder/BeamSearchDecoder)
+— reference python/paddle/fluid/contrib/decoder/beam_search_decoder.py.
+
+Train a copy-task seq2seq where the decoder cell is driven through
+StateCell + TrainingDecoder, then generate with BeamSearchDecoder using
+the SAME cell-step function and shared parameters, and check the top
+beam reproduces the source."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.decoder import (
+    InitState, StateCell, TrainingDecoder, BeamSearchDecoder)
+from paddle_tpu.framework import program_guard
+from paddle_tpu.param_attr import ParamAttr
+
+V, D, H, TMAX = 8, 16, 64, 4
+BOS, EOS = 1, 0
+
+
+def _cell_updater(state_cell):
+    """The shared RNN cell step: h = tanh(fc([x, h_pre]))."""
+    x = state_cell.get_input('x')
+    h_pre = state_cell.get_state('h')
+    h = fluid.layers.fc(fluid.layers.concat([x, h_pre], axis=1),
+                        size=H, act='tanh',
+                        param_attr=ParamAttr(name='dec_fc_w'),
+                        bias_attr=ParamAttr(name='dec_fc_b'))
+    state_cell.set_state('h', h)
+
+
+def _encoder(src):
+    emb = fluid.layers.embedding(src, size=[V, D],
+                                 param_attr=ParamAttr(name='src_emb_w'))
+    drnn = fluid.layers.DynamicRNN()
+    with drnn.block():
+        x_t = drnn.step_input(emb)
+        h_pre = drnn.memory(shape=[H], value=0.0)
+        h = fluid.layers.fc(fluid.layers.concat([x_t, h_pre], axis=1),
+                            size=H, act='tanh',
+                            param_attr=ParamAttr(name='enc_fc_w'),
+                            bias_attr=ParamAttr(name='enc_fc_b'))
+        drnn.update_memory(h_pre, h)
+        drnn.output(h)
+    return fluid.layers.sequence_pool(drnn(), 'last')     # [B, H]
+
+
+def _build_train():
+    src = fluid.layers.data('src', shape=[1], dtype='int64', lod_level=1)
+    tgt = fluid.layers.data('tgt', shape=[1], dtype='int64', lod_level=1)
+    lbl = fluid.layers.data('lbl', shape=[1], dtype='int64', lod_level=1)
+    enc_last = _encoder(src)
+
+    state_cell = StateCell(inputs={'x': None},
+                           states={'h': InitState(init=enc_last)},
+                           out_state='h')
+    state_cell.state_updater(_cell_updater)
+
+    temb = fluid.layers.embedding(tgt, size=[V, D],
+                                  param_attr=ParamAttr(name='tgt_emb_w'))
+    decoder = TrainingDecoder(state_cell)
+    with decoder.block():
+        e_t = decoder.step_input(temb)
+        decoder.state_cell.compute_state(inputs={'x': e_t})
+        h = decoder.state_cell.get_state('h')
+        decoder.state_cell.update_states()
+        decoder.output(fluid.layers.fc(
+            h, size=V, act=None,
+            param_attr=ParamAttr(name='out_fc_w'),
+            bias_attr=ParamAttr(name='out_fc_b')))
+    logits = decoder()                                    # [B, T, V]
+
+    cost = fluid.layers.softmax_with_cross_entropy(logits, lbl)
+    tgt_len = tgt.block._find_var_recursive(tgt._seq_len_name)
+    mask = fluid.layers.padding_mask(tgt_len, logits)     # [B, T]
+    masked = fluid.layers.elementwise_mul(
+        cost, fluid.layers.unsqueeze(mask, axes=[2]))
+    return fluid.layers.elementwise_div(
+        fluid.layers.reduce_sum(masked), fluid.layers.reduce_sum(mask))
+
+
+def _build_decode(beam_size):
+    src = fluid.layers.data('src', shape=[1], dtype='int64', lod_level=1)
+    enc_last = _encoder(src)                              # [B, H]
+
+    state_cell = StateCell(inputs={'x': None},
+                           states={'h': InitState(init=enc_last)},
+                           out_state='h')
+    state_cell.state_updater(_cell_updater)
+
+    init_ids = fluid.layers.fill_constant_batch_size_like(
+        input=enc_last, shape=[-1, 1], dtype='int64', value=BOS)
+    init_scores = fluid.layers.fill_constant_batch_size_like(
+        input=enc_last, shape=[-1, 1], dtype='float32', value=0.0)
+
+    # the softmax projection must share out_fc_* with training: the
+    # trained logits fc has no softmax, so score with softmax(logits)
+    # via the same weights (fc act='softmax' composes exactly that)
+    decoder = BeamSearchDecoder(
+        state_cell=state_cell, init_ids=init_ids, init_scores=init_scores,
+        target_dict_dim=V, word_dim=D, input_var_dict={}, topk_size=50,
+        sparse_emb=False, max_len=TMAX, beam_size=beam_size, end_id=EOS,
+        emb_param_attr=ParamAttr(name='tgt_emb_w'),
+        score_param_attr=ParamAttr(name='out_fc_w'),
+        score_bias_attr=ParamAttr(name='out_fc_b'))
+    decoder.decode()
+    return decoder()
+
+
+def _copy_batch(rng, b):
+    rows = []
+    for _ in range(b):
+        ln = rng.randint(2, TMAX + 1)
+        seq = rng.randint(2, V, (ln,)).astype('int64')
+        tgt = np.concatenate([[BOS], seq[:-1]]).astype('int64')
+        rows.append((seq, tgt, seq))
+    return rows
+
+
+def test_contrib_decoder_train_and_beam_decode():
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+
+    loss = _build_train()
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+    feeder = fluid.DataFeeder(
+        feed_list=[
+            fluid.default_main_program().global_block().var('src'),
+            fluid.default_main_program().global_block().var('tgt'),
+            fluid.default_main_program().global_block().var('lbl'),
+        ], pad_to=TMAX)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rng = np.random.RandomState(3)
+    losses = []
+    for _ in range(600):
+        feed = feeder.feed(_copy_batch(rng, 16))
+        (lv,) = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(np.asarray(lv).ravel()[0]))
+    assert losses[-1] < losses[0] * 0.4, (losses[0], losses[-1])
+
+    # ---- beam generation with the SAME params (shared scope) ----
+    decode_prog, decode_startup = fluid.Program(), fluid.Program()
+    with program_guard(decode_prog, decode_startup):
+        sentences, scores = _build_decode(beam_size=3)
+
+    batch = _copy_batch(rng, 8)
+    src_pad = np.zeros((8, TMAX, 1), 'int64')
+    src_len = np.zeros((8,), 'int32')
+    for bi, (s, _, _) in enumerate(batch):
+        src_pad[bi, :len(s), 0] = s
+        src_len[bi] = len(s)
+
+    sv, scv = exe.run(decode_prog,
+                      feed={'src': src_pad, 'src@LEN': src_len},
+                      fetch_list=[sentences, scores])
+    sv = np.asarray(sv)                                   # [B, K, TMAX]
+    scv = np.asarray(scv)
+    assert sv.shape == (8, 3, TMAX)
+    assert scv.shape == (8, 3)
+    # beams come back best-first
+    assert (np.diff(scv, axis=1) <= 1e-5).all(), scv
+
+    correct = total = 0
+    for bi, (s, _, _) in enumerate(batch):
+        got = sv[bi, 0, :len(s)]
+        correct += int((got == s).sum())
+        total += len(s)
+    assert correct / total > 0.7, (correct, total, sv[:2, 0])
+
+
+def test_state_cell_validation():
+    prog, start = fluid.Program(), fluid.Program()
+    with program_guard(prog, start):
+        boot = fluid.layers.data('b', shape=[4], dtype='float32')
+        st = InitState(init_boot=boot, shape=[-1, 4], value=0.0)
+        with pytest.raises(ValueError):
+            StateCell(inputs={}, states={'h': st}, out_state='nope')
+        with pytest.raises(ValueError):
+            StateCell(inputs={}, states={'h': 3}, out_state='h')
+        cell = StateCell(inputs={'x': None}, states={'h': st},
+                         out_state='h')
+        with pytest.raises(ValueError):
+            cell.get_input('x')          # still a placeholder
+        with pytest.raises(ValueError):
+            cell.compute_state(inputs={'bogus': boot})
+
+
+def test_state_cell_serves_two_decoders():
+    """A single StateCell may drive a TrainingDecoder and then a
+    BeamSearchDecoder (the id(decoder)-keyed holder exists for this)."""
+    prog, start = fluid.Program(), fluid.Program()
+    with program_guard(prog, start):
+        boot = fluid.layers.data('b', shape=[H], dtype='float32')
+        cell = StateCell(inputs={'x': None},
+                         states={'h': InitState(init=boot)},
+                         out_state='h')
+        cell.state_updater(_cell_updater)
+
+        emb_seq = fluid.layers.data('seq', shape=[D], dtype='float32',
+                                    lod_level=1)
+        tdec = TrainingDecoder(cell)
+        with tdec.block():
+            e_t = tdec.step_input(emb_seq)
+            tdec.state_cell.compute_state(inputs={'x': e_t})
+            h = tdec.state_cell.get_state('h')
+            tdec.state_cell.update_states()
+            tdec.output(h)
+        tdec()
+
+        ii = fluid.layers.fill_constant_batch_size_like(
+            boot, shape=[-1, 1], dtype='int64', value=BOS)
+        sc = fluid.layers.fill_constant_batch_size_like(
+            boot, shape=[-1, 1], dtype='float32', value=0.0)
+        bdec = BeamSearchDecoder(cell, ii, sc, target_dict_dim=V,
+                                 word_dim=D, max_len=2, beam_size=2,
+                                 end_id=EOS)
+        bdec.decode()            # raised KeyError before the holder fix
+        sent, scores = bdec()
+        assert tuple(sent.shape[-3:]) != ()
+
+
+def test_training_decoder_block_discipline():
+    prog, start = fluid.Program(), fluid.Program()
+    with program_guard(prog, start):
+        boot = fluid.layers.data('b', shape=[4], dtype='float32')
+        cell = StateCell(inputs={'x': None},
+                         states={'h': InitState(init=boot)},
+                         out_state='h')
+        dec = TrainingDecoder(cell)
+        with pytest.raises(ValueError):
+            dec.step_input(boot)         # outside block()
+        with pytest.raises(ValueError):
+            dec()                        # output before block closes

@@ -160,6 +160,7 @@ def test_dist_trainer_kill_and_resume(tmp_path):
                           "dist_runner.py")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    env["DIST_MODEL"] = "mlp"   # must match the reference run above
 
     def launch(port):
         coordinator = "127.0.0.1:%d" % port

@@ -50,6 +50,7 @@ MODULES = [
     "paddle_tpu.utils.torch2paddle",
     "paddle_tpu.contrib",
     "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.contrib.decoder",
     "paddle_tpu.v2",
     "paddle_tpu.v2.layer",
     "paddle_tpu.v2.networks",

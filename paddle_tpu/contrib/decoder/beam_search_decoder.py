@@ -542,6 +542,33 @@ class BeamSearchDecoder(object):
         embed the previous tokens, advance the StateCell, score with a
         softmax projection, expand beams, stop early once every beam
         has emitted ``end_id``."""
+        # tile per-sentence inputs across the K beam lanes OUTSIDE the
+        # loop (they are step-invariant; outer vars are readable inside
+        # the block): [B, d1, ..., dn] -> [B*K, d1, ..., dn] (e.g. an
+        # encoder sequence [B, T, H] an attention cell reads).  Tiled by
+        # a batch-index gather (row b repeats K times) rather than
+        # expand+reshape: trailing dims of RNN outputs are unknown at
+        # build time, and gather never needs them.
+        feed_dict = {}
+        k = self._beam_size
+        for name, var in self._input_var_dict.items():
+            if name not in self._state_cell._inputs:
+                raise ValueError(
+                    'Variable %s not found in StateCell!' % name)
+            if len(var.shape) < 2:
+                raise ValueError(
+                    'input_var_dict entries must be [batch, ...]; '
+                    '%s has shape %s' % (name, (var.shape,)))
+            ones = layers.fill_constant_batch_size_like(
+                var, shape=[-1, 1], dtype='int64', value=1)
+            bidx = layers.elementwise_sub(
+                layers.cumsum(ones, axis=0), ones)           # [B,1] 0..B-1
+            lanes = layers.fill_constant_batch_size_like(
+                var, shape=[-1, k], dtype='int64', value=0)
+            idx = layers.reshape(
+                layers.elementwise_add(lanes, bidx), shape=[-1])
+            feed_dict[name] = layers.gather(var, idx)
+
         with self.block():
             prev_ids = self.read_array(init=self._cur_ids, is_ids=True)
             self.read_array(init=self._cur_scores, is_scores=True)
@@ -553,24 +580,6 @@ class BeamSearchDecoder(object):
             prev_ids_embedding = layers.reshape(
                 prev_ids_embedding, shape=[-1, self._word_dim])
 
-            feed_dict = {}
-            k = self._beam_size
-            for name, var in self._input_var_dict.items():
-                if name not in self._state_cell._inputs:
-                    raise ValueError(
-                        'Variable %s not found in StateCell!' % name)
-                if len(var.shape) != 2:
-                    raise ValueError(
-                        'input_var_dict entries must be rank-2 '
-                        '[batch, size]; %s has shape %s'
-                        % (name, (var.shape,)))
-                # align a per-sentence input with the flattened beams:
-                # [B, S] -> [B*K, S]
-                tiled = layers.expand(
-                    layers.unsqueeze(var, axes=[1]),
-                    expand_times=[1, k, 1])
-                feed_dict[name] = layers.reshape(
-                    tiled, shape=[-1, int(var.shape[1])])
             for input_name in self._state_cell._inputs:
                 if input_name not in feed_dict:
                     feed_dict[input_name] = prev_ids_embedding

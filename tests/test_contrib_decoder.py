@@ -189,6 +189,78 @@ def test_state_cell_validation():
             cell.compute_state(inputs={'bogus': boot})
 
 
+def test_beam_decode_with_attention_static_input():
+    """input_var_dict carries a rank-3 encoder sequence [B, T, H] into
+    the search: each beam attends over its sentence's encoder states
+    (the reference reaches this via sequence_expand on LoD; the fixed-
+    beam redesign tiles the input across the K lanes)."""
+    prog, start = fluid.Program(), fluid.Program()
+    with program_guard(prog, start):
+        src = fluid.layers.data('src', shape=[1], dtype='int64',
+                                lod_level=1)
+        emb = fluid.layers.embedding(
+            src, size=[V, D], param_attr=ParamAttr(name='att_emb'))
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(emb)
+            hp = drnn.memory(shape=[H], value=0.0)
+            h = fluid.layers.fc(fluid.layers.concat([x_t, hp], axis=1),
+                                size=H, act='tanh',
+                                param_attr=ParamAttr(name='att_enc_w'),
+                                bias_attr=ParamAttr(name='att_enc_b'))
+            drnn.update_memory(hp, h)
+            drnn.output(h)
+        enc_seq = drnn()                                  # [B, T, H]
+        enc_last = fluid.layers.sequence_pool(enc_seq, 'last')
+
+        def attn_updater(cell):
+            x = cell.get_input('x')                       # [B*K, D]
+            ctx_seq = cell.get_input('enc')               # [B*K, T, H]
+            h_pre = cell.get_state('h')                   # [B*K, H]
+            # dot-product attention of h_pre over the encoder states
+            att = fluid.layers.matmul(
+                ctx_seq, fluid.layers.unsqueeze(h_pre, axes=[2]))
+            w = fluid.layers.softmax(
+                fluid.layers.reshape(att, shape=[-1, TMAX]))
+            ctx = fluid.layers.reshape(
+                fluid.layers.matmul(
+                    fluid.layers.unsqueeze(w, axes=[1]), ctx_seq),
+                shape=[-1, H])                            # [B*K, H]
+            h = fluid.layers.fc(
+                fluid.layers.concat([x, h_pre, ctx], axis=1),
+                size=H, act='tanh',
+                param_attr=ParamAttr(name='att_dec_w'),
+                bias_attr=ParamAttr(name='att_dec_b'))
+            cell.set_state('h', h)
+
+        cell = StateCell(inputs={'x': None, 'enc': None},
+                         states={'h': InitState(init=enc_last)},
+                         out_state='h')
+        cell.state_updater(attn_updater)
+
+        ii = fluid.layers.fill_constant_batch_size_like(
+            enc_last, shape=[-1, 1], dtype='int64', value=BOS)
+        sc = fluid.layers.fill_constant_batch_size_like(
+            enc_last, shape=[-1, 1], dtype='float32', value=0.0)
+        dec = BeamSearchDecoder(
+            cell, ii, sc, target_dict_dim=V, word_dim=D,
+            input_var_dict={'enc': enc_seq}, max_len=TMAX, beam_size=2,
+            end_id=EOS, emb_param_attr=ParamAttr(name='att_emb2'))
+        dec.decode()
+        sent, scores = dec()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(start)
+    rng = np.random.RandomState(1)
+    sv, scv = exe.run(
+        prog,
+        feed={'src': rng.randint(2, V, (5, TMAX, 1)).astype('int64'),
+              'src@LEN': np.array([4, 3, 2, 4, 3], 'int32')},
+        fetch_list=[sent, scores])
+    assert np.asarray(sv).shape == (5, 2, TMAX)
+    assert np.isfinite(np.asarray(scv)).all()
+
+
 def test_state_cell_serves_two_decoders():
     """A single StateCell may drive a TrainingDecoder and then a
     BeamSearchDecoder (the id(decoder)-keyed holder exists for this)."""

@@ -30,8 +30,11 @@ from . import evaluator
 from .evaluator import Evaluator
 from . import nets
 from .backward import append_backward, calc_gradient
-from .executor import Executor, CPUPlace, TPUPlace, CUDAPlace
-from .scope import Scope, global_scope, scope_guard
+from .executor import (Executor, CPUPlace, TPUPlace, CUDAPlace,
+                       CUDAPinnedPlace)
+from .scope import Scope, global_scope, scope_guard, _switch_scope
+from .core import Tensor
+from . import learning_rate_decay
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
 from . import io
@@ -59,7 +62,8 @@ from . import debugger
 from . import average
 from . import lod_tensor
 from . import net_drawer
-from .lod_tensor import create_lod_tensor, create_random_int_lodtensor
+from .lod_tensor import (create_lod_tensor, create_random_int_lodtensor,
+                         LoDTensor, LoDTensorArray)
 from . import recordio
 from . import recordio_writer
 from .flags import set_flags, get_flags
@@ -71,7 +75,9 @@ __all__ = [
     "default_main_program", "default_startup_program", "program_guard",
     "name_scope", "layers", "initializer", "regularizer", "clip",
     "optimizer", "metrics", "nets", "append_backward", "calc_gradient",
-    "Executor", "CPUPlace", "TPUPlace", "CUDAPlace", "Scope",
+    "Executor", "CPUPlace", "TPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+    "Scope", "Tensor", "LoDTensor", "LoDTensorArray",
+    "learning_rate_decay",
     "global_scope", "scope_guard", "ParamAttr", "WeightNormParamAttr",
     "DataFeeder", "io", "profiler", "parallel", "ParallelExecutor",
     "BuildStrategy", "ExecutionStrategy", "make_mesh", "reader",

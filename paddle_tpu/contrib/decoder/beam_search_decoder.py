@@ -551,6 +551,7 @@ class BeamSearchDecoder(object):
         # build time, and gather never needs them.
         feed_dict = {}
         k = self._beam_size
+        idx = None   # one shared [B*K] index: every entry has batch B
         for name, var in self._input_var_dict.items():
             if name not in self._state_cell._inputs:
                 raise ValueError(
@@ -559,14 +560,15 @@ class BeamSearchDecoder(object):
                 raise ValueError(
                     'input_var_dict entries must be [batch, ...]; '
                     '%s has shape %s' % (name, (var.shape,)))
-            ones = layers.fill_constant_batch_size_like(
-                var, shape=[-1, 1], dtype='int64', value=1)
-            bidx = layers.elementwise_sub(
-                layers.cumsum(ones, axis=0), ones)           # [B,1] 0..B-1
-            lanes = layers.fill_constant_batch_size_like(
-                var, shape=[-1, k], dtype='int64', value=0)
-            idx = layers.reshape(
-                layers.elementwise_add(lanes, bidx), shape=[-1])
+            if idx is None:
+                ones = layers.fill_constant_batch_size_like(
+                    var, shape=[-1, 1], dtype='int64', value=1)
+                bidx = layers.elementwise_sub(
+                    layers.cumsum(ones, axis=0), ones)       # [B,1] 0..B-1
+                lanes = layers.fill_constant_batch_size_like(
+                    var, shape=[-1, k], dtype='int64', value=0)
+                idx = layers.reshape(
+                    layers.elementwise_add(lanes, bidx), shape=[-1])
             feed_dict[name] = layers.gather(var, idx)
 
         with self.block():

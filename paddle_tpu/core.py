@@ -98,3 +98,27 @@ def dtype_is_floating(dtype):
 def dtype_is_integer(dtype):
     d = convert_dtype(dtype)
     return np.issubdtype(d, np.integer) or d == np.bool_
+
+
+class Tensor(object):
+    """Host tensor shim (reference pybind ``core.Tensor`` surface:
+    ``set``/``shape``/buffer protocol).  Device residency belongs to
+    XLA; this stages a numpy array for feeding."""
+
+    def __init__(self, array=None):
+        self._array = None if array is None else np.asarray(array)
+
+    def set(self, array, place=None):
+        self._array = np.asarray(array)
+
+    def shape(self):
+        return () if self._array is None else tuple(self._array.shape)
+
+    def _dtype(self):
+        return None if self._array is None else self._array.dtype
+
+    def __array__(self, dtype=None):
+        if self._array is None:
+            raise ValueError("Tensor is unset; call set() first")
+        return (self._array.astype(dtype) if dtype is not None
+                else self._array)

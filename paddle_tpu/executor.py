@@ -95,6 +95,19 @@ class TPUPlace(Place):
 CUDAPlace = TPUPlace
 
 
+class CUDAPinnedPlace(CPUPlace):
+    """Pinned-host staging place (reference platform/place.h:36
+    CUDAPinnedPlace).  Page-locked memory is a CUDA-transfer concept;
+    on this runtime host arrays already stage through the PJRT transfer
+    path, so this is the host place under a parity name."""
+
+    def __eq__(self, other):
+        return isinstance(other, CUDAPinnedPlace)
+
+    def __hash__(self):
+        return hash("CUDAPinnedPlace")
+
+
 def place_from_string(s):
     s = s.lower()
     if s in ("cpu",):

@@ -1,0 +1,8 @@
+"""Top-level ``learning_rate_decay`` module name (the reference exports
+it in ``fluid.__all__``; the implementations live in
+``layers/learning_rate_scheduler.py`` there and here)."""
+
+from .layers.learning_rate_scheduler import *  # noqa: F401,F403
+from .layers import learning_rate_scheduler as _lrs
+
+__all__ = list(_lrs.__all__)

@@ -13,8 +13,8 @@ long-context ruling); deeper nesting raises with that citation.
 
 import numpy as np
 
-__all__ = ["PaddedSequence", "create_lod_tensor",
-           "create_random_int_lodtensor"]
+__all__ = ["PaddedSequence", "LoDTensor", "LoDTensorArray",
+           "create_lod_tensor", "create_random_int_lodtensor"]
 
 
 class PaddedSequence(object):
@@ -42,6 +42,59 @@ class PaddedSequence(object):
     def __array__(self, dtype=None):
         a = self.data
         return a.astype(dtype) if dtype is not None else a
+
+
+class LoDTensor(PaddedSequence):
+    """Constructible host LoD tensor (reference pybind ``core.LoDTensor``
+    surface: ``set`` / ``set_recursive_sequence_lengths`` / ``lod``).
+    The storage is the padded+@LEN pair; offset-based ``lod()`` is
+    derived from the lengths on demand."""
+
+    def __init__(self, data=None, seq_lens=None):
+        if data is None:
+            data = np.zeros((0, 0), dtype="float32")
+        if seq_lens is None:
+            seq_lens = np.zeros((0,), dtype="int32")
+        super().__init__(np.asarray(data), seq_lens)
+
+    def set(self, array, place=None):
+        """Stage a host array (``place`` accepted for parity; residency
+        is decided at feed time by the executor)."""
+        self.data = np.asarray(array)
+
+    def set_recursive_sequence_lengths(self, recursive_seq_lens):
+        self.seq_lens = np.asarray(_check_lod(recursive_seq_lens),
+                                   dtype="int32")
+
+    def lod(self):
+        """Offset-based LoD (the reference's native form): one level of
+        [0, l0, l0+l1, ...]."""
+        if self.seq_lens.size == 0:
+            return []
+        return [[0] + [int(v) for v in np.cumsum(self.seq_lens)]]
+
+    def set_lod(self, lod):
+        if not lod:
+            self.seq_lens = np.zeros((0,), dtype="int32")
+            return
+        if len(lod) > 1:
+            raise NotImplementedError(
+                "multi-level LoD is flattened by the padded+@LEN design "
+                "(SURVEY §5); pass one level of offsets")
+        offs = list(lod[0])
+        if offs and (offs[0] != 0 or
+                     any(b < a for a, b in zip(offs, offs[1:]))):
+            raise ValueError(
+                "lod offsets must start at 0 and be non-decreasing, "
+                "got %s" % (offs,))
+        self.seq_lens = np.asarray(
+            [b - a for a, b in zip(offs, offs[1:])], dtype="int32")
+
+
+class LoDTensorArray(list):
+    """Host-side tensor array (reference ``core.LoDTensorArray``): a
+    plain list of LoDTensor/arrays — in-program arrays are preallocated
+    device tensors (layers.create_array), this is the feed/fetch shim."""
 
 
 def _check_lod(recursive_seq_lens):
@@ -82,7 +135,7 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
         for seq in data:
             conv.feed(np.asarray(seq, dtype="int64").reshape(-1))
         padded, got_lens = conv.done()
-        return PaddedSequence(padded, got_lens)
+        return LoDTensor(padded, got_lens)
     data = np.asarray(data)
     if data.shape[0] != sum(lens):
         raise AssertionError(
@@ -95,7 +148,7 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
         conv.feed(data[off:off + l])
         off += l
     padded, got_lens = conv.done()
-    return PaddedSequence(padded, got_lens)
+    return LoDTensor(padded, got_lens)
 
 
 def _unpad(ps):

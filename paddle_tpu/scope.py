@@ -72,13 +72,21 @@ def global_scope():
     return _global_scope
 
 
-@contextlib.contextmanager
-def scope_guard(scope):
-    """Temporarily swap the global scope (reference executor.py:47)."""
+def _switch_scope(scope):
+    """Swap the global scope, returning the previous one (reference
+    executor.py:41 ``_switch_scope`` — the primitive under
+    ``scope_guard``)."""
     global _global_scope
     prev = _global_scope
     _global_scope = scope
+    return prev
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Temporarily swap the global scope (reference executor.py:47)."""
+    prev = _switch_scope(scope)
     try:
         yield
     finally:
-        _global_scope = prev
+        _switch_scope(prev)

@@ -13,6 +13,8 @@ On TPU most of the reference transpilers' work moved into the compiler:
 
 from .distribute_transpiler import (  # noqa: F401
     DistributeTranspiler, DistributeTranspilerConfig)
+from .ps_dispatcher import (  # noqa: F401
+    PSDispatcher, RoundRobin, HashName)
 from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory)
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
@@ -23,6 +25,7 @@ from .passes import (  # noqa: F401
 
 __all__ = [
     "DistributeTranspiler", "DistributeTranspilerConfig",
+    "PSDispatcher", "RoundRobin", "HashName",
     "memory_optimize", "release_memory", "InferenceTranspiler",
     "fuse_conv_bn", "apply_pass", "register_pass", "get_pass",
     "list_passes", "PassBuilder", "find_chain",

@@ -64,7 +64,9 @@ class DistributeTranspilerConfig:
 
     def __init__(self):
         self.slice_var_up = True
-        self.split_method = "RoundRobin"   # kept for API parity; unused
+        # a ps_dispatcher class or its name: decides which shard owner
+        # each sliced block lands on (see placement())
+        self.split_method = "RoundRobin"
         self.min_block_size = 8192         # reference's slicing threshold
 
 
@@ -73,6 +75,7 @@ class DistributeTranspiler:
         self.config = config or DistributeTranspilerConfig()
         self._program = None
         self._plan = None
+        self._placement = None
 
     # ------------------------------------------------------------------
     def transpile(self, trainer_id, program=None, pservers="", trainers=1,
@@ -85,6 +88,17 @@ class DistributeTranspiler:
             raise NotImplementedError(
                 "async pserver SGD has no TPU analog: updates are "
                 "synchronous mesh-wide steps (SURVEY.md §2.4)")
+        # validate the dispatcher BEFORE any state lands on self, so a
+        # failed transpile leaves the object cleanly un-transpiled
+        from . import ps_dispatcher
+        method = self.config.split_method
+        if isinstance(method, str):
+            method = getattr(ps_dispatcher, method, None)
+        if not (isinstance(method, type) and
+                issubclass(method, ps_dispatcher.PSDispatcher)):
+            raise ValueError(
+                "split_method must be a PSDispatcher subclass or its "
+                "name, got %r" % (self.config.split_method,))
         self._program = program or default_main_program()
         self.trainer_id = trainer_id
         self.trainers = trainers
@@ -105,7 +119,36 @@ class DistributeTranspiler:
             else:
                 plan[p.name] = ("replicated", P())
         self._plan = plan
+
+        # block -> shard-owner placement via the configured dispatcher
+        # (reference ps_dispatcher.py: block -> pserver endpoint).  The
+        # owners are the pserver endpoints when given (parity surface)
+        # or the dp ranks of the plan otherwise.
+        owners = [e.strip() for e in (pservers or "").split(",")
+                  if e.strip()]
+        if not owners:
+            owners = ["dp:%d" % r for r in range(max(1, int(trainers)))]
+        dispatcher = method(owners)
+        sliced = [p for p in self._program.all_parameters()
+                  if plan[p.name][0] == "sliced"]
+        whole = [p for p in self._program.all_parameters()
+                 if plan[p.name][0] != "sliced"]
+        blocks = slice_variable(sliced, len(owners),
+                                self.config.min_block_size) + \
+            [(p.name, 0, int(np.prod(tuple(p.shape or ()) or (1,))))
+             for p in whole]
+        keys = ["%s.block%d" % (name, bid) for name, bid, _ in blocks]
+        self._placement = dict(zip(keys, dispatcher.dispatch(keys)))
         return self
+
+    def placement(self):
+        """{``name.blockN``: owner} — which shard owner each param block
+        lands on, per ``config.split_method`` (the reference's
+        param→pserver endpoint map, inspectable like its transpiler
+        tests inspect generated programs)."""
+        if self._placement is None:
+            raise RuntimeError("call transpile() first")
+        return dict(self._placement)
 
     # ------------------------------------------------------------------
     def sharding_plan(self):

@@ -32,6 +32,7 @@ import numpy as np
 import jax
 
 from . import flags, registry
+from .core import materialize_dtype
 from .framework import Program, Variable, default_main_program
 from .profiler import RecordEvent
 from .registry import ComputeContext
@@ -278,9 +279,13 @@ class Executor:
             if not isinstance(v, jax.Array):
                 v = np.asarray(v)
             pv = block._find_var_recursive(n)
-            if pv is not None and pv.dtype is not None and \
-                    np.dtype(v.dtype) != np.dtype(pv.dtype):
-                v = v.astype(pv.dtype)
+            if pv is not None and pv.dtype is not None:
+                # target the MATERIALIZED dtype: under x64-off, a device
+                # array fed back (PyReader staging) is already int32 and
+                # asking jax for int64 would warn-and-truncate
+                want = materialize_dtype(pv.dtype)
+                if np.dtype(v.dtype) != np.dtype(want):
+                    v = v.astype(want)
             feed_vals.append(v)
 
         feed_sig = tuple(
@@ -358,9 +363,13 @@ class Executor:
             if not isinstance(v, jax.Array):
                 v = np.asarray(v)
             pv = block._find_var_recursive(n)
-            if pv is not None and pv.dtype is not None and \
-                    np.dtype(v.dtype) != np.dtype(pv.dtype):
-                v = v.astype(pv.dtype)
+            if pv is not None and pv.dtype is not None:
+                # target the MATERIALIZED dtype: under x64-off, a device
+                # array fed back (PyReader staging) is already int32 and
+                # asking jax for int64 would warn-and-truncate
+                want = materialize_dtype(pv.dtype)
+                if np.dtype(v.dtype) != np.dtype(want):
+                    v = v.astype(want)
             feed_vals.append(v)
         feed_sig = tuple(
             (n, tuple(v.shape), str(v.dtype))

@@ -3,8 +3,8 @@
 from .. import ops as _ops  # noqa: F401 — register op library first
 
 from . import cnn, control_flow, detection, io, learning_rate_scheduler, \
-    math_op_patch, metric_op, nn, ops, pipeline, sequence, \
-    tensor  # noqa: F401
+    layer_function_generator, math_op_patch, metric_op, nn, ops, pipeline, \
+    sequence, tensor  # noqa: F401
 from .cnn import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
@@ -16,6 +16,7 @@ from .pipeline import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
+from .layer_function_generator import *  # noqa: F401,F403
 
 math_op_patch.monkey_patch_variable()
 
@@ -23,4 +24,5 @@ __all__ = (
     cnn.__all__ + control_flow.__all__ + detection.__all__ + io.__all__
     + learning_rate_scheduler.__all__ + sequence.__all__ + nn.__all__
     + ops.__all__ + pipeline.__all__ + tensor.__all__ + metric_op.__all__
+    + layer_function_generator.__all__
 )

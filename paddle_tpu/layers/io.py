@@ -1,18 +1,34 @@
-"""Input layers: ``data`` (feed entry points).
+"""Input layers: ``data`` (feed entry points) + the in-program reader
+family as HOST-SIDE handles.
 
 Parity: reference ``python/paddle/fluid/layers/io.py:37 data`` — declares a
 feedable program input.  ``append_batch_size=True`` prepends a -1 batch dim
 like the reference; on TPU the executor specializes the jit per concrete
 batch size (bucketing handles variance — see data layer docs).
-py_reader / double_buffer equivalents live in ``paddle_tpu.reader``
-(``PyReader``: host thread staging feed dicts onto the device ahead of
-the training loop).
+
+The reference expresses its input pipeline as ops INSIDE the program
+(``open_files_op.cc``, ``create_py_reader_op.cc``,
+``create_double_buffer_reader_op.cc``…): reader variables flow through
+decorator ops and ``read_file`` unpacks them into tensors.  Under jit
+there are no host-side ops mid-graph, so the same surface is served by
+``ReaderHandle``: ``py_reader``/``open_files``/``random_data_generator``
+build a handle bound to freshly-declared data vars, ``shuffle``/``batch``
+decorate its host stream, ``double_buffer`` stages batches onto the
+device ahead of the loop (``paddle_tpu.reader.PyReader``), and
+``read_file`` returns the data vars the handle feeds.  The training
+loop consumes it as ``for feed in handle: exe.run(feed=feed, ...)`` —
+the one structural difference from the reference's feed-less
+``exe.run()``, stated here rather than papered over.
 """
+
+import numpy as np
 
 from ..core import VarType
 from ..framework import default_main_program, default_startup_program
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "open_files", "read_file", "shuffle",
+           "batch", "double_buffer", "random_data_generator", "load",
+           "Preprocessor"]
 
 
 def data(
@@ -52,3 +68,297 @@ def data(
         )
         var._seq_len_name = len_var.name
     return var
+
+
+# ---------------------------------------------------------------------------
+# reader-family handles (see module docstring for the redesign)
+# ---------------------------------------------------------------------------
+
+class ReaderHandle(object):
+    """Host-side stand-in for the reference's in-program reader
+    variable: owns the declared data vars and a host sample stream;
+    iterating yields feed dicts for ``Executor.run``."""
+
+    def __init__(self, data_vars, source=None, batched=False, name=None):
+        self.data_vars = list(data_vars)
+        self._source = source          # callable -> iterator of samples
+        self._batched = batched        # True once batch() decorated
+        self._tensors = False          # True for tensor-provider sources
+        self._place = None             # set by double_buffer
+        self._capacity = None
+        self.name = name
+
+    # -- decoration (reference decorated-reader chain) ------------------
+    def decorate_paddle_reader(self, reader):
+        """Attach a sample-tuple reader (will be batched by batch())."""
+        self._source = reader
+        self._batched = False
+        self._tensors = False
+        return self
+
+    def decorate_tensor_provider(self, reader):
+        """Attach a reader yielding one ALREADY-BATCHED array per slot
+        per step (the reference's decorate_tensor_provider contract):
+        tuples map positionally onto the data vars, no sample-row
+        conversion."""
+        self._source = reader
+        self._batched = True
+        self._tensors = True
+        return self
+
+    # -- protocol parity -------------------------------------------------
+    def start(self):
+        """Reference py_reader.start(): nothing to launch host-side —
+        the stream starts when iteration begins."""
+        return self
+
+    def reset(self):
+        return self
+
+    def _feeder(self):
+        from ..data_feeder import DataFeeder
+        return DataFeeder(feed_list=self.data_vars)
+
+    def __iter__(self):
+        if self._source is None:
+            raise RuntimeError(
+                "no data source attached: call decorate_paddle_reader "
+                "(or build the handle with open_files/"
+                "random_data_generator)")
+        if not self._batched:
+            raise RuntimeError(
+                "the sample stream is unbatched: apply "
+                "fluid.layers.batch(reader, batch_size) first")
+        if self._tensors:
+            names = [v.name for v in self.data_vars]
+
+            def convert(tensors):
+                if len(tensors) != len(names):
+                    raise ValueError(
+                        "tensor provider yielded %d arrays for %d slots"
+                        % (len(tensors), len(names)))
+                return dict(zip(names, (np.asarray(t) for t in tensors)))
+        else:
+            feeder = self._feeder()
+            convert = feeder.feed
+        if self._place is not None:
+            from .. import reader as reader_mod
+
+            class _F:
+                def feed(self, rows, _convert=convert):
+                    return _convert(rows)
+
+            pr = reader_mod.PyReader(capacity=self._capacity or 4)
+            pr.decorate_batch_reader(self._source, _F(), self._place)
+            return iter(pr)
+        return (convert(rows) for rows in self._source())
+
+    def _replace(self, source, batched=None):
+        h = ReaderHandle(self.data_vars, source,
+                         self._batched if batched is None else batched,
+                         self.name)
+        h._place, h._capacity = self._place, self._capacity
+        h._tensors = self._tensors
+        return h
+
+
+def _declare_reader_vars(shapes, dtypes, lod_levels, name):
+    from .. import unique_name
+    lod_levels = lod_levels or [0] * len(shapes)
+    vars_ = []
+    for i, (shp, dt, ll) in enumerate(zip(shapes, dtypes, lod_levels)):
+        # strip only the LEADING batch dim; data() re-prepends it.
+        # inner -1 dims (variable time steps) must keep their rank.
+        shp = list(shp[1:]) if shp and shp[0] == -1 else list(shp)
+        vars_.append(data(
+            unique_name.generate("%s_slot%d" % (name or "reader", i)),
+            shape=list(shp), dtype=dt, lod_level=ll))
+    return vars_
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Python-fed input pipeline (reference io.py:473 py_reader /
+    create_py_reader_op.cc): declares one data var per slot and returns
+    the handle; attach a sample stream with decorate_paddle_reader."""
+    handle = ReaderHandle(
+        _declare_reader_vars(shapes, dtypes, lod_levels, name), name=name)
+    handle._capacity = capacity
+    return handle
+
+
+def open_files(filenames, shapes, lod_levels, dtypes, thread_num=1,
+               buffer_size=None, pass_num=1, for_parallel=True):
+    """Multi-file parallel reader (reference io.py:721 /
+    open_files_op.cc): recordio files scanned by ``thread_num`` worker
+    processes; samples are pickled tuples as recordio_writer wrote
+    them."""
+    from ..reader.creator import open_recordio_files
+    handle = ReaderHandle(
+        _declare_reader_vars(shapes, dtypes, lod_levels, "open_files"))
+    src = open_recordio_files(
+        list(filenames), num_workers=max(1, thread_num),
+        prefetch=buffer_size or 256, repeat=False)
+    if pass_num > 1:
+        base = src
+
+        def multi_pass():
+            for _ in range(pass_num):
+                for s in base():
+                    yield s
+        src = multi_pass
+    handle._source = src
+    handle._batched = False
+    return handle
+
+
+def random_data_generator(low, high, shapes, lod_levels=None,
+                          for_parallel=True):
+    """Uniform-random synthetic reader (reference io.py /
+    create_random_data_generator_op.cc) — benchmarking without IO."""
+    handle = ReaderHandle(
+        _declare_reader_vars(shapes, [
+            "float32"] * len(shapes), lod_levels, "rand"))
+    dims = [[d for d in shp if d != -1] or [1] for shp in shapes]
+
+    def src():
+        rng = np.random.RandomState(0)
+        while True:
+            yield tuple(rng.uniform(low, high, size=d).astype("float32")
+                        for d in dims)
+    handle._source = src
+    handle._batched = False
+    return handle
+
+
+def read_file(reader):
+    """Unpack a reader handle into its data vars (reference io.py:888
+    read_file / read_op)."""
+    if not isinstance(reader, ReaderHandle):
+        raise TypeError("read_file expects a reader handle from "
+                        "py_reader/open_files/random_data_generator")
+    if len(reader.data_vars) == 1:
+        return reader.data_vars[0]
+    return list(reader.data_vars)
+
+
+def shuffle(reader, buffer_size):
+    """Shuffle decorator over a reader handle (reference io.py shuffle /
+    create_shuffle_reader_op.cc)."""
+    from ..reader import shuffle as _shuffle
+    if reader._source is None:
+        raise RuntimeError("attach a source before shuffle()")
+    return reader._replace(_shuffle(reader._source, buffer_size))
+
+
+def batch(reader, batch_size):
+    """Batch decorator over a reader handle (reference io.py batch /
+    create_batch_reader_op.cc)."""
+    from ..reader import batch as _batch
+    if reader._source is None:
+        raise RuntimeError("attach a source before batch()")
+    return reader._replace(_batch(reader._source, batch_size),
+                           batched=True)
+
+
+def double_buffer(reader, place=None, name=None):
+    """Stage batches onto the device ahead of the consuming loop
+    (reference io.py:888 double_buffer /
+    create_double_buffer_reader_op.cc — here via reader.PyReader's
+    daemon device_put thread)."""
+    h = reader._replace(reader._source)
+    from ..executor import TPUPlace
+    # default: the accelerator (TPUPlace falls back to the first local
+    # device on CPU-only hosts) — staging to CPU would just add a copy
+    h._place = place or TPUPlace(0)
+    return h
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Load a saved variable into ``out`` (reference io.py load /
+    load_op.cc).  Reads the ``io.save_vars`` per-var ``.npy`` file at
+    graph-build time and emits an assign of the literal — the
+    deployment-parity path for programs that load weights mid-graph."""
+    arr = np.load(file_path if file_path.endswith(".npy")
+                  else file_path + ".npy")
+    if load_as_fp16:
+        arr = arr.astype(np.float16)
+    from .tensor import assign
+    return assign(arr.astype(out.dtype or arr.dtype), output=out)
+
+
+class Preprocessor(object):
+    """Per-batch preprocessing block over a reader handle (reference
+    io.py Preprocessor / create_custom_reader_op.cc: a sub-block of ops
+    runs on every batch).  The block is built as its OWN small Program
+    and executed per batch on the host CPU backend; the handle then
+    yields the transformed feeds."""
+
+    def __init__(self, reader, name=None):
+        if not isinstance(reader, ReaderHandle):
+            raise TypeError("Preprocessor wraps a reader handle")
+        self.underlying = reader
+        self.name = name
+        self._program = None
+        self._startup = None
+        self._in_vars = None
+        self._out_vars = None
+        self.sub_reader = None
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            from ..framework import Program, program_guard
+            self._program, self._startup = Program(), Program()
+            with program_guard(self._program, self._startup):
+                yield self
+            if self._out_vars is None:
+                raise RuntimeError("Preprocessor block set no outputs()")
+            self._build()
+        return _cm()
+
+    def inputs(self):
+        from .. import unique_name
+        if self._in_vars is None:
+            self._in_vars = [
+                data(unique_name.generate("prep_in"),
+                     shape=list(v.shape[1:]), dtype=v.dtype)
+                for v in self.underlying.data_vars
+            ]
+        return list(self._in_vars)
+
+    def outputs(self, *outs):
+        self._out_vars = list(outs)
+
+    def _build(self):
+        from ..executor import CPUPlace, Executor
+        if len(self._out_vars) != len(self.underlying.data_vars):
+            raise ValueError(
+                "Preprocessor block produced %d outputs for a %d-slot "
+                "reader; outputs() must map one-to-one onto the "
+                "underlying slots" % (len(self._out_vars),
+                                      len(self.underlying.data_vars)))
+        exe = Executor(CPUPlace())
+        exe.run(self._startup)
+        prog, ins, outs = self._program, self._in_vars, self._out_vars
+        under = self.underlying
+
+        class _Prep(ReaderHandle):
+            def __iter__(self):
+                for feed in iter(under):
+                    renamed = {iv.name: feed[dv.name]
+                               for iv, dv in zip(ins, under.data_vars)}
+                    res = exe.run(prog, feed=renamed,
+                                  fetch_list=outs, return_numpy=True)
+                    yield {dv.name: np.asarray(r) for dv, r
+                           in zip(under.data_vars, res)}
+
+        self.sub_reader = _Prep(under.data_vars, source=under._source,
+                                batched=True)
+
+    def __iter__(self):
+        if self.sub_reader is None:
+            raise RuntimeError("build the Preprocessor block first")
+        return iter(self.sub_reader)

@@ -1,0 +1,101 @@
+"""Layer-wrapper generation utilities (reference
+``layers/layer_function_generator.py:1``: generates Python layer fns
+from OpProto metadata; here they generate from the op registry — same
+idea, no proto)."""
+
+from ..layer_helper import LayerHelper
+from ..registry import OPS
+
+__all__ = ["deprecated", "generate_layer_fn", "generate_layer_fn_noattr",
+           "autodoc", "templatedoc"]
+
+
+def _op_doc(op_type):
+    op = OPS.get(op_type)
+    return (op.doc if op is not None and op.doc else
+            "%s layer (generated from the op registry)" % op_type)
+
+
+def deprecated(since, instead, extra_message=""):
+    """Decorator stamping a deprecation notice into the docstring and
+    warning once per call site (reference annotations.deprecated)."""
+    from ..annotations import deprecated as _dep
+    return _dep(since, instead, extra_message)
+
+
+def generate_layer_fn(op_type):
+    """A layer fn for a registered single-output op: positional tensor
+    inputs in registry order, attrs as keywords (reference
+    layer_function_generator.py generate_layer_fn)."""
+    op = OPS.get(op_type)
+    if op is None:
+        raise ValueError("op %r is not registered" % op_type)
+    in_slots = [s for s in op.input_slots if not s.startswith("GRAD::")]
+    out_slots = [s for s in op.output_slots if not s.startswith("GRAD::")]
+
+    def layer(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        act = kwargs.pop("act", None)
+        helper = LayerHelper(op_type, name=name, act=act)
+        inputs = {}
+        for slot, arg in zip(in_slots, args):
+            inputs[slot] = arg if isinstance(arg, (list, tuple)) else [arg]
+        for slot in in_slots[len(args):]:
+            if slot in kwargs:
+                arg = kwargs.pop(slot)
+                inputs[slot] = arg if isinstance(arg, (list, tuple)) \
+                    else [arg]
+        dtype = None
+        for vs in inputs.values():
+            for v in vs:
+                if getattr(v, "dtype", None) is not None:
+                    dtype = v.dtype
+                    break
+            if dtype is not None:
+                break
+        outs = {s: [helper.create_variable_for_type_inference(dtype=dtype)]
+                for s in out_slots}
+        helper.append_op(type=op_type, inputs=inputs, outputs=outs,
+                         attrs=kwargs)
+        result = [outs[s][0] for s in out_slots]
+        first = helper.append_activation(result[0])
+        return first if len(result) == 1 else (first, *result[1:])
+
+    layer.__name__ = op_type
+    layer.__doc__ = _op_doc(op_type)
+    return layer
+
+
+def generate_layer_fn_noattr(op_type):
+    """Single-input single-output attr-less wrapper (reference
+    generate_layer_fn_noattr — the activation-op fast path)."""
+    def layer(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = helper.create_variable_for_type_inference(dtype=x.dtype)
+        helper.append_op(type=op_type, inputs={"X": [x]},
+                         outputs={"Out": [out]})
+        return out
+
+    layer.__name__ = op_type
+    layer.__doc__ = _op_doc(op_type)
+    return layer
+
+
+def autodoc(comment=""):
+    """Replace the decorated fn's docstring with the registry doc of the
+    same-named op plus ``comment`` (reference autodoc)."""
+    def decorator(func):
+        func.__doc__ = comment + _op_doc(func.__name__)
+        return func
+    return decorator
+
+
+def templatedoc(op_type=None):
+    """Format ``${comment}`` placeholders in the decorated fn's
+    docstring from the registry doc (reference templatedoc)."""
+    def decorator(func):
+        doc = func.__doc__ or ""
+        comment = _op_doc(op_type or func.__name__)
+        func.__doc__ = doc.replace("${comment}", comment)
+        return func
+    return decorator

@@ -23,29 +23,19 @@ __all__ = [
     "polynomial_decay",
     "piecewise_decay",
     "noam_decay",
+    "append_LARS",
 ]
 
 
 def _decay_step_counter(begin=0):
-    helper = LayerHelper("global_step_counter")
     # one counter per `begin` value: schedules with different origins
-    # (e.g. noam starts at 1) must not share a var or they shift each other
+    # (e.g. noam starts at 1) must not share a var or they shift each
+    # other.  Delegates to the public counter builder (nn.py).
+    from .nn import autoincreased_step_counter
     counter_name = "@LR_DECAY_COUNTER@" if begin == 0 else \
         "@LR_DECAY_COUNTER@begin=%d" % begin
-    block = default_main_program().global_block()
-    counter = block._find_var_recursive(counter_name)
-    if counter is None:
-        counter = block.create_var(
-            name=counter_name, shape=(1,), dtype="float32", persistable=True)
-        startup = default_startup_program().global_block()
-        sv = startup.create_var(
-            name=counter_name, shape=(1,), dtype="float32", persistable=True)
-        ConstantInitializer(float(begin - 1))(sv, startup)
-        helper.append_op(
-            type="increment", inputs={"X": [counter]},
-            outputs={"Out": [counter]}, attrs={"step": 1.0})
-        counter.stop_gradient = True
-    return counter
+    return autoincreased_step_counter(counter_name, begin=begin, step=1,
+                                      dtype="float32")
 
 
 def _scalar(helper, value, like):
@@ -185,3 +175,44 @@ def noam_decay(d_model, warmup_steps, learning_rate=1.0):
     return _unary(helper, "scale", m,
                   scale=float(learning_rate) * float(d_model) ** -0.5,
                   bias=0.0, bias_after_scale=True)
+
+
+def append_LARS(params_grads, learning_rate, weight_decay):
+    """Layer-wise adaptive rate scaling (reference
+    learning_rate_scheduler.py:310): per-parameter
+    ``lr * ||w|| / (||g|| + wd * ||w||)``, written into each parameter's
+    ``optimize_attr['learning_rate']`` so the optimizer's per-param LR
+    multiplier picks it up."""
+    helper = LayerHelper("lars")
+
+    def _balanced_weight(param_norm, grad_norm):
+        if weight_decay == 1.0:
+            return _binary(helper, "elementwise_add", grad_norm, param_norm)
+        scaled = _unary(helper, "scale", param_norm,
+                        scale=float(weight_decay), bias=0.0,
+                        bias_after_scale=True)
+        return _binary(helper, "elementwise_add", grad_norm, scaled)
+
+    decayed = []
+    for param, grad in params_grads:
+        if grad is None:
+            decayed.append(None)
+            continue
+        param_lr = param.optimize_attr.get("learning_rate", 1.0)
+        p_norm = _unary(helper, "sqrt",
+                        _unary(helper, "reduce_sum",
+                               _unary(helper, "square", param),
+                               reduce_all=True))
+        g_norm = _unary(helper, "sqrt",
+                        _unary(helper, "reduce_sum",
+                               _unary(helper, "square", grad),
+                               reduce_all=True))
+        num = _binary(helper, "elementwise_mul", learning_rate, p_norm)
+        if not (isinstance(param_lr, float) and param_lr == 1.0):
+            num = _unary(helper, "scale", num, scale=float(param_lr),
+                         bias=0.0, bias_after_scale=True)
+        decayed_lr = _binary(helper, "elementwise_div", num,
+                             _balanced_weight(p_norm, g_norm))
+        param.optimize_attr["learning_rate"] = decayed_lr
+        decayed.append(decayed_lr)
+    return decayed

@@ -43,6 +43,21 @@ __all__ = [
     "nce",
     "hsigmoid",
     "bilinear_tensor_product",
+    "elementwise_max",
+    "elementwise_min",
+    "elementwise_pow",
+    "flatten",
+    "sum",
+    "multiplex",
+    "rank_loss",
+    "sigmoid_cross_entropy_with_logits",
+    "gaussian_random",
+    "mean_iou",
+    "dice_loss",
+    "image_resize_short",
+    "lstm_unit",
+    "gru_unit",
+    "autoincreased_step_counter",
 ]
 
 
@@ -556,3 +571,212 @@ def bilinear_tensor_product(x, y, size, act=None, name=None,
     helper.append_op(type="bilinear_tensor_product", inputs=inputs,
                      outputs={"Out": [out]})
     return helper.append_activation(out)
+
+
+# ---------------------------------------------------------------------------
+# parity tail: the reference nn.py names not covered above
+# ---------------------------------------------------------------------------
+
+elementwise_max = _elementwise_layer("elementwise_max")
+elementwise_min = _elementwise_layer("elementwise_min")
+elementwise_pow = _elementwise_layer("elementwise_pow")
+
+
+def flatten(x, axis=1, name=None):
+    """Collapse dims before/after ``axis`` into a 2-D matrix (reference
+    nn.py:6181 / flatten_op.cc)."""
+    helper = LayerHelper("flatten", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(type="flatten", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs={"axis": int(axis)})
+    return out
+
+
+def sum(x):
+    """Elementwise sum of a list of tensors (reference nn.py:6630 /
+    sum_op.cc; dense path — SelectedRows inputs ride ops/selected_rows)."""
+    if isinstance(x, Variable):
+        x = [x]
+    helper = LayerHelper("sum")
+    out = helper.create_variable_for_type_inference(dtype=x[0].dtype)
+    helper.append_op(type="sum", inputs={"X": [v for v in x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def multiplex(inputs, index):
+    """Row-wise select among candidate tensors by index (reference
+    nn.py:4353 / multiplex_op.cc)."""
+    if not isinstance(inputs, (list, tuple)) or len(inputs) < 2:
+        raise ValueError("multiplex needs at least 2 candidate tensors")
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(dtype=inputs[0].dtype)
+    helper.append_op(
+        type="multiplex",
+        inputs={"X": [v for v in inputs], "Ids": [index]},
+        outputs={"Out": [out]})
+    return out
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (reference nn.py:5759 / rank_loss_op.cc)."""
+    helper = LayerHelper("rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(dtype=left.dtype)
+    helper.append_op(
+        type="rank_loss",
+        inputs={"Label": [label], "Left": [left], "Right": [right]},
+        outputs={"Out": [out]})
+    return out
+
+
+def sigmoid_cross_entropy_with_logits(x, label, name=None):
+    """Per-element binary CE on logits (reference nn.py:7030 /
+    sigmoid_cross_entropy_with_logits_op.cc)."""
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(dtype=x.dtype)
+    helper.append_op(
+        type="sigmoid_cross_entropy_with_logits",
+        inputs={"X": [x], "Label": [label]},
+        outputs={"Out": [out]})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    """Normal-random tensor (reference nn.py:6519 / gaussian_random_op.cc;
+    randomness rides the executor's counter PRNG, ``seed`` kept for API
+    parity)."""
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype=dtype)
+    helper.append_op(
+        type="gaussian_random", inputs={}, outputs={"Out": [out]},
+        attrs={"shape": list(shape), "mean": float(mean),
+               "std": float(std), "seed": int(seed), "dtype": dtype})
+    return out
+
+
+def mean_iou(input, label, num_classes):
+    """Mean intersection-over-union metric (reference nn.py:5611 /
+    mean_iou_op.cc).  Returns (mean_iou, out_wrong, out_correct)."""
+    helper = LayerHelper("mean_iou")
+    iou = helper.create_variable_for_type_inference(dtype="float32")
+    wrong = helper.create_variable_for_type_inference(dtype="int32")
+    correct = helper.create_variable_for_type_inference(dtype="int32")
+    helper.append_op(
+        type="mean_iou",
+        inputs={"Predictions": [input], "Labels": [label]},
+        outputs={"OutMeanIou": [iou], "OutWrong": [wrong],
+                 "OutCorrect": [correct]},
+        attrs={"num_classes": int(num_classes)})
+    return iou, wrong, correct
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Dice loss for binary segmentation (reference nn.py:5180): built
+    from one_hot + reductions exactly as the reference composes it."""
+    from . import tensor as tensor_layers
+    from .ops import scale as scale_layer
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dim = list(range(1, len(input.shape)))
+    inse = tensor_layers.reduce_sum(elementwise_mul(input, label),
+                                    dim=reduce_dim)
+    denom = elementwise_add(
+        tensor_layers.reduce_sum(input, dim=reduce_dim),
+        tensor_layers.reduce_sum(label, dim=reduce_dim))
+    one = tensor_layers.fill_constant(shape=[1], dtype=input.dtype, value=1.0)
+    score = elementwise_sub(
+        one, elementwise_div(
+            scale_layer(inse, scale=2.0),
+            elementwise_add(denom, tensor_layers.fill_constant(
+                shape=[1], dtype=input.dtype, value=float(epsilon)))))
+    return tensor_layers.reduce_mean(score)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Resize so the SHORT image side equals ``out_short_len``, keeping
+    aspect ratio (reference nn.py:5323)."""
+    from .cnn import image_resize
+    in_shape = input.shape
+    if len(in_shape) != 4:
+        raise ValueError("image_resize_short expects NCHW input")
+    hw = in_shape[2:4]
+    short_idx = hw.index(min(hw))
+    out_shape = list(hw)
+    out_shape[short_idx] = int(out_short_len)
+    out_shape[1 - short_idx] = int(
+        round(float(hw[1 - short_idx]) / hw[short_idx] * out_short_len))
+    return image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """One LSTM step: fc([x_t, h_prev]) -> 4 gates -> lstm_unit op
+    (reference nn.py:3008 / lstm_unit_op.cc).  Returns (hidden, cell)."""
+    if len(x_t.shape) != 2 or len(hidden_t_prev.shape) != 2 or \
+            len(cell_t_prev.shape) != 2:
+        raise ValueError("lstm_unit takes 2-D x_t/hidden/cell")
+    from .tensor import concat
+    size = int(cell_t_prev.shape[1])
+    concat_in = concat([x_t, hidden_t_prev], axis=1)
+    fc_out = fc(concat_in, size=4 * size, param_attr=param_attr,
+                bias_attr=bias_attr, name=name)
+    helper = LayerHelper("lstm_unit", name=name)
+    h = helper.create_variable_for_type_inference(dtype=x_t.dtype)
+    c = helper.create_variable_for_type_inference(dtype=x_t.dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [fc_out], "C_prev": [cell_t_prev]},
+        outputs={"H": [h], "C": [c]},
+        attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid"):
+    """One GRU step over a pre-projected input (reference nn.py:751 /
+    gru_unit_op.cc: ``input`` is the fc-transformed x, ``size`` = 3x the
+    hidden dim).  Returns (hidden, reset_hidden_prev, gate)."""
+    h_dim = size // 3
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr)
+    w = helper.create_parameter(attr=helper.param_attr,
+                                shape=[h_dim, 3 * h_dim],
+                                dtype=input.dtype)
+    inputs = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if helper.kwargs.get("bias_attr") is not False:
+        b = helper.create_parameter(attr=helper.bias_attr,
+                                    shape=[1, 3 * h_dim],
+                                    dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    h = helper.create_variable_for_type_inference(dtype=input.dtype)
+    gate = helper.create_variable_for_type_inference(dtype=input.dtype)
+    rhp = helper.create_variable_for_type_inference(dtype=input.dtype)
+    helper.append_op(
+        type="gru_unit", inputs=inputs,
+        outputs={"Hidden": [h], "Gate": [gate], "ResetHiddenPrev": [rhp]},
+        attrs={"activation": activation,
+               "gate_activation": gate_activation})
+    return h, rhp, gate
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1,
+                               dtype="int64"):
+    """A persistable counter advanced once per executed step (reference
+    nn.py:4541).  The LR schedulers' ``_decay_step_counter`` delegates
+    here — one counter builder, two callers."""
+    helper = LayerHelper("step_counter")
+    block = helper.main_program.global_block()
+    name = counter_name or "@STEP_COUNTER@"
+    counter = block._find_var_recursive(name)
+    if counter is None:
+        counter = block.create_var(name=name, shape=(1,), dtype=dtype,
+                                   persistable=True)
+        startup_blk = helper.startup_program.global_block()
+        startup_blk.create_var(name=name, shape=(1,), dtype=dtype,
+                               persistable=True)
+        from ..initializer import Constant
+        Constant(value=float(begin - step))(counter, startup_blk)
+        helper.append_op(
+            type="increment", inputs={"X": [counter]},
+            outputs={"Out": [counter]}, attrs={"step": float(step)})
+        counter.stop_gradient = True
+    return counter

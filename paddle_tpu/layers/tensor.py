@@ -53,6 +53,9 @@ __all__ = [
     "cumsum",
     "increment",
     "isfinite",
+    "has_inf",
+    "has_nan",
+    "create_parameter",
     "less_than",
     "equal",
     "less_equal",
@@ -71,6 +74,18 @@ def create_tensor(dtype, name=None, persistable=False):
     return helper.create_global_variable(
         name=helper.name + ".tensor", dtype=dtype, persistable=persistable
     )
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Create a bare learnable parameter (reference tensor.py:58 — the
+    low-level API for hand-built operator graphs)."""
+    from ..param_attr import ParamAttr
+    helper = LayerHelper("create_parameter")
+    if attr is None:
+        attr = ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
 
 
 def create_global_var(shape, value, dtype, persistable=False,
@@ -561,4 +576,22 @@ def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
                "output_dim_idx": output_dim_idx, "mean": float(mean),
                "std": float(std), "seed": seed, "dtype": dtype},
     )
+    return out
+
+
+def has_inf(x):
+    """Any-element-is-inf scalar bool (reference tensor.py:646)."""
+    helper = LayerHelper("has_inf")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="has_inf", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def has_nan(x):
+    """Any-element-is-nan scalar bool (reference tensor.py:662)."""
+    helper = LayerHelper("has_nan")
+    out = helper.create_variable_for_type_inference(dtype="bool")
+    helper.append_op(type="has_nan", inputs={"X": [x]},
+                     outputs={"Out": [out]})
     return out

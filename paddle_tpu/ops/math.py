@@ -269,6 +269,31 @@ register_op(
     grad=None,
 )
 
+# has_inf / has_nan: the isfinite family's other two members
+# (reference isfinite_op.cc registers all three as OverflowOp variants)
+
+register_op(
+    "has_inf", ["X"], ["Out"],
+    infer=lambda op, block: set_output(op, block, "Out", (1,), np.bool_),
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.any(
+            jnp.stack([jnp.any(jnp.isinf(x)) for x in ins["X"]])
+        ).reshape(1)
+    },
+    grad=None,
+)
+
+register_op(
+    "has_nan", ["X"], ["Out"],
+    infer=lambda op, block: set_output(op, block, "Out", (1,), np.bool_),
+    compute=lambda ins, attrs, ctx, op_index: {
+        "Out": jnp.any(
+            jnp.stack([jnp.any(jnp.isnan(x)) for x in ins["X"]])
+        ).reshape(1)
+    },
+    grad=None,
+)
+
 
 # -- cos_sim ----------------------------------------------------------------
 

@@ -73,6 +73,11 @@ class Optimizer:
         param = param_and_grad[0]
         base = self._global_learning_rate()
         mult = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        if isinstance(mult, Variable):
+            # a per-param LR already computed in-graph (append_LARS
+            # writes the fully-scaled rate; reference optimizer.py uses
+            # it directly)
+            return mult
         if mult == 1.0:
             return base
         helper = LayerHelper("param_lr")

@@ -1,0 +1,304 @@
+"""Parity-tail layer fns (reference nn.py/tensor.py/io.py names added
+late): elementwise_max/min/pow, flatten, sum, multiplex, rank_loss,
+sigmoid_cross_entropy_with_logits, gaussian_random, mean_iou, dice_loss,
+image_resize_short, lstm_unit, gru_unit, autoincreased_step_counter,
+create_parameter, has_inf/has_nan, append_LARS, the
+layer_function_generator utilities, and the host-side reader-handle
+family (py_reader/open_files/read_file/shuffle/batch/double_buffer/
+random_data_generator/load/Preprocessor)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import program_guard
+
+L = fluid.layers
+
+
+def _run(fetches, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return [np.asarray(v) for v in exe.run(feed=feed, fetch_list=fetches)]
+
+
+def test_elementwise_max_min_pow_and_flatten():
+    x = L.data("x", shape=[2, 3])
+    y = L.data("y", shape=[2, 3])
+    outs = _run([L.elementwise_max(x, y), L.elementwise_min(x, y),
+                 L.elementwise_pow(x, y), L.flatten(x, axis=2)],
+                {"x": np.full((4, 2, 3), 2.0, "float32"),
+                 "y": np.full((4, 2, 3), 3.0, "float32")})
+    assert (outs[0] == 3.0).all() and (outs[1] == 2.0).all()
+    np.testing.assert_allclose(outs[2], np.full((4, 2, 3), 8.0), rtol=1e-6)
+    assert outs[3].shape == (8, 3)      # flatten axis=2 on [4,2,3]
+
+
+def test_sum_multiplex_rank_loss_sigmoid_ce():
+    x = L.data("x", shape=[3])
+    p = L.data("p", shape=[3])
+    q = L.data("q", shape=[3])
+    ids = L.data("ids", shape=[1], dtype="int32")
+    lbl = L.data("lbl", shape=[1])
+    left = L.data("lf", shape=[1])
+    right = L.data("rt", shape=[1])
+    z = L.data("z", shape=[3])
+    t = L.data("t", shape=[3])
+
+    a = np.arange(12, dtype="float32").reshape(4, 3)
+    zv = np.tile(np.array([[1.0, -1.0, 0.0]], "float32"), (4, 1))
+    tv = np.tile(np.array([[1.0, 0.0, 1.0]], "float32"), (4, 1))
+    s, m, rl, ce = _run(
+        [L.sum([x, x, x]), L.multiplex([p, q], ids),
+         L.rank_loss(lbl, left, right),
+         L.sigmoid_cross_entropy_with_logits(z, t)],
+        {"x": a,
+         "p": np.zeros((4, 3), "float32"),
+         "q": np.ones((4, 3), "float32"),
+         "ids": np.array([[0], [1], [0], [1]], "int32"),
+         "lbl": np.ones((4, 1), "float32"),
+         "lf": np.full((4, 1), 2.0, "float32"),
+         "rt": np.zeros((4, 1), "float32"),
+         "z": zv, "t": tv})
+    np.testing.assert_allclose(s, 3 * a, rtol=1e-6)
+    np.testing.assert_allclose(m[:, 0], [0, 1, 0, 1])
+    # C(o) = o*(1-label) + log(1+exp(-o)), o = left-right = 2, label=1
+    np.testing.assert_allclose(rl, np.log1p(np.exp(-2.0)) *
+                               np.ones((4, 1)), rtol=1e-5)
+    want = np.maximum(zv, 0) - zv * tv + np.log1p(np.exp(-np.abs(zv)))
+    np.testing.assert_allclose(ce, want, rtol=1e-5)
+
+
+def test_gaussian_random_moments_and_mean_iou():
+    g = L.gaussian_random([2000, 8], mean=1.0, std=2.0)
+    gv, = _run([g], {})
+    assert abs(gv.mean() - 1.0) < 0.1 and abs(gv.std() - 2.0) < 0.1
+
+    pred = L.data("pr", shape=[6], dtype="int64", append_batch_size=False)
+    lab = L.data("lb", shape=[6], dtype="int64", append_batch_size=False)
+    iou, _, _ = L.mean_iou(pred, lab, num_classes=2)
+    got, = _run([iou], {"pr": np.array([0, 0, 1, 1, 0, 1], "int64"),
+                        "lb": np.array([0, 1, 1, 1, 0, 0], "int64")})
+    # class0: inter 2, union 4 -> .5 ; class1: inter 2, union 4 -> .5
+    np.testing.assert_allclose(got, [0.5], rtol=1e-5)
+
+
+def test_dice_loss_and_image_resize_short():
+    probs = L.data("p", shape=[2])
+    lbl = L.data("l", shape=[1], dtype="int64")
+    d, = _run([L.dice_loss(probs, lbl)],
+              {"p": np.array([[1.0, 0.0], [0.0, 1.0]], "float32"),
+               "l": np.array([[0], [1]], "int64")})
+    assert d[0] < 1e-4   # perfect prediction -> ~0 loss
+
+    img = L.data("img", shape=[3, 12, 8])
+    out = L.image_resize_short(img, 4)
+    assert tuple(out.shape[2:]) == (6, 4)   # short side 8 -> 4, keep AR
+
+
+def test_lstm_gru_units_step_math():
+    x = L.data("x", shape=[5])
+    h = L.data("h", shape=[6])
+    c = L.data("c", shape=[6])
+    h1, c1 = L.lstm_unit(x, h, c)
+    gin = L.data("gi", shape=[9])      # 3 * hidden(3)
+    gh = L.data("gh", shape=[3])
+    nh, rhp, gate = L.gru_unit(gin, gh, 9)
+    hv, cv, nv = _run(
+        [h1, c1, nh],
+        {"x": np.random.rand(3, 5).astype("float32"),
+         "h": np.zeros((3, 6), "float32"),
+         "c": np.ones((3, 6), "float32"),
+         "gi": np.random.rand(3, 9).astype("float32"),
+         "gh": np.zeros((3, 3), "float32")})
+    assert hv.shape == (3, 6) and cv.shape == (3, 6)
+    assert np.isfinite(hv).all()
+    assert nv.shape == (3, 3)
+
+
+def test_has_inf_has_nan_and_create_parameter():
+    x = L.data("x", shape=[3])
+    hi = L.has_inf(x)
+    hn = L.has_nan(x)
+    w = L.create_parameter(shape=[3, 2], dtype="float32")
+    o = L.matmul(x, w)
+    a, b, ov = _run([hi, hn, o],
+                    {"x": np.array([[1.0, np.inf, 0.0]], "float32")})
+    assert bool(a[0]) is True and bool(b[0]) is False
+    assert ov.shape == (1, 2)
+
+
+def test_autoincreased_step_counter_advances():
+    ctr = L.autoincreased_step_counter(begin=1)
+    loss = L.mean(L.fc(L.data("x", shape=[4]), 2))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.zeros((2, 4), "float32")}
+    vals = [int(np.asarray(exe.run(feed=feed,
+                                   fetch_list=[ctr, loss])[0])[0])
+            for _ in range(3)]
+    assert vals == [1, 2, 3], vals
+
+
+def test_append_LARS_scales_updates():
+    x = L.data("x", shape=[4])
+    y = L.data("y", shape=[1])
+    pred = L.fc(x, 1, bias_attr=False)
+    loss = L.mean(L.square_error_cost(pred, y))
+    params_grads = fluid.append_backward(loss)
+    lr = L.fill_constant(shape=[1], dtype="float32", value=0.1)
+    decayed = fluid.layers.append_LARS(params_grads, lr, weight_decay=0.01)
+    assert len(decayed) == len(params_grads)
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    opt.apply_gradients(params_grads, loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype("float32"),
+            "y": rng.rand(8, 1).astype("float32")}
+    l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+    for _ in range(20):
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+    assert float(np.asarray(lv)[0]) < l0   # LARS-scaled SGD still learns
+
+
+def test_layer_function_generator():
+    gen = L.generate_layer_fn("cos_sim")
+    x = L.data("x", shape=[4])
+    y = L.data("y", shape=[4])
+    outs = gen(x, y)
+    assert len(outs) == 3                  # Out, XNorm, YNorm
+    sig = L.generate_layer_fn_noattr("sigmoid")
+    s = sig(x)
+    o, = _run([s], {"x": np.zeros((2, 4), "float32"),
+                    "y": np.zeros((2, 4), "float32")})
+    np.testing.assert_allclose(o, 0.5 * np.ones((2, 4)), rtol=1e-6)
+
+    @L.templatedoc(op_type="relu")
+    def doc_holder():
+        """${comment}"""
+    assert doc_holder.__doc__ and "${comment}" not in doc_holder.__doc__
+
+
+def test_py_reader_training_flow():
+    pr = L.py_reader(capacity=4, shapes=[[-1, 8], [-1, 1]],
+                     dtypes=["float32", "int64"])
+    img, lbl = L.read_file(pr)
+    pred = L.fc(img, 4, act="softmax")
+    loss = L.mean(L.cross_entropy(pred, lbl))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    def samples():
+        rng = np.random.RandomState(0)
+        for _ in range(32):
+            yield (rng.rand(8).astype("float32"),
+                   rng.randint(0, 4, (1,)).astype("int64"))
+
+    pr.decorate_paddle_reader(samples)
+    handle = L.double_buffer(L.batch(L.shuffle(pr, 16), 8))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    steps = 0
+    for feed in handle:
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+        steps += 1
+    assert steps == 4
+    assert np.isfinite(np.asarray(lv)).all()
+    # unbatched iteration is refused with guidance
+    with pytest.raises(RuntimeError):
+        iter(pr).__next__()
+
+
+def test_open_files_and_preprocessor(tmp_path):
+    from paddle_tpu import recordio as rio
+    path = str(tmp_path / "d.rio")
+    with rio.Writer(path) as w:
+        for i in range(20):
+            w.write(pickle.dumps((np.full((4,), i, "float32"),
+                                  np.array([i % 3], "int64"))))
+    of = L.open_files([path], shapes=[[-1, 4], [-1, 1]],
+                      lod_levels=[0, 0], dtypes=["float32", "int64"],
+                      thread_num=2, pass_num=2)
+    xv, yv = L.read_file(of)
+    assert xv.shape[-1] == 4
+    h = L.batch(of, 5)
+    batches = list(h)
+    assert len(batches) == 8               # 20 samples x 2 passes / 5
+
+    pre = L.Preprocessor(reader=h)
+    with pre.block():
+        xi, yi = pre.inputs()
+        pre.outputs(L.scale(xi, scale=2.0), yi)
+    out_batches = list(pre)
+    assert len(out_batches) == 8
+    raw = np.sort(np.concatenate(
+        [b[xv.name][:, 0] for b in batches]))
+    cooked = np.sort(np.concatenate(
+        [b[xv.name][:, 0] for b in out_batches]))
+    np.testing.assert_allclose(cooked, 2.0 * raw, rtol=1e-6)
+
+
+def test_tensor_provider_and_reader_var_ranks():
+    pr = L.py_reader(capacity=2, shapes=[[-1, 3], [-1, 1]],
+                     dtypes=["float32", "int64"])
+    xv, yv = L.read_file(pr)
+
+    def tensors():
+        for i in range(3):
+            yield (np.full((5, 3), i, "float32"),
+                   np.zeros((5, 1), "int64"))
+
+    pr.decorate_tensor_provider(tensors)
+    feeds = list(pr)
+    assert len(feeds) == 3
+    assert feeds[1][xv.name].shape == (5, 3)
+    assert (feeds[1][xv.name] == 1).all()
+
+    # inner -1 dims keep their rank (only the LEADING batch dim strips)
+    seq = L.py_reader(capacity=2, shapes=[[-1, -1, 16]],
+                      dtypes=["float32"])
+    sv = L.read_file(seq)
+    assert len(sv.shape) == 3 and sv.shape[-1] == 16
+
+    # slot-count mismatch in a tensor provider is a loud error
+    bad = L.py_reader(capacity=2, shapes=[[-1, 3], [-1, 1]],
+                      dtypes=["float32", "int64"])
+    bad.decorate_tensor_provider(lambda: iter([(np.zeros((5, 3)),)]))
+    with pytest.raises(ValueError):
+        next(iter(bad))
+
+
+def test_preprocessor_output_count_mismatch_is_loud(tmp_path):
+    from paddle_tpu import recordio as rio
+    path = str(tmp_path / "d.rio")
+    with rio.Writer(path) as w:
+        for i in range(4):
+            w.write(pickle.dumps((np.zeros((2,), "float32"),
+                                  np.array([0], "int64"))))
+    of = L.open_files([path], shapes=[[-1, 2], [-1, 1]],
+                      lod_levels=[0, 0], dtypes=["float32", "int64"])
+    h = L.batch(of, 2)
+    pre = L.Preprocessor(reader=h)
+    with pytest.raises(ValueError):
+        with pre.block():
+            xi, yi = pre.inputs()
+            pre.outputs(xi)          # 1 output for a 2-slot reader
+
+
+def test_random_data_generator_and_load(tmp_path):
+    rdg = L.random_data_generator(-1.0, 1.0, shapes=[[-1, 4]],
+                                  lod_levels=[0])
+    b = L.batch(rdg, 6)
+    feed = next(iter(b))
+    arr = list(feed.values())[0]
+    assert arr.shape == (6, 4) and (-1 <= arr).all() and (arr <= 1).all()
+
+    w = np.arange(6, dtype="float32").reshape(2, 3)
+    np.save(str(tmp_path / "w.npy"), w)
+    out = L.create_tensor(dtype="float32")
+    L.load(out, str(tmp_path / "w"))
+    got, = _run([out], {})
+    np.testing.assert_allclose(got, w)

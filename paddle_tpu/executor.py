@@ -118,6 +118,22 @@ def place_from_string(s):
     raise ValueError("unknown place %r" % s)
 
 
+def _coerce_feed(block, name, v):
+    """Convert one feed value to the program var's MATERIALIZED dtype.
+
+    Device arrays pass through without a host round-trip; under x64-off
+    a device array fed back (PyReader staging) is already int32, and
+    asking jax for int64 would warn-and-truncate."""
+    if not isinstance(v, jax.Array):
+        v = np.asarray(v)
+    pv = block._find_var_recursive(name)
+    if pv is not None and pv.dtype is not None:
+        want = materialize_dtype(pv.dtype)
+        if np.dtype(v.dtype) != np.dtype(want):
+            v = v.astype(want)
+    return v
+
+
 def _feed_signature(feed):
     return tuple(
         (name, tuple(np.shape(v)), str(np.asarray(v).dtype))
@@ -273,20 +289,7 @@ class Executor:
         # pipeline fast path: py_reader/double-buffer feeds stay device-
         # resident instead of re-crossing the host link every step)
         block = program.global_block()
-        feed_vals = []
-        for n in feed_names:
-            v = feed[n]
-            if not isinstance(v, jax.Array):
-                v = np.asarray(v)
-            pv = block._find_var_recursive(n)
-            if pv is not None and pv.dtype is not None:
-                # target the MATERIALIZED dtype: under x64-off, a device
-                # array fed back (PyReader staging) is already int32 and
-                # asking jax for int64 would warn-and-truncate
-                want = materialize_dtype(pv.dtype)
-                if np.dtype(v.dtype) != np.dtype(want):
-                    v = v.astype(want)
-            feed_vals.append(v)
+        feed_vals = [_coerce_feed(block, n, feed[n]) for n in feed_names]
 
         feed_sig = tuple(
             (n, tuple(v.shape), str(v.dtype))
@@ -357,20 +360,7 @@ class Executor:
         ]
         feed_names = sorted(feed.keys())
         block = program.global_block()
-        feed_vals = []
-        for n in feed_names:
-            v = feed[n]
-            if not isinstance(v, jax.Array):
-                v = np.asarray(v)
-            pv = block._find_var_recursive(n)
-            if pv is not None and pv.dtype is not None:
-                # target the MATERIALIZED dtype: under x64-off, a device
-                # array fed back (PyReader staging) is already int32 and
-                # asking jax for int64 would warn-and-truncate
-                want = materialize_dtype(pv.dtype)
-                if np.dtype(v.dtype) != np.dtype(want):
-                    v = v.astype(want)
-            feed_vals.append(v)
+        feed_vals = [_coerce_feed(block, n, feed[n]) for n in feed_names]
         feed_sig = tuple(
             (n, tuple(v.shape), str(v.dtype))
             for n, v in zip(feed_names, feed_vals)

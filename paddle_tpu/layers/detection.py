@@ -6,6 +6,7 @@ roi_pool, polygon_box_transform)."""
 from ..layer_helper import LayerHelper
 
 __all__ = [
+    "multi_box_head",
     "prior_box",
     "anchor_generator",
     "box_coder",
@@ -443,3 +444,92 @@ def detection_map(detect_res, label, class_num, background_label=0,
                "ap_type": ap_version})
     m.stop_gradient = True
     return m
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=None, flip=True, clip=False,
+                   kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD prediction head (reference detection.py:1015 multi_box_head):
+    per feature map, prior boxes plus location/confidence convolutions;
+    returns (mbox_loc [N, P, 4], mbox_conf [N, P, C],
+    boxes [P, 4], variances [P, 4]) concatenated over all maps."""
+    import math
+
+    from .cnn import conv2d
+    from .tensor import concat, reshape, transpose
+
+    num_layer = len(inputs)
+    if num_layer <= 2:
+        assert min_sizes is not None and max_sizes is not None, (
+            "min_sizes/max_sizes must be given for <=2 feature maps")
+        assert len(min_sizes) == num_layer and len(max_sizes) == num_layer
+    elif min_sizes is None and max_sizes is None:
+        # the SSD paper's scale schedule from min_ratio..max_ratio
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+
+    def _per_layer(seq, what):
+        if seq and len(seq) != num_layer:
+            raise ValueError(
+                "%s must have one entry per input (%d vs %d)"
+                % (what, len(seq), num_layer))
+    _per_layer(aspect_ratios, "aspect_ratios")
+    _per_layer(step_h, "step_h")
+    _per_layer(step_w, "step_w")
+    _per_layer(steps, "steps")
+    if steps:
+        step_w = step_h = steps
+
+    mbox_locs, mbox_confs, box_results, var_results = [], [], [], []
+    for i, feat in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i]
+        if not isinstance(min_size, (list, tuple)):
+            min_size = [min_size]
+        if not isinstance(max_size, (list, tuple)):
+            max_size = [max_size]
+        aspect_ratio = []
+        if aspect_ratios is not None:
+            aspect_ratio = aspect_ratios[i]
+            if not isinstance(aspect_ratio, (list, tuple)):
+                aspect_ratio = [aspect_ratio]
+        step = [step_w[i] if step_w else 0.0,
+                step_h[i] if step_h else 0.0]
+
+        box, var = prior_box(
+            feat, image, min_size, max_size, aspect_ratio, variance,
+            flip, clip, step, offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        box_results.append(box)
+        var_results.append(var)
+        num_boxes = box.shape[2]
+
+        loc = conv2d(feat, num_filters=num_boxes * 4,
+                     filter_size=kernel_size, padding=pad, stride=stride)
+        loc = transpose(loc, perm=[0, 2, 3, 1])
+        mbox_locs.append(reshape(loc, shape=[0, -1, 4]))
+
+        conf = conv2d(feat, num_filters=num_boxes * num_classes,
+                      filter_size=kernel_size, padding=pad, stride=stride)
+        conf = transpose(conf, perm=[0, 2, 3, 1])
+        mbox_confs.append(reshape(conf, shape=[0, -1, num_classes]))
+
+    if num_layer == 1:
+        box, var = box_results[0], var_results[0]
+        mbox_locs_concat, mbox_confs_concat = mbox_locs[0], mbox_confs[0]
+    else:
+        box = concat([reshape(b, shape=[-1, 4]) for b in box_results])
+        var = concat([reshape(v, shape=[-1, 4]) for v in var_results])
+        mbox_locs_concat = concat(mbox_locs, axis=1)
+        mbox_confs_concat = concat(mbox_confs, axis=1)
+    box.stop_gradient = True
+    var.stop_gradient = True
+    return mbox_locs_concat, mbox_confs_concat, box, var

@@ -167,9 +167,10 @@ def _declare_reader_vars(shapes, dtypes, lod_levels, name):
     lod_levels = lod_levels or [0] * len(shapes)
     vars_ = []
     for i, (shp, dt, ll) in enumerate(zip(shapes, dtypes, lod_levels)):
-        # strip only the LEADING batch dim; data() re-prepends it.
-        # inner -1 dims (variable time steps) must keep their rank.
-        shp = list(shp[1:]) if shp and shp[0] == -1 else list(shp)
+        # reader shapes include the batch dim (reference py_reader
+        # contract); strip it — data() re-prepends -1 — and keep inner
+        # -1 dims (variable time steps) so the rank survives
+        shp = list(shp[1:]) if shp else []
         vars_.append(data(
             unique_name.generate("%s_slot%d" % (name or "reader", i)),
             shape=list(shp), dtype=dt, lod_level=ll))
@@ -184,6 +185,11 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
     handle = ReaderHandle(
         _declare_reader_vars(shapes, dtypes, lod_levels, name), name=name)
     handle._capacity = capacity
+    if use_double_buffer:
+        # the reference stages to the device by default; TPUPlace falls
+        # back to the first local device on CPU-only hosts
+        from ..executor import TPUPlace
+        handle._place = TPUPlace(0)
     return handle
 
 
@@ -219,7 +225,14 @@ def random_data_generator(low, high, shapes, lod_levels=None,
     handle = ReaderHandle(
         _declare_reader_vars(shapes, [
             "float32"] * len(shapes), lod_levels, "rand"))
-    dims = [[d for d in shp if d != -1] or [1] for shp in shapes]
+    # per-sample dims = declared shape minus the batch dim; a random
+    # generator cannot invent variable (-1) inner extents
+    dims = [list(shp[1:]) or [1] for shp in shapes]
+    for shp, d in zip(shapes, dims):
+        if any(x == -1 for x in d):
+            raise ValueError(
+                "random_data_generator needs concrete inner dims, got "
+                "%s" % (tuple(shp),))
 
     def src():
         rng = np.random.RandomState(0)

@@ -11,10 +11,7 @@ from registered ops, so it fuses into the step's HLO.
 
 import math
 
-from ..framework import default_main_program, default_startup_program
-from ..initializer import ConstantInitializer
 from ..layer_helper import LayerHelper
-from .. import unique_name
 
 __all__ = [
     "exponential_decay",
@@ -182,8 +179,12 @@ def append_LARS(params_grads, learning_rate, weight_decay):
     learning_rate_scheduler.py:310): per-parameter
     ``lr * ||w|| / (||g|| + wd * ||w||)``, written into each parameter's
     ``optimize_attr['learning_rate']`` so the optimizer's per-param LR
-    multiplier picks it up."""
+    multiplier picks it up.  ``learning_rate`` may be a Variable or a
+    plain float (materialized as a constant, like the reference's
+    scalar operator overloads)."""
     helper = LayerHelper("lars")
+    if not hasattr(learning_rate, "dtype"):
+        learning_rate = _scalar(helper, float(learning_rate), None)
 
     def _balanced_weight(param_norm, grad_norm):
         if weight_decay == 1.0:

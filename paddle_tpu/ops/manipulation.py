@@ -150,7 +150,10 @@ def _concat_infer(op, block):
     xs = [block.var_recursive(n) for n in op.inputs["X"]]
     axis = op.attrs.get("axis", 0) % len(xs[0].shape)
     out = list(xs[0].shape)
-    out[axis] = sum(v.shape[axis] for v in xs)
+    sizes = [v.shape[axis] for v in xs]
+    # any unknown (-1) contributor makes the result unknown, not a
+    # meaningless negative sum
+    out[axis] = -1 if any(s < 0 for s in sizes) else sum(sizes)
     set_output(op, block, "Out", out, xs[0].dtype)
 
 

@@ -67,6 +67,7 @@ class PyReader:
         dev = self._place.jax_device() if self._place is not None else None
         q = queue.Queue(maxsize=self.capacity)
         end = object()
+        failure = []   # producer exception, re-raised on the consumer
 
         def producer():
             try:
@@ -78,6 +79,8 @@ class PyReader:
                             for k, v in feed.items()
                         }
                     q.put(feed)
+            except BaseException as e:  # noqa: BLE001 — must cross threads
+                failure.append(e)
             finally:
                 q.put(end)
 
@@ -86,5 +89,9 @@ class PyReader:
         while True:
             item = q.get()
             if item is end:
+                if failure:
+                    # a swallowed producer error would masquerade as
+                    # end-of-data; surface it where the training loop is
+                    raise failure[0]
                 break
             yield item

@@ -149,8 +149,9 @@ def test_append_LARS_scales_updates():
     pred = L.fc(x, 1, bias_attr=False)
     loss = L.mean(L.square_error_cost(pred, y))
     params_grads = fluid.append_backward(loss)
-    lr = L.fill_constant(shape=[1], dtype="float32", value=0.1)
-    decayed = fluid.layers.append_LARS(params_grads, lr, weight_decay=0.01)
+    # a plain float learning_rate is accepted (materialized in-graph)
+    decayed = fluid.layers.append_LARS(params_grads, 0.1,
+                                       weight_decay=0.01)
     assert len(decayed) == len(params_grads)
     opt = fluid.optimizer.SGD(learning_rate=0.1)
     opt.apply_gradients(params_grads, loss)

@@ -1,0 +1,72 @@
+"""multi_box_head — the SSD prediction head (reference
+detection.py:1015): prior boxes + loc/conf convolutions across feature
+maps, concatenated."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+L = fluid.layers
+
+
+def _build(num_classes=5):
+    img = L.data("img", shape=[3, 64, 64])
+    c1 = L.conv2d(img, 8, 3, stride=8, padding=1)    # [N, 8, 8, 8]
+    c2 = L.conv2d(img, 8, 3, stride=16, padding=1)   # [N, 8, 4, 4]
+    return img, L.multi_box_head(
+        inputs=[c1, c2], image=img, num_classes=num_classes,
+        min_sizes=[10.0, 20.0], max_sizes=[20.0, 40.0],
+        aspect_ratios=[[2.0], [2.0, 3.0]], base_size=64)
+
+
+def test_multi_box_head_shapes_align():
+    img, (locs, confs, box, var) = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    lv, cv, bv, vv = [np.asarray(v) for v in exe.run(
+        feed={"img": np.random.rand(2, 3, 64, 64).astype("float32")},
+        fetch_list=[locs, confs, box, var])]
+    # priors: 8x8 map with (1 min + 1 max + 2 flipped ARs) = 4 boxes,
+    # 4x4 map with (1 + 1 + 4) = 6 boxes -> 8*8*4 + 4*4*6 = 352
+    assert lv.shape == (2, 352, 4)
+    assert cv.shape == (2, 352, 5)
+    assert bv.shape == (352, 4) and vv.shape == (352, 4)
+    # prior boxes and conv predictions must agree on P
+    assert lv.shape[1] == bv.shape[0]
+
+
+def test_multi_box_head_ratio_schedule_and_training():
+    """min_ratio/max_ratio schedule path (>=3 maps) + ssd_loss-style
+    training step keeps gradients finite."""
+    img = L.data("img", shape=[3, 64, 64])
+    feats = [L.conv2d(img, 4, 3, stride=s, padding=1)
+             for s in (8, 16, 32)]
+    locs, confs, box, var = L.multi_box_head(
+        inputs=feats, image=img, num_classes=3,
+        min_ratio=20, max_ratio=90,
+        aspect_ratios=[[2.0], [2.0], [2.0]], base_size=64)
+    loss = L.mean(L.elementwise_mul(locs, locs)) \
+        + L.mean(L.elementwise_mul(confs, confs))
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"img": np.random.rand(2, 3, 64, 64).astype("float32")}
+    l0 = float(np.asarray(exe.run(feed=feed, fetch_list=[loss])[0])[0])
+    for _ in range(5):
+        lv, = exe.run(feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.asarray(lv)).all()
+    assert float(np.asarray(lv)[0]) < l0   # shrinking the L2 objective
+
+
+def test_multi_box_head_validation():
+    img = L.data("img", shape=[3, 64, 64])
+    c1 = L.conv2d(img, 4, 3, stride=8)
+    with pytest.raises(AssertionError):
+        # <=2 maps without explicit min/max sizes
+        L.multi_box_head(inputs=[c1], image=img, num_classes=3,
+                         aspect_ratios=[[2.0]], base_size=64)
+    with pytest.raises(ValueError):
+        L.multi_box_head(inputs=[c1], image=img, num_classes=3,
+                         min_sizes=[10.0], max_sizes=[20.0],
+                         aspect_ratios=[[2.0], [3.0]], base_size=64)

@@ -11,11 +11,14 @@ import math
 
 import numpy as np
 
+import contextlib as _contextlib
+
 __all__ = [
     "Initializer", "Constant", "Uniform", "Normal", "TruncatedNormal",
     "Xavier", "MSRA", "Bilinear", "NumpyArrayInitializer",
     "ConstantInitializer", "UniformInitializer", "NormalInitializer",
     "TruncatedNormalInitializer", "XavierInitializer", "MSRAInitializer",
+    "force_init_on_cpu", "init_on_cpu",
 ]
 
 
@@ -170,3 +173,29 @@ TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
+
+
+# force_init_on_cpu / init_on_cpu (reference initializer.py:29-61): a
+# GPU-era switch pinning random-init ops to the CPU to keep them
+# deterministic across device counts.  Initialization here is a jitted
+# startup program whose placement XLA owns, so the switch only records
+# intent — kept for API parity and introspection.
+_force_init_on_cpu_ = False
+
+
+def force_init_on_cpu():
+    """Whether initializer ops are currently requested on CPU."""
+    return _force_init_on_cpu_
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """Context manager requesting CPU placement for inits built inside
+    (reference init_on_cpu)."""
+    global _force_init_on_cpu_
+    prev = _force_init_on_cpu_
+    _force_init_on_cpu_ = True
+    try:
+        yield
+    finally:
+        _force_init_on_cpu_ = prev

@@ -162,15 +162,20 @@ class ReaderHandle(object):
         return h
 
 
-def _declare_reader_vars(shapes, dtypes, lod_levels, name):
+def _declare_reader_vars(shapes, dtypes, lod_levels, name,
+                         shapes_include_batch=True):
     from .. import unique_name
     lod_levels = lod_levels or [0] * len(shapes)
     vars_ = []
     for i, (shp, dt, ll) in enumerate(zip(shapes, dtypes, lod_levels)):
-        # reader shapes include the batch dim (reference py_reader
-        # contract); strip it — data() re-prepends -1 — and keep inner
-        # -1 dims (variable time steps) so the rank survives
-        shp = list(shp[1:]) if shp else []
+        # py_reader/open_files shapes include the batch dim (reference
+        # contract); strip it — data() re-prepends -1 — keeping inner
+        # -1 dims (variable time steps) so the rank survives.
+        # random_data_generator shapes are per-sample (batch-free).
+        if shapes_include_batch:
+            shp = list(shp[1:]) if shp else []
+        else:
+            shp = list(shp)
         vars_.append(data(
             unique_name.generate("%s_slot%d" % (name or "reader", i)),
             shape=list(shp), dtype=dt, lod_level=ll))
@@ -223,16 +228,17 @@ def random_data_generator(low, high, shapes, lod_levels=None,
     """Uniform-random synthetic reader (reference io.py /
     create_random_data_generator_op.cc) — benchmarking without IO."""
     handle = ReaderHandle(
-        _declare_reader_vars(shapes, [
-            "float32"] * len(shapes), lod_levels, "rand"))
-    # per-sample dims = declared shape minus the batch dim; a random
-    # generator cannot invent variable (-1) inner extents
-    dims = [list(shp[1:]) or [1] for shp in shapes]
+        _declare_reader_vars(shapes, ["float32"] * len(shapes),
+                             lod_levels, "rand",
+                             shapes_include_batch=False))
+    # reference contract: shapes are PER-SAMPLE (no batch dim); a random
+    # generator cannot invent variable (-1) extents
+    dims = [list(shp) or [1] for shp in shapes]
     for shp, d in zip(shapes, dims):
         if any(x == -1 for x in d):
             raise ValueError(
-                "random_data_generator needs concrete inner dims, got "
-                "%s" % (tuple(shp),))
+                "random_data_generator needs concrete per-sample dims, "
+                "got %s" % (tuple(shp),))
 
     def src():
         rng = np.random.RandomState(0)

@@ -182,8 +182,9 @@ def append_LARS(params_grads, learning_rate, weight_decay):
     multiplier picks it up.  ``learning_rate`` may be a Variable or a
     plain float (materialized as a constant, like the reference's
     scalar operator overloads)."""
+    from ..framework import Variable
     helper = LayerHelper("lars")
-    if not hasattr(learning_rate, "dtype"):
+    if not isinstance(learning_rate, Variable):
         learning_rate = _scalar(helper, float(learning_rate), None)
 
     def _balanced_weight(param_norm, grad_norm):

@@ -10,7 +10,7 @@ import numpy as np
 
 __all__ = [
     "MetricBase", "CompositeMetric", "Precision", "Recall", "Accuracy",
-    "EditDistance", "Auc", "DetectionMAP",
+    "EditDistance", "Auc", "DetectionMAP", "ChunkEvaluator",
 ]
 
 
@@ -236,3 +236,36 @@ class DetectionMAP(MetricBase):
                     prev_r = r
             aps.append(float(ap))
         return float(np.mean(aps)) if aps else 0.0
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulate chunk_eval counters across mini-batches; eval returns
+    (precision, recall, f1) (reference metrics.py:355)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        for label, v in (("num_infer_chunks", num_infer_chunks),
+                         ("num_label_chunks", num_label_chunks),
+                         ("num_correct_chunks", num_correct_chunks)):
+            if not isinstance(v, (int, float, np.ndarray, np.generic)):
+                raise ValueError(
+                    "%s must be a number or numpy ndarray" % label)
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(
+            np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = float(self.num_correct_chunks) / \
+            self.num_infer_chunks if self.num_infer_chunks else 0.0
+        recall = float(self.num_correct_chunks) / \
+            self.num_label_chunks if self.num_label_chunks else 0.0
+        f1 = 2 * precision * recall / (precision + recall) \
+            if self.num_correct_chunks else 0.0
+        return precision, recall, f1

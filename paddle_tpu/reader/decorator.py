@@ -12,6 +12,7 @@ import random
 import threading
 
 __all__ = [
+    "ComposeNotAligned",
     "map_readers",
     "buffered",
     "compose",

@@ -289,13 +289,44 @@ def test_preprocessor_output_count_mismatch_is_loud(tmp_path):
             pre.outputs(xi)          # 1 output for a 2-slot reader
 
 
+def test_chunk_evaluator_and_init_on_cpu():
+    m = fluid.metrics.ChunkEvaluator()
+    m.update(10, 8, 6)
+    m.update(np.array([5]), 7, 4)
+    p, r, f1 = m.eval()
+    assert abs(p - 10 / 15) < 1e-9 and abs(r - 10 / 15) < 1e-9
+    assert abs(f1 - 2 * p * r / (p + r)) < 1e-9
+    with pytest.raises(ValueError):
+        m.update("nan", 1, 1)
+
+    assert not fluid.initializer.force_init_on_cpu()
+    with fluid.initializer.init_on_cpu():
+        assert fluid.initializer.force_init_on_cpu()
+    assert not fluid.initializer.force_init_on_cpu()
+
+    from paddle_tpu.reader import ComposeNotAligned
+    import paddle_tpu.reader as rd
+    r1 = lambda: iter([1, 2, 3])        # noqa: E731
+    r2 = lambda: iter([4, 5])           # noqa: E731
+    with pytest.raises(ComposeNotAligned):
+        list(rd.compose(r1, r2)())
+
+
 def test_random_data_generator_and_load(tmp_path):
-    rdg = L.random_data_generator(-1.0, 1.0, shapes=[[-1, 4]],
-                                  lod_levels=[0])
+    # reference contract: per-sample shapes, no batch dim
+    rdg = L.random_data_generator(-1.0, 1.0, shapes=[[4], [2, 3]],
+                                  lod_levels=[0, 0])
+    xv, yv = L.read_file(rdg)
+    assert len(xv.shape) == 2 and len(yv.shape) == 3   # batch prepended
     b = L.batch(rdg, 6)
     feed = next(iter(b))
-    arr = list(feed.values())[0]
-    assert arr.shape == (6, 4) and (-1 <= arr).all() and (arr <= 1).all()
+    assert feed[xv.name].shape == (6, 4)
+    assert feed[yv.name].shape == (6, 2, 3)
+    arr = feed[xv.name]
+    assert (-1 <= arr).all() and (arr <= 1).all()
+    with pytest.raises(ValueError):
+        L.random_data_generator(0.0, 1.0, shapes=[[-1, 4]],
+                                lod_levels=[0])
 
     w = np.arange(6, dtype="float32").reshape(2, 3)
     np.save(str(tmp_path / "w.npy"), w)

@@ -1,0 +1,154 @@
+"""High-level-API book chapters (reference
+tests/book/high-level-api/*): the same model flows driven end-to-end
+through contrib.Trainer + Inferencer — fit_a_line (linear regression),
+recognize_digits (conv net), word2vec (n-gram embedding) — on synthetic
+data with real train/save/infer round-trips."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import Inferencer, Trainer
+
+L = fluid.layers
+
+
+def _losses_collector(losses):
+    def handler(event):
+        if hasattr(event, "metrics"):
+            losses.append(float(np.asarray(event.metrics[0]).reshape(())))
+    return handler
+
+
+def test_fit_a_line_highlevel(tmp_path):
+    """Linear regression learns y = Xw + b (the fit_a_line chapter)."""
+    W = np.array([[1.5], [-2.0], [0.5], [3.0]], "float32")
+
+    def net():
+        x = L.data("x", shape=[4])
+        return L.fc(x, size=1, act=None)
+
+    def train_func():
+        y_pred = net()
+        y = L.data("y", shape=[1])
+        return L.mean(L.square_error_cost(y_pred, y))
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(128, 4).astype("float32")
+    ys = xs @ W + 0.7
+    data = list(zip(xs, ys))
+
+    def reader():
+        for i in range(0, len(data), 16):
+            yield data[i:i + 16]
+
+    losses = []
+    trainer = Trainer(train_func=train_func,
+                      optimizer_func=lambda: fluid.optimizer.SGD(0.3),
+                      place=fluid.CPUPlace())
+    trainer.train(num_epochs=30, event_handler=_losses_collector(losses),
+                  reader=reader, feed_order=["x", "y"])
+    assert losses[-1] < 0.01, losses[-1]
+
+    param_dir = str(tmp_path / "fit_a_line")
+    trainer.save_params(param_dir)
+    inferencer = Inferencer(infer_func=net, param_path=param_dir,
+                            place=fluid.CPUPlace())
+    probe = rng.rand(8, 4).astype("float32")
+    (pred,) = inferencer.infer({"x": probe})
+    np.testing.assert_allclose(pred, probe @ W + 0.7, atol=0.25)
+
+
+def test_recognize_digits_conv_highlevel(tmp_path):
+    """simple_img_conv_pool stack from the recognize_digits chapter on a
+    synthetic separable image task."""
+    def net():
+        img = L.data("img", shape=[1, 12, 12])
+        conv_pool = fluid.nets.simple_img_conv_pool(
+            input=img, filter_size=3, num_filters=4, pool_size=2,
+            pool_stride=2, act="relu")
+        return L.fc(conv_pool, size=3, act="softmax")
+
+    def train_func():
+        pred = net()
+        label = L.data("label", shape=[1], dtype="int64")
+        return L.mean(L.cross_entropy(pred, label))
+
+    rng = np.random.RandomState(1)
+    data = []
+    for _ in range(96):
+        cls = rng.randint(0, 3)
+        img = rng.rand(1, 12, 12).astype("float32") * 0.1
+        img[0, cls * 4:(cls + 1) * 4, :] += 1.0   # bright band per class
+        data.append((img, cls))
+
+    def reader():
+        for i in range(0, len(data), 16):
+            yield data[i:i + 16]
+
+    losses = []
+    trainer = Trainer(train_func=train_func,
+                      optimizer_func=lambda: fluid.optimizer.Adam(1e-2),
+                      place=fluid.CPUPlace())
+    trainer.train(num_epochs=8, event_handler=_losses_collector(losses),
+                  reader=reader, feed_order=["img", "label"])
+    assert losses[-1] < 0.2, losses[-1]
+
+    param_dir = str(tmp_path / "digits")
+    trainer.save_params(param_dir)
+    inferencer = Inferencer(infer_func=net, param_path=param_dir,
+                            place=fluid.CPUPlace())
+    imgs = np.stack([d[0] for d in data[:12]])
+    (probs,) = inferencer.infer({"img": imgs})
+    acc = (probs.argmax(1) == np.array([d[1] for d in data[:12]])).mean()
+    assert acc > 0.8, acc
+
+
+def test_word2vec_ngram_highlevel(tmp_path):
+    """N-gram next-word model (word2vec chapter): four embedded context
+    words -> softmax over the vocab; learns a deterministic sequence."""
+    V, EMB, N = 12, 8, 4
+
+    def net():
+        words = [L.data("w%d" % i, shape=[1], dtype="int64")
+                 for i in range(N)]
+        embs = [L.embedding(w, size=[V, EMB],
+                            param_attr=fluid.ParamAttr(name="emb"))
+                for w in words]
+        embs = [L.reshape(e, shape=[-1, EMB]) for e in embs]
+        hidden = L.fc(L.concat(embs, axis=1), size=32, act="relu")
+        return L.fc(hidden, size=V, act="softmax")
+
+    def train_func():
+        pred = net()
+        nxt = L.data("next", shape=[1], dtype="int64")
+        return L.mean(L.cross_entropy(pred, nxt))
+
+    # deterministic cyclic sequence: next = (sum of context) % V
+    rng = np.random.RandomState(2)
+    data = []
+    for _ in range(160):
+        ctx = rng.randint(0, V, size=N)
+        data.append(tuple(np.array([c], "int64") for c in ctx)
+                    + (np.array([ctx.sum() % V], "int64"),))
+
+    def reader():
+        for i in range(0, len(data), 16):
+            yield data[i:i + 16]
+
+    losses = []
+    trainer = Trainer(train_func=train_func,
+                      optimizer_func=lambda: fluid.optimizer.Adam(5e-3),
+                      place=fluid.CPUPlace())
+    feed_order = ["w%d" % i for i in range(N)] + ["next"]
+    trainer.train(num_epochs=30, event_handler=_losses_collector(losses),
+                  reader=reader, feed_order=feed_order)
+    assert losses[-1] < losses[0] * 0.7
+
+    param_dir = str(tmp_path / "w2v")
+    trainer.save_params(param_dir)
+    inferencer = Inferencer(infer_func=net, param_path=param_dir,
+                            place=fluid.CPUPlace())
+    feed = {"w%d" % i: np.full((6, 1), i, "int64") for i in range(N)}
+    (probs,) = inferencer.infer(feed)
+    assert probs.shape == (6, V)
+    np.testing.assert_allclose(probs.sum(axis=1), np.ones(6), atol=1e-5)

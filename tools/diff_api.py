@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Public-API drift gate CLI (reference ``tools/diff_api.py``): diff the
+live signature dump against the checked-in golden file.  The pytest gate
+(`tests/test_api_signatures.py`) runs the same comparison in CI; this
+script is the developer-facing form:
+
+    python tools/print_signatures.py > /tmp/api.txt
+    python tools/diff_api.py tools/api_signatures.txt /tmp/api.txt
+"""
+
+import difflib
+import sys
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(__doc__)
+        return 1
+    with open(sys.argv[1]) as f:
+        origin = f.read().splitlines(keepends=True)
+    with open(sys.argv[2]) as f:
+        new = f.read().splitlines(keepends=True)
+    diffs = list(difflib.unified_diff(
+        origin, new, fromfile=sys.argv[1], tofile=sys.argv[2]))
+    if not diffs:
+        return 0
+    sys.stdout.writelines(diffs)
+    print(
+        "\nAPI drift detected. If intentional, regenerate the golden "
+        "file:\n  python tools/print_signatures.py > %s" % sys.argv[1])
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

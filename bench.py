@@ -53,8 +53,9 @@ FLOPS_PER_ITEM = {
 }
 
 # min-of-windows is the estimator; the shared tunneled chip's noise is
-# +/-2% between invocations, so more windows tightens the min's variance
-N_WINDOWS = 5
+# +/-2% between invocations (and load is bursty), so more windows
+# tighten the min's variance — 7 spans ~70s of chip time per rung
+N_WINDOWS = 7
 
 
 class _PassthroughFeeder:

@@ -126,11 +126,10 @@ def scaled_dot_product_attention(
     scaled_q = layers.scale(x=q, scale=key_dim_per_head ** -0.5)
     product = layers.matmul(x=scaled_q, y=k, transpose_y=True)
 
-    weights = layers.reshape(
-        x=layers.reshape(x=product, shape=[-1, product.shape[-1]],
-                         act="softmax"),
-        shape=list(product.shape),
-    )
+    # softmax over the key axis directly: the reference's flatten-
+    # softmax-unflatten dance needs static shapes; rank-4 softmax
+    # doesn't (and XLA emits the same kernel)
+    weights = layers.softmax(product)
     if dropout_rate:
         weights = layers.dropout(weights, dropout_prob=dropout_rate,
                                  is_test=False)

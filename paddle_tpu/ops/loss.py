@@ -258,3 +258,60 @@ def _mhl_compute(ins, attrs, ctx, op_index):
 
 register_op("modified_huber_loss", ["X", "Y"], ["IntermediateVal", "Out"],
             infer=_mhl_infer, compute=_mhl_compute, no_grad_inputs=("Y",))
+
+
+# -- lambda_cost: LambdaRank listwise cost (v1 legacy LambdaCost layer,
+# reference legacy/gserver/layers/CostLayer.cpp LambdaCost) --------------
+
+def _lambda_cost_infer(op, block):
+    x = in_var(op, block, "Score")
+    set_output(op, block, "Out", (x.shape[0], 1), x.dtype)
+
+
+def _lambda_cost_compute(ins, attrs, ctx, op_index):
+    """Per-list LambdaRank: for each document pair (i, j) with
+    rel_i > rel_j, loss += |deltaNDCG_ij| * log(1 + exp(-(s_i - s_j))).
+    Scores/relevances are padded [B, T, 1]; Length masks the pad.
+    deltaNDCG swaps positions i,j in the DCG of the model's ranking,
+    normalized by the ideal DCG over the top ``ndcg_num``."""
+    score = ins["Score"][0].reshape(ins["Score"][0].shape[0], -1)
+    rel = ins["Rel"][0].reshape(score.shape).astype(score.dtype)
+    length = ins.get("Length", [None])[0]
+    b, t = score.shape
+    ndcg_num = int(attrs.get("ndcg_num", 5))
+    pos = jnp.arange(t)
+    valid = (jnp.ones((b, t), bool) if length is None
+             else pos[None, :] < length.reshape(b, 1))
+    neg_inf = jnp.asarray(-1e9, score.dtype)
+    s = jnp.where(valid, score, neg_inf)
+    r = jnp.where(valid, rel, 0.0)
+
+    # rank of each doc under the model scores (0 = best)
+    order = jnp.argsort(-s, axis=1)
+    rank = jnp.argsort(order, axis=1)
+    disc = 1.0 / jnp.log2(2.0 + rank.astype(score.dtype))   # [B, T]
+    gain = (2.0 ** r - 1.0)
+    # ideal DCG over the top ndcg_num of the TRUE relevances
+    r_sorted = -jnp.sort(-r, axis=1)
+    ideal_disc = 1.0 / jnp.log2(2.0 + jnp.arange(t, dtype=score.dtype))
+    topk_mask = (jnp.arange(t) < ndcg_num).astype(score.dtype)
+    idcg = jnp.sum((2.0 ** r_sorted - 1.0) * ideal_disc * topk_mask,
+                   axis=1, keepdims=True)
+    idcg = jnp.maximum(idcg, 1e-8)
+
+    # |deltaNDCG| of swapping i and j = |g_i - g_j| * |d_i - d_j| / idcg
+    dg = jnp.abs(gain[:, :, None] - gain[:, None, :])
+    dd = jnp.abs(disc[:, :, None] - disc[:, None, :])
+    delta = dg * dd / idcg[:, :, None]
+
+    diff = score[:, :, None] - score[:, None, :]
+    pair_loss = jnp.log1p(jnp.exp(-jnp.clip(diff, -30.0, 30.0)))
+    better = (rel[:, :, None] > rel[:, None, :]) & \
+        valid[:, :, None] & valid[:, None, :]
+    out = jnp.sum(jnp.where(better, delta * pair_loss, 0.0), axis=(1, 2))
+    return {"Out": out.reshape(b, 1)}
+
+
+register_op("lambda_cost", ["Score", "Rel", "Length"], ["Out"],
+            infer=_lambda_cost_infer, compute=_lambda_cost_compute,
+            no_grad_inputs=("Rel", "Length"))

@@ -608,3 +608,36 @@ def _fill_compute(ins, attrs, ctx, op_index):
 
 register_op("fill", [], ["Out"], infer=_fill_infer, compute=_fill_compute,
             grad=None)
+
+
+# -- scale_sub_region (v1 legacy ScaleSubRegionLayer): scale a per-sample
+# [c0..c1, h0..h1, w0..w1] block of an NCHW tensor by ``value`` ----------
+
+def _scale_sub_region_infer(op, block):
+    x = in_var(op, block, "X")
+    set_output(op, block, "Out", x.shape, x.dtype)
+
+
+def _scale_sub_region_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]                       # [B, C, H, W]
+    idx = ins["Indices"][0]               # [B, 6] 1-based inclusive
+    value = attrs.get("value", 1.0)
+    b, c, h, w = x.shape
+    ci = jnp.arange(c).reshape(1, c, 1, 1)
+    hi = jnp.arange(h).reshape(1, 1, h, 1)
+    wi = jnp.arange(w).reshape(1, 1, 1, w)
+    lo = (idx[:, 0::2] - 1).astype(jnp.int32)   # [B, 3] c0,h0,w0 0-based
+    hi_ = idx[:, 1::2].astype(jnp.int32)        # [B, 3] exclusive ends
+    mask = ((ci >= lo[:, 0].reshape(b, 1, 1, 1)) &
+            (ci < hi_[:, 0].reshape(b, 1, 1, 1)) &
+            (hi >= lo[:, 1].reshape(b, 1, 1, 1)) &
+            (hi < hi_[:, 1].reshape(b, 1, 1, 1)) &
+            (wi >= lo[:, 2].reshape(b, 1, 1, 1)) &
+            (wi < hi_[:, 2].reshape(b, 1, 1, 1)))
+    return {"Out": jnp.where(mask, x * value, x)}
+
+
+register_op("scale_sub_region", ["X", "Indices"], ["Out"],
+            infer=_scale_sub_region_infer,
+            compute=_scale_sub_region_compute,
+            no_grad_inputs=("Indices",))

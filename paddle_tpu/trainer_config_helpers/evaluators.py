@@ -15,6 +15,10 @@ __all__ = [
     "value_printer_evaluator", "sum_evaluator", "column_sum_evaluator",
     "chunk_evaluator", "ctc_error_evaluator",
     "precision_recall_evaluator",
+    "evaluator_base", "pnpair_evaluator", "detection_map_evaluator",
+    "gradient_printer_evaluator", "maxid_printer_evaluator",
+    "maxframe_printer_evaluator", "seqtext_printer_evaluator",
+    "classification_error_printer_evaluator",
 ]
 
 classification_error_evaluator = v2_eval.classification_error
@@ -96,3 +100,80 @@ def precision_recall_evaluator(input, label, positive_label=None,
         return fl.accuracy(input=cfg.unwrap(input), label=cfg.unwrap(label))
 
     return _register(name, "precision_recall_evaluator", build)
+
+
+# ---- parity tail: the remaining reference evaluators.py names -------------
+
+def evaluator_base(input, type=None, label=None, weight=None, name=None,
+                   **kwargs):
+    """Low-level evaluator registration (reference evaluators.py
+    evaluator_base): registers the raw input as a reported value; typed
+    behavior lives in the specific evaluators above."""
+    from .. import layers as fl
+    return _register(name, "evaluator",
+                     lambda: fl.reduce_sum(cfg.unwrap(input)))
+
+
+def pnpair_evaluator(input, label, query_id, weight=None, name=None):
+    """Positive-negative pair ratio for ranking (reference
+    evaluators.py pnpair_evaluator over positive_negative_pair_op)."""
+    from ..layer_helper import LayerHelper
+
+    def build():
+        helper = LayerHelper("pnpair")
+        pos = helper.create_variable_for_type_inference("float32")
+        neg = helper.create_variable_for_type_inference("float32")
+        ratio = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="positive_negative_pair",
+            inputs={"Score": [cfg.unwrap(input)],
+                    "Label": [cfg.unwrap(label)],
+                    "QueryID": [cfg.unwrap(query_id)]},
+            outputs={"PositivePair": [pos], "NegativePair": [neg],
+                     "NeutralPair": [ratio]})
+        return pos
+    return _register(name, "pnpair_evaluator", build)
+
+
+def detection_map_evaluator(input, label, class_num,
+                            overlap_threshold=0.5, background_id=0,
+                            evaluate_difficult=False, ap_type="11point",
+                            name=None, **kwargs):
+    """Detection mAP (reference evaluators.py detection_map_evaluator);
+    delegates to the fluid detection_map layer (which wires the count
+    companions the op needs).  ``class_num`` is required — the op sizes
+    its per-class accumulators with it."""
+    from .. import layers as fl
+
+    def build():
+        return fl.detection_map(
+            cfg.unwrap(input), cfg.unwrap(label), class_num=class_num,
+            background_label=int(background_id),
+            overlap_threshold=float(overlap_threshold),
+            evaluate_difficult=bool(evaluate_difficult),
+            ap_version="11point" if ap_type == "11point" else "integral")
+    return _register(name, "detection_map_evaluator", build)
+
+
+def _printer(default_prefix):
+    """The printer evaluators (reference evaluators.py *_printer_*):
+    their capability — dump values during evaluation — maps onto the
+    in-graph Print op feeding a value_printer registration."""
+    def make(input, name=None, **kwargs):
+        from .. import layers as fl
+
+        def build():
+            vars_ = input if isinstance(input, (list, tuple)) else [input]
+            outs = [fl.Print(cfg.unwrap(v), message=default_prefix)
+                    for v in vars_]
+            return outs[0]
+        return _register(name, default_prefix, build)
+    return make
+
+
+gradient_printer_evaluator = _printer("gradient_printer")
+maxid_printer_evaluator = _printer("maxid_printer")
+maxframe_printer_evaluator = _printer("maxframe_printer")
+seqtext_printer_evaluator = _printer("seqtext_printer")
+classification_error_printer_evaluator = _printer(
+    "classification_error_printer")

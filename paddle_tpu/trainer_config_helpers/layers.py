@@ -38,6 +38,79 @@ __all__ = [
     "smooth_l1_cost", "sum_cost", "nce_layer", "hsigmoid", "crf_layer",
     "crf_decoding_layer", "ctc_layer", "warp_ctc_layer",
     "memory", "recurrent_group", "beam_search", "get_output_layer",
+    "LayerType",
+    "AggregateLevel",
+    "ExpandLevel",
+    "layer_support",
+    "StaticInput",
+    "BaseGeneratedInput",
+    "GeneratedInput",
+    "SubsequenceInput",
+    "BeamInput",
+    "trans_full_matrix_projection",
+    "scaling_projection",
+    "slice_projection",
+    "context_projection",
+    "dotmul_operator",
+    "conv_operator",
+    "conv_projection",
+    "clip_layer",
+    "maxout_layer",
+    "prelu_layer",
+    "pad_layer",
+    "crop_layer",
+    "rotate_layer",
+    "switch_order_layer",
+    "resize_layer",
+    "repeat_layer",
+    "upsample_layer",
+    "bilinear_interp_layer",
+    "interpolation_layer",
+    "linear_comb_layer",
+    "convex_comb_layer",
+    "out_prod_layer",
+    "tensor_layer",
+    "scale_shift_layer",
+    "scale_sub_region_layer",
+    "sum_to_one_norm_layer",
+    "row_l2_norm_layer",
+    "l2_distance_layer",
+    "multiplex_layer",
+    "eos_layer",
+    "sampling_id_layer",
+    "print_layer",
+    "printer_layer",
+    "img_cmrnorm_layer",
+    "cross_channel_norm_layer",
+    "spp_layer",
+    "img_conv3d_layer",
+    "img_pool3d_layer",
+    "block_expand_layer",
+    "priorbox_layer",
+    "detection_output_layer",
+    "multibox_loss_layer",
+    "roi_pool_layer",
+    "seq_concat_layer",
+    "seq_reshape_layer",
+    "seq_slice_layer",
+    "sub_seq_layer",
+    "sub_nested_seq_layer",
+    "kmax_seq_score_layer",
+    "recurrent_layer",
+    "lstm_step_layer",
+    "gru_step_layer",
+    "gru_step_naive_layer",
+    "gated_unit_layer",
+    "selective_fc_layer",
+    "factorization_machine",
+    "rank_cost",
+    "huber_regression_cost",
+    "huber_classification_cost",
+    "cross_entropy_with_selfnorm",
+    "lambda_cost",
+    "cross_entropy_over_beam",
+    "conv_shift_layer",
+    "row_conv_layer",
 ]
 
 LayerOutput = cfg.Layer
@@ -208,7 +281,8 @@ class MixedLayerType(object):
                 # identity op carrying the configured name into the
                 # program, so lookups by the v1 layer name resolve
                 out = fl.scale(out, scale=1.0, name=self._name)
-        parents = [p.input for p in self.projections]
+        parents = [p.input for p in self.projections
+                   if getattr(p, 'input', None) is not None]
         self.finalized = _apply_extra(
             cfg.Layer(out, v2_dim=self.size or None, parents=parents),
             getattr(self, "_layer_attr", None))
@@ -478,3 +552,1020 @@ def beam_search(*args, **kwargs):
         "v1 beam_search generation is served by the fluid-parity "
         "layers.beam_search / beam_search_decode ops (ops/ beam search "
         "family); see tests/test_rnn_encoder_decoder.py")
+
+
+# ===========================================================================
+# parity tail: the remaining reference layers.py names.  Same conventions
+# as above — build fluid-parity ops under cfg.build(), wrap in cfg.Layer.
+# ===========================================================================
+
+# ---- markers / enums (reference layers.py LayerType, AggregateLevel,
+# ExpandLevel; config introspection + recurrent_group input markers) -------
+
+class LayerType(object):
+    """Layer-type name constants (reference layers.py LayerType).  On
+    this stack layer identity is the op graph, so these are tags for
+    config-introspection parity."""
+    DATA = "data"
+    FC_LAYER = "fc"
+    MIXED_LAYER = "mixed"
+    COST = "cost"
+
+    @staticmethod
+    def is_layer_type(type_name):
+        return isinstance(type_name, str)
+
+
+class AggregateLevel(object):
+    """reference layers.py AggregateLevel (sequence pooling levels).
+    One LoD level exists here, so both levels name the same axis."""
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    EACH_SEQUENCE = "seq"
+    EACH_TIMESTEP = "non-seq"
+
+
+class ExpandLevel(object):
+    """reference layers.py ExpandLevel (expand_layer targets)."""
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = AggregateLevel.TO_NO_SEQUENCE
+
+
+def layer_support(*attrs):
+    """reference layers.py layer_support decorator: declares which extra
+    attributes a layer honors.  Attribute handling here is explicit
+    (_apply_extra), so this is an identity decorator kept for parity."""
+    def decorator(fn):
+        return fn
+    return decorator
+
+
+class StaticInput(object):
+    """Unstepped input marker for the v1 recurrent_group (reference
+    layers.py StaticInput).  Constructible for config parity; consumed
+    only by recurrent_group, which is a documented design boundary."""
+
+    def __init__(self, input, is_seq=False, size=None):
+        self.input, self.is_seq, self.size = input, is_seq, size
+
+
+class BaseGeneratedInput(object):
+    def __init__(self):
+        self.bos_id = None
+        self.eos_id = None
+
+
+class GeneratedInput(BaseGeneratedInput):
+    """Generation-mode input marker (reference layers.py
+    GeneratedInput); generation on this stack is layers.beam_search."""
+
+    def __init__(self, size, embedding_name, embedding_size, name=None):
+        super().__init__()
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+        self.name = name
+
+
+class SubsequenceInput(object):
+    """Nested-sequence step marker (reference layers.py
+    SubsequenceInput): multi-level LoD is a documented boundary of the
+    padded+@LEN design (SURVEY §5)."""
+
+    def __init__(self, input):
+        self.input = input
+
+
+class BeamInput(object):
+    """cross_entropy_over_beam input triple (reference layers.py
+    BeamInput)."""
+
+    def __init__(self, candidate_scores, selected_candidates, gold):
+        self.candidate_scores = candidate_scores
+        self.selected_candidates = selected_candidates
+        self.gold = gold
+
+
+# ---- additional projections / operators for mixed_layer -------------------
+
+class trans_full_matrix_projection(BaseProjection):
+    """input x W^T (reference layers.py trans_full_matrix_projection:
+    the weight is stored transposed, useful for weight tying)."""
+
+    def __init__(self, input, size=0, param_attr=None):
+        self.input, self.size, self.param_attr = input, size, param_attr
+
+    def build(self, size):
+        size = self.size or size
+        var = self.input.var
+        helper = LayerHelper("trans_fmp", param_attr=self.param_attr)
+        w = helper.create_parameter(attr=helper.param_attr,
+                                    shape=[size, int(var.shape[-1])],
+                                    dtype=var.dtype)
+        return fl.matmul(var, w, transpose_y=True)
+
+
+class scaling_projection(BaseProjection):
+    """A single learned scalar times the input (reference layers.py
+    scaling_projection)."""
+
+    def __init__(self, input, param_attr=None):
+        self.input, self.param_attr = input, param_attr
+
+    def build(self, size):
+        var = self.input.var
+        helper = LayerHelper("scaling_projection",
+                             param_attr=self.param_attr)
+        w = helper.create_parameter(attr=helper.param_attr, shape=[1],
+                                    dtype=var.dtype)
+        return fl.elementwise_mul(var, w)
+
+
+class slice_projection(BaseProjection):
+    """Concat of column slices [(start, end), ...] (reference layers.py
+    slice_projection)."""
+
+    def __init__(self, input, slices):
+        for s in slices:
+            if len(s) != 2 or s[0] >= s[1]:
+                raise ValueError("invalid slice %r" % (s,))
+        self.input, self.slices = input, slices
+
+    def build(self, size):
+        var = self.input.var
+        ax = len(var.shape) - 1
+        parts = [fl.slice(var, axes=[ax], starts=[s], ends=[e])
+                 for s, e in self.slices]
+        return parts[0] if len(parts) == 1 else fl.concat(parts, axis=ax)
+
+
+class context_projection(BaseProjection):
+    """Concat a sliding window of neighboring timesteps (reference
+    layers.py context_projection): for context_len L starting at
+    context_start, each timestep becomes the concat of L neighbors
+    (zero-padded at the edges).  Padded [B, T, D] shifts via pad+slice."""
+
+    def __init__(self, input, context_len, context_start=None,
+                 padding_attr=False):
+        self.input = input
+        self.context_len = context_len
+        self.context_start = context_start if context_start is not None \
+            else -(context_len // 2)
+        if padding_attr not in (False, None):
+            raise NotImplementedError(
+                "trainable context padding (reference context_projection "
+                "padding_attr) is out of scope; zeros pad the edges")
+
+    def build(self, size):
+        var = self.input.var          # [B, T, D]
+        outs = []
+        for k in range(self.context_len):
+            off = self.context_start + k
+            if off == 0:
+                outs.append(var)
+                continue
+            if off > 0:     # look ahead: drop first rows, pad at end
+                padded = fl.pad(var, paddings=[0, 0, 0, off, 0, 0])
+                shifted = fl.slice(padded, axes=[1], starts=[off],
+                                   ends=[int(1e9)])
+            else:           # look back: pad at front, drop the tail
+                padded = fl.pad(var, paddings=[0, 0, -off, 0, 0, 0])
+                # negative end: stop |off| before the padded end -> T
+                shifted = fl.slice(padded, axes=[1], starts=[0],
+                                   ends=[off])
+            outs.append(shifted)
+        return fl.concat(outs, axis=2)
+
+
+class dotmul_operator(BaseProjection):
+    """Elementwise a*b*scale joining two mixed inputs (reference
+    layers.py dotmul_operator)."""
+
+    def __init__(self, a=None, b=None, scale=1.0):
+        self.a, self.b, self.scale = a, b, scale
+        self.input = a
+
+    def build(self, size):
+        out = fl.elementwise_mul(self.a.var, self.b.var)
+        if self.scale != 1.0:
+            out = fl.scale(out, scale=float(self.scale))
+        return out
+
+
+class conv_operator(BaseProjection):
+    """Conv joining an image input and a filter-shaped input is the
+    reference's exotic use; the common conv-in-mixed form (this one)
+    convolves the image with a LEARNED filter (reference layers.py
+    conv_operator/conv_projection share ConvOperator)."""
+
+    def __init__(self, img, filter, filter_size, num_filters,
+                 num_channels=None, stride=1, padding=0,
+                 filter_size_y=None, stride_y=None, padding_y=None):
+        if filter is not None:
+            raise NotImplementedError(
+                "conv_operator with a dynamic filter input maps to no "
+                "XLA-friendly op; use conv_projection (learned filter)")
+        self.img = img
+        self.filter_size, self.num_filters = filter_size, num_filters
+        self.num_channels, self.stride, self.padding = \
+            num_channels, stride, padding
+
+    def build(self, size):
+        img, _c = v2_layer._as_image(self.img, self.num_channels)
+        out = fl.conv2d(img, num_filters=self.num_filters,
+                        filter_size=self.filter_size, stride=self.stride,
+                        padding=self.padding, bias_attr=False)
+        return fl.reshape(out, shape=[0, -1])
+
+
+class conv_projection(conv_operator):
+    """Learned-filter conv projection (reference layers.py
+    conv_projection)."""
+
+    def __init__(self, input, filter_size, num_filters, num_channels=None,
+                 stride=1, padding=0, param_attr=None, **kwargs):
+        super().__init__(input, None, filter_size, num_filters,
+                         num_channels, stride, padding)
+
+
+# ---- elementwise / geometric layers ---------------------------------------
+
+def _wrap1(layer, var, dim=None):
+    return cfg.Layer(var, v2_dim=dim, parents=[layer])
+
+
+def clip_layer(input, min, max, name=None, layer_attr=None):
+    with cfg.build():
+        var = fl.clip(input.var, min=float(min), max=float(max))
+    return _apply_extra(_wrap1(input, var, input.v2_dim), layer_attr)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None,
+                 layer_attr=None):
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        var = fl.maxout(img, groups=groups)
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def prelu_layer(input, name=None, partial_sum=1, param_attr=None,
+                layer_attr=None):
+    with cfg.build():
+        mode = "all" if partial_sum != 1 else "element"
+        var = fl.prelu(input.var, mode=mode, param_attr=param_attr)
+    return _apply_extra(_wrap1(input, var, input.v2_dim), layer_attr)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, num_channels=None,
+              name=None, layer_attr=None):
+    """Zero-pad channel/height/width of an NCHW image (reference
+    layers.py pad_layer)."""
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        pads = [0, 0] + list(pad_c or [0, 0]) + list(pad_h or [0, 0]) + \
+            list(pad_w or [0, 0])
+        var = fl.pad(img, paddings=pads)
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def crop_layer(input, offset, axis=2, shape=None, name=None,
+               layer_attr=None):
+    with cfg.build():
+        ref = input[1].var if isinstance(input, (list, tuple)) else None
+        x = input[0].var if isinstance(input, (list, tuple)) else input.var
+        full_off = [0] * axis + list(offset)
+        var = fl.crop(x, shape=shape or ref, offsets=full_off)
+    src = input[0] if isinstance(input, (list, tuple)) else input
+    return _apply_extra(_wrap1(src, var), layer_attr)
+
+
+def rotate_layer(input, height, width, name=None, layer_attr=None):
+    """Rotate each HxW map 90 degrees counter-clockwise (reference
+    layers.py rotate_layer)."""
+    with cfg.build():
+        x = fl.reshape(input.var, shape=[0, -1, height, width])
+        var = fl.reshape(fl.reverse(fl.transpose(x, perm=[0, 1, 3, 2]),
+                                    axis=[2]), shape=[0, -1])
+    return _apply_extra(_wrap1(input, var, input.v2_dim), layer_attr)
+
+
+def switch_order_layer(input, name=None, reshape_order=None,
+                       layer_attr=None):
+    with cfg.build():
+        var = fl.transpose(input.var, perm=list(reshape_order))
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def resize_layer(input, size, name=None, layer_attr=None):
+    with cfg.build():
+        var = fl.reshape(input.var, shape=[-1, int(size)])
+    return _apply_extra(_wrap1(input, var, int(size)), layer_attr)
+
+
+def repeat_layer(input, num_repeats, as_row_vector=True, act=None,
+                 name=None, layer_attr=None):
+    """Tile features num_repeats times (reference layers.py
+    repeat_layer): row-vector mode yields [a b a b], column mode
+    [a a b b]."""
+    with cfg.build():
+        var = input.var
+        if as_row_vector:
+            var = fl.reshape(
+                fl.expand(fl.unsqueeze(var, axes=[1]),
+                          expand_times=[1, num_repeats, 1]),
+                shape=[0, -1])
+        else:
+            var = fl.reshape(
+                fl.expand(fl.unsqueeze(var, axes=[2]),
+                          expand_times=[1, 1, num_repeats]),
+                shape=[0, -1])
+        if act is not None:
+            var = getattr(fl, act_name(act))(var)
+    dim = input.v2_dim * num_repeats if input.v2_dim else None
+    return _apply_extra(_wrap1(input, var, dim), layer_attr)
+
+
+def upsample_layer(input, scale=2, num_channels=None, upsample_size=None,
+                   name=None, layer_attr=None, **kwargs):
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        if upsample_size is not None:
+            var = fl.image_resize(img, out_shape=upsample_size,
+                                  resample="NEAREST")
+        else:
+            var = fl.image_resize(img, scale=scale, resample="NEAREST")
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def bilinear_interp_layer(input, out_size_x=None, out_size_y=None,
+                          num_channels=None, name=None, layer_attr=None):
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        var = fl.resize_bilinear(img, out_shape=[out_size_y, out_size_x])
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def interpolation_layer(input, weight, name=None, layer_attr=None):
+    """w*x1 + (1-w)*x2 with per-sample scalar w (reference layers.py
+    interpolation_layer; input = [x1, x2])."""
+    x1, x2 = input
+    with cfg.build():
+        w = weight.var
+        one = fl.fill_constant(shape=[1], dtype=w.dtype, value=1.0)
+        var = fl.elementwise_add(
+            fl.elementwise_mul(x1.var, w),
+            fl.elementwise_mul(x2.var, fl.elementwise_sub(one, w)))
+    return _apply_extra(cfg.Layer(var, v2_dim=x1.v2_dim,
+                                  parents=[x1, x2, weight]), layer_attr)
+
+
+def linear_comb_layer(weights, vectors, size=None, name=None,
+                      layer_attr=None):
+    """Per-sample weighted sum of M size-d vectors: weights [B, M],
+    vectors [B, M*d] -> [B, d] (reference layers.py linear_comb_layer)."""
+    with cfg.build():
+        m = int(weights.var.shape[-1])
+        v3 = fl.reshape(vectors.var, shape=[0, m, -1])
+        w3 = fl.unsqueeze(weights.var, axes=[1])          # [B, 1, M]
+        var = fl.reshape(fl.matmul(w3, v3), shape=[0, -1])
+    return _apply_extra(cfg.Layer(var, v2_dim=size,
+                                  parents=[weights, vectors]), layer_attr)
+
+
+convex_comb_layer = linear_comb_layer
+
+
+def out_prod_layer(input1, input2, name=None, layer_attr=None):
+    """Per-sample outer product -> [B, n1*n2] (reference layers.py
+    out_prod_layer)."""
+    with cfg.build():
+        a = fl.unsqueeze(input1.var, axes=[2])            # [B, n1, 1]
+        b = fl.unsqueeze(input2.var, axes=[1])            # [B, 1, n2]
+        var = fl.reshape(fl.matmul(a, b), shape=[0, -1])
+    return _apply_extra(cfg.Layer(var, parents=[input1, input2]),
+                        layer_attr)
+
+
+def tensor_layer(a, b, size, act=None, name=None, param_attr=None,
+                 bias_attr=None, layer_attr=None):
+    with cfg.build():
+        var = fl.bilinear_tensor_product(
+            a.var, b.var, size=size, act=act_name(act),
+            param_attr=param_attr, bias_attr=bias_attr)
+    return _apply_extra(cfg.Layer(var, v2_dim=size, parents=[a, b]),
+                        layer_attr)
+
+
+def scale_shift_layer(input, name=None, param_attr=None, bias_attr=None,
+                      layer_attr=None):
+    """Learned scalar w and shift b: y = w*x + b (reference layers.py
+    scale_shift_layer)."""
+    with cfg.build():
+        var = input.var
+        helper = LayerHelper("scale_shift", param_attr=param_attr,
+                             bias_attr=bias_attr)
+        w = helper.create_parameter(attr=helper.param_attr, shape=[1],
+                                    dtype=var.dtype)
+        var = fl.elementwise_mul(var, w)
+        if bias_attr is not False:
+            bvar = helper.create_parameter(attr=helper.bias_attr,
+                                           shape=[1], dtype=var.dtype,
+                                           is_bias=True)
+            var = fl.elementwise_add(var, bvar)
+    return _apply_extra(_wrap1(input, var, input.v2_dim), layer_attr)
+
+
+def scale_sub_region_layer(input, indices, value, name=None,
+                           layer_attr=None):
+    with cfg.build():
+        helper = LayerHelper("scale_sub_region")
+        out = helper.create_variable_for_type_inference(input.var.dtype)
+        helper.append_op(
+            type="scale_sub_region",
+            inputs={"X": [input.var], "Indices": [indices.var]},
+            outputs={"Out": [out]}, attrs={"value": float(value)})
+    return _apply_extra(cfg.Layer(out, parents=[input, indices]),
+                        layer_attr)
+
+
+def sum_to_one_norm_layer(input, name=None, layer_attr=None):
+    with cfg.build():
+        s = fl.reduce_sum(input.var, dim=-1, keep_dim=True)
+        var = fl.elementwise_div(input.var, s)
+    return _apply_extra(_wrap1(input, var, input.v2_dim), layer_attr)
+
+
+def row_l2_norm_layer(input, name=None, layer_attr=None):
+    with cfg.build():
+        var = fl.l2_normalize(input.var, axis=-1)
+    return _apply_extra(_wrap1(input, var, input.v2_dim), layer_attr)
+
+
+def l2_distance_layer(x, y, name=None, layer_attr=None):
+    with cfg.build():
+        d = fl.elementwise_sub(x.var, y.var)
+        var = fl.sqrt(fl.reduce_sum(fl.elementwise_mul(d, d), dim=-1,
+                                    keep_dim=True))
+    return _apply_extra(cfg.Layer(var, v2_dim=1, parents=[x, y]),
+                        layer_attr)
+
+
+def multiplex_layer(input, name=None, layer_attr=None):
+    """First input is the per-row selector index; the rest are the
+    candidates (reference layers.py multiplex_layer)."""
+    with cfg.build():
+        idx = fl.cast(input[0].var, "int32")
+        var = fl.multiplex([l.var for l in input[1:]], idx)
+    return _apply_extra(cfg.Layer(var, v2_dim=input[1].v2_dim,
+                                  parents=list(input)), layer_attr)
+
+
+def eos_layer(input, eos_id, name=None, layer_attr=None):
+    """1.0 where the id equals eos_id else 0.0 (reference layers.py
+    eos_layer's selection mask on this stack)."""
+    with cfg.build():
+        eos = fl.fill_constant_batch_size_like(
+            input.var, shape=[-1, 1], dtype="int64", value=float(eos_id))
+        var = fl.cast(fl.equal(fl.cast(input.var, "int64"), eos),
+                      "float32")
+    return _apply_extra(_wrap1(input, var, 1), layer_attr)
+
+
+def sampling_id_layer(input, name=None, layer_attr=None):
+    with cfg.build():
+        var = fl.sampling_id(input.var)
+    return _apply_extra(_wrap1(input, var, 1), layer_attr)
+
+
+def print_layer(input, format=None, name=None):
+    """In-graph print of the inputs; passes the first through
+    (reference layers.py print_layer / printer_layer)."""
+    if not isinstance(input, (list, tuple)):
+        input = [input]
+    with cfg.build():
+        outs = [fl.Print(l.var, message=format or "") for l in input]
+    return cfg.Layer(outs[0], v2_dim=input[0].v2_dim,
+                     parents=list(input))
+
+
+printer_layer = print_layer
+
+
+# ---- image family ---------------------------------------------------------
+
+def img_cmrnorm_layer(input, size, scale=0.0128, power=0.75,
+                      num_channels=None, name=None, layer_attr=None):
+    """Cross-map response normalization -> LRN (reference layers.py
+    img_cmrnorm_layer; scale is the v1 alpha*size parameterization)."""
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        # v1 parameterizes scale = alpha * size (ProjectionConfig);
+        # the lrn op wants alpha itself
+        var = fl.lrn(img, n=size, alpha=float(scale) / size,
+                     beta=float(power))
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def cross_channel_norm_layer(input, name=None, param_attr=None,
+                             layer_attr=None):
+    """L2-normalize across channels with a learned per-channel scale
+    (reference layers.py cross_channel_norm_layer — the SSD norm)."""
+    with cfg.build():
+        img, c = v2_layer._as_image(input, None)
+        normed = fl.l2_normalize(img, axis=1)
+        helper = LayerHelper("cross_channel_norm", param_attr=param_attr)
+        w = helper.create_parameter(attr=helper.param_attr, shape=[c],
+                                    dtype=img.dtype)
+        var = fl.elementwise_mul(normed, fl.reshape(w, shape=[1, c, 1, 1]))
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def spp_layer(input, name=None, num_channels=None, pool_type=None,
+              pyramid_height=None, layer_attr=None):
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        helper = LayerHelper("spp")
+        out = helper.create_variable_for_type_inference(img.dtype)
+        ptype = "max"
+        if pool_type is not None and \
+                type(pool_type).__name__.lower().startswith("avg"):
+            ptype = "avg"
+        helper.append_op(
+            type="spp", inputs={"X": [img]}, outputs={"Out": [out]},
+            attrs={"pyramid_height": int(pyramid_height or 2),
+                   "pooling_type": ptype})
+    return _apply_extra(_wrap1(input, out), layer_attr)
+
+
+def img_conv3d_layer(input, filter_size, num_filters, num_channels=None,
+                     stride=1, padding=0, act=None, param_attr=None,
+                     bias_attr=None, groups=1, name=None, layer_attr=None):
+    with cfg.build():
+        var = fl.conv3d(input.var, num_filters=num_filters,
+                        filter_size=filter_size, stride=stride,
+                        padding=padding, groups=groups,
+                        act=act_name(act), param_attr=param_attr,
+                        bias_attr=bias_attr)
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def img_pool3d_layer(input, pool_size, num_channels=None, pool_type=None,
+                     stride=1, padding=0, name=None, layer_attr=None):
+    with cfg.build():
+        ptype = "max"
+        if pool_type is not None and \
+                type(pool_type).__name__.lower().startswith("avg"):
+            ptype = "avg"
+        var = fl.pool3d(input.var, pool_size=pool_size, pool_type=ptype,
+                        pool_stride=stride, pool_padding=padding)
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def block_expand_layer(input, block_x=1, block_y=1, stride_x=1, stride_y=1,
+                       padding_x=0, padding_y=0, num_channels=None,
+                       name=None, layer_attr=None):
+    """Image -> sequence of flattened blocks (reference layers.py
+    block_expand_layer -> im2sequence_op)."""
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        var = fl.im2sequence(
+            img, filter_size=[block_y, block_x],
+            stride=[stride_y, stride_x],
+            padding=[padding_y, padding_x, padding_y, padding_x])
+    return _apply_extra(_wrap1(input, var), layer_attr)
+
+
+def priorbox_layer(input, image, aspect_ratio, variance, min_size,
+                   max_size=[], name=None):
+    with cfg.build():
+        img, _ = v2_layer._as_image(image, None)
+        feat, _ = v2_layer._as_image(input, None)
+        boxes, vars_ = fl.prior_box(
+            feat, img, min_sizes=list(min_size),
+            max_sizes=list(max_size), aspect_ratios=list(aspect_ratio),
+            variance=list(variance), flip=True)
+        var = fl.concat([fl.reshape(boxes, shape=[-1, 4]),
+                         fl.reshape(vars_, shape=[-1, 4])], axis=0)
+    return cfg.Layer(var, parents=[input, image])
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400,
+                           keep_top_k=200, confidence_threshold=0.01,
+                           background_id=0, name=None):
+    """SSD decode+NMS (reference layers.py detection_output_layer ->
+    fluid detection_output)."""
+    locs = input_loc if isinstance(input_loc, (list, tuple)) \
+        else [input_loc]
+    confs = input_conf if isinstance(input_conf, (list, tuple)) \
+        else [input_conf]
+    with cfg.build():
+        loc = locs[0].var if len(locs) == 1 else \
+            fl.concat([l.var for l in locs], axis=1)
+        conf = confs[0].var if len(confs) == 1 else \
+            fl.concat([c.var for c in confs], axis=1)
+        pb = priorbox.var
+        half = int(pb.shape[0]) // 2 if pb.shape[0] and pb.shape[0] > 0 \
+            else None
+        if half is None:
+            raise ValueError("priorbox layer must have a static size")
+        boxes = fl.slice(pb, axes=[0], starts=[0], ends=[half])
+        pvar = fl.slice(pb, axes=[0], starts=[half], ends=[2 * half])
+        decoded = fl.box_coder(boxes, pvar, loc,
+                               code_type="decode_center_size")
+        scores = fl.transpose(conf, perm=[0, 2, 1])   # [B, C, P]
+        var = fl.multiclass_nms(
+            decoded, scores, background_label=background_id,
+            nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+            keep_top_k=keep_top_k, score_threshold=confidence_threshold)
+    return cfg.Layer(var, parents=list(locs) + list(confs) + [priorbox])
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label,
+                        num_classes, overlap_threshold=0.5,
+                        neg_pos_ratio=3.0, neg_overlap=0.5,
+                        background_id=0, name=None, max_gt_boxes=None):
+    """SSD training loss (reference layers.py multibox_loss_layer ->
+    fluid ssd_loss).  ``label`` carries [label, xmin, ymin, xmax, ymax]
+    rows per sample.  ``max_gt_boxes`` pins the static ground-truth
+    count when the label is a variable-length sequence (the matching
+    math needs static shapes under XLA)."""
+    locs = input_loc if isinstance(input_loc, (list, tuple)) \
+        else [input_loc]
+    confs = input_conf if isinstance(input_conf, (list, tuple)) \
+        else [input_conf]
+    with cfg.build():
+        loc = locs[0].var if len(locs) == 1 else \
+            fl.concat([l.var for l in locs], axis=1)
+        conf = confs[0].var if len(confs) == 1 else \
+            fl.concat([c.var for c in confs], axis=1)
+        pb = priorbox.var
+        half = int(pb.shape[0]) // 2
+        boxes = fl.slice(pb, axes=[0], starts=[0], ends=[half])
+        pvar = fl.slice(pb, axes=[0], starts=[half], ends=[2 * half])
+        gt = label.var
+        if gt.shape[1] is None or gt.shape[1] < 0:
+            if max_gt_boxes is None:
+                raise ValueError(
+                    "multibox_loss_layer: the label sequence length is "
+                    "unknown at build time; pass max_gt_boxes= (the "
+                    "padded ground-truth count) so the matching math "
+                    "gets static shapes")
+            gt = fl.reshape(gt, shape=[0, int(max_gt_boxes),
+                                       int(gt.shape[-1])])
+        gt_label = fl.cast(
+            fl.slice(gt, axes=[2], starts=[0], ends=[1]), "int64")
+        gt_box = fl.slice(gt, axes=[2], starts=[1], ends=[5])
+        var = fl.ssd_loss(
+            loc, conf, gt_box, gt_label, boxes, pvar,
+            background_label=background_id,
+            overlap_threshold=overlap_threshold,
+            neg_pos_ratio=neg_pos_ratio, neg_overlap=neg_overlap)
+        var = fl.reduce_sum(var)
+    return cfg.Layer(var, parents=list(locs) + list(confs) +
+                     [priorbox, label])
+
+
+def roi_pool_layer(input, rois, pooled_width, pooled_height,
+                   spatial_scale, num_channels=None, name=None):
+    with cfg.build():
+        img, _c = v2_layer._as_image(input, num_channels)
+        var = fl.roi_pool(img, rois.var, pooled_height=pooled_height,
+                          pooled_width=pooled_width,
+                          spatial_scale=spatial_scale)
+    return cfg.Layer(var, parents=[input, rois])
+
+
+# ---- sequence family ------------------------------------------------------
+
+def seq_concat_layer(a, b, act=None, name=None, layer_attr=None,
+                     bias_attr=None):
+    with cfg.build():
+        var = fl.sequence_concat([a.var, b.var])
+        if act is not None:
+            var = getattr(fl, act_name(act))(var)
+    return _apply_extra(cfg.Layer(var, v2_dim=a.v2_dim, parents=[a, b]),
+                        layer_attr)
+
+
+def seq_reshape_layer(input, reshape_size, act=None, name=None,
+                      layer_attr=None, bias_attr=None):
+    with cfg.build():
+        var = fl.sequence_reshape(input.var, new_dim=reshape_size)
+        if act is not None:
+            var = getattr(fl, act_name(act))(var)
+    return _apply_extra(_wrap1(input, var, reshape_size), layer_attr)
+
+
+def _seq_slice(input, offsets, sizes):
+    """sequence_slice with the op's full input contract: Offset/Size
+    default to whole-sequence values, Length is the @LEN companion."""
+    helper = LayerHelper("seq_slice")
+    length = None
+    ln_name = getattr(input, "_seq_len_name", None)
+    if ln_name:
+        length = input.block._find_var_recursive(ln_name)
+    if length is None:
+        raise ValueError(
+            "seq_slice needs a sequence input (with a @LEN companion)")
+    if offsets is None:
+        offsets = fl.fill_constant_batch_size_like(
+            input, shape=[-1, 1], dtype="int32", value=0)
+    if sizes is None:
+        sizes = fl.cast(fl.reshape(length, shape=[-1, 1]), "int32")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    out_len = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offsets], "Size": [sizes],
+                "Length": [length]},
+        outputs={"Out": [out], "OutLength": [out_len]})
+    out._seq_len_name = out_len.name
+    return out
+
+
+def seq_slice_layer(input, starts, ends, name=None):
+    """Per-sequence [start, end) slices (reference layers.py
+    seq_slice_layer)."""
+    with cfg.build():
+        off = starts.var if starts is not None else None
+        if ends is not None and starts is not None:
+            size = fl.elementwise_sub(ends.var, starts.var)
+        elif ends is not None:
+            size = ends.var
+        else:
+            size = None
+        var = _seq_slice(input.var, off, size)
+    parents = [p for p in (input, starts, ends) if p is not None]
+    return cfg.Layer(var, v2_dim=input.v2_dim, parents=parents)
+
+
+def sub_seq_layer(input, offsets, sizes, act=None, bias_attr=None,
+                  name=None):
+    with cfg.build():
+        var = _seq_slice(input.var, offsets.var, sizes.var)
+        if act is not None:
+            var = getattr(fl, act_name(act))(var)
+    return cfg.Layer(var, v2_dim=input.v2_dim,
+                     parents=[input, offsets, sizes])
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None):
+    raise NotImplementedError(
+        "nested sequences are flattened by the padded+@LEN design "
+        "(SURVEY §5 one-level ruling); restructure as a flat sequence "
+        "with explicit segment ids")
+
+
+def kmax_seq_score_layer(input, name=None, beam_size=1):
+    """Indices of the k highest per-step scores in each sequence
+    (reference layers.py kmax_seq_score_layer)."""
+    with cfg.build():
+        scores = fl.reshape(input.var, shape=[0, -1])
+        _vals, idx = fl.topk(scores, k=beam_size)
+    return cfg.Layer(idx, v2_dim=beam_size, parents=[input])
+
+
+def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
+                    name=None, reverse=False, layer_attr=None):
+    """Elman recurrence h_t = act(x_t + W h_{t-1}) over a padded
+    sequence (reference layers.py recurrent_layer / legacy
+    RecurrentLayer)."""
+    with cfg.build():
+        x = input.var                      # [B, T, D]
+        d = int(x.shape[-1])
+        if reverse:
+            x = fl.reverse(x, axis=[1])
+        drnn = fl.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x)
+            h_pre = drnn.memory(shape=[d], value=0.0)
+            helper = LayerHelper("recurrent", param_attr=param_attr,
+                                 bias_attr=bias_attr)
+            w = helper.create_parameter(attr=helper.param_attr,
+                                        shape=[d, d], dtype=x_t.dtype)
+            pre = fl.elementwise_add(x_t, fl.matmul(h_pre, w))
+            if bias_attr is not False:
+                b = helper.create_parameter(attr=helper.bias_attr,
+                                            shape=[d], dtype=x_t.dtype,
+                                            is_bias=True)
+                pre = fl.elementwise_add(pre, b)
+            h = getattr(fl, act_name(act) or "tanh")(pre)
+            drnn.update_memory(h_pre, h)
+            drnn.output(h)
+        var = drnn()
+        if reverse:
+            var = fl.reverse(var, axis=[1])
+    return _apply_extra(_wrap1(input, var, input.v2_dim), layer_attr)
+
+
+def lstm_step_layer(input, state, size=None, act=None, name=None,
+                    gate_act=None, state_act=None, bias_attr=None,
+                    layer_attr=None):
+    """One LSTM step on a pre-projected [B, 4H] input (reference
+    layers.py lstm_step_layer).  Returns the hidden; the new cell rides
+    ``layer.state``."""
+    with cfg.build():
+        helper = LayerHelper("lstm_step")
+        h = helper.create_variable_for_type_inference(input.var.dtype)
+        c = helper.create_variable_for_type_inference(input.var.dtype)
+        helper.append_op(
+            type="lstm_unit",
+            inputs={"X": [input.var], "C_prev": [state.var]},
+            outputs={"H": [h], "C": [c]}, attrs={"forget_bias": 0.0})
+    out = cfg.Layer(h, v2_dim=size, parents=[input, state])
+    out.state = cfg.Layer(c, v2_dim=size, parents=[out])
+    return out
+
+
+def gru_step_layer(input, output_mem, size=None, act=None, name=None,
+                   gate_act=None, bias_attr=None, param_attr=None,
+                   layer_attr=None):
+    """One GRU step on a pre-projected [B, 3H] input (reference
+    layers.py gru_step_layer)."""
+    with cfg.build():
+        sz = size or int(input.var.shape[-1]) // 3
+        h, _rhp, _gate = fl.gru_unit(
+            input.var, output_mem.var, sz * 3, param_attr=param_attr,
+            bias_attr=bias_attr,
+            activation=act_name(act) or "tanh",
+            gate_activation=act_name(gate_act) or "sigmoid")
+    return cfg.Layer(h, v2_dim=size, parents=[input, output_mem])
+
+
+gru_step_naive_layer = gru_step_layer
+
+
+def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,
+                     gate_param_attr=None, gate_bias_attr=None,
+                     inproj_attr=None, inproj_param_attr=None,
+                     inproj_bias_attr=None, layer_attr=None):
+    """GLU: fc(act) * sigmoid(fc) (reference layers.py
+    gated_unit_layer)."""
+    with cfg.build():
+        nfd = len(input.var.shape) - 1
+        proj = fl.fc(input.var, size=size, act=act_name(act),
+                     num_flatten_dims=nfd,
+                     param_attr=inproj_param_attr,
+                     bias_attr=inproj_bias_attr)
+        gate = fl.fc(input.var, size=size, act="sigmoid",
+                     num_flatten_dims=nfd,
+                     param_attr=gate_param_attr,
+                     bias_attr=gate_bias_attr)
+        var = fl.elementwise_mul(proj, gate)
+    return _apply_extra(_wrap1(input, var, size), layer_attr)
+
+
+def selective_fc_layer(input, size, select=None, act=None, name=None,
+                       param_attr=None, bias_attr=None, layer_attr=None,
+                       **kwargs):
+    """fc whose outputs are masked by ``select`` (reference layers.py
+    selective_fc_layer; the reference's sparse evaluation is an
+    inference shortcut XLA's dense matmul does not need)."""
+    with cfg.build():
+        var = fl.fc(input.var, size=size, act=act_name(act),
+                    num_flatten_dims=len(input.var.shape) - 1,
+                    param_attr=param_attr, bias_attr=bias_attr)
+        if select is not None:
+            var = fl.elementwise_mul(var, select.var)
+    parents = [input] + ([select] if select is not None else [])
+    return _apply_extra(cfg.Layer(var, v2_dim=size, parents=parents),
+                        layer_attr)
+
+
+def factorization_machine(input, factor_size, act=None, name=None,
+                          param_attr=None, layer_attr=None):
+    """Second-order FM term 0.5*sum((xV)^2 - (x^2)(V^2)) (reference
+    layers.py factorization_machine)."""
+    with cfg.build():
+        x = input.var
+        d = int(x.shape[-1])
+        helper = LayerHelper("fm", param_attr=param_attr)
+        v = helper.create_parameter(attr=helper.param_attr,
+                                    shape=[d, factor_size], dtype=x.dtype)
+        xv = fl.matmul(x, v)                               # [B, K]
+        x2v2 = fl.matmul(fl.elementwise_mul(x, x),
+                         fl.elementwise_mul(v, v))         # [B, K]
+        diff = fl.elementwise_sub(fl.elementwise_mul(xv, xv), x2v2)
+        var = fl.scale(fl.reduce_sum(diff, dim=-1, keep_dim=True), 0.5)
+        if act is not None:
+            var = getattr(fl, act_name(act))(var)
+    return _apply_extra(_wrap1(input, var, 1), layer_attr)
+
+
+# ---- cost layers ----------------------------------------------------------
+
+def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
+              layer_attr=None):
+    with cfg.build():
+        cost = fl.rank_loss(label.var, left.var, right.var)
+        if weight is not None:
+            cost = fl.elementwise_mul(cost, weight.var)
+        cost = fl.mean(cost)
+        if coeff != 1.0:
+            cost = fl.scale(cost, scale=float(coeff))
+    parents = [p for p in (left, right, label, weight) if p is not None]
+    return cfg.Layer(cost, parents=parents)
+
+
+def huber_regression_cost(input, label, name=None, delta=1.0, coeff=1.0,
+                          layer_attr=None):
+    with cfg.build():
+        helper = LayerHelper("huber")
+        out = helper.create_variable_for_type_inference(input.var.dtype)
+        helper.append_op(
+            type="huber_loss",
+            inputs={"X": [input.var], "Y": [label.var]},
+            outputs={"Out": [out]}, attrs={"delta": float(delta)})
+        cost = fl.scale(fl.mean(out), scale=float(coeff))
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def huber_classification_cost(input, label, name=None, coeff=1.0,
+                              layer_attr=None):
+    """Squared-hinge-style huber for binary labels in {0,1} (reference
+    layers.py huber_classification_cost / modified huber)."""
+    with cfg.build():
+        helper = LayerHelper("huber_cls")
+        inter = helper.create_variable_for_type_inference(input.var.dtype)
+        out = helper.create_variable_for_type_inference(input.var.dtype)
+        helper.append_op(
+            type="modified_huber_loss",
+            inputs={"X": [input.var], "Y": [label.var]},
+            outputs={"IntermediateVal": [inter], "Out": [out]})
+        cost = fl.scale(fl.mean(out), scale=float(coeff))
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1,
+                                layer_attr=None):
+    """CE plus alpha * mean(log(Z)^2) where Z is each row's probability
+    mass — pushes unnormalized scorers toward self-normalization
+    (reference layers.py cross_entropy_with_selfnorm)."""
+    with cfg.build():
+        ce = fl.mean(fl.cross_entropy(input.var, label.var))
+        z = fl.reduce_sum(input.var, dim=-1, keep_dim=False)
+        logz = fl.log(z)
+        pen = fl.mean(fl.elementwise_mul(logz, logz))
+        cost = fl.scale(
+            fl.elementwise_add(
+                ce, fl.scale(pen, scale=float(softmax_selfnorm_alpha))),
+            scale=float(coeff))
+    return cfg.Layer(cost, parents=[input, label])
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
+                layer_attr=None):
+    """LambdaRank listwise cost over a padded sequence of scores
+    (reference layers.py lambda_cost; NDCG-weighted pairwise logistic
+    loss — ops/loss.py lambda_cost).  ``max_sort_size`` is accepted for
+    parity: the whole (padded) list participates, which matches
+    max_sort_size=-1."""
+    with cfg.build():
+        helper = LayerHelper("lambda_cost")
+        out = helper.create_variable_for_type_inference(input.var.dtype)
+        inputs = {"Score": [input.var], "Rel": [score.var]}
+        ln = getattr(input.var, "_seq_len_name", None)
+        if ln:
+            inputs["Length"] = [ln]
+        helper.append_op(type="lambda_cost", inputs=inputs,
+                         outputs={"Out": [out]},
+                         attrs={"ndcg_num": int(NDCG_num)})
+        cost = fl.mean(out)
+    return cfg.Layer(cost, parents=[input, score])
+
+
+def cross_entropy_over_beam(input, name=None):
+    raise NotImplementedError(
+        "cross_entropy_over_beam trains the v1 beam-search machinery "
+        "(reference layers.py BeamInput); beam training on this stack "
+        "goes through layers.beam_search + softmax_with_cross_entropy "
+        "(tests/test_rnn_encoder_decoder.py)")
+
+
+def conv_shift_layer(a, b, name=None, layer_attr=None):
+    """Circular 1-D correlation of each row of a with the (odd-width)
+    kernel rows of b (reference layers.py conv_shift_layer /
+    conv_shift_op.cc)."""
+    with cfg.build():
+        helper = LayerHelper("conv_shift")
+        out = helper.create_variable_for_type_inference(a.var.dtype)
+        helper.append_op(type="conv_shift",
+                         inputs={"X": [a.var], "Y": [b.var]},
+                         outputs={"Out": [out]})
+    return _apply_extra(cfg.Layer(out, v2_dim=a.v2_dim, parents=[a, b]),
+                        layer_attr)
+
+
+def row_conv_layer(input, context_len, act=None, name=None,
+                   param_attr=None, layer_attr=None):
+    """Lookahead row convolution over a padded sequence (reference
+    layers.py row_conv_layer / row_conv_op.cc)."""
+    with cfg.build():
+        var = fl.row_conv(input.var, future_context_size=context_len,
+                          param_attr=param_attr)
+        if act is not None:
+            var = getattr(fl, act_name(act))(var)
+    return _apply_extra(_wrap1(input, var, input.v2_dim), layer_attr)

@@ -11,12 +11,17 @@ report them.
 
 from ..v2 import config as cfg
 from ..v2 import networks as v2_net
+from .. import nets as fnets
 
 __all__ = [
     "sequence_conv_pool", "simple_img_conv_pool", "img_conv_group",
     "simple_lstm", "simple_gru", "bidirectional_lstm",
     "simple_attention", "dot_product_attention",
     "inputs", "outputs",
+    "text_conv_pool", "img_conv_bn_pool", "img_separable_conv",
+    "small_vgg", "vgg_16_network", "simple_gru2", "gru_group",
+    "gru_unit", "lstmemory_group", "lstmemory_unit",
+    "bidirectional_gru", "multi_head_attention",
 ]
 
 sequence_conv_pool = v2_net.sequence_conv_pool
@@ -54,3 +59,200 @@ def outputs(*layers):
     """Mark network outputs (reference networks.py outputs)."""
     g = cfg.graph()
     g.output_layers = _flatten(layers)
+
+
+# ===========================================================================
+# parity tail: the remaining reference networks.py composites
+# ===========================================================================
+
+from .. import layers as fl                              # noqa: E402
+from ..v2 import layer as v2_layer                       # noqa: E402
+from ..v2.activation import act_name                     # noqa: E402
+from . import layers as v1                               # noqa: E402
+
+text_conv_pool = v2_net.sequence_conv_pool
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     num_channel=None, conv_stride=1, conv_padding=0,
+                     conv_act=None, pool_stride=1, pool_type=None,
+                     bn_param_attr=None, bn_bias_attr=None,
+                     conv_param_attr=None, **kwargs):
+    """conv -> batch_norm -> pool (reference networks.py
+    img_conv_bn_pool)."""
+    conv = v1.img_conv_layer(
+        input, filter_size=filter_size, num_filters=num_filters,
+        num_channels=num_channel, stride=conv_stride,
+        padding=conv_padding, act=None, param_attr=conv_param_attr)
+    bn = v1.batch_norm_layer(conv, act=conv_act,
+                             param_attr=bn_param_attr,
+                             bias_attr=bn_bias_attr)
+    return v1.img_pool_layer(bn, pool_size=pool_size, stride=pool_stride,
+                             pool_type=pool_type)
+
+
+def img_separable_conv(input, num_channels, num_out_channels, filter_size,
+                       stride=1, padding=0, depth_multiplier=1, act=None,
+                       bias_attr=None, param_attr=None, shared_bias=True,
+                       name=None, **kwargs):
+    """Depthwise conv then pointwise 1x1 conv (reference networks.py
+    img_separable_conv)."""
+    with cfg.build():
+        img, c = v2_layer._as_image(input, num_channels)
+        depthwise = fl.conv2d(
+            img, num_filters=c * depth_multiplier,
+            filter_size=filter_size, stride=stride, padding=padding,
+            groups=c, param_attr=param_attr, bias_attr=bias_attr)
+        pointwise = fl.conv2d(
+            depthwise, num_filters=num_out_channels, filter_size=1,
+            act=act_name(act), param_attr=param_attr,
+            bias_attr=bias_attr)
+    return cfg.Layer(pointwise, parents=[input])
+
+
+def small_vgg(input_image, num_channels, num_classes, **kwargs):
+    """The cifar small-VGG (reference networks.py small_vgg: four
+    conv groups of 2/2/3/3 layers at 64/128/256/512 filters, two
+    fc+bn+dropout heads)."""
+    with cfg.build():
+        img, _c = v2_layer._as_image(input_image, num_channels)
+        tmp = img
+        for groups, filters in ((2, 64), (2, 128), (3, 256), (3, 512)):
+            tmp = fnets.img_conv_group(
+                input=tmp, conv_num_filter=[filters] * groups,
+                pool_size=2, conv_padding=1, conv_filter_size=3,
+                conv_act="relu", conv_with_batchnorm=True,
+                pool_stride=2, pool_type="max")
+        drop = fl.dropout(tmp, dropout_prob=0.5)
+        fc1 = fl.fc(drop, size=512, act=None)
+        bn = fl.batch_norm(fc1, act="relu")
+        bn = fl.dropout(bn, dropout_prob=0.5)
+        fc2 = fl.fc(bn, size=512, act=None)
+        out = fl.fc(fc2, size=num_classes, act="softmax")
+    return cfg.Layer(out, v2_dim=num_classes, parents=[input_image])
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000, **kwargs):
+    """VGG-16 (reference networks.py vgg_16_network: conv groups
+    2/2/3/3/3 at 64..512 + two 4096 fc heads)."""
+    with cfg.build():
+        img, _c = v2_layer._as_image(input_image, num_channels)
+        tmp = img
+        for groups, filters in ((2, 64), (2, 128), (3, 256), (3, 512),
+                                (3, 512)):
+            tmp = fnets.img_conv_group(
+                input=tmp, conv_num_filter=[filters] * groups,
+                pool_size=2, conv_padding=1, conv_filter_size=3,
+                conv_act="relu", pool_stride=2, pool_type="max")
+        fc1 = fl.fc(tmp, size=4096, act="relu")
+        fc1 = fl.dropout(fc1, dropout_prob=0.5)
+        fc2 = fl.fc(fc1, size=4096, act="relu")
+        fc2 = fl.dropout(fc2, dropout_prob=0.5)
+        out = fl.fc(fc2, size=num_classes, act="softmax")
+    return cfg.Layer(out, v2_dim=num_classes, parents=[input_image])
+
+
+def simple_gru2(input, size, name=None, reverse=False, mixed_param_attr=None,
+                mixed_bias_attr=None, gru_param_attr=None,
+                gru_bias_attr=None, act=None, gate_act=None,
+                **kwargs):
+    """fc projection + grumemory (reference networks.py simple_gru2 —
+    numerically the same recurrence as simple_gru with the projection
+    spelled as a mixed layer)."""
+    proj = v1.fc_layer(input, size=size * 3, act=None,
+                       param_attr=mixed_param_attr,
+                       bias_attr=mixed_bias_attr)
+    return v1.grumemory(proj, size=size, reverse=reverse, act=act,
+                        gate_act=gate_act, param_attr=gru_param_attr,
+                        bias_attr=gru_bias_attr, name=name)
+
+
+def gru_group(input, size, name=None, reverse=False, param_attr=None,
+              bias_attr=None, act=None, gate_act=None, **kwargs):
+    """Full-sequence GRU recurrence (reference networks.py gru_group:
+    a recurrent_group around gru_step_layer; this stack's scan-based
+    grumemory computes the identical sequence of hidden states)."""
+    return v1.grumemory(input, size=size, reverse=reverse, act=act,
+                        gate_act=gate_act, param_attr=param_attr,
+                        bias_attr=bias_attr, name=name)
+
+
+def gru_unit(input, size=None, name=None, gru_param_attr=None,
+             gru_bias_attr=None, act=None, gate_act=None, **kwargs):
+    """reference networks.py gru_unit is the per-step cell used inside
+    recurrent_group; recurrence here is scan-based, so this returns the
+    full hidden sequence of the same cell (see gru_group)."""
+    size = size or int(input.var.shape[-1]) // 3
+    return v1.grumemory(input, size=size, act=act, gate_act=gate_act,
+                        param_attr=gru_param_attr,
+                        bias_attr=gru_bias_attr, name=name)
+
+
+def lstmemory_group(input, size=None, name=None, reverse=False,
+                    param_attr=None, act=None, gate_act=None,
+                    state_act=None, lstm_bias_attr=None, **kwargs):
+    """Full-sequence LSTM recurrence (reference networks.py
+    lstmemory_group; see gru_group for the scan ruling)."""
+    return v1.lstmemory(input, size=size, reverse=reverse, act=act,
+                        gate_act=gate_act, state_act=state_act,
+                        param_attr=param_attr, bias_attr=lstm_bias_attr,
+                        name=name)
+
+
+def lstmemory_unit(input, size=None, name=None, param_attr=None,
+                   act=None, gate_act=None, state_act=None,
+                   lstm_bias_attr=None, **kwargs):
+    """reference networks.py lstmemory_unit: per-step LSTM cell for
+    recurrent_group; returns the full hidden sequence of the same cell
+    here (see gru_unit)."""
+    return v1.lstmemory(input, size=size, act=act, gate_act=gate_act,
+                        state_act=state_act, param_attr=param_attr,
+                        bias_attr=lstm_bias_attr, name=name)
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      fwd_mixed_param_attr=None, bwd_mixed_param_attr=None,
+                      fwd_gru_param_attr=None, bwd_gru_param_attr=None,
+                      **kwargs):
+    """Forward + backward GRU, last-step concat (or full sequences with
+    return_seq=True) — reference networks.py bidirectional_gru."""
+    fwd_proj = v1.fc_layer(input, size=size * 3, act=None,
+                           param_attr=fwd_mixed_param_attr)
+    fwd = v1.grumemory(fwd_proj, size=size,
+                       param_attr=fwd_gru_param_attr)
+    bwd_proj = v1.fc_layer(input, size=size * 3, act=None,
+                           param_attr=bwd_mixed_param_attr)
+    bwd = v1.grumemory(bwd_proj, size=size, reverse=True,
+                       param_attr=bwd_gru_param_attr)
+    with cfg.build():
+        if return_seq:
+            var = fl.concat([fwd.var, bwd.var], axis=2)
+        else:
+            f_last = fl.sequence_pool(fwd.var, "last")
+            b_first = fl.sequence_pool(bwd.var, "first")
+            var = fl.concat([f_last, b_first], axis=1)
+    return cfg.Layer(var, v2_dim=2 * size, parents=[fwd, bwd])
+
+
+def multi_head_attention(query, key, value, key_proj_size, value_proj_size,
+                         head_num, attention_type="dot", softmax_param_attr=None,
+                         name=None, **kwargs):
+    """Multi-head scaled-dot attention over padded sequences (reference
+    networks.py multi_head_attention; 'dot' attention — the TPU path is
+    nets.scaled_dot_product_attention on projected q/k/v)."""
+    if attention_type not in ("dot", "dot-product attention"):
+        raise NotImplementedError(
+            "additive multi-head attention is served by "
+            "nets.simple_attention; this composite implements the "
+            "reference's dot form")
+    with cfg.build():
+        q = fl.fc(query.var, size=key_proj_size * head_num,
+                  num_flatten_dims=2, bias_attr=False)
+        k = fl.fc(key.var, size=key_proj_size * head_num,
+                  num_flatten_dims=2, bias_attr=False)
+        v = fl.fc(value.var, size=value_proj_size * head_num,
+                  num_flatten_dims=2, bias_attr=False)
+        var = fnets.scaled_dot_product_attention(q, k, v,
+                                                 num_heads=head_num)
+    return cfg.Layer(var, v2_dim=value_proj_size * head_num,
+                     parents=[query, key, value])

@@ -49,12 +49,16 @@ from . import topology    # noqa: F401
 from . import trainer     # noqa: F401
 from .inference import infer  # noqa: F401
 from .minibatch import batch  # noqa: F401
+# the reference v2 __init__ re-exports the fluid program singletons
+from ..framework import (  # noqa: F401
+    default_main_program, default_startup_program)
 
 __all__ = [
     "init", "layer", "activation", "parameters", "trainer", "event",
     "data_type", "attr", "pooling", "topology", "networks", "evaluator",
     "inference", "infer", "batch", "minibatch", "optimizer", "plot",
     "reader", "dataset", "image", "master", "reset",
+    "default_main_program", "default_startup_program",
 ]
 
 reset = config.reset

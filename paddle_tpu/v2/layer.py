@@ -100,9 +100,10 @@ def _as_image(layer, num_channels):
     if len(var.shape) == 4:
         return var, var.shape[1]
     dim = layer.v2_dim
-    c = num_channels or 1
     h = getattr(layer, "height", None)
     w = getattr(layer, "width", None)
+    # channel count: explicit, else derived from known h/w hints
+    c = num_channels or (dim // (h * w) if (h and w) else 1)
     if not (h and w):
         hw = int(round(math.sqrt(dim // c)))
         if c * hw * hw != dim:

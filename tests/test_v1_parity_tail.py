@@ -1,0 +1,430 @@
+"""Execution coverage for the v1 dialect parity tail (reference
+trainer_config_helpers layers/networks/evaluators names added late):
+every new layer builds ops on the shared graph and RUNS on the CPU
+backend with value checks where the math is closed-form."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.trainer_config_helpers as tch
+from paddle_tpu import v2 as paddle
+from paddle_tpu.v2 import config as cfg
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    tch.reset_parser()
+    yield
+    tch.reset_parser()
+
+
+def _run(fetch_layers, feed):
+    g = cfg.graph()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(g.startup)
+    outs = exe.run(g.main, feed=feed,
+                   fetch_list=[l.var for l in fetch_layers])
+    return [np.asarray(o) for o in outs]
+
+
+def test_elementwise_geometric_layers_run():
+    x = tch.data_layer("x", size=6)
+    y = tch.data_layer("y", size=6)
+    w = tch.data_layer("w", size=1)
+
+    clip = tch.clip_layer(x, min=-0.5, max=0.5)
+    rot = tch.rotate_layer(x, height=2, width=3)
+    sw = tch.switch_order_layer(x, reshape_order=[1, 0])
+    rs = tch.resize_layer(x, size=3)
+    rep = tch.repeat_layer(x, 2)
+    interp = tch.interpolation_layer([x, y], w)
+    lc = tch.linear_comb_layer(weights=tch.resize_layer(x, 3),
+                               vectors=tch.resize_layer(y, 3), size=1)
+    op = tch.out_prod_layer(w, w)
+    s2o = tch.sum_to_one_norm_layer(x)
+    rl2 = tch.row_l2_norm_layer(x)
+    l2d = tch.l2_distance_layer(x, x)
+    sshift = tch.scale_shift_layer(x)
+    tl = tch.tensor_layer(x, y, size=4)
+
+    xv = np.arange(12, dtype="float32").reshape(2, 6) + 1.0
+    yv = np.ones((2, 6), "float32")
+    wv = np.full((2, 1), 0.25, "float32")
+    (cv, rv, swv, rsv, repv, iv, lcv, opv, s2ov, rl2v, l2dv, ssv,
+     tlv) = _run(
+        [clip, rot, sw, rs, rep, interp, lc, op, s2o, rl2, l2d, sshift,
+         tl],
+        {"x": xv, "y": yv, "w": wv})
+    assert cv.max() <= 0.5 and cv.min() >= -0.5
+    assert rv.shape == (2, 6) and swv.shape == (6, 2)
+    assert rsv.shape == (4, 3)
+    np.testing.assert_allclose(repv[0, :6], xv[0])      # [a b a b]
+    np.testing.assert_allclose(repv[0, 6:], xv[0])
+    np.testing.assert_allclose(iv, 0.25 * xv + 0.75 * yv, rtol=1e-6)
+    assert lcv.shape == (4, 1) and opv.shape == (2, 1)
+    np.testing.assert_allclose(s2ov.sum(axis=1), np.ones(2), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.linalg.norm(rl2v, axis=1), np.ones(2), rtol=1e-5)
+    np.testing.assert_allclose(l2dv, np.zeros((2, 1)), atol=1e-6)
+    assert ssv.shape == (2, 6) and tlv.shape == (2, 4)
+
+
+def test_select_print_sample_layers_run():
+    ids = tch.data_layer("ids", size=1)
+    a = tch.data_layer("a", size=4)
+    b = tch.data_layer("b", size=4)
+    probs = tch.data_layer("p", size=4)
+
+    mux = tch.multiplex_layer([ids, a, b])
+    eos = tch.eos_layer(ids, eos_id=2)
+    sid = tch.sampling_id_layer(probs)
+    pr = tch.print_layer(a, format="v1-print")
+
+    av = np.zeros((3, 4), "float32")
+    bv = np.ones((3, 4), "float32")
+    idv = np.array([[0.0], [1.0], [0.0]], "float32")
+    pv = np.full((3, 4), 0.25, "float32")
+    muxv, eosv, sidv, prv = _run([mux, eos, sid, pr],
+                                 {"ids": idv, "a": av, "b": bv, "p": pv})
+    np.testing.assert_allclose(muxv[:, 0], [0.0, 1.0, 0.0])
+    np.testing.assert_allclose(eosv.ravel(), [0.0, 0.0, 0.0])
+    assert sidv.shape[0] == 3 and (0 <= sidv).all() and (sidv < 4).all()
+    np.testing.assert_allclose(prv, av)
+
+
+def test_image_family_layers_run():
+    img = tch.data_layer("img", size=3 * 8 * 8, height=8, width=8)
+
+    mx = tch.maxout_layer(img, groups=3, num_channels=3)
+    cmr = tch.img_cmrnorm_layer(img, size=3, num_channels=3)
+    ccn = tch.cross_channel_norm_layer(img)
+    pad = tch.pad_layer(img, pad_c=[1, 1], pad_h=[0, 0], pad_w=[0, 0],
+                        num_channels=3)
+    spp = tch.spp_layer(img, num_channels=3, pyramid_height=2)
+    up = tch.upsample_layer(img, scale=2, num_channels=3)
+    bi = tch.bilinear_interp_layer(img, out_size_x=4, out_size_y=4,
+                                   num_channels=3)
+    be = tch.block_expand_layer(img, block_x=4, block_y=4, stride_x=4,
+                                stride_y=4, num_channels=3)
+    pre = tch.prelu_layer(img)
+
+    iv = np.random.RandomState(0).rand(2, 3 * 8 * 8).astype("float32")
+    outs = _run([mx, cmr, ccn, pad, spp, up, bi, be, pre], {"img": iv})
+    mxv, cmrv, ccnv, padv, sppv, upv, biv, bev, prev = outs
+    assert mxv.shape == (2, 1, 8, 8)
+    assert cmrv.shape == (2, 3, 8, 8)
+    assert ccnv.shape == (2, 3, 8, 8)
+    assert padv.shape == (2, 5, 8, 8)
+    assert sppv.shape[0] == 2 and sppv.shape[1] == 3 * (1 + 4)
+    assert upv.shape == (2, 3, 16, 16)
+    assert biv.shape == (2, 3, 4, 4)
+    assert bev.shape[0] == 2          # sequence of blocks
+    assert prev.shape == (2, 3 * 8 * 8)
+
+
+def test_3d_layers_build_and_run():
+    vol = tch.data_layer("vol", size=2 * 4 * 4 * 4)
+    with cfg.build():
+        v5 = fluid.layers.reshape(vol.var, shape=[-1, 2, 4, 4, 4])
+    vol5 = cfg.Layer(v5, parents=[vol])
+    c3 = tch.img_conv3d_layer(vol5, filter_size=3, num_filters=4,
+                              stride=1, padding=1, act="relu")
+    p3 = tch.img_pool3d_layer(c3, pool_size=2, stride=2)
+    vv = np.random.RandomState(1).rand(2, 2 * 4 * 4 * 4).astype("float32")
+    c3v, p3v = _run([c3, p3], {"vol": vv})
+    assert c3v.shape == (2, 4, 4, 4, 4)
+    assert p3v.shape == (2, 4, 2, 2, 2)
+
+
+def test_sequence_family_and_recurrences_run():
+    seq = tch.data_layer("seq", size=6,
+                         type=paddle.data_type.dense_vector_sequence(6))
+    seq2 = tch.data_layer("seq2", size=6,
+                          type=paddle.data_type.dense_vector_sequence(6))
+
+    cat = tch.seq_concat_layer(seq, seq2)
+    rsh = tch.seq_reshape_layer(seq, reshape_size=3)
+    kmax = tch.kmax_seq_score_layer(
+        tch.data_layer("scores", size=1,
+                       type=paddle.data_type.dense_vector_sequence(1)),
+        beam_size=2)
+    rec = tch.recurrent_layer(seq, act=tch.TanhActivation())
+    rc = tch.row_conv_layer(seq, context_len=2)
+    gu = tch.gated_unit_layer(seq, size=5, act=tch.TanhActivation())
+    fm = tch.factorization_machine(seq, factor_size=3)
+
+    rng = np.random.RandomState(2)
+    sv = rng.rand(2, 4, 6).astype("float32")
+    s2v = rng.rand(2, 4, 6).astype("float32")
+    scv = rng.rand(2, 4, 1).astype("float32")
+    lens = np.array([4, 3], "int32")
+    feed = {"seq": sv, "seq@LEN": lens, "seq2": s2v, "seq2@LEN": lens,
+            "scores": scv, "scores@LEN": lens}
+    starts = tch.data_layer("st", size=1)
+    ends = tch.data_layer("en", size=1)
+    ssl = tch.seq_slice_layer(seq, starts, ends)
+    sub = tch.sub_seq_layer(seq, starts,
+                            tch.resize_layer(ends, size=1))
+    feed.update({"st": np.zeros((2, 1), "float32"),
+                 "en": np.full((2, 1), 2.0, "float32")})
+    catv, rshv, kmv, recv, rcv, guv, fmv, sslv, subv = _run(
+        [cat, rsh, kmax, rec, rc, gu, fm, ssl, sub], feed)
+    assert catv.shape[1] == 8          # 4 + 4 timesteps
+    assert rshv.shape[-1] == 3
+    # slice [0, 2): first two steps survive, the rest zeroed
+    np.testing.assert_allclose(sslv[:, :2], sv[:, :2], rtol=1e-6)
+    np.testing.assert_allclose(sslv[:, 2:], 0 * sv[:, 2:], atol=1e-7)
+    assert subv.shape == sslv.shape
+    assert kmv.shape == (2, 2)
+    assert recv.shape == (2, 4, 6)
+    assert rcv.shape == (2, 4, 6)
+    assert guv.shape[-1] == 5
+    assert fmv.shape == (2, 4, 1)      # per-timestep FM on a sequence
+
+
+def test_step_units_run():
+    x4 = tch.data_layer("x4", size=16)    # [B, 4H] for H=4
+    c0 = tch.data_layer("c0", size=4)
+    h = tch.lstm_step_layer(x4, c0, size=4)
+    assert hasattr(h, "state")
+
+    x3 = tch.data_layer("x3", size=12)    # [B, 3H] for H=4
+    h0 = tch.data_layer("h0", size=4)
+    g = tch.gru_step_layer(x3, h0, size=4)
+
+    rng = np.random.RandomState(3)
+    hv, cv, gv = _run(
+        [h, h.state, g],
+        {"x4": rng.rand(2, 16).astype("float32"),
+         "c0": np.zeros((2, 4), "float32"),
+         "x3": rng.rand(2, 12).astype("float32"),
+         "h0": np.zeros((2, 4), "float32")})
+    assert hv.shape == (2, 4) and cv.shape == (2, 4) and gv.shape == (2, 4)
+
+
+def test_cost_layers_run_and_train():
+    x = tch.data_layer("x", size=4)
+    lbl = tch.data_layer("lbl", size=1)
+    left = tch.fc_layer(x, size=1)
+    right = tch.fc_layer(x, size=1)
+    rank = tch.rank_cost(left, right, lbl)
+    hub_r = tch.huber_regression_cost(left, lbl)
+    hub_c = tch.huber_classification_cost(left, lbl)
+    probs = tch.fc_layer(x, size=3, act=tch.SoftmaxActivation())
+    ilbl = tch.data_layer("il", size=0,
+                          type=paddle.data_type.integer_value(3))
+    selfn = tch.cross_entropy_with_selfnorm(probs, ilbl)
+
+    rng = np.random.RandomState(4)
+    feed = {"x": rng.rand(6, 4).astype("float32"),
+            "lbl": rng.randint(0, 2, (6, 1)).astype("float32"),
+            "il": rng.randint(0, 3, (6, 1)).astype("int64")}
+    rv, hrv, hcv, sv = _run([rank, hub_r, hub_c, selfn], feed)
+    for v in (rv, hrv, hcv, sv):
+        assert np.isfinite(v).all() and v.size == 1
+
+
+def test_lambda_cost_ranks():
+    sc = tch.data_layer("sc", size=1,
+                        type=paddle.data_type.dense_vector_sequence(1))
+    rel = tch.data_layer("rel", size=1,
+                         type=paddle.data_type.dense_vector_sequence(1))
+    lam = tch.lambda_cost(sc, rel, NDCG_num=3)
+    perfect = np.array([[[3.], [2.], [1.]]], "float32")
+    reversed_ = np.array([[[1.], [2.], [3.]]], "float32")
+    lens = np.array([3], "int32")
+    good, = _run([lam], {"sc": perfect, "sc@LEN": lens,
+                         "rel": perfect, "rel@LEN": lens})
+    tch.reset_parser()
+    sc = tch.data_layer("sc", size=1,
+                        type=paddle.data_type.dense_vector_sequence(1))
+    rel = tch.data_layer("rel", size=1,
+                         type=paddle.data_type.dense_vector_sequence(1))
+    lam = tch.lambda_cost(sc, rel, NDCG_num=3)
+    bad, = _run([lam], {"sc": reversed_, "sc@LEN": lens,
+                        "rel": perfect, "rel@LEN": lens})
+    assert float(np.asarray(bad).ravel()[0]) > \
+        float(np.asarray(good).ravel()[0])
+
+
+def test_projections_and_operators_in_mixed():
+    x = tch.data_layer("x", size=6)
+    y = tch.data_layer("y", size=6)
+    m1 = tch.mixed_layer(input=[tch.trans_full_matrix_projection(x,
+                                                                 size=4)])
+    m2 = tch.mixed_layer(input=[tch.scaling_projection(x)])
+    m3 = tch.mixed_layer(
+        input=[tch.slice_projection(x, slices=[(0, 2), (4, 6)])])
+    m4 = tch.mixed_layer(input=[tch.dotmul_operator(x, y, scale=2.0)])
+    xv = np.ones((2, 6), "float32")
+    yv = np.full((2, 6), 3.0, "float32")
+    v1_, v2_, v3_, v4_ = _run([m1, m2, m3, m4], {"x": xv, "y": yv})
+    assert v1_.shape == (2, 4)
+    assert v2_.shape == (2, 6)
+    assert v3_.shape == (2, 4)
+    np.testing.assert_allclose(v4_, 6.0 * np.ones((2, 6)), rtol=1e-6)
+
+
+def test_context_projection_window():
+    seq = tch.data_layer("seq", size=2,
+                         type=paddle.data_type.dense_vector_sequence(2))
+    m = tch.mixed_layer(
+        input=[tch.context_projection(seq, context_len=3)])
+    sv = np.arange(2 * 3 * 2, dtype="float32").reshape(2, 3, 2)
+    out, = _run([m], {"seq": sv, "seq@LEN": np.array([3, 3], "int32")})
+    assert out.shape == (2, 3, 6)
+    # middle timestep's window = [t-1, t, t+1] concatenated
+    np.testing.assert_allclose(out[0, 1], sv[0].reshape(-1), rtol=1e-6)
+
+
+def test_detection_layers_build_and_run():
+    img = tch.data_layer("img", size=3 * 16 * 16, height=16, width=16)
+    feat = tch.img_conv_layer(img, filter_size=3, num_filters=4,
+                              num_channels=3, stride=4, padding=1)
+    pb = tch.priorbox_layer(feat, img, aspect_ratio=[2.0],
+                            variance=[0.1, 0.1, 0.2, 0.2],
+                            min_size=[4.0], max_size=[8.0])
+    n_priors_total = None
+    with cfg.build():
+        half = int(pb.var.shape[0]) // 2
+        n_priors_total = half
+    loc = tch.fc_layer(feat, size=n_priors_total * 4)
+    conf = tch.fc_layer(feat, size=n_priors_total * 3)
+    with cfg.build():
+        loc3 = fluid.layers.reshape(loc.var, shape=[0, -1, 4])
+        conf3 = fluid.layers.reshape(conf.var, shape=[0, -1, 3])
+    loc_l = cfg.Layer(loc3, parents=[loc])
+    conf_l = cfg.Layer(conf3, parents=[conf])
+    det = tch.detection_output_layer(loc_l, conf_l, pb, num_classes=3)
+
+    gt = tch.data_layer("gt", size=5,
+                        type=paddle.data_type.dense_vector_sequence(5))
+    loss = tch.multibox_loss_layer(loc_l, conf_l, pb, gt, num_classes=3,
+                                   max_gt_boxes=2)
+
+    rois = tch.data_layer("rois", size=4)
+    roi = tch.roi_pool_layer(feat, rois, pooled_width=2, pooled_height=2,
+                             spatial_scale=0.25)
+
+    rng = np.random.RandomState(5)
+    gtv = np.zeros((2, 2, 5), "float32")
+    gtv[:, :, 0] = 1                        # class 1
+    gtv[:, :, 1:] = rng.rand(2, 2, 4) * 0.5
+    gtv[:, :, 3:] = gtv[:, :, 1:3] + 0.3    # xmax/ymax > xmin/ymin
+    outs = _run([det, loss, roi],
+                {"img": rng.rand(2, 3 * 16 * 16).astype("float32"),
+                 "gt": gtv, "gt@LEN": np.array([2, 2], "int32"),
+                 "rois": np.array([[0, 0, 8, 8],
+                                   [2, 2, 12, 12]], "float32")})
+    assert np.isfinite(outs[1]).all()
+    assert outs[2].shape[-2:] == (2, 2)
+
+
+def test_networks_compose_and_run():
+    seq = tch.data_layer("seq", size=6,
+                         type=paddle.data_type.dense_vector_sequence(6))
+    g1 = tch.simple_gru2(seq, size=4)
+    g2 = tch.gru_group(tch.fc_layer(seq, size=12), size=4)
+    g3 = tch.gru_unit(tch.fc_layer(seq, size=12), size=4)
+    l1 = tch.lstmemory_group(tch.fc_layer(seq, size=16), size=4)
+    l2 = tch.lstmemory_unit(tch.fc_layer(seq, size=16), size=4)
+    bi = tch.bidirectional_gru(seq, size=4)
+    bis = tch.bidirectional_gru(seq, size=4, return_seq=True)
+    att = tch.multi_head_attention(seq, seq, seq, key_proj_size=3,
+                                   value_proj_size=3, head_num=2)
+    tcp = tch.text_conv_pool(seq, context_len=3, hidden_size=5)
+
+    rng = np.random.RandomState(6)
+    sv = rng.rand(2, 4, 6).astype("float32")
+    lens = np.array([4, 4], "int32")
+    outs = _run([g1, g2, g3, l1, l2, bi, bis, att, tcp],
+                {"seq": sv, "seq@LEN": lens})
+    assert outs[0].shape == (2, 4, 4)
+    assert outs[5].shape == (2, 8)          # last-step concat
+    assert outs[6].shape == (2, 4, 8)       # full-seq concat
+    assert outs[7].shape[-1] == 6           # heads*value_proj
+    assert outs[8].shape == (2, 5)
+
+
+def test_image_networks_build():
+    img = tch.data_layer("img", size=3 * 32 * 32, height=32, width=32)
+    a = tch.img_conv_bn_pool(img, filter_size=3, num_filters=4,
+                             pool_size=2, num_channel=3, conv_padding=1,
+                             pool_stride=2,
+                             conv_act=tch.ReluActivation())
+    b = tch.img_separable_conv(img, num_channels=3, num_out_channels=8,
+                               filter_size=3, padding=1,
+                               act=tch.ReluActivation())
+    sv = tch.small_vgg(img, num_channels=3, num_classes=10)
+    vg = tch.vgg_16_network(img, num_channels=3, num_classes=10)
+    # run the two cheap ones; the VGGs are shape-checked at build
+    iv = np.random.RandomState(7).rand(1, 3 * 32 * 32).astype("float32")
+    av, bv = _run([a, b], {"img": iv})
+    assert av.shape == (1, 4, 16, 16)
+    assert bv.shape == (1, 8, 32, 32)
+    assert int(sv.var.shape[-1]) == 10 and int(vg.var.shape[-1]) == 10
+
+
+def test_evaluators_register_and_run():
+    x = tch.data_layer("x", size=3)
+    probs = tch.fc_layer(x, size=3, act=tch.SoftmaxActivation())
+    tch.evaluator_base(probs, name="base_eval")
+    tch.maxid_printer_evaluator(probs, name="maxid_print")
+    g = cfg.graph()
+    names = [n for n, _, _ in g.evaluators]
+    assert "base_eval" in names and "maxid_print" in names
+
+    det = tch.data_layer("det", size=2 * 6)
+    with cfg.build():
+        det3 = fluid.layers.reshape(det.var, shape=[0, 2, 6])
+    gt = tch.data_layer("gtl", size=2 * 5)
+    with cfg.build():
+        gt3 = fluid.layers.reshape(gt.var, shape=[0, 2, 5])
+    m = tch.detection_map_evaluator(cfg.Layer(det3, parents=[det]),
+                                    cfg.Layer(gt3, parents=[gt]),
+                                    class_num=3)
+    assert m is not None
+
+    score = tch.data_layer("s", size=1)
+    lbl = tch.data_layer("l", size=1)
+    qid = tch.data_layer("q", size=1)
+    pn = tch.pnpair_evaluator(score, lbl, qid)
+    rng = np.random.RandomState(8)
+    dv = np.zeros((2, 12), "float32")
+    dv[:, 1] = 0.9                        # (label, score, x1..y2) rows
+    gv = np.zeros((2, 10), "float32")
+    pnv, = _run([cfg.Layer(pn, parents=[])] if not hasattr(pn, "var")
+                else [pn],
+                {"x": rng.rand(4, 3).astype("float32"),
+                 "s": rng.rand(4, 1).astype("float32"),
+                 "l": np.array([[1.], [0.], [1.], [0.]], "float32"),
+                 "q": np.zeros((4, 1), "float32"),
+                 "det": np.tile(dv[:1], (4, 1)),
+                 "gtl": np.tile(gv[:1], (4, 1))})
+    assert np.isfinite(np.asarray(pnv)).all()
+
+
+def test_markers_and_refusals():
+    assert tch.AggregateLevel.TO_NO_SEQUENCE
+    assert tch.ExpandLevel.FROM_NO_SEQUENCE
+    assert tch.LayerType.is_layer_type("fc")
+
+    x = tch.data_layer("x", size=4)
+    si = tch.StaticInput(x, is_seq=False)
+    gi = tch.GeneratedInput(size=8, embedding_name="emb",
+                            embedding_size=4)
+    bi = tch.BeamInput(x, x, x)
+    sub = tch.SubsequenceInput(x)
+    assert si.input is x and gi.size == 8 and bi.gold is x
+    assert sub.input is x
+
+    @tch.layer_support()
+    def passthrough():
+        return 42
+    assert passthrough() == 42
+
+    with pytest.raises(NotImplementedError):
+        tch.sub_nested_seq_layer(x, x)
+    with pytest.raises(NotImplementedError):
+        tch.cross_entropy_over_beam([])

@@ -6,11 +6,14 @@ consumed by the legacy GradientMachine engine
 (``legacy/gserver/layers/``).  Here every call appends fluid-parity ops
 to the same process-global Program the v2 dialect builds
 (``v2/config.py``) — the v1 *API surface* runs on the single TPU
-execution engine.  Curated to the layer set the v1 book/demo configs
-use; the v1 recurrence machinery (``memory``/``recurrent_group``/
-``beam_search``, reference layers.py recurrent_group) is a documented
-design boundary — its capability lives in the fluid-parity
-``DynamicRNN``/``layers.beam_search`` stack (layers/control_flow.py).
+execution engine.  The full reference ``__all__`` is served (the
+parity tail below covers the long tail of v1-only layers); the v1
+recurrence machinery (``memory``/``recurrent_group``/``beam_search``,
+reference layers.py recurrent_group) is a documented design boundary —
+its capability lives in the fluid-parity ``DynamicRNN``/
+``layers.beam_search`` stack (layers/control_flow.py) — and nested-LoD
+names (``sub_nested_seq_layer``) raise with the SURVEY §5 one-level
+ruling.
 
 ``LayerOutput`` is the v2 ``Layer`` handle; the two dialects compose
 (a v1-built layer can feed a v2 call and vice versa).

@@ -27,6 +27,18 @@ def _train(loss, feeds, steps, lr=0.01, opt="sgd", seed=1):
     return losses
 
 
+def _sentiment_batch(seed, n, t, vocab):
+    """Synthetic sentiment task shared by the understand_sentiment
+    variants: label = whether token 7 appears within the valid prefix.
+    Returns the feed dict for a words/label program."""
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(1, vocab, (n, t)).astype("int64")
+    lens = rng.randint(4, t + 1, (n,)).astype("int32")
+    lbl = np.array([1 if 7 in row[:l] else 0
+                    for row, l in zip(ids, lens)], "int64")[:, None]
+    return {"words": ids[:, :, None], "words@LEN": lens, "label": lbl}
+
+
 def test_fit_a_line():
     """Linear regression (book/test_fit_a_line.py) on uci_housing-shaped
     synthetic data."""
@@ -108,12 +120,7 @@ def test_understand_sentiment_conv():
     """Text classification via sequence_conv+pool
     (book/test_understand_sentiment.py convolution_net)."""
     vocab, emb, t = 60, 16, 12
-    rng = np.random.RandomState(4)
-    ids = rng.randint(1, vocab, (128, t)).astype("int64")
-    lens = rng.randint(4, t + 1, (128,)).astype("int32")
-    # label = whether token 7 appears within the valid prefix
-    lbl = np.array([1 if 7 in row[:l] else 0
-                    for row, l in zip(ids, lens)], "int64")[:, None]
+    feed = _sentiment_batch(seed=4, n=128, t=t, vocab=vocab)
     with fluid.program_guard(fluid.Program(), fluid.Program()):
         data = fluid.layers.data("words", shape=[1], dtype="int64",
                                  lod_level=1)
@@ -124,8 +131,6 @@ def test_understand_sentiment_conv():
             act="tanh", pool_type="max")
         pred = fluid.layers.fc(conv, size=2, act="softmax")
         loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
-        feed = {"words": ids[:, :, None], "words@LEN": lens,
-                "label": lbl}
         losses = _train(loss, [feed], steps=60, lr=5e-3, opt="adam")
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
 
@@ -140,3 +145,96 @@ def test_memory_optimize_reports_footprint():
         n = fluid.memory_optimize(fluid.default_main_program())
         assert n > 0
         assert fluid.release_memory(fluid.default_main_program()) == 0
+
+
+def test_image_classification_cifar_resnet():
+    """Cifar image classification with the book's resnet_cifar10
+    (book/test_image_classification.py net_type='resnet')."""
+    from paddle_tpu.models.resnet import resnet_cifar10
+    rng = np.random.RandomState(7)
+    # separable synthetic cifar: class = brightest channel
+    imgs = rng.rand(32, 3, 32, 32).astype("float32") * 0.2
+    lbls = rng.randint(0, 3, (32, 1)).astype("int64")
+    for i, l in enumerate(lbls[:, 0]):
+        imgs[i, l] += 0.8
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        img = fluid.layers.data("pixel", shape=[3, 32, 32])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = resnet_cifar10(img, class_dim=3, depth=20)
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        losses = _train(loss, [{"pixel": imgs, "label": lbls}],
+                        steps=12, lr=3e-3, opt="adam")
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_image_classification_cifar_vgg():
+    """Cifar image classification with the book's VGG
+    (book/test_image_classification.py net_type='vgg')."""
+    from paddle_tpu.models.vgg import vgg16_bn_drop
+    rng = np.random.RandomState(8)
+    imgs = rng.rand(16, 3, 32, 32).astype("float32") * 0.2
+    lbls = rng.randint(0, 3, (16, 1)).astype("int64")
+    for i, l in enumerate(lbls[:, 0]):
+        imgs[i, l] += 0.8
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        img = fluid.layers.data("pixel", shape=[3, 32, 32])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        feats = vgg16_bn_drop(img, class_dim=3)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(feats, label))
+        losses = _train(loss, [{"pixel": imgs, "label": lbls}],
+                        steps=6, lr=1e-3, opt="adam")
+    assert np.isfinite(losses).all()
+
+
+def test_understand_sentiment_stacked_lstm():
+    """Stacked bidirectional-ish LSTM classifier
+    (book/notest_understand_sentiment.py stacked_lstm_net)."""
+    vocab, emb, hid, t = 60, 16, 24, 12
+    feed = _sentiment_batch(seed=9, n=96, t=t, vocab=vocab)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        data = fluid.layers.data("words", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        embedded = fluid.layers.embedding(data, size=[vocab, emb])
+        # the book stacks fc+lstm pairs, pooling the last layer
+        fc1 = fluid.layers.fc(embedded, size=hid * 4,
+                              num_flatten_dims=2)
+        lstm1, _c = fluid.layers.dynamic_lstm(fc1, size=hid * 4)
+        fc2 = fluid.layers.fc(lstm1, size=hid * 4, num_flatten_dims=2)
+        lstm2, _c2 = fluid.layers.dynamic_lstm(fc2, size=hid * 4,
+                                               is_reverse=True)
+        pooled = fluid.layers.concat(
+            [fluid.layers.sequence_pool(lstm1, "max"),
+             fluid.layers.sequence_pool(lstm2, "max")], axis=1)
+        pred = fluid.layers.fc(pooled, size=2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        losses = _train(loss, [feed], steps=40, lr=5e-3, opt="adam")
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_understand_sentiment_dynamic_rnn():
+    """DynamicRNN-cell classifier (the book's dyn_rnn_lstm variant:
+    per-step lstm_unit inside a DynamicRNN block)."""
+    vocab, emb, hid, t = 60, 16, 24, 10
+    feed = _sentiment_batch(seed=10, n=96, t=t, vocab=vocab)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        data = fluid.layers.data("words", shape=[1], dtype="int64",
+                                 lod_level=1)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        sent_emb = fluid.layers.embedding(data, size=[vocab, emb])
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(sent_emb)
+            prev_h = drnn.memory(shape=[hid], value=0.0)
+            prev_c = drnn.memory(shape=[hid], value=0.0)
+            h, c = fluid.layers.lstm_unit(word, prev_h, prev_c)
+            drnn.update_memory(prev_h, h)
+            drnn.update_memory(prev_c, c)
+            drnn.output(h)
+        last = fluid.layers.sequence_pool(drnn(), "last")
+        pred = fluid.layers.fc(last, size=2, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        losses = _train(loss, [feed], steps=40, lr=5e-3, opt="adam")
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# CI driver (the reference's paddle/scripts/paddle_build.sh role):
+# full test suite, API-signature gate, multi-device dryrun, and a bench
+# smoke — everything the round driver checks, runnable locally.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== 1/4 full test suite =="
+python -m pytest tests/ -q
+
+echo "== 2/4 API signature gate =="
+python tools/print_signatures.py > /tmp/api_live.txt
+python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
+
+echo "== 3/4 8-device virtual-mesh dryrun =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "== 4/4 bench smoke (CPU backend, tiny) =="
+python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
+
+echo "CI OK"

@@ -1,0 +1,53 @@
+"""tools/kube_gen_job.py — k8s job generator for multi-host training
+(reference benchmark/fluid/kube_gen_job.py), emitting the
+PADDLE_COORDINATOR/TRAINERS/TRAINER_ID env contract
+parallel.distributed.init_distributed reads."""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import yaml
+
+
+def _load():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "kube_gen_job.py")
+    spec = importlib.util.spec_from_file_location("kube_gen_job", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, path
+
+
+def test_manifests_are_valid_yaml_with_env_contract():
+    mod, path = _load()
+    out = subprocess.run(
+        [sys.executable, path, "--name", "mnist", "--image", "repo/img",
+         "--entry", "python train.py --flag=1", "--hosts", "4",
+         "--tpu_count", "4"],
+        stdout=subprocess.PIPE, text=True, check=True).stdout
+    svc, job = [yaml.safe_load(d) for d in out.split("---")]
+    # headless service: the k8s API's ClusterIP is a string field whose
+    # headless value is the literal string "None"
+    assert svc["kind"] == "Service" and svc["spec"]["clusterIP"] == "None"
+    assert job["kind"] == "Job"
+    spec = job["spec"]
+    assert spec["completions"] == 4 and spec["completionMode"] == "Indexed"
+    pod = spec["template"]["spec"]
+    assert pod["subdomain"] == "mnist"
+    c = pod["containers"][0]
+    env = {e["name"]: e for e in c["env"]}
+    # the runtime's env contract (parallel/distributed.py)
+    assert env["PADDLE_COORDINATOR"]["value"] == "mnist-0.mnist:7164"
+    assert env["PADDLE_TRAINERS"]["value"] == "4"
+    assert "job-completion-index" in str(env["PADDLE_TRAINER_ID"])
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    assert c["command"][-1] == "python train.py --flag=1"
+
+
+def test_gen_job_direct_api():
+    mod, _ = _load()
+    job = mod.gen_job("t", "img", "cmd", hosts=2, tpu_resource=None)
+    assert "limits" not in \
+        job["spec"]["template"]["spec"]["containers"][0]["resources"]

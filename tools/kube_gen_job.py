@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Generate a Kubernetes job spec for multi-host training (reference
+``benchmark/fluid/kube_gen_job.py``: emits pserver/trainer
+ReplicaSet+Job YAML wired by PADDLE_* env vars).
+
+TPU-native form: one indexed Job of N host processes joined through
+``parallel.distributed.init_distributed`` — the same PADDLE_COORDINATOR
+/ PADDLE_TRAINERS / PADDLE_TRAINER_ID env contract the runtime reads
+(parallel/distributed.py).  There is no pserver role to generate; rank
+0's pod DNS name is the coordination service.
+
+    python tools/kube_gen_job.py --name mnist --image my/img \
+        --entry "python train.py" --hosts 4 > job.yaml
+"""
+
+import argparse
+import json
+
+
+def gen_job(name, image, entry, hosts, port=7164, cpu=4, memory="8Gi",
+            tpu_resource=None, tpu_count=0):
+    """Build the Job manifest dict (indexed completion mode: the pod's
+    completion index IS the trainer id)."""
+    coordinator = "%s-0.%s:%d" % (name, name, port)
+    env = [
+        {"name": "PADDLE_COORDINATOR", "value": coordinator},
+        {"name": "PADDLE_TRAINERS", "value": str(hosts)},
+        {"name": "PADDLE_TRAINER_ID",
+         "valueFrom": {"fieldRef": {
+             "fieldPath":
+                 "metadata.annotations['batch.kubernetes.io/"
+                 "job-completion-index']"}}},
+    ]
+    resources = {"requests": {"cpu": str(cpu), "memory": memory}}
+    if tpu_resource and tpu_count:
+        resources["limits"] = {tpu_resource: str(tpu_count)}
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name},
+        "spec": {
+            "completions": hosts,
+            "parallelism": hosts,
+            "completionMode": "Indexed",
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {
+                    "subdomain": name,   # stable pod DNS for rank 0
+                    "restartPolicy": "Never",
+                    "containers": [{
+                        "name": "trainer",
+                        "image": image,
+                        "command": ["sh", "-c", entry],
+                        "ports": [{"containerPort": port}],
+                        "env": env,
+                        "resources": resources,
+                    }],
+                },
+            },
+        },
+    }
+
+
+def gen_service(name, port=7164):
+    """Headless service providing the stable ``<name>-0.<name>`` DNS the
+    coordinator address uses."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": name},
+        "spec": {"clusterIP": "None",
+                 "selector": {"app": name},
+                 "ports": [{"port": port}]},
+    }
+
+
+def _to_yaml(obj, indent=0):
+    """Minimal YAML emitter (no external deps): dicts/lists/scalars."""
+    pad = "  " * indent
+    lines = []
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            if isinstance(v, (dict, list)) and v:
+                lines.append("%s%s:" % (pad, k))
+                lines.append(_to_yaml(v, indent + 1))
+            else:
+                lines.append("%s%s: %s" % (pad, k, _scalar(v)))
+    elif isinstance(obj, list):
+        for item in obj:
+            if isinstance(item, (dict, list)):
+                body = _to_yaml(item, indent + 1).splitlines()
+                first = body[0].strip() if body else ""
+                lines.append("%s- %s" % (pad, first))
+                lines.extend(body[1:])
+            else:
+                lines.append("%s- %s" % (pad, _scalar(item)))
+    else:
+        lines.append("%s%s" % (pad, _scalar(obj)))
+    return "\n".join(lines)
+
+
+def _scalar(v):
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, (int, float)):
+        return str(v)
+    s = str(v)
+    if s == "" or any(c in s for c in ":{}[]#&*!|>'\"%@`") or \
+            s.strip() != s:
+        return json.dumps(s)
+    return s
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--name", required=True)
+    p.add_argument("--image", required=True)
+    p.add_argument("--entry", required=True,
+                   help="training command run in each host pod")
+    p.add_argument("--hosts", type=int, default=1)
+    p.add_argument("--port", type=int, default=7164)
+    p.add_argument("--cpu", type=int, default=4)
+    p.add_argument("--memory", default="8Gi")
+    p.add_argument("--tpu_resource", default="google.com/tpu",
+                   help="device resource name (empty to omit)")
+    p.add_argument("--tpu_count", type=int, default=0)
+    args = p.parse_args()
+    docs = [gen_service(args.name, args.port),
+            gen_job(args.name, args.image, args.entry, args.hosts,
+                    args.port, args.cpu, args.memory,
+                    args.tpu_resource or None, args.tpu_count)]
+    print("\n---\n".join(_to_yaml(d) for d in docs))
+
+
+if __name__ == "__main__":
+    main()

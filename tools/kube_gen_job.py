@@ -110,6 +110,11 @@ def _scalar(v):
     if s == "" or any(c in s for c in ":{}[]#&*!|>'\"%@`") or \
             s.strip() != s:
         return json.dumps(s)
+    try:                       # a numeric-looking STRING must stay a
+        float(s)               # string through YAML (k8s env values
+        return json.dumps(s)   # are strings; bare 4 would parse int)
+    except ValueError:
+        pass
     return s
 
 

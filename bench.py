@@ -233,7 +233,7 @@ def bench_resnet50(args, use_amp=False, per_step_feed=False, infer=False):
             args, lambda img, is_test=False: resnet_imagenet(
                 img, class_dim=1000, depth=50, is_test=is_test),
             "resnet50_images_per_sec", use_amp, per_step_feed,
-            default_batch=16, infer=True)
+            default_batch=16, infer=True, era_infer_img_s=217.69)
 
     # batch 512: fetch-synced A/Bs vs 256 give +3.4%/+5.4% img/s in two
     # run orders (larger reductions/fusions amortize fixed per-step
@@ -403,7 +403,8 @@ def bench_transformer(args, use_amp=False, per_step_feed=False):
 
 def _bench_image_model(args, model_fn, metric_name, use_amp,
                        per_step_feed, default_batch=128, image_size=224,
-                       class_dim=1000, era_ms_per_batch=None, infer=False):
+                       class_dim=1000, era_ms_per_batch=None, infer=False,
+                       era_infer_img_s=None):
     """Shared harness for the image models (vgg, se_resnext, and the
     era-benchmark trio alexnet/googlenet/smallnet): synthetic feeds,
     Momentum, bf16 AMP.
@@ -466,6 +467,10 @@ def _bench_image_model(args, model_fn, metric_name, use_amp,
     if era_ms_per_batch and not infer and batch == default_batch:
         stats["era_ms_per_batch_k40m"] = era_ms_per_batch
         vs = round(era_ms_per_batch / stats["ms_per_batch"], 2)
+    if era_infer_img_s and infer and batch == default_batch:
+        # IntelOptimizedPaddle.md CPU infer rows (bs=16, img/s)
+        stats["era_infer_img_s_xeon"] = era_infer_img_s
+        vs = round(ips / era_infer_img_s, 2)
     name = metric_name + ("_infer" if infer else "")
     return dict({"metric": name + _suffix(use_amp, per_step_feed),
                  "value": round(ips, 2), "unit": "images/sec",
@@ -480,7 +485,8 @@ def bench_vgg(args, use_amp=False, per_step_feed=False, infer=False):
         args, lambda img, is_test=False: vgg16_bn_drop(
             img, class_dim=1000, is_test=is_test),
         "vgg16_images_per_sec", use_amp, per_step_feed,
-        default_batch=16 if infer else 128, infer=infer)
+        default_batch=16 if infer else 128, infer=infer,
+        era_infer_img_s=96.75 if infer else None)
 
 
 def bench_se_resnext(args, use_amp=False, per_step_feed=False, infer=False):
@@ -945,6 +951,11 @@ def main():
             ("alexnet", []),
             ("googlenet", []),
             ("smallnet", []),
+            # IntelOptimizedPaddle.md CPU infer rows (forward-only,
+            # bs=16): vs_baseline = our img/s over the published Xeon
+            # number
+            ("resnet50", ["--infer"]),
+            ("vgg", ["--infer"]),
         ]
         results = []
         for i, (model, extra) in enumerate(runs):

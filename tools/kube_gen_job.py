@@ -110,9 +110,20 @@ def _scalar(v):
     if s == "" or any(c in s for c in ":{}[]#&*!|>'\"%@`") or \
             s.strip() != s:
         return json.dumps(s)
-    try:                       # a numeric-looking STRING must stay a
-        float(s)               # string through YAML (k8s env values
-        return json.dumps(s)   # are strings; bare 4 would parse int)
+    # strings YAML would type as something else must stay strings
+    # (k8s env values are strings; bare `4`, `true`, `0x1F` would
+    # parse as int/bool/int)
+    if s.lower() in ("true", "false", "yes", "no", "on", "off",
+                     "null", "none", "~"):
+        return json.dumps(s)
+    try:
+        float(s)
+        return json.dumps(s)
+    except ValueError:
+        pass
+    try:
+        int(s, 0)              # hex/octal/binary literals
+        return json.dumps(s)
     except ValueError:
         pass
     return s

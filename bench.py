@@ -1,4 +1,9 @@
-"""paddle_tpu benchmark CLI — prints ONE JSON line for the driver.
+"""paddle_tpu benchmark CLI — emits driver-parseable JSON on stdout.
+
+Single-model invocations print ONE JSON line.  The auto ladder prints
+one enriched primary line after EVERY completed rung — the LAST line is
+authoritative (``ladder_complete: true`` when the ladder finished) — so
+a driver-side timeout kills rungs, never the artifact.
 
 Methodology mirrors the reference's ``benchmark/fluid/fluid_benchmark.py``
 (args.py: ``--iterations``, ``--skip_batch_num`` warmup; per-batch
@@ -54,7 +59,9 @@ FLOPS_PER_ITEM = {
 
 # min-of-windows is the estimator; the shared tunneled chip's noise is
 # +/-2% between invocations (and load is bursty), so more windows
-# tighten the min's variance — 7 spans ~70s of chip time per rung
+# tighten the min's variance — 7 spans ~70s of chip time per rung.
+# The auto ladder overrides this per rung (--n_windows): the headline
+# keeps 7, secondary rungs run 3 so the ladder fits the driver budget.
 N_WINDOWS = 7
 
 
@@ -294,7 +301,7 @@ def bench_resnet50(args, use_amp=False, per_step_feed=False, infer=False):
                  "vs_baseline": round(ips / RESNET_TARGET, 4)}, **stats)
 
 
-def _jpeg_pipeline(batch, rng):
+def _jpeg_pipeline(batch, rng, num_workers=8):
     """A REAL input pipeline for the reader-included path: JPEG-encoded
     images in a chunked recordio file, scanned and decoded by a pool of
     worker processes (reader.creator.open_recordio_files — the
@@ -333,7 +340,7 @@ def _jpeg_pipeline(batch, rng):
         # repeat=True: one persistent worker pool streams epochs forever
         # (no per-epoch re-fork inside the timed windows); the daemon
         # workers die with the bench process
-        r = open_recordio_files([path], num_workers=8,
+        r = open_recordio_files([path], num_workers=num_workers,
                                 chunks_per_task=1, mapper=decode,
                                 repeat=True)
         imgs, labels = [], []
@@ -346,6 +353,56 @@ def _jpeg_pipeline(batch, rng):
                                            "int64").reshape(-1, 1)}
                 imgs, labels = [], []
     return batch_reader
+
+
+def bench_reader_capacity(args):
+    """Host-side input-pipeline capacity: the full jpeg->tensor pipeline
+    (recordio scan + multi-process decode + batch assembly) into a null
+    sink, NO device involved (VERDICT r4 #6).  Answers "could the
+    8-worker pipeline feed a local chip at its ~2,500 img/s demand
+    rate?" — reported next to the demand rate, with per-worker decode
+    throughput and the host's core count so the projection to a real
+    multi-core host is machine-readable.  Reference analog:
+    operators/reader/open_files_op.cc multithreaded ingestion."""
+    batch = args.batch_size or 128
+    rng = np.random.RandomState(0)
+    # pool size matched to the host: oversubscribing a small host with
+    # the default 8 workers measures IPC thrash, not pipeline capacity
+    cores = len(os.sched_getaffinity(0))
+    workers = min(8, cores)
+    stream = _jpeg_pipeline(batch, rng, num_workers=workers)()
+    # warmup: worker-pool spinup + first chunks in flight
+    for _ in range(3):
+        next(stream)
+    windows = []
+    n_batches = 8
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            next(stream)
+        windows.append(n_batches * batch / (time.perf_counter() - t0))
+    ips = max(windows)
+    # single-worker decode rate, measured inline (no pool): the unit of
+    # scaling — capacity ~= per_worker * min(workers, host_cores)
+    import cv2
+    im = rng.randint(0, 256, (224, 224, 3), "uint8")
+    ok, enc = cv2.imencode(".jpg", im)
+    assert ok
+    buf = enc.tobytes()
+    t0 = time.perf_counter()
+    n_dec = 200
+    for _ in range(n_dec):
+        d = cv2.imdecode(np.frombuffer(buf, np.uint8), cv2.IMREAD_COLOR)
+        d.transpose(2, 0, 1)
+    per_worker = n_dec / (time.perf_counter() - t0)
+    demand = 2500.0   # the chip's bf16 ResNet-50 demand rate (img/s)
+    return {"metric": "reader_capacity_img_s", "value": round(ips, 2),
+            "unit": "images/sec", "vs_baseline": round(ips / demand, 4),
+            "demand_img_s": demand, "host_cores": cores,
+            "pool_workers": workers,
+            "per_worker_decode_img_s": round(per_worker, 2),
+            "projected_8core_img_s": round(per_worker * 8, 2),
+            "n_windows": len(windows)}
 
 
 def bench_transformer(args, use_amp=False, per_step_feed=False):
@@ -869,7 +926,7 @@ def main():
                             "transformer_realdist", "longctx", "vgg",
                             "se_resnext", "stacked_lstm",
                             "machine_translation", "alexnet", "googlenet",
-                            "smallnet"])
+                            "smallnet", "reader_capacity"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -894,9 +951,26 @@ def main():
     p.add_argument("--exact_mfu", action="store_true",
                    help="also report XLA cost-analysis exact flops/bytes"
                         " per step (one extra compile per rung)")
+    p.add_argument("--n_windows", type=int, default=0,
+                   help="override the measurement-window count for this"
+                        " invocation (auto ladder trims secondary rungs"
+                        " to 3)")
+    p.add_argument("--budget_s", type=float,
+                   default=float(os.environ.get("BENCH_BUDGET_S", "1100")),
+                   help="global wall-clock budget for the auto ladder;"
+                        " rungs that don't fit are listed in 'omitted'"
+                        " (the primary JSON line is reprinted after every"
+                        " rung so a hard kill still leaves an artifact)")
     args = p.parse_args()
-    global EXACT_MFU
+    global EXACT_MFU, N_WINDOWS
     EXACT_MFU = args.exact_mfu
+    if args.n_windows > 0:
+        N_WINDOWS = args.n_windows
+
+    if args.model == "reader_capacity":
+        # pure host-side pipeline measurement: no device, no jax client
+        print(json.dumps(bench_reader_capacity(args)))
+        return
 
     if args.pallas or args.fast_prng:
         import paddle_tpu as fluid
@@ -924,6 +998,17 @@ def main():
         # degrades later entries >20x (stale executables/buffers from
         # earlier ladder rungs), and isolation is the honest methodology
         # anyway (fluid_benchmark runs one model per invocation).
+        #
+        # r5 redesign (VERDICT r4 #1: BENCH_r04 was an rc=124 timeout
+        # with NO parsed line): the ladder now (a) REPRINTS the full
+        # primary JSON line after EVERY rung, so a timeout kills rungs,
+        # never the artifact; (b) runs under a global --budget_s —
+        # rungs that don't fit are listed in "omitted", not attempted;
+        # (c) orders scored rungs first and marks everything that is
+        # not a first-class scored comparison "informational": true
+        # (fp32 = dtype-ruling rungs, era/infer = load-noise-hostage
+        # rungs per PERF.md, with_reader = tunnel-bound, longctx = a
+        # pallas-vs-xla A/B with no era target).
         import subprocess
         import sys
 
@@ -932,35 +1017,109 @@ def main():
         # shapes (101.6k vs 65.2k tok/s true), and the rbg PRNG saves the
         # threefry dropout-mask cost (135.9k with both).  --pallas stays
         # available for long-context/memory-bound regimes.
+        # (model, extra, informational, per-rung cap seconds)
         runs = [
+            # --- scored rungs (compute-bound; PERF.md measured them
+            # moving <1% under host load) ---
             # headline carries the XLA-exact flops/bytes accounting
             # (one extra compile; errors degrade to a field, not a
-            # failed rung)
-            ("resnet50", ["--exact_mfu"]),
-            ("resnet50", ["--fp32_only"]),
-            ("transformer", ["--fast_prng"]),
-            ("transformer", ["--fp32_only", "--fast_prng"]),
-            ("resnet50", ["--with_reader"]),
-            ("transformer_realdist", ["--fast_prng"]),
-            # compile-heavy; steps themselves are fast
+            # failed rung) and the full 7 windows
+            ("resnet50", ["--exact_mfu", "--n_windows", "7"], False, 900),
+            ("transformer", ["--fast_prng", "--n_windows", "5"],
+             False, 600),
+            ("transformer_realdist", ["--fast_prng", "--n_windows", "3"],
+             False, 600),
+            # --- informational rungs ---
+            # fp32: the A100 comparison config is bf16 (BASELINE.md
+            # ruling; fp32 is 2.12x HBM bytes on a chip with less
+            # bandwidth — PERF.md roofline proof)
+            ("resnet50", ["--fp32_only", "--n_windows", "3"], True, 480),
+            ("transformer",
+             ["--fp32_only", "--fast_prng", "--n_windows", "3"],
+             True, 480),
+            # host-side pipeline capacity (no device)
+            ("reader_capacity", [], True, 300),
+            # tunnel-bound on this setup (PERF.md: reader matches
+            # synthetic off-tunnel)
+            ("resnet50", ["--with_reader", "--n_windows", "3"],
+             True, 480),
+            # pallas-vs-xla A/B at T=4096; compile-heavy
             ("longctx", ["--iterations", "8", "--skip_batch_num", "2",
-                         "--longctx_t", "4096"]),
+                         "--longctx_t", "4096", "--n_windows", "3"],
+             True, 600),   # rung_name special-cases this to longctx_t4096
             # the reference's own era headline benchmarks
             # (benchmark/README.md K40m ms/batch): vs_baseline here =
-            # published_ms / measured_ms at the published batch size
-            ("alexnet", []),
-            ("googlenet", []),
-            ("smallnet", []),
+            # published_ms / measured_ms at the published batch size.
+            # Small nets are dispatch-bound and host-load-sensitive
+            # (PERF.md: smallnet swings 0.89x-3.9x) => informational.
+            ("alexnet", ["--n_windows", "3"], True, 300),
+            ("googlenet", ["--n_windows", "3"], True, 300),
+            ("smallnet", ["--n_windows", "3"], True, 300),
             # IntelOptimizedPaddle.md CPU infer rows (forward-only,
             # bs=16): vs_baseline = our img/s over the published Xeon
             # number
-            ("resnet50", ["--infer"]),
-            ("vgg", ["--infer"]),
+            ("resnet50", ["--infer", "--n_windows", "3"], True, 300),
+            ("vgg", ["--infer", "--n_windows", "3"], True, 300),
         ]
-        results = []
-        for i, (model, extra) in enumerate(runs):
-            if i:
+
+        t_start = time.monotonic()
+
+        def remaining():
+            return args.budget_s - (time.monotonic() - t_start)
+
+        def emit(results, omitted, done=False):
+            primary = dict(results[0]) if results else {
+                "metric": "resnet50_images_per_sec_bf16", "value": 0.0,
+                "unit": "images/sec", "vs_baseline": 0.0,
+                "error": "no rung completed"}
+            if len(results) > 1:
+                primary["extra_metrics"] = results[1:]
+            if omitted:
+                primary["omitted"] = list(omitted)
+            primary["elapsed_s"] = round(time.monotonic() - t_start, 1)
+            primary["ladder_complete"] = done
+            print(json.dumps(primary), flush=True)
+
+        def rung_name(model, extra):
+            if model == "longctx":
+                return "longctx_t4096"
+            drop = {"--n_windows", "--iterations", "--skip_batch_num"}
+            return model + "".join(
+                a.replace("--", "_") for a in extra
+                if a.startswith("--") and a not in drop)
+
+        def host_load():
+            # sampled per gated rung, not once up front: the ladder runs
+            # for many minutes and the load picture changes under it
+            try:
+                return os.getloadavg()[0] / max(
+                    1, len(os.sched_getaffinity(0)))
+            except OSError:
+                return 0.0
+
+        results, omitted = [], []
+        first = True
+        for model, extra, informational, cap in runs:
+            name = rung_name(model, extra)
+            # informational rungs only run on remaining budget; a rung
+            # that cannot finish inside the budget is omitted up front
+            min_need = 90 if informational else 150
+            if remaining() < min_need:
+                omitted.append(name)
+                continue
+            # era/infer rungs are load-noise hostages (PERF.md): skip
+            # them when the host is busy AT RUNG TIME rather than
+            # record nonsense ratios
+            if informational and (
+                    model in ("alexnet", "googlenet", "smallnet")
+                    or "--infer" in extra):
+                load = host_load()
+                if load > 1.5:
+                    omitted.append(name + "#host_load=%.2f" % load)
+                    continue
+            if not first:
                 time.sleep(10)   # let the previous client release the chip
+            first = False
             cmd = [sys.executable, __file__, "--model", model,
                    "--device", args.device,
                    "--iterations", str(args.iterations),
@@ -968,14 +1127,26 @@ def main():
             if args.batch_size:
                 cmd += ["--batch_size", str(args.batch_size)]
             detail = None
-            for attempt in range(2):   # one retry: tunnel errors are
-                try:                   # transient (remote_compile drops)
+            # one retry for scored rungs only (tunnel errors are
+            # transient), and only while the budget allows it
+            max_attempts = 2 if not informational else 1
+            for attempt in range(max_attempts):
+                timeout_s = min(cap, max(60, remaining() - 20))
+                try:
                     out = subprocess.run(
                         cmd, stdout=subprocess.PIPE,
-                        stderr=subprocess.PIPE, text=True, timeout=2400,
-                        check=True).stdout
-                    results.append(
-                        json.loads(out.strip().splitlines()[-1]))
+                        stderr=subprocess.PIPE, text=True,
+                        timeout=timeout_s, check=True).stdout
+                    r = json.loads(out.strip().splitlines()[-1])
+                    if informational:
+                        r["informational"] = True
+                        if "--fp32_only" in extra:
+                            r["ruling"] = (
+                                "fp32 is informational: the A100 "
+                                "comparison config is bf16 (BASELINE.md; "
+                                "fp32 = 2.12x HBM bytes, PERF.md "
+                                "roofline)")
+                    results.append(r)
                     detail = None
                     break
                 except Exception as e:  # noqa: BLE001 — keep the ladder
@@ -983,16 +1154,26 @@ def main():
                     stderr = getattr(e, "stderr", None)
                     if stderr:
                         detail += " | stderr: " + stderr[-400:]
-                    if attempt == 0:
+                    if isinstance(e, subprocess.TimeoutExpired):
+                        # a rung that hit its cap won't fit in the
+                        # (smaller) remaining budget either — retrying
+                        # would only starve the later scored rungs
+                        break
+                    if attempt + 1 < max_attempts and remaining() > 120:
                         time.sleep(20)   # settle before the one retry
+                    else:
+                        break
             if detail is not None:
-                results.append({"metric": "%s%s_error" % (model,
-                                "".join(extra).replace("--", "_")),
+                results.append({"metric": name + "_error",
                                 "value": 0.0, "unit": "error",
-                                "vs_baseline": 0.0, "error": detail[:600]})
-        primary = dict(results[0])
-        primary["extra_metrics"] = results[1:]
-        print(json.dumps(primary))
+                                "vs_baseline": 0.0,
+                                "informational": informational,
+                                "error": detail[:600]})
+            # reprint the enriched primary after every rung: the
+            # artifact is whatever line was printed last when the
+            # driver's clock runs out
+            emit(results, omitted)
+        emit(results, omitted, done=True)
         return
 
     _INFER_MODELS = {"resnet50", "vgg", "se_resnext", "alexnet",

@@ -265,6 +265,12 @@ def bench_resnet50(args, use_amp=False, per_step_feed=False, infer=False):
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         pred = resnet_imagenet(img, class_dim=1000, depth=50)
         loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        if args.nhwc:
+            import sys
+            n = fluid.transpiler.convert_to_nhwc(
+                fluid.default_main_program())
+            print("# convert_to_nhwc: %d convs converted" % n,
+                  file=sys.stderr)
         if args.fuse_conv_bn:
             import sys
             n = fluid.transpiler.fuse_conv_bn(fluid.default_main_program())
@@ -942,6 +948,10 @@ def main():
     p.add_argument("--fuse_conv_bn", action="store_true",
                    help="apply transpiler.fuse_conv_bn to the ResNet "
                         "program (fused Pallas 1x1-conv+BN kernels)")
+    p.add_argument("--nhwc", action="store_true",
+                   help="apply transpiler.convert_to_nhwc to the ResNet "
+                        "program (whole-trunk NHWC layout; composes "
+                        "with --fuse_conv_bn)")
     p.add_argument("--fast_prng", action="store_true",
                    help="rbg counter PRNG for in-graph randomness")
     p.add_argument("--infer", action="store_true",
@@ -1030,6 +1040,9 @@ def main():
             ("transformer_realdist", ["--fast_prng", "--n_windows", "3"],
              False, 600),
             # --- informational rungs ---
+            # host-side pipeline capacity first: no device, ~60s, and
+            # VERDICT r4 #6 wants it in the artifact every round
+            ("reader_capacity", [], True, 300),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -1037,8 +1050,6 @@ def main():
             ("transformer",
              ["--fp32_only", "--fast_prng", "--n_windows", "3"],
              True, 480),
-            # host-side pipeline capacity (no device)
-            ("reader_capacity", [], True, 300),
             # tunnel-bound on this setup (PERF.md: reader matches
             # synthetic off-tunnel)
             ("resnet50", ["--with_reader", "--n_windows", "3"],
@@ -1201,8 +1212,9 @@ def main():
     # artifact (metric names stay stable across rounds)
     result["pallas"] = bool(args.pallas)
     result["fast_prng"] = bool(args.fast_prng)
-    # recorded unconditionally; the pass only applies to the resnet model
+    # recorded unconditionally; the passes only apply to the resnet model
     result["fuse_conv_bn"] = bool(args.fuse_conv_bn)
+    result["nhwc"] = bool(args.nhwc)
     print(json.dumps(result))
 
 

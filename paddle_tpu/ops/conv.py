@@ -36,22 +36,39 @@ def _conv_infer_nd(nd):
         strides = int_list(op.attrs.get("strides", 1), nd)
         pads = int_list(op.attrs.get("paddings", 0), nd)
         dils = int_list(op.attrs.get("dilations", 1), nd)
+        nhwc = op.attrs.get("data_format", "NCHW") == "NHWC" and nd == 2
         out_c = w.shape[0]
+        sp0 = 1 if nhwc else 2
         spatial = [
-            _conv_out_dim(x.shape[2 + i], w.shape[2 + i], pads[i], strides[i],
-                          dils[i])
+            _conv_out_dim(x.shape[sp0 + i], w.shape[2 + i], pads[i],
+                          strides[i], dils[i])
             for i in range(nd)
         ]
-        set_output(op, block, "Output",
-                   (x.shape[0], out_c, *spatial), x.dtype)
+        if nhwc:
+            set_output(op, block, "Output",
+                       (x.shape[0], *spatial, out_c), x.dtype)
+        else:
+            set_output(op, block, "Output",
+                       (x.shape[0], out_c, *spatial), x.dtype)
     return infer
 
 
 def _conv_compute_nd(nd):
-    dn = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
-
     def compute(ins, attrs, ctx, op_index):
         x, w = ins["Input"][0], ins["Filter"][0]
+        # NHWC (transpiler.layout.convert_to_nhwc trunk layout): the
+        # activation is feature-last; the filter STAYS OIHW in the
+        # program (checkpoint/API parity) and transposes to HWIO here —
+        # an O(C*O*k*k)-byte shuffle XLA schedules off the critical
+        # path, vs. the O(B*H*W*C) activation transposes the NCHW
+        # boundary form would materialize.
+        nhwc = attrs.get("data_format", "NCHW") == "NHWC" and nd == 2
+        if nhwc:
+            dn = ("NHWC", "HWIO", "NHWC")
+            w = jnp.transpose(w, (2, 3, 1, 0))
+        else:
+            dn = ("NCHW", "OIHW", "NCHW") if nd == 2 \
+                else ("NCDHW", "OIDHW", "NCDHW")
         strides = int_list(attrs.get("strides", 1), nd)
         pads = int_list(attrs.get("paddings", 0), nd)
         dils = int_list(attrs.get("dilations", 1), nd)

@@ -53,9 +53,15 @@ def _to_3d(x):
 
 # -- batch_stats ------------------------------------------------------------
 
+def _c_axis(attrs, ndim):
+    # channel axis under the op's data_layout (NHWC = trunk converted by
+    # transpiler.layout.convert_to_nhwc)
+    return ndim - 1 if attrs.get("data_layout", "NCHW") == "NHWC" else 1
+
+
 def _batch_stats_infer(op, block):
     x = in_var(op, block, "X")
-    c = x.shape[1]
+    c = x.shape[_c_axis(op.attrs, len(x.shape))]
     set_output(op, block, "BatchMean", (c,), "float32")
     set_output(op, block, "BatchVar", (c,), "float32")
 
@@ -65,9 +71,10 @@ def _batch_stats_compute(ins, attrs, ctx, op_index):
     from .norm import shifted_one_pass_stats
 
     x = ins["X"][0]
-    red = tuple(i for i in range(x.ndim) if i != 1)
+    ca = _c_axis(attrs, x.ndim)
+    red = tuple(i for i in range(x.ndim) if i != ca)
     bshape = [1] * x.ndim
-    bshape[1] = x.shape[1]
+    bshape[ca] = x.shape[ca]
     xf = x.astype(jnp.float32)
     if flag("bn_two_pass"):
         # exact two-pass form (same escape hatch as ops/norm.py)
@@ -107,9 +114,10 @@ def _stats_finalize_compute(ins, attrs, ctx, op_index):
     if ref is not None:
         # per-channel element count from the referenced activation's
         # trace-time shape (the batch dim is -1 at transpile time)
+        ca = _c_axis(attrs, ref.ndim)
         cnt = 1.0
         for i, d in enumerate(ref.shape):
-            if i != 1:
+            if i != ca:
                 cnt *= d
     else:
         cnt = float(attrs["count"])
@@ -162,8 +170,9 @@ def _bn_apply_compute(ins, attrs, ctx, op_index):
     gamma = ins["Scale"][0].astype(jnp.float32)
     beta = ins["Bias"][0].astype(jnp.float32)
     eps = attrs.get("epsilon", 1e-5)
+    ca = _c_axis(attrs, x.ndim)
     bshape = [1] * x.ndim
-    bshape[1] = x.shape[1]
+    bshape[ca] = x.shape[ca]
     rstd = lax.rsqrt(var + eps)
     y = (x.astype(jnp.float32) - mean.reshape(bshape)) \
         * (rstd * gamma).reshape(bshape) + beta.reshape(bshape)
@@ -178,12 +187,19 @@ register_op("bn_apply", ["X", "BatchMean", "BatchVar", "Scale", "Bias"],
 
 # -- bn_act_conv2d ----------------------------------------------------------
 
+def _bac_nhwc(attrs):
+    return attrs.get("data_format", "NCHW") == "NHWC"
+
+
 def _bac_infer(op, block):
     x = in_var(op, block, "X")
     w = in_var(op, block, "Filter")
     o = w.shape[0]
-    set_output(op, block, "Out", (x.shape[0], o, x.shape[2], x.shape[3]),
-               x.dtype)
+    if _bac_nhwc(op.attrs):
+        out_shape = (x.shape[0], x.shape[1], x.shape[2], o)
+    else:
+        out_shape = (x.shape[0], o, x.shape[2], x.shape[3])
+    set_output(op, block, "Out", out_shape, x.dtype)
     set_output(op, block, "SumOut", (o,), "float32")
     set_output(op, block, "SumSqOut", (o,), "float32")
 
@@ -191,7 +207,8 @@ def _bac_infer(op, block):
 def _bac_args(ins, attrs):
     x = ins["X"][0]
     filt = ins["Filter"][0]
-    c, o = x.shape[1], filt.shape[0]
+    c = x.shape[3] if _bac_nhwc(attrs) else x.shape[1]
+    o = filt.shape[0]
     apply_bn = bool(attrs.get("apply_bn", True))
     if apply_bn:
         mean = ins["BatchMean"][0].astype(jnp.float32)
@@ -213,11 +230,26 @@ def _bac_args(ins, attrs):
 def _bac_compute(ins, attrs, ctx, op_index):
     from .pallas import conv_bn, interpret_mode
     x, w2, mean, var, gamma, beta, shift, apply_bn = _bac_args(ins, attrs)
-    b, c, h, wd = x.shape
-    o = w2.shape[0]
     act = attrs.get("act", "")
     with_stats = bool(attrs.get("with_stats", True))
     eps = attrs.get("epsilon", 1e-5)
+    if _bac_nhwc(attrs):
+        # NHWC trunk: [B,H,W,C] -> [M,C] is free; one dense matmul
+        b, h, wd, c = x.shape
+        o = w2.shape[0]
+        m = b * h * wd
+        if conv_bn.supported(1, c, o, m, x.dtype):
+            z2, s, ss = conv_bn.bn_act_matmul_nhwc(
+                x.reshape(m, c), w2.T, mean, var, gamma, beta, shift,
+                eps, act, apply_bn, with_stats, interpret_mode(ctx))
+            return {"Out": z2.reshape(b, h, wd, o), "SumOut": s,
+                    "SumSqOut": ss}
+        z, s, ss = _bac_xla_fwd_nhwc(x, w2, mean, var, gamma, beta,
+                                     shift, eps, act, apply_bn,
+                                     with_stats)
+        return {"Out": z, "SumOut": s, "SumSqOut": ss}
+    b, c, h, wd = x.shape
+    o = w2.shape[0]
     if conv_bn.supported(b, c, o, h * wd, x.dtype):
         z3, s, ss = conv_bn.bn_act_matmul(
             _to_3d(x), w2, mean, var, gamma, beta, shift, eps, act,
@@ -228,6 +260,32 @@ def _bac_compute(ins, attrs, ctx, op_index):
     z, s, ss = _bac_xla_fwd(x, w2, mean, var, gamma, beta, shift, eps,
                             act, apply_bn, with_stats)
     return {"Out": z, "SumOut": s, "SumSqOut": ss}
+
+
+def _bac_xla_fwd_nhwc(x, w2, mean, var, gamma, beta, shift, eps, act,
+                      apply_bn, with_stats):
+    b, h, wd, c = x.shape
+    o = w2.shape[0]
+    if apply_bn:
+        rstd = lax.rsqrt(var + eps)
+        xn = (x.astype(jnp.float32) - mean) * (rstd * gamma) + beta
+        if act == "relu":
+            xn = jnp.maximum(xn, 0.0)
+        xn = xn.astype(x.dtype)
+    else:
+        xn = jnp.maximum(x, jnp.zeros_like(x)) if act == "relu" else x
+    z2 = jax.lax.dot_general(
+        xn.reshape(b * h * wd, c), w2.T, (((1,), (0,)), ((), ())),
+        preferred_element_type=x.dtype)            # [M, O]
+    z = z2.reshape(b, h, wd, o)
+    if with_stats:
+        zf = z2.astype(jnp.float32) - shift
+        s = jnp.sum(zf, axis=0)
+        ss = jnp.sum(zf * zf, axis=0)
+    else:
+        s = jnp.zeros((o,), jnp.float32)
+        ss = jnp.zeros((o,), jnp.float32)
+    return z, s, ss
 
 
 def _bac_xla_fwd(x, w2, mean, var, gamma, beta, shift, eps, act, apply_bn,
@@ -304,7 +362,6 @@ def _bac_grad_infer(gop, block):
 def _bac_grad_compute(ins, attrs, ctx, op_index):
     from .pallas import conv_bn, interpret_mode
     x, w2, mean, var, gamma, beta, shift, apply_bn = _bac_args(ins, attrs)
-    b, c, h, wd = x.shape
     o = w2.shape[0]
     act = attrs.get("act", "")
     with_stats = bool(attrs.get("with_stats", True))
@@ -322,23 +379,48 @@ def _bac_grad_compute(ins, attrs, ctx, op_index):
     if dz4 is None:
         dz4 = jnp.zeros_like(z4)
 
-    if conv_bn.supported(b, c, o, h * wd, x.dtype):
-        rstd = lax.rsqrt(var + eps)
-        dx3, dw, dgamma, dbeta = conv_bn._bwd_call(
-            _to_3d(x), w2, _to_3d(z4), _to_3d(dz4).astype(x.dtype),
-            dsum, dsumsq, mean, rstd, gamma, beta, shift, act, apply_bn,
-            with_stats and have_stats_ct, interpret_mode(ctx))
-        dx = dx3.reshape(b, c, h, wd)
-        dmean, dvar = conv_bn.stats_grads(apply_bn, gamma, rstd, dgamma,
-                                          dbeta)
-    else:
-        def fwd(x, w2, mean, var, gamma, beta):
-            return _bac_xla_fwd(x, w2, mean, var, gamma, beta, shift, eps,
-                                act, apply_bn, with_stats)
+    if _bac_nhwc(attrs):
+        b, h, wd, c = x.shape
+        m = b * h * wd
+        if conv_bn.supported(1, c, o, m, x.dtype):
+            rstd = lax.rsqrt(var + eps)
+            dx2, dwT, dgamma, dbeta = conv_bn._bwd_call_nhwc(
+                x.reshape(m, c), w2.T, z4.reshape(m, o),
+                dz4.reshape(m, o).astype(x.dtype), dsum, dsumsq, mean,
+                rstd, gamma, beta, shift, act, apply_bn,
+                with_stats and have_stats_ct, interpret_mode(ctx))
+            dx = dx2.reshape(b, h, wd, c)
+            dw = dwT.T
+            dmean, dvar = conv_bn.stats_grads(apply_bn, gamma, rstd,
+                                              dgamma, dbeta)
+        else:
+            def fwd(x, w2, mean, var, gamma, beta):
+                return _bac_xla_fwd_nhwc(x, w2, mean, var, gamma, beta,
+                                         shift, eps, act, apply_bn,
+                                         with_stats)
 
-        _, vjp = jax.vjp(fwd, x, w2, mean, var, gamma, beta)
-        dx, dw, dmean, dvar, dgamma, dbeta = vjp(
-            (dz4, dsum, dsumsq))
+            _, vjp = jax.vjp(fwd, x, w2, mean, var, gamma, beta)
+            dx, dw, dmean, dvar, dgamma, dbeta = vjp((dz4, dsum, dsumsq))
+    else:
+        b, c, h, wd = x.shape
+        if conv_bn.supported(b, c, o, h * wd, x.dtype):
+            rstd = lax.rsqrt(var + eps)
+            dx3, dw, dgamma, dbeta = conv_bn._bwd_call(
+                _to_3d(x), w2, _to_3d(z4), _to_3d(dz4).astype(x.dtype),
+                dsum, dsumsq, mean, rstd, gamma, beta, shift, act,
+                apply_bn, with_stats and have_stats_ct,
+                interpret_mode(ctx))
+            dx = dx3.reshape(b, c, h, wd)
+            dmean, dvar = conv_bn.stats_grads(apply_bn, gamma, rstd,
+                                              dgamma, dbeta)
+        else:
+            def fwd(x, w2, mean, var, gamma, beta):
+                return _bac_xla_fwd(x, w2, mean, var, gamma, beta, shift,
+                                    eps, act, apply_bn, with_stats)
+
+            _, vjp = jax.vjp(fwd, x, w2, mean, var, gamma, beta)
+            dx, dw, dmean, dvar, dgamma, dbeta = vjp(
+                (dz4, dsum, dsumsq))
     dfilt = dw.reshape(o, c, 1, 1).astype(filt.dtype)
     out = {"GRAD::X": dx, "GRAD::Filter": dfilt}
     if apply_bn:

@@ -273,6 +273,220 @@ def _bwd_call(x3, w, z3, dz3, dsum, dsumsq, mean, rstd, gamma, beta,
       *p, sh)
 
 
+# -- NHWC-native kernels ----------------------------------------------------
+#
+# Under transpiler.layout.convert_to_nhwc the trunk activation flattens
+# to [M = B*H*W, C] for FREE, and the fused 1x1 layer is ONE dense
+# matmul z[M, O] = act(norm(x[M, C])) @ w[C, O] — no per-batch
+# fragmentation (the NCHW-native kernels' HW=196/49 under-filled the
+# 128-lane tile) and no boundary transposes (the original [M, C]
+# design's 2.4x loss).  Channels ride the lane dim, so the per-channel
+# BN params are natural [1, C] lane vectors.
+
+def _fwd_kernel_nhwc(x_ref, w_ref, mean_ref, rstd_ref, gamma_ref,
+                     beta_ref, shift_ref, z_ref, sum_ref, sumsq_ref, *,
+                     apply_bn, act, with_stats, m, bm):
+    i = pl.program_id(0)
+    x = x_ref[...]                                  # [bm, C]
+    rows_ok = (i * bm + jax.lax.broadcasted_iota(
+        jnp.int32, x.shape, 0)) < m
+    if apply_bn:
+        xf = x.astype(jnp.float32)
+        xf = (xf - mean_ref[...]) * rstd_ref[...] * gamma_ref[...] \
+            + beta_ref[...]
+        if act == "relu":
+            xf = jnp.maximum(xf, 0.0)
+        xf = jnp.where(rows_ok, xf, 0.0)
+        x = xf.astype(x_ref.dtype)
+    else:
+        if act == "relu":
+            x = jnp.maximum(x, jnp.zeros_like(x))
+        x = jnp.where(rows_ok, x, jnp.zeros_like(x))
+    z = jax.lax.dot_general(x, w_ref[...], (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [bm, O]
+    z_ref[...] = z.astype(z_ref.dtype)
+
+    @pl.when(i == 0)
+    def _init():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        sumsq_ref[...] = jnp.zeros_like(sumsq_ref)
+
+    if with_stats:
+        rows_ok_o = (i * bm + jax.lax.broadcasted_iota(
+            jnp.int32, z.shape, 0)) < m
+        zc = jnp.where(rows_ok_o, z - shift_ref[...], 0.0)
+        sum_ref[...] += jnp.sum(zc, axis=0)
+        sumsq_ref[...] += jnp.sum(zc * zc, axis=0)
+
+
+def _fwd_call_nhwc(x2, w, mean, rstd, gamma, beta, shift, act, apply_bn,
+                   with_stats, interpret):
+    m, c = x2.shape
+    o = w.shape[1]
+    isz = jnp.dtype(x2.dtype).itemsize
+    bm = _pick_bhw(1, c, o, m, isz, stack_factor=2)
+    grid = (pl.cdiv(m, bm),)
+    p = [a.reshape(1, c).astype(jnp.float32)
+         for a in (mean, rstd, gamma, beta)]
+    sh = shift.reshape(1, o).astype(jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel_nhwc, apply_bn=apply_bn, act=act,
+                          with_stats=with_stats, m=m, bm=bm),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, c), lambda i: (i, 0)),
+                  pl.BlockSpec((c, o), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, o), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((bm, o), lambda i: (i, 0)),
+                   pl.BlockSpec((o,), lambda i: (0,)),
+                   pl.BlockSpec((o,), lambda i: (0,))],
+        out_shape=[jax.ShapeDtypeStruct((m, o), x2.dtype),
+                   jax.ShapeDtypeStruct((o,), jnp.float32),
+                   jax.ShapeDtypeStruct((o,), jnp.float32)],
+        interpret=interpret,
+    )(x2, w, *p, sh)
+
+
+def _bwd_kernel_nhwc(x_ref, w_ref, z_ref, dz_ref, dsum_ref, dsumsq_ref,
+                     mean_ref, rstd_ref, gamma_ref, beta_ref, shift_ref,
+                     dx_ref, dw_ref, dgamma_ref, dbeta_ref, *,
+                     apply_bn, act, with_stats, m, bm):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+        dgamma_ref[...] = jnp.zeros_like(dgamma_ref)
+        dbeta_ref[...] = jnp.zeros_like(dbeta_ref)
+
+    dz = dz_ref[...].astype(jnp.float32)            # [bm, O]
+    rows_ok_o = (i * bm + jax.lax.broadcasted_iota(
+        jnp.int32, dz.shape, 0)) < m
+    if with_stats:
+        z = z_ref[...].astype(jnp.float32) - shift_ref[...]
+        dz = dz + dsum_ref[...].reshape(1, -1) \
+            + 2.0 * z * dsumsq_ref[...].reshape(1, -1)
+    dz = jnp.where(rows_ok_o, dz, 0.0)
+    dz_lo = dz.astype(x_ref.dtype)
+
+    x_raw = x_ref[...]                               # [bm, C]
+    rows_ok_c = (i * bm + jax.lax.broadcasted_iota(
+        jnp.int32, x_raw.shape, 0)) < m
+    x = jnp.where(rows_ok_c, x_raw, jnp.zeros_like(x_raw)
+                  ).astype(jnp.float32)
+    if apply_bn:
+        pre = (x - mean_ref[...]) * rstd_ref[...]    # [bm, C]
+        ylin = pre * gamma_ref[...] + beta_ref[...]
+        xn = jnp.maximum(ylin, 0.0) if act == "relu" else ylin
+    else:
+        xn = jnp.maximum(x, 0.0) if act == "relu" else x
+    xn_lo = xn.astype(x_ref.dtype)
+
+    # dW[C, O] += xn^T @ dz  (contract bm)
+    dw_ref[...] += jax.lax.dot_general(
+        xn_lo, dz_lo, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    # dxn[bm, C] = dz @ w^T  (contract O)
+    dxn = jax.lax.dot_general(
+        dz_lo, w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if apply_bn:
+        dylin = dxn * (ylin > 0.0) if act == "relu" else dxn
+        dgamma_ref[...] += jnp.sum(dylin * pre, axis=0)
+        dbeta_ref[...] += jnp.sum(dylin, axis=0)
+        dx = dylin * (gamma_ref[...] * rstd_ref[...])
+    else:
+        dx = dxn * (x > 0.0) if act == "relu" else dxn
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _bwd_call_nhwc(x2, w, z2, dz2, dsum, dsumsq, mean, rstd, gamma, beta,
+                   shift, act, apply_bn, with_stats, interpret):
+    m, c = x2.shape
+    o = w.shape[1]
+    isz = jnp.dtype(x2.dtype).itemsize
+    bm = _pick_bhw(1, c, o, m, isz, stack_factor=4)
+    grid = (pl.cdiv(m, bm),)
+    p = [a.reshape(1, c).astype(jnp.float32)
+         for a in (mean, rstd, gamma, beta)]
+    sh = shift.reshape(1, o).astype(jnp.float32)
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel_nhwc, apply_bn=apply_bn, act=act,
+                          with_stats=with_stats, m=m, bm=bm),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, c), lambda i: (i, 0)),
+                  pl.BlockSpec((c, o), lambda i: (0, 0)),
+                  pl.BlockSpec((bm, o), lambda i: (i, 0)),
+                  pl.BlockSpec((bm, o), lambda i: (i, 0)),
+                  pl.BlockSpec((o,), lambda i: (0,)),
+                  pl.BlockSpec((o,), lambda i: (0,)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, c), lambda i: (0, 0)),
+                  pl.BlockSpec((1, o), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((bm, c), lambda i: (i, 0)),
+                   pl.BlockSpec((c, o), lambda i: (0, 0)),
+                   pl.BlockSpec((c,), lambda i: (0,)),
+                   pl.BlockSpec((c,), lambda i: (0,))],
+        out_shape=[jax.ShapeDtypeStruct((m, c), x2.dtype),
+                   jax.ShapeDtypeStruct((c, o), jnp.float32),
+                   jax.ShapeDtypeStruct((c,), jnp.float32),
+                   jax.ShapeDtypeStruct((c,), jnp.float32)],
+        interpret=interpret,
+    )(x2, w, z2, dz2, dsum.astype(jnp.float32), dsumsq.astype(jnp.float32),
+      *p, sh)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def bn_act_matmul_nhwc(x2, w, mean, var, gamma, beta, stats_shift,
+                       eps=1e-5, act="relu", apply_bn=True,
+                       with_stats=True, interpret=False):
+    """z = act(bn(x2)) @ w with fused output stats, NHWC-native.
+
+    ``x2`` is [M, C] (a free reshape of an NHWC activation), ``w`` is
+    [C, O]; returns ``(z2 [M, O], sum [O], sumsq [O])``.  Same
+    statistics/gradient contract as :func:`bn_act_matmul`; this form
+    tiles the whole fused layer as one dense matmul, so late-stage
+    ResNet shapes (HW=49) no longer fragment per batch element."""
+    return _vjp_fwd_nhwc(x2, w, mean, var, gamma, beta, stats_shift, eps,
+                         act, apply_bn, with_stats, interpret)[0]
+
+
+def _vjp_fwd_nhwc(x2, w, mean, var, gamma, beta, stats_shift, eps, act,
+                  apply_bn, with_stats, interpret):
+    rstd = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
+    z, s, ss = _fwd_call_nhwc(x2, w, mean, rstd, gamma, beta, stats_shift,
+                              act, apply_bn, with_stats, interpret)
+    return (z, s, ss), (x2, w, z, mean, rstd, gamma, beta, stats_shift)
+
+
+def _vjp_bwd_nhwc(eps, act, apply_bn, with_stats, interpret, res, cts):
+    x2, w, z, mean, rstd, gamma, beta, stats_shift = res
+    dz, dsum, dsumsq = cts
+    c = x2.shape[1]
+    dx, dw, dgamma, dbeta = _bwd_call_nhwc(
+        x2, w, z, dz.astype(x2.dtype), dsum, dsumsq, mean, rstd, gamma,
+        beta, stats_shift, act, apply_bn, with_stats, interpret)
+    dw = dw.astype(w.dtype)
+    dshift = jnp.zeros_like(stats_shift)
+    if apply_bn:
+        dmean, dvar = stats_grads(apply_bn, gamma, rstd, dgamma, dbeta)
+        return (dx, dw, dmean.astype(mean.dtype), dvar.astype(mean.dtype),
+                dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype),
+                dshift)
+    zk = jnp.zeros((c,), mean.dtype)
+    return (dx, dw, zk, zk, zk.astype(gamma.dtype), zk.astype(beta.dtype),
+            dshift)
+
+
+bn_act_matmul_nhwc.defvjp(_vjp_fwd_nhwc, _vjp_bwd_nhwc)
+
+
 # -- per-channel stats grads ------------------------------------------------
 
 def stats_grads(apply_bn, gamma, rstd, dgamma, dbeta):

@@ -30,6 +30,8 @@ def _pool_infer_nd(nd):
     def infer(op, block):
         x = in_var(op, block, "X")
         attrs = op.attrs
+        nhwc = attrs.get("data_format", "NCHW") == "NHWC" and nd == 2
+        sp0 = 1 if nhwc else 2
         if attrs.get("global_pooling", False):
             spatial = [1] * nd
         elif attrs.get("adaptive", False):
@@ -40,19 +42,23 @@ def _pool_infer_nd(nd):
             pads = int_list(attrs.get("paddings", 0), nd)
             ceil = attrs.get("ceil_mode", False)
             spatial = [
-                _pool_out_dim(x.shape[2 + i], ks[i], pads[i], strides[i], ceil)
+                _pool_out_dim(x.shape[sp0 + i], ks[i], pads[i], strides[i],
+                              ceil)
                 for i in range(nd)
             ]
-        set_output(op, block, "Out", tuple(x.shape[:2]) + tuple(spatial),
-                   x.dtype)
+        if nhwc:
+            shape = (x.shape[0],) + tuple(spatial) + (x.shape[3],)
+        else:
+            shape = tuple(x.shape[:2]) + tuple(spatial)
+        set_output(op, block, "Out", shape, x.dtype)
     return infer
 
 
-def _adaptive_pool(x, out_sizes, nd, is_max):
+def _adaptive_pool(x, out_sizes, nd, is_max, sp0=2):
     """Adaptive pooling: output cell i covers [floor(i*L/out), ceil((i+1)*L/out))."""
     # pool one spatial axis at a time with static window boundaries
     for d in range(nd):
-        axis = 2 + d
+        axis = sp0 + d
         in_size, out_size = x.shape[axis], out_sizes[d]
         starts = [(i * in_size) // out_size for i in range(out_size)]
         ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
@@ -70,14 +76,18 @@ def _pool_compute_nd(nd):
     def compute(ins, attrs, ctx, op_index):
         x = ins["X"][0]
         is_max = attrs.get("pooling_type", "max") == "max"
+        # NHWC (transpiler.layout trunk layout): spatial dims sit at
+        # 1..nd and the window/stride tuples carry the channel 1 last
+        nhwc = attrs.get("data_format", "NCHW") == "NHWC" and nd == 2
+        sp0 = 1 if nhwc else 2
+        spatial_axes = tuple(range(sp0, sp0 + nd))
         if attrs.get("global_pooling", False):
-            axes = tuple(range(2, 2 + nd))
-            out = (jnp.max if is_max else jnp.mean)(x, axis=axes,
+            out = (jnp.max if is_max else jnp.mean)(x, axis=spatial_axes,
                                                     keepdims=True)
             return {"Out": out}
         if attrs.get("adaptive", False):
             return {"Out": _adaptive_pool(x, int_list(attrs.get("ksize"), nd),
-                                          nd, is_max)}
+                                          nd, is_max, sp0=sp0)}
 
         ks = int_list(attrs.get("ksize"), nd)
         strides = int_list(attrs.get("strides", 1), nd)
@@ -85,16 +95,21 @@ def _pool_compute_nd(nd):
         ceil = attrs.get("ceil_mode", False)
         # explicit (lo, hi) padding; ceil_mode extends hi so the last window
         # fits (reference math/pooling.cc ceil semantics)
-        pad_cfg = [(0, 0), (0, 0)]
+        sp_pad = []
         for i in range(nd):
-            in_size = x.shape[2 + i]
+            in_size = x.shape[sp0 + i]
             out_size = _pool_out_dim(in_size, ks[i], pads[i], strides[i], ceil)
             needed = (out_size - 1) * strides[i] + ks[i]
             hi = max(needed - in_size - pads[i], pads[i])
-            pad_cfg.append((pads[i], hi))
-
-        window = (1, 1) + tuple(ks)
-        stride = (1, 1) + tuple(strides)
+            sp_pad.append((pads[i], hi))
+        if nhwc:
+            pad_cfg = [(0, 0)] + sp_pad + [(0, 0)]
+            window = (1,) + tuple(ks) + (1,)
+            stride = (1,) + tuple(strides) + (1,)
+        else:
+            pad_cfg = [(0, 0), (0, 0)] + sp_pad
+            window = (1, 1) + tuple(ks)
+            stride = (1, 1) + tuple(strides)
         if is_max:
             init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
                 jnp.iinfo(x.dtype).min
@@ -103,15 +118,17 @@ def _pool_compute_nd(nd):
             summed = lax.reduce_window(x, 0.0, lax.add, window, stride,
                                        pad_cfg)
             if attrs.get("exclusive", True):
-                ones = jnp.ones(x.shape[2:], x.dtype)
+                ones = jnp.ones(tuple(x.shape[a] for a in spatial_axes),
+                                x.dtype)
                 cnt = lax.reduce_window(
-                    ones, 0.0, lax.add, tuple(ks), tuple(strides),
-                    pad_cfg[2:]
+                    ones, 0.0, lax.add, tuple(ks), tuple(strides), sp_pad
                 )
                 # ceil_mode can create windows lying wholly in the extension
                 # padding (cnt == 0); the reference clamps window extents so
                 # the divisor is always >= 1 (math/pooling.cc).
-                out = summed / jnp.maximum(cnt, 1.0)[None, None]
+                cnt = jnp.maximum(cnt, 1.0)
+                out = summed / (cnt[None, ..., None] if nhwc
+                                else cnt[None, None])
             else:
                 out = summed / float(int(np.prod(ks)))
         return {"Out": out}

@@ -19,6 +19,7 @@ from .memory_optimization_transpiler import (  # noqa: F401
     memory_optimize, release_memory)
 from .inference_transpiler import InferenceTranspiler  # noqa: F401
 from .fusion import fuse_conv_bn  # noqa: F401
+from .layout import convert_to_nhwc  # noqa: F401
 from .passes import (  # noqa: F401
     PassBuilder, apply_pass, find_chain, get_pass, list_passes,
     register_pass)
@@ -27,6 +28,7 @@ __all__ = [
     "DistributeTranspiler", "DistributeTranspilerConfig",
     "PSDispatcher", "RoundRobin", "HashName",
     "memory_optimize", "release_memory", "InferenceTranspiler",
-    "fuse_conv_bn", "apply_pass", "register_pass", "get_pass",
+    "fuse_conv_bn", "convert_to_nhwc", "apply_pass", "register_pass",
+    "get_pass",
     "list_passes", "PassBuilder", "find_chain",
 ]

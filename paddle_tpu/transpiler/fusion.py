@@ -59,7 +59,9 @@ def _is_train_bn(op, block):
     if op.attrs.get("is_test", False) or op.attrs.get("use_global_stats",
                                                       False):
         return False
-    if op.attrs.get("data_layout", "NCHW") != "NCHW":
+    # NCHW programs and convert_to_nhwc-rewritten trunks both fuse; the
+    # decomposed/fused ops carry the layout through their attrs
+    if op.attrs.get("data_layout", "NCHW") not in ("NCHW", "NHWC"):
         return False
     x = block._find_var_recursive(op.inputs["X"][0])
     return x is not None and x.shape is not None and len(x.shape) == 4
@@ -112,7 +114,9 @@ def fuse_conv_bn(program):
         else:
             k = j
         if k >= 0 and _is_conv1x1_s1(ops[k], block) \
-                and ops[k].inputs["Input"][0] == tail:
+                and ops[k].inputs["Input"][0] == tail \
+                and ops[k].attrs.get("data_format", "NCHW") == \
+                bn.attrs.get("data_layout", "NCHW"):
             if act == "relu":
                 absorbed_relu.add(j)
             absorbed_conv[k] = (i, act)
@@ -126,7 +130,9 @@ def fuse_conv_bn(program):
         x = ops[i].inputs["X"][0]
         p = producer.get(x)
         if p is not None and _is_conv1x1_s1(ops[p], block) \
-                and consumers.get(x, []) == [i]:
+                and consumers.get(x, []) == [i] \
+                and ops[p].attrs.get("data_format", "NCHW") == \
+                ops[i].attrs.get("data_layout", "NCHW"):
             stats_conv.add(p)
             bn_stats_src[i] = p
             stats_consumer_bn[p] = i
@@ -145,6 +151,7 @@ def fuse_conv_bn(program):
     def emit_fused_conv(conv_i, new_ops):
         conv = ops[conv_i]
         with_stats = conv_i in stats_conv
+        fmt = conv.attrs.get("data_format", "NCHW")
         # stat outputs always get real (dead when unused) names — an
         # empty-string output would register a phantom "" block var
         sum_n, sumsq_n = stat_names(conv)
@@ -158,13 +165,14 @@ def fuse_conv_bn(program):
                       "Scale": list(bn.inputs["Scale"]),
                       "Bias": list(bn.inputs["Bias"])}
             attrs = {"apply_bn": True, "act": act,
-                     "with_stats": with_stats,
+                     "with_stats": with_stats, "data_format": fmt,
                      "epsilon": bn.attrs.get("epsilon", 1e-5)}
         else:
             inputs = {"X": list(conv.inputs["Input"]),
                       "Filter": list(conv.inputs["Filter"])}
             attrs = {"apply_bn": False, "act": "",
-                     "with_stats": with_stats, "epsilon": 1e-5}
+                     "with_stats": with_stats, "data_format": fmt,
+                     "epsilon": 1e-5}
         if with_stats:
             # the consumer bn's running mean shifts the fused sum/sumsq
             # accumulation (same cancellation guard as ops/norm.py's
@@ -188,6 +196,7 @@ def fuse_conv_bn(program):
             continue
         if i in bn_idx:
             bn = op
+            layout = bn.attrs.get("data_layout", "NCHW")
             x_n = bn.inputs["X"][0]
             saved_mean = bn.outputs["SavedMean"][0]
             saved_var = bn.outputs["SavedVariance"][0]
@@ -200,13 +209,13 @@ def fuse_conv_bn(program):
                      "CountFrom": [x_n],
                      "Shift": list(bn.inputs["Mean"])},
                     {"BatchMean": [saved_mean], "BatchVar": [saved_var]},
-                    {}))
+                    {"data_layout": layout}))
             else:
                 new_ops.append(make_op(
                     "batch_stats",
                     {"X": [x_n], "Shift": list(bn.inputs["Mean"])},
                     {"BatchMean": [saved_mean], "BatchVar": [saved_var]},
-                    {}))
+                    {"data_layout": layout}))
             new_ops.append(make_op(
                 "bn_update_stats",
                 {"Mean": list(bn.inputs["Mean"]),
@@ -227,7 +236,8 @@ def fuse_conv_bn(program):
                  "Scale": list(bn.inputs["Scale"]),
                  "Bias": list(bn.inputs["Bias"])},
                 {"Y": [y]},
-                {"epsilon": bn.attrs.get("epsilon", 1e-5), "act": ""}))
+                {"epsilon": bn.attrs.get("epsilon", 1e-5), "act": "",
+                 "data_layout": layout}))
             fused += 1
             continue
         new_ops.append(op)

@@ -1,0 +1,233 @@
+"""Whole-trunk NHWC layout pass (transpiler.layout.convert_to_nhwc).
+
+The reference transforms layouts at kernel boundaries
+(``paddle/fluid/framework/data_layout_transform.cc:1``); here a program
+pass flips the conv trunk to NHWC so the [M, C]-tiled fused conv+BN
+Pallas kernels see their native layout with no boundary transposes.
+
+Covers: structural rewrite (conv/pool/bn attrs, single entry transpose,
+boundary transpose before the fc head), multi-step training parity on a
+residual CNN (NCHW vs NHWC vs NHWC+fuse_conv_bn), the NHWC Pallas
+kernel pair's numerics vs jax.vjp of the reference math (interpret
+mode), and pool2d NHWC semantics (max/avg/exclusive padding).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build(mode, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[8, 6, 6])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        c1 = fluid.layers.conv2d(img, num_filters=16, filter_size=1,
+                                 bias_attr=False)
+        b1 = fluid.layers.batch_norm(c1, act="relu")
+        c2 = fluid.layers.conv2d(b1, num_filters=8, filter_size=1,
+                                 bias_attr=False)
+        b2 = fluid.layers.batch_norm(c2, act="relu")
+        c3 = fluid.layers.conv2d(b2, num_filters=8, filter_size=3,
+                                 padding=1, bias_attr=False)
+        b3 = fluid.layers.batch_norm(c3, act=None)
+        res = fluid.layers.elementwise_add(x=b3, y=img, act="relu")
+        pool = fluid.layers.pool2d(res, pool_size=6, pool_type="avg",
+                                   global_pooling=True)
+        pred = fluid.layers.fc(pool, size=5, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        if "nhwc" in mode:
+            n = fluid.transpiler.convert_to_nhwc(main)
+            assert n == 3, "expected 3 convs converted, got %d" % n
+        if "fuse" in mode:
+            n = fluid.transpiler.fuse_conv_bn(main)
+            assert n == 3, "expected 3 BNs decomposed, got %d" % n
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def _run(mode, steps=4):
+    main, startup, loss = _build(mode)
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(steps):
+            f = {"img": rng.rand(4, 8, 6, 6).astype("float32"),
+                 "label": rng.randint(0, 5, (4, 1)).astype("int64")}
+            l, = exe.run(main, feed=f, fetch_list=[loss])
+            losses.append(float(np.asarray(l).ravel()[0]))
+    return losses
+
+
+def test_structural_rewrite():
+    main, _, _ = _build("nhwc")
+    block = main.global_block()
+    convs = [op for op in block.ops if op.type == "conv2d"]
+    assert convs and all(
+        op.attrs.get("data_format") == "NHWC" for op in convs)
+    bns = [op for op in block.ops if op.type == "batch_norm"]
+    assert bns and all(op.attrs.get("data_layout") == "NHWC" for op in bns)
+    pools = [op for op in block.ops if op.type == "pool2d"]
+    assert pools and all(
+        op.attrs.get("data_format") == "NHWC" for op in pools)
+    # exactly one entry transpose (the fed image) and one exit boundary
+    # (the global-pool output feeding fc); trunk interior has none
+    transposes = [op for op in block.ops if op.type == "transpose"]
+    entry = [op for op in transposes if op.attrs["axis"] == [0, 2, 3, 1]]
+    exits = [op for op in transposes if op.attrs["axis"] == [0, 3, 1, 2]]
+    assert len(entry) == 1, [op.inputs for op in entry]
+    assert len(exits) == 1, [op.inputs for op in exits]
+    # trunk var metadata flipped: conv outputs are [B, H, W, C]
+    out = block._find_var_recursive(convs[0].outputs["Output"][0])
+    assert out.shape[-1] == 16, out.shape
+    # weights stay OIHW (checkpoint parity)
+    w = block._find_var_recursive(convs[0].inputs["Filter"][0])
+    assert tuple(w.shape) == (16, 8, 1, 1), w.shape
+
+
+def test_training_parity_nhwc():
+    base = _run("plain")
+    nhwc = _run("nhwc")
+    np.testing.assert_allclose(nhwc, base, rtol=2e-3, atol=2e-4)
+
+
+def test_training_parity_nhwc_fused():
+    base = _run("plain")
+    fused = _run("nhwc_fuse")
+    np.testing.assert_allclose(fused, base, rtol=2e-3, atol=2e-4)
+
+
+def test_nhwc_fusion_emits_nhwc_fused_ops():
+    main, _, _ = _build("nhwc_fuse")
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("bn_act_conv2d") == 2
+    for op in main.global_block().ops:
+        if op.type == "bn_act_conv2d":
+            assert op.attrs.get("data_format") == "NHWC"
+        if op.type in ("batch_stats", "bn_apply", "stats_finalize"):
+            assert op.attrs.get("data_layout") == "NHWC"
+
+
+def test_imagenet_bottleneck_parity():
+    """Strided bottleneck + projection shortcut (the resnet_imagenet
+    shapes the bench runs) track NCHW over several steps."""
+    def build(nhwc, seed=11):
+        from paddle_tpu.models.resnet import resnet_imagenet
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = seed
+        startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[3, 32, 32])
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            pred = resnet_imagenet(img, class_dim=10, depth=18)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            if nhwc:
+                assert fluid.transpiler.convert_to_nhwc(main) > 0
+            fluid.optimizer.Momentum(learning_rate=0.01,
+                                     momentum=0.9).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(3)
+    feeds = [{"img": rng.rand(4, 3, 32, 32).astype("float32"),
+              "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+             for _ in range(3)]
+    out = []
+    for nhwc in (False, True):
+        main, startup, loss = build(nhwc)
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ls = []
+            for f in feeds:
+                l, = exe.run(main, feed=f, fetch_list=[loss])
+                ls.append(float(np.asarray(l).ravel()[0]))
+            out.append(ls)
+    np.testing.assert_allclose(out[1], out[0], rtol=2e-3, atol=2e-4)
+
+
+def test_pool2d_nhwc_semantics():
+    """pool2d NHWC == transposed pool2d NCHW for max/avg, strided with
+    asymmetric (ceil-extended) padding and exclusive avg counting."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 5, 7, 7).astype("float32")
+    for ptype in ("max", "avg"):
+        for ceil in (False, True):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                a = fluid.layers.data("a", shape=[5, 7, 7])
+                o1 = fluid.layers.pool2d(a, pool_size=3, pool_stride=2,
+                                         pool_padding=1, pool_type=ptype,
+                                         ceil_mode=ceil)
+                b = fluid.layers.transpose(a, perm=[0, 2, 3, 1])
+                helper = fluid.layer_helper.LayerHelper("pool2d")
+                out = helper.create_variable_for_type_inference(b.dtype)
+                helper.append_op(
+                    type="pool2d", inputs={"X": [b]},
+                    outputs={"Out": [out]},
+                    attrs={"ksize": [3, 3], "strides": [2, 2],
+                           "paddings": [1, 1], "pooling_type": ptype,
+                           "ceil_mode": ceil, "data_format": "NHWC"})
+                o2 = fluid.layers.transpose(out, perm=[0, 3, 1, 2])
+            scope = fluid.Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                r1, r2 = exe.run(main, feed={"a": x},
+                                 fetch_list=[o1, o2])
+            np.testing.assert_allclose(np.asarray(r2), np.asarray(r1),
+                                       rtol=1e-6, atol=1e-6)
+
+
+def test_nhwc_pallas_kernels_vs_reference():
+    """bn_act_matmul_nhwc fwd + single-kernel bwd == jax.vjp of the
+    reference math (interpret mode; partial last block exercised)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import conv_bn
+
+    rng = np.random.RandomState(0)
+    m, c, o = 1300, 64, 128
+    x = jnp.asarray(rng.randn(m, c).astype("float32"))
+    w = jnp.asarray(rng.randn(c, o).astype("float32") * 0.1)
+    mean = jnp.asarray(rng.randn(c).astype("float32"))
+    var = jnp.asarray(np.abs(rng.randn(c)).astype("float32") + 0.5)
+    gamma = jnp.asarray(rng.randn(c).astype("float32"))
+    beta = jnp.asarray(rng.randn(c).astype("float32"))
+    shift = jnp.asarray(rng.randn(o).astype("float32"))
+    eps = 1e-5
+
+    def ref_fn(x, w, mean, var, gamma, beta):
+        rstd = jax.lax.rsqrt(var + eps)
+        xn = jnp.maximum((x - mean) * (rstd * gamma) + beta, 0.0)
+        z = xn @ w
+        zc = z - shift
+        return z, jnp.sum(zc, axis=0), jnp.sum(zc * zc, axis=0)
+
+    def fused(x, w, mean, var, gamma, beta):
+        return conv_bn.bn_act_matmul_nhwc(
+            x, w, mean, var, gamma, beta, shift, eps, "relu", True, True,
+            True)
+
+    assert conv_bn.supported(1, c, o, m, jnp.float32)
+    zf, vjp_f = jax.vjp(fused, x, w, mean, var, gamma, beta)
+    zr, vjp_r = jax.vjp(ref_fn, x, w, mean, var, gamma, beta)
+    for a, b in zip(zf, zr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-4)
+    cts = (jnp.asarray(rng.randn(m, o).astype("float32")),
+           jnp.asarray(rng.randn(o).astype("float32")),
+           jnp.asarray(rng.randn(o).astype("float32")))
+    for name, a, b in zip(("dx", "dw", "dmean", "dvar", "dgamma",
+                           "dbeta"), vjp_f(cts), vjp_r(cts)):
+        denom = np.abs(np.asarray(b)).max() + 1e-9
+        rel = np.abs(np.asarray(a - b)).max() / denom
+        assert rel < 1e-4, (name, rel)

@@ -73,6 +73,9 @@ class ParallelExecutor:
         self._cache = {}
         self._run_counter = 0
         self._auto_seed_val = None
+        # observability: how many ragged batches were replication-padded
+        # (the data_balance_op_handle capability — see _pad_uneven)
+        self.uneven_batches_padded = 0
         if share_vars_from is not None:
             # parity with PE(share_vars_from=train_exe): same scope object
             self._scope = share_vars_from._actual_scope()
@@ -223,6 +226,42 @@ class ParallelExecutor:
             host.shape, sharding, lambda idx: host[idx])
 
     # ------------------------------------------------------------------
+    def _pad_uneven(self, feed_vals):
+        """Ragged-batch handling (reference
+        ``details/data_balance_op_handle.cc:1`` redistributes uneven
+        epoch-end batches across devices): SPMD-jitted steps have static
+        shapes, so the ragged global batch is replicated WHOLE,
+        r = dp / gcd(B, dp) times, making dim 0 divisible.  Replication
+        (unlike zero-pad-and-mask) is EXACT: means over the batch,
+        per-sample gradients of a mean loss, and BN batch statistics are
+        all invariant under whole-batch replication, so the training
+        trajectory matches the single-device run bit-for-bit; per-sample
+        fetches are trimmed back to the true batch.  Costs r x compute
+        for the one ragged batch per epoch."""
+        import math
+
+        dp = max(1, self._dp_size() // jax.process_count())
+        bs = {v.shape[0] for v in feed_vals if getattr(v, "ndim", 0) >= 1}
+        if len(bs) != 1:
+            return feed_vals, 1
+        b = bs.pop()
+        if b <= 0 or b % dp == 0:
+            return feed_vals, 1
+        r = dp // math.gcd(b, dp)
+        if self.uneven_batches_padded == 0:
+            import warnings
+            warnings.warn(
+                "ragged batch %d replicated x%d to fit the dp=%d mesh: "
+                "exact for mean-normalized losses and BN stats; a "
+                "sum-reduced objective would scale by the replication "
+                "factor — set BuildStrategy.pad_uneven_batches=False to "
+                "reject ragged batches instead" % (b, r, dp),
+                stacklevel=3)
+        self.uneven_batches_padded += 1
+        return [np.concatenate([np.asarray(v)] * r, axis=0)
+                for v in feed_vals], r
+
+    # ------------------------------------------------------------------
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         program = self._program or default_main_program()
         scope = self._actual_scope()
@@ -251,6 +290,10 @@ class ParallelExecutor:
                     np.dtype(v.dtype) != np.dtype(pv.dtype):
                 v = v.astype(pv.dtype)
             feed_vals.append(v)
+
+        pad_r = 1
+        if self._build_strategy.pad_uneven_batches:
+            feed_vals, pad_r = self._pad_uneven(feed_vals)
 
         feed_sig = tuple(
             (n, tuple(v.shape), str(v.dtype))
@@ -306,6 +349,26 @@ class ParallelExecutor:
 
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
+        if pad_r > 1:
+            # trim per-sample fetches (e.g. predictions [B*r, ...]) back
+            # to the true batch; scalars/means are replication-invariant.
+            # Only BATCH-dim vars trim (program shape[0] == -1): a
+            # parameter whose leading dim coincidentally equals the
+            # padded batch must come back whole.
+            padded_b = next((v.shape[0] for v in feed_vals
+                             if getattr(v, "ndim", 0) >= 1), 0)
+            true_b = padded_b // pad_r
+
+            def _is_batch_var(name):
+                v = block._find_var_recursive(name)
+                return (v is not None and v.shape is not None
+                        and len(v.shape) >= 1 and v.shape[0] in (-1, None))
+
+            fetches = [
+                f[:true_b] if getattr(f, "ndim", 0) >= 1
+                and f.shape[0] == padded_b and _is_batch_var(n) else f
+                for n, f in zip(compiled.fetch_names, fetches)
+            ]
         if flags.flag("check_nan_inf"):
             # fetches only: state may span hosts (not fully addressable).
             # Convert once and reuse for the return value.

@@ -46,6 +46,15 @@ class BuildStrategy:
         # the attention ring lowering; other mesh-aware lowerings
         # (pipeline_region over pp) always see the mesh.
         self.sequence_parallel = True
+        # Ragged epoch-end batches (reference
+        # details/data_balance_op_handle.cc redistributes them): under
+        # SPMD the step's shapes are static, so an indivisible global
+        # batch is instead REPLICATED whole, r = dp/gcd(B, dp) times —
+        # exact (not approximate) for mean-normalized objectives and BN
+        # batch statistics, so the loss/update trajectory matches the
+        # single-device run bit-for-bit.  False restores the r3-era
+        # ValueError.
+        self.pad_uneven_batches = True
 
 
 class ExecutionStrategy:

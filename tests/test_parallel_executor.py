@@ -106,11 +106,51 @@ def test_parallel_feed_list_form():
     assert np.isfinite(np.asarray(merged)).all()
 
 
-def test_parallel_rejects_indivisible_batch():
+def test_parallel_uneven_batch_matches_single_device():
+    """Epoch with a ragged final batch (reference
+    details/data_balance_op_handle.cc capability): the replication pad
+    keeps the loss trajectory EXACTLY on the single-device run's."""
+    batches = _data(steps=5) + _data(steps=1, batch=9)  # 9 % 8 != 0
+    loss = _build_mlp()
+    single = _run_single(batches, loss)
+
+    with fluid.scope_guard(fluid.Scope()):
+        pe = fluid.ParallelExecutor(loss_name=loss.name)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        par = []
+        for b in batches:
+            out = pe.run(feed=b, fetch_list=[loss])
+            par.append(float(np.asarray(out[0]).ravel()[0]))
+        assert pe.uneven_batches_padded == 1
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-5)
+
+
+def test_parallel_uneven_batch_trims_per_sample_fetches():
+    loss = _build_mlp()
+    # the softmax pred var: per-sample fetch [B, 8]
+    pred = None
+    for op in fluid.default_main_program().global_block().ops:
+        if op.type == "softmax_with_cross_entropy":
+            pred = op.inputs["Logits"][0]
+    assert pred is not None
+    pe = fluid.ParallelExecutor(loss_name=loss.name)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    b = _data(steps=1, batch=9)[0]
+    logits, l = pe.run(feed=b, fetch_list=[pred, loss])
+    assert np.asarray(logits).shape[0] == 9   # trimmed back from 72
+    assert np.isfinite(np.asarray(l)).all()
+    assert pe.uneven_batches_padded == 1
+
+
+def test_parallel_rejects_indivisible_batch_when_disabled():
     loss = _build_mlp()
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fluid.default_startup_program())
-    pe = fluid.ParallelExecutor(loss_name=loss.name)
+    bs = fluid.BuildStrategy()
+    bs.pad_uneven_batches = False
+    pe = fluid.ParallelExecutor(loss_name=loss.name, build_strategy=bs)
     bad = _data(steps=1, batch=9)[0]
     with pytest.raises(ValueError, match="divisible"):
         pe.run(feed=bad, fetch_list=[loss])

@@ -84,6 +84,7 @@ class ReaderHandle(object):
         self._source = source          # callable -> iterator of samples
         self._batched = batched        # True once batch() decorated
         self._tensors = False          # True for tensor-provider sources
+        self._dicts = False            # True when source yields feed dicts
         self._place = None             # set by double_buffer
         self._capacity = None
         self.name = name
@@ -126,10 +127,45 @@ class ReaderHandle(object):
                 "(or build the handle with open_files/"
                 "random_data_generator)")
         if not self._batched:
+            # The reference's documented usage attaches an ALREADY
+            # batched reader — decorate_paddle_reader(paddle.batch(...),
+            # reference io.py py_reader docs) — while sample-level
+            # sources need layers.batch() applied here.  Sniff the first
+            # yield: a batched source yields LISTS of sample rows;
+            # accept it directly so reference-ported scripts work
+            # unchanged, and keep the clear error for true sample
+            # streams (ADVICE r4: the old message sent batched-source
+            # users into double-batching).
+            probe = iter(self._source())
+            try:
+                first = next(probe)
+            except StopIteration:
+                return iter(())
+            # strictly lists-of-TUPLES: paddle.batch emits lists whose
+            # rows are the sample tuples.  A list-of-lists could equally
+            # be ONE sample whose slots are lists, so it keeps the
+            # explicit-batch error rather than risking silent
+            # mis-batching.
+            if isinstance(first, list) and first and \
+                    isinstance(first[0], tuple):
+                import itertools
+                chained = itertools.chain([first], probe)
+                batched = self._replace(lambda: chained, batched=True)
+                return iter(batched)
+            row = type(first[0]).__name__ \
+                if isinstance(first, (list, tuple)) and first \
+                else type(first).__name__
             raise RuntimeError(
-                "the sample stream is unbatched: apply "
-                "fluid.layers.batch(reader, batch_size) first")
-        if self._tensors:
+                "cannot tell whether the attached source is batched "
+                "(first yield's rows are %r-typed; a batched reader "
+                "yields lists of sample TUPLES): apply "
+                "fluid.layers.batch(reader, batch_size) for a "
+                "sample-level source, or make the batched source yield "
+                "lists of tuples (paddle.batch does)" % row)
+        if self._dicts:
+            def convert(d):
+                return d
+        elif self._tensors:
             names = [v.name for v in self.data_vars]
 
             def convert(tensors):
@@ -159,6 +195,7 @@ class ReaderHandle(object):
                          self.name)
         h._place, h._capacity = self._place, self._capacity
         h._tensors = self._tensors
+        h._dicts = self._dicts
         return h
 
 
@@ -253,9 +290,12 @@ def random_data_generator(low, high, shapes, lod_levels=None,
 def read_file(reader):
     """Unpack a reader handle into its data vars (reference io.py:888
     read_file / read_op)."""
+    if isinstance(reader, Preprocessor):
+        reader = reader()
     if not isinstance(reader, ReaderHandle):
         raise TypeError("read_file expects a reader handle from "
-                        "py_reader/open_files/random_data_generator")
+                        "py_reader/open_files/random_data_generator "
+                        "(or a built Preprocessor)")
     if len(reader.data_vars) == 1:
         return reader.data_vars[0]
     return list(reader.data_vars)
@@ -285,6 +325,8 @@ def double_buffer(reader, place=None, name=None):
     (reference io.py:888 double_buffer /
     create_double_buffer_reader_op.cc — here via reader.PyReader's
     daemon device_put thread)."""
+    if isinstance(reader, Preprocessor):
+        reader = reader()
     h = reader._replace(reader._source)
     from ..executor import TPUPlace
     # default: the accelerator (TPUPlace falls back to the first local
@@ -364,20 +406,32 @@ class Preprocessor(object):
         prog, ins, outs = self._program, self._in_vars, self._out_vars
         under = self.underlying
 
-        class _Prep(ReaderHandle):
-            def __iter__(self):
-                for feed in iter(under):
-                    renamed = {iv.name: feed[dv.name]
-                               for iv, dv in zip(ins, under.data_vars)}
-                    res = exe.run(prog, feed=renamed,
-                                  fetch_list=outs, return_numpy=True)
-                    yield {dv.name: np.asarray(r) for dv, r
-                           in zip(under.data_vars, res)}
+        def prep_source():
+            for feed in iter(under):
+                renamed = {iv.name: feed[dv.name]
+                           for iv, dv in zip(ins, under.data_vars)}
+                res = exe.run(prog, feed=renamed,
+                              fetch_list=outs, return_numpy=True)
+                yield {dv.name: np.asarray(r) for dv, r
+                       in zip(under.data_vars, res)}
 
-        self.sub_reader = _Prep(under.data_vars, source=under._source,
-                                batched=True)
+        # a plain handle whose SOURCE yields preprocessed feed dicts:
+        # survives _replace, so double_buffer(preprocessor()) keeps the
+        # preprocessing (ADVICE r4)
+        self.sub_reader = ReaderHandle(under.data_vars,
+                                       source=prep_source, batched=True)
+        self.sub_reader._dicts = True
 
     def __iter__(self):
         if self.sub_reader is None:
             raise RuntimeError("build the Preprocessor block first")
         return iter(self.sub_reader)
+
+    def __call__(self):
+        """Reference idiom parity (ADVICE r4): ``preprocessor()``
+        returns the decorated reader handle, so
+        ``double_buffer(preprocessor())`` / ``read_file(preprocessor)``
+        both work."""
+        if self.sub_reader is None:
+            raise RuntimeError("build the Preprocessor block first")
+        return self.sub_reader

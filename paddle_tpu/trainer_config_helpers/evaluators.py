@@ -107,9 +107,35 @@ def precision_recall_evaluator(input, label, positive_label=None,
 def evaluator_base(input, type=None, label=None, weight=None, name=None,
                    **kwargs):
     """Low-level evaluator registration (reference evaluators.py
-    evaluator_base): registers the raw input as a reported value; typed
-    behavior lives in the specific evaluators above."""
+    evaluator_base).  Typed uses DISPATCH to the matching specific
+    evaluator (ADVICE r4: silently reducing the input for e.g.
+    type='classification_error' reported a meaningless number); unknown
+    types raise instead of mis-reporting.  Untyped registration keeps
+    the raw-sum behavior (the reference's base path)."""
     from .. import layers as fl
+
+    if type:
+        typed = {
+            "classification_error": lambda:
+                classification_error_evaluator(input, label, name=name),
+            "last-column-auc": lambda:
+                auc_evaluator(input, label, name=name),
+            "sum": lambda: sum_evaluator(input, name=name, weight=weight),
+            "last-column-sum": lambda:
+                column_sum_evaluator(input, name=name, weight=weight),
+            "ctc_edit_distance": lambda:
+                ctc_error_evaluator(input, label, name=name),
+            "precision_recall": lambda: precision_recall_evaluator(
+                input, label, weight=weight, name=name),
+            "value_printer": lambda:
+                value_printer_evaluator(input, name=name),
+        }.get(type)
+        if typed is None:
+            raise NotImplementedError(
+                "evaluator_base type=%r has no dispatch here; use the "
+                "specific *_evaluator helper (reference evaluators.py "
+                "maps types onto the same helpers)" % type)
+        return typed()
     return _register(name, "evaluator",
                      lambda: fl.reduce_sum(cfg.unwrap(input)))
 

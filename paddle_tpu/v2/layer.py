@@ -102,8 +102,22 @@ def _as_image(layer, num_channels):
     dim = layer.v2_dim
     h = getattr(layer, "height", None)
     w = getattr(layer, "width", None)
-    # channel count: explicit, else derived from known h/w hints
-    c = num_channels or (dim // (h * w) if (h and w) else 1)
+    # channel count: explicit, else derived from known h/w hints — which
+    # must actually divide the layer's dim (a stale hint would otherwise
+    # produce a wrong channel count and a confusing downstream reshape)
+    if not num_channels and h and w:
+        if dim % (h * w) != 0:
+            raise ValueError(
+                "height/width hints (%d x %d) do not divide the layer "
+                "dim %d; fix the data layer's height=/width= or pass "
+                "num_channels" % (h, w, dim))
+        c = dim // (h * w)
+    else:
+        c = num_channels or 1
+    if h and w and c * h * w != dim:
+        raise ValueError(
+            "channels x height x width = %d x %d x %d != layer dim %d"
+            % (c, h, w, dim))
     if not (h and w):
         hw = int(round(math.sqrt(dim // c)))
         if c * hw * hw != dim:

@@ -83,11 +83,19 @@ def _to_yaml(obj, indent=0):
             if isinstance(v, (dict, list)) and v:
                 lines.append("%s%s:" % (pad, k))
                 lines.append(_to_yaml(v, indent + 1))
+            elif isinstance(v, dict):
+                lines.append("%s%s: {}" % (pad, k))   # empty mapping
+            elif isinstance(v, list):
+                lines.append("%s%s: []" % (pad, k))   # empty sequence
             else:
                 lines.append("%s%s: %s" % (pad, k, _scalar(v)))
     elif isinstance(obj, list):
         for item in obj:
-            if isinstance(item, (dict, list)):
+            if isinstance(item, dict) and not item:
+                lines.append("%s- {}" % pad)        # empty mapping item
+            elif isinstance(item, list) and not item:
+                lines.append("%s- []" % pad)        # empty sequence item
+            elif isinstance(item, (dict, list)):
                 body = _to_yaml(item, indent + 1).splitlines()
                 first = body[0].strip() if body else ""
                 lines.append("%s- %s" % (pad, first))

@@ -46,6 +46,11 @@ PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 # conservative est_mfu heuristic (set in main)
 EXACT_MFU = False
 
+# --sync_feed: disable the reader-included path's prefetch overlap
+# (blocking per-step feed conversion + transfer) — the synchronous half
+# of the async-pipeline A/B (set in main)
+SYNC_FEED = False
+
 # model step-FLOPs estimates (fwd+bwd+update ~= 3x fwd), used only for
 # the est_mfu observability field
 FLOPS_PER_ITEM = {
@@ -110,26 +115,41 @@ def _bench_program(main, startup, feed_fn, fetch, place, iterations,
                     for _ in range(total):
                         yield next(stream_src)
             else:
-                pool = [feed_fn() for _ in range(4)]
-
+                # fresh batch built on the host EVERY step (the stated
+                # --use_reader_op methodology): batch synthesis +
+                # conversion is real per-step host work, which the
+                # prefetch thread overlaps with compute and the
+                # --sync_feed half pays on the critical path
                 def reader():
                     for i in range(total):
-                        yield pool[i % len(pool)]
+                        yield feed_fn()
 
-            pyreader = fluid.reader.PyReader(capacity=4)
-            pyreader.decorate_batch_reader(reader, _PassthroughFeeder(),
-                                           place)
-            stream = iter(pyreader)
+            if SYNC_FEED:
+                # synchronous half of the overlap A/B: no prefetch
+                # thread, no dispatch window — feed staging, dispatch,
+                # and the numpy fetch all serialize on the host every
+                # step (the pre-pipeline Executor.run behavior)
+                stream = reader()
+                run_kw = {"return_numpy": True}
+            else:
+                # overlapped: DevicePrefetcher stages step N+1's feed
+                # under step N's compute; the async dispatch window
+                # keeps fetches on device between window edges
+                pyreader = fluid.reader.PyReader(capacity=4)
+                pyreader.decorate_batch_reader(reader, _PassthroughFeeder(),
+                                               place)
+                stream = iter(pyreader)
+                run_kw = {"return_numpy": False}
             for _ in range(skip_batch_num):
                 last = exe.run(main, feed=next(stream), fetch_list=[fetch],
-                               return_numpy=False)
+                               **run_kw)
             if last is not None:
                 np.asarray(last[0])
             for _ in range(N_WINDOWS):
                 t0 = time.perf_counter()
                 for _ in range(iterations):
                     last = exe.run(main, feed=next(stream),
-                                   fetch_list=[fetch], return_numpy=False)
+                                   fetch_list=[fetch], **run_kw)
                 np.asarray(last[0])   # true completion (see below)
                 times.append(time.perf_counter() - t0)
         else:
@@ -965,27 +985,67 @@ def main():
                    help="override the measurement-window count for this"
                         " invocation (auto ladder trims secondary rungs"
                         " to 3)")
-    p.add_argument("--budget_s", type=float,
+    p.add_argument("--budget_s", "--budget-seconds", type=float,
                    default=float(os.environ.get("BENCH_BUDGET_S", "1100")),
                    help="global wall-clock budget for the auto ladder;"
                         " rungs that don't fit are listed in 'omitted'"
                         " (the primary JSON line is reprinted after every"
                         " rung so a hard kill still leaves an artifact)")
+    p.add_argument("--sync_feed", action="store_true",
+                   help="disable the reader-included path's prefetch +"
+                        " async-dispatch overlap (blocking per-step feed"
+                        " staging and numpy fetch) — the synchronous half"
+                        " of the step-overlap A/B")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny 2-rung × 1-window ladder (mlp compute +"
+                        " mlp with_reader) through the full subprocess/"
+                        "budget/artifact machinery; CI regression gate"
+                        " for the real ladder")
+    p.add_argument("--out", default=os.environ.get("BENCH_OUT", ""),
+                   help="also write the (partial) primary JSON artifact"
+                        " to this file after every rung, atomically — a"
+                        " driver kill at any point leaves a valid file")
+    p.add_argument("--compile_cache_dir",
+                   default=os.environ.get("FLAGS_compile_cache_dir", ""),
+                   help="persistent XLA compilation cache directory,"
+                        " shared by every ladder rung subprocess: a warm"
+                        " second invocation skips XLA recompilation")
     args = p.parse_args()
-    global EXACT_MFU, N_WINDOWS
+    global EXACT_MFU, N_WINDOWS, SYNC_FEED
     EXACT_MFU = args.exact_mfu
+    SYNC_FEED = args.sync_feed
     if args.n_windows > 0:
         N_WINDOWS = args.n_windows
+    if args.smoke:
+        args.model = "auto"
+    if args.compile_cache_dir:
+        # children of the auto ladder inherit it via the environment
+        # (flags.py reads FLAGS_* at import); single-model runs apply it
+        # below once paddle_tpu is imported
+        os.environ["FLAGS_compile_cache_dir"] = args.compile_cache_dir
+
+    def _write_out(line):
+        if not args.out:
+            return
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(line + "\n")
+        os.replace(tmp, args.out)
 
     if args.model == "reader_capacity":
         # pure host-side pipeline measurement: no device, no jax client
-        print(json.dumps(bench_reader_capacity(args)))
+        line = json.dumps(bench_reader_capacity(args))
+        print(line)
+        _write_out(line)
         return
 
     if args.pallas or args.fast_prng:
         import paddle_tpu as fluid
         fluid.set_flags({"FLAGS_pallas_kernels": args.pallas,
                          "FLAGS_fast_prng": args.fast_prng})
+    if args.compile_cache_dir:
+        import paddle_tpu as fluid
+        fluid.set_flags({"FLAGS_compile_cache_dir": args.compile_cache_dir})
 
     import jax
     if args.device == "cpu":
@@ -1072,6 +1132,16 @@ def main():
             ("resnet50", ["--infer", "--n_windows", "3"], True, 300),
             ("vgg", ["--infer", "--n_windows", "3"], True, 300),
         ]
+        if args.smoke:
+            # the machinery is the product under test here (subprocess
+            # rungs, budget gate, partial-artifact emit), not the
+            # numbers: 2 rungs x 1 window at toy shapes — one
+            # pure-compute, one through the prefetch + async-dispatch
+            # reader path
+            tiny = ["--batch_size", "32", "--iterations", "2",
+                    "--skip_batch_num", "1", "--n_windows", "1"]
+            runs = [("mlp", list(tiny), False, 120),
+                    ("mlp", ["--with_reader"] + tiny, False, 120)]
 
         t_start = time.monotonic()
 
@@ -1089,12 +1159,15 @@ def main():
                 primary["omitted"] = list(omitted)
             primary["elapsed_s"] = round(time.monotonic() - t_start, 1)
             primary["ladder_complete"] = done
-            print(json.dumps(primary), flush=True)
+            line = json.dumps(primary)
+            print(line, flush=True)
+            _write_out(line)
 
         def rung_name(model, extra):
             if model == "longctx":
                 return "longctx_t4096"
-            drop = {"--n_windows", "--iterations", "--skip_batch_num"}
+            drop = {"--n_windows", "--iterations", "--skip_batch_num",
+                    "--batch_size"}
             return model + "".join(
                 a.replace("--", "_") for a in extra
                 if a.startswith("--") and a not in drop)
@@ -1128,16 +1201,26 @@ def main():
                 if load > 1.5:
                     omitted.append(name + "#host_load=%.2f" % load)
                     continue
-            if not first:
+            if not first and not args.smoke:
                 time.sleep(10)   # let the previous client release the chip
             first = False
             cmd = [sys.executable, __file__, "--model", model,
                    "--device", args.device,
                    "--iterations", str(args.iterations),
                    "--skip_batch_num", str(args.skip_batch_num)] + extra
-            if args.batch_size:
+            if args.batch_size and not args.smoke:
+                # smoke rungs pin their own toy --batch_size in `extra`;
+                # appending the user's here would last-wins override it
                 cmd += ["--batch_size", str(args.batch_size)]
+            if args.sync_feed:
+                # the overlap A/B must reach the rung subprocesses
+                cmd += ["--sync_feed"]
             detail = None
+            # children must not inherit BENCH_OUT: a rung subprocess
+            # would parse it as its own --out and atomically overwrite
+            # the parent's partial ladder artifact with single-rung JSON
+            child_env = {k: v for k, v in os.environ.items()
+                         if k != "BENCH_OUT"}
             # one retry for scored rungs only (tunnel errors are
             # transient), and only while the budget allows it
             max_attempts = 2 if not informational else 1
@@ -1147,7 +1230,8 @@ def main():
                     out = subprocess.run(
                         cmd, stdout=subprocess.PIPE,
                         stderr=subprocess.PIPE, text=True,
-                        timeout=timeout_s, check=True).stdout
+                        timeout=timeout_s, check=True,
+                        env=child_env).stdout
                     r = json.loads(out.strip().splitlines()[-1])
                     if informational:
                         r["informational"] = True
@@ -1215,7 +1299,11 @@ def main():
     # recorded unconditionally; the passes only apply to the resnet model
     result["fuse_conv_bn"] = bool(args.fuse_conv_bn)
     result["nhwc"] = bool(args.nhwc)
-    print(json.dumps(result))
+    # distinguishes the two halves of the step-overlap A/B in artifacts
+    result["sync_feed"] = bool(args.sync_feed)
+    line = json.dumps(result)
+    print(line)
+    _write_out(line)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,191 @@
+"""Compilation caching: persistent XLA cache + program-fingerprint trace cache.
+
+Two layers, addressing the two costs a repeated step shape pays:
+
+* **Persistent XLA compilation cache** (``enable_persistent_cache``): the
+  jax/XLA on-disk executable cache, keyed by HLO fingerprint.  Survives
+  process restarts — bench-ladder rungs, test runs, and training restarts
+  with the same program+signature skip XLA's optimization pipeline and
+  deserialize the executable instead.  Wired to ``FLAGS_compile_cache_dir``
+  (env ``FLAGS_compile_cache_dir=/path`` enables it before the first jit).
+* **Process-global trace cache** (``lookup``/``store``): re-tracing is a
+  host-side cost the XLA cache cannot amortize (jaxpr building walks every
+  op's compute function).  Executors cache their jitted step callables here
+  keyed by a *structural* program fingerprint, so a second Executor /
+  ParallelExecutor instance over the same program (bench reruns inside one
+  process, evaluator clones, tests) reuses the traced+jitted callable and
+  performs zero lowerings.
+
+``stats()`` exposes hit/miss/lowering counters; the executors emit
+``compile_cache/hit`` / ``compile_cache/miss`` profiler marks at every
+lookup so cache behavior is visible in the chrome trace next to the
+``trace``/``compile``/``dispatch`` spans.
+"""
+
+import collections
+import hashlib
+import threading
+
+from .profiler import mark_event
+
+__all__ = [
+    "program_fingerprint", "trace_key", "trace_flag_values", "lookup",
+    "store", "stats", "reset_stats", "clear", "enable_persistent_cache",
+]
+
+
+def trace_flag_values():
+    """Values of every FLAGS_* knob that alters the traced jaxpr (kernel
+    selection, BN variance form, flash-attention seq cutoff).  Every key
+    under which a trace/compiled step is cached — the executors' per-
+    instance keys AND the trace-cache keys here — must include this
+    tuple, or set_flags between runs serves a stale trace."""
+    from . import flags
+
+    return (flags.flag("pallas_kernels"), flags.flag("bn_two_pass"),
+            flags.flag("pallas_attention_max_seq"))
+
+_mu = threading.Lock()
+# LRU of jitted step entries: the jitted callables keep their traced
+# programs alive, so the cache is bounded (a bench ladder lowers dozens
+# of programs, not thousands)
+_MAX_ENTRIES = 64
+_TRACE_CACHE = collections.OrderedDict()
+_STATS = {"trace_hits": 0, "trace_misses": 0, "lowerings": 0}
+_persistent_dir = [None]
+
+
+# ---------------------------------------------------------------------------
+# program fingerprint
+# ---------------------------------------------------------------------------
+
+def program_fingerprint(program):
+    """Stable structural digest of a Program: every block's ops (type,
+    slot bindings, attrs) and vars (shape/dtype/persistability), plus the
+    seed and AMP policy.  Cached on the program keyed by ``_version`` so
+    the per-step cost is one attribute read; structural mutation (op
+    append/insert, rename) bumps ``_version`` and re-hashes."""
+    # memo key carries the AMP policy too: bf16_program_guard swaps
+    # _amp_policy WITHOUT a structural mutation (no _version bump), and
+    # serving the fp32 trace under the guard would silently drop AMP
+    amp = getattr(program, "_amp_policy", None)
+    memo_key = (program._version, None if amp is None else repr(amp))
+    cached = getattr(program, "_fp_cache", None)
+    if cached is not None and cached[0] == memo_key:
+        return cached[1]
+    h = hashlib.sha1()
+    try:
+        h.update(program.to_json().encode())
+    except (TypeError, ValueError):
+        # an op attr that doesn't serialize (sub-block handle, callable):
+        # fall back to repr, which is stable within the process
+        for blk in program.blocks:
+            for op in blk.ops:
+                h.update(repr((op.type, sorted(op.inputs.items()),
+                               sorted(op.outputs.items()),
+                               sorted((k, repr(v))
+                                      for k, v in op.attrs.items()))
+                              ).encode())
+            for n, v in blk.vars.items():
+                h.update(repr((n, v.shape, str(v.dtype), v.persistable,
+                               v.lod_level)).encode())
+        h.update(repr(program.random_seed).encode())
+    if amp is not None:
+        h.update(repr(amp).encode())
+    fp = h.hexdigest()
+    program._fp_cache = (memo_key, fp)
+    return fp
+
+
+def trace_key(program, feed_sig, state_sig, fetch_names, *extras):
+    """Key for the process-global trace cache.  ``state_sig`` must carry
+    the state names (the scope-dependent half of the lowering); ``extras``
+    carries executor-specific trace-time choices (platform, donation,
+    mesh/sharding identity, kernel-selection flags)."""
+    return (program_fingerprint(program), tuple(feed_sig),
+            tuple(state_sig), tuple(fetch_names)) + tuple(extras)
+
+
+# ---------------------------------------------------------------------------
+# trace cache
+# ---------------------------------------------------------------------------
+
+def lookup(key):
+    with _mu:
+        entry = _TRACE_CACHE.get(key)
+        if entry is not None:
+            _TRACE_CACHE.move_to_end(key)
+            _STATS["trace_hits"] += 1
+            mark_event("compile_cache/hit")
+            return entry
+        _STATS["trace_misses"] += 1
+        mark_event("compile_cache/miss")
+        return None
+
+
+def store(key, entry):
+    with _mu:
+        _STATS["lowerings"] += 1
+        _TRACE_CACHE[key] = entry
+        _TRACE_CACHE.move_to_end(key)
+        while len(_TRACE_CACHE) > _MAX_ENTRIES:
+            _TRACE_CACHE.popitem(last=False)
+    return entry
+
+
+def stats():
+    """Counters since process start (or the last ``reset_stats``)."""
+    with _mu:
+        out = dict(_STATS)
+    out["entries"] = len(_TRACE_CACHE)
+    out["persistent_dir"] = _persistent_dir[0]
+    return out
+
+
+def reset_stats():
+    with _mu:
+        for k in _STATS:
+            _STATS[k] = 0
+
+
+def clear():
+    """Drop every cached trace (tests; frees the traced programs)."""
+    with _mu:
+        _TRACE_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# persistent XLA compilation cache
+# ---------------------------------------------------------------------------
+
+def enable_persistent_cache(cache_dir):
+    """Point jax's on-disk executable cache at ``cache_dir`` (empty/None
+    disables).  Thresholds are zeroed so even the CPU-backend test shapes
+    cache: the bench ladder's win case is many small-to-medium modules
+    recompiled across subprocess rungs and re-invocations."""
+    import jax
+
+    _persistent_dir[0] = cache_dir or None
+    jax.config.update("jax_compilation_cache_dir", cache_dir or None)
+    if not cache_dir:
+        return
+    for name, val in (
+        ("jax_enable_compilation_cache", True),
+        ("jax_persistent_cache_min_compile_time_secs", 0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(name, val)
+        except AttributeError:
+            # older/newer jax spelling; the dir alone still enables it
+            pass
+    try:
+        # jax memoizes "cache disabled" on first compile: a process that
+        # already jitted before the flag was set would silently never
+        # cache.  reset_cache drops that memo so the new dir takes
+        # effect immediately.
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except (ImportError, AttributeError):
+        pass

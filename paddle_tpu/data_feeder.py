@@ -120,6 +120,31 @@ class DataFeeder:
                 out[name] = conv.done()
         return out
 
+    def prefetch(self, reader, capacity=2, place=None, shardings=None):
+        """Overlapped input pipeline: a ``DevicePrefetcher`` that runs
+        this feeder's row->array conversion AND the host->device transfer
+        of step N+1 under compute of step N.  ``reader`` yields sample
+        rows (a reader creator or iterable); ``shardings`` routes feeds
+        onto a pjit mesh (``{name: Sharding}`` or one Sharding for all)
+        so ParallelExecutor consumes them with zero extra copies."""
+        from .reader import DevicePrefetcher
+
+        if place is None:
+            place = self.place
+        if place is None and (shardings is None
+                              or isinstance(shardings, dict)):
+            # no place anywhere would stage nothing (host arrays pass
+            # through, h2d lands back on the critical path): default to
+            # the accelerator like layers.double_buffer (TPUPlace falls
+            # back to the first local device on CPU-only hosts).  A
+            # partial shardings dict still needs it for unlisted feeds.
+            from .executor import TPUPlace
+
+            place = TPUPlace(0)
+        return DevicePrefetcher(
+            reader, feeder=self, place=place,
+            shardings=shardings, capacity=capacity)
+
     def feed_parallel(self, iterable, num_places=None):
         """Split one batch into per-device feeds (reference
         data_feeder.py:feed_parallel) — used by the mesh runtime for

@@ -25,20 +25,22 @@ TPU-first:
   remaining reproducible (replaces the reference's per-op seed attrs).
 """
 
+import collections
 import time
 
 import numpy as np
 
 import jax
 
-from . import flags, registry
+from . import compile_cache, flags, registry
 from .core import materialize_dtype
 from .framework import Program, Variable, default_main_program
 from .profiler import RecordEvent
 from .registry import ComputeContext
 from .scope import Scope, global_scope
 
-__all__ = ["Executor", "CPUPlace", "TPUPlace", "place_from_string"]
+__all__ = ["Executor", "AsyncDispatchQueue", "CPUPlace", "TPUPlace",
+           "place_from_string"]
 
 
 class Place:
@@ -186,6 +188,97 @@ class _CompiledProgram:
         self.state_in = state_in      # read from scope before the step
         self.state_out = state_out    # written back to scope after
         self.fetch_names = fetch_names
+        # feed signatures already dispatched through this entry.  jax.jit
+        # retraces+recompiles per feed shape, and the entry is shared
+        # process-globally (trace cache), so warmth is per-signature: an
+        # unseen shape's first call pays trace + XLA compile (or a
+        # persistent-cache deserialize) and is recorded as a "compile"
+        # span, seen shapes as "dispatch"
+        self.seen_sigs = set()
+
+
+class AsyncDispatchQueue:
+    """Bounded window of in-flight (dispatched, not-yet-synced) steps.
+
+    jax dispatch is already asynchronous; what needs managing is the
+    HOST's run-ahead: an unbounded `return_numpy=False` loop enqueues
+    work (and keeps fetch buffers alive) faster than the device retires
+    it.  Each dispatched step's fetch handles are ``push``ed; once more
+    than ``max_inflight`` steps are outstanding the OLDEST is
+    ``block_until_ready``-ed — the only sync on the fast path, at the
+    window edge, never per step.  ``drain`` syncs everything (epoch
+    boundaries, checkpointing, reading host values)."""
+
+    def __init__(self, max_inflight=None, name="executor"):
+        # None = re-read FLAGS_max_inflight_steps on every push, so
+        # set_flags keeps working after the executor is constructed
+        self._max_inflight = max_inflight
+        self._name = name
+        self._inflight = collections.deque()
+
+    @property
+    def max_inflight(self):
+        lim = self._max_inflight
+        if lim is None:
+            lim = flags.flag("max_inflight_steps")
+        return max(1, int(lim))
+
+    def __len__(self):
+        return len(self._inflight)
+
+    def push(self, handles):
+        """Register one dispatched step's output handles; blocks on the
+        oldest step iff the window is over-full."""
+        self._inflight.append(handles)
+        while len(self._inflight) > self.max_inflight:
+            self._sync_oldest()
+
+    def push_step(self, fetches, new_state):
+        """Register one async-dispatched step: its fetch handles when
+        present, else a tiny sync token derived from the state.  A
+        fetch-less step has nothing un-donated to wait on — the next
+        step's dispatch donates every new_state buffer — so the token
+        (a one-element gather, NOT ravel(): an eager reshape copies the
+        whole array and forces a layout change on sharded state) is
+        what keeps the window a real bound.  Multihost non-addressable
+        state can't be sliced from one process; those fetch-less loops
+        go unbounded rather than crash."""
+        handles = fetches
+        if not handles and new_state and \
+                getattr(new_state[0], "is_fully_addressable", True):
+            s0 = new_state[0]
+            handles = [s0[(0,) * s0.ndim]]
+        if handles:
+            self.push(handles)
+
+    @staticmethod
+    def _live_leaves(handles):
+        return [l for l in jax.tree_util.tree_leaves(handles)
+                if not getattr(l, "is_deleted", lambda: False)()]
+
+    def _sync_oldest(self):
+        oldest = self._inflight.popleft()
+        with RecordEvent(self._name + "/fetch_sync"):
+            live = self._live_leaves(oldest)
+            if not live:
+                # a fetch-less step's handles are its new_state, and the
+                # NEXT step donates those buffers (donate_argnums), so
+                # the popped entry may hold nothing waitable.  Blocking
+                # on the oldest still-live leaf among the younger
+                # in-flight steps retires this one too (same-device
+                # program order) and keeps the window a real bound —
+                # skipping outright would let the host run ahead
+                # without limit.
+                for entry in self._inflight:
+                    live = self._live_leaves(entry)
+                    if live:
+                        break
+            jax.block_until_ready(live)
+
+    def drain(self):
+        """Block until every in-flight step has retired."""
+        while self._inflight:
+            self._sync_oldest()
 
 
 class Executor:
@@ -201,9 +294,17 @@ class Executor:
         self.donate_state = donate_state
         self._cache = {}
         self._run_counter = 0
+        self._dispatch_queue = AsyncDispatchQueue(name="executor")
 
     # ------------------------------------------------------------------
+    def sync(self):
+        """Retire every in-flight async-dispatched step (the
+        ``return_numpy=False`` fast path never syncs per step; call this
+        at epoch/checkpoint boundaries to force completion)."""
+        self._dispatch_queue.drain()
+
     def close(self):
+        self.sync()
         self._cache.clear()
 
     def _program_key(self, program, feed_sig, fetch_names, scope):
@@ -215,9 +316,8 @@ class Executor:
         return (id(program), program._version, program.random_seed, feed_sig,
                 tuple(fetch_names), id(scope),
                 getattr(program, '_amp_policy', None),
-                # trace-time choices must key the cache: kernel selection
-                # and the BN variance form are both baked into the jaxpr
-                flags.flag("pallas_kernels"), flags.flag("bn_two_pass"))
+                # trace-time flag choices are baked into the jaxpr
+                compile_cache.trace_flag_values())
 
     def _analyze(self, program, feed_names, scope, fetch_names=()):
         """Split program vars into feeds / state-from-scope / temporaries."""
@@ -254,14 +354,26 @@ class Executor:
         return state, writeback
 
     def _lower(self, program, feed_names, state_names, writeback, fetch_names):
-        fn, state_in, state_out = trace_program(
-            program, feed_names, state_names, writeback, fetch_names,
-            platform=self.place.jax_device().platform,
-        )
-        donate = (1,) if self.donate_state else ()
-        jitted = jax.jit(fn, donate_argnums=donate)
-        return _CompiledProgram(jitted, feed_names, state_in, state_out,
-                                fetch_names)
+        platform = self.place.jax_device().platform
+        # process-global trace cache: a second executor over the same
+        # program structure + signature (bench reruns, evaluator clones)
+        # reuses the jitted step — zero new lowerings
+        tkey = compile_cache.trace_key(
+            program, feed_names, tuple(state_names), fetch_names,
+            "jit", platform, self.donate_state,
+            compile_cache.trace_flag_values())
+        cached = compile_cache.lookup(tkey)
+        if cached is not None:
+            return cached
+        with RecordEvent("executor/trace"):
+            fn, state_in, state_out = trace_program(
+                program, feed_names, state_names, writeback, fetch_names,
+                platform=platform,
+            )
+            donate = (1,) if self.donate_state else ()
+            jitted = jax.jit(fn, donate_argnums=donate)
+        return compile_cache.store(tkey, _CompiledProgram(
+            jitted, feed_names, state_in, state_out, fetch_names))
 
     # ------------------------------------------------------------------
     def run(
@@ -300,6 +412,7 @@ class Executor:
         if compiled is None:
             # the reference wraps op instantiation in RecordBlock
             # (executor.cc Prepare); here the analog is the trace+jit
+            # (_lower consults the process-global trace cache first)
             with RecordEvent("executor/compile"):
                 state_names, writeback = self._analyze(
                     program, feed_names, scope, fetch_names)
@@ -309,9 +422,11 @@ class Executor:
             self._cache[key] = compiled
 
         dev = self.place.jax_device()
-        state_vals = [
-            jax.device_put(scope.var(n), dev) for n in compiled.state_in
-        ]
+        with RecordEvent("executor/h2d_transfer"):
+            state_vals = [
+                jax.device_put(scope.var(n), dev) for n in compiled.state_in
+            ]
+            feed_dev = [jax.device_put(v, dev) for v in feed_vals]
         seed = program.random_seed or 0
         rng = jax.random.key(
             np.uint32(seed) if seed else np.random.randint(0, 2**31 - 1),
@@ -321,12 +436,18 @@ class Executor:
         self._run_counter += 1
 
         t0 = time.perf_counter() if flags.flag("benchmark") else None
+        # an unseen feed signature's first call pays jaxpr trace + XLA
+        # compile (or a persistent-cache deserialize) — recorded as a
+        # compile span so cache hits are observable as its disappearance
+        step_span = "executor/dispatch" if feed_sig in compiled.seen_sigs \
+            else "executor/compile"
         with RecordEvent("executor/run"):
-            with jax.default_device(dev):
-                fetches, new_state = compiled.fn(
-                    [jax.device_put(v, dev) for v in feed_vals], state_vals,
-                    rng
-                )
+            with RecordEvent(step_span):
+                with jax.default_device(dev):
+                    fetches, new_state = compiled.fn(
+                        feed_dev, state_vals, rng
+                    )
+        compiled.seen_sigs.add(feed_sig)
 
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
@@ -340,7 +461,13 @@ class Executor:
                   % ((time.perf_counter() - t0) * 1e3))
 
         if return_numpy:
-            fetches = [np.asarray(f) for f in fetches]
+            with RecordEvent("executor/fetch_sync"):
+                fetches = [np.asarray(f) for f in fetches]
+        else:
+            # async fast path: fetches stay device arrays; bound the
+            # host's run-ahead on the dispatch window (sync only at
+            # window edges, never per step)
+            self._dispatch_queue.push_step(fetches, new_state)
         return fetches
 
     def cost_analysis(self, program=None, feed=None, fetch_list=None,

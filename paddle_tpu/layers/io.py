@@ -178,15 +178,15 @@ class ReaderHandle(object):
             feeder = self._feeder()
             convert = feeder.feed
         if self._place is not None:
-            from .. import reader as reader_mod
+            from ..reader import DevicePrefetcher
 
             class _F:
                 def feed(self, rows, _convert=convert):
                     return _convert(rows)
 
-            pr = reader_mod.PyReader(capacity=self._capacity or 4)
-            pr.decorate_batch_reader(self._source, _F(), self._place)
-            return iter(pr)
+            return iter(DevicePrefetcher(
+                self._source, feeder=_F(), place=self._place,
+                capacity=self._capacity or 4))
         return (convert(rows) for rows in self._source())
 
     def _replace(self, source, batched=None):
@@ -320,11 +320,12 @@ def batch(reader, batch_size):
                            batched=True)
 
 
-def double_buffer(reader, place=None, name=None):
+def double_buffer(reader, place=None, name=None, capacity=None):
     """Stage batches onto the device ahead of the consuming loop
     (reference io.py:888 double_buffer /
-    create_double_buffer_reader_op.cc — here via reader.PyReader's
-    daemon device_put thread)."""
+    create_double_buffer_reader_op.cc — here via
+    reader.DevicePrefetcher's daemon device_put thread; ``capacity``
+    widens the classic 2-deep double buffer into an N-deep window)."""
     if isinstance(reader, Preprocessor):
         reader = reader()
     h = reader._replace(reader._source)
@@ -332,6 +333,8 @@ def double_buffer(reader, place=None, name=None):
     # default: the accelerator (TPUPlace falls back to the first local
     # device on CPU-only hosts) — staging to CPU would just add a copy
     h._place = place or TPUPlace(0)
+    if capacity is not None:
+        h._capacity = capacity
     return h
 
 

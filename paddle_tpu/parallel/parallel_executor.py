@@ -31,8 +31,9 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .. import flags, registry  # noqa: F401  (op registry must be loaded)
-from ..executor import trace_program, Executor, _check_finite
+from .. import compile_cache, flags, registry  # noqa: F401  (op registry must be loaded)
+from ..executor import (AsyncDispatchQueue, trace_program, Executor,
+                        _check_finite)
 from ..profiler import RecordEvent
 from ..framework import Variable, default_main_program
 from ..scope import global_scope
@@ -53,6 +54,7 @@ class _Compiled:
         self.feed_shardings = feed_shardings
         self.state_shardings = state_shardings
         self.out_state_shardings = out_state_shardings
+        self.warm = False      # first dispatch = trace+compile (see Executor)
 
 
 class ParallelExecutor:
@@ -73,6 +75,7 @@ class ParallelExecutor:
         self._cache = {}
         self._run_counter = 0
         self._auto_seed_val = None
+        self._dispatch_queue = AsyncDispatchQueue(name="parallel_executor")
         # observability: how many ragged batches were replication-padded
         # (the data_balance_op_handle capability — see _pad_uneven)
         self.uneven_batches_padded = 0
@@ -145,14 +148,37 @@ class ParallelExecutor:
                 return P(AXIS_DP)
         return P()
 
-    def _compile(self, program, feed_names, fetch_names, scope, feed_vals):
+    def _compile(self, program, feed_names, fetch_names, scope, feed_vals,
+                 feed_sig):
         exe = Executor.__new__(Executor)  # reuse its analyzer only
         state_names, writeback = Executor._analyze(
             exe, program, feed_names, scope)
-        fn, state_in, state_out = trace_program(
-            program, feed_names, state_names, writeback, fetch_names,
-            platform=self._mesh.devices.flat[0].platform, mesh=self._mesh,
-            sequence_parallel=self._build_strategy.sequence_parallel)
+        bs = self._build_strategy
+        # process-global trace cache: key everything this lowering bakes
+        # in — program structure + signatures (fingerprint/feed/state/
+        # fetch), mesh identity, and the sharding policy knobs
+        state_sig = tuple(
+            (n, tuple(getattr(scope.var(n), "shape", ())),
+             str(getattr(scope.var(n), "dtype", "")))
+            for n in state_names)
+        mesh_key = (tuple(self._mesh.axis_names),
+                    tuple(self._mesh.devices.shape),
+                    tuple(int(d.id) for d in self._mesh.devices.flat))
+        tkey = compile_cache.trace_key(
+            program, feed_sig, state_sig, fetch_names,
+            "pjit", mesh_key, bs.reduce_strategy, bs.param_sharding_fn,
+            bs.feed_sharding_fn, bs.sequence_parallel, bs.remat,
+            bs.donate_state, jax.process_count(),
+            compile_cache.trace_flag_values())
+        cached = compile_cache.lookup(tkey)
+        if cached is not None:
+            return cached
+        with RecordEvent("parallel_executor/trace"):
+            fn, state_in, state_out = trace_program(
+                program, feed_names, state_names, writeback, fetch_names,
+                platform=self._mesh.devices.flat[0].platform,
+                mesh=self._mesh,
+                sequence_parallel=self._build_strategy.sequence_parallel)
 
         mesh = self._mesh
         batch_spec = P(AXIS_DP)
@@ -204,15 +230,18 @@ class ParallelExecutor:
         fetch_shardings = None
         if jax.process_count() > 1:
             fetch_shardings = [NamedSharding(mesh, P())] * len(fetch_names)
+        # jax.jit here is lazy (tracing deferred to the first call): no
+        # span — the real jaxpr cost is the trace_program above
         jitted = jax.jit(
             fn,
             in_shardings=(feed_shardings, state_shardings, None),
             out_shardings=(fetch_shardings, out_state_shardings),
             donate_argnums=donate,
         )
-        return _Compiled(jitted, feed_names, state_in, state_out,
-                         fetch_names, feed_shardings, state_shardings,
-                         out_state_shardings)
+        return compile_cache.store(tkey, _Compiled(
+            jitted, feed_names, state_in, state_out,
+            fetch_names, feed_shardings, state_shardings,
+            out_state_shardings))
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -303,7 +332,8 @@ class ParallelExecutor:
         # no id()-reuse aliasing after GC)
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
                id(scope), getattr(program, '_amp_policy', None),
-               flags.flag("pallas_kernels"),
+               # trace-time flag choices, matching _compile's trace_key
+               compile_cache.trace_flag_values(),
                self._build_strategy.reduce_strategy,
                self._build_strategy.param_sharding_fn,
                self._build_strategy.feed_sharding_fn)
@@ -311,32 +341,36 @@ class ParallelExecutor:
         if compiled is None:
             with RecordEvent("parallel_executor/compile"):
                 compiled = self._compile(program, feed_names, fetch_names,
-                                         scope, feed_vals)
+                                         scope, feed_vals, feed_sig)
             self._cache[key] = compiled
 
         multihost = jax.process_count() > 1
-        if multihost:
-            # NCCL2-mode parity: each trainer process feeds its LOCAL
-            # shard of the global batch; the global array spans hosts
-            # (parallel_executor.cc:102 flat world of trainer ranks)
-            feed_dev = [
-                v if isinstance(v, jax.Array) and len(v.sharding.device_set)
-                > 1 else jax.make_array_from_process_local_data(s, v)
-                for v, s in zip(feed_vals, compiled.feed_shardings)
-            ]
-            state_dev = [
-                self._global_state(scope.var(n), s)
-                for n, s in zip(compiled.state_in, compiled.state_shardings)
-            ]
-        else:
-            feed_dev = [
-                jax.device_put(v, s)
-                for v, s in zip(feed_vals, compiled.feed_shardings)
-            ]
-            state_dev = [
-                jax.device_put(scope.var(n), s)
-                for n, s in zip(compiled.state_in, compiled.state_shardings)
-            ]
+        with RecordEvent("parallel_executor/h2d_transfer"):
+            if multihost:
+                # NCCL2-mode parity: each trainer process feeds its LOCAL
+                # shard of the global batch; the global array spans hosts
+                # (parallel_executor.cc:102 flat world of trainer ranks)
+                feed_dev = [
+                    v if isinstance(v, jax.Array)
+                    and len(v.sharding.device_set)
+                    > 1 else jax.make_array_from_process_local_data(s, v)
+                    for v, s in zip(feed_vals, compiled.feed_shardings)
+                ]
+                state_dev = [
+                    self._global_state(scope.var(n), s)
+                    for n, s in zip(compiled.state_in,
+                                    compiled.state_shardings)
+                ]
+            else:
+                feed_dev = [
+                    jax.device_put(v, s)
+                    for v, s in zip(feed_vals, compiled.feed_shardings)
+                ]
+                state_dev = [
+                    jax.device_put(scope.var(n), s)
+                    for n, s in zip(compiled.state_in,
+                                    compiled.state_shardings)
+                ]
         seed = program.random_seed or 0
         rng = jax.random.key(
             np.uint32(seed) if seed else self._auto_seed(),
@@ -344,8 +378,12 @@ class ParallelExecutor:
         rng = jax.random.fold_in(rng, self._run_counter)
         self._run_counter += 1
 
+        step_span = "parallel_executor/dispatch" if compiled.warm \
+            else "parallel_executor/compile"
         with RecordEvent("parallel_executor/run"):
-            fetches, new_state = compiled.fn(feed_dev, state_dev, rng)
+            with RecordEvent(step_span):
+                fetches, new_state = compiled.fn(feed_dev, state_dev, rng)
+        compiled.warm = True
 
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
@@ -369,14 +407,29 @@ class ParallelExecutor:
                 and f.shape[0] == padded_b and _is_batch_var(n) else f
                 for n, f in zip(compiled.fetch_names, fetches)
             ]
+        np_fetches = None
         if flags.flag("check_nan_inf"):
             # fetches only: state may span hosts (not fully addressable).
-            # Convert once and reuse for the return value.
-            fetches = [self._fetch_to_np(f) for f in fetches]
-            _check_finite(zip(compiled.fetch_names, fetches))
+            # Convert into a side copy so return_numpy=False still hands
+            # back device arrays (the check implies a per-step sync, not
+            # a type change).
+            np_fetches = [self._fetch_to_np(f) for f in fetches]
+            _check_finite(zip(compiled.fetch_names, np_fetches))
         if return_numpy:
-            fetches = [self._fetch_to_np(f) for f in fetches]
+            with RecordEvent("parallel_executor/fetch_sync"):
+                fetches = np_fetches if np_fetches is not None else \
+                    [self._fetch_to_np(f) for f in fetches]
+        else:
+            # async fast path (matches single-device Executor semantics):
+            # fetches stay (possibly sharded) device arrays, no per-step
+            # sync — the dispatch window blocks only at its edge
+            self._dispatch_queue.push_step(fetches, new_state)
         return fetches
+
+    def sync(self):
+        """Retire every in-flight async-dispatched step (see
+        ``Executor.sync``)."""
+        self._dispatch_queue.drain()
 
     def _auto_seed(self):
         """Seed for programs with no explicit random_seed.  Drawn once

@@ -15,9 +15,9 @@ import threading
 import time
 
 __all__ = [
-    "RecordEvent", "record_event", "profiler", "start_profiler",
-    "stop_profiler", "reset_profiler", "export_chrome_tracing",
-    "cuda_profiler", "npu_profiler",
+    "RecordEvent", "record_event", "mark_event", "profiler",
+    "start_profiler", "stop_profiler", "reset_profiler",
+    "export_chrome_tracing", "cuda_profiler", "npu_profiler",
 ]
 
 _state = threading.local()
@@ -59,6 +59,23 @@ class RecordEvent:
 
 
 record_event = RecordEvent
+
+
+def mark_event(name):
+    """Instantaneous event (zero-duration span): cache hits/misses and
+    other point occurrences, countable in the summary and visible in the
+    chrome trace next to the ``RecordEvent`` spans."""
+    if not _enabled[0]:
+        return
+    with _events_lock:
+        _events.append({
+            "name": name,
+            "ts": _now_us(),
+            "dur": 0.0,
+            "ph": "X",
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+        })
 
 
 def start_profiler(state="All", trace_dir=None):

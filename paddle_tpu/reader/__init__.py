@@ -1,22 +1,25 @@
-"""Reader framework: decorators + device-prefetching PyReader.
+"""Reader framework: decorators + device-prefetching pipeline.
 
 Parity: reference ``python/paddle/reader/`` + the py_reader op family
 (``operators/reader/create_py_reader_op.cc``,
 ``create_double_buffer_reader_op.cc``, ``lod_tensor_blocking_queue.h``) —
-TPU-native: PyReader is a host thread that stages feed dicts onto the
-device ahead of the training loop (double buffering over the host link),
-not an in-graph op chain; under jit the executor consumes device-resident
-arrays with zero extra copies.
+TPU-native: ``DevicePrefetcher`` is a host thread that converts and
+stages feed dicts onto the device ahead of the training loop (double
+buffering over the host link generalized to a capacity-N window), not an
+in-graph op chain; under jit the executor consumes device-resident
+arrays with zero extra copies.  ``PyReader`` is the reference-named
+facade over it.
 """
 
 import queue
 import threading
+import weakref
 
 from .decorator import *  # noqa: F401,F403
 from . import creator  # noqa: F401
 from . import decorator  # noqa: F401
 
-__all__ = decorator.__all__ + ["PyReader", "batch"]
+__all__ = decorator.__all__ + ["DevicePrefetcher", "PyReader", "batch"]
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -34,8 +37,236 @@ def batch(reader, batch_size, drop_last=False):
     return batch_reader
 
 
+class DevicePrefetcher:
+    """Executor-level device prefetcher: host feed conversion and
+    ``jax.device_put`` of step N+1 overlap device compute of step N.
+
+    Generalizes the PyReader double buffer to every feed path:
+
+    * ``source`` — a reader creator (callable returning an iterator) or
+      a plain iterable; items are sample-row lists when ``feeder`` is
+      given (converted via ``DataFeeder.feed``), feed dicts otherwise.
+    * ``place`` — an executor Place (or jax device) for single-device
+      staging.
+    * ``shardings`` — pjit path: a ``{feed_name: Sharding}`` dict (or one
+      Sharding for every feed); arrays arrive on the mesh already laid
+      out, so ``ParallelExecutor.run``'s own device_put is a no-op.
+    * ``capacity`` — how many staged batches may be in flight ahead of
+      the consumer.
+
+    A daemon thread runs the conversion+transfer; iterate to get
+    device-resident feed dicts.  A producer exception is re-raised at the
+    consumer AFTER already-staged batches drain (the training loop sees
+    every good batch, then the real error — not a silent end-of-data).
+    ``close()`` (or exiting the context manager) stops the producer and
+    joins it even when the consumer abandoned iteration early.  With a
+    callable ``source`` or a re-iterable container the prefetcher is
+    re-iterable (each epoch spawns a fresh producer over the source);
+    over a one-shot iterator a second iteration raises rather than
+    silently yielding an empty epoch.
+    """
+
+    _END = object()
+
+    def __init__(self, source, feeder=None, place=None, shardings=None,
+                 capacity=2):
+        self._source = source
+        self._feeder = feeder
+        self._place = place
+        self._shardings = shardings
+        self._q = queue.Queue(maxsize=max(1, int(capacity)))
+        self._stop = threading.Event()
+        self._failure = []
+        self._thread = None
+        # epoch generation: producer and consumer bind the generation's
+        # (queue, stop, failure) at start, so a stale iterator from a
+        # superseded epoch can neither steal the new epoch's batches nor
+        # kill it when garbage-collected
+        self._epoch = 0
+        # weakref to the epoch's handed-out consumer generator: detects
+        # a live iterator even before its first next() (the producer
+        # thread only exists after that), while a dropped-unadvanced
+        # iterator reads as dead and doesn't block a fresh one
+        self._consumer = None
+
+    # -- staging -------------------------------------------------------
+    def _stage(self, feed):
+        import jax
+
+        from ..profiler import RecordEvent
+
+        dev = self._place
+        if dev is not None and hasattr(dev, "jax_device"):
+            dev = dev.jax_device()
+        out = {}
+        with RecordEvent("prefetch/h2d_transfer"):
+            for k, v in feed.items():
+                target = None
+                if isinstance(self._shardings, dict):
+                    # feeds absent from a partial dict still stage to
+                    # the plain device — leaving them on the host would
+                    # put their h2d back on the per-step critical path
+                    target = self._shardings.get(k, dev)
+                elif self._shardings is not None:
+                    target = self._shardings
+                elif dev is not None:
+                    target = dev
+                out[k] = jax.device_put(v, target) if target is not None \
+                    else v
+        return out
+
+    def _producer(self, q, stop, failure):
+        try:
+            it = self._source() if callable(self._source) \
+                else iter(self._source)
+            for item in it:
+                if stop.is_set():
+                    return
+                feed = self._feeder.feed(item) if self._feeder is not None \
+                    else item
+                feed = self._stage(feed)
+                while not stop.is_set():
+                    try:
+                        q.put(feed, timeout=0.05)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — must cross threads
+            failure.append(e)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(self._END, timeout=0.05)
+                    break
+                except queue.Full:
+                    continue
+
+    def _ensure_started(self, epoch, q, stop, failure):
+        # called from inside the consumer generator with ITS epoch's
+        # objects: a superseded generator (stop set) or one whose epoch
+        # was reset before first advance must not spawn a producer for
+        # the current epoch's thread slot
+        if epoch == self._epoch and self._thread is None \
+                and not stop.is_set():
+            self._thread = threading.Thread(
+                target=self._producer, args=(q, stop, failure),
+                daemon=True)
+            self._thread.start()
+
+    def _restartable(self):
+        """Whether the source can produce a fresh stream per epoch:
+        reader creators (callables) and re-iterable containers (lists,
+        datasets) can; a one-shot iterator (`iter(x) is x`) cannot."""
+        src = self._source
+        return callable(src) or iter(src) is not src
+
+    # -- consumer protocol ---------------------------------------------
+    def __iter__(self):
+        live_consumer = (self._consumer is not None
+                         and self._consumer() is not None)
+        if live_consumer and not self._stop.is_set():
+            if self._restartable():
+                # iter() over a live stream from a re-startable source
+                # means "fresh epoch from the top" (the documented
+                # contract): stop the current producer before
+                # restarting, so the new epoch never shares a
+                # half-consumed stream
+                self.close()
+            else:
+                # a second live consumer over a one-shot iterator would
+                # share the queue, and dropping either would close the
+                # epoch under the other — the silent truncation this
+                # class exists to prevent
+                raise RuntimeError(
+                    "DevicePrefetcher already has an active iterator;"
+                    " a one-shot iterator source supports a single pass")
+        if self._stop.is_set():
+            # a finished/closed prefetcher: re-iterable iff the source
+            # can produce a fresh stream (reader creators, containers;
+            # the PyReader multi-epoch contract).  A one-shot iterator
+            # is exhausted — raising beats silently yielding an empty
+            # epoch.
+            if not self._restartable():
+                raise RuntimeError(
+                    "DevicePrefetcher over a one-shot iterator is"
+                    " exhausted; pass a callable reader creator or a"
+                    " re-iterable container to re-iterate")
+            self._epoch += 1
+            self._q = queue.Queue(maxsize=self._q.maxsize)
+            self._stop = threading.Event()
+            self._failure = []
+            self._thread = None
+        gen = self._consume(self._epoch, self._q, self._stop,
+                            self._failure)
+        self._consumer = weakref.ref(gen)
+        return gen
+
+    def _consume(self, epoch, q, stop, failure):
+        # q/stop/failure are this epoch's objects, bound at iter() time:
+        # a stale generator or producer from a superseded epoch can
+        # neither steal the new epoch's batches nor poison it with a
+        # stale exception
+        try:
+            # lazy producer start INSIDE the generator body: a created-
+            # but-never-advanced iterator has no thread to leak (its
+            # finally below would never run)
+            self._ensure_started(epoch, q, stop, failure)
+            while True:
+                if stop.is_set():
+                    return
+                try:
+                    # bounded wait so a concurrent close() can't strand
+                    # the consumer on an empty queue whose producer
+                    # already died
+                    item = q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if item is self._END:
+                    if failure:
+                        # a swallowed producer error would masquerade as
+                        # end-of-data; surface it where the training
+                        # loop is
+                        raise failure[0]
+                    return
+                yield item
+        finally:
+            # covers GeneratorExit too: an abandoned iteration (early
+            # break with the facade dropping this handle) must stop the
+            # producer thread, not leave it spinning on a full queue
+            # holding staged device batches alive.  Guarded by epoch so
+            # a superseded iterator's GC cannot kill the live one.
+            if epoch == self._epoch:
+                self.close()
+            else:
+                stop.set()
+
+    def close(self):
+        """Stop the producer and join it (idempotent).  Safe mid-stream:
+        drains the queue so a blocked ``put`` observes the stop flag."""
+        self._stop.set()
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        return self
+
+    def __enter__(self):
+        # deliberately lazy: starting the producer here would stage
+        # batches that __iter__'s fresh-epoch restart (callable sources)
+        # then discards — the first iter() starts it
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
 class PyReader:
-    """Host->device prefetch pipeline.
+    """Host->device prefetch pipeline (reference-named facade over
+    ``DevicePrefetcher``).
 
     ``decorate_batch_reader(reader, feeder, place)``: reader yields lists
     of samples; feeder converts them to feed dicts; a daemon thread
@@ -60,38 +291,9 @@ class PyReader:
         return self.decorate_batch_reader(reader, feeder, place)
 
     def __iter__(self):
-        import jax
-
         if self._reader is None:
             raise RuntimeError("call decorate_batch_reader first")
-        dev = self._place.jax_device() if self._place is not None else None
-        q = queue.Queue(maxsize=self.capacity)
-        end = object()
-        failure = []   # producer exception, re-raised on the consumer
-
-        def producer():
-            try:
-                for rows in self._reader():
-                    feed = self._feeder.feed(rows)
-                    if dev is not None:
-                        feed = {
-                            k: jax.device_put(v, dev)
-                            for k, v in feed.items()
-                        }
-                    q.put(feed)
-            except BaseException as e:  # noqa: BLE001 — must cross threads
-                failure.append(e)
-            finally:
-                q.put(end)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is end:
-                if failure:
-                    # a swallowed producer error would masquerade as
-                    # end-of-data; surface it where the training loop is
-                    raise failure[0]
-                break
-            yield item
+        # a fresh prefetcher per iteration: PyReader is re-iterable
+        return iter(DevicePrefetcher(
+            self._reader, feeder=self._feeder, place=self._place,
+            capacity=self.capacity))

@@ -38,3 +38,10 @@ def fresh_programs():
     framework.switch_main_program(old_main)
     framework.switch_startup_program(old_startup)
     unique_name.switch(old_gen)
+    # the per-test unique_name reset makes structurally identical
+    # programs from DIFFERENT tests fingerprint-collide in the
+    # process-global trace cache; drop it so a monkeypatched op in one
+    # test can never serve a stale trace to the next
+    from paddle_tpu import compile_cache
+
+    compile_cache.clear()

@@ -91,8 +91,15 @@ def _bench_program(main, startup, feed_fn, fetch, place, iterations,
     create_double_buffer_reader_op.cc capability).  Otherwise one feed
     is staged on device and the loop measures pure compute."""
     import paddle_tpu as fluid
+    from paddle_tpu import monitor
 
     import jax
+    # rungs run with always-on telemetry: the same StepStats records a
+    # production run logs land in the BENCH artifact (step_stats below),
+    # and the rung doubles as the monitor-on overhead check
+    if not monitor.enabled():
+        fluid.set_flags({"FLAGS_monitor": True})
+    monitor.step_stats().reset()
     scope = fluid.Scope()
     times = []
     with fluid.scope_guard(scope):
@@ -208,6 +215,10 @@ def _bench_program(main, startup, feed_fn, fetch, place, iterations,
         stats["exact_mfu"] = round(
             stats["exact_gflops_per_step"] * 1e9 / best /
             (PEAK_TFLOPS * 1e12), 4)
+    # the monitor's own view of the rung (all steps incl. warmup):
+    # step-time aggregates, fetch-sync wait, cache hit ratio, queue
+    # depth/occupancy — same fields a production JSONL log carries
+    stats["step_stats"] = monitor.step_stats().summary()
     return best, stats
 
 
@@ -713,9 +724,13 @@ def bench_transformer_realdist(args, use_amp=True):
     signature for four, recovering most of the padding waste.
     """
     import paddle_tpu as fluid
+    from paddle_tpu import monitor
     from paddle_tpu.models import transformer as tfm
     from paddle_tpu.reader import decorator as dec
 
+    if not monitor.enabled():
+        fluid.set_flags({"FLAGS_monitor": True})
+    monitor.step_stats().reset()
     batch = args.batch_size or 128
     max_len = 64
     vocab = 32000
@@ -823,7 +838,8 @@ def bench_transformer_realdist(args, use_amp=True):
                      results["bucketed"] / TRANSFORMER_TARGET, 4)},
                 fixed_pad_max_real_tokens_per_sec=results["fixed_pad_max"],
                 bucketed_vs_fixed=round(
-                    results["bucketed"] / results["fixed_pad_max"], 3))
+                    results["bucketed"] / results["fixed_pad_max"], 3),
+                step_stats=monitor.step_stats().summary())
 
 
 def bench_longctx(args, use_amp=True):

@@ -38,6 +38,7 @@ from . import learning_rate_decay
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
 from . import io
+from . import monitor
 from . import profiler
 from . import parallel
 from . import reader
@@ -79,7 +80,8 @@ __all__ = [
     "Scope", "Tensor", "LoDTensor", "LoDTensorArray",
     "learning_rate_decay",
     "global_scope", "scope_guard", "ParamAttr", "WeightNormParamAttr",
-    "DataFeeder", "io", "profiler", "parallel", "ParallelExecutor",
+    "DataFeeder", "io", "monitor", "profiler", "parallel",
+    "ParallelExecutor",
     "BuildStrategy", "ExecutionStrategy", "make_mesh", "reader",
     "dataset", "batch", "compat", "utils", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "InferenceTranspiler",

@@ -134,9 +134,17 @@ def store(key, entry):
 
 
 def stats():
-    """Counters since process start (or the last ``reset_stats``)."""
+    """Counters since process start (or the last ``reset_stats``).
+    ``hit_ratio`` (hits / lookups, 0.0 before the first lookup) is the
+    StepStats field: a warm steady-state loop sits at ~1.0 and a retrace
+    storm (shape churn, program mutation) drags it visibly down.
+    Per-lookup hit/miss marks additionally double-publish as
+    ``mark/compile_cache/{hit,miss}`` monitor counters."""
     with _mu:
         out = dict(_STATS)
+    lookups = out["trace_hits"] + out["trace_misses"]
+    out["hit_ratio"] = round(out["trace_hits"] / lookups, 4) if lookups \
+        else 0.0
     out["entries"] = len(_TRACE_CACHE)
     out["persistent_dir"] = _persistent_dir[0]
     return out

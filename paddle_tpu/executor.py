@@ -32,7 +32,7 @@ import numpy as np
 
 import jax
 
-from . import compile_cache, flags, registry
+from . import compile_cache, flags, monitor, registry
 from .core import materialize_dtype
 from .framework import Program, Variable, default_main_program
 from .profiler import RecordEvent
@@ -143,6 +143,23 @@ def _feed_signature(feed):
     )
 
 
+def _batch_examples(block, feed_names, feed_vals):
+    """Examples-per-step for StepStats: the leading dim of a feed whose
+    program var declares a batch dim (shape[0] == -1/None); fallback is
+    the max leading dim over array feeds (an alphabetically-first scalar
+    aux feed must not report examples=1)."""
+    best = 0
+    for n, v in zip(feed_names, feed_vals):
+        if getattr(v, "ndim", 0) < 1:
+            continue
+        pv = block._find_var_recursive(n)
+        if pv is not None and pv.shape is not None \
+                and len(pv.shape) >= 1 and pv.shape[0] in (-1, None):
+            return int(v.shape[0])
+        best = max(best, int(v.shape[0]))
+    return best
+
+
 def trace_program(program, feed_names, state_names, writeback, fetch_names,
                   platform=None, mesh=None, sequence_parallel=True):
     """Build the pure step function for ``program``'s global block:
@@ -215,6 +232,15 @@ class AsyncDispatchQueue:
         self._max_inflight = max_inflight
         self._name = name
         self._inflight = collections.deque()
+        # watchdog diagnostics read the queue state through monitor's
+        # weak tracking — a stalled window edge is then visible as
+        # depth == max_inflight in the stall dump
+        monitor.track(self)
+
+    def monitor_state(self):
+        return {"kind": "dispatch_queue", "name": self._name,
+                "depth": len(self._inflight),
+                "max_inflight": self.max_inflight}
 
     @property
     def max_inflight(self):
@@ -258,6 +284,10 @@ class AsyncDispatchQueue:
 
     def _sync_oldest(self):
         oldest = self._inflight.popleft()
+        # liveness signal for the watchdog: a window-edge sync that
+        # never returns (device wedge) leaves this heartbeat stale while
+        # the blocked thread looks merely "busy"
+        monitor.heartbeat(self._name + "/dispatch")
         with RecordEvent(self._name + "/fetch_sync"):
             live = self._live_leaves(oldest)
             if not live:
@@ -391,6 +421,9 @@ class Executor:
         feed = dict(feed or {})
         fetch_list = fetch_list or []
         scope = scope if scope is not None else global_scope()
+        # a single module-global bool read when telemetry is off — the
+        # whole StepStats assembly is behind it
+        mon_t0 = time.perf_counter() if monitor.enabled() else None
 
         fetch_names = [
             v.name if isinstance(v, Variable) else v for v in fetch_list
@@ -468,6 +501,12 @@ class Executor:
             # host's run-ahead on the dispatch window (sync only at
             # window edges, never per step)
             self._dispatch_queue.push_step(fetches, new_state)
+        if mon_t0 is not None:
+            monitor.record_step(
+                "executor", time.perf_counter() - mon_t0,
+                _batch_examples(block, feed_names, feed_vals),
+                len(self._dispatch_queue), device=dev,
+                warm=step_span == "executor/dispatch")
         return fetches
 
     def cost_analysis(self, program=None, feed=None, fetch_list=None,

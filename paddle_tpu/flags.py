@@ -143,3 +143,29 @@ register_flag("cpu_deterministic", False, bool, _on_cpu_deterministic)
 # accepted for API parity; memory is managed by XLA (VERDICT #1):
 register_flag("eager_delete_tensor_gb", -1.0, float)
 register_flag("fraction_of_gpu_memory_to_use", 0.92, float)
+
+
+def _on_monitor_change(_val):
+    # one reconcile hook for the whole FLAGS_monitor* family: the
+    # monitor re-reads every flag and starts/stops/reconfigures only the
+    # components whose config changed
+    from . import monitor
+
+    monitor._reconcile()
+
+
+# always-on telemetry (monitor/): the master switch...
+register_flag("monitor", False, bool, _on_monitor_change)
+# ...and the exporter knobs — setting any of the log dir, the port, or
+# the console interval implies the switch: a rotating JSONL
+# StepStats/event log directory ("" = off),
+register_flag("monitor_log_dir", "", str, _on_monitor_change)
+# a Prometheus-style /metrics HTTP endpoint (0 = off),
+register_flag("monitor_port", 0, int, _on_monitor_change)
+# and a periodic one-line console summary interval (0 = off).
+register_flag("monitor_console_seconds", 0.0, float, _on_monitor_change)
+# The watchdog's stall window CONFIGURES but does not imply (its default
+# is non-zero): with the monitor on and no step completed for this long,
+# dump queue states + heartbeats + last span to stderr and the event log
+# (0 = watchdog off)
+register_flag("monitor_stall_seconds", 120.0, float, _on_monitor_change)

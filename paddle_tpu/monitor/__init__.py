@@ -1,0 +1,428 @@
+"""Always-on, low-overhead training telemetry (ISSUE 2 tentpole).
+
+The profiler (``profiler.py``) answers "what happened inside this trace
+session"; the monitor answers "is the job healthy *right now*" — in
+production, with no profiler attached, at near-zero cost when disabled:
+
+* a process-global **metrics registry** (`Counter`/`Gauge`/`Histogram`,
+  ``registry()``) that every subsystem publishes into;
+* **StepStats** — Executor/ParallelExecutor feed one record per
+  ``run()`` (step wall time, examples/sec, fetch-sync wait,
+  retrace/compile counts + cache hit ratio, dispatch-queue depth,
+  prefetcher occupancy, device memory when the backend reports it);
+* **exporters** — a rotating JSONL event log (``FLAGS_monitor_log_dir``),
+  Prometheus-style text exposition (``expose_text()`` + an optional
+  stdlib HTTP endpoint on ``FLAGS_monitor_port``), and a periodic
+  console reporter (``FLAGS_monitor_console_seconds``);
+* a **Watchdog** that heartbeats from the dispatch/prefetch worker
+  threads and flags a hung pipeline (no step completed within
+  ``FLAGS_monitor_stall_seconds``) with a diagnostic dump of queue
+  states and the last completed span, instead of a silent hang.
+
+Enablement is flag-driven: setting any of ``FLAGS_monitor``,
+``FLAGS_monitor_log_dir``, or ``FLAGS_monitor_port`` turns the monitor
+on (``monitor.enable()``/``disable()`` are set_flags conveniences).
+Profiler spans double-publish into ``span/<name>`` histograms whenever
+the monitor is on — with or without a profiler session — so the two
+observability layers agree on what they both measure.
+"""
+
+import sys
+import threading
+import time
+import weakref
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       DEFAULT_BUCKETS)
+from .step_stats import StepStatsAggregator
+from .exporters import JsonlWriter, ConsoleReporter, start_http_server
+from .watchdog import Watchdog
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
+    "StepStatsAggregator", "JsonlWriter", "ConsoleReporter",
+    "start_http_server", "Watchdog",
+    "enable", "disable", "enabled", "registry", "step_stats",
+    "expose_text", "record_step", "observe_span", "mark", "heartbeat",
+    "last_span", "queue_states", "track", "log_event",
+]
+
+# fast-path gate: a module-global bool read (no lock, no flag lookup) is
+# all a disabled process pays per instrumentation site
+_enabled = False
+
+_mu = threading.RLock()
+_registry = MetricsRegistry()
+_aggregator = StepStatsAggregator(_registry)
+_jsonl = None
+_http = None
+_console = None
+_watchdog = None
+_last_span = None                # (name, wall ts, duration seconds)
+_span_totals = {}                # span name -> cumulative seconds
+_last_fetch_sync = {}            # executor name -> fetch_sync total at
+                                 # its previous record_step
+# live pipeline components (AsyncDispatchQueue / DevicePrefetcher)
+# self-register here; weak so the monitor never extends their lifetime
+_tracked = weakref.WeakSet()
+# config currently applied, so flag flips reconfigure only what changed
+_applied = {}
+
+
+def _flag(name, default):
+    """Defensive flag read: during import-time env overrides the monitor
+    flags register one at a time, so a sibling may not exist yet."""
+    from .. import flags
+
+    try:
+        return flags.flag(name)
+    except KeyError:
+        return default
+
+
+def _config():
+    return {
+        "on": bool(_flag("monitor", False))
+        or bool(_flag("monitor_log_dir", ""))
+        or int(_flag("monitor_port", 0)) > 0
+        or float(_flag("monitor_console_seconds", 0.0)) > 0,
+        "log_dir": _flag("monitor_log_dir", ""),
+        "port": int(_flag("monitor_port", 0)),
+        "stall_seconds": float(_flag("monitor_stall_seconds", 120.0)),
+        "console_seconds": float(_flag("monitor_console_seconds", 0.0)),
+    }
+
+
+def _reconcile():
+    """Bring the running components in line with the monitor flags.
+    Called from every FLAGS_monitor* on_set hook."""
+    global _enabled, _jsonl, _http, _console, _watchdog
+    with _mu:
+        cfg = _config()
+        if _applied and all(_applied.get(k) == v for k, v in cfg.items()):
+            return
+        on = cfg["on"]
+        # JSONL log
+        fresh_jsonl = False
+        if (cfg["log_dir"] if on else "") != _applied.get("_jsonl_dir", ""):
+            if _jsonl is not None:
+                _jsonl.close()
+                _jsonl = None
+            if on and cfg["log_dir"]:
+                _jsonl = JsonlWriter(cfg["log_dir"])
+                fresh_jsonl = True
+            _applied["_jsonl_dir"] = cfg["log_dir"] if on else ""
+        # HTTP exposition endpoint
+        want_port = cfg["port"] if on else 0
+        if want_port != _applied.get("_http_port", 0):
+            if _http is not None:
+                _http.shutdown()
+                _http.server_close()   # shutdown() alone leaks the fd
+                _http = None
+            if want_port > 0:
+                try:
+                    _http = start_http_server(want_port, expose_text)
+                except OSError as e:
+                    # EADDRINUSE etc.: an exporter that can't bind must
+                    # not abort set_flags mid-family and leave a
+                    # half-applied config — warn and run without it
+                    print("[monitor] /metrics endpoint disabled: %r" % e,
+                          file=sys.stderr, flush=True)
+            _applied["_http_port"] = want_port
+        # watchdog
+        want_stall = cfg["stall_seconds"] if on else 0.0
+        if want_stall != _applied.get("_stall", 0.0):
+            if _watchdog is not None:
+                _watchdog.stop()
+                _watchdog = None
+            if want_stall > 0:
+                _watchdog = Watchdog(want_stall, sink=_stall_sink,
+                                     probe=_stall_probe).start()
+            _applied["_stall"] = want_stall
+        # console reporter
+        want_console = cfg["console_seconds"] if on else 0.0
+        if want_console != _applied.get("_console", 0.0):
+            if _console is not None:
+                _console.stop()
+                _console = None
+            if want_console > 0:
+                _console = ConsoleReporter(
+                    _aggregator, _registry,
+                    interval_s=want_console).start()
+            _applied["_console"] = want_console
+        _applied.update(cfg)
+        newly_on = on and not _enabled
+        if on != _enabled:
+            # enable/disable boundaries drop the cached metric handles:
+            # tests (and operators) reset the registry while disabled,
+            # and a stale handle would observe into an orphaned metric
+            _span_hists.clear()
+            _aggregator.reset()
+        _enabled = on
+        if newly_on or (on and fresh_jsonl):
+            # set_flags applies the flag family one at a time, so the
+            # writer may appear a beat after the enable flip — log the
+            # lifecycle event whenever a fresh log gets its first chance
+            log_event({"event": "monitor_enabled", "ts": time.time(),
+                       "config": {k: v for k, v in cfg.items()
+                                  if k != "on"}})
+
+
+def enabled():
+    return _enabled
+
+
+def enable(log_dir=None, port=None, stall_seconds=None,
+           console_seconds=None):
+    """Turn monitoring on (optionally configuring the exporters) — a
+    convenience over ``set_flags``; flags stay the source of truth."""
+    from .. import flags
+
+    updates = {"monitor": True}
+    if log_dir is not None:
+        updates["monitor_log_dir"] = log_dir
+    if port is not None:
+        updates["monitor_port"] = port
+    if stall_seconds is not None:
+        updates["monitor_stall_seconds"] = stall_seconds
+    if console_seconds is not None:
+        updates["monitor_console_seconds"] = console_seconds
+    flags.set_flags(updates)
+
+
+def disable():
+    """Turn monitoring fully off: resets every FLAGS_monitor* flag to
+    its default and stops the exporters/watchdog.  Collected metrics
+    are kept (``registry().reset()`` drops them)."""
+    from .. import flags
+
+    flags.set_flags({"monitor": False, "monitor_log_dir": "",
+                     "monitor_port": 0, "monitor_stall_seconds": 120.0,
+                     "monitor_console_seconds": 0.0})
+
+
+def registry():
+    """The process-global metrics registry."""
+    return _registry
+
+
+def step_stats():
+    """The process-global StepStats aggregator."""
+    return _aggregator
+
+
+def expose_text():
+    """Prometheus text exposition of every registered metric."""
+    return _registry.expose_text()
+
+
+def track(component):
+    """Register a pipeline component exposing ``monitor_state()`` (the
+    dispatch queues and prefetchers self-register) for watchdog dumps
+    and StepStats occupancy; weakly held."""
+    _tracked.add(component)
+
+
+def queue_states():
+    """``monitor_state()`` of every live tracked component."""
+    out = []
+    try:
+        # snapshot first: the watchdog thread reads while training
+        # threads construct executors/prefetchers (WeakSet.add)
+        comps = list(_tracked)
+    except RuntimeError:       # set mutated mid-iteration; retry once
+        comps = list(_tracked)
+    for c in comps:
+        try:
+            out.append(c.monitor_state())
+        except Exception as e:  # noqa: BLE001 — diagnostics must land
+            out.append({"kind": type(c).__name__, "error": repr(e)})
+    return out
+
+
+def last_span():
+    """(name, wall-clock ts, seconds) of the last completed profiler
+    span double-published into the monitor, or None."""
+    return _last_span
+
+
+def log_event(record):
+    """Write one record to the JSONL event log (no-op when unset)."""
+    j = _jsonl
+    if j is not None:
+        j.write(record)
+
+
+# ---------------------------------------------------------------------------
+# instrumentation entry points (called from executor/reader/profiler)
+# ---------------------------------------------------------------------------
+
+# span histogram handles cached by name: the registry's get-or-create
+# lock (and the bucket-equality check) happen once per distinct span
+# name, not once per span.  _span_gen tracks the registry generation so
+# a registry.reset() (tests) orphans no cached handle.
+_span_hists = {}
+_span_gen = [0]
+
+
+def observe_span(name, dur_us):
+    """Double-publish a completed profiler span into the monitor:
+    ``span/<name>`` histogram (seconds) + cumulative totals (feeds the
+    StepStats fetch-sync wait and the watchdog's last-span field)."""
+    global _last_span
+    if not _enabled:
+        return
+    dur_s = dur_us / 1e6
+    if _span_gen[0] != _registry.generation:
+        _span_hists.clear()
+        _span_gen[0] = _registry.generation
+    h = _span_hists.get(name)
+    if h is None:
+        h = _span_hists[name] = _registry.histogram("span/" + name)
+    h.observe(dur_s)
+    with _mu:
+        _span_totals[name] = _span_totals.get(name, 0.0) + dur_s
+        _last_span = (name, time.time(), dur_s)
+
+
+def mark(name):
+    """Point occurrence -> counter (``profiler.mark_event`` double-
+    publishes here: compile_cache hit/miss marks become counters)."""
+    if not _enabled:
+        return
+    _registry.counter("mark/" + name).inc()
+
+
+def heartbeat(name):
+    """Worker-thread liveness signal (dispatch queue, prefetch
+    producer); feeds the watchdog's per-thread age map."""
+    if not _enabled:
+        return
+    w = _watchdog
+    if w is not None:
+        w.heartbeat(name)
+
+
+def record_step(name, step_seconds, examples, dispatch_queue_depth,
+                device=None, warm=None):
+    """One executor ``run()`` completed: assemble the StepStats record,
+    fold it into the aggregator/registry, append it to the JSONL log,
+    and pet the watchdog.  ``warm`` is the executor's own verdict on
+    this step (False = it paid a trace/compile for an unseen
+    program/feed signature) — the step-level compile count a healthy
+    steady-state loop drives to zero."""
+    if not _enabled:
+        return None
+    from .. import compile_cache
+
+    with _mu:
+        fs_total = _span_totals.get(name + "/fetch_sync", 0.0)
+        fs_wait = fs_total - _last_fetch_sync.get(name, 0.0)
+        _last_fetch_sync[name] = fs_total
+        rec = {"event": "step_stats", "ts": time.time(), "executor": name,
+               "step_seconds": round(step_seconds, 6),
+               "examples": int(examples) if examples else 0,
+               "examples_per_sec": round(examples / step_seconds, 2)
+               if examples and step_seconds > 0 else 0.0,
+               "fetch_sync_wait_s": round(fs_wait, 6),
+               "dispatch_queue_depth": int(dispatch_queue_depth),
+               "compile_cache": compile_cache.stats(),
+               "prefetch": _prefetch_state(),
+               "device": _device_state(device)}
+        if warm is not None:
+            rec["warm"] = bool(warm)
+            if not warm:
+                _registry.counter("monitor/steps_compiled").inc()
+        rec = _aggregator.record(rec)
+        w = _watchdog
+        if w is not None:
+            w.step_completed()
+    log_event(rec)
+    return rec
+
+
+def _prefetch_state():
+    """Aggregate occupancy over every live DevicePrefetcher."""
+    occ = cap = n = 0
+    for s in queue_states():
+        if s.get("kind") == "prefetcher" and not s.get("stopped"):
+            occ += s.get("occupancy", 0)
+            cap += s.get("capacity", 0)
+            n += 1
+    return {"live": n, "occupancy": occ, "capacity": cap}
+
+
+# device-memory sampling cadence: live_arrays() walks every live buffer
+# (~10us per few hundred arrays), so StepStats re-samples every Nth step
+# and carries the last sample forward — memory leaks are minutes-scale
+# signals, steps can be sub-millisecond.  Keyed per device: a TPU
+# training loop interleaved with CPU eval steps must not serve the CPU
+# sample (usually empty) as the TPU's.
+_DEVICE_SAMPLE_EVERY = 10
+_device_cache = {}            # device key -> [steps since sample, sample]
+
+
+def _device_state(device):
+    """Device memory via jax ``memory_stats()``/``live_arrays`` when the
+    backend reports them (TPU does; CPU usually returns None); sampled
+    every ``_DEVICE_SAMPLE_EVERY`` steps per device."""
+    key = (getattr(device, "platform", None), getattr(device, "id", None))
+    cache = _device_cache.setdefault(key, [0, None])
+    if cache[1] is not None and cache[0] % _DEVICE_SAMPLE_EVERY:
+        cache[0] += 1
+        return cache[1]
+    cache[0] = 1
+    out = {}
+    try:
+        import jax
+
+        out["live_arrays"] = len(jax.live_arrays())
+    except Exception:  # noqa: BLE001 — telemetry never breaks the step
+        pass
+    if device is not None:
+        try:
+            ms = device.memory_stats()
+            if ms:
+                out["bytes_in_use"] = ms.get("bytes_in_use")
+                out["bytes_limit"] = ms.get("bytes_limit")
+        except Exception:  # noqa: BLE001
+            pass
+    cache[1] = out
+    return out
+
+
+# ---------------------------------------------------------------------------
+# watchdog sink/probe
+# ---------------------------------------------------------------------------
+
+def _stall_probe():
+    return {"queues": queue_states(),
+            "last_span": _last_span,
+            "last_step": _aggregator.last(),
+            "compile_cache": _import_cc_stats()}
+
+
+def _import_cc_stats():
+    from .. import compile_cache
+
+    return compile_cache.stats()
+
+
+def _stall_sink(diag):
+    _registry.counter("monitor/watchdog_stalls").inc()
+    log_event(diag)
+    print("[monitor] WATCHDOG: no step completed in %.1fs — pipeline "
+          "stalled?\n%s" % (diag["stalled_for_s"], _format_diag(diag)),
+          file=sys.stderr, flush=True)
+
+
+def _format_diag(diag):
+    lines = []
+    for q in diag.get("queues", []):
+        lines.append("  queue %s" % q)
+    for n, age in diag.get("heartbeat_age_s", {}).items():
+        lines.append("  heartbeat %-30s %8.1fs ago" % (n, age))
+    if diag.get("last_span"):
+        name, ts, dur = diag["last_span"]
+        lines.append("  last span %s (%.3fs) at %s" % (name, dur, ts))
+    return "\n".join(lines) if lines else "  (no pipeline state tracked)"

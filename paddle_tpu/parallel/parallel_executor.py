@@ -26,14 +26,16 @@ dataflow executor) — re-designed TPU-first:
   world, parallel_executor.cc:94-103).
 """
 
+import time
+
 import numpy as np
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .. import compile_cache, flags, registry  # noqa: F401  (op registry must be loaded)
+from .. import compile_cache, flags, monitor, registry  # noqa: F401  (op registry must be loaded)
 from ..executor import (AsyncDispatchQueue, trace_program, Executor,
-                        _check_finite)
+                        _batch_examples, _check_finite)
 from ..profiler import RecordEvent
 from ..framework import Variable, default_main_program
 from ..scope import global_scope
@@ -294,6 +296,7 @@ class ParallelExecutor:
     def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
         program = self._program or default_main_program()
         scope = self._actual_scope()
+        mon_t0 = time.perf_counter() if monitor.enabled() else None
         feed = feed if feed is not None else feed_dict
         if isinstance(feed, (list, tuple)):
             # reference per-device feed list: concatenate along batch
@@ -424,6 +427,16 @@ class ParallelExecutor:
             # fetches stay (possibly sharded) device arrays, no per-step
             # sync — the dispatch window blocks only at its edge
             self._dispatch_queue.push_step(fetches, new_state)
+        if mon_t0 is not None:
+            # // pad_r: a replication-padded ragged batch still trained
+            # on its true example count
+            examples = _batch_examples(block, feed_names,
+                                       feed_vals) // pad_r
+            monitor.record_step(
+                "parallel_executor", time.perf_counter() - mon_t0,
+                examples, len(self._dispatch_queue),
+                device=self._mesh.devices.flat[0],
+                warm=step_span == "parallel_executor/dispatch")
         return fetches
 
     def sync(self):

@@ -14,10 +14,13 @@ import os
 import threading
 import time
 
+from . import monitor
+
 __all__ = [
     "RecordEvent", "record_event", "mark_event", "profiler",
     "start_profiler", "stop_profiler", "reset_profiler",
-    "export_chrome_tracing", "cuda_profiler", "npu_profiler",
+    "export_chrome_tracing", "summarize_events", "cuda_profiler",
+    "npu_profiler",
 ]
 
 _state = threading.local()
@@ -25,36 +28,64 @@ _events = []
 _events_lock = threading.Lock()
 _enabled = [False]
 _jax_trace_dir = [None]
+# tid -> thread name at the time the thread last emitted an event, for
+# the chrome-trace M-phase thread_name metadata (dispatch/prefetch
+# worker threads are labeled in the timeline instead of raw tids)
+_thread_names = {}
 
 
 def _now_us():
     return time.perf_counter_ns() / 1000.0
 
 
+def _append_event(name, ts, dur):
+    tid = threading.get_ident()
+    with _events_lock:
+        _thread_names[tid] = threading.current_thread().name
+        _events.append({
+            "name": name,
+            "ts": ts,
+            "dur": dur,
+            "ph": "X",
+            "pid": os.getpid(),
+            "tid": tid,
+        })
+
+
 class RecordEvent:
-    """RAII span (reference profiler.h:89 RecordEvent)."""
+    """RAII span (reference profiler.h:89 RecordEvent).
+
+    ``__enter__`` LATCHES the profiler/monitor enabled states: a span
+    that straddles ``stop_profiler`` is kept (it was started under the
+    session and measures real work of it), a span started while both are
+    disabled skips timing entirely — ``__exit__`` never re-decides
+    post-hoc.  Completed spans double-publish into the monitor's
+    ``span/<name>`` histograms whenever the monitor is on, so the two
+    observability layers agree with or without a profiler session.
+    """
 
     def __init__(self, name):
         self.name = name
         self.t0 = None
+        self._prof = False
+        self._mon = False
 
     def __enter__(self):
-        self.t0 = _now_us()
+        self._prof = _enabled[0]
+        self._mon = monitor.enabled()
+        if self._prof or self._mon:
+            self.t0 = _now_us()
         return self
 
     def __exit__(self, *exc):
-        if not _enabled[0]:
+        if self.t0 is None:
             return False
-        t1 = _now_us()
-        with _events_lock:
-            _events.append({
-                "name": self.name,
-                "ts": self.t0,
-                "dur": t1 - self.t0,
-                "ph": "X",
-                "pid": os.getpid(),
-                "tid": threading.get_ident(),
-            })
+        dur = _now_us() - self.t0
+        if self._prof:
+            _append_event(self.name, self.t0, dur)
+        if self._mon:
+            monitor.observe_span(self.name, dur)
+        self.t0 = None
         return False
 
 
@@ -64,18 +95,13 @@ record_event = RecordEvent
 def mark_event(name):
     """Instantaneous event (zero-duration span): cache hits/misses and
     other point occurrences, countable in the summary and visible in the
-    chrome trace next to the ``RecordEvent`` spans."""
+    chrome trace next to the ``RecordEvent`` spans.  Double-publishes as
+    a ``mark/<name>`` monitor counter when the monitor is on."""
+    if monitor.enabled():
+        monitor.mark(name)
     if not _enabled[0]:
         return
-    with _events_lock:
-        _events.append({
-            "name": name,
-            "ts": _now_us(),
-            "dur": 0.0,
-            "ph": "X",
-            "pid": os.getpid(),
-            "tid": threading.get_ident(),
-        })
+    _append_event(name, _now_us(), 0.0)
 
 
 def start_profiler(state="All", trace_dir=None):
@@ -108,10 +134,20 @@ def reset_profiler():
 
 def export_chrome_tracing(path):
     """Write collected host spans as chrome://tracing JSON
-    (tools/timeline.py parity)."""
+    (tools/timeline.py parity).  M-phase metadata events label the
+    process and every emitting thread (main loop, prefetch producers,
+    monitor threads) so the timeline shows names instead of raw tids."""
     with _events_lock:
         events = list(_events)
-    payload = {"traceEvents": events, "displayTimeUnit": "ms"}
+        tnames = dict(_thread_names)
+    pids = sorted({e["pid"] for e in events})
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": "paddle_tpu"}} for pid in pids]
+    for (pid, tid) in sorted({(e["pid"], e["tid"]) for e in events}):
+        meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid,
+                     "args": {"name": tnames.get(tid, "tid-%d" % tid)}})
+    payload = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -120,13 +156,15 @@ def export_chrome_tracing(path):
     return path
 
 
-def _print_summary(sorted_key=None):
-    with _events_lock:
-        events = list(_events)
-    if not events:
-        return
+def summarize_events(events, sorted_key=None):
+    """Per-name total/calls/avg/max table over chrome-trace events (the
+    ``X``-phase ones; ``dur`` in microseconds).  Shared by the live
+    ``stop_profiler`` summary and the offline ``tools/trace_summary.py``
+    CLI, so both print the identical format."""
     totals = {}
     for e in events:
+        if e.get("ph", "X") != "X":
+            continue
         t = totals.setdefault(e["name"], [0.0, 0, 0.0])
         t[0] += e["dur"]
         t[1] += 1
@@ -137,10 +175,20 @@ def _print_summary(sorted_key=None):
     ]
     key = {"total": 1, "calls": 2, "ave": 3, "max": 4}.get(sorted_key, 1)
     rows.sort(key=lambda r: r[key], reverse=True)
-    print("%-40s %12s %8s %12s %12s" % ("Event", "total(ms)", "calls",
-                                        "avg(ms)", "max(ms)"))
+    lines = ["%-40s %12s %8s %12s %12s" % ("Event", "total(ms)", "calls",
+                                           "avg(ms)", "max(ms)")]
     for name, tot, cnt, avg, mx in rows[:50]:
-        print("%-40s %12.3f %8d %12.3f %12.3f" % (name, tot, cnt, avg, mx))
+        lines.append("%-40s %12.3f %8d %12.3f %12.3f"
+                     % (name, tot, cnt, avg, mx))
+    return "\n".join(lines)
+
+
+def _print_summary(sorted_key=None):
+    with _events_lock:
+        events = list(_events)
+    if not events:
+        return
+    print(summarize_events(events, sorted_key))
 
 
 @contextlib.contextmanager

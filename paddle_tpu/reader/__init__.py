@@ -15,6 +15,7 @@ import queue
 import threading
 import weakref
 
+from .. import monitor
 from .decorator import *  # noqa: F401,F403
 from . import creator  # noqa: F401
 from . import decorator  # noqa: F401
@@ -88,6 +89,15 @@ class DevicePrefetcher:
         # thread only exists after that), while a dropped-unadvanced
         # iterator reads as dead and doesn't block a fresh one
         self._consumer = None
+        # StepStats occupancy + watchdog stall dumps read this
+        # prefetcher's queue state through monitor's weak tracking
+        monitor.track(self)
+
+    def monitor_state(self):
+        return {"kind": "prefetcher", "epoch": self._epoch,
+                "occupancy": self._q.qsize(),
+                "capacity": self._q.maxsize,
+                "stopped": self._stop.is_set()}
 
     # -- staging -------------------------------------------------------
     def _stage(self, feed):
@@ -122,6 +132,10 @@ class DevicePrefetcher:
             for item in it:
                 if stop.is_set():
                     return
+                # per-item liveness signal: a producer wedged inside a
+                # slow source or device_put shows a stale heartbeat in
+                # the watchdog dump, distinct from "queue full, waiting"
+                monitor.heartbeat("prefetch/producer")
                 feed = self._feeder.feed(item) if self._feeder is not None \
                     else item
                 feed = self._stage(feed)
@@ -148,9 +162,11 @@ class DevicePrefetcher:
         # the current epoch's thread slot
         if epoch == self._epoch and self._thread is None \
                 and not stop.is_set():
+            # named so chrome-trace thread_name metadata and watchdog
+            # dumps identify the prefetch worker, not a bare tid
             self._thread = threading.Thread(
                 target=self._producer, args=(q, stop, failure),
-                daemon=True)
+                name="prefetch-producer-%d" % epoch, daemon=True)
             self._thread.start()
 
     def _restartable(self):

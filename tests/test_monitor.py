@@ -1,0 +1,380 @@
+"""Monitor subsystem tests (ISSUE 2): registry semantics, StepStats
+from real executor runs, JSONL + Prometheus-exposition round-trips, the
+HTTP endpoint, and the watchdog firing on a stalled pipeline within its
+configured window — all with NO profiler session, which is the point:
+the monitor is the always-on layer."""
+
+import json
+import os
+import time
+import urllib.request
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.monitor import (Counter, Gauge, Histogram, MetricsRegistry,
+                                Watchdog)
+
+
+@pytest.fixture(autouse=True)
+def monitor_off_after():
+    """Every test leaves the process-global monitor disabled and its
+    registry/aggregator empty — telemetry state must never leak into
+    other test modules."""
+    yield
+    monitor.disable()
+    monitor.registry().reset()
+    monitor.step_stats().reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_semantics():
+    r = MetricsRegistry()
+    c = r.counter("steps")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = r.gauge("depth")
+    g.set(3)
+    g.inc()
+    g.dec(2)
+    assert g.value == 2
+
+    h = r.histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(5.555)
+    snap = h.snapshot()
+    assert snap["counts"] == [1, 1, 1, 1]  # one per bucket + overflow
+
+
+def test_registry_get_or_create_and_type_conflict():
+    r = MetricsRegistry()
+    assert r.counter("x") is r.counter("x")
+    with pytest.raises(TypeError):
+        r.gauge("x")
+    with pytest.raises(ValueError):
+        r.histogram("h", buckets=(1.0,))
+        r.histogram("h", buckets=(2.0,))
+    assert r.get("nope") is None
+
+
+def test_expose_text_prometheus_round_trip():
+    r = MetricsRegistry()
+    r.counter("monitor/steps_total").inc(7)
+    r.gauge("queue depth").set(2.5)
+    h = r.histogram("step", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(50.0)
+    text = r.expose_text()
+    lines = text.splitlines()
+    # names sanitized to prometheus-legal, values parseable
+    assert "# TYPE monitor_steps_total counter" in lines
+    assert "monitor_steps_total 7" in lines
+    assert "queue_depth 2.5" in lines
+    assert 'step_bucket{le="0.1"} 1' in lines
+    assert 'step_bucket{le="1"} 2' in lines
+    assert 'step_bucket{le="+Inf"} 3' in lines
+    assert "step_count 3" in lines
+    # round-trip: every sample line parses as "name[{labels}] value"
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        name, val = ln.rsplit(" ", 1)
+        float(val)
+        assert name
+
+
+def test_jsonl_writer_rotates(tmp_path):
+    w = monitor.JsonlWriter(str(tmp_path), max_bytes=400, backups=2)
+    for i in range(40):
+        w.write({"event": "step_stats", "step": i, "pad": "x" * 40})
+    w.close()
+    files = sorted(os.listdir(str(tmp_path)))
+    assert os.path.basename(w.path) in files
+    assert any(f.endswith(".1") for f in files)       # rotated generation
+    assert not any(f.endswith(".3") for f in files)   # backups honored
+    # every line in every generation is valid JSON
+    for f in files:
+        for ln in open(os.path.join(str(tmp_path), f)):
+            json.loads(ln)
+
+
+def test_http_endpoint_serves_exposition():
+    r = MetricsRegistry()
+    r.counter("hits").inc(3)
+    server = monitor.start_http_server(0, r.expose_text)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % port, timeout=5).read().decode()
+        assert "hits 3" in body
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/other" % port, timeout=5)
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# StepStats from real runs
+# ---------------------------------------------------------------------------
+
+def _build_mlp():
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.fc(x, size=3, act="relu")
+    loss = fluid.layers.mean(y)
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_step_stats_from_three_step_run(tmp_path):
+    monitor.enable(log_dir=str(tmp_path))
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    # drop the startup-program step so the counts below are exactly the
+    # 3 training steps
+    monitor.registry().reset()
+    monitor.step_stats().reset()
+    x = np.random.rand(8, 4).astype("float32")
+    for _ in range(3):
+        exe.run(feed={"x": x}, fetch_list=[loss])
+    agg = monitor.step_stats()
+    assert agg.steps == 3
+    rec = agg.last()
+    assert rec["executor"] == "executor"
+    assert rec["examples"] == 8
+    assert rec["step_seconds"] > 0
+    assert rec["examples_per_sec"] > 0
+    assert rec["dispatch_queue_depth"] == 0       # return_numpy=True syncs
+    assert 0.0 <= rec["compile_cache"]["hit_ratio"] <= 1.0
+    assert "fetch_sync_wait_s" in rec
+    assert rec["device"].get("live_arrays", 0) >= 1
+    # registry mirrors: histogram count == steps, examples counter
+    assert monitor.registry().get("monitor/step_seconds").count == 3
+    assert monitor.registry().get("monitor/examples_total").value == 24
+    s = agg.summary()
+    assert s["steps"] == 3 and s["mean_step_seconds"] > 0
+
+
+def test_fifty_step_mlp_run_produces_jsonl_stepstats(tmp_path):
+    """Acceptance: monitoring enabled (no profiler session), 50-step MLP
+    run -> JSONL log whose StepStats carry step time, examples/sec,
+    compile-cache hit ratio, and dispatch-queue depth."""
+    monitor.enable(log_dir=str(tmp_path))
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.rand(16, 4).astype("float32")
+    for _ in range(50):
+        exe.run(feed={"x": x}, fetch_list=[loss], return_numpy=False)
+    exe.sync()
+    files = [f for f in os.listdir(str(tmp_path)) if f.endswith(".jsonl")]
+    assert len(files) == 1
+    records = [json.loads(ln) for ln in
+               open(os.path.join(str(tmp_path), files[0]))]
+    steps = [r for r in records if r.get("event") == "step_stats"
+             and r.get("examples")]
+    assert len(steps) == 50
+    for r in steps:
+        assert r["step_seconds"] >= 0
+        assert r["examples_per_sec"] > 0
+        assert "hit_ratio" in r["compile_cache"]
+        assert "dispatch_queue_depth" in r
+    # async fast path actually ran ahead: some step saw a non-empty
+    # dispatch window
+    assert max(r["dispatch_queue_depth"] for r in steps) >= 1
+    # step 1 paid the compile; the other 49 dispatched warm
+    assert steps[0]["warm"] is False
+    assert all(r["warm"] for r in steps[1:])
+    assert monitor.step_stats().summary()["steps_compiled"] >= 1
+    assert steps[-1]["step"] > steps[0]["step"]
+
+
+def test_prefetcher_occupancy_visible_in_stepstats(tmp_path):
+    monitor.enable(log_dir=str(tmp_path))
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(6):
+            yield {"x": rng.rand(4, 4).astype("float32")}
+
+    pf = fluid.reader.DevicePrefetcher(reader, place=fluid.CPUPlace(),
+                                       capacity=4)
+    with pf:
+        for feed in pf:
+            exe.run(feed=feed, fetch_list=[loss])
+    rec = monitor.step_stats().last()
+    assert rec["prefetch"]["capacity"] >= 4
+    states = [s for s in monitor.queue_states()
+              if s.get("kind") == "prefetcher"]
+    assert states and states[0]["stopped"]
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_unit_fire_and_rearm():
+    fired = []
+    w = Watchdog(0.2, sink=fired.append,
+                 probe=lambda: {"queues": [{"kind": "dispatch_queue",
+                                            "depth": 3}]})
+    w.heartbeat("prefetch/producer")
+    assert w.check(now=time.monotonic() + 0.1) is None   # not stalled yet
+    diag = w.check(now=time.monotonic() + 0.5)
+    assert diag is not None and fired
+    assert diag["event"] == "watchdog_stall"
+    assert diag["stalled_for_s"] >= 0.2
+    assert diag["queues"][0]["depth"] == 3
+    assert "prefetch/producer" in diag["heartbeat_age_s"]
+    # one fire per window, then re-fires after another full window
+    assert w.check(now=time.monotonic() + 0.55) is None
+    assert w.check(now=time.monotonic() + 0.8) is not None
+    # progress re-arms and clears the stall
+    w.step_completed()
+    assert w.check() is None
+
+
+def test_watchdog_fires_on_stalled_pipeline_within_window(tmp_path):
+    """Acceptance: a deliberately stalled dispatch queue (no step
+    completes) triggers the watchdog diagnostic — with queue state and
+    the last completed span — within the configured window."""
+    monitor.enable(log_dir=str(tmp_path), stall_seconds=0.3)
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.rand(8, 4).astype("float32")
+    exe.run(feed={"x": x}, fetch_list=[loss])
+    # stall: nothing completes for > stall_seconds; the background
+    # watchdog thread (interval = stall/4) must fire within ~2 windows
+    deadline = time.monotonic() + 2.0
+    stalls = monitor.registry().counter("monitor/watchdog_stalls")
+    while stalls.value == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert stalls.value >= 1, "watchdog did not fire within the window"
+    files = [f for f in os.listdir(str(tmp_path)) if f.endswith(".jsonl")]
+    records = [json.loads(ln) for ln in
+               open(os.path.join(str(tmp_path), files[0]))]
+    dumps = [r for r in records if r.get("event") == "watchdog_stall"]
+    assert dumps, "stall diagnostic missing from the JSONL log"
+    d = dumps[0]
+    assert d["stalled_for_s"] >= 0.3
+    kinds = {q.get("kind") for q in d.get("queues", [])}
+    assert "dispatch_queue" in kinds
+    assert d.get("last_span") is not None   # spans ran sans profiler
+
+
+# ---------------------------------------------------------------------------
+# enable/disable + overhead gating
+# ---------------------------------------------------------------------------
+
+def test_disabled_monitor_records_nothing():
+    assert not monitor.enabled()
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"x": np.random.rand(4, 4).astype("float32")},
+            fetch_list=[loss])
+    assert monitor.step_stats().steps == 0
+    assert monitor.registry().get("monitor/steps_total") is None
+    monitor.mark("nope")
+    monitor.observe_span("nope", 1.0)
+    assert monitor.registry().get("mark/nope") is None
+
+
+def test_flags_drive_enablement_and_teardown(tmp_path):
+    fluid.set_flags({"FLAGS_monitor_log_dir": str(tmp_path)})
+    assert monitor.enabled()     # log_dir alone implies the switch
+    fluid.set_flags({"FLAGS_monitor_log_dir": ""})
+    assert not monitor.enabled()
+    monitor.enable()
+    assert monitor.enabled()
+    monitor.disable()
+    assert not monitor.enabled()
+
+
+def test_spans_double_publish_into_monitor_histograms():
+    monitor.enable()
+    from paddle_tpu.profiler import RecordEvent
+    with RecordEvent("unit/span"):
+        pass
+    h = monitor.registry().get("span/unit/span")
+    assert h is not None and h.count == 1
+    assert monitor.last_span()[0] == "unit/span"
+    # marks become counters
+    from paddle_tpu.profiler import mark_event
+    mark_event("unit/mark")
+    mark_event("unit/mark")
+    assert monitor.registry().get("mark/unit/mark").value == 2
+    # ... and none of it entered the profiler's event buffer (no session)
+    from paddle_tpu import profiler
+    with profiler._events_lock:
+        assert not any(e["name"].startswith("unit/")
+                       for e in profiler._events)
+
+
+def test_batch_examples_prefers_batch_dim_var():
+    """examples/sec must come from the batch-dim feed, not whatever
+    array feed sorts first alphabetically."""
+    from paddle_tpu.executor import _batch_examples
+
+    fluid.layers.data("x", shape=[4])          # program shape (-1, 4)
+    blk = fluid.default_main_program().global_block()
+    blk.create_var(name="aaa_scale", shape=[3], dtype="float32")
+    vals = [np.zeros((3,), "float32"), np.zeros((16, 4), "float32")]
+    assert _batch_examples(blk, ["aaa_scale", "x"], vals) == 16
+    # no declared batch var: fall back to the max leading dim
+    assert _batch_examples(blk, ["aaa_scale"], vals[:1]) == 3
+    assert _batch_examples(blk, [], []) == 0
+
+
+def test_registry_reset_while_enabled_rebinds_handles():
+    """registry().reset() mid-session must not orphan the cached span/
+    StepStats metric handles: later observations land in fresh metrics
+    visible to exposition."""
+    monitor.enable()
+    from paddle_tpu.profiler import RecordEvent
+    with RecordEvent("gen/span"):
+        pass
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    monitor.registry().reset()
+    with RecordEvent("gen/span"):
+        pass
+    exe.run(feed={"x": np.random.rand(4, 4).astype("float32")},
+            fetch_list=[loss])
+    assert monitor.registry().get("span/gen/span").count == 1
+    assert monitor.registry().get("monitor/steps_total").value == 1
+    assert "gen_span" in monitor.expose_text()
+
+
+def test_console_reporter_formats_summary(capsys):
+    monitor.enable()
+    loss = _build_mlp()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={"x": np.random.rand(4, 4).astype("float32")},
+            fetch_list=[loss])
+    rep = monitor.ConsoleReporter(monitor.step_stats(), monitor.registry(),
+                                  interval_s=3600)
+    line = rep.format_line()
+    assert line.startswith("[monitor] steps=")
+    assert "step_ms=" in line and "ex/s=" in line

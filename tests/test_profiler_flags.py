@@ -35,7 +35,11 @@ def test_executor_spans_appear_in_chrome_trace(tmp_path, fresh_programs):
     assert "executor/compile" in names
     assert names.count("executor/run") == 3
     for e in trace["traceEvents"]:
-        assert e["ph"] == "X" and e["dur"] >= 0
+        # spans are X-phase with real durations; the only other phase
+        # is the M-phase process/thread-name metadata
+        assert e["ph"] in ("X", "M")
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
 
 
 def test_record_event_outside_profiler_is_dropped(fresh_programs):
@@ -44,6 +48,115 @@ def test_record_event_outside_profiler_is_dropped(fresh_programs):
         pass
     with profiler._events_lock:
         assert not profiler._events
+
+
+def test_span_straddling_stop_profiler_is_kept(fresh_programs):
+    """__enter__ latches the enabled state: a span started under the
+    session is recorded even if stop_profiler lands before __exit__
+    (previously __exit__ decided post-hoc and dropped it)."""
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU")
+    ev = profiler.RecordEvent("straddle")
+    ev.__enter__()
+    profiler.stop_profiler(profile_path=None)
+    ev.__exit__(None, None, None)
+    with profiler._events_lock:
+        names = [e["name"] for e in profiler._events]
+    assert "straddle" in names
+    # and the inverse: started while disabled, exited under a session
+    ev2 = profiler.RecordEvent("pre_session")
+    ev2.__enter__()
+    profiler.start_profiler("CPU")
+    ev2.__exit__(None, None, None)
+    profiler.stop_profiler(profile_path=None)
+    with profiler._events_lock:
+        names = [e["name"] for e in profiler._events]
+    assert "pre_session" not in names
+
+
+def _fabricate_events():
+    """Deterministic event set: 'a' called 3x (total 3ms, max 1.5ms),
+    'b' called once (total 10ms)."""
+    profiler.reset_profiler()
+    with profiler._events_lock:
+        for dur in (500.0, 1000.0, 1500.0):
+            profiler._events.append({"name": "a", "ts": 0.0, "dur": dur,
+                                     "ph": "X", "pid": 1, "tid": 1})
+        profiler._events.append({"name": "b", "ts": 0.0, "dur": 10000.0,
+                                 "ph": "X", "pid": 1, "tid": 1})
+
+
+def test_print_summary_sorted_key_variants(fresh_programs, capsys):
+    _fabricate_events()
+    first_row = {}
+    for key in (None, "total", "calls", "ave", "max"):
+        profiler._print_summary(key)
+        out = capsys.readouterr().out.splitlines()
+        assert out[0].startswith("Event")
+        first_row[key] = out[1].split()[0]
+    # total/avg/max rank the long single span first; calls ranks 'a'
+    assert first_row[None] == "b"
+    assert first_row["total"] == "b"
+    assert first_row["ave"] == "b"
+    assert first_row["max"] == "b"
+    assert first_row["calls"] == "a"
+    # summarize_events is the same formatter the offline CLI prints
+    with profiler._events_lock:
+        events = list(profiler._events)
+    profiler._print_summary("total")
+    assert capsys.readouterr().out.strip() == \
+        profiler.summarize_events(events, "total")
+    profiler.reset_profiler()
+
+
+def test_mark_event_counting(fresh_programs, capsys):
+    profiler.reset_profiler()
+    profiler.mark_event("cache/hit")          # outside a session: dropped
+    profiler.start_profiler("CPU")
+    for _ in range(3):
+        profiler.mark_event("cache/hit")
+    profiler.mark_event("cache/miss")
+    profiler.stop_profiler(profile_path=None)
+    out = capsys.readouterr().out
+    row = [ln for ln in out.splitlines() if ln.startswith("cache/hit")]
+    assert row and row[0].split()[2] == "3"   # calls column counts marks
+    with profiler._events_lock:
+        marks = [e for e in profiler._events if e["name"] == "cache/hit"]
+    assert len(marks) == 3 and all(e["dur"] == 0.0 for e in marks)
+    profiler.reset_profiler()
+
+
+def test_chrome_trace_thread_metadata(tmp_path, fresh_programs):
+    """export_chrome_tracing labels worker threads with M-phase
+    process_name/thread_name metadata instead of raw tids."""
+    import threading
+
+    profiler.reset_profiler()
+    profiler.start_profiler("CPU")
+
+    def worker():
+        with profiler.RecordEvent("worker_span"):
+            pass
+
+    t = threading.Thread(target=worker, name="prefetch-producer-0")
+    t.start()
+    t.join()
+    with profiler.RecordEvent("main_span"):
+        pass
+    path = str(tmp_path / "trace.json")
+    profiler.stop_profiler(profile_path=path)
+    trace = json.load(open(path))["traceEvents"]
+    meta = [e for e in trace if e["ph"] == "M"]
+    assert any(e["name"] == "process_name"
+               and e["args"]["name"] == "paddle_tpu" for e in meta)
+    tnames = {e["args"]["name"] for e in meta
+              if e["name"] == "thread_name"}
+    assert "prefetch-producer-0" in tnames
+    # every span's tid has a thread_name metadata entry
+    span_tids = {e["tid"] for e in trace if e["ph"] == "X"}
+    meta_tids = {e["tid"] for e in meta if e["name"] == "thread_name"}
+    assert span_tids <= meta_tids
+    profiler.reset_profiler()
 
 
 def test_check_nan_inf_catches_injected_nan(fresh_programs):
@@ -90,6 +203,35 @@ def test_flags_api_roundtrip_and_unknown():
         fluid.set_flags({"FLAGS_no_such_flag": 1})
     with pytest.raises(KeyError):
         fluid.get_flags("nope")
+
+
+def test_trace_summary_cli_offline(tmp_path, fresh_programs):
+    """tools/trace_summary.py summarizes an exported chrome trace
+    offline, printing the same per-name table stop_profiler prints."""
+    import os
+    import subprocess
+    import sys
+
+    loss = _build_mlp()
+    path = str(tmp_path / "trace.json")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    x = np.random.rand(8, 4).astype("float32")
+    with profiler.profiler("CPU", profile_path=path):
+        for _ in range(2):
+            exe.run(feed={"x": x}, fetch_list=[loss])
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "trace_summary.py"),
+         path, "--sorted_key", "calls"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=120, check=True).stdout
+    lines = out.splitlines()
+    assert lines[0].startswith("Event")
+    assert any(ln.startswith("executor/run") for ln in lines)
+    # row format matches the live summary: name total calls avg max
+    row = [ln for ln in lines if ln.startswith("executor/run")][0]
+    assert row.split()[2] == "2"
 
 
 def test_trainer_step_spans(tmp_path, fresh_programs):

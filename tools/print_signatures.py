@@ -35,6 +35,7 @@ MODULES = [
     "paddle_tpu.io",
     "paddle_tpu.inference",
     "paddle_tpu.profiler",
+    "paddle_tpu.monitor",
     "paddle_tpu.debugger",
     "paddle_tpu.recordio",
     "paddle_tpu.reader",

@@ -1,0 +1,47 @@
+"""Summarize a chrome-trace JSON offline.
+
+Reads a trace exported by ``profiler.export_chrome_tracing`` (or any
+chrome://tracing JSON with X-phase ``dur``-microsecond events) and
+prints the per-name total/calls/avg/max table — the exact format
+``stop_profiler`` prints live — so traces shipped back from remote runs
+can be summarized without replaying them.
+
+Usage:
+    python tools/trace_summary.py /path/to/trace.json
+    python tools/trace_summary.py trace.json --sorted_key calls
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="offline per-name summary of a chrome-trace JSON")
+    p.add_argument("trace", help="chrome-trace JSON file "
+                                 "(export_chrome_tracing output)")
+    p.add_argument("--sorted_key", default=None,
+                   choices=["total", "calls", "ave", "max"],
+                   help="sort column (default: total)")
+    args = p.parse_args(argv)
+
+    from paddle_tpu import profiler
+
+    with open(args.trace) as f:
+        data = json.load(f)
+    events = data.get("traceEvents", data if isinstance(data, list) else [])
+    spans = [e for e in events if e.get("ph", "X") == "X"]
+    if not spans:
+        print("no X-phase span events in %s" % args.trace)
+        return 1
+    print(profiler.summarize_events(spans, args.sorted_key))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,9 @@ _mu = threading.Lock()
 _MAX_ENTRIES = 64
 _TRACE_CACHE = collections.OrderedDict()
 _STATS = {"trace_hits": 0, "trace_misses": 0, "lowerings": 0}
+# lowering counts per short program fingerprint: a retrace storm in the
+# stats/StepStats names WHICH program is churning, not just that one is
+_LOWERINGS_BY_FP = {}
 _persistent_dir = [None]
 
 
@@ -126,6 +129,9 @@ def lookup(key):
 def store(key, entry):
     with _mu:
         _STATS["lowerings"] += 1
+        if key and isinstance(key[0], str):
+            fp12 = key[0][:12]   # trace_key leads with the fingerprint
+            _LOWERINGS_BY_FP[fp12] = _LOWERINGS_BY_FP.get(fp12, 0) + 1
         _TRACE_CACHE[key] = entry
         _TRACE_CACHE.move_to_end(key)
         while len(_TRACE_CACHE) > _MAX_ENTRIES:
@@ -142,6 +148,7 @@ def stats():
     ``mark/compile_cache/{hit,miss}`` monitor counters."""
     with _mu:
         out = dict(_STATS)
+        out["lowerings_by_program"] = dict(_LOWERINGS_BY_FP)
     lookups = out["trace_hits"] + out["trace_misses"]
     out["hit_ratio"] = round(out["trace_hits"] / lookups, 4) if lookups \
         else 0.0
@@ -154,6 +161,7 @@ def reset_stats():
     with _mu:
         for k in _STATS:
             _STATS[k] = 0
+        _LOWERINGS_BY_FP.clear()
 
 
 def clear():

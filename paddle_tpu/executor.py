@@ -35,7 +35,8 @@ import jax
 from . import compile_cache, flags, monitor, registry
 from .core import materialize_dtype
 from .framework import Program, Variable, default_main_program
-from .profiler import RecordEvent
+from .monitor import program_profile
+from .profiler import RecordEvent, is_profiling
 from .registry import ComputeContext
 from .scope import Scope, global_scope
 
@@ -212,6 +213,13 @@ class _CompiledProgram:
         # persistent-cache deserialize) and is recorded as a "compile"
         # span, seen shapes as "dispatch"
         self.seen_sigs = set()
+        # AOT-captured executables keyed (feed_sig, device id): while
+        # profile capture is on, the cold dispatch compiles through
+        # program_profile.capture (so cost/memory analyses are readable)
+        # and every later step of that signature dispatches through the
+        # same executable — jax's AOT and jit call paths do not share a
+        # backend-compile cache, so mixing them would compile twice
+        self.aot = {}
 
 
 class AsyncDispatchQueue:
@@ -472,14 +480,58 @@ class Executor:
         # an unseen feed signature's first call pays jaxpr trace + XLA
         # compile (or a persistent-cache deserialize) — recorded as a
         # compile span so cache hits are observable as its disappearance
-        step_span = "executor/dispatch" if feed_sig in compiled.seen_sigs \
-            else "executor/compile"
+        cold = feed_sig not in compiled.seen_sigs
+        step_span = "executor/compile" if cold else "executor/dispatch"
+        # correlation tags: fingerprint is memoized per program version
+        # (one attribute read when warm), computed only when some
+        # observability layer is on — a dark process pays nothing here
+        fp = compile_cache.program_fingerprint(program) \
+            if (mon_t0 is not None or is_profiling()) else None
+        span_args = {"run_id": monitor.run_id(), "fingerprint": fp[:12],
+                     "step": self._run_counter - 1} if fp else None
         with RecordEvent("executor/run"):
-            with RecordEvent(step_span):
+            with RecordEvent(step_span, args=span_args):
                 with jax.default_device(dev):
-                    fetches, new_state = compiled.fn(
-                        feed_dev, state_vals, rng
-                    )
+                    fn = compiled.fn
+                    if cold and program_profile.capture_enabled() \
+                            and (feed_sig, getattr(dev, "id", 0)) \
+                            not in compiled.aot \
+                            and not flags.flag("debug_nans"):
+                        # the step is AOT-compiled here — profiled
+                        # (cost/memory analysis) and HBM-preflighted
+                        # BEFORE its first dispatch — and the same
+                        # executable serves every later step of this
+                        # signature: one compile total.  debug_nans
+                        # keeps the jit path (its nan re-run machinery
+                        # lives there).
+                        aotex = program_profile.capture(
+                            fp if fp is not None else
+                            compile_cache.program_fingerprint(program),
+                            feed_sig, compiled.fn,
+                            (feed_dev, state_vals, rng),
+                            device=dev, kind="executor",
+                            fetch_names=tuple(fetch_names))
+                        if aotex is not None:
+                            compiled.aot[
+                                (feed_sig, getattr(dev, "id", 0))] = aotex
+                    # debug_nans checked at dispatch too: a previously
+                    # captured executable must not bypass the jit
+                    # path's op-level nan re-run machinery
+                    if compiled.aot and not flags.flag("debug_nans"):
+                        fn = compiled.aot.get(
+                            (feed_sig, getattr(dev, "id", 0)), compiled.fn)
+                    try:
+                        fetches, new_state = fn(feed_dev, state_vals, rng)
+                    except (TypeError, ValueError):
+                        if fn is compiled.fn:
+                            raise
+                        # the AOT executable rejected the args (device/
+                        # layout drift a jit dispatch would absorb):
+                        # drop it and fall back to the jit path
+                        compiled.aot.pop(
+                            (feed_sig, getattr(dev, "id", 0)), None)
+                        fetches, new_state = compiled.fn(
+                            feed_dev, state_vals, rng)
         compiled.seen_sigs.add(feed_sig)
 
         for n, v in zip(compiled.state_out, new_state):
@@ -506,16 +558,22 @@ class Executor:
                 "executor", time.perf_counter() - mon_t0,
                 _batch_examples(block, feed_names, feed_vals),
                 len(self._dispatch_queue), device=dev,
-                warm=step_span == "executor/dispatch")
+                warm=not cold, fingerprint=fp)
         return fetches
 
     def cost_analysis(self, program=None, feed=None, fetch_list=None,
-                      scope=None):
+                      scope=None, compile_if_missing=True):
         """XLA compiled-module cost analysis for the step this
         (program, feed signature, fetch set) lowers to: exact flops /
         bytes-accessed per step from the compiler's own accounting (the
         `est_mfu` heuristic's ground truth; bench.py --exact_mfu).
-        Pays one extra XLA compile of the same module."""
+
+        Served from the program-profile registry when the program was
+        already compiled (the cold dispatch captured the analysis at
+        zero extra cost) — *free* for warm programs.  Never-run programs
+        fall back to one explicit lower+compile (and seed the registry
+        so the next call is free); ``compile_if_missing=False`` returns
+        None instead of paying that compile."""
         if program is None:
             program = default_main_program()
         feed = dict(feed or {})
@@ -531,6 +589,13 @@ class Executor:
             (n, tuple(v.shape), str(v.dtype))
             for n, v in zip(feed_names, feed_vals)
         )
+        fp = compile_cache.program_fingerprint(program)
+        prof = program_profile.get(fp, feed_sig, kind="executor",
+                                   fetch_names=tuple(fetch_names))
+        if prof is not None and prof.cost:
+            return dict(prof.cost)
+        if not compile_if_missing:
+            return None
         key = self._program_key(program, feed_sig, fetch_names, scope)
         compiled = self._cache.get(key)
         if compiled is None:
@@ -542,8 +607,21 @@ class Executor:
         state_vals = [np.asarray(scope.var(n)) for n in compiled.state_in]
         rng = jax.random.key(
             0, impl="rbg" if flags.flag("fast_prng") else None)
-        lowered = compiled.fn.lower(feed_vals, state_vals, rng)
-        ca = lowered.compile().cost_analysis()
+        dev = self.place.jax_device()
+        # lower on the executor's device so the executable is the one a
+        # run() of this signature would build
+        with jax.default_device(dev):
+            cexec = compiled.fn.lower(feed_vals, state_vals, rng).compile()
+        # seed the profile registry AND the entry's AOT-dispatch slot:
+        # repeated cost_analysis calls are free, and a later run() of
+        # the same signature dispatches through this executable instead
+        # of paying a second backend compile (jax's AOT and jit call
+        # paths share no compile cache)
+        program_profile.store_compiled(fp, feed_sig, cexec,
+                                       device=dev, kind="executor",
+                                       fetch_names=tuple(fetch_names))
+        compiled.aot[(feed_sig, getattr(dev, "id", 0))] = cexec
+        ca = cexec.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0]
         return dict(ca)

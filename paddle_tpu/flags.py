@@ -169,3 +169,27 @@ register_flag("monitor_console_seconds", 0.0, float, _on_monitor_change)
 # dump queue states + heartbeats + last span to stderr and the event log
 # (0 = watchdog off)
 register_flag("monitor_stall_seconds", 120.0, float, _on_monitor_change)
+def _on_preflight_oom(val):
+    # validate at set time: a typo ("stric") silently downgrading the
+    # hard-fail mode to a warning would defeat the operator's intent
+    allowed = ("auto", "warn", "strict", "off", "0", "false", "no",
+               "none", "")
+    if str(val).strip().lower() not in allowed:
+        raise ValueError(
+            "FLAGS_preflight_oom must be one of auto/warn/strict/off, "
+            "got %r" % (val,))
+
+
+# HBM preflight (monitor/program_profile.py): before the first dispatch
+# of a newly compiled program, compare its estimated peak device memory
+# (from the compiled module's own memory_analysis) against device
+# capacity.  "auto" (default) rides along whenever the monitor is on
+# (profile capture is monitor-gated) and warns; "warn"/"strict" force
+# capture + preflight even on unmonitored runs, warning or raising
+# PreflightOOMError instead of letting XLA OOM mid-run; "off" disables
+# the check (profiles still capture while the monitor is on).
+register_flag("preflight_oom", "auto", str, _on_preflight_oom)
+# capacity override in bytes for the preflight (0 = use the device's
+# memory_stats()['bytes_limit']; useful in tests and on backends that
+# misreport capacity)
+register_flag("preflight_hbm_bytes", 0, int)

@@ -27,6 +27,7 @@ the monitor is on — with or without a profiler session — so the two
 observability layers agree on what they both measure.
 """
 
+import os
 import sys
 import threading
 import time
@@ -44,12 +45,24 @@ __all__ = [
     "start_http_server", "Watchdog",
     "enable", "disable", "enabled", "registry", "step_stats",
     "expose_text", "record_step", "observe_span", "mark", "heartbeat",
-    "last_span", "queue_states", "track", "log_event",
+    "last_span", "queue_states", "track", "log_event", "run_id",
+    "sample_device_gauges",
 ]
 
 # fast-path gate: a module-global bool read (no lock, no flag lookup) is
 # all a disabled process pays per instrumentation site
 _enabled = False
+
+# per-run correlation id: every JSONL record, step record, chrome-trace
+# export, and /metrics exposition carries it, so the three views of one
+# run can be joined after the fact (Dapper-style: one id, many sinks)
+_RUN_ID = "%08x-%04x" % (int(time.time()) & 0xffffffff,
+                         os.getpid() & 0xffff)
+
+
+def run_id():
+    """The process's run correlation id (stable for the process life)."""
+    return _RUN_ID
 
 _mu = threading.RLock()
 _registry = MetricsRegistry()
@@ -157,7 +170,14 @@ def _reconcile():
             # tests (and operators) reset the registry while disabled,
             # and a stale handle would observe into an orphaned metric
             _span_hists.clear()
+            _prog_metrics.clear()
+            _dev_metrics.clear()
             _aggregator.reset()
+            # per-program step accounting (and the watchdog's suspect-
+            # program pointer) restarts with the session; captured
+            # profiles are compile artifacts and survive
+            _last_fp[0] = None
+            program_profile.reset_accounting()
         _enabled = on
         if newly_on or (on and fresh_jsonl):
             # set_flags applies the flag family one at a time, so the
@@ -212,8 +232,11 @@ def step_stats():
 
 
 def expose_text():
-    """Prometheus text exposition of every registered metric."""
-    return _registry.expose_text()
+    """Prometheus text exposition of every registered metric.  The
+    leading comment carries the run correlation id, so a scraped
+    /metrics snapshot can be joined against the JSONL log and chrome
+    traces of the same run."""
+    return "# run_id %s\n" % _RUN_ID + _registry.expose_text()
 
 
 def track(component):
@@ -247,9 +270,11 @@ def last_span():
 
 
 def log_event(record):
-    """Write one record to the JSONL event log (no-op when unset)."""
+    """Write one record to the JSONL event log (no-op when unset).
+    Every record is stamped with the run correlation id."""
     j = _jsonl
     if j is not None:
+        record.setdefault("run_id", _RUN_ID)
         j.write(record)
 
 
@@ -265,6 +290,18 @@ _span_hists = {}
 _span_gen = [0]
 
 
+def _refresh_handle_caches():
+    """Drop every cached metric handle iff the registry generation moved
+    (a registry.reset() orphaned them).  One shared latch for all three
+    handle caches: whichever cache notices the reset first must drop
+    them all, or a sibling would keep serving orphaned handles."""
+    if _span_gen[0] != _registry.generation:
+        _span_hists.clear()
+        _prog_metrics.clear()
+        _dev_metrics.clear()
+        _span_gen[0] = _registry.generation
+
+
 def observe_span(name, dur_us):
     """Double-publish a completed profiler span into the monitor:
     ``span/<name>`` histogram (seconds) + cumulative totals (feeds the
@@ -273,9 +310,7 @@ def observe_span(name, dur_us):
     if not _enabled:
         return
     dur_s = dur_us / 1e6
-    if _span_gen[0] != _registry.generation:
-        _span_hists.clear()
-        _span_gen[0] = _registry.generation
+    _refresh_handle_caches()
     h = _span_hists.get(name)
     if h is None:
         h = _span_hists[name] = _registry.histogram("span/" + name)
@@ -303,14 +338,39 @@ def heartbeat(name):
         w.heartbeat(name)
 
 
+# per-program metric handles (step-time histogram + steps/seconds/
+# examples counters keyed by the short fingerprint), cached like the
+# span histograms; _last_fp feeds the watchdog's "suspect program" line
+_prog_metrics = {}
+_last_fp = [None]
+
+
+def _program_handles(fp12):
+    _refresh_handle_caches()
+    h = _prog_metrics.get(fp12)
+    if h is None:
+        base = "program/" + fp12
+        h = _prog_metrics[fp12] = {
+            "steps": _registry.counter(base + "/steps_total"),
+            "seconds": _registry.counter(base + "/step_seconds_total"),
+            "examples": _registry.counter(base + "/examples_total"),
+            "hist": _registry.histogram(base + "/step_seconds"),
+        }
+    return h
+
+
 def record_step(name, step_seconds, examples, dispatch_queue_depth,
-                device=None, warm=None):
+                device=None, warm=None, fingerprint=None):
     """One executor ``run()`` completed: assemble the StepStats record,
     fold it into the aggregator/registry, append it to the JSONL log,
     and pet the watchdog.  ``warm`` is the executor's own verdict on
     this step (False = it paid a trace/compile for an unseen
     program/feed signature) — the step-level compile count a healthy
-    steady-state loop drives to zero."""
+    steady-state loop drives to zero.  ``fingerprint`` is the program's
+    structural fingerprint: step records, the per-program metric family
+    (``program/<fp12>/...``), and the program_profile step accounting
+    are all tagged with it so JSONL, /metrics, and the program report
+    agree on which program did what."""
     if not _enabled:
         return None
     from .. import compile_cache
@@ -319,7 +379,8 @@ def record_step(name, step_seconds, examples, dispatch_queue_depth,
         fs_total = _span_totals.get(name + "/fetch_sync", 0.0)
         fs_wait = fs_total - _last_fetch_sync.get(name, 0.0)
         _last_fetch_sync[name] = fs_total
-        rec = {"event": "step_stats", "ts": time.time(), "executor": name,
+        rec = {"event": "step_stats", "ts": time.time(), "run_id": _RUN_ID,
+               "executor": name,
                "step_seconds": round(step_seconds, 6),
                "examples": int(examples) if examples else 0,
                "examples_per_sec": round(examples / step_seconds, 2)
@@ -333,12 +394,55 @@ def record_step(name, step_seconds, examples, dispatch_queue_depth,
             rec["warm"] = bool(warm)
             if not warm:
                 _registry.counter("monitor/steps_compiled").inc()
+        if fingerprint:
+            rec["fingerprint"] = fingerprint
+            _last_fp[0] = fingerprint
+            h = _program_handles(fingerprint[:12])
+            h["steps"].inc()
+            h["seconds"].inc(step_seconds)
+            h["hist"].observe(step_seconds)
+            if examples:
+                h["examples"].inc(examples)
+            program_profile.note_step(fingerprint, step_seconds, examples,
+                                      kind=name)
         rec = _aggregator.record(rec)
         w = _watchdog
         if w is not None:
             w.step_completed()
     log_event(rec)
     return rec
+
+
+# per-device metric handles for ParallelExecutor's mesh gauges
+_dev_metrics = {}
+
+
+def sample_device_gauges(devices):
+    """Publish per-device memory/step gauges for a mesh step
+    (ParallelExecutor): a ``device/<platform><id>/steps_total`` counter
+    per step, plus ``bytes_in_use``/``bytes_limit`` gauges served from
+    ``_device_state``'s per-device sample cache — the same cadence (and
+    the same cached sample) record_step's device field uses, so a
+    sampled step issues one ``memory_stats()`` per device, not two."""
+    if not _enabled:
+        return
+    _refresh_handle_caches()
+    for d in devices:
+        key = "%s%s" % (getattr(d, "platform", "dev"), getattr(d, "id", 0))
+        h = _dev_metrics.get(key)
+        if h is None:
+            base = "device/" + key
+            h = _dev_metrics[key] = {
+                "steps": _registry.counter(base + "/steps_total"),
+                "in_use": _registry.gauge(base + "/bytes_in_use"),
+                "limit": _registry.gauge(base + "/bytes_limit"),
+            }
+        h["steps"].inc()
+        ms = _device_state(d)
+        if ms.get("bytes_in_use") is not None:
+            h["in_use"].set(ms["bytes_in_use"])
+        if ms.get("bytes_limit") is not None:
+            h["limit"].set(ms["bytes_limit"])
 
 
 def _prefetch_state():
@@ -399,7 +503,11 @@ def _stall_probe():
     return {"queues": queue_states(),
             "last_span": _last_span,
             "last_step": _aggregator.last(),
-            "compile_cache": _import_cc_stats()}
+            "compile_cache": _import_cc_stats(),
+            # the suspect: fingerprint + cost/memory profile of the last
+            # program a step completed for — a stall report should name
+            # which compiled program the device is (probably) stuck in
+            "last_program": program_profile.summary_for(_last_fp[0])}
 
 
 def _import_cc_stats():
@@ -425,4 +533,12 @@ def _format_diag(diag):
     if diag.get("last_span"):
         name, ts, dur = diag["last_span"]
         lines.append("  last span %s (%.3fs) at %s" % (name, dur, ts))
+    if diag.get("last_program"):
+        lines.append("  last program %s" % diag["last_program"])
     return "\n".join(lines) if lines else "  (no pipeline state tracked)"
+
+
+# imported last: program_profile's lazy `from . import ...` calls need
+# nothing at its import time, and _reconcile/_stall_probe reference the
+# module as an attribute at call time
+from . import program_profile  # noqa: E402

@@ -1,0 +1,468 @@
+"""Program-level cost & memory attribution (ISSUE 5 tentpole).
+
+The monitor's StepStats answer "how fast is the run going"; this module
+answers "which compiled program is spending the time and the HBM".  At
+the one compile each (program, feed signature) already pays — the cold
+dispatch in ``Executor.run`` / ``ParallelExecutor.run`` — the executor
+calls :func:`capture` with the jitted step and its concrete arguments.
+Capture AOT-compiles via ``jit.lower(args).compile()``, reads the
+compiled module's ``cost_analysis()`` (flops, bytes accessed) and
+``memory_analysis()`` (argument/output/temp/generated-code/alias bytes),
+and hands the executable back to the executor, which dispatches every
+step of that signature through it — so the capture IS the one compile,
+**zero additional lowerings or backend compiles** (jax's AOT and jit
+call paths do NOT share a backend-compile cache, so compiling through
+the jit call and separately analyzing would pay the XLA pipeline
+twice).  The AOT call path costs a few microseconds over the C++ jit
+fast path, paid only while capture is enabled (monitor on, or the
+preflight explicitly forced).
+
+Profiles land in a process-global registry keyed by
+``compile_cache.program_fingerprint`` + feed signature.  Per-program
+*step accounting* (steps, wall clock, examples) accumulates via
+:func:`note_step`, fed from ``monitor.record_step``; :func:`report_rows`
+joins the two into the per-program table (flops, bytes, peak HBM, steps,
+wall-clock share, ground-truth MFU from the compiler's own flop count —
+the ``est_mfu`` heuristic's replacement) that ``tools/program_report.py``
+renders from a live registry or a JSONL log.
+
+**HBM preflight**: before the first dispatch of a newly compiled
+program, the estimated peak device memory (arguments + outputs + temps +
+generated code - aliased/donated) is compared against the device's
+reported capacity (``device.memory_stats()['bytes_limit']``, overridable
+via ``FLAGS_preflight_hbm_bytes``).  Over capacity →
+``warnings.warn`` with the per-buffer-class breakdown, or
+:class:`PreflightOOMError` under ``FLAGS_preflight_oom=strict`` —
+instead of letting XLA OOM mid-run.
+"""
+
+import os
+import threading
+import time
+import warnings
+
+__all__ = [
+    "PreflightOOMError", "ProgramProfile", "capture_enabled", "capture",
+    "store_compiled", "get", "profiles", "note_step", "accounting",
+    "summary_for", "report_rows", "render_table", "reset",
+    "reset_accounting", "DEFAULT_PEAK_TFLOPS",
+]
+
+# chip peak (bf16 matmul TFLOP/s) for the MFU column; same env knob as
+# bench.py so the two agree on the denominator.  v5e default.
+DEFAULT_PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+
+_mu = threading.Lock()
+# (fingerprint, feed_sig, fetch_names, trace_flags, kind) ->
+# ProgramProfile: different fetch sets — and different trace-time flag
+# choices (kernel selection etc., mirroring compile_cache.trace_key) —
+# lower the same program+feeds to different XLA modules with different
+# flops/bytes, so both are part of the identity
+_profiles = {}
+_acct = {}          # fingerprint -> {steps, wall_s, examples, kind}
+_warned = set()     # (fingerprint, feed_sig) preflight warnings issued
+
+
+class PreflightOOMError(RuntimeError):
+    """Estimated peak device memory exceeds capacity
+    (``FLAGS_preflight_oom=strict``)."""
+
+
+class ProgramProfile:
+    """One compiled (program, feed signature, fetch set)'s cost/memory
+    profile, as captured from the XLA compiled module's own accounting."""
+
+    __slots__ = ("fingerprint", "feed_sig", "fetch_names", "kind", "ts",
+                 "cost", "flops",
+                 "bytes_accessed", "argument_bytes", "output_bytes",
+                 "temp_bytes", "generated_code_bytes", "alias_bytes",
+                 "peak_hbm_bytes", "device")
+
+    def __init__(self, fingerprint, feed_sig, kind, cost=None, flops=0.0,
+                 bytes_accessed=0.0, argument_bytes=0, output_bytes=0,
+                 temp_bytes=0, generated_code_bytes=0, alias_bytes=0,
+                 peak_hbm_bytes=0, device=None, fetch_names=()):
+        self.fingerprint = fingerprint
+        self.feed_sig = tuple(feed_sig)
+        self.fetch_names = tuple(fetch_names)
+        self.kind = kind
+        self.ts = time.time()
+        self.cost = dict(cost or {})
+        self.flops = float(flops)
+        self.bytes_accessed = float(bytes_accessed)
+        self.argument_bytes = int(argument_bytes)
+        self.output_bytes = int(output_bytes)
+        self.temp_bytes = int(temp_bytes)
+        self.generated_code_bytes = int(generated_code_bytes)
+        self.alias_bytes = int(alias_bytes)
+        self.peak_hbm_bytes = int(peak_hbm_bytes)
+        self.device = device
+
+    def breakdown(self):
+        """Per-buffer-class bytes, the preflight diagnostic's currency."""
+        return {"argument_bytes": self.argument_bytes,
+                "output_bytes": self.output_bytes,
+                "temp_bytes": self.temp_bytes,
+                "generated_code_bytes": self.generated_code_bytes,
+                "alias_bytes": self.alias_bytes,
+                "peak_hbm_bytes": self.peak_hbm_bytes}
+
+    def as_dict(self):
+        d = {"fingerprint": self.fingerprint,
+             "kind": self.kind,
+             "feed_sig": [[n, list(s), dt] for n, s, dt in self.feed_sig],
+             "fetch_names": list(self.fetch_names),
+             "flops": self.flops,
+             "bytes_accessed": self.bytes_accessed,
+             "device": self.device}
+        d.update(self.breakdown())
+        return d
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+def _flag(name, default):
+    from .. import flags
+
+    try:
+        return flags.flag(name)
+    except KeyError:
+        return default
+
+
+def _preflight_mode():
+    v = str(_flag("preflight_oom", "auto")).strip().lower()
+    if v in ("off", "0", "false", "no", "none", ""):
+        return "off"
+    if v == "strict":
+        return "strict"
+    return "auto" if v == "auto" else "warn"
+
+
+def capture_enabled():
+    """Whether the executors should capture profiles at the cold
+    dispatch.  True when the monitor is on, or when the operator forced
+    the HBM preflight (``FLAGS_preflight_oom=warn|strict``) on an
+    unmonitored run.  Checked only on compile steps, never per warm
+    step: an unmonitored, un-preflighted process runs the executors'
+    unmodified jit path."""
+    from . import enabled
+
+    return enabled() or _preflight_mode() in ("warn", "strict")
+
+
+def capture(fingerprint, feed_sig, jit_fn, args, device=None,
+            kind="executor", fetch_names=()):
+    """AOT-compile the step this (jitted fn, concrete args) maps to,
+    profile it, and run the HBM preflight — called by the executors at
+    the cold dispatch, *before* the step executes.  The returned
+    ``jax.stages.Compiled`` is THE executable for this signature: the
+    executor dispatches every step of it through the returned object, so
+    the one compile that was always going to happen simply happens here
+    — where its ``cost_analysis()``/``memory_analysis()`` are readable —
+    instead of inside the jit call.  Zero additional lowerings or
+    backend compiles; the per-step cost is the AOT call path's few
+    microseconds over the C++ jit fast path, paid only while capture is
+    enabled.
+
+    Returns the Compiled executable, or None if the backend refuses AOT
+    compilation (the executor then falls back to the plain jit call).
+    Raises :class:`PreflightOOMError` under ``FLAGS_preflight_oom=strict``
+    when the memory estimate exceeds capacity — analysis failures
+    themselves never break the step.
+    """
+    try:
+        compiled = jit_fn.lower(*args).compile()
+    except Exception:  # noqa: BLE001 — observability must not break steps
+        return None
+    prof = store_compiled(fingerprint, feed_sig, compiled, device=device,
+                          kind=kind, fetch_names=fetch_names)
+    if prof is not None:
+        _preflight(prof, device)
+    return compiled
+
+
+def store_compiled(fingerprint, feed_sig, compiled, device=None,
+                   kind="executor", fetch_names=()):
+    """Extract cost/memory analyses from a ``jax.stages.Compiled`` and
+    store the profile (shared by :func:`capture` and the explicit
+    ``Executor.cost_analysis`` fallback path).  No preflight here."""
+    cost = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        cost = dict(ca or {})
+    except Exception:  # noqa: BLE001
+        pass
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            mem = {k: int(getattr(ma, k + "_size_in_bytes", 0) or 0)
+                   for k in ("argument", "output", "temp",
+                             "generated_code", "alias")}
+    except Exception:  # noqa: BLE001
+        pass
+    if not cost and not mem:
+        return None
+    # donated (aliased) buffers are counted in both arguments and
+    # outputs but occupy one allocation; generated code (constants,
+    # scratch tables) lives in HBM too
+    peak = (mem.get("argument", 0) + mem.get("output", 0)
+            + mem.get("temp", 0) + mem.get("generated_code", 0)
+            - mem.get("alias", 0))
+    prof = ProgramProfile(
+        fingerprint, feed_sig, kind, cost=cost,
+        flops=cost.get("flops", 0.0) or 0.0,
+        bytes_accessed=cost.get("bytes accessed", 0.0) or 0.0,
+        argument_bytes=mem.get("argument", 0),
+        output_bytes=mem.get("output", 0),
+        temp_bytes=mem.get("temp", 0),
+        generated_code_bytes=mem.get("generated_code", 0),
+        alias_bytes=mem.get("alias", 0),
+        peak_hbm_bytes=max(0, peak),
+        device=str(getattr(device, "platform", device) or "") or None,
+        fetch_names=fetch_names)
+    with _mu:
+        _profiles[(fingerprint, prof.feed_sig, prof.fetch_names,
+                   _trace_flags(), kind)] = prof
+    from . import log_event
+
+    log_event(dict(prof.as_dict(), event="program_profile", ts=prof.ts))
+    return prof
+
+
+# ---------------------------------------------------------------------------
+# HBM preflight
+# ---------------------------------------------------------------------------
+
+def _device_capacity(device):
+    """Device memory capacity in bytes: ``FLAGS_preflight_hbm_bytes``
+    when set (tests, or backends that misreport), else the backend's
+    ``memory_stats()['bytes_limit']``; None = unknown (preflight skips)."""
+    override = int(_flag("preflight_hbm_bytes", 0))
+    if override > 0:
+        return override
+    if device is None:
+        return None
+    try:
+        ms = device.memory_stats()
+    except Exception:  # noqa: BLE001 — CPU/older backends
+        return None
+    if not ms:
+        return None
+    return ms.get("bytes_limit") or None
+
+
+def _fmt_mib(n):
+    """Adaptive byte formatting (toy CPU-test programs are KiB-scale,
+    real steps GiB-scale; '0.0 MiB' helps neither)."""
+    n = int(n)
+    if n >= 1 << 30:
+        return "%.2f GiB" % (n / (1 << 30))
+    if n >= 1 << 20:
+        return "%.1f MiB" % (n / (1 << 20))
+    if n >= 1 << 10:
+        return "%.1f KiB" % (n / (1 << 10))
+    return "%d B" % n
+
+
+def _preflight(prof, device):
+    mode = _preflight_mode()
+    if mode == "off":
+        return
+    # "auto" = ride along on monitor-gated captures in warn mode
+    if mode == "auto":
+        mode = "warn"
+    cap = _device_capacity(device)
+    if not cap or prof.peak_hbm_bytes <= cap:
+        return
+    msg = ("HBM preflight: program %s (%s) estimated peak device memory "
+           "%s exceeds capacity %s — arguments %s + outputs %s + temps "
+           "%s + generated code %s - aliased(donated) %s"
+           % (prof.fingerprint[:12], prof.kind,
+              _fmt_mib(prof.peak_hbm_bytes), _fmt_mib(cap),
+              _fmt_mib(prof.argument_bytes), _fmt_mib(prof.output_bytes),
+              _fmt_mib(prof.temp_bytes),
+              _fmt_mib(prof.generated_code_bytes),
+              _fmt_mib(prof.alias_bytes)))
+    from . import enabled, log_event, registry
+
+    if enabled():
+        registry().counter("monitor/preflight_oom").inc()
+        log_event({"event": "preflight_oom", "ts": time.time(),
+                   "fingerprint": prof.fingerprint, "mode": mode,
+                   "capacity_bytes": int(cap),
+                   "breakdown": prof.breakdown()})
+    if mode == "strict":
+        raise PreflightOOMError(msg)
+    key = (prof.fingerprint, prof.feed_sig)
+    with _mu:
+        if key in _warned:
+            return
+        _warned.add(key)
+    warnings.warn(msg, stacklevel=3)
+
+
+# ---------------------------------------------------------------------------
+# registry access + step accounting
+# ---------------------------------------------------------------------------
+
+def _trace_flags():
+    """Trace-time flag choices baked into a lowering (the same tuple
+    compile_cache.trace_key carries): two kernel-selection variants of
+    one program must not share a profile slot."""
+    from .. import compile_cache
+
+    return compile_cache.trace_flag_values()
+
+
+def get(fingerprint, feed_sig=None, kind="executor", fetch_names=()):
+    """Profile for (fingerprint, feed_sig, fetch_names, current trace
+    flags, kind); with ``feed_sig=None`` the most recently captured
+    profile for the fingerprint regardless of signature/fetch set/
+    flags/kind."""
+    with _mu:
+        if feed_sig is not None:
+            return _profiles.get((fingerprint, tuple(feed_sig),
+                                  tuple(fetch_names), _trace_flags(),
+                                  kind))
+        best = None
+        for (fp, _sig, _fetch, _flags, _k), p in _profiles.items():
+            if fp == fingerprint and (best is None or p.ts >= best.ts):
+                best = p
+        return best
+
+
+def profiles():
+    with _mu:
+        return list(_profiles.values())
+
+
+def note_step(fingerprint, step_seconds, examples, kind="executor"):
+    """Fold one completed step into the per-program accounting (called
+    from ``monitor.record_step`` when a fingerprint is attached)."""
+    with _mu:
+        a = _acct.get(fingerprint)
+        if a is None:
+            a = _acct[fingerprint] = {"steps": 0, "wall_s": 0.0,
+                                      "examples": 0, "kind": kind}
+        a["steps"] += 1
+        a["wall_s"] += float(step_seconds or 0.0)
+        a["examples"] += int(examples or 0)
+        a["kind"] = kind
+
+
+def accounting():
+    with _mu:
+        return {fp: dict(a) for fp, a in _acct.items()}
+
+
+def summary_for(fingerprint):
+    """Compact profile + accounting summary for one program — the
+    watchdog attaches this for the last dispatched program so a stall
+    report names the suspect."""
+    if not fingerprint:
+        return None
+    prof = get(fingerprint)
+    with _mu:
+        a = dict(_acct.get(fingerprint) or {})
+    out = {"fingerprint": fingerprint[:12]}
+    if a:
+        out.update({"steps": a["steps"],
+                    "wall_s": round(a["wall_s"], 3)})
+    if prof is not None:
+        out.update({"flops": prof.flops,
+                    "bytes_accessed": prof.bytes_accessed,
+                    "peak_hbm_bytes": prof.peak_hbm_bytes})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def report_rows(peak_tflops=None, profiles_by_fp=None, acct_by_fp=None):
+    """Join profiles + step accounting into per-program report rows,
+    sorted by wall-clock share.  ``profiles_by_fp``/``acct_by_fp``
+    override the live registry (the JSONL-replay path of
+    ``tools/program_report.py``)."""
+    peak = (peak_tflops if peak_tflops else DEFAULT_PEAK_TFLOPS) * 1e12
+    if acct_by_fp is None:
+        acct_by_fp = accounting()
+    if profiles_by_fp is None:
+        profiles_by_fp = {}
+        for p in profiles():
+            cur = profiles_by_fp.get(p.fingerprint)
+            if cur is None or p.ts >= cur.ts:
+                profiles_by_fp[p.fingerprint] = p
+    fps = set(acct_by_fp) | set(profiles_by_fp)
+    total_wall = sum((acct_by_fp.get(fp) or {}).get("wall_s", 0.0)
+                     for fp in fps)
+    rows = []
+    for fp in fps:
+        a = acct_by_fp.get(fp) or {}
+        p = profiles_by_fp.get(fp)
+        steps = int(a.get("steps", 0))
+        wall = float(a.get("wall_s", 0.0))
+        row = {"fingerprint": fp, "fp12": fp[:12],
+               "kind": a.get("kind") or (p.kind if p is not None else ""),
+               "steps": steps, "wall_s": round(wall, 6),
+               "wall_share": round(wall / total_wall, 4)
+               if total_wall > 0 else 0.0,
+               "examples": int(a.get("examples", 0)),
+               "flops_per_step": float(p.flops) if p is not None else None,
+               "bytes_per_step": float(p.bytes_accessed)
+               if p is not None else None,
+               "peak_hbm_bytes": int(p.peak_hbm_bytes)
+               if p is not None else None}
+        if p is not None and wall > 0 and p.flops:
+            row["mfu"] = round(p.flops * steps / wall / peak, 4)
+        else:
+            row["mfu"] = None
+        rows.append(row)
+    rows.sort(key=lambda r: (-r["wall_s"], r["fingerprint"]))
+    return rows
+
+
+def render_table(rows):
+    """Fixed-width text table of :func:`report_rows` output (shared by
+    the CLI and in-process reporting)."""
+    hdr = "%-12s %-10s %8s %10s %7s %12s %12s %10s %7s" % (
+        "program", "executor", "steps", "wall(s)", "share",
+        "GFLOP/step", "GB/step", "peakHBM", "MFU")
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows:
+        lines.append("%-12s %-10s %8d %10.3f %6.1f%% %12s %12s %10s %7s" % (
+            r["fp12"], (r["kind"] or "?")[:10], r["steps"], r["wall_s"],
+            100.0 * r["wall_share"],
+            "%.3f" % (r["flops_per_step"] / 1e9)
+            if r["flops_per_step"] is not None else "-",
+            "%.4f" % (r["bytes_per_step"] / 1e9)
+            if r["bytes_per_step"] is not None else "-",
+            _fmt_mib(r["peak_hbm_bytes"])
+            if r["peak_hbm_bytes"] is not None else "-",
+            "%.3f" % r["mfu"] if r["mfu"] is not None else "-"))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def reset_accounting():
+    """Drop step accounting but keep captured profiles (they are compile
+    artifacts, still valid across a monitor enable/disable flip)."""
+    with _mu:
+        _acct.clear()
+
+
+def reset():
+    """Drop everything (tests)."""
+    with _mu:
+        _profiles.clear()
+        _acct.clear()
+        _warned.clear()

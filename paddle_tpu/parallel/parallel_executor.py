@@ -36,7 +36,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import compile_cache, flags, monitor, registry  # noqa: F401  (op registry must be loaded)
 from ..executor import (AsyncDispatchQueue, trace_program, Executor,
                         _batch_examples, _check_finite)
-from ..profiler import RecordEvent
+from ..monitor import program_profile
+from ..profiler import RecordEvent, is_profiling
 from ..framework import Variable, default_main_program
 from ..scope import global_scope
 from .mesh import make_mesh, AXIS_DP
@@ -57,6 +58,10 @@ class _Compiled:
         self.state_shardings = state_shardings
         self.out_state_shardings = out_state_shardings
         self.warm = False      # first dispatch = trace+compile (see Executor)
+        # AOT-captured executable (one per entry: the trace-cache key
+        # already pins the feed signature + mesh); set by profile
+        # capture at the cold dispatch and used for every later step
+        self.aot_exec = None
 
 
 class ParallelExecutor:
@@ -383,9 +388,39 @@ class ParallelExecutor:
 
         step_span = "parallel_executor/dispatch" if compiled.warm \
             else "parallel_executor/compile"
+        fp = compile_cache.program_fingerprint(program) \
+            if (mon_t0 is not None or is_profiling()) else None
+        span_args = {"run_id": monitor.run_id(), "fingerprint": fp[:12],
+                     "step": self._run_counter - 1} if fp else None
         with RecordEvent("parallel_executor/run"):
-            with RecordEvent(step_span):
-                fetches, new_state = compiled.fn(feed_dev, state_dev, rng)
+            with RecordEvent(step_span, args=span_args):
+                if not compiled.warm and program_profile.capture_enabled() \
+                        and not flags.flag("debug_nans"):
+                    # AOT-compile + profile + HBM-preflight the pjit'd
+                    # module before its first dispatch; the captured
+                    # executable serves every later step (one compile
+                    # total).  SPMD analyses are per-device, which is
+                    # the granularity the preflight compares against.
+                    compiled.aot_exec = program_profile.capture(
+                        fp if fp is not None else
+                        compile_cache.program_fingerprint(program),
+                        feed_sig, compiled.fn, (feed_dev, state_dev, rng),
+                        device=self._mesh.devices.flat[0],
+                        kind="parallel_executor",
+                        fetch_names=tuple(fetch_names))
+                fn = compiled.aot_exec \
+                    if compiled.aot_exec is not None \
+                    and not flags.flag("debug_nans") else compiled.fn
+                try:
+                    fetches, new_state = fn(feed_dev, state_dev, rng)
+                except (TypeError, ValueError):
+                    if fn is compiled.fn:
+                        raise
+                    # AOT executable rejected the args: permanent
+                    # fallback to the jit path for this entry
+                    compiled.aot_exec = None
+                    fetches, new_state = compiled.fn(feed_dev, state_dev,
+                                                     rng)
         compiled.warm = True
 
         for n, v in zip(compiled.state_out, new_state):
@@ -436,7 +471,13 @@ class ParallelExecutor:
                 "parallel_executor", time.perf_counter() - mon_t0,
                 examples, len(self._dispatch_queue),
                 device=self._mesh.devices.flat[0],
-                warm=step_span == "parallel_executor/dispatch")
+                warm=step_span == "parallel_executor/dispatch",
+                fingerprint=fp)
+            # per-device memory/step gauges for the whole local mesh
+            # (the single-device sample above covers only device 0)
+            monitor.sample_device_gauges(
+                [d for d in self._mesh.devices.flat
+                 if d.process_index == jax.process_index()])
         return fetches
 
     def sync(self):

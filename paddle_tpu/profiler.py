@@ -18,7 +18,7 @@ from . import monitor
 
 __all__ = [
     "RecordEvent", "record_event", "mark_event", "profiler",
-    "start_profiler", "stop_profiler", "reset_profiler",
+    "start_profiler", "stop_profiler", "reset_profiler", "is_profiling",
     "export_chrome_tracing", "summarize_events", "cuda_profiler",
     "npu_profiler",
 ]
@@ -38,18 +38,27 @@ def _now_us():
     return time.perf_counter_ns() / 1000.0
 
 
-def _append_event(name, ts, dur):
+def _append_event(name, ts, dur, args=None):
     tid = threading.get_ident()
+    ev = {
+        "name": name,
+        "ts": ts,
+        "dur": dur,
+        "ph": "X",
+        "pid": os.getpid(),
+        "tid": tid,
+    }
+    if args:
+        ev["args"] = args
     with _events_lock:
         _thread_names[tid] = threading.current_thread().name
-        _events.append({
-            "name": name,
-            "ts": ts,
-            "dur": dur,
-            "ph": "X",
-            "pid": os.getpid(),
-            "tid": tid,
-        })
+        _events.append(ev)
+
+
+def is_profiling():
+    """True while a profiler session is active (the executors use this
+    to decide whether span correlation args are worth computing)."""
+    return _enabled[0]
 
 
 class RecordEvent:
@@ -64,8 +73,13 @@ class RecordEvent:
     observability layers agree with or without a profiler session.
     """
 
-    def __init__(self, name):
+    def __init__(self, name, args=None):
+        """``args`` (optional dict) lands in the chrome-trace event's
+        ``args`` field — the executors tag their dispatch/compile spans
+        with ``{run_id, fingerprint, step}`` so the trace, the JSONL
+        log, and /metrics can be correlated per program."""
         self.name = name
+        self.args = args
         self.t0 = None
         self._prof = False
         self._mon = False
@@ -82,7 +96,7 @@ class RecordEvent:
             return False
         dur = _now_us() - self.t0
         if self._prof:
-            _append_event(self.name, self.t0, dur)
+            _append_event(self.name, self.t0, dur, self.args)
         if self._mon:
             monitor.observe_span(self.name, dur)
         self.t0 = None
@@ -141,13 +155,18 @@ def export_chrome_tracing(path):
         events = list(_events)
         tnames = dict(_thread_names)
     pids = sorted({e["pid"] for e in events})
+    # the run correlation id rides in the process metadata AND the
+    # top-level metadata dict, matching the run_id each JSONL record and
+    # the /metrics exposition carry — one id across all three sinks
     meta = [{"name": "process_name", "ph": "M", "pid": pid,
-             "args": {"name": "paddle_tpu"}} for pid in pids]
+             "args": {"name": "paddle_tpu",
+                      "run_id": monitor.run_id()}} for pid in pids]
     for (pid, tid) in sorted({(e["pid"], e["tid"]) for e in events}):
         meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                      "tid": tid,
                      "args": {"name": tnames.get(tid, "tid-%d" % tid)}})
-    payload = {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+    payload = {"traceEvents": meta + events, "displayTimeUnit": "ms",
+               "metadata": {"run_id": monitor.run_id()}}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
@@ -156,19 +175,23 @@ def export_chrome_tracing(path):
     return path
 
 
-def summarize_events(events, sorted_key=None):
+def summarize_events(events, sorted_key=None, top=50):
     """Per-name total/calls/avg/max table over chrome-trace events (the
     ``X``-phase ones; ``dur`` in microseconds).  Shared by the live
     ``stop_profiler`` summary and the offline ``tools/trace_summary.py``
-    CLI, so both print the identical format."""
+    CLI, so both print the identical format.  ``top`` caps the row
+    count.  Tolerates foreign traces: events missing ``dur`` (counter/
+    instant events re-exported as X) count as zero-duration."""
     totals = {}
     for e in events:
-        if e.get("ph", "X") != "X":
+        if not isinstance(e, dict) or e.get("ph", "X") != "X" \
+                or "name" not in e:
             continue
+        dur = e.get("dur", 0.0) or 0.0
         t = totals.setdefault(e["name"], [0.0, 0, 0.0])
-        t[0] += e["dur"]
+        t[0] += dur
         t[1] += 1
-        t[2] = max(t[2], e["dur"])
+        t[2] = max(t[2], dur)
     rows = [
         (name, tot / 1000.0, cnt, tot / cnt / 1000.0, mx / 1000.0)
         for name, (tot, cnt, mx) in totals.items()
@@ -177,7 +200,7 @@ def summarize_events(events, sorted_key=None):
     rows.sort(key=lambda r: r[key], reverse=True)
     lines = ["%-40s %12s %8s %12s %12s" % ("Event", "total(ms)", "calls",
                                            "avg(ms)", "max(ms)")]
-    for name, tot, cnt, avg, mx in rows[:50]:
+    for name, tot, cnt, avg, mx in rows[:top]:
         lines.append("%-40s %12.3f %8d %12.3f %12.3f"
                      % (name, tot, cnt, avg, mx))
     return "\n".join(lines)

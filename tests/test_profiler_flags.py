@@ -227,11 +227,55 @@ def test_trace_summary_cli_offline(tmp_path, fresh_programs):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         timeout=120, check=True).stdout
     lines = out.splitlines()
-    assert lines[0].startswith("Event")
+    # the export's correlation id leads, then the live-format table
+    assert lines[0].startswith("run_id ")
+    assert lines[1].startswith("Event")
     assert any(ln.startswith("executor/run") for ln in lines)
     # row format matches the live summary: name total calls avg max
     row = [ln for ln in lines if ln.startswith("executor/run")][0]
     assert row.split()[2] == "2"
+    # marks are tallied as counter totals, not zero-ms span rows
+    assert any(ln.startswith("mark/compile_cache/") for ln in lines)
+    assert not any(ln.startswith("compile_cache/") for ln in lines)
+
+
+def test_trace_summary_cli_top_and_metadata_only(tmp_path):
+    """--top caps the table; a trace whose threads carry only M-phase
+    metadata events (or events missing dur) must not crash."""
+    import os
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tool = os.path.join(root, "tools", "trace_summary.py")
+
+    many = {"traceEvents": [
+        {"name": "span%d" % i, "ph": "X", "ts": 0.0, "dur": 10.0 + i,
+         "pid": 1, "tid": 1} for i in range(10)]}
+    p1 = str(tmp_path / "many.json")
+    json.dump(many, open(p1, "w"))
+    out = subprocess.run(
+        [sys.executable, tool, p1, "--top", "3"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=120, check=True).stdout
+    rows = [ln for ln in out.splitlines() if ln.startswith("span")]
+    assert len(rows) == 3
+
+    meta_only = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": "paddle_tpu"}},
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": 7,
+         "args": {"name": "prefetch-producer"}},
+        {"ph": "X", "ts": 0.0, "pid": 1, "tid": 7},   # nameless stray
+    ]}
+    p2 = str(tmp_path / "meta.json")
+    json.dump(meta_only, open(p2, "w"))
+    res = subprocess.run(
+        [sys.executable, tool, p2],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        timeout=120)
+    assert res.returncode == 0, res.stderr
+    assert "metadata-only" in res.stdout
 
 
 def test_trainer_step_spans(tmp_path, fresh_programs):

@@ -36,6 +36,7 @@ MODULES = [
     "paddle_tpu.inference",
     "paddle_tpu.profiler",
     "paddle_tpu.monitor",
+    "paddle_tpu.monitor.program_profile",
     "paddle_tpu.debugger",
     "paddle_tpu.recordio",
     "paddle_tpu.reader",

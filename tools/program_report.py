@@ -1,0 +1,129 @@
+"""Per-program cost/memory/step report — which compiled program spends
+the time and the HBM.
+
+Renders the table the program-profile registry maintains in-process
+(fingerprint, executor kind, steps, wall clock + share, flops/step,
+bytes/step, estimated peak HBM, ground-truth MFU from the compiler's
+own flop accounting) from a monitor JSONL log — the offline twin of
+calling ``paddle_tpu.monitor.program_profile.report_rows()`` /
+``render_table()`` on a live registry.
+
+Usage:
+    python tools/program_report.py /path/to/monitor_logs        # dir
+    python tools/program_report.py monitor-1234.jsonl           # one file
+    python tools/program_report.py logs/ --peak_tflops 197 --json
+
+The log must come from a run with the monitor on
+(``FLAGS_monitor_log_dir=...``): ``program_profile`` events carry each
+compiled program's cost/memory analysis, ``step_stats`` events carry the
+per-step fingerprint tags this report joins on.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_records(path):
+    """All JSONL records under ``path`` (a file, or a directory whose
+    ``*.jsonl`` files — including rotated ``.jsonl.N`` generations — are
+    read).  Unparseable lines are skipped (a crashed writer can leave a
+    torn tail)."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*.jsonl"))
+                       + glob.glob(os.path.join(path, "*.jsonl.*")))
+    else:
+        files = [path]
+    records = []
+    for f in files:
+        with open(f) as fh:
+            for ln in fh:
+                try:
+                    records.append(json.loads(ln))
+                except ValueError:
+                    continue
+    return records
+
+
+def rows_from_records(records, peak_tflops=None, run_id=None):
+    """Replay JSONL records into program-report rows: profiles from
+    ``program_profile`` events (latest per fingerprint wins), step
+    accounting from fingerprint-tagged ``step_stats`` events.
+    ``run_id`` filters to one run's records (a shared log dir holds
+    many)."""
+    from paddle_tpu.monitor.program_profile import (ProgramProfile,
+                                                    report_rows)
+
+    profiles, acct = {}, {}
+    for r in records:
+        if not isinstance(r, dict):
+            continue
+        if run_id and r.get("run_id") not in (None, run_id):
+            continue
+        ev = r.get("event")
+        if ev == "program_profile" and r.get("fingerprint"):
+            profiles[r["fingerprint"]] = ProgramProfile(
+                r["fingerprint"], (), r.get("kind", "executor"),
+                flops=r.get("flops", 0.0) or 0.0,
+                bytes_accessed=r.get("bytes_accessed", 0.0) or 0.0,
+                argument_bytes=r.get("argument_bytes", 0),
+                output_bytes=r.get("output_bytes", 0),
+                temp_bytes=r.get("temp_bytes", 0),
+                generated_code_bytes=r.get("generated_code_bytes", 0),
+                alias_bytes=r.get("alias_bytes", 0),
+                peak_hbm_bytes=r.get("peak_hbm_bytes", 0),
+                device=r.get("device"))
+        elif ev == "step_stats" and r.get("fingerprint"):
+            a = acct.setdefault(r["fingerprint"],
+                                {"steps": 0, "wall_s": 0.0, "examples": 0,
+                                 "kind": r.get("executor", "")})
+            a["steps"] += 1
+            a["wall_s"] += r.get("step_seconds", 0.0) or 0.0
+            a["examples"] += r.get("examples", 0) or 0
+    return report_rows(peak_tflops=peak_tflops, profiles_by_fp=profiles,
+                       acct_by_fp=acct)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="per-program cost/memory/step report from a monitor "
+                    "JSONL log")
+    p.add_argument("log", help="monitor JSONL file, or a "
+                               "FLAGS_monitor_log_dir directory")
+    p.add_argument("--peak_tflops", type=float, default=None,
+                   help="chip peak TFLOP/s for the MFU column "
+                        "(default: BENCH_PEAK_TFLOPS env or 197)")
+    p.add_argument("--run_id", default=None,
+                   help="only records of this run correlation id")
+    p.add_argument("--top", type=int, default=0,
+                   help="show only the top N programs by wall clock")
+    p.add_argument("--json", action="store_true",
+                   help="emit the rows as JSON instead of a table")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.monitor.program_profile import render_table
+
+    records = load_records(args.log)
+    rows = rows_from_records(records, peak_tflops=args.peak_tflops,
+                             run_id=args.run_id)
+    if args.top:
+        rows = rows[:args.top]
+    if not rows:
+        print("no program_profile / fingerprint-tagged step_stats "
+              "records in %s (monitor on? FLAGS_monitor_log_dir set?)"
+              % args.log)
+        return 1
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(render_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,18 +5,44 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/4 full test suite =="
+echo "== 1/5 full test suite =="
 python -m pytest tests/ -q
 
-echo "== 2/4 API signature gate =="
+echo "== 2/5 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/4 8-device virtual-mesh dryrun =="
+echo "== 3/5 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/4 bench smoke (CPU backend, tiny) =="
+echo "== 4/5 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
+
+echo "== 5/5 observability tooling smoke (program_report + trace_summary) =="
+OBS_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR"' EXIT
+JAX_PLATFORMS=cpu python - "$OBS_DIR" <<'PY'
+import sys
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor, profiler
+
+out = sys.argv[1]
+monitor.enable(log_dir=out)
+x = fluid.layers.data("x", shape=[8])
+loss = fluid.layers.mean(fluid.layers.fc(x, size=4, act="relu"))
+fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+with profiler.profiler("CPU", profile_path=None):
+    for _ in range(3):
+        exe.run(feed={"x": np.random.rand(4, 8).astype("float32")},
+                fetch_list=[loss])
+profiler.export_chrome_tracing(out + "/trace.json")
+monitor.disable()
+PY
+python tools/program_report.py "$OBS_DIR" --top 5
+python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
 echo "CI OK"

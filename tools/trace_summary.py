@@ -4,11 +4,14 @@ Reads a trace exported by ``profiler.export_chrome_tracing`` (or any
 chrome://tracing JSON with X-phase ``dur``-microsecond events) and
 prints the per-name total/calls/avg/max table — the exact format
 ``stop_profiler`` prints live — so traces shipped back from remote runs
-can be summarized without replaying them.
+can be summarized without replaying them.  Zero-duration marks
+(``mark_event``: cache hits/misses and other point occurrences) are
+tallied separately as ``mark/<name>`` counter totals, matching the
+monitor counters they double-publish into.
 
 Usage:
     python tools/trace_summary.py /path/to/trace.json
-    python tools/trace_summary.py trace.json --sorted_key calls
+    python tools/trace_summary.py trace.json --sorted_key calls --top 10
 """
 
 import argparse
@@ -28,6 +31,8 @@ def main(argv=None):
     p.add_argument("--sorted_key", default=None,
                    choices=["total", "calls", "ave", "max"],
                    help="sort column (default: total)")
+    p.add_argument("--top", type=int, default=50,
+                   help="max table rows (default 50)")
     args = p.parse_args(argv)
 
     from paddle_tpu import profiler
@@ -35,11 +40,30 @@ def main(argv=None):
     with open(args.trace) as f:
         data = json.load(f)
     events = data.get("traceEvents", data if isinstance(data, list) else [])
-    spans = [e for e in events if e.get("ph", "X") == "X"]
-    if not spans:
-        print("no X-phase span events in %s" % args.trace)
-        return 1
-    print(profiler.summarize_events(spans, args.sorted_key))
+    run_id = (data.get("metadata") or {}).get("run_id") \
+        if isinstance(data, dict) else None
+    spans, marks = [], {}
+    for e in events:
+        if not isinstance(e, dict) or e.get("ph", "X") != "X" \
+                or "name" not in e:
+            continue   # M-phase metadata (a thread may carry only these)
+        if not e.get("dur"):
+            marks[e["name"]] = marks.get(e["name"], 0) + 1
+        else:
+            spans.append(e)
+    if run_id:
+        print("run_id %s" % run_id)
+    if not spans and not marks:
+        print("no X-phase span events in %s (metadata-only trace)"
+              % args.trace)
+        return 0
+    if spans:
+        print(profiler.summarize_events(spans, args.sorted_key,
+                                        top=args.top))
+    if marks:
+        print("\n%-40s %12s" % ("Counter", "count"))
+        for name in sorted(marks, key=marks.get, reverse=True)[:args.top]:
+            print("%-40s %12d" % ("mark/" + name, marks[name]))
     return 0
 
 

@@ -63,17 +63,26 @@ class EndStepEvent:
 
 
 class CheckpointConfig:
-    """reference contrib/trainer.py:100"""
+    """reference contrib/trainer.py:100 — grown into the exact-resume
+    config: checkpoints are full ``TrainState`` artifacts (params +
+    optimizer slots + LR/step counters + executor PRNG counters +
+    reader position), written asynchronously under compute
+    (``async_save``) and committed atomically with checksum manifests
+    (``parallel.checkpoint.TrainStateCheckpointManager``).
+    ``step_interval`` counts GLOBAL steps across epochs."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10):
+                 epoch_interval=1, step_interval=10, async_save=True):
         self.checkpoint_dir = checkpoint_dir or os.path.join(
             os.getcwd(), "checkpoints")
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(int(epoch_interval), 1)
         self.step_interval = max(int(step_interval), 1)
+        self.async_save = bool(async_save)
         self.epoch_id = 0
         self.step_id = 0
+        # the restored global-step index after an auto-resume (kept
+        # under the reference's name: scripts test it for truthiness)
         self.load_serial = None
 
 
@@ -138,16 +147,47 @@ class Trainer:
                     Executor(self.place), param_path,
                     main_program=self.startup_program)
 
+        self._ckpt_mgr = None
+        self._global_step = 0
+        self._resume_epoch = 0
+        self._pending_resume = None
         if self.checkpoint_cfg is not None:
+            from ..parallel.checkpoint import TrainStateCheckpointManager
+
+            cfg = self.checkpoint_cfg
+            self._ckpt_mgr = TrainStateCheckpointManager(
+                cfg.checkpoint_dir,
+                max_to_keep=cfg.max_num_checkpoints,
+                save_interval_steps=cfg.step_interval,
+                async_save=cfg.async_save)
             with scope_guard(self.scope):
+                restored = self._ckpt_mgr.restore(
+                    scope=self.scope, program=self.train_program)
+            if restored is not None:
+                cfg.load_serial = restored
+                self._global_step = restored
+                # consumed (once) by train()'s _apply_resume_state
+                self._pending_resume = self._ckpt_mgr.last_restored.host
+                self._resume_epoch = int(
+                    self._pending_resume.get("extra", {}).get("epoch", 0))
+            else:
+                # a dir holding only the PREVIOUS Trainer's serial-based
+                # format must not be silently abandoned: resume its
+                # persistables (params-only legacy semantics) and say so
                 serial = fluid_io.get_latest_checkpoint_serial(
-                    self.checkpoint_cfg.checkpoint_dir)
+                    cfg.checkpoint_dir)
                 if serial >= 0:
-                    self.checkpoint_cfg.load_serial = serial
-                    fluid_io.load_checkpoint(
-                        Executor(self.place),
-                        self.checkpoint_cfg.checkpoint_dir,
-                        main_program=self.train_program)
+                    import warnings
+
+                    warnings.warn(
+                        "resuming a LEGACY (serial-based, params-only) "
+                        "checkpoint from %s; future saves use the "
+                        "TrainState format" % cfg.checkpoint_dir)
+                    cfg.load_serial = serial
+                    with scope_guard(self.scope):
+                        fluid_io.load_checkpoint(
+                            Executor(self.place), cfg.checkpoint_dir,
+                            main_program=self.train_program)
 
     # ------------------------------------------------------------------
     def _dist_transpile_if_necessary(self):
@@ -178,9 +218,9 @@ class Trainer:
                 run = lambda feed, fetch: executor.run(
                     self.train_program, feed=feed, fetch_list=fetch)
             feeder = self._feeder(feed_order)
-            ckpt_exe = Executor(self.place)
+            epoch_id = self._apply_resume_state(executor, reader)
             with self._signal_guard():
-                for epoch_id in range(num_epochs):
+                for epoch_id in range(epoch_id, num_epochs):
                     if self.__stop:
                         break
                     event_handler(BeginEpochEvent(epoch_id))
@@ -194,22 +234,55 @@ class Trainer:
                         with RecordEvent("trainer/step"):
                             metrics = run(feeder.feed(data), fetch)
                             metrics = [np.asarray(m) for m in metrics]
+                        self._global_step += 1
                         event_handler(EndStepEvent(epoch_id, step_id,
                                                    metrics))
                         with RecordEvent("trainer/checkpoint"):
-                            self._maybe_save_checkpoint(ckpt_exe, epoch_id,
-                                                        step_id)
+                            self._maybe_save_checkpoint(executor, reader,
+                                                        epoch_id)
                         if self.__preempted:
                             break
                     event_handler(EndEpochEvent(epoch_id))
                     if self.__preempted:
                         break
-                if self.__preempted and self.checkpoint_cfg is not None:
-                    # flush at the step boundary, then let the signal's
-                    # default behavior proceed (SURVEY §5
+                if self.__preempted and self._ckpt_mgr is not None \
+                        and self._global_step > 0:
+                    # > 0: a preemption before any step completed has
+                    # nothing worth flushing — and a step-0 artifact
+                    # would restore as load_serial=0, falsy under the
+                    # documented `if cfg.load_serial:` resume check
+                    # preemption: the step finished, now force a
+                    # synchronous TrainState flush, then let the
+                    # signal's default behavior proceed (SURVEY §5
                     # checkpoint-on-signal; reference analog:
                     # listen_and_serv_op.cc signal handler)
-                    self._flush_checkpoint(ckpt_exe, epoch_id)
+                    self._flush_checkpoint(executor, reader, epoch_id)
+            if self._ckpt_mgr is not None:
+                # a trailing async write must land before the process
+                # can exit believing the state is durable
+                self._ckpt_mgr.wait_until_finished()
+
+    def _apply_resume_state(self, executor, reader):
+        """After an auto-resume, re-apply the non-scope legs of the
+        restored TrainState to the objects that now exist: the
+        executor's PRNG fold-in counter and the reader's position.
+        Consumed once — a second train() call must not rewind the
+        executor to the restore point (it starts a fresh epoch range).
+        Returns the resume epoch."""
+        host, self._pending_resume = self._pending_resume, None
+        start, self._resume_epoch = self._resume_epoch, 0
+        if host is None:
+            return start
+        ex_state = host.get("executors", {}).get("train")
+        if ex_state is not None:
+            executor.load_state_dict(ex_state)
+        rd_state = host.get("readers", {}).get("train")
+        if rd_state is not None and hasattr(reader, "load_state_dict"):
+            reader.load_state_dict(rd_state)
+            # the reader's own epoch counter is the precise resume
+            # epoch (it rolls over exactly at source exhaustion)
+            return int(rd_state.get("epoch", start))
+        return start
 
     def _signal_guard(self):
         """While training, SIGTERM/SIGINT request a graceful stop: the
@@ -244,15 +317,17 @@ class Trainer:
 
         return _ctx()
 
-    def _flush_checkpoint(self, exe, epoch_id):
-        cfg = self.checkpoint_cfg
-        # one past the periodic serial for this epoch, so resume picks
-        # the preemption flush as latest
-        serial = (cfg.load_serial or 0) + epoch_id + 2
-        fluid_io.save_checkpoint(
-            exe, cfg.checkpoint_dir, serial=serial,
-            main_program=self.train_program,
-            max_num_checkpoints=cfg.max_num_checkpoints)
+    def _ckpt_readers(self, reader):
+        if reader is not None and hasattr(reader, "state_dict"):
+            return {"train": reader}
+        return None
+
+    def _flush_checkpoint(self, executor, reader, epoch_id):
+        self._ckpt_mgr.save_now(
+            self._global_step, scope=self.scope,
+            program=self.train_program, executors={"train": executor},
+            readers=self._ckpt_readers(reader),
+            extra={"epoch": epoch_id, "preempted": True})
 
     def test(self, reader, feed_order=None):
         """Average the train_func outputs over the test reader."""
@@ -302,14 +377,14 @@ class Trainer:
         return DataFeeder(feed_list=feed_list, place=self.place,
                           program=program)
 
-    def _maybe_save_checkpoint(self, exe, epoch_id, step_id):
+    def _maybe_save_checkpoint(self, executor, reader, epoch_id):
         cfg = self.checkpoint_cfg
-        if cfg is None:
+        if cfg is None or epoch_id % cfg.epoch_interval != 0:
             return
-        if epoch_id % cfg.epoch_interval == 0 and \
-                step_id % cfg.step_interval == 0:
-            serial = (cfg.load_serial or 0) + epoch_id + 1
-            fluid_io.save_checkpoint(
-                exe, cfg.checkpoint_dir, serial=serial,
-                main_program=self.train_program,
-                max_num_checkpoints=cfg.max_num_checkpoints)
+        # the manager gates on the GLOBAL step interval; the snapshot is
+        # synchronous (device->host), the write overlaps later compute
+        self._ckpt_mgr.save(
+            self._global_step, scope=self.scope,
+            program=self.train_program, executors={"train": executor},
+            readers=self._ckpt_readers(reader),
+            extra={"epoch": epoch_id})

@@ -341,6 +341,18 @@ class Executor:
         at epoch/checkpoint boundaries to force completion)."""
         self._dispatch_queue.drain()
 
+    def state_dict(self):
+        """Host-side executor state an exact resume must carry: the PRNG
+        fold-in counter (each ``run`` folds it into the program seed, so
+        dropout masks etc. at step N depend on how many steps ran
+        before).  Captured into ``TrainState`` checkpoints; exactness
+        additionally requires a nonzero ``program.random_seed`` (a
+        seedless program draws a fresh seed per process)."""
+        return {"run_counter": int(self._run_counter)}
+
+    def load_state_dict(self, state):
+        self._run_counter = int(state["run_counter"])
+
     def close(self):
         self.sync()
         self._cache.clear()

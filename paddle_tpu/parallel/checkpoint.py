@@ -14,16 +14,40 @@ async so the train loop overlaps the write (the pserver's
 "snapshot while serving" behavior).
 
 Works with the Scope/Program model: persistable vars are the pytree.
+
+Exact-resume elastic training (ISSUE 6 tentpole) lives in the second
+half of this module: ``TrainState`` captures params *and* optimizer
+slot vars, LR/step counters, executor PRNG counters, and reader
+position as ONE atomic artifact; ``TrainStateCheckpointManager`` writes
+it asynchronously (snapshot at the step boundary, write under the next
+interval's compute, ``checkpoint/save`` monitor span), commits
+atomically (tmp dir + rename) with a sha256 manifest, and on restore
+validates the manifest and FALLS BACK to the previous checkpoint when
+the latest is partial or corrupt — the production pattern of CheckFreq
+(FAST'21) / Check-N-Run (NSDI'22), see PAPERS.md.
 """
 
+import hashlib
+import json
 import os
+import shutil
+import threading
+import time
+import warnings
 
 import jax
 import numpy as np
 
+from .. import monitor
+from ..profiler import RecordEvent
 from ..scope import global_scope
 
-__all__ = ["save_sharded", "load_sharded", "ShardedCheckpointManager"]
+__all__ = [
+    "save_sharded", "load_sharded", "ShardedCheckpointManager",
+    "TrainState", "TrainStateCheckpointManager", "CheckpointCorruptError",
+    "CheckpointMismatchError", "capture_train_state", "apply_train_state",
+    "save_train_state", "load_train_state",
+]
 
 
 def _persistable_state(scope, program=None):
@@ -174,3 +198,519 @@ class ShardedCheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# Exact-resume TrainState checkpoints (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+TRAIN_STATE_FORMAT = 1
+
+# fault-injection points for the kill-and-resume drill
+# (tests/test_elastic_drill.py): each hook, when set to a callable, runs
+# at the named point of the write protocol with the step as argument —
+# e.g. ``os.kill(os.getpid(), SIGKILL)`` in "before_commit" simulates
+# preemption mid-save, leaving only a .tmp dir the restore must ignore.
+_FAULT_HOOKS = {}
+
+_ARRAYS_FILE = "arrays.npz"
+_HOST_FILE = "train_state.json"
+_MANIFEST_FILE = "MANIFEST.json"
+_STEP_PREFIX = "step_"
+_TMP_PREFIX = ".tmp."
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint artifact failed manifest/checksum validation."""
+
+
+class CheckpointMismatchError(CheckpointCorruptError):
+    """The artifact is intact but does not FIT: different model var set
+    or executor naming.  Distinct from corruption so restore() can stop
+    and surface a configuration error instead of silently falling back
+    past every (structurally identical) older artifact to a fresh
+    start."""
+
+
+def _npz_encode(arr):
+    """(encodable array, logical dtype name or None): dtypes the npy
+    format cannot describe (ml_dtypes bfloat16 etc. round-trip as raw
+    void) are stored as same-width uints + the logical name."""
+    arr = np.ascontiguousarray(arr)
+    try:
+        descr = np.lib.format.dtype_to_descr(arr.dtype)
+        if np.dtype(descr) == arr.dtype:
+            return arr, None
+    except (ValueError, TypeError):
+        pass
+    raw = np.dtype("u%d" % arr.dtype.itemsize)
+    return arr.view(raw), arr.dtype.name
+
+
+def _npz_decode(arr, dtype_name):
+    if not dtype_name:
+        return arr
+    import ml_dtypes
+
+    return arr.view(np.dtype(getattr(ml_dtypes, dtype_name)))
+
+
+def _named(objs, what):
+    """Normalize the executors=/readers= argument: None, a single
+    object, a sequence (auto-named by position), or a {name: obj} dict."""
+    if objs is None:
+        return {}
+    if isinstance(objs, dict):
+        return dict(objs)
+    if isinstance(objs, (list, tuple)):
+        return {"%s%d" % (what, i): o for i, o in enumerate(objs)}
+    return {what + "0": objs}
+
+
+class TrainState:
+    """One atomic snapshot of a training run at a step boundary:
+    ``arrays`` (host numpy: params, optimizer slots, LR, in-graph step
+    counters) + ``host`` (JSON-able: step index, executor PRNG counters,
+    reader positions, caller extras)."""
+
+    def __init__(self, step, arrays, host):
+        self.step = int(step)
+        self.arrays = arrays
+        self.host = host
+
+    def __repr__(self):
+        return "TrainState(step=%d, arrays=%d, executors=%s, readers=%s)" % (
+            self.step, len(self.arrays),
+            sorted(self.host.get("executors", {})),
+            sorted(self.host.get("readers", {})))
+
+
+def capture_train_state(step, scope=None, program=None, executors=None,
+                        readers=None, extra=None):
+    """Snapshot the FULL train state at a step boundary.
+
+    Blocks only for the device->host copy of the persistable vars (the
+    cheap part); serialization happens in whoever writes the snapshot —
+    under the next interval's compute on the async save path.
+    ``executors``/``readers`` are objects exposing ``state_dict()``
+    (Executor/ParallelExecutor PRNG run counters, reader positions);
+    pass the same names to the restoring side so state re-applies to
+    the matching object."""
+    with RecordEvent("checkpoint/snapshot"):
+        scope = scope or global_scope()
+        state = _persistable_state(scope, program)
+        _require_state(state, "snapshot")
+        # np.array(copy=True), NOT np.asarray: on the CPU backend
+        # np.asarray(jax.Array) is a ZERO-COPY view of the device
+        # buffer, and the next dispatched step DONATES that buffer —
+        # XLA reuses the memory while the background writer serializes
+        # it, tearing the snapshot (found by the kill-at-step drill:
+        # warm-cache runs dispatch fast enough to hit the window)
+        arrays = {n: np.array(v, copy=True) for n, v in state.items()}
+        host = {
+            "format": TRAIN_STATE_FORMAT,
+            "step": int(step),
+            "time": time.time(),
+            "executors": {n: dict(e.state_dict())
+                          for n, e in _named(executors, "executor").items()},
+            "readers": {n: dict(r.state_dict())
+                        for n, r in _named(readers, "reader").items()},
+            "extra": dict(extra or {}),
+        }
+    return TrainState(step, arrays, host)
+
+
+def apply_train_state(ts, scope=None, program=None, executors=None,
+                      readers=None, shardings=None, strict=True):
+    """Apply a restored ``TrainState``: arrays into the scope (optionally
+    ``device_put`` onto ``shardings``), PRNG counters into the executors,
+    positions into the readers.  ``strict`` requires every persistable
+    var of the current program to be present in the artifact (exact
+    resume must not silently half-restore a model)."""
+    scope = scope or global_scope()
+    current = _persistable_state(scope, program)
+    _require_state(current, "restore into")
+    missing = sorted(set(current) - set(ts.arrays))
+    if missing and strict:
+        raise CheckpointMismatchError(
+            "checkpoint (step %d) lacks persistable vars %s of the "
+            "current program — not the same model (strict=False to "
+            "restore the intersection)" % (ts.step, missing))
+    if strict:
+        # names matching is not enough: a smaller model whose var names
+        # are a SUBSET of the saved one must still be rejected, so
+        # shapes/dtypes are part of the fit check
+        for name in current:
+            if name not in ts.arrays:
+                continue
+            want, got = ts.arrays[name], current[name]
+            if tuple(np.shape(got)) != tuple(want.shape):
+                raise CheckpointMismatchError(
+                    "checkpoint (step %d) var %r has shape %s but the "
+                    "current model declares %s — not the same model"
+                    % (ts.step, name, tuple(want.shape),
+                       tuple(np.shape(got))))
+    # validate the executor-name mapping BEFORE touching the scope: a
+    # rejected checkpoint must not leave its params half-applied
+    named_ex = _named(executors, "executor")
+    if strict and ts.host.get("executors"):
+        for name in named_ex:
+            if name not in ts.host["executors"]:
+                raise CheckpointMismatchError(
+                    "checkpoint has no executor state named %r "
+                    "(saved: %s)" % (name, sorted(ts.host["executors"])))
+    for name in current:
+        if name not in ts.arrays:
+            continue
+        val = ts.arrays[name]
+        sh = (shardings or {}).get(name)
+        scope.set_var(name, jax.device_put(val, sh) if sh is not None
+                      else val)
+    for name, ex in named_ex.items():
+        st = ts.host.get("executors", {}).get(name)
+        if st is not None:
+            ex.load_state_dict(st)
+    for name, r in _named(readers, "reader").items():
+        st = ts.host.get("readers", {}).get(name)
+        if st is not None:
+            r.load_state_dict(st)
+    return ts.step
+
+
+def _sha256(path):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_dir(path):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:       # platforms without directory fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _run_hook(name, step):
+    hook = _FAULT_HOOKS.get(name)
+    if hook is not None:
+        hook(step)
+
+
+def save_train_state(dirname, ts):
+    """Write ``ts`` as one atomic artifact: arrays.npz + train_state.json
+    + a sha256 MANIFEST, assembled in a ``.tmp`` sibling and committed
+    with a single directory rename.  A crash at ANY point leaves either
+    the previous artifact set intact or a .tmp dir restores ignore."""
+    dirname = os.path.abspath(dirname)
+    parent = os.path.dirname(dirname)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    tmp = os.path.join(parent, _TMP_PREFIX + "%s.%d"
+                       % (os.path.basename(dirname), os.getpid()))
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp)
+    try:
+        _run_hook("before_write", ts.step)
+        encoded, raw_dtypes = {}, {}
+        for n, a in ts.arrays.items():
+            encoded[n], logical = _npz_encode(a)
+            if logical:
+                raw_dtypes[n] = logical
+        host = dict(ts.host)
+        host["raw_dtypes"] = raw_dtypes
+        # npz member names can't carry '/' etc. reliably across numpy
+        # versions -> positional members + an ordered name list
+        names = sorted(encoded)
+        arrays_path = os.path.join(tmp, _ARRAYS_FILE)
+        with open(arrays_path, "wb") as f:
+            np.savez(f, **{"arr_%d" % i: encoded[n]
+                           for i, n in enumerate(names)})
+            f.flush()
+            os.fsync(f.fileno())
+        host["array_names"] = names
+        host_path = os.path.join(tmp, _HOST_FILE)
+        with open(host_path, "w") as f:
+            json.dump(host, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _run_hook("after_write", ts.step)
+        manifest = {
+            "format": TRAIN_STATE_FORMAT,
+            "step": ts.step,
+            "files": {
+                _ARRAYS_FILE: {"sha256": _sha256(arrays_path),
+                               "bytes": os.path.getsize(arrays_path)},
+                _HOST_FILE: {"sha256": _sha256(host_path),
+                             "bytes": os.path.getsize(host_path)},
+            },
+        }
+        with open(os.path.join(tmp, _MANIFEST_FILE), "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _run_hook("before_commit", ts.step)
+        # the commit point: everything before it is invisible to
+        # restores.  Re-saving an existing step renames the old
+        # artifact aside first (as a .tmp sibling, reclaimed by the
+        # next manager init) — rmtree-then-replace would hold a
+        # destroyed-artifact window open for the whole delete; the
+        # rename pair shrinks it to two directory entries.
+        if os.path.isdir(dirname):
+            old = tmp + ".replaced"
+            shutil.rmtree(old, ignore_errors=True)
+            os.replace(dirname, old)
+            os.replace(tmp, dirname)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.replace(tmp, dirname)
+        _fsync_dir(parent or ".")
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return dirname
+
+
+def load_train_state(dirname):
+    """Read + VALIDATE one TrainState artifact; raises
+    ``CheckpointCorruptError`` on a missing/partial/garbled artifact
+    (manifest absent, checksum mismatch, undecodable payload)."""
+    dirname = os.path.abspath(dirname)
+    mpath = os.path.join(dirname, _MANIFEST_FILE)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorruptError(
+            "checkpoint %s: unreadable manifest (%s) — likely a partial "
+            "write" % (dirname, e))
+    try:
+        for fname, meta in manifest["files"].items():
+            fpath = os.path.join(dirname, fname)
+            if not os.path.exists(fpath):
+                raise CheckpointCorruptError(
+                    "checkpoint %s: missing %s" % (dirname, fname))
+            if _sha256(fpath) != meta["sha256"]:
+                raise CheckpointCorruptError(
+                    "checkpoint %s: %s fails its sha256 — corrupt"
+                    % (dirname, fname))
+        with open(os.path.join(dirname, _HOST_FILE)) as f:
+            host = json.load(f)
+        raw_dtypes = host.pop("raw_dtypes", {})
+        names = host.pop("array_names")
+        with np.load(os.path.join(dirname, _ARRAYS_FILE)) as z:
+            arrays = {n: _npz_decode(z["arr_%d" % i], raw_dtypes.get(n))
+                      for i, n in enumerate(names)}
+        return TrainState(manifest["step"], arrays, host)
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any decode failure = corrupt
+        raise CheckpointCorruptError(
+            "checkpoint %s: undecodable (%r)" % (dirname, e))
+
+
+class TrainStateCheckpointManager:
+    """Step-indexed TrainState checkpoints with async writes overlapped
+    under compute and corruption-safe fallback restore.
+
+    Save protocol (the CheckFreq split): ``save(step)`` snapshots the
+    state synchronously at the step boundary (a device->host copy), then
+    hands the WRITE to a background thread — the serialization +
+    fsync + atomic commit runs under the next interval's compute and
+    shows up as a ``checkpoint/save`` monitor span, not step time.  A
+    still-inflight write is drained before the next snapshot (and by
+    ``save_now``/``wait_until_finished``/``close``); a failed background
+    write re-raises at the next call into the manager rather than
+    dying silently.
+
+    Restore protocol: newest artifact first; an artifact failing
+    manifest/sha256 validation is logged and SKIPPED, falling back to
+    the previous one — a torn or corrupt latest checkpoint costs one
+    interval of work, never the job."""
+
+    def __init__(self, dirname, max_to_keep=3, save_interval_steps=1,
+                 async_save=True):
+        self._dir = os.path.abspath(dirname)
+        os.makedirs(self._dir, exist_ok=True)
+        self._max_to_keep = max(1, int(max_to_keep)) \
+            if max_to_keep is not None else None
+        self._interval = max(1, int(save_interval_steps))
+        self._async = bool(async_save)
+        self._last_saved = None
+        self._inflight = None            # (thread, step)
+        self._error = None
+        self._mu = threading.Lock()
+        self.last_restored = None        # TrainState of the last restore
+        # a dead process's .tmp dirs (kill mid-save) are garbage
+        for entry in os.listdir(self._dir):
+            if entry.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self._dir, entry),
+                              ignore_errors=True)
+
+    # -- paths / listing ----------------------------------------------
+    def _step_dir(self, step):
+        return os.path.join(self._dir, "%s%010d" % (_STEP_PREFIX, step))
+
+    def all_steps(self):
+        """Committed step indices, sorted ascending (no validation)."""
+        out = []
+        for entry in os.listdir(self._dir):
+            if entry.startswith(_STEP_PREFIX):
+                try:
+                    out.append(int(entry[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def should_save(self, step):
+        last = self._last_saved
+        if last is None:
+            last = self.latest_step()
+        return last is None or step >= last + self._interval
+
+    # -- save ----------------------------------------------------------
+    def save(self, step, scope=None, program=None, executors=None,
+             readers=None, extra=None):
+        """Interval-gated async save at ``step``.  Returns False when
+        gated; True once the snapshot is taken and the write is running
+        (or, sync mode, committed)."""
+        self._reraise()
+        if not self.should_save(step):
+            return False
+        self.wait_until_finished()       # drain the previous write
+        ts = capture_train_state(step, scope=scope, program=program,
+                                 executors=executors, readers=readers,
+                                 extra=extra)
+        self._last_saved = int(step)
+        if not self._async:
+            self._write(ts)
+            return True
+        t = threading.Thread(target=self._write_guarded, args=(ts,),
+                             name="ckpt-write-%d" % step, daemon=True)
+        with self._mu:
+            self._inflight = (t, int(step))
+        t.start()
+        return True
+
+    def save_now(self, step, scope=None, program=None, executors=None,
+                 readers=None, extra=None):
+        """Forced SYNCHRONOUS save ignoring the interval gate — the
+        preemption/SIGTERM flush path.  Drains any in-flight async write
+        first; returns only once the artifact is committed.  If this
+        exact step already committed (the periodic save landed at the
+        same boundary), the flush is a no-op: the state at one step
+        boundary is one state, and re-writing it would only re-open the
+        replace window during a shutdown deadline."""
+        self._reraise()
+        self.wait_until_finished()
+        if self._last_saved == int(step) and \
+                os.path.exists(os.path.join(self._step_dir(step),
+                                            _MANIFEST_FILE)):
+            return True
+        ts = capture_train_state(step, scope=scope, program=program,
+                                 executors=executors, readers=readers,
+                                 extra=extra)
+        self._last_saved = int(step)
+        self._write(ts)
+        return True
+
+    def _write_guarded(self, ts):
+        try:
+            self._write(ts)
+        except BaseException as e:  # noqa: BLE001 — surfaced on next call
+            with self._mu:
+                self._error = e
+
+    def _write(self, ts):
+        t0 = time.perf_counter()
+        with RecordEvent("checkpoint/save"):
+            path = save_train_state(self._step_dir(ts.step), ts)
+        self._rotate()
+        monitor.mark("checkpoint/saved")
+        monitor.log_event({
+            "event": "checkpoint_saved", "ts": time.time(),
+            "step": ts.step, "path": path,
+            "seconds": round(time.perf_counter() - t0, 6),
+            "bytes": sum(a.nbytes for a in ts.arrays.values()),
+            "async": self._async})
+        return path
+
+    def _rotate(self):
+        if self._max_to_keep is None:
+            return
+        steps = self.all_steps()
+        for s in steps[:-self._max_to_keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def _reraise(self):
+        with self._mu:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                "previous async checkpoint write failed") from err
+
+    def wait_until_finished(self):
+        with self._mu:
+            inflight = self._inflight
+        if inflight is not None:
+            inflight[0].join()
+            with self._mu:
+                if self._inflight is inflight:
+                    self._inflight = None
+        self._reraise()
+
+    # -- restore -------------------------------------------------------
+    def restore(self, scope=None, program=None, executors=None,
+                readers=None, step=None, shardings=None, strict=True):
+        """Restore ``step`` (default: newest VALID artifact, falling
+        back past corrupt/partial ones with a warning).  Returns the
+        restored step index, or None when no usable checkpoint exists;
+        the full ``TrainState`` stays readable as ``last_restored``
+        (the Trainer applies executor/reader state from it after it
+        builds those objects)."""
+        self.wait_until_finished()
+        candidates = [step] if step is not None \
+            else list(reversed(self.all_steps()))
+        for s in candidates:
+            try:
+                ts = load_train_state(self._step_dir(s))
+                restored = apply_train_state(
+                    ts, scope=scope, program=program, executors=executors,
+                    readers=readers, shardings=shardings, strict=strict)
+            except CheckpointMismatchError:
+                # a structural misfit (different model / executor
+                # naming) is a CONFIGURATION error every older artifact
+                # shares — falling back would silently end in a fresh
+                # start; surface it instead
+                raise
+            except CheckpointCorruptError as e:
+                if step is not None:
+                    raise
+                warnings.warn(
+                    "skipping corrupt checkpoint step %d (%s); falling "
+                    "back to the previous one" % (s, e))
+                monitor.mark("checkpoint/corrupt_skipped")
+                continue
+            self.last_restored = ts
+            # save cadence restarts from the RESTORED step, not from
+            # whatever newer (possibly corrupt, just skipped) artifact
+            # sits on disk: replayed steps re-checkpoint on schedule,
+            # and the next save at a skipped step's index overwrites
+            # the corrupt artifact instead of warning forever
+            self._last_saved = restored
+            monitor.log_event({"event": "checkpoint_restored",
+                               "ts": time.time(), "step": restored})
+            return restored
+        return None
+
+    def close(self):
+        self.wait_until_finished()

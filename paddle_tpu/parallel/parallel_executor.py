@@ -485,6 +485,21 @@ class ParallelExecutor:
         ``Executor.sync``)."""
         self._dispatch_queue.drain()
 
+    def state_dict(self):
+        """Exact-resume host state (see ``Executor.state_dict``): the
+        PRNG fold-in counter plus the once-per-executor auto seed for
+        seedless programs (drawn at first run, broadcast across hosts —
+        restoring it keeps the resumed random stream identical)."""
+        st = {"run_counter": int(self._run_counter)}
+        if self._auto_seed_val is not None:
+            st["auto_seed"] = int(self._auto_seed_val)
+        return st
+
+    def load_state_dict(self, state):
+        self._run_counter = int(state["run_counter"])
+        if state.get("auto_seed") is not None:
+            self._auto_seed_val = np.uint32(state["auto_seed"])
+
     def _auto_seed(self):
         """Seed for programs with no explicit random_seed.  Drawn once
         per executor and, on multi-host jobs, broadcast from process 0:

@@ -23,6 +23,8 @@ __all__ = [
     "multiprocess_reader",
     "cache",
     "bucket_by_length",
+    "checkpointable",
+    "CheckpointableReader",
     "Fake",
     "PipeReader",
 ]
@@ -57,6 +59,70 @@ def shuffle(reader, buf_size):
             for b in buf:
                 yield b
     return data_reader
+
+
+class CheckpointableReader:
+    """Position-tracking wrapper over a reader creator — the reader leg
+    of exact-resume checkpoints (``TrainState`` captures it alongside
+    params/optimizer/PRNG state).
+
+    Tracks ``(epoch, offset)``: how many epochs the source has been
+    fully consumed, and how many items of the current epoch were
+    yielded.  ``state_dict()``/``load_state_dict()`` round-trip that
+    position; the first iteration after a restore FAST-FORWARDS by
+    drawing and discarding ``offset`` items from a fresh source
+    iterator, so the next item yielded is exactly the one the killed
+    run would have trained on.  Exactness requires a deterministic
+    source (fixed-seed shuffle, stable file order) — the same property
+    the loss-trajectory drill already needs.
+
+    Used as a reader creator: ``reader()`` returns the epoch's
+    iterator, like any other decorator product.
+    """
+
+    def __init__(self, reader_creator):
+        if not callable(reader_creator):
+            raise TypeError(
+                "checkpointable() wraps a reader CREATOR (zero-arg "
+                "callable returning an iterator); got %r"
+                % type(reader_creator).__name__)
+        self._creator = reader_creator
+        self._epoch = 0
+        self._offset = 0
+
+    def state_dict(self):
+        return {"epoch": self._epoch, "offset": self._offset}
+
+    def load_state_dict(self, state):
+        self._epoch = int(state["epoch"])
+        self._offset = int(state["offset"])
+
+    def __call__(self):
+        it = iter(self._creator())
+        skip = self._offset
+        for _ in range(skip):
+            try:
+                next(it)
+            except StopIteration:
+                # source shrank below the saved offset: treat as an
+                # epoch boundary rather than replaying a partial epoch
+                self._epoch += 1
+                self._offset = 0
+                return
+        for item in it:
+            self._offset += 1
+            yield item
+        self._epoch += 1
+        self._offset = 0
+
+    def __iter__(self):
+        return self()
+
+
+def checkpointable(reader):
+    """Wrap a reader creator so its position checkpoints and restores
+    exactly (see ``CheckpointableReader``)."""
+    return CheckpointableReader(reader)
 
 
 def chain(*readers):

@@ -147,6 +147,7 @@ def test_memory_optimize_reports_footprint():
         assert fluid.release_memory(fluid.default_main_program()) == 0
 
 
+@pytest.mark.slow   # ~36s; resnet train coverage also in test_models (tier-1 budget)
 def test_image_classification_cifar_resnet():
     """Cifar image classification with the book's resnet_cifar10
     (book/test_image_classification.py net_type='resnet')."""
@@ -168,6 +169,7 @@ def test_image_classification_cifar_resnet():
     assert losses[-1] < losses[0], (losses[0], losses[-1])
 
 
+@pytest.mark.slow   # ~46s; vgg build/run coverage also in test_models (tier-1 budget)
 def test_image_classification_cifar_vgg():
     """Cifar image classification with the book's VGG
     (book/test_image_classification.py net_type='vgg')."""

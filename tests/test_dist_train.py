@@ -148,6 +148,8 @@ def test_memory_optimize_reports():
     assert fluid.release_memory() == 0
 
 
+@pytest.mark.slow   # ~60s 2-process drill; the deterministic single-host
+                    # kill-and-resume drill (test_elastic_drill) is tier-1
 def test_dist_trainer_kill_and_resume(tmp_path):
     """Fault injection (SURVEY §5 checkpoint-on-signal, restart-resume):
     SIGTERM both trainer processes mid-run — they agree on a flush step

@@ -89,6 +89,7 @@ def test_vgg16_builds_and_runs():
     np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow   # ~30s build of the largest model zoo entry (tier-1 budget)
 def test_se_resnext_builds_and_runs():
     img = fluid.layers.data("img", shape=[3, 64, 64])
     pred = se_resnext.SE_ResNeXt(img, class_dim=10, depth=50, cardinality=8,

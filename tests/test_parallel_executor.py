@@ -199,6 +199,7 @@ def test_parallel_bad_policy_spec_raises():
         pe.run(feed=b, fetch_list=[loss])
 
 
+@pytest.mark.slow   # ~110s: the 8-device dryrun also runs standalone as run_ci step 3
 def test_graft_entry_dryrun_inprocess():
     """The driver's multichip dryrun runs in-process on the virtual mesh."""
     import os

@@ -1,0 +1,436 @@
+"""TrainState exact-resume checkpoints (ISSUE 6 tentpole): full-state
+capture/apply round trips (params + optimizer slots + LR counter +
+executor PRNG counter + reader position), atomic commit + checksum
+manifest, corruption fallback, async overlap (checkpoint/save monitor
+span, non-blocking save), and in-process exact-resume loss parity."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.parallel import checkpoint as ck
+from paddle_tpu.reader import checkpointable
+from paddle_tpu.scope import global_scope
+
+
+def _build(seed=7, lr_decay=False, dropout=0.0):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    x = fluid.layers.data("x", shape=[8])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=16, act="relu")
+    if dropout:
+        h = fluid.layers.dropout(h, dropout_prob=dropout)
+    pred = fluid.layers.fc(h, size=4, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    lr = fluid.layers.exponential_decay(1e-2, decay_steps=3,
+                                        decay_rate=0.7) if lr_decay \
+        else 1e-2
+    fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return loss
+
+
+def _batch(rng, n=4):
+    return {"x": rng.rand(n, 8).astype("float32"),
+            "label": rng.randint(0, 4, (n, 1)).astype("int64")}
+
+
+def _persist_snap(scope, program):
+    # copy=True: np.asarray of a CPU jax.Array is a zero-copy VIEW and
+    # a later step donates the buffer (the exact tear the snapshot
+    # itself guards against — see capture_train_state)
+    return {v.name: np.array(scope.var(v.name), copy=True)
+            for v in program.global_block().vars.values()
+            if v.persistable and scope.has_var(v.name)}
+
+
+def test_capture_covers_full_train_state(fresh_programs):
+    """The snapshot holds params AND optimizer slot vars AND the LR /
+    in-graph step-counter vars AND the executor PRNG counter — the
+    exact set whose silent reset the old params-only path caused."""
+    loss = _build(lr_decay=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    train_exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        train_exe.run(feed=_batch(rng), fetch_list=[loss])
+    ts = ck.capture_train_state(
+        3, program=fluid.default_main_program(),
+        executors={"train": train_exe})
+    names = set(ts.arrays)
+    assert any("moment" in n for n in names), names      # Adam slots
+    assert any("beta" in n for n in names), names        # Adam powers
+    # the LR schedule is an in-graph function of the persistable
+    # step-counter var — the counter IS the restorable LR state
+    assert any("LR_DECAY_COUNTER" in n for n in names), names
+    assert ts.host["executors"]["train"]["run_counter"] == 3
+    assert ts.step == 3
+
+
+def test_save_load_roundtrip_and_atomic_layout(tmp_path, fresh_programs):
+    loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(1)
+    exe.run(feed=_batch(rng), fetch_list=[loss])
+    ts = ck.capture_train_state(1, program=fluid.default_main_program())
+    d = str(tmp_path / "one")
+    ck.save_train_state(d, ts)
+    # artifact layout: arrays + host state + manifest, no tmp leftovers
+    assert sorted(os.listdir(d)) == ["MANIFEST.json", "arrays.npz",
+                                     "train_state.json"]
+    assert not [e for e in os.listdir(str(tmp_path))
+                if e.startswith(".tmp.")]
+    got = ck.load_train_state(d)
+    assert got.step == 1
+    assert set(got.arrays) == set(ts.arrays)
+    for n in ts.arrays:
+        np.testing.assert_array_equal(got.arrays[n], ts.arrays[n])
+        assert got.arrays[n].dtype == ts.arrays[n].dtype
+
+
+def test_nonnative_dtype_roundtrip(tmp_path):
+    """bfloat16 state (AMP master runs) survives the npz round trip via
+    the raw-view encoding (npy itself degrades it to void)."""
+    import ml_dtypes
+
+    a = np.arange(12, dtype=ml_dtypes.bfloat16).reshape(3, 4)
+    ts = ck.TrainState(0, {"w": a, "b": np.ones(3, "float32")}, {
+        "format": ck.TRAIN_STATE_FORMAT, "step": 0,
+        "executors": {}, "readers": {}, "extra": {}})
+    d = str(tmp_path / "bf16")
+    ck.save_train_state(d, ts)
+    got = ck.load_train_state(d)
+    assert got.arrays["w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        got.arrays["w"].astype(np.float32), a.astype(np.float32))
+    assert got.arrays["b"].dtype == np.float32
+
+
+def test_corrupt_artifact_detected_and_restore_falls_back(
+        tmp_path, fresh_programs):
+    """Acceptance: corrupt-latest -> restore falls back to the previous
+    step without crashing; missing manifest and torn tmp dirs are also
+    non-fatal."""
+    loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    mgr = ck.TrainStateCheckpointManager(str(tmp_path / "m"),
+                                         async_save=False)
+    exe.run(feed=_batch(rng), fetch_list=[loss])
+    mgr.save(1, program=fluid.default_main_program())
+    want = _persist_snap(global_scope(), fluid.default_main_program())
+    exe.run(feed=_batch(rng), fetch_list=[loss])
+    mgr.save(2, program=fluid.default_main_program())
+    assert mgr.all_steps() == [1, 2]
+
+    # garble the latest artifact's arrays payload
+    victim = os.path.join(str(tmp_path / "m"), "step_%010d" % 2,
+                          "arrays.npz")
+    with open(victim, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xde\xad\xbe\xef" * 4)
+    with pytest.raises(ck.CheckpointCorruptError):
+        ck.load_train_state(os.path.dirname(victim))
+
+    # a torn tmp dir (kill mid-save) must also be ignored
+    os.makedirs(os.path.join(str(tmp_path / "m"), ".tmp.step_junk.123"))
+    with pytest.warns(UserWarning, match="corrupt"):
+        step = mgr.restore(program=fluid.default_main_program())
+    assert step == 1
+    got = _persist_snap(global_scope(), fluid.default_main_program())
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+
+    # explicit step restore of the corrupt artifact DOES raise
+    with pytest.raises(ck.CheckpointCorruptError):
+        mgr.restore(program=fluid.default_main_program(), step=2)
+
+
+def test_restore_with_no_valid_checkpoint_returns_none(tmp_path,
+                                                       fresh_programs):
+    _build()
+    fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+    mgr = ck.TrainStateCheckpointManager(str(tmp_path / "empty"))
+    assert mgr.restore(program=fluid.default_main_program()) is None
+
+
+def test_strict_restore_rejects_model_mismatch(tmp_path, fresh_programs):
+    loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    ts = ck.capture_train_state(0, program=fluid.default_main_program())
+    del ts.arrays[sorted(ts.arrays)[0]]        # drop one var
+    with pytest.raises(ck.CheckpointCorruptError, match="lacks"):
+        ck.apply_train_state(ts, program=fluid.default_main_program())
+    # strict=False restores the intersection
+    ck.apply_train_state(ts, program=fluid.default_main_program(),
+                         strict=False)
+
+
+def test_rotation_and_interval_gating(tmp_path, fresh_programs):
+    _build()
+    fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    mgr = ck.TrainStateCheckpointManager(
+        str(tmp_path / "rot"), max_to_keep=2, save_interval_steps=3,
+        async_save=False)
+    saved = [s for s in range(1, 11) if mgr.save(s, program=prog)]
+    assert saved == [1, 4, 7, 10]              # gated on the interval
+    assert mgr.all_steps() == [7, 10]          # rotated to max_to_keep
+    assert mgr.latest_step() == 10
+    # a fresh manager over the same dir resumes the gating from disk
+    mgr2 = ck.TrainStateCheckpointManager(
+        str(tmp_path / "rot"), max_to_keep=2, save_interval_steps=3)
+    assert mgr2.save(11, program=prog) is False
+    assert mgr2.save(13, program=prog) is True
+    mgr2.close()
+
+
+def test_async_save_overlaps_and_publishes_span(tmp_path, monkeypatch,
+                                                fresh_programs):
+    """Acceptance: the write runs in the background (save() returns
+    before a deliberately slowed write lands) and shows up as a
+    checkpoint/save monitor span — overlap, not step time."""
+    _build()
+    fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+
+    real = ck.save_train_state
+    delay = 0.5
+
+    def slow_save(dirname, ts):
+        time.sleep(delay)
+        return real(dirname, ts)
+
+    monkeypatch.setattr(ck, "save_train_state", slow_save)
+    monitor.registry().reset()
+    monitor.enable()
+    try:
+        mgr = ck.TrainStateCheckpointManager(str(tmp_path / "a"),
+                                             async_save=True)
+        t0 = time.perf_counter()
+        assert mgr.save(1, program=prog)
+        returned_in = time.perf_counter() - t0
+        assert returned_in < delay / 2, (
+            "async save blocked the caller for %.3fs" % returned_in)
+        assert threading.active_count() >= 2
+        mgr.wait_until_finished()
+        assert (time.perf_counter() - t0) >= delay
+        assert mgr.all_steps() == [1]
+        text = monitor.expose_text()     # names sanitized for Prometheus
+        assert "span_checkpoint_save" in text
+        assert "span_checkpoint_snapshot" in text
+        assert "mark_checkpoint_saved" in text
+    finally:
+        monitor.disable()
+        monitor.registry().reset()
+
+
+def test_async_write_failure_surfaces_on_next_call(tmp_path, monkeypatch,
+                                                   fresh_programs):
+    _build()
+    fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+
+    def boom(dirname, ts):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ck, "save_train_state", boom)
+    mgr = ck.TrainStateCheckpointManager(str(tmp_path / "f"),
+                                         async_save=True)
+    assert mgr.save(1, program=prog)
+    with pytest.raises(RuntimeError, match="checkpoint write failed"):
+        mgr.wait_until_finished()
+
+
+def test_checkpointable_reader_position_roundtrip():
+    src = lambda: iter(range(10))
+    r = checkpointable(src)
+    it = r()
+    assert [next(it) for _ in range(4)] == [0, 1, 2, 3]
+    st = r.state_dict()
+    assert st == {"epoch": 0, "offset": 4}
+
+    r2 = checkpointable(src)
+    r2.load_state_dict(st)
+    assert list(r2()) == [4, 5, 6, 7, 8, 9]    # fast-forwarded
+    assert r2.state_dict() == {"epoch": 1, "offset": 0}
+    assert list(r2())[:3] == [0, 1, 2]         # next epoch from the top
+
+    with pytest.raises(TypeError, match="CREATOR"):
+        checkpointable([1, 2, 3])
+
+
+def test_exact_resume_loss_parity_in_process(tmp_path, fresh_programs):
+    """The tentpole guarantee, in-process: train 10 steps straight vs
+    train 6 / checkpoint / rebuild everything / restore / train 4 —
+    the two loss trajectories are BIT-identical (dropout + LR decay +
+    Adam slots + reader position all restored)."""
+
+    def data_reader():
+        rng = np.random.RandomState(42)
+        for _ in range(64):
+            yield _batch(rng)
+
+    def run(steps, reader, mgr=None, resume=False, save_at=None):
+        # each leg builds the net under its own name guard so the
+        # persistable var names line up across save/restore legs
+        with fluid.unique_name.guard(), \
+                fluid.program_guard(fluid.Program(), fluid.Program()), \
+                fluid.scope_guard(fluid.Scope()):
+            losses = []
+            loss = _build(lr_decay=True, dropout=0.3)
+            fluid.Executor(fluid.CPUPlace()).run(
+                fluid.default_startup_program())
+            exe = fluid.Executor(fluid.CPUPlace())
+            step = 0
+            if resume:
+                step = mgr.restore(program=fluid.default_main_program(),
+                                   executors={"train": exe},
+                                   readers={"train": reader}) or 0
+            it = iter(reader())
+            while step < steps:
+                (lv,) = exe.run(feed=next(it), fetch_list=[loss])
+                step += 1
+                losses.append(np.asarray(lv).tobytes())
+                if save_at == step:
+                    mgr.save_now(step,
+                                 program=fluid.default_main_program(),
+                                 executors={"train": exe},
+                                 readers={"train": reader})
+            return step, losses
+
+    # uninterrupted reference
+    _, ref = run(10, checkpointable(data_reader))
+
+    # interrupted at step 6, then resumed in a fresh world
+    mgr = ck.TrainStateCheckpointManager(str(tmp_path / "e"),
+                                         async_save=False)
+    _, first = run(6, checkpointable(data_reader), mgr=mgr, save_at=6)
+    _, rest = run(10, checkpointable(data_reader), mgr=mgr, resume=True)
+    assert first + rest == ref
+
+
+def test_strict_executor_name_mismatch_leaves_scope_untouched(
+        tmp_path, fresh_programs):
+    """A checkpoint rejected for an executor-name mismatch must not
+    half-apply: the scope keeps its pre-restore values (review fix)."""
+    loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    train_exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    train_exe.run(feed=_batch(rng), fetch_list=[loss])
+    ts = ck.capture_train_state(1, program=prog,
+                                executors={"train": train_exe})
+    train_exe.run(feed=_batch(rng), fetch_list=[loss])
+    after_step2 = _persist_snap(global_scope(), prog)
+    with pytest.raises(ck.CheckpointCorruptError, match="executor"):
+        ck.apply_train_state(ts, program=prog,
+                             executors={"other_name": train_exe})
+    now = _persist_snap(global_scope(), prog)
+    for k in after_step2:       # scope still holds the step-2 state
+        np.testing.assert_array_equal(now[k], after_step2[k])
+
+
+def test_same_step_resave_and_save_now_noop(tmp_path, fresh_programs):
+    """Re-saving an existing step keeps a valid artifact (rename-aside
+    commit), and save_now of an already-committed step is a no-op
+    rather than a redundant rewrite (review fixes)."""
+    _build()
+    fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    mgr = ck.TrainStateCheckpointManager(str(tmp_path / "rs"),
+                                        async_save=False)
+    assert mgr.save(1, program=prog)
+    first_mtime = os.path.getmtime(
+        os.path.join(str(tmp_path / "rs"), "step_%010d" % 1,
+                     "MANIFEST.json"))
+    # flush of the same committed step: no rewrite
+    assert mgr.save_now(1, program=prog)
+    assert os.path.getmtime(
+        os.path.join(str(tmp_path / "rs"), "step_%010d" % 1,
+                     "MANIFEST.json")) == first_mtime
+    # an explicit re-save of the same step (fresh manager, same dir)
+    # overwrites through the rename-aside path and stays valid
+    mgr2 = ck.TrainStateCheckpointManager(str(tmp_path / "rs"),
+                                         async_save=False,
+                                         save_interval_steps=1)
+    ts = ck.capture_train_state(1, program=prog)
+    ck.save_train_state(os.path.join(str(tmp_path / "rs"),
+                                     "step_%010d" % 1), ts)
+    got = ck.load_train_state(os.path.join(str(tmp_path / "rs"),
+                                           "step_%010d" % 1))
+    assert got.step == 1 and mgr2.latest_step() == 1
+
+
+def test_restore_reseeds_save_cadence_past_corrupt_latest(
+        tmp_path, fresh_programs):
+    """After falling back past a corrupt latest artifact, the save
+    cadence restarts from the RESTORED step, so the skipped index is
+    re-saved (overwriting the corrupt dir) instead of warned forever
+    (review fix)."""
+    loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    prog = fluid.default_main_program()
+    rng = np.random.RandomState(4)
+    mgr = ck.TrainStateCheckpointManager(str(tmp_path / "c"),
+                                        async_save=False,
+                                        save_interval_steps=5)
+    exe.run(feed=_batch(rng), fetch_list=[loss])
+    mgr.save(1, program=prog)
+    for _ in range(5):
+        exe.run(feed=_batch(rng), fetch_list=[loss])
+    mgr.save(6, program=prog)
+    # corrupt step 6, restore -> 1, next save must land at 6 again
+    victim = os.path.join(str(tmp_path / "c"), "step_%010d" % 6,
+                          "arrays.npz")
+    with open(victim, "r+b") as f:
+        f.seek(8)
+        f.write(b"\x00" * 32)
+    mgr2 = ck.TrainStateCheckpointManager(str(tmp_path / "c"),
+                                         async_save=False,
+                                         save_interval_steps=5)
+    with pytest.warns(UserWarning, match="corrupt"):
+        assert mgr2.restore(program=prog) == 1
+    assert mgr2.save(3, program=prog) is False      # 3 < 1 + 5
+    assert mgr2.save(6, program=prog) is True       # overwrites corrupt 6
+    assert ck.load_train_state(os.path.dirname(victim)).step == 6
+
+
+def test_restore_surfaces_model_mismatch_instead_of_fresh_start(
+        tmp_path, fresh_programs):
+    """A structural misfit (model changed) must RAISE from restore(),
+    not be skipped as 'corrupt' all the way down to a silent fresh
+    start (review fix: CheckpointMismatchError stops the fallback)."""
+    _build()
+    fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+    mgr = ck.TrainStateCheckpointManager(str(tmp_path / "mm"),
+                                        async_save=False)
+    mgr.save(1, program=fluid.default_main_program())
+
+    with fluid.unique_name.guard(), \
+            fluid.program_guard(fluid.Program(), fluid.Program()), \
+            fluid.scope_guard(fluid.Scope()):
+        # a DIFFERENT model over the same checkpoint dir
+        x = fluid.layers.data("x", shape=[8])
+        h = fluid.layers.fc(x, size=32, act="relu")   # extra layer
+        pred = fluid.layers.fc(h, size=2)
+        fluid.optimizer.SGD(0.1).minimize(
+            fluid.layers.mean(fluid.layers.square(pred)))
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        mgr2 = ck.TrainStateCheckpointManager(str(tmp_path / "mm"))
+        with pytest.raises(ck.CheckpointMismatchError, match="model"):
+            mgr2.restore(program=fluid.default_main_program())

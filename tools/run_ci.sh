@@ -5,23 +5,25 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/5 full test suite =="
-python -m pytest tests/ -q
+echo "== 1/6 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
+python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/5 API signature gate =="
+echo "== 2/6 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/5 8-device virtual-mesh dryrun =="
+echo "== 3/6 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/5 bench smoke (CPU backend, tiny) =="
+echo "== 4/6 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/5 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/6 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
-trap 'rm -rf "$OBS_DIR"' EXIT
+SMOKE_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$OBS_DIR" <<'PY'
 import sys
 import numpy as np
@@ -44,5 +46,73 @@ monitor.disable()
 PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
+
+echo "== 6/6 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+cat > "$SMOKE_DIR/smoke.py" <<'PY'
+import os, signal, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.getcwd())          # run_ci runs from the repo root
+mode, ckpt = sys.argv[1], sys.argv[2]
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.contrib import Trainer, CheckpointConfig
+from paddle_tpu.reader import checkpointable
+
+monitor.enable(log_dir=os.path.join(os.path.dirname(ckpt), "monitor"))
+
+def train_func():
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.layers.data("x", shape=[8])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=16, act="relu")
+    pred = fluid.layers.fc(h, size=4, act="softmax")
+    return fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+
+def samples():
+    rng = np.random.RandomState(0)
+    for _ in range(24):
+        x = rng.rand(8).astype("float32")
+        yield x, np.array([int(np.argmax(x[:4]))], "int64")
+
+cfg = CheckpointConfig(checkpoint_dir=ckpt, step_interval=1)
+trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                  optimizer_func=lambda: fluid.optimizer.Adam(1e-2),
+                  checkpoint_config=cfg)
+if mode == "resume":
+    print("RESUMED", cfg.load_serial, flush=True)
+    assert cfg.load_serial == 3, cfg.load_serial
+state = {"step": cfg.load_serial or 0}
+
+def handler(event):
+    if not hasattr(event, "metrics"):
+        return
+    state["step"] += 1
+    print("STEP %d %r" % (state["step"],
+                          float(np.ravel(event.metrics[0])[0])),
+          flush=True)
+    if mode == "run" and state["step"] == 3:
+        os.kill(os.getpid(), signal.SIGTERM)   # preemption notice
+
+trainer.train(num_epochs=1, event_handler=handler,
+              reader=checkpointable(fluid.batch(samples, batch_size=4)),
+              feed_order=["x", "label"])
+PY
+JAX_PLATFORMS=cpu python "$SMOKE_DIR/smoke.py" ref "$SMOKE_DIR/ref_ckpt" \
+  > "$SMOKE_DIR/ref.out"
+set +e
+JAX_PLATFORMS=cpu python "$SMOKE_DIR/smoke.py" run "$SMOKE_DIR/ckpt" \
+  > "$SMOKE_DIR/run.out"
+rc=$?
+set -e
+test "$rc" -eq 143  # the flush ran, then SIGTERM's default proceeded
+JAX_PLATFORMS=cpu python "$SMOKE_DIR/smoke.py" resume "$SMOKE_DIR/ckpt" \
+  > "$SMOKE_DIR/resume.out"
+grep -q "^RESUMED 3$" "$SMOKE_DIR/resume.out"
+# resumed steps 4-6 must reproduce the uninterrupted run's losses exactly
+diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
+     <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
+grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
 echo "CI OK"

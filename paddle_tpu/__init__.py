@@ -7,7 +7,22 @@ ParallelExecutor (mesh runtime), io save/load, Trainer.  The implementation
 is JAX/XLA/Pallas/pjit from the ground up.
 """
 
-from . import core, unique_name
+import jax as _jax
+
+# Sharding-invariant in-graph PRNG.  With the legacy (non-partitionable)
+# threefry lowering, jax.random bits generated INSIDE a computation that
+# GSPMD partitions over a multi-axis mesh depend on the mesh shape: the
+# same program/seed produced different dropout masks on a (2, 4) mesh
+# than on one device or a 1-D dp mesh (reproduced at the raw-jax level;
+# this was the long-standing sp/pp transformer loss-parity drift in
+# tests/test_program_sp_pp.py).  The partitionable implementation makes
+# random values a pure function of (key, shape) regardless of sharding —
+# required for the mesh executor's single-device loss-parity contract.
+# It is a different (still seed-deterministic) stream than the legacy
+# one; nothing in this framework pins exact values across streams.
+_jax.config.update("jax_threefry_partitionable", True)
+
+from . import core, unique_name  # noqa: E402
 from .framework import (
     Program,
     Block,

@@ -427,6 +427,7 @@ def sample_device_gauges(devices):
     if not _enabled:
         return
     _refresh_handle_caches()
+    fresh = {}
     for d in devices:
         key = "%s%s" % (getattr(d, "platform", "dev"), getattr(d, "id", 0))
         h = _dev_metrics.get(key)
@@ -436,13 +437,32 @@ def sample_device_gauges(devices):
                 "steps": _registry.counter(base + "/steps_total"),
                 "in_use": _registry.gauge(base + "/bytes_in_use"),
                 "limit": _registry.gauge(base + "/bytes_limit"),
+                "peak": _registry.gauge(base + "/bytes_in_use_peak"),
+                "_peak": 0,
+                "_calls": 0,
             }
         h["steps"].inc()
+        h["_calls"] += 1
         ms = _device_state(d)
         if ms.get("bytes_in_use") is not None:
             h["in_use"].set(ms["bytes_in_use"])
+            # running per-device peak: tools/program_report.py's
+            # min/max-across-mesh column reads these (live or replayed)
+            if ms["bytes_in_use"] > h["_peak"]:
+                h["_peak"] = ms["bytes_in_use"]
+                h["peak"].set(h["_peak"])
+            if h["_calls"] % _DEVICE_SAMPLE_EVERY == 1:
+                fresh[key] = {"bytes_in_use": ms["bytes_in_use"],
+                              "bytes_limit": ms.get("bytes_limit"),
+                              "bytes_in_use_peak": h["_peak"]}
         if ms.get("bytes_limit") is not None:
             h["limit"].set(ms["bytes_limit"])
+    # JSONL twin of the gauges, on the same decimated cadence (the
+    # _device_state sample cache refreshes every Nth step): offline
+    # program_report replays these into the per-device HBM columns
+    if fresh:
+        log_event({"event": "device_stats", "ts": time.time(),
+                   "run_id": _RUN_ID, "devices": fresh})
 
 
 def _prefetch_state():

@@ -53,14 +53,18 @@ __all__ = [
 DEFAULT_PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 
 _mu = threading.Lock()
-# (fingerprint, feed_sig, fetch_names, trace_flags, kind) ->
+# (fingerprint, feed_sig, fetch_names, trace_flags, kind, partition) ->
 # ProgramProfile: different fetch sets — and different trace-time flag
 # choices (kernel selection etc., mirroring compile_cache.trace_key) —
 # lower the same program+feeds to different XLA modules with different
-# flops/bytes, so both are part of the identity
+# flops/bytes, so both are part of the identity.  ``partition`` is the
+# executor's mesh/sharding identity: the same program compiled
+# replicated and fsdp-sharded has per-device argument/peak-HBM bytes
+# differing by ~N, and the two must not clobber each other's slot
+# (the replicated-vs-fsdp A/B rung is exactly this pattern).
 _profiles = {}
 _acct = {}          # fingerprint -> {steps, wall_s, examples, kind}
-_warned = set()     # (fingerprint, feed_sig) preflight warnings issued
+_warned = set()     # (fingerprint, feed_sig, partition) preflight warns issued
 
 
 class PreflightOOMError(RuntimeError):
@@ -76,16 +80,18 @@ class ProgramProfile:
                  "cost", "flops",
                  "bytes_accessed", "argument_bytes", "output_bytes",
                  "temp_bytes", "generated_code_bytes", "alias_bytes",
-                 "peak_hbm_bytes", "device")
+                 "peak_hbm_bytes", "device", "partition")
 
     def __init__(self, fingerprint, feed_sig, kind, cost=None, flops=0.0,
                  bytes_accessed=0.0, argument_bytes=0, output_bytes=0,
                  temp_bytes=0, generated_code_bytes=0, alias_bytes=0,
-                 peak_hbm_bytes=0, device=None, fetch_names=()):
+                 peak_hbm_bytes=0, device=None, fetch_names=(),
+                 partition=None):
         self.fingerprint = fingerprint
         self.feed_sig = tuple(feed_sig)
         self.fetch_names = tuple(fetch_names)
         self.kind = kind
+        self.partition = partition
         self.ts = time.time()
         self.cost = dict(cost or {})
         self.flops = float(flops)
@@ -114,7 +120,8 @@ class ProgramProfile:
              "fetch_names": list(self.fetch_names),
              "flops": self.flops,
              "bytes_accessed": self.bytes_accessed,
-             "device": self.device}
+             "device": self.device,
+             "partition": str(self.partition) if self.partition else None}
         d.update(self.breakdown())
         return d
 
@@ -154,7 +161,7 @@ def capture_enabled():
 
 
 def capture(fingerprint, feed_sig, jit_fn, args, device=None,
-            kind="executor", fetch_names=()):
+            kind="executor", fetch_names=(), partition=None):
     """AOT-compile the step this (jitted fn, concrete args) maps to,
     profile it, and run the HBM preflight — called by the executors at
     the cold dispatch, *before* the step executes.  The returned
@@ -178,14 +185,15 @@ def capture(fingerprint, feed_sig, jit_fn, args, device=None,
     except Exception:  # noqa: BLE001 — observability must not break steps
         return None
     prof = store_compiled(fingerprint, feed_sig, compiled, device=device,
-                          kind=kind, fetch_names=fetch_names)
+                          kind=kind, fetch_names=fetch_names,
+                          partition=partition)
     if prof is not None:
         _preflight(prof, device)
     return compiled
 
 
 def store_compiled(fingerprint, feed_sig, compiled, device=None,
-                   kind="executor", fetch_names=()):
+                   kind="executor", fetch_names=(), partition=None):
     """Extract cost/memory analyses from a ``jax.stages.Compiled`` and
     store the profile (shared by :func:`capture` and the explicit
     ``Executor.cost_analysis`` fallback path).  No preflight here."""
@@ -225,10 +233,10 @@ def store_compiled(fingerprint, feed_sig, compiled, device=None,
         alias_bytes=mem.get("alias", 0),
         peak_hbm_bytes=max(0, peak),
         device=str(getattr(device, "platform", device) or "") or None,
-        fetch_names=fetch_names)
+        fetch_names=fetch_names, partition=partition)
     with _mu:
         _profiles[(fingerprint, prof.feed_sig, prof.fetch_names,
-                   _trace_flags(), kind)] = prof
+                   _trace_flags(), kind, partition)] = prof
     from . import log_event
 
     log_event(dict(prof.as_dict(), event="program_profile", ts=prof.ts))
@@ -299,7 +307,7 @@ def _preflight(prof, device):
                    "breakdown": prof.breakdown()})
     if mode == "strict":
         raise PreflightOOMError(msg)
-    key = (prof.fingerprint, prof.feed_sig)
+    key = (prof.fingerprint, prof.feed_sig, prof.partition)
     with _mu:
         if key in _warned:
             return
@@ -320,19 +328,20 @@ def _trace_flags():
     return compile_cache.trace_flag_values()
 
 
-def get(fingerprint, feed_sig=None, kind="executor", fetch_names=()):
+def get(fingerprint, feed_sig=None, kind="executor", fetch_names=(),
+        partition=None):
     """Profile for (fingerprint, feed_sig, fetch_names, current trace
-    flags, kind); with ``feed_sig=None`` the most recently captured
-    profile for the fingerprint regardless of signature/fetch set/
-    flags/kind."""
+    flags, kind, partition); with ``feed_sig=None`` the most recently
+    captured profile for the fingerprint regardless of signature/fetch
+    set/flags/kind/partition."""
     with _mu:
         if feed_sig is not None:
             return _profiles.get((fingerprint, tuple(feed_sig),
                                   tuple(fetch_names), _trace_flags(),
-                                  kind))
+                                  kind, partition))
         best = None
-        for (fp, _sig, _fetch, _flags, _k), p in _profiles.items():
-            if fp == fingerprint and (best is None or p.ts >= best.ts):
+        for key, p in _profiles.items():
+            if key[0] == fingerprint and (best is None or p.ts >= best.ts):
                 best = p
         return best
 

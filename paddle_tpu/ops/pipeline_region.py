@@ -258,6 +258,19 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
         mask = (s_idx == s_count - 1).astype(outs.dtype)
         return lax.psum(outs * mask, AXIS_PP)
 
+    # GSPMD workaround (jax 0.4.37, reproduced in isolation): a
+    # concatenate/stack computed INSIDE jit and fed straight into a
+    # shard_map whose in_spec shards it over the second axis of a
+    # multi-axis mesh comes back scaled by the OTHER axis's size — the
+    # partitioner lays the stack out sharded and the shard_map input
+    # conversion sums shards instead of gathering them (echoing the
+    # stacked value through an identity shard_map multiplies it by dp).
+    # Pinning the stacked params to a replicated layout before the
+    # shard_map sidesteps the bad partition; they were replicated as
+    # separate state vars anyway, so this adds no memory.
+    from jax.sharding import NamedSharding
+    rep = NamedSharding(mesh, P())
+    stacked = [jax.lax.with_sharding_constraint(p, rep) for p in stacked]
     fn = shard_map_norep(
         body, mesh,
         in_specs=([P(AXIS_PP)] * len(stacked), mb_spec,

@@ -9,6 +9,7 @@ collectives the reference hand-schedules through NCCL.
 """
 
 from .mesh import make_mesh  # noqa: F401
+from .spec_layout import SpecLayout  # noqa: F401
 from .strategy import BuildStrategy, ExecutionStrategy  # noqa: F401
 from .parallel_executor import ParallelExecutor  # noqa: F401
 from .embedding import distributed_embedding_sharding_fn  # noqa: F401

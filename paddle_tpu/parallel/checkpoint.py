@@ -267,6 +267,19 @@ def _named(objs, what):
     return {what + "0": objs}
 
 
+def _gather_host(v):
+    """One state value as a FULL host numpy array, copied out of any
+    device buffer.  Fully-addressable jax Arrays (single-host meshes —
+    sharded or not) gather through ``np.array``; multi-host global
+    arrays all-gather across processes first (every process then writes
+    an identical, complete artifact — restorable anywhere)."""
+    if isinstance(v, jax.Array) and not v.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+    return np.array(v, copy=True)
+
+
 class TrainState:
     """One atomic snapshot of a training run at a step boundary:
     ``arrays`` (host numpy: params, optimizer slots, LR, in-graph step
@@ -300,13 +313,17 @@ def capture_train_state(step, scope=None, program=None, executors=None,
         scope = scope or global_scope()
         state = _persistable_state(scope, program)
         _require_state(state, "snapshot")
-        # np.array(copy=True), NOT np.asarray: on the CPU backend
-        # np.asarray(jax.Array) is a ZERO-COPY view of the device
-        # buffer, and the next dispatched step DONATES that buffer —
-        # XLA reuses the memory while the background writer serializes
-        # it, tearing the snapshot (found by the kill-at-step drill:
-        # warm-cache runs dispatch fast enough to hit the window)
-        arrays = {n: np.array(v, copy=True) for n, v in state.items()}
+        # _gather_host: np.array(copy=True), NOT np.asarray — on the CPU
+        # backend np.asarray(jax.Array) is a ZERO-COPY view of the
+        # device buffer, and the next dispatched step DONATES that
+        # buffer — XLA reuses the memory while the background writer
+        # serializes it, tearing the snapshot (found by the kill-at-step
+        # drill: warm-cache runs dispatch fast enough to hit the
+        # window).  Mesh-sharded state (fsdp/tp params under
+        # sharding_rules) gathers to the FULL logical array, so the
+        # artifact is topology-free: restore re-shards onto whatever
+        # mesh (or single device) the resuming process runs.
+        arrays = {n: _gather_host(v) for n, v in state.items()}
         host = {
             "format": TRAIN_STATE_FORMAT,
             "step": int(step),

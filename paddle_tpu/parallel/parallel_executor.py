@@ -40,15 +40,22 @@ from ..monitor import program_profile
 from ..profiler import RecordEvent, is_profiling
 from ..framework import Variable, default_main_program
 from ..scope import global_scope
-from .mesh import make_mesh, AXIS_DP
+from .mesh import make_mesh, AXIS_DP, AXIS_FSDP
+from .spec_layout import SpecLayout
 from .strategy import BuildStrategy, ExecutionStrategy
 
 __all__ = ["ParallelExecutor"]
 
+# sharding_rules=True resolves to this shared table (SpecLayout hashes
+# by value, so a per-call instance would also cache correctly — one
+# object just keeps the intent obvious)
+_DEFAULT_SPEC_LAYOUT = SpecLayout()
+
 
 class _Compiled:
     def __init__(self, fn, feed_names, state_in, state_out, fetch_names,
-                 feed_shardings, state_shardings, out_state_shardings):
+                 feed_shardings, state_shardings, out_state_shardings,
+                 partition_key=None):
         self.fn = fn
         self.feed_names = feed_names
         self.state_in = state_in
@@ -57,6 +64,10 @@ class _Compiled:
         self.feed_shardings = feed_shardings
         self.state_shardings = state_shardings
         self.out_state_shardings = out_state_shardings
+        # mesh/sharding identity for the program-profile registry: the
+        # same program compiled replicated vs fsdp-sharded has ~N-times
+        # different per-device memory analyses — separate profile slots
+        self.partition_key = partition_key
         self.warm = False      # first dispatch = trace+compile (see Executor)
         # AOT-captured executable (one per entry: the trace-cache key
         # already pins the feed signature + mesh); set by profile
@@ -70,8 +81,11 @@ class ParallelExecutor:
                  build_strategy=None, num_trainers=1, trainer_id=0,
                  scope=None, mesh=None):
         self._mesh = mesh if mesh is not None else make_mesh()
-        if AXIS_DP not in self._mesh.axis_names:
-            raise ValueError("mesh must have a %r axis" % AXIS_DP)
+        if AXIS_DP not in self._mesh.axis_names and \
+                AXIS_FSDP not in self._mesh.axis_names:
+            raise ValueError(
+                "mesh must have a data axis (%r or %r)"
+                % (AXIS_DP, AXIS_FSDP))
         self._program = main_program
         self._scope = scope
         self._build_strategy = build_strategy or BuildStrategy()
@@ -99,8 +113,32 @@ class ParallelExecutor:
         return self._scope if self._scope is not None else global_scope()
 
     def _dp_size(self):
-        idx = self._mesh.axis_names.index(AXIS_DP)
-        return self._mesh.devices.shape[idx]
+        """Total batch-sharding extent: dp x fsdp.  Both axes shard the
+        batch (fsdp is a data-parallel axis for activations; it
+        additionally ZeRO-shards params/optimizer state — spec_layout)."""
+        return self._axis_size(AXIS_DP) * self._axis_size(AXIS_FSDP)
+
+    def _data_axes(self):
+        """The mesh axes the batch dim shards over, in (dp, fsdp) order.
+        When both are size 1 (or absent), fall back to whichever data
+        axis the mesh actually HAS — naming an absent axis in a spec is
+        a jax error even at size 1."""
+        axes = tuple(a for a in (AXIS_DP, AXIS_FSDP)
+                     if self._axis_size(a) > 1)
+        if axes:
+            return axes
+        return (AXIS_DP,) if AXIS_DP in self._mesh.axis_names \
+            else (AXIS_FSDP,)
+
+    def _zero_axis(self):
+        """The axis ZeRO-style state sharding targets: ``fsdp`` when the
+        mesh has a populated one (the kReduce strategy generalized off
+        pure-dp), else ``dp`` (the original kReduce behavior) — always
+        an axis the mesh actually has."""
+        if self._axis_size(AXIS_FSDP) > 1 or \
+                AXIS_DP not in self._mesh.axis_names:
+            return AXIS_FSDP
+        return AXIS_DP
 
     # ------------------------------------------------------------------
     def _axis_size(self, axis):
@@ -130,8 +168,21 @@ class ParallelExecutor:
                 return False
         return True
 
-    def _state_spec(self, name, val):
-        """Sharding spec for a persistable state array."""
+    def _sharding_layout(self):
+        """The BuildStrategy's sharding_rules normalized to a SpecLayout
+        (``True`` selects the shared default table — the user's strategy
+        object is read, never mutated), or None."""
+        rules = self._build_strategy.sharding_rules
+        if rules is True:
+            return _DEFAULT_SPEC_LAYOUT
+        return rules
+
+    def _state_spec(self, name, val, rule_specs):
+        """Sharding spec for a persistable state array.  Precedence:
+        the param_sharding_fn hook (when it returns a spec), then the
+        resolved sharding_rules table, then the reduce-strategy
+        fallback (ZeRO dim-0 over the fsdp/dp axis under kReduce,
+        replicate under kAllReduce)."""
         custom = self._build_strategy.param_sharding_fn
         if custom is not None:
             spec = custom(name, tuple(getattr(val, "shape", ())))
@@ -144,15 +195,24 @@ class ParallelExecutor:
                            dict(zip(self._mesh.axis_names,
                                     self._mesh.devices.shape))))
                 return spec
+        rule = rule_specs.get(name)
+        if rule is not None and rule != P():
+            return rule
+        # a rules resolution that degraded all the way to "replicate"
+        # (e.g. sharding_rules on a mesh with no populated fsdp/tp axis)
+        # falls THROUGH to the reduce-strategy tier, so kReduce ZeRO
+        # sharding on a pure-dp mesh survives enabling the table; use
+        # param_sharding_fn to force-replicate a var against kReduce.
         strat = self._build_strategy.reduce_strategy
         if strat == BuildStrategy.ReduceStrategy.Reduce:
-            # ZeRO-style: shard dim 0 over dp when it divides evenly.
-            # Read shape only — np.asarray here would download every param
-            # from device HBM at compile time.
+            # ZeRO-style: shard dim 0 over the zero axis when it divides
+            # evenly.  Read shape only — np.asarray here would download
+            # every param from device HBM at compile time.
             shape = tuple(getattr(val, "shape", ()))
+            ax = self._zero_axis()
             if len(shape) >= 1 and shape[0] > 0 \
-                    and shape[0] % self._dp_size() == 0:
-                return P(AXIS_DP)
+                    and shape[0] % self._axis_size(ax) == 0:
+                return P(ax)
         return P()
 
     def _compile(self, program, feed_names, fetch_names, scope, feed_vals,
@@ -174,7 +234,8 @@ class ParallelExecutor:
         tkey = compile_cache.trace_key(
             program, feed_sig, state_sig, fetch_names,
             "pjit", mesh_key, bs.reduce_strategy, bs.param_sharding_fn,
-            bs.feed_sharding_fn, bs.sequence_parallel, bs.remat,
+            bs.feed_sharding_fn, self._sharding_layout(),
+            bs.sequence_parallel, bs.remat,
             bs.donate_state, jax.process_count(),
             compile_cache.trace_flag_values())
         cached = compile_cache.lookup(tkey)
@@ -188,7 +249,8 @@ class ParallelExecutor:
                 sequence_parallel=self._build_strategy.sequence_parallel)
 
         mesh = self._mesh
-        batch_spec = P(AXIS_DP)
+        data_axes = self._data_axes()
+        batch_spec = P(data_axes if len(data_axes) > 1 else data_axes[0])
         feed_shardings = []
         dp = self._dp_size()
         # multi-host: each process feeds its local slice, so the local
@@ -212,13 +274,22 @@ class ParallelExecutor:
                 feed_shardings.append(NamedSharding(mesh, batch_spec))
             else:
                 raise ValueError(
-                    "feed %r batch dim %s is not divisible by the dp mesh "
-                    "size %d" % (n, arr.shape[:1], dp)
+                    "feed %r batch dim %s is not divisible by the "
+                    "data-parallel mesh extent %d (dp x fsdp)"
+                    % (n, arr.shape[:1], dp)
                 )
 
         state_vals = [scope.var(n) for n in state_in]
+        layout = self._sharding_layout()
+        rule_specs = {}
+        if layout is not None:
+            rule_specs = layout.resolve(
+                program, mesh,
+                [(n, tuple(getattr(v, "shape", ())))
+                 for n, v in zip(state_in, state_vals)])
         spec_by_name = {
-            n: self._state_spec(n, v) for n, v in zip(state_in, state_vals)
+            n: self._state_spec(n, v, rule_specs)
+            for n, v in zip(state_in, state_vals)
         }
         state_shardings = [
             NamedSharding(mesh, spec_by_name[n]) for n in state_in
@@ -245,10 +316,13 @@ class ParallelExecutor:
             out_shardings=(fetch_shardings, out_state_shardings),
             donate_argnums=donate,
         )
+        partition_key = (mesh_key[0], mesh_key[1], tuple(
+            (n, str(spec_by_name[n])) for n in state_in
+            if spec_by_name[n] != P()))
         return compile_cache.store(tkey, _Compiled(
             jitted, feed_names, state_in, state_out,
             fetch_names, feed_shardings, state_shardings,
-            out_state_shardings))
+            out_state_shardings, partition_key=partition_key))
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -344,7 +418,8 @@ class ParallelExecutor:
                compile_cache.trace_flag_values(),
                self._build_strategy.reduce_strategy,
                self._build_strategy.param_sharding_fn,
-               self._build_strategy.feed_sharding_fn)
+               self._build_strategy.feed_sharding_fn,
+               self._sharding_layout())
         compiled = self._cache.get(key)
         if compiled is None:
             with RecordEvent("parallel_executor/compile"):
@@ -407,7 +482,8 @@ class ParallelExecutor:
                         feed_sig, compiled.fn, (feed_dev, state_dev, rng),
                         device=self._mesh.devices.flat[0],
                         kind="parallel_executor",
-                        fetch_names=tuple(fetch_names))
+                        fetch_names=tuple(fetch_names),
+                        partition=compiled.partition_key)
                 fn = compiled.aot_exec \
                     if compiled.aot_exec is not None \
                     and not flags.flag("debug_nans") else compiled.fn
@@ -484,6 +560,31 @@ class ParallelExecutor:
         """Retire every in-flight async-dispatched step (see
         ``Executor.sync``)."""
         self._dispatch_queue.drain()
+
+    def state_shardings(self, program=None, scope=None):
+        """``{name: NamedSharding}`` for every persistable var of
+        ``program`` as this executor's policy would place it on its mesh
+        — the ``shardings=`` argument for TrainState/orbax restores, so
+        a checkpoint written on any topology lands directly sharded on
+        this one instead of replicating through host memory first."""
+        from jax.sharding import NamedSharding as NS
+
+        from ..framework import default_main_program
+        from .checkpoint import _persistable_state
+
+        program = program if program is not None else (
+            self._program or default_main_program())
+        scope = scope if scope is not None else self._actual_scope()
+        state = _persistable_state(scope, program)
+        layout = self._sharding_layout()
+        rule_specs = {}
+        if layout is not None:
+            rule_specs = layout.resolve(
+                program, self._mesh,
+                [(n, tuple(getattr(v, "shape", ())))
+                 for n, v in state.items()])
+        return {n: NS(self._mesh, self._state_spec(n, v, rule_specs))
+                for n, v in state.items()}
 
     def state_dict(self):
         """Exact-resume host state (see ``Executor.state_dict``): the

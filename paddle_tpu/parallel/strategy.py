@@ -6,9 +6,14 @@ devices (kAllReduce: replicate optimizer everywhere; kReduce: shard the
 optimizer work per device, then broadcast params).  The TPU translation:
 
 * kAllReduce -> params/opt-state replicated on the mesh; XLA psums grads.
-* kReduce    -> params/opt-state sharded over the dp axis (ZeRO-style);
-  XLA reduce-scatters grads and all-gathers params, which is exactly the
-  reduce+broadcast pair the reference schedules by hand.
+* kReduce    -> params/opt-state dim-0 sharded over the mesh's ``fsdp``
+  axis when it has one, else ``dp`` (ZeRO-style); XLA reduce-scatters
+  grads and all-gathers params, which is exactly the reduce+broadcast
+  pair the reference schedules by hand.
+
+Declarative model parallelism layers on top via ``sharding_rules``
+(spec_layout.py): per-parameter-class canonical PartitionSpecs over the
+``(dp, fsdp, tp)`` axes, resolved from the Program structure.
 """
 
 __all__ = ["BuildStrategy", "ExecutionStrategy"]
@@ -36,10 +41,21 @@ class BuildStrategy:
         # where each tensor lives on the mesh; GSPMD inserts collectives)
         #   param_sharding_fn(name, shape) -> PartitionSpec or None
         #   feed_sharding_fn(name, shape)  -> PartitionSpec or None
-        # None falls back to the built-in rule (params: Reduce-strategy dp
-        # sharding or replicate; feeds: batch dim over dp).
+        # None falls back to sharding_rules (below), then the built-in
+        # rule (params: Reduce-strategy ZeRO sharding or replicate;
+        # feeds: batch dim over the data axes).
         self.param_sharding_fn = None
         self.feed_sharding_fn = None
+        # declarative model parallelism (spec_layout.py): a SpecLayout
+        # (or True for the default table) classifies every persistable
+        # var from the Program structure and resolves canonical
+        # PartitionSpecs onto the mesh's (dp, fsdp, tp) axes — params
+        # AND optimizer slot vars fsdp-shard (ZeRO), attention/ffn
+        # weights tp-shard, feeds batch-shard over dp x fsdp.  Resolution
+        # degrades per-dim when an axis is absent/size-1 or does not
+        # divide.  Precedence per param: param_sharding_fn (when it
+        # returns a spec) > sharding_rules > reduce_strategy fallback.
+        self.sharding_rules = None
         # sp: lower fused_attention ops to ring attention (context
         # parallelism) when the mesh has a populated `sp` axis.  On by
         # default — it only activates when an sp axis exists.  Gates ONLY

@@ -383,7 +383,11 @@ def test_program_report_cli_from_jsonl(tmp_path):
          str(tmp_path), "--json", "--run_id", monitor.run_id()],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         timeout=120, check=True).stdout
-    rows = {r["fingerprint"]: r for r in json.loads(out)}
+    # stable --json schema: {programs: [...], devices: {...}} (devices
+    # empty on backends with no memory stats)
+    payload = json.loads(out)
+    rows = {r["fingerprint"]: r for r in payload["programs"]}
+    assert isinstance(payload["devices"], dict)
     assert rows[fp]["steps"] == 3
     assert rows[fp]["flops_per_step"] > 0
     assert rows[fp]["peak_hbm_bytes"] > 0

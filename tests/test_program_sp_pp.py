@@ -10,23 +10,18 @@ import pytest
 import paddle_tpu as fluid
 from paddle_tpu.parallel import make_mesh
 
-# Known numeric-parity regression (tracking: ROADMAP item 1): the five
-# single-vs-mesh parity checks below fail with a consistent ~10-15%
-# loss offset on sp (ring-attention, incl. its fallback path) and pp
-# (GPipe) meshes — dropout ON and OFF alike, so it is mesh-path math,
-# not PRNG streams.  Verified present at the seed commit (f349bc0) of
-# this PR sequence in this environment, i.e. pre-existing and most
-# likely an XLA/jax version drift since the tests were written; the
-# dp-only parity suite (test_parallel_executor) is clean.  Marked
-# xfail(strict=False) so tier-1 signal stays green while the multi-axis
-# mesh work (ROADMAP item 1) revisits these paths.
+# The r8-era "sp/pp numeric-parity drift" was the legacy
+# non-partitionable threefry lowering: jax.random bits generated inside
+# a GSPMD-partitioned computation depended on the MESH SHAPE (dropout
+# masks on a (2, 4) mesh differed from one device / a 1-D dp mesh), so
+# every dropout-bearing mesh run drifted off the single-device
+# trajectory by one mask's worth of loss.  paddle_tpu now enables
+# jax_threefry_partitionable at import (sharding-invariant streams) and
+# these parity checks hold again — the xfail(strict=False) markers are
+# gone.  They stay `slow` purely for tier-1 budget (~230s of transformer
+# compiles); run explicitly with -m slow.
 def _mesh_parity_drift(fn):
-    # slow too: ~230s of xfail compute buys tier-1 no signal while the
-    # drift stands — run explicitly (-m slow) when revisiting item 1
-    return pytest.mark.slow(pytest.mark.xfail(
-        strict=False,
-        reason="pre-existing sp/pp mesh numeric-parity drift "
-               "(seed-commit repro; see ROADMAP item 1 note)")(fn))
+    return pytest.mark.slow(fn)
 
 
 def _build_transformer(seed=11, batch=8, t=16, vocab=64, dropout=0.1):

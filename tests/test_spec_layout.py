@@ -1,0 +1,459 @@
+"""Named-mesh model parallelism (ISSUE 7 tentpole): the SpecLayout
+table + logical-axis rules, program-structure parameter classification,
+graceful per-dim degradation, BuildStrategy.sharding_rules wiring, the
+fsdp acceptance criteria (loss parity vs single device AND per-device
+HBM ~1/N for the sharded state, from the program-profile registry), and
+cross-topology TrainState round trips (fsdp mesh save -> single-device
+restore and back).  Runs on the 8-device virtual CPU mesh (conftest)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as fluid
+from paddle_tpu import compile_cache, monitor
+from paddle_tpu.monitor import program_profile
+from paddle_tpu.parallel import SpecLayout, make_mesh
+from paddle_tpu.parallel import spec_layout as sl
+from paddle_tpu.parallel.checkpoint import (_persistable_state,
+                                            apply_train_state,
+                                            capture_train_state,
+                                            load_train_state,
+                                            save_train_state)
+
+
+@pytest.fixture(autouse=True)
+def clean_profile_state():
+    program_profile.reset()
+    yield
+    monitor.disable()
+    monitor.registry().reset()
+    monitor.step_stats().reset()
+    program_profile.reset()
+
+
+def _build_transformer(seed=11, t=8, vocab=32, dropout=0.1, n_layer=1):
+    """The real enc-dec transformer at the smallest shape that still
+    exercises every parameter class (tier-1 budget: compiles dominate
+    these tests; n_layer=1/t=8 halves them vs the sp/pp suite's
+    config — the classification tests that need 2 layers ask for
+    them explicitly)."""
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    from paddle_tpu.models import transformer as tfm
+    src = fluid.layers.data("src_word", shape=[1], dtype="int64",
+                            lod_level=1)
+    tgt = fluid.layers.data("tgt_word", shape=[1], dtype="int64",
+                            lod_level=1)
+    lbl = fluid.layers.data("lbl_word", shape=[1], dtype="int64",
+                            lod_level=1)
+    cost, _ = tfm.transformer(src, tgt, lbl, t, t, vocab, vocab,
+                              n_layer=n_layer,
+                              n_head=2, d_model=16, d_inner=32,
+                              dropout_rate=dropout)
+    fluid.optimizer.Adam(learning_rate=2e-3).minimize(cost)
+    return cost
+
+
+def _batches(steps=3, batch=8, t=8, vocab=32):
+    rng = np.random.RandomState(3)
+    out = []
+    for _ in range(steps):
+        ids = rng.randint(2, vocab, (batch, t, 1)).astype("int64")
+        lens = rng.randint(t // 2, t + 1, (batch,)).astype("int32")
+        out.append({"src_word": ids, "src_word@LEN": lens,
+                    "tgt_word": ids, "tgt_word@LEN": lens,
+                    "lbl_word": ids, "lbl_word@LEN": lens})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the table + rules (unit)
+# ---------------------------------------------------------------------------
+
+def test_spec_layout_canonical_table():
+    lay = SpecLayout()
+    assert lay.embeddings() == P(("fsdp", "tp"), None)
+    assert lay.qkv_projection() == P("fsdp", "tp")
+    assert lay.attn_output() == P("tp", "fsdp")
+    assert lay.ffn_up() == P("fsdp", "tp")
+    assert lay.ffn_down() == P("tp", "fsdp")
+    assert lay.norm_scale() == P("fsdp")
+    assert lay.batch() == P(("dp", "fsdp"))
+
+
+def test_spec_layout_axis_renaming():
+    lay = SpecLayout(fsdp_axis="dp")       # pure-dp ZeRO layout
+    assert lay.embeddings() == P(("dp", "tp"), None)
+    assert dict(lay.rules)["embed"] == "dp"
+
+
+def test_classify_transformer_params():
+    _build_transformer()
+    classes = sl.classify_params(fluid.default_main_program())
+    assert classes["src_word_emb"] == ("vocab", "embed")
+    assert classes["tgt_word_emb"] == ("vocab", "embed")
+    # qkv in-projections are column-parallel ...
+    assert classes["enc0_attn_q.w_0"] == ("embed", "mlp")
+    # ... and the out-projection is row-parallel (Megatron pairing:
+    # lineage propagates through reshape/transpose/fused_attention)
+    assert classes["enc0_attn_o.w_0"] == ("mlp", "embed")
+    # ffn pair likewise
+    assert classes["enc0_ffn_fc1.w_0"] == ("embed", "mlp")
+    assert classes["enc0_ffn_fc2.w_0"] == ("mlp", "embed")
+    # layer_norm scales/shifts
+    norm = [n for n, c in classes.items() if c == ("norm",)]
+    assert len(norm) >= 8            # 2 per post_process x many sites
+
+
+def test_optimizer_slots_inherit_param_class():
+    loss = _build_transformer()
+    del loss
+    slots = sl.optimizer_slot_params(fluid.default_main_program())
+    moments = {s: p for s, p in slots.items() if "_moment" in s}
+    assert moments, "no adam moment slots found"
+    for s, p in moments.items():
+        assert s.startswith(p)       # moment var carries the param prefix
+    assert any(p == "src_word_emb" for p in moments.values())
+
+
+def test_resolve_degrades_gracefully():
+    _build_transformer()
+    program = fluid.default_main_program()
+    lay = SpecLayout()
+    # no tp axis and fsdp=2: tp entries drop, fsdp survives
+    mesh = make_mesh((2, 2), ("dp", "fsdp"))
+    specs = lay.resolve(program, mesh, [("src_word_emb", (64, 16)),
+                                        ("enc0_attn_q.w_0", (16, 16))])
+    assert specs["src_word_emb"] == P("fsdp")
+    assert specs["enc0_attn_q.w_0"] == P("fsdp")
+    # full (dp, fsdp, tp) mesh
+    mesh3 = make_mesh((1, 2, 2), ("dp", "fsdp", "tp"))
+    specs3 = lay.resolve(program, mesh3, [("src_word_emb", (64, 16)),
+                                          ("enc0_attn_q.w_0", (16, 16)),
+                                          ("enc0_attn_o.w_0", (16, 16))])
+    assert specs3["src_word_emb"] == P(("fsdp", "tp"))
+    assert specs3["enc0_attn_q.w_0"] == P("fsdp", "tp")
+    assert specs3["enc0_attn_o.w_0"] == P("tp", "fsdp")
+    # a dim the axis product does not divide sheds axes until it fits
+    specs_bad = lay.resolve(program, mesh3, [("src_word_emb", (6, 16))])
+    assert specs_bad["src_word_emb"] == P("fsdp")   # 6 % 2 == 0, % 4 != 0
+    # vocab indivisible outright: dim 0 replicates, which frees fsdp
+    # for the embed dim — the table still finds a 1/N layout
+    specs_rep = lay.resolve(program, mesh3, [("src_word_emb", (7, 16))])
+    assert specs_rep["src_word_emb"] == P(None, "fsdp")
+    # scalar slots replicate; unclassified tensors ZeRO-shard dim 0
+    specs_misc = lay.resolve(program, mesh3, [("learning_rate_0", (1,)),
+                                              ("some_counter", (8, 3))])
+    assert specs_misc["learning_rate_0"] == P()
+    assert specs_misc["some_counter"] == P("fsdp")
+
+
+def test_spec_layout_value_equality():
+    """Two default tables are one policy: equality/hash are by value so
+    separate executors with sharding_rules=True share one process-global
+    trace-cache entry instead of recompiling per object."""
+    assert SpecLayout() == SpecLayout()
+    assert hash(SpecLayout()) == hash(SpecLayout())
+    assert SpecLayout() != SpecLayout(fsdp_axis="dp")
+
+
+def test_rules_do_not_shadow_kreduce_on_pure_dp_mesh():
+    """sharding_rules on a mesh with no populated fsdp/tp axis resolves
+    everything to replicate — that must fall THROUGH to the kReduce
+    tier (ZeRO dim-0 over dp), not silently un-shard the state."""
+    _build_mlp()
+    loss_var = None
+    for op in fluid.default_main_program().global_block().ops:
+        if op.type == "mean":
+            loss_var = op.outputs["Out"][0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    bs = fluid.BuildStrategy()
+    bs.sharding_rules = True
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    pe = fluid.ParallelExecutor(loss_name=loss_var, mesh=make_mesh((8,)),
+                                build_strategy=bs)
+    x = np.random.RandomState(0).rand(8, 16).astype("float32")
+    y = np.zeros((8, 1), "int64")
+    pe.run(feed={"x": x, "label": y}, fetch_list=[loss_var])
+    w = fluid.global_scope().var("fc_0.w_0")     # [16, 32]: 16 % 8 == 0
+    assert isinstance(w, jax.Array) and w.sharding.spec == P("dp")
+
+
+def test_axis_size_one_drops_out():
+    _build_transformer()
+    program = fluid.default_main_program()
+    mesh = make_mesh((2, 1, 1), ("dp", "fsdp", "tp"))
+    specs = SpecLayout().resolve(program, mesh,
+                                 [("src_word_emb", (64, 16))])
+    assert specs["src_word_emb"] == P()   # both axes size 1 -> replicated
+
+
+# ---------------------------------------------------------------------------
+# acceptance: fsdp transformer — loss parity + per-device HBM ~ 1/N
+# ---------------------------------------------------------------------------
+
+def test_fsdp_transformer_loss_parity_and_state_sharding():
+    """The ISSUE 7 acceptance: the real transformer trains through
+    ParallelExecutor with fsdp-sharded params AND optimizer state under
+    sharding_rules, with the loss trajectory matching the single-device
+    run (GSPMD only changes layout), and the sharded state visible in
+    the scope's array shardings."""
+    batches = _batches()
+    loss = _build_transformer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    single = [float(np.asarray(exe2.run(feed=b, fetch_list=[loss])[0])
+                    .ravel()[0]) for b in batches]
+
+    mesh = make_mesh((1, 2, 2), ("dp", "fsdp", "tp"))
+    bs = fluid.BuildStrategy()
+    bs.sharding_rules = True
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                    build_strategy=bs)
+        par = [float(np.asarray(pe.run(feed=b, fetch_list=[loss])[0])
+                     .ravel()[0]) for b in batches]
+        scope = fluid.global_scope()
+        emb = scope.var("src_word_emb")
+        assert isinstance(emb, jax.Array)
+        assert emb.sharding.spec == P(("fsdp", "tp"))
+        qkv = scope.var("enc0_attn_q.w_0")
+        assert qkv.sharding.spec == P("fsdp", "tp")
+        # optimizer slot state inherits the param's spec (ZeRO)
+        moments = [n for n in
+                   sl.optimizer_slot_params(
+                       fluid.default_main_program())
+                   if "src_word_emb_moment1" in n]
+        assert moments
+        mom = scope.var(moments[0])
+        assert mom.sharding.spec == P(("fsdp", "tp"))
+    np.testing.assert_allclose(single, par, rtol=1e-4, atol=1e-4)
+    assert par[-1] < par[0]
+
+
+def test_fsdp_per_device_hbm_drops_one_over_n():
+    """The program-profile registry's compiled-module memory analysis is
+    per-device under SPMD: with the full state fsdp-sharded 4 ways the
+    per-device argument bytes must drop to ~1/N of the replicated run
+    for the state's share (scalar counters stay replicated, hence the
+    tolerance band), and estimated peak HBM must drop too."""
+    monitor.enable()
+    b = _batches(steps=1)[0]
+    loss = _build_transformer()
+    fp = compile_cache.program_fingerprint(fluid.default_main_program())
+
+    breakdown = {}
+    for label, shape, axes, rules in [
+            ("replicated", (4,), ("dp",), None),
+            ("fsdp", (1, 4), ("dp", "fsdp"), True)]:
+        mesh = make_mesh(shape, axes)
+        bstrat = fluid.BuildStrategy()
+        if rules:
+            bstrat.sharding_rules = True
+        with fluid.scope_guard(fluid.Scope()):
+            fluid.Executor(fluid.CPUPlace()).run(
+                fluid.default_startup_program())
+            pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                        build_strategy=bstrat)
+            pe.run(feed=b, fetch_list=[loss])
+            prof = program_profile.get(fp)
+            assert prof is not None, "capture did not run (%s)" % label
+            breakdown[label] = prof.breakdown()
+
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        state = _persistable_state(fluid.global_scope(),
+                                   fluid.default_main_program())
+        state_bytes = sum(np.asarray(v).nbytes for v in state.values())
+
+    rep, fs = breakdown["replicated"], breakdown["fsdp"]
+    # replicated run holds the full state per device
+    assert rep["argument_bytes"] >= state_bytes
+    # the fsdp run's per-device state share is ~1/4 (+ replicated
+    # scalars): measured 26.2% at these shapes, assert < 35%
+    fsdp_state = fs["argument_bytes"] - (rep["argument_bytes"]
+                                         - state_bytes)
+    assert fsdp_state / state_bytes < 0.35, (
+        "fsdp per-device state share %.1f%% — not ~1/4"
+        % (100 * fsdp_state / state_bytes))
+    assert fsdp_state / state_bytes > 0.20          # sanity: not zero
+    assert fs["peak_hbm_bytes"] < rep["peak_hbm_bytes"]
+
+
+@pytest.mark.slow   # ~24s of transformer compiles; the precedence chain
+# is also covered in tier-1 by test_rules_do_not_shadow_kreduce_on_pure_
+# dp_mesh (rules->reduce tier) and test_parallel_tensor_parallel_policy
+# (hook alone)
+def test_param_sharding_fn_overrides_rules():
+    """Precedence: the imperative hook wins per-param over the table."""
+    b = _batches(steps=1)[0]
+    loss = _build_transformer()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    mesh = make_mesh((1, 4), ("dp", "fsdp"))
+    bs = fluid.BuildStrategy()
+    bs.sharding_rules = True
+    bs.param_sharding_fn = (
+        lambda name, shape: P() if name == "src_word_emb" else None)
+    with fluid.scope_guard(fluid.Scope()):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                    build_strategy=bs)
+        pe.run(feed=b, fetch_list=[loss])
+        scope = fluid.global_scope()
+        assert scope.var("src_word_emb").sharding.spec == P()     # hook
+        assert scope.var("enc0_attn_q.w_0").sharding.spec == P("fsdp")
+
+
+# ---------------------------------------------------------------------------
+# per-device HBM reporting (satellite): gauges -> JSONL -> report columns
+# ---------------------------------------------------------------------------
+
+class _FakeDev:
+    def __init__(self, i, in_use, limit=1 << 30):
+        self.platform = "tpu"
+        self.id = i
+        self._ms = {"bytes_in_use": in_use, "bytes_limit": limit}
+
+    def memory_stats(self):
+        return dict(self._ms)
+
+
+def test_device_gauges_emit_stats_and_report_columns(tmp_path):
+    """sample_device_gauges publishes per-device bytes_in_use(+peak)
+    gauges and a decimated ``device_stats`` JSONL event; the
+    program_report CLI folds those into the per-device peak-HBM table
+    with the min/max summary the 1/N claim is read from."""
+    monitor.enable(log_dir=str(tmp_path))
+    devs = [_FakeDev(0, 100), _FakeDev(1, 400)]
+    monitor.sample_device_gauges(devs)
+    devs[1]._ms["bytes_in_use"] = 900          # peak moves up
+    for _ in range(10):                        # cross the sample cadence
+        monitor.sample_device_gauges(devs)
+    reg = monitor.registry()
+    assert reg.gauge("device/tpu1/bytes_in_use_peak").value == 900
+    assert reg.gauge("device/tpu0/bytes_in_use_peak").value == 100
+    monitor.disable()
+
+    import sys
+    sys.path.insert(0, __import__("os").path.join(
+        __import__("os").path.dirname(__import__("os").path.dirname(
+            __import__("os").path.abspath(__file__))), "tools"))
+    import program_report
+    records = program_report.load_records(str(tmp_path))
+    devices = program_report.devices_from_records(records)
+    assert devices["tpu0"]["bytes_in_use_peak"] == 100
+    assert devices["tpu1"]["bytes_in_use_peak"] == 900
+    table = program_report.render_device_table(devices)
+    assert "min 100 B / max 900 B" in table
+
+
+# ---------------------------------------------------------------------------
+# cross-topology TrainState round trip (satellite)
+# ---------------------------------------------------------------------------
+
+def _train_mlp_steps(runner, steps=2):
+    losses = []
+    for i in range(steps):
+        x = np.random.RandomState(i).rand(8, 16).astype("float32")
+        y = (x[:, :4].argmax(1)).astype("int64").reshape(-1, 1)
+        losses.append(float(np.asarray(
+            runner({"x": x, "label": y})).ravel()[0]))
+    return losses
+
+
+def _build_mlp(seed=7):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    x = fluid.layers.data("x", shape=[16])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=32, act="relu")
+    pred = fluid.layers.fc(h, size=4, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return loss
+
+
+def test_train_state_fsdp_save_restores_single_device(tmp_path):
+    """Save from a (dp=2, fsdp=2) mesh (sharded arrays gather to full
+    host arrays in the artifact), restore single-device: params must be
+    BIT-identical to the mesh state."""
+    loss = _build_mlp()
+    mesh = make_mesh((2, 2), ("dp", "fsdp"))
+    bs = fluid.BuildStrategy()
+    bs.sharding_rules = True
+    mesh_scope = fluid.Scope()
+    with fluid.scope_guard(mesh_scope):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                    build_strategy=bs)
+        _train_mlp_steps(
+            lambda f: pe.run(feed=f, fetch_list=[loss])[0])
+        # state is mesh-sharded at this point
+        w = mesh_scope.var("fc_0.w_0")
+        assert isinstance(w, jax.Array) and w.sharding.spec == P("fsdp")
+        ts = capture_train_state(2, scope=mesh_scope, executors=pe)
+        save_train_state(str(tmp_path / "ck"), ts)
+        full = {n: np.asarray(v) for n, v in ts.arrays.items()}
+
+    # restore into a fresh single-device world
+    solo = fluid.Scope()
+    with fluid.scope_guard(solo):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        loaded = load_train_state(str(tmp_path / "ck"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        apply_train_state(loaded, scope=solo, executors=exe)
+        for n, v in full.items():
+            np.testing.assert_array_equal(np.asarray(solo.var(n)), v,
+                                          err_msg=n)
+
+
+def test_train_state_single_device_save_restores_onto_mesh(tmp_path):
+    """The other direction: train single-device, save, restore onto a
+    (dp=2, fsdp=2) mesh with PE.state_shardings() — arrays land sharded
+    per the rules, values bit-identical, and training continues."""
+    loss = _build_mlp()
+    solo = fluid.Scope()
+    with fluid.scope_guard(solo):
+        exe0 = fluid.Executor(fluid.CPUPlace())
+        exe0.run(fluid.default_startup_program())
+        exe = fluid.Executor(fluid.CPUPlace())
+        _train_mlp_steps(
+            lambda f: exe.run(feed=f, fetch_list=[loss])[0])
+        ts = capture_train_state(2, scope=solo, executors=exe)
+        save_train_state(str(tmp_path / "ck"), ts)
+        full = {n: np.asarray(v) for n, v in ts.arrays.items()}
+
+    mesh = make_mesh((2, 2), ("dp", "fsdp"))
+    bs = fluid.BuildStrategy()
+    bs.sharding_rules = True
+    mesh_scope = fluid.Scope()
+    with fluid.scope_guard(mesh_scope):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                    build_strategy=bs)
+        loaded = load_train_state(str(tmp_path / "ck"))
+        apply_train_state(loaded, scope=mesh_scope, executors=pe,
+                          shardings=pe.state_shardings())
+        w = mesh_scope.var("fc_0.w_0")
+        assert isinstance(w, jax.Array) and w.sharding.spec == P("fsdp")
+        for n, v in full.items():
+            np.testing.assert_array_equal(np.asarray(mesh_scope.var(n)),
+                                          v, err_msg=n)
+        out = pe.run(feed={
+            "x": np.random.RandomState(9).rand(8, 16).astype("float32"),
+            "label": np.zeros((8, 1), "int64")}, fetch_list=[loss])
+        assert np.isfinite(np.asarray(out[0])).all()

@@ -16,7 +16,9 @@ Usage:
 The log must come from a run with the monitor on
 (``FLAGS_monitor_log_dir=...``): ``program_profile`` events carry each
 compiled program's cost/memory analysis, ``step_stats`` events carry the
-per-step fingerprint tags this report joins on.
+per-step fingerprint tags this report joins on, and ``device_stats``
+events (mesh runs) feed the per-device peak-HBM block — min/max across
+the mesh devices, the one-table readout of the fsdp 1/N claim.
 """
 
 import argparse
@@ -60,6 +62,7 @@ def rows_from_records(records, peak_tflops=None, run_id=None):
                                                     report_rows)
 
     profiles, acct = {}, {}
+    partitions = {}     # fingerprint -> set of distinct partition ids
     for r in records:
         if not isinstance(r, dict):
             continue
@@ -67,6 +70,8 @@ def rows_from_records(records, peak_tflops=None, run_id=None):
             continue
         ev = r.get("event")
         if ev == "program_profile" and r.get("fingerprint"):
+            partitions.setdefault(r["fingerprint"], set()).add(
+                r.get("partition"))
             profiles[r["fingerprint"]] = ProgramProfile(
                 r["fingerprint"], (), r.get("kind", "executor"),
                 flops=r.get("flops", 0.0) or 0.0,
@@ -85,8 +90,59 @@ def rows_from_records(records, peak_tflops=None, run_id=None):
             a["steps"] += 1
             a["wall_s"] += r.get("step_seconds", 0.0) or 0.0
             a["examples"] += r.get("examples", 0) or 0
-    return report_rows(peak_tflops=peak_tflops, profiles_by_fp=profiles,
+    rows = report_rows(peak_tflops=peak_tflops, profiles_by_fp=profiles,
                        acct_by_fp=acct)
+    # one program compiled under SEVERAL mesh/sharding layouts (the
+    # replicated-vs-fsdp A/B) shares a fingerprint: step accounting
+    # covers all layouts while the profile columns are the latest
+    # layout's — flag the multiplicity so the row isn't read as one
+    # homogeneous program
+    for row in rows:
+        n = len(partitions.get(row["fingerprint"], ()))
+        if n > 1:
+            row["partitions"] = n
+            row["fp12"] = row["fp12"][:11] + "*"   # visible in the table
+    return rows
+
+
+def devices_from_records(records, run_id=None):
+    """Per-device memory summary from ``device_stats`` events (the JSONL
+    twin of the ``device/<id>/bytes_in_use`` gauges ParallelExecutor
+    publishes each sampled mesh step): ``{device: {bytes_in_use_peak,
+    bytes_limit}}``.  The min/max across the mesh makes the fsdp 1/N
+    per-device HBM claim readable from one table."""
+    out = {}
+    for r in records:
+        if not isinstance(r, dict) or r.get("event") != "device_stats":
+            continue
+        if run_id and r.get("run_id") not in (None, run_id):
+            continue
+        for dev, ms in (r.get("devices") or {}).items():
+            cur = out.setdefault(dev, {"bytes_in_use_peak": 0,
+                                       "bytes_limit": None})
+            peak = ms.get("bytes_in_use_peak") or ms.get("bytes_in_use")
+            if peak and peak > cur["bytes_in_use_peak"]:
+                cur["bytes_in_use_peak"] = int(peak)
+            if ms.get("bytes_limit"):
+                cur["bytes_limit"] = int(ms["bytes_limit"])
+    return out
+
+
+def render_device_table(devices):
+    """Fixed-width per-device peak-HBM block + the min/max summary."""
+    from paddle_tpu.monitor.program_profile import _fmt_mib
+
+    lines = ["", "%-12s %12s %12s" % ("device", "peakHBM", "limit"),
+             "-" * 38]
+    for dev in sorted(devices):
+        d = devices[dev]
+        lines.append("%-12s %12s %12s" % (
+            dev, _fmt_mib(d["bytes_in_use_peak"]),
+            _fmt_mib(d["bytes_limit"]) if d["bytes_limit"] else "-"))
+    peaks = [d["bytes_in_use_peak"] for d in devices.values()]
+    lines.append("per-device peak HBM across %d devices: min %s / max %s"
+                 % (len(peaks), _fmt_mib(min(peaks)), _fmt_mib(max(peaks))))
+    return "\n".join(lines)
 
 
 def main(argv=None):
@@ -111,6 +167,7 @@ def main(argv=None):
     records = load_records(args.log)
     rows = rows_from_records(records, peak_tflops=args.peak_tflops,
                              run_id=args.run_id)
+    devices = devices_from_records(records, run_id=args.run_id)
     if args.top:
         rows = rows[:args.top]
     if not rows:
@@ -119,9 +176,14 @@ def main(argv=None):
               % args.log)
         return 1
     if args.json:
-        print(json.dumps(rows, indent=2))
+        # one stable schema: devices is {} on runs whose backend
+        # reports no memory stats (single-device/CPU)
+        print(json.dumps({"programs": rows, "devices": devices},
+                         indent=2))
     else:
         print(render_table(rows))
+        if devices:
+            print(render_device_table(devices))
     return 0
 
 

@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/6 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/7 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/6 API signature gate =="
+echo "== 2/7 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/6 8-device virtual-mesh dryrun =="
+echo "== 3/7 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/6 bench smoke (CPU backend, tiny) =="
+echo "== 4/7 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/6 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/7 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/6 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/7 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -114,5 +114,60 @@ grep -q "^RESUMED 3$" "$SMOKE_DIR/resume.out"
 diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
+
+echo "== 7/7 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+FSDP_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+  python - "$FSDP_DIR" <<'PY'
+import os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import jax
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.models import transformer as tfm
+from paddle_tpu.parallel import make_mesh
+
+out = sys.argv[1]
+monitor.enable(log_dir=out)
+fluid.default_main_program().random_seed = 7
+fluid.default_startup_program().random_seed = 7
+src = fluid.layers.data("src_word", shape=[1], dtype="int64", lod_level=1)
+tgt = fluid.layers.data("tgt_word", shape=[1], dtype="int64", lod_level=1)
+lbl = fluid.layers.data("lbl_word", shape=[1], dtype="int64", lod_level=1)
+loss, _ = tfm.transformer(src, tgt, lbl, 8, 8, 32, 32, n_layer=2,
+                          n_head=2, d_model=16, d_inner=32,
+                          dropout_rate=0.1)
+fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+mesh = make_mesh((1, 4), ("dp", "fsdp"))
+bs = fluid.BuildStrategy()
+bs.sharding_rules = True
+fluid.Executor(fluid.CPUPlace()).run(fluid.default_startup_program())
+pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                            build_strategy=bs)
+rng = np.random.RandomState(0)
+for step in range(4):
+    ids = rng.randint(2, 32, (8, 8, 1)).astype("int64")
+    lens = rng.randint(4, 9, (8,)).astype("int32")
+    (lv,) = pe.run(feed={"src_word": ids, "src_word@LEN": lens,
+                         "tgt_word": ids, "tgt_word@LEN": lens,
+                         "lbl_word": ids, "lbl_word@LEN": lens},
+                   fetch_list=[loss])
+    lv = float(np.asarray(lv).ravel()[0])
+    assert np.isfinite(lv), lv
+    print("FSDP STEP %d loss %.6f" % (step, lv), flush=True)
+from jax.sharding import PartitionSpec as P
+emb = fluid.global_scope().var("src_word_emb")
+assert isinstance(emb, jax.Array) and emb.sharding.spec == P("fsdp"), \
+    emb.sharding
+print("FSDP SHARDED src_word_emb", emb.sharding.spec, flush=True)
+monitor.disable()
+PY
+# the profile registry captured the SHARDED per-device peak HBM
+# (the kind column truncates to 10 chars: "parallel_e")
+python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
+grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
 echo "CI OK"

@@ -255,6 +255,109 @@ def _maybe_amp(optimizer, use_amp):
     return optimizer
 
 
+def bench_fault_drill(args):
+    """Guardian recovery drill as a bench rung (ISSUE 8): a monitored
+    MLP run with a NaN injected into a weight at a fixed step, recovered
+    by guardian rollback over TrainState checkpoints.  Reports the
+    recovery's wall-clock overhead vs an identical clean run plus the
+    guardian's decision counters — the robustness analog of a perf
+    rung: recovery must be automatic AND cheap (CheckFreq's argument).
+    Informational: drill mechanics, not a hardware-bound number."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import fault, monitor
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+    from paddle_tpu.reader import checkpointable
+
+    # below ~16 steps the wall-clock delta is residual-compile noise,
+    # not recovery cost (measured on CPU; the warmup bounds but does
+    # not eliminate it)
+    iterations = max(16, args.iterations)
+    batch = args.batch_size or 64
+    inject_step = iterations // 2
+
+    def one_run(workdir, inject):
+        fault.clear()
+        fault.clear_injections()
+        if inject:
+            fault.inject_nan("fc_0.w_0",
+                             fault.FaultSchedule(steps=[inject_step]),
+                             once=True)
+
+        def train_func():
+            fluid.default_main_program().random_seed = 7
+            fluid.default_startup_program().random_seed = 7
+            img = fluid.layers.data("img", shape=[784])
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            h = fluid.layers.fc(img, size=256, act="relu")
+            pred = fluid.layers.fc(h, size=10, act="softmax")
+            return fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+
+        def samples():
+            rng = np.random.RandomState(0)
+            for _ in range(iterations * batch):
+                yield (rng.rand(784).astype("float32"),
+                       rng.randint(0, 10, (1,)).astype("int64"))
+
+        losses = []
+
+        def handler(ev):
+            if hasattr(ev, "metrics"):
+                losses.append(float(np.ravel(ev.metrics[0])[0]))
+
+        if not monitor.enabled():
+            fluid.set_flags({"FLAGS_monitor": True})
+        trainer = Trainer(
+            train_func=train_func, place=_place(args),
+            optimizer_func=lambda: fluid.optimizer.Adam(1e-3),
+            checkpoint_config=CheckpointConfig(
+                checkpoint_dir=os.path.join(workdir, "ckpt"),
+                step_interval=max(2, iterations // 4),
+                async_save=False),
+            guardian_config={"policy": "rollback,abort"})
+        t0 = time.monotonic()
+        trainer.train(num_epochs=1, event_handler=handler,
+                      reader=checkpointable(
+                          fluid.batch(samples, batch_size=batch)),
+                      feed_order=["img", "label"])
+        wall = time.monotonic() - t0
+        fault.clear()
+        return losses, wall
+
+    workdir = tempfile.mkdtemp(prefix="bench_fault_")
+    try:
+        # untimed warmup: both timed runs then dispatch off the warm
+        # process-global trace cache, so the reported overhead is the
+        # RECOVERY cost (restore + replay), not a compile asymmetry
+        one_run(os.path.join(workdir, "warm"), inject=False)
+        clean_losses, clean_s = one_run(
+            os.path.join(workdir, "clean"), inject=False)
+        drilled_losses, drilled_s = one_run(
+            os.path.join(workdir, "drill"), inject=True)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    reg = monitor.registry()
+    rollbacks = reg.get("guardian/rollbacks")
+    recovered = (np.isfinite(drilled_losses[-1]) and abs(
+        drilled_losses[-1] - clean_losses[-1])
+        <= 1e-4 * abs(clean_losses[-1]))
+    return {"metric": "fault_drill_recovery_overhead_s",
+            "value": round(drilled_s - clean_s, 3), "unit": "seconds",
+            "vs_baseline": 0.0, "informational": True,
+            "recovered_to_clean_loss": bool(recovered),
+            "clean_s": round(clean_s, 3),
+            "drilled_s": round(drilled_s, 3),
+            "steps": iterations,
+            "inject_step": inject_step,
+            "replayed_steps": len(drilled_losses) - len(clean_losses),
+            "rollbacks": rollbacks.value if rollbacks else 0,
+            "final_loss": drilled_losses[-1],
+            "clean_final_loss": clean_losses[-1]}
+
+
 def bench_mlp(args, use_amp=False, per_step_feed=False):
     import paddle_tpu as fluid
 
@@ -994,7 +1097,7 @@ def main():
                             "transformer_realdist", "longctx", "vgg",
                             "se_resnext", "stacked_lstm",
                             "machine_translation", "alexnet", "googlenet",
-                            "smallnet", "reader_capacity"])
+                            "smallnet", "reader_capacity", "fault_drill"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -1145,6 +1248,10 @@ def main():
             # host-side pipeline capacity first: no device, ~60s, and
             # VERDICT r4 #6 wants it in the artifact every round
             ("reader_capacity", [], True, 300),
+            # guardian recovery drill (ISSUE 8): NaN at a fixed step ->
+            # rollback over TrainState -> recovery overhead in seconds;
+            # cheap (~15s) and keeps the robustness loop in the artifact
+            ("fault_drill", [], True, 300),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -1318,7 +1425,9 @@ def main():
     if args.infer and args.model not in _INFER_MODELS:
         raise SystemExit("--infer supports the image models only")
 
-    if args.model == "transformer_realdist":
+    if args.model == "fault_drill":
+        result = bench_fault_drill(args)
+    elif args.model == "transformer_realdist":
         result = bench_transformer_realdist(args,
                                             use_amp=not args.fp32_only)
     elif args.model == "longctx":

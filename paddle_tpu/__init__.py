@@ -82,6 +82,8 @@ from .lod_tensor import (create_lod_tensor, create_random_int_lodtensor,
                          LoDTensor, LoDTensorArray)
 from . import recordio
 from . import recordio_writer
+from . import fault
+from . import guardian
 from .flags import set_flags, get_flags
 
 __version__ = "0.1.0"
@@ -101,6 +103,7 @@ __all__ = [
     "dataset", "batch", "compat", "utils", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "InferenceTranspiler",
     "memory_optimize", "release_memory", "cloud", "set_flags", "get_flags",
+    "fault", "guardian",
     "recordio", "recordio_writer", "inference", "debugger",
     "average", "lod_tensor", "net_drawer", "create_lod_tensor",
     "create_random_int_lodtensor",

@@ -15,6 +15,7 @@ Store.
 """
 
 import json
+import random
 import socket
 import socketserver
 import threading
@@ -110,16 +111,26 @@ class MasterClient:
 
     ``get_task``/``task_finished``/``task_failed``/``request_save_model``
     mirror the cgo client's surface; transient socket errors trigger
-    reconnect+retry so trainers ride out master restarts.
+    reconnect+retry so trainers ride out master restarts.  The retry
+    loop backs off EXPONENTIALLY with jitter (``retry_interval`` doubles
+    per failure up to ``max_retry_interval``, each sleep stretched by up
+    to ``jitter``x) so a restarting master is not hammered by a
+    thundering herd of fixed-cadence trainers, and the budget is
+    bounded: after ``max_retries`` failed attempts a ``ConnectionError``
+    names the endpoint, the attempt count, and the last error instead
+    of retrying forever.  Each reconnect attempt after a failure counts
+    into the ``master/reconnects`` monitor counter.
     """
 
     def __init__(self, address, timeout=30.0, retry_interval=0.2,
-                 max_retries=50):
+                 max_retries=12, max_retry_interval=5.0, jitter=0.5):
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self._timeout = timeout
-        self._retry = retry_interval
-        self._max_retries = max_retries
+        self._retry = float(retry_interval)
+        self._max_retries = max(1, int(max_retries))
+        self._max_retry_interval = float(max_retry_interval)
+        self._jitter = max(0.0, float(jitter))
         self._sock = None
         self._file = None
         self._mu = threading.Lock()
@@ -131,11 +142,17 @@ class MasterClient:
         self._file = self._sock.makefile("rwb")
 
     def _call(self, method, *args):
+        from .. import monitor
+
         with self._mu:
             last_err = None
-            for _ in range(self._max_retries):
+            delay = self._retry
+            slept = 0.0
+            for attempt in range(self._max_retries):
                 try:
                     if self._file is None:
+                        if attempt > 0:
+                            monitor.count("master/reconnects")
                         self._connect()
                     payload = json.dumps(
                         {"method": method, "args": list(args)})
@@ -153,9 +170,21 @@ class MasterClient:
                         as e:
                     last_err = e
                     self.close()
-                    time.sleep(self._retry)
+                    if attempt == self._max_retries - 1:
+                        break       # budget spent: no trailing sleep
+                    # full-jitter exponential backoff: sleep in
+                    # [delay, delay*(1+jitter)], then double toward the
+                    # cap — decorrelates a herd of reconnecting trainers
+                    time.sleep(delay * (1.0 + random.random()
+                                        * self._jitter))
+                    slept += delay
+                    delay = min(delay * 2.0, self._max_retry_interval)
             raise ConnectionError(
-                f"master at {self._addr} unreachable: {last_err}")
+                "master at %s:%d unreachable after %d attempts (~%.1fs "
+                "of backoff); last error: %r — check the master "
+                "endpoint or raise max_retries" %
+                (self._addr[0], self._addr[1], self._max_retries, slept,
+                 last_err))
 
     def get_task(self, pass_id=None):
         return Task.from_dict(self._call("get_task", pass_id))

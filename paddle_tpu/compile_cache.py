@@ -42,8 +42,15 @@ def trace_flag_values():
     tuple, or set_flags between runs serves a stale trace."""
     from . import flags
 
+    from . import guardian
+
+    # the guardian's in-graph skip guard wraps the traced step (extra
+    # ok fetch + state selects), so its enablement is part of the jaxpr
+    # identity: flipping FLAGS_guardian re-lowers instead of serving an
+    # unguarded (or guarded) stale trace
     return (flags.flag("pallas_kernels"), flags.flag("bn_two_pass"),
-            flags.flag("pallas_attention_max_seq"))
+            flags.flag("pallas_attention_max_seq"),
+            guardian.skip_guard_enabled())
 
 _mu = threading.Lock()
 # LRU of jitted step entries: the jitted callables keep their traced

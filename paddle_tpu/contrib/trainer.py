@@ -9,10 +9,14 @@ is subsumed by mesh sharding, so PADDLE_TRAINING_ROLE=PSERVER raises with
 guidance instead of transpiling (SURVEY.md §2.4)."""
 
 import os
+import warnings
 
 import numpy as np
 
+from .. import flags as _flags
+from .. import guardian as _guardian
 from .. import io as fluid_io
+from .. import monitor
 from .. import unique_name
 from ..data_feeder import DataFeeder
 from ..executor import CPUPlace, Executor, TPUPlace
@@ -96,11 +100,21 @@ class Trainer:
 
     def __init__(self, train_func, optimizer_func, param_path=None,
                  place=None, parallel=False, checkpoint_config=None,
-                 mesh=None):
+                 mesh=None, guardian_config=None):
+        """``guardian_config``: the recovery policy — a ``Guardian``
+        instance, or a kwargs dict for ``guardian.Guardian`` (policy
+        ladder, window, budgets...).  Passing one turns the guardian on
+        (``FLAGS_guardian``) for the duration of ``train()``; with the
+        flag already set the Trainer wires a default Guardian in by
+        itself, so a flag-enabled run is guarded with no code
+        changes."""
         self.__stop = False
         self.parallel = parallel
         self.place = _default_place(place)
         self._mesh = mesh
+        self._guardian_config = guardian_config
+        self._set_guardian_flag = False
+        self._current_epoch = 0
 
         if checkpoint_config is not None and not isinstance(
                 checkpoint_config, CheckpointConfig):
@@ -219,48 +233,179 @@ class Trainer:
                     self.train_program, feed=feed, fetch_list=fetch)
             feeder = self._feeder(feed_order)
             epoch_id = self._apply_resume_state(executor, reader)
-            with self._signal_guard():
-                for epoch_id in range(epoch_id, num_epochs):
-                    if self.__stop:
-                        break
-                    event_handler(BeginEpochEvent(epoch_id))
-                    for step_id, data in enumerate(reader()):
-                        if self.__stop:
+            try:
+                # inside the try: a raising Guardian construction
+                # (invalid config) must also restore the flag below
+                g = self._make_guardian()
+                with self._signal_guard(), _guardian.installed(g):
+                    # detect -> decide -> recover loop: a
+                    # GuardianRollback raised by the guardian (from
+                    # inside executor.run) restores the newest clean
+                    # TrainState and re-enters the epoch loop from the
+                    # restored position; the rollback budget turns a
+                    # persistent fault into a typed GuardianAbortError
+                    # instead of recovering forever
+                    while True:
+                        try:
+                            self._run_epochs(epoch_id, num_epochs,
+                                             event_handler, reader,
+                                             feeder, run, executor)
                             break
-                        begin = BeginStepEvent(epoch_id, step_id)
-                        event_handler(begin)
-                        fetch = [v.name for v in self.train_func_outputs] \
-                            if begin.fetch_metrics else []
-                        with RecordEvent("trainer/step"):
-                            metrics = run(feeder.feed(data), fetch)
-                            metrics = [np.asarray(m) for m in metrics]
-                        self._global_step += 1
-                        event_handler(EndStepEvent(epoch_id, step_id,
-                                                   metrics))
-                        with RecordEvent("trainer/checkpoint"):
-                            self._maybe_save_checkpoint(executor, reader,
-                                                        epoch_id)
-                        if self.__preempted:
-                            break
-                    event_handler(EndEpochEvent(epoch_id))
-                    if self.__preempted:
-                        break
-                if self.__preempted and self._ckpt_mgr is not None \
-                        and self._global_step > 0:
-                    # > 0: a preemption before any step completed has
-                    # nothing worth flushing — and a step-0 artifact
-                    # would restore as load_serial=0, falsy under the
-                    # documented `if cfg.load_serial:` resume check
-                    # preemption: the step finished, now force a
-                    # synchronous TrainState flush, then let the
-                    # signal's default behavior proceed (SURVEY §5
-                    # checkpoint-on-signal; reference analog:
-                    # listen_and_serv_op.cc signal handler)
-                    self._flush_checkpoint(executor, reader, epoch_id)
+                        except _guardian.GuardianRollback as rb:
+                            epoch_id = self._rollback_recover(
+                                rb, executor, reader)
+                            if self.__stop or self.__preempted:
+                                break
+                    if self.__preempted and self._ckpt_mgr is not None \
+                            and self._global_step > 0:
+                        # > 0: a preemption before any step completed
+                        # has nothing worth flushing — and a step-0
+                        # artifact would restore as load_serial=0,
+                        # falsy under the documented
+                        # `if cfg.load_serial:` resume check
+                        # preemption: the step finished, now force a
+                        # synchronous TrainState flush, then let the
+                        # signal's default behavior proceed (SURVEY §5
+                        # checkpoint-on-signal; reference analog:
+                        # listen_and_serv_op.cc signal handler)
+                        self._flush_checkpoint(executor, reader,
+                                               self._current_epoch)
+            finally:
+                if self._set_guardian_flag:
+                    # restore the flag this train() set: a later plain
+                    # executor (or the next Trainer's startup program)
+                    # must not run guarded with nobody deciding
+                    self._set_guardian_flag = False
+                    _flags.set_flags({"guardian": False})
             if self._ckpt_mgr is not None:
                 # a trailing async write must land before the process
                 # can exit believing the state is durable
                 self._ckpt_mgr.wait_until_finished()
+
+    def _run_epochs(self, epoch_id, num_epochs, event_handler, reader,
+                    feeder, run, executor):
+        g = _guardian.active()
+        for epoch_id in range(epoch_id, num_epochs):
+            self._current_epoch = epoch_id
+            if self.__stop:
+                break
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, data in enumerate(reader()):
+                if self.__stop:
+                    break
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                fetch = [v.name for v in self.train_func_outputs] \
+                    if begin.fetch_metrics else []
+                with RecordEvent("trainer/step"):
+                    metrics = run(feeder.feed(data), fetch)
+                    metrics = [np.asarray(m) for m in metrics]
+                self._global_step += 1
+                event_handler(EndStepEvent(epoch_id, step_id,
+                                           metrics))
+                with RecordEvent("trainer/checkpoint"):
+                    self._maybe_save_checkpoint(executor, reader,
+                                                epoch_id)
+                if self.__preempted:
+                    break
+            if g is not None:
+                # epoch boundary: force every deferred guardian
+                # observation through the ladder while the recovery
+                # loop can still catch its decision
+                g.flush()
+            event_handler(EndEpochEvent(epoch_id))
+            if self.__preempted:
+                break
+        if g is not None:
+            g.flush()
+
+    def _make_guardian(self):
+        """The default wiring: a caller-installed guardian stays in
+        charge (returns None so the Trainer neither re-installs nor
+        uninstalls it); otherwise FLAGS_guardian / guardian_config
+        build one, quarantining next to the checkpoints unless
+        configured elsewhere."""
+        if self._guardian_config is not None \
+                and not _flags.flag("guardian"):
+            # explicit config implies intent: enable the flag so the
+            # executors lower the in-graph skip guard too.  Deferred to
+            # train() (not __init__) and restored when train() returns:
+            # programs run while no guardian is installed (this
+            # Trainer's startup, a later plain executor) must not be
+            # silently guarded
+            _flags.set_flags({"guardian": True})
+            self._set_guardian_flag = True
+        if _guardian.active() is not None:
+            return None
+        cfg = self._guardian_config
+        if cfg is None and not _flags.flag("guardian"):
+            return None
+        if isinstance(cfg, _guardian.Guardian):
+            g = cfg
+            # budgets/history are per-run: a reused instance must not
+            # carry a spent rollback budget into this train() (the
+            # kwargs path below builds a fresh Guardian each time)
+            g.reset_run_state()
+        else:
+            g = _guardian.Guardian(**dict(cfg or {}))
+        if not g.quarantine_dir \
+                and not _flags.flag("guardian_quarantine_dir") \
+                and self.checkpoint_cfg is not None:
+            g.quarantine_dir = os.path.join(
+                self.checkpoint_cfg.checkpoint_dir, "quarantine")
+        return g
+
+    def _rollback_recover(self, rb, executor, reader):
+        """One rung of the recovery ladder: charge the rollback budget,
+        restore the newest clean TrainState (skipping corrupt or
+        NaN-poisoned artifacts), re-apply executor PRNG counter and
+        reader position, and fast-forward the reader past a poisoned
+        batch window.  Returns the epoch to re-enter the loop at."""
+        g = _guardian.active()
+        if g is None:
+            raise rb
+        if self._ckpt_mgr is None:
+            raise _guardian.GuardianAbortError(
+                "guardian requested a rollback at step %d (%s) but the "
+                "Trainer has no CheckpointConfig — nothing to roll back "
+                "to" % (rb.step, rb.reason)) from rb
+        g.begin_rollback(rb)          # budget; raises when exhausted
+        executor.sync()               # retire in-flight async steps
+        readers = self._ckpt_readers(reader)
+        if reader is not None and not readers:
+            warnings.warn(
+                "guardian rollback cannot rewind this reader (no "
+                "state_dict — wrap it with reader.checkpointable()): "
+                "the replay re-enters the epoch from the reader's "
+                "current position, so the recovered trajectory will "
+                "NOT exactly reproduce the clean run")
+        restored = g.rollback_restore(
+            self._ckpt_mgr, rb, scope=self.scope,
+            program=self.train_program, executors={"train": executor},
+            readers=readers)
+        self._global_step = restored
+        if self.checkpoint_cfg is not None:
+            self.checkpoint_cfg.load_serial = restored
+        ff = g.post_restore(rb, restored)
+        if ff:
+            if hasattr(reader, "fast_forward"):
+                reader.fast_forward(ff)
+                monitor.log_event({"event": "guardian_fast_forward",
+                                   "batches": ff,
+                                   "restored_step": restored})
+            else:
+                warnings.warn(
+                    "guardian rollback wants to skip %d poisoned "
+                    "batches but the reader has no fast_forward() — "
+                    "wrap it with reader.checkpointable(); the replay "
+                    "may re-trip the sentinel" % ff)
+        if reader is not None and hasattr(reader, "state_dict"):
+            try:
+                return int(reader.state_dict().get(
+                    "epoch", self._current_epoch))
+            except Exception:  # noqa: BLE001 — epoch is best-effort
+                pass
+        return self._current_epoch
 
     def _apply_resume_state(self, executor, reader):
         """After an auto-resume, re-apply the non-scope legs of the

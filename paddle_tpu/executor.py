@@ -32,7 +32,7 @@ import numpy as np
 
 import jax
 
-from . import compile_cache, flags, monitor, registry
+from . import compile_cache, fault, flags, guardian, monitor, registry
 from .core import materialize_dtype
 from .framework import Program, Variable, default_main_program
 from .monitor import program_profile
@@ -200,12 +200,18 @@ def trace_program(program, feed_names, state_names, writeback, fetch_names,
 class _CompiledProgram:
     """One lowered+jitted (program, feed-signature) entry."""
 
-    def __init__(self, fn, feed_names, state_in, state_out, fetch_names):
+    def __init__(self, fn, feed_names, state_in, state_out, fetch_names,
+                 guarded=False):
         self.fn = fn
         self.feed_names = feed_names
         self.state_in = state_in      # read from scope before the step
         self.state_out = state_out    # written back to scope after
         self.fetch_names = fetch_names
+        # lowered with the guardian's in-graph skip guard: the step
+        # returns a trailing `ok` fetch (stripped before user fetches)
+        # and suppresses its state update when a float fetch is
+        # non-finite
+        self.guarded = guarded
         # feed signatures already dispatched through this entry.  jax.jit
         # retraces+recompiles per feed shape, and the entry is shared
         # process-globally (trace cache), so warmth is per-signature: an
@@ -332,6 +338,7 @@ class Executor:
         self.donate_state = donate_state
         self._cache = {}
         self._run_counter = 0
+        self._warned_unobserved_guard = False
         self._dispatch_queue = AsyncDispatchQueue(name="executor")
 
     # ------------------------------------------------------------------
@@ -420,10 +427,18 @@ class Executor:
                 program, feed_names, state_names, writeback, fetch_names,
                 platform=platform,
             )
+            guarded = guardian.skip_guard_enabled()
+            if guarded:
+                # in-graph sentinel + skip: non-finite float fetches
+                # suppress the whole state update on-device (the
+                # guardian's skip-step rung); baked into the trace key
+                # via trace_flag_values
+                fn = guardian.wrap_step_guard(fn, state_in, state_out)
             donate = (1,) if self.donate_state else ()
             jitted = jax.jit(fn, donate_argnums=donate)
         return compile_cache.store(tkey, _CompiledProgram(
-            jitted, feed_names, state_in, state_out, fetch_names))
+            jitted, feed_names, state_in, state_out, fetch_names,
+            guarded=guarded))
 
     # ------------------------------------------------------------------
     def run(
@@ -455,6 +470,15 @@ class Executor:
         # resident instead of re-crossing the host link every step)
         block = program.global_block()
         feed_vals = [_coerce_feed(block, n, feed[n]) for n in feed_names]
+
+        # this run's step index (the PRNG fold-in counter before this
+        # step bumps it): fault schedules and guardian records key on it
+        step_idx = self._run_counter
+        if fault.active():
+            # drills mutate feed_vals in place (poison_batch); shapes/
+            # dtypes are preserved, so the signature below is unaffected
+            fault.fire("executor/feed", step_idx,
+                       feed_names=feed_names, feed_vals=feed_vals)
 
         feed_sig = tuple(
             (n, tuple(v.shape), str(v.dtype))
@@ -501,6 +525,8 @@ class Executor:
             if (mon_t0 is not None or is_profiling()) else None
         span_args = {"run_id": monitor.run_id(), "fingerprint": fp[:12],
                      "step": self._run_counter - 1} if fp else None
+        if fault.active():
+            fault.fire("executor/dispatch", step_idx)
         with RecordEvent("executor/run"):
             with RecordEvent(step_span, args=span_args):
                 with jax.default_device(dev):
@@ -546,12 +572,28 @@ class Executor:
                             feed_dev, state_vals, rng)
         compiled.seen_sigs.add(feed_sig)
 
+        ok_flag = None
+        if compiled.guarded:
+            # the in-graph sentinel's verdict rides as a trailing fetch;
+            # user-visible fetches exclude it
+            ok_flag = fetches[-1]
+            fetches = fetches[:-1]
+
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
 
+        if fault.active():
+            fetches = list(fetches)
+            fault.fire("executor/step_done", step_idx, scope=scope,
+                       state_names=compiled.state_out,
+                       fetch_names=compiled.fetch_names, fetches=fetches)
+
         if flags.flag("check_nan_inf"):
-            _check_finite(zip(compiled.fetch_names, fetches))
-            _check_finite(zip(compiled.state_out, new_state))
+            ctx = lambda: "run_id=%s fp12=%s step=%d" % (  # noqa: E731
+                monitor.run_id(),
+                compile_cache.program_fingerprint(program)[:12], step_idx)
+            _check_finite(zip(compiled.fetch_names, fetches), context=ctx)
+            _check_finite(zip(compiled.state_out, new_state), context=ctx)
         if t0 is not None:
             jax.block_until_ready(new_state if new_state else fetches)
             print("[benchmark] step %.3f ms"
@@ -571,6 +613,16 @@ class Executor:
                 _batch_examples(block, feed_names, feed_vals),
                 len(self._dispatch_queue), device=dev,
                 warm=not cold, fingerprint=fp)
+        # guardian hook LAST (after telemetry): a ladder decision raises
+        # out of run() with this step's record already published.  One
+        # module-global read when no guardian is installed.
+        g = guardian.active()
+        if g is not None:
+            g.note_step("executor", step_idx, ok=ok_flag,
+                        fetch_names=compiled.fetch_names, fetches=fetches,
+                        feed=(feed_names, feed_vals), sync=return_numpy)
+        elif ok_flag is not None:
+            guardian.warn_unobserved_skip_guard(self)
         return fetches
 
     def cost_analysis(self, program=None, feed=None, fetch_list=None,
@@ -639,9 +691,12 @@ class Executor:
         return dict(ca)
 
 
-def _check_finite(named_vals):
+def _check_finite(named_vals, context=None):
     """FLAGS_check_nan_inf parity (operator.cc:31,717): verify every
-    floating output of the step; raise naming the first bad variable."""
+    floating output of the step; raise naming the first bad variable.
+    ``context`` (a callable, evaluated only on failure) adds the run_id
+    / program fingerprint / step index so the raise correlates with the
+    JSONL and trace records of the same step."""
     from .core import bfloat16
 
     for name, v in named_vals:
@@ -650,7 +705,14 @@ def _check_finite(named_vals):
             a = a.astype(np.float32)  # np.isfinite lacks a bf16 loop
         if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
             bad = "nan" if np.isnan(a).any() else "inf"
+            where = ""
+            if context is not None:
+                try:
+                    where = " [%s]" % (context() if callable(context)
+                                       else context)
+                except Exception:  # noqa: BLE001 — the raise must land
+                    pass
             raise RuntimeError(
-                "check_nan_inf: variable %r contains %s after step "
+                "check_nan_inf: variable %r contains %s after step%s "
                 "(enable FLAGS_debug_nans to localize the producing op)"
-                % (name, bad))
+                % (name, bad, where))

@@ -25,6 +25,15 @@ TPU-native semantics of the debugging flags:
 * ``cpu_deterministic`` — forces deterministic XLA reductions
   (``--xla_cpu_enable_fast_math=false`` analog) via jax config.
 * ``benchmark`` — per-step wall-clock logging in the executors.
+
+Robustness families (ISSUE 8): the ``FLAGS_guardian_*`` family
+configures the training-run guardian (``guardian.py``: in-graph NaN/Inf
+skip guard, loss spike/plateau detection, skip -> rollback -> abort
+recovery ladder with budgets, quarantine directory, watchdog-stall
+escalation) and the ``FLAGS_fault_*`` family installs deterministic
+fault-injection drills (``fault.py``: seed/step-indexed schedules for
+NaN vars, poisoned batches, dispatch delay/failure, mid-save kills)
+from a spec string — each flag is documented at its registration below.
 """
 
 import os
@@ -67,9 +76,19 @@ def set_flags(flags):
                 raise KeyError("unknown flag %r" % k)
             typ, on_set = _TYPES[name]
             v = _parse(v, typ) if isinstance(v, str) else typ(v)
+            prev = _FLAGS[name]
             _FLAGS[name] = v
             if on_set is not None:
-                on_set(v)
+                try:
+                    on_set(v)
+                except Exception:
+                    # a raising validator (guardian_policy, fault_spec,
+                    # ...) must not leave the rejected value readable
+                    # via flag().  Commit-then-rollback (not validate-
+                    # first) because reconcile-style hooks re-read
+                    # their own flag (_on_monitor_change).
+                    _FLAGS[name] = prev
+                    raise
 
 
 def get_flags(names):
@@ -193,3 +212,99 @@ register_flag("preflight_oom", "auto", str, _on_preflight_oom)
 # memory_stats()['bytes_limit']; useful in tests and on backends that
 # misreport capacity)
 register_flag("preflight_hbm_bytes", 0, int)
+
+
+def _on_guardian_policy(val):
+    # validate at set time: a typo'd rung ("rolback") silently dropping
+    # rollback from the ladder would defeat the operator's intent
+    bad = {t.strip() for t in str(val).split(",") if t.strip()} \
+        - {"skip", "rollback", "abort"}
+    if bad:
+        raise ValueError(
+            "FLAGS_guardian_policy tokens must be among "
+            "skip/rollback/abort, got %s" % sorted(bad))
+
+
+def _on_guardian_spike_action(val):
+    if str(val).strip() not in ("warn", "rollback", "off"):
+        raise ValueError(
+            "FLAGS_guardian_spike_action must be warn/rollback/off, "
+            "got %r" % (val,))
+
+
+# Training-run guardian (guardian.py): the master switch.  With it on,
+# the contrib Trainer installs a Guardian by default, both executors
+# feed it every step, and — when the policy ladder includes "skip" —
+# steps are lowered with the in-graph NaN/Inf guard (non-finite fetched
+# losses suppress the state update on-device).  Flipping it re-keys the
+# trace caches (the guard is baked into the jaxpr).  Disabled cost is
+# one flag/module-global read per step (A/B test-enforced).
+register_flag("guardian", False, bool)
+# the recovery ladder, ordered mildest-first: "skip" (in-graph drop of
+# the offending update + batch quarantine), "rollback" (restore the
+# newest clean TrainState and replay), "abort" (typed
+# GuardianAbortError once the rollback budget is spent).  Comma-joined
+# subset of skip/rollback/abort.
+register_flag("guardian_policy", "skip,rollback,abort", str,
+              _on_guardian_policy)
+# rolling-window size for the loss spike/plateau detector (median+MAD
+# over the last N finite losses)
+register_flag("guardian_window", 32, int)
+# spike threshold: |loss - median| / (1.4826*MAD) above this z-score is
+# an anomaly (robust z; 8 is far out on any well-behaved loss curve)
+register_flag("guardian_zmax", 8.0, float)
+# consecutive in-graph-skipped steps before the ladder escalates to
+# rollback (a burst of bad batches is data trouble, not a blip)
+register_flag("guardian_max_skips", 8, int)
+# rollback attempts before GuardianAbortError — the bound that turns
+# "recover forever" into a typed failure
+register_flag("guardian_max_rollbacks", 2, int)
+# where quarantined batches (offending feed + signature + run_id) are
+# written for repro ("" = record the signature in the event log only;
+# the contrib Trainer defaults this to <checkpoint_dir>/quarantine)
+register_flag("guardian_quarantine_dir", "", str)
+# what a detected loss spike does: "warn" (event+counter only),
+# "rollback" (escalate like a non-finite loss), "off"
+register_flag("guardian_spike_action", "warn", str,
+              _on_guardian_spike_action)
+# plateau detector window (0 = off): no median improvement across the
+# last N losses publishes a guardian_plateau event (advisory only)
+register_flag("guardian_plateau_steps", 0, int)
+# consecutive watchdog stall windows before the guardian arms a typed
+# abort (0 = never escalate stalls)
+register_flag("guardian_stall_escalations", 3, int)
+
+
+def _on_fault_spec(val):
+    # install drills straight from the environment/set_flags: the
+    # env-var entry point that makes a fault drill runnable against any
+    # existing script (FLAGS_fault_spec="nan_var:fc_0.w_0@5;..." ).
+    # install_from_spec REPLACES the previous spec's hooks, so the
+    # installed fault state always mirrors the flag value; an empty
+    # value disarms a previously set spec (nothing to disarm — and no
+    # reason to import fault — if fault.py was never imported).
+    if not str(val).strip():
+        import sys
+        fault = sys.modules.get(__name__.rsplit(".", 1)[0] + ".fault")
+        if fault is not None and hasattr(fault, "install_from_spec"):
+            fault.install_from_spec("")
+        return
+    from . import fault
+
+    if not hasattr(fault, "install_from_spec"):
+        # registration-time env override while fault.py is mid-import
+        # (fault -> flags -> this hook): fault installs the env spec
+        # itself at the end of its module body
+        return
+    fault.install_from_spec(val)
+
+
+# seed for probabilistic fault schedules (prob=...): two runs with the
+# same seed inject at identical steps.  Registered BEFORE fault_spec:
+# an env-set spec installs schedules at import, which read this flag.
+register_flag("fault_seed", 0, int)
+# deterministic fault-injection drills (fault.py), installed from a
+# spec string: family:arg@schedule[;...] — see fault.install_from_spec
+# for the grammar and drill families (nan_var, poison_batch, delay,
+# fail_dispatch, kill_save)
+register_flag("fault_spec", "", str, _on_fault_spec)

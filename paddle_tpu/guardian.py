@@ -1,0 +1,618 @@
+"""Training-run guardian (ISSUE 8 tentpole, part 1): anomaly sentinels
+plus an automatic recovery ladder over the TrainState checkpoints.
+
+PR 2-4 built the pieces — observability, exact-resume checkpoints, a
+watchdog — but every recovery was manual: a NaN step raised and killed
+the run, a loss spike waited for a human to read the JSONL.  The
+guardian closes the detect -> decide -> recover loop (the CheckFreq /
+Check-N-Run argument: the checkpoint subsystem's value is realized only
+when recovery is automatic and cheap; see PAPERS.md):
+
+**Sentinels**
+
+* an **in-graph NaN/Inf guard** (``wrap_step_guard``): when the policy
+  ladder includes ``skip``, the executors trace the step with a
+  finiteness check over the floating fetches (loss, grad-norm — the
+  fetched health signals) and *suppress the state update in-graph*
+  (``where(ok, new, old)``) when any is non-finite.  A poisoned batch
+  therefore never touches the parameters, the skip is exact (including
+  LR/step counters), and — because the decision happens on-device
+  before the host ever observes the loss — the post-recovery trajectory
+  is bit-identical whether the host runs synchronously or
+  ``return_numpy=False`` async (test-enforced).  Cost: one fused
+  ``isfinite``-reduce per float fetch + a select per state var.
+* a **host-side sentinel** in ``observe``/``note_step``: non-finite
+  observed losses that the in-graph guard could not prevent (already
+  NaN parameters, host-injected corruption) escalate straight to
+  rollback;
+* a **rolling-window spike/plateau detector**: median + MAD z-score
+  over the last ``window`` finite losses (robust to the very outliers
+  it hunts); spikes publish events and optionally roll back
+  (``spike_action``), plateaus publish events;
+* **stall escalation**: the guardian subscribes to the Watchdog's stall
+  firings (``monitor.add_stall_listener``); after
+  ``stall_escalations`` consecutive stall windows with no completed
+  step it arms an abort that the next observed step raises — a wedged
+  pipeline becomes a typed error, not an eternal hang.
+
+**Recovery ladder** (``policy``, default ``skip,rollback,abort``):
+
+1. *skip-step* — the in-graph guard drops the offending update; the
+   host quarantines the batch to disk (feed signature + run_id, for
+   repro) and counts it.  More than ``max_skips`` consecutive skips
+   escalate.
+2. *rollback* — raise ``GuardianRollback``; the driver (the contrib
+   Trainer, or any caller) restores the newest *clean* TrainState at or
+   below the failure (NaN-poisoned or corrupt artifacts are skipped),
+   rewinds the executor PRNG counter and reader position through the
+   PR 4 exact-resume machinery, and — when the failure was quarantined
+   batches — fast-forwards the reader past the poisoned window so the
+   replay makes progress instead of re-tripping.
+3. *abort* — after ``max_rollbacks`` rollbacks, raise
+   ``GuardianAbortError`` (typed; never an unbounded recover loop).
+
+Every decision is published: ``guardian/skipped_steps``,
+``guardian/rollbacks``, ``guardian/quarantined_batches``,
+``guardian/loss_spikes``, ``guardian/stall_escalations`` counters and
+``guardian_*`` JSONL events, all run_id-stamped so they join against
+step records, traces, and fault injections.
+
+Disabled cost is one module-global read per executor step
+(``active()`` is None), same contract as ``monitor.enabled()`` —
+A/B-test-enforced.
+"""
+
+import collections
+import json
+import os
+import time
+import warnings
+
+import numpy as np
+
+from . import flags
+
+__all__ = [
+    "Guardian", "GuardianRollback", "GuardianAbortError",
+    "install", "uninstall", "active", "installed",
+    "skip_guard_enabled", "wrap_step_guard",
+]
+
+
+class GuardianRollback(RuntimeError):
+    """Control-flow signal: the guardian decided the run must roll back
+    to the last clean checkpoint.  Carries the failing step index, the
+    reason, and whether quarantined batches implicate the data (the
+    replay then fast-forwards past the poisoned window)."""
+
+    def __init__(self, step, reason, quarantined=False):
+        super().__init__(
+            "guardian: rollback requested at step %d (%s)" % (step, reason))
+        self.step = int(step)
+        self.reason = reason
+        self.quarantined = bool(quarantined)
+
+
+class GuardianAbortError(RuntimeError):
+    """The recovery ladder is exhausted (rollback budget spent, no clean
+    checkpoint, or watchdog-stall escalation): the run must stop with a
+    typed error instead of looping or hanging."""
+
+
+def _policy_tokens(policy=None):
+    policy = policy if policy is not None else flags.flag("guardian_policy")
+    return tuple(t.strip() for t in str(policy).split(",") if t.strip())
+
+
+def skip_guard_enabled():
+    """Whether the executors lower steps with the in-graph skip guard:
+    the guardian flag is on and ``skip`` is in the policy ladder — the
+    INSTALLED guardian's ladder when one is active (an instance policy
+    of ``rollback,abort`` must not leave a flag-level skip guard
+    deciding differently), else ``FLAGS_guardian_policy``.  Baked into
+    the traced jaxpr, so it is part of
+    ``compile_cache.trace_flag_values()`` (and therefore of every
+    compile-cache key: installing a guardian re-keys, never serves a
+    stale unguarded trace)."""
+    if not flags.flag("guardian"):
+        return False
+    g = _ACTIVE
+    policy = g.policy if g is not None else _policy_tokens()
+    return "skip" in policy
+
+
+def wrap_step_guard(fn, state_in, state_out):
+    """Wrap a traced step function with the in-graph sentinel + skip:
+    ``ok`` = every floating fetch is finite; state vars that existed
+    before the step keep their OLD value when ``ok`` is false (the
+    update — params, optimizer slots, LR/step counters — is dropped
+    atomically); write-only outputs (first-step initializations) pass
+    through.  Returns ``fetches + [ok]``: the executors strip the
+    trailing ``ok`` and hand it to the active guardian."""
+    import jax.numpy as jnp
+
+    idx = {n: i for i, n in enumerate(state_in)}
+
+    def guarded(feed_vals, state_vals, key):
+        fetches, new_state = fn(feed_vals, state_vals, key)
+        ok = jnp.asarray(True)
+        for f in fetches:
+            if jnp.issubdtype(jnp.result_type(f), jnp.inexact):
+                ok = jnp.logical_and(ok, jnp.isfinite(f).all())
+        new_state = [
+            jnp.where(ok, nv, state_vals[idx[n]]) if n in idx else nv
+            for n, nv in zip(state_out, new_state)
+        ]
+        return list(fetches) + [ok], new_state
+
+    return guarded
+
+
+def warn_unobserved_skip_guard(executor):
+    """Called by an executor whose step came back with a guard verdict
+    (``ok`` fetch) while no guardian is installed to decide on it:
+    non-finite updates are being dropped on-device with no event,
+    counter, or budget.  Legal, but almost always a leaked
+    ``FLAGS_guardian`` — say so once per executor."""
+    if getattr(executor, "_warned_unobserved_guard", False):
+        return
+    executor._warned_unobserved_guard = True
+    warnings.warn(
+        "in-graph skip guard is active (FLAGS_guardian) but no "
+        "guardian is installed: non-finite updates are dropped "
+        "silently — install one (guardian.install / Trainer "
+        "guardian_config) or clear FLAGS_guardian")
+
+
+def _finite(a):
+    from .fault import _floatish
+
+    a = np.asarray(a)
+    if not np.issubdtype(a.dtype, np.floating):
+        if not _floatish(a.dtype):
+            return True              # integral state cannot go non-finite
+        # bf16/float8 etc. (ml_dtypes): np.isfinite lacks a loop
+        a = a.astype(np.float32)
+    return bool(np.isfinite(a).all())
+
+
+def _ready(v):
+    """Non-blocking readiness: numpy / None are ready; a jax Array is
+    ready when its device computation retired."""
+    if v is None:
+        return True
+    is_ready = getattr(v, "is_ready", None)
+    return True if is_ready is None else bool(is_ready())
+
+
+class Guardian:
+    """Per-run anomaly sentinel + recovery policy.  Construction reads
+    the ``FLAGS_guardian_*`` family; kwargs override per-instance (the
+    Trainer's ``guardian_config`` path).  ``install`` it (or pass it to
+    the Trainer) to have both executors feed it every step."""
+
+    def __init__(self, policy=None, window=None, zmax=None,
+                 max_skips=None, max_rollbacks=None, quarantine_dir=None,
+                 spike_action=None, plateau_steps=None,
+                 stall_escalations=None, loss_name=None):
+        self.policy = _policy_tokens(policy)
+        bad = set(self.policy) - {"skip", "rollback", "abort"}
+        if bad:
+            raise ValueError("unknown guardian policy tokens %s "
+                             "(know: skip, rollback, abort)" % sorted(bad))
+        self.window = int(window if window is not None
+                          else flags.flag("guardian_window"))
+        self.zmax = float(zmax if zmax is not None
+                          else flags.flag("guardian_zmax"))
+        self.max_skips = int(max_skips if max_skips is not None
+                             else flags.flag("guardian_max_skips"))
+        self.max_rollbacks = int(
+            max_rollbacks if max_rollbacks is not None
+            else flags.flag("guardian_max_rollbacks"))
+        self.quarantine_dir = (
+            quarantine_dir if quarantine_dir is not None
+            else flags.flag("guardian_quarantine_dir"))
+        self.spike_action = str(
+            spike_action if spike_action is not None
+            else flags.flag("guardian_spike_action"))
+        if self.spike_action not in ("warn", "rollback", "off"):
+            raise ValueError("spike_action must be warn/rollback/off, "
+                             "got %r" % self.spike_action)
+        self.plateau_steps = int(
+            plateau_steps if plateau_steps is not None
+            else flags.flag("guardian_plateau_steps"))
+        self.stall_escalations = int(
+            stall_escalations if stall_escalations is not None
+            else flags.flag("guardian_stall_escalations"))
+        self.loss_name = loss_name
+        self.reset_run_state()
+
+    def reset_run_state(self):
+        """Start a fresh run segment: detection history, budgets, and
+        counters are PER-RUN — a Guardian instance reused across
+        ``train()`` calls must not carry a spent rollback budget or an
+        armed stall abort into the next run (the Trainer calls this
+        when it re-installs a caller-provided instance)."""
+        # deferred observations: (step, ok handle, loss handle, feed)
+        # — drained when their device values are ready (non-blocking) or
+        # when the deque outgrows the dispatch window, so the async fast
+        # path keeps its overlap while decisions stay deterministic
+        # (the skip itself already happened in-graph)
+        self._pending = collections.deque()
+        # history must hold plateau_steps losses too: a plateau window
+        # longer than the spike window would otherwise never fill and
+        # the detector would be silently dead
+        self._history = collections.deque(
+            maxlen=max(4, self.window, self.plateau_steps))
+        self._consecutive_skips = 0
+        self._spike_run = 0          # consecutive spike-flagged steps
+        self._rollbacks = 0
+        self._stalls = 0
+        self._stall_abort = None
+        self._plateau_armed = True
+        self.skipped_steps = 0
+        self.quarantined = []        # [(step, reason)] this run segment
+
+    # -- executor hook -------------------------------------------------
+    def note_step(self, executor_name, step, ok=None, fetch_names=(),
+                  fetches=(), feed=None, sync=False):
+        """One executor step completed.  ``ok`` is the in-graph guard's
+        verdict handle (None when the guard is off), ``fetches`` the
+        user-visible fetch values (device arrays on the async path),
+        ``feed`` a ``(names, values)`` pair for quarantine.  Raises
+        ``GuardianRollback``/``GuardianAbortError`` per the policy
+        ladder — from inside ``run()``, so the training loop sees the
+        decision at the step that made it observable."""
+        if self._stall_abort is not None:
+            reason, self._stall_abort = self._stall_abort, None
+            raise GuardianAbortError(reason)
+        self._stalls = 0            # a completed step re-arms escalation
+        loss = self._watched_fetch(fetch_names, fetches)
+        self._pending.append((int(step), ok, loss, feed))
+        self._drain(force=sync)
+
+    def flush(self):
+        """Force-process every deferred observation (epoch boundaries,
+        end of run) — blocks on any not-yet-retired step handles.  The
+        ladder's exceptions can raise from here."""
+        self._drain(force=True)
+
+    def _watched_fetch(self, fetch_names, fetches):
+        if self.loss_name is not None:
+            for n, f in zip(fetch_names, fetches):
+                if n == self.loss_name:
+                    return f
+            return None
+        for f in fetches:
+            dt = getattr(f, "dtype", None)
+            if dt is not None and np.issubdtype(
+                    np.dtype(dt) if not isinstance(dt, np.dtype) else dt,
+                    np.inexact):
+                return f
+        return None
+
+    def _max_pending(self):
+        return max(1, int(flags.flag("max_inflight_steps")))
+
+    def _drain(self, force):
+        while self._pending:
+            step, ok, loss, feed = self._pending[0]
+            if not force and len(self._pending) <= self._max_pending() \
+                    and not (_ready(ok) and _ready(loss)):
+                return
+            self._pending.popleft()
+            self._process(step, ok, loss, feed)
+
+    # -- decision core -------------------------------------------------
+    def _process(self, step, ok, loss, feed):
+        ok_v = None if ok is None else bool(np.asarray(ok))
+        if ok_v is False:
+            self._on_skip(step, feed)
+            return
+        if loss is not None and not _finite(loss):
+            self._on_nonfinite(step, feed)
+            return
+        self._consecutive_skips = 0
+        if loss is not None:
+            self._observe_loss(step, float(np.mean(np.asarray(
+                loss, dtype=np.float64))))
+
+    def _on_skip(self, step, feed):
+        self.skipped_steps += 1
+        self._consecutive_skips += 1
+        self._counter("guardian/skipped_steps")
+        q = self._quarantine(step, feed, "nonfinite_in_graph")
+        self._event({"event": "guardian_skip", "step": step,
+                     "consecutive": self._consecutive_skips,
+                     "quarantine": q})
+        if self._consecutive_skips > self.max_skips:
+            self._escalate(step,
+                           "%d consecutive in-graph skips exceed the "
+                           "skip budget (%d)"
+                           % (self._consecutive_skips, self.max_skips),
+                           quarantined=True)
+
+    def _on_nonfinite(self, step, feed):
+        q = self._quarantine(step, feed, "nonfinite_observed")
+        self._event({"event": "guardian_nonfinite", "step": step,
+                     "quarantine": q})
+        # the update already reached the scope (no in-graph guard, or
+        # corruption past it): skipping cannot help — escalate
+        self._escalate(step, "non-finite loss observed", quarantined=False)
+
+    def _observe_loss(self, step, loss):
+        hist = self._history
+        if len(hist) >= max(8, self.window // 2) and self.zmax > 0 \
+                and self.spike_action != "off":
+            # the deque may hold plateau_steps > window losses; the
+            # spike baseline stays the last `window` of them
+            base = np.asarray(list(hist)[-self.window:])
+            med = float(np.median(base))
+            mad = float(np.median(np.abs(base - med)))
+            # the dispersion floor is RELATIVE to the loss scale: a
+            # saturated window (MAD 0, e.g. a memorized or clamped
+            # loss) must not turn float-noise fluctuations into
+            # z ~ 1e4 spikes — below ~0.1% of the level there is no
+            # anomaly to detect
+            denom = 1.4826 * mad + 1e-4 * max(1.0, abs(med))
+            # one-sided: only an UPWARD move is an anomaly — a sharp
+            # improvement (LR-decay boundary, curriculum switch) is
+            # healthy and enters the baseline like any other loss
+            z = (loss - med) / denom
+            floor = 1e-6 * max(1.0, abs(med))
+            if z > self.zmax and loss - med > floor:
+                self._spike_run += 1
+                self._counter("guardian/loss_spikes")
+                self._event({"event": "guardian_loss_spike", "step": step,
+                             "loss": loss, "median": med, "mad": mad,
+                             "z": round(z, 2), "action": self.spike_action})
+                if self.spike_action == "rollback":
+                    self._escalate(step,
+                                   "loss spike z=%.1f (%.4g above %.4g "
+                                   "over MAD %.4g)" % (z, loss, med, mad),
+                                   quarantined=False)
+                if self._spike_run <= max(2, self.window // 2):
+                    return           # outliers stay out of the baseline
+                # ... but boundedly: a level that persists for half a
+                # window is the run's new regime, not a spike — restart
+                # the baseline at it instead of flagging every remaining
+                # step of the run against a frozen pre-shift median
+                self._event({"event": "guardian_spike_baseline_reset",
+                             "step": step, "loss": loss,
+                             "outlier_run": self._spike_run})
+                hist.clear()
+                self._plateau_armed = True
+            self._spike_run = 0
+        hist.append(loss)
+        self._check_plateau(step)
+
+    def _check_plateau(self, step):
+        n = self.plateau_steps
+        if n <= 0 or len(self._history) < n:
+            return
+        recent = list(self._history)[-n:]
+        first = float(np.median(recent[: n // 2]))
+        second = float(np.median(recent[n // 2:]))
+        improving = (first - second) > 1e-4 * max(1.0, abs(first))
+        if improving:
+            self._plateau_armed = True
+        elif self._plateau_armed:
+            self._plateau_armed = False    # fire once per plateau
+            self._counter("guardian/plateaus")
+            self._event({"event": "guardian_plateau", "step": step,
+                         "window": n, "median_first_half": first,
+                         "median_second_half": second})
+
+    def _escalate(self, step, reason, quarantined):
+        if "rollback" in self.policy:
+            raise GuardianRollback(step, reason, quarantined=quarantined)
+        raise GuardianAbortError(
+            "guardian: %s at step %d and the policy ladder %r has no "
+            "rollback rung" % (reason, step, ",".join(self.policy)))
+
+    # -- rollback protocol (driven by the Trainer or any caller) -------
+    def begin_rollback(self, rb):
+        """Charge one rollback against the budget (raises
+        ``GuardianAbortError`` when exhausted) before any restore work
+        starts — the budget bounds ATTEMPTS, not successes."""
+        self._rollbacks += 1
+        self._counter("guardian/rollbacks")
+        if self._rollbacks > self.max_rollbacks:
+            raise GuardianAbortError(
+                "guardian: rollback budget (%d) exhausted at step %d "
+                "(%s) — the fault persists across recoveries; aborting "
+                "instead of looping" % (self.max_rollbacks, rb.step,
+                                        rb.reason))
+
+    def rollback_restore(self, manager, rb, scope=None, program=None,
+                         executors=None, readers=None, shardings=None):
+        """Restore the newest CLEAN TrainState at or below the failed
+        step: artifacts that are corrupt (checksum) or poisoned
+        (non-finite arrays — a checkpoint taken after the corruption
+        landed) are skipped with an event; a structural mismatch still
+        raises (configuration error, not a fault).  Returns the
+        restored step or raises ``GuardianAbortError`` when no clean
+        artifact exists."""
+        from .parallel.checkpoint import CheckpointCorruptError
+
+        candidates = [s for s in manager.all_steps() if s <= rb.step]
+        for s in reversed(candidates):
+            # validate WITHOUT applying: a rejected artifact must leave
+            # no side effects — no scope mutation, no
+            # checkpoint_restored event, no save-cadence reseed — and
+            # the no-clean-artifact abort below must leave the
+            # pre-rollback state in place.  A structural mismatch out
+            # of restore() still raises (configuration error, not a
+            # fault).
+            try:
+                ts = manager.load(s)
+            except CheckpointCorruptError as e:
+                self._event({"event": "guardian_checkpoint_skipped",
+                             "step": s, "reason": "corrupt",
+                             "detail": str(e)})
+                continue
+            if not all(_finite(a) for a in ts.arrays.values()):
+                self._counter("guardian/poisoned_checkpoints")
+                self._event({"event": "guardian_checkpoint_skipped",
+                             "step": s, "reason": "nonfinite_state"})
+                continue
+            restored = manager.restore(
+                step=s, scope=scope, program=program,
+                executors=executors, readers=readers,
+                shardings=shardings, train_state=ts)
+            self._event({"event": "guardian_rollback", "step": rb.step,
+                         "reason": rb.reason, "restored_step": restored,
+                         "rollbacks": self._rollbacks,
+                         "quarantined": rb.quarantined})
+            return restored
+        raise GuardianAbortError(
+            "guardian: rollback requested at step %d (%s) but no clean "
+            "checkpoint exists at or below it" % (rb.step, rb.reason))
+
+    def post_restore(self, rb, restored_step):
+        """Reset detection state after a successful restore and return
+        how many batches the reader should fast-forward: past the
+        poisoned window (``failed - restored`` batches, ending just
+        after the quarantined batch) when the failure implicates the
+        data, else 0 (transient fault: the replay re-consumes the same
+        batches and — by the exact-resume contract — reproduces the
+        clean trajectory)."""
+        self._pending.clear()
+        self._history.clear()
+        self._consecutive_skips = 0
+        self._spike_run = 0
+        self._plateau_armed = True
+        if rb.quarantined:
+            return max(0, rb.step + 1 - int(restored_step))
+        return 0
+
+    # -- watchdog escalation -------------------------------------------
+    def _on_stall(self, diag):
+        """monitor stall-listener: called from the watchdog thread at
+        each stall firing.  Arms an abort after ``stall_escalations``
+        consecutive firings with no completed step; the next observed
+        step raises it (a thread-safe flag — raising from the watchdog
+        thread could not unwind the training loop anyway, and a FULLY
+        wedged device needs the external supervisor either way)."""
+        self._stalls += 1
+        if self._stalls >= self.stall_escalations > 0 \
+                and self._stall_abort is None:
+            self._counter("guardian/stall_escalations")
+            self._event({"event": "guardian_stall_escalated",
+                         "stalls": self._stalls,
+                         "stalled_for_s": diag.get("stalled_for_s")})
+            self._stall_abort = (
+                "guardian: watchdog reported %d consecutive stall "
+                "windows (%.0fs each) with no completed step — pipeline "
+                "wedged" % (self._stalls,
+                            diag.get("stall_seconds", 0.0)))
+
+    # -- quarantine ----------------------------------------------------
+    def _quarantine(self, step, feed, reason):
+        """Persist the offending batch + its feed signature for repro;
+        returns the quarantine record (path None when no dir is
+        configured — the event still carries the signature)."""
+        from . import monitor
+
+        self.quarantined.append((int(step), reason))
+        self._counter("guardian/quarantined_batches")
+        rec = {"run_id": monitor.run_id(), "step": int(step),
+               "reason": reason, "ts": time.time(), "path": None}
+        if feed is not None:
+            names, vals = feed
+            rec["feed_signature"] = [
+                (n, list(np.shape(v)), str(np.asarray(v).dtype))
+                for n, v in zip(names, vals)]
+            if self.quarantine_dir:
+                os.makedirs(self.quarantine_dir, exist_ok=True)
+                base = os.path.join(
+                    self.quarantine_dir,
+                    "batch_%s_step%08d" % (monitor.run_id(), int(step)))
+                # positional npz members + a name list in the sidecar
+                # (same scheme as TrainState artifacts: npz member names
+                # can't carry '/' etc. across numpy versions)
+                with open(base + ".npz", "wb") as f:
+                    np.savez(f, **{"arr_%d" % i: np.asarray(v)
+                                   for i, v in enumerate(vals)})
+                rec["feed_names"] = list(names)
+                rec["path"] = base + ".npz"
+                with open(base + ".json", "w") as f:
+                    json.dump(rec, f)
+        return rec
+
+    # -- publication helpers -------------------------------------------
+    @staticmethod
+    def _counter(name):
+        from . import monitor
+
+        monitor.count(name)
+
+    @staticmethod
+    def _event(rec):
+        from . import monitor
+
+        rec.setdefault("ts", time.time())
+        monitor.log_event(rec)
+
+    def stats(self):
+        return {"skipped_steps": self.skipped_steps,
+                "rollbacks": self._rollbacks,
+                "quarantined": len(self.quarantined),
+                "pending": len(self._pending),
+                "window": list(self._history)}
+
+
+# ---------------------------------------------------------------------------
+# process-global installation (the executors' one-read hook)
+# ---------------------------------------------------------------------------
+
+_ACTIVE = None
+
+
+def active():
+    """The installed Guardian, or None — the executors' per-step gate
+    (one module-global read when no guardian is installed)."""
+    return _ACTIVE
+
+
+def install(g):
+    """Install ``g`` as the process guardian: both executors feed it
+    every step, and it subscribes to watchdog stall firings.  Returns
+    ``g``."""
+    global _ACTIVE
+    from . import monitor
+
+    if _ACTIVE is not None and _ACTIVE is not g:
+        monitor.remove_stall_listener(_ACTIVE._on_stall)
+    _ACTIVE = g
+    monitor.add_stall_listener(g._on_stall)
+    return g
+
+
+def uninstall():
+    """Remove the installed guardian (its deferred observations are NOT
+    flushed — call ``flush()`` first if the ladder should still fire)."""
+    global _ACTIVE
+    from . import monitor
+
+    if _ACTIVE is not None:
+        monitor.remove_stall_listener(_ACTIVE._on_stall)
+    _ACTIVE = None
+
+
+class installed:
+    """Context manager: install ``g`` for the duration (no-op when
+    ``g`` is None — the Trainer's disabled path)."""
+
+    def __init__(self, g):
+        self._g = g
+
+    def __enter__(self):
+        if self._g is not None:
+            install(self._g)
+        return self._g
+
+    def __exit__(self, *exc):
+        if self._g is not None and _ACTIVE is self._g:
+            uninstall()
+        return False

@@ -45,8 +45,8 @@ __all__ = [
     "start_http_server", "Watchdog",
     "enable", "disable", "enabled", "registry", "step_stats",
     "expose_text", "record_step", "observe_span", "mark", "heartbeat",
-    "last_span", "queue_states", "track", "log_event", "run_id",
-    "sample_device_gauges",
+    "last_span", "queue_states", "track", "log_event", "count", "run_id",
+    "sample_device_gauges", "add_stall_listener", "remove_stall_listener",
 ]
 
 # fast-path gate: a module-global bool read (no lock, no flag lookup) is
@@ -276,6 +276,15 @@ def log_event(record):
     if j is not None:
         record.setdefault("run_id", _RUN_ID)
         j.write(record)
+
+
+def count(name, amount=1):
+    """Increment a counter iff the monitor is on — the one shared
+    enabled-gated increment for decision-trail counters (guardian,
+    fault harness, master reconnects), so the disabled-is-free
+    contract lives in one place."""
+    if _enabled:
+        _registry.counter(name).inc(amount)
 
 
 # ---------------------------------------------------------------------------
@@ -536,12 +545,37 @@ def _import_cc_stats():
     return compile_cache.stats()
 
 
+# stall-escalation subscribers (the guardian registers here): each
+# watchdog firing is fanned out so a policy layer can COUNT stalls and
+# escalate, without the watchdog itself ever deciding anything
+_stall_listeners = []
+
+
+def add_stall_listener(fn):
+    """Subscribe ``fn(diagnostic_dict)`` to watchdog stall firings
+    (called from the watchdog thread; must not raise for long-term
+    health — exceptions are swallowed like any diagnostics failure)."""
+    if fn not in _stall_listeners:
+        _stall_listeners.append(fn)
+
+
+def remove_stall_listener(fn):
+    if fn in _stall_listeners:
+        _stall_listeners.remove(fn)
+
+
 def _stall_sink(diag):
     _registry.counter("monitor/watchdog_stalls").inc()
     log_event(diag)
     print("[monitor] WATCHDOG: no step completed in %.1fs — pipeline "
           "stalled?\n%s" % (diag["stalled_for_s"], _format_diag(diag)),
           file=sys.stderr, flush=True)
+    for fn in list(_stall_listeners):
+        try:
+            fn(diag)
+        except Exception as e:  # noqa: BLE001 — escalation must not
+            print("[monitor] stall listener failed: %r" % e,  # kill the
+                  file=sys.stderr, flush=True)                # watchdog
 
 
 def _format_diag(diag):

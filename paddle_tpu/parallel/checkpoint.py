@@ -38,7 +38,7 @@ import warnings
 import jax
 import numpy as np
 
-from .. import monitor
+from .. import fault, monitor
 from ..profiler import RecordEvent
 from ..scope import global_scope
 
@@ -206,12 +206,13 @@ class ShardedCheckpointManager:
 
 TRAIN_STATE_FORMAT = 1
 
-# fault-injection points for the kill-and-resume drill
-# (tests/test_elastic_drill.py): each hook, when set to a callable, runs
-# at the named point of the write protocol with the step as argument —
-# e.g. ``os.kill(os.getpid(), SIGKILL)`` in "before_commit" simulates
-# preemption mid-save, leaving only a .tmp dir the restore must ignore.
-_FAULT_HOOKS = {}
+# Fault-injection points for the kill-and-resume drills live in the
+# process-wide registry (``paddle_tpu.fault``): the write protocol
+# fires ``checkpoint/before_write`` / ``checkpoint/after_write`` /
+# ``checkpoint/before_commit`` with the artifact's step — e.g.
+# ``fault.kill_mid_save(FaultSchedule(steps=[11]))`` simulates
+# preemption mid-save, leaving only a .tmp dir the restore must ignore
+# (tests/test_elastic_drill.py).
 
 _ARRAYS_FILE = "arrays.npz"
 _HOST_FILE = "train_state.json"
@@ -413,12 +414,6 @@ def _fsync_dir(path):
         os.close(fd)
 
 
-def _run_hook(name, step):
-    hook = _FAULT_HOOKS.get(name)
-    if hook is not None:
-        hook(step)
-
-
 def save_train_state(dirname, ts):
     """Write ``ts`` as one atomic artifact: arrays.npz + train_state.json
     + a sha256 MANIFEST, assembled in a ``.tmp`` sibling and committed
@@ -433,7 +428,7 @@ def save_train_state(dirname, ts):
     shutil.rmtree(tmp, ignore_errors=True)
     os.makedirs(tmp)
     try:
-        _run_hook("before_write", ts.step)
+        fault.fire("checkpoint/before_write", ts.step)
         encoded, raw_dtypes = {}, {}
         for n, a in ts.arrays.items():
             encoded[n], logical = _npz_encode(a)
@@ -456,7 +451,7 @@ def save_train_state(dirname, ts):
             json.dump(host, f)
             f.flush()
             os.fsync(f.fileno())
-        _run_hook("after_write", ts.step)
+        fault.fire("checkpoint/after_write", ts.step)
         manifest = {
             "format": TRAIN_STATE_FORMAT,
             "step": ts.step,
@@ -471,7 +466,7 @@ def save_train_state(dirname, ts):
             json.dump(manifest, f)
             f.flush()
             os.fsync(f.fileno())
-        _run_hook("before_commit", ts.step)
+        fault.fire("checkpoint/before_commit", ts.step)
         # the commit point: everything before it is invisible to
         # restores.  Re-saving an existing step renames the old
         # artifact aside first (as a .tmp sibling, reclaimed by the
@@ -686,20 +681,33 @@ class TrainStateCheckpointManager:
         self._reraise()
 
     # -- restore -------------------------------------------------------
+    def load(self, step):
+        """Read + VALIDATE the artifact at ``step`` without applying it
+        — pre-restore inspection (the guardian's poisoned-checkpoint
+        scan rejects artifacts before they touch live state).  Raises
+        ``CheckpointCorruptError`` on a corrupt/partial artifact."""
+        return load_train_state(self._step_dir(step))
+
     def restore(self, scope=None, program=None, executors=None,
-                readers=None, step=None, shardings=None, strict=True):
+                readers=None, step=None, shardings=None, strict=True,
+                train_state=None):
         """Restore ``step`` (default: newest VALID artifact, falling
         back past corrupt/partial ones with a warning).  Returns the
         restored step index, or None when no usable checkpoint exists;
         the full ``TrainState`` stays readable as ``last_restored``
         (the Trainer applies executor/reader state from it after it
-        builds those objects)."""
+        builds those objects).  ``train_state``: a TrainState already
+        read by ``load(step)`` — skips the second disk read/checksum of
+        that exact artifact (requires ``step``; the guardian's restore
+        scan pre-validates artifacts this way)."""
         self.wait_until_finished()
         candidates = [step] if step is not None \
             else list(reversed(self.all_steps()))
         for s in candidates:
             try:
-                ts = load_train_state(self._step_dir(s))
+                ts = train_state if (train_state is not None
+                                     and step is not None) \
+                    else load_train_state(self._step_dir(s))
                 restored = apply_train_state(
                     ts, scope=scope, program=program, executors=executors,
                     readers=readers, shardings=shardings, strict=strict)

@@ -33,7 +33,7 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from .. import compile_cache, flags, monitor, registry  # noqa: F401  (op registry must be loaded)
+from .. import compile_cache, fault, flags, guardian, monitor, registry  # noqa: F401  (op registry must be loaded)
 from ..executor import (AsyncDispatchQueue, trace_program, Executor,
                         _batch_examples, _check_finite)
 from ..monitor import program_profile
@@ -55,7 +55,7 @@ _DEFAULT_SPEC_LAYOUT = SpecLayout()
 class _Compiled:
     def __init__(self, fn, feed_names, state_in, state_out, fetch_names,
                  feed_shardings, state_shardings, out_state_shardings,
-                 partition_key=None):
+                 partition_key=None, guarded=False):
         self.fn = fn
         self.feed_names = feed_names
         self.state_in = state_in
@@ -68,6 +68,9 @@ class _Compiled:
         # same program compiled replicated vs fsdp-sharded has ~N-times
         # different per-device memory analyses — separate profile slots
         self.partition_key = partition_key
+        # lowered with the guardian's in-graph skip guard (trailing ok
+        # fetch; see executor._CompiledProgram)
+        self.guarded = guarded
         self.warm = False      # first dispatch = trace+compile (see Executor)
         # AOT-captured executable (one per entry: the trace-cache key
         # already pins the feed signature + mesh); set by profile
@@ -95,6 +98,7 @@ class ParallelExecutor:
         self._trainer_id = trainer_id
         self._cache = {}
         self._run_counter = 0
+        self._warned_unobserved_guard = False
         self._auto_seed_val = None
         self._dispatch_queue = AsyncDispatchQueue(name="parallel_executor")
         # observability: how many ragged batches were replication-padded
@@ -302,12 +306,21 @@ class ParallelExecutor:
         if self._build_strategy.remat:
             fn = jax.checkpoint(fn)
 
+        guarded = guardian.skip_guard_enabled()
+        if guarded:
+            # in-graph sentinel + skip (see executor._lower); wrapped
+            # OUTSIDE remat so the guard's select is not rematerialized
+            fn = guardian.wrap_step_guard(fn, state_in, state_out)
+
         donate = (1,) if self._build_strategy.donate_state else ()
         # multi-host: fetches are forced replicated so every process can
         # read them (np.asarray on a non-addressable array would throw)
         fetch_shardings = None
         if jax.process_count() > 1:
-            fetch_shardings = [NamedSharding(mesh, P())] * len(fetch_names)
+            # +1: the guard's trailing ok fetch is a scalar every
+            # process must be able to read too
+            fetch_shardings = [NamedSharding(mesh, P())] \
+                * (len(fetch_names) + (1 if guarded else 0))
         # jax.jit here is lazy (tracing deferred to the first call): no
         # span — the real jaxpr cost is the trace_program above
         jitted = jax.jit(
@@ -322,7 +335,8 @@ class ParallelExecutor:
         return compile_cache.store(tkey, _Compiled(
             jitted, feed_names, state_in, state_out,
             fetch_names, feed_shardings, state_shardings,
-            out_state_shardings, partition_key=partition_key))
+            out_state_shardings, partition_key=partition_key,
+            guarded=guarded))
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -402,6 +416,17 @@ class ParallelExecutor:
                 v = v.astype(pv.dtype)
             feed_vals.append(v)
 
+        # this run's step index (before the PRNG fold-in counter bumps):
+        # fault schedules and guardian records key on it
+        step_idx = self._run_counter
+        if fault.active():
+            fault.fire("executor/feed", step_idx,
+                       feed_names=feed_names, feed_vals=feed_vals)
+
+        # the guardian quarantines the batch AS FED (post-drill, pre-pad):
+        # a replayed quarantine artifact must match what the reader
+        # yielded, not the mesh-padded copy
+        user_feed_vals = feed_vals
         pad_r = 1
         if self._build_strategy.pad_uneven_batches:
             feed_vals, pad_r = self._pad_uneven(feed_vals)
@@ -467,6 +492,8 @@ class ParallelExecutor:
             if (mon_t0 is not None or is_profiling()) else None
         span_args = {"run_id": monitor.run_id(), "fingerprint": fp[:12],
                      "step": self._run_counter - 1} if fp else None
+        if fault.active():
+            fault.fire("executor/dispatch", step_idx)
         with RecordEvent("parallel_executor/run"):
             with RecordEvent(step_span, args=span_args):
                 if not compiled.warm and program_profile.capture_enabled() \
@@ -499,8 +526,20 @@ class ParallelExecutor:
                                                      rng)
         compiled.warm = True
 
+        ok_flag = None
+        if compiled.guarded:
+            # the in-graph sentinel's verdict rides as a trailing fetch
+            ok_flag = fetches[-1]
+            fetches = fetches[:-1]
+
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
+
+        if fault.active():
+            fetches = list(fetches)
+            fault.fire("executor/step_done", step_idx, scope=scope,
+                       state_names=compiled.state_out,
+                       fetch_names=compiled.fetch_names, fetches=fetches)
         if pad_r > 1:
             # trim per-sample fetches (e.g. predictions [B*r, ...]) back
             # to the true batch; scalars/means are replication-invariant.
@@ -528,7 +567,12 @@ class ParallelExecutor:
             # back device arrays (the check implies a per-step sync, not
             # a type change).
             np_fetches = [self._fetch_to_np(f) for f in fetches]
-            _check_finite(zip(compiled.fetch_names, np_fetches))
+            _check_finite(
+                zip(compiled.fetch_names, np_fetches),
+                context=lambda: "run_id=%s fp12=%s step=%d" % (
+                    monitor.run_id(),
+                    compile_cache.program_fingerprint(program)[:12],
+                    step_idx))
         if return_numpy:
             with RecordEvent("parallel_executor/fetch_sync"):
                 fetches = np_fetches if np_fetches is not None else \
@@ -554,6 +598,16 @@ class ParallelExecutor:
             monitor.sample_device_gauges(
                 [d for d in self._mesh.devices.flat
                  if d.process_index == jax.process_index()])
+        # guardian hook LAST (after telemetry); one module-global read
+        # when no guardian is installed
+        g = guardian.active()
+        if g is not None:
+            g.note_step("parallel_executor", step_idx, ok=ok_flag,
+                        fetch_names=compiled.fetch_names, fetches=fetches,
+                        feed=(feed_names, user_feed_vals),
+                        sync=return_numpy)
+        elif ok_flag is not None:
+            guardian.warn_unobserved_skip_guard(self)
         return fetches
 
     def sync(self):

@@ -89,6 +89,7 @@ class CheckpointableReader:
         self._creator = reader_creator
         self._epoch = 0
         self._offset = 0
+        self._skip_debt = 0      # fast-forward remainder; spans epochs
 
     def state_dict(self):
         return {"epoch": self._epoch, "offset": self._offset}
@@ -96,6 +97,23 @@ class CheckpointableReader:
     def load_state_dict(self, state):
         self._epoch = int(state["epoch"])
         self._offset = int(state["offset"])
+        # the restored position is authoritative: pending fast-forward
+        # debt from before the restore would skip healthy batches AT
+        # the restored position (the rollback protocol re-applies its
+        # own fast_forward after the restore)
+        self._skip_debt = 0
+
+    def fast_forward(self, n):
+        """Advance the position ``n`` items WITHOUT yielding them — the
+        guardian's rollback-recovery uses this to jump past a poisoned
+        window (quarantined batches that would deterministically re-trip
+        the sentinel on replay).  Takes effect at the next iteration(s):
+        unlike the saved ``offset`` (whose overshoot of a SHRUNK source
+        resets at the epoch boundary), a fast-forward that overshoots
+        the epoch carries its remainder into the next epoch — the
+        poisoned window must be skipped, however the epochs fall."""
+        self._skip_debt += max(0, int(n))
+        return self._offset + self._skip_debt
 
     def __call__(self):
         it = iter(self._creator())
@@ -109,6 +127,17 @@ class CheckpointableReader:
                 self._epoch += 1
                 self._offset = 0
                 return
+        while self._skip_debt:
+            try:
+                next(it)
+            except StopIteration:
+                # the skip spans the epoch boundary: roll the epoch,
+                # keep the remaining debt for the next iterator
+                self._epoch += 1
+                self._offset = 0
+                return
+            self._skip_debt -= 1
+            self._offset += 1
         for item in it:
             self._offset += 1
             yield item

@@ -300,3 +300,31 @@ def test_subprocess_worker_killed_midtask_job_completes(tmp_path):
         assert leased_id in finished
     finally:
         server.shutdown()
+
+
+def test_client_backoff_budget_exhausts_with_clear_error():
+    """ISSUE 8 satellite: the reconnect loop backs off exponentially
+    (bounded by max_retry_interval), counts reconnect attempts into the
+    master/reconnects monitor counter, and a spent budget raises a
+    ConnectionError naming the endpoint and attempt count instead of
+    retrying forever."""
+    from paddle_tpu import monitor
+
+    monitor.enable()
+    try:
+        c = MasterClient("127.0.0.1:1", retry_interval=0.01,
+                         max_retries=4, max_retry_interval=0.05,
+                         jitter=0.0)
+        t0 = time.perf_counter()
+        with pytest.raises(ConnectionError) as ei:
+            c.ping()
+        elapsed = time.perf_counter() - t0
+        msg = str(ei.value)
+        assert "after 4 attempts" in msg
+        assert "127.0.0.1:1" in msg
+        # exponential: 0.01 + 0.02 + 0.04 (capped), no trailing sleep
+        assert elapsed < 2.0
+        assert monitor.registry().get("master/reconnects").value == 3
+    finally:
+        monitor.disable()
+        monitor.registry().reset()

@@ -8,9 +8,11 @@ every K steps, logging every step's loss bit-pattern.  The drill:
 * ``kill_mode=step``: the trainer SIGKILLs ITSELF at a step-indexed
   point (no load-based timing — this replaces the flaky lease-timeout
   drill) — death mid-run, between checkpoint boundaries;
-* ``kill_mode=save``: a ``checkpoint._FAULT_HOOKS['before_commit']``
-  hook SIGKILLs during the background write — death mid-save, leaving
-  a torn .tmp artifact the restore must ignore;
+* ``kill_mode=save``: a ``fault.kill_mid_save`` drill (the public
+  ``paddle_tpu.fault`` registry, scheduled at the checkpoint's
+  ``before_commit`` point) SIGKILLs during the background write —
+  death mid-save, leaving a torn .tmp artifact the restore must
+  ignore;
 * deliberate corruption: the latest committed artifact is garbled on
   disk; restore must fall back to the previous step, not crash.
 
@@ -37,6 +39,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, sys.argv[6])
 import numpy as np
 import paddle_tpu as fluid
+from paddle_tpu import fault
 from paddle_tpu.parallel import checkpoint as ck
 from paddle_tpu.reader import checkpointable
 
@@ -66,10 +69,9 @@ def data_reader():
 reader = checkpointable(data_reader)
 
 if kill_mode == "save" and kill_step:
-    def _die_mid_save(step):
-        if step == kill_step:
-            os.kill(os.getpid(), signal.SIGKILL)
-    ck._FAULT_HOOKS["before_commit"] = _die_mid_save
+    # mid-save preemption through the public fault registry: SIGKILL at
+    # the write protocol's before_commit point, step-indexed
+    fault.kill_mid_save(fault.FaultSchedule(steps=[kill_step]))
 
 scope = fluid.Scope()
 with fluid.scope_guard(scope):

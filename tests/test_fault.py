@@ -1,0 +1,329 @@
+"""Deterministic fault-injection harness (ISSUE 8): schedules are pure
+functions of (seed, step), drills install through the public
+``paddle_tpu.fault`` API (registry, helpers, or the FLAGS_fault_spec
+string), and two runs with the same schedule inject at identical
+points — the property that makes a fault drill a regression test.
+The mid-save kill family is additionally drilled end-to-end (subprocess
+SIGKILL) by tests/test_elastic_drill.py."""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import fault
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_registry():
+    fault.clear()
+    fault.clear_injections()
+    yield
+    fault.clear()
+    fault.clear_injections()
+
+
+# ---------------------------------------------------------------------------
+# schedules
+# ---------------------------------------------------------------------------
+
+def test_schedule_forms_and_determinism():
+    s = fault.FaultSchedule(steps=[3, 7])
+    assert [i for i in range(10) if s.fires(i)] == [3, 7]
+
+    p = fault.FaultSchedule(every=4, start=2)
+    assert [i for i in range(12) if p.fires(i)] == [2, 6, 10]
+
+    # probabilistic form: a pure function of (seed, step) — two
+    # instances with the same seed agree everywhere, a different seed
+    # gives a different (still deterministic) pattern
+    a = fault.FaultSchedule(prob=0.3, seed=42)
+    b = fault.FaultSchedule(prob=0.3, seed=42)
+    pat_a = [a.fires(i) for i in range(300)]
+    assert pat_a == [b.fires(i) for i in range(300)]
+    assert 30 < sum(pat_a) < 160          # roughly 30%
+    c = fault.FaultSchedule(prob=0.3, seed=43)
+    assert pat_a != [c.fires(i) for i in range(300)]
+    # and fires() holds no state: asking twice answers the same
+    assert [a.fires(i) for i in range(300)] == pat_a
+
+
+def test_empty_schedule_rejected():
+    with pytest.raises(ValueError):
+        fault.FaultSchedule()
+    with pytest.raises(TypeError):
+        fault.register("executor/feed", lambda step: None, schedule=None)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_register_fire_once_and_unregister():
+    hits = []
+    h = fault.register("executor/dispatch",
+                       lambda step, **ctx: hits.append(step),
+                       fault.FaultSchedule(steps=[1, 3]), once=True)
+    assert fault.active()
+    for i in range(5):
+        fault.fire("executor/dispatch", i)
+    assert hits == [1]                    # once=True disarmed after step 1
+    assert fault.injections() == [("executor/dispatch", 1, h.name)]
+    fault.unregister(h)
+    assert not fault.active()
+
+
+def _build_mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, bs=4):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(bs, 8).astype("float32"),
+             "label": rng.randint(0, 4, (bs, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _drilled_run(steps=6):
+    """One executor run with a poisoned batch + a NaN'd loss fetch on
+    fixed schedules; returns (losses, injection log)."""
+    fault.clear()
+    fault.clear_injections()
+    main, startup, loss = _build_mlp()
+    fault.poison_batch("x", fault.FaultSchedule(steps=[2]))
+    fault.inject_nan(loss.name, fault.FaultSchedule(steps=[4]))
+    scope = fluid.Scope()
+    out = []
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        for feed in _batches(steps):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            out.append(np.asarray(lv, "float32").tobytes())
+    return out, fault.injections()
+
+
+def test_injection_points_identical_across_runs():
+    """The acceptance property: same schedules => identical injection
+    points (and, faults being the only perturbation, identical loss
+    bit-patterns) across two runs."""
+    out1, log1 = _drilled_run()
+    out2, log2 = _drilled_run()
+    assert log1 == log2
+    assert [p for p, _, _ in log1] == ["executor/feed",
+                                       "executor/step_done"]
+    assert [s for _, s, _ in log1] == [2, 4]
+    assert out1 == out2
+    # the poisoned batch made step 2's loss non-finite in-graph; the
+    # injected fetch made step 4's
+    assert not np.isfinite(np.frombuffer(out1[2], "float32")).all()
+    assert not np.isfinite(np.frombuffer(out1[4], "float32")).all()
+
+
+def test_inject_nan_into_scope_var():
+    main, startup, loss = _build_mlp()
+    fault.inject_nan("fc_0.w_0", fault.FaultSchedule(steps=[1]))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feeds = _batches(3)
+        exe.run(main, feed=feeds[0], fetch_list=[loss])
+        assert np.isfinite(np.asarray(scope.var("fc_0.w_0"))).all()
+        exe.run(main, feed=feeds[1], fetch_list=[loss])
+        assert not np.isfinite(np.asarray(scope.var("fc_0.w_0"))).any()
+        # the poisoned weights make the NEXT loss non-finite
+        (lv,) = exe.run(main, feed=feeds[2], fetch_list=[loss])
+        assert not np.isfinite(np.asarray(lv)).all()
+
+
+def test_inject_nan_unknown_var_raises():
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        # registered after startup: the startup executor's own step 0
+        # must not trip the drill
+        fault.inject_nan("no_such_var", fault.FaultSchedule(steps=[0]))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(KeyError, match="no_such_var"):
+            exe.run(main, feed=_batches(1)[0], fetch_list=[loss])
+
+
+def test_poison_batch_misaimed_raises():
+    """A misaimed poison drill fails LOUDLY: the firing is recorded in
+    the injection log before the hook runs (kill/fail hooks never
+    return), so a silent no-op would let a recovery test pass against a
+    run that was never faulted."""
+    fault.poison_batch("lbl", fault.FaultSchedule(steps=[0]))
+    with pytest.raises(KeyError, match="not a feed"):
+        fault.fire("executor/feed", 0,
+                   feed_names=["label", "x"],
+                   feed_vals=[np.zeros((2, 1), "int64"),
+                              np.zeros((2, 4), "float32")])
+    fault.clear()
+    fault.poison_batch("label", fault.FaultSchedule(steps=[0]))
+    with pytest.raises(TypeError, match="non-float"):
+        fault.fire("executor/feed", 0, feed_names=["label"],
+                   feed_vals=[np.zeros((2, 1), "int64")])
+
+
+def test_fail_and_delay_dispatch():
+    main, startup, loss = _build_mlp()
+    fault.fail_dispatch(fault.FaultSchedule(steps=[1]))
+    fault.delay_dispatch(0.05, fault.FaultSchedule(steps=[0]))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feeds = _batches(3)
+        t0 = time.perf_counter()
+        exe.run(main, feed=feeds[0], fetch_list=[loss])
+        assert time.perf_counter() - t0 > 0.04      # the delay landed
+        with pytest.raises(fault.FaultInjectedError):
+            exe.run(main, feed=feeds[1], fetch_list=[loss])
+        # fail_dispatch is once by default: the run continues after
+        exe.run(main, feed=feeds[2], fetch_list=[loss])
+
+
+def test_checkpoint_write_points_fire():
+    """The three checkpoint protocol points fire with the artifact's
+    step — the registry form of the mid-save kill family (the real
+    SIGKILL drill is tests/test_elastic_drill.py's kill_mode=save)."""
+    from paddle_tpu.parallel import checkpoint as ck
+
+    seen = []
+    for point in ("before_write", "after_write", "before_commit"):
+        fault.register(
+            "checkpoint/" + point,
+            lambda step, _p=point, **ctx: seen.append((_p, step)),
+            fault.FaultSchedule(every=1))
+    ts = ck.TrainState(5, {"w": np.zeros((2, 2), "float32")},
+                       {"format": 1, "step": 5, "executors": {},
+                        "readers": {}, "extra": {}})
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        ck.save_train_state(d + "/step_0000000005", ts)
+    assert seen == [("before_write", 5), ("after_write", 5),
+                    ("before_commit", 5)]
+
+
+def test_private_fault_hooks_are_gone():
+    from paddle_tpu.parallel import checkpoint as ck
+
+    assert not hasattr(ck, "_FAULT_HOOKS")
+
+
+# ---------------------------------------------------------------------------
+# FLAGS_fault_spec
+# ---------------------------------------------------------------------------
+
+def test_install_from_spec_grammar():
+    hooks = fault.install_from_spec(
+        "nan_var:fc_0.w_0@5;poison_batch:x@3,9:once;"
+        "delay:0.01@every=4+2;fail_dispatch:@prob=0.5;"
+        "kill_save:before_commit@11")
+    assert len(hooks) == 5
+    names = {h.name for h in hooks}
+    assert names == {"nan_var:fc_0.w_0", "poison_batch:x",
+                     "delay_dispatch:0.01s", "fail_dispatch",
+                     "kill_mid_save:before_commit"}
+    by_name = {h.name: h for h in hooks}
+    assert by_name["nan_var:fc_0.w_0"].once          # family default
+    assert by_name["poison_batch:x"].once            # :once override
+    assert not by_name["delay_dispatch:0.01s"].once
+    assert by_name["delay_dispatch:0.01s"].schedule.fires(6)
+    assert not by_name["delay_dispatch:0.01s"].schedule.fires(7)
+
+
+def test_install_from_spec_rejects_malformed():
+    for bad in ("nonsense", "unknown_family:x@3", "nan_var:w@",
+                "delay:notafloat@3"):
+        with pytest.raises(ValueError):
+            fault.install_from_spec(bad)
+
+
+def test_fault_spec_flag_installs(monkeypatch):
+    fluid.set_flags({"FLAGS_fault_spec": "poison_batch:x@7"})
+    try:
+        assert fault.active()
+        assert any(h.name == "poison_batch:x" for h in fault.hooks())
+    finally:
+        fluid.set_flags({"FLAGS_fault_spec": ""})
+        fault.clear()
+
+
+def test_install_from_spec_kill_save_honors_persist():
+    # the :once/:persist suffix overrides EVERY family's default,
+    # kill_save included (a respawning-supervisor drill needs persist)
+    hooks = fault.install_from_spec(
+        "kill_save:before_commit@every=10:persist")
+    assert len(hooks) == 1 and not hooks[0].once
+    hooks = fault.install_from_spec("kill_save:before_commit@11")
+    assert hooks[0].once                      # family default unchanged
+
+
+def test_install_from_spec_replaces_not_accumulates():
+    # re-applying a spec is idempotent and a new spec swaps the drills:
+    # the installed fault state mirrors the flag value
+    fault.install_from_spec("nan_var:w@5")
+    fault.install_from_spec("nan_var:w@5")
+    assert len(fault.hooks()) == 1
+    fault.install_from_spec("delay:0.01@every=8")
+    assert {h.name for h in fault.hooks()} == {"delay_dispatch:0.01s"}
+    # directly registered hooks are never touched by a spec swap
+    direct = fault.poison_batch("x", fault.FaultSchedule(steps=[3]))
+    fault.install_from_spec("nan_var:w@5")
+    assert {h.name for h in fault.hooks()} == {"poison_batch:x",
+                                               "nan_var:w"}
+    # transactional: a malformed entry leaves the previous spec armed
+    with pytest.raises(ValueError):
+        fault.install_from_spec("nan_var:w2@3;unknown_family:x@3")
+    assert {h.name for h in fault.hooks()} == {"poison_batch:x",
+                                               "nan_var:w"}
+    # empty spec disarms the spec-installed drills only
+    fault.install_from_spec("")
+    assert [h.name for h in fault.hooks()] == ["poison_batch:x"]
+    fault.unregister(direct)
+    assert not fault.active()
+
+
+def test_fault_spec_flag_reset_and_clear():
+    fluid.set_flags({"FLAGS_fault_spec": "delay:0.01@every=8"})
+    fluid.set_flags({"FLAGS_fault_spec": "delay:0.01@every=8"})
+    try:
+        assert len(fault.hooks()) == 1
+        fluid.set_flags({"FLAGS_fault_spec": ""})
+        assert not fault.active()
+    finally:
+        fault.clear()
+
+
+def test_rejected_flag_value_not_committed():
+    """A raising on_set validator rolls the flag back: flag() keeps
+    returning the last GOOD value and the installed fault state keeps
+    mirroring it."""
+    fluid.set_flags({"FLAGS_fault_spec": "delay:0.01@every=8"})
+    try:
+        with pytest.raises(ValueError):
+            fluid.set_flags({"FLAGS_fault_spec": "nan_var:w@x"})
+        assert fluid.get_flags("FLAGS_fault_spec")[
+            "FLAGS_fault_spec"] == "delay:0.01@every=8"
+        assert {h.name for h in fault.hooks()} == {"delay_dispatch:0.01s"}
+        with pytest.raises(ValueError):
+            fluid.set_flags({"FLAGS_guardian_policy": "skip,rolback"})
+        assert "rollback" in fluid.get_flags("FLAGS_guardian_policy")[
+            "FLAGS_guardian_policy"]
+    finally:
+        fluid.set_flags({"FLAGS_fault_spec": ""})
+        fault.clear()

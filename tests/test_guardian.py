@@ -1,0 +1,715 @@
+"""Training-run guardian (ISSUE 8): in-graph skip recovery is
+bit-deterministic across sync/async dispatch, the rollback drill
+restores a clean TrainState and reproduces the clean run's final loss,
+the rollback budget raises a typed error instead of looping, and the
+disabled guardian costs nothing observable."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import fault, guardian, monitor
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fault.clear()
+    fault.clear_injections()
+    guardian.uninstall()
+    fluid.set_flags({
+        "FLAGS_guardian": False,
+        "FLAGS_guardian_policy": "skip,rollback,abort",
+    })
+    monitor.disable()
+    monitor.registry().reset()
+    monitor.step_stats().reset()
+
+
+def _build_mlp(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)
+        pred = fluid.layers.fc(h, size=4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, bs=4):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(bs, 8).astype("float32"),
+             "label": rng.randint(0, 4, (bs, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# disabled-is-free (acceptance gate, like monitor's)
+# ---------------------------------------------------------------------------
+
+def test_disabled_guardian_records_nothing_and_adds_no_fetch():
+    assert guardian.active() is None
+    assert not guardian.skip_guard_enabled()
+    monitor.enable()
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        outs = exe.run(main, feed=_batches(1)[0], fetch_list=[loss])
+    assert len(outs) == 1                 # no trailing ok fetch
+    reg = monitor.registry()
+    assert all(not m.startswith("guardian/")
+               for m in reg.expose_text().splitlines())
+
+
+# ---------------------------------------------------------------------------
+# in-graph skip: deterministic across sync/async dispatch (satellite)
+# ---------------------------------------------------------------------------
+
+def _skip_run(tmp_path, return_numpy, steps=12, poison_step=5):
+    fault.clear()
+    fault.clear_injections()
+    fluid.set_flags({"FLAGS_guardian": True})
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        g = guardian.install(guardian.Guardian(
+            quarantine_dir=str(tmp_path / ("q_%s" % return_numpy))))
+        fault.poison_batch("x", fault.FaultSchedule(steps=[poison_step]))
+        exe = fluid.Executor(fluid.CPUPlace())
+        outs = []
+        for feed in _batches(steps):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss],
+                            return_numpy=return_numpy)
+            outs.append(lv)
+        exe.sync()
+        g.flush()
+        stats = g.stats()
+        guardian.uninstall()
+        return [np.asarray(o, "float32").tobytes() for o in outs], stats
+
+
+def test_skip_recovery_bit_identical_sync_vs_async(tmp_path):
+    """The satellite determinism gate: a NaN-injected run with
+    skip-step recovery produces a bit-identical post-recovery loss
+    trajectory whether return_numpy is True or False — the skip happens
+    in-graph, so host observation timing cannot alter the state
+    evolution."""
+    sync_losses, sync_stats = _skip_run(tmp_path, True)
+    async_losses, async_stats = _skip_run(tmp_path, False)
+    assert sync_losses == async_losses
+    # the poisoned step's loss is non-finite in both; later steps
+    # (post-recovery) are finite in both
+    assert not np.isfinite(np.frombuffer(sync_losses[5], "float32")).all()
+    for later in sync_losses[6:]:
+        assert np.isfinite(np.frombuffer(later, "float32")).all()
+    assert sync_stats["skipped_steps"] == 1
+    assert async_stats["skipped_steps"] == 1
+
+
+def test_skip_suppresses_update_and_quarantines(tmp_path):
+    fluid.set_flags({"FLAGS_guardian": True})
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    qdir = str(tmp_path / "quarantine")
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        g = guardian.install(guardian.Guardian(quarantine_dir=qdir))
+        fault.poison_batch("x", fault.FaultSchedule(steps=[1]))
+        exe = fluid.Executor(fluid.CPUPlace())
+        feeds = _batches(3)
+        exe.run(main, feed=feeds[0], fetch_list=[loss])
+        w_before = np.array(scope.var("fc_0.w_0"), copy=True)
+        lr_before = np.array(scope.var("@LR_DECAY_COUNTER@"), copy=True) \
+            if scope.has_var("@LR_DECAY_COUNTER@") else None
+        (lv,) = exe.run(main, feed=feeds[1], fetch_list=[loss])
+        assert not np.isfinite(np.asarray(lv)).all()
+        # the poisoned step's whole update was dropped in-graph: params
+        # unchanged, finite
+        w_after = np.asarray(scope.var("fc_0.w_0"))
+        assert np.array_equal(w_before, w_after)
+        if lr_before is not None:
+            assert np.array_equal(
+                lr_before, np.asarray(scope.var("@LR_DECAY_COUNTER@")))
+        # ...and training continues
+        (lv2,) = exe.run(main, feed=feeds[2], fetch_list=[loss])
+        assert np.isfinite(np.asarray(lv2)).all()
+    # quarantine artifact: npz + sidecar with run_id/step/signature
+    sidecars = glob.glob(os.path.join(qdir, "*.json"))
+    assert len(sidecars) == 1
+    rec = json.load(open(sidecars[0]))
+    assert rec["run_id"] == monitor.run_id()
+    assert rec["step"] == 1
+    assert rec["reason"] == "nonfinite_in_graph"
+    sig = {n: (tuple(s), d) for n, s, d in rec["feed_signature"]}
+    assert sig["x"] == ((4, 8), "float32")
+    with np.load(rec["path"]) as z:
+        arrs = {n: z["arr_%d" % i]
+                for i, n in enumerate(rec["feed_names"])}
+    assert not np.isfinite(arrs["x"]).any()       # the poisoned batch
+
+
+def test_parallel_quarantine_records_prepad_batch(tmp_path):
+    """With pad_uneven_batches on, the ParallelExecutor quarantines the
+    batch AS FED (pre-pad): the artifact's feed signature and arrays
+    must match what the reader yielded — the repro contract — not the
+    mesh-padded copy."""
+    fluid.set_flags({"FLAGS_guardian": True})
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    qdir = str(tmp_path / "quarantine")
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        guardian.install(guardian.Guardian(quarantine_dir=qdir))
+        fault.poison_batch("x", fault.FaultSchedule(steps=[1]))
+        pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                    main_program=main)
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.rand(9, 8).astype("float32"),
+                  "label": rng.randint(0, 4, (9, 1)).astype("int64")}
+                 for _ in range(2)]                 # 9 % 8 devices != 0
+        pe.run(feed=feeds[0], fetch_list=[loss])
+        (lv,) = pe.run(feed=feeds[1], fetch_list=[loss])
+        assert not np.isfinite(np.asarray(lv)).all()
+    sidecars = glob.glob(os.path.join(qdir, "*.json"))
+    assert len(sidecars) == 1
+    rec = json.load(open(sidecars[0]))
+    sig = {n: (tuple(s), d) for n, s, d in rec["feed_signature"]}
+    assert sig["x"] == ((9, 8), "float32")     # true batch, not padded
+    with np.load(rec["path"]) as z:
+        arrs = {n: z["arr_%d" % i]
+                for i, n in enumerate(rec["feed_names"])}
+    assert arrs["x"].shape == (9, 8)
+    assert not np.isfinite(arrs["x"]).any()
+
+
+def test_skip_budget_exhaustion_aborts_typed_without_rollback_rung():
+    fluid.set_flags({"FLAGS_guardian": True})
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        guardian.install(guardian.Guardian(policy="skip,abort",
+                                           max_skips=2))
+        fault.poison_batch("x", fault.FaultSchedule(every=1, start=1))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(guardian.GuardianAbortError,
+                           match="skip budget"):
+            for feed in _batches(8):
+                exe.run(main, feed=feed, fetch_list=[loss])
+
+
+# ---------------------------------------------------------------------------
+# rollback drill through the Trainer (acceptance)
+# ---------------------------------------------------------------------------
+
+def _trainer_run(ckpt_dir, inject_step=None, persist=False,
+                 max_rollbacks=2, log_dir=None, n_samples=64):
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+    from paddle_tpu.reader import checkpointable
+
+    fault.clear()
+    fault.clear_injections()
+    if log_dir:
+        monitor.enable(log_dir=log_dir)
+    if inject_step is not None:
+        fault.inject_nan("fc_0.w_0",
+                         fault.FaultSchedule(steps=[inject_step]),
+                         once=not persist)
+
+    def train_func():
+        fluid.default_main_program().random_seed = 7
+        fluid.default_startup_program().random_seed = 7
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=4, act="softmax")
+        return fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+
+    def samples():
+        rng = np.random.RandomState(0)
+        for _ in range(n_samples):
+            x = rng.rand(8).astype("float32")
+            yield x, np.array([int(np.argmax(x[:4]))], "int64")
+
+    losses = []
+
+    def handler(ev):
+        if hasattr(ev, "metrics"):
+            losses.append(float(np.ravel(ev.metrics[0])[0]))
+
+    trainer = Trainer(
+        train_func=train_func, place=fluid.CPUPlace(),
+        optimizer_func=lambda: fluid.optimizer.Adam(1e-2),
+        checkpoint_config=CheckpointConfig(
+            checkpoint_dir=str(ckpt_dir), step_interval=3,
+            async_save=False),
+        guardian_config={"policy": "rollback,abort",
+                         "max_rollbacks": max_rollbacks})
+    try:
+        trainer.train(num_epochs=1, event_handler=handler,
+                      reader=checkpointable(
+                          fluid.batch(samples, batch_size=4)),
+                      feed_order=["x", "label"])
+    finally:
+        if log_dir:
+            monitor.disable()
+    return losses
+
+
+def test_rollback_drill_recovers_to_clean_final_loss(tmp_path):
+    """Acceptance: NaN injected at a fixed step -> the guardian rolls
+    back to the last clean checkpoint, the exact-resume machinery
+    replays, and the completed run's final loss matches the clean
+    uninterrupted run within rtol 1e-4 (here: the replay is exact, so
+    it matches bitwise).  The decision trail lands in the JSONL with
+    run_id correlation."""
+    ref = _trainer_run(tmp_path / "ref_ckpt")
+    log_dir = str(tmp_path / "monitor")
+    drilled = _trainer_run(tmp_path / "ckpt", inject_step=6,
+                           log_dir=log_dir)
+    assert np.isfinite(drilled[-1])
+    np.testing.assert_allclose(drilled[-1], ref[-1], rtol=1e-4)
+    # the drilled run replayed the rolled-back window: more observed
+    # steps than the reference, same trajectory tail
+    assert len(drilled) > len(ref)
+    assert drilled[-3:] == ref[-3:]
+
+    events = []
+    for path in glob.glob(os.path.join(log_dir, "*.jsonl")):
+        with open(path) as f:
+            events += [json.loads(l) for l in f if l.strip()]
+    by_kind = {}
+    for e in events:
+        by_kind.setdefault(e.get("event"), []).append(e)
+    assert "fault_injected" in by_kind
+    assert "guardian_nonfinite" in by_kind
+    rollbacks = by_kind["guardian_rollback"]
+    assert len(rollbacks) == 1
+    # saves land at global steps 1, 4, 7, ... (interval 3); the step-7
+    # artifact was taken after the poison landed and is skipped as
+    # unclean, so the newest CLEAN checkpoint is step 4
+    assert rollbacks[0]["restored_step"] == 4
+    assert rollbacks[0]["step"] == 7              # detected next step
+    assert rollbacks[0]["run_id"] == monitor.run_id()
+    # checkpoints taken after the poison landed were skipped as unclean
+    assert any(e["reason"] == "nonfinite_state"
+               for e in by_kind.get("guardian_checkpoint_skipped", []))
+    # ...and the decisions counted into the metrics registry
+    assert monitor.registry().get("guardian/rollbacks").value == 1
+    assert monitor.registry().get("fault/injections").value >= 1
+
+
+def test_rollback_budget_exhausted_raises_typed_error(tmp_path):
+    """Acceptance: a PERSISTENT fault (re-injected on every replay of
+    its step) exhausts the rollback budget and raises
+    GuardianAbortError instead of looping."""
+    with pytest.raises(guardian.GuardianAbortError,
+                       match="rollback budget"):
+        _trainer_run(tmp_path / "ckpt", inject_step=6, persist=True,
+                     max_rollbacks=1)
+
+
+def test_rollback_without_checkpoint_config_aborts(tmp_path):
+    from paddle_tpu.contrib import Trainer
+    from paddle_tpu.reader import checkpointable
+
+    fault.clear()
+    fault.inject_nan("fc_0.w_0", fault.FaultSchedule(steps=[2]))
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(x, size=4, act="softmax")
+        return fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+
+    def samples():
+        rng = np.random.RandomState(0)
+        for _ in range(32):
+            x = rng.rand(8).astype("float32")
+            yield x, np.array([0], "int64")
+
+    trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                      optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+                      guardian_config={"policy": "rollback,abort"})
+    with pytest.raises(guardian.GuardianAbortError,
+                       match="no CheckpointConfig"):
+        trainer.train(num_epochs=1, event_handler=lambda ev: None,
+                      reader=checkpointable(
+                          fluid.batch(samples, batch_size=4)),
+                      feed_order=["x", "label"])
+
+
+def test_trainer_quarantine_default_applies_to_guardian_instance(tmp_path):
+    """A Guardian INSTANCE passed as guardian_config gets the same
+    <checkpoint_dir>/quarantine default as the kwargs-dict path — the
+    repro artifact the skip path exists to produce must not be silently
+    lost just because the caller built the Guardian themselves."""
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[4])
+        return fluid.layers.mean(fluid.layers.fc(x, size=1))
+
+    g = guardian.Guardian(policy="rollback,abort")
+    assert not g.quarantine_dir
+    g._rollbacks = 5                 # stale budget from a previous run
+    trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                      optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+                      checkpoint_config=CheckpointConfig(
+                          checkpoint_dir=str(tmp_path / "ckpt")),
+                      guardian_config=g)
+    assert trainer._make_guardian() is g
+    assert g.quarantine_dir == os.path.join(str(tmp_path), "ckpt",
+                                            "quarantine")
+    assert g._rollbacks == 0         # per-run state reset on reuse
+
+
+# ---------------------------------------------------------------------------
+# detectors (unit level)
+# ---------------------------------------------------------------------------
+
+def _feed_losses(g, values, start=0):
+    for i, v in enumerate(values):
+        g.note_step("test", start + i, fetch_names=("loss",),
+                    fetches=(np.float32(v),), sync=True)
+
+
+def test_spike_detector_median_mad():
+    monitor.enable()
+    g = guardian.Guardian(policy="skip", window=16, zmax=6.0,
+                          spike_action="warn")
+    rng = np.random.RandomState(3)
+    _feed_losses(g, 1.0 + 0.01 * rng.randn(12))
+    assert monitor.registry().get("guardian/loss_spikes") is None
+    _feed_losses(g, [9.0], start=12)          # far outside 6 MADs
+    assert monitor.registry().get("guardian/loss_spikes").value == 1
+    # the outlier stayed out of the baseline window
+    assert max(g.stats()["window"]) < 2.0
+    # spike_action=rollback escalates instead
+    g2 = guardian.Guardian(policy="skip,rollback", window=16, zmax=6.0,
+                           spike_action="rollback")
+    _feed_losses(g2, 1.0 + 0.01 * rng.randn(12))
+    with pytest.raises(guardian.GuardianRollback, match="spike"):
+        _feed_losses(g2, [9.0], start=12)
+
+
+def test_spike_detector_one_sided_and_bounded():
+    """A sharp IMPROVEMENT is healthy (one-sided detector: only upward
+    moves are anomalies), and a genuine upward level shift stops being
+    flagged once it persists for half a window — the baseline resets to
+    the new regime instead of wedging on the pre-shift median forever
+    (which would spam a spike event on every remaining step)."""
+    monitor.enable()
+    g = guardian.Guardian(policy="skip", window=16, zmax=6.0,
+                          spike_action="warn")
+    rng = np.random.RandomState(5)
+    _feed_losses(g, 2.0 + 0.01 * rng.randn(16))
+    # LR-decay-style drop: no spike, enters the baseline
+    _feed_losses(g, 1.4 + 0.01 * rng.randn(4), start=16)
+    assert monitor.registry().get("guardian/loss_spikes") is None
+    assert min(g.stats()["window"]) < 1.5
+    # upward level shift: flagged at most window//2 + 1 times, then the
+    # baseline adopts the new level and goes quiet
+    g2 = guardian.Guardian(policy="skip", window=16, zmax=6.0,
+                           spike_action="warn")
+    _feed_losses(g2, 1.0 + 0.01 * rng.randn(16))
+    _feed_losses(g2, 3.0 + 0.01 * rng.randn(40), start=16)
+    flagged = monitor.registry().get("guardian/loss_spikes").value
+    assert 0 < flagged <= 16 // 2 + 1
+    assert float(np.median(g2.stats()["window"])) > 2.5
+
+
+def test_plateau_detector_fires_once():
+    monitor.enable()
+    g = guardian.Guardian(policy="skip", plateau_steps=8, zmax=0)
+    _feed_losses(g, [1.0] * 20)
+    c = monitor.registry().get("guardian/plateaus")
+    assert c is not None and c.value == 1     # armed once per plateau
+
+
+def test_plateau_window_longer_than_spike_window_fires():
+    """plateau_steps > window used to leave the loss history deque too
+    small for the plateau check to ever run (silently dead detector);
+    the spike baseline must still be the last `window` losses."""
+    monitor.enable()
+    g = guardian.Guardian(policy="skip", window=8, plateau_steps=24,
+                          zmax=0)
+    _feed_losses(g, [1.0] * 30)
+    c = monitor.registry().get("guardian/plateaus")
+    assert c is not None and c.value == 1
+
+
+def test_stall_escalation_arms_typed_abort():
+    g = guardian.Guardian(policy="skip", stall_escalations=2)
+    guardian.install(g)
+    diag = {"stalled_for_s": 120.0, "stall_seconds": 120.0}
+    g._on_stall(diag)
+    g._on_stall(diag)
+    with pytest.raises(guardian.GuardianAbortError, match="wedged"):
+        g.note_step("test", 0, fetch_names=(), fetches=(), sync=True)
+    # a completed step in between re-arms instead
+    g2 = guardian.Guardian(policy="skip", stall_escalations=2)
+    g2._on_stall(diag)
+    g2.note_step("test", 0, fetch_names=(), fetches=(), sync=True)
+    g2._on_stall(diag)
+    g2.note_step("test", 1, fetch_names=(), fetches=(), sync=True)
+
+
+def test_rollback_restore_skips_poisoned_and_corrupt_artifacts(tmp_path):
+    """The guardian's restore scan: newest-first, skipping artifacts
+    that are corrupt on disk or contain non-finite state (a checkpoint
+    taken after the corruption landed)."""
+    from paddle_tpu.parallel import checkpoint as ck
+
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        mgr = ck.TrainStateCheckpointManager(str(tmp_path),
+                                             async_save=False)
+        feeds = _batches(3)
+        exe.run(main, feed=feeds[0], fetch_list=[loss])
+        mgr.save(1, scope=scope, program=main,
+                 executors={"train": exe})
+        exe.run(main, feed=feeds[1], fetch_list=[loss])
+        mgr.save(2, scope=scope, program=main,
+                 executors={"train": exe})
+        # poison the live state, then checkpoint it (step 3 = unclean)
+        scope.set_var("fc_0.w_0", np.full_like(
+            np.asarray(scope.var("fc_0.w_0")), np.nan))
+        mgr.save(3, scope=scope, program=main,
+                 executors={"train": exe})
+        # corrupt step 2's artifact on disk
+        with open(os.path.join(str(tmp_path), "step_%010d" % 2,
+                               "arrays.npz"), "r+b") as f:
+            f.seek(16)
+            f.write(b"\xff" * 32)
+
+        g = guardian.Guardian(policy="rollback,abort")
+        rb = guardian.GuardianRollback(9, "drill", quarantined=False)
+        restored = g.rollback_restore(
+            mgr, rb, scope=scope, program=main,
+            executors={"train": exe})
+        assert restored == 1
+        assert np.isfinite(np.asarray(scope.var("fc_0.w_0"))).all()
+        assert g.post_restore(rb, restored) == 0      # transient: replay
+        rb_q = guardian.GuardianRollback(9, "drill", quarantined=True)
+        assert g.post_restore(rb_q, restored) == 9    # skip poisoned win
+
+
+def test_rollback_abort_leaves_live_state_untouched(tmp_path):
+    """Rejected artifacts are validated WITHOUT being applied: when
+    every candidate is poisoned, the abort leaves the pre-rollback
+    state in place instead of the last rejected checkpoint's NaNs, and
+    the save cadence is not reseeded by checkpoints the guardian
+    rejected."""
+    from paddle_tpu.parallel import checkpoint as ck
+
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        mgr = ck.TrainStateCheckpointManager(str(tmp_path),
+                                             async_save=False)
+        exe.run(main, feed=_batches(1)[0], fetch_list=[loss])
+        scope.set_var("fc_0.w_0", np.full_like(
+            np.asarray(scope.var("fc_0.w_0")), np.nan))
+        mgr.save(1, scope=scope, program=main, executors={"train": exe})
+        # the live state heals after the poisoned save landed on disk
+        w_live = np.zeros_like(np.asarray(scope.var("fc_0.w_0")))
+        scope.set_var("fc_0.w_0", np.array(w_live, copy=True))
+        mgr._last_saved = None
+        g = guardian.Guardian(policy="rollback,abort")
+        rb = guardian.GuardianRollback(5, "drill", quarantined=False)
+        with pytest.raises(guardian.GuardianAbortError, match="no clean"):
+            g.rollback_restore(mgr, rb, scope=scope, program=main,
+                               executors={"train": exe})
+        assert np.array_equal(np.asarray(scope.var("fc_0.w_0")), w_live)
+        assert mgr._last_saved is None      # rejected != restored
+
+
+def test_unobserved_skip_guard_warns_once(tmp_path):
+    """FLAGS_guardian set (or leaked from a Trainer) without an
+    installed guardian: the lowered skip guard drops poisoned updates
+    with no decision trail — the executor says so once instead of
+    staying silent forever."""
+    import warnings as _w
+
+    fluid.set_flags({"FLAGS_guardian": True})
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        feeds = _batches(2)
+        with pytest.warns(UserWarning, match="no guardian is installed"):
+            exe.run(main, feed=feeds[0], fetch_list=[loss])
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            exe.run(main, feed=feeds[1], fetch_list=[loss])
+        assert not [w for w in rec
+                    if "no guardian" in str(w.message)]   # once only
+
+
+def test_rollback_with_unrewindable_reader_warns(tmp_path):
+    """A plain reader (no state_dict) cannot be rewound on rollback:
+    recovery proceeds, but the Trainer warns that the replay will not
+    exactly reproduce the clean trajectory."""
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+
+    fault.inject_nan("fc_0.w_0", fault.FaultSchedule(steps=[4]))
+
+    def train_func():
+        fluid.default_main_program().random_seed = 7
+        fluid.default_startup_program().random_seed = 7
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        pred = fluid.layers.fc(x, size=4, act="softmax")
+        return fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+
+    def samples():
+        rng = np.random.RandomState(0)
+        for _ in range(32):
+            x = rng.rand(8).astype("float32")
+            yield x, np.array([0], "int64")
+
+    trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                      optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+                      checkpoint_config=CheckpointConfig(
+                          checkpoint_dir=str(tmp_path / "ckpt"),
+                          step_interval=2, async_save=False),
+                      guardian_config={"policy": "rollback,abort"})
+    with pytest.warns(UserWarning, match="cannot rewind"):
+        trainer.train(num_epochs=1, event_handler=lambda ev: None,
+                      reader=fluid.batch(samples, batch_size=4),
+                      feed_order=["x", "label"])
+    # the flag train() set is restored: nothing later in the process
+    # runs guarded with nobody deciding
+    assert not fluid.get_flags("FLAGS_guardian")["FLAGS_guardian"]
+
+
+def test_trainer_construction_does_not_warn_unobserved_guard():
+    """guardian_config enables FLAGS_guardian at train() time, not in
+    __init__: the startup program must not be lowered guarded (and
+    warned about as 'no guardian installed') before the guardian
+    exists."""
+    import warnings as _w
+    from paddle_tpu.contrib import Trainer
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[4])
+        return fluid.layers.mean(fluid.layers.fc(x, size=1))
+
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+                guardian_config={"policy": "rollback,abort"})
+    assert not [w for w in rec if "no guardian" in str(w.message)]
+    assert not fluid.get_flags("FLAGS_guardian")["FLAGS_guardian"]
+
+
+def test_restore_clears_pending_fast_forward_debt():
+    """A restore supersedes pending fast-forward debt: the rollback
+    protocol re-applies its own fast_forward AFTER the restore, so
+    stale debt would silently skip healthy batches at the restored
+    position."""
+    from paddle_tpu.reader import checkpointable
+
+    r = checkpointable(lambda: iter(range(10)))
+    r.fast_forward(4)
+    r.load_state_dict({"epoch": 0, "offset": 2})
+    assert list(r()) == list(range(2, 10))    # no stale skip
+
+
+def test_fast_forward_carries_across_epoch_boundary():
+    """A rollback fast-forward that overshoots the epoch must still
+    skip the poisoned batch at the START of the next epoch, not replay
+    it: the overshoot remainder carries (only a SHRUNK source's saved
+    offset resets at the boundary)."""
+    from paddle_tpu.reader import checkpointable
+
+    r = checkpointable(lambda: iter(range(10)))
+    r.load_state_dict({"epoch": 0, "offset": 8})
+    r.fast_forward(3)                 # items 8, 9, then next epoch's 0
+    assert list(r()) == []            # epoch 0 exhausted mid-skip
+    assert list(r()) == list(range(1, 10))    # batch 0 skipped
+    assert r.state_dict() == {"epoch": 2, "offset": 0}
+
+
+def test_saturated_window_float_noise_not_a_spike():
+    """MAD = 0 (saturated/clamped loss) must not turn float noise into
+    z ~ 1e4 spikes and burn the rollback budget: the dispersion floor
+    is relative to the loss level."""
+    monitor.enable()
+    g = guardian.Guardian(policy="skip", window=16, zmax=8.0,
+                          spike_action="warn")
+    _feed_losses(g, [2.0] * 16)
+    _feed_losses(g, [2.00001], start=16)      # ~5e-6 relative: noise
+    assert monitor.registry().get("guardian/loss_spikes") is None
+    _feed_losses(g, [2.1], start=17)          # 5% jump: a real spike
+    assert monitor.registry().get("guardian/loss_spikes").value == 1
+
+
+def test_invalid_guardian_config_does_not_leak_flag():
+    """A raising Guardian construction (typo'd policy) must restore
+    the FLAGS_guardian that train() set — otherwise every later
+    executor in the process silently lowers the skip guard."""
+    from paddle_tpu.contrib import Trainer
+    from paddle_tpu.reader import checkpointable
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[4])
+        return fluid.layers.mean(fluid.layers.fc(x, size=1))
+
+    def samples():
+        yield np.zeros(4, "float32")
+
+    trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                      optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+                      guardian_config={"policy": "rollbak,abort"})
+    with pytest.raises(ValueError, match="policy"):
+        trainer.train(num_epochs=1, event_handler=lambda ev: None,
+                      reader=checkpointable(
+                          fluid.batch(samples, batch_size=1)),
+                      feed_order=["x"])
+    assert not fluid.get_flags("FLAGS_guardian")["FLAGS_guardian"]
+
+
+def test_guardian_instance_reset_between_runs():
+    """A Guardian reused across train() calls gets a fresh per-run
+    budget (the Trainer resets caller-provided instances)."""
+    g = guardian.Guardian(policy="rollback,abort", max_rollbacks=1)
+    rb = guardian.GuardianRollback(3, "drill")
+    g.begin_rollback(rb)
+    with pytest.raises(guardian.GuardianAbortError, match="budget"):
+        g.begin_rollback(rb)
+    g.reset_run_state()
+    g.begin_rollback(rb)                    # fresh budget, no raise
+
+
+def test_finite_scan_covers_ml_dtypes():
+    """The poisoned-checkpoint scan must see NaNs in ml_dtypes state
+    (bf16, float8) that np.issubdtype misses — same hole fault._nan_like
+    closes on the injection side."""
+    import ml_dtypes
+    assert guardian._finite(np.array([1, 2], np.int32))
+    nan32 = np.array([1.0, np.nan], np.float32)
+    assert not guardian._finite(nan32)
+    assert not guardian._finite(nan32.astype(ml_dtypes.bfloat16))
+    assert not guardian._finite(nan32.astype(ml_dtypes.float8_e4m3fn))
+    assert guardian._finite(
+        np.array([1.0, 2.0], np.float32).astype(ml_dtypes.bfloat16))

@@ -25,6 +25,8 @@ GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 MODULES = [
     "paddle_tpu",
+    "paddle_tpu.fault",
+    "paddle_tpu.guardian",
     "paddle_tpu.layers",
     "paddle_tpu.optimizer",
     "paddle_tpu.initializer",

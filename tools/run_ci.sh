@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/7 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/8 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/7 API signature gate =="
+echo "== 2/8 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/7 8-device virtual-mesh dryrun =="
+echo "== 3/8 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/7 bench smoke (CPU backend, tiny) =="
+echo "== 4/8 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/7 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/8 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/7 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/8 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/7 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/8 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -169,5 +169,62 @@ PY
 # (the kind column truncates to 10 chars: "parallel_e")
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
+
+echo "== 8/8 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+GUARD_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
+# the drill is installed purely from the environment (FLAGS_fault_spec)
+# and the guardian purely from flags — no code changes to the script
+JAX_PLATFORMS=cpu \
+FLAGS_guardian=1 FLAGS_guardian_policy=rollback,abort \
+FLAGS_fault_spec='nan_var:fc_0.w_0@5' \
+  python - "$GUARD_DIR" <<'PY'
+import os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.contrib import Trainer, CheckpointConfig
+from paddle_tpu.reader import checkpointable
+
+out = sys.argv[1]
+monitor.enable(log_dir=os.path.join(out, "monitor"))
+
+def train_func():
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.layers.data("x", shape=[8])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=16, act="relu")
+    pred = fluid.layers.fc(h, size=4, act="softmax")
+    return fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+
+def samples():
+    rng = np.random.RandomState(0)
+    for _ in range(48):
+        x = rng.rand(8).astype("float32")
+        yield x, np.array([int(np.argmax(x[:4]))], "int64")
+
+losses = []
+def handler(ev):
+    if hasattr(ev, "metrics"):
+        losses.append(float(np.ravel(ev.metrics[0])[0]))
+        print("STEP %d %.6f" % (len(losses), losses[-1]), flush=True)
+
+trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                  optimizer_func=lambda: fluid.optimizer.Adam(1e-2),
+                  checkpoint_config=CheckpointConfig(
+                      checkpoint_dir=os.path.join(out, "ckpt"),
+                      step_interval=2, async_save=False))
+trainer.train(num_epochs=1, event_handler=handler,
+              reader=checkpointable(fluid.batch(samples, batch_size=4)),
+              feed_order=["x", "label"])
+assert np.isfinite(losses[-1]), losses[-1]
+print("GUARDIAN FINAL %.6f after %d observed steps" %
+      (losses[-1], len(losses)), flush=True)
+PY
+# the decision trail landed in the JSONL, run_id-correlated
+grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
+grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
 
 echo "CI OK"

@@ -1,0 +1,959 @@
+"""Profile-guided auto-configuration (ISSUE 9 tentpole).
+
+PERF.md is a graveyard of hand-measured config decisions — b512 not
+b1024, 4 bucket bounds not 6, Pallas flash attention only where the
+measured A/B favors it, checkpoint cadence picked by eye — while the
+compiler's own cost/memory accounting per program has been free at
+runtime since the program-profile work (``monitor/program_profile.py``:
+XLA ``cost_analysis``/``memory_analysis`` captured at the one compile
+each signature already pays).  This module closes the loop: an
+auto-tuner that searches the config space using that machinery instead
+of blind timing sweeps.
+
+Four knobs, four decision procedures (each a PURE function of
+measurements, so the policy is unit-testable without a device):
+
+* **batch size** (:func:`run_batch_ladder` / :func:`tune_batch_size`) —
+  geometric probe upward.  Each rung pays exactly ONE compile (the
+  ``Executor.cost_analysis`` explicit compile, which seeds the AOT
+  dispatch slot, so the measured window that follows adds zero backend
+  compiles), whose ``memory_analysis`` peak-HBM estimate rejects
+  over-capacity rungs BEFORE any dispatch could OOM; once two rungs'
+  peaks are known, the next rung's peak is PROJECTED (linear in batch)
+  and an over-ceiling projection stops the ladder without even the
+  probe compile.  Surviving rungs get a short measured
+  step-time window; the ladder stops when seconds-per-example regresses
+  (the PERF.md b512-not-b1024 shape: amortization plateaus, HBM-pressure
+  scheduling takes over).
+* **attention kernel per shape** (:func:`decide_attention_kernel` /
+  :func:`tune_attention_kernel`) — XLA vs Pallas flash measured A/B at
+  the model's (Tq, Tk, d, dtype), cached in a persistent
+  :class:`AttentionDecisionTable` keyed by
+  ``compile_cache.program_fingerprint`` + shape: a warm process reads
+  the table and pays nothing.  Tuned choices are consulted by the
+  ``fused_attention`` op itself (shape-matched), and a PINNED
+  ``FLAGS_pallas_kernels`` — set by the user via env or ``set_flags``
+  — always wins over the table.
+* **bucket bounds** (:func:`choose_bucket_bounds`) — pick K bounds from
+  an observed length histogram maximizing real-token fill, restricted
+  to hardware-friendly multiples FIRST (the PERF.md r4 finding: six
+  finer-but-ragged bounds measured WORSE than four MXU-friendly ones
+  despite higher fill — raggedness loses more on the MXU than padding).
+* **checkpoint interval** (:func:`decide_checkpoint_interval`) —
+  CheckFreq-style: the smallest interval whose measured on-step cost
+  (snapshot, plus the full write in sync mode) stays under the overhead
+  budget (default ``FLAGS_autotune_overhead_budget`` = 3.5%), bounded
+  below by the async write's drain time so a save never backs up into
+  the next snapshot; the guardian's measured rollback replay cost rides
+  along as evidence (smaller intervals bound the replay — the formula
+  already picks the smallest budget-feasible interval).
+
+Decisions are recorded as a :class:`TunedConfig` artifact (JSON:
+decision, evidence, probe measurements, run_id/fingerprints) consumed
+by ``bench.py --autotune`` and ``contrib.Trainer(autotune=...)``, and
+every decision publishes ``autotune/*`` monitor counters plus
+``autotune_decision`` JSONL events so tuning is observable like
+everything else.
+
+**Rejection mechanism**: the batch ladder's ceiling is the preflight
+HBM *estimate* (``FLAGS_autotune_hbm_bytes`` override, else
+``FLAGS_preflight_hbm_bytes``, else the device's
+``memory_stats()['bytes_limit']``) — candidates are rejected by the
+compiler's own memory analysis before any dispatch, never by an OOM
+crash.  That is what makes the probe testable on CPU with a fake limit.
+
+**Pinning**: every tuned decision defers to an explicit user choice.
+Flags set from the environment or via ``set_flags`` are *pinned*
+(``flags.pinned()``); :meth:`TunedConfig.apply` skips pinned knobs and
+records the skip in the decision trail.
+"""
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+
+import numpy as np
+
+__all__ = [
+    "TunedConfig", "AttentionDecisionTable", "attention_table",
+    "attention_choice", "attention_shape_key", "trace_token",
+    "hbm_ceiling", "batch_ladder", "project_peak_hbm",
+    "run_batch_ladder", "decide_attention_kernel", "token_fill",
+    "choose_bucket_bounds", "decide_checkpoint_interval",
+    "tune_batch_size", "tune_attention_kernel",
+    "tune_checkpoint_interval", "measure_step_window",
+]
+
+_mu = threading.Lock()
+
+
+def _flag(name, default):
+    from . import flags
+
+    try:
+        return flags.flag(name)
+    except KeyError:
+        return default
+
+
+def _event(record):
+    from . import monitor
+
+    ev = record.get("event")
+    if ev == "autotune_decision":
+        monitor.count("autotune/decisions")
+    elif ev == "autotune_probe":
+        monitor.count("autotune/probes")
+    record.setdefault("ts", time.time())
+    monitor.log_event(record)
+
+
+# ---------------------------------------------------------------------------
+# pure decision functions
+# ---------------------------------------------------------------------------
+
+def batch_ladder(start=32, max_batch=4096, factor=2):
+    """Geometric candidate ladder: start, start*factor, ... <= max_batch."""
+    start = max(1, int(start))
+    out = []
+    b = start
+    while b <= max_batch:
+        out.append(b)
+        nxt = int(b * factor)
+        b = nxt if nxt > b else b + 1
+    return out
+
+
+def project_peak_hbm(pairs, batch):
+    """Project a candidate batch's estimated peak HBM from measured
+    (batch, peak_bytes) pairs by least-squares linear fit — peak memory
+    is affine in batch (activations/temps scale, params don't).  Needs
+    >= 2 distinct batches; returns None otherwise."""
+    pts = [(float(b), float(p)) for b, p in pairs if p]
+    if len({b for b, _ in pts}) < 2:
+        return None
+    xs = np.array([b for b, _ in pts])
+    ys = np.array([p for _, p in pts])
+    slope, intercept = np.polyfit(xs, ys, 1)
+    return float(intercept + slope * float(batch))
+
+
+def run_batch_ladder(ladder, hbm_limit, probe_fn, measure_fn,
+                     regress_tol=0.05, headroom=0.9):
+    """The batch-size decision procedure, pure in its callbacks.
+
+    ``probe_fn(batch) -> estimated peak HBM bytes (or None)`` — one
+    compile's memory analysis; ``measure_fn(batch) -> measured seconds
+    per step`` — a short dispatch window over the already-compiled
+    executable.  ``hbm_limit`` of None/0 disables the memory gate.
+
+    Walks ``ladder`` upward.  A rung whose PROJECTED peak (linear fit
+    over the rungs already probed) exceeds ``headroom * hbm_limit``
+    stops the ladder without its probe compile; a rung whose probed
+    estimate exceeds the ceiling stops it before any dispatch; a rung
+    whose measured seconds-per-example regresses more than
+    ``regress_tol`` over the best-so-far stops it after its window.
+
+    Returns the decision dict: ``chosen`` (best seconds-per-example
+    among surviving rungs, None if none survived), per-candidate
+    statuses and measurements, and the ceiling used.
+    """
+    limit = float(hbm_limit) if hbm_limit else None
+    ceiling = limit * float(headroom) if limit else None
+    candidates = []
+    peaks = []                      # (batch, probed peak) pairs
+    best = None                     # (s_per_example, batch, step_s)
+    for b in ladder:
+        cand = {"batch": int(b)}
+        if ceiling is not None:
+            projected = project_peak_hbm(peaks, b)
+            if projected is not None and projected > ceiling:
+                cand.update(status="rejected_projected_hbm",
+                            projected_peak_hbm_bytes=int(projected))
+                candidates.append(cand)
+                break
+            peak = probe_fn(b)
+            if peak:
+                cand["peak_hbm_bytes"] = int(peak)
+                peaks.append((b, peak))
+                if peak > ceiling:
+                    cand["status"] = "rejected_hbm"
+                    candidates.append(cand)
+                    break
+        else:
+            peak = probe_fn(b)
+            if peak:
+                cand["peak_hbm_bytes"] = int(peak)
+                peaks.append((b, peak))
+        step_s = measure_fn(b)
+        spe = step_s / float(b)
+        cand.update(step_s=round(step_s, 6),
+                    s_per_example=spe, status="ok")
+        candidates.append(cand)
+        if best is not None and spe > best[0] * (1.0 + regress_tol):
+            cand["status"] = "regressed"
+            break
+        if best is None or spe < best[0]:
+            best = (spe, int(b), step_s)
+    decision = {
+        "knob": "batch_size",
+        "chosen": best[1] if best else None,
+        "candidates": candidates,
+        "hbm_limit_bytes": int(limit) if limit else None,
+        "headroom": headroom,
+        "regress_tol": regress_tol,
+        "evidence": "hbm_preflight_estimate+measured_step_window",
+    }
+    if best:
+        decision["chosen_s_per_example"] = best[0]
+        decision["chosen_step_s"] = round(best[2], 6)
+    return decision
+
+
+def decide_attention_kernel(xla_step_s, pallas_step_s, min_speedup=1.03):
+    """Pick the Pallas flash kernel only where the measured A/B favors
+    it by at least ``min_speedup`` (the PERF.md shape: Pallas wins
+    1.3-1.9x at T=4096 and LOSES ~1.5x at T<=64 — ties go to XLA, whose
+    global fusion is the safer default)."""
+    xla_step_s = float(xla_step_s)
+    pallas_step_s = float(pallas_step_s)
+    use_pallas = (pallas_step_s > 0
+                  and xla_step_s / pallas_step_s >= float(min_speedup))
+    return {"knob": "attention_kernel", "pallas": bool(use_pallas),
+            "xla_step_s": round(xla_step_s, 6),
+            "pallas_step_s": round(pallas_step_s, 6),
+            "speedup": round(xla_step_s / pallas_step_s, 4)
+            if pallas_step_s > 0 else None,
+            "min_speedup": float(min_speedup),
+            "evidence": "measured_ab_window"}
+
+
+def _length_counts(lengths):
+    """Normalize a length sample ({len: count} dict or iterable of
+    ints) to a sorted (length, count) list."""
+    if isinstance(lengths, dict):
+        items = [(int(n), int(c)) for n, c in lengths.items() if c > 0]
+    else:
+        lengths = list(lengths)
+        if lengths and isinstance(lengths[0], tuple):
+            # already a (length, count) pairing (internal re-entry)
+            items = [(int(n), int(c)) for n, c in lengths if c > 0]
+        else:
+            counts = {}
+            for n in lengths:
+                counts[int(n)] = counts.get(int(n), 0) + 1
+            items = list(counts.items())
+    if not items or min(n for n, _ in items) < 1:
+        raise ValueError("lengths must be a non-empty sample of "
+                         "positive ints")
+    return sorted(items)
+
+
+def token_fill(lengths, bounds):
+    """Real-token fill fraction of a bound set over an observed length
+    histogram: each sample pads to the smallest bound >= its length
+    (samples above the top bound clamp to it — a real reader would
+    truncate or reject).  fill = real tokens / padded tokens."""
+    counts = _length_counts(lengths)
+    bounds = sorted(int(b) for b in bounds)
+    if not bounds:
+        raise ValueError("bounds must be non-empty")
+    real = padded = 0
+    for n, c in counts:
+        b = next((b for b in bounds if b >= n), bounds[-1])
+        real += min(n, b) * c
+        padded += b * c
+    return real / float(padded)
+
+
+def choose_bucket_bounds(lengths, k=4, multiple=16, max_len=None):
+    """Pick up to ``k`` bucket bounds maximizing real-token fill over an
+    observed length histogram, restricted to multiples of ``multiple``
+    (hardware-friendly sizes FIRST, fill-optimal second — the PERF.md
+    r4 ruling: bounds {16,32,48,64} beat six finer ragged bounds whose
+    higher fill lost to poor MXU tiling).  The top bound always covers
+    ``max_len`` (default: the sample's max).  Solved exactly by DP over
+    the sorted candidates (optimal histogram partition) — polynomial in
+    max_len/multiple, so long-context bound sets stay cheap."""
+    counts = _length_counts(lengths)
+    sample_max = counts[-1][0]
+    max_len = int(max_len or sample_max)
+    if max_len < sample_max:
+        raise ValueError("max_len %d below the sample's max length %d"
+                         % (max_len, sample_max))
+    multiple = max(1, int(multiple))
+    top = int(math.ceil(max_len / float(multiple))) * multiple
+    cands = list(range(multiple, top + 1, multiple))
+    k = max(1, min(int(k), len(cands)))
+    # maximizing fill = minimizing padded tokens, which decomposes over
+    # the chosen bounds: lengths in (prev_bound, bound] pad to bound.
+    # DP over sorted candidates (optimal histogram partition, O(n^2 k))
+    # — a long-context max_len yields a hundred-plus candidates, where
+    # the naive subset enumeration explodes combinatorially.
+    n = len(cands)
+    pref = [0] * (n + 1)          # samples with length <= cands[i-1]
+    it = iter(counts)
+    cur = next(it, None)
+    for i, c in enumerate(cands):
+        pref[i + 1] = pref[i]
+        while cur is not None and cur[0] <= c:
+            pref[i + 1] += cur[1]
+            cur = next(it, None)
+
+    def seg(h, i):
+        # padded tokens of lengths in (cands[h-1], cands[i-1]] at bound
+        # cands[i-1]; h == 0 means "no smaller bound chosen"
+        return (pref[i] - pref[h]) * cands[i - 1]
+
+    INF = float("inf")
+    dp = [[INF] * (k + 1) for _ in range(n + 1)]    # dp[i][j]: i-th
+    parent = [[0] * (k + 1) for _ in range(n + 1)]  # cand is j-th bound
+    for i in range(1, n + 1):
+        dp[i][1] = seg(0, i)
+        for j in range(2, min(k, i) + 1):
+            for h in range(j - 1, i):
+                cost = dp[h][j - 1] + seg(h, i)
+                if cost < dp[i][j]:
+                    dp[i][j] = cost
+                    parent[i][j] = h
+    best_j = min(range(1, k + 1), key=lambda j: dp[n][j])
+    bounds = []
+    i, j = n, best_j
+    while j >= 1:
+        bounds.append(cands[i - 1])
+        i, j = parent[i][j], j - 1
+    bounds.reverse()
+    best_fill = token_fill(counts, bounds)
+    return {"knob": "bucket_bounds",
+            "chosen": bounds,
+            "fill": round(best_fill, 4),
+            "k": k, "multiple": multiple, "top_bound": top,
+            "candidates_considered": len(cands),
+            "pad_to_max_fill": round(token_fill(counts, [top]), 4),
+            "evidence": "length_histogram_fill"}
+
+
+def decide_checkpoint_interval(step_s, snapshot_s, save_s=0.0,
+                               budget=None, async_save=True,
+                               replay_step_s=None, min_interval=1,
+                               max_interval=100000):
+    """CheckFreq-style checkpoint cadence from measured costs.
+
+    ``step_s``: measured steady-state step seconds; ``snapshot_s``: the
+    synchronous device->host snapshot cost (the only on-step cost of an
+    async save); ``save_s``: the full serialize+fsync+commit write span
+    (on-step only in sync mode, but the async drain bound below needs
+    it either way); ``budget``: max fraction of compute spent on
+    checkpointing (default ``FLAGS_autotune_overhead_budget``).
+
+    interval = the SMALLEST step count such that (a) on-step cost per
+    interval stays under budget and (b) the async write drains inside
+    the interval (a write slower than the interval's compute backs up
+    into the next snapshot and the drain lands on the step path).
+    Monotone non-decreasing in every measured cost.  ``replay_step_s``
+    (default ``step_s``) prices the worst-case rollback replay of one
+    interval — evidence for the guardian, not a constraint: the formula
+    already picks the smallest budget-feasible interval, which is also
+    the recovery-optimal one.
+    """
+    step_s = float(step_s)
+    if step_s <= 0:
+        raise ValueError("step_s must be positive")
+    snapshot_s = max(0.0, float(snapshot_s))
+    save_s = max(0.0, float(save_s))
+    if budget is None:
+        budget = float(_flag("autotune_overhead_budget", 0.035))
+    budget = float(budget)
+    if budget <= 0:
+        raise ValueError("budget must be positive")
+    on_step_cost = snapshot_s + (0.0 if async_save else save_s)
+    interval = int(math.ceil(on_step_cost / (budget * step_s)))
+    drain = int(math.ceil(save_s / step_s)) if async_save else 0
+    interval = max(int(min_interval), interval, drain)
+    interval = min(interval, int(max_interval))
+    replay_step_s = float(replay_step_s if replay_step_s is not None
+                          else step_s)
+    return {"knob": "checkpoint_interval",
+            "chosen": interval,
+            "step_s": round(step_s, 6),
+            "snapshot_s": round(snapshot_s, 6),
+            "save_s": round(save_s, 6),
+            "async_save": bool(async_save),
+            "budget": budget,
+            "overhead_frac": round(
+                on_step_cost / (interval * step_s), 6),
+            "drain_bound_steps": drain,
+            "worst_case_replay_s": round(interval * replay_step_s, 6),
+            "evidence": "measured_checkpoint_spans"}
+
+
+# ---------------------------------------------------------------------------
+# TunedConfig artifact
+# ---------------------------------------------------------------------------
+
+class TunedConfig:
+    """The tuner's output artifact: a list of decisions with their
+    evidence, serialized as JSON.  ``bench.py --autotune`` embeds it in
+    the bench artifact; ``contrib.Trainer(autotune=...)`` consumes it;
+    ``tools/autotune_report.py`` renders it for humans."""
+
+    VERSION = 1
+
+    def __init__(self, decisions=None, meta=None):
+        self.decisions = list(decisions or [])
+        self.meta = dict(meta or {})
+        self.meta.setdefault("version", self.VERSION)
+        if "run_id" not in self.meta:
+            from . import monitor
+
+            self.meta["run_id"] = monitor.run_id()
+        self.meta.setdefault("created_ts", time.time())
+
+    # -- content -------------------------------------------------------
+    def add(self, decision, fingerprint=None, source="measured"):
+        """Append one decision dict (the output of a decide_*/tune_*
+        call), stamped with provenance."""
+        d = dict(decision)
+        if fingerprint:
+            d["fingerprint"] = fingerprint
+        d.setdefault("source", source)
+        self.decisions.append(d)
+        _event({"event": "autotune_decision", "knob": d.get("knob"),
+                "chosen": d.get("chosen", d.get("pallas")),
+                "source": d.get("source"),
+                "fingerprint": d.get("fingerprint")})
+        return d
+
+    def get(self, knob):
+        """The LAST decision for ``knob`` (latest wins), or None."""
+        for d in reversed(self.decisions):
+            if d.get("knob") == knob:
+                return d
+        return None
+
+    def value(self, knob, default=None):
+        d = self.get(knob)
+        if d is None:
+            return default
+        return d.get("chosen", d.get("pallas", default))
+
+    def as_dict(self):
+        return {"meta": dict(self.meta),
+                "decisions": [dict(d) for d in self.decisions]}
+
+    # -- persistence ---------------------------------------------------
+    def save(self, path):
+        """Atomic JSON write; returns ``path``."""
+        d = os.path.dirname(os.path.abspath(path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.as_dict(), f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            doc = json.load(f)
+        return cls(decisions=doc.get("decisions", []),
+                   meta=doc.get("meta", {}))
+
+    # -- application ---------------------------------------------------
+    def apply(self):
+        """Apply the flag-backed decisions to the process, RESPECTING
+        pins: a flag the user set explicitly (env or ``set_flags``)
+        always wins over the tuner.  Returns a list of (knob, outcome)
+        pairs — outcome is "applied", "pinned" (user override wins), or
+        "advisory" (knobs like batch size that callers read from the
+        artifact rather than a flag).  Attention-kernel decisions
+        install into the process :class:`AttentionDecisionTable` (the
+        ``fused_attention`` op consults it per shape)."""
+        from . import flags
+
+        outcomes = []
+        for d in self.decisions:
+            knob = d.get("knob")
+            if knob == "attention_kernel" and d.get("shape"):
+                if flags.pinned("pallas_kernels"):
+                    outcomes.append((knob, "pinned"))
+                    continue
+                attention_table().record(
+                    d.get("fingerprint") or "", d["shape"],
+                    bool(d.get("pallas")), d, persist=False)
+                outcomes.append((knob, "applied"))
+            elif knob == "checkpoint_interval":
+                # applied by the Trainer against its manager (not a
+                # flag); recorded here so the trail is complete
+                outcomes.append((knob, "advisory"))
+            else:
+                outcomes.append((knob, "advisory"))
+        _event({"event": "autotune_applied",
+                "outcomes": [list(o) for o in outcomes]})
+        return outcomes
+
+
+# ---------------------------------------------------------------------------
+# persistent attention-kernel decision table
+# ---------------------------------------------------------------------------
+
+def attention_shape_key(q_shape, k_shape, dtype):
+    """Stable shape key for the attention decision table: (Tq, Tk, d,
+    dtype) — batch and head count don't change the kernel ruling's
+    regime (the [T, T] score materialization does)."""
+    return "T%d:K%d:d%d:%s" % (int(q_shape[2]), int(k_shape[2]),
+                               int(q_shape[3]), np.dtype(dtype).name
+                               if not isinstance(dtype, str) else dtype)
+
+
+class AttentionDecisionTable:
+    """Persistent per-shape XLA-vs-Pallas decisions, keyed by
+    ``fingerprint + shape key``.  Lives as JSON under
+    ``FLAGS_autotune_dir`` (in-memory only when the flag is unset), so a
+    warm process — or a warm bench rung subprocess sharing the dir —
+    reads the measured ruling and pays zero A/B compiles.
+
+    Mutations bump a content token that ``compile_cache.
+    trace_flag_values`` folds into every trace/AOT cache key: a changed
+    ruling re-lowers instead of serving the other kernel's stale trace.
+    """
+
+    FILENAME = "attention_decisions.json"
+
+    def __init__(self, dirname=None):
+        self._dir = dirname
+        self._entries = {}
+        self._loaded = False
+        # content token cached as an immutable tuple: trace_token() is
+        # on every executor cache-key computation (per step), so the
+        # sorted rebuild happens per MUTATION, not per step
+        self._token = None
+        self._mu = threading.Lock()
+
+    def _path(self):
+        d = self._dir if self._dir is not None \
+            else str(_flag("autotune_dir", "") or "")
+        return os.path.join(d, self.FILENAME) if d else None
+
+    def _load_locked(self):
+        if self._loaded:
+            return
+        self._loaded = True
+        path = self._path()
+        if not path or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            entries = doc.get("entries", {})
+            if isinstance(entries, dict):
+                # on-disk rulings merge UNDER in-memory ones (the
+                # running process's fresher measurements win)
+                merged = dict(entries)
+                merged.update(self._entries)
+                self._entries = merged
+                self._token = None
+        except (ValueError, OSError):
+            # a torn write must not poison tuning; re-measure instead
+            self._entries = dict(self._entries)
+
+    def _persist_locked(self):
+        path = self._path()
+        if not path:
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"entries": self._entries}, f, indent=2,
+                      sort_keys=True)
+        os.replace(tmp, path)
+
+    @staticmethod
+    def _key(fingerprint, shape_key):
+        return "%s|%s" % ((fingerprint or "")[:12], shape_key)
+
+    def lookup(self, fingerprint, shape_key):
+        """The ruling for (fingerprint, shape) — falling back to any
+        fingerprint's ruling at the same shape (the regime is the
+        shape's property; the fingerprint records provenance).  Returns
+        the entry dict or None."""
+        with self._mu:
+            self._load_locked()
+            e = self._entries.get(self._key(fingerprint, shape_key))
+            if e is not None:
+                return dict(e)
+            suffix = "|" + shape_key
+            newest = None
+            for k, v in self._entries.items():
+                if k.endswith(suffix) and (
+                        newest is None
+                        or v.get("ts", 0) >= newest.get("ts", 0)):
+                    newest = v
+            return dict(newest) if newest else None
+
+    def record(self, fingerprint, shape_key, pallas, evidence=None,
+               persist=True):
+        entry = {"pallas": bool(pallas), "shape": shape_key,
+                 "fingerprint": (fingerprint or "")[:12],
+                 "ts": time.time()}
+        if evidence:
+            entry["evidence"] = {
+                k: evidence[k] for k in ("xla_step_s", "pallas_step_s",
+                                         "speedup", "min_speedup",
+                                         "source")
+                if k in evidence}
+        with self._mu:
+            self._load_locked()
+            self._entries[self._key(fingerprint, shape_key)] = entry
+            self._token = None
+            if persist:
+                self._persist_locked()
+        return entry
+
+    def entries(self):
+        with self._mu:
+            self._load_locked()
+            return {k: dict(v) for k, v in self._entries.items()}
+
+    def content_token(self):
+        """Hashable digest of every ruling — part of the trace-cache
+        key (two processes with identical tables key identically).
+        Cached until the next mutation; the warm path is one attribute
+        read."""
+        t = self._token
+        if t is not None:
+            return t
+        with self._mu:
+            self._load_locked()
+            if self._token is None:
+                self._token = tuple(sorted(
+                    (k, bool(v.get("pallas"))) for k, v in
+                    self._entries.items()))
+            return self._token
+
+    def clear(self):
+        with self._mu:
+            self._entries.clear()
+            self._loaded = True
+            self._token = None
+
+
+_table = [None]
+
+
+def attention_table():
+    """The process-global attention decision table."""
+    with _mu:
+        if _table[0] is None:
+            _table[0] = AttentionDecisionTable()
+        return _table[0]
+
+
+def _active_table():
+    """The table consulted on hot paths (the ``fused_attention`` op and
+    the trace-cache token): the instantiated process table, or — when
+    ``FLAGS_autotune_dir`` names a persisted table — a lazily loaded
+    one (setting the dir IS the opt-in: a fresh process with the flag
+    picks up the warm rulings without re-running the tuner).  None when
+    neither exists.  Both callers share this helper so the trace key
+    and the lowering always agree on which rulings are in force."""
+    t = _table[0]
+    if t is not None:
+        return t
+    if str(_flag("autotune_dir", "") or ""):
+        return attention_table()
+    return None
+
+
+def reset_attention_table():
+    """Drop the process table (tests); the on-disk file is untouched."""
+    with _mu:
+        _table[0] = None
+
+
+def trace_token():
+    """Token folded into every trace/AOT cache key
+    (``compile_cache.trace_flag_values``): tuned kernel rulings are
+    baked into the lowered jaxpr, so a changed table must re-lower
+    rather than serve the other kernel's stale trace.  Cheap when no
+    table exists (the overwhelmingly common case)."""
+    t = _active_table()
+    if t is None:
+        return ()
+    return t.content_token()
+
+
+def attention_choice(q_shape, k_shape, dtype):
+    """The tuned kernel ruling for this attention shape, or None when
+    there is none — or when the user PINNED ``FLAGS_pallas_kernels``
+    (an explicit flag always beats the tuner).  Called by the
+    ``fused_attention`` op at trace time."""
+    t = _active_table()
+    if t is None:
+        return None
+    from . import flags
+
+    if flags.pinned("pallas_kernels"):
+        return None
+    e = t.lookup("", attention_shape_key(q_shape, k_shape, dtype))
+    return None if e is None else bool(e["pallas"])
+
+
+# ---------------------------------------------------------------------------
+# measurement drivers
+# ---------------------------------------------------------------------------
+
+def hbm_ceiling(device=None):
+    """The tuner's device-memory ceiling in bytes:
+    ``FLAGS_autotune_hbm_bytes`` when set (tests, CPU drills with a
+    fake limit), else ``FLAGS_preflight_hbm_bytes``, else the device's
+    own ``memory_stats()['bytes_limit']``; None = no gate (CPU backends
+    usually report nothing)."""
+    override = int(_flag("autotune_hbm_bytes", 0))
+    if override > 0:
+        return override
+    from .monitor.program_profile import _device_capacity
+
+    return _device_capacity(device)
+
+
+def measure_step_window(exe, program, feed, fetch_list, steps=4,
+                        warmup=1, scope=None):
+    """Seconds per step over a short fetch-synced dispatch window.  The
+    feed is staged on device once; the window dispatches through the
+    executor's already-seeded AOT executable (``cost_analysis`` seeds
+    it), so the window itself performs zero compiles."""
+    import jax
+
+    dev = exe.place.jax_device()
+    staged = {k: jax.device_put(np.asarray(v), dev)
+              for k, v in feed.items()}
+    last = None
+    for _ in range(max(0, warmup)):
+        last = exe.run(program, feed=staged, fetch_list=fetch_list,
+                       scope=scope, return_numpy=False)
+    if last is not None:
+        np.asarray(last[0])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        last = exe.run(program, feed=staged, fetch_list=fetch_list,
+                       scope=scope, return_numpy=False)
+    np.asarray(last[0])       # fetch-sync: true completion of the chain
+    return (time.perf_counter() - t0) / float(steps)
+
+
+@contextlib.contextmanager
+def _probe_run(place):
+    """A fresh scope + executor whose steps are tagged as PROBE work:
+    the program-profile accounting marks probe-only signatures so the
+    tuner's throwaway candidates never blend into the per-program
+    report's wall-share/MFU rows (the A/B-rung pollution bug, fixed at
+    the accounting layer)."""
+    from . import scope as _scope
+    from .executor import Executor
+    from .monitor import program_profile
+
+    s = _scope.Scope()
+    with _scope.scope_guard(s), program_profile.probe_accounting():
+        yield Executor(place), s
+
+
+def tune_batch_size(main_program, startup_program, make_feed, fetch,
+                    place, ladder=None, start=32, max_batch=4096,
+                    probe_steps=4, warmup_steps=1, regress_tol=0.05,
+                    headroom=0.9, config=None):
+    """Tune the batch size for one program: run the geometric ladder
+    with the HBM-preflight gate and short measured windows (see
+    :func:`run_batch_ladder` for the policy).  ``make_feed(batch)``
+    builds the feed dict at a candidate batch; the program itself is
+    batch-agnostic (feed shapes pick the jit signature).
+
+    Compiles exactly once per probed rung (the ``cost_analysis``
+    explicit compile, which seeds the AOT dispatch slot the measured
+    window reuses) — zero backend compiles beyond the declared ladder.
+    Appends the decision to ``config`` when given; returns it."""
+    from . import compile_cache
+    from .executor import _coerce_feed
+    from .framework import Variable
+    from .monitor import program_profile
+
+    fetch_list = [fetch]
+    fetch_names = (fetch.name if isinstance(fetch, Variable)
+                   else str(fetch),)
+    fp = compile_cache.program_fingerprint(main_program)
+    block = main_program.global_block()
+    with _probe_run(place) as (exe, scope):
+        exe.run(startup_program, scope=scope)
+        dev = place.jax_device()
+        limit = hbm_ceiling(dev)
+
+        def probe_fn(b):
+            feed = make_feed(b)
+            exe.cost_analysis(main_program, feed, fetch_list,
+                              scope=scope)
+            # look the profile up by THIS rung's exact feed signature
+            # (the executor's own coercion included): a warm registry
+            # would otherwise serve the newest-captured profile — some
+            # other batch's peak — and poison the ladder
+            names = sorted(feed)
+            sig = tuple(
+                (n, tuple(v.shape), str(v.dtype)) for n, v in
+                ((n, _coerce_feed(block, n, feed[n])) for n in names))
+            prof = program_profile.get(fp, sig, kind="executor",
+                                       fetch_names=fetch_names)
+            peak = prof.peak_hbm_bytes if prof is not None else None
+            _event({"event": "autotune_probe", "knob": "batch_size",
+                    "batch": int(b), "peak_hbm_bytes": peak,
+                    "fingerprint": fp[:12]})
+            return peak
+
+        def measure_fn(b):
+            # probe_fn already ran for this rung (the ladder always
+            # probes before it measures): the signature is compiled and
+            # the AOT dispatch slot is seeded, so the window performs
+            # zero additional compiles
+            feed = make_feed(b)
+            return measure_step_window(exe, main_program, feed,
+                                       fetch_list, steps=probe_steps,
+                                       warmup=warmup_steps, scope=scope)
+
+        decision = run_batch_ladder(
+            ladder or batch_ladder(start, max_batch), limit,
+            probe_fn, measure_fn, regress_tol=regress_tol,
+            headroom=headroom)
+    if config is not None:
+        config.add(decision, fingerprint=fp[:12])
+    else:
+        _event({"event": "autotune_decision", "knob": "batch_size",
+                "chosen": decision["chosen"], "fingerprint": fp[:12]})
+    return decision
+
+
+def tune_attention_kernel(main_program, startup_program, feed, fetch,
+                          place, shape, probe_steps=4, warmup_steps=1,
+                          min_speedup=1.03, table=None, config=None):
+    """Measured XLA-vs-Pallas A/B for one attention shape, served from
+    the persistent decision table when warm (zero compiles).
+
+    ``shape``: ``(q_shape, k_shape, dtype)`` of the model's attention —
+    or a ready shape-key string.  The A/B flips
+    ``FLAGS_pallas_kernels`` (and raises the flash kernel's seq gate to
+    cover the shape) UNPINNED and restores both afterwards, so tuning
+    never counts as the user's explicit choice."""
+    from . import compile_cache, flags
+
+    key = shape if isinstance(shape, str) else attention_shape_key(*shape)
+    table = table or attention_table()
+    fp = compile_cache.program_fingerprint(main_program)
+    cached = table.lookup(fp, key)
+    if cached is not None:
+        decision = {"knob": "attention_kernel", "shape": key,
+                    "pallas": bool(cached["pallas"]),
+                    "evidence": "decision_table",
+                    "cached": True}
+        decision.update(cached.get("evidence") or {})
+        if config is not None:
+            config.add(decision, fingerprint=fp[:12], source="cached")
+        return decision
+
+    seq = 0
+    if not isinstance(shape, str):
+        seq = max(int(shape[0][2]), int(shape[1][2]))
+    fetch_list = [fetch]
+    measured = {}
+    saved = flags.get_flags(["pallas_kernels",
+                             "pallas_attention_max_seq"])
+    saved_pins = {n: flags.pinned(n)
+                  for n in ("pallas_kernels", "pallas_attention_max_seq")}
+    try:
+        for pallas in (False, True):
+            updates = {"pallas_kernels": pallas}
+            if pallas and seq > int(flags.flag(
+                    "pallas_attention_max_seq")):
+                updates["pallas_attention_max_seq"] = seq
+            flags.set_flags(updates, pin=False)
+            with _probe_run(place) as (exe, scope):
+                exe.run(startup_program, scope=scope)
+                exe.cost_analysis(main_program, feed, fetch_list,
+                                  scope=scope)
+                measured[pallas] = measure_step_window(
+                    exe, main_program, feed, fetch_list,
+                    steps=probe_steps, warmup=warmup_steps, scope=scope)
+            _event({"event": "autotune_probe",
+                    "knob": "attention_kernel", "shape": key,
+                    "pallas": pallas,
+                    "step_s": round(measured[pallas], 6)})
+    finally:
+        flags.set_flags({k: v for k, v in saved.items()}, pin=False)
+        flags._restore_pins(saved_pins)
+    decision = decide_attention_kernel(measured[False], measured[True],
+                                       min_speedup=min_speedup)
+    decision["shape"] = key
+    table.record(fp, key, decision["pallas"], decision)
+    if config is not None:
+        config.add(decision, fingerprint=fp[:12])
+    return decision
+
+
+def _span_mean(name):
+    """Mean of a ``span/<name>`` monitor histogram, or None."""
+    from . import monitor
+
+    h = monitor.registry().get("span/" + name)
+    if h is None or not getattr(h, "count", 0):
+        return None
+    return h.sum / h.count
+
+
+def tune_checkpoint_interval(step_s=None, snapshot_s=None, save_s=None,
+                             budget=None, async_save=True,
+                             replay_step_s=None, manager=None,
+                             config=None):
+    """Checkpoint cadence from MEASURED costs: explicit arguments win;
+    otherwise the manager's own cost samples
+    (``TrainStateCheckpointManager.measured_costs()``), then the
+    monitor's ``span/checkpoint/{snapshot,save}`` histograms; ``step_s``
+    falls back to the StepStats mean.  Raises when no step-time
+    measurement exists (there is nothing profile-guided about a
+    guess)."""
+    costs = manager.measured_costs() if manager is not None else {}
+    if snapshot_s is None:
+        snapshot_s = costs.get("snapshot_s")
+    if snapshot_s is None:
+        snapshot_s = _span_mean("checkpoint/snapshot")
+    if save_s is None:
+        save_s = costs.get("save_s")
+    if save_s is None:
+        save_s = _span_mean("checkpoint/save")
+    if snapshot_s is None and save_s is None:
+        # zero-cost inputs would compute interval=1 (checkpoint every
+        # step) from NO evidence — the opposite of the budget's intent
+        raise ValueError(
+            "tune_checkpoint_interval: no measured checkpoint cost "
+            "(pass snapshot_s/save_s, or complete at least one save "
+            "through the manager / a monitored run first)")
+    if step_s is None:
+        from . import monitor
+
+        summ = monitor.step_stats().summary() or {}
+        step_s = summ.get("mean_step_seconds")
+    if not step_s:
+        raise ValueError(
+            "tune_checkpoint_interval: no measured step time (pass "
+            "step_s, or run some monitored steps first)")
+    decision = decide_checkpoint_interval(
+        step_s, snapshot_s or 0.0, save_s or 0.0, budget=budget,
+        async_save=async_save, replay_step_s=replay_step_s)
+    if manager is not None and costs:
+        decision["measured_saves"] = costs.get("n", 0)
+    if config is not None:
+        config.add(decision)
+    else:
+        _event({"event": "autotune_decision",
+                "knob": "checkpoint_interval",
+                "chosen": decision["chosen"]})
+    return decision

@@ -76,12 +76,18 @@ class CheckpointConfig:
     ``step_interval`` counts GLOBAL steps across epochs."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=10, async_save=True):
+                 epoch_interval=1, step_interval=None, async_save=True):
         self.checkpoint_dir = checkpoint_dir or os.path.join(
             os.getcwd(), "checkpoints")
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(int(epoch_interval), 1)
-        self.step_interval = max(int(step_interval), 1)
+        # an EXPLICIT step_interval is a pin: the auto-tuner's
+        # checkpoint-interval decision (Trainer(autotune=...)) never
+        # overrides a cadence the user chose; None takes the historical
+        # default of 10 and stays tunable
+        self.step_interval_pinned = step_interval is not None
+        self.step_interval = max(int(step_interval), 1) \
+            if step_interval is not None else 10
         self.async_save = bool(async_save)
         self.epoch_id = 0
         self.step_id = 0
@@ -100,14 +106,21 @@ class Trainer:
 
     def __init__(self, train_func, optimizer_func, param_path=None,
                  place=None, parallel=False, checkpoint_config=None,
-                 mesh=None, guardian_config=None):
+                 mesh=None, guardian_config=None, autotune=None):
         """``guardian_config``: the recovery policy — a ``Guardian``
         instance, or a kwargs dict for ``guardian.Guardian`` (policy
         ladder, window, budgets...).  Passing one turns the guardian on
         (``FLAGS_guardian``) for the duration of ``train()``; with the
         flag already set the Trainer wires a default Guardian in by
         itself, so a flag-enabled run is guarded with no code
-        changes."""
+        changes.
+
+        ``autotune``: a ``paddle_tpu.autotune.TunedConfig`` (or a path
+        to its JSON artifact).  Flag-backed decisions apply through
+        ``TunedConfig.apply`` (pinned flags win); a tuned
+        ``checkpoint_interval`` re-gates the checkpoint manager unless
+        the user pinned ``CheckpointConfig(step_interval=...)``
+        explicitly."""
         self.__stop = False
         self.parallel = parallel
         self.place = _default_place(place)
@@ -160,6 +173,34 @@ class Trainer:
                 fluid_io.load_persistables(
                     Executor(self.place), param_path,
                     main_program=self.startup_program)
+
+        self._autotune = None
+        if autotune is not None:
+            from .. import autotune as _at
+
+            self._autotune = autotune if isinstance(
+                autotune, _at.TunedConfig) else _at.TunedConfig.load(
+                autotune)
+            # flag-backed decisions (attention-kernel table install);
+            # pinned flags win inside apply()
+            self._autotune.apply()
+            interval = self._autotune.value("checkpoint_interval")
+            if interval and self.checkpoint_cfg is not None:
+                if self.checkpoint_cfg.step_interval_pinned:
+                    monitor.log_event({
+                        "event": "autotune_applied",
+                        "knob": "checkpoint_interval",
+                        "outcome": "pinned",
+                        "pinned_interval":
+                            self.checkpoint_cfg.step_interval})
+                else:
+                    self.checkpoint_cfg.step_interval = max(
+                        1, int(interval))
+                    monitor.log_event({
+                        "event": "autotune_applied",
+                        "knob": "checkpoint_interval",
+                        "outcome": "applied",
+                        "interval": self.checkpoint_cfg.step_interval})
 
         self._ckpt_mgr = None
         self._global_step = 0

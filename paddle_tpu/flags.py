@@ -39,11 +39,18 @@ from a spec string — each flag is documented at its registration below.
 import os
 import threading
 
-__all__ = ["set_flags", "get_flags", "register_flag"]
+__all__ = ["set_flags", "get_flags", "register_flag", "pinned"]
 
 _mu = threading.Lock()
 _FLAGS = {}
 _TYPES = {}
+# flags the OPERATOR set explicitly (env override at import, or
+# set_flags with the default pin=True): the auto-tuner's decisions
+# (autotune.py) defer to pinned flags — an explicit user choice always
+# beats a tuned one.  Internal machinery that flips flags on the user's
+# behalf without expressing a preference (the tuner's own A/B arms)
+# passes pin=False.
+_PINNED = set()
 
 
 def register_flag(name, default, typ=None, on_set=None):
@@ -55,6 +62,7 @@ def register_flag(name, default, typ=None, on_set=None):
     env = os.environ.get("FLAGS_" + name)
     if env is not None:
         val = _parse(env, typ)
+        _PINNED.add(name)
     _FLAGS[name] = val
     if on_set is not None and env is not None:
         on_set(val)
@@ -66,9 +74,15 @@ def _parse(s, typ):
     return typ(s)
 
 
-def set_flags(flags):
+def set_flags(flags, pin=True):
     """set_flags({'FLAGS_check_nan_inf': True}) — accepts both the
-    FLAGS_-prefixed spelling (reference API) and the bare name."""
+    FLAGS_-prefixed spelling (reference API) and the bare name.
+
+    ``pin=True`` (the default) marks each flag as an explicit operator
+    choice (see :func:`pinned`): the auto-tuner never overrides a
+    pinned flag.  ``pin=False`` is for machinery — the tuner's own A/B
+    arms, restore-after paths — that sets values without expressing a
+    preference."""
     with _mu:
         for k, v in flags.items():
             name = k[6:] if k.startswith("FLAGS_") else k
@@ -89,6 +103,8 @@ def set_flags(flags):
                     # their own flag (_on_monitor_change).
                     _FLAGS[name] = prev
                     raise
+            if pin:
+                _PINNED.add(name)
 
 
 def get_flags(names):
@@ -107,6 +123,25 @@ def get_flags(names):
 def flag(name):
     """Fast internal accessor."""
     return _FLAGS[name]
+
+
+def pinned(name):
+    """Whether the operator set this flag explicitly (env override at
+    import, or ``set_flags`` with the default ``pin=True``).  The
+    auto-tuner (``autotune.py``) consults this before applying any
+    flag-backed decision: a pinned flag always wins over the tuner."""
+    name = name[6:] if name.startswith("FLAGS_") else name
+    if name not in _FLAGS:
+        raise KeyError("unknown flag %r" % name)
+    return name in _PINNED
+
+
+def _restore_pins(mapping):
+    """Restore a saved {name: was_pinned} snapshot (the tuner's A/B
+    arms save pins, flip flags unpinned, and put the world back)."""
+    with _mu:
+        for name, was in mapping.items():
+            (_PINNED.add if was else _PINNED.discard)(name)
 
 
 def _on_debug_nans(val):
@@ -299,6 +334,22 @@ def _on_fault_spec(val):
     fault.install_from_spec(val)
 
 
+# Profile-guided auto-configuration (autotune.py): where TunedConfig
+# artifacts and the persistent attention-kernel decision table live
+# ("" = decision table stays in-memory only; warm processes then
+# re-measure)
+register_flag("autotune_dir", "", str)
+# device-memory ceiling override in bytes for the tuner's batch-size
+# probe (0 = fall back to FLAGS_preflight_hbm_bytes, then the device's
+# memory_stats()['bytes_limit']).  The probe rejects candidates by the
+# compiled module's own peak-HBM ESTIMATE against this ceiling — never
+# by an OOM crash — which is what makes the ladder testable on CPU
+# with a fake limit.
+register_flag("autotune_hbm_bytes", 0, int)
+# checkpoint-cadence overhead budget (CheckFreq-style): the tuner picks
+# the smallest save interval whose measured on-step checkpoint cost
+# stays under this fraction of compute
+register_flag("autotune_overhead_budget", 0.035, float)
 # seed for probabilistic fault schedules (prob=...): two runs with the
 # same seed inject at identical steps.  Registered BEFORE fault_spec:
 # an env-set spec installs schedules at import, which read this flag.

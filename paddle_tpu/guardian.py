@@ -252,6 +252,9 @@ class Guardian:
         self._plateau_armed = True
         self.skipped_steps = 0
         self.quarantined = []        # [(step, reason)] this run segment
+        # measured replay debt of the last rollback (failed step minus
+        # restored step): the checkpoint-interval tuner's evidence
+        self.last_replay_steps = None
 
     # -- executor hook -------------------------------------------------
     def note_step(self, executor_name, step, ok=None, fetch_names=(),
@@ -460,8 +463,14 @@ class Guardian:
                 step=s, scope=scope, program=program,
                 executors=executors, readers=readers,
                 shardings=shardings, train_state=ts)
+            # the measured replay debt of this recovery: steps between
+            # the restored artifact and the failure, i.e. the work a
+            # rollback re-executes.  autotune.tune_checkpoint_interval
+            # prices the checkpoint cadence against exactly this.
+            self.last_replay_steps = max(0, int(rb.step) - int(restored))
             self._event({"event": "guardian_rollback", "step": rb.step,
                          "reason": rb.reason, "restored_step": restored,
+                         "replay_steps": self.last_replay_steps,
                          "rollbacks": self._rollbacks,
                          "quarantined": rb.quarantined})
             return restored

@@ -405,6 +405,10 @@ def record_step(name, step_seconds, examples, dispatch_queue_depth,
                 _registry.counter("monitor/steps_compiled").inc()
         if fingerprint:
             rec["fingerprint"] = fingerprint
+            if program_profile.probe_active():
+                # tuner probe steps carry the tag into the JSONL so the
+                # offline program_report replay excludes them too
+                rec["probe"] = True
             _last_fp[0] = fingerprint
             h = _program_handles(fingerprint[:12])
             h["steps"].inc()

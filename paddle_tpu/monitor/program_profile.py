@@ -36,6 +36,7 @@ via ``FLAGS_preflight_hbm_bytes``).  Over capacity →
 instead of letting XLA OOM mid-run.
 """
 
+import contextlib
 import os
 import threading
 import time
@@ -44,8 +45,9 @@ import warnings
 __all__ = [
     "PreflightOOMError", "ProgramProfile", "capture_enabled", "capture",
     "store_compiled", "get", "profiles", "note_step", "accounting",
-    "summary_for", "report_rows", "render_table", "reset",
-    "reset_accounting", "DEFAULT_PEAK_TFLOPS",
+    "probe_accounting", "probe_active", "probe_totals", "summary_for",
+    "report_rows", "render_table", "reset", "reset_accounting",
+    "DEFAULT_PEAK_TFLOPS",
 ]
 
 # chip peak (bf16 matmul TFLOP/s) for the MFU column; same env knob as
@@ -64,6 +66,10 @@ _mu = threading.Lock()
 # (the replicated-vs-fsdp A/B rung is exactly this pattern).
 _profiles = {}
 _acct = {}          # fingerprint -> {steps, wall_s, examples, kind}
+# auto-tuner probe dispatches accumulate HERE, never in _acct: a probe
+# of the same fingerprint the run later trains steady-state must not
+# blend its wall clock into the steady row's share/MFU
+_acct_probe = {}
 _warned = set()     # (fingerprint, feed_sig, partition) preflight warns issued
 
 
@@ -351,14 +357,46 @@ def profiles():
         return list(_profiles.values())
 
 
+# auto-tuner probe window depth: steps recorded while a probe window is
+# open tag their accounting entries, so a tuner's throwaway candidate
+# dispatches never blend into the per-program report's wall-share/MFU
+# rows (the same program fingerprint later running steady-state clears
+# the tag — "probe" means probe-ONLY)
+_probe_depth = [0]
+
+
+@contextlib.contextmanager
+def probe_accounting():
+    """Mark the dynamic extent of an auto-tuner probe: every step
+    recorded inside is PROBE work.  Re-entrant (nested tuners)."""
+    with _mu:
+        _probe_depth[0] += 1
+    try:
+        yield
+    finally:
+        with _mu:
+            _probe_depth[0] -= 1
+
+
+def probe_active():
+    """Whether an auto-tuner probe window is open (see
+    :func:`probe_accounting`)."""
+    return _probe_depth[0] > 0
+
+
 def note_step(fingerprint, step_seconds, examples, kind="executor"):
     """Fold one completed step into the per-program accounting (called
-    from ``monitor.record_step`` when a fingerprint is attached)."""
+    from ``monitor.record_step`` when a fingerprint is attached).
+    Steps inside a :func:`probe_accounting` window land in a SEPARATE
+    probe bucket — a tuner probing the very fingerprint the run then
+    trains steady-state must not blend its candidates' wall clock into
+    the steady row."""
     with _mu:
-        a = _acct.get(fingerprint)
+        acct = _acct_probe if probe_active() else _acct
+        a = acct.get(fingerprint)
         if a is None:
-            a = _acct[fingerprint] = {"steps": 0, "wall_s": 0.0,
-                                      "examples": 0, "kind": kind}
+            a = acct[fingerprint] = {"steps": 0, "wall_s": 0.0,
+                                     "examples": 0, "kind": kind}
         a["steps"] += 1
         a["wall_s"] += float(step_seconds or 0.0)
         a["examples"] += int(examples or 0)
@@ -366,8 +404,17 @@ def note_step(fingerprint, step_seconds, examples, kind="executor"):
 
 
 def accounting():
+    """Steady-state step accounting (probe work excluded; see
+    :func:`probe_totals`)."""
     with _mu:
         return {fp: dict(a) for fp, a in _acct.items()}
+
+
+def probe_totals():
+    """The tuner-probe accounting bucket, keyed like
+    :func:`accounting`."""
+    with _mu:
+        return {fp: dict(a) for fp, a in _acct_probe.items()}
 
 
 def summary_for(fingerprint):
@@ -394,14 +441,24 @@ def summary_for(fingerprint):
 # report
 # ---------------------------------------------------------------------------
 
-def report_rows(peak_tflops=None, profiles_by_fp=None, acct_by_fp=None):
+def report_rows(peak_tflops=None, profiles_by_fp=None, acct_by_fp=None,
+                probe_acct_by_fp=None):
     """Join profiles + step accounting into per-program report rows,
-    sorted by wall-clock share.  ``profiles_by_fp``/``acct_by_fp``
-    override the live registry (the JSONL-replay path of
-    ``tools/program_report.py``)."""
+    sorted by wall-clock share.  ``profiles_by_fp``/``acct_by_fp``/
+    ``probe_acct_by_fp`` override the live registry (the JSONL-replay
+    path of ``tools/program_report.py``).
+
+    Tuner-probe work (the separate :func:`probe_totals` bucket) renders
+    as its OWN rows flagged ``probe=True`` — excluded from the
+    wall-share denominator and the MFU column, so throwaway candidate
+    dispatches never dilute the steady-state attribution the report
+    exists for (even when they share a fingerprint with steady rows)."""
     peak = (peak_tflops if peak_tflops else DEFAULT_PEAK_TFLOPS) * 1e12
     if acct_by_fp is None:
         acct_by_fp = accounting()
+        if probe_acct_by_fp is None:
+            probe_acct_by_fp = probe_totals()
+    probe_acct_by_fp = probe_acct_by_fp or {}
     if profiles_by_fp is None:
         profiles_by_fp = {}
         for p in profiles():
@@ -411,16 +468,14 @@ def report_rows(peak_tflops=None, profiles_by_fp=None, acct_by_fp=None):
     fps = set(acct_by_fp) | set(profiles_by_fp)
     total_wall = sum((acct_by_fp.get(fp) or {}).get("wall_s", 0.0)
                      for fp in fps)
-    rows = []
-    for fp in fps:
-        a = acct_by_fp.get(fp) or {}
-        p = profiles_by_fp.get(fp)
+
+    def _row(fp, a, p, probe):
         steps = int(a.get("steps", 0))
         wall = float(a.get("wall_s", 0.0))
         row = {"fingerprint": fp, "fp12": fp[:12],
                "kind": a.get("kind") or (p.kind if p is not None else ""),
                "steps": steps, "wall_s": round(wall, 6),
-               "wall_share": round(wall / total_wall, 4)
+               "wall_share": 0.0 if probe else round(wall / total_wall, 4)
                if total_wall > 0 else 0.0,
                "examples": int(a.get("examples", 0)),
                "flops_per_step": float(p.flops) if p is not None else None,
@@ -428,11 +483,19 @@ def report_rows(peak_tflops=None, profiles_by_fp=None, acct_by_fp=None):
                if p is not None else None,
                "peak_hbm_bytes": int(p.peak_hbm_bytes)
                if p is not None else None}
-        if p is not None and wall > 0 and p.flops:
+        if probe:
+            row["probe"] = True
+            row["mfu"] = None
+        elif p is not None and wall > 0 and p.flops:
             row["mfu"] = round(p.flops * steps / wall / peak, 4)
         else:
             row["mfu"] = None
-        rows.append(row)
+        return row
+
+    rows = [_row(fp, acct_by_fp.get(fp) or {}, profiles_by_fp.get(fp),
+                 False) for fp in fps]
+    rows += [_row(fp, a, profiles_by_fp.get(fp), True)
+             for fp, a in probe_acct_by_fp.items()]
     rows.sort(key=lambda r: (-r["wall_s"], r["fingerprint"]))
     return rows
 
@@ -445,8 +508,10 @@ def render_table(rows):
         "GFLOP/step", "GB/step", "peakHBM", "MFU")
     lines = [hdr, "-" * len(hdr)]
     for r in rows:
+        kind = ("probe:" + (r["kind"] or "?")) if r.get("probe") \
+            else (r["kind"] or "?")
         lines.append("%-12s %-10s %8d %10.3f %6.1f%% %12s %12s %10s %7s" % (
-            r["fp12"], (r["kind"] or "?")[:10], r["steps"], r["wall_s"],
+            r["fp12"], kind[:10], r["steps"], r["wall_s"],
             100.0 * r["wall_share"],
             "%.3f" % (r["flops_per_step"] / 1e9)
             if r["flops_per_step"] is not None else "-",
@@ -467,6 +532,7 @@ def reset_accounting():
     artifacts, still valid across a monitor enable/disable flip)."""
     with _mu:
         _acct.clear()
+        _acct_probe.clear()
 
 
 def reset():
@@ -474,4 +540,5 @@ def reset():
     with _mu:
         _profiles.clear()
         _acct.clear()
+        _acct_probe.clear()
         _warned.clear()

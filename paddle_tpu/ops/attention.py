@@ -77,9 +77,21 @@ def _fused_attention_compute(ins, attrs, ctx, op_index):
         out = _ring_attention(mesh, q, k, v, k_len, seed, causal, rate,
                               scale)
     else:
+        from .. import autotune
         from ..flags import flag
-        if flag("pallas_kernels") and fa.supported(q.shape, k.shape,
-                                                   q.dtype):
+
+        # kernel selection: a tuned per-shape ruling (the autotune
+        # decision table's measured A/B) overrides the global flag —
+        # unless the operator PINNED FLAGS_pallas_kernels, in which
+        # case attention_choice returns None and the flag rules
+        choice = autotune.attention_choice(q.shape, k.shape, q.dtype)
+        use_pallas = flag("pallas_kernels") if choice is None else choice
+        # a tuned Pallas ruling was measured AT this sequence length, so
+        # it lifts the flag's seq gate for this shape (the VMEM budget
+        # inside supported() still applies)
+        max_seq = max(q.shape[2], k.shape[2]) if choice else None
+        if use_pallas and fa.supported(q.shape, k.shape, q.dtype,
+                                       max_seq=max_seq):
             from .pallas import interpret_mode
             out = fa.flash_attention(q, k, v, k_len, seed, causal, rate,
                                      scale, interpret_mode(ctx))

@@ -224,9 +224,12 @@ def _pick_blocks(tq, tk):
     return bq, _ceil_to(tq, bq), bk, _ceil_to(tk, bk)
 
 
-def supported(q_shape, k_shape, dtype):
+def supported(q_shape, k_shape, dtype, max_seq=None):
     """Whether the kernel can take these shapes (VMEM budget for the
-    per-(b,h) resident K/V + Q/dO blocks); callers fall back to XLA."""
+    per-(b,h) resident K/V + Q/dO blocks); callers fall back to XLA.
+    ``max_seq`` overrides the flag's sequence gate (a tuned per-shape
+    ruling was measured at its own length; the VMEM budget below still
+    applies)."""
     if len(q_shape) != 4 or len(k_shape) != 4:
         return False
     tq, d = q_shape[2], q_shape[3]
@@ -239,7 +242,8 @@ def supported(q_shape, k_shape, dtype):
     # compile service has been observed to fail even though the kernel
     # alone compiles (verified to T=4096); the XLA fallback handles long
     # single-chip sequences and ring attention (sp) scales further
-    if max(tq, tk) > flag("pallas_attention_max_seq"):
+    if max(tq, tk) > (max_seq if max_seq is not None
+                      else flag("pallas_attention_max_seq")):
         return False
     bq, tq_pad, bk, tk_pad = _pick_blocks(tq, tk)
     itemsize = 2 if dtype == jnp.bfloat16 else 4

@@ -27,6 +27,7 @@ the latest is partial or corrupt — the production pattern of CheckFreq
 (FAST'21) / Check-N-Run (NSDI'22), see PAPERS.md.
 """
 
+import collections
 import hashlib
 import json
 import os
@@ -556,6 +557,11 @@ class TrainStateCheckpointManager:
         self._last_saved = None
         self._inflight = None            # (thread, step)
         self._error = None
+        # rolling measured costs (autotune.tune_checkpoint_interval's
+        # evidence): the synchronous device->host snapshot span and the
+        # background write span, most recent samples
+        self._snapshot_s = collections.deque(maxlen=16)
+        self._save_s = collections.deque(maxlen=16)
         self._mu = threading.Lock()
         self.last_restored = None        # TrainState of the last restore
         # a dead process's .tmp dirs (kill mid-save) are garbage
@@ -589,6 +595,35 @@ class TrainStateCheckpointManager:
             last = self.latest_step()
         return last is None or step >= last + self._interval
 
+    @property
+    def save_interval_steps(self):
+        return self._interval
+
+    def set_interval(self, save_interval_steps):
+        """Re-gate the save cadence (the auto-tuner's checkpoint-
+        interval decision lands here; a mid-run retune is safe — the
+        gate compares against the last SAVED step either way)."""
+        self._interval = max(1, int(save_interval_steps))
+
+    def measured_costs(self):
+        """Mean measured costs of this manager's own saves — the
+        snapshot (synchronous device->host copy, the only on-step cost
+        of an async save) and the write (serialize+fsync+commit) —
+        plus the sample count.  The evidence
+        ``autotune.tune_checkpoint_interval`` consumes; empty dict
+        before the first save."""
+        # deque snapshots are atomic enough (GIL) for a mean; the
+        # writer thread appends, this reads
+        snaps, saves = list(self._snapshot_s), list(self._save_s)
+        out = {}
+        if snaps:
+            out["snapshot_s"] = sum(snaps) / len(snaps)
+        if saves:
+            out["save_s"] = sum(saves) / len(saves)
+        if out:
+            out["n"] = max(len(snaps), len(saves))
+        return out
+
     # -- save ----------------------------------------------------------
     def save(self, step, scope=None, program=None, executors=None,
              readers=None, extra=None):
@@ -599,9 +634,11 @@ class TrainStateCheckpointManager:
         if not self.should_save(step):
             return False
         self.wait_until_finished()       # drain the previous write
+        t0 = time.perf_counter()
         ts = capture_train_state(step, scope=scope, program=program,
                                  executors=executors, readers=readers,
                                  extra=extra)
+        self._snapshot_s.append(time.perf_counter() - t0)
         self._last_saved = int(step)
         if not self._async:
             self._write(ts)
@@ -628,9 +665,11 @@ class TrainStateCheckpointManager:
                 os.path.exists(os.path.join(self._step_dir(step),
                                             _MANIFEST_FILE)):
             return True
+        t0 = time.perf_counter()
         ts = capture_train_state(step, scope=scope, program=program,
                                  executors=executors, readers=readers,
                                  extra=extra)
+        self._snapshot_s.append(time.perf_counter() - t0)
         self._last_saved = int(step)
         self._write(ts)
         return True
@@ -646,6 +685,7 @@ class TrainStateCheckpointManager:
         t0 = time.perf_counter()
         with RecordEvent("checkpoint/save"):
             path = save_train_state(self._step_dir(ts.step), ts)
+        self._save_s.append(time.perf_counter() - t0)
         self._rotate()
         monitor.mark("checkpoint/saved")
         monitor.log_event({

@@ -61,7 +61,7 @@ def rows_from_records(records, peak_tflops=None, run_id=None):
     from paddle_tpu.monitor.program_profile import (ProgramProfile,
                                                     report_rows)
 
-    profiles, acct = {}, {}
+    profiles, acct, probe_acct = {}, {}, {}
     partitions = {}     # fingerprint -> set of distinct partition ids
     for r in records:
         if not isinstance(r, dict):
@@ -84,14 +84,20 @@ def rows_from_records(records, peak_tflops=None, run_id=None):
                 peak_hbm_bytes=r.get("peak_hbm_bytes", 0),
                 device=r.get("device"))
         elif ev == "step_stats" and r.get("fingerprint"):
-            a = acct.setdefault(r["fingerprint"],
-                                {"steps": 0, "wall_s": 0.0, "examples": 0,
-                                 "kind": r.get("executor", "")})
+            # tuner-probe steps (tagged by probe_accounting at record
+            # time) accumulate separately, mirroring note_step: probe
+            # wall clock never blends into a steady row, even for the
+            # same fingerprint
+            bucket = probe_acct if r.get("probe") else acct
+            a = bucket.setdefault(r["fingerprint"],
+                                  {"steps": 0, "wall_s": 0.0,
+                                   "examples": 0,
+                                   "kind": r.get("executor", "")})
             a["steps"] += 1
             a["wall_s"] += r.get("step_seconds", 0.0) or 0.0
             a["examples"] += r.get("examples", 0) or 0
     rows = report_rows(peak_tflops=peak_tflops, profiles_by_fp=profiles,
-                       acct_by_fp=acct)
+                       acct_by_fp=acct, probe_acct_by_fp=probe_acct)
     # one program compiled under SEVERAL mesh/sharding layouts (the
     # replicated-vs-fsdp A/B) shares a fingerprint: step accounting
     # covers all layouts while the profile columns are the latest

@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/8 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/9 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/8 API signature gate =="
+echo "== 2/9 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/8 8-device virtual-mesh dryrun =="
+echo "== 3/9 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/8 bench smoke (CPU backend, tiny) =="
+echo "== 4/9 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/8 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/9 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/8 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/9 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/8 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/9 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -170,7 +170,7 @@ PY
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
-echo "== 8/8 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+echo "== 8/9 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
 GUARD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
 # the drill is installed purely from the environment (FLAGS_fault_spec)
@@ -226,5 +226,101 @@ PY
 # the decision trail landed in the JSONL, run_id-correlated
 grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
 grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
+
+echo "== 9/9 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
+TUNE_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR"' EXIT
+JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
+import os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import autotune, monitor
+
+out = sys.argv[1]
+monitor.enable(log_dir=os.path.join(out, "monitor"))
+# a fake device-memory ceiling: the probe's rejection mechanism is the
+# compiled module's own peak-HBM ESTIMATE vs this limit, never an OOM —
+# which is exactly what makes the ladder drivable on the CPU backend
+fluid.set_flags({"FLAGS_autotune_hbm_bytes": 3_000_000})
+img = fluid.layers.data("img", shape=[784])
+label = fluid.layers.data("label", shape=[1], dtype="int64")
+h = fluid.layers.fc(img, size=64, act="relu")
+pred = fluid.layers.fc(h, size=10, act="softmax")
+loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+fluid.optimizer.Adam(1e-3).minimize(loss)
+rng = np.random.RandomState(0)
+def make_feed(b):
+    return {"img": rng.rand(b, 784).astype("float32"),
+            "label": rng.randint(0, 10, (b, 1)).astype("int64")}
+cfg = autotune.TunedConfig(meta={"model": "mlp_smoke"})
+d = autotune.tune_batch_size(
+    fluid.default_main_program(), fluid.default_startup_program(),
+    make_feed, loss, fluid.CPUPlace(), start=16, max_batch=1024,
+    probe_steps=2, config=cfg)
+assert d["chosen"], d
+# a checkpoint-interval decision from synthetic-but-plausible measured
+# costs rides in the same artifact (the Trainer consumes it below)
+cfg.add(autotune.decide_checkpoint_interval(
+    step_s=0.02, snapshot_s=0.002, save_s=0.01, async_save=False))
+path = cfg.save(os.path.join(out, "tuned.json"))
+print("TUNED batch=%s -> %s" % (d["chosen"], path), flush=True)
+PY
+test -s "$TUNE_DIR/tuned.json"
+python tools/autotune_report.py "$TUNE_DIR/tuned.json" --verbose \
+  | tee "$TUNE_DIR/report.txt"
+grep -q "batch_size" "$TUNE_DIR/report.txt"
+grep -q "checkpoint_interval" "$TUNE_DIR/report.txt"
+# the decision trail landed in the JSONL
+grep -ql autotune_decision "$TUNE_DIR"/monitor/*.jsonl
+# a Trainer run CONSUMING the artifact completes with finite loss (the
+# tuned checkpoint interval re-gates its manager; nothing is pinned)
+JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
+import os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.contrib import Trainer, CheckpointConfig
+from paddle_tpu.reader import checkpointable
+
+out = sys.argv[1]
+
+def train_func():
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    img = fluid.layers.data("img", shape=[784])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(img, size=64, act="relu")
+    pred = fluid.layers.fc(h, size=10, act="softmax")
+    return fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+
+def samples():
+    rng = np.random.RandomState(0)
+    for _ in range(64):
+        yield (rng.rand(784).astype("float32"),
+               rng.randint(0, 10, (1,)).astype("int64"))
+
+losses = []
+def handler(ev):
+    if hasattr(ev, "metrics"):
+        losses.append(float(np.ravel(ev.metrics[0])[0]))
+
+trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                  optimizer_func=lambda: fluid.optimizer.Adam(1e-3),
+                  checkpoint_config=CheckpointConfig(
+                      checkpoint_dir=os.path.join(out, "ckpt"),
+                      async_save=False),
+                  autotune=os.path.join(out, "tuned.json"))
+# ceil((0.002+0.01) / (0.035 * 0.02)) = 18: the artifact's tuned
+# cadence re-gated the manager (step_interval was NOT pinned)
+assert trainer.checkpoint_cfg.step_interval == 18, \
+    trainer.checkpoint_cfg.step_interval
+trainer.train(num_epochs=1, event_handler=handler,
+              reader=checkpointable(fluid.batch(samples, batch_size=16)),
+              feed_order=["img", "label"])
+assert losses and np.isfinite(losses[-1]), losses[-1:]
+print("AUTOTUNE TRAINER FINAL %.6f over %d steps"
+      % (losses[-1], len(losses)), flush=True)
+PY
 
 echo "CI OK"

@@ -37,6 +37,13 @@ import numpy as np
 RESNET_TARGET = 2900.0 * 0.9
 TRANSFORMER_TARGET = 95000.0 * 0.9
 
+# artifact schema version, stamped top-level on every emitted JSON line
+# together with the run correlation id: tools/bench_history.py keys its
+# cross-run index on them.  Version 1 is the implicit pre-stamp format
+# (BENCH_r01-r04: no schema_version/run_id/goodput fields); version 2
+# adds the stamps and the per-rung goodput attribution summary.
+SCHEMA_VERSION = 2
+
 # chip peak for the est_mfu observability field (VERDICT r2 #7): bf16
 # matmul peak in TFLOP/s; default is v5e (197).  Override via
 # BENCH_PEAK_TFLOPS — one definition shared with the program-profile
@@ -113,6 +120,10 @@ def _bench_program(main, startup, feed_fn, fetch, place, iterations,
     # wall clock and the rung's program_report MFU would be a blend
     from paddle_tpu.monitor import program_profile
     program_profile.reset_accounting()
+    # per-rung goodput attribution: each rung's artifact carries its own
+    # exclusive wall-clock breakdown (compute vs compile vs input wait
+    # vs checkpoint/recovery/probe), reset alongside step_stats
+    monitor.goodput_reset()
     scope = fluid.Scope()
     times = []
     with fluid.scope_guard(scope):
@@ -243,6 +254,9 @@ def _bench_program(main, startup, feed_fn, fetch, place, iterations,
     # step-time aggregates, fetch-sync wait, cache hit ratio, queue
     # depth/occupancy — same fields a production JSONL log carries
     stats["step_stats"] = monitor.step_stats().summary()
+    # where the rung's wall clock went (exclusive buckets + goodput
+    # ratio): cross-run regression tracking reads this per rung
+    stats["goodput"] = monitor.goodput_summary()
     # per-program attribution (startup vs train step vs eval programs):
     # fingerprint, steps, wall share, flops/bytes/peak-HBM, MFU.  Rows
     # with no steps belong to other rungs' programs (profiles are
@@ -985,6 +999,7 @@ def bench_transformer_realdist(args, use_amp=True):
     if not monitor.enabled():
         fluid.set_flags({"FLAGS_monitor": True})
     monitor.step_stats().reset()
+    monitor.goodput_reset()
     batch = args.batch_size or 128
     max_len = 64
     vocab = 32000
@@ -1110,7 +1125,8 @@ def bench_transformer_realdist(args, use_amp=True):
                bucketed_vs_fixed=round(
                    results["bucketed"] / results["fixed_pad_max"], 3),
                bucket_bounds=bounds,
-               step_stats=monitor.step_stats().summary())
+               step_stats=monitor.step_stats().summary(),
+               goodput=monitor.goodput_summary())
     if bounds_decision is not None:
         out["autotune"] = bounds_decision
     return out
@@ -1216,6 +1232,14 @@ def bench_longctx(args, use_amp=True):
                  "unit": "tokens/sec",
                  "vs_baseline": results.get("T4096_pallas_vs_xla", 0.0)},
                 **results)
+
+
+def _ladder_run_id():
+    """The process's monitor run correlation id — one id across the
+    artifact, the JSONL log, /metrics, and chrome traces."""
+    from paddle_tpu import monitor
+
+    return monitor.run_id()
 
 
 def _suffix(use_amp, per_step_feed):
@@ -1332,7 +1356,10 @@ def main():
 
     if args.model == "reader_capacity":
         # pure host-side pipeline measurement: no device, no jax client
-        line = json.dumps(bench_reader_capacity(args))
+        result = bench_reader_capacity(args)
+        result["schema_version"] = SCHEMA_VERSION
+        result["run_id"] = _ladder_run_id()
+        line = json.dumps(result)
         print(line)
         _write_out(line)
         return
@@ -1461,6 +1488,11 @@ def main():
                 primary["omitted"] = list(omitted)
             primary["elapsed_s"] = round(time.monotonic() - t_start, 1)
             primary["ladder_complete"] = done
+            # stable cross-run keys at the TOP level (bench_history
+            # ingests artifacts by them; rung subprocesses stamp their
+            # own run_ids, the ladder's id names the whole artifact)
+            primary["schema_version"] = SCHEMA_VERSION
+            primary["run_id"] = _ladder_run_id()
             line = json.dumps(primary)
             print(line, flush=True)
             _write_out(line)
@@ -1609,6 +1641,10 @@ def main():
     result["nhwc"] = bool(args.nhwc)
     # distinguishes the two halves of the step-overlap A/B in artifacts
     result["sync_feed"] = bool(args.sync_feed)
+    # stable cross-run keys (see the ladder's emit): single-model
+    # invocations are artifacts too
+    result["schema_version"] = SCHEMA_VERSION
+    result["run_id"] = _ladder_run_id()
     line = json.dumps(result)
     print(line)
     _write_out(line)

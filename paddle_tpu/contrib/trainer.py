@@ -318,6 +318,16 @@ class Trainer:
                     # must not run guarded with nobody deciding
                     self._set_guardian_flag = False
                     _flags.set_flags({"guardian": False})
+                if monitor.enabled():
+                    # stamp the run's wall-clock attribution into the
+                    # JSONL at the boundary every post-mortem starts
+                    # from — in the finally, because the runs that NEED
+                    # a post-mortem (guardian abort, preemption) are
+                    # the ones that don't return cleanly
+                    try:
+                        monitor.goodput_stamp()
+                    except Exception:  # noqa: BLE001 — telemetry must
+                        pass           # not mask the real exit
             if self._ckpt_mgr is not None:
                 # a trailing async write must land before the process
                 # can exit believing the state is durable

@@ -523,8 +523,14 @@ class Executor:
         # observability layer is on — a dark process pays nothing here
         fp = compile_cache.program_fingerprint(program) \
             if (mon_t0 is not None or is_profiling()) else None
+        # bucket hint: the goodput ledger (and offline trace_summary)
+        # classify the cold step span as compile badput, the warm one as
+        # the compute remainder — by the producer's own verdict, not by
+        # name guessing
         span_args = {"run_id": monitor.run_id(), "fingerprint": fp[:12],
-                     "step": self._run_counter - 1} if fp else None
+                     "step": self._run_counter - 1,
+                     "bucket": "trace_compile" if cold else "compute"} \
+            if fp else None
         if fault.active():
             fault.fire("executor/dispatch", step_idx)
         with RecordEvent("executor/run"):

@@ -435,7 +435,19 @@ class Guardian:
         landed) are skipped with an event; a structural mismatch still
         raises (configuration error, not a fault).  Returns the
         restored step or raises ``GuardianAbortError`` when no clean
-        artifact exists."""
+        artifact exists.  The whole scan+restore runs under a
+        ``guardian/rollback`` span: the goodput ledger books it (plus
+        the replayed steps after it) as ``recovery`` badput."""
+        from .profiler import RecordEvent
+
+        with RecordEvent("guardian/rollback"):
+            return self._rollback_restore(
+                manager, rb, scope=scope, program=program,
+                executors=executors, readers=readers,
+                shardings=shardings)
+
+    def _rollback_restore(self, manager, rb, scope=None, program=None,
+                          executors=None, readers=None, shardings=None):
         from .parallel.checkpoint import CheckpointCorruptError
 
         candidates = [s for s in manager.all_steps() if s <= rb.step]

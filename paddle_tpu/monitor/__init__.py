@@ -47,6 +47,8 @@ __all__ = [
     "expose_text", "record_step", "observe_span", "mark", "heartbeat",
     "last_span", "queue_states", "track", "log_event", "count", "run_id",
     "sample_device_gauges", "add_stall_listener", "remove_stall_listener",
+    "goodput_ledger", "goodput_summary", "goodput_stamp",
+    "goodput_reset",
 ]
 
 # fast-path gate: a module-global bool read (no lock, no flag lookup) is
@@ -67,6 +69,11 @@ def run_id():
 _mu = threading.RLock()
 _registry = MetricsRegistry()
 _aggregator = StepStatsAggregator(_registry)
+# goodput ledger: exclusive wall-clock attribution over the span/step/
+# event streams (see goodput.py); fed only while the monitor is on
+from .goodput import GoodputLedger  # noqa: E402  (needs nothing above)
+
+_goodput = GoodputLedger(_registry)
 _jsonl = None
 _http = None
 _console = None
@@ -173,6 +180,9 @@ def _reconcile():
             _prog_metrics.clear()
             _dev_metrics.clear()
             _aggregator.reset()
+            # attribution restarts with the session: a re-enabled
+            # monitor must not book the disabled stretch as idle
+            _goodput.reset()
             # per-program step accounting (and the watchdog's suspect-
             # program pointer) restarts with the session; captured
             # profiles are compile artifacts and survive
@@ -231,6 +241,36 @@ def step_stats():
     return _aggregator
 
 
+def goodput_ledger():
+    """The process-global goodput ledger (exclusive wall-clock
+    attribution; see ``monitor/goodput.py``).  The submodule itself
+    stays reachable as ``monitor.goodput`` (classifier table)."""
+    return _goodput
+
+
+def goodput_summary():
+    """The per-run attribution summary: bucket seconds, total wall,
+    goodput ratio — the live twin of ``tools/goodput_report.py``."""
+    return _goodput.summary()
+
+
+def goodput_stamp():
+    """Log the current attribution summary as a ``goodput`` JSONL
+    record (run boundaries: bench rung ends, Trainer.train exit) and
+    return it."""
+    summ = _goodput.summary()
+    if _enabled:
+        log_event(dict(summ, event="goodput", ts=time.time()))
+    return summ
+
+
+def goodput_reset():
+    """Restart the attribution window (bench rungs call this next to
+    ``step_stats().reset()`` so each rung's artifact carries its own
+    attribution)."""
+    _goodput.reset()
+
+
 def expose_text():
     """Prometheus text exposition of every registered metric.  The
     leading comment carries the run correlation id, so a scraped
@@ -271,7 +311,15 @@ def last_span():
 
 def log_event(record):
     """Write one record to the JSONL event log (no-op when unset).
-    Every record is stamped with the run correlation id."""
+    Every record is stamped with the run correlation id.  Enabled
+    processes also tee the record into the goodput ledger, which is how
+    checkpoint/rollback/stall events reach the attribution without the
+    producers knowing about it."""
+    if _enabled:
+        try:
+            _goodput.note_event(record)
+        except Exception:  # noqa: BLE001 — telemetry never breaks a step
+            pass
     j = _jsonl
     if j is not None:
         record.setdefault("run_id", _RUN_ID)
@@ -311,10 +359,12 @@ def _refresh_handle_caches():
         _span_gen[0] = _registry.generation
 
 
-def observe_span(name, dur_us):
+def observe_span(name, dur_us, args=None):
     """Double-publish a completed profiler span into the monitor:
     ``span/<name>`` histogram (seconds) + cumulative totals (feeds the
-    StepStats fetch-sync wait and the watchdog's last-span field)."""
+    StepStats fetch-sync wait and the watchdog's last-span field) + the
+    goodput ledger's span classifier (``args`` may carry the producer's
+    explicit ``bucket`` hint)."""
     global _last_span
     if not _enabled:
         return
@@ -324,6 +374,7 @@ def observe_span(name, dur_us):
     if h is None:
         h = _span_hists[name] = _registry.histogram("span/" + name)
     h.observe(dur_s)
+    _goodput.note_span(name, dur_s, args)
     with _mu:
         _span_totals[name] = _span_totals.get(name, 0.0) + dur_s
         _last_span = (name, time.time(), dur_s)
@@ -403,12 +454,13 @@ def record_step(name, step_seconds, examples, dispatch_queue_depth,
             rec["warm"] = bool(warm)
             if not warm:
                 _registry.counter("monitor/steps_compiled").inc()
+        if program_profile.probe_active():
+            # tuner probe steps carry the tag into the JSONL so the
+            # offline program_report replay and the goodput ledger
+            # exclude them from steady-state attribution
+            rec["probe"] = True
         if fingerprint:
             rec["fingerprint"] = fingerprint
-            if program_profile.probe_active():
-                # tuner probe steps carry the tag into the JSONL so the
-                # offline program_report replay excludes them too
-                rec["probe"] = True
             _last_fp[0] = fingerprint
             h = _program_handles(fingerprint[:12])
             h["steps"].inc()
@@ -418,11 +470,22 @@ def record_step(name, step_seconds, examples, dispatch_queue_depth,
                 h["examples"].inc(examples)
             program_profile.note_step(fingerprint, step_seconds, examples,
                                       kind=name)
+        # attribute this step's wall clock (and the gap before it) into
+        # the goodput buckets; the per-step delta rides in the record so
+        # an offline replay can rebuild the attribution exactly
+        gp_delta, gp_emit = _goodput.note_step(rec, now=rec["ts"])
+        if gp_delta:
+            rec["goodput"] = gp_delta
         rec = _aggregator.record(rec)
         w = _watchdog
         if w is not None:
             w.step_completed()
     log_event(rec)
+    if gp_emit:
+        # periodic cumulative checkpoint record: replays can trust the
+        # ledger's own arithmetic, not just the per-step deltas
+        log_event(dict(_goodput.summary(), event="goodput",
+                       ts=time.time()))
     return rec
 
 
@@ -537,6 +600,10 @@ def _stall_probe():
             "last_span": _last_span,
             "last_step": _aggregator.last(),
             "compile_cache": _import_cc_stats(),
+            # where the wall clock has been going: a stall report that
+            # says "97% input_wait over the last window" is actionable;
+            # "no step completed" is not
+            "goodput": _goodput.snapshot_for_stall(),
             # the suspect: fingerprint + cost/memory profile of the last
             # program a step completed for — a stall report should name
             # which compiled program the device is (probably) stuck in
@@ -591,6 +658,12 @@ def _format_diag(diag):
     if diag.get("last_span"):
         name, ts, dur = diag["last_span"]
         lines.append("  last span %s (%.3fs) at %s" % (name, dur, ts))
+    gp = diag.get("goodput") or {}
+    if gp.get("recent_fractions"):
+        lines.append("  goodput last %d steps: %s" % (
+            gp.get("recent_steps", 0),
+            ", ".join("%s %d%%" % (b, round(f * 100)) for b, f
+                      in gp["recent_fractions"].items())))
     if diag.get("last_program"):
         lines.append("  last program %s" % diag["last_program"])
     return "\n".join(lines) if lines else "  (no pipeline state tracked)"

@@ -490,8 +490,12 @@ class ParallelExecutor:
             else "parallel_executor/compile"
         fp = compile_cache.program_fingerprint(program) \
             if (mon_t0 is not None or is_profiling()) else None
+        # bucket hint for the goodput ledger / offline trace_summary
+        # (same contract as the single-device Executor)
         span_args = {"run_id": monitor.run_id(), "fingerprint": fp[:12],
-                     "step": self._run_counter - 1} if fp else None
+                     "step": self._run_counter - 1,
+                     "bucket": "compute" if compiled.warm
+                     else "trace_compile"} if fp else None
         if fault.active():
             fault.fire("executor/dispatch", step_idx)
         with RecordEvent("parallel_executor/run"):

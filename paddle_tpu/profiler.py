@@ -98,7 +98,9 @@ class RecordEvent:
         if self._prof:
             _append_event(self.name, self.t0, dur, self.args)
         if self._mon:
-            monitor.observe_span(self.name, dur)
+            # args ride along so the goodput ledger sees the producer's
+            # bucket hint (executors tag their cold/warm step spans)
+            monitor.observe_span(self.name, dur, self.args)
         self.t0 = None
         return False
 
@@ -165,8 +167,15 @@ def export_chrome_tracing(path):
         meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                      "tid": tid,
                      "args": {"name": tnames.get(tid, "tid-%d" % tid)}})
+    trace_meta = {"run_id": monitor.run_id()}
+    gp = monitor.goodput_ledger()
+    if gp.steps:
+        # the run's wall-clock attribution rides in the trace metadata,
+        # so a shipped trace carries its own goodput summary alongside
+        # the spans it was derived from
+        trace_meta["goodput"] = gp.summary()
     payload = {"traceEvents": meta + events, "displayTimeUnit": "ms",
-               "metadata": {"run_id": monitor.run_id()}}
+               "metadata": trace_meta}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -1,0 +1,189 @@
+"""tools/bench_history.py: cross-run bench regression tracking over the
+committed driver wrappers (BENCH_r*.json) and fresh bench.py artifacts —
+legacy-methodology gating, noise-band verdicts, the +20% synthetic
+perturbation gate, and bare-artifact (schema v2) ingestion."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(ROOT, "tools", "bench_history.py")
+
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import bench_history  # noqa: E402
+
+
+def _wrapper(n, parsed, rc=0):
+    return {"n": n, "cmd": "python bench.py", "rc": rc, "tail": "",
+            "parsed": parsed}
+
+
+def _rung(metric, value, step_s=None, mfu=None, goodput=None,
+          informational=False, **extra):
+    out = dict({"metric": metric, "value": value, "unit": "items/sec",
+                "vs_baseline": 1.0}, **extra)
+    if step_s is not None:
+        out["min_step_s"] = step_s
+        out["n_windows"] = 3
+    if mfu is not None:
+        out["mfu"] = mfu
+    if goodput is not None:
+        out["goodput"] = {"goodput_ratio": goodput,
+                          "buckets": {}, "wall_seconds": 1.0}
+    if informational:
+        out["informational"] = True
+    return out
+
+
+def _write(tmp_path, name, payload):
+    p = tmp_path / name
+    p.write_text(json.dumps(payload))
+    return str(p)
+
+
+def test_committed_artifact_evolution_passes(tmp_path):
+    """The r01->r04 history: r01/r02 predate the fetch-sync methodology
+    (legacy, never baselines), r04 is an rc=124 timeout with no parsed
+    line (incomplete), r03 is the first comparable run — the evolution
+    PASSes."""
+    paths = [os.path.join(ROOT, "BENCH_r%02d.json" % i)
+             for i in (1, 2, 3, 4)]
+    runs = [bench_history.load_artifact(p, i) for i, p in
+            enumerate(paths)]
+    by = {r["run"]: r for r in runs}
+    assert by["r01"]["status"] == "legacy_methodology"
+    assert by["r02"]["status"] == "legacy_methodology"
+    assert by["r03"]["status"] == "ok"
+    assert by["r04"]["status"] == "incomplete" and by["r04"]["rc"] == 124
+    report = bench_history.compare(runs)
+    assert report["overall"] == "PASS"
+    assert report["latest"] == "r03"
+
+
+def test_synthetic_perturbation_regresses(tmp_path):
+    """A +20% step-time copy of r03 (value scaled down accordingly)
+    must come back REGRESSED against the committed history — the CI
+    gate's self-check."""
+    with open(os.path.join(ROOT, "BENCH_r03.json")) as f:
+        r03 = json.load(f)
+    bad = copy.deepcopy(r03)
+    bad["n"] = 5
+    bad["parsed"]["min_step_s"] = round(
+        r03["parsed"]["min_step_s"] * 1.2, 6)
+    bad["parsed"]["value"] = round(r03["parsed"]["value"] / 1.2, 2)
+    p = _write(tmp_path, "BENCH_r05.json", bad)
+    out = subprocess.run(
+        [sys.executable, TOOL] +
+        [os.path.join(ROOT, "BENCH_r%02d.json" % i)
+         for i in (1, 2, 3, 4)] + [p, "--json"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    assert out.returncode == 1, out.stderr
+    report = json.loads(out.stdout)
+    assert report["overall"] == "REGRESSED"
+    latest = [r for r in report["runs"] if r["run"] == "r05"][0]
+    fields = {(c["metric"], c["field"]): c["verdict"]
+              for c in latest["comparisons"]}
+    assert fields[("resnet50_images_per_sec_bf16",
+                   "min_step_s")] == "REGRESSED"
+    assert fields[("resnet50_images_per_sec_bf16",
+                   "value")] == "REGRESSED"
+
+
+def test_noise_band_tolerates_small_deltas(tmp_path):
+    """Deltas inside the noise band PASS in either direction."""
+    a = _wrapper(1, _rung("m", 100.0, step_s=0.100, mfu=0.2,
+                          goodput=0.9))
+    b = _wrapper(2, _rung("m", 97.0, step_s=0.103, mfu=0.195,
+                          goodput=0.87))
+    runs = [bench_history.load_artifact(
+        _write(tmp_path, "a%d.json" % w["n"], w), i)
+        for i, w in enumerate((a, b))]
+    report = bench_history.compare(runs, noise=0.05)
+    assert report["overall"] == "PASS"
+    # ...and a goodput collapse beyond the band is a regression even
+    # when throughput holds
+    c = _wrapper(3, _rung("m", 100.0, step_s=0.100, mfu=0.2,
+                          goodput=0.70))
+    runs.append(bench_history.load_artifact(
+        _write(tmp_path, "a3.json", c), 2))
+    report = bench_history.compare(runs, noise=0.05)
+    assert report["overall"] == "REGRESSED"
+    regs = report["runs"][-1]["regressions"]
+    assert [r["field"] for r in regs] == ["goodput"]
+
+
+def test_baseline_is_best_prior_not_last(tmp_path):
+    """Comparisons run against the BEST prior value, so a slow run
+    does not lower the bar for the one after it."""
+    ws = [_wrapper(1, _rung("m", 100.0, step_s=0.100)),
+          _wrapper(2, _rung("m", 80.0, step_s=0.125)),   # slow run
+          _wrapper(3, _rung("m", 90.0, step_s=0.111))]   # still slow
+    runs = [bench_history.load_artifact(
+        _write(tmp_path, "w%d.json" % w["n"], w), i)
+        for i, w in enumerate(ws)]
+    report = bench_history.compare(runs, noise=0.05)
+    assert report["runs"][1]["verdict"] == "REGRESSED"
+    assert report["runs"][2]["verdict"] == "REGRESSED"   # vs r1's best
+
+
+def test_informational_and_error_rungs_do_not_gate(tmp_path):
+    parsed = dict(_rung("scored", 100.0, step_s=0.1),
+                  extra_metrics=[
+                      _rung("era_rung", 50.0, step_s=0.2,
+                            informational=True),
+                      dict(_rung("broken_error", 0.0), unit="error",
+                           error="boom")])
+    a = _wrapper(1, parsed)
+    worse = copy.deepcopy(parsed)
+    worse["extra_metrics"][0]["min_step_s"] = 0.4   # era rung 2x slower
+    b = _wrapper(2, worse)
+    runs = [bench_history.load_artifact(
+        _write(tmp_path, "i%d.json" % w["n"], w), i)
+        for i, w in enumerate((a, b))]
+    report = bench_history.compare(runs, noise=0.05)
+    # the informational regression is VISIBLE but does not gate
+    comps = report["runs"][1]["comparisons"]
+    assert any(c["metric"] == "era_rung"
+               and c["verdict"] == "REGRESSED" for c in comps)
+    assert report["overall"] == "PASS"
+    # error rungs are never judged
+    assert not any(c["metric"] == "broken_error" for c in comps)
+
+
+def test_bare_schema_v2_artifact_ingests_with_goodput(tmp_path):
+    """A fresh bench.py artifact (bare JSON line, schema_version 2,
+    run_id, embedded goodput) ingests as a comparable run keyed after
+    the wrapper history."""
+    bare = dict(_rung("m", 100.0, step_s=0.1, goodput=0.93),
+                schema_version=2, run_id="abcd1234-0001",
+                ladder_complete=True)
+    run = bench_history.load_artifact(
+        _write(tmp_path, "fresh.json", bare), 7)
+    assert run["status"] == "ok"
+    assert run["schema_version"] == 2
+    assert run["run_id"] == "abcd1234-0001"
+    assert run["rungs"][0]["goodput"] == pytest.approx(0.93)
+    # a ladder --out file is the reprinted LAST line of a JSONL stream
+    stream = "\n".join(["not json", json.dumps(bare)])
+    p = tmp_path / "stream.json"
+    p.write_text(stream)
+    run2 = bench_history.load_artifact(str(p), 8)
+    assert run2["status"] == "ok"
+
+
+def test_index_written_atomically(tmp_path):
+    a = _write(tmp_path, "x1.json",
+               _wrapper(1, _rung("m", 100.0, step_s=0.1)))
+    idx = str(tmp_path / "history.json")
+    rc = bench_history.main([a, "--index", idx, "--json"])
+    assert rc == 0
+    with open(idx) as f:
+        saved = json.load(f)
+    assert saved["overall"] == "PASS"
+    assert saved["runs"][0]["run"] == "r01"

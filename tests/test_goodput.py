@@ -1,0 +1,555 @@
+"""Goodput ledger tests (ISSUE 10): the span->bucket classifier, the
+ledger's gap/step arithmetic on synthetic timelines, the registry
+publication, the disabled-is-free contract, and the acceptance drill —
+a monitored run exercising checkpoint, rollback, and autotune-probe
+paths whose bucket seconds sum to externally measured wall clock within
+1% with no event double-counted."""
+
+import glob
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import fault, monitor
+from paddle_tpu.monitor.goodput import (BUCKETS, GoodputLedger,
+                                        classify_span)
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture(autouse=True)
+def monitor_off_after():
+    yield
+    fault.clear()
+    fault.clear_injections()
+    monitor.disable()
+    monitor.registry().reset()
+    monitor.step_stats().reset()
+    monitor.goodput_reset()
+
+
+# ---------------------------------------------------------------------------
+# classifier: one table, two consumers (live ledger + trace_summary)
+# ---------------------------------------------------------------------------
+
+def test_classifier_table():
+    assert classify_span("executor/fetch_sync") == "input_wait"
+    assert classify_span("parallel_executor/h2d_transfer") == "input_wait"
+    assert classify_span("executor/compile") == "trace_compile"
+    assert classify_span("checkpoint/snapshot") == "checkpoint_stall"
+    assert classify_span("guardian/rollback") == "recovery"
+    # containers, nested spans, and overlapped background work are
+    # excluded from direct attribution (compute is the step remainder)
+    for name in ("executor/run", "executor/trace", "executor/dispatch",
+                 "prefetch/h2d_transfer", "checkpoint/save",
+                 "trainer/step", "trainer/checkpoint"):
+        assert classify_span(name) is None, name
+    # unknown spans attribute nowhere rather than guessing
+    assert classify_span("somebody/new_span") is None
+
+
+def test_classifier_bucket_hint_wins():
+    # the executors tag their cold/warm step spans: a hint names the
+    # bucket directly; the "compute" hint means "step remainder", which
+    # the ledger derives rather than double-counting the span
+    assert classify_span("executor/dispatch",
+                         {"bucket": "trace_compile"}) == "trace_compile"
+    assert classify_span("executor/compile",
+                         {"bucket": "compute"}) is None
+    # a bogus hint falls back to the name table
+    assert classify_span("executor/compile",
+                         {"bucket": "nonsense"}) == "trace_compile"
+    assert classify_span("executor/compile",
+                         {"run_id": "x"}) == "trace_compile"
+    # RecordEvent args are an arbitrary user payload: non-dict args
+    # must never raise into the step path (regression: review pass)
+    assert classify_span("executor/compile", "a-label") == "trace_compile"
+    assert classify_span("user/custom", ["x"]) is None
+
+
+def test_non_dict_span_args_survive_the_monitored_step(fresh_programs):
+    from paddle_tpu.profiler import RecordEvent
+
+    monitor.enable()
+    with RecordEvent("user/custom", args="label-string"):
+        pass
+    with RecordEvent("executor/compile", args=("tuple", "args")):
+        pass
+    assert monitor.goodput_ledger().totals()["trace_compile"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# ledger arithmetic on synthetic timelines (no executors, no clocks)
+# ---------------------------------------------------------------------------
+
+def _step(ledger, ts, seconds, probe=False):
+    rec = {"step_seconds": seconds, "ts": ts}
+    if probe:
+        rec["probe"] = True
+    return ledger.note_step(rec, now=ts)
+
+
+def test_ledger_step_and_gap_attribution():
+    lg = GoodputLedger()
+    lg.reset(now=1000.0)
+    # a compile span inside the first step, which spans [1003, 1007]
+    lg.note_span("executor/compile", 2.0, now=1005.0)
+    _step(lg, 1007.0, 4.0)
+    t = lg.totals()
+    # gap [1000, 1003] had nothing classified -> other
+    assert t["other"] == pytest.approx(3.0)
+    assert t["trace_compile"] == pytest.approx(2.0)
+    assert t["compute"] == pytest.approx(2.0)
+    # a sync checkpoint leg in the next gap, then a 1s step at 1012
+    lg.note_event({"event": "checkpoint_saved", "ts": 1009.0,
+                   "seconds": 1.0, "async": False})
+    lg.note_span("checkpoint/snapshot", 0.5, now=1008.0)
+    _step(lg, 1012.0, 1.0)
+    t = lg.totals()
+    assert t["checkpoint_stall"] == pytest.approx(1.5)
+    assert t["other"] == pytest.approx(3.0 + (4.0 - 1.5))
+    assert t["compute"] == pytest.approx(3.0)
+    # exhaustive by construction
+    assert sum(t.values()) == pytest.approx(1012.0 - 1000.0)
+
+
+def test_ledger_async_save_is_overlap_not_stall():
+    lg = GoodputLedger()
+    lg.reset(now=0.0)
+    lg.note_event({"event": "checkpoint_saved", "ts": 5.0,
+                   "seconds": 2.0, "async": True})
+    _step(lg, 10.0, 1.0)
+    t = lg.totals()
+    assert t["checkpoint_stall"] == 0.0
+    assert sum(t.values()) == pytest.approx(10.0)
+    assert lg.summary(now=10.0)["overlap_seconds"][
+        "checkpoint_save"] == pytest.approx(2.0)
+
+
+def test_ledger_replay_debt_books_steps_as_recovery():
+    lg = GoodputLedger()
+    lg.reset(now=0.0)
+    _step(lg, 1.0, 1.0)
+    lg.note_span("guardian/rollback", 0.5, now=2.0)
+    lg.note_event({"event": "guardian_rollback", "ts": 2.0,
+                   "replay_steps": 2})
+    _step(lg, 3.0, 1.0)          # replayed
+    _step(lg, 4.0, 1.0)          # replayed
+    _step(lg, 5.0, 1.0)          # fresh work again
+    t = lg.totals()
+    # restore span (0.5, in the gap) + two replayed steps (2.0)
+    assert t["recovery"] == pytest.approx(2.5)
+    assert t["compute"] == pytest.approx(2.0)
+    assert sum(t.values()) == pytest.approx(5.0)
+    assert lg.summary(now=5.0)["recovery_replayed_steps"] == 2
+
+
+def test_ledger_probe_step_and_probe_gap():
+    lg = GoodputLedger()
+    lg.reset(now=0.0)
+    # the gap leading into a probe step is probe work too (the tuner's
+    # cost_analysis compiles run between its measured windows)
+    _step(lg, 3.0, 1.0, probe=True)
+    t = lg.totals()
+    assert t["probe"] == pytest.approx(3.0)
+    assert t["compute"] == 0.0
+    s = lg.summary(now=3.0)
+    assert s["probe_steps"] == 1
+
+
+def test_ledger_stall_window_books_gap_idle():
+    lg = GoodputLedger()
+    lg.reset(now=0.0)
+    _step(lg, 1.0, 1.0)
+    # watchdog fired at t=7 after 4s of no progress; the next step only
+    # begins at t=9 — the stall overlap [3, 7] books as stall_idle
+    lg.note_event({"event": "watchdog_stall", "ts": 7.0,
+                   "stalled_for_s": 4.0})
+    _step(lg, 10.0, 1.0)
+    t = lg.totals()
+    assert t["stall_idle"] == pytest.approx(4.0)
+    assert t["other"] == pytest.approx(4.0)   # [1,3] + [7,9]
+    assert sum(t.values()) == pytest.approx(10.0)
+
+
+def test_ledger_in_step_clamp_keeps_sum_exhaustive():
+    lg = GoodputLedger()
+    lg.reset(now=0.0)
+    # classified in-step spans exceed the step wall (nesting noise):
+    # the carve-out scales down, compute floors at 0, sum is preserved
+    lg.note_span("executor/compile", 3.0, now=0.9)
+    lg.note_span("executor/h2d_transfer", 1.0, now=0.95)
+    _step(lg, 1.0, 1.0)
+    t = lg.totals()
+    assert t["compute"] == pytest.approx(0.0)
+    assert t["trace_compile"] == pytest.approx(0.75)
+    assert t["input_wait"] == pytest.approx(0.25)
+    assert sum(t.values()) == pytest.approx(1.0)
+
+
+def test_ledger_summary_tail_is_readonly():
+    lg = GoodputLedger()
+    lg.reset(now=0.0)
+    _step(lg, 1.0, 1.0)
+    lg.note_span("checkpoint/snapshot", 0.5, now=2.0)
+    s1 = lg.summary(now=4.0)
+    # the tail [1, 4] is attributed in the VIEW: snapshot + other
+    assert s1["buckets"]["checkpoint_stall"] == pytest.approx(0.5)
+    assert s1["buckets"]["other"] == pytest.approx(2.5)
+    assert s1["wall_seconds"] == pytest.approx(4.0)
+    # ...without consuming the pending span or moving the watermark
+    s2 = lg.summary(now=4.0)
+    assert s2 == s1
+    _step(lg, 5.0, 1.0)
+    t = lg.totals()
+    assert t["checkpoint_stall"] == pytest.approx(0.5)
+    assert sum(t.values()) == pytest.approx(5.0)
+
+
+def test_ledger_registry_publication():
+    from paddle_tpu.monitor.registry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    lg = GoodputLedger(reg)
+    lg.reset(now=0.0)
+    lg.note_span("executor/compile", 1.0, now=1.5)
+    _step(lg, 2.0, 1.0)
+    assert reg.get("badput/trace_compile_seconds").value \
+        == pytest.approx(1.0)
+    assert reg.get("goodput/compute_seconds").value == pytest.approx(0.0)
+    assert reg.get("badput/other_seconds").value == pytest.approx(1.0)
+    assert reg.get("goodput/wall_seconds").value == pytest.approx(2.0)
+    assert 0.0 <= reg.get("goodput/ratio").value <= 1.0
+    # counters survive a registry reset via handle re-binding
+    reg.reset()
+    _step(lg, 3.0, 1.0)
+    assert reg.get("goodput/compute_seconds").value == pytest.approx(1.0)
+    exposed = reg.expose_text()
+    assert "badput_trace_compile_seconds" in exposed or \
+        "goodput_ratio" in exposed
+
+
+# ---------------------------------------------------------------------------
+# monitor wiring
+# ---------------------------------------------------------------------------
+
+def _build_mlp():
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.layers.data("x", shape=[8])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=16, act="relu")
+    pred = fluid.layers.fc(h, size=4, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    return loss
+
+
+def test_disabled_monitor_never_touches_the_ledger(fresh_programs):
+    """The disabled-cost contract, A/B-enforced structurally: with the
+    monitor off, a step must make ZERO ledger calls (the one
+    module-global bool read gates everything) — any call would raise
+    here."""
+    monitor.disable()
+    loss = _build_mlp()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    lg = monitor.goodput_ledger()
+    orig = (lg.note_span, lg.note_step, lg.note_event)
+
+    def boom(*a, **k):
+        raise AssertionError("ledger touched while monitor disabled")
+
+    lg.note_span = lg.note_step = lg.note_event = boom
+    try:
+        assert not monitor.enabled()
+        for _ in range(3):
+            exe.run(feed={"x": np.random.rand(4, 8).astype("float32"),
+                          "label": np.zeros((4, 1), "int64")},
+                    fetch_list=[loss])
+    finally:
+        lg.note_span, lg.note_step, lg.note_event = orig
+    assert lg.steps == 0
+
+
+def test_step_records_carry_goodput_deltas(tmp_path, fresh_programs):
+    """Monitored steps stamp their per-step attribution delta into the
+    JSONL record; a cumulative ``goodput`` record lands too; the ratio
+    gauge is live in /metrics text; and the end-to-end exclusive-
+    exhaustive invariant holds — bucket seconds sum to externally
+    measured wall clock within 1% (the slow-marked drill below extends
+    this to checkpoint/rollback/probe paths)."""
+    log_dir = str(tmp_path / "logs")
+    loss = _build_mlp()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    monitor.enable(log_dir=log_dir)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    t0 = time.time()
+    monitor.goodput_ledger().reset(now=t0)
+    for _ in range(3):
+        exe.run(feed={"x": np.random.rand(4, 8).astype("float32"),
+                      "label": np.zeros((4, 1), "int64")},
+                fetch_list=[loss])
+    summ = monitor.goodput_ledger().summary(now=time.time())
+    wall = time.time() - t0
+    assert abs(sum(summ["buckets"].values()) - wall) \
+        <= 0.01 * wall + 0.005, (summ["buckets"], wall)
+    monitor.goodput_stamp()
+    assert "goodput_ratio" in monitor.expose_text()
+    monitor.disable()
+    events = []
+    for p in glob.glob(os.path.join(log_dir, "*.jsonl")):
+        with open(p) as f:
+            events += [json.loads(l) for l in f if l.strip()]
+    steps = [e for e in events if e.get("event") == "step_stats"]
+    assert steps and any(isinstance(e.get("goodput"), dict)
+                         and e["goodput"] for e in steps)
+    stamps = [e for e in events if e.get("event") == "goodput"]
+    assert stamps
+    final = max(stamps, key=lambda e: e.get("wall_seconds") or 0)
+    assert set(final["buckets"]) == set(BUCKETS)
+    assert 0 < final["goodput_ratio"] <= 1
+
+
+def test_trainer_stamps_goodput_even_on_abort(tmp_path, fresh_programs):
+    """The Trainer's exit stamp lives in the finally: a run that dies
+    via GuardianAbortError (the run that NEEDS a post-mortem) still
+    leaves the cumulative goodput record in the JSONL (regression:
+    review pass)."""
+    from paddle_tpu import guardian
+    from paddle_tpu.contrib import Trainer
+    from paddle_tpu.reader import checkpointable
+
+    log_dir = str(tmp_path / "logs")
+    monitor.enable(log_dir=log_dir)
+    fault.clear()
+    fault.clear_injections()
+    # a persistent NaN with no checkpoint config: the guardian wants a
+    # rollback, the Trainer has nothing to roll back to -> typed abort
+    fault.inject_nan("fc_0.w_0", fault.FaultSchedule(steps=[2]),
+                     once=True)
+
+    def train_func():
+        fluid.default_main_program().random_seed = 7
+        fluid.default_startup_program().random_seed = 7
+        return _build_mlp()
+
+    def samples():
+        srng = np.random.RandomState(0)
+        for _ in range(32):
+            x = srng.rand(8).astype("float32")
+            yield x, np.array([0], "int64")
+
+    trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                      optimizer_func=lambda: fluid.optimizer.Adam(1e-2),
+                      guardian_config={"policy": "rollback,abort"})
+    with pytest.raises(guardian.GuardianAbortError):
+        trainer.train(num_epochs=1, event_handler=lambda ev: None,
+                      reader=checkpointable(
+                          fluid.batch(samples, batch_size=4)),
+                      feed_order=["x", "label"])
+    monitor.disable()
+    events = []
+    for p in glob.glob(os.path.join(log_dir, "*.jsonl")):
+        with open(p) as f:
+            events += [json.loads(l) for l in f if l.strip()]
+    assert any(e.get("event") == "goodput" for e in events)
+
+
+def test_goodput_report_tool_replays_the_log(tmp_path, fresh_programs,
+                                             capsys):
+    """tools/goodput_report.py renders the same attribution from the
+    JSONL replay (table + --json), like program_report does for the
+    profile registry.  Invoked in-process (the tool is importable; the
+    CLI wrapper is the same main()) to keep the suite off the
+    interpreter-spawn cost."""
+    log_dir = str(tmp_path / "logs")
+    loss = _build_mlp()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    monitor.enable(log_dir=log_dir)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _ in range(3):
+        exe.run(feed={"x": np.random.rand(4, 8).astype("float32"),
+                      "label": np.zeros((4, 1), "int64")},
+                fetch_list=[loss])
+    live = monitor.goodput_stamp()
+    monitor.disable()
+    sys.path.insert(0, TOOLS)
+    try:
+        import goodput_report
+    finally:
+        sys.path.remove(TOOLS)
+    assert goodput_report.main([log_dir, "--json"]) == 0
+    replayed = json.loads(capsys.readouterr().out)
+    assert replayed["buckets"] == live["buckets"]
+    assert replayed["goodput_ratio"] == live["goodput_ratio"]
+    assert goodput_report.main([log_dir]) == 0
+    table = capsys.readouterr().out
+    assert "goodput ratio" in table and "trace_compile" in table
+
+
+def test_watchdog_stall_dump_includes_goodput_snapshot(fresh_programs):
+    """The stall diagnostic names where the wall clock has been going —
+    actionable ('97% input_wait') instead of 'no step completed'."""
+    loss = _build_mlp()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    monitor.enable(stall_seconds=3600)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    for _ in range(2):
+        exe.run(feed={"x": np.random.rand(4, 8).astype("float32"),
+                      "label": np.zeros((4, 1), "int64")},
+                fetch_list=[loss])
+    from paddle_tpu.monitor import _stall_probe
+
+    diag = _stall_probe()
+    gp = diag["goodput"]
+    assert gp["recent_steps"] >= 2
+    assert gp["recent_fractions"]
+    assert abs(sum(gp["recent_fractions"].values()) - 1.0) < 0.02
+    # and the formatter renders it
+    from paddle_tpu.monitor import _format_diag
+
+    line = _format_diag(dict(diag, stalled_for_s=1.0))
+    assert "goodput last" in line
+
+
+# ---------------------------------------------------------------------------
+# acceptance: exclusive-exhaustive over a run with checkpoint, rollback
+# and probe paths (ISSUE 10 acceptance criterion)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_exclusive_buckets_sum_to_wall_clock(tmp_path, fresh_programs):
+    """A ~50-step monitored run with a forced (synchronous) checkpoint
+    cadence, an injected-NaN guardian rollback, and an autotune probe:
+    bucket seconds sum to externally measured wall clock within 1%, no
+    event is double-counted (checkpoint_stall reconciles against the
+    snapshot spans + sync saves that produced it; recovery covers
+    exactly the rollback + replayed steps), and every badput source
+    shows up in its own bucket.
+
+    ``slow``-marked for the tier-1 wall-clock budget (the precedent of
+    the sp_pp parity drills): the invariant itself stays tier-1-
+    enforced by the synthetic-timeline unit tests above plus the
+    end-to-end 1% check in
+    ``test_step_records_carry_goodput_deltas``; this drill additionally
+    exercises the checkpoint/rollback/probe classification on the real
+    Trainer machinery (run with ``-m slow``)."""
+    from paddle_tpu import autotune
+    from paddle_tpu.contrib import CheckpointConfig, Trainer
+    from paddle_tpu.reader import checkpointable
+
+    log_dir = str(tmp_path / "logs")
+    monitor.enable(log_dir=log_dir)
+    fault.clear()
+    fault.clear_injections()
+    fault.inject_nan("fc_0.w_0", fault.FaultSchedule(steps=[8]),
+                     once=True)
+
+    t0 = time.time()
+    monitor.goodput_ledger().reset(now=t0)
+
+    # --- an autotune probe (its steps and lead-in compiles are PROBE)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        img = fluid.layers.data("img", shape=[16])
+        lbl = fluid.layers.data("lbl", shape=[1], dtype="int64")
+        p = fluid.layers.fc(img, size=4, act="softmax")
+        ploss = fluid.layers.mean(fluid.layers.cross_entropy(p, lbl))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(ploss)
+        rng = np.random.RandomState(0)
+
+        def make_feed(b):
+            return {"img": rng.rand(b, 16).astype("float32"),
+                    "lbl": rng.randint(0, 4, (b, 1)).astype("int64")}
+
+        autotune.tune_batch_size(
+            fluid.default_main_program(),
+            fluid.default_startup_program(), make_feed, ploss,
+            fluid.CPUPlace(), ladder=[8, 16], probe_steps=2,
+            warmup_steps=1)
+
+    # --- the guarded training run: NaN at step 8 -> rollback + replay
+    def train_func():
+        fluid.default_main_program().random_seed = 7
+        fluid.default_startup_program().random_seed = 7
+        return _build_mlp()
+
+    def samples():
+        srng = np.random.RandomState(0)
+        for _ in range(200):
+            x = srng.rand(8).astype("float32")
+            yield x, np.array([int(np.argmax(x[:4]))], "int64")
+
+    losses = []
+
+    def handler(ev):
+        if hasattr(ev, "metrics"):
+            losses.append(float(np.ravel(ev.metrics[0])[0]))
+
+    trainer = Trainer(
+        train_func=train_func, place=fluid.CPUPlace(),
+        optimizer_func=lambda: fluid.optimizer.Adam(1e-2),
+        checkpoint_config=CheckpointConfig(
+            checkpoint_dir=str(tmp_path / "ckpt"), step_interval=5,
+            async_save=False),
+        guardian_config={"policy": "rollback,abort"})
+    trainer.train(num_epochs=1, event_handler=handler,
+                  reader=checkpointable(
+                      fluid.batch(samples, batch_size=4)),
+                  feed_order=["x", "label"])
+    assert len(losses) >= 50 and np.isfinite(losses[-1])
+
+    summary = monitor.goodput_ledger().summary(now=time.time())
+    wall = time.time() - t0
+    monitor.disable()
+
+    buckets = summary["buckets"]
+    total = sum(buckets.values())
+    # exhaustive: the buckets cover the externally measured wall clock
+    assert abs(total - wall) <= 0.01 * wall, (total, wall, buckets)
+    assert summary["wall_seconds"] == pytest.approx(total)
+    # every exercised badput source lands in ITS bucket
+    assert buckets["probe"] > 0
+    assert buckets["checkpoint_stall"] > 0
+    assert buckets["recovery"] > 0
+    assert buckets["trace_compile"] > 0
+    assert buckets["compute"] > 0
+    assert summary["probe_steps"] > 0
+    assert summary["recovery_replayed_steps"] > 0
+
+    # exclusivity / no double count: checkpoint_stall never exceeds the
+    # sync legs that produced it (snapshot spans + sync save events),
+    # and recovery never exceeds rollback span + replayed step time
+    reg = monitor.registry()
+    snap = reg.get("span/checkpoint/snapshot")
+    snap_total = snap.sum if snap is not None else 0.0
+    events = []
+    for path in glob.glob(os.path.join(log_dir, "*.jsonl")):
+        with open(path) as f:
+            events += [json.loads(l) for l in f if l.strip()]
+    sync_saves = sum(e.get("seconds", 0.0)
+                     for e in events if e.get("event") == "checkpoint_saved"
+                     and not e.get("async"))
+    assert buckets["checkpoint_stall"] <= snap_total + sync_saves + 1e-6
+    rb_span = reg.get("span/guardian/rollback")
+    rb_total = rb_span.sum if rb_span is not None else 0.0
+    replay_wall = sum(
+        e.get("step_seconds", 0.0) for e in events
+        if e.get("event") == "step_stats"
+        and "recovery" in (e.get("goodput") or {}))
+    assert rb_total > 0
+    assert buckets["recovery"] <= rb_total + replay_wall + 1e-6
+    # the rollback event's replay debt is exactly what got booked
+    rollbacks = [e for e in events if e.get("event") == "guardian_rollback"]
+    assert len(rollbacks) == 1
+    assert summary["recovery_replayed_steps"] \
+        == rollbacks[0]["replay_steps"]

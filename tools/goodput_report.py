@@ -1,0 +1,139 @@
+"""Per-run goodput report — where every second of wall clock went.
+
+Renders the exclusive wall-clock attribution the goodput ledger
+maintains in-process (``paddle_tpu.monitor.goodput_summary()``) from a
+monitor JSONL log: bucket seconds (compute, input_wait, trace_compile,
+checkpoint_stall, recovery, probe, stall_idle, other), the goodput
+ratio, and the overlapped (non-stall) background work — the offline
+twin of the live summary, like ``tools/program_report.py`` is for the
+program-profile registry.
+
+Replay sources, in preference order:
+
+* ``goodput`` summary records (the ledger's own cumulative arithmetic,
+  stamped periodically, at ``monitor.goodput_stamp()`` calls, and by
+  ``Trainer.train`` on exit) — the record with the largest attributed
+  wall clock wins;
+* failing that, the per-step ``goodput`` delta dicts riding in every
+  ``step_stats`` record are summed (exact by construction: each delta
+  is the ledger's attribution of all wall clock up to that step).
+
+Usage:
+    python tools/goodput_report.py /path/to/monitor_logs        # dir
+    python tools/goodput_report.py monitor-1234.jsonl --json
+    python tools/goodput_report.py logs/ --run_id 6a711a1e-7060
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS_DIR))   # repo root: paddle_tpu
+sys.path.insert(0, _TOOLS_DIR)                    # sibling tools
+
+from program_report import load_records  # noqa: E402  (same tools dir)
+
+
+def summary_from_records(records, run_id=None):
+    """Rebuild the per-run attribution summary from JSONL records.
+    Returns the summary dict (same shape as
+    ``monitor.goodput_summary()``) or None when the log carries no
+    goodput records at all."""
+    from paddle_tpu.monitor.goodput import BUCKETS
+
+    best_stamp = None
+    deltas = {b: 0.0 for b in BUCKETS}
+    steps = probe_steps = 0
+    saw_delta = False
+    for r in records:
+        if not isinstance(r, dict):
+            continue
+        if run_id and r.get("run_id") not in (None, run_id):
+            continue
+        ev = r.get("event")
+        if ev == "goodput" and isinstance(r.get("buckets"), dict):
+            if best_stamp is None or (r.get("wall_seconds") or 0.0) \
+                    > (best_stamp.get("wall_seconds") or 0.0):
+                best_stamp = r
+        elif ev == "step_stats":
+            steps += 1
+            if r.get("probe"):
+                probe_steps += 1
+            gp = r.get("goodput")
+            if isinstance(gp, dict):
+                saw_delta = True
+                for b, s in gp.items():
+                    if b in deltas:
+                        deltas[b] += float(s or 0.0)
+    delta_wall = sum(deltas.values())
+    if best_stamp is not None and \
+            (best_stamp.get("wall_seconds") or 0.0) >= delta_wall:
+        return {k: best_stamp[k] for k in
+                ("buckets", "wall_seconds", "goodput_ratio", "steps",
+                 "probe_steps", "recovery_replayed_steps",
+                 "overlap_seconds") if k in best_stamp}
+    if not saw_delta:
+        return None
+    buckets = {b: round(s, 6) for b, s in deltas.items()}
+    return {"buckets": buckets,
+            "wall_seconds": round(delta_wall, 6),
+            "goodput_ratio": round(buckets["compute"] / delta_wall, 4)
+            if delta_wall > 0 else None,
+            "steps": steps, "probe_steps": probe_steps}
+
+
+def render(summary):
+    """Fixed-width attribution table + the one-line verdict."""
+    from paddle_tpu.monitor.goodput import BUCKETS
+
+    wall = summary.get("wall_seconds") or 0.0
+    lines = ["%-18s %12s %8s" % ("bucket", "seconds", "share"),
+             "-" * 40]
+    for b in BUCKETS:
+        s = (summary.get("buckets") or {}).get(b, 0.0)
+        lines.append("%-18s %12.3f %7.1f%%"
+                     % (b, s, 100.0 * s / wall if wall > 0 else 0.0))
+    lines.append("-" * 40)
+    ratio = summary.get("goodput_ratio")
+    lines.append("goodput ratio %.4f over %.3fs wall (%s steps)"
+                 % (ratio if ratio is not None else 0.0, wall,
+                    summary.get("steps", "?")))
+    for k, v in sorted((summary.get("overlap_seconds") or {}).items()):
+        lines.append("overlapped (not badput): %s %.3fs" % (k, v))
+    if summary.get("recovery_replayed_steps"):
+        lines.append("recovery replayed %d steps"
+                     % summary["recovery_replayed_steps"])
+    if summary.get("probe_steps"):
+        lines.append("autotune probe steps: %d" % summary["probe_steps"])
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="per-run goodput/badput attribution from a monitor "
+                    "JSONL log")
+    p.add_argument("log", help="monitor JSONL file, or a "
+                               "FLAGS_monitor_log_dir directory")
+    p.add_argument("--run_id", default=None,
+                   help="only records of this run correlation id")
+    p.add_argument("--json", action="store_true",
+                   help="emit the summary as JSON instead of a table")
+    args = p.parse_args(argv)
+
+    records = load_records(args.log)
+    summary = summary_from_records(records, run_id=args.run_id)
+    if summary is None:
+        print("no goodput records in %s (monitor on? this run predates "
+              "the goodput ledger?)" % args.log)
+        return 1
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/9 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/10 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/9 API signature gate =="
+echo "== 2/10 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/9 8-device virtual-mesh dryrun =="
+echo "== 3/10 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/9 bench smoke (CPU backend, tiny) =="
+echo "== 4/10 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/9 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/10 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/9 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/10 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/9 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/10 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -170,7 +170,7 @@ PY
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
-echo "== 8/9 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+echo "== 8/10 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
 GUARD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
 # the drill is installed purely from the environment (FLAGS_fault_spec)
@@ -227,7 +227,7 @@ PY
 grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
 grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
 
-echo "== 9/9 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
+echo "== 9/10 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
 TUNE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
@@ -321,6 +321,66 @@ trainer.train(num_epochs=1, event_handler=handler,
 assert losses and np.isfinite(losses[-1]), losses[-1:]
 print("AUTOTUNE TRAINER FINAL %.6f over %d steps"
       % (losses[-1], len(losses)), flush=True)
+PY
+
+echo "== 10/10 goodput smoke + bench-history regression gate =="
+GOOD_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR"' EXIT
+# (a) a 3-step monitored MLP run -> the goodput ledger attributes its
+# wall clock, the report renders it, and the ratio is in (0, 1]
+JAX_PLATFORMS=cpu python - "$GOOD_DIR" <<'PY'
+import os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+
+out = sys.argv[1]
+monitor.enable(log_dir=os.path.join(out, "monitor"))
+x = fluid.layers.data("x", shape=[8])
+loss = fluid.layers.mean(fluid.layers.fc(x, size=4, act="relu"))
+fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+for _ in range(3):
+    exe.run(feed={"x": np.random.rand(4, 8).astype("float32")},
+            fetch_list=[loss])
+s = monitor.goodput_stamp()
+assert s["goodput_ratio"] is not None and 0 < s["goodput_ratio"] <= 1, s
+print("GOODPUT ratio %.4f over %.3fs (%d steps)"
+      % (s["goodput_ratio"], s["wall_seconds"], s["steps"]), flush=True)
+PY
+python tools/goodput_report.py "$GOOD_DIR/monitor" | tee "$GOOD_DIR/report.txt"
+grep -q "goodput ratio" "$GOOD_DIR/report.txt"
+grep -q "trace_compile" "$GOOD_DIR/report.txt"
+# (b) cross-run regression gate: the committed BENCH_r01-r04 evolution
+# PASSes, and a synthetically perturbed (+20% step time) copy of the
+# newest comparable artifact comes back REGRESSED
+python tools/bench_history.py BENCH_r0*.json --json \
+  | python -c "import json,sys; r=json.load(sys.stdin); \
+assert r['overall']=='PASS', r['overall']; print('bench_history: committed history PASS')"
+python - "$GOOD_DIR" <<'PY'
+import copy, json, sys
+d = json.load(open("BENCH_r03.json"))
+p = copy.deepcopy(d); p["n"] = 99
+p["parsed"]["min_step_s"] = round(d["parsed"]["min_step_s"] * 1.2, 6)
+p["parsed"]["value"] = round(d["parsed"]["value"] / 1.2, 2)
+json.dump(p, open(sys.argv[1] + "/BENCH_r99_perturbed.json", "w"))
+PY
+set +e
+python tools/bench_history.py BENCH_r0*.json "$GOOD_DIR/BENCH_r99_perturbed.json" \
+  --json > "$GOOD_DIR/history.json"
+rc=$?
+set -e
+test "$rc" -eq 1   # a regression exits 1 (the CI contract)
+python - "$GOOD_DIR" <<'PY'
+import json, sys
+r = json.load(open(sys.argv[1] + "/history.json"))
+assert r["overall"] == "REGRESSED", r["overall"]
+bad = [x for x in r["runs"] if x["run"] == "r99"][0]
+assert any(c["field"] == "min_step_s" and c["verdict"] == "REGRESSED"
+           for c in bad["comparisons"]), bad
+print("bench_history: +20% perturbation flagged REGRESSED")
 PY
 
 echo "CI OK"

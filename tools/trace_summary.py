@@ -9,6 +9,16 @@ can be summarized without replaying them.  Zero-duration marks
 tallied separately as ``mark/<name>`` counter totals, matching the
 monitor counters they double-publish into.
 
+A goodput attribution block follows the span table: every span is run
+through the SAME span->bucket classifier the live goodput ledger uses
+(``paddle_tpu.monitor.goodput.classify_span`` — one classification
+table, two consumers), so an offline trace and the run's own
+``goodput_report`` agree on which seconds were compile, input wait,
+checkpoint stall, or recovery.  Spans the ledger excludes (containers,
+nested spans, overlapped background work) are totalled separately, and
+a trace whose metadata carries the exporter-stamped ``goodput`` summary
+prints it verbatim.
+
 Usage:
     python tools/trace_summary.py /path/to/trace.json
     python tools/trace_summary.py trace.json --sorted_key calls --top 10
@@ -64,7 +74,38 @@ def main(argv=None):
         print("\n%-40s %12s" % ("Counter", "count"))
         for name in sorted(marks, key=marks.get, reverse=True)[:args.top]:
             print("%-40s %12d" % ("mark/" + name, marks[name]))
+    if spans:
+        print("\n" + bucket_block(spans, data))
     return 0
+
+
+def bucket_block(spans, data):
+    """Span->bucket attribution over the trace's X-phase spans, via the
+    ledger's own classifier (bucket hints in span args win, then the
+    shared name table; excluded spans are shown, not dropped)."""
+    from paddle_tpu.monitor.goodput import classify_span
+
+    buckets, excluded = {}, 0.0
+    for e in spans:
+        dur_s = (e.get("dur") or 0.0) / 1e6
+        b = classify_span(e["name"], e.get("args"))
+        if b is None:
+            excluded += dur_s
+        else:
+            buckets[b] = buckets.get(b, 0.0) + dur_s
+    lines = ["%-18s %12s" % ("bucket (spans)", "seconds"), "-" * 31]
+    for b, s in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        lines.append("%-18s %12.3f" % (b, s))
+    lines.append("%-18s %12.3f" % ("(excluded)", excluded))
+    lines.append("(containers/nested/overlapped spans are excluded; "
+                 "compute is the live ledger's step remainder, not a "
+                 "span — see goodput_report for the exhaustive view)")
+    meta_gp = (data.get("metadata") or {}).get("goodput") \
+        if isinstance(data, dict) else None
+    if meta_gp:
+        lines.append("exporter-stamped goodput summary: %s"
+                     % json.dumps(meta_gp))
+    return "\n".join(lines)
 
 
 if __name__ == "__main__":

@@ -85,6 +85,7 @@ from . import recordio_writer
 from . import fault
 from . import guardian
 from . import autotune
+from . import serving
 from .flags import set_flags, get_flags
 
 __version__ = "0.1.0"
@@ -104,7 +105,7 @@ __all__ = [
     "dataset", "batch", "compat", "utils", "transpiler", "DistributeTranspiler",
     "DistributeTranspilerConfig", "InferenceTranspiler",
     "memory_optimize", "release_memory", "cloud", "set_flags", "get_flags",
-    "fault", "guardian", "autotune",
+    "fault", "guardian", "autotune", "serving",
     "recordio", "recordio_writer", "inference", "debugger",
     "average", "lod_tensor", "net_drawer", "create_lod_tensor",
     "create_random_int_lodtensor",

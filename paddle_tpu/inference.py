@@ -84,11 +84,25 @@ class AnalysisConfig(NativeConfig):
     program and XLA performs the numeric fusions the reference's ir
     passes hand-roll, so AnalysisConfig is API parity with identical
     runtime behavior; ``enable_ir_optim`` is recorded but has nothing
-    left to do."""
+    left to do.  ``enable_serving`` routes ``Run`` through the
+    continuous-batching :class:`~.serving.InferenceEngine` instead of a
+    private dispatch — concurrent predictors/clones then share one
+    admission queue and fixed slot batches."""
 
     def __init__(self, *args, enable_ir_optim=True, **kwargs):
         super().__init__(*args, **kwargs)
         self.enable_ir_optim = enable_ir_optim
+        self.serving = None
+
+    def enable_serving(self, slots=8, timeout_s=30.0, bucket_bounds=None,
+                       tuned_config=None, quarantine_dir=None):
+        """Opt this config's predictors into engine-backed Run (keyword
+        args mirror :class:`~.serving.InferenceEngine`)."""
+        self.serving = {"slots": slots, "timeout_s": timeout_s,
+                        "bucket_bounds": bucket_bounds,
+                        "tuned_config": tuned_config,
+                        "quarantine_dir": quarantine_dir}
+        return self
 
 
 class PaddlePredictor:
@@ -100,9 +114,11 @@ class PaddlePredictor:
         # no state donation: clones run concurrently over shared weights
         self._exe = Executor(self._place, donate_state=False)
         if _shared is not None:
-            # Clone(): share program + weights, own executor cache
+            # Clone(): share program + weights (and the serving engine
+            # holder — all clones feed ONE admission queue), own
+            # executor cache
             self._program, self._feed_names, self._fetch_vars, \
-                self._scope = _shared
+                self._scope, self._engine_holder = _shared
         else:
             self._scope = Scope()
             from .scope import scope_guard
@@ -113,7 +129,31 @@ class PaddlePredictor:
                         config.model_dir, self._exe,
                         model_filename=config.prog_file,
                         params_filename=config.param_file)
+            # the holder carries its own lock: clones share the holder
+            # but not self._mu, and two first-calls racing from a base
+            # and its clone must not build two engines
+            self._engine_holder = [None, threading.Lock()]
         self._mu = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def serving_engine(self, **overrides):
+        """The continuous-batching engine over this predictor's loaded
+        program + shared weights — built lazily, shared by every clone
+        (the delegation target of ``enable_serving`` configs; also
+        usable directly for request-level ``submit``)."""
+        holder = self._engine_holder
+        with holder[1]:
+            if holder[0] is None:
+                from .serving import InferenceEngine
+
+                kw = dict(getattr(self._config, "serving", None) or {})
+                kw.update(overrides)
+                holder[0] = InferenceEngine(
+                    program=self._program,
+                    feed_names=self._feed_names,
+                    fetch_vars=self._fetch_vars, scope=self._scope,
+                    place=self._place, **kw)
+        return holder[0]
 
     # ------------------------------------------------------------------
     def run(self, inputs):
@@ -141,12 +181,58 @@ class PaddlePredictor:
         missing = [n for n in self._feed_names if n not in feed]
         if missing:
             raise ValueError("missing inputs: %s" % missing)
+        if getattr(self._config, "serving", None) is not None:
+            return self._run_serving(feed)
         # scope passed explicitly — scope_guard's global stack is not
         # thread-safe and clones run concurrently
         with self._mu:
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetch_vars,
                                  scope=self._scope)
+        return [PaddleTensor(name=v.name, data=o)
+                for v, o in zip(self._fetch_vars, outs)]
+
+    def _run_serving(self, feed):
+        """Engine-backed Run: the whole call becomes one micro-batch
+        request (fixed-shape models) or one request per example
+        (variable-length sequence models) through the shared
+        continuous-batching engine — same outputs as the direct
+        dispatch (deterministic inference program), but concurrent
+        callers' work co-batches instead of serializing on the
+        predictor lock."""
+        engine = self.serving_engine()
+        batch = max(int(np.shape(v)[0]) for n, v in feed.items()
+                    if not n.endswith("@LEN"))
+        # block until the engine decides: expiry is the engine's job
+        # (every queued request is either served or timed out by it)
+        if not engine._seq_feeds:
+            # one micro-batch request per slot-capacity chunk
+            step = engine.slots
+            reqs = []
+            for lo in range(0, batch, step):
+                chunk = {n: np.asarray(v)[lo:lo + step]
+                         for n, v in feed.items()}
+                rows = min(step, batch - lo)
+                if rows == 1:
+                    chunk = {n: v[0] for n, v in chunk.items()}
+                reqs.append(engine.submit(chunk, rows=rows))
+            parts = [r.result() for r in reqs]
+            outs = [np.concatenate(
+                [p[j] if r.rows > 1 else np.asarray(p[j])[None]
+                 for p, r in zip(parts, reqs)])
+                for j in range(len(self._fetch_vars))]
+            return [PaddleTensor(name=v.name, data=o)
+                    for v, o in zip(self._fetch_vars, outs)]
+        requests = []
+        for i in range(batch):
+            one = {}
+            for n, v in feed.items():
+                one[n] = np.asarray(v)[i] if not n.endswith("@LEN") \
+                    else int(np.asarray(v)[i])
+            requests.append(engine.submit(one))
+        rows = [r.result() for r in requests]
+        outs = [np.stack([row[j] for row in rows])
+                for j in range(len(self._fetch_vars))]
         return [PaddleTensor(name=v.name, data=o)
                 for v, o in zip(self._fetch_vars, outs)]
 
@@ -159,7 +245,7 @@ class PaddlePredictor:
         return PaddlePredictor(
             self._config,
             _shared=(self._program, self._feed_names, self._fetch_vars,
-                     self._scope))
+                     self._scope, self._engine_holder))
 
     Clone = clone
 

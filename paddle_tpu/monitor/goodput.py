@@ -103,6 +103,13 @@ EXCLUDED_SPANS = {
                        "(async writes are overlap, not stall)",
     "trainer/step": "container (step + bookkeeping)",
     "trainer/checkpoint": "container (snapshot span inside is counted)",
+    # serving-engine containers: each wraps one executor step, whose own
+    # compile/dispatch/fetch_sync spans carry the attribution — counting
+    # the container too would double-book every serving second
+    "serving/batch": "container (admission batch around an executor step)",
+    "serving/prefill": "container (prefill batch around an executor step)",
+    "serving/decode_step": "container (decode step around an executor "
+                           "step)",
 }
 
 

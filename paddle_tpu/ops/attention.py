@@ -11,7 +11,11 @@ so the flag changes schedule, not math.
 
 Masking is structural: an optional per-batch valid-key count ``KLen`` [B]
 (the ``<name>@LEN`` companion of the key sequence) and a ``causal`` attr —
-the two shapes every Transformer mask reduces to.  Eval-time dropout follows
+the two shapes every Transformer mask reduces to.  ``causal`` with
+``Tq == Tk`` is aligned self-attention (query i sees keys <= i); with
+``Tq < Tk`` the queries are the *suffix* of the valid keys — query i sits
+at global position ``klen - Tq + i`` — which is the single-token /
+chunked KV-cache decode shape the serving engine drives.  Eval-time dropout follows
 the reference's ``downgrade_in_infer``: weights scale by (1 - p), which
 commutes with the PV matmul into a single output scale.
 """
@@ -38,14 +42,13 @@ def _fused_attention_infer(op, block):
             "fused_attention V must be [B, H, Tk, D] matching K's length "
             "and Q's head dim: got Q %s, K %s, V %s"
             % (q.shape, k.shape, v.shape))
-    if op.attrs.get("causal", False) and q.shape[2] != k.shape[2]:
-        # the kernels' causal masks assume self-attention alignment; a
-        # decode-style suffix query (Tq != Tk) would silently get a
-        # top-aligned mask instead of the standard bottom-aligned one
+    if op.attrs.get("causal", False) and q.shape[2] > k.shape[2]:
+        # a suffix query cannot be longer than the key sequence it is a
+        # suffix of; Tq < Tk is the decode/chunked-decode shape (queries
+        # are the LAST Tq valid positions — bottom-aligned causal mask)
         raise ValueError(
-            "fused_attention: causal=True requires Tq == Tk (got %d vs "
-            "%d); slice the output of a full-length causal call instead"
-            % (q.shape[2], k.shape[2]))
+            "fused_attention: causal=True requires Tq <= Tk (got %d vs "
+            "%d)" % (q.shape[2], k.shape[2]))
     set_output(op, block, "Out", q.shape, q.dtype)
 
 

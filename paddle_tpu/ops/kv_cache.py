@@ -1,0 +1,75 @@
+"""KV-cache write op — the serving engine's donated in-place cache update.
+
+The reference framework has no KV-cache story (its inference stack
+re-runs the full decoder per step); this op is the TPU-native primitive
+the serving engine's decode loop is built on.  A cache is an ordinary
+persistable scope variable ``[S, H, Tmax, D]`` (S = decode slots): the
+executor classifies it as state, and because training-style state
+donation applies, the XLA-level update is **in place** — the decode step
+never copies the cache through HBM, it overwrites one ``[t, D]`` stripe
+per (slot, head).
+
+``kv_cache_write(Cache, X, Pos, Slot?) -> Out``:
+
+* ``Cache`` [S, H, Tmax, D] — the persistent cache (Out reuses the SAME
+  variable name, making the op a read-modify-write on executor state);
+* ``X``     [B, H, t, D]    — new keys/values for B requests;
+* ``Pos``   [B] int32       — per-request time offset (0 for prefill,
+  the current length for decode);
+* ``Slot``  [B] int32, optional — which cache slot each request owns.
+  Omitted = identity (B == S, row b writes slot b): the decode-loop
+  fast path, lowered as one vmapped dynamic_update_slice.  Present =
+  scattered prefill (an admitted batch lands in recycled slots).
+
+Writes clamp like ``lax.dynamic_update_slice`` (pos+t is bounded by the
+engine's bucket admission, so clamping never fires in practice).  No
+gradient: serving is forward-only, and a cache write has no meaningful
+cotangent (``grad=None`` keeps backward.py from ever differentiating
+through it).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..registry import register_op, set_output, in_var
+
+
+def _kv_cache_write_infer(op, block):
+    cache = in_var(op, block, "Cache")
+    x = in_var(op, block, "X")
+    if cache is None or x is None:
+        raise ValueError("kv_cache_write needs Cache and X inputs")
+    if len(cache.shape) != 4 or len(x.shape) != 4:
+        raise ValueError(
+            "kv_cache_write expects Cache [S, H, Tmax, D] and X "
+            "[B, H, t, D], got %s / %s" % (cache.shape, x.shape))
+    set_output(op, block, "Out", cache.shape, cache.dtype)
+
+
+def _kv_cache_write_compute(ins, attrs, ctx, op_index):
+    cache = ins["Cache"][0]
+    x = ins["X"][0].astype(cache.dtype)
+    pos = ins["Pos"][0].astype(jnp.int32).reshape(-1)
+    slot = ins.get("Slot", [None])[0]
+    if slot is None:
+        # decode fast path: row b writes slot b, one vmapped in-place
+        # stripe update across the whole slot batch
+        out = jax.vmap(
+            lambda c, xb, p: jax.lax.dynamic_update_slice(
+                c, xb, (0, p, 0)))(cache, x, pos)
+        return {"Out": out}
+    slot = slot.astype(jnp.int32).reshape(-1)
+    # scattered prefill: B is a trace-time constant (the admitted batch),
+    # one dynamic_update_slice per request row
+    out = cache
+    for b in range(x.shape[0]):
+        out = jax.lax.dynamic_update_slice(
+            out, x[b][None], (slot[b], 0, pos[b], 0))
+    return {"Out": out}
+
+
+register_op(
+    "kv_cache_write", ["Cache", "X", "Pos", "Slot"], ["Out"],
+    infer=_kv_cache_write_infer, compute=_kv_cache_write_compute,
+    grad=None, no_grad_inputs=("Pos", "Slot"),
+)

@@ -11,7 +11,12 @@ QK^T / PV products run back-to-back on the MXU without score materialization.
 
 Masking is structural rather than a dense additive bias: a per-batch key
 length (padding) and an optional causal flag — exactly the two mask shapes
-the Transformer model builds (padding_attn_bias + causal_mask).
+the Transformer model builds (padding_attn_bias + causal_mask).  Causal
+with Tq == Tk is top-aligned self-attention; with Tq < Tk the queries are
+the suffix of the klen valid keys (query i at global position
+klen - Tq + i) — the KV-cache decode shape, where a single-token or
+chunked query attends a longer cache without the full-length-call
+workaround.
 
 Dropout on the attention weights is computed *inside* the kernel from a
 counter-based hash of (head, query, key) positions, so the backward kernels
@@ -73,6 +78,21 @@ def _keep_mask(seed, bh, gq, gk, rate):
     return (h >> jnp.uint32(8)) >= thresh
 
 
+def _causal_valid(gq, gk, klen, tq, tk):
+    """Causal mask term for query/key position grids: top-aligned when
+    Tq == Tk (self-attention over equally padded sequences), suffix-
+    aligned otherwise — query i sits at global key position
+    ``klen - tq + i``, so decode queries see exactly the cache prefix.
+    ``klen`` is a scalar (kernel) or broadcastable array (fallback).
+    A batch row with klen < Tq has queries below the valid window;
+    their rows are FULLY masked and come back as zeros (the fully-
+    masked-row contract the kernels already honor for klen == 0), never
+    NaN — callers that care should keep Tq <= min(klen)."""
+    if tq == tk:
+        return gq >= gk
+    return gq + (klen - tq) >= gk
+
+
 def _dot(a, b, in_dtype):
     """MXU matmul with fp32 accumulation; operands in the input dtype so
     bf16 inputs (the AMP path) hit the bf16 MXU pipeline."""
@@ -83,7 +103,7 @@ def _dot(a, b, in_dtype):
 
 
 def _fwd_kernel(klen_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                scale, causal, rate, bq, bk, nk, in_dtype):
+                scale, causal, rate, bq, bk, nk, tq, tk, in_dtype):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # [bq, d]
@@ -99,7 +119,7 @@ def _fwd_kernel(klen_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         gk = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = gk < klen
         if causal:
-            valid = valid & (gq >= gk)
+            valid = valid & _causal_valid(gq, gk, klen, tq, tk)
         s = jnp.where(valid, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
@@ -131,7 +151,7 @@ def _fwd_kernel(klen_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 def _dq_kernel(klen_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                delta_ref, dq_ref, *, scale, causal, rate, bq, bk, nk,
-               in_dtype):
+               tq, tk, in_dtype):
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
@@ -149,7 +169,7 @@ def _dq_kernel(klen_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         gk = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         valid = gk < klen
         if causal:
-            valid = valid & (gq >= gk)
+            valid = valid & _causal_valid(gq, gk, klen, tq, tk)
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse)                           # masked rows: lse=+BIG
         g = _dot(do, vb, in_dtype)                     # dL/dy_jk pre-dropout
@@ -169,7 +189,7 @@ def _dq_kernel(klen_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
 def _dkv_kernel(klen_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                 delta_ref, dk_ref, dv_ref, *, scale, causal, rate, bq, bk,
-                nq, in_dtype):
+                nq, tq, tk, in_dtype):
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     kb = k_ref[0]                                      # [bk, d]
@@ -189,7 +209,7 @@ def _dkv_kernel(klen_ref, seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         gq = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         valid = gk < klen
         if causal:
-            valid = valid & (gq >= gk)
+            valid = valid & _causal_valid(gq, gk, klen, tq, tk)
         s = jnp.where(valid, s, _NEG_INF)
         p = jnp.exp(s - lse)
         if rate:
@@ -304,7 +324,7 @@ def _flash_fwd(q, k, v, k_len, seed, causal, rate, scale, interpret):
     bhn, nq, nk = b * h, tq_pad // bq, tk_pad // bk
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, rate=rate, bq=bq, bk=bk,
-        nk=nk, in_dtype=q.dtype)
+        nk=nk, tq=tq, tk=tk, in_dtype=q.dtype)
     o, lse = pl.pallas_call(
         kern,
         grid=(bhn, nq),
@@ -340,7 +360,7 @@ def _flash_bwd(causal, rate, scale, interpret, res, dout):
                     keepdims=True)                     # [bhn, tq_pad, 1]
 
     common = dict(scale=scale, causal=causal, rate=rate, bq=bq, bk=bk,
-                  in_dtype=q.dtype)
+                  tq=tq, tk=tk, in_dtype=q.dtype)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, nk=nk, **common),
         grid=(bhn, nq),
@@ -398,10 +418,13 @@ def reference_attention(q, k, v, k_len, seed, causal=False, dropout_rate=0.0,
     gq = jnp.arange(tq)[:, None]
     gk = jnp.arange(tk)[None, :]
     valid = jnp.ones((b, 1, tq, tk), bool)
+    klen = (jnp.full((b,), tk, jnp.int32) if k_len is None
+            else jnp.minimum(k_len.astype(jnp.int32).reshape(b), tk))
     if k_len is not None:
-        valid = gk[None, None] < k_len.astype(jnp.int32).reshape(b, 1, 1, 1)
+        valid = gk[None, None] < klen.reshape(b, 1, 1, 1)
     if causal:
-        valid = valid & (gq >= gk)[None, None]
+        valid = valid & _causal_valid(gq[None, None], gk[None, None],
+                                      klen.reshape(b, 1, 1, 1), tq, tk)
     s = jnp.where(valid, s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.where(valid, jnp.exp(s - m), 0.0)

@@ -1,0 +1,33 @@
+"""Serving subsystem: the framework's first non-training workload.
+
+ROADMAP item 1 ("millions of users, heavy traffic") realized over the
+existing substrate — the bucketed-length machinery as an admission
+policy, the TunedConfig artifact as the admitted-batch/bucket source,
+the goodput ledger for chip-utilization-per-request, and guardian-style
+request health (timeouts, poison quarantine).  See the package modules:
+
+* ``scheduler``  — continuous-batching queue/admission (pure, fake-
+  clock-testable control logic);
+* ``engine``     — :class:`InferenceEngine` (one-shot forward serving)
+  and :class:`GenerationEngine` (prefill + donated KV-cache decode);
+* ``decoder``    — score/prefill/decode program builder for decoder
+  LMs;
+* ``kv_cache``   — per-slot cache state over executor scope variables;
+* ``metrics``    — SLO observability (p50/p99, queue/occupancy gauges,
+  per-request JSONL events, serving goodput view).
+"""
+
+from .scheduler import (ContinuousBatchingScheduler, ServingRequest,
+                        BatchPlan, RequestTimeoutError,
+                        PoisonedRequestError, EngineClosedError)
+from .metrics import ServingMetrics
+from .kv_cache import KVCacheStore
+from .decoder import DecoderSpec, build_decoder_lm
+from .engine import InferenceEngine, GenerationEngine
+
+__all__ = [
+    "ContinuousBatchingScheduler", "ServingRequest", "BatchPlan",
+    "RequestTimeoutError", "PoisonedRequestError", "EngineClosedError",
+    "ServingMetrics", "KVCacheStore", "DecoderSpec", "build_decoder_lm",
+    "InferenceEngine", "GenerationEngine",
+]

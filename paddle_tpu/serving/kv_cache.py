@@ -1,0 +1,66 @@
+"""Decode KV-cache state: per-slot, per-layer K/V tensors living in the
+engine's scope as ordinary persistable variables.
+
+The executor already gives caches everything they need: a persistable
+var that an op reads and re-emits under the same name is read-modify-
+write state, donated on the decode executor (``donate_state=True``), so
+the per-step update compiled by ``kv_cache_write`` is a true in-place
+stripe write — the cache never round-trips HBM.  Slot recycling is free
+by construction: stale content past a slot's valid length is masked by
+the attention op's ``k_len``, and a re-prefill overwrites positions
+``0..len-1``, so freeing a slot is a host-side bookkeeping change, not a
+device memset."""
+
+import numpy as np
+
+__all__ = ["KVCacheStore"]
+
+
+class KVCacheStore:
+    """Names, declares, and initializes the cache variables shared by
+    the prefill and decode programs of one decoder."""
+
+    def __init__(self, n_layer, slots, n_head, max_len, head_dim,
+                 dtype="float32", prefix="declm"):
+        self.n_layer = int(n_layer)
+        self.slots = int(slots)
+        self.n_head = int(n_head)
+        self.max_len = int(max_len)
+        self.head_dim = int(head_dim)
+        self.dtype = dtype
+        self.prefix = prefix
+
+    @property
+    def shape(self):
+        return (self.slots, self.n_head, self.max_len, self.head_dim)
+
+    def name(self, kind, layer):
+        return "%s_cache_%s_%d" % (self.prefix, kind, layer)
+
+    def names(self):
+        return [self.name(kind, i) for i in range(self.n_layer)
+                for kind in ("k", "v")]
+
+    def declare(self, block, layer):
+        """Create (or fetch) this layer's cache vars in ``block`` —
+        persistable, so the executor treats them as scope state and
+        writes the op's same-name output back."""
+        out = []
+        for kind in ("k", "v"):
+            name = self.name(kind, layer)
+            v = block._find_var_recursive(name)
+            if v is None:
+                v = block.create_var(name=name, shape=self.shape,
+                                     dtype=self.dtype, persistable=True)
+            out.append(v)
+        return out
+
+    def init_scope(self, scope):
+        """Zero-fill every cache var (engine startup; content before a
+        slot's valid length is never read thanks to k_len masking)."""
+        for name in self.names():
+            scope.set_var(name, np.zeros(self.shape, self.dtype))
+
+    def bytes(self):
+        itemsize = np.dtype(self.dtype).itemsize
+        return 2 * self.n_layer * int(np.prod(self.shape)) * itemsize

@@ -1,0 +1,234 @@
+"""Serving SLO observability: request latency percentiles, queue/batch
+gauges, per-request JSONL events, and a goodput-ledger serving view.
+
+Rides the PR-2/PR-8 monitor stack rather than inventing a sink: every
+counter/gauge/histogram lands in ``monitor.registry()`` under
+``serving/*`` (Prometheus exposition + console reporter for free), every
+request emits a run_id-stamped ``serving_request`` JSONL event (the
+Dapper-style correlation the monitor already does for steps), and the
+serving view divides the goodput ledger's attributed compute seconds by
+completed requests — chip-utilization-per-request without new
+accounting.  Exact p50/p99 come from a bounded in-memory latency window
+(the artifact's SLO numbers must be exact, not bucket-interpolated); the
+registry histogram carries the same observations for scraping.
+
+Poison quarantine follows the guardian's batch-quarantine format
+(``batch_*.npz`` + json sidecar): a request whose forward produces NaN
+is rejected with :class:`~.scheduler.PoisonedRequestError` and its
+payload persisted for repro — the engine keeps serving."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+__all__ = ["ServingMetrics"]
+
+# latency-shaped buckets in seconds for the registry histogram: serving
+# requests span ~1ms (warm single dispatch) to tens of seconds (long
+# decode); the step-stats DEFAULT_BUCKETS top out too early for queues
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServingMetrics:
+    """One instance per engine; every entry point is cheap and never
+    raises into the serving path (telemetry contract shared with the
+    monitor)."""
+
+    WINDOW = 8192                  # exact-percentile latency window
+
+    def __init__(self, name="serving", quarantine_dir=None):
+        self.name = name
+        self.quarantine_dir = quarantine_dir
+        self._mu = threading.Lock()
+        self._lat = []             # latency seconds, bounded WINDOW
+        self._first_ts = None
+        self._last_ts = None
+        self._counts = {"submitted": 0, "completed": 0, "failed": 0,
+                        "expired": 0, "quarantined": 0, "batches": 0,
+                        "decode_steps": 0, "generated_tokens": 0}
+        # registry handles cached per generation (the monitor's own
+        # pattern): the submit/complete hot path must not pay a
+        # get-or-create registry lock per request
+        self._handles = {}
+        self._handle_gen = -1
+
+    # -- registry handles (gated on the monitor, like every producer) --
+    def _reg(self):
+        from .. import monitor
+
+        return monitor.registry() if monitor.enabled() else None
+
+    def _handle(self, reg, kind, metric, **kw):
+        if self._handle_gen != reg.generation:
+            self._handles.clear()
+            self._handle_gen = reg.generation
+        h = self._handles.get(metric)
+        if h is None:
+            h = self._handles[metric] = getattr(reg, kind)(
+                "%s/%s" % (self.name, metric), **kw)
+        return h
+
+    def _count(self, key, metric, amount=1):
+        with self._mu:
+            self._counts[key] = self._counts.get(key, 0) + amount
+        reg = self._reg()
+        if reg is not None:
+            self._handle(reg, "counter", metric).inc(amount)
+
+    def _gauge(self, metric, value):
+        reg = self._reg()
+        if reg is not None:
+            self._handle(reg, "gauge", metric).set(value)
+
+    def _event(self, record):
+        from .. import monitor
+
+        record.setdefault("ts", time.time())
+        monitor.log_event(record)
+
+    # -- request lifecycle ---------------------------------------------
+    def note_submit(self, req, queue_depth):
+        self._count("submitted", "requests_total")
+        self._gauge("queue_depth", queue_depth)
+        with self._mu:
+            if self._first_ts is None:
+                self._first_ts = time.time()
+
+    def note_admit(self, plan, occupancy, queue_depth):
+        self._count("batches", "batches_total")
+        self._gauge("batch_occupancy", occupancy)
+        self._gauge("queue_depth", queue_depth)
+
+    def note_decode_step(self, active, occupancy):
+        self._count("decode_steps", "decode_steps_total")
+        self._gauge("batch_occupancy", occupancy)
+
+    def note_complete(self, req, now=None, extra=None):
+        now = time.time() if now is None else now
+        queue_s = ((req.admitted_at - req.arrival)
+                   if req.admitted_at is not None else 0.0)
+        # latency on the engine's own clock base: arrival/finished are
+        # scheduler-clock stamps, so the difference is wall seconds
+        lat = ((req.finished_at - req.arrival)
+               if req.finished_at is not None and req.arrival else 0.0)
+        self._count("completed", "completed_total")
+        with self._mu:
+            self._lat.append(lat)
+            del self._lat[:-self.WINDOW]
+            self._last_ts = now
+        reg = self._reg()
+        if reg is not None:
+            self._handle(reg, "histogram", "request_latency_seconds",
+                         buckets=LATENCY_BUCKETS).observe(lat)
+        rec = {"event": "serving_request", "request_id": req.id,
+               "status": "ok", "latency_ms": round(lat * 1e3, 3),
+               "queue_ms": round(queue_s * 1e3, 3),
+               "bucket": req.bucket, "slot": req.slot,
+               "length": req.length}
+        if extra:
+            rec.update(extra)
+        self._event(rec)
+
+    def note_failure(self, req, error, status="failed"):
+        # quarantined requests are counted by quarantine() itself (the
+        # decision record); here only the terminal event is published
+        if status != "quarantined":
+            # count under the RESOLVED key so summary() and /metrics
+            # agree (an unknown status like "cancelled" is a failure on
+            # both surfaces, not a phantom metric family)
+            key = status if status in self._counts else "failed"
+            self._count(key, "timeout_total" if key == "expired"
+                        else "%s_total" % key)
+        self._event({"event": "serving_request", "request_id": req.id,
+                     "status": status, "error": str(error)[:200],
+                     "bucket": req.bucket, "length": req.length})
+
+    # -- poison quarantine (guardian-style request health) -------------
+    def quarantine(self, req, feed=None, reason="non-finite output"):
+        """Persist the poisoned request for repro and publish the
+        decision; returns the quarantine record."""
+        from .. import monitor
+
+        self._count("quarantined", "quarantined_total")
+        rec = {"event": "serving_quarantine", "request_id": req.id,
+               "reason": reason, "run_id": monitor.run_id(),
+               "ts": time.time(), "path": None}
+        if feed is not None:
+            names = sorted(feed)
+            rec["feed_signature"] = [
+                (n, list(np.shape(feed[n])), str(np.asarray(feed[n]).dtype))
+                for n in names]
+            if self.quarantine_dir:
+                try:
+                    os.makedirs(self.quarantine_dir, exist_ok=True)
+                    base = os.path.join(
+                        self.quarantine_dir, "request_%s_%s"
+                        % (monitor.run_id(), req.id))
+                    # positional npz members + a name list in the
+                    # sidecar (the guardian's batch-quarantine scheme:
+                    # npz member names can't carry '/' etc. across
+                    # numpy versions)
+                    with open(base + ".npz", "wb") as f:
+                        np.savez(f, **{"arr_%d" % i: np.asarray(feed[n])
+                                       for i, n in enumerate(names)})
+                    rec["feed_names"] = names
+                    rec["path"] = base + ".npz"
+                    with open(base + ".json", "w") as f:
+                        json.dump(rec, f)
+                except OSError as e:
+                    # telemetry never breaks the serving path: an
+                    # unwritable quarantine dir degrades to an event
+                    # without a dump, not an engine-batch failure
+                    rec["path"] = None
+                    rec["dump_error"] = str(e)[:200]
+        self._event(dict(rec))
+        return rec
+
+    # -- read side ------------------------------------------------------
+    def percentiles(self):
+        with self._mu:
+            vals = sorted(self._lat)
+        return {"p50_s": _percentile(vals, 0.50),
+                "p90_s": _percentile(vals, 0.90),
+                "p99_s": _percentile(vals, 0.99),
+                "mean_s": (sum(vals) / len(vals)) if vals else None,
+                "n": len(vals)}
+
+    def summary(self):
+        """Counts, exact latency percentiles, observed throughput, and
+        the serving goodput view (chip-utilization-per-request riding
+        the PR-8 ledger)."""
+        from .. import monitor
+
+        with self._mu:
+            counts = dict(self._counts)
+            first, last = self._first_ts, self._last_ts
+        pct = self.percentiles()
+        out = {"counts": counts}
+        for k in ("p50_s", "p90_s", "p99_s", "mean_s"):
+            out[k.replace("_s", "_ms")] = (round(pct[k] * 1e3, 3)
+                                           if pct[k] is not None else None)
+        span = (last - first) if first and last and last > first else None
+        out["throughput_rps"] = (round(counts["completed"] / span, 2)
+                                 if span and counts["completed"] else None)
+        gp = monitor.goodput_summary()
+        view = {"goodput_ratio": gp.get("goodput_ratio"),
+                "compute_seconds": gp["buckets"].get("compute")
+                if gp.get("buckets") else None}
+        if counts["completed"] and view["compute_seconds"] is not None:
+            view["compute_seconds_per_request"] = round(
+                view["compute_seconds"] / counts["completed"], 6)
+        out["goodput_view"] = view
+        return out

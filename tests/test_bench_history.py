@@ -187,3 +187,37 @@ def test_index_written_atomically(tmp_path):
         saved = json.load(f)
     assert saved["overall"] == "PASS"
     assert saved["runs"][0]["run"] == "r01"
+
+
+def test_serving_rung_slo_fields_indexed_but_non_gating(tmp_path):
+    """The serving rung's {throughput_rps, p99_ms} SLO pair is indexed
+    and judged, but the rung is informational — a serving regression
+    never flips the overall verdict (non-gating at first)."""
+    def serving(rps, p99):
+        return _rung("serving_requests_per_sec", rps,
+                     informational=True, throughput_rps=rps,
+                     p99_ms=p99, min_step_s=0.01, n_windows=1)
+
+    r1 = {"metric": "resnet", "value": 100.0, "unit": "img/s",
+          "vs_baseline": 1.0, "min_step_s": 0.5, "n_windows": 3,
+          "extra_metrics": [serving(3000.0, 25.0)]}
+    # next run: scored rung steady, serving MUCH worse
+    r2 = copy.deepcopy(r1)
+    r2["extra_metrics"] = [serving(1000.0, 400.0)]
+    paths = [_write(tmp_path, "a.json", _wrapper(1, r1)),
+             _write(tmp_path, "b.json", _wrapper(2, r2))]
+    report = bench_history.compare(
+        [bench_history.load_artifact(p, i)
+         for i, p in enumerate(paths)])
+    runs = {r["run"]: r for r in report["runs"]}
+    rec = [g for g in runs["r02"]["rungs"]
+           if g["metric"] == "serving_requests_per_sec"][0]
+    assert rec["throughput_rps"] == 1000.0 and rec["p99_ms"] == 400.0
+    judged = {c["field"]: c for c in runs["r02"]["comparisons"]
+              if c["metric"] == "serving_requests_per_sec"}
+    assert judged["throughput_rps"]["verdict"] == "REGRESSED"
+    assert judged["p99_ms"]["verdict"] == "REGRESSED"
+    assert judged["throughput_rps"]["informational"]
+    # ...but the run (and the report) still PASS
+    assert runs["r02"]["verdict"] == "PASS"
+    assert report["overall"] == "PASS"

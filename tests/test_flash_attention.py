@@ -274,3 +274,98 @@ def test_label_smooth_pallas_kernel_matches_xla():
     np.testing.assert_allclose(jax.grad(xla)(logits),
                                jax.grad(pallas)(logits),
                                rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# suffix-query (bottom-aligned) causal masks: the KV-cache decode shape
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("tq,klen", [(1, 16), (4, 9), (8, 16)])
+def test_suffix_causal_decode_parity(tq, klen):
+    """causal with Tq < Tk: queries are the LAST tq of the klen valid
+    keys — parity against the sliced rows of a full-length causal call
+    (the workaround this mask retires)."""
+    tk, b, h, d = 16, 2, 3, 8
+    q_full = _rand((b, h, tk, d), 0)
+    k = _rand((b, h, tk, d), 1)
+    v = _rand((b, h, tk, d), 2)
+    k_len = jnp.asarray([klen] * b, jnp.int32)
+    full = _oracle(q_full, k, v, k_len=k_len, causal=True)
+    lo = klen - tq
+    q_suf = q_full[:, :, lo:klen, :]
+    want = full[:, :, lo:klen, :]
+    got_fb = fa.reference_attention(q_suf, k, v, k_len, None, True, 0.0,
+                                    None)
+    np.testing.assert_allclose(got_fb, want, rtol=2e-5, atol=2e-5)
+    got_pl = fa.flash_attention(q_suf, k, v, k_len, None, True, 0.0, None,
+                                True)
+    np.testing.assert_allclose(got_pl, want, rtol=2e-5, atol=2e-5)
+
+
+def test_suffix_causal_per_batch_lengths():
+    """Single-token decode (Tq=1) with DIFFERENT valid lengths per batch
+    row: each query sits at its own batch's position klen-1."""
+    tk, b, h, d = 16, 3, 2, 8
+    q_full = _rand((b, h, tk, d), 0)
+    k = _rand((b, h, tk, d), 1)
+    v = _rand((b, h, tk, d), 2)
+    k_len = jnp.asarray([16, 9, 1], jnp.int32)
+    full = np.asarray(_oracle(q_full, k, v, k_len=k_len, causal=True))
+    q_suf = jnp.stack([q_full[i, :, int(k_len[i]) - 1: int(k_len[i]), :]
+                       for i in range(b)])
+    want = np.stack([full[i, :, int(k_len[i]) - 1: int(k_len[i]), :]
+                     for i in range(b)])
+    for fn in (fa.reference_attention,
+               lambda *a: fa.flash_attention(*a, True)):
+        got = fn(q_suf, k, v, k_len, None, True, 0.0, None)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_suffix_causal_grad_parity():
+    """Backward parity for the chunked-decode shape: grads of the suffix
+    call equal the corresponding grads of the sliced full-length
+    objective (rows outside the suffix contribute nothing).  Slow: two
+    interpret-mode backward kernel compiles; the fwd parity set above
+    stays tier-1."""
+    tk, tq, klen, b, h, d = 16, 4, 11, 2, 2, 8
+    q_full = _rand((b, h, tk, d), 0)
+    k = _rand((b, h, tk, d), 1)
+    v = _rand((b, h, tk, d), 2)
+    k_len = jnp.asarray([klen] * b, jnp.int32)
+    w = _rand((b, h, tq, d), 3)
+    lo = klen - tq
+
+    def f_full(qf, k, v):
+        out = _oracle(qf, k, v, k_len=k_len, causal=True)
+        return jnp.sum(w * out[:, :, lo:klen, :])
+
+    gq_full, gk_full, gv_full = jax.grad(f_full, (0, 1, 2))(q_full, k, v)
+    q_suf = q_full[:, :, lo:klen, :]
+    for fn in (lambda q, k, v: fa.flash_attention(q, k, v, k_len, None,
+                                                  True, 0.0, None, True),
+               lambda q, k, v: fa.reference_attention(q, k, v, k_len,
+                                                      None, True, 0.0)):
+        f = lambda q, k, v: jnp.sum(w * fn(q, k, v))  # noqa: E731
+        gq, gk, gv = jax.grad(f, (0, 1, 2))(q_suf, k, v)
+        np.testing.assert_allclose(gq, gq_full[:, :, lo:klen, :],
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(gk, gk_full, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(gv, gv_full, rtol=2e-4, atol=2e-4)
+
+
+def test_fused_attention_op_rejects_query_longer_than_keys():
+    """Tq > Tk under causal stays a build-time error (a suffix cannot be
+    longer than the sequence it suffixes); Tq < Tk now builds."""
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        q = fluid.layers.data("q", shape=[2, 8, 4])
+        k = fluid.layers.data("k", shape=[2, 4, 4])
+        v = fluid.layers.data("vv", shape=[2, 4, 4])
+        with pytest.raises(ValueError, match="Tq <= Tk"):
+            fluid.layers.fused_attention(q, k, v, causal=True)
+        # the decode shape builds: Tq=4 suffix against Tk=8 keys
+        q2 = fluid.layers.data("q2", shape=[2, 4, 4])
+        k2 = fluid.layers.data("k2", shape=[2, 8, 4])
+        v2 = fluid.layers.data("v2", shape=[2, 8, 4])
+        out = fluid.layers.fused_attention(q2, k2, v2, causal=True)
+        assert tuple(out.shape) == (-1, 2, 4, 4)

@@ -1,0 +1,439 @@
+"""Serving subsystem tests (ISSUE 11).
+
+Three layers, matching the subsystem's own split:
+
+* the continuous-batching scheduler as a PURE unit — deterministic
+  fake-clock admission tests (bucket selection, FIFO head priority,
+  slot recycling, timeout expiry, quarantine record format) that run
+  without any compiled program;
+* the one-shot :class:`InferenceEngine` end to end over a toy MLP
+  (tier-1): concurrent submits, output parity with direct execution,
+  poison-request quarantine that does NOT kill the engine, SLO metric
+  presence;
+* the KV-cache decode loop (slow-marked): greedy generation through the
+  :class:`GenerationEngine` reproduces the score program's full-forward
+  logits bit-nearly at every decoded position, and in-flight slot
+  recycling completes more requests than there are slots.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.serving import (BatchPlan, ContinuousBatchingScheduler,
+                                GenerationEngine, InferenceEngine,
+                                PoisonedRequestError, RequestTimeoutError,
+                                ServingMetrics, build_decoder_lm)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# scheduler: pure control logic under a fake clock
+# ---------------------------------------------------------------------------
+
+def test_bucket_selection_smallest_cover():
+    clk = FakeClock()
+    s = ContinuousBatchingScheduler(4, [8, 16, 32], clock=clk)
+    assert s.bucket_for(1) == 8
+    assert s.bucket_for(8) == 8
+    assert s.bucket_for(9) == 16
+    assert s.bucket_for(32) == 32
+    with pytest.raises(ValueError, match="exceeds the top bucket"):
+        s.submit({}, length=33)
+
+
+def test_admission_head_picks_bucket_and_fills_fifo():
+    clk = FakeClock()
+    s = ContinuousBatchingScheduler(4, [8, 16, 32], clock=clk)
+    a = s.submit("a", length=12)      # head: bucket 16
+    b = s.submit("b", length=20)      # too long for 16 — must wait
+    c = s.submit("c", length=3)       # fits 16 — joins a's batch
+    plan, expired = s.admit()
+    assert not expired
+    assert isinstance(plan, BatchPlan) and plan.bucket == 16
+    assert plan.requests == [a, c]
+    assert a.status == b.status != c.status or True  # a,c running; b queued
+    assert a.status == "running" and c.status == "running"
+    assert b.status == "queued" and s.queue_depth() == 1
+    # the waiting longer request is next in line once slots free
+    s.complete(a, None)
+    s.complete(c, None)
+    plan2, _ = s.admit()
+    assert plan2.bucket == 32 and plan2.requests == [b]
+
+
+def test_slot_recycling_refills_without_drain():
+    clk = FakeClock()
+    s = ContinuousBatchingScheduler(2, clock=clk)
+    r1, r2, r3 = (s.submit(i) for i in range(3))
+    plan, _ = s.admit()
+    assert plan.requests == [r1, r2] and set(plan.slots) == {0, 1}
+    # r2 finishes while r1 keeps running: its slot refills immediately
+    s.complete(r2, "done")
+    plan2, _ = s.admit()
+    assert plan2.requests == [r3]
+    assert plan2.slots == [r2.slot]          # the recycled slot
+    assert r1.status == "running"            # never drained
+    assert s.occupancy() == 1.0
+
+
+def test_timeout_expiry_queued_and_running():
+    clk = FakeClock()
+    s = ContinuousBatchingScheduler(1, clock=clk, default_timeout_s=5.0)
+    r1 = s.submit("a")
+    plan, _ = s.admit()
+    assert plan.requests == [r1]
+    r2 = s.submit("b")                       # queued behind the one slot
+    clk.tick(6.0)
+    # queued request expires on the next admission decision
+    plan2, expired = s.admit()
+    assert plan2 is None and expired == [r2]
+    assert r2.status == "expired"
+    with pytest.raises(RequestTimeoutError):
+        r2.result(0)
+    # the running request is reported for eviction, not silently dropped
+    assert s.expired_running() == [r1]
+    s.fail(r1, RequestTimeoutError("evicted"), status="expired")
+    assert s.busy_slots() == 0
+
+
+def test_fixed_slot_cap_and_max_batch():
+    clk = FakeClock()
+    s = ContinuousBatchingScheduler(3, clock=clk)
+    reqs = [s.submit(i) for i in range(5)]
+    plan, _ = s.admit(max_batch=2)
+    assert plan.requests == reqs[:2]
+    plan2, _ = s.admit()
+    assert plan2.requests == [reqs[2]]       # only one slot left
+    assert s.queue_depth() == 2
+
+
+def test_close_fails_pending():
+    clk = FakeClock()
+    s = ContinuousBatchingScheduler(1, clock=clk)
+    r1 = s.submit("a")
+    s.admit()
+    r2 = s.submit("b")
+    s.close()
+    for r in (r1, r2):
+        with pytest.raises(Exception, match="closed"):
+            r.result(0)
+    with pytest.raises(Exception, match="closed"):
+        s.submit("c")
+
+
+def test_quarantine_record_format(tmp_path):
+    """Guardian-style npz + json sidecar, feed signature included."""
+    clk = FakeClock()
+    s = ContinuousBatchingScheduler(1, clock=clk)
+    req = s.submit({"x": np.zeros((4,), "float32")}, length=0)
+    m = ServingMetrics(quarantine_dir=str(tmp_path))
+    rec = m.quarantine(req, feed=req.payload, reason="test poison")
+    assert rec["path"] and rec["path"].endswith(".npz")
+    data = np.load(rec["path"])
+    assert data["arr_0"].shape == (4,)
+    assert rec["feed_names"] == ["x"]
+    assert rec["feed_signature"] == [("x", [4], "float32")]
+    import json, os
+
+    side = json.load(open(rec["path"].replace(".npz", ".json")))
+    assert side["reason"] == "test poison"
+    assert os.path.exists(rec["path"])
+    assert m.summary()["counts"]["quarantined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# one-shot InferenceEngine end to end (tier-1)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def saved_mlp(tmp_path):
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.layers.data("x", shape=[6])
+    h = fluid.layers.fc(x, size=8, act="relu")
+    pred = fluid.layers.fc(h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"],
+                                      [pred], exe)
+    return str(tmp_path / "m")
+
+
+def test_engine_serves_toy_mlp_concurrently(saved_mlp):
+    eng = InferenceEngine(model_dir=saved_mlp, slots=4, timeout_s=60.0)
+    try:
+        rng = np.random.RandomState(0)
+        xs = [rng.rand(6).astype("float32") for _ in range(10)]
+        results = {}
+
+        def client(i):
+            results[i] = eng.run({"x": xs[i]}, timeout=120)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(xs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # parity: the engine's batched answers == direct execution
+        direct = fluid.Executor(fluid.CPUPlace())
+        (want,) = direct.run(eng._program,
+                             feed={"x": np.stack(xs)},
+                             fetch_list=eng._fetch_vars,
+                             scope=eng._scope)
+        for i in range(len(xs)):
+            np.testing.assert_allclose(results[i][0], want[i],
+                                       rtol=1e-6, atol=1e-6)
+        summ = eng.metrics.summary()
+        assert summ["counts"]["completed"] == len(xs)
+        assert summ["counts"]["batches"] >= 1
+        assert summ["p50_ms"] is not None and summ["p99_ms"] is not None
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_engine_quarantines_poison_requests_and_survives(saved_mlp,
+                                                         tmp_path):
+    """A NaN-producing request is rejected + quarantined like a poisoned
+    batch; the engine keeps serving (guardian-style request health)."""
+    # sqrt of a negative input poisons exactly the rows that feed it
+    fluid.default_startup_program().random_seed = 3
+    x = fluid.layers.data("x", shape=[4])
+    out = fluid.layers.sqrt(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        fluid.io.save_inference_model(str(tmp_path / "p"), ["x"],
+                                      [out], exe)
+    qdir = tmp_path / "quarantine"
+    eng = InferenceEngine(model_dir=str(tmp_path / "p"), slots=4,
+                          timeout_s=60.0, quarantine_dir=str(qdir))
+    try:
+        good = eng.submit({"x": np.ones(4, "float32")})
+        bad = eng.submit({"x": -np.ones(4, "float32")})
+        np.testing.assert_allclose(good.result(120)[0], np.ones(4),
+                                   rtol=1e-6)
+        with pytest.raises(PoisonedRequestError):
+            bad.result(120)
+        assert bad.status == "quarantined"
+        assert list(qdir.glob("request_*.npz"))
+        # the engine is still alive and serving
+        again = eng.run({"x": 4.0 * np.ones(4, "float32")}, timeout=120)
+        np.testing.assert_allclose(again[0], 2.0 * np.ones(4), rtol=1e-6)
+        assert eng.metrics.summary()["counts"]["quarantined"] == 1
+    finally:
+        eng.close()
+
+
+def test_engine_times_out_stale_queued_requests(saved_mlp):
+    """A request submitted before the loop starts and already past its
+    budget expires instead of running."""
+    eng = InferenceEngine(model_dir=saved_mlp, slots=2, timeout_s=60.0,
+                          start=False)
+    req = eng.submit({"x": np.zeros(6, "float32")}, timeout_s=0.001)
+    import time
+
+    time.sleep(0.05)
+    eng.start()
+    with pytest.raises(RequestTimeoutError):
+        req.result(30)
+    assert req.status == "expired"
+    eng.close()
+
+
+@pytest.mark.slow
+def test_engine_bucketed_sequence_padding(tmp_path):
+    """Variable-length sequence requests co-batch at bucket bounds; the
+    @LEN companion carries each request's true length."""
+    fluid.default_startup_program().random_seed = 5
+    ids = fluid.layers.data("ids", shape=[1], dtype="int64", lod_level=1)
+    emb = fluid.layers.embedding(ids, size=[20, 4])
+    pooled = fluid.layers.sequence_pool(emb, "sum")
+    out = fluid.layers.fc(pooled, size=2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        # emb is a PER-TOKEN fetch: its padded time dim must come back
+        # trimmed to each request's true length
+        fluid.io.save_inference_model(str(tmp_path / "s"),
+                                      ["ids", "ids@LEN"], [out, emb],
+                                      exe)
+    eng = InferenceEngine(model_dir=str(tmp_path / "s"), slots=4,
+                          bucket_bounds=[4, 8], timeout_s=60.0)
+    try:
+        rng = np.random.RandomState(1)
+        lens = [2, 4, 3, 7]
+        reqs = [eng.submit(
+            {"ids": rng.randint(0, 20, (n, 1)).astype("int64")})
+            for n in lens]
+        rows = [r.result(120) for r in reqs]
+        # parity against direct padded execution, one request at a time
+        direct = fluid.Executor(fluid.CPUPlace())
+        for req, row, n in zip(reqs, rows, lens):
+            padded = np.zeros((1, 8, 1), "int64")
+            padded[0, :n] = req.payload["ids"]
+            want = direct.run(
+                eng._program,
+                feed={"ids": padded,
+                      "ids@LEN": np.asarray([n], "int32")},
+                fetch_list=eng._fetch_vars, scope=eng._scope)
+            np.testing.assert_allclose(row[0], want[0][0], rtol=1e-5,
+                                       atol=1e-6)
+            # the per-token fetch comes back TRIMMED to the request's
+            # true length, not bucket-padded
+            assert row[1].shape == (n, 4), row[1].shape
+            np.testing.assert_allclose(row[1], want[1][0][:n],
+                                       rtol=1e-5, atol=1e-6)
+        # @LEN-companion models reject multi-row requests
+        with pytest.raises(ValueError, match="fixed-shape only"):
+            eng.submit({"ids": np.zeros((2, 3, 1), "int64")}, rows=2)
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_engine_micro_batch_requests_co_batch(saved_mlp):
+    """rows>1 requests (the predictor's Run unit) co-batch with single
+    examples; outputs keep each request's own shape."""
+    eng = InferenceEngine(model_dir=saved_mlp, slots=8, timeout_s=60.0)
+    try:
+        rng = np.random.RandomState(3)
+        xb = rng.rand(4, 6).astype("float32")
+        x1 = rng.rand(6).astype("float32")
+        rb = eng.submit({"x": xb}, rows=4)
+        r1 = eng.submit({"x": x1})
+        outb, out1 = rb.result(120), r1.result(120)
+        assert outb[0].shape == (4, 3) and out1[0].shape == (3,)
+        direct = fluid.Executor(fluid.CPUPlace())
+        (want,) = direct.run(eng._program,
+                             feed={"x": np.concatenate([xb, x1[None]])},
+                             fetch_list=eng._fetch_vars,
+                             scope=eng._scope)
+        np.testing.assert_allclose(outb[0], want[:4], rtol=1e-6,
+                                   atol=1e-6)
+        np.testing.assert_allclose(out1[0], want[4], rtol=1e-6,
+                                   atol=1e-6)
+        with pytest.raises(ValueError, match="exceed the 8-slot"):
+            eng.submit({"x": rng.rand(9, 6).astype("float32")}, rows=9)
+    finally:
+        eng.close()
+
+
+def test_scheduler_multi_row_admission():
+    clk = FakeClock()
+    s = ContinuousBatchingScheduler(8, clock=clk)
+    a = s.submit("a", rows=5)
+    b = s.submit("b", rows=4)        # 5+4 > 8: waits
+    c = s.submit("c", rows=3)        # fills around b
+    plan, _ = s.admit()
+    assert plan.requests == [a, c] and len(plan.slots) == 8
+    assert s.occupancy() == 1.0
+    s.complete(a, None)
+    plan2, _ = s.admit()
+    assert plan2.requests == [b]
+    assert set(plan2.slots) <= set(range(8))
+
+
+# ---------------------------------------------------------------------------
+# decoder programs + KV-cache decode (slow: compiles three programs)
+# ---------------------------------------------------------------------------
+
+def test_decoder_programs_share_parameter_names():
+    """Prefill and decode read the SAME weights the score program
+    initializes — cross-program weight sharing is by explicit name."""
+    spec = build_decoder_lm(vocab_size=11, max_len=16, slots=2,
+                            n_layer=1, n_head=2, d_model=8, d_inner=16)
+
+    def params(prog):
+        from paddle_tpu.framework import Parameter
+
+        return {v.name for v in prog.list_vars()
+                if isinstance(v, Parameter)}
+
+    score, prefill, decode = (params(spec.score_program),
+                              params(spec.prefill_program),
+                              params(spec.decode_program))
+    assert score == prefill == decode
+    assert "declm_tok_emb" in score
+    # cache vars are persistable NON-parameters of prefill/decode only
+    cache_names = set(spec.cache.names())
+    pf_vars = {v.name for v in spec.prefill_program.list_vars()}
+    dc_vars = {v.name for v in spec.decode_program.list_vars()}
+    assert cache_names <= pf_vars and cache_names <= dc_vars
+
+
+@pytest.mark.slow
+def test_kv_cache_decode_matches_full_forward_recompute():
+    """The acceptance contract: greedy decode through the donated
+    KV-cache loop reproduces the score program's logits at every
+    generated position (same weights, full-forward recompute)."""
+    spec = build_decoder_lm(vocab_size=23, max_len=32, slots=4,
+                            n_layer=2, n_head=2, d_model=16, d_inner=32)
+    eng = GenerationEngine(spec, place=fluid.CPUPlace(),
+                           max_new_tokens=5, record_logits=True,
+                           timeout_s=300.0)
+    try:
+        prompts = [[3, 5, 7], [2, 9, 4, 6, 8], [1, 2],
+                   [11, 12, 13, 14]]
+        results = [eng.submit(p).result(600) for p in prompts]
+        exe = fluid.Executor(fluid.CPUPlace())
+        for p, res in zip(prompts, results):
+            assert len(res["tokens"]) == 5
+            seq = p + res["tokens"]
+            t = len(seq)
+            (full,) = exe.run(
+                spec.score_program,
+                feed={"tok": np.asarray(seq, "int64").reshape(1, t, 1),
+                      "tok@LEN": np.asarray([t], "int32"),
+                      "pos": np.arange(t, dtype="int64").reshape(1, t, 1)},
+                fetch_list=[spec.score_logits], scope=eng._scope)
+            full = np.asarray(full)[0]
+            for k, step_logits in enumerate(res["logits"]):
+                np.testing.assert_allclose(
+                    step_logits, full[len(p) - 1 + k], rtol=2e-4,
+                    atol=2e-4)
+    finally:
+        eng.close()
+
+
+@pytest.mark.slow
+def test_generation_engine_recycles_slots_in_flight():
+    """More requests than slots all complete — freed slots refill
+    between decode steps without draining the batch — and the decode
+    loop compiles ONCE (one signature) regardless of traffic."""
+    spec = build_decoder_lm(vocab_size=13, max_len=16, slots=2,
+                            n_layer=1, n_head=2, d_model=8, d_inner=16,
+                            prefix="declm2")
+    eng = GenerationEngine(spec, place=fluid.CPUPlace(),
+                           max_new_tokens=3, timeout_s=300.0,
+                           bucket_bounds=[4])
+    try:
+        reqs = [eng.submit([1 + i, 2 + i]) for i in range(5)]
+        outs = [r.result(600) for r in reqs]
+        assert all(len(o["tokens"]) == 3 for o in outs)
+        counts = eng.metrics.summary()["counts"]
+        assert counts["completed"] == 5
+        assert counts["decode_steps"] >= 2
+        # one compiled decode signature total: the decode executor saw
+        # exactly one (program, feed-signature) pair
+        sigs = {k[3] for k in eng._exe_decode._cache}
+        assert len(eng._exe_decode._cache) == 1, sigs
+    finally:
+        eng.close()

@@ -44,11 +44,15 @@ import json
 import os
 import sys
 
-# (field, better, pretty) — the comparison schema per rung
+# (field, better, pretty) — the comparison schema per rung.
+# throughput_rps / p99_ms are the serving rung's SLO pair (schema v2+);
+# that rung is informational, so they index and judge without gating.
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
           ("mfu", "higher", "mfu"),
-          ("goodput", "higher", "goodput"))
+          ("goodput", "higher", "goodput"),
+          ("throughput_rps", "higher", "rps"),
+          ("p99_ms", "lower", "p99"))
 
 
 def _rung_record(r):
@@ -67,6 +71,9 @@ def _rung_record(r):
     mfu = r.get("mfu", r.get("exact_mfu", r.get("est_mfu")))
     if mfu is not None:
         out["mfu"] = mfu
+    for f in ("throughput_rps", "p99_ms"):
+        if r.get(f) is not None:
+            out[f] = r[f]
     gp = r.get("goodput")
     if isinstance(gp, dict) and gp.get("goodput_ratio") is not None:
         out["goodput"] = gp["goodput_ratio"]

@@ -26,6 +26,7 @@ GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 MODULES = [
     "paddle_tpu",
     "paddle_tpu.autotune",
+    "paddle_tpu.serving",
     "paddle_tpu.fault",
     "paddle_tpu.guardian",
     "paddle_tpu.layers",

@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/10 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/11 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/10 API signature gate =="
+echo "== 2/11 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/10 8-device virtual-mesh dryrun =="
+echo "== 3/11 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/10 bench smoke (CPU backend, tiny) =="
+echo "== 4/11 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/10 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/11 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/10 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/11 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/10 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/11 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -170,7 +170,7 @@ PY
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
-echo "== 8/10 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+echo "== 8/11 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
 GUARD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
 # the drill is installed purely from the environment (FLAGS_fault_spec)
@@ -227,7 +227,7 @@ PY
 grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
 grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
 
-echo "== 9/10 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
+echo "== 9/11 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
 TUNE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
@@ -323,7 +323,7 @@ print("AUTOTUNE TRAINER FINAL %.6f over %d steps"
       % (losses[-1], len(losses)), flush=True)
 PY
 
-echo "== 10/10 goodput smoke + bench-history regression gate =="
+echo "== 10/11 goodput smoke + bench-history regression gate =="
 GOOD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR"' EXIT
 # (a) a 3-step monitored MLP run -> the goodput ledger attributes its
@@ -382,5 +382,60 @@ assert any(c["field"] == "min_step_s" and c["verdict"] == "REGRESSED"
            for c in bad["comparisons"]), bad
 print("bench_history: +20% perturbation flagged REGRESSED")
 PY
+
+echo "== 11/11 serving smoke (engine over toy MLP, concurrent requests) =="
+SERVE_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR"' EXIT
+JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'PY'
+import os, sys, threading
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.serving import InferenceEngine
+
+out = sys.argv[1]
+monitor.enable(log_dir=os.path.join(out, "monitor"))
+fluid.default_startup_program().random_seed = 7
+x = fluid.layers.data("x", shape=[32])
+h = fluid.layers.fc(x, size=32, act="relu")
+pred = fluid.layers.fc(h, size=4, act="softmax")
+exe = fluid.Executor(fluid.CPUPlace())
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe.run(fluid.default_startup_program())
+    fluid.io.save_inference_model(os.path.join(out, "model"), ["x"],
+                                  [pred], exe)
+eng = InferenceEngine(model_dir=os.path.join(out, "model"), slots=8,
+                      timeout_s=60.0)
+xs = [np.random.RandomState(i).rand(32).astype("float32")
+      for i in range(24)]
+results = {}
+def client(i):
+    results[i] = eng.run({"x": xs[i]}, timeout=120)
+threads = [threading.Thread(target=client, args=(i,))
+           for i in range(len(xs))]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert len(results) == len(xs)
+assert all(np.isfinite(v[0]).all() for v in results.values())
+s = eng.metrics.summary()
+assert s["counts"]["completed"] == len(xs), s
+# generous p99 bound: the smoke asserts the SLO pipeline, not the chip
+assert s["p99_ms"] is not None and s["p99_ms"] < 10000, s
+assert s["goodput_view"]["goodput_ratio"] is not None, s
+print("SERVING p50 %.2fms p99 %.2fms over %d requests (%d batches)"
+      % (s["p50_ms"], s["p99_ms"], s["counts"]["completed"],
+         s["counts"]["batches"]), flush=True)
+text = monitor.expose_text()
+assert "serving_request_latency_seconds" in text, "missing histogram"
+assert "serving_queue_depth" in text, "missing gauge"
+eng.close()
+monitor.disable()
+PY
+# per-request serving/* events landed in the JSONL, run_id-correlated
+grep -ql serving_request "$SERVE_DIR"/monitor/*.jsonl
 
 echo "CI OK"

@@ -10,7 +10,7 @@ each signature already pays).  This module closes the loop: an
 auto-tuner that searches the config space using that machinery instead
 of blind timing sweeps.
 
-Four knobs, four decision procedures (each a PURE function of
+Five knobs, five decision procedures (each a PURE function of
 measurements, so the policy is unit-testable without a device):
 
 * **batch size** (:func:`run_batch_ladder` / :func:`tune_batch_size`) —
@@ -39,6 +39,17 @@ measurements, so the policy is unit-testable without a device):
   to hardware-friendly multiples FIRST (the PERF.md r4 finding: six
   finer-but-ragged bounds measured WORSE than four MXU-friendly ones
   despite higher fill — raggedness loses more on the MXU than padding).
+* **pipeline schedule + microbatch count** (:func:`decide_pipeline` /
+  :func:`tune_pipeline`) — for programs whose ``pipeline_region`` ops
+  run pipelined on a ``pp`` mesh: measure a short step window per
+  (schedule, microbatches) candidate, reject candidates whose compiled
+  peak-HBM estimate exceeds the ceiling (1F1B's M-independent
+  activation memory is exactly what unlocks the larger-M rungs GPipe
+  cannot afford), pick the fastest, and tie-break near-equal timings by
+  the schedule table's exact bubble fraction then memory bound
+  (``parallel.pipeline.schedule_stats``).  An explicit
+  ``BuildStrategy.pipeline_schedule`` is a user pin the tuner records
+  and respects.
 * **checkpoint interval** (:func:`decide_checkpoint_interval`) —
   CheckFreq-style: the smallest interval whose measured on-step cost
   (snapshot, plus the full write in sync mode) stays under the overhead
@@ -85,6 +96,7 @@ __all__ = [
     "choose_bucket_bounds", "decide_checkpoint_interval",
     "tune_batch_size", "tune_attention_kernel",
     "tune_checkpoint_interval", "measure_step_window",
+    "decide_pipeline", "tune_pipeline",
 ]
 
 _mu = threading.Lock()
@@ -956,4 +968,222 @@ def tune_checkpoint_interval(step_s=None, snapshot_s=None, save_s=None,
         _event({"event": "autotune_decision",
                 "knob": "checkpoint_interval",
                 "chosen": decision["chosen"]})
+    return decision
+
+
+# ---------------------------------------------------------------------------
+# pipeline schedule + microbatch tuning
+# ---------------------------------------------------------------------------
+
+def decide_pipeline(candidates, tol=0.03):
+    """Pure pipeline-schedule policy over measured candidates.
+
+    ``candidates``: dicts with ``schedule``, ``microbatches``,
+    ``step_s`` (None/absent = not measured), ``bubble_fraction``,
+    ``in_flight``, and optionally ``rejected`` (HBM gate).  Picks the
+    fastest measured candidate; everything within ``tol`` of it
+    tie-breaks by (bubble fraction, in-flight memory bound, smaller M)
+    — schedule accounting settles what timing noise cannot."""
+    ok = [c for c in candidates
+          if not c.get("rejected") and c.get("step_s")]
+    if not ok:
+        raise ValueError(
+            "decide_pipeline: no measured candidate survived "
+            "(all rejected by the HBM gate or unmeasured)")
+    best = min(ok, key=lambda c: c["step_s"])
+    near = [c for c in ok if c["step_s"] <= best["step_s"] * (1 + tol)]
+    near.sort(key=lambda c: (c.get("bubble_fraction", 1.0),
+                             c.get("in_flight", 1 << 30),
+                             c["microbatches"]))
+    chosen = near[0]
+    return {"knob": "pipeline",
+            "chosen": {"schedule": chosen["schedule"],
+                       "microbatches": int(chosen["microbatches"])},
+            "candidates": [dict(c) for c in candidates],
+            "evidence": "measured_step_window"}
+
+
+def tune_pipeline(main_program, startup_program, feed, fetch, mesh,
+                  build_strategy=None, schedules=None,
+                  microbatch_candidates=None, probe_steps=3,
+                  warmup_steps=1, tol=0.03, headroom=0.9, config=None):
+    """Choose the pipeline schedule and microbatch count for a program
+    with ``pipeline_region`` ops on ``mesh``'s ``pp`` axis, the same
+    way the batch ladder works: one compile per candidate, a short
+    measured step window through the ParallelExecutor, the compiled
+    peak-HBM estimate as a pre-dispatch rejection gate
+    (:func:`hbm_ceiling` — CPU-testable with a fake limit), and the
+    schedule table's exact bubble accounting as evidence and
+    tie-breaker.  Decisions land in ``config`` (TunedConfig) with the
+    full candidate table.
+
+    Pin semantics: an explicit ``build_strategy.pipeline_schedule`` is
+    the user's choice — recorded as a pinned decision, never measured
+    over."""
+    from . import compile_cache
+    from . import scope as _scope
+    from .framework import Variable
+    from .monitor import program_profile
+    from .parallel.mesh import AXIS_PP
+    from .parallel.parallel_executor import ParallelExecutor
+    from .parallel.pipeline import SCHEDULES, schedule_stats
+    from .parallel.strategy import BuildStrategy
+
+    bs = build_strategy or BuildStrategy()
+    fp = compile_cache.program_fingerprint(main_program)
+    pp = 1
+    if AXIS_PP in mesh.axis_names:
+        pp = mesh.devices.shape[mesh.axis_names.index(AXIS_PP)]
+    region_stages = [int(op.attrs["stages"])
+                     for op in main_program.global_block().ops
+                     if op.type == "pipeline_region"]
+    if pp <= 1 or not region_stages:
+        raise ValueError(
+            "tune_pipeline: program has no pipeline_region ops that "
+            "would run pipelined on this mesh (pp=%d, regions=%d)"
+            % (pp, len(region_stages)))
+
+    if bs.pipeline_schedule is not None:
+        decision = {"knob": "pipeline",
+                    "chosen": {"schedule": bs.pipeline_schedule,
+                               "microbatches":
+                               bs.pipeline_microbatches},
+                    "evidence": "pinned",
+                    "candidates": []}
+        if config is not None:
+            config.add(decision, fingerprint=fp[:12], source="pinned")
+        return decision
+
+    batch = max((int(np.shape(v)[0]) for v in feed.values()
+                 if np.ndim(v) >= 1), default=0)
+
+    def _engages(sched):
+        # mirrors the lowering's engagement test (pipeline_region's
+        # pp_ok): a candidate that would silently run the SEQUENTIAL
+        # fallback must never be measured as if it pipelined (its
+        # bubble stats would be fabricated and could win the
+        # tie-break).  Interleaved engages at any v >= 1 there.
+        if sched == "interleaved":
+            return all(sc % pp == 0 for sc in region_stages)
+        return all(sc == pp for sc in region_stages)
+
+    if schedules is None:
+        schedules = [sc for sc in ("gpipe", "1f1b") if _engages(sc)]
+        # the default list adds interleaved only when it brings v > 1
+        # chunks per device — v == 1 is gpipe with a wrap edge, a
+        # wasted compile to measure by default (an explicit
+        # schedules=['interleaved'] still may)
+        if all(sc % pp == 0 and sc // pp > 1 for sc in region_stages):
+            schedules.append("interleaved")
+        elif not schedules and _engages("interleaved"):
+            # mixed region stage counts (some v == 1): interleaved is
+            # the only schedule that pipelines them all — measure it
+            # even though part of it degenerates to a wrapped gpipe
+            schedules.append("interleaved")
+        if not schedules:
+            raise ValueError(
+                "tune_pipeline: no schedule runs the program's "
+                "pipeline regions (stages=%s) pipelined on this mesh "
+                "(pp=%d)" % (region_stages, pp))
+    for s in schedules:
+        if s not in SCHEDULES:
+            raise ValueError("unknown schedule %r" % s)
+    if microbatch_candidates is None:
+        microbatch_candidates = [m for m in (pp, 2 * pp, 4 * pp)
+                                 if batch and batch % m == 0]
+    if not microbatch_candidates:
+        raise ValueError(
+            "tune_pipeline: no microbatch candidate divides the batch "
+            "(%d) — pass microbatch_candidates" % batch)
+
+    limit = hbm_ceiling(mesh.devices.flat[0])
+    fetch_list = [fetch]
+    fetch_name = fetch.name if isinstance(fetch, Variable) else str(fetch)
+    candidates = []
+    with program_profile.probe_accounting():
+        for sched in schedules:
+            for m in microbatch_candidates:
+                # every non-viable combination is RECORDED, never
+                # silently skipped: the artifact's candidate table must
+                # cover the searched space
+                if sched == "interleaved" and m % pp:
+                    candidates.append(
+                        {"schedule": sched, "microbatches": int(m),
+                         "rejected": "microbatches %% pp != 0 "
+                                     "(interleaved groups of %d)" % pp})
+                    continue
+                if not _engages(sched):
+                    candidates.append(
+                        {"schedule": sched, "microbatches": int(m),
+                         "rejected": "not pipelined on this mesh "
+                                     "(stages=%s, pp=%d)"
+                                     % (region_stages, pp)})
+                    continue
+                stats = [schedule_stats(
+                    sched, pp, m, s // pp if sched == "interleaved"
+                    else 1) for s in region_stages]
+                cand = {"schedule": sched, "microbatches": int(m),
+                        "bubble_fraction": round(
+                            sum(st["idle_units"] for st in stats)
+                            / max(1, sum(st["total_units"]
+                                         for st in stats)), 4),
+                        "in_flight": max(st["in_flight"]
+                                         for st in stats)}
+                cbs = BuildStrategy()
+                for attr, val in vars(bs).items():
+                    setattr(cbs, attr, val)
+                cbs.pipeline_schedule = sched
+                cbs.pipeline_microbatches = int(m)
+                scope = _scope.Scope()
+                try:
+                    with _scope.scope_guard(scope):
+                        from .executor import CPUPlace, Executor
+                        Executor(CPUPlace()).run(startup_program,
+                                                 scope=scope)
+                        pe = ParallelExecutor(
+                            loss_name=fetch_name, mesh=mesh,
+                            build_strategy=cbs,
+                            main_program=main_program, scope=scope)
+                        # the profile registry keys by (fingerprint,
+                        # feed sig, partition) — NOT by schedule — so a
+                        # warm trace cache (a second tune call) serves
+                        # a stale peak from some other candidate.  Only
+                        # a capture that happened DURING this
+                        # candidate's cold dispatch is evidence.
+                        prof_before = program_profile.get(fp)
+                        for _ in range(max(1, warmup_steps)):
+                            pe.run(feed=feed, fetch_list=fetch_list)
+                        prof = program_profile.get(fp)
+                        peak = prof.peak_hbm_bytes \
+                            if prof is not None \
+                            and prof is not prof_before else None
+                        cand["peak_hbm_bytes"] = peak
+                        if limit and peak and peak > headroom * limit:
+                            cand["rejected"] = "peak_hbm %d > %.0f" % (
+                                peak, headroom * limit)
+                        else:
+                            t0 = time.perf_counter()
+                            for _ in range(probe_steps):
+                                out = pe.run(feed=feed,
+                                             fetch_list=fetch_list)
+                            np.asarray(out[0])
+                            cand["step_s"] = round(
+                                (time.perf_counter() - t0)
+                                / probe_steps, 6)
+                except Exception as e:  # noqa: BLE001 — a failed
+                    # candidate is evidence, not a tuner crash
+                    cand["rejected"] = "error: %s" % str(e)[:160]
+                _event({"event": "autotune_probe", "knob": "pipeline",
+                        "schedule": sched, "microbatches": int(m),
+                        "step_s": cand.get("step_s"),
+                        "rejected": cand.get("rejected"),
+                        "fingerprint": fp[:12]})
+                candidates.append(cand)
+    decision = decide_pipeline(candidates, tol=tol)
+    decision["mesh_pp"] = int(pp)
+    if config is not None:
+        config.add(decision, fingerprint=fp[:12])
+    else:
+        _event({"event": "autotune_decision", "knob": "pipeline",
+                "chosen": decision["chosen"], "fingerprint": fp[:12]})
     return decision

@@ -162,7 +162,8 @@ def _batch_examples(block, feed_names, feed_vals):
 
 
 def trace_program(program, feed_names, state_names, writeback, fetch_names,
-                  platform=None, mesh=None, sequence_parallel=True):
+                  platform=None, mesh=None, sequence_parallel=True,
+                  pipeline_schedule=None, pipeline_microbatches=None):
     """Build the pure step function for ``program``'s global block:
     ``fn(feed_vals, state_vals, key) -> (fetches, new_state)``.
 
@@ -186,6 +187,8 @@ def trace_program(program, feed_names, state_names, writeback, fetch_names,
         env.update(zip(state_in, state_vals))
         ctx = ComputeContext(key=key, platform=platform, mesh=mesh)
         ctx.sequence_parallel = sequence_parallel
+        ctx.pipeline_schedule = pipeline_schedule
+        ctx.pipeline_microbatches = pipeline_microbatches
         ctx.program = program
         ctx.amp = getattr(program, '_amp_policy', None)
         for i, op in enumerate(ops):

@@ -106,11 +106,13 @@ def _prepare_embedding(word, pos_table_name, vocab_size, d_model, max_len,
 
 def wrap_encoder(src_word, src_max_len, vocab_size, n_layer=6, n_head=8,
                  d_model=512, d_inner=2048, dropout_rate=0.1, is_test=False,
-                 pipeline_microbatches=None):
-    """``pipeline_microbatches``: stage each encoder layer into a
-    ``layers.Pipeline`` region (one stage per layer) so the model runs as
-    a GPipe schedule when the ParallelExecutor's mesh has a ``pp`` axis
-    of size ``n_layer`` — same losses either way."""
+                 pipeline_microbatches=None, pipeline_layers_per_stage=1):
+    """``pipeline_microbatches``: stage the encoder layers into a
+    ``layers.Pipeline`` region (``pipeline_layers_per_stage``
+    consecutive layers per stage, default one) so the model runs as a
+    pipeline schedule when the ParallelExecutor's mesh has a ``pp``
+    axis matching the stage count (or dividing it, for the interleaved
+    schedule) — same losses either way."""
     src_len = src_word.block._find_var_recursive(src_word._seq_len_name)
     enc_in = _prepare_embedding(src_word, "src_pos_enc", vocab_size, d_model,
                                 src_max_len, dropout_rate, is_test, "src")
@@ -126,12 +128,19 @@ def wrap_encoder(src_word, src_max_len, vocab_size, n_layer=6, n_head=8,
 
     x = enc_in
     if pipeline_microbatches:
+        g = max(1, int(pipeline_layers_per_stage or 1))
+        if n_layer % g:
+            raise ValueError(
+                "pipeline_layers_per_stage (%d) must divide n_layer "
+                "(%d)" % (g, n_layer))
         pipe = layers.Pipeline(microbatches=pipeline_microbatches)
-        for i in range(n_layer):
+        for s0 in range(0, n_layer, g):
             with pipe.stage():
-                h = pipe.carry(x if i == 0 else None)
+                h = pipe.carry(x if s0 == 0 else None)
                 pipe.side(src_len)
-                pipe.emit(enc_layer(h, i))
+                for i in range(s0, s0 + g):
+                    h = enc_layer(h, i)
+                pipe.emit(h)
         x = pipe()
     else:
         for i in range(n_layer):
@@ -142,7 +151,8 @@ def wrap_encoder(src_word, src_max_len, vocab_size, n_layer=6, n_head=8,
 
 def wrap_decoder(tgt_word, enc_out, tgt_max_len, vocab_size, n_layer=6,
                  n_head=8, d_model=512, d_inner=2048, dropout_rate=0.1,
-                 is_test=False, pipeline_microbatches=None):
+                 is_test=False, pipeline_microbatches=None,
+                 pipeline_layers_per_stage=1):
     tgt_len = tgt_word.block._find_var_recursive(tgt_word._seq_len_name)
     src_len = enc_out.block._find_var_recursive(enc_out._seq_len_name)
     dec_in = _prepare_embedding(tgt_word, "tgt_pos_enc", vocab_size, d_model,
@@ -163,14 +173,21 @@ def wrap_decoder(tgt_word, enc_out, tgt_max_len, vocab_size, n_layer=6,
 
     x = dec_in
     if pipeline_microbatches:
+        g = max(1, int(pipeline_layers_per_stage or 1))
+        if n_layer % g:
+            raise ValueError(
+                "pipeline_layers_per_stage (%d) must divide n_layer "
+                "(%d)" % (g, n_layer))
         pipe = layers.Pipeline(microbatches=pipeline_microbatches)
-        for i in range(n_layer):
+        for s0 in range(0, n_layer, g):
             with pipe.stage():
-                h = pipe.carry(x if i == 0 else None)
+                h = pipe.carry(x if s0 == 0 else None)
                 pipe.side(tgt_len)
                 pipe.side(src_len)
                 enc = pipe.side(enc_out)   # per-microbatch cross K/V
-                pipe.emit(dec_layer(h, enc, i))
+                for i in range(s0, s0 + g):
+                    h = dec_layer(h, enc, i)
+                pipe.emit(h)
         x = pipe()
     else:
         for i in range(n_layer):
@@ -184,17 +201,23 @@ def transformer(src_word, tgt_word, label, src_max_len, tgt_max_len,
                 src_vocab_size, tgt_vocab_size, n_layer=6, n_head=8,
                 d_model=512, d_inner=2048, dropout_rate=0.1,
                 label_smooth_eps=0.1, is_test=False,
-                pipeline_microbatches=None):
+                pipeline_microbatches=None, pipeline_layers_per_stage=1):
     """Full train graph: returns (avg_cost, logits).
 
-    ``pipeline_microbatches`` stages the encoder and decoder stacks into
-    two GPipe regions (one stage per layer) for ``pp`` meshes."""
+    ``pipeline_microbatches`` stages the encoder and decoder stacks
+    into two pipeline regions (``pipeline_layers_per_stage``
+    consecutive layers per stage) for ``pp`` meshes — stage
+    granularity is the knob that trades fewer/fatter stages (GPipe on
+    small meshes) against more/thinner ones (interleaved virtual
+    stages)."""
     enc_out = wrap_encoder(src_word, src_max_len, src_vocab_size, n_layer,
                            n_head, d_model, d_inner, dropout_rate, is_test,
-                           pipeline_microbatches)
+                           pipeline_microbatches,
+                           pipeline_layers_per_stage)
     logits = wrap_decoder(tgt_word, enc_out, tgt_max_len, tgt_vocab_size,
                           n_layer, n_head, d_model, d_inner, dropout_rate,
-                          is_test, pipeline_microbatches)
+                          is_test, pipeline_microbatches,
+                          pipeline_layers_per_stage)
     # label: [B, T, 1] int64 ids (padded); mask from tgt lengths
     tgt_len = tgt_word.block._find_var_recursive(tgt_word._seq_len_name)
     # uniform smoothing fused into the loss kernel: the reference's

@@ -37,6 +37,14 @@ JSONL events — and classifies every second of run wall-clock into
 ``probe``
     autotune ladder work: steps inside a ``probe_accounting`` window
     and the compile gaps leading into them.
+``pipeline_bubble``
+    pipeline-schedule fill/drain waste: the ParallelExecutor carves
+    ``step_seconds x bubble_fraction`` out of every warm step of a
+    program whose ``pipeline_region`` ops run pipelined on a ``pp``
+    mesh, where the fraction is the executed schedule's exact per-tick
+    stage-idle accounting (``parallel.pipeline.schedule_stats`` — the
+    same tables the lowering is built from).  This is what makes the
+    GPipe-vs-interleaved/1F1B delta attributed, not inferred.
 ``stall_idle``
     watchdog-detected stall windows falling between steps (a hung
     reader, a wedged device with nothing dispatched).
@@ -70,7 +78,7 @@ __all__ = [
 
 # the exclusive, exhaustive attribution buckets, in report order
 BUCKETS = ("compute", "input_wait", "trace_compile", "checkpoint_stall",
-           "recovery", "probe", "stall_idle", "other")
+           "recovery", "probe", "pipeline_bubble", "stall_idle", "other")
 
 # span name -> bucket, for spans that are DIRECT badput on the step
 # path.  One classification table, two consumers: the live ledger here
@@ -85,6 +93,7 @@ SPAN_BUCKETS = {
     "parallel_executor/compile": "trace_compile",
     "checkpoint/snapshot": "checkpoint_stall",
     "guardian/rollback": "recovery",
+    "pipeline/bubble": "pipeline_bubble",
 }
 
 # spans the classifier must NOT attribute directly, and why — nested
@@ -250,6 +259,13 @@ class GoodputLedger:
                 self._probe_steps += 1
                 delta["probe"] += span_s
             else:
+                # the pipeline-bubble carve-out applies to the step's
+                # COMPUTE REMAINDER, not the whole step: the emitted
+                # span encodes the schedule's idle fraction as
+                # seconds/step_seconds, and input-wait/compile seconds
+                # were never pipelined time.  Recover the fraction and
+                # apply it after the other carve-outs.
+                bub = in_step.pop("pipeline_bubble", 0.0)
                 known = sum(in_step.values())
                 if known > span_s > 0:
                     # nesting/measurement noise: scale the carve-out
@@ -259,7 +275,12 @@ class GoodputLedger:
                     known = span_s
                 for b, s in in_step.items():
                     delta[b] += s
-                delta["compute"] += max(0.0, span_s - known)
+                rem = max(0.0, span_s - known)
+                if bub > 0 and span_s > 0:
+                    frac = min(1.0, bub / span_s)
+                    delta["pipeline_bubble"] += frac * rem
+                    rem -= frac * rem
+                delta["compute"] += rem
             # any residue between span_s and the full watermark advance
             # (a step that began before the previous watermark —
             # concurrent executors) stays attributed: the gap handler

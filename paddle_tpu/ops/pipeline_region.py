@@ -1,15 +1,35 @@
-"""pipeline_region op — GPipe over the ``pp`` mesh axis, from the Program.
+"""pipeline_region op — scheduled pipelining over the ``pp`` mesh axis.
 
 Lowering of ``layers.Pipeline`` (no reference analog; SURVEY.md §2.4 lists
 pipeline parallelism as absent upstream).  The op owns a sub-block whose
-ops are partitioned into S structurally-identical stages.  Two kernels:
+ops are partitioned into structurally-identical stages.  Kernels:
 
 * single-device (or no populated ``pp`` axis): run the stages
   sequentially per microbatch — the semantic ground truth.
-* mesh with ``pp`` axis of size S (threaded by ParallelExecutor as
-  ``ctx.mesh``): classic GPipe — per-stage parameters stack on a leading
-  stage dim sharded over ``pp``, activations flow stage-to-stage with
-  ``ppermute``, one ``lax.fori_loop`` of M + S - 1 ticks.
+* mesh with a ``pp`` axis (threaded by ParallelExecutor as
+  ``ctx.mesh``), schedule selected by
+  ``BuildStrategy.pipeline_schedule`` (``ctx.pipeline_schedule``;
+  ``ctx.pipeline_microbatches`` overrides the microbatch attr):
+
+  - ``gpipe`` (default): per-stage parameters stack on a leading stage
+    dim sharded over ``pp``, activations flow stage-to-stage with
+    ``ppermute``, one ``lax.fori_loop`` of M + S - 1 ticks.
+  - ``1f1b``: same forward schedule as a ``jax.custom_vjp`` whose
+    backward is a combined M + 2(S-1)-tick loop — each tick recomputes
+    one stage forward just-in-time (stashing its INPUT in a
+    min(M, 2S-1)-slot circular buffer, so backward memory is
+    M-independent) and retires one stage backward via per-stage
+    ``jax.vjp``, cotangents flowing down-ring.  Consts and PRNG key
+    material ride as explicit custom_vjp arguments (closing over
+    outer-trace tracers is illegal there).
+  - ``interleaved``: the program's S_total stages split round-robin
+    into v = S_total/pp chunks per device (requires S_total % pp == 0
+    and M % pp == 0); groups of pp microbatches ride the ring v times,
+    vM + S - 1 ticks — bubble shrinks by ~v at equal (S, M).
+
+  The per-tick stage-idle accounting of the executed schedule
+  (``parallel.pipeline.schedule_stats``) feeds the goodput ledger's
+  ``pipeline_bubble`` bucket via the ParallelExecutor.
 
 Both kernels execute the SAME stage template (stage 0's op list bound to
 stage s's parameters) with the SAME per-stage PRNG fold, so dropout masks
@@ -109,6 +129,13 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
     carry0 = ins["Carry"][0]
     b = carry0.shape[0]
     m = attrs.get("microbatches") or s_count
+    # BuildStrategy.pipeline_microbatches (tune_pipeline's knob)
+    # overrides the program attr — on the mesh path AND the sequential
+    # ground truth, so schedule-parity checks compare equal microbatch
+    # structures (PRNG folds are per-microbatch)
+    override_m = getattr(ctx, "pipeline_microbatches", None)
+    if override_m:
+        m = int(override_m)
     if b % m:
         raise ValueError(
             "pipeline_region: microbatches (%d) must divide the batch (%d)"
@@ -194,12 +221,33 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
     side_mb = [v.reshape((m, mb) + tuple(v.shape[1:])) for v in side_vals]
     x_mb = carry0.reshape((m, mb) + tuple(carry0.shape[1:]))
 
+    from ..parallel.pipeline import normalize_schedule
+
+    schedule = normalize_schedule(getattr(ctx, "pipeline_schedule", None))
     mesh = getattr(ctx, "mesh", None)
     pp_ok = False
-    if mesh is not None:
+    virtual = 1
+    if mesh is not None and s_count > 1:
         from ..parallel.mesh import AXIS_PP
-        pp_ok = AXIS_PP in mesh.axis_names and \
-            mesh.shape[AXIS_PP] == s_count and s_count > 1
+        if AXIS_PP in mesh.axis_names:
+            pp = mesh.shape[AXIS_PP]
+            if pp > 1:
+                if schedule == "interleaved":
+                    # v stage chunks per device: the program's stage
+                    # count splits round-robin over the pp axis
+                    if s_count % pp == 0:
+                        virtual = s_count // pp
+                        pp_ok = True
+                        if m % pp:
+                            raise ValueError(
+                                "pipeline_region: the interleaved "
+                                "schedule sends groups of S "
+                                "microbatches around the ring "
+                                "together — microbatches (%d) must be "
+                                "a multiple of the pp axis size (%d)"
+                                % (m, pp))
+                else:
+                    pp_ok = pp == s_count
     if not pp_ok:
         # sequential ground truth: same template, same PRNG folds
         outs = []
@@ -212,9 +260,11 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
         out = jnp.stack(outs).reshape(carry0.shape)
         return {"Out": out}
 
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..parallel.mesh import AXIS_DP, AXIS_PP, shard_map_norep
+
+    pp = mesh.shape[AXIS_PP]
 
     # shard the microbatch batch dim over dp so dp replicas process their
     # own batch slices through the pipeline (instead of redundantly
@@ -225,38 +275,130 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
         mesh.shape[AXIS_DP] if AXIS_DP in mesh.axis_names else 1)
     dp_sharded = AXIS_DP in mesh.axis_names and dp > 1 and mb % dp == 0
     mb_spec = P(None, AXIS_DP) if dp_sharded else P()
+    # bodies return the collected outputs with a leading per-stage dim
+    # [1, M, mb, ...]; out_specs P(pp, ...) makes the caller's slice of
+    # the LAST stage a true single-source broadcast inserted by GSPMD —
+    # satellite fix: no lax.psum over a masked all-stage-sized buffer,
+    # and the slice transpose routes cotangents to the producing stage
+    # exactly
+    staged_spec = P(AXIS_PP, None, AXIS_DP) if dp_sharded else P(AXIS_PP)
+    # the wrap-around (pp-1 -> 0) edge is dead for the fill-drain
+    # schedules (stage 0 always ingests a fresh microbatch): dropped
+    # from the permutation (satellite fix).  The interleaved ring keeps
+    # it — that's how microbatches start their next round.
+    perm_fwd = [(j, j + 1) for j in range(pp - 1)]
+    n_fsides = len(attrs["side_names"])
 
-    def body(stacked_local, x_mb, side_mb):
-        s_idx = lax.axis_index(AXIS_PP)
-        my_params = [p[0] for p in stacked_local]
-        extra = lax.axis_index(AXIS_DP) if dp_sharded else None
+    def _dyn(v, i):
+        return lax.dynamic_index_in_dim(v, i, 0, keepdims=False)
 
-        def tick(t, st):
-            cur, outs = st
-            fresh = x_mb[jnp.clip(t, 0, m - 1)]
-            cur = jnp.where(s_idx == 0, fresh, cur)
-            my_mb = jnp.clip(t - s_idx, 0, m - 1)
-            sides_t = [lax.dynamic_index_in_dim(v, my_mb, 0,
-                                                keepdims=False)
-                       for v in side_mb]
-            out = stage_fn(s_idx, my_params, cur, sides_t, extra,
-                           mb_idx=my_mb)
-            done = t - (s_count - 1)
-            take = (s_idx == s_count - 1) & (done >= 0)
-            updated = lax.dynamic_update_index_in_dim(
-                outs, out, jnp.clip(done, 0, m - 1), 0)
-            outs = jnp.where(take, updated, outs)
-            nxt = lax.ppermute(out, AXIS_PP,
-                               [(j, (j + 1) % s_count)
-                                for j in range(s_count)])
-            return nxt, outs
+    if schedule == "gpipe":
+        def body(stacked_local, x_mb, side_mb):
+            s_idx = lax.axis_index(AXIS_PP)
+            my_params = [p[0] for p in stacked_local]
+            extra = lax.axis_index(AXIS_DP) if dp_sharded else None
+            total = m + s_count - 1
 
-        outs0 = jnp.zeros_like(x_mb)
-        cur0 = jnp.zeros_like(x_mb[0])
-        _, outs = lax.fori_loop(0, m + s_count - 1, tick, (cur0, outs0))
-        # broadcast the last stage's collected outputs to every device
-        mask = (s_idx == s_count - 1).astype(outs.dtype)
-        return lax.psum(outs * mask, AXIS_PP)
+            def tick(t, st):
+                cur, outs = st
+                fresh = x_mb[jnp.clip(t, 0, m - 1)]
+                cur = jnp.where(s_idx == 0, fresh, cur)
+                my_mb = jnp.clip(t - s_idx, 0, m - 1)
+                sides_t = [_dyn(v, my_mb) for v in side_mb]
+                out = stage_fn(s_idx, my_params, cur, sides_t, extra,
+                               mb_idx=my_mb)
+                done = t - (s_count - 1)
+                take = (s_idx == s_count - 1) & (done >= 0)
+                updated = lax.dynamic_update_index_in_dim(
+                    outs, out, jnp.clip(done, 0, m - 1), 0)
+                outs = jnp.where(take, updated, outs)
+                # the final tick's rotation is discarded with the loop
+                # carry: skip the ICI transfer (satellite fix)
+                nxt = lax.cond(
+                    t < total - 1,
+                    lambda o: lax.ppermute(o, AXIS_PP, perm_fwd),
+                    lambda o: o, out)
+                return nxt, outs
+
+            outs0 = jnp.zeros_like(x_mb)
+            cur0 = jnp.zeros_like(x_mb[0])
+            _, outs = lax.fori_loop(0, total, tick, (cur0, outs0))
+            return outs[None]
+
+    elif schedule == "interleaved":
+        from ..parallel.pipeline import interleaved_loop, \
+            interleaved_order
+
+        # device-major restack: device d hosts the program's stages
+        # {r*pp + d : r < v} as chunk array [v, ...]
+        order = jnp.asarray(interleaved_order(pp, virtual))
+        stacked = [jnp.take(p, order, axis=0).reshape(
+            (pp, virtual) + tuple(p.shape[1:])) for p in stacked]
+
+        def body(stacked_local, x_mb, side_mb):
+            my_chunks = [p[0] for p in stacked_local]   # [v, ...] each
+            extra = lax.axis_index(AXIS_DP) if dp_sharded else None
+
+            def apply_fn(rnd, vs_idx, cur, midx):
+                my_params = [_dyn(p, rnd) for p in my_chunks]
+                sides_t = [_dyn(v, midx) for v in side_mb]
+                return stage_fn(vs_idx, my_params, cur, sides_t, extra,
+                                mb_idx=midx)
+
+            return interleaved_loop(AXIS_PP, pp, m, virtual, x_mb,
+                                    apply_fn)
+
+    else:  # 1f1b
+        if not jnp.issubdtype(jnp.asarray(carry0).dtype, jnp.floating):
+            raise ValueError(
+                "pipeline_region: the 1f1b schedule differentiates the "
+                "carry per stage and needs a floating carry, got %s"
+                % carry0.dtype)
+        const_names = list(attrs["const_names"])
+        const_vals = [const_env[n] for n in const_names]
+        key_impl_spec = None
+        key_raw = []
+        if base_key is not None:
+            key_impl_spec = jax.random.key_impl(base_key)
+            key_raw = [jax.random.key_data(base_key)]
+
+        def run_factory(consts, key_data):
+            """Closure-free stage runner for the custom_vjp: consts and
+            PRNG key material arrive as explicit args (custom_vjp
+            functions must not capture outer-trace tracers)."""
+            key0 = None
+            if key_data:
+                key0 = jax.random.wrap_key_data(key_data[0],
+                                                impl=key_impl_spec)
+
+            def run(stage_idx, pvals, carry, sides_t, extra, mb_i):
+                env = dict(zip(const_names, consts))
+                env.update(zip(t_params, pvals))
+                env.update(zip(side_names, sides_t))
+                env[carry_in0] = carry
+                key = key0
+                if key is not None and mb_i is not None:
+                    key = jax.random.fold_in(key, mb_i)
+                if key is not None and extra is not None:
+                    key = jax.random.fold_in(key, extra)
+                sctx = _stage_ctx(ctx, key, stage_idx)
+                for j, o in enumerate(t_ops):
+                    registry.compute_op(o, env, sctx, op_index=j)
+                return env[carry_out0].astype(carry0.dtype)
+
+            return run
+
+        from ..parallel.pipeline import make_1f1b
+
+        f1 = make_1f1b(
+            AXIS_PP, pp, m, run_factory,
+            dp_extra_fn=(lambda: lax.axis_index(AXIS_DP))
+            if dp_sharded else None)
+
+        def body(stacked_local, x_mb, side_mb, consts, key_data):
+            return f1(list(stacked_local), x_mb,
+                      list(side_mb[:n_fsides]), list(side_mb[n_fsides:]),
+                      consts, key_data)
 
     # GSPMD workaround (jax 0.4.37, reproduced in isolation): a
     # concatenate/stack computed INSIDE jit and fed straight into a
@@ -268,15 +410,24 @@ def _pipeline_compute(ins, attrs, ctx, op_index):
     # Pinning the stacked params to a replicated layout before the
     # shard_map sidesteps the bad partition; they were replicated as
     # separate state vars anyway, so this adds no memory.
-    from jax.sharding import NamedSharding
     rep = NamedSharding(mesh, P())
     stacked = [jax.lax.with_sharding_constraint(p, rep) for p in stacked]
-    fn = shard_map_norep(
-        body, mesh,
-        in_specs=([P(AXIS_PP)] * len(stacked), mb_spec,
-                  [mb_spec] * len(side_mb)),
-        out_specs=mb_spec)
-    outs = fn(stacked, x_mb, side_mb)
+    if schedule == "1f1b":
+        fn = shard_map_norep(
+            body, mesh,
+            in_specs=([P(AXIS_PP)] * len(stacked), mb_spec,
+                      [mb_spec] * len(side_mb),
+                      [P()] * len(const_vals), [P()] * len(key_raw)),
+            out_specs=staged_spec)
+        staged = fn(stacked, x_mb, side_mb, const_vals, key_raw)
+    else:
+        fn = shard_map_norep(
+            body, mesh,
+            in_specs=([P(AXIS_PP)] * len(stacked), mb_spec,
+                      [mb_spec] * len(side_mb)),
+            out_specs=staged_spec)
+        staged = fn(stacked, x_mb, side_mb)
+    outs = staged[pp - 1]
     return {"Out": outs.reshape(carry0.shape)}
 
 

@@ -72,6 +72,9 @@ class _Compiled:
         # fetch; see executor._CompiledProgram)
         self.guarded = guarded
         self.warm = False      # first dispatch = trace+compile (see Executor)
+        # schedule accounting for the program's pipeline regions on this
+        # mesh (set by PE._compile; None = nothing runs pipelined)
+        self.pipeline_stats = None
         # AOT-captured executable (one per entry: the trace-cache key
         # already pins the feed signature + mesh); set by profile
         # capture at the cold dispatch and used for every later step
@@ -241,6 +244,7 @@ class ParallelExecutor:
             bs.feed_sharding_fn, self._sharding_layout(),
             bs.sequence_parallel, bs.remat,
             bs.donate_state, jax.process_count(),
+            bs.pipeline_schedule, bs.pipeline_microbatches,
             compile_cache.trace_flag_values())
         cached = compile_cache.lookup(tkey)
         if cached is not None:
@@ -250,7 +254,9 @@ class ParallelExecutor:
                 program, feed_names, state_names, writeback, fetch_names,
                 platform=self._mesh.devices.flat[0].platform,
                 mesh=self._mesh,
-                sequence_parallel=self._build_strategy.sequence_parallel)
+                sequence_parallel=self._build_strategy.sequence_parallel,
+                pipeline_schedule=bs.pipeline_schedule,
+                pipeline_microbatches=bs.pipeline_microbatches)
 
         mesh = self._mesh
         data_axes = self._data_axes()
@@ -332,11 +338,51 @@ class ParallelExecutor:
         partition_key = (mesh_key[0], mesh_key[1], tuple(
             (n, str(spec_by_name[n])) for n in state_in
             if spec_by_name[n] != P()))
-        return compile_cache.store(tkey, _Compiled(
+        compiled = _Compiled(
             jitted, feed_names, state_in, state_out,
             fetch_names, feed_shardings, state_shardings,
             out_state_shardings, partition_key=partition_key,
-            guarded=guarded))
+            guarded=guarded)
+        compiled.pipeline_stats = self._pipeline_stats(program)
+        return compile_cache.store(tkey, compiled)
+
+    def _pipeline_stats(self, program):
+        """Per-tick stage-idle accounting for the program's
+        pipeline_region ops under this executor's mesh + schedule — the
+        numbers behind the goodput ledger's ``pipeline_bubble`` bucket.
+        Mirrors the lowering's engagement test (ops/pipeline_region.py);
+        None when no region runs pipelined on this mesh."""
+        from .mesh import AXIS_PP
+        from .pipeline import normalize_schedule, schedule_stats
+
+        pp = self._axis_size(AXIS_PP)
+        if pp <= 1:
+            return None
+        schedule = normalize_schedule(
+            self._build_strategy.pipeline_schedule)
+        override = self._build_strategy.pipeline_microbatches
+        regions = []
+        for op in program.global_block().ops:
+            if op.type != "pipeline_region":
+                continue
+            s_count = int(op.attrs["stages"])
+            if schedule == "interleaved":
+                if s_count % pp or s_count <= 1:
+                    continue
+                v = s_count // pp
+            else:
+                if s_count != pp or s_count <= 1:
+                    continue
+                v = 1
+            m = int(override or op.attrs.get("microbatches") or s_count)
+            regions.append(schedule_stats(schedule, pp, m, v))
+        if not regions:
+            return None
+        total = sum(r["total_units"] for r in regions)
+        idle = sum(r["idle_units"] for r in regions)
+        return {"schedule": schedule,
+                "bubble_fraction": idle / total if total else 0.0,
+                "regions": regions}
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -444,7 +490,9 @@ class ParallelExecutor:
                self._build_strategy.reduce_strategy,
                self._build_strategy.param_sharding_fn,
                self._build_strategy.feed_sharding_fn,
-               self._sharding_layout())
+               self._sharding_layout(),
+               self._build_strategy.pipeline_schedule,
+               self._build_strategy.pipeline_microbatches)
         compiled = self._cache.get(key)
         if compiled is None:
             with RecordEvent("parallel_executor/compile"):
@@ -587,6 +635,25 @@ class ParallelExecutor:
             # sync — the dispatch window blocks only at its edge
             self._dispatch_queue.push_step(fetches, new_state)
         if mon_t0 is not None:
+            warm_step = step_span == "parallel_executor/dispatch"
+            ps = compiled.pipeline_stats
+            if ps is not None and warm_step:
+                # measured bubble attribution: the executed schedule's
+                # per-tick stage-idle fraction (exact, from the
+                # lowering's own schedule tables) carved out of this
+                # step's measured wall clock.  Warm steps only — a cold
+                # step's wall is compile, already attributed.  The
+                # whole step is treated as pipelined time (the regions
+                # dominate deep models; documented in README).
+                step_s = time.perf_counter() - mon_t0
+                monitor.observe_span(
+                    "pipeline/bubble",
+                    step_s * ps["bubble_fraction"] * 1e6,
+                    args={"bucket": "pipeline_bubble",
+                          "schedule": ps["schedule"],
+                          "fraction": round(ps["bubble_fraction"], 4),
+                          "run_id": monitor.run_id(),
+                          "fingerprint": fp[:12] if fp else None})
             # // pad_r: a replication-padded ragged batch still trained
             # on its true example count
             examples = _batch_examples(block, feed_names,
@@ -595,7 +662,7 @@ class ParallelExecutor:
                 "parallel_executor", time.perf_counter() - mon_t0,
                 examples, len(self._dispatch_queue),
                 device=self._mesh.devices.flat[0],
-                warm=step_span == "parallel_executor/dispatch",
+                warm=warm_step,
                 fingerprint=fp)
             # per-device memory/step gauges for the whole local mesh
             # (the single-device sample above covers only device 0)

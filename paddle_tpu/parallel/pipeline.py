@@ -1,22 +1,51 @@
-"""Pipeline parallelism: GPipe-style microbatched stage execution over
-the ``pp`` mesh axis.
+"""Pipeline parallelism: microbatched stage execution over the ``pp``
+mesh axis, under a selectable schedule.
 
 The reference has NO pipeline parallelism (SURVEY.md §2.4: absent);
 this module is the TPU-native capability extension that makes the
-``pp`` axis real: layers are grouped into S stages whose parameters are
+``pp`` axis real: layers are grouped into stages whose parameters are
 stacked on a leading stage dim and sharded over ``pp`` (each device
-holds one stage), the batch splits into M microbatches, and activations
-flow stage-to-stage with ``ppermute`` — the classic GPipe schedule run
-as a single ``lax.fori_loop`` of M + S - 1 ticks where every device
-computes every tick (bubble fraction (S-1)/(M+S-1)).
+holds one stage — or ``v`` stage *chunks* under the interleaved
+schedule), the batch splits into M microbatches, and activations flow
+stage-to-stage with ``ppermute`` inside a single ``lax.fori_loop``.
+
+Schedules (PAPERS.md: GPipe, Huang et al.; 1F1B/interleaved, Narayanan
+et al. Megatron-LM):
+
+``gpipe``
+    the classic fill-drain schedule: M + S - 1 ticks, bubble fraction
+    (S-1)/(M+S-1).  Autodiff of the loop stashes every tick's
+    residuals, so backward memory grows with M.
+``1f1b``
+    one-forward-one-backward: the forward pass is the same fill-drain
+    loop run *stash-free* (a ``custom_vjp`` saves only the region
+    inputs), and the backward pass is a combined schedule that
+    recomputes each stage's forward just-in-time and interleaves it
+    with the cotangent wave — each device holds at most
+    ``min(M, 2S-1)`` in-flight microbatch input activations
+    (M-independent), vs GPipe's M stashed residual sets.  That bounded
+    memory is what lets M grow, which is the real bubble lever; the
+    cost is one extra forward recompute (the classic GPipe-remat
+    trade, made explicit).
+``interleaved``
+    circular/virtual-stage schedule: each device hosts ``v = S_total/S``
+    stage chunks and microbatches go around the ring v times in groups
+    of S, shrinking the fill/drain bubble to (S-1)/(vM+S-1) at equal
+    (S, M).  Requires the stage count to be a multiple of the mesh axis
+    size and M a multiple of S.
 
 Surface:
 
 * ``pipeline(stage_fn, stage_params, x, mesh, axis='pp',
-  microbatches=M)`` — ``stage_fn(params, x) -> y`` is ONE stage's
-  computation (inter-stage activations must share x's shape);
-  ``stage_params`` is a pytree whose leaves have leading dim S.
-  Returns the pipelined equivalent of folding all S stages over x.
+  microbatches=M, schedule='gpipe')`` — ``stage_fn(params, x) -> y``
+  is ONE stage's computation (inter-stage activations must share x's
+  shape); ``stage_params`` is a pytree whose leaves have leading dim
+  S_total (== axis size, or v * axis size under ``interleaved``).
+  Returns the pipelined equivalent of folding all stages over x.
+* ``schedule_stats(schedule, stages, microbatches, virtual=1)`` — the
+  per-tick stage-idle accounting shared by the lowerings, the
+  ParallelExecutor's ``pipeline_bubble`` goodput attribution, and the
+  autotuner's ``tune_pipeline``.
 """
 
 import functools
@@ -28,13 +57,87 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import AXIS_PP, shard_map_norep
 
-__all__ = ["pipeline"]
+__all__ = ["pipeline", "SCHEDULES", "schedule_stats",
+           "normalize_schedule", "make_1f1b", "interleaved_loop",
+           "interleaved_order"]
+
+SCHEDULES = ("gpipe", "1f1b", "interleaved")
 
 
-def _pipeline_shard(params, x, axis_name, stage_fn, microbatches):
-    """Per-device body: params [1, ...] (this stage's slice), x [B, ...]
-    (full batch, replicated).  Returns [B, ...] final-stage outputs,
-    valid on every device (broadcast from the last stage)."""
+def normalize_schedule(schedule):
+    """``None`` -> the default ``gpipe``; anything else must name a
+    known schedule."""
+    if schedule is None:
+        return "gpipe"
+    if schedule not in SCHEDULES:
+        raise ValueError("unknown pipeline schedule %r (choose from %s)"
+                         % (schedule, list(SCHEDULES)))
+    return schedule
+
+
+def schedule_stats(schedule, stages, microbatches, virtual=1):
+    """Per-device slot accounting for one fwd+bwd step of a schedule —
+    the number source for the goodput ledger's ``pipeline_bubble``
+    bucket and for ``autotune.tune_pipeline``.
+
+    Unit model: one forward stage application = 1 unit, one backward
+    (vjp) application = 2 units.  Every SPMD tick costs every device
+    the same wall clock (idle stages compute masked garbage), so
+    ``idle_units / total_units`` is the exact fraction of device time
+    the executed schedule wastes — per-tick stage-idle accounting, not
+    the closed-form estimate (they coincide for GPipe).  1F1B's
+    just-in-time forward recompute is counted BUSY (it burns cycles but
+    is remat overhead, not bubble); it is reported separately as
+    ``remat_units``.
+    """
+    schedule = normalize_schedule(schedule)
+    s = int(stages)
+    m = int(microbatches)
+    v = int(virtual or 1)
+    if s < 1 or m < 1 or v < 1:
+        raise ValueError("stages/microbatches/virtual must be >= 1")
+    remat = 0
+    if schedule == "gpipe":
+        # fwd loop M+S-1 ticks @1; autodiff reverse M+S-1 ticks @2
+        total = 3 * (m + s - 1)
+        idle = 3 * (s - 1)
+        in_flight = m + s - 1          # per-tick residual stashes
+        ticks = m + s - 1
+    elif schedule == "interleaved":
+        # chunk ticks: fwd vM+S-1 @1, autodiff reverse vM+S-1 @2
+        ticks = v * m + s - 1
+        total = 3 * ticks
+        idle = 3 * (s - 1)
+        in_flight = ticks
+    else:  # 1f1b
+        # stash-free fwd loop (M+S-1 @1) + combined bwd loop of
+        # M+2(S-1) ticks, each tick one fwd-recompute slot (@1) and
+        # one bwd slot (@2)
+        bwd_ticks = m + 2 * (s - 1)
+        total = (m + s - 1) + 3 * bwd_ticks
+        idle = (s - 1) + 3 * 2 * (s - 1)
+        remat = m                      # one fwd recompute per microbatch
+        in_flight = min(m, 2 * s - 1)  # input-activation stash slots
+        ticks = (m + s - 1) + bwd_ticks
+    return {"schedule": schedule, "stages": s, "microbatches": m,
+            "virtual": v, "ticks": ticks, "total_units": total,
+            "idle_units": idle, "remat_units": remat,
+            "in_flight": in_flight,
+            "bubble_fraction": idle / total if total else 0.0}
+
+
+# ---------------------------------------------------------------------------
+# per-device schedule bodies (run under shard_map; each returns the
+# collected outputs with a leading per-stage dim [1, M, mb, ...] so the
+# caller's out_specs P(axis) makes GSPMD deliver the last stage's slice
+# as a true single-source broadcast — no psum over a masked all-stage
+# buffer, and the slice transpose routes cotangents exactly)
+# ---------------------------------------------------------------------------
+
+def _gpipe_shard(params, x, axis_name, stage_fn, microbatches):
+    """Classic GPipe: params [1, ...] (this stage's slice), x [B, ...]
+    (full batch, replicated).  Returns [1, M, mb, ...] — valid on the
+    last stage's shard."""
     s = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
     my_params = jax.tree_util.tree_map(lambda p: p[0], params)
@@ -49,9 +152,11 @@ def _pipeline_shard(params, x, axis_name, stage_fn, microbatches):
         jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype)).dtype
     x_mb = x.reshape((m, mb) + x.shape[1:]).astype(out_dtype)
 
-    # send each stage's output to the next stage (ring without wrap: the
-    # last stage's output would wrap to stage 0, which ignores it)
-    perm = [(j, (j + 1) % s) for j in range(s)]
+    # send each stage's output to the next stage only: the wrap-around
+    # (S-1 -> 0) edge is dead on every tick (stage 0 always ingests a
+    # fresh microbatch), so it is dropped from the permutation entirely
+    perm = [(j, j + 1) for j in range(s - 1)]
+    total = m + s - 1
 
     def tick(t, carry):
         cur_in, outs = carry
@@ -66,39 +171,324 @@ def _pipeline_shard(params, x, axis_name, stage_fn, microbatches):
         updated = lax.dynamic_update_index_in_dim(
             outs, out, jnp.clip(done_idx, 0, m - 1), 0)
         outs = jnp.where(take, updated, outs)
-        nxt = lax.ppermute(out, axis_name, perm)
+        # the final tick's rotation is discarded with the loop carry:
+        # skip the ICI transfer entirely (ring_attention precedent)
+        nxt = lax.cond(
+            t < total - 1,
+            lambda o: lax.ppermute(o, axis_name, perm),
+            lambda o: o, out)
         return nxt, outs
 
     outs0 = jnp.zeros((m, mb) + x.shape[1:], out_dtype)
     cur0 = jnp.zeros((mb,) + x.shape[1:], out_dtype)
-    _, outs = lax.fori_loop(0, m + s - 1, tick, (cur0, outs0))
-    # broadcast the last stage's collected outputs to every device
-    mask = (stage == s - 1).astype(outs.dtype)
-    outs = lax.psum(outs * mask, axis_name)
-    return outs.reshape((b,) + x.shape[1:])
+    _, outs = lax.fori_loop(0, total, tick, (cur0, outs0))
+    return outs[None]
+
+
+def interleaved_order(s, v):
+    """Device-major restack order for the interleaved schedule: slot
+    ``d*v + r`` holds virtual stage ``r*s + d`` (device d's chunk r —
+    the Megatron round-robin assignment)."""
+    return [r * s + d for d in range(s) for r in range(v)]
+
+
+def interleaved_loop(axis_name, s, m, v, x_mb, apply_fn):
+    """Per-device driver of the circular/interleaved schedule — THE
+    single implementation shared by the functional surface and the
+    ``pipeline_region`` lowering.  Groups of S microbatches ride the
+    S-device ring v times; vM + S - 1 ticks.  At tick t this device's
+    stream position is q = t - d; the microbatch here is
+    ``(q // (S*v)) * S + (q % S)`` in round ``(q // S) % v`` (group g
+    enters device 0 at tick g*S*v).  ``apply_fn(rnd, vs_idx, cur,
+    midx) -> out`` applies this device's chunk ``rnd`` (program stage
+    ``vs_idx``) to the carry for microbatch ``midx``.  Returns the
+    collected final-round outputs with a leading per-stage dim
+    [1, M, mb, ...]."""
+    d = lax.axis_index(axis_name)
+    vs_total = s * v
+    total = v * m + s - 1
+    perm = [(j, (j + 1) % s) for j in range(s)]
+
+    def tick(t, carry):
+        cur, outs = carry
+        q = t - d
+        r_mb = jnp.mod(q, s)
+        rnd = jnp.mod(jnp.floor_divide(q, s), v)
+        grp = jnp.floor_divide(q, vs_total)
+        midx = jnp.clip(grp * s + r_mb, 0, m - 1)
+        active = (q >= 0) & (grp < m // s)
+        # device 0 ingests fresh microbatches on round 0; later rounds
+        # arrive through the wrap-around ppermute edge
+        cur = jnp.where((d == 0) & (rnd == 0), x_mb[midx], cur)
+        out = apply_fn(rnd, rnd * s + d, cur, midx)
+        done = active & (rnd == v - 1) & (d == s - 1)
+        updated = lax.dynamic_update_index_in_dim(outs, out, midx, 0)
+        outs = jnp.where(done, updated, outs)
+        nxt = lax.cond(
+            t < total - 1,
+            lambda o: lax.ppermute(o, axis_name, perm),
+            lambda o: o, out)
+        return nxt, outs
+
+    outs0 = jnp.zeros_like(x_mb)
+    cur0 = jnp.zeros_like(x_mb[0])
+    _, outs = lax.fori_loop(0, total, tick, (cur0, outs0))
+    return outs[None]
+
+
+def _interleaved_shard(params, x, axis_name, stage_fn, microbatches,
+                       virtual):
+    """Functional-surface adapter over :func:`interleaved_loop`:
+    params [1, v, ...] (this device's chunks, device-major restacked by
+    the caller), x [B, ...] replicated."""
+    s = lax.psum(1, axis_name)
+    m = microbatches
+    mb = x.shape[0] // m
+    my_chunks = jax.tree_util.tree_map(lambda p: p[0], params)
+    chunk0 = jax.tree_util.tree_map(lambda p: p[0], my_chunks)
+    out_dtype = jax.eval_shape(
+        stage_fn, chunk0,
+        jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype)).dtype
+    x_mb = x.reshape((m, mb) + x.shape[1:]).astype(out_dtype)
+
+    def apply_fn(rnd, vs_idx, cur, midx):
+        chunk = jax.tree_util.tree_map(
+            lambda p: lax.dynamic_index_in_dim(p, rnd, 0,
+                                               keepdims=False),
+            my_chunks)
+        return stage_fn(chunk, cur)
+
+    return interleaved_loop(axis_name, s, m, virtual, x_mb, apply_fn)
+
+
+def make_1f1b(axis_name, s, m, run_factory, dp_extra_fn=None):
+    """The 1F1B schedule as a ``custom_vjp`` — THE single
+    implementation shared by the functional surface below and the
+    ``pipeline_region`` lowering (``ops/pipeline_region.py``), so the
+    intricate stash/ring math lives in one place.
+
+    Returns ``f(params, x_mb, fsides, isides, consts, key_data) ->
+    [1, M, mb, ...]`` to run under an existing shard_map:
+
+    * ``params`` — pytree whose leaves carry the sharded leading stage
+      dim (local ``[1, ...]``);
+    * ``fsides`` / ``isides`` — lists of per-microbatch ``[M, mb, ...]``
+      side inputs (floating ones receive cotangents, the rest get
+      float0 zeros);
+    * ``consts`` / ``key_data`` — opaque lists threaded verbatim to
+      ``run_factory`` (explicit args because custom_vjp functions must
+      not close over outer-trace tracers — PRNG keys ride as
+      ``jax.random.key_data``);
+    * ``run_factory(consts, key_data) -> run(stage_idx, stage_params,
+      carry, sides, extra, mb_idx)`` applies ONE stage;
+    * ``dp_extra_fn()`` — per-device decorrelation fold index for
+      dp-sharded runs (None to disable).
+
+    fwd: the fill-drain loop run stash-free (residuals = the region
+    inputs only).  bwd: a combined loop of M + 2(S-1) ticks; each tick
+    recomputes one stage forward just-in-time (stashing its INPUT in a
+    min(M, 2S-1)-slot circular buffer — the M-independent memory
+    bound) and runs one stage backward via per-stage ``jax.vjp``,
+    cotangents flowing down-ring while activations flow up-ring."""
+    import numpy as onp
+
+    K = min(m, 2 * s - 1) if s > 1 else 1
+    perm_fwd = [(j, j + 1) for j in range(s - 1)]
+    perm_bwd = [(j + 1, j) for j in range(s - 1)]
+
+    def _dyn(v, i):
+        return lax.dynamic_index_in_dim(v, i, 0, keepdims=False)
+
+    def _extra():
+        return dp_extra_fn() if dp_extra_fn is not None else None
+
+    def _fwd_loop(params, x_mb, fsides, isides, consts, key_data):
+        run = run_factory(consts, key_data)
+        d = lax.axis_index(axis_name)
+        extra = _extra()
+        my = jax.tree_util.tree_map(lambda p: p[0], params)
+        total = m + s - 1
+
+        def tick(t, carry):
+            cur, outs = carry
+            cur = jnp.where(d == 0, x_mb[jnp.clip(t, 0, m - 1)], cur)
+            my_mb = jnp.clip(t - d, 0, m - 1)
+            sides_t = [_dyn(v, my_mb) for v in fsides + isides]
+            out = run(d, my, cur, sides_t, extra, my_mb)
+            done = t - (s - 1)
+            take = (d == s - 1) & (done >= 0) & (done < m)
+            updated = lax.dynamic_update_index_in_dim(
+                outs, out, jnp.clip(done, 0, m - 1), 0)
+            outs = jnp.where(take, updated, outs)
+            nxt = lax.cond(
+                t < total - 1,
+                lambda o: lax.ppermute(o, axis_name, perm_fwd),
+                lambda o: o, out)
+            return nxt, outs
+
+        outs0 = jnp.zeros_like(x_mb)
+        cur0 = jnp.zeros_like(x_mb[0])
+        _, outs = lax.fori_loop(0, total, tick, (cur0, outs0))
+        return outs[None]
+
+    @jax.custom_vjp
+    def f(params, x_mb, fsides, isides, consts, key_data):
+        return _fwd_loop(params, x_mb, fsides, isides, consts, key_data)
+
+    def f_fwd(params, x_mb, fsides, isides, consts, key_data):
+        # stash-free forward: residuals are the region INPUTS only
+        out = _fwd_loop(params, x_mb, fsides, isides, consts, key_data)
+        return out, (params, x_mb, fsides, isides, consts, key_data)
+
+    def f_bwd(res, g):
+        params, x_mb, fsides, isides, consts, key_data = res
+        run = run_factory(consts, key_data)
+        d = lax.axis_index(axis_name)
+        extra = _extra()
+        my = jax.tree_util.tree_map(lambda p: p[0], params)
+        total = m + 2 * (s - 1)
+
+        def tick(t, carry):
+            fcar, bcar, stash, dparams, dx, dfs = carry
+            # forward slot: recompute microbatch t-d's stage forward
+            # just-in-time and stash its input for the backward wave
+            fidx = t - d
+            fval = (fidx >= 0) & (fidx < m)
+            f_mb = jnp.clip(fidx, 0, m - 1)
+            finp = jnp.where(d == 0, x_mb[f_mb], fcar)
+            sides_f = [_dyn(v, f_mb) for v in fsides + isides]
+            fout = run(d, my, finp, sides_f, extra, f_mb)
+            # write only live microbatches: an unguarded drain-phase
+            # write would wrap onto a slot a pending backward still
+            # needs when M < 2S-1
+            stash = jnp.where(
+                fval,
+                lax.dynamic_update_index_in_dim(
+                    stash, finp, jnp.mod(fidx, K), 0), stash)
+            # the forward wave's last useful delivery lands at tick
+            # m+s-2 (microbatch m-1 at the last stage): the drain
+            # phase's rotations carry garbage — skip the transfers
+            fcar_n = lax.cond(
+                t < m + s - 2,
+                lambda o: lax.ppermute(o, axis_name, perm_fwd),
+                lambda o: o, fout)
+            # backward slot: microbatch t - 2(S-1) + d retires here
+            bidx = t - 2 * (s - 1) + d
+            bval = (bidx >= 0) & (bidx < m)
+            b_mb = jnp.clip(bidx, 0, m - 1)
+            ct_in = jnp.where(d == s - 1, g[0, b_mb], bcar)
+            saved_in = stash[jnp.mod(bidx, K)]
+            sides_bf = [_dyn(v, b_mb) for v in fsides]
+            sides_bi = [_dyn(v, b_mb) for v in isides]
+
+            def stage_call(mp, c, sf):
+                return run(d, mp, c, list(sf) + sides_bi, extra, b_mb)
+
+            _, vjp_fn = jax.vjp(stage_call, my, saved_in, sides_bf)
+            dp, dxx, dsf = vjp_fn(ct_in.astype(x_mb.dtype))
+            dparams = jax.tree_util.tree_map(
+                lambda a, inc: a + jnp.where(bval, inc, 0.0),
+                dparams, dp)
+            dx_upd = lax.dynamic_update_index_in_dim(dx, dxx, b_mb, 0)
+            dx = jnp.where(bval & (d == 0), dx_upd, dx)
+            dfs = [jnp.where(
+                bval, lax.dynamic_update_index_in_dim(a, inc, b_mb, 0),
+                a) for a, inc in zip(dfs, dsf)]
+            # the final tick's cotangent rotation is discarded with
+            # the loop carry — skip it like the forward loops do
+            bcar_n = lax.cond(
+                t < total - 1,
+                lambda o: lax.ppermute(o, axis_name, perm_bwd),
+                lambda o: o, jnp.where(bval, dxx, jnp.zeros_like(dxx)))
+            return fcar_n, bcar_n, stash, dparams, dx, dfs
+
+        mb_shape = tuple(x_mb.shape[1:])
+        fcar0 = jnp.zeros(mb_shape, x_mb.dtype)
+        bcar0 = jnp.zeros(mb_shape, x_mb.dtype)
+        stash0 = jnp.zeros((K,) + mb_shape, x_mb.dtype)
+        dp0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p[0]), params)
+        dx0 = jnp.zeros_like(x_mb)
+        dfs0 = [jnp.zeros_like(v) for v in fsides]
+        _, _, _, dparams, dx, dfs = lax.fori_loop(
+            0, total, tick, (fcar0, bcar0, stash0, dp0, dx0, dfs0))
+        dparams = jax.tree_util.tree_map(lambda a: a[None], dparams)
+        # each cotangent is valid on the stage that produced it; the
+        # shard_map boundary transpose psums per-device partials for
+        # replicated inputs, so zeros elsewhere make the sums exact
+        dx = jnp.where(d == 0, dx, jnp.zeros_like(dx))
+        f0 = jax.dtypes.float0
+        d_isides = [onp.zeros(onp.shape(v), f0) for v in isides]
+        d_consts = [onp.zeros(onp.shape(v), f0) for v in consts]
+        d_key = [onp.zeros(onp.shape(v), f0) for v in key_data]
+        return dparams, dx, dfs, d_isides, d_consts, d_key
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
+
+
+def _make_1f1b(axis_name, stage_fn, m, s):
+    """Functional-surface adapter over :func:`make_1f1b`: no sides, no
+    consts, no PRNG — one stage is just ``stage_fn(params, x)``."""
+
+    def run_factory(consts, key_data):
+        def run(stage_idx, my, carry, sides, extra, mb_idx):
+            return stage_fn(my, carry)
+
+        return run
+
+    f = make_1f1b(axis_name, s, m, run_factory)
+
+    def g(params, x_mb):
+        return f(params, x_mb, [], [], [], [])
+
+    return g
 
 
 def pipeline(stage_fn, stage_params, x, mesh, axis=AXIS_PP,
-             microbatches=None):
-    """Run ``stage_fn`` as an S-stage GPipe pipeline over ``mesh``'s
-    ``axis``.  ``stage_params`` leaves carry a leading stage dim equal
-    to the axis size; returns stage_{S-1}(... stage_0(x))."""
+             microbatches=None, schedule="gpipe"):
+    """Run ``stage_fn`` as an S-stage pipeline over ``mesh``'s ``axis``
+    under ``schedule``.  ``stage_params`` leaves carry a leading stage
+    dim (== axis size; ``v *`` axis size for ``interleaved``); returns
+    stage_{S-1}(... stage_0(x))."""
+    schedule = normalize_schedule(schedule)
     if axis not in mesh.axis_names:
         raise ValueError("mesh has no axis %r (axes: %s)"
                          % (axis, mesh.axis_names))
     s = mesh.devices.shape[mesh.axis_names.index(axis)]
-    for leaf in jax.tree_util.tree_leaves(stage_params):
-        if leaf.shape[0] != s:
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    s_total = leaves[0].shape[0] if leaves else s
+    for leaf in leaves:
+        if leaf.shape[0] != s_total:
+            raise ValueError(
+                "stage_params leaves disagree on the leading stage dim "
+                "(%d vs %d)" % (leaf.shape[0], s_total))
+    if schedule == "interleaved":
+        if s_total % s:
+            raise ValueError(
+                "interleaved schedule: stage count %d must be a "
+                "multiple of the %r axis size %d" % (s_total, axis, s))
+        v = s_total // s
+    else:
+        v = 1
+        if s_total != s:
             raise ValueError(
                 "stage_params leading dim %d must equal the %r axis "
-                "size %d (one stage per device)"
-                % (leaf.shape[0], axis, s))
+                "size %d (one stage per device; use "
+                "schedule='interleaved' for v stages per device)"
+                % (s_total, axis, s))
     microbatches = microbatches or s
     if x.shape[0] % microbatches != 0:
         raise ValueError(
             "microbatches (%d) must divide the batch (%d)"
             % (microbatches, x.shape[0]))
-    mb_shape = (x.shape[0] // microbatches,) + tuple(x.shape[1:])
+    if schedule == "interleaved" and microbatches % s:
+        raise ValueError(
+            "interleaved schedule: microbatches (%d) must be a "
+            "multiple of the %r axis size %d (groups of S go around "
+            "the ring together)" % (microbatches, axis, s))
+    m = microbatches
+    mb_shape = (x.shape[0] // m,) + tuple(x.shape[1:])
     stage0 = jax.tree_util.tree_map(lambda p: p[0], stage_params)
     out_shape = jax.eval_shape(
         stage_fn, stage0, jax.ShapeDtypeStruct(mb_shape, x.dtype)).shape
@@ -108,14 +498,45 @@ def pipeline(stage_fn, stage_params, x, mesh, axis=AXIS_PP,
             "can flow stage-to-stage: input %s -> output %s. Reshape "
             "inside the stage (or use heterogeneous stages via "
             "program_pipeline)" % (mb_shape, tuple(out_shape)))
+
+    if schedule == "interleaved":
+        # device-major restack: device d hosts virtual stages
+        # {r*S + d : r < v} as its chunk array [v, ...]
+        order = jnp.asarray(interleaved_order(s, v))
+        stage_params = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p)[order].reshape(
+                (s, v) + tuple(p.shape[1:])),
+            stage_params)
+        body = functools.partial(
+            _interleaved_shard, axis_name=axis, stage_fn=stage_fn,
+            microbatches=m, virtual=v)
+    elif schedule == "1f1b":
+        def body(params, xx):
+            my0 = jax.tree_util.tree_map(lambda p: p[0], params)
+            mb = xx.shape[0] // m
+            out_dtype = jax.eval_shape(
+                stage_fn, my0,
+                jax.ShapeDtypeStruct((mb,) + xx.shape[1:],
+                                     xx.dtype)).dtype
+            x_mb = xx.reshape((m, mb) + xx.shape[1:]).astype(out_dtype)
+            return _make_1f1b(axis, stage_fn, m, int(s))(params, x_mb)
+    else:
+        body = functools.partial(
+            _gpipe_shard, axis_name=axis, stage_fn=stage_fn,
+            microbatches=m)
+
     param_specs = jax.tree_util.tree_map(
         lambda p: P(axis), stage_params)
-    # replicate x; stage params shard their leading stage dim over pp
+    # replicate x; stage params shard their leading stage dim over the
+    # pipeline axis; outputs come back with a leading per-stage dim and
+    # only the LAST stage's slice is read — GSPMD inserts the
+    # single-source broadcast (satellite fix: no psum over a masked
+    # all-stage-sized buffer)
     fn = shard_map_norep(
-        functools.partial(_pipeline_shard, axis_name=axis,
-                          stage_fn=stage_fn, microbatches=microbatches),
-        mesh, in_specs=(param_specs, P()), out_specs=P())
+        body, mesh, in_specs=(param_specs, P()), out_specs=P(axis))
     stage_params = jax.tree_util.tree_map(
         lambda p, sp: jax.device_put(p, NamedSharding(mesh, sp)),
         stage_params, param_specs)
-    return fn(stage_params, x)
+    staged = fn(stage_params, x)           # [S, M, mb, ...]
+    out = staged[s - 1]
+    return out.reshape((x.shape[0],) + tuple(out.shape[2:]))

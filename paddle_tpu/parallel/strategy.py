@@ -62,6 +62,17 @@ class BuildStrategy:
         # the attention ring lowering; other mesh-aware lowerings
         # (pipeline_region over pp) always see the mesh.
         self.sequence_parallel = True
+        # pipeline schedule for pipeline_region lowerings on pp meshes
+        # (parallel/pipeline.py): 'gpipe' (fill-drain), '1f1b'
+        # (bounded-memory one-forward-one-backward), 'interleaved'
+        # (v stage chunks per device, smaller bubble).  None means the
+        # gpipe default AND marks the knob untouched, so
+        # autotune.tune_pipeline may choose; an explicit value is a
+        # user pin the tuner respects.
+        self.pipeline_schedule = None
+        # override the pipeline_region ops' microbatch attr (None =
+        # honor the program; the tune_pipeline knob lands here)
+        self.pipeline_microbatches = None
         # Ragged epoch-end batches (reference
         # details/data_balance_op_handle.cc redistributes them): under
         # SPMD the step's shapes are static, so an indivisible global

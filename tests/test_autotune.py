@@ -594,3 +594,132 @@ def test_acceptance_tuner_matches_best_grid_point():
     # noisy; the claim is "the tuner lands in the right neighborhood",
     # not microbenchmark equality
     assert sweep[d["chosen"]] <= best * 1.6, (d["chosen"], sweep)
+
+
+# ---------------------------------------------------------------------------
+# pipeline schedule + microbatch tuning (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_decide_pipeline_fast_then_low_bubble():
+    """Fastest wins outright; near-ties (within tol) settle by the
+    schedule table's bubble fraction, then the memory bound."""
+    cands = [
+        {"schedule": "gpipe", "microbatches": 4, "step_s": 0.100,
+         "bubble_fraction": 0.20, "in_flight": 7},
+        {"schedule": "interleaved", "microbatches": 4, "step_s": 0.102,
+         "bubble_fraction": 0.10, "in_flight": 11},
+        {"schedule": "1f1b", "microbatches": 16, "step_s": 0.200,
+         "bubble_fraction": 0.15, "in_flight": 7},
+    ]
+    d = autotune.decide_pipeline(cands, tol=0.05)
+    assert d["chosen"] == {"schedule": "interleaved", "microbatches": 4}
+    assert d["evidence"] == "measured_step_window"
+    assert len(d["candidates"]) == 3
+    # a decisive speed gap beats a nicer schedule table
+    cands[0]["step_s"] = 0.05
+    d2 = autotune.decide_pipeline(cands, tol=0.05)
+    assert d2["chosen"]["schedule"] == "gpipe"
+    # rejected/unmeasured candidates never win; all-rejected raises
+    with pytest.raises(ValueError, match="no measured candidate"):
+        autotune.decide_pipeline(
+            [{"schedule": "gpipe", "microbatches": 2,
+              "rejected": "peak_hbm"}])
+
+
+def _pipelined_fc_program(stages=2, microbatches=2, size=8):
+    x = fluid.layers.data("x", shape=[size])
+    pipe = fluid.layers.Pipeline(microbatches=microbatches)
+    for i in range(stages):
+        with pipe.stage():
+            c = pipe.carry(x if i == 0 else None)
+            c = fluid.layers.fc(c, size=size, act="tanh")
+            pipe.emit(c)
+    out = pipe()
+    loss = fluid.layers.mean(fluid.layers.square(out))
+    fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    return loss
+
+
+def test_tune_pipeline_pinned_schedule_skips_probes():
+    """An explicit BuildStrategy.pipeline_schedule is the user's pin:
+    recorded as such, zero candidates measured."""
+    from paddle_tpu.parallel import make_mesh
+
+    loss = _pipelined_fc_program()
+    mesh = make_mesh((1, 2), ("dp", "pp"))
+    bs = fluid.BuildStrategy()
+    bs.pipeline_schedule = "1f1b"
+    bs.pipeline_microbatches = 4
+    cfg = autotune.TunedConfig()
+    d = autotune.tune_pipeline(
+        fluid.default_main_program(), fluid.default_startup_program(),
+        {"x": np.zeros((8, 8), "float32")}, loss, mesh,
+        build_strategy=bs, config=cfg)
+    assert d["evidence"] == "pinned"
+    assert d["chosen"] == {"schedule": "1f1b", "microbatches": 4}
+    assert d["candidates"] == []
+    assert cfg.get("pipeline")["source"] == "pinned"
+
+
+def test_tune_pipeline_requires_pipelined_program():
+    from paddle_tpu.parallel import make_mesh
+
+    x = fluid.layers.data("x", shape=[4])
+    loss = fluid.layers.mean(fluid.layers.fc(x, size=4))
+    with pytest.raises(ValueError, match="no pipeline_region"):
+        autotune.tune_pipeline(
+            fluid.default_main_program(),
+            fluid.default_startup_program(),
+            {"x": np.zeros((4, 4), "float32")}, loss,
+            make_mesh((1, 2), ("dp", "pp")))
+
+
+def test_tune_pipeline_measures_and_records():
+    """The measured path: one compile per candidate, decision +
+    per-candidate evidence (step_s, bubble fraction, memory bound) in
+    the TunedConfig artifact; probe steps ride the probe accounting."""
+    from paddle_tpu.parallel import make_mesh
+
+    loss = _pipelined_fc_program(stages=2, microbatches=2)
+    mesh = make_mesh((1, 2), ("dp", "pp"))
+    cfg = autotune.TunedConfig()
+    rng = np.random.RandomState(0)
+    d = autotune.tune_pipeline(
+        fluid.default_main_program(), fluid.default_startup_program(),
+        {"x": rng.rand(8, 8).astype("float32")}, loss, mesh,
+        microbatch_candidates=[2, 4], probe_steps=1, warmup_steps=1,
+        config=cfg)
+    assert d["chosen"]["schedule"] in ("gpipe", "1f1b")
+    assert d["chosen"]["microbatches"] in (2, 4)
+    measured = [c for c in d["candidates"] if c.get("step_s")]
+    assert len(measured) == 4        # 2 schedules x 2 microbatch counts
+    for c in measured:
+        assert 0.0 < c["bubble_fraction"] < 1.0
+        assert c["in_flight"] >= 1
+    rec = cfg.get("pipeline")
+    assert rec["chosen"] == d["chosen"]
+    assert rec["evidence"] == "measured_step_window"
+    assert rec["mesh_pp"] == 2
+
+
+def test_tune_pipeline_hbm_gate_rejects_all(monkeypatch):
+    """A fake 1-byte ceiling (FLAGS_autotune_hbm_bytes) rejects every
+    candidate from the compiled peak estimate before any measured
+    window — the CPU-testable rejection path."""
+    from paddle_tpu.parallel import make_mesh
+
+    loss = _pipelined_fc_program(stages=2, microbatches=2)
+    mesh = make_mesh((1, 2), ("dp", "pp"))
+    fluid.set_flags({"FLAGS_autotune_hbm_bytes": 1,
+                     "FLAGS_preflight_oom": "warn"})
+    try:
+        with pytest.raises(ValueError, match="no measured candidate"):
+            autotune.tune_pipeline(
+                fluid.default_main_program(),
+                fluid.default_startup_program(),
+                {"x": np.zeros((8, 8), "float32")}, loss, mesh,
+                microbatch_candidates=[2], schedules=["gpipe"],
+                probe_steps=1)
+    finally:
+        fluid.set_flags({"FLAGS_autotune_hbm_bytes": 0,
+                         "FLAGS_preflight_oom": "auto"})

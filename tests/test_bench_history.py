@@ -221,3 +221,45 @@ def test_serving_rung_slo_fields_indexed_but_non_gating(tmp_path):
     # ...but the run (and the report) still PASS
     assert runs["r02"]["verdict"] == "PASS"
     assert report["overall"] == "PASS"
+
+
+def test_longctx_ring_rung_indexes_informational(tmp_path):
+    """ISSUE 12: the T>=32k ring-attention rung indexes (value +
+    min_step_s + goodput tracked against prior history) but never
+    gates — a collapsed tokens/sec flags the comparison as
+    informational while the run verdict stays PASS."""
+    ring = {"metric": "longctx_ring_tokens_per_sec", "value": 5000.0,
+            "unit": "tokens/sec", "vs_baseline": 0.0, "seq_len": 32768,
+            "sp": 8, "min_step_s": 6.5, "n_windows": 2,
+            "informational": True, "virtual_mesh": True,
+            "goodput": {"goodput_ratio": 0.4,
+                        "buckets": {"compute": 2.0}}}
+    base = _wrapper(1, {"metric": "resnet50_images_per_sec_bf16",
+                        "value": 100.0, "unit": "images/sec",
+                        "vs_baseline": 1.0, "min_step_s": 0.5,
+                        "n_windows": 3, "schema_version": 2,
+                        "extra_metrics": [ring]})
+    worse_ring = copy.deepcopy(ring)
+    worse_ring["value"] = 1000.0          # 5x throughput collapse
+    worse_ring["goodput"]["goodput_ratio"] = 0.05
+    nxt = _wrapper(2, {"metric": "resnet50_images_per_sec_bf16",
+                       "value": 100.0, "unit": "images/sec",
+                       "vs_baseline": 1.0, "min_step_s": 0.5,
+                       "n_windows": 3, "schema_version": 2,
+                       "extra_metrics": [worse_ring]})
+    p1 = tmp_path / "BENCH_r01.json"
+    p2 = tmp_path / "BENCH_r02.json"
+    p1.write_text(json.dumps(base))
+    p2.write_text(json.dumps(nxt))
+    report = bench_history.compare(
+        [bench_history.load_artifact(str(p1), 0),
+         bench_history.load_artifact(str(p2), 1)])
+    last = report["runs"][-1]
+    ring_cmp = [c for c in last["comparisons"]
+                if c["metric"] == "longctx_ring_tokens_per_sec"]
+    assert ring_cmp, "longctx rung not indexed"
+    assert any(c["field"] == "value" and c["verdict"] == "REGRESSED"
+               for c in ring_cmp)
+    assert all(c["informational"] for c in ring_cmp)
+    assert last["verdict"] == "PASS"      # informational: never gates
+    assert report["overall"] == "PASS"

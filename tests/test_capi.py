@@ -129,8 +129,16 @@ def test_demo_predictor_binary(tmp_path):
 
 def test_demo_trainer_binary(tmp_path):
     """The reference demo_trainer flow: a C++ process trains from a
-    saved program and the loss falls."""
+    saved program and the loss falls.
+
+    The program seeds are PINNED (they serialize with the program):
+    an unseeded program draws its init auto-seed from numpy's global
+    RNG, whose state depends on which tests ran before — the
+    convergence margin then flips under the full suite while passing
+    in isolation (the PR-11 flake)."""
     with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_main_program().random_seed = 1234
+        fluid.default_startup_program().random_seed = 1234
         x = fluid.layers.data("x", shape=[8])
         y = fluid.layers.data("y", shape=[1])
         pred = fluid.layers.fc(x, size=1)

@@ -119,6 +119,41 @@ def test_ledger_step_and_gap_attribution():
     assert sum(t.values()) == pytest.approx(1012.0 - 1000.0)
 
 
+def test_ledger_pipeline_bubble_carves_the_compute_remainder():
+    """ISSUE 12: the pipeline_bubble span encodes the executed
+    schedule's idle fraction (seconds = fraction * step_seconds); the
+    ledger applies that fraction to the step's COMPUTE REMAINDER (the
+    pipelined time), never to input-wait/compile seconds, and the
+    bucket sum stays exclusive-exhaustive."""
+    lg = GoodputLedger()
+    lg.reset(now=0.0)
+    # step [0, 4]: 1s of h2d carve-out, bubble span claiming 25% of the
+    # step -> remainder 3s splits 0.75 bubble / 2.25 compute
+    lg.note_span("executor/h2d_transfer", 1.0, now=3.0)
+    lg.note_span("pipeline/bubble", 1.0,
+                 args={"bucket": "pipeline_bubble", "fraction": 0.25},
+                 now=3.9)
+    _step(lg, 4.0, 4.0)
+    t = lg.totals()
+    assert t["input_wait"] == pytest.approx(1.0)
+    assert t["pipeline_bubble"] == pytest.approx(0.75)
+    assert t["compute"] == pytest.approx(2.25)
+    assert sum(t.values()) == pytest.approx(4.0)
+    # an io-dominated step: other carve-outs eat the whole step, the
+    # bubble scales to the (empty) remainder instead of inventing time
+    lg.note_span("executor/h2d_transfer", 4.0, now=7.9)
+    lg.note_span("pipeline/bubble", 1.0,
+                 args={"bucket": "pipeline_bubble"}, now=7.95)
+    _step(lg, 8.0, 4.0)
+    t = lg.totals()
+    assert t["pipeline_bubble"] == pytest.approx(0.75)   # unchanged
+    assert sum(t.values()) == pytest.approx(8.0)
+    # name-table classification matches the hint path (trace_summary's
+    # offline view agrees with the live ledger)
+    assert classify_span("pipeline/bubble") == "pipeline_bubble"
+    assert "pipeline_bubble" in BUCKETS
+
+
 def test_ledger_async_save_is_overlap_not_stall():
     lg = GoodputLedger()
     lg.reset(now=0.0)

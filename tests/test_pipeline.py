@@ -1,14 +1,21 @@
-"""GPipe-style pipeline parallelism tests on the 8-device virtual mesh:
-parity with sequential stage folding, gradients, microbatch counts."""
+"""Pipeline-parallelism tests on the 8-device virtual mesh: schedule
+equivalence (GPipe vs 1F1B vs interleaved) against sequential stage
+folding, gradients, microbatch counts, and the per-tick schedule
+accounting the goodput ledger's ``pipeline_bubble`` bucket is built
+from."""
+
+import functools
 
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from paddle_tpu.parallel import pipeline
-from paddle_tpu.parallel.mesh import make_mesh
+from paddle_tpu.parallel.mesh import make_mesh, shard_map_norep
+from paddle_tpu.parallel.pipeline import SCHEDULES, schedule_stats
 
 
 def _stage_fn(params, x):
@@ -82,6 +89,180 @@ def test_pipeline_rejects_bad_axis_and_batch():
     with pytest.raises(ValueError, match="must divide"):
         pipeline(_stage_fn, (jnp.zeros((4, 2, 2)), jnp.zeros((4, 2))),
                  jnp.zeros((10, 2)), pp, microbatches=4)
+
+
+def _stage_arrays(s_total, d, seed=0):
+    rng = np.random.RandomState(seed)
+    w = jnp.asarray(rng.randn(s_total, d, d).astype("float32") * 0.3)
+    b = jnp.asarray(rng.randn(s_total, d).astype("float32") * 0.1)
+    return w, b
+
+
+@pytest.mark.parametrize("schedule,s_total,mesh_s,microbatches", [
+    ("1f1b", 4, 4, 8),
+    ("1f1b", 4, 4, 2),          # M < 2S-1: the stash-guard regime
+    ("interleaved", 8, 4, 8),   # v=2
+    ("interleaved", 8, 4, 4),   # v=2, one ring group
+])
+def test_schedule_matches_sequential(schedule, s_total, mesh_s,
+                                     microbatches):
+    """Schedule equivalence: every schedule computes the same function
+    as folding the stages sequentially."""
+    d, batch = 5, 16
+    w, b = _stage_arrays(s_total, d)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(batch, d).astype("float32"))
+    mesh = make_mesh((mesh_s,), ("pp",))
+    out = pipeline(_stage_fn, (w, b), x, mesh,
+                   microbatches=microbatches, schedule=schedule)
+    want = _sequential((np.asarray(w), np.asarray(b)), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+
+
+@pytest.mark.parametrize("schedule,s_total", [
+    ("1f1b", 4), ("interleaved", 8)])
+def test_schedule_gradients_match_sequential(schedule, s_total):
+    """The 1F1B custom-vjp (bounded stash + per-stage recompute) and
+    the interleaved loop's autodiff both reproduce sequential grads."""
+    d, batch, m = 4, 16, 8
+    w, b = _stage_arrays(s_total, d, seed=2)
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(batch, d).astype("float32"))
+    mesh = make_mesh((4,), ("pp",))
+
+    def piped_loss(w_, b_):
+        return jnp.sum(pipeline(_stage_fn, (w_, b_), x, mesh,
+                                microbatches=m, schedule=schedule) ** 2)
+
+    def seq_loss(w_, b_):
+        return jnp.sum(_sequential((w_, b_), x) ** 2)
+
+    gp = jax.grad(piped_loss, argnums=(0, 1))(w, b)
+    gs = jax.grad(seq_loss, argnums=(0, 1))(w, b)
+    for a, b_ in zip(gp, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4)
+
+
+def test_gpipe_matches_old_psum_lowering():
+    """Satellite: the slice-out single-source broadcast (plus the
+    dropped wrap edge and the skipped final-tick rotation) computes
+    BIT-identical outputs to the original masked-psum GPipe lowering,
+    inlined here as the reference."""
+    s, d, batch, m = 4, 5, 16, 8
+    w, b = _stage_arrays(s, d, seed=4)
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(batch, d).astype("float32"))
+    mesh = make_mesh((s,), ("pp",))
+
+    def old_shard(params, xx, axis_name):
+        n = lax.psum(1, axis_name)
+        stage = lax.axis_index(axis_name)
+        my = jax.tree_util.tree_map(lambda p: p[0], params)
+        mb = batch // m
+        x_mb = xx.reshape((m, mb) + xx.shape[1:])
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def tick(t, carry):
+            cur, outs = carry
+            cur = jnp.where(stage == 0, x_mb[jnp.clip(t, 0, m - 1)], cur)
+            out = _stage_fn(my, cur)
+            done = t - (n - 1)
+            take = (stage == n - 1) & (done >= 0) & (done < m)
+            upd = lax.dynamic_update_index_in_dim(
+                outs, out, jnp.clip(done, 0, m - 1), 0)
+            outs = jnp.where(take, upd, outs)
+            return lax.ppermute(out, axis_name, perm), outs
+
+        outs0 = jnp.zeros((m, mb) + xx.shape[1:], xx.dtype)
+        cur0 = jnp.zeros((mb,) + xx.shape[1:], xx.dtype)
+        _, outs = lax.fori_loop(0, m + n - 1, tick, (cur0, outs0))
+        mask = (stage == n - 1).astype(outs.dtype)
+        return lax.psum(outs * mask, axis_name).reshape(xx.shape)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    old_fn = shard_map_norep(
+        functools.partial(old_shard, axis_name="pp"), mesh,
+        in_specs=((P("pp"), P("pp")), P()), out_specs=P())
+    wj = jax.device_put(w, NamedSharding(mesh, P("pp")))
+    bj = jax.device_put(b, NamedSharding(mesh, P("pp")))
+    old = old_fn((wj, bj), x)
+    new = pipeline(_stage_fn, (w, b), x, mesh, microbatches=m)
+    np.testing.assert_array_equal(np.asarray(old), np.asarray(new))
+
+
+def test_schedule_stats_accounting():
+    """The per-tick stage-idle tables behind the pipeline_bubble
+    bucket: gpipe matches the closed form, interleaved shrinks the
+    fraction at equal (S, M), 1f1b's stash bound is M-independent."""
+    s, m = 4, 8
+    g = schedule_stats("gpipe", s, m)
+    assert g["bubble_fraction"] == pytest.approx(
+        (s - 1) / (m + s - 1))
+    i2 = schedule_stats("interleaved", s, m, virtual=2)
+    assert i2["bubble_fraction"] == pytest.approx(
+        (s - 1) / (2 * m + s - 1))
+    assert i2["bubble_fraction"] < g["bubble_fraction"]
+    f = schedule_stats("1f1b", s, m)
+    assert f["in_flight"] == min(m, 2 * s - 1)
+    assert schedule_stats("1f1b", s, 64)["in_flight"] == 2 * s - 1
+    assert schedule_stats("gpipe", s, 64)["in_flight"] == 64 + s - 1
+    assert f["remat_units"] == m
+    # None normalizes to the gpipe default; junk raises
+    assert schedule_stats(None, s, m)["schedule"] == "gpipe"
+    with pytest.raises(ValueError, match="unknown"):
+        schedule_stats("zigzag", s, m)
+    assert set(SCHEDULES) == {"gpipe", "1f1b", "interleaved"}
+
+
+def test_schedule_validation_errors():
+    mesh = make_mesh((4,), ("pp",))
+    w, b = _stage_arrays(6, 3)
+    with pytest.raises(ValueError, match="multiple"):
+        pipeline(_stage_fn, (w, b), jnp.zeros((8, 3)), mesh,
+                 microbatches=4, schedule="interleaved")
+    w8, b8 = _stage_arrays(8, 3)
+    with pytest.raises(ValueError, match="multiple"):
+        pipeline(_stage_fn, (w8, b8), jnp.zeros((12, 3)), mesh,
+                 microbatches=6, schedule="interleaved")
+    w4, b4 = _stage_arrays(4, 3)
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        pipeline(_stage_fn, (w4, b4), jnp.zeros((8, 3)), mesh,
+                 schedule="zigzag")
+
+
+@pytest.mark.slow
+def test_1f1b_backward_memory_m_independent():
+    """The 1F1B memory claim, measured on the compiled module: growing
+    M grows the GPipe backward's temp footprint (per-tick residual
+    stashes) while 1F1B's stays bounded (min(M, 2S-1) input-activation
+    slots + per-stage recompute)."""
+    s, d, batch_per_m = 4, 32, 4
+    w, b = _stage_arrays(s, d, seed=6)
+    mesh = make_mesh((4,), ("pp",))
+
+    def temp_bytes(schedule, m):
+        x = jnp.zeros((batch_per_m * m, d), jnp.float32)
+
+        def loss(w_, b_):
+            return jnp.sum(pipeline(_stage_fn, (w_, b_), x, mesh,
+                                    microbatches=m,
+                                    schedule=schedule) ** 2)
+
+        compiled = jax.jit(jax.grad(loss, argnums=(0, 1))).lower(w, b)\
+            .compile()
+        ma = compiled.memory_analysis()
+        return getattr(ma, "temp_size_in_bytes", None)
+
+    g4, g32 = temp_bytes("gpipe", 4), temp_bytes("gpipe", 32)
+    f4, f32 = temp_bytes("1f1b", 4), temp_bytes("1f1b", 32)
+    if None in (g4, g32, f4, f32):  # backend without memory analysis
+        pytest.skip("compiled memory_analysis unavailable")
+    # gpipe's backward temp grows with M; 1f1b's grows strictly slower
+    # (the stash is capped at 2S-1 slots; growth comes only from the
+    # M-sized in/out buffers both schedules share)
+    assert g32 > g4
+    assert (f32 - f4) < 0.5 * (g32 - g4), (f4, f32, g4, g32)
 
 
 def test_pipeline_bf16_activations_fp32_params():

@@ -214,6 +214,114 @@ def test_pipelined_matches_plain_transformer_no_dropout():
     np.testing.assert_allclose(plain, staged, rtol=2e-4, atol=2e-4)
 
 
+@_mesh_parity_drift
+@pytest.mark.parametrize("schedule", ["1f1b", "interleaved"])
+def test_pipelined_transformer_schedule_parity(schedule):
+    """Schedule equivalence through the program path: the REAL
+    transformer staged into pipeline regions trains under 1F1B and
+    interleaved schedules with the same loss trajectory as the
+    single-device sequential lowering (same stage template, same PRNG
+    folds — dropout ON).  Interleaved runs 4 program stages as v=2
+    chunks per device on pp=2."""
+    n_layer = 4 if schedule == "interleaved" else 2
+    batches = _batches()
+    # built at the right depth (interleaved needs stages % pp == 0
+    # with v > 1)
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_main_program().random_seed = 13
+        fluid.default_startup_program().random_seed = 13
+        from paddle_tpu.models import transformer as tfm
+        src = fluid.layers.data("src_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        tgt = fluid.layers.data("tgt_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        lbl = fluid.layers.data("lbl_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        loss, _ = tfm.transformer(src, tgt, lbl, 16, 16, 64, 64,
+                                  n_layer=n_layer, n_head=2, d_model=16,
+                                  d_inner=32, dropout_rate=0.1,
+                                  pipeline_microbatches=2)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+        single = _run_single(batches, loss)
+
+        mesh = make_mesh((1, 2), ("dp", "pp"))
+        bs = fluid.BuildStrategy()
+        bs.pipeline_schedule = schedule
+        with fluid.scope_guard(fluid.Scope()):
+            par = _run_parallel(batches, loss, mesh, build_strategy=bs)
+        np.testing.assert_allclose(single, par, rtol=2e-4, atol=2e-4)
+        assert par[-1] < par[0]
+
+
+@_mesh_parity_drift
+def test_pipeline_bubble_attributed_and_smaller_interleaved():
+    """The goodput ledger's pipeline_bubble bucket: warm pipelined
+    steps carve out the executed schedule's exact per-tick stage-idle
+    fraction, and the measured interleaved fraction is SMALLER than
+    gpipe's at equal (S, M) — the ISSUE 12 acceptance, from the
+    artifact, not the formula."""
+    from paddle_tpu import monitor
+
+    batches = _batches()
+    fractions, losses = {}, {}
+    mesh = make_mesh((1, 2), ("dp", "pp"))
+    monitor.enable()
+    try:
+        # EQUAL (S, M): the same 4-layer model, M=2 microbatches, on
+        # the same pp=2 mesh — gpipe runs it as 2 fat stages (2 layers
+        # each), interleaved as 4 thin stages = v=2 chunks per device
+        for schedule, lps in (("gpipe", 2), ("interleaved", 1)):
+            with fluid.program_guard(fluid.Program(), fluid.Program()):
+                fluid.default_main_program().random_seed = 13
+                fluid.default_startup_program().random_seed = 13
+                from paddle_tpu.models import transformer as tfm
+                src = fluid.layers.data("src_word", shape=[1],
+                                        dtype="int64", lod_level=1)
+                tgt = fluid.layers.data("tgt_word", shape=[1],
+                                        dtype="int64", lod_level=1)
+                lbl = fluid.layers.data("lbl_word", shape=[1],
+                                        dtype="int64", lod_level=1)
+                loss, _ = tfm.transformer(
+                    src, tgt, lbl, 16, 16, 64, 64, n_layer=4, n_head=2,
+                    d_model=16, d_inner=32, dropout_rate=0.0,
+                    pipeline_microbatches=2,
+                    pipeline_layers_per_stage=lps)
+                fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+                bs = fluid.BuildStrategy()
+                bs.pipeline_schedule = schedule
+                with fluid.scope_guard(fluid.Scope()):
+                    fluid.Executor(fluid.CPUPlace()).run(
+                        fluid.default_startup_program())
+                    pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                                mesh=mesh,
+                                                build_strategy=bs)
+                    # warm the trace first, then open a fresh
+                    # attribution window: the cold step's compile
+                    # residual is not pipelined time
+                    pe.run(feed=batches[0], fetch_list=[loss])
+                    monitor.goodput_reset()
+                    losses[schedule] = [
+                        float(np.asarray(pe.run(feed=b,
+                                                fetch_list=[loss])[0])
+                              .ravel()[0]) for b in batches]
+                summ = monitor.goodput_summary()
+                assert summ["buckets"]["pipeline_bubble"] > 0, summ
+                # normalize against the warm step path only: the cold
+                # step's compile wall would swamp the fraction
+                warm = summ["buckets"]["pipeline_bubble"] + \
+                    summ["buckets"]["compute"]
+                fractions[schedule] = \
+                    summ["buckets"]["pipeline_bubble"] / warm
+    finally:
+        monitor.disable()
+    # same trajectory (schedules/stagings are layout, not math:
+    # dropout off makes the two stagings' PRNG structure irrelevant)...
+    np.testing.assert_allclose(losses["gpipe"], losses["interleaved"],
+                               rtol=2e-4, atol=2e-4)
+    # ...but interleaved measurably wastes less of the step
+    assert fractions["interleaved"] < fractions["gpipe"], fractions
+
+
 def test_pipeline_rejects_structurally_different_stages():
     """Stages differing in op attrs (not just types) must be rejected —
     the template lowering would silently run stage 0's math otherwise."""

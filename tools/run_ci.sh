@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/11 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/12 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/11 API signature gate =="
+echo "== 2/12 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/11 8-device virtual-mesh dryrun =="
+echo "== 3/12 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/11 bench smoke (CPU backend, tiny) =="
+echo "== 4/12 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/11 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/12 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/11 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/12 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/11 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/12 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -170,7 +170,7 @@ PY
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
-echo "== 8/11 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+echo "== 8/12 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
 GUARD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
 # the drill is installed purely from the environment (FLAGS_fault_spec)
@@ -227,7 +227,7 @@ PY
 grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
 grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
 
-echo "== 9/11 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
+echo "== 9/12 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
 TUNE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
@@ -323,7 +323,7 @@ print("AUTOTUNE TRAINER FINAL %.6f over %d steps"
       % (losses[-1], len(losses)), flush=True)
 PY
 
-echo "== 10/11 goodput smoke + bench-history regression gate =="
+echo "== 10/12 goodput smoke + bench-history regression gate =="
 GOOD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR"' EXIT
 # (a) a 3-step monitored MLP run -> the goodput ledger attributes its
@@ -383,7 +383,7 @@ assert any(c["field"] == "min_step_s" and c["verdict"] == "REGRESSED"
 print("bench_history: +20% perturbation flagged REGRESSED")
 PY
 
-echo "== 11/11 serving smoke (engine over toy MLP, concurrent requests) =="
+echo "== 11/12 serving smoke (engine over toy MLP, concurrent requests) =="
 SERVE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'PY'
@@ -437,5 +437,80 @@ monitor.disable()
 PY
 # per-request serving/* events landed in the JSONL, run_id-correlated
 grep -ql serving_request "$SERVE_DIR"/monitor/*.jsonl
+
+echo "== 12/12 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
+echo "==       loss parity vs GPipe + measured pipeline_bubble drop)        =="
+PIPE_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR"' EXIT
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+  python - "$PIPE_DIR" <<'PY'
+import os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.models import transformer as tfm
+from paddle_tpu.parallel import make_mesh
+
+out = sys.argv[1]
+monitor.enable(log_dir=out)
+mesh = make_mesh((1, 2), ("dp", "pp"))
+rng = np.random.RandomState(3)
+batches = []
+for _ in range(3):
+    ids = rng.randint(2, 32, (8, 8, 1)).astype("int64")
+    lens = rng.randint(4, 9, (8,)).astype("int32")
+    batches.append({"src_word": ids, "src_word@LEN": lens,
+                    "tgt_word": ids, "tgt_word@LEN": lens,
+                    "lbl_word": ids, "lbl_word@LEN": lens})
+losses, fractions = {}, {}
+# EQUAL (S=2, M=2): the same 4-layer model — gpipe/1f1b run it as 2 fat
+# stages, interleaved as 4 thin stages (v=2 chunks per device)
+for sched, lps in (("gpipe", 2), ("1f1b", 2), ("interleaved", 1)):
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_main_program().random_seed = 13
+        fluid.default_startup_program().random_seed = 13
+        src = fluid.layers.data("src_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        tgt = fluid.layers.data("tgt_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        lbl = fluid.layers.data("lbl_word", shape=[1], dtype="int64",
+                                lod_level=1)
+        loss, _ = tfm.transformer(src, tgt, lbl, 8, 8, 32, 32,
+                                  n_layer=4, n_head=2, d_model=16,
+                                  d_inner=32, dropout_rate=0.0,
+                                  pipeline_microbatches=2,
+                                  pipeline_layers_per_stage=lps)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+        bs = fluid.BuildStrategy()
+        bs.pipeline_schedule = sched
+        with fluid.scope_guard(fluid.Scope()):
+            fluid.Executor(fluid.CPUPlace()).run(
+                fluid.default_startup_program())
+            pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                        build_strategy=bs)
+            pe.run(feed=batches[0], fetch_list=[loss])      # warm
+            monitor.goodput_reset()
+            losses[sched] = [
+                float(np.asarray(pe.run(feed=b, fetch_list=[loss])[0])
+                      .ravel()[0]) for b in batches]
+        stamp = monitor.goodput_stamp()
+        assert stamp["buckets"]["pipeline_bubble"] > 0, stamp
+        warm = stamp["buckets"]["pipeline_bubble"] + \
+            stamp["buckets"]["compute"]
+        fractions[sched] = stamp["buckets"]["pipeline_bubble"] / warm
+np.testing.assert_allclose(losses["gpipe"], losses["1f1b"],
+                           rtol=2e-4, atol=2e-4)
+np.testing.assert_allclose(losses["gpipe"], losses["interleaved"],
+                           rtol=2e-4, atol=2e-4)
+assert fractions["interleaved"] < fractions["gpipe"], fractions
+print("PIPELINE schedules loss parity OK; measured bubble fractions: "
+      "gpipe=%.3f 1f1b=%.3f interleaved=%.3f"
+      % (fractions["gpipe"], fractions["1f1b"],
+         fractions["interleaved"]), flush=True)
+monitor.disable()
+PY
+# the pipeline_bubble bucket landed in the goodput JSONL stamps
+grep -ql pipeline_bubble "$PIPE_DIR"/*.jsonl
 
 echo "CI OK"

@@ -460,6 +460,98 @@ def bench_fault_drill(args):
                     or drill_interval < tuned["chosen"]))}
 
 
+def bench_ckpt_sharded(args):
+    """Per-host sharded checkpoint IO rung (ISSUE 13): capture a real
+    TrainState (~50MB of fc params + Adam slots) and write it as a
+    per-host sharded artifact with N = 1/2/4 virtual hosts, timing each
+    host's own shard write.  Evidence for the orbax-OCDBT-style scaling
+    claim: per-host bytes written are 1/N of the state, so the per-host
+    write RATE (MB/s) stays flat (±IO noise) as the mesh grows — i.e.
+    checkpoint cost at constant per-host state is independent of host
+    count.  ``save_wall_s`` (the N=4 per-host wall, lower is better) is
+    indexed by tools/bench_history.py; informational, never a gate
+    (disk-bound, not chip-bound).  The N=4 artifact is re-loaded and
+    verified bit-identical against the capture."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel.checkpoint import (
+        capture_train_state, commit_sharded_train_state,
+        load_train_state, partition_shards, write_train_state_shards)
+
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.layers.data("x", shape=[1024])
+    h = fluid.layers.fc(x, size=2048, act="relu")
+    h = fluid.layers.fc(h, size=1024, act="relu")
+    loss = fluid.layers.mean(fluid.layers.fc(h, size=16))
+    fluid.optimizer.Adam(1e-3).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(_place(args))
+        exe.run(fluid.default_startup_program())
+        exe.run(feed={"x": np.random.RandomState(0).rand(
+            8, 1024).astype("float32")}, fetch_list=[loss])
+        ts = capture_train_state(1, scope=scope, executors=exe,
+                                 sharded=True)
+    total_bytes = sum(e["data"].nbytes for e in ts.shards)
+
+    workdir = tempfile.mkdtemp(prefix="bench_ckpt_")
+    per_host = {}
+    try:
+        for n in (1, 2, 4):
+            ck = os.path.join(workdir, "w%d" % n, "step_0000000001")
+            os.makedirs(os.path.dirname(ck))
+            parts = partition_shards(ts, n)
+            walls, bytes_by_writer = [], []
+            for w, entries in enumerate(parts):
+                t0 = time.monotonic()
+                b = write_train_state_shards(ck, ts, w, entries=entries)
+                walls.append(time.monotonic() - t0)
+                bytes_by_writer.append(b)
+            t0 = time.monotonic()
+            commit_sharded_train_state(ck, ts, n)
+            commit_s = time.monotonic() - t0
+            wall = max(walls)     # the parallel-hosts wall-clock analog
+            per_host[str(n)] = {
+                "wall_s": round(wall, 4),
+                "commit_s": round(commit_s, 4),
+                "bytes_max": max(bytes_by_writer),
+                "mb_per_s": round(max(bytes_by_writer) / wall / 2**20,
+                                  1) if wall > 0 else None,
+            }
+        # single-host restore of the sharded artifact round-trips
+        # bit-identically (the elastic-resume precondition)
+        loaded = load_train_state(
+            os.path.join(workdir, "w4", "step_0000000001"))
+        roundtrip_ok = all(
+            np.array_equal(loaded.arrays[e["name"]][tuple(
+                slice(a, b) for a, b in e["index"])], e["data"])
+            for e in ts.shards)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    rates = [p["mb_per_s"] for p in per_host.values() if p["mb_per_s"]]
+    # value is HIGHER-is-better across the whole artifact schema, so
+    # the rung's value is the per-host write RATE; the wall clock rides
+    # in save_wall_s (judged lower-is-better by bench_history)
+    return {"metric": "ckpt_sharded_per_host_save",
+            "value": per_host["4"]["mb_per_s"], "unit": "mb_per_s",
+            "vs_baseline": 0.0, "informational": True,
+            "save_wall_s": per_host["4"]["wall_s"],
+            "state_bytes": total_bytes,
+            "per_host": per_host,
+            # flatness evidence: per-host write rate spread across
+            # 1/2/4 virtual hosts (1.0 = perfectly flat cost at
+            # constant per-host state)
+            "mb_per_s_spread": round(max(rates) / min(rates), 3)
+            if rates else None,
+            "bytes_one_over_n": {
+                n: round(per_host[n]["bytes_max"] / total_bytes, 3)
+                for n in per_host},
+            "roundtrip_bit_identical": bool(roundtrip_ok)}
+
+
 def bench_serving(args):
     """Serving rung (ISSUE 11): throughput-vs-latency curve for the
     continuous-batching engine against the bs=16 sequential-dispatch
@@ -1590,7 +1682,7 @@ def main():
                             "se_resnext", "stacked_lstm",
                             "machine_translation", "alexnet", "googlenet",
                             "smallnet", "reader_capacity", "fault_drill",
-                            "serving"])
+                            "serving", "ckpt_sharded"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -1768,6 +1860,10 @@ def main():
             # vs-latency curve against the bs=16 sequential-dispatch
             # baseline; informational while the rung accumulates history
             ("serving", [], True, 300),
+            # per-host sharded checkpoint IO (ISSUE 13): 1/2/4 virtual
+            # hosts each write 1/N of a real TrainState; per-host save
+            # wall + MB/s flatness; disk-bound -> informational
+            ("ckpt_sharded", [], True, 300),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -1961,6 +2057,8 @@ def main():
         result = bench_fault_drill(args)
     elif args.model == "serving":
         result = bench_serving(args)
+    elif args.model == "ckpt_sharded":
+        result = bench_ckpt_sharded(args)
     elif args.model == "transformer_realdist":
         result = bench_transformer_realdist(args,
                                             use_amp=not args.fp32_only)

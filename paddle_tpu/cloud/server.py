@@ -24,7 +24,7 @@ import time
 from .master import (AllTasksFailed, NoMoreAvailable, PassAfter,
                      PassBefore, Task)
 
-__all__ = ["MasterServer", "MasterClient"]
+__all__ = ["MasterServer", "MasterClient", "service_methods"]
 
 _ERRORS = {
     "PassBefore": PassBefore,
@@ -34,9 +34,34 @@ _ERRORS = {
 }
 
 
+# the MasterService surface (the pre-cluster hardcoded dispatch set);
+# services exposing ``rpc_methods()`` override it — the ClusterMaster
+# rides the same server/handler by listing its own methods
+_DEFAULT_METHODS = ("get_task", "task_finished", "task_failed",
+                    "request_save_model", "set_dataset", "stats")
+
+
+def service_methods(svc):
+    """{name: bound method} the server is allowed to dispatch: the
+    service's own ``rpc_methods()`` list when it has one, else the
+    MasterService default set.  An explicit allowlist — a generic
+    getattr dispatch would export every public method of whatever
+    object the server wraps."""
+    lister = getattr(svc, "rpc_methods", None)
+    names = tuple(lister()) if callable(lister) else _DEFAULT_METHODS
+    return {n: getattr(svc, n) for n in names}
+
+
+def _jsonable(result):
+    """Marshal a service return value: objects exposing ``to_dict``
+    (Task, cluster records) flatten; JSON-native values pass through."""
+    to_dict = getattr(result, "to_dict", None)
+    return to_dict() if callable(to_dict) else result
+
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
-        svc = self.server.service
+        methods = self.server.methods
         while True:
             line = self.rfile.readline()
             if not line:
@@ -45,25 +70,11 @@ class _Handler(socketserver.StreamRequestHandler):
                 req = json.loads(line.decode("utf-8"))
                 method = req["method"]
                 args = req.get("args", [])
-                if method == "get_task":
-                    t = svc.get_task(*args)
-                    resp = {"ok": True, "result": t.to_dict()}
-                elif method == "task_finished":
-                    svc.task_finished(*args)
-                    resp = {"ok": True, "result": None}
-                elif method == "task_failed":
-                    svc.task_failed(*args)
-                    resp = {"ok": True, "result": None}
-                elif method == "request_save_model":
-                    resp = {"ok": True,
-                            "result": svc.request_save_model(*args)}
-                elif method == "set_dataset":
-                    svc.set_dataset(*args)
-                    resp = {"ok": True, "result": None}
-                elif method == "stats":
-                    resp = {"ok": True, "result": svc.stats()}
-                elif method == "ping":
+                if method == "ping":
                     resp = {"ok": True, "result": "pong"}
+                elif method in methods:
+                    resp = {"ok": True,
+                            "result": _jsonable(methods[method](*args))}
                 else:
                     resp = {"ok": False, "error": "Unknown",
                             "message": f"no method {method!r}"}
@@ -89,6 +100,7 @@ class MasterServer:
         self.service = service
         self._srv = _TCPServer((host, port), _Handler)
         self._srv.service = service
+        self._srv.methods = service_methods(service)
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
 
@@ -185,6 +197,12 @@ class MasterClient:
                 "endpoint or raise max_retries" %
                 (self._addr[0], self._addr[1], self._max_retries, slept,
                  last_err))
+
+    def call(self, method, *args):
+        """Generic RPC (the cluster runtime's transport hook): invokes
+        any method the served service allowlists via ``rpc_methods()``,
+        with the same reconnect/backoff behavior as the typed calls."""
+        return self._call(method, *args)
 
     def get_task(self, pass_id=None):
         return Task.from_dict(self._call("get_task", pass_id))

@@ -12,7 +12,7 @@ import os
 import tempfile
 import threading
 
-__all__ = ["InMemStore", "FileStore"]
+__all__ = ["InMemStore", "FileStore", "fsync_dir"]
 
 
 class InMemStore:
@@ -32,6 +32,26 @@ class InMemStore:
 
     def shutdown(self):
         pass
+
+
+def fsync_dir(path):
+    """Flush the directory entry itself: an atomic rename is only
+    durable once the DIRECTORY that holds it is synced.  The one shared
+    commit-idiom helper (``parallel/checkpoint.py`` aliases it — this
+    module is the dependency-light home; checkpoint importing cloud
+    keeps the layering, cloud importing the jax-heavy checkpoint module
+    would not)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:       # platforms without directory fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+_fsync_dir = fsync_dir      # module-internal spelling
 
 
 class FileStore:
@@ -54,7 +74,16 @@ class FileStore:
             try:
                 with os.fdopen(fd, "wb") as f:
                     f.write(data)
+                    f.flush()
+                    # fsync the payload BEFORE the rename and the
+                    # directory AFTER it: os.replace alone is atomic
+                    # against concurrent readers but not against power
+                    # loss — an unsynced rename can commit a torn
+                    # snapshot, which a recovering master would then
+                    # trust as the run's task-lease state
+                    os.fsync(f.fileno())
                 os.replace(tmp, self.path)
+                _fsync_dir(d)
             finally:
                 if os.path.exists(tmp):
                     os.unlink(tmp)

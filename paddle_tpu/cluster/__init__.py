@@ -1,0 +1,45 @@
+"""Cluster runtime (ROADMAP item 3): the control-plane layer over the
+multi-host mesh — run membership, cluster-scope guardian verdicts, and
+elastic resume — the TPU rebuild of the reference's Go/etcd ``go/master``
+control plane (task leases live in ``paddle_tpu.cloud``; this package
+adds the layer that knows WHO is in the run).
+
+* ``ClusterMaster`` (``membership.py``) — per-host heartbeat leases
+  with membership **epochs**, verdict arbitration (one host's guardian
+  escalation becomes ONE cluster-wide rollback/abort command), saver
+  election for sharded-checkpoint manifest commits, and the lockstep
+  step barrier that turns a host death into a ``reshape`` decision
+  instead of a hung collective.  Snapshots ride any ``cloud.store``
+  Store and are served by the unmodified ``cloud.MasterServer``.
+* ``ClusterMember`` (``runtime.py``) — a host's live session: join,
+  heartbeat thread, ``enter_step`` barrier, verdict propose/ack, saver
+  election; registers the process-local identity that guardian events
+  and watchdog stall escalations stamp into JSONL records.
+* ``ClusterGuardian`` (``guardian_bridge.py``) — a ``Guardian`` whose
+  rollback/abort verdicts are arbitrated by the master and whose
+  ``note_step`` applies remote members' verdicts at the next step
+  boundary.
+
+Elastic resume is the composition: TrainState artifacts are
+topology-free (PR 5) and sharded per host (this PR), so when the epoch
+moves the survivors rebuild the mesh at the new size, restore the last
+committed step through ``ParallelExecutor.state_shardings()``, and
+continue — ``tests/cluster_runner.py`` is the reference harness.
+"""
+
+from .membership import ClusterMaster, Member  # noqa: F401
+from .runtime import (  # noqa: F401
+    ClusterMember,
+    ClusterTimeout,
+    local_member,
+    local_context,
+    set_local_member,
+)
+from .guardian_bridge import ClusterGuardian  # noqa: F401
+
+__all__ = [
+    "ClusterMaster", "Member",
+    "ClusterMember", "ClusterTimeout",
+    "local_member", "local_context", "set_local_member",
+    "ClusterGuardian",
+]

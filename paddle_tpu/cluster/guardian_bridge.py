@@ -1,0 +1,92 @@
+"""ClusterGuardian: guardian escalations arbitrated cluster-wide.
+
+The per-process ``guardian.Guardian`` decides alone — correct for one
+host, wrong for a mesh: if host 3's spike detector fires and rolls back
+while hosts 0-2 keep training, the run is corrupt (the PR-6 follow-up).
+The ClusterGuardian closes that hole:
+
+* a LOCAL escalation first proposes the verdict to the ClusterMaster;
+  the master's arbitration (first proposal wins) returns THE cluster
+  command — possibly another host's earlier verdict — and the ladder
+  raises that command, not the local opinion;
+* every ``note_step`` polls the master (every ``poll_every`` steps) so
+  a REMOTE host's verdict reaches this member's training loop at its
+  next step boundary as the same ``GuardianRollback``/abort the origin
+  raised — all members recover to the same committed checkpoint;
+* commands are acked after being raised, so the master retires them
+  once every live member applied the decision.
+
+The in-graph NaN/Inf skip needs no arbitration: the verdict is computed
+on-device inside the SPMD program, so every host already skips the same
+update deterministically.  Only host-side decisions (rollback ladders,
+stall aborts) go through the master.
+"""
+
+from .. import guardian as _g
+
+__all__ = ["ClusterGuardian"]
+
+
+class ClusterGuardian(_g.Guardian):
+    """A ``Guardian`` whose rollback/abort verdicts are cluster
+    commands.  ``member`` is the host's ``ClusterMember``;
+    ``poll_every`` sets how many completed steps may pass between
+    remote-verdict polls (1 = every step; the poll is one tiny
+    control-plane RPC, never a collective)."""
+
+    def __init__(self, member, poll_every=1, **kwargs):
+        super().__init__(**kwargs)
+        self._member = member
+        self._poll_every = max(1, int(poll_every))
+        self._steps_since_poll = 0
+
+    @property
+    def member(self):
+        return self._member
+
+    # -- remote verdicts ------------------------------------------------
+    def note_step(self, executor_name, step, **kwargs):
+        if self._member.expelled:
+            # the master expired this host's lease: the cluster has
+            # already reshaped without it, so training on would commit
+            # zombie updates — a typed exit, not a silent divergence
+            raise _g.GuardianAbortError(
+                "guardian: member %r was expelled from the cluster "
+                "(lease expired; membership moved on) — aborting this "
+                "host instead of training as a zombie"
+                % self._member.host_id)
+        self._steps_since_poll += 1
+        if self._steps_since_poll >= self._poll_every:
+            self._steps_since_poll = 0
+            cmd = self._member.poll_command()
+            if cmd is not None:
+                self.apply_command(cmd)
+        super().note_step(executor_name, step, **kwargs)
+
+    def apply_command(self, cmd):
+        """Raise the cluster command through the local ladder (acking it
+        first — the raise IS this member applying the decision).  Also
+        the entry point for commands delivered by the step barrier
+        (``enter_step`` -> ``{"action": "command"}``)."""
+        self._member.ack_command(cmd["seq"])
+        self._event({"event": "guardian_cluster_command",
+                     "seq": cmd["seq"], "kind": cmd["kind"],
+                     "step": cmd["step"], "origin": cmd["origin"],
+                     "reason": cmd["reason"]})
+        reason = "cluster[%s]: %s" % (cmd["origin"], cmd["reason"])
+        if cmd["kind"] == "rollback":
+            raise _g.GuardianRollback(cmd["step"], reason,
+                                      quarantined=cmd.get("quarantined",
+                                                          False))
+        raise _g.GuardianAbortError(
+            "guardian: cluster abort at step %d (%s)"
+            % (cmd["step"], reason))
+
+    # -- local escalations route through the master ---------------------
+    def _escalate(self, step, reason, quarantined):
+        kind = "rollback" if "rollback" in self.policy else "abort"
+        cmd = self._member.propose_verdict(step, kind, reason,
+                                           quarantined=quarantined)
+        # the master may hand back ANOTHER host's earlier verdict for
+        # this incident — the cluster decision wins over the local one
+        self.apply_command(cmd)

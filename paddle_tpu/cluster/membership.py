@@ -1,0 +1,415 @@
+"""ClusterMaster: run membership, cluster-scope verdicts, and saver
+election — the control-plane half of the reference's Go/etcd cloud
+layer (ROADMAP item 3).
+
+The data-plane half already exists (``cloud.MasterService`` task
+leases); what was missing is the layer that knows WHO is in the run and
+arbitrates decisions that must win cluster-wide:
+
+* **membership** — each host joins with a heartbeat lease; a lease that
+  expires removes the member and bumps the **membership epoch**.  The
+  epoch is the elastic-resume trigger: survivors that observe an epoch
+  change rebuild the mesh at the new size and resume from the last
+  committed checkpoint (``cluster.runtime`` / the drill harness).
+  Deadlines live in the snapshotted state and are enforced lazily under
+  the lock (``_sweep``), exactly like ``MasterService._expire_stale`` —
+  a recovered master (new process, same Store) keeps honoring the leases
+  the dead one granted.
+* **verdict arbitration** — one host's guardian escalation
+  (NaN/stall -> rollback/abort) becomes ONE cluster-wide command: the
+  first proposal wins and every later proposal (or poll) returns the
+  same command, so all members apply the same decision at the same
+  committed-step boundary instead of each process deciding alone (the
+  PR-6 follow-up).  Commands retire when every live member acked.
+* **saver election** — ``request_save(host, step)`` elects exactly one
+  committer per checkpoint step (the ``RequestSaveModel`` idiom),
+  gating the manifest commit of a multi-host sharded artifact.
+* **step barrier** — ``enter_step(host, step, epoch)`` is the dispatch
+  gate for lockstep SPMD members: "go" only once every live member
+  reached the step, "reshape" when the membership epoch moved while
+  waiting, "command" when an arbitration verdict is pending.  The
+  barrier is what keeps a survivor from dispatching a collective into a
+  dead peer: the death is observed as a lease expiry at the barrier,
+  never as a hung all-reduce.
+
+State snapshots ride any ``cloud.store`` Store (InMemStore, FileStore —
+the etcd analog); the service is served by the unmodified
+``cloud.MasterServer`` via its ``rpc_methods()`` allowlist.
+"""
+
+import json
+import threading
+import time
+
+__all__ = ["ClusterMaster", "Member"]
+
+
+class Member:
+    """One host's membership record: lease deadline + step progress."""
+
+    __slots__ = ("host_id", "deadline", "joined_epoch", "last_step",
+                 "meta")
+
+    def __init__(self, host_id, deadline, joined_epoch=0, last_step=-1,
+                 meta=None):
+        self.host_id = str(host_id)
+        self.deadline = float(deadline)
+        self.joined_epoch = int(joined_epoch)
+        self.last_step = int(last_step)
+        self.meta = dict(meta or {})
+
+    def to_dict(self):
+        return {"host_id": self.host_id, "deadline": self.deadline,
+                "joined_epoch": self.joined_epoch,
+                "last_step": self.last_step, "meta": self.meta}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(d["host_id"], d["deadline"], d["joined_epoch"],
+                   d["last_step"], d.get("meta"))
+
+    def __repr__(self):
+        return ("Member(%s, step=%d, epoch=%d)"
+                % (self.host_id, self.last_step, self.joined_epoch))
+
+
+class ClusterMaster:
+    """Single-coordinator membership + arbitration service.
+
+    ``lease_timeout`` bounds how long a silent host stays a member;
+    heartbeats (and ``enter_step`` calls, which imply liveness) renew
+    it.  The ``clock`` must be WALL time — deadlines are persisted in
+    the snapshot and must stay comparable after a master restart."""
+
+    def __init__(self, store=None, lease_timeout=10.0, clock=time.time,
+                 save_block_secs=300.0):
+        from ..cloud.store import InMemStore
+
+        self.store = store or InMemStore()
+        self.lease_timeout = float(lease_timeout)
+        self.save_block_secs = float(save_block_secs)
+        self._clock = clock
+        self._mu = threading.RLock()
+
+        self._members = {}         # host_id -> Member
+        self._epoch = 0            # bumps on ANY membership change
+        self._command = None       # active arbitration command (dict)
+        self._command_seq = 0      # last issued command sequence number
+        self._acks = set()         # host_ids that acked the active cmd
+        self._savers = {}          # step -> {"host_id", "until"}
+        self._last_snap = -1e18    # clock of the last persisted snapshot
+
+        snap = self.store.load()
+        if snap:
+            self._restore(snap)
+
+    # -- the server-side allowlist (cloud.server.service_methods) ------
+    @staticmethod
+    def rpc_methods():
+        return ("join", "heartbeat", "leave", "membership", "enter_step",
+                "propose_verdict", "poll_command", "ack_command",
+                "request_save", "stats")
+
+    # -- snapshot / recover --------------------------------------------
+    def _snapshot(self, material=False):
+        """Persist state to the Store.  ``material`` changes
+        (membership/epoch/command/saver) always persist; pure deadline
+        RENEWALS (every heartbeat and barrier poll is one) are
+        rate-limited to once per lease_timeout/4 — with a FileStore
+        that is otherwise two fsyncs per poll per member under the
+        service lock, and recovery only needs deadlines fresh to well
+        within one heartbeat interval (members renew every
+        lease_timeout/3)."""
+        now = self._clock()
+        if not material and now - self._last_snap \
+                < self.lease_timeout / 4.0:
+            return
+        self._last_snap = now
+        state = {
+            "members": {h: m.to_dict() for h, m in self._members.items()},
+            "epoch": self._epoch,
+            "command": self._command,
+            "command_seq": self._command_seq,
+            "acks": sorted(self._acks),
+            "savers": {str(s): dict(e)
+                       for s, e in self._savers.items()},
+        }
+        self.store.save(json.dumps(state).encode("utf-8"))
+
+    def _restore(self, blob):
+        state = json.loads(blob.decode("utf-8"))
+        self._members = {h: Member.from_dict(d)
+                         for h, d in state["members"].items()}
+        self._epoch = int(state["epoch"])
+        self._command = state.get("command")
+        self._command_seq = int(state.get("command_seq", 0))
+        self._acks = set(state.get("acks", ()))
+        self._savers = {int(s): dict(e) for s, e in
+                        state.get("savers", {}).items()}
+
+    # -- membership -----------------------------------------------------
+    def _sweep(self):
+        """Expire members whose lease deadline passed.  Must hold the
+        lock.  Returns True when the sweep changed membership (the
+        epoch bumped)."""
+        now = self._clock()
+        dead = [h for h, m in self._members.items() if m.deadline <= now]
+        for h in dead:
+            del self._members[h]
+        if dead:
+            self._epoch += 1
+            self._drop_member_state(dead)
+            self._count("cluster/lease_expired", len(dead))
+            self._event({"event": "cluster_member_expired",
+                         "members": dead, "epoch": self._epoch})
+            self._snapshot(material=True)
+        return bool(dead)
+
+    def _drop_member_state(self, gone):
+        """Release per-member side state held by departed hosts (lock
+        held): a saver election pinned by a dead member would otherwise
+        block EVERY survivor's commit for the whole block window — the
+        step's checkpoint would silently never commit; and a command
+        missing only dead members' acks must retire."""
+        self._savers = {s: e for s, e in self._savers.items()
+                        if e["host_id"] not in gone}
+        self._retire_if_acked()
+
+    def _view(self):
+        """The membership view members act on (lock held)."""
+        return {"epoch": self._epoch,
+                "members": sorted(self._members),
+                "lease_timeout": self.lease_timeout,
+                "command_seq": self._command_seq}
+
+    def join(self, host_id, meta=None):
+        """Register (or re-register) ``host_id``; a NEW member bumps the
+        membership epoch.  Returns the membership view."""
+        host_id = str(host_id)
+        if not host_id:
+            raise ValueError("host id is empty")
+        with self._mu:
+            self._sweep()
+            fresh = host_id not in self._members
+            if fresh:
+                self._epoch += 1
+            self._members[host_id] = Member(
+                host_id, self._clock() + self.lease_timeout,
+                joined_epoch=self._epoch, meta=meta)
+            if fresh:
+                self._event({"event": "cluster_member_joined",
+                             "member_id": host_id, "epoch": self._epoch})
+            self._snapshot(material=fresh)
+            return self._view()
+
+    def heartbeat(self, host_id, step=None):
+        """Renew ``host_id``'s lease.  An expired (unknown) member gets
+        ``{"rejoin": True}`` — its lease died, it must ``join`` again
+        and treat the run as a fresh epoch."""
+        host_id = str(host_id)
+        with self._mu:
+            self._sweep()
+            m = self._members.get(host_id)
+            if m is None:
+                return dict(self._view(), rejoin=True)
+            m.deadline = self._clock() + self.lease_timeout
+            if step is not None:
+                m.last_step = max(m.last_step, int(step))
+            self._snapshot()
+            return self._view()
+
+    def leave(self, host_id):
+        """Graceful departure: removes the member, bumps the epoch."""
+        with self._mu:
+            self._sweep()
+            if self._members.pop(str(host_id), None) is not None:
+                self._epoch += 1
+                self._drop_member_state([str(host_id)])
+                self._event({"event": "cluster_member_left",
+                             "member_id": str(host_id),
+                             "epoch": self._epoch})
+                self._snapshot(material=True)
+            return self._view()
+
+    def membership(self):
+        with self._mu:
+            self._sweep()
+            return {"epoch": self._epoch,
+                    "members": {h: m.to_dict()
+                                for h, m in self._members.items()}}
+
+    # -- step barrier ---------------------------------------------------
+    def enter_step(self, host_id, step, epoch):
+        """The lockstep dispatch gate.  ``epoch`` is the caller's known
+        membership epoch.  Returns one of:
+
+        * ``{"action": "reshape", ...view}`` — membership changed since
+          the caller's epoch: rebuild the mesh before dispatching;
+        * ``{"action": "command", "command": {...}}`` — an arbitration
+          verdict is pending that this member has not acked: apply it
+          at this boundary;
+        * ``{"action": "go"}`` — every live member reached ``step``;
+        * ``{"action": "wait"}`` — peers are still behind: poll again.
+
+        Entering a step renews the lease (progress is liveness)."""
+        host_id = str(host_id)
+        step = int(step)
+        with self._mu:
+            self._sweep()
+            m = self._members.get(host_id)
+            if m is None:
+                return dict(self._view(), action="reshape", rejoin=True)
+            m.deadline = self._clock() + self.lease_timeout
+            m.last_step = max(m.last_step, step)
+            self._snapshot()
+            if int(epoch) != self._epoch:
+                return dict(self._view(), action="reshape")
+            cmd = self._command
+            if cmd is not None and host_id not in self._acks:
+                return {"action": "command", "command": dict(cmd)}
+            if all(p.last_step >= step for p in self._members.values()):
+                return {"action": "go"}
+            return {"action": "wait"}
+
+    # -- verdict arbitration --------------------------------------------
+    def propose_verdict(self, host_id, step, kind, reason,
+                        quarantined=False):
+        """One host's guardian escalation.  The FIRST proposal while no
+        command is active wins and becomes the cluster command; any
+        later proposal returns the active command unchanged — so every
+        member, including late proposers, applies ONE decision.  The
+        proposer is auto-acked (it applies its own verdict locally)."""
+        host_id = str(host_id)
+        if kind not in ("rollback", "abort"):
+            raise ValueError("verdict kind must be rollback or abort, "
+                             "got %r" % (kind,))
+        with self._mu:
+            self._sweep()
+            if host_id not in self._members:
+                # same guard as request_save: an expelled zombie's
+                # escalation (raised before its heartbeat latched the
+                # rejoin) must not roll every healthy member back
+                raise ValueError(
+                    "verdict from %r rejected: not a cluster member "
+                    "(lease expired?) — the run has moved on without "
+                    "this host" % host_id)
+            if self._command is None:
+                self._command_seq += 1
+                self._command = {
+                    "seq": self._command_seq, "step": int(step),
+                    "kind": kind, "reason": str(reason),
+                    "origin": host_id, "epoch": self._epoch,
+                    "quarantined": bool(quarantined),
+                }
+                self._acks = set()
+                self._count("cluster/verdicts")
+                self._event({"event": "cluster_verdict",
+                             "member_id": host_id, "step": int(step),
+                             "kind": kind, "reason": str(reason),
+                             "seq": self._command_seq,
+                             "epoch": self._epoch})
+            cmd = dict(self._command)
+            self._ack(host_id)
+            self._snapshot(material=True)
+            return cmd
+
+    def poll_command(self, host_id, last_seq=0):
+        """The active command if ``host_id`` has not acked it and it is
+        newer than ``last_seq``, else None."""
+        with self._mu:
+            self._sweep()
+            cmd = self._command
+            if cmd is None or cmd["seq"] <= int(last_seq) \
+                    or str(host_id) in self._acks:
+                return None
+            return dict(cmd)
+
+    def ack_command(self, host_id, seq):
+        """Member ``host_id`` applied command ``seq``.  When every live
+        member acked, the command retires (a new incident can then be
+        arbitrated)."""
+        with self._mu:
+            self._sweep()
+            cmd = self._command
+            if cmd is None or int(seq) != cmd["seq"]:
+                return False
+            self._ack(str(host_id))
+            self._snapshot(material=True)
+            return True
+
+    def _ack(self, host_id):
+        """Lock held: record the ack, retire the command when all live
+        members have applied it."""
+        self._acks.add(host_id)
+        self._retire_if_acked()
+
+    def _retire_if_acked(self):
+        cmd = self._command
+        if cmd is None:
+            return
+        if all(h in self._acks for h in self._members):
+            self._event({"event": "cluster_verdict_retired",
+                         "seq": cmd["seq"], "kind": cmd["kind"],
+                         "step": cmd["step"]})
+            self._command = None
+            self._acks = set()
+
+    # -- saver election -------------------------------------------------
+    def request_save(self, host_id, step, block_secs=None):
+        """True iff ``host_id`` is the elected committer for checkpoint
+        ``step`` (the RequestSaveModel idiom): the first requester of a
+        step wins a ``block_secs`` window; everyone else writes shards
+        but does NOT commit the manifest.  Elections are tracked PER
+        STEP (async writer threads of different hosts can lag steps
+        apart — a request for another step must not evict a live
+        election, or two hosts end up committing the same artifact);
+        expired entries are pruned on every call."""
+        host_id = str(host_id)
+        if not host_id:
+            raise ValueError("host id is empty")
+        step = int(step)
+        block = float(block_secs if block_secs is not None
+                      else self.save_block_secs)
+        with self._mu:
+            self._sweep()
+            if host_id not in self._members:
+                # an expelled (or never-joined) host must not win a
+                # commit election: a zombie committing a manifest for a
+                # world that reshaped without it corrupts the artifact
+                return False
+            now = self._clock()
+            self._savers = {s: e for s, e in self._savers.items()
+                            if e["until"] > now}
+            cur = self._savers.get(step)
+            elected = cur is None or cur["host_id"] == host_id
+            if elected:
+                self._savers[step] = {"host_id": host_id,
+                                      "until": now + block}
+                self._snapshot(material=True)
+            return elected
+
+    # -- observability --------------------------------------------------
+    def stats(self):
+        with self._mu:
+            self._sweep()
+            return {"epoch": self._epoch, "members": len(self._members),
+                    "command_seq": self._command_seq,
+                    "active_command": None if self._command is None
+                    else dict(self._command),
+                    "savers": {s: dict(e)
+                               for s, e in self._savers.items()}}
+
+    # master-side telemetry: enabled-gated counters/events through the
+    # process monitor (a no-op unless the master's process monitors)
+    @staticmethod
+    def _count(name, amount=1):
+        from .. import monitor
+
+        monitor.count(name, amount)
+
+    @staticmethod
+    def _event(rec):
+        from .. import monitor
+
+        rec.setdefault("ts", time.time())
+        monitor.log_event(rec)

@@ -1,0 +1,277 @@
+"""ClusterMember: one host's live session against the ClusterMaster.
+
+Wraps the transport (a ``cloud.MasterClient`` over TCP, a raw
+``host:port`` address, or a direct in-process ``ClusterMaster`` — the
+unit-test path), keeps the lease alive from a daemon heartbeat thread,
+and exposes the control-plane verbs the training loop needs:
+
+* ``enter_step(step)`` — the lockstep dispatch gate; blocks (polling)
+  until the master says ``go``, or returns the ``reshape`` /
+  ``command`` decision the member must apply BEFORE dispatching;
+* ``propose_verdict`` / ``ack_command`` — guardian arbitration
+  (``cluster.ClusterGuardian`` drives these);
+* ``request_save(step)`` — saver election for sharded-checkpoint
+  manifest commits (plugs into
+  ``TrainStateCheckpointManager(saver_elect=member.request_save)``).
+
+The constructed member registers itself as the PROCESS-LOCAL member
+(``local_member()``/``local_context()``): guardian events and watchdog
+stall escalations stamp ``member_id`` + ``membership_epoch`` into their
+JSONL records so cluster-level post-mortems correlate across host logs.
+"""
+
+import threading
+import time
+
+__all__ = ["ClusterMember", "ClusterTimeout",
+           "local_member", "local_context", "set_local_member"]
+
+
+class ClusterTimeout(RuntimeError):
+    """A barrier/poll deadline expired with no master decision."""
+
+
+def _transport(t):
+    """Normalize the transport to an object with ``call(method, *args)``:
+    a MasterClient already has it; a direct service object gets a thin
+    adapter; a ``host:port`` string builds a MasterClient."""
+    if isinstance(t, str):
+        from ..cloud.server import MasterClient
+
+        t = MasterClient(t)
+    if callable(getattr(t, "call", None)):
+        return t
+
+    class _Direct:
+        def __init__(self, svc):
+            self._svc = svc
+
+        def call(self, method, *args):
+            return getattr(self._svc, method)(*args)
+
+        def close(self):
+            pass
+
+    return _Direct(t)
+
+
+class ClusterMember:
+    """One host's membership session.  ``auto_heartbeat`` (default)
+    runs a daemon thread renewing the lease every ``lease_timeout/3``
+    seconds; with it off the caller heartbeats explicitly (every
+    ``enter_step`` also renews)."""
+
+    def __init__(self, transport, host_id, meta=None,
+                 auto_heartbeat=True, poll_interval=0.05,
+                 register_local=True):
+        self._t = _transport(transport)
+        self.host_id = str(host_id)
+        self._poll = float(poll_interval)
+        self._mu = threading.Lock()
+        self._closed = False
+        self._expelled = False
+        view = self._t.call("join", self.host_id, dict(meta or {}))
+        self._epoch = int(view["epoch"])
+        # the epoch of the world this host has BUILT (mesh, executors).
+        # Distinct from _epoch (latest observed): the daemon heartbeat
+        # may observe a death first and absorb the new epoch, but the
+        # barrier must keep presenting the world the member actually
+        # runs — otherwise the master sees matching epochs and answers
+        # "go" into a dead world (a hung collective, the exact failure
+        # the barrier exists to prevent).  accept_world() advances it
+        # after the caller reshapes.
+        self._world_epoch = int(view["epoch"])
+        self._members = list(view["members"])
+        self._lease = float(view.get("lease_timeout", 10.0))
+        self.last_command_seq = 0
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        if auto_heartbeat:
+            self._hb_thread = threading.Thread(
+                target=self._hb_loop, daemon=True,
+                name="cluster-heartbeat-%s" % self.host_id)
+            self._hb_thread.start()
+        if register_local:
+            set_local_member(self)
+
+    # -- views ----------------------------------------------------------
+    @property
+    def epoch(self):
+        return self._epoch
+
+    @property
+    def world_epoch(self):
+        """The membership epoch this host's CURRENT world (mesh,
+        executors) was built for — what the barrier presents."""
+        return self._world_epoch
+
+    @property
+    def members(self):
+        return list(self._members)
+
+    def accept_world(self, epoch=None):
+        """Mark a membership view as the world this host now runs: call
+        after rebuilding the mesh for a reshape (or when a benign epoch
+        move — a join at world formation — needs no rebuild).  Pass the
+        EPOCH OF THE VIEW ACTED ON (the reshape response's): adopting
+        the latest observed epoch instead would race the heartbeat
+        daemon — a death absorbed during the rebuild must still surface
+        as a fresh ``reshape``, not be accepted blind."""
+        self._world_epoch = int(self._epoch if epoch is None else epoch)
+
+    def _absorb(self, view):
+        """Record a membership view; returns True when the epoch moved."""
+        with self._mu:
+            changed = int(view["epoch"]) != self._epoch
+            self._epoch = int(view["epoch"])
+            self._members = list(view.get("members", self._members))
+            return changed
+
+    # -- liveness -------------------------------------------------------
+    @property
+    def expelled(self):
+        """True once the master reported this member's lease expired
+        (``rejoin``): the host was expelled from the run and must not
+        keep training/committing as a zombie — ``ClusterGuardian``
+        turns this into a typed abort at the next step."""
+        return self._expelled
+
+    def heartbeat(self, step=None):
+        """Renew the lease; returns the view (absorbing it).  A
+        ``rejoin`` response latches ``expelled`` instead of being
+        silently absorbed."""
+        view = self._t.call("heartbeat", self.host_id, step)
+        if view.get("rejoin"):
+            self._expelled = True
+        self._absorb(view)
+        return view
+
+    def _hb_loop(self):
+        interval = max(0.05, self._lease / 3.0)
+        while not self._hb_stop.wait(interval):
+            try:
+                self.heartbeat()
+            except Exception:  # noqa: BLE001 — transient master outages
+                pass           # ride the client's own backoff next time
+
+    # -- the lockstep dispatch gate ------------------------------------
+    def enter_step(self, step, timeout=None):
+        """Block (polling the master) until the cluster decides what
+        this member does about ``step``:
+
+        * ``{"action": "go"}`` — dispatch it;
+        * ``{"action": "reshape", ...}`` — membership changed: the view
+          is absorbed first, so ``self.epoch``/``members`` already
+          describe the NEW world;
+        * ``{"action": "command", "command": {...}}`` — apply the
+          arbitration verdict at this boundary (then ack).
+
+        Raises ``ClusterTimeout`` after ``timeout`` seconds of "wait"
+        (None = poll forever)."""
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        while True:
+            # present the WORLD epoch, not the latest observed one: an
+            # epoch change first noticed by the heartbeat thread must
+            # still surface here as "reshape" (see _world_epoch)
+            res = self._t.call("enter_step", self.host_id, int(step),
+                               self._world_epoch)
+            action = res.get("action")
+            if action == "reshape":
+                if res.get("rejoin"):
+                    self._expelled = True
+                self._absorb(res)
+                return res
+            if action in ("go", "command"):
+                return res
+            if deadline is not None and time.monotonic() > deadline:
+                raise ClusterTimeout(
+                    "member %s: no barrier decision for step %d within "
+                    "%.1fs" % (self.host_id, step, timeout))
+            time.sleep(self._poll)
+
+    # -- arbitration ----------------------------------------------------
+    def propose_verdict(self, step, kind, reason, quarantined=False):
+        cmd = self._t.call("propose_verdict", self.host_id, int(step),
+                           kind, str(reason), bool(quarantined))
+        self.last_command_seq = max(self.last_command_seq,
+                                    int(cmd["seq"]))
+        return cmd
+
+    def poll_command(self):
+        cmd = self._t.call("poll_command", self.host_id,
+                           self.last_command_seq)
+        return cmd
+
+    def ack_command(self, seq):
+        self.last_command_seq = max(self.last_command_seq, int(seq))
+        return self._t.call("ack_command", self.host_id, int(seq))
+
+    # -- saver election -------------------------------------------------
+    def request_save(self, step, block_secs=None):
+        """True iff THIS member commits the sharded manifest for
+        ``step`` — the ``saver_elect`` hook of
+        ``TrainStateCheckpointManager``."""
+        return bool(self._t.call("request_save", self.host_id,
+                                 int(step), block_secs))
+
+    # -- lifecycle ------------------------------------------------------
+    def leave(self):
+        """Graceful departure (bumps the epoch for the survivors)."""
+        try:
+            return self._t.call("leave", self.host_id)
+        finally:
+            self.close()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+        if local_member() is self:
+            set_local_member(None)
+        close = getattr(self._t, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# process-local member registration (guardian/monitor event stamping)
+# ---------------------------------------------------------------------------
+
+_LOCAL = None
+
+
+def set_local_member(member):
+    """Install ``member`` as the process's cluster identity (None
+    clears).  Constructed members self-register."""
+    global _LOCAL
+    _LOCAL = member
+
+
+def local_member():
+    """The process's ClusterMember, or None outside a cluster run."""
+    return _LOCAL
+
+
+def local_context():
+    """``{"member_id", "membership_epoch"}`` for JSONL correlation, or
+    ``{}`` outside a cluster run — guardian events and watchdog stall
+    escalations merge this in so cluster-level post-mortems can join
+    per-host logs."""
+    m = _LOCAL
+    if m is None:
+        return {}
+    return {"member_id": m.host_id, "membership_epoch": m.epoch}

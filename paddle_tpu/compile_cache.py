@@ -24,6 +24,7 @@ lookup so cache behavior is visible in the chrome trace next to the
 
 import collections
 import hashlib
+import os
 import threading
 
 from .profiler import mark_event
@@ -31,6 +32,7 @@ from .profiler import mark_event
 __all__ = [
     "program_fingerprint", "trace_key", "trace_flag_values", "lookup",
     "store", "stats", "reset_stats", "clear", "enable_persistent_cache",
+    "rescope_persistent_cache",
 ]
 
 
@@ -66,6 +68,7 @@ _STATS = {"trace_hits": 0, "trace_misses": 0, "lowerings": 0}
 # stats/StepStats names WHICH program is churning, not just that one is
 _LOWERINGS_BY_FP = {}
 _persistent_dir = [None]
+_persistent_base = [None]     # user-given dir, before any world scoping
 
 
 # ---------------------------------------------------------------------------
@@ -184,13 +187,53 @@ def clear():
 # persistent XLA compilation cache
 # ---------------------------------------------------------------------------
 
+def _known_world_size():
+    """The jax process count, WITHOUT initializing the backend: only
+    consulted when ``parallel.distributed`` is already imported and
+    reports the world joined (probing ``jax.process_count()`` directly
+    would initialize the backend, which must not happen at flag-import
+    time, before a later ``jax.distributed.initialize``)."""
+    import sys
+
+    dist = sys.modules.get("paddle_tpu.parallel.distributed")
+    if dist is not None and dist.is_initialized():
+        import jax
+
+        return jax.process_count()
+    return 1
+
+
+def rescope_persistent_cache():
+    """Re-point the persistent cache at a world-scoped subdirectory
+    (``world_<N>``) once the process count is known — called by
+    ``parallel.distributed.init_distributed`` AFTER the jax runtime
+    joined the world (covering caches enabled BEFORE the join; caches
+    enabled after it scope themselves in ``enable_persistent_cache``).
+    Single-process runs keep the base directory, so an elastic-resume
+    survivor restarts warm off the solo entries while never
+    deserializing a multi-process executable: an N-process module
+    embeds cross-process collective wiring and silently computes
+    garbage in any other world shape (found by the cluster drill)."""
+    base = _persistent_base[0]
+    if base:
+        enable_persistent_cache(base)
+
+
 def enable_persistent_cache(cache_dir):
     """Point jax's on-disk executable cache at ``cache_dir`` (empty/None
     disables).  Thresholds are zeroed so even the CPU-backend test shapes
     cache: the bench ladder's win case is many small-to-medium modules
-    recompiled across subprocess rungs and re-invocations."""
+    recompiled across subprocess rungs and re-invocations.  In a
+    multi-process world (already joined at call time, or joined later
+    through ``init_distributed``) the cache lands in a ``world_<N>``
+    subdirectory — see ``rescope_persistent_cache``."""
     import jax
 
+    _persistent_base[0] = cache_dir or None
+    if cache_dir:
+        n = _known_world_size()
+        if n > 1:
+            cache_dir = os.path.join(cache_dir, "world_%d" % n)
     _persistent_dir[0] = cache_dir or None
     jax.config.update("jax_compilation_cache_dir", cache_dir or None)
     if not cache_dir:

@@ -106,7 +106,8 @@ class Trainer:
 
     def __init__(self, train_func, optimizer_func, param_path=None,
                  place=None, parallel=False, checkpoint_config=None,
-                 mesh=None, guardian_config=None, autotune=None):
+                 mesh=None, guardian_config=None, autotune=None,
+                 cluster_member=None):
         """``guardian_config``: the recovery policy — a ``Guardian``
         instance, or a kwargs dict for ``guardian.Guardian`` (policy
         ladder, window, budgets...).  Passing one turns the guardian on
@@ -120,12 +121,23 @@ class Trainer:
         ``TunedConfig.apply`` (pinned flags win); a tuned
         ``checkpoint_interval`` re-gates the checkpoint manager unless
         the user pinned ``CheckpointConfig(step_interval=...)``
-        explicitly."""
+        explicitly.
+
+        ``cluster_member``: a ``paddle_tpu.cluster.ClusterMember`` — the
+        host's session against a ClusterMaster.  With one, multi-host
+        sharded checkpoint commits go through the master's saver
+        election, and — when a guardian is enabled (``FLAGS_guardian``
+        or ``guardian_config``) — verdicts are cluster-arbitrated
+        (``ClusterGuardian``: one host's rollback wins cluster-wide).
+        A plain ``Guardian`` INSTANCE as ``guardian_config`` conflicts
+        with that promise and raises; pass a kwargs dict or a
+        ``ClusterGuardian``."""
         self.__stop = False
         self.parallel = parallel
         self.place = _default_place(place)
         self._mesh = mesh
         self._guardian_config = guardian_config
+        self._cluster_member = cluster_member
         self._set_guardian_flag = False
         self._current_epoch = 0
 
@@ -210,11 +222,16 @@ class Trainer:
             from ..parallel.checkpoint import TrainStateCheckpointManager
 
             cfg = self.checkpoint_cfg
+            member = self._cluster_member
             self._ckpt_mgr = TrainStateCheckpointManager(
                 cfg.checkpoint_dir,
                 max_to_keep=cfg.max_num_checkpoints,
                 save_interval_steps=cfg.step_interval,
-                async_save=cfg.async_save)
+                async_save=cfg.async_save,
+                # cluster runs elect exactly one manifest committer per
+                # step through the master (sharded-mode saves only)
+                saver_elect=member.request_save
+                if member is not None else None)
             with scope_guard(self.scope):
                 restored = self._ckpt_mgr.restore(
                     scope=self.scope, program=self.train_program)
@@ -392,11 +409,33 @@ class Trainer:
         if cfg is None and not _flags.flag("guardian"):
             return None
         if isinstance(cfg, _guardian.Guardian):
+            from ..cluster import ClusterGuardian
+
+            if self._cluster_member is not None \
+                    and not isinstance(cfg, ClusterGuardian):
+                # a plain Guardian instance would decide ALONE while
+                # cluster_member promises arbitration — silently
+                # bypassing it is exactly the per-process-divergence
+                # hole the bridge exists to close; make the conflict a
+                # configuration error instead
+                raise ValueError(
+                    "Trainer(cluster_member=...) with a plain Guardian "
+                    "instance: verdicts would not be cluster-"
+                    "arbitrated.  Pass guardian_config as a kwargs "
+                    "dict (the Trainer builds a ClusterGuardian), or "
+                    "construct cluster.ClusterGuardian(member, ...) "
+                    "yourself")
             g = cfg
             # budgets/history are per-run: a reused instance must not
             # carry a spent rollback budget into this train() (the
             # kwargs path below builds a fresh Guardian each time)
             g.reset_run_state()
+        elif self._cluster_member is not None:
+            # cluster runs arbitrate verdicts through the master: one
+            # host's rollback/abort becomes the cluster's
+            from ..cluster import ClusterGuardian
+
+            g = ClusterGuardian(self._cluster_member, **dict(cfg or {}))
         else:
             g = _guardian.Guardian(**dict(cfg or {}))
         if not g.quarantine_dir \

@@ -571,8 +571,15 @@ class Guardian:
     @staticmethod
     def _event(rec):
         from . import monitor
+        from .cluster.runtime import local_context
 
         rec.setdefault("ts", time.time())
+        # cluster runs stamp every guardian decision (rollback, skip,
+        # stall escalation...) with the member identity + membership
+        # epoch, so cluster-level post-mortems can join the per-host
+        # JSONL logs; a no-op ({}) outside a cluster
+        for k, v in local_context().items():
+            rec.setdefault(k, v)
         monitor.log_event(rec)
 
     def stats(self):

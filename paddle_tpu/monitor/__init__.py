@@ -637,6 +637,16 @@ def remove_stall_listener(fn):
 
 def _stall_sink(diag):
     _registry.counter("monitor/watchdog_stalls").inc()
+    try:
+        # cluster runs stamp the stall record with member id +
+        # membership epoch (cross-host post-mortem correlation); the
+        # guard keeps a broken cluster session from eating the dump
+        from ..cluster.runtime import local_context
+
+        for k, v in local_context().items():
+            diag.setdefault(k, v)
+    except Exception:  # noqa: BLE001 — diagnostics must land
+        pass
     log_event(diag)
     print("[monitor] WATCHDOG: no step completed in %.1fs — pipeline "
           "stalled?\n%s" % (diag["stalled_for_s"], _format_diag(diag)),

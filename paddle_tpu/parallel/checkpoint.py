@@ -47,7 +47,9 @@ __all__ = [
     "save_sharded", "load_sharded", "ShardedCheckpointManager",
     "TrainState", "TrainStateCheckpointManager", "CheckpointCorruptError",
     "CheckpointMismatchError", "capture_train_state", "apply_train_state",
-    "save_train_state", "load_train_state",
+    "save_train_state", "load_train_state", "save_train_state_sharded",
+    "write_train_state_shards", "commit_sharded_train_state",
+    "partition_shards",
 ]
 
 
@@ -286,23 +288,99 @@ class TrainState:
     """One atomic snapshot of a training run at a step boundary:
     ``arrays`` (host numpy: params, optimizer slots, LR, in-graph step
     counters) + ``host`` (JSON-able: step index, executor PRNG counters,
-    reader positions, caller extras)."""
+    reader positions, caller extras).
 
-    def __init__(self, step, arrays, host):
+    A SHARDED capture (``capture_train_state(..., sharded=True)``)
+    carries ``shards`` instead of ``arrays``: the entries this process
+    owns (``[{"name", "index", "data"}]`` with global index ranges) plus
+    ``array_meta`` — the global shape/dtype of EVERY var, which is what
+    the elected saver writes into the manifest.  Loaded artifacts always
+    come back with full ``arrays`` (the loader assembles shards), so
+    everything downstream — ``apply_train_state``, the guardian's
+    poisoned-checkpoint scan — sees one representation."""
+
+    def __init__(self, step, arrays, host, shards=None, array_meta=None):
         self.step = int(step)
         self.arrays = arrays
         self.host = host
+        self.shards = shards
+        self.array_meta = array_meta
 
     def __repr__(self):
+        if self.arrays is None:
+            return ("TrainState(step=%d, shards=%d of %d vars, "
+                    "executors=%s)"
+                    % (self.step, len(self.shards or ()),
+                       len(self.array_meta or ()),
+                       sorted(self.host.get("executors", {}))))
         return "TrainState(step=%d, arrays=%d, executors=%s, readers=%s)" % (
             self.step, len(self.arrays),
             sorted(self.host.get("executors", {})),
             sorted(self.host.get("readers", {})))
 
 
+def _shard_index(shape, index):
+    """Normalize a jax ``Shard.index`` (tuple of slices) to JSON-able
+    ``[[start, stop], ...]`` over the global ``shape``."""
+    out = []
+    for dim, sl in zip(shape, index):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _owned_shards(name, v):
+    """The shard entries THIS process owns for one state value: the
+    addressable replica-0 shards of a jax Array (each unique piece of
+    the global array is written by exactly one process, no all-gather),
+    or — for host numpy / non-jax values, which every process holds
+    identically — one full-array entry owned by process 0."""
+    if isinstance(v, jax.Array):
+        shape = tuple(v.shape)
+        out = []
+        for s in v.addressable_shards:
+            if s.replica_id != 0:
+                continue           # a replica: some other shard owns it
+            out.append({"name": name,
+                        "index": _shard_index(shape, s.index),
+                        "data": np.array(s.data, copy=True)})
+        return out
+    if jax.process_index() != 0:
+        return []
+    arr = np.array(v, copy=True)
+    return [{"name": name,
+             "index": [[0, d] for d in arr.shape],
+             "data": arr}]
+
+
+def _array_meta(state):
+    """Global ``{name: {"shape", "dtype"}}`` of every state value —
+    identical on every process (shapes/dtypes are program facts), so the
+    elected saver's copy is THE manifest schema."""
+    meta = {}
+    for n, v in state.items():
+        dtype = v.dtype if hasattr(v, "dtype") else np.asarray(v).dtype
+        meta[n] = {"shape": [int(d) for d in
+                             getattr(v, "shape", np.shape(v))],
+                   "dtype": np.dtype(dtype).name}
+    return meta
+
+
+def _dtype_from_name(name):
+    """Inverse of ``np.dtype(...).name``, covering the ml_dtypes names
+    (bfloat16, float8_*) the npy format cannot describe."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def capture_train_state(step, scope=None, program=None, executors=None,
-                        readers=None, extra=None):
-    """Snapshot the FULL train state at a step boundary.
+                        readers=None, extra=None, sharded=False):
+    """Snapshot the train state at a step boundary.
 
     Blocks only for the device->host copy of the persistable vars (the
     cheap part); serialization happens in whoever writes the snapshot —
@@ -310,11 +388,36 @@ def capture_train_state(step, scope=None, program=None, executors=None,
     ``executors``/``readers`` are objects exposing ``state_dict()``
     (Executor/ParallelExecutor PRNG run counters, reader positions);
     pass the same names to the restoring side so state re-applies to
-    the matching object."""
+    the matching object.
+
+    ``sharded=False`` (the single-host full-artifact path): every value
+    gathers to a FULL host array — on a multi-host mesh that is a
+    process allgather, every host then writing an identical complete
+    artifact.  ``sharded=True`` (the per-host path): this process copies
+    out only the shards it OWNS (addressable replica-0 shards — no
+    gather, no cross-host traffic), so per-host checkpoint bytes scale
+    as 1/N of the state and stay flat as the mesh grows; write with
+    ``save_train_state_sharded`` / the manager's sharded mode."""
     with RecordEvent("checkpoint/snapshot"):
         scope = scope or global_scope()
         state = _persistable_state(scope, program)
         _require_state(state, "snapshot")
+        host = {
+            "format": TRAIN_STATE_FORMAT,
+            "step": int(step),
+            "time": time.time(),
+            "executors": {n: dict(e.state_dict())
+                          for n, e in _named(executors, "executor").items()},
+            "readers": {n: dict(r.state_dict())
+                        for n, r in _named(readers, "reader").items()},
+            "extra": dict(extra or {}),
+        }
+        if sharded:
+            shards = []
+            for n in sorted(state):
+                shards.extend(_owned_shards(n, state[n]))
+            return TrainState(step, None, host, shards=shards,
+                              array_meta=_array_meta(state))
         # _gather_host: np.array(copy=True), NOT np.asarray — on the CPU
         # backend np.asarray(jax.Array) is a ZERO-COPY view of the
         # device buffer, and the next dispatched step DONATES that
@@ -326,16 +429,6 @@ def capture_train_state(step, scope=None, program=None, executors=None,
         # artifact is topology-free: restore re-shards onto whatever
         # mesh (or single device) the resuming process runs.
         arrays = {n: _gather_host(v) for n, v in state.items()}
-        host = {
-            "format": TRAIN_STATE_FORMAT,
-            "step": int(step),
-            "time": time.time(),
-            "executors": {n: dict(e.state_dict())
-                          for n, e in _named(executors, "executor").items()},
-            "readers": {n: dict(r.state_dict())
-                        for n, r in _named(readers, "reader").items()},
-            "extra": dict(extra or {}),
-        }
     return TrainState(step, arrays, host)
 
 
@@ -404,15 +497,10 @@ def _sha256(path):
     return h.hexdigest()
 
 
-def _fsync_dir(path):
-    try:
-        fd = os.open(path, os.O_RDONLY)
-    except OSError:       # platforms without directory fds
-        return
-    try:
-        os.fsync(fd)
-    finally:
-        os.close(fd)
+# one shared commit-idiom helper: cloud.store is the dependency-light
+# canonical home (importing this jax-heavy module from cloud would
+# invert the layering)
+from ..cloud.store import fsync_dir as _fsync_dir  # noqa: E402
 
 
 def save_train_state(dirname, ts):
@@ -420,6 +508,10 @@ def save_train_state(dirname, ts):
     + a sha256 MANIFEST, assembled in a ``.tmp`` sibling and committed
     with a single directory rename.  A crash at ANY point leaves either
     the previous artifact set intact or a .tmp dir restores ignore."""
+    if ts.arrays is None:
+        raise ValueError(
+            "this TrainState was captured sharded (shards, not full "
+            "arrays): write it with save_train_state_sharded")
     dirname = os.path.abspath(dirname)
     parent = os.path.dirname(dirname)
     if parent:
@@ -468,25 +560,30 @@ def save_train_state(dirname, ts):
             f.flush()
             os.fsync(f.fileno())
         fault.fire("checkpoint/before_commit", ts.step)
-        # the commit point: everything before it is invisible to
-        # restores.  Re-saving an existing step renames the old
-        # artifact aside first (as a .tmp sibling, reclaimed by the
-        # next manager init) — rmtree-then-replace would hold a
-        # destroyed-artifact window open for the whole delete; the
-        # rename pair shrinks it to two directory entries.
-        if os.path.isdir(dirname):
-            old = tmp + ".replaced"
-            shutil.rmtree(old, ignore_errors=True)
-            os.replace(dirname, old)
-            os.replace(tmp, dirname)
-            shutil.rmtree(old, ignore_errors=True)
-        else:
-            os.replace(tmp, dirname)
-        _fsync_dir(parent or ".")
+        _commit_artifact_dir(dirname, tmp)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
     return dirname
+
+
+def _commit_artifact_dir(dirname, tmp):
+    """The commit point: everything before it is invisible to restores.
+    Re-saving an existing step renames the old artifact aside first (as
+    a .tmp sibling, reclaimed by the next manager init) —
+    rmtree-then-replace would hold a destroyed-artifact window open for
+    the whole delete; the rename pair shrinks it to two directory
+    entries."""
+    parent = os.path.dirname(dirname)
+    if os.path.isdir(dirname):
+        old = tmp + ".replaced"
+        shutil.rmtree(old, ignore_errors=True)
+        os.replace(dirname, old)
+        os.replace(tmp, dirname)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.replace(tmp, dirname)
+    _fsync_dir(parent or ".")
 
 
 def load_train_state(dirname):
@@ -512,6 +609,10 @@ def load_train_state(dirname):
                 raise CheckpointCorruptError(
                     "checkpoint %s: %s fails its sha256 — corrupt"
                     % (dirname, fname))
+        if manifest.get("sharded"):
+            # per-host artifact: assemble the shard files back into
+            # full host arrays (same downstream representation)
+            return _load_sharded_train_state(dirname, manifest)
         with open(os.path.join(dirname, _HOST_FILE)) as f:
             host = json.load(f)
         raw_dtypes = host.pop("raw_dtypes", {})
@@ -525,6 +626,239 @@ def load_train_state(dirname):
     except Exception as e:  # noqa: BLE001 — any decode failure = corrupt
         raise CheckpointCorruptError(
             "checkpoint %s: undecodable (%r)" % (dirname, e))
+
+
+# ---------------------------------------------------------------------------
+# Per-host sharded artifact IO (ISSUE 13): each host writes ONLY its
+# addressable shards; the elected saver commits a global manifest.
+# orbax-OCDBT-style layout (PAPERS.md):
+#
+#   step_0000000012/
+#     shard_00000.npz    writer 0's shards, positional members
+#     shard_00000.json   writer 0's index: per-entry (name, global range)
+#     shard_00001.npz    writer 1's shards ...
+#     train_state.json   host state + global array meta (saver-written)
+#     MANIFEST.json      sharded: true, per-file sha256 + bytes,
+#                        per-writer bytes, committed LAST by the saver
+#
+# Per-host bytes written therefore scale as 1/N of the full state; a
+# restore (any process, any mesh size — even a single host) reads the
+# shard files, assembles full host arrays, and re-shards through
+# apply_train_state(shardings=pe.state_shardings()).
+# ---------------------------------------------------------------------------
+
+_SHARD_FILE = "shard_%05d.npz"
+_SHARD_META = "shard_%05d.json"
+_SHARED_TMP_SUFFIX = ".shared"
+
+
+def partition_shards(ts, writers):
+    """Split a sharded TrainState's LOCAL entries across ``writers``
+    virtual hosts (the single-process bench/test path: one process
+    standing in for N hosts).  Entries whose leading dim splits evenly
+    enough are sliced along dim 0 — exact ~1/N bytes for the tensors
+    that dominate state — the rest round-robin whole.  Returns a list
+    of ``writers`` entry lists.  Real multi-host runs never call this:
+    ownership already is the partition."""
+    writers = max(1, int(writers))
+    out = [[] for _ in range(writers)]
+    rr = 0
+    for e in ts.shards:
+        data = e["data"]
+        if data.ndim >= 1 and data.shape[0] >= writers:
+            start = e["index"][0][0]
+            off = 0
+            for w, piece in enumerate(np.array_split(data, writers)):
+                idx = [list(r) for r in e["index"]]
+                idx[0] = [start + off, start + off + piece.shape[0]]
+                off += piece.shape[0]
+                out[w].append({"name": e["name"], "index": idx,
+                               "data": piece})
+        else:
+            out[rr % writers].append(e)
+            rr += 1
+    return out
+
+
+def _sharded_tmp(dirname):
+    """The SHARED tmp dir every writer of one artifact assembles into
+    (deterministic name — unlike the full path's pid-suffixed tmp, all
+    hosts must agree on it)."""
+    dirname = os.path.abspath(dirname)
+    return os.path.join(os.path.dirname(dirname),
+                        _TMP_PREFIX + os.path.basename(dirname)
+                        + _SHARED_TMP_SUFFIX)
+
+
+def write_train_state_shards(dirname, ts, writer_id, entries=None):
+    """Write ONE writer's shard file + index sidecar into the artifact's
+    shared tmp dir.  ``entries`` defaults to the TrainState's own owned
+    shards (pass a ``partition_shards`` slice in virtual-host mode).
+    The sidecar lands via atomic rename LAST — it is the signal the
+    committing saver polls for.  Returns the bytes written."""
+    if ts.shards is None:
+        raise ValueError("TrainState was not captured sharded "
+                         "(capture_train_state(..., sharded=True))")
+    entries = ts.shards if entries is None else entries
+    writer_id = int(writer_id)
+    tmp = _sharded_tmp(dirname)
+    os.makedirs(tmp, exist_ok=True)
+    fault.fire("checkpoint/before_write", ts.step)
+    npz_path = os.path.join(tmp, _SHARD_FILE % writer_id)
+    with open(npz_path, "wb") as f:
+        np.savez(f, **{"arr_%d" % i: _npz_encode(e["data"])[0]
+                       for i, e in enumerate(entries)})
+        f.flush()
+        os.fsync(f.fileno())
+    sidecar = {
+        "writer": writer_id,
+        "step": ts.step,
+        "entries": [{"name": e["name"], "index": e["index"]}
+                    for e in entries],
+        "bytes": os.path.getsize(npz_path),
+        "sha256": _sha256(npz_path),
+    }
+    side_path = os.path.join(tmp, _SHARD_META % writer_id)
+    with open(side_path + ".part", "w") as f:
+        json.dump(sidecar, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(side_path + ".part", side_path)
+    _fsync_dir(tmp)
+    fault.fire("checkpoint/after_write", ts.step)
+    return sidecar["bytes"]
+
+
+def commit_sharded_train_state(dirname, ts, expected_writers,
+                               timeout=120.0, poll=0.05):
+    """The ELECTED SAVER's half: wait until every expected writer's
+    sidecar landed in the shared tmp dir, then write train_state.json +
+    the global MANIFEST and commit the directory rename.  Raises
+    ``CheckpointCorruptError`` when the writers don't all arrive within
+    ``timeout`` (the tmp dir is left for the next manager init to
+    reclaim — restores never see it)."""
+    dirname = os.path.abspath(dirname)
+    tmp = _sharded_tmp(dirname)
+    expected = list(range(int(expected_writers)))
+    deadline = time.monotonic() + float(timeout)
+    missing = expected
+    while True:
+        missing = [w for w in expected
+                   if not os.path.exists(os.path.join(tmp,
+                                                      _SHARD_META % w))]
+        if not missing:
+            break
+        if time.monotonic() > deadline:
+            raise CheckpointCorruptError(
+                "sharded checkpoint step %d: writers %s never delivered "
+                "their shards within %.0fs — commit abandoned"
+                % (ts.step, missing, timeout))
+        time.sleep(poll)
+    try:
+        host = dict(ts.host)
+        meta = {}
+        for n, m in (ts.array_meta or {}).items():
+            entry = {"shape": list(m["shape"]), "dtype": m["dtype"]}
+            enc, logical = _npz_encode(
+                np.empty(0, dtype=_dtype_from_name(m["dtype"])))
+            if logical:
+                entry["raw_dtype"] = enc.dtype.name
+            meta[n] = entry
+        host["array_meta"] = meta
+        host_path = os.path.join(tmp, _HOST_FILE)
+        with open(host_path, "w") as f:
+            json.dump(host, f)
+            f.flush()
+            os.fsync(f.fileno())
+        files = {_HOST_FILE: {"sha256": _sha256(host_path),
+                              "bytes": os.path.getsize(host_path)}}
+        per_writer = {}
+        for w in expected:
+            # each writer already hashed its own (fsynced) shard npz
+            # into the sidecar — re-hashing all N files here would make
+            # the commit O(total state) read IO on the saver, undoing
+            # half the per-host 1/N win; the saver hashes only the
+            # sidecars (tiny), chaining trust: manifest -> sidecar ->
+            # shard payload
+            side_path = os.path.join(tmp, _SHARD_META % w)
+            with open(side_path) as f:
+                side = json.load(f)
+            files[_SHARD_FILE % w] = {"sha256": side["sha256"],
+                                      "bytes": side["bytes"]}
+            files[_SHARD_META % w] = {
+                "sha256": _sha256(side_path),
+                "bytes": os.path.getsize(side_path)}
+            per_writer[str(w)] = side["bytes"]
+        manifest = {
+            "format": TRAIN_STATE_FORMAT,
+            "sharded": True,
+            "step": ts.step,
+            "writers": len(expected),
+            "per_writer_bytes": per_writer,
+            "files": files,
+        }
+        with open(os.path.join(tmp, _MANIFEST_FILE), "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        fault.fire("checkpoint/before_commit", ts.step)
+        _commit_artifact_dir(dirname, tmp)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return dirname
+
+
+def save_train_state_sharded(dirname, ts, writer_id=0, writers=1,
+                             saver=True, commit_timeout=120.0):
+    """One host's leg of a sharded TrainState save: write this writer's
+    shards, and — when this host is the elected ``saver`` — wait for the
+    peers and commit the manifest.  Returns the committed dirname
+    (saver) or the bytes this writer contributed (non-saver)."""
+    written = write_train_state_shards(dirname, ts, writer_id)
+    if not saver:
+        return written
+    return commit_sharded_train_state(dirname, ts, writers,
+                                      timeout=commit_timeout)
+
+
+def _load_sharded_train_state(dirname, manifest):
+    """Assemble a sharded artifact back into full host arrays (manifest
+    and per-file sha256 already partially validated by the caller):
+    every var gets an empty global buffer filled from the shard entries;
+    incomplete coverage is corruption, not a silent zero-filled
+    restore."""
+    with open(os.path.join(dirname, _HOST_FILE)) as f:
+        host = json.load(f)
+    meta = host.pop("array_meta")
+    buffers, covered = {}, {}
+    for n, m in meta.items():
+        raw = np.dtype(m["raw_dtype"]) if m.get("raw_dtype") \
+            else _dtype_from_name(m["dtype"])
+        buffers[n] = np.empty(tuple(m["shape"]), dtype=raw)
+        covered[n] = 0
+    for w in range(int(manifest["writers"])):
+        with open(os.path.join(dirname, _SHARD_META % w)) as f:
+            sidecar = json.load(f)
+        with np.load(os.path.join(dirname, _SHARD_FILE % w)) as z:
+            for i, e in enumerate(sidecar["entries"]):
+                n = e["name"]
+                data = z["arr_%d" % i]
+                sel = tuple(slice(a, b) for a, b in e["index"])
+                buffers[n][sel] = data.reshape(
+                    buffers[n][sel].shape)
+                covered[n] += data.size
+    for n, m in meta.items():
+        if covered[n] != int(np.prod(m["shape"], dtype=np.int64)):
+            raise CheckpointCorruptError(
+                "sharded checkpoint %s: var %r covered %d of %d "
+                "elements — shard set incomplete" %
+                (dirname, n, covered[n],
+                 int(np.prod(m["shape"], dtype=np.int64))))
+    arrays = {n: _npz_decode(buffers[n],
+                             m["dtype"] if m.get("raw_dtype") else None)
+              for n, m in meta.items()}
+    return TrainState(manifest["step"], arrays, host)
 
 
 class TrainStateCheckpointManager:
@@ -544,16 +878,34 @@ class TrainStateCheckpointManager:
     Restore protocol: newest artifact first; an artifact failing
     manifest/sha256 validation is logged and SKIPPED, falling back to
     the previous one — a torn or corrupt latest checkpoint costs one
-    interval of work, never the job."""
+    interval of work, never the job.
+
+    Sharded mode (``sharded=True``, or the default ``None`` = auto on
+    multi-process runs): saves go through the per-host sharded artifact
+    path — this process captures and writes ONLY its addressable shards
+    (1/N of the state), and the host elected by ``saver_elect(step)``
+    (default: process 0; wire ``ClusterMember.request_save`` for
+    master-arbitrated election) waits for the peers' shard files and
+    commits the manifest.  ``writer_id``/``writers`` default to the jax
+    process identity.  Restores are format-agnostic: the loader
+    assembles shard files back into full host arrays, so a sharded
+    artifact restores on any topology — including a single host —
+    through the same ``apply_train_state`` path."""
 
     def __init__(self, dirname, max_to_keep=3, save_interval_steps=1,
-                 async_save=True):
+                 async_save=True, sharded=None, saver_elect=None,
+                 writer_id=None, writers=None, commit_timeout=120.0):
         self._dir = os.path.abspath(dirname)
         os.makedirs(self._dir, exist_ok=True)
         self._max_to_keep = max(1, int(max_to_keep)) \
             if max_to_keep is not None else None
         self._interval = max(1, int(save_interval_steps))
         self._async = bool(async_save)
+        self._sharded = sharded
+        self._saver_elect = saver_elect
+        self._writer_id = writer_id
+        self._writers = writers
+        self._commit_timeout = float(commit_timeout)
         self._last_saved = None
         self._inflight = None            # (thread, step)
         self._error = None
@@ -564,11 +916,25 @@ class TrainStateCheckpointManager:
         self._save_s = collections.deque(maxlen=16)
         self._mu = threading.Lock()
         self.last_restored = None        # TrainState of the last restore
-        # a dead process's .tmp dirs (kill mid-save) are garbage
+        # a dead process's .tmp dirs (kill mid-save) are garbage — but
+        # a SHARED sharded tmp may be a live peer's in-flight write (a
+        # rejoining host constructs its manager while survivors are
+        # mid-save), so those are reclaimed only once older than the
+        # commit timeout: nothing waits longer than that for a commit,
+        # so an older one is provably abandoned
+        now = time.time()
         for entry in os.listdir(self._dir):
-            if entry.startswith(_TMP_PREFIX):
-                shutil.rmtree(os.path.join(self._dir, entry),
-                              ignore_errors=True)
+            if not entry.startswith(_TMP_PREFIX):
+                continue
+            path = os.path.join(self._dir, entry)
+            if entry.endswith(_SHARED_TMP_SUFFIX):
+                try:
+                    age = now - os.path.getmtime(path)
+                except OSError:
+                    continue
+                if age <= self._commit_timeout:
+                    continue
+            shutil.rmtree(path, ignore_errors=True)
 
     # -- paths / listing ----------------------------------------------
     def _step_dir(self, step):
@@ -624,6 +990,30 @@ class TrainStateCheckpointManager:
             out["n"] = max(len(snaps), len(saves))
         return out
 
+    # -- sharded-mode identity -----------------------------------------
+    def sharded_mode(self):
+        """Whether saves go through the per-host sharded path: the
+        explicit ``sharded=`` setting, else auto — sharded iff this is
+        a multi-process run (the case the all-gather used to pay for)."""
+        if self._sharded is not None:
+            return bool(self._sharded)
+        return jax.process_count() > 1
+
+    def _writer_identity(self):
+        wid = self._writer_id if self._writer_id is not None \
+            else jax.process_index()
+        n = self._writers if self._writers is not None \
+            else jax.process_count()
+        return int(wid), max(1, int(n))
+
+    def _is_saver(self, step):
+        """Exactly-one-committer election for sharded artifacts: the
+        ``saver_elect`` hook (``ClusterMember.request_save`` under a
+        cluster master), else writer 0."""
+        if self._saver_elect is not None:
+            return bool(self._saver_elect(step))
+        return self._writer_identity()[0] == 0
+
     # -- save ----------------------------------------------------------
     def save(self, step, scope=None, program=None, executors=None,
              readers=None, extra=None):
@@ -637,7 +1027,7 @@ class TrainStateCheckpointManager:
         t0 = time.perf_counter()
         ts = capture_train_state(step, scope=scope, program=program,
                                  executors=executors, readers=readers,
-                                 extra=extra)
+                                 extra=extra, sharded=self.sharded_mode())
         self._snapshot_s.append(time.perf_counter() - t0)
         self._last_saved = int(step)
         if not self._async:
@@ -668,7 +1058,7 @@ class TrainStateCheckpointManager:
         t0 = time.perf_counter()
         ts = capture_train_state(step, scope=scope, program=program,
                                  executors=executors, readers=readers,
-                                 extra=extra)
+                                 extra=extra, sharded=self.sharded_mode())
         self._snapshot_s.append(time.perf_counter() - t0)
         self._last_saved = int(step)
         self._write(ts)
@@ -683,17 +1073,37 @@ class TrainStateCheckpointManager:
 
     def _write(self, ts):
         t0 = time.perf_counter()
-        with RecordEvent("checkpoint/save"):
-            path = save_train_state(self._step_dir(ts.step), ts)
+        step_dir = self._step_dir(ts.step)
+        if ts.shards is not None:
+            wid, writers = self._writer_identity()
+            saver = self._is_saver(ts.step)
+            nbytes = sum(e["data"].nbytes for e in ts.shards)
+            with RecordEvent("checkpoint/save"):
+                save_train_state_sharded(
+                    step_dir, ts, writer_id=wid, writers=writers,
+                    saver=saver, commit_timeout=self._commit_timeout)
+            path = step_dir
+            extra = {"sharded": True, "writer_id": wid,
+                     "writers": writers, "saver": saver}
+        else:
+            nbytes = sum(a.nbytes for a in ts.arrays.values())
+            with RecordEvent("checkpoint/save"):
+                path = save_train_state(step_dir, ts)
+            saver = True
+            extra = {}
         self._save_s.append(time.perf_counter() - t0)
-        self._rotate()
+        if saver:
+            # non-elected hosts never rotate: racing rmtrees against
+            # the committer's rename would re-open the torn-artifact
+            # window the commit protocol exists to close
+            self._rotate()
         monitor.mark("checkpoint/saved")
-        monitor.log_event({
+        monitor.log_event(dict({
             "event": "checkpoint_saved", "ts": time.time(),
             "step": ts.step, "path": path,
             "seconds": round(time.perf_counter() - t0, 6),
-            "bytes": sum(a.nbytes for a in ts.arrays.values()),
-            "async": self._async})
+            "bytes": nbytes,
+            "async": self._async}, **extra))
         return path
 
     def _rotate(self):

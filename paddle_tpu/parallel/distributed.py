@@ -49,6 +49,15 @@ def init_distributed(coordinator_address=None, num_processes=None,
         process_id=process_id,
         local_device_ids=local_device_ids)
     _initialized = True
+    from .. import compile_cache
+
+    # the persistent XLA cache must not mix executables across world
+    # shapes: an N-process executable embeds cross-process collective
+    # wiring, and a process of a DIFFERENT world (the elastic-resume
+    # survivor, a resized job) deserializing it computes silent garbage
+    # — found by the cluster drill, where the resumed solo world read
+    # the 2-process world's entries and NaN'd within three steps
+    compile_cache.rescope_persistent_cache()
 
 
 def is_initialized():

@@ -1,0 +1,370 @@
+"""Cluster elastic-resume drill harness (ISSUE 13).
+
+``member`` mode is one host of a cluster training run:
+
+* joins the ClusterMaster (TCP), heartbeats on a lease;
+* multi-member worlds init ``jax.distributed`` (gloo) and train a
+  fixed-seed MLP on the GLOBAL ``(dp=1, fsdp=N*devs)`` mesh, feeding
+  each host's slice of the same deterministic global batches;
+* every dispatch goes through the master's **step barrier**
+  (``enter_step``) — lockstep SPMD members never enter a collective
+  with a dead peer: a death surfaces as a lease expiry and the barrier
+  answers ``reshape`` instead of hanging an all-reduce;
+* checkpoints are per-host SHARDED TrainState artifacts (sync saves;
+  the manifest committer is master-elected via ``request_save``);
+* on ``reshape`` with itself as the only survivor, the member RE-EXECS
+  into a single-host world: fresh jax runtime, the mesh rebuilt at the
+  new (smaller) size, state restored from the last committed step
+  through ``ParallelExecutor.state_shardings()`` — elastic resume with
+  no operator action;
+* a designated victim SIGKILLs itself at a step boundary (mid-run,
+  between checkpoint commits).
+
+``supervise`` mode (also importable: ``supervise()``) runs the whole
+drill — reference solo run, 2-member world, kill, elastic resume — and
+checks the acceptance criteria: every logged step loss within the
+parity band of the uninterrupted smaller-mesh reference, and per-host
+shard bytes ~1/N in the committed manifest.
+
+Run:  python cluster_runner.py supervise <workdir>
+      python cluster_runner.py member <id> <n> <master> <coordinator>
+             <ckpt> <log> <total> <kill_step> <devs_per_host>
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOTAL_STEPS = 12
+KILL_STEP = 8
+SAVE_INTERVAL = 3
+# generous vs the ~1.3s heartbeat cadence: a member's heartbeat thread
+# can starve for a beat behind a cold XLA compile on a loaded box, and
+# a spurious mid-compile expiry turns the drill into a reshape storm
+LEASE_SECONDS = 4.0
+BATCH = 16
+# mesh-size-change parity band: fsdp reduce order differs between mesh
+# sizes, so losses match to float noise, not bitwise, and Adam
+# compounds the noise step over step (PR 5 measured ~1e-6 over 3 Adam
+# steps; measured here ~2e-5 over 12 steps at lr 2e-3 — an aggressive
+# lr amplifies reduce-order noise chaotically, x30/step at lr 1e-2)
+PARITY_RTOL = 1e-3
+
+
+def _global_batch(step):
+    import numpy as np
+
+    rng = np.random.RandomState(1000 + step)
+    x = rng.rand(BATCH, 64).astype("float32")
+    y = x[:, :4].argmax(1).astype("int64").reshape(-1, 1)
+    return x, y
+
+
+def member_main(argv):
+    (member_id, nmembers, master_addr, coordinator, ckpt_dir, log_path,
+     total, kill_step, devs) = (int(argv[0]), int(argv[1]), argv[2],
+                                argv[3], argv[4], argv[5], int(argv[6]),
+                                int(argv[7]), int(argv[8]))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=%d" % devs)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, REPO)
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.cluster import ClusterMember
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.checkpoint import TrainStateCheckpointManager
+
+    if nmembers > 1:
+        # init_distributed (not raw jax.distributed.initialize): it
+        # re-scopes the persistent XLA cache per world shape, so the
+        # elastic-resume survivor never deserializes this 2-process
+        # world's executables into its solo world
+        from paddle_tpu.parallel import distributed
+
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        distributed.init_distributed(coordinator_address=coordinator,
+                                     num_processes=nmembers,
+                                     process_id=member_id)
+
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.layers.data("x", shape=[64])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=256, act="relu")
+    pred = fluid.layers.fc(h, size=4, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(pred, label))
+    lr = fluid.layers.exponential_decay(2e-3, decay_steps=4,
+                                        decay_rate=0.8)
+    fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+
+    member = ClusterMember(master_addr, "host%d" % member_id,
+                           meta={"devices": devs})
+    mesh = make_mesh((1, len(jax.devices())), ("dp", "fsdp"))
+    bs = fluid.BuildStrategy()
+    bs.sharding_rules = True
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                    build_strategy=bs)
+        mgr = TrainStateCheckpointManager(
+            ckpt_dir, sharded=True, async_save=False,
+            save_interval_steps=SAVE_INTERVAL,
+            saver_elect=member.request_save, commit_timeout=60.0)
+        step = mgr.restore(scope=scope,
+                           program=fluid.default_main_program(),
+                           executors={"train": pe},
+                           shardings=pe.state_shardings())
+        if step is None:
+            step = 0
+        else:
+            print("RESUMED", step, "mesh", len(jax.devices()),
+                  flush=True)
+        log = open(log_path, "a") if member_id == 0 else None
+
+        # wait for the full world to form before the first barrier, so
+        # the join-order epoch bumps are absorbed up front
+        deadline = time.monotonic() + 60.0
+        while nmembers > 1 and len(member.members) < nmembers:
+            if time.monotonic() > deadline:
+                raise RuntimeError("world never formed: %s"
+                                   % member.members)
+            member.heartbeat()
+            time.sleep(0.05)
+
+        while step < total:
+            step += 1
+            while True:
+                res = member.enter_step(step, timeout=90.0)
+                if res["action"] != "reshape":
+                    break
+                survivors = member.members
+                if len(survivors) >= nmembers:
+                    # benign epoch move (a join at world formation):
+                    # same world size, nothing to rebuild — accept THE
+                    # VIEW WE SAW (not the latest observed epoch, which
+                    # the heartbeat thread may advance concurrently)
+                    member.accept_world(res["epoch"])
+                    continue
+                print("RESHAPE epoch", member.epoch, "members",
+                      survivors, flush=True)
+                if survivors != ["host%d" % member_id]:
+                    # a multi-survivor reshape needs a fresh gloo world
+                    # — out of this drill's scope
+                    print("RESHAPE_UNSUPPORTED", survivors, flush=True)
+                    sys.exit(3)
+                if log is not None:
+                    log.close()
+                member.close()
+                # elastic resume: re-exec into a single-host world — a
+                # fresh jax runtime over this host's local devices; the
+                # restore above rebuilds state on the smaller mesh
+                os.execv(sys.executable, [
+                    sys.executable, os.path.abspath(__file__), "member",
+                    str(member_id), "1", master_addr, "-", ckpt_dir,
+                    log_path, str(total), "0", str(devs)])
+            assert res["action"] == "go", res
+
+            xg, yg = _global_batch(step)
+            lo = member_id * (BATCH // nmembers)
+            hi = lo + BATCH // nmembers
+            (lv,) = pe.run(feed={"x": xg[lo:hi], "label": yg[lo:hi]},
+                           fetch_list=[loss])
+            lv = np.asarray(lv, "float32")
+            if log is not None:
+                log.write(json.dumps(
+                    {"step": step, "loss_hex": lv.tobytes().hex(),
+                     "loss": float(lv.ravel()[0]),
+                     "mesh": len(jax.devices())}) + "\n")
+                log.flush()
+                os.fsync(log.fileno())
+            mgr.save(step, scope=scope,
+                     program=fluid.default_main_program(),
+                     executors={"train": pe})
+            if kill_step and step == kill_step \
+                    and member_id == nmembers - 1:
+                print("KILLING_SELF", step, flush=True)
+                os.kill(os.getpid(), signal.SIGKILL)
+        mgr.wait_until_finished()
+        print("DONE", step, flush=True)
+        member.leave()
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+def _member_cmd(member_id, nmembers, master, coordinator, ckpt, log,
+                total, kill_step, devs):
+    return [sys.executable, os.path.abspath(__file__), "member",
+            str(member_id), str(nmembers), master, coordinator,
+            str(ckpt), str(log), str(total), str(kill_step), str(devs)]
+
+
+def _member_env():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)      # member mode sets its own count
+    # NO persistent compile cache: deserialized MULTI-DEVICE CPU
+    # executables are numerically NONDETERMINISTIC (measured here:
+    # warm replays of one artifact drifted 1e-3..1e-1 run to run,
+    # fresh compiles are bit-exact) — a parity drill cannot ride them.
+    # Single-device warm restarts (test_elastic_drill) stay exact.
+    env.pop("FLAGS_compile_cache_dir", None)
+    return env
+
+
+def _read_log(log_path):
+    """step -> {loss_hex values seen} + step -> [float losses]."""
+    hexes, losses = {}, {}
+    with open(log_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            hexes.setdefault(rec["step"], set()).add(rec["loss_hex"])
+            losses.setdefault(rec["step"], []).append(rec["loss"])
+    return hexes, losses
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def supervise(workdir, total_steps=TOTAL_STEPS, kill_step=KILL_STEP,
+              devs=4, timeout=420.0):
+    """Run the full drill; returns the evidence dict (asserting the
+    acceptance criteria along the way)."""
+    sys.path.insert(0, REPO)
+    import numpy as np
+    from paddle_tpu.cluster import ClusterMaster
+    from paddle_tpu.cloud import MasterServer
+
+    workdir = os.path.abspath(str(workdir))
+    os.makedirs(workdir, exist_ok=True)
+
+    # reference: an UNINTERRUPTED solo run on the small mesh (its own
+    # master so its membership never perturbs the drill's epochs)
+    ref_srv = MasterServer(
+        ClusterMaster(lease_timeout=LEASE_SECONDS)).start()
+    ref_log = os.path.join(workdir, "ref.jsonl")
+    p = subprocess.run(
+        _member_cmd(0, 1, ref_srv.address, "-",
+                    os.path.join(workdir, "ref_ckpt"), ref_log,
+                    total_steps, 0, devs),
+        env=_member_env(), capture_output=True, text=True,
+        timeout=timeout)
+    ref_srv.shutdown()
+    assert p.returncode == 0, (p.returncode, p.stderr[-4000:])
+    ref_hexes, ref_losses = _read_log(ref_log)
+    assert sorted(ref_hexes) == list(range(1, total_steps + 1))
+
+    # the drill world: 2 members, one global mesh, shared sharded ckpt
+    master = ClusterMaster(lease_timeout=LEASE_SECONDS)
+    srv = MasterServer(master).start()
+    ckpt = os.path.join(workdir, "ckpt")
+    log = os.path.join(workdir, "drill.jsonl")
+    coordinator = "127.0.0.1:%d" % _free_port()
+    procs = [subprocess.Popen(
+        _member_cmd(i, 2, srv.address, coordinator, ckpt, log,
+                    total_steps, kill_step, devs),
+        env=_member_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for i in range(2)]
+    try:
+        out1, err1 = procs[1].communicate(timeout=timeout)
+        out0, err0 = procs[0].communicate(timeout=timeout)
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+    assert procs[1].returncode == -signal.SIGKILL, (
+        procs[1].returncode, err1[-4000:])
+    assert "KILLING_SELF %d" % kill_step in out1, out1[-2000:]
+    assert procs[0].returncode == 0, (procs[0].returncode,
+                                      err0[-4000:])
+    # the survivor observed the lease expiry, reshaped, resumed solo
+    assert "RESHAPE epoch" in out0, out0[-2000:]
+    assert "RESUMED" in out0, out0[-2000:]
+    resumed_from = int(out0.split("RESUMED")[1].split()[0])
+    assert 0 < resumed_from <= kill_step, (resumed_from, out0[-2000:])
+    assert "DONE %d" % total_steps in out0, out0[-2000:]
+    srv.shutdown()
+
+    # parity: every logged loss (2-member mesh, replayed, resumed) sits
+    # in the float-noise band of the uninterrupted small-mesh run
+    hexes, losses = _read_log(log)
+    assert sorted(hexes) == list(range(1, total_steps + 1)), \
+        sorted(hexes)
+    max_rel = 0.0
+    for step, vals in losses.items():
+        ref = ref_losses[step][0]
+        for v in vals:
+            assert np.isfinite(v), (step, v)
+            max_rel = max(max_rel, abs(v - ref) / max(abs(ref), 1e-9))
+    assert max_rel <= PARITY_RTOL, (
+        "loss trajectory out of the parity band: max rel dev %g"
+        % max_rel)
+
+    # manifest-verified 1/N per-host bytes: a world-A artifact
+    # (writers=2) must exist with both hosts contributing ~half
+    two_writer = None
+    for d in sorted(os.listdir(ckpt)):
+        mf = os.path.join(ckpt, d, "MANIFEST.json")
+        if d.startswith("step_") and os.path.exists(mf):
+            man = json.load(open(mf))
+            if man.get("writers") == 2:
+                two_writer = (d, man)
+    assert two_writer is not None, os.listdir(ckpt)
+    pw = two_writer[1]["per_writer_bytes"]
+    total_bytes = sum(pw.values())
+    max_frac = max(pw.values()) / total_bytes
+    assert len(pw) == 2 and max_frac < 0.7, (pw, max_frac)
+
+    return {"resumed_from": resumed_from,
+            "max_rel_loss_dev": max_rel,
+            "parity_rtol": PARITY_RTOL,
+            "sharded_artifact": two_writer[0],
+            "per_writer_bytes": pw,
+            "max_writer_fraction": max_frac,
+            "steps": total_steps, "kill_step": kill_step}
+
+
+def main():
+    mode = sys.argv[1]
+    if mode == "member":
+        member_main(sys.argv[2:])
+    elif mode == "supervise":
+        evidence = supervise(sys.argv[2],
+                             *[int(a) for a in sys.argv[3:]])
+        print("CLUSTER_DRILL", json.dumps(evidence))
+        print("CLUSTER_DRILL OK: survivor resumed from step %d on the "
+              "smaller mesh; max loss deviation %.2e (band %.0e); "
+              "per-host shard bytes %s (max fraction %.3f)"
+              % (evidence["resumed_from"], evidence["max_rel_loss_dev"],
+                 evidence["parity_rtol"],
+                 evidence["per_writer_bytes"],
+                 evidence["max_writer_fraction"]))
+    else:
+        raise SystemExit("unknown mode %r" % mode)
+
+
+if __name__ == "__main__":
+    main()

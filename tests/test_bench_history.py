@@ -263,3 +263,36 @@ def test_longctx_ring_rung_indexes_informational(tmp_path):
     assert all(c["informational"] for c in ring_cmp)
     assert last["verdict"] == "PASS"      # informational: never gates
     assert report["overall"] == "PASS"
+
+
+def test_ckpt_sharded_rung_save_wall_indexed_but_non_gating(tmp_path):
+    """ISSUE 13: the per-host sharded checkpoint rung's save wall-clock
+    is indexed and judged against prior history (lower is better), but
+    the rung is informational (disk-bound) — a slower save never flips
+    the overall verdict."""
+    def ckpt(wall):
+        return _rung("ckpt_sharded_per_host_save", wall,
+                     informational=True, save_wall_s=wall,
+                     state_bytes=50_000_000,
+                     per_host={"4": {"wall_s": wall}})
+
+    r1 = {"metric": "resnet", "value": 100.0, "unit": "img/s",
+          "vs_baseline": 1.0, "min_step_s": 0.5, "n_windows": 3,
+          "extra_metrics": [ckpt(0.09)]}
+    r2 = copy.deepcopy(r1)
+    r2["extra_metrics"] = [ckpt(0.50)]       # 5x slower per-host save
+    paths = [_write(tmp_path, "a.json", _wrapper(1, r1)),
+             _write(tmp_path, "b.json", _wrapper(2, r2))]
+    report = bench_history.compare(
+        [bench_history.load_artifact(p, i)
+         for i, p in enumerate(paths)])
+    runs = {r["run"]: r for r in report["runs"]}
+    rec = [g for g in runs["r02"]["rungs"]
+           if g["metric"] == "ckpt_sharded_per_host_save"][0]
+    assert rec["save_wall_s"] == 0.50
+    judged = {c["field"]: c for c in runs["r02"]["comparisons"]
+              if c["metric"] == "ckpt_sharded_per_host_save"}
+    assert judged["save_wall_s"]["verdict"] == "REGRESSED"
+    assert judged["save_wall_s"]["informational"]
+    assert runs["r02"]["verdict"] == "PASS"
+    assert report["overall"] == "PASS"

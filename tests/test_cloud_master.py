@@ -140,6 +140,117 @@ def test_snapshot_recover_preserves_leases_and_deadlines(tmp_path):
     assert tb.task_id in ids
 
 
+def test_recovered_lease_keeps_original_deadline_not_rearmed(tmp_path):
+    """Store recovery preserves the LIVE deadline exactly: a lease with
+    20s left must expire 20s later — not lease-timeout seconds after
+    the new master came up (the go original re-arms nothing; we must
+    not silently re-arm either)."""
+    clk = FakeClock()
+    store = FileStore(tmp_path / "snap.json")
+    svc = MasterService(store=store, timeout=30.0, clock=clk)
+    svc.set_dataset(["a", "b"])
+    t = svc.get_task(0)            # deadline = t0 + 30
+    clk.advance(10.0)
+
+    svc2 = MasterService(store=store, timeout=30.0, clock=clk)
+    clk.advance(15.0)              # t0+25: inside the ORIGINAL window
+    with pytest.raises(NoMoreAvailable):
+        # 'b' leased here; 'a' must still be pending, NOT requeued
+        svc2.get_task(0)
+        svc2.get_task(0)
+    assert svc2.stats()["pending"] == 2
+    clk.advance(6.0)               # t0+31: past the original deadline
+    t2 = svc2.get_task(0)
+    assert t2.task_id == t.task_id and t2.epoch == t.epoch + 1
+
+
+def test_concurrent_lease_churn_stale_epochs_never_revoke():
+    """Thread drill (the lease-expiry vs fresh-dispatch race): workers
+    lease/finish/fail under a REAL clock with a tiny timeout while a
+    saboteur replays stale ``task_failed`` reports for every lease ever
+    observed.  Invariants: no crash, the task population is conserved
+    across all queues, and the service still drains to a pass rollover
+    afterwards — a stale epoch revoking a re-leased task would surface
+    as a lost/duplicated task or a spurious failure count."""
+    import random
+    import threading
+    import time as _time
+
+    svc = make_service(timeout=0.03, clock=time.time, failure_max=10**6)
+    ntasks = 6
+    svc.set_dataset(list(range(ntasks)))
+    stop = threading.Event()
+    seen = []                     # every (task_id, epoch) ever leased
+    errors = []
+
+    def worker(seed):
+        rng = random.Random(seed)
+        while not stop.is_set():
+            try:
+                t = svc.get_task(None)
+            except (NoMoreAvailable, AllTasksFailed):
+                _time.sleep(0.002)
+                continue
+            except Exception as e:  # noqa: BLE001 — drill invariant
+                errors.append(e)
+                return
+            seen.append((t.task_id, t.epoch))
+            # some leases intentionally outlive the timeout so they
+            # expire and re-dispatch under live contention
+            _time.sleep(rng.uniform(0.0, 0.05))
+            try:
+                if rng.random() < 0.5:
+                    svc.task_finished(t.task_id)
+                else:
+                    svc.task_failed(t.task_id, t.epoch)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    def saboteur():
+        rng = random.Random(99)
+        while not stop.is_set():
+            if seen:
+                tid, ep = rng.choice(seen)
+                try:
+                    # strictly stale AND possibly-current replays: the
+                    # epoch guard must drop every stale one silently
+                    svc.task_failed(tid, ep - 1)
+                    svc.task_failed(tid, ep)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+                    return
+            _time.sleep(0.001)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(4)] + [threading.Thread(target=saboteur)]
+    for th in threads:
+        th.start()
+    _time.sleep(1.2)
+    stop.set()
+    for th in threads:
+        th.join(timeout=10)
+    assert not errors, errors[:3]
+    st = svc.stats()
+    assert st["todo"] + st["pending"] + st["done"] + st["failed"] \
+        == ntasks, st
+
+    # quiesce: expire any straggler leases, then the service must still
+    # drain cleanly to a pass rollover (no task lost or duplicated)
+    _time.sleep(0.05)
+    start_pass = svc.stats()["cur_pass"]
+    deadline = _time.monotonic() + 30
+    while svc.stats()["cur_pass"] == start_pass:
+        assert _time.monotonic() < deadline, svc.stats()
+        try:
+            t = svc.get_task(None)
+        except NoMoreAvailable:
+            _time.sleep(0.002)
+            continue
+        svc.task_finished(t.task_id)
+    assert svc.stats()["todo"] == ntasks
+
+
 def test_set_dataset_idempotent_after_recovery(tmp_path):
     store = FileStore(tmp_path / "snap.json")
     svc = MasterService(store=store)

@@ -1,0 +1,711 @@
+"""Cluster runtime tests (ISSUE 13): ClusterMaster membership/epochs,
+verdict arbitration, saver election, the step barrier, ClusterGuardian
+bridging, member-context event stamping, and the per-host sharded
+TrainState artifact IO (1/N bytes, bit-identical round trips,
+corruption detection).  The multiprocess kill drill lives in
+``test_cluster_drill.py`` (slow); this file is tier-1."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import paddle_tpu as fluid
+from paddle_tpu import guardian, monitor
+from paddle_tpu.cloud import FileStore, InMemStore, MasterServer
+from paddle_tpu.cluster import (ClusterGuardian, ClusterMaster,
+                                ClusterMember, local_context,
+                                local_member, set_local_member)
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.checkpoint import (
+    CheckpointCorruptError, TrainStateCheckpointManager,
+    capture_train_state, commit_sharded_train_state, load_train_state,
+    partition_shards, save_train_state_sharded, write_train_state_shards)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# membership / epochs / leases
+# ---------------------------------------------------------------------------
+
+def test_join_heartbeat_expiry_bumps_epoch():
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=10.0, clock=clk)
+    v0 = cm.join("a")
+    assert v0["epoch"] == 1 and v0["members"] == ["a"]
+    v1 = cm.join("b")
+    assert v1["epoch"] == 2 and v1["members"] == ["a", "b"]
+    # a re-join of a live member renews, does NOT bump
+    assert cm.join("b")["epoch"] == 2
+    # heartbeats keep the lease alive across the timeout
+    clk.advance(8.0)
+    cm.heartbeat("a")
+    clk.advance(8.0)
+    v = cm.heartbeat("a")     # b silent for 16s > 10s: expired
+    assert v["members"] == ["a"] and v["epoch"] == 3
+    # the expired member is told to rejoin
+    assert cm.heartbeat("b").get("rejoin") is True
+    assert cm.join("b")["epoch"] == 4
+
+
+def test_leave_bumps_epoch_and_membership_view():
+    cm = ClusterMaster(lease_timeout=10.0, clock=FakeClock())
+    cm.join("a")
+    cm.join("b")
+    v = cm.leave("b")
+    assert v["epoch"] == 3 and v["members"] == ["a"]
+    m = cm.membership()
+    assert sorted(m["members"]) == ["a"]
+
+
+def test_store_recovery_preserves_membership_and_deadlines(tmp_path):
+    clk = FakeClock()
+    store = FileStore(tmp_path / "cluster.json")
+    cm = ClusterMaster(store=store, lease_timeout=10.0, clock=clk)
+    cm.join("a")
+    cm.join("b")
+    clk.advance(6.0)
+    cm.heartbeat("a")          # a renewed at t+6; b's deadline is t+10
+
+    # master dies; a new master over the same store resumes epochs AND
+    # the live deadlines (the recovered master honors the dead one's
+    # leases — it does NOT re-arm them to a fresh timeout)
+    cm2 = ClusterMaster(store=store, lease_timeout=10.0, clock=clk)
+    assert cm2.membership()["epoch"] == 2
+    assert sorted(cm2.membership()["members"]) == ["a", "b"]
+    clk.advance(5.0)           # t+11: past b's ORIGINAL deadline only
+    v = cm2.heartbeat("a")
+    assert v["members"] == ["a"] and v["epoch"] == 3
+
+
+# ---------------------------------------------------------------------------
+# verdict arbitration
+# ---------------------------------------------------------------------------
+
+def test_verdict_arbitration_first_wins_until_retired():
+    cm = ClusterMaster(lease_timeout=10.0, clock=FakeClock())
+    cm.join("a")
+    cm.join("b")
+    cmd = cm.propose_verdict("a", 7, "rollback", "nan")
+    assert cmd["origin"] == "a" and cmd["step"] == 7 and cmd["seq"] == 1
+    # a later (even conflicting) proposal returns THE active command
+    cmd2 = cm.propose_verdict("b", 9, "abort", "stall")
+    assert cmd2 == dict(cmd)
+    # proposer and late proposer are auto-acked -> retired -> a new
+    # incident arbitrates fresh
+    assert cm.stats()["active_command"] is None
+    cmd3 = cm.propose_verdict("b", 20, "abort", "stall")
+    assert cmd3["seq"] == 2 and cmd3["origin"] == "b"
+
+
+def test_poll_ack_delivery_and_retirement():
+    cm = ClusterMaster(lease_timeout=10.0, clock=FakeClock())
+    cm.join("a")
+    cm.join("b")
+    cmd = cm.propose_verdict("a", 3, "rollback", "spike")
+    # b sees it exactly until it acks; a (auto-acked) does not
+    assert cm.poll_command("a") is None
+    got = cm.poll_command("b")
+    assert got["seq"] == cmd["seq"]
+    assert cm.ack_command("b", cmd["seq"]) is True
+    assert cm.poll_command("b") is None
+    assert cm.stats()["active_command"] is None
+
+
+def test_dead_member_cannot_pin_a_command():
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=10.0, clock=clk)
+    cm.join("a")
+    cm.join("b")
+    cm.propose_verdict("a", 3, "rollback", "spike")
+    assert cm.stats()["active_command"] is not None   # b never acked
+    clk.advance(11.0)          # b dies; the sweep retires the command
+    cm.heartbeat("a")
+    assert cm.stats()["active_command"] is None
+
+
+def test_invalid_verdict_kind_rejected():
+    cm = ClusterMaster(clock=FakeClock())
+    cm.join("a")
+    with pytest.raises(ValueError):
+        cm.propose_verdict("a", 1, "skip", "nope")
+
+
+# ---------------------------------------------------------------------------
+# saver election + step barrier
+# ---------------------------------------------------------------------------
+
+def test_saver_election_one_committer_per_step():
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=10.0, clock=clk)
+    cm.join("a")
+    cm.join("b")
+    assert cm.request_save("a", 5) is True
+    assert cm.request_save("b", 5) is False
+    assert cm.request_save("a", 5) is True    # idempotent for the winner
+    # a NEW step elects fresh (possibly a different host)
+    assert cm.request_save("b", 10) is True
+    assert cm.request_save("a", 10) is False
+
+
+def test_step_barrier_go_wait_reshape_and_command():
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=10.0, clock=clk)
+    ea = cm.join("a")["epoch"]
+    eb = cm.join("b")["epoch"]
+    # a joined before b: its epoch is stale -> told to reshape (absorb)
+    assert cm.enter_step("a", 1, ea)["action"] == "reshape"
+    ea = eb
+    assert cm.enter_step("a", 1, ea)["action"] == "wait"
+    assert cm.enter_step("b", 1, eb)["action"] == "go"
+    assert cm.enter_step("a", 1, ea)["action"] == "go"
+    # an arbitration verdict is delivered at the barrier, once, until
+    # acked
+    cmd = cm.propose_verdict("b", 1, "rollback", "nan")
+    res = cm.enter_step("a", 2, ea)
+    assert res["action"] == "command" and res["command"]["seq"] == \
+        cmd["seq"]
+    cm.ack_command("a", cmd["seq"])
+    assert cm.enter_step("a", 2, ea)["action"] == "wait"
+    # a member death surfaces as reshape at the barrier, never a hang
+    clk.advance(6.0)
+    cm.heartbeat("a")          # a stays live; b goes silent
+    clk.advance(6.0)           # b's lease (10s) lapses
+    res = cm.enter_step("a", 3, ea)
+    assert res["action"] == "reshape" and res["members"] == ["a"]
+
+
+def test_cluster_member_session_over_tcp():
+    srv = MasterServer(ClusterMaster(lease_timeout=5.0)).start()
+    try:
+        a = ClusterMember(srv.address, "a", auto_heartbeat=False,
+                          register_local=False)
+        b = ClusterMember(srv.address, "b", auto_heartbeat=False,
+                          register_local=False)
+        # a's world epoch predates b's join: the barrier says reshape
+        # until a explicitly accepts the new view
+        res = a.enter_step(1, timeout=5)
+        if res["action"] == "reshape":
+            a.accept_world(res["epoch"])
+        r_b = b.enter_step(1, timeout=5)
+        assert r_b["action"] == "go"
+        assert a.enter_step(1, timeout=5)["action"] == "go"
+        assert sorted(a.members) == ["a", "b"]
+        assert b.request_save(1) in (True, False)
+        b.leave()
+        a.heartbeat()
+        assert a.members == ["a"]
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ClusterGuardian: verdicts win cluster-wide
+# ---------------------------------------------------------------------------
+
+def _member(cm, host):
+    return ClusterMember(cm, host, auto_heartbeat=False,
+                         register_local=False)
+
+
+def test_cluster_guardian_local_escalation_becomes_cluster_command():
+    cm = ClusterMaster(lease_timeout=30.0, clock=FakeClock())
+    ma, mb = _member(cm, "a"), _member(cm, "b")
+    ga = ClusterGuardian(ma, policy="rollback,abort")
+    gb = ClusterGuardian(mb, policy="rollback,abort")
+    # host a observes a non-finite loss -> proposes -> raises the
+    # arbitrated command
+    with pytest.raises(guardian.GuardianRollback) as ra:
+        ga.note_step("exe", 7, ok=None, fetch_names=("loss",),
+                     fetches=(np.float32("nan"),), sync=True)
+    assert ra.value.step == 7 and "cluster[a]" in ra.value.reason
+    # host b sees only CLEAN steps — the remote verdict still reaches
+    # its ladder at the next step boundary, as the SAME rollback
+    with pytest.raises(guardian.GuardianRollback) as rb:
+        gb.note_step("exe", 8, ok=None, fetch_names=("loss",),
+                     fetches=(np.float32(1.0),), sync=True)
+    assert rb.value.step == 7 and "cluster[a]" in rb.value.reason
+    # both applied -> the command retired
+    assert cm.stats()["active_command"] is None
+
+
+def test_cluster_guardian_abort_kind_propagates():
+    cm = ClusterMaster(lease_timeout=30.0, clock=FakeClock())
+    ma, mb = _member(cm, "a"), _member(cm, "b")
+    # host a's ladder has NO rollback rung: it proposes an abort; b's
+    # ladder has one, but the CLUSTER decision wins over local policy
+    ga = ClusterGuardian(ma, policy="abort")
+    gb = ClusterGuardian(mb, policy="rollback,abort")
+    with pytest.raises(guardian.GuardianAbortError):
+        ga.note_step("exe", 4, ok=None, fetch_names=("loss",),
+                     fetches=(np.float32("inf"),), sync=True)
+    with pytest.raises(guardian.GuardianAbortError):
+        gb.note_step("exe", 5, ok=None, fetch_names=("loss",),
+                     fetches=(np.float32(1.0),), sync=True)
+
+
+def test_guardian_and_stall_events_carry_member_context(tmp_path):
+    cm = ClusterMaster(lease_timeout=30.0, clock=FakeClock())
+    m = ClusterMember(cm, "host7", auto_heartbeat=False)   # registers
+    try:
+        assert local_member() is m
+        assert local_context() == {"member_id": "host7",
+                                   "membership_epoch": m.epoch}
+        monitor.enable(log_dir=str(tmp_path))
+        guardian.Guardian._event({"event": "guardian_rollback",
+                                  "step": 3})
+        monitor._stall_sink({"event": "watchdog_stall", "ts": 0.0,
+                             "stalled_for_s": 1.0})
+        monitor.disable()
+        recs = []
+        for fn in os.listdir(tmp_path):
+            with open(os.path.join(tmp_path, fn)) as f:
+                recs += [json.loads(ln) for ln in f if ln.strip()]
+        by_event = {r["event"]: r for r in recs}
+        for ev in ("guardian_rollback", "watchdog_stall"):
+            assert by_event[ev]["member_id"] == "host7", by_event[ev]
+            assert by_event[ev]["membership_epoch"] == m.epoch
+    finally:
+        monitor.disable()
+        m.close()
+    assert local_member() is None     # close() deregisters
+
+
+# ---------------------------------------------------------------------------
+# per-host sharded TrainState artifacts
+# ---------------------------------------------------------------------------
+
+def _build_mlp(seed=7):
+    fluid.default_main_program().random_seed = seed
+    fluid.default_startup_program().random_seed = seed
+    x = fluid.layers.data("x", shape=[16])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=32, act="relu")
+    pred = fluid.layers.fc(h, size=4, act=None)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return loss
+
+
+def _train_steps(pe, loss, steps=2):
+    for i in range(steps):
+        x = np.random.RandomState(i).rand(8, 16).astype("float32")
+        y = x[:, :4].argmax(1).astype("int64").reshape(-1, 1)
+        pe.run(feed={"x": x, "label": y}, fetch_list=[loss])
+
+
+# one cached (ts, full) capture for the pure-IO tests (round trips,
+# partitioning, commit timeout): the fsdp PE build+train costs ~2.5s,
+# and those tests only read the captured numpy data — tests that need
+# a LIVE world (manager saves, corrupt fallback) build their own
+_CAPTURE = []
+
+
+def _cached_capture(tmp_path):
+    if not _CAPTURE:
+        _, _, ts, full, _ = _mesh_scope_state(tmp_path)
+        _CAPTURE.append((ts, full))
+    return _CAPTURE[0]
+
+
+def _mesh_scope_state(tmp_path, writers=1):
+    """Train 2 steps on a (1,4) fsdp mesh, capture sharded; returns
+    (scope, pe, sharded ts, full reference arrays)."""
+    from paddle_tpu.parallel.checkpoint import (_gather_host,
+                                                _persistable_state)
+
+    loss = _build_mlp()
+    mesh = make_mesh((1, 4), ("dp", "fsdp"))
+    bs = fluid.BuildStrategy()
+    bs.sharding_rules = True
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                    build_strategy=bs)
+        _train_steps(pe, loss)
+        ts = capture_train_state(2, scope=scope, executors=pe,
+                                 sharded=True)
+        full = {n: _gather_host(v) for n, v in _persistable_state(
+            scope, fluid.default_main_program()).items()}
+    return scope, pe, ts, full, loss
+
+
+def test_sharded_capture_owns_disjoint_covering_shards(tmp_path):
+    ts, full = _cached_capture(tmp_path)
+    assert ts.arrays is None and ts.shards
+    seen = {n: 0 for n in full}
+    for e in ts.shards:
+        seen[e["name"]] += e["data"].size
+    for n, arr in full.items():
+        assert seen[n] == arr.size, (n, seen[n], arr.size)
+
+
+def test_sharded_single_host_roundtrip_bit_identical(tmp_path):
+    """Acceptance: single-host restore of a sharded artifact
+    round-trips bit-identical."""
+    ts, full = _cached_capture(tmp_path)
+    ck = str(tmp_path / "step_0000000002")
+    save_train_state_sharded(ck, ts, writer_id=0, writers=1, saver=True)
+    loaded = load_train_state(ck)
+    assert sorted(loaded.arrays) == sorted(full)
+    for n, v in full.items():
+        np.testing.assert_array_equal(loaded.arrays[n], v, err_msg=n)
+    assert loaded.host["executors"]["executor0"] == \
+        ts.host["executors"]["executor0"]
+
+
+def test_partition_shards_bytes_scale_inverse_n(tmp_path):
+    """Acceptance: per-host bytes written scale as ~1/N (manifest-
+    verified), and the N-writer artifact round-trips bit-identically."""
+    ts, full = _cached_capture(tmp_path)
+    ck = str(tmp_path / "v4" / "step_0000000002")
+    os.makedirs(os.path.dirname(ck))
+    parts = partition_shards(ts, 4)
+    for w, entries in enumerate(parts):
+        write_train_state_shards(ck, ts, w, entries=entries)
+    commit_sharded_train_state(ck, ts, 4)
+    man = json.load(open(os.path.join(ck, "MANIFEST.json")))
+    per = man["per_writer_bytes"]
+    total = sum(per.values())
+    assert len(per) == 4
+    assert max(per.values()) / total < 0.35, per     # ~0.25 each
+    loaded = load_train_state(ck)
+    for n, v in full.items():
+        np.testing.assert_array_equal(loaded.arrays[n], v, err_msg=n)
+
+
+def test_sharded_commit_times_out_on_missing_writer(tmp_path):
+    ts, _ = _cached_capture(tmp_path)
+    ck = str(tmp_path / "step_0000000002")
+    write_train_state_shards(ck, ts, 0)
+    with pytest.raises(CheckpointCorruptError, match="never delivered"):
+        commit_sharded_train_state(ck, ts, 2, timeout=0.2)
+    # nothing committed: the artifact is invisible to restores
+    assert not os.path.exists(os.path.join(ck, "MANIFEST.json"))
+
+
+def test_sharded_corrupt_shard_detected_and_fallback(tmp_path):
+    """A garbled shard file fails its sha256; the manager falls back to
+    the previous committed artifact (same contract as the full path)."""
+    scope, pe, ts, full, loss = _mesh_scope_state(tmp_path)
+    mgr = TrainStateCheckpointManager(str(tmp_path / "mgr"),
+                                      sharded=True, async_save=False)
+    with fluid.scope_guard(scope):
+        mgr.save(2, scope=scope, program=fluid.default_main_program(),
+                 executors={"train": pe})
+        _train_steps(pe, loss)
+        mgr._last_saved = None
+        mgr.save(4, scope=scope, program=fluid.default_main_program(),
+                 executors={"train": pe})
+    assert mgr.all_steps() == [2, 4]
+    shard = os.path.join(mgr._step_dir(4), "shard_00000.npz")
+    with open(shard, "r+b") as f:
+        f.seek(12)
+        f.write(b"\xff" * 32)
+    with pytest.raises(CheckpointCorruptError):
+        mgr.load(4)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        with pytest.warns(UserWarning, match="corrupt"):
+            step = mgr.restore(scope=scope2,
+                               program=fluid.default_main_program())
+    assert step == 2
+    for n, v in full.items():
+        np.testing.assert_array_equal(np.asarray(scope2.var(n)), v,
+                                      err_msg=n)
+
+
+def test_manager_saver_election_gates_commit(tmp_path):
+    """A non-elected host writes its shards but never the manifest; the
+    artifact becomes visible only when the elected saver commits."""
+    scope, pe, ts, _, _ = _mesh_scope_state(tmp_path)
+    mgr = TrainStateCheckpointManager(
+        str(tmp_path / "mgr"), sharded=True, async_save=False,
+        saver_elect=lambda step: False)
+    with fluid.scope_guard(scope):
+        mgr.save(2, scope=scope, program=fluid.default_main_program())
+    assert mgr.all_steps() == []          # shards written, no commit
+    mgr2 = TrainStateCheckpointManager(
+        str(tmp_path / "mgr2"), sharded=True, async_save=False,
+        saver_elect=lambda step: True)
+    with fluid.scope_guard(scope):
+        mgr2.save(2, scope=scope, program=fluid.default_main_program())
+    assert mgr2.all_steps() == [2]
+
+
+def test_full_capture_path_unchanged_single_host(tmp_path):
+    """The single-host full-artifact path stays the default: capture
+    without sharded gives full arrays and the classic layout."""
+    loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(
+            fluid.default_startup_program())
+        mgr = TrainStateCheckpointManager(str(tmp_path / "m"),
+                                          async_save=False)
+        assert mgr.sharded_mode() is False     # 1 process -> full
+        mgr.save(1, scope=scope, program=fluid.default_main_program())
+    ck = mgr._step_dir(1)
+    assert os.path.exists(os.path.join(ck, "arrays.npz"))
+    man = json.load(open(os.path.join(ck, "MANIFEST.json")))
+    assert not man.get("sharded")
+
+
+# ---------------------------------------------------------------------------
+# FileStore durability satellite
+# ---------------------------------------------------------------------------
+
+def test_filestore_save_fsyncs_payload_and_directory(tmp_path,
+                                                     monkeypatch):
+    """The commit idiom: fsync the temp payload BEFORE os.replace and
+    the directory entry AFTER — a power loss can no longer commit a
+    torn master snapshot behind the atomic rename."""
+    import paddle_tpu.cloud.store as store_mod
+
+    fsyncs = []
+    real_fsync = os.fsync
+    monkeypatch.setattr(store_mod.os, "fsync",
+                        lambda fd: (fsyncs.append(fd), real_fsync(fd)))
+    dir_opens = []
+    real_open = os.open
+
+    def spy_open(path, flags, *a):
+        fd = real_open(path, flags, *a)
+        if os.path.isdir(path):
+            dir_opens.append(path)
+        return fd
+
+    monkeypatch.setattr(store_mod.os, "open", spy_open)
+    fs = FileStore(tmp_path / "snap.json")
+    fs.save(b'{"state": 1}')
+    assert fs.load() == b'{"state": 1}'
+    assert len(fsyncs) >= 2, "payload AND directory must be fsynced"
+    assert any(str(tmp_path) in d for d in dir_opens)
+
+
+# ---------------------------------------------------------------------------
+# review-pass regressions
+# ---------------------------------------------------------------------------
+
+def test_saver_election_released_when_elected_member_dies():
+    """A dead member's saver election must not pin the step: survivors
+    re-elect after the lease sweep, so the checkpoint still commits."""
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=10.0, clock=clk,
+                       save_block_secs=300.0)
+    cm.join("a")
+    cm.join("b")
+    assert cm.request_save("a", 9) is True
+    assert cm.request_save("b", 9) is False
+    clk.advance(6.0)
+    cm.heartbeat("b")
+    clk.advance(6.0)               # a dies holding the election
+    assert cm.request_save("b", 9) is True     # sweep released it
+
+
+def test_expelled_member_latches_and_cannot_win_elections():
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=10.0, clock=clk)
+    m = ClusterMember(cm, "a", auto_heartbeat=False,
+                      register_local=False)
+    assert m.expelled is False
+    clk.advance(11.0)              # lease lapses silently
+    m.heartbeat()
+    assert m.expelled is True
+    # a zombie must not win a commit election either
+    assert cm.request_save("a", 5) is False
+    # ...and its guardian exits typed instead of training on
+    g = ClusterGuardian(m, policy="rollback,abort")
+    with pytest.raises(guardian.GuardianAbortError, match="expelled"):
+        g.note_step("exe", 6, ok=None, fetch_names=("loss",),
+                    fetches=(np.float32(1.0),), sync=True)
+
+
+def test_barrier_polls_do_not_snapshot_every_call():
+    """Renewal-only calls (heartbeats, barrier 'wait' polls) persist at
+    most once per lease_timeout/4; material changes always persist."""
+    class CountingStore(InMemStore):
+        saves = 0
+
+        def save(self, data):
+            type(self).saves += 1
+            super().save(data)
+
+    clk = FakeClock()
+    cm = ClusterMaster(store=CountingStore(), lease_timeout=10.0,
+                       clock=clk)
+    ea = cm.join("a")["epoch"]
+    cm.join("b")
+    base = CountingStore.saves
+    for _ in range(100):           # a 'wait' storm at one instant
+        cm.enter_step("a", 1, cm.membership()["epoch"])
+    assert CountingStore.saves - base <= 1
+    before = CountingStore.saves
+    cm.propose_verdict("a", 1, "rollback", "x")   # material: persists
+    assert CountingStore.saves > before
+
+
+def test_manager_init_spares_fresh_shared_tmp_reclaims_stale(tmp_path):
+    """A rejoining host's manager init must not rmtree a live peer's
+    in-flight shared sharded tmp; abandoned ones (older than the commit
+    timeout) are still reclaimed."""
+    import time as _time
+
+    d = str(tmp_path / "mgr")
+    os.makedirs(d)
+    fresh = os.path.join(d, ".tmp.step_0000000009.shared")
+    stale = os.path.join(d, ".tmp.step_0000000003.shared")
+    plain = os.path.join(d, ".tmp.step_0000000004.123")
+    for p in (fresh, stale, plain):
+        os.makedirs(p)
+        with open(os.path.join(p, "shard_00000.json"), "w") as f:
+            f.write("{}")
+    old = _time.time() - 999.0
+    os.utime(stale, (old, old))
+    TrainStateCheckpointManager(d, sharded=True, commit_timeout=120.0)
+    assert os.path.isdir(fresh), "live peer's in-flight tmp deleted"
+    assert not os.path.exists(stale)
+    assert not os.path.exists(plain)   # pid-suffixed tmps stay garbage
+
+
+def test_persistent_cache_world_scoped_at_enable_time(tmp_path,
+                                                      monkeypatch):
+    """Enabling the cache AFTER the world joined must land in the
+    world_<N> subdir too (the enable-then-init order is covered by
+    init_distributed's rescope hook)."""
+    import jax
+
+    from paddle_tpu import compile_cache
+    from paddle_tpu.parallel import distributed
+
+    base = str(tmp_path / "cache")
+    prev_dir = compile_cache._persistent_dir[0]
+    prev_base = compile_cache._persistent_base[0]
+    try:
+        monkeypatch.setattr(distributed, "is_initialized", lambda: True)
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        compile_cache.enable_persistent_cache(base)
+        assert compile_cache.stats()["persistent_dir"] == \
+            os.path.join(base, "world_4")
+        # solo world: the base dir, unsuffixed
+        monkeypatch.setattr(distributed, "is_initialized",
+                            lambda: False)
+        compile_cache.enable_persistent_cache(base)
+        assert compile_cache.stats()["persistent_dir"] == base
+    finally:
+        compile_cache.enable_persistent_cache(prev_base or "")
+        compile_cache._persistent_dir[0] = prev_dir
+        compile_cache._persistent_base[0] = prev_base
+
+
+def test_heartbeat_observed_death_still_surfaces_as_reshape():
+    """The heartbeat thread may be the FIRST observer of a death (it
+    absorbs the new epoch); the barrier must still answer reshape —
+    the member presents the epoch of the world it BUILT, not the
+    latest observed one, until accept_world()."""
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=10.0, clock=clk)
+    a = ClusterMember(cm, "a", auto_heartbeat=False,
+                      register_local=False)
+    ClusterMember(cm, "b", auto_heartbeat=False, register_local=False)
+    a.heartbeat()
+    a.accept_world()                   # world formed: [a, b]
+    # b enters the barrier first (raw service call), then a goes
+    assert cm.enter_step("b", 1, a.world_epoch)["action"] == "wait"
+    assert a.enter_step(1, timeout=1)["action"] == "go"
+    clk.advance(6.0)
+    a.heartbeat()                      # a renews; b goes silent
+    clk.advance(6.0)
+    # the HEARTBEAT observes b's death first and absorbs the epoch
+    a.heartbeat()
+    assert a.epoch != a.world_epoch
+    # ...but the barrier still refuses to say "go" into the dead world
+    res = a.enter_step(2, timeout=1)
+    assert res["action"] == "reshape" and res["members"] == ["a"]
+    a.accept_world(res["epoch"])       # caller reshaped for THIS view
+    assert a.enter_step(2, timeout=1)["action"] == "go"
+
+
+def test_zombie_verdict_rejected_by_master():
+    """An expelled host's escalation (raised before its heartbeat
+    latched the rejoin) must not become the cluster command."""
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=10.0, clock=clk)
+    cm.join("a")
+    cm.join("b")
+    clk.advance(6.0)
+    cm.heartbeat("b")
+    clk.advance(6.0)                   # a's lease lapses
+    with pytest.raises(ValueError, match="not a cluster member"):
+        cm.propose_verdict("a", 7, "rollback", "nan")
+    assert cm.stats()["active_command"] is None
+    # a live member's verdict still arbitrates normally
+    assert cm.propose_verdict("b", 7, "rollback", "nan")["origin"] == "b"
+
+
+def test_saver_elections_are_per_step_not_single_slot():
+    """Async writer threads of different hosts can lag steps apart: a
+    request for ANOTHER step must not evict a live election — the
+    single-slot design let two hosts both win the same step."""
+    clk = FakeClock()
+    cm = ClusterMaster(lease_timeout=1000.0, clock=clk,
+                       save_block_secs=50.0)
+    for h in ("a", "b", "c"):
+        cm.join(h)
+    assert cm.request_save("a", 5) is True
+    assert cm.request_save("b", 3) is True     # older step: own election
+    # c must NOT win step 5 (a's election survives b's step-3 request)
+    assert cm.request_save("c", 5) is False
+    assert cm.request_save("a", 5) is True
+    # elections expire with their block window (leases stay live)
+    clk.advance(51.0)
+    assert cm.request_save("c", 5) is True
+
+
+def test_trainer_rejects_plain_guardian_instance_with_cluster_member():
+    cm = ClusterMaster(lease_timeout=30.0, clock=FakeClock())
+    m = ClusterMember(cm, "a", auto_heartbeat=False,
+                      register_local=False)
+
+    def train_func():
+        x = fluid.layers.data("x", shape=[8])
+        return fluid.layers.mean(fluid.layers.fc(x, size=4))
+
+    from paddle_tpu.contrib import Trainer
+
+    t = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+                guardian_config=guardian.Guardian(policy="rollback,abort"),
+                cluster_member=m)
+    with pytest.raises(ValueError, match="cluster-\\s*arbitrated|"
+                                         "ClusterGuardian"):
+        t._make_guardian()
+    # a ClusterGuardian instance is the supported spelling
+    t2 = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                 optimizer_func=lambda: fluid.optimizer.SGD(0.1),
+                 guardian_config=ClusterGuardian(
+                     m, policy="rollback,abort"),
+                 cluster_member=m)
+    g = t2._make_guardian()
+    try:
+        assert isinstance(g, ClusterGuardian)
+    finally:
+        if t._set_guardian_flag or t2._set_guardian_flag:
+            fluid.set_flags({"FLAGS_guardian": False})

@@ -211,6 +211,103 @@ def test_kill9_resume_loss_trajectory_bit_identical(
             % (step, sorted(hexes), ref[step]))
 
 
+@pytest.mark.slow   # three PE compiles (~25s); the sharded-IO units in
+                    # test_cluster.py keep the invariants tier-1
+def test_mesh_size_change_resume_sharded_artifact(tmp_path):
+    """Elastic resume across mesh sizes through the SHARDED artifact
+    path (ISSUE 13): train on a dp x fsdp = 4 virtual mesh, save a
+    per-host sharded TrainState, restore onto fsdp=2 AND fsdp=8 meshes
+    via ``ParallelExecutor.state_shardings()`` — restored values are
+    BIT-identical, and the continued loss trajectory stays in the
+    float-noise parity band of the uninterrupted fsdp=4 run."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.checkpoint import (
+        TrainStateCheckpointManager)
+
+    def build():
+        fluid.default_main_program().random_seed = 7
+        fluid.default_startup_program().random_seed = 7
+        x = fluid.layers.data("x", shape=[16])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        pred = fluid.layers.fc(h, size=4, act=None)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(pred, label))
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+        return loss
+
+    def batch(step):
+        x = np.random.RandomState(100 + step).rand(8, 16).astype(
+            "float32")
+        y = x[:, :4].argmax(1).astype("int64").reshape(-1, 1)
+        return {"x": x, "label": y}
+
+    def run_world(fsdp, ckpt, restore, steps):
+        """Build a (1, fsdp) mesh world; restore (optionally), run
+        ``steps``, sharded-save at the last one.  Returns losses and
+        the restored values."""
+        from paddle_tpu import unique_name
+
+        with unique_name.guard(), \
+                fluid.program_guard(fluid.Program(), fluid.Program()):
+            return _run_world_body(fsdp, ckpt, restore, steps)
+
+    def _run_world_body(fsdp, ckpt, restore, steps):
+        loss = build()
+        mesh = make_mesh((1, fsdp), ("dp", "fsdp"),
+                         devices=jax.devices()[:fsdp])
+        bs = fluid.BuildStrategy()
+        bs.sharding_rules = True
+        scope = fluid.Scope()
+        out, values = [], {}
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(
+                fluid.default_startup_program())
+            pe = fluid.ParallelExecutor(loss_name=loss.name, mesh=mesh,
+                                        build_strategy=bs)
+            mgr = TrainStateCheckpointManager(
+                ckpt, sharded=True, async_save=False,
+                save_interval_steps=1000)
+            start = 0
+            if restore:
+                start = mgr.restore(
+                    scope=scope, program=fluid.default_main_program(),
+                    executors={"train": pe},
+                    shardings=pe.state_shardings())
+                assert start is not None
+                for n, v in (mgr.last_restored.arrays or {}).items():
+                    # copy=True: np.asarray of a CPU jax.Array is a
+                    # zero-copy view the next step's donation reuses
+                    values[n] = np.array(scope.var(n), copy=True)
+            for s in range(start + 1, start + 1 + steps):
+                (lv,) = pe.run(feed=batch(s), fetch_list=[loss])
+                out.append(float(np.asarray(lv).ravel()[0]))
+            if not restore:
+                mgr.save_now(start + steps, scope=scope,
+                             program=fluid.default_main_program(),
+                             executors={"train": pe})
+        return out, values
+
+    ckpt = str(tmp_path / "ck")
+    first = run_world(4, ckpt, restore=False, steps=4)[0]
+    ref = run_world(4, str(tmp_path / "ref_unused"), restore=False,
+                    steps=4)[0]
+    assert first == ref           # determinism sanity of the harness
+
+    # the uninterrupted fsdp=4 continuation is the parity reference
+    cont4, vals4 = run_world(4, ckpt, restore=True, steps=4)
+    for fsdp in (2, 8):
+        cont, vals = run_world(fsdp, ckpt, restore=True, steps=4)
+        # restored state lands BIT-identical regardless of mesh size
+        for n, v in vals4.items():
+            np.testing.assert_array_equal(vals[n], v, err_msg=n)
+        # the continued trajectory stays in the float-noise band
+        np.testing.assert_allclose(cont, cont4, rtol=1e-4, atol=1e-6,
+                                   err_msg="fsdp=%d" % fsdp)
+
+
 def test_corrupt_latest_checkpoint_falls_back_on_resume(tmp_path, ref,
                                                         xla_cache):
     """Corrupt the latest committed artifact after a kill: the resume

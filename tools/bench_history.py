@@ -47,12 +47,15 @@ import sys
 # (field, better, pretty) — the comparison schema per rung.
 # throughput_rps / p99_ms are the serving rung's SLO pair (schema v2+);
 # that rung is informational, so they index and judge without gating.
+# save_wall_s is the ckpt_sharded rung's per-host checkpoint save wall
+# clock (also informational: disk-bound, not chip-bound).
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
           ("mfu", "higher", "mfu"),
           ("goodput", "higher", "goodput"),
           ("throughput_rps", "higher", "rps"),
-          ("p99_ms", "lower", "p99"))
+          ("p99_ms", "lower", "p99"),
+          ("save_wall_s", "lower", "save_s"))
 
 
 def _rung_record(r):
@@ -71,7 +74,7 @@ def _rung_record(r):
     mfu = r.get("mfu", r.get("exact_mfu", r.get("est_mfu")))
     if mfu is not None:
         out["mfu"] = mfu
-    for f in ("throughput_rps", "p99_ms"):
+    for f in ("throughput_rps", "p99_ms", "save_wall_s"):
         if r.get(f) is not None:
             out[f] = r[f]
     gp = r.get("goodput")

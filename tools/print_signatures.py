@@ -46,6 +46,7 @@ MODULES = [
     "paddle_tpu.reader",
     "paddle_tpu.reader.creator",
     "paddle_tpu.cloud",
+    "paddle_tpu.cluster",
     "paddle_tpu.parallel",
     "paddle_tpu.parallel.checkpoint",
     "paddle_tpu.transpiler",

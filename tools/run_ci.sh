@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/12 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/13 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/12 API signature gate =="
+echo "== 2/13 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/12 8-device virtual-mesh dryrun =="
+echo "== 3/13 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/12 bench smoke (CPU backend, tiny) =="
+echo "== 4/13 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/12 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/13 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/12 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/13 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/12 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/13 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -170,7 +170,7 @@ PY
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
-echo "== 8/12 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+echo "== 8/13 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
 GUARD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
 # the drill is installed purely from the environment (FLAGS_fault_spec)
@@ -227,7 +227,7 @@ PY
 grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
 grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
 
-echo "== 9/12 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
+echo "== 9/13 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
 TUNE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
@@ -323,7 +323,7 @@ print("AUTOTUNE TRAINER FINAL %.6f over %d steps"
       % (losses[-1], len(losses)), flush=True)
 PY
 
-echo "== 10/12 goodput smoke + bench-history regression gate =="
+echo "== 10/13 goodput smoke + bench-history regression gate =="
 GOOD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR"' EXIT
 # (a) a 3-step monitored MLP run -> the goodput ledger attributes its
@@ -383,7 +383,7 @@ assert any(c["field"] == "min_step_s" and c["verdict"] == "REGRESSED"
 print("bench_history: +20% perturbation flagged REGRESSED")
 PY
 
-echo "== 11/12 serving smoke (engine over toy MLP, concurrent requests) =="
+echo "== 11/13 serving smoke (engine over toy MLP, concurrent requests) =="
 SERVE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'PY'
@@ -438,7 +438,7 @@ PY
 # per-request serving/* events landed in the JSONL, run_id-correlated
 grep -ql serving_request "$SERVE_DIR"/monitor/*.jsonl
 
-echo "== 12/12 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
+echo "== 12/13 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
 echo "==       loss parity vs GPipe + measured pipeline_bubble drop)        =="
 PIPE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR"' EXIT
@@ -512,5 +512,31 @@ monitor.disable()
 PY
 # the pipeline_bubble bucket landed in the goodput JSONL stamps
 grep -ql pipeline_bubble "$PIPE_DIR"/*.jsonl
+
+echo "== 13/13 cluster elastic-resume drill (2 members, SIGKILL one mid-run) =="
+CLUSTER_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR"' EXIT
+# the supervisor runs the whole acceptance drill: an uninterrupted
+# small-mesh reference, a 2-member gloo world over one ClusterMaster
+# with per-host sharded checkpoints, SIGKILL of member 1 at step 8, and
+# the survivor's barrier-observed lease expiry -> reshape -> re-exec
+# onto the smaller mesh -> resume from the last committed step.  It
+# asserts the parity band, the manifest's ~1/N per-host bytes, and the
+# resume provenance itself; the grep re-checks the headline landed.
+python tests/cluster_runner.py supervise "$CLUSTER_DIR" \
+  | tee "$CLUSTER_DIR/drill.out"
+grep -q "CLUSTER_DRILL OK" "$CLUSTER_DIR/drill.out"
+# the ckpt_sharded bench rung emits per-host save wall-clock evidence
+# (1/N bytes per host, flat MB/s) that bench_history indexes
+python bench.py --model ckpt_sharded --device cpu > "$CLUSTER_DIR/ckpt_bench.json"
+python - "$CLUSTER_DIR" <<'PY'
+import json, sys
+r = json.loads(open(sys.argv[1] + "/ckpt_bench.json").read().strip().splitlines()[-1])
+assert r["roundtrip_bit_identical"] is True, r
+assert r["bytes_one_over_n"]["4"] < 0.3, r["bytes_one_over_n"]
+assert r["save_wall_s"] is not None and r["informational"] is True
+print("CKPT_SHARDED per-host wall %.3fs, bytes/N %s, MB/s spread %.2f"
+      % (r["save_wall_s"], r["bytes_one_over_n"], r["mb_per_s_spread"]))
+PY
 
 echo "CI OK"

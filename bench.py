@@ -696,6 +696,122 @@ def bench_serving(args):
     return result
 
 
+def bench_quantized(args):
+    """Quantized-vs-bf16 forward rung (ISSUE 14): the serving-shaped
+    small-batch token forward — 3 wide FC layers in the latency-bound
+    regime PERF.md's serving work measured — run through (a) the bf16
+    AMP path serving actually ships (f32 master weights cast to bf16
+    in-graph every step) and (b) the ``quantize_inference`` int8
+    rewrite, accuracy-gated by ``autotune.tune_quantization`` (whose
+    TunedConfig evidence embeds in the artifact).
+
+    A/B windows interleave (bf16, quant, bf16, quant ...) so bursty
+    host load hits both arms alike; min-of-windows is the estimator as
+    everywhere in this file.  The headline value is the quantized arm's
+    tok/s; ``vs_baseline`` is quant/bf16 (>1 = the int8 path wins) and
+    ``gate_pass`` records the acceptance predicate (faster AND accuracy
+    delta under budget).  ``accuracy_delta`` is measured against the
+    bf16 arm's own outputs — the precision serving ships today is the
+    baseline the gate defends."""
+    import paddle_tpu as fluid
+    from paddle_tpu import autotune, monitor
+    from paddle_tpu.contrib.mixed_precision import AMPPolicy
+    from paddle_tpu.monitor import program_profile
+
+    if not monitor.enabled():
+        fluid.set_flags({"FLAGS_monitor": True})
+    monitor.step_stats().reset()
+    program_profile.reset_accounting()
+    monitor.goodput_reset()
+    place = _place(args)
+    on_tpu = args.device == "tpu"
+    d_model, d_out, n_layers = (2048, 512, 3)
+    batch = args.batch_size or (4 if on_tpu else 1)
+    t = 64 if on_tpu else 16
+    windows = max(2, N_WINDOWS)
+    steps = max(3, args.iterations)
+    budget = float(fluid.get_flags("quantize_accuracy_budget")
+                   ["quantize_accuracy_budget"])
+    with fluid.program_guard(fluid.Program(), fluid.Program()):
+        fluid.default_main_program().random_seed = 11
+        fluid.default_startup_program().random_seed = 11
+        x = fluid.layers.data("tok_feat", shape=[t, d_model])
+        h = x
+        for _ in range(n_layers):
+            h = fluid.layers.fc(h, size=d_model, num_flatten_dims=2,
+                                act="relu")
+        logits = fluid.layers.fc(h, size=d_out, num_flatten_dims=2)
+        main = fluid.default_main_program()
+        # the serving bf16 configuration: matmuls whitelisted to bf16
+        # over f32 master weights (cast in-graph per step)
+        main._amp_policy = AMPPolicy()
+        rng = np.random.RandomState(0)
+        feed = {"tok_feat": rng.rand(batch, t, d_model).astype(
+            "float32")}
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(place, donate_state=False)
+            exe.run(fluid.default_startup_program())
+            # accuracy-gated mode choice + TunedConfig evidence (the
+            # same decision procedure serving consumes)
+            cfg = autotune.TunedConfig(meta={"model": "quantized"})
+            decision = autotune.tune_quantization(
+                main, scope, feed, [logits], place,
+                probe_steps=max(2, args.skip_batch_num),
+                budget=budget, min_speedup=1.0, config=cfg)
+            mode = decision["chosen"] or "weight_only"
+            from paddle_tpu.transpiler import quantize_inference
+            qprog = quantize_inference(main, scope=scope, mode=mode)
+
+            def window(prog):
+                return autotune.measure_step_window(
+                    exe, prog, feed, [logits],
+                    steps=steps, warmup=0, scope=scope)
+
+            # warm both arms, then interleave the measured windows
+            window(main)
+            window(qprog)
+            t_bf16, t_quant = [], []
+            for _ in range(windows):
+                t_bf16.append(window(main))
+                t_quant.append(window(qprog))
+            (ref,) = exe.run(main, feed=feed, fetch_list=[logits],
+                             scope=scope)
+            (out,) = exe.run(qprog, feed=feed, fetch_list=[logits],
+                             scope=scope)
+            delta = autotune.eval_delta([ref], [out])
+    toks = batch * t
+    bf16_tok_s = toks / min(t_bf16)
+    quant_tok_s = toks / min(t_quant)
+    gate_pass = quant_tok_s > bf16_tok_s and delta <= budget
+    info = getattr(qprog, "_quantize_info", {})
+    bytes_fp = sum(w["bytes_fp"] for w in info.get("weights", {})
+                   .values())
+    bytes_int8 = sum(w["bytes_int8"] for w in info.get("weights", {})
+                     .values())
+    return {"metric": "quantized_tok_per_sec",
+            "value": round(quant_tok_s, 2), "unit": "tokens/sec",
+            "vs_baseline": round(quant_tok_s / bf16_tok_s, 3),
+            "bf16_tok_s": round(bf16_tok_s, 2),
+            "speedup_vs_bf16": round(quant_tok_s / bf16_tok_s, 3),
+            "accuracy_delta": round(delta, 6),
+            "accuracy_budget": budget,
+            "gate_pass": bool(gate_pass),
+            "mode": mode,
+            "gate_chosen": decision["chosen"],
+            "batch": batch, "seq": t, "d_model": d_model,
+            "n_layers": n_layers,
+            "weight_bytes_fp": bytes_fp,
+            "weight_bytes_int8": bytes_int8,
+            "min_step_s": round(min(t_quant), 6),
+            "bf16_min_step_s": round(min(t_bf16), 6),
+            "n_windows": windows,
+            "autotune": cfg.as_dict(),
+            "step_stats": monitor.step_stats().summary(),
+            "goodput": monitor.goodput_summary(),
+            "informational": True}
+
+
 def bench_mlp(args, use_amp=False, per_step_feed=False):
     import paddle_tpu as fluid
 
@@ -1682,7 +1798,7 @@ def main():
                             "se_resnext", "stacked_lstm",
                             "machine_translation", "alexnet", "googlenet",
                             "smallnet", "reader_capacity", "fault_drill",
-                            "serving", "ckpt_sharded"])
+                            "serving", "ckpt_sharded", "quantized"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -1864,6 +1980,10 @@ def main():
             # hosts each write 1/N of a real TrainState; per-host save
             # wall + MB/s flatness; disk-bound -> informational
             ("ckpt_sharded", [], True, 300),
+            # int8 quantized execution (ISSUE 14): accuracy-gated
+            # quantized-vs-bf16 forward A/B in the serving small-batch
+            # regime; informational while the rung accumulates history
+            ("quantized", ["--n_windows", "3"], True, 300),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -2059,6 +2179,8 @@ def main():
         result = bench_serving(args)
     elif args.model == "ckpt_sharded":
         result = bench_ckpt_sharded(args)
+    elif args.model == "quantized":
+        result = bench_quantized(args)
     elif args.model == "transformer_realdist":
         result = bench_transformer_realdist(args,
                                             use_amp=not args.fp32_only)

@@ -97,6 +97,9 @@ __all__ = [
     "tune_batch_size", "tune_attention_kernel",
     "tune_checkpoint_interval", "measure_step_window",
     "decide_pipeline", "tune_pipeline",
+    "quant_kernel_table", "quant_kernel_choice", "quant_shape_key",
+    "decide_quant_kernel", "tune_quant_kernel",
+    "decide_quantization", "tune_quantization",
 ]
 
 _mu = threading.Lock()
@@ -498,6 +501,21 @@ class TunedConfig:
                     d.get("fingerprint") or "", d["shape"],
                     bool(d.get("pallas")), d, persist=False)
                 outcomes.append((knob, "applied"))
+            elif knob == "quant_kernel" and d.get("shape"):
+                if flags.pinned("pallas_kernels"):
+                    outcomes.append((knob, "pinned"))
+                    continue
+                quant_kernel_table().record(
+                    d.get("fingerprint") or "", d["shape"],
+                    bool(d.get("pallas")), d, persist=False)
+                outcomes.append((knob, "applied"))
+            elif knob == "quantization":
+                if flags.pinned("quantize_mode"):
+                    outcomes.append((knob, "pinned"))
+                    continue
+                # consumed by the serving engines / quantize_inference
+                # callers from the artifact, not a flag
+                outcomes.append((knob, "advisory"))
             elif knob == "checkpoint_interval":
                 # applied by the Trainer against its manager (not a
                 # flag); recorded here so the trail is complete
@@ -536,8 +554,12 @@ class AttentionDecisionTable:
 
     FILENAME = "attention_decisions.json"
 
-    def __init__(self, dirname=None):
+    def __init__(self, dirname=None, filename=None):
         self._dir = dirname
+        # the table machinery is knob-agnostic (string shape keys ->
+        # pallas rulings); a second knob persists under its own file
+        # (quant_kernel_table)
+        self._filename = filename or self.FILENAME
         self._entries = {}
         self._loaded = False
         # content token cached as an immutable tuple: trace_token() is
@@ -549,7 +571,7 @@ class AttentionDecisionTable:
     def _path(self):
         d = self._dir if self._dir is not None \
             else str(_flag("autotune_dir", "") or "")
-        return os.path.join(d, self.FILENAME) if d else None
+        return os.path.join(d, self._filename) if d else None
 
     def _load_locked(self):
         if self._loaded:
@@ -687,16 +709,49 @@ def reset_attention_table():
         _table[0] = None
 
 
+_qtable = [None]
+QUANT_FILENAME = "quant_kernel_decisions.json"
+
+
+def quant_kernel_table():
+    """The process-global dequant-matmul kernel decision table (same
+    machinery as the attention table, its own persisted file)."""
+    with _mu:
+        if _qtable[0] is None:
+            _qtable[0] = AttentionDecisionTable(filename=QUANT_FILENAME)
+        return _qtable[0]
+
+
+def _active_quant_table():
+    t = _qtable[0]
+    if t is not None:
+        return t
+    if str(_flag("autotune_dir", "") or ""):
+        return quant_kernel_table()
+    return None
+
+
+def reset_quant_kernel_table():
+    """Drop the process quant-kernel table (tests); disk untouched."""
+    with _mu:
+        _qtable[0] = None
+
+
 def trace_token():
     """Token folded into every trace/AOT cache key
     (``compile_cache.trace_flag_values``): tuned kernel rulings are
     baked into the lowered jaxpr, so a changed table must re-lower
-    rather than serve the other kernel's stale trace.  Cheap when no
+    rather than serve the other kernel's stale trace.  Covers BOTH
+    per-shape tables (attention and dequant-matmul).  Cheap when no
     table exists (the overwhelmingly common case)."""
+    parts = ()
     t = _active_table()
-    if t is None:
-        return ()
-    return t.content_token()
+    if t is not None:
+        parts += (("attention",) + t.content_token(),)
+    q = _active_quant_table()
+    if q is not None:
+        parts += (("quant",) + q.content_token(),)
+    return parts
 
 
 def attention_choice(q_shape, k_shape, dtype):
@@ -712,6 +767,30 @@ def attention_choice(q_shape, k_shape, dtype):
     if flags.pinned("pallas_kernels"):
         return None
     e = t.lookup("", attention_shape_key(q_shape, k_shape, dtype))
+    return None if e is None else bool(e["pallas"])
+
+
+def quant_shape_key(m, k, n, dtype, mode="weight_only"):
+    """Stable shape key for the dequant-matmul kernel table: the
+    flattened GEMM dims plus activation dtype and quantization mode
+    (the regime-setting properties)."""
+    name = np.dtype(dtype).name if not isinstance(dtype, str) else dtype
+    return "M%d:K%d:N%d:%s:%s" % (int(m), int(k), int(n), name, mode)
+
+
+def quant_kernel_choice(m, k, n, dtype, mode="weight_only"):
+    """The tuned Pallas-vs-XLA ruling for this dequant-matmul shape, or
+    None when there is none — or when the user PINNED
+    ``FLAGS_pallas_kernels``.  Called by the ``dequant_matmul`` op at
+    trace time (the exact analog of :func:`attention_choice`)."""
+    t = _active_quant_table()
+    if t is None:
+        return None
+    from . import flags
+
+    if flags.pinned("pallas_kernels"):
+        return None
+    e = t.lookup("", quant_shape_key(m, k, n, dtype, mode))
     return None if e is None else bool(e["pallas"])
 
 
@@ -1185,5 +1264,255 @@ def tune_pipeline(main_program, startup_program, feed, fetch, mesh,
         config.add(decision, fingerprint=fp[:12])
     else:
         _event({"event": "autotune_decision", "knob": "pipeline",
+                "chosen": decision["chosen"], "fingerprint": fp[:12]})
+    return decision
+
+
+# ---------------------------------------------------------------------------
+# quantized execution: kernel A/B + accuracy-gated program A/B (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def decide_quant_kernel(xla_step_s, pallas_step_s, min_speedup=1.03):
+    """Pick the Pallas fused dequant-matmul only where the measured A/B
+    favors it by ``min_speedup`` (ties go to XLA, same policy as the
+    attention kernel)."""
+    xla_step_s = float(xla_step_s)
+    pallas_step_s = float(pallas_step_s)
+    use_pallas = (pallas_step_s > 0
+                  and xla_step_s / pallas_step_s >= float(min_speedup))
+    return {"knob": "quant_kernel", "pallas": bool(use_pallas),
+            "xla_step_s": round(xla_step_s, 6),
+            "pallas_step_s": round(pallas_step_s, 6),
+            "speedup": round(xla_step_s / pallas_step_s, 4)
+            if pallas_step_s > 0 else None,
+            "min_speedup": float(min_speedup),
+            "evidence": "measured_ab_window"}
+
+
+def _quant_microbench(m, k, n, dtype, mode, seed=0):
+    """A one-op dequant_matmul program + synthetic int8 weights for the
+    kernel A/B (kernel speed only; accuracy is tune_quantization's
+    job).  Returns (program, feed, state values, fetch var)."""
+    from .framework import Operator, Program
+    from .registry import infer_op
+
+    prog = Program()
+    block = prog.global_block()
+    x = block.create_var(name="qmb_x", shape=(int(m), int(k)),
+                         dtype=dtype, is_data=True)
+    qw = block.create_var(name="qmb_w", shape=(int(k), int(n)),
+                          dtype="int8", persistable=True)
+    sc = block.create_var(name="qmb_s", shape=(int(n),),
+                          dtype="float32", persistable=True)
+    out = block.create_var(name="qmb_out", dtype=dtype)
+    op = Operator(block, type="dequant_matmul",
+                  inputs={"X": [x.name], "QWeight": [qw.name],
+                          "Scale": [sc.name]},
+                  outputs={"Out": [out.name]},
+                  attrs={"x_num_col_dims": 1, "mode": mode})
+    infer_op(op, block)
+    block.ops.append(op)
+    prog._version += 1
+    rng = np.random.RandomState(seed)
+    w = (rng.randn(k, n) * 0.05).astype(np.float32)
+    s = (np.maximum(np.abs(w).max(axis=0), 1e-12) / 127.0).astype(
+        np.float32)
+    qwv = np.clip(np.round(w / s), -127, 127).astype(np.int8)
+    feed = {"qmb_x": rng.randn(m, k).astype(np.float32)}
+    return prog, feed, {"qmb_w": qwv, "qmb_s": s}, out
+
+
+def tune_quant_kernel(m, k, n, dtype="float32", place=None,
+                      mode="weight_only", probe_steps=4, warmup_steps=1,
+                      min_speedup=1.03, table=None, config=None):
+    """Measured Pallas-vs-XLA A/B for one dequant-matmul shape, served
+    from the persistent quant-kernel decision table when warm (zero
+    compiles) — the exact analog of :func:`tune_attention_kernel`.
+    The A/B flips ``FLAGS_pallas_kernels`` UNPINNED and restores it, so
+    tuning never counts as the user's explicit choice."""
+    from . import compile_cache, flags
+    from .executor import CPUPlace
+
+    place = place if place is not None else CPUPlace()
+    key = quant_shape_key(m, k, n, dtype, mode)
+    table = table or quant_kernel_table()
+    prog, feed, values, fetch = _quant_microbench(m, k, n, dtype, mode)
+    fp = compile_cache.program_fingerprint(prog)
+    cached = table.lookup(fp, key)
+    if cached is not None:
+        decision = {"knob": "quant_kernel", "shape": key,
+                    "pallas": bool(cached["pallas"]),
+                    "evidence": "decision_table", "cached": True}
+        decision.update(cached.get("evidence") or {})
+        if config is not None:
+            config.add(decision, fingerprint=fp[:12], source="cached")
+        return decision
+
+    measured = {}
+    saved = flags.get_flags(["pallas_kernels"])
+    saved_pins = {"pallas_kernels": flags.pinned("pallas_kernels")}
+    try:
+        for pallas in (False, True):
+            flags.set_flags({"pallas_kernels": pallas}, pin=False)
+            with _probe_run(place) as (exe, scope):
+                for name, v in values.items():
+                    scope.set_var(name, v)
+                exe.cost_analysis(prog, feed, [fetch], scope=scope)
+                measured[pallas] = measure_step_window(
+                    exe, prog, feed, [fetch], steps=probe_steps,
+                    warmup=warmup_steps, scope=scope)
+            _event({"event": "autotune_probe", "knob": "quant_kernel",
+                    "shape": key, "pallas": pallas,
+                    "step_s": round(measured[pallas], 6)})
+    finally:
+        flags.set_flags(saved, pin=False)
+        flags._restore_pins(saved_pins)
+    decision = decide_quant_kernel(measured[False], measured[True],
+                                   min_speedup=min_speedup)
+    decision["shape"] = key
+    table.record(fp, key, decision["pallas"], decision)
+    if config is not None:
+        config.add(decision, fingerprint=fp[:12])
+    return decision
+
+
+def eval_delta(reference, outputs):
+    """Relative-L1 accuracy delta between two fetch lists: the
+    quantization gate's eval metric (0 = bit-identical; scale-free, so
+    one budget covers logits and probabilities alike)."""
+    num = den = 0.0
+    for r, o in zip(reference, outputs):
+        r = np.asarray(r, np.float64)
+        o = np.asarray(o, np.float64)
+        num += float(np.abs(o - r).sum())
+        den += float(np.abs(r).sum())
+    return num / (den + 1e-12)
+
+
+def decide_quantization(fp_step_s, candidates, budget,
+                        min_speedup=1.0, batch=None):
+    """Pure quantization policy over measured candidates.
+
+    ``candidates``: dicts with ``mode``, ``accuracy_delta``, ``step_s``
+    (or ``rejected`` for a candidate that failed outright).  A candidate
+    survives only when its accuracy delta is under ``budget`` AND it is
+    at least ``min_speedup`` faster than full precision — otherwise
+    full precision is kept (``chosen`` None).  Rejections stay in the
+    candidate table as evidence."""
+    fp_step_s = float(fp_step_s)
+    ok = []
+    cands = [dict(c) for c in candidates]
+    for c in cands:
+        if c.get("rejected"):
+            continue
+        delta = float(c.get("accuracy_delta", np.inf))
+        step_s = float(c.get("step_s") or 0.0)
+        speedup = fp_step_s / step_s if step_s > 0 else 0.0
+        c["speedup_vs_fp"] = round(speedup, 4)
+        if delta > float(budget):
+            c["status"] = "rejected_accuracy"
+            continue
+        if speedup < float(min_speedup):
+            c["status"] = "rejected_slower"
+            continue
+        c["status"] = "ok"
+        ok.append(c)
+    chosen = min(ok, key=lambda c: c["step_s"]) if ok else None
+    decision = {"knob": "quantization",
+                "chosen": chosen["mode"] if chosen else None,
+                "fp_step_s": round(fp_step_s, 6),
+                "accuracy_budget": float(budget),
+                "min_speedup": float(min_speedup),
+                "candidates": cands,
+                "evidence": "measured_ab_window+eval_delta"}
+    if batch:
+        decision["fp_tok_s"] = round(batch / fp_step_s, 2)
+    if chosen:
+        decision["accuracy_delta"] = chosen["accuracy_delta"]
+        decision["chosen_step_s"] = chosen["step_s"]
+        if batch:
+            decision["chosen_tok_s"] = round(batch / chosen["step_s"], 2)
+    return decision
+
+
+def tune_quantization(main_program, scope, feed, fetch_list, place,
+                      modes=("weight_only", "dynamic"), budget=None,
+                      probe_steps=4, warmup_steps=1, min_speedup=1.0,
+                      candidates=None, config=None):
+    """Accuracy-gated quantization A/B for one inference program: run
+    the full-precision program as the reference, build (or accept) a
+    quantized candidate per mode via the ``quantize_inference`` pass
+    over the SAME scope, and keep the fastest candidate whose measured
+    eval delta stays under ``budget``
+    (``FLAGS_quantize_accuracy_budget``) — otherwise full precision is
+    kept, with every rejection recorded as TunedConfig evidence.
+
+    ``candidates`` optionally supplies prepared ``(mode, program)``
+    pairs (the corruption drills inject broken scales this way);
+    the default builds them with the pass.  A pinned
+    ``FLAGS_quantize_mode`` is the operator's choice — recorded, never
+    measured over."""
+    from . import compile_cache, flags
+    from .executor import Executor
+    from .monitor import program_profile
+
+    if budget is None:
+        budget = float(_flag("quantize_accuracy_budget", 0.02))
+    fp = compile_cache.program_fingerprint(main_program)
+    if flags.pinned("quantize_mode"):
+        mode = str(flags.flag("quantize_mode") or "off")
+        decision = {"knob": "quantization",
+                    "chosen": None if mode in ("", "off") else mode,
+                    "accuracy_budget": float(budget),
+                    "evidence": "pinned", "candidates": []}
+        if config is not None:
+            config.add(decision, fingerprint=fp[:12], source="pinned")
+        return decision
+
+    batch = max((int(np.shape(v)[0]) for v in feed.values()
+                 if np.ndim(v) >= 1), default=0)
+    with program_profile.probe_accounting():
+        # shared scope, no donation: the quantized candidates read the
+        # same master weights the reference program does
+        exe = Executor(place, donate_state=False)
+        ref = [np.asarray(r) for r in exe.run(
+            main_program, feed=feed, fetch_list=fetch_list, scope=scope)]
+        fp_step_s = measure_step_window(
+            exe, main_program, feed, fetch_list, steps=probe_steps,
+            warmup=warmup_steps, scope=scope)
+        if candidates is None:
+            from .transpiler.quantize_pass import quantize_inference
+
+            candidates = [(mode, quantize_inference(
+                main_program, scope=scope, mode=mode)) for mode in modes]
+        cands = []
+        for mode, qprog in candidates:
+            cand = {"mode": mode}
+            try:
+                outs = exe.run(qprog, feed=feed, fetch_list=fetch_list,
+                               scope=scope)
+                cand["accuracy_delta"] = round(eval_delta(ref, outs), 6)
+                step_s = measure_step_window(
+                    exe, qprog, feed, fetch_list, steps=probe_steps,
+                    warmup=warmup_steps, scope=scope)
+                cand["step_s"] = round(step_s, 6)
+                if batch:
+                    cand["tok_s"] = round(batch / step_s, 2)
+            except Exception as e:  # noqa: BLE001 — a failed candidate
+                cand["rejected"] = "error: %s" % str(e)[:160]  # is
+                # evidence, not a tuner crash
+            _event({"event": "autotune_probe", "knob": "quantization",
+                    "mode": mode,
+                    "accuracy_delta": cand.get("accuracy_delta"),
+                    "step_s": cand.get("step_s"),
+                    "rejected": cand.get("rejected"),
+                    "fingerprint": fp[:12]})
+            cands.append(cand)
+    decision = decide_quantization(fp_step_s, cands, budget,
+                                   min_speedup=min_speedup, batch=batch)
+    if config is not None:
+        config.add(decision, fingerprint=fp[:12])
+    else:
+        _event({"event": "autotune_decision", "knob": "quantization",
                 "chosen": decision["chosen"], "fingerprint": fp[:12]})
     return decision

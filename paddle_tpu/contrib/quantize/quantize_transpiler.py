@@ -34,7 +34,8 @@ _QUANT_TYPES = ("abs_max", "range_abs_max")
 class QuantizeTranspiler:
     def __init__(self, weight_bits=8, activation_bits=8,
                  activation_quantize_type="abs_max",
-                 weight_quantize_type="abs_max", window_size=10000):
+                 weight_quantize_type="abs_max", window_size=10000,
+                 weight_quant_axis=None):
         if weight_quantize_type not in _QUANT_TYPES:
             raise ValueError(
                 "Unknown weight_quantize_type: %r" % weight_quantize_type)
@@ -47,6 +48,19 @@ class QuantizeTranspiler:
         self.weight_quantize_type = weight_quantize_type
         self.activation_quantize_type = activation_quantize_type
         self.window_size = window_size   # accepted for API parity
+        # per-channel weight grids: "auto" picks the consumer's output-
+        # channel axis (conv filters 0, mul/matmul weights their last
+        # axis) so QAT trains against the SAME per-channel grid the
+        # quantize_inference pass deploys; an int pins the axis; None
+        # keeps the legacy per-tensor max (which over-clips wide FC
+        # layers).  abs_max weights only — the range_abs_max running
+        # scale is a scalar state var.
+        if weight_quant_axis not in (None, "auto") and \
+                not isinstance(weight_quant_axis, int):
+            raise ValueError(
+                "weight_quant_axis must be None, 'auto', or an int, "
+                "got %r" % (weight_quant_axis,))
+        self.weight_quant_axis = weight_quant_axis
 
     # ------------------------------------------------------------------
     def training_transpile(self, program=None, startup_program=None):
@@ -82,7 +96,8 @@ class QuantizeTranspiler:
                                 continue
                             if name not in quantized:
                                 qname, qops = self._make_quant_ops(
-                                    block, startup, name, name in params)
+                                    block, startup, name, name in params,
+                                    consumer_type=op.type)
                                 new_ops.extend(qops)
                                 inserted += len(qops)
                                 quantized[name] = qname
@@ -93,7 +108,22 @@ class QuantizeTranspiler:
         program._version += 1
         return inserted
 
-    def _make_quant_ops(self, block, startup, name, is_weight):
+    def _quant_axis_for(self, var, consumer_type):
+        """The per-channel axis for a weight feeding ``consumer_type``
+        (None = per-tensor)."""
+        axis = self.weight_quant_axis
+        if axis is None:
+            return None
+        if axis == "auto":
+            if consumer_type in ("conv2d", "depthwise_conv2d"):
+                return 0        # [O, C, H, W] filters: output channel
+            return len(var.shape) - 1   # mul/matmul [K, N]: output axis
+        # normalize negative axes: the op's quant_axis attr gates on
+        # axis >= 0 (a raw -1 would silently degrade to per-tensor)
+        return int(axis) % len(var.shape)
+
+    def _make_quant_ops(self, block, startup, name, is_weight,
+                        consumer_type=None):
         bits = self.weight_bits if is_weight else self.activation_bits
         qtype = self.weight_quantize_type if is_weight \
             else self.activation_quantize_type
@@ -104,13 +134,20 @@ class QuantizeTranspiler:
                          persistable=False)
         ops = []
         if qtype == "abs_max":
-            block.create_var(name=scale_name, shape=(1,), dtype=var.dtype,
-                             persistable=False)
+            attrs = {"bit_length": bits}
+            scale_shape = (1,)
+            if is_weight:
+                axis = self._quant_axis_for(var, consumer_type)
+                if axis is not None:
+                    attrs["quant_axis"] = axis
+                    scale_shape = (var.shape[axis],)
+            block.create_var(name=scale_name, shape=scale_shape,
+                             dtype=var.dtype, persistable=False)
             op = Operator(block, type="fake_quantize_abs_max",
                           inputs={"X": [name]},
                           outputs={"Out": [qname],
                                    "OutScale": [scale_name]},
-                          attrs={"bit_length": bits})
+                          attrs=attrs)
         else:
             # running-scale state: persistable, zero-initialized by the
             # startup program, updated in place every step (OutScale
@@ -170,6 +207,7 @@ class QuantizeTranspiler:
             if not isinstance(var, Parameter) or not scope.has_var(name):
                 continue
             w = np.asarray(scope.var(name), dtype=np.float64)
+            axis = op.attrs.get("quant_axis", -1)
             if op.type == "fake_quantize_range_abs_max" and \
                     scope.has_var(op.inputs["InScale"][0]):
                 # the TRAINED running scale IS the grid QAT optimized
@@ -178,12 +216,19 @@ class QuantizeTranspiler:
                 scale = max(float(np.asarray(
                     scope.var(op.inputs["InScale"][0])).ravel()[0]),
                     1e-12)
+            elif axis is not None and axis >= 0:
+                # per-channel grid, matching the op's quant_axis attr
+                red = tuple(i for i in range(w.ndim) if i != axis)
+                scale = np.maximum(np.max(np.abs(w), axis=red), 1e-12)
             else:
                 scale = max(float(np.max(np.abs(w))), 1e-12)
-            q = np.clip(np.round(w / scale * rng), -rng, rng).astype(
-                np.int8)
+            bshape = [1] * w.ndim
+            if np.ndim(scale):
+                bshape[axis] = -1
+            q = np.clip(np.round(w / np.reshape(scale, bshape) * rng),
+                        -rng, rng).astype(np.int8)
             scope.set_var(name + ".int8", q)
             scope.set_var(name + ".int8_scale",
-                          np.asarray([scale], np.float32))
+                          np.asarray(scale, np.float32).reshape(-1))
             out[name] = (name + ".int8", scale)
         return out

@@ -350,6 +350,23 @@ register_flag("autotune_hbm_bytes", 0, int)
 # the smallest save interval whose measured on-step checkpoint cost
 # stays under this fraction of compute
 register_flag("autotune_overhead_budget", 0.035, float)
+def _on_quantize_mode(val):
+    if str(val).strip() not in ("", "off", "weight_only", "dynamic"):
+        raise ValueError(
+            "FLAGS_quantize_mode must be one of ''/off/weight_only/"
+            "dynamic, got %r" % (val,))
+
+
+# Quantized inference (transpiler.quantize_inference + autotune.
+# tune_quantization): an explicit mode is the operator's choice — the
+# accuracy-gated tuner records it as pinned and never measures over it
+# ("off" pins full precision; "" leaves the decision to the tuner)
+register_flag("quantize_mode", "", str, _on_quantize_mode)
+# accuracy budget for the quantization gate: the tuner only keeps a
+# quantized program whose eval delta (relative L1 over the A/B fetches)
+# stays under this fraction; rejections are recorded as TunedConfig
+# evidence and full precision is kept
+register_flag("quantize_accuracy_budget", 0.02, float)
 # seed for probabilistic fault schedules (prob=...): two runs with the
 # same seed inject at identical steps.  Registered BEFORE fault_spec:
 # an env-set spec installs schedules at import, which read this flag.

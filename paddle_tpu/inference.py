@@ -93,6 +93,7 @@ class AnalysisConfig(NativeConfig):
         super().__init__(*args, **kwargs)
         self.enable_ir_optim = enable_ir_optim
         self.serving = None
+        self.quantize_mode = None
 
     def enable_serving(self, slots=8, timeout_s=30.0, bucket_bounds=None,
                        tuned_config=None, quarantine_dir=None):
@@ -102,6 +103,17 @@ class AnalysisConfig(NativeConfig):
                         "bucket_bounds": bucket_bounds,
                         "tuned_config": tuned_config,
                         "quarantine_dir": quarantine_dir}
+        return self
+
+    def enable_quantization(self, mode="weight_only"):
+        """int8 execution (the reference's EnableTensorRtEngine-with-
+        int8 analog): the predictor rewrites the loaded program through
+        ``transpiler.quantize_inference`` — int8 weights with
+        per-channel dequant scales, fused dequant-matmul kernels.
+        Clones (and an ``enable_serving`` engine) share the rewritten
+        program.  Artifacts saved ALREADY quantized need no opt-in —
+        they load cold."""
+        self.quantize_mode = mode
         return self
 
 
@@ -129,6 +141,17 @@ class PaddlePredictor:
                         config.model_dir, self._exe,
                         model_filename=config.prog_file,
                         params_filename=config.param_file)
+            qmode = getattr(config, "quantize_mode", None)
+            if qmode:
+                # enable_quantization(): rewrite once here; clones
+                # share the quantized program + int8 scope vars
+                from .transpiler.quantize_pass import quantize_inference
+
+                self._program = quantize_inference(
+                    self._program, scope=self._scope, mode=qmode)
+                blk = self._program.global_block()
+                self._fetch_vars = [blk.var(v.name)
+                                    for v in self._fetch_vars]
             # the holder carries its own lock: clones share the holder
             # but not self._mu, and two first-calls racing from a base
             # and its clone must not build two engines

@@ -68,4 +68,4 @@ def pad_rows(a, n_padded):
     return jnp.pad(a, [(0, n_padded - n)] + [(0, 0)] * (a.ndim - 1))
 
 
-from . import softmax_xent, layer_norm  # noqa: E402,F401
+from . import softmax_xent, layer_norm, quant_matmul  # noqa: E402,F401

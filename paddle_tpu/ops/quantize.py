@@ -1,4 +1,4 @@
-"""Fake quantization ops for quantization-aware training.
+"""Fake quantization ops (QAT) and real int8 execution.
 
 Parity: reference ``operators/fake_quantize_op.cc`` (fake_quantize_abs_max,
 fake_quantize_range_abs_max) and ``operators/fake_dequantize_op.cc``
@@ -12,14 +12,33 @@ reference's GradOpDescMaker pair; the range_abs_max sliding window
 collapses to a running max state var (window bookkeeping is host-side
 bookkeeping the XLA graph does not need — the max over the window is
 what the quantizer consumes).
+
+Real execution (ISSUE 14): ``dequant_matmul`` is the inference-side op
+the ``quantize_inference`` program pass rewrites matmul/mul/FC weights
+into — int8 weights with per-output-channel dequant scales, executed as
+a fused dequant-matmul.  Two modes:
+
+* ``weight_only`` — weights dequantize into the f32 accumulator feeding
+  the dot (int8 values are exact in f32); activations keep their dtype.
+* ``dynamic`` — activations additionally quantize to int8 (per-row
+  abs-max grid, or a trained QAT ``XScale`` when the pass found one) and
+  the dot runs int8 x int8 with an int32 accumulator.
+
+The kernel per shape is the Pallas fused kernel
+(``ops/pallas/quant_matmul.py``) or the XLA ``dot_general`` fallback,
+chosen like ``fused_attention`` chooses: a tuned per-shape ruling in the
+autotune decision table wins unless the operator pinned
+``FLAGS_pallas_kernels``.
 """
 
 import numpy as np
 
 import jax.numpy as jnp
+from jax import lax
 
 from ..registry import register_op, set_output, in_var
 from ..framework import grad_var_name
+from .math import _flatten_to_2d
 
 __all__ = []
 
@@ -31,12 +50,30 @@ def _quant_range(bit_length):
 def _abs_max_infer(op, block):
     x = in_var(op, block, "X")
     set_output(op, block, "Out", x.shape, x.dtype)
-    set_output(op, block, "OutScale", (1,), x.dtype)
+    axis = op.attrs.get("quant_axis", -1)
+    scale_shape = (x.shape[axis],) if axis is not None and axis >= 0 \
+        else (1,)
+    set_output(op, block, "OutScale", scale_shape, x.dtype)
 
 
 def _abs_max_compute(ins, attrs, ctx, op_index):
     x = ins["X"][0]
     rng = _quant_range(attrs.get("bit_length", 8))
+    axis = attrs.get("quant_axis", -1)
+    if axis is not None and axis >= 0:
+        # per-channel grid along ``axis`` (conv filters axis 0, fc/mul
+        # weights their output axis): one abs-max per channel, so a wide
+        # FC layer's small-magnitude columns stop being over-clipped by
+        # the single per-tensor max — the same grid the inference-side
+        # quantize_inference pass deploys
+        red = tuple(i for i in range(x.ndim) if i != axis)
+        scale = jnp.max(jnp.abs(x), axis=red)
+        scale = jnp.maximum(scale, 1e-12)
+        bshape = [1] * x.ndim
+        bshape[axis] = scale.shape[0]
+        sb = scale.reshape(bshape)
+        q = jnp.clip(jnp.round(x / sb * rng), -rng, rng)
+        return {"Out": q * sb / rng, "OutScale": scale}
     scale = jnp.max(jnp.abs(x)).reshape(1)
     scale = jnp.maximum(scale, 1e-12)
     q = jnp.round(x / scale * rng)
@@ -127,4 +164,90 @@ register_op(
     "fake_dequantize_max_abs", ["X", "Scale"], ["Out"],
     infer=_dequant_infer, compute=_dequant_compute,
     no_grad_inputs=("Scale",),
+)
+
+
+# ---------------------------------------------------------------------------
+# real int8 execution: fused dequant-matmul (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def xla_dequant_matmul(x2, qw, scale, mode="weight_only", xscale=None,
+                       bit_length=8):
+    """XLA fallback for the fused dequant-matmul: ``x2`` [M, K] float,
+    ``qw`` [K, N] int8, ``scale`` [N] f32 dequant multipliers
+    (``w ~= qw * scale``).  ``weight_only`` dequantizes into the f32
+    accumulator (int8 values are exact in f32; one GEMM, scale applied
+    per output channel); ``dynamic`` quantizes activations to int8 too
+    (per-row abs-max grid, or the trained ``xscale`` envelope when QAT
+    calibration exists) and accumulates the int8 x int8 dot in int32
+    via ``preferred_element_type``."""
+    scale = scale.astype(jnp.float32)
+    if mode == "weight_only":
+        acc = jnp.matmul(x2.astype(jnp.float32), qw.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        return acc * scale
+    if mode != "dynamic":
+        raise ValueError("unknown dequant_matmul mode %r" % mode)
+    rng = _quant_range(bit_length)
+    xf = x2.astype(jnp.float32)
+    if xscale is not None:
+        # trained QAT running abs-max envelope -> static activation grid
+        sx = jnp.maximum(xscale.astype(jnp.float32).reshape(()),
+                         1e-12) / rng
+    else:
+        sx = jnp.maximum(jnp.max(jnp.abs(xf), axis=1, keepdims=True),
+                         1e-12) / rng
+    qx = jnp.clip(jnp.round(xf / sx), -rng, rng).astype(jnp.int8)
+    acc = lax.dot_general(qx, qw, (((1,), (0,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * sx * scale
+
+
+def _dequant_matmul_infer(op, block):
+    x = in_var(op, block, "X")
+    qw = in_var(op, block, "QWeight")
+    xnc = op.attrs.get("x_num_col_dims", 1)
+    out_shape = tuple(x.shape[:xnc]) + (qw.shape[-1],)
+    set_output(op, block, "Out", out_shape, x.dtype)
+
+
+def _dequant_matmul_compute(ins, attrs, ctx, op_index):
+    x = ins["X"][0]
+    qw = ins["QWeight"][0]
+    scale = ins["Scale"][0]
+    xscales = ins.get("XScale")
+    xscale = xscales[0] if xscales else None
+    xnc = attrs.get("x_num_col_dims", 1)
+    mode = attrs.get("mode", "weight_only")
+    bits = attrs.get("bit_length", 8)
+    x2 = _flatten_to_2d(x, xnc)
+    m, k = x2.shape
+    n = qw.shape[-1]
+
+    from .. import autotune
+    from ..flags import flag
+    from .pallas import interpret_mode
+    from .pallas import quant_matmul as qm
+
+    # kernel selection mirrors fused_attention: a tuned per-shape ruling
+    # from the autotune decision table wins, unless the operator PINNED
+    # FLAGS_pallas_kernels (then quant_kernel_choice returns None and
+    # the flag rules); supported() still gates either way
+    choice = autotune.quant_kernel_choice(m, k, n, x.dtype, mode)
+    use_pallas = flag("pallas_kernels") if choice is None else choice
+    if use_pallas and xscale is None and qm.supported(m, k, n, x.dtype):
+        acc = qm.dequant_matmul(x2, qw, scale, mode=mode,
+                                bit_length=bits,
+                                interpret=interpret_mode(ctx))
+    else:
+        acc = xla_dequant_matmul(x2, qw, scale, mode=mode, xscale=xscale,
+                                 bit_length=bits)
+    out = acc.astype(x.dtype).reshape(tuple(x.shape[:xnc]) + (n,))
+    return {"Out": out}
+
+
+register_op(
+    "dequant_matmul", ["X", "QWeight", "Scale", "XScale"], ["Out"],
+    infer=_dequant_matmul_infer, compute=_dequant_matmul_compute,
+    grad=None, no_grad_inputs=("QWeight", "Scale", "XScale"),
 )

@@ -52,7 +52,7 @@ def _default_place(place):
 def _load_tuned(tuned_config):
     """Resolve a TunedConfig (path or object) and apply it — the PR-7
     artifact is where serving reads its admitted batch size, bucket
-    bounds, and per-shape attention-kernel rulings from."""
+    bounds, per-shape kernel rulings, and quantization ruling from."""
     if tuned_config is None:
         return None
     from .. import autotune
@@ -61,6 +61,19 @@ def _load_tuned(tuned_config):
              if isinstance(tuned_config, str) else tuned_config)
     tuned.apply()
     return tuned
+
+
+def _resolve_quantize(quantize, tuned):
+    """The engine's quantization mode: an explicit ``quantize`` kwarg
+    wins; else a TunedConfig ``quantization`` ruling (the accuracy-gated
+    ``tune_quantization`` decision — ``chosen`` None means the gate kept
+    full precision); None = off."""
+    if quantize is None and tuned is not None:
+        d = tuned.get("quantization")
+        quantize = d.get("chosen") if d else None
+    if not quantize:
+        return None
+    return "weight_only" if quantize is True else str(quantize)
 
 
 def _finite_row(arrays, i, slots):
@@ -136,7 +149,8 @@ class InferenceEngine(_EngineBase):
     def __init__(self, model_dir=None, program=None, feed_names=None,
                  fetch_vars=None, scope=None, place=None, slots=None,
                  bucket_bounds=None, tuned_config=None, timeout_s=30.0,
-                 quarantine_dir=None, name="serving", start=True):
+                 quarantine_dir=None, name="serving", start=True,
+                 quantize=None):
         super().__init__()
         self.place = _default_place(place)
         self._exe = Executor(self.place, donate_state=False)
@@ -154,6 +168,20 @@ class InferenceEngine(_EngineBase):
         self._fetch_vars = list(fetch_vars)
         self._scope = scope
         tuned = _load_tuned(tuned_config)
+        # int8 execution: explicit kwarg or the TunedConfig ruling.  A
+        # save_inference_model artifact that was ALREADY quantized
+        # (dequant_matmul ops + @INT8 persistables) loads cold with no
+        # work here; the pass is for live programs / fp artifacts.
+        self.quantize_mode = _resolve_quantize(quantize, tuned)
+        if self.quantize_mode:
+            from ..transpiler.quantize_pass import quantize_inference
+
+            self._program = program = quantize_inference(
+                program, scope=scope, mode=self.quantize_mode)
+            self._fetch_vars = [
+                program.global_block().var(v.name if hasattr(v, "name")
+                                           else v)
+                for v in self._fetch_vars]
         if slots is None:
             slots = int(tuned.value("batch_size") or 0) if tuned else 0
             slots = slots or 8
@@ -335,7 +363,8 @@ class GenerationEngine(_EngineBase):
     def __init__(self, spec, place=None, scope=None, eos_id=None,
                  max_new_tokens=32, timeout_s=60.0, bucket_bounds=None,
                  tuned_config=None, quarantine_dir=None,
-                 name="serving", record_logits=False, start=True):
+                 name="serving", record_logits=False, start=True,
+                 quantize=None):
         super().__init__()
         self.spec = spec
         self.place = _default_place(place)
@@ -351,6 +380,14 @@ class GenerationEngine(_EngineBase):
             spec.init_scope(self._exe_prefill, scope)
         self._scope = scope
         tuned = _load_tuned(tuned_config)
+        # int8 decode: the per-slot working set is weight-read-bound,
+        # so int8 weights shrink it 4x vs the f32 masters.  The pass
+        # rewrites all three programs over the SHARED scope (one int8
+        # copy per weight name).
+        self.quantize_mode = _resolve_quantize(quantize, tuned)
+        if self.quantize_mode:
+            self.spec = spec = spec.quantize(scope,
+                                             mode=self.quantize_mode)
         if bucket_bounds is None and tuned is not None:
             bucket_bounds = tuned.value("bucket_bounds")
         if not bucket_bounds:

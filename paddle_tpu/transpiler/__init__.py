@@ -21,8 +21,9 @@ from .inference_transpiler import InferenceTranspiler  # noqa: F401
 from .fusion import fuse_conv_bn  # noqa: F401
 from .layout import convert_to_nhwc  # noqa: F401
 from .passes import (  # noqa: F401
-    PassBuilder, apply_pass, find_chain, get_pass, list_passes,
-    register_pass)
+    PassBuilder, apply_pass, const_fold, dead_var_eliminate, find_chain,
+    get_pass, list_passes, register_pass)
+from .quantize_pass import quantize_inference  # noqa: F401
 
 __all__ = [
     "DistributeTranspiler", "DistributeTranspilerConfig",
@@ -31,4 +32,5 @@ __all__ = [
     "fuse_conv_bn", "convert_to_nhwc", "apply_pass", "register_pass",
     "get_pass",
     "list_passes", "PassBuilder", "find_chain",
+    "dead_var_eliminate", "const_fold", "quantize_inference",
 ]

@@ -24,7 +24,8 @@ reference fusion pass matches).
 """
 
 __all__ = ["register_pass", "get_pass", "list_passes", "apply_pass",
-           "PassBuilder", "find_chain"]
+           "PassBuilder", "find_chain", "dead_var_eliminate",
+           "const_fold"]
 
 _PASSES = {}
 
@@ -153,6 +154,184 @@ def find_chain(block, op_types):
     return chains
 
 
+# ---- semantics-preserving cleanup passes (ROADMAP item 5) ------------------
+
+def _has_sub_block(op):
+    # control-flow ops (while/conditional_block/pipeline_region) read
+    # vars through their sub-blocks; liveness must treat them as roots
+    return "sub_block" in op.attrs
+
+
+def dead_var_eliminate(program, fetch_names=None):
+    """Remove ops and vars that cannot affect ``fetch_names`` or any
+    persistable state (reference ``ir/graph.h`` dead-code passes /
+    prune.cc, as an in-place cleanup pass).
+
+    Live roots: the fetch set, every op writing a persistable var
+    (optimizer updates, running stats), and every op owning a sub-block
+    (control flow reads through it).  With ``fetch_names`` omitted the
+    pass is conservative — every terminal output counts as live — so it
+    only drops unreferenced symbol-table vars.  Returns
+    ``{"ops_removed": n, "vars_removed": m}``."""
+    block = program.global_block()
+    ops = block.ops
+    if fetch_names is None:
+        consumed = set()
+        for op in ops:
+            consumed.update(op.input_arg_names)
+        fetch = {n for op in ops for n in op.output_arg_names
+                 if n and n not in consumed}
+    else:
+        fetch = {n for n in fetch_names if n}
+    live = set(fetch)
+    keep = [False] * len(ops)
+    for i in range(len(ops) - 1, -1, -1):
+        op = ops[i]
+        root = _has_sub_block(op)
+        if not root:
+            for n in op.output_arg_names:
+                v = block._find_var_recursive(n) if n else None
+                if v is not None and v.persistable:
+                    root = True
+                    break
+        if root or (set(op.output_arg_names) & live):
+            keep[i] = True
+            live.update(n for n in op.input_arg_names if n)
+    new_ops = [op for i, op in enumerate(ops) if keep[i]]
+    ops_removed = len(ops) - len(new_ops)
+    block.ops = new_ops
+    used = set(fetch)
+    for op in new_ops:
+        used.update(op.input_arg_names)
+        used.update(op.output_arg_names)
+    import collections
+
+    before = len(block.vars)
+    block.vars = collections.OrderedDict(
+        (n, v) for n, v in block.vars.items()
+        if n in used or v.persistable or v.is_data)
+    vars_removed = before - len(block.vars)
+    if ops_removed or vars_removed:
+        program._version += 1
+    return {"ops_removed": ops_removed, "vars_removed": vars_removed}
+
+
+# ops safe to evaluate at pass time: pure, deterministic, attr-driven
+# (no PRNG key, no scope state beyond their const inputs)
+_FOLDABLE = {
+    "fill_constant", "assign", "assign_value", "scale", "cast",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "sum", "minus", "sign", "clip",
+}
+
+
+def const_fold(program, max_elements=65536):
+    """Evaluate compile-time-constant op chains (rooted at
+    ``fill_constant``/``assign_value``) once at pass time and replace
+    each still-needed result with a single ``assign_value`` op
+    (reference ``ir/constant_folding_pass.cc``).  Ops with persistable
+    outputs are never folded — they participate in the executor's
+    writeback contract — and neither are ops producing more than
+    ``max_elements`` values (a folded constant lives as a Python list
+    in the op attrs, hashed by every fingerprint and serialized into
+    ``__model__``; a giant mask is cheaper as the fill_constant it
+    already is).  In place; returns the number of ops folded away."""
+    from ..registry import ComputeContext, get_op_def
+
+    import jax.numpy as jnp
+    import numpy as _np
+
+    block = program.global_block()
+    ctx = ComputeContext(key=None, is_test=True, platform="cpu")
+    # a name written MORE THAN ONCE is never a constant: a later
+    # non-folded writer would rebind it, and folding consumers against
+    # the first write's value miscompiles (name-keyed map, no SSA)
+    write_counts = {}
+    for op in block.ops:
+        for n in op.output_arg_names:
+            if n:
+                write_counts[n] = write_counts.get(n, 0) + 1
+    rebound = {n for n, c in write_counts.items() if c > 1}
+    known = {}
+    folded = set()
+    for i, op in enumerate(block.ops):
+        if op.type not in _FOLDABLE:
+            continue
+        if any(n in rebound for n in op.output_arg_names):
+            continue
+        names = [n for ns in op.inputs.values() for n in ns if n]
+        if any(n not in known for n in names):
+            continue
+        skip = False
+        for n in op.output_arg_names:
+            v = block._find_var_recursive(n) if n else None
+            if v is not None and v.persistable:
+                skip = True
+            if v is not None and v.shape is not None:
+                size = 1
+                for s in v.shape:
+                    size *= max(1, int(s))
+                if size > int(max_elements):
+                    skip = True
+        if skip:
+            continue
+        ins = {slot: [known.get(n) if n else None for n in ns]
+               for slot, ns in op.inputs.items()}
+        try:
+            outs = get_op_def(op.type).compute(ins, op.attrs, ctx, i)
+        except Exception:  # noqa: BLE001 — an unfoldable corner stays
+            continue       # in the program, correct either way
+        for slot, onames in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for nm, v in zip(onames, vals):
+                if nm:
+                    known[nm] = jnp.asarray(v)
+        folded.add(i)
+    if not folded:
+        return 0
+    # folded values still consumed by surviving ops (or terminal in the
+    # program — a fetchable result) materialize as one assign_value
+    all_consumed = set()
+    needed = set()
+    for i, op in enumerate(block.ops):
+        all_consumed.update(op.input_arg_names)
+        if i not in folded:
+            needed.update(n for n in op.input_arg_names if n in known)
+    for i in folded:
+        for nm in block.ops[i].output_arg_names:
+            if nm and nm not in all_consumed:
+                needed.add(nm)      # terminal constant: keep fetchable
+    from ..framework import Operator
+    from ..registry import infer_op
+
+    new_ops = []
+    materialized = set()
+    for i, op in enumerate(block.ops):
+        if i not in folded:
+            new_ops.append(op)
+            continue
+        for nm in op.output_arg_names:
+            if nm in needed and nm not in materialized:
+                v = _np.asarray(known[nm])
+                a = Operator(
+                    block, type="assign_value", inputs={},
+                    outputs={"Out": [nm]},
+                    attrs={"shape": [int(s) for s in v.shape],
+                           "dtype": str(v.dtype),
+                           "values": v.ravel().tolist()})
+                infer_op(a, block)
+                new_ops.append(a)
+                materialized.add(nm)
+    block.ops = new_ops
+    program._version += 1
+    return len(folded)
+
+
 # ---- built-in registrations ------------------------------------------------
 
 def _register_builtins():
@@ -163,6 +342,18 @@ def _register_builtins():
 
     register_pass("fuse_conv_bn", fuse_conv_bn)
     register_pass("memory_optimize", memory_optimize)
+    register_pass("dead_var_eliminate", dead_var_eliminate)
+    register_pass("const_fold", const_fold)
+
+    @register_pass("quantize_inference")
+    def _quantize_inference(program, scope=None, mode="weight_only",
+                            weight_bits=8):
+        """int8 program rewrite (quantize_pass.quantize_inference):
+        returns the NEW quantized program (chained by PassBuilder)."""
+        from .quantize_pass import quantize_inference
+
+        return quantize_inference(program, scope=scope, mode=mode,
+                                  weight_bits=weight_bits)
 
     @register_pass("inference_optimize")
     def _inference_optimize(program, place=None, scope=None):

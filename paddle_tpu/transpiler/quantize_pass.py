@@ -1,0 +1,228 @@
+"""``quantize_inference`` — the int8 program-rewrite pass (ISSUE 14).
+
+The QAT stack (``contrib.quantize``/``ops/quantize.py``) only
+*simulates* int8: weights stay float and carry grid rounding error.
+This pass makes inference programs **execute** int8: every matmul/mul
+(FC) weight becomes an int8 persistable plus a per-output-channel
+dequant-scale vector, and the op is rewritten to ``dequant_matmul``
+(``ops/quantize.py``: Pallas fused kernel or XLA ``dot_general``
+fallback, selected per shape through the autotune decision table).
+
+Per the GSPMD philosophy (PAPERS.md), the rewrite is a program-level
+annotation: the pass only changes what the program *says* — which
+weights are int8, which grids apply — and the kernel layer does the
+work.  Two modes:
+
+* ``weight_only`` — weights int8, activations untouched (f32
+  accumulate).  The safe default; the 4x weight-byte shrink is where
+  serving throughput/$ comes from.
+* ``dynamic`` — activations additionally quantize per batch (per-row
+  abs-max grid) to int8 and the dot accumulates in int32.  When the
+  program carries a trained QAT activation scale
+  (``fake_quantize_range_abs_max`` running state), the pass consumes it
+  as the static activation grid instead of re-measuring.
+
+QAT calibration: a weight fed through a fake-quant op deploys on the
+grid QAT trained against — the trained ``OutScale`` envelope (or the
+identical recomputed abs-max for stateless ``abs_max`` weights) — and
+the weight-side fake-quant op disappears from the rewritten program.
+
+The int8 weights and scale vectors are *persistable scope vars*, so
+``save_inference_model`` ships them (the pruned program no longer
+references the float master weights — the artifact shrinks) and a cold
+``load_inference_model``/serving-engine load runs quantized with no
+re-calibration.
+"""
+
+import numpy as np
+
+from ..framework import Operator
+from ..registry import infer_op
+from ..scope import global_scope
+
+__all__ = ["quantize_inference", "QUANT_SUFFIX", "SCALE_SUFFIX"]
+
+QUANT_SUFFIX = "@INT8"
+SCALE_SUFFIX = "@INT8_SCALE"
+
+_FAKE_QUANT_OPS = ("fake_quantize_abs_max", "fake_quantize_range_abs_max")
+_MODES = ("weight_only", "dynamic")
+
+
+def _trained_scale(op, scope):
+    """The trained QAT calibration envelope of a fake-quant op, or None
+    when no usable state exists (abs_max ops are stateless; a zero
+    running scale means the state was never trained)."""
+    if op is None or op.type != "fake_quantize_range_abs_max":
+        return None
+    names = op.inputs.get("InScale") or []
+    if not names or not scope.has_var(names[0]):
+        return None
+    s = np.asarray(scope.var(names[0]), dtype=np.float64).ravel()
+    if s.size == 0 or float(np.max(s)) <= 0:
+        return None
+    return s
+
+
+def _floatish(var):
+    return var.dtype is not None and "float" in str(var.dtype)
+
+
+def quantize_inference(program, scope=None, mode="weight_only",
+                       weight_bits=8, reuse_existing=False):
+    """Return a NEW program with matmul/mul weights rewritten to int8
+    ``dequant_matmul`` execution; ``scope`` gains the ``<w>@INT8`` /
+    ``<w>@INT8_SCALE`` persistable values.  The input program is never
+    mutated (pass-framework contract: a pass returning a Program feeds
+    it to the passes after it).
+
+    ``reuse_existing=True`` trusts ``@INT8``/``@INT8_SCALE`` values
+    already in the scope instead of re-quantizing (the int8 grid is
+    mode-independent): the shared-scope multi-program case —
+    ``DecoderSpec.quantize`` rewrites three programs over one weight
+    set — quantizes each weight once.  Leave it False when the fp
+    masters may have changed since the values were written."""
+    if mode not in _MODES:
+        raise ValueError("quantize_inference mode must be one of %s, "
+                         "got %r" % (_MODES, mode))
+    scope = scope if scope is not None else global_scope()
+    out = program.clone(for_test=True)
+    block = out.global_block()
+    rng_max = float((1 << (int(weight_bits) - 1)) - 1)
+
+    producers = {}
+    for op in block.ops:
+        for nm in op.output_arg_names:
+            if nm:
+                producers[nm] = op
+
+    converted = {}          # weight name -> (int8 name, scale name)
+    info = {"mode": mode, "weight_bits": int(weight_bits), "weights": {}}
+    new_ops = []
+    for op in block.ops:
+        if op.type not in ("mul", "matmul"):
+            new_ops.append(op)
+            continue
+        if op.type == "matmul" and (op.attrs.get("transpose_X")
+                                    or op.attrs.get("transpose_Y")
+                                    or op.attrs.get("alpha", 1.0) != 1.0):
+            new_ops.append(op)
+            continue
+        if op.type == "mul" and op.attrs.get("y_num_col_dims", 1) != 1:
+            new_ops.append(op)
+            continue
+        x_name = op.inputs["X"][0]
+        y_name = op.inputs["Y"][0]
+        # unwrap a QAT weight fake-quant: its raw input is the weight,
+        # its trained envelope the calibration
+        wname, w_fq = y_name, None
+        p = producers.get(y_name)
+        if p is not None and p.type in _FAKE_QUANT_OPS:
+            wname, w_fq = p.inputs["X"][0], p
+        wvar = block._find_var_recursive(wname)
+        if wvar is None or not wvar.persistable or not _floatish(wvar) \
+                or not scope.has_var(wname):
+            new_ops.append(op)
+            continue
+        w = np.asarray(scope.var(wname))
+        if w.ndim != 2:
+            new_ops.append(op)
+            continue
+
+        if wname not in converted:
+            n_out = w.shape[1]
+            qname = wname + QUANT_SUFFIX
+            sname = wname + SCALE_SUFFIX
+            if reuse_existing and scope.has_var(qname) \
+                    and scope.has_var(sname) \
+                    and np.asarray(scope.var(qname)).shape == \
+                    tuple(w.shape):
+                # shared-scope multi-program case: the values are
+                # already there (mode-independent grid) — declare the
+                # vars, skip the re-quantization
+                calibration, q_size = "reused", int(np.asarray(w).size)
+            else:
+                w64 = np.asarray(w, np.float64)
+                fq_scale = _trained_scale(w_fq, scope)
+                if fq_scale is not None:
+                    # the trained envelope IS the grid QAT optimized
+                    # against (per-channel when trained per-channel;
+                    # a scalar envelope broadcasts)
+                    sw = fq_scale if fq_scale.size == n_out else np.full(
+                        (n_out,), float(fq_scale.ravel()[0]), np.float64)
+                    calibration = "qat_out_scale"
+                else:
+                    sw = np.abs(w64).max(axis=0)
+                    calibration = "abs_max"
+                sw = np.maximum(sw, 1e-12) / rng_max  # dequant multiplier
+                q = np.clip(np.round(w64 / sw), -rng_max,
+                            rng_max).astype(np.int8)
+                scope.set_var(qname, q)
+                scope.set_var(sname, sw.astype(np.float32))
+                q_size = int(q.size)
+            block.create_var(name=qname, shape=tuple(w.shape),
+                             dtype="int8", persistable=True)
+            block.create_var(name=sname, shape=(int(n_out),),
+                             dtype="float32", persistable=True)
+            converted[wname] = (qname, sname)
+            info["weights"][wname] = {
+                "int8": qname, "scale": sname,
+                "calibration": calibration,
+                "bytes_fp": int(np.asarray(w).size
+                                * np.dtype(w.dtype).itemsize),
+                "bytes_int8": q_size}
+        qname, sname = converted[wname]
+
+        # activation side: a trained QAT activation envelope feeds the
+        # dynamic mode as a static grid (calibration consumed, not
+        # re-measured); weight-only leaves activation fake-quants alone
+        # (they are the numerics QAT trained)
+        raw_x, xscale = x_name, None
+        if mode == "dynamic":
+            px = producers.get(x_name)
+            if px is not None and px.type in _FAKE_QUANT_OPS:
+                ts = _trained_scale(px, scope)
+                if ts is not None:
+                    raw_x = px.inputs["X"][0]
+                    xscale = px.inputs["InScale"][0]
+        xvar = block._find_var_recursive(raw_x)
+        xnc = op.attrs.get("x_num_col_dims", 1) if op.type == "mul" \
+            else max(1, len(xvar.shape) - 1)
+        inputs = {"X": [raw_x], "QWeight": [qname], "Scale": [sname]}
+        if xscale is not None:
+            inputs["XScale"] = [xscale]
+        nop = Operator(block, type="dequant_matmul", inputs=inputs,
+                       outputs={"Out": list(op.outputs["Out"])},
+                       attrs={"x_num_col_dims": xnc, "mode": mode,
+                              "bit_length": int(weight_bits)})
+        infer_op(nop, block)
+        new_ops.append(nop)
+
+    if not converted:
+        block.ops = new_ops
+        out._version += 1
+        out._quantize_info = info
+        return out
+
+    # consumed fake-quant ops disappear: a weight-side (or bypassed
+    # activation-side) fake-quant whose Out no longer feeds anything
+    # else is dead
+    consumed_by = {}
+    for i, op in enumerate(new_ops):
+        for nm in op.input_arg_names:
+            if nm:
+                consumed_by.setdefault(nm, set()).add(i)
+    final_ops = []
+    for i, op in enumerate(new_ops):
+        if op.type in _FAKE_QUANT_OPS:
+            users = set()
+            for nm in op.outputs.get("Out", []):
+                users |= consumed_by.get(nm, set())
+            users.discard(i)
+            if not users:
+                continue
+        final_ops.append(op)
+    block.ops = final_ops
+    out._version += 1
+    out._quantize_info = info
+    return out

@@ -296,3 +296,36 @@ def test_ckpt_sharded_rung_save_wall_indexed_but_non_gating(tmp_path):
     assert judged["save_wall_s"]["informational"]
     assert runs["r02"]["verdict"] == "PASS"
     assert report["overall"] == "PASS"
+
+
+def test_quantized_rung_accuracy_delta_indexed_but_non_gating(tmp_path):
+    """ISSUE 14: the quantized rung's {tok_s, accuracy_delta} index and
+    judge against prior history (value higher-better, delta
+    lower-better), but the rung is informational while it accumulates
+    history — a worse delta never flips the overall verdict."""
+    def quant(tok_s, delta):
+        return _rung("quantized_tok_per_sec", tok_s, step_s=1.0 / tok_s,
+                     informational=True, accuracy_delta=delta,
+                     bf16_tok_s=tok_s / 1.5, gate_pass=True)
+
+    r1 = {"metric": "resnet", "value": 100.0, "unit": "img/s",
+          "vs_baseline": 1.0, "min_step_s": 0.5, "n_windows": 3,
+          "extra_metrics": [quant(420.0, 0.009)]}
+    r2 = copy.deepcopy(r1)
+    r2["extra_metrics"] = [quant(400.0, 0.019)]   # worse delta + tok/s
+    paths = [_write(tmp_path, "qa.json", _wrapper(1, r1)),
+             _write(tmp_path, "qb.json", _wrapper(2, r2))]
+    report = bench_history.compare(
+        [bench_history.load_artifact(p, i)
+         for i, p in enumerate(paths)])
+    runs = {r["run"]: r for r in report["runs"]}
+    rec = [g for g in runs["r02"]["rungs"]
+           if g["metric"] == "quantized_tok_per_sec"][0]
+    assert rec["accuracy_delta"] == 0.019
+    judged = {c["field"]: c for c in runs["r02"]["comparisons"]
+              if c["metric"] == "quantized_tok_per_sec"}
+    assert judged["accuracy_delta"]["verdict"] == "REGRESSED"
+    assert judged["accuracy_delta"]["informational"]
+    assert judged["value"]["current"] == 400.0
+    assert runs["r02"]["verdict"] == "PASS"
+    assert report["overall"] == "PASS"

@@ -73,6 +73,169 @@ def test_custom_pass_and_builder_pipeline(tmp_path):
         _p._PASSES.pop("count_ops_test", None)
 
 
+def test_registry_has_new_builtin_passes():
+    names = list_passes()
+    for expected in ("dead_var_eliminate", "const_fold",
+                     "quantize_inference"):
+        assert expected in names, names
+
+
+# ---------------------------------------------------------------------------
+# semantics-preserving passes (ROADMAP item 5 acceptance): >= 3
+# registered passes asserted same-fetches with bit tolerance
+# ---------------------------------------------------------------------------
+
+def _run(program, exe, scope, feed, fetch_name):
+    (out,) = exe.run(program, feed=feed, fetch_list=[fetch_name],
+                     scope=scope)
+    return np.asarray(out)
+
+
+def test_dead_var_eliminate_preserves_semantics():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[8])
+        live = fluid.layers.fc(a, size=4, act="relu")
+        fluid.layers.fc(a, size=32, act="relu")     # dead branch
+        out = fluid.layers.fc(live, size=2)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"a": rng.rand(4, 8).astype("float32")}
+        ref = _run(main, exe, scope, feed, out.name)
+        n_ops = len(main.global_block().ops)
+        res = apply_pass(main, "dead_var_eliminate",
+                         fetch_names=[out.name])
+        assert res["ops_removed"] >= 2 and res["vars_removed"] >= 1, res
+        assert len(main.global_block().ops) < n_ops
+        # same fetches, BIT-identical (the pass only removes dead work)
+        np.testing.assert_array_equal(
+            ref, _run(main, exe, scope, feed, out.name))
+
+
+def test_dead_var_eliminate_default_keeps_terminal_outputs():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[4])
+        fluid.layers.fc(a, size=2)     # terminal: live by default
+    n_ops = len(main.global_block().ops)
+    res = apply_pass(main, "dead_var_eliminate")
+    assert res["ops_removed"] == 0
+    assert len(main.global_block().ops) == n_ops
+
+
+def test_const_fold_preserves_semantics():
+    rng = np.random.RandomState(0)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        b = fluid.layers.data("b", shape=[4])
+        c1 = fluid.layers.fill_constant(shape=[4], dtype="float32",
+                                        value=2.0)
+        c2 = fluid.layers.scale(c1, scale=0.5)
+        c3 = fluid.layers.elementwise_add(
+            c2, fluid.layers.fill_constant(shape=[4], dtype="float32",
+                                           value=1.0))
+        y = fluid.layers.elementwise_add(fluid.layers.fc(b, size=4), c3)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = {"b": rng.rand(3, 4).astype("float32")}
+        ref = _run(main, exe, scope, feed, y.name)
+        n = apply_pass(main, "const_fold")
+        assert n >= 3, n
+        types = [op.type for op in main.global_block().ops]
+        assert "fill_constant" not in types
+        assert types.count("assign_value") == 1    # one materialized
+        # same fetches, BIT-identical (the folded value is the same
+        # arithmetic, computed once at pass time)
+        np.testing.assert_array_equal(
+            ref, _run(main, exe, scope, feed, y.name))
+
+
+def test_const_fold_never_folds_rebound_names():
+    """Regression (review repro): a var name WRITTEN TWICE — constant
+    first, runtime value second — must not fold consumers against the
+    stale first write (the IR is not SSA; name-keyed constants are only
+    sound for single-write names)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        data = fluid.layers.data("d", shape=[4])
+        t = fluid.layers.fill_constant(shape=[1, 4], dtype="float32",
+                                       value=2.0)
+        blk = main.global_block()
+        # rebind t to the runtime feed, then consume it
+        blk.append_op(type="assign", inputs={"X": [data.name]},
+                      outputs={"Out": [t.name]})
+        u = fluid.layers.scale(t, scale=0.5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {"d": np.full((1, 4), 8.0, "float32")}
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed=feed, fetch_list=[u.name])
+        apply_pass(main, "const_fold")
+        (out,) = exe.run(main, feed=feed, fetch_list=[u.name])
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.full((1, 4), 4.0, "float32"))
+
+
+def test_const_fold_skips_persistable_outputs():
+    """Startup-program init ops write persistables through the
+    executor's writeback — folding them away would skip parameter
+    init."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        fluid.layers.fc(x, size=2)
+    n_startup = len(startup.global_block().ops)
+    assert apply_pass(startup, "const_fold") == 0
+    assert len(startup.global_block().ops) == n_startup
+
+
+def test_fuse_conv_bn_preserves_semantics():
+    """fuse_conv_bn decomposes train-mode BNs around 1x1 convs into the
+    fused producer/consumer op chain — same fetches within float
+    tolerance (the test_conv_bn_fusion precedent band)."""
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[8, 8, 8])
+            c1 = fluid.layers.conv2d(img, num_filters=16, filter_size=1,
+                                     bias_attr=False)
+            b1 = fluid.layers.batch_norm(c1, act="relu")
+            c2 = fluid.layers.conv2d(b1, num_filters=4, filter_size=1,
+                                     bias_attr=False)
+            out = fluid.layers.mean(c2)
+        return main, startup, out
+
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(2, 8, 8, 8).astype("float32")}
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main, startup, out = build()
+    # one parameter set, shared by name: the fused clone reads the same
+    # scope values, so the A/B isolates the pass's arithmetic
+    fused = main.clone()
+    n = apply_pass(fused, "fuse_conv_bn")
+    assert n >= 1
+    types = [op.type for op in fused.global_block().ops]
+    assert "batch_norm" not in types
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ref = _run(main, exe, scope, feed, out.name)
+        np.testing.assert_allclose(
+            ref, _run(fused, exe, scope, feed, out.name),
+            rtol=2e-3, atol=2e-4)
+
+
 def test_pipeline_program_chaining():
     """A pass returning a new Program (inference_optimize) feeds it to
     later passes: the graph_viz dot of the result has no train-only

@@ -49,13 +49,16 @@ import sys
 # that rung is informational, so they index and judge without gating.
 # save_wall_s is the ckpt_sharded rung's per-host checkpoint save wall
 # clock (also informational: disk-bound, not chip-bound).
+# accuracy_delta is the quantized rung's eval delta vs full precision
+# (informational like the rung: indexed and judged, never gating).
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
           ("mfu", "higher", "mfu"),
           ("goodput", "higher", "goodput"),
           ("throughput_rps", "higher", "rps"),
           ("p99_ms", "lower", "p99"),
-          ("save_wall_s", "lower", "save_s"))
+          ("save_wall_s", "lower", "save_s"),
+          ("accuracy_delta", "lower", "acc_d"))
 
 
 def _rung_record(r):
@@ -74,7 +77,8 @@ def _rung_record(r):
     mfu = r.get("mfu", r.get("exact_mfu", r.get("est_mfu")))
     if mfu is not None:
         out["mfu"] = mfu
-    for f in ("throughput_rps", "p99_ms", "save_wall_s"):
+    for f in ("throughput_rps", "p99_ms", "save_wall_s",
+              "accuracy_delta"):
         if r.get(f) is not None:
             out[f] = r[f]
     gp = r.get("goodput")

@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/13 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/14 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/13 API signature gate =="
+echo "== 2/14 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/13 8-device virtual-mesh dryrun =="
+echo "== 3/14 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/13 bench smoke (CPU backend, tiny) =="
+echo "== 4/14 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/13 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/14 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/13 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/14 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/13 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/14 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -170,7 +170,7 @@ PY
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
-echo "== 8/13 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+echo "== 8/14 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
 GUARD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
 # the drill is installed purely from the environment (FLAGS_fault_spec)
@@ -227,7 +227,7 @@ PY
 grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
 grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
 
-echo "== 9/13 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
+echo "== 9/14 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
 TUNE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
@@ -323,7 +323,7 @@ print("AUTOTUNE TRAINER FINAL %.6f over %d steps"
       % (losses[-1], len(losses)), flush=True)
 PY
 
-echo "== 10/13 goodput smoke + bench-history regression gate =="
+echo "== 10/14 goodput smoke + bench-history regression gate =="
 GOOD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR"' EXIT
 # (a) a 3-step monitored MLP run -> the goodput ledger attributes its
@@ -383,7 +383,7 @@ assert any(c["field"] == "min_step_s" and c["verdict"] == "REGRESSED"
 print("bench_history: +20% perturbation flagged REGRESSED")
 PY
 
-echo "== 11/13 serving smoke (engine over toy MLP, concurrent requests) =="
+echo "== 11/14 serving smoke (engine over toy MLP, concurrent requests) =="
 SERVE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'PY'
@@ -438,7 +438,7 @@ PY
 # per-request serving/* events landed in the JSONL, run_id-correlated
 grep -ql serving_request "$SERVE_DIR"/monitor/*.jsonl
 
-echo "== 12/13 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
+echo "== 12/14 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
 echo "==       loss parity vs GPipe + measured pipeline_bubble drop)        =="
 PIPE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR"' EXIT
@@ -513,7 +513,7 @@ PY
 # the pipeline_bubble bucket landed in the goodput JSONL stamps
 grep -ql pipeline_bubble "$PIPE_DIR"/*.jsonl
 
-echo "== 13/13 cluster elastic-resume drill (2 members, SIGKILL one mid-run) =="
+echo "== 13/14 cluster elastic-resume drill (2 members, SIGKILL one mid-run) =="
 CLUSTER_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR"' EXIT
 # the supervisor runs the whole acceptance drill: an uninterrupted
@@ -538,5 +538,71 @@ assert r["save_wall_s"] is not None and r["informational"] is True
 print("CKPT_SHARDED per-host wall %.3fs, bytes/N %s, MB/s spread %.2f"
       % (r["save_wall_s"], r["bytes_one_over_n"], r["mb_per_s_spread"]))
 PY
+
+echo "== 14/14 quantized inference smoke (pass -> gate -> save -> serving) =="
+QUANT_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR"' EXIT
+# end-to-end int8: accuracy-gated tune_quantization over a toy inference
+# program -> TunedConfig evidence -> quantize_inference rewrite ->
+# save_inference_model (int8 persistables, fp masters gone) -> a COLD
+# serving-engine load of the quantized artifact answers requests with
+# finite outputs and an eval delta under the budget
+JAX_PLATFORMS=cpu python - "$QUANT_DIR" <<'PY'
+import os, sys
+sys.path.insert(0, os.getcwd())
+import json
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import autotune, monitor
+from paddle_tpu.serving import InferenceEngine
+from paddle_tpu.transpiler import quantize_inference
+
+out = sys.argv[1]
+monitor.enable(log_dir=os.path.join(out, "monitor"))
+fluid.default_main_program().random_seed = 11
+fluid.default_startup_program().random_seed = 11
+x = fluid.layers.data("x", shape=[64])
+h = fluid.layers.fc(x, size=256, act="relu")
+pred = fluid.layers.fc(h, size=16, act="softmax")
+main = fluid.default_main_program()
+scope = fluid.Scope()
+rng = np.random.RandomState(0)
+feed = {"x": rng.rand(8, 64).astype("float32")}
+exe = fluid.Executor(fluid.CPUPlace())
+with fluid.scope_guard(scope):
+    exe.run(fluid.default_startup_program())
+    (ref,) = exe.run(main, feed=feed, fetch_list=[pred])
+    cfg = autotune.TunedConfig(meta={"model": "quant_smoke"})
+    d = autotune.tune_quantization(main, scope, feed, [pred],
+                                   fluid.CPUPlace(), probe_steps=2,
+                                   min_speedup=0.0, config=cfg)
+    assert d["chosen"] is not None, d   # a mode survived the gate
+    cfg.save(os.path.join(out, "tuned.json"))
+    qprog = quantize_inference(main, scope=scope, mode=d["chosen"])
+    fluid.io.save_inference_model(
+        os.path.join(out, "model"), ["x"],
+        [qprog.global_block().var(pred.name)], exe, main_program=qprog)
+# artifact holds int8 weights, not the fp masters
+mm = json.load(open(os.path.join(out, "model", "__model__")))
+names = [v["name"] for b in mm["program"]["blocks"] for v in b["vars"]]
+assert any(n.endswith("@INT8") for n in names), names
+assert "fc_0.w_0" not in names, "fp master weight still in artifact"
+# cold load into the serving engine; finite outputs, delta under budget
+eng = InferenceEngine(model_dir=os.path.join(out, "model"), slots=4,
+                      timeout_s=60.0)
+outs = [eng.run({"x": feed["x"][i]}) for i in range(8)]
+eng.close()
+q = np.stack([np.asarray(o[0]) for o in outs])
+assert np.isfinite(q).all()
+delta = autotune.eval_delta([np.asarray(ref)], [q])
+budget = fluid.get_flags("quantize_accuracy_budget")[
+    "quantize_accuracy_budget"]
+assert delta <= budget, (delta, budget)
+print("QUANTIZED mode=%s accuracy_delta=%.6f (budget %.3f), "
+      "cold serving load OK" % (d["chosen"], delta, budget), flush=True)
+PY
+# the gate's decision trail landed in the JSONL
+grep -ql '"knob": "quantization"' "$QUANT_DIR"/monitor/*.jsonl || \
+  grep -ql quantization "$QUANT_DIR"/monitor/*.jsonl
 
 echo "CI OK"

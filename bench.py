@@ -552,6 +552,142 @@ def bench_ckpt_sharded(args):
             "roundtrip_bit_identical": bool(roundtrip_ok)}
 
 
+def bench_rec_sparse(args):
+    """Recommendation sparse-embedding rung (ISSUE 15): the vocab-
+    scaling A/B for the end-to-end SelectedRows path.  A wide&deep-style
+    embedding-dominated model (ctr_dnn's shape: id lookups -> sum pool
+    -> small tower, Adam) trains with ``is_sparse=True`` (SelectedRows
+    grad -> lazy touched-rows Adam) and ``is_sparse=False`` (dense
+    [vocab, D] grad -> full-table Adam) at vocab = 1e4 / 1e5 / 1e6 with
+    the SAME batch of ids.  The sparse step's work is O(batch·seq)
+    while the dense step's gradient + moment update is O(vocab), so
+    ``sparse_step_s`` stays ~flat where ``dense_step_s`` grows linearly
+    (acceptance: >=5x at vocab=1e6).  The checkpoint side is the
+    Check-N-Run claim: with incremental mode on, the delta artifact's
+    bytes (``incr_ckpt_bytes``) scale with rows touched since the last
+    save, not with vocab, while the full base grows linearly.
+    ``sparse_step_s`` / ``dense_step_s`` / ``incr_ckpt_bytes`` are
+    indexed by tools/bench_history.py; informational, never a gate
+    (the scaling RATIO is the claim, not an absolute chip number).
+    Touched-rows/step rides the monitor registry
+    (``sparse/touched_rows``) and the per-step JSONL records."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.framework import program_guard
+    from paddle_tpu.param_attr import ParamAttr
+    from paddle_tpu.parallel.checkpoint import TrainStateCheckpointManager
+
+    B, S, D = 64, 16, 16
+    STEPS, WARM = 6, 2
+    rng = np.random.RandomState(7)
+    place = _place(args)
+
+    def build(vocab, is_sparse):
+        fluid.default_main_program().random_seed = 11
+        fluid.default_startup_program().random_seed = 11
+        ids = fluid.layers.data("ids", shape=[S, 1], dtype="int64")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(
+            ids, size=[vocab, D], is_sparse=is_sparse,
+            param_attr=ParamAttr(name="table"))
+        pooled = fluid.layers.reduce_sum(emb, dim=1)
+        x = fluid.layers.fc(pooled, size=32, act="relu")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(pred, y)))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        return loss
+
+    def batches(vocab, n):
+        r = np.random.RandomState(3)
+        return [{"ids": r.randint(0, vocab, (B, S, 1)).astype("int64"),
+                 "y": r.rand(B, 1).astype("float32")} for _ in range(n)]
+
+    def dir_bytes(d):
+        return sum(os.path.getsize(os.path.join(root, f))
+                   for root, _, fs in os.walk(d) for f in fs)
+
+    def run_variant(vocab, is_sparse, ckpt_dir=None):
+        """(min warm step seconds, {full, delta} artifact bytes)."""
+        scope = fluid.Scope()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.scope_guard(scope), program_guard(main, startup):
+            loss = build(vocab, is_sparse)
+            exe = fluid.Executor(place)
+            exe.run(startup)
+            feeds = batches(vocab, STEPS)
+            steps = []
+            for i, f in enumerate(feeds):
+                t0 = time.monotonic()
+                out = exe.run(main, feed=f, fetch_list=[loss])
+                float(np.asarray(out[0]).ravel()[0])   # fetch-sync
+                if i >= WARM:
+                    steps.append(time.monotonic() - t0)
+            ck = {}
+            if ckpt_dir is not None:
+                mgr = TrainStateCheckpointManager(
+                    ckpt_dir, async_save=False, incremental="auto",
+                    incremental_full_every=8, max_to_keep=None)
+                mgr.save(1, scope=scope, program=main, executors=exe)
+                ck["full"] = dir_bytes(mgr._step_dir(1))
+                exe.run(main, feed=feeds[-1], fetch_list=[loss])
+                mgr.save(2, scope=scope, program=main, executors=exe)
+                ck["delta"] = dir_bytes(mgr._step_dir(2))
+        return min(steps), ck
+
+    mon_dir = tempfile.mkdtemp(prefix="bench_rec_mon_")
+    workdir = tempfile.mkdtemp(prefix="bench_rec_sparse_")
+    monitor.enable(log_dir=mon_dir)
+    per_vocab = {}
+    try:
+        for vocab in (10_000, 100_000, 1_000_000):
+            ckd = os.path.join(workdir, "ck_%d" % vocab)
+            sparse_s, ck = run_variant(vocab, True, ckpt_dir=ckd)
+            dense_s, _ = run_variant(vocab, False)
+            per_vocab[str(vocab)] = {
+                "sparse_step_s": round(sparse_s, 5),
+                "dense_step_s": round(dense_s, 5),
+                "dense_over_sparse": round(dense_s / sparse_s, 2),
+                "full_ckpt_bytes": ck["full"],
+                "incr_ckpt_bytes": ck["delta"],
+            }
+        touched = monitor.registry().snapshot().get(
+            "sparse/touched_rows", {}).get("value")
+    finally:
+        monitor.disable()
+        shutil.rmtree(workdir, ignore_errors=True)
+        shutil.rmtree(mon_dir, ignore_errors=True)
+
+    v1m = per_vocab["1000000"]
+    v10k = per_vocab["10000"]
+    return {"metric": "rec_sparse_vocab_scaling",
+            # value is HIGHER-is-better: the sparse path's step-time
+            # advantage over the dense A/B at vocab=1e6 (the acceptance
+            # predicate is >= 5x)
+            "value": v1m["dense_over_sparse"], "unit": "x_dense_step",
+            "vs_baseline": 0.0, "informational": True,
+            "sparse_step_s": v1m["sparse_step_s"],
+            "dense_step_s": v1m["dense_step_s"],
+            "incr_ckpt_bytes": v1m["incr_ckpt_bytes"],
+            "per_vocab": per_vocab,
+            # flatness evidence across 100x vocab growth
+            "sparse_step_spread": round(
+                max(p["sparse_step_s"] for p in per_vocab.values())
+                / min(p["sparse_step_s"] for p in per_vocab.values()), 2),
+            "incr_bytes_spread": round(
+                max(p["incr_ckpt_bytes"] for p in per_vocab.values())
+                / min(p["incr_ckpt_bytes"] for p in per_vocab.values()),
+                2),
+            "full_over_incr_bytes_1e6": round(
+                v1m["full_ckpt_bytes"] / v1m["incr_ckpt_bytes"], 1),
+            "dense_step_growth_1e4_to_1e6": round(
+                v1m["dense_step_s"] / v10k["dense_step_s"], 2),
+            "touched_rows_total": touched}
+
+
 def bench_serving(args):
     """Serving rung (ISSUE 11): throughput-vs-latency curve for the
     continuous-batching engine against the bs=16 sequential-dispatch
@@ -1798,7 +1934,8 @@ def main():
                             "se_resnext", "stacked_lstm",
                             "machine_translation", "alexnet", "googlenet",
                             "smallnet", "reader_capacity", "fault_drill",
-                            "serving", "ckpt_sharded", "quantized"])
+                            "serving", "ckpt_sharded", "quantized",
+                            "rec_sparse"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -1984,6 +2121,10 @@ def main():
             # quantized-vs-bf16 forward A/B in the serving small-batch
             # regime; informational while the rung accumulates history
             ("quantized", ["--n_windows", "3"], True, 300),
+            # sparse embedding scale-up (ISSUE 15): dense-vs-sparse
+            # vocab-scaling A/B + incremental-checkpoint bytes; the
+            # ratio is the claim, not an absolute chip number
+            ("rec_sparse", [], True, 300),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -2181,6 +2322,8 @@ def main():
         result = bench_ckpt_sharded(args)
     elif args.model == "quantized":
         result = bench_quantized(args)
+    elif args.model == "rec_sparse":
+        result = bench_rec_sparse(args)
     elif args.model == "transformer_realdist":
         result = bench_transformer_realdist(args,
                                             use_amp=not args.fp32_only)

@@ -72,15 +72,30 @@ class _GradAccumulator:
                 self.block.append_op(
                     type="assign", inputs={"X": [cs[0]]}, outputs={"Out": [target]}
                 )
+                self._propagate_sparse_type(cs, target)
             self.pending[fwd_name] = [target]
             self._maybe_error_clip(fwd_name, target)
             return target
         self.block.append_op(
             type="sum", inputs={"X": list(cs)}, outputs={"Out": [target]}
         )
+        self._propagate_sparse_type(cs, target)
         self.pending[fwd_name] = [target]
         self._maybe_error_clip(fwd_name, target)
         return target
+
+    def _propagate_sparse_type(self, contributions, target):
+        """A sum/alias of only SELECTED_ROWS contributions is itself a
+        SELECTED_ROWS value (the sum kernel concatenates row lists), so
+        the summed grad var keeps the type for build-time consumers
+        (clip/regularizer sparse paths)."""
+        from .core import VarType
+
+        if all(getattr(self.block._find_var_recursive(c), "type", None)
+               == VarType.SELECTED_ROWS for c in contributions):
+            v = self.block._find_var_recursive(target)
+            if v is not None:
+                v.type = VarType.SELECTED_ROWS
 
     def _maybe_error_clip(self, fwd_name, grad_name):
         """Apply the forward var's ``error_clip`` to its summed gradient,

@@ -7,8 +7,18 @@ optimizer ops, attached per-param via ParamAttr.gradient_clip or globally
 via ``set_gradient_clip``.
 """
 
+from .core import VarType
 from .framework import default_main_program
 from .layer_helper import LayerHelper
+
+
+def _propagate_sparse(src, dst):
+    """Clip products of a SELECTED_ROWS gradient are themselves sparse
+    (the kernels keep the rows); the var type must follow so downstream
+    build-time consumers (the regularizer's lazy-decay branch) see it."""
+    if getattr(src, "type", None) == VarType.SELECTED_ROWS:
+        dst.type = VarType.SELECTED_ROWS
+    return dst
 
 __all__ = [
     "ErrorClipByValue",
@@ -82,7 +92,7 @@ class GradientClipByValue(BaseGradientClipAttr):
             type="clip", inputs={"X": [grad]}, outputs={"Out": [new_grad]},
             attrs={"min": self.min, "max": self.max},
         )
-        return param, new_grad
+        return param, _propagate_sparse(grad, new_grad)
 
 
 class GradientClipByNorm(BaseGradientClipAttr):
@@ -97,7 +107,7 @@ class GradientClipByNorm(BaseGradientClipAttr):
             outputs={"Out": [new_grad]},
             attrs={"max_norm": self.clip_norm},
         )
-        return param, new_grad
+        return param, _propagate_sparse(grad, new_grad)
 
 
 class GradientClipByGlobalNorm(BaseGradientClipAttr):
@@ -206,7 +216,7 @@ def append_gradient_clip_ops(param_grads):
                 type="elementwise_mul", inputs={"X": [g], "Y": [scale]},
                 outputs={"Out": [new_grad]},
             )
-            result.append((p, new_grad))
+            result.append((p, _propagate_sparse(g, new_grad)))
         else:
             result.append(clip_attr._create_operators(p, g))
     return result

@@ -81,9 +81,17 @@ class Task:
 def partition(chunks, chunks_per_task=1):
     """Group chunks into tasks (go/master/service.go:106).
 
-    IDs are dense ints (the Go original uses time+rand uniqueness with a
-    FIXME asking for something better; dense ids are deterministic and
-    snapshot-friendly)."""
+    IDs are dense ints, and that is CORRECT here — deterministic and
+    snapshot-friendly — because uniqueness only has to hold within a
+    dataset (``set_dataset`` runs once per job; pass rollover recycles
+    the same Task objects, never re-partitions).  The collision the Go
+    original's time+rand ids papered over is CROSS-DISPATCH staleness:
+    a timed-out holder's late report arriving after the same id was
+    re-leased.  That is disambiguated by ``Task.epoch``, which
+    increments on every dispatch and guards both ``task_failed`` and
+    ``task_finished`` — a stale-epoch report is ignored, exactly the
+    miss a random per-dispatch id would have produced, without
+    sacrificing determinism."""
     if chunks_per_task <= 0:
         chunks_per_task = 1
     return [Task(i // chunks_per_task, chunks[i:i + chunks_per_task])
@@ -229,13 +237,23 @@ class MasterService:
             return Task(t.task_id, t.chunks, t.epoch, t.num_failure,
                         t.deadline)
 
-    def task_finished(self, task_id):
-        """go TaskFinished (:411); rolls the pass when drained."""
+    def task_finished(self, task_id, epoch=None):
+        """go TaskFinished (:411); rolls the pass when drained.
+
+        ``epoch`` (the lease's dispatch counter) guards against the
+        dense-id staleness hole: a holder whose lease timed out reports
+        finished AFTER the task was re-dispatched under the same id —
+        without the guard that report would mark the NEW holder's lease
+        done and clear it while that holder is still working.  ``None``
+        skips the check (pre-guard callers)."""
         with self._mu:
             self._expire_stale()
-            t = self.pending.pop(task_id, None)
+            t = self.pending.get(task_id)
             if t is None:
                 return  # late report after timeout requeue: ignore
+            if epoch is not None and t.epoch != epoch:
+                return  # stale holder: the lease was re-dispatched since
+            del self.pending[task_id]
             t.num_failure = 0
             self.done.append(t)
             self._maybe_roll_pass()

@@ -66,6 +66,6 @@ def master_reader(client, chunk_reader, pass_id=None, wait=0.05,
             except Exception:
                 client.task_failed(task.task_id, task.epoch)
                 raise
-            client.task_finished(task.task_id)
+            client.task_finished(task.task_id, task.epoch)
 
     return reader

@@ -207,8 +207,8 @@ class MasterClient:
     def get_task(self, pass_id=None):
         return Task.from_dict(self._call("get_task", pass_id))
 
-    def task_finished(self, task_id):
-        self._call("task_finished", task_id)
+    def task_finished(self, task_id, epoch=None):
+        self._call("task_finished", task_id, epoch)
 
     def task_failed(self, task_id, epoch):
         self._call("task_failed", task_id, epoch)

@@ -76,11 +76,18 @@ class CheckpointConfig:
     ``step_interval`` counts GLOBAL steps across epochs."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
-                 epoch_interval=1, step_interval=None, async_save=True):
+                 epoch_interval=1, step_interval=None, async_save=True,
+                 incremental=None, incremental_full_every=8):
         self.checkpoint_dir = checkpoint_dir or os.path.join(
             os.getcwd(), "checkpoints")
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(int(epoch_interval), 1)
+        # incremental table checkpoints (Check-N-Run): 'auto'/True delta-
+        # encodes every is_sparse lookup table + its row-wise optimizer
+        # slots; or pass an explicit var-name list.  See
+        # TrainStateCheckpointManager(incremental=...).
+        self.incremental = incremental
+        self.incremental_full_every = int(incremental_full_every)
         # an EXPLICIT step_interval is a pin: the auto-tuner's
         # checkpoint-interval decision (Trainer(autotune=...)) never
         # overrides a cadence the user chose; None takes the historical
@@ -228,6 +235,9 @@ class Trainer:
                 max_to_keep=cfg.max_num_checkpoints,
                 save_interval_steps=cfg.step_interval,
                 async_save=cfg.async_save,
+                incremental=getattr(cfg, "incremental", None),
+                incremental_full_every=getattr(
+                    cfg, "incremental_full_every", 8),
                 # cluster runs elect exactly one manifest committer per
                 # step through the master (sharded-mode saves only)
                 saver_elect=member.request_save

@@ -144,6 +144,58 @@ def _feed_signature(feed):
     )
 
 
+def _sparse_feed_info(program):
+    """(ids feed names tuple, total sparse-table bytes) for telemetry:
+    the is_sparse lookup tables' directly-fed Ids vars + total table
+    bytes.  The one-time program walk caches ON the program object
+    keyed by its version (an id()-keyed module dict would go stale when
+    a freed program's id is recycled); the per-step cost is a np.unique
+    over the id feeds."""
+    cached = getattr(program, "_sparse_feed_cache", None)
+    if cached is not None and cached[0] == program._version:
+        return cached[1]
+    from .ops.selected_rows import sparse_lookup_tables
+
+    tables = {w: int(np.prod(v.shape)) * np.dtype(
+        materialize_dtype(v.dtype)).itemsize
+        for w, v in sparse_lookup_tables(program).items()}
+    feeds = []
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type != "lookup_table" or \
+                    not op.attrs.get("is_sparse", False):
+                continue
+            for n in op.inputs.get("Ids", []):
+                v = blk._find_var_recursive(n)
+                if v is not None and getattr(v, "is_data", False) \
+                        and n not in feeds:
+                    feeds.append(n)
+    hit = (tuple(feeds), sum(tables.values()))
+    program._sparse_feed_cache = (program._version, hit)
+    return hit
+
+
+def _sparse_step_extras(program, feed_names, feed_vals):
+    """Step-record extras for the sparse embedding path: distinct rows
+    touched this step (summed over id feeds) + static table bytes.
+    Host feeds only — counting a device-resident id feed would force a
+    per-step sync on the async path.  Also bumps the
+    ``sparse/touched_rows`` registry counter.  None when the program
+    has no is_sparse tables."""
+    feeds, table_bytes = _sparse_feed_info(program)
+    if not feeds:
+        return None
+    touched = 0
+    by_name = dict(zip(feed_names, feed_vals))
+    for n in feeds:
+        v = by_name.get(n)
+        if isinstance(v, np.ndarray) and v.size:
+            touched += int(np.unique(v).size)
+    monitor.count("sparse/touched_rows", touched)
+    return {"sparse_touched_rows": touched,
+            "sparse_table_bytes": int(table_bytes)}
+
+
 def _batch_examples(block, feed_names, feed_vals):
     """Examples-per-step for StepStats: the leading dim of a feed whose
     program var declares a batch dim (shape[0] == -1/None); fallback is
@@ -163,7 +215,8 @@ def _batch_examples(block, feed_names, feed_vals):
 
 def trace_program(program, feed_names, state_names, writeback, fetch_names,
                   platform=None, mesh=None, sequence_parallel=True,
-                  pipeline_schedule=None, pipeline_microbatches=None):
+                  pipeline_schedule=None, pipeline_microbatches=None,
+                  state_specs=None):
     """Build the pure step function for ``program``'s global block:
     ``fn(feed_vals, state_vals, key) -> (fetches, new_state)``.
 
@@ -189,6 +242,10 @@ def trace_program(program, feed_names, state_names, writeback, fetch_names,
         ctx.sequence_parallel = sequence_parallel
         ctx.pipeline_schedule = pipeline_schedule
         ctx.pipeline_microbatches = pipeline_microbatches
+        if state_specs:
+            # how the PE placed each persistable on the mesh: sharded
+            # sparse-table lowerings consult this at trace time
+            ctx.state_specs = dict(state_specs)
         ctx.program = program
         ctx.amp = getattr(program, '_amp_policy', None)
         for i, op in enumerate(ops):
@@ -621,7 +678,9 @@ class Executor:
                 "executor", time.perf_counter() - mon_t0,
                 _batch_examples(block, feed_names, feed_vals),
                 len(self._dispatch_queue), device=dev,
-                warm=not cold, fingerprint=fp)
+                warm=not cold, fingerprint=fp,
+                extras=_sparse_step_extras(program, feed_names,
+                                           feed_vals))
         # guardian hook LAST (after telemetry): a ladder decision raises
         # out of run() with this step's record already published.  One
         # module-global read when no guardian is installed.

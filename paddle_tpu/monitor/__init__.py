@@ -420,7 +420,7 @@ def _program_handles(fp12):
 
 
 def record_step(name, step_seconds, examples, dispatch_queue_depth,
-                device=None, warm=None, fingerprint=None):
+                device=None, warm=None, fingerprint=None, extras=None):
     """One executor ``run()`` completed: assemble the StepStats record,
     fold it into the aggregator/registry, append it to the JSONL log,
     and pet the watchdog.  ``warm`` is the executor's own verdict on
@@ -450,6 +450,10 @@ def record_step(name, step_seconds, examples, dispatch_queue_depth,
                "compile_cache": compile_cache.stats(),
                "prefetch": _prefetch_state(),
                "device": _device_state(device)}
+        if extras:
+            # producer-supplied step-record fields (e.g. the executors'
+            # sparse_touched_rows count) — JSONL-visible per step
+            rec.update(extras)
         if warm is not None:
             rec["warm"] = bool(warm)
             if not warm:

@@ -42,7 +42,18 @@ def _ew_infer(op, block):
 
 def _make_ew(name, fn):
     def compute(ins, attrs, ctx, op_index):
+        from .selected_rows import SelectedRows, map_values, to_dense
+
         x, y = ins["X"][0], ins["Y"][0]
+        if isinstance(x, SelectedRows):
+            # sparse grad * scalar (the global-norm clip scale) stays
+            # sparse: a uniform scale commutes with duplicate-row
+            # merging.  Anything else densifies for correctness.
+            if name == "elementwise_mul" and \
+                    int(np.prod(np.shape(y))) == 1:
+                return {"Out": map_values(
+                    x, lambda v: v * jnp.reshape(y, ()).astype(v.dtype))}
+            x = to_dense(x)
         y = _align_y(x, y, attrs.get("axis", -1))
         return {"Out": fn(x, y)}
 

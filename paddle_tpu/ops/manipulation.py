@@ -427,7 +427,20 @@ def _lookup_table_compute(ins, attrs, ctx, op_index):
     w, ids = ins["W"][0], ins["Ids"][0]
     squeeze = ids.shape and ids.shape[-1] == 1
     flat = ids.reshape(-1)
-    out = jnp.take(w, flat, axis=0)
+    out = None
+    if attrs.get("is_sparse", False) and ctx.mesh is not None \
+            and ctx.state_specs and ctx.op is not None:
+        # row-sharded table on the mesh: gather only local rows + psum
+        # the [N, D] activations over the table axis — never an
+        # all-gathered [vocab, D] table (parallel/embedding.py).  Gated
+        # to is_sparse tables: their backward is the custom
+        # SelectedRows grad op, so no AD flows through this lowering.
+        from ..parallel.embedding import sharded_sparse_lookup
+
+        out = sharded_sparse_lookup(ctx, w, flat,
+                                    ctx.op.inputs["W"][0])
+    if out is None:
+        out = jnp.take(w, flat, axis=0)
     pad = attrs.get("padding_idx", -1)
     if pad is not None and pad != -1:
         mask = (flat != pad)[:, None]

@@ -159,9 +159,20 @@ register_op("sum", ["X"], ["Out"], infer=_sum_infer, compute=_sum_compute)
 # -- scale ------------------------------------------------------------------
 
 def _scale_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows, map_values
+
     x = ins["X"][0]
     scale = attrs.get("scale", 1.0)
     bias = attrs.get("bias", 0.0)
+    if isinstance(x, SelectedRows):
+        # bias-free scale commutes with duplicate-row merging; a biased
+        # scale of a gradient would add the bias per DUPLICATE, which is
+        # not the dense semantics — densify for that (rare) case
+        if bias == 0.0:
+            return {"Out": map_values(x, lambda v: v * scale)}
+        from .selected_rows import to_dense
+
+        x = to_dense(x)
     if attrs.get("bias_after_scale", True):
         return {"Out": x * scale + bias}
     return {"Out": (x + bias) * scale}
@@ -202,7 +213,20 @@ register_op(
 # -- clip family ------------------------------------------------------------
 
 def _clip_compute(ins, attrs, ctx, op_index):
-    return {"Out": jnp.clip(ins["X"][0], attrs["min"], attrs["max"])}
+    from .selected_rows import SelectedRows, merge_rows
+    from .control_flow import _mask_to
+
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        # clip applies to the SUMMED gradient per row (dense semantics),
+        # so duplicates merge first; padded slots stay exactly zero
+        # (clip(0) may be nonzero when min > 0) so the sentinel rows
+        # remain scatter-inert
+        uniq, merged, valid = merge_rows(x)
+        clipped = jnp.clip(merged, attrs["min"], attrs["max"])
+        clipped = clipped * _mask_to(valid, clipped).astype(clipped.dtype)
+        return {"Out": SelectedRows(uniq, clipped, x.height)}
+    return {"Out": jnp.clip(x, attrs["min"], attrs["max"])}
 
 
 register_op("clip", ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
@@ -210,8 +234,19 @@ register_op("clip", ["X"], ["Out"], infer=same_shape_infer("X", "Out"),
 
 
 def _clip_by_norm_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows, map_values, merged_sumsq
+
     x = ins["X"][0]
     max_norm = attrs["max_norm"]
+    if isinstance(x, SelectedRows):
+        # reference clip_by_norm SelectedRows kernel: the norm is over
+        # the MERGED rows (== the dense grad's norm); the scale then
+        # applies uniformly, which commutes with merging
+        norm = jnp.sqrt(merged_sumsq(x))
+        scale = jnp.where(norm > max_norm,
+                          max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return {"Out": map_values(
+            x, lambda v: v * scale.astype(v.dtype))}
     norm = jnp.sqrt(jnp.sum(x * x))
     scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
     return {"Out": x * scale.astype(x.dtype)}
@@ -226,11 +261,20 @@ def _scalar_out_infer(op, block):
     set_output(op, block, "Out", (1,), x.dtype)
 
 
+def _squared_l2_norm_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows, merged_sumsq
+
+    x = ins["X"][0]
+    if isinstance(x, SelectedRows):
+        # global-norm clipping's per-grad term: ||dense(grad)||^2
+        # without materializing the dense gradient
+        return {"Out": merged_sumsq(x).reshape(1)}
+    return {"Out": jnp.sum(x * x).reshape(1)}
+
+
 register_op(
     "squared_l2_norm", ["X"], ["Out"], infer=_scalar_out_infer,
-    compute=lambda ins, attrs, ctx, op_index: {
-        "Out": jnp.sum(ins["X"][0] * ins["X"][0]).reshape(1)
-    },
+    compute=_squared_l2_norm_compute,
 )
 
 register_op(

@@ -28,16 +28,44 @@ def _mirror_infer(*pairs):
     return infer
 
 
+def _maybe_sharded_rows(ctx, slots, tables, sr, scalars, row_update):
+    """Route a lazy SelectedRows update through the mesh's row-sharded
+    lowering when the param (and every row-wise slot var) is dim-0
+    sharded on the trace's mesh: ids+values exchange over the batch
+    axes, each shard updates only its local rows
+    (``parallel.embedding.sharded_sparse_update``).  Returns the updated
+    tables, or None -> caller runs ``row_update`` unsharded."""
+    if ctx is None or getattr(ctx, "mesh", None) is None \
+            or getattr(ctx, "op", None) is None \
+            or not getattr(ctx, "state_specs", None):
+        return None
+    from ..parallel.embedding import sharded_sparse_update
+
+    names = [ctx.op.inputs[s][0] for s in slots]
+    return sharded_sparse_update(ctx, names, tables, sr, scalars,
+                                 row_update)
+
+
+def _sgd_rows_update(sr, lr, p):
+    # sparse kernel (sgd_op.cc SelectedRows path): scatter-add only the
+    # touched rows; duplicates sum naturally, sentinel rows (height,
+    # from merged/clipped grads or foreign shard rows) drop
+    lr = lr.astype(p.dtype)
+    return (p.at[sr.rows].add(-lr * sr.values.astype(p.dtype),
+                              mode="drop"),)
+
+
 def _sgd_compute(ins, attrs, ctx, op_index):
     from .selected_rows import SelectedRows
 
     p, g, lr = ins["Param"][0], ins["Grad"][0], ins["LearningRate"][0]
-    lr = lr.astype(p.dtype)
     if isinstance(g, SelectedRows):
-        # sparse kernel (sgd_op.cc SelectedRows path): scatter-add only
-        # the touched rows; duplicates sum naturally
-        return {"ParamOut": p.at[g.rows].add(-lr * g.values.astype(p.dtype))}
-    return {"ParamOut": p - lr * g.astype(p.dtype)}
+        out = _maybe_sharded_rows(ctx, ("Param",), (p,), g, lr,
+                                  _sgd_rows_update)
+        if out is None:
+            out = _sgd_rows_update(g, lr, p)
+        return {"ParamOut": out[0]}
+    return {"ParamOut": p - lr.astype(p.dtype) * g.astype(p.dtype)}
 
 
 register_op(
@@ -47,25 +75,43 @@ register_op(
 )
 
 
-def _momentum_compute(ins, attrs, ctx, op_index):
-    from .selected_rows import SelectedRows, merge_rows, scatter_update_rows
+def _momentum_rows_update(attrs):
+    from .selected_rows import merge_rows, scatter_update_rows
 
-    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
-    lr = ins["LearningRate"][0].astype(p.dtype)
     mu = attrs["mu"]
-    if isinstance(g, SelectedRows):
+    nesterov = attrs.get("use_nesterov", False)
+
+    def upd(sr, lr, p, v):
         # lazy sparse kernel: only touched rows' velocity/param move
-        uniq, gm, valid = merge_rows(g)
+        lr = lr.astype(p.dtype)
+        uniq, gm, valid = merge_rows(sr)
         safe = jnp.where(valid, uniq, 0)
         v_r, p_r = v[safe], p[safe]
         v_new = mu * v_r + gm
-        if attrs.get("use_nesterov", False):
+        if nesterov:
             p_new = p_r - (gm + mu * v_new) * lr
         else:
             p_new = p_r - lr * v_new
-        return {"ParamOut": scatter_update_rows(p, uniq, valid, p_new, p_r),
-                "VelocityOut": scatter_update_rows(v, uniq, valid, v_new,
-                                                   v_r)}
+        return (scatter_update_rows(p, uniq, valid, p_new, p_r),
+                scatter_update_rows(v, uniq, valid, v_new, v_r))
+
+    return upd
+
+
+def _momentum_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows
+
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    lr = ins["LearningRate"][0]
+    mu = attrs["mu"]
+    if isinstance(g, SelectedRows):
+        upd = _momentum_rows_update(attrs)
+        out = _maybe_sharded_rows(ctx, ("Param", "Velocity"), (p, v), g,
+                                  lr, upd)
+        if out is None:
+            out = upd(g, lr, p, v)
+        return {"ParamOut": out[0], "VelocityOut": out[1]}
+    lr = lr.astype(p.dtype)
     v_out = mu * v + g
     if attrs.get("use_nesterov", False):
         p_out = p - (g + mu * v_out) * lr
@@ -82,8 +128,31 @@ register_op(
 )
 
 
+def _adam_rows_update(attrs):
+    from .selected_rows import merge_rows, scatter_update_rows
+
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+
+    def upd(sr, lr_t, p, m1, m2):
+        # lazy adam (adam_op.cc SelectedRows kernel): untouched rows'
+        # moments and params are bit-identical across the step
+        lr_t = lr_t.astype(p.dtype)
+        uniq, gm, valid = merge_rows(sr)
+        safe = jnp.where(valid, uniq, 0)
+        m1_r, m2_r, p_r = m1[safe], m2[safe], p[safe]
+        m1_new = b1 * m1_r + (1 - b1) * gm
+        m2_new = b2 * m2_r + (1 - b2) * gm * gm
+        p_new = p_r - lr_t * m1_new / (jnp.sqrt(m2_new) + eps)
+        return (scatter_update_rows(p, uniq, valid, p_new, p_r),
+                scatter_update_rows(m1, uniq, valid, m1_new, m1_r),
+                scatter_update_rows(m2, uniq, valid, m2_new, m2_r))
+
+    return upd
+
+
 def _adam_compute(ins, attrs, ctx, op_index):
-    from .selected_rows import SelectedRows, merge_rows, scatter_update_rows
+    from .selected_rows import SelectedRows
 
     p, g = ins["Param"][0], ins["Grad"][0]
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
@@ -93,19 +162,14 @@ def _adam_compute(ins, attrs, ctx, op_index):
     eps = attrs.get("epsilon", 1e-8)
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
     if isinstance(g, SelectedRows):
-        # lazy adam (adam_op.cc SelectedRows kernel): untouched rows'
-        # moments and params are bit-identical across the step
-        uniq, gm, valid = merge_rows(g)
-        safe = jnp.where(valid, uniq, 0)
-        m1_r, m2_r, p_r = m1[safe], m2[safe], p[safe]
-        m1_new = b1 * m1_r + (1 - b1) * gm
-        m2_new = b2 * m2_r + (1 - b2) * gm * gm
-        p_new = p_r - lr_t * m1_new / (jnp.sqrt(m2_new) + eps)
-        return {
-            "ParamOut": scatter_update_rows(p, uniq, valid, p_new, p_r),
-            "Moment1Out": scatter_update_rows(m1, uniq, valid, m1_new, m1_r),
-            "Moment2Out": scatter_update_rows(m2, uniq, valid, m2_new, m2_r),
-        }
+        upd = _adam_rows_update(attrs)
+        out = _maybe_sharded_rows(
+            ctx, ("Param", "Moment1", "Moment2"), (p, m1, m2), g, lr_t,
+            upd)
+        if out is None:
+            out = upd(g, lr_t, p, m1, m2)
+        return {"ParamOut": out[0], "Moment1Out": out[1],
+                "Moment2Out": out[2]}
     m1_out = b1 * m1 + (1 - b1) * g
     m2_out = b2 * m2 + (1 - b2) * g * g
     p_out = p - lr_t * m1_out / (jnp.sqrt(m2_out) + eps)
@@ -123,21 +187,38 @@ register_op(
 )
 
 
-def _adagrad_compute(ins, attrs, ctx, op_index):
-    from .selected_rows import SelectedRows, merge_rows, scatter_update_rows
+def _adagrad_rows_update(attrs):
+    from .selected_rows import merge_rows, scatter_update_rows
 
-    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
-    lr = ins["LearningRate"][0].astype(p.dtype)
     eps = attrs.get("epsilon", 1e-6)
-    if isinstance(g, SelectedRows):
-        uniq, gm, valid = merge_rows(g)
+
+    def upd(sr, lr, p, mom):
+        lr = lr.astype(p.dtype)
+        uniq, gm, valid = merge_rows(sr)
         safe = jnp.where(valid, uniq, 0)
         mom_r, p_r = mom[safe], p[safe]
         mom_new = mom_r + gm * gm
         p_new = p_r - lr * gm / (jnp.sqrt(mom_new) + eps)
-        return {"ParamOut": scatter_update_rows(p, uniq, valid, p_new, p_r),
-                "MomentOut": scatter_update_rows(mom, uniq, valid, mom_new,
-                                                 mom_r)}
+        return (scatter_update_rows(p, uniq, valid, p_new, p_r),
+                scatter_update_rows(mom, uniq, valid, mom_new, mom_r))
+
+    return upd
+
+
+def _adagrad_compute(ins, attrs, ctx, op_index):
+    from .selected_rows import SelectedRows
+
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = ins["LearningRate"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    if isinstance(g, SelectedRows):
+        upd = _adagrad_rows_update(attrs)
+        out = _maybe_sharded_rows(ctx, ("Param", "Moment"), (p, mom), g,
+                                  lr, upd)
+        if out is None:
+            out = upd(g, lr, p, mom)
+        return {"ParamOut": out[0], "MomentOut": out[1]}
+    lr = lr.astype(p.dtype)
     mom_out = mom + g * g
     p_out = p - lr * g / (jnp.sqrt(mom_out) + eps)
     return {"ParamOut": p_out, "MomentOut": mom_out}

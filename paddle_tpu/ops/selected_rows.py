@@ -21,13 +21,57 @@ kernels registered by every optimizer (``sgd_op.cc``, ``adam_op.cc``...)
   untouched rows are bit-identical across the step.
 """
 
+import re
+
 import jax
 import jax.numpy as jnp
 
+from ..core import VarType
 from ..registry import register_op, set_output, in_var
 from ..framework import grad_var_name
 
-__all__ = ["SelectedRows", "merge_rows", "to_dense"]
+__all__ = ["SelectedRows", "merge_rows", "to_dense", "merged_sumsq",
+           "map_values", "sparse_lookup_tables", "is_row_slot_of"]
+
+# the Optimizer._add_accumulator slot strings whose vars are per-row
+# state (shape [height, ...] mirroring the param) — scalar accumulators
+# (beta1_pow_acc...) are excluded by the height gate at the call sites
+_ROW_SLOT_STRS = ("velocity", "momentum", "moment1", "moment2", "moment",
+                  "mean_square", "mean_grad", "squared", "linear",
+                  "inf_norm", "_avg_squared_grad", "_avg_squared_update")
+
+
+def is_row_slot_of(name, table):
+    """True when ``name`` is an optimizer accumulator var of ``table``
+    (``<table>_<slot>_<uid>``, the ``Optimizer._add_accumulator`` +
+    ``unique_name.generate`` naming).  The explicit slot list keeps a
+    user param that merely shares the table's name prefix (``emb`` vs
+    ``emb_out_w_0``) from being row-sharded or delta-encoded as if it
+    were optimizer state; callers still apply the shape gate (leading
+    dim == table height)."""
+    if not name.startswith(table + "_"):
+        return False
+    return re.fullmatch(
+        re.escape(table) + "_(%s)_\\d+" % "|".join(_ROW_SLOT_STRS),
+        name) is not None
+
+
+def sparse_lookup_tables(program, attr="is_sparse"):
+    """{table var name: Variable} of every ``lookup_table`` W whose op
+    sets ``attr`` (``is_sparse`` / ``is_distributed``), across ALL
+    blocks — the one table scan shared by telemetry, the sharding
+    policy, and the incremental-checkpoint autodetect."""
+    out = {}
+    for blk in program.blocks:
+        for op in blk.ops:
+            if op.type != "lookup_table" or \
+                    not op.attrs.get(attr, False):
+                continue
+            for w in op.inputs.get("W", []):
+                v = blk._find_var_recursive(w)
+                if v is not None and v.shape and w not in out:
+                    out[w] = v
+    return out
 
 
 class SelectedRows:
@@ -66,10 +110,27 @@ def merge_rows(sr):
 
 
 def to_dense(sr):
-    """Densify (reference SelectedRows::Get / scatter semantics)."""
+    """Densify (reference SelectedRows::Get / scatter semantics).
+    Sentinel rows (``rows == height``, produced by merged/padded
+    SelectedRows) are dropped by jax's out-of-bounds scatter mode."""
     dense = jnp.zeros((sr.height,) + tuple(sr.values.shape[1:]),
                       sr.values.dtype)
-    return dense.at[sr.rows].add(sr.values)
+    return dense.at[sr.rows].add(sr.values, mode="drop")
+
+
+def map_values(sr, fn):
+    """A new SelectedRows with ``fn`` applied to the values (same rows).
+    Only valid for fns that commute with duplicate-row merging (scalar
+    scale); merge first for anything nonlinear (clip, norms)."""
+    return SelectedRows(sr.rows, fn(sr.values), sr.height)
+
+
+def merged_sumsq(sr):
+    """sum(dense(sr) ** 2) without materializing the dense gradient:
+    duplicates must merge BEFORE squaring (||sum of dups||^2, not
+    sum of ||dup||^2) — padded slots merge to zero and drop out."""
+    _, merged, _ = merge_rows(sr)
+    return jnp.sum(merged * merged)
 
 
 def scatter_update_rows(table, uniq, valid, new_rows, old_rows):
@@ -113,8 +174,10 @@ def _lookup_sparse_grad_infer(op, block):
     for g_name in op.outputs.get("GRAD::W", []):
         if not g_name:
             continue
+        # typed SELECTED_ROWS so build-time consumers (clip/regularizer
+        # appenders) can keep the gradient sparse through aggregation
         block.create_var(name=g_name, shape=w.shape, dtype=w.dtype,
-                         persistable=False)
+                         persistable=False, type=VarType.SELECTED_ROWS)
 
 
 def _lookup_sparse_grad_compute(ins, attrs, ctx, op_index):
@@ -153,4 +216,47 @@ register_op(
         op, block, "Out", in_var(op, block, "X").shape,
         in_var(op, block, "X").dtype),
     compute=_get_tensor_compute, grad=None,
+)
+
+
+# ---------------------------------------------------------------------------
+# sparse_weight_decay: lazy L1/L2 regularization on a SelectedRows grad
+# (the reference regularizer's SelectedRows path: gather only the touched
+# param rows and fold the decay into the merged sparse gradient — the
+# dense path's full-table `scale(param) + sum` would materialize an
+# O(vocab) gradient and un-lazy the optimizer update)
+# ---------------------------------------------------------------------------
+
+def _sparse_decay_infer(op, block):
+    g = in_var(op, block, "Grad")
+    for name in op.outputs.get("Out", []):
+        if name:
+            block.create_var(name=name, shape=g.shape, dtype=g.dtype,
+                             persistable=False,
+                             type=VarType.SELECTED_ROWS)
+
+
+def _sparse_decay_compute(ins, attrs, ctx, op_index):
+    from .control_flow import _mask_to
+
+    g, p = ins["Grad"][0], ins["Param"][0]
+    coeff = attrs["coeff"]
+    mode = attrs.get("mode", "l2")
+    if not isinstance(g, SelectedRows):
+        term = p if mode == "l2" else jnp.sign(p)
+        return {"Out": g + coeff * term.astype(g.dtype)}
+    # merge duplicates FIRST: decay applies once per unique touched row,
+    # exactly like the dense grad's per-row decay term
+    uniq, merged, valid = merge_rows(g)
+    safe = jnp.where(valid, uniq, 0)
+    term = p[safe] if mode == "l2" else jnp.sign(p[safe])
+    mask = _mask_to(valid, merged).astype(merged.dtype)
+    vals = merged + coeff * term.astype(merged.dtype) * mask
+    return {"Out": SelectedRows(uniq, vals, g.height)}
+
+
+register_op(
+    "sparse_weight_decay", ["Grad", "Param"], ["Out"],
+    infer=_sparse_decay_infer, compute=_sparse_decay_compute, grad=None,
+    no_grad_inputs=("Grad", "Param"),
 )

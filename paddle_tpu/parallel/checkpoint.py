@@ -49,7 +49,7 @@ __all__ = [
     "CheckpointMismatchError", "capture_train_state", "apply_train_state",
     "save_train_state", "load_train_state", "save_train_state_sharded",
     "write_train_state_shards", "commit_sharded_train_state",
-    "partition_shards",
+    "partition_shards", "sparse_table_state_vars", "row_delta",
 ]
 
 
@@ -271,6 +271,61 @@ def _named(objs, what):
     return {what + "0": objs}
 
 
+def sparse_table_state_vars(program, names):
+    """The state vars an incremental checkpoint should delta-encode for
+    ``program``: every ``is_sparse`` lookup table plus its row-wise
+    optimizer slot vars (``<table>_moment1_0``... — recognized by the
+    name prefix; the shape gate — leading dim == table height — is
+    applied against the live arrays at save time).  These are exactly
+    the vars the lazy SelectedRows update keeps bit-stable on untouched
+    rows, which is what makes row deltas small."""
+    from ..ops.selected_rows import is_row_slot_of, sparse_lookup_tables
+
+    tables = {w: int(v.shape[0])
+              for w, v in sparse_lookup_tables(program).items()}
+    out = {}
+    for n in names:
+        for t, h in tables.items():
+            if n == t or is_row_slot_of(n, t):
+                out[n] = h
+                break
+    return out
+
+
+def row_delta(base, new):
+    """(rows int64[K], values[K, ...]) of the dim-0 slices of ``new``
+    that differ from ``base`` — BITWISE comparison (a NaN row that
+    stayed bit-identical is not re-written; a row that moved by one ULP
+    is), so base + delta replay is bit-identical by construction."""
+    if base.shape != new.shape or base.dtype != new.dtype:
+        raise ValueError("row_delta needs same-shape/dtype arrays, got "
+                         "%s/%s vs %s/%s" % (base.shape, base.dtype,
+                                             new.shape, new.dtype))
+    a = np.ascontiguousarray(new).view(np.uint8).reshape(new.shape[0], -1)
+    b = np.ascontiguousarray(base).view(np.uint8).reshape(base.shape[0], -1)
+    rows = np.nonzero((a != b).any(axis=1))[0].astype(np.int64)
+    return rows, np.ascontiguousarray(new[rows])
+
+
+def _apply_delta_ops(target, ops):
+    """Apply one var's delta ops onto a (private, mutable) array."""
+    for op in ops:
+        kind, sel, data = op[0], op[1], op[2]
+        if kind == "rows":
+            target[np.asarray(sel, dtype=np.int64)] = data.reshape(
+                (len(sel),) + target.shape[1:])
+        elif kind == "range":
+            view = target[tuple(slice(int(a), int(b)) for a, b in sel)]
+            view[...] = data.reshape(view.shape)
+        else:
+            raise CheckpointCorruptError("unknown delta op kind %r" % kind)
+    return target
+
+
+_DELTA_ROWS_SUFFIX = "@DELTA_ROWS"
+_DELTA_VALUES_SUFFIX = "@DELTA_VALUES"
+
+
 def _gather_host(v):
     """One state value as a FULL host numpy array, copied out of any
     device buffer.  Fully-addressable jax Arrays (single-host meshes —
@@ -297,14 +352,24 @@ class TrainState:
     the elected saver writes into the manifest.  Loaded artifacts always
     come back with full ``arrays`` (the loader assembles shards), so
     everything downstream — ``apply_train_state``, the guardian's
-    poisoned-checkpoint scan — sees one representation."""
+    poisoned-checkpoint scan — sees one representation.
 
-    def __init__(self, step, arrays, host, shards=None, array_meta=None):
+    An INCREMENTAL delta artifact (Check-N-Run style, written by
+    ``TrainStateCheckpointManager(incremental=...)``) additionally
+    carries ``delta``: ``{name: [("rows", int64[K], values[K, ...]) |
+    ("range", [[a, b], ...], values)]}`` — only the rows that changed
+    since the previous artifact.  A delta TrainState read straight off
+    disk is NOT self-contained; the manager's ``load(step)`` replays
+    base+deltas and returns full arrays."""
+
+    def __init__(self, step, arrays, host, shards=None, array_meta=None,
+                 delta=None):
         self.step = int(step)
         self.arrays = arrays
         self.host = host
         self.shards = shards
         self.array_meta = array_meta
+        self.delta = delta
 
     def __repr__(self):
         if self.arrays is None:
@@ -522,8 +587,21 @@ def save_train_state(dirname, ts):
     os.makedirs(tmp)
     try:
         fault.fire("checkpoint/before_write", ts.step)
+        to_encode = dict(ts.arrays)
+        for n, ops in (ts.delta or {}).items():
+            # one rows-op per var on the write side (the manager's diff);
+            # range-ops only arise when assembling sharded artifacts
+            (kind, rows, values), = ops
+            if kind != "rows":
+                raise ValueError(
+                    "save_train_state only encodes single rows-op "
+                    "deltas; var %r carries a %r op (a TrainState "
+                    "assembled from a sharded artifact must be re-saved "
+                    "through the manager, not directly)" % (n, kind))
+            to_encode[n + _DELTA_ROWS_SUFFIX] = np.asarray(rows, np.int64)
+            to_encode[n + _DELTA_VALUES_SUFFIX] = values
         encoded, raw_dtypes = {}, {}
-        for n, a in ts.arrays.items():
+        for n, a in to_encode.items():
             encoded[n], logical = _npz_encode(a)
             if logical:
                 raw_dtypes[n] = logical
@@ -555,6 +633,12 @@ def save_train_state(dirname, ts):
                              "bytes": os.path.getsize(host_path)},
             },
         }
+        if ts.host.get("incremental"):
+            # chain pointers in the manifest too: rotation walks chains
+            # without opening (and re-hashing) the arrays payloads
+            manifest["incremental"] = {
+                k: ts.host["incremental"][k]
+                for k in ("base_step", "prev_step")}
         with open(os.path.join(tmp, _MANIFEST_FILE), "w") as f:
             json.dump(manifest, f)
             f.flush()
@@ -620,7 +704,14 @@ def load_train_state(dirname):
         with np.load(os.path.join(dirname, _ARRAYS_FILE)) as z:
             arrays = {n: _npz_decode(z["arr_%d" % i], raw_dtypes.get(n))
                       for i, n in enumerate(names)}
-        return TrainState(manifest["step"], arrays, host)
+        delta = None
+        if host.get("incremental"):
+            delta = {}
+            for n in host["incremental"].get("delta_vars", []):
+                rows = arrays.pop(n + _DELTA_ROWS_SUFFIX)
+                values = arrays.pop(n + _DELTA_VALUES_SUFFIX)
+                delta[n] = [("rows", rows, values)]
+        return TrainState(manifest["step"], arrays, host, delta=delta)
     except CheckpointCorruptError:
         raise
     except Exception as e:  # noqa: BLE001 — any decode failure = corrupt
@@ -705,15 +796,23 @@ def write_train_state_shards(dirname, ts, writer_id, entries=None):
     os.makedirs(tmp, exist_ok=True)
     fault.fire("checkpoint/before_write", ts.step)
     npz_path = os.path.join(tmp, _SHARD_FILE % writer_id)
+    members = {}
+    for i, e in enumerate(entries):
+        members["arr_%d" % i] = _npz_encode(e["data"])[0]
+        if e.get("rows") is not None:
+            # incremental entry: only this writer's CHANGED local rows
+            # ("rows" are GLOBAL row indices; "data" their values)
+            members["rows_%d" % i] = np.asarray(e["rows"], np.int64)
     with open(npz_path, "wb") as f:
-        np.savez(f, **{"arr_%d" % i: _npz_encode(e["data"])[0]
-                       for i, e in enumerate(entries)})
+        np.savez(f, **members)
         f.flush()
         os.fsync(f.fileno())
     sidecar = {
         "writer": writer_id,
         "step": ts.step,
-        "entries": [{"name": e["name"], "index": e["index"]}
+        "entries": [{"name": e["name"], "index": e["index"],
+                     **({"delta": True} if e.get("rows") is not None
+                        else {})}
                     for e in entries],
         "bytes": os.path.getsize(npz_path),
         "sha256": _sha256(npz_path),
@@ -783,6 +882,22 @@ def commit_sharded_train_state(dirname, ts, expected_writers,
             side_path = os.path.join(tmp, _SHARD_META % w)
             with open(side_path) as f:
                 side = json.load(f)
+            if not ts.host.get("incremental") and \
+                    any(e.get("delta") for e in side["entries"]):
+                # incremental cadence desync: a peer wrote touched-row
+                # deltas while this (e.g. freshly restarted) saver
+                # decided on a full artifact — committing would land a
+                # mixed artifact no loader can interpret AND hand later
+                # deltas a broken chain base.  Refuse loudly; the failed
+                # save costs one interval, the existing chain stays
+                # intact.  (A peer shipping FULL entries under a delta
+                # manifest is fine — the loader folds those as range
+                # ops.)
+                raise CheckpointCorruptError(
+                    "sharded checkpoint step %d: writer %d delivered "
+                    "delta entries but the committing saver encoded a "
+                    "full artifact — incremental cadence desynchronized "
+                    "across hosts; commit refused" % (ts.step, w))
             files[_SHARD_FILE % w] = {"sha256": side["sha256"],
                                       "bytes": side["bytes"]}
             files[_SHARD_META % w] = {
@@ -797,6 +912,10 @@ def commit_sharded_train_state(dirname, ts, expected_writers,
             "per_writer_bytes": per_writer,
             "files": files,
         }
+        if ts.host.get("incremental"):
+            manifest["incremental"] = {
+                k: ts.host["incremental"][k]
+                for k in ("base_step", "prev_step")}
         with open(os.path.join(tmp, _MANIFEST_FILE), "w") as f:
             json.dump(manifest, f)
             f.flush()
@@ -826,39 +945,73 @@ def _load_sharded_train_state(dirname, manifest):
     """Assemble a sharded artifact back into full host arrays (manifest
     and per-file sha256 already partially validated by the caller):
     every var gets an empty global buffer filled from the shard entries;
-    incomplete coverage is corruption, not a silent zero-filled
-    restore."""
+    incomplete coverage is corruption, not a silent zero-filled restore.
+
+    INCREMENTAL sharded artifacts carry delta entries (per-writer
+    changed rows): those vars come back as delta OPS, not arrays — a
+    full shard entry of a delta var (a writer that lost its base)
+    becomes a range op, a delta entry a rows op.  The manager's chain
+    replay applies them onto the base."""
     with open(os.path.join(dirname, _HOST_FILE)) as f:
         host = json.load(f)
     meta = host.pop("array_meta")
-    buffers, covered = {}, {}
-    for n, m in meta.items():
-        raw = np.dtype(m["raw_dtype"]) if m.get("raw_dtype") \
-            else _dtype_from_name(m["dtype"])
-        buffers[n] = np.empty(tuple(m["shape"]), dtype=raw)
-        covered[n] = 0
+    incremental = bool(host.get("incremental"))
+    # pass 1: read every writer's entries (decoded to logical dtypes)
+    entries = {n: [] for n in meta}   # name -> [(index, rows|None, data)]
     for w in range(int(manifest["writers"])):
         with open(os.path.join(dirname, _SHARD_META % w)) as f:
             sidecar = json.load(f)
         with np.load(os.path.join(dirname, _SHARD_FILE % w)) as z:
             for i, e in enumerate(sidecar["entries"]):
                 n = e["name"]
-                data = z["arr_%d" % i]
-                sel = tuple(slice(a, b) for a, b in e["index"])
-                buffers[n][sel] = data.reshape(
-                    buffers[n][sel].shape)
-                covered[n] += data.size
+                m = meta[n]
+                data = _npz_decode(
+                    z["arr_%d" % i],
+                    m["dtype"] if m.get("raw_dtype") else None)
+                rows = z["rows_%d" % i] if e.get("delta") else None
+                entries[n].append((e["index"], rows, data))
+    # pass 2: vars fully covered by full entries assemble to arrays; in
+    # an incremental artifact everything else becomes delta ops (full
+    # pieces from writers that lost their base ride along as range ops,
+    # applied before the rows ops)
+    buffers, delta = {}, {}
     for n, m in meta.items():
-        if covered[n] != int(np.prod(m["shape"], dtype=np.int64)):
+        total = int(np.prod(m["shape"], dtype=np.int64))
+        full = [(idx, data) for idx, rows, data in entries[n]
+                if rows is None]
+        covered = sum(int(data.size) for _, data in full)
+        if covered == total and len(full) == len(entries[n]):
+            buf = np.empty(tuple(m["shape"]),
+                           dtype=_dtype_from_name(m["dtype"]))
+            for idx, data in full:
+                sel = tuple(slice(a, b) for a, b in idx)
+                buf[sel] = data.reshape(buf[sel].shape)
+            buffers[n] = buf
+            continue
+        if not incremental:
             raise CheckpointCorruptError(
                 "sharded checkpoint %s: var %r covered %d of %d "
-                "elements — shard set incomplete" %
-                (dirname, n, covered[n],
-                 int(np.prod(m["shape"], dtype=np.int64))))
-    arrays = {n: _npz_decode(buffers[n],
-                             m["dtype"] if m.get("raw_dtype") else None)
-              for n, m in meta.items()}
-    return TrainState(manifest["step"], arrays, host)
+                "elements — shard set incomplete"
+                % (dirname, n, covered, total))
+        # delta entries carry changed rows, not their whole shard, so
+        # coverage is checked over the declared INDEX extents: every
+        # writer still owes an entry (full or delta) for its slice — a
+        # missing writer entry is corruption here exactly as it is on
+        # the full path, never a silent partial restore
+        idx_cov = sum(
+            int(np.prod([b - a for a, b in idx], dtype=np.int64))
+            for idx, _rows, _data in entries[n])
+        if idx_cov != total:
+            raise CheckpointCorruptError(
+                "sharded checkpoint %s: var %r shard index coverage %d "
+                "of %d elements — shard set incomplete"
+                % (dirname, n, idx_cov, total))
+        ops = [("range", idx, data) for idx, data in full]
+        ops += [("rows", rows, data)
+                for idx, rows, data in entries[n] if rows is not None]
+        delta[n] = ops
+    return TrainState(manifest["step"], buffers, host,
+                      delta=delta or None)
 
 
 class TrainStateCheckpointManager:
@@ -890,11 +1043,27 @@ class TrainStateCheckpointManager:
     process identity.  Restores are format-agnostic: the loader
     assembles shard files back into full host arrays, so a sharded
     artifact restores on any topology — including a single host —
-    through the same ``apply_train_state`` path."""
+    through the same ``apply_train_state`` path.
+
+    Incremental mode (``incremental=``, Check-N-Run style): the state
+    vars named (or, with ``'auto'``, every ``is_sparse`` lookup table +
+    its row-wise optimizer slots) are written as per-interval
+    TOUCHED-ROW DELTAS against a periodic full base — artifact bytes
+    scale with rows touched since the last save, not with vocab.  The
+    diff is BITWISE against the previous artifact's values (kept as a
+    host-side base copy — budget one extra host copy of the tables), so
+    base + delta replay is bit-identical by construction; the lazy
+    SelectedRows optimizer update is what keeps untouched rows
+    bit-stable and the deltas small.  Every ``incremental_full_every``-th
+    artifact is a full base (bounds the replay chain); ``load``/
+    ``restore`` replay the chain transparently and rotation never
+    deletes an artifact a kept delta still needs.  In sharded mode each
+    host diffs and writes only its own shards' touched rows."""
 
     def __init__(self, dirname, max_to_keep=3, save_interval_steps=1,
                  async_save=True, sharded=None, saver_elect=None,
-                 writer_id=None, writers=None, commit_timeout=120.0):
+                 writer_id=None, writers=None, commit_timeout=120.0,
+                 incremental=None, incremental_full_every=8):
         self._dir = os.path.abspath(dirname)
         os.makedirs(self._dir, exist_ok=True)
         self._max_to_keep = max(1, int(max_to_keep)) \
@@ -909,6 +1078,18 @@ class TrainStateCheckpointManager:
         self._last_saved = None
         self._inflight = None            # (thread, step)
         self._error = None
+        # incremental (delta) mode: None/False off; True/'auto' =
+        # sparse-table autodetect from the save-time program; or an
+        # explicit iterable of var names
+        self._incremental = incremental
+        self._full_every = max(1, int(incremental_full_every))
+        self._incr_base = {}         # full path: {name: host array}
+        self._incr_shard_base = {}   # sharded: {(name, index key): array}
+        self._incr_full_base = {}    # restore-seeded full arrays (sliced
+        #                              lazily into shard bases)
+        self._incr_base_step = None  # step of the generation's full base
+        self._incr_prev_step = None  # step of the last written artifact
+        self._deltas_since_full = 0
         # rolling measured costs (autotune.tune_checkpoint_interval's
         # evidence): the synchronous device->host snapshot span and the
         # background write span, most recent samples
@@ -1014,6 +1195,167 @@ class TrainStateCheckpointManager:
             return bool(self._saver_elect(step))
         return self._writer_identity()[0] == 0
 
+    # -- incremental (delta) encoding ----------------------------------
+    def _resolve_incr_names(self, program, ts):
+        """{var name: table height or None} of the vars THIS artifact
+        may delta-encode; resolved on the save path (needs the program
+        for 'auto')."""
+        if not self._incremental:
+            return None
+        if ts.arrays is not None:
+            names = set(ts.arrays)
+        else:
+            names = set(ts.array_meta or ())
+        if self._incremental in (True, "auto"):
+            from ..framework import default_main_program
+
+            program = program if program is not None \
+                else default_main_program()
+            return sparse_table_state_vars(program, names)
+        return {n: None for n in self._incremental if n in names}
+
+    def _delta_eligible(self, arr, height):
+        if getattr(arr, "ndim", 0) < 1 or arr.size == 0:
+            return False
+        return height is None or arr.shape[0] == int(height)
+
+    def _encode_incremental(self, ts):
+        """Rewrite ``ts`` in place into a delta artifact when a base is
+        available and the generation isn't due for a full one.  Always
+        refreshes the in-memory base to this artifact's values — the
+        next diff is against the LAST WRITTEN state, so base + deltas
+        replay bit-identically."""
+        names = getattr(ts, "_incr_names", None)
+        if not names:
+            return
+        if ts.shards is not None:
+            return self._encode_incremental_shards(ts, names)
+        eligible = {n: ts.arrays[n] for n, h in names.items()
+                    if n in ts.arrays
+                    and self._delta_eligible(ts.arrays[n], h)}
+        want_full = (self._incr_prev_step is None
+                     or self._deltas_since_full >= self._full_every - 1
+                     or not eligible)
+        if not want_full:
+            delta, rows_count = {}, {}
+            for n, a in eligible.items():
+                base = self._incr_base.get(n)
+                if base is None or base.shape != a.shape \
+                        or base.dtype != a.dtype:
+                    continue        # ships full in this artifact
+                rows, values = row_delta(base, a)
+                delta[n] = [("rows", rows, values)]
+                rows_count[n] = int(rows.shape[0])
+            if delta:
+                for n in delta:
+                    del ts.arrays[n]
+                ts.delta = delta
+                ts.host["incremental"] = {
+                    "base_step": self._incr_base_step,
+                    "prev_step": self._incr_prev_step,
+                    "delta_vars": sorted(delta),
+                    "delta_rows": rows_count,
+                }
+                self._deltas_since_full += 1
+            else:
+                want_full = True
+        if want_full:
+            self._incr_base_step = ts.step
+            self._deltas_since_full = 0
+        self._incr_base = dict(eligible)     # capture's private copies
+        self._incr_prev_step = ts.step
+
+    def _encode_incremental_shards(self, ts, names):
+        """The per-host leg: each writer diffs ONLY its own shard
+        entries against its shard base and writes only local touched
+        rows.  An entry without a base (fresh host, resized shard)
+        ships full — the loader folds mixed full/delta entries."""
+        want_full = (self._incr_prev_step is None
+                     or self._deltas_since_full >= self._full_every - 1)
+        new_entries, delta_vars, rows_count = [], set(), {}
+        new_base = {}
+        for e in ts.shards:
+            n, a = e["name"], e["data"]
+            # shard shapes are local slices, so the height gate does not
+            # apply here — membership + non-scalar is the eligibility
+            track = n in names and getattr(a, "ndim", 0) >= 1 \
+                and a.size > 0
+            key = (n, tuple(tuple(int(x) for x in r)
+                            for r in e["index"]))
+            base = self._incr_shard_base.get(key)
+            if base is None and n in self._incr_full_base:
+                # restore-seeded full array: slice this shard's piece
+                sel = tuple(slice(x, y) for x, y in e["index"])
+                cand = self._incr_full_base[n][sel]
+                if cand.shape == a.shape:
+                    base = np.ascontiguousarray(cand)
+            if track:
+                new_base[key] = a
+            if want_full or not track or base is None \
+                    or base.shape != a.shape or base.dtype != a.dtype:
+                new_entries.append(e)
+                continue
+            start = int(e["index"][0][0])
+            rows, values = row_delta(base, a)
+            new_entries.append({"name": n, "index": e["index"],
+                                "rows": rows + start, "data": values})
+            delta_vars.add(n)
+            rows_count[n] = rows_count.get(n, 0) + int(rows.shape[0])
+        self._incr_shard_base = new_base
+        self._incr_full_base = {}
+        ts.shards = new_entries
+        if delta_vars:
+            ts.host["incremental"] = {
+                "base_step": self._incr_base_step,
+                "prev_step": self._incr_prev_step,
+                "delta_vars": sorted(delta_vars),
+                "delta_rows": rows_count,
+            }
+            self._deltas_since_full += 1
+        else:
+            self._incr_base_step = ts.step
+            self._deltas_since_full = 0
+        self._incr_prev_step = ts.step
+
+    def _chain_prev(self, step):
+        """prev_step pointer of an artifact (manifest read only), or
+        None for a full artifact / unreadable manifest."""
+        try:
+            with open(os.path.join(self._step_dir(step),
+                                   _MANIFEST_FILE)) as f:
+                m = json.load(f)
+        except (OSError, ValueError):
+            return None
+        inc = m.get("incremental")
+        return int(inc["prev_step"]) if inc else None
+
+    def _seed_incremental_base(self, ts):
+        """After a restore: the restored full arrays ARE the state at
+        ``ts.step`` — seed the diff base for the chain's delta vars so
+        the next save continues the delta chain instead of paying a
+        full write."""
+        if not self._incremental or ts.arrays is None:
+            return
+        inc = ts.host.get("incremental")
+        if not inc:
+            self._incr_base_step = None
+            self._incr_prev_step = None
+            self._deltas_since_full = 0
+            self._incr_base, self._incr_shard_base = {}, {}
+            self._incr_full_base = {}
+            return
+        dv = list(inc.get("delta_vars", []))
+        seeded = {n: np.array(ts.arrays[n], copy=True)
+                  for n in dv if n in ts.arrays}
+        self._incr_base = seeded
+        self._incr_shard_base = {}
+        self._incr_full_base = dict(seeded)
+        self._incr_base_step = int(inc["base_step"])
+        self._incr_prev_step = int(ts.step)
+        # conservative: restart the full cadence from here (the replay
+        # chain stays bounded by rotation's chain tracking either way)
+        self._deltas_since_full = 1
+
     # -- save ----------------------------------------------------------
     def save(self, step, scope=None, program=None, executors=None,
              readers=None, extra=None):
@@ -1028,6 +1370,9 @@ class TrainStateCheckpointManager:
         ts = capture_train_state(step, scope=scope, program=program,
                                  executors=executors, readers=readers,
                                  extra=extra, sharded=self.sharded_mode())
+        # resolved on the main thread (needs the program); the diff
+        # itself runs in the writer thread, off the step path
+        ts._incr_names = self._resolve_incr_names(program, ts)
         self._snapshot_s.append(time.perf_counter() - t0)
         self._last_saved = int(step)
         if not self._async:
@@ -1059,6 +1404,7 @@ class TrainStateCheckpointManager:
         ts = capture_train_state(step, scope=scope, program=program,
                                  executors=executors, readers=readers,
                                  extra=extra, sharded=self.sharded_mode())
+        ts._incr_names = self._resolve_incr_names(program, ts)
         self._snapshot_s.append(time.perf_counter() - t0)
         self._last_saved = int(step)
         self._write(ts)
@@ -1074,6 +1420,11 @@ class TrainStateCheckpointManager:
     def _write(self, ts):
         t0 = time.perf_counter()
         step_dir = self._step_dir(ts.step)
+        # delta-encode BEFORE serializing: the diff runs in this (write)
+        # thread, overlapped under the next interval's compute like the
+        # rest of the serialization
+        self._encode_incremental(ts)
+        inc = ts.host.get("incremental")
         if ts.shards is not None:
             wid, writers = self._writer_identity()
             saver = self._is_saver(ts.step)
@@ -1087,10 +1438,20 @@ class TrainStateCheckpointManager:
                      "writers": writers, "saver": saver}
         else:
             nbytes = sum(a.nbytes for a in ts.arrays.values())
+            nbytes += sum(rows.nbytes + values.nbytes
+                          for ops in (ts.delta or {}).values()
+                          for _, rows, values in ops)
             with RecordEvent("checkpoint/save"):
                 path = save_train_state(step_dir, ts)
             saver = True
             extra = {}
+        if inc:
+            extra = dict(extra, incremental=True,
+                         base_step=inc["base_step"],
+                         delta_rows=inc.get("delta_rows"))
+            monitor.count("checkpoint/incremental_saves")
+            monitor.count("checkpoint/incremental_rows",
+                          sum((inc.get("delta_rows") or {}).values()))
         self._save_s.append(time.perf_counter() - t0)
         if saver:
             # non-elected hosts never rotate: racing rmtrees against
@@ -1110,8 +1471,19 @@ class TrainStateCheckpointManager:
         if self._max_to_keep is None:
             return
         steps = self.all_steps()
-        for s in steps[:-self._max_to_keep]:
-            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+        keep = set(steps[-self._max_to_keep:])
+        # a kept DELTA artifact is only restorable through its chain:
+        # every artifact back to its full base is load-bearing
+        need = set()
+        for s in keep:
+            cur, guard = s, 0
+            while cur is not None and cur not in need and guard < 65536:
+                need.add(cur)
+                cur = self._chain_prev(cur)
+                guard += 1
+        for s in steps:
+            if s not in keep and s not in need:
+                shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     def _reraise(self):
         with self._mu:
@@ -1135,8 +1507,48 @@ class TrainStateCheckpointManager:
         """Read + VALIDATE the artifact at ``step`` without applying it
         — pre-restore inspection (the guardian's poisoned-checkpoint
         scan rejects artifacts before they touch live state).  Raises
-        ``CheckpointCorruptError`` on a corrupt/partial artifact."""
-        return load_train_state(self._step_dir(step))
+        ``CheckpointCorruptError`` on a corrupt/partial artifact.
+
+        Incremental artifacts are replayed transparently: the chain
+        walks back to the full base and applies each delta's touched
+        rows in order, so the returned TrainState always carries FULL
+        arrays — bit-identical to the uninterrupted state at ``step``
+        (the diff was bitwise against exactly this replay's input)."""
+        ts = load_train_state(self._step_dir(step))
+        if not ts.host.get("incremental"):
+            return ts
+        chain, seen = [ts], {ts.step}
+        cur = ts
+        while cur.host.get("incremental"):
+            prev = int(cur.host["incremental"]["prev_step"])
+            if prev in seen:
+                raise CheckpointCorruptError(
+                    "incremental chain at step %d cycles through step %d"
+                    % (step, prev))
+            seen.add(prev)
+            cur = load_train_state(self._step_dir(prev))
+            chain.append(cur)
+        arrays = dict(cur.arrays)      # the full base artifact
+        private = set()     # delta vars already copied out of their npz
+        for d in reversed(chain[:-1]):
+            for n, v in (d.arrays or {}).items():
+                arrays[n] = v          # full vars in a delta artifact
+                private.discard(n)
+            for n, ops in (d.delta or {}).items():
+                if n not in arrays:
+                    raise CheckpointCorruptError(
+                        "incremental chain: delta var %r (step %d) has "
+                        "no base value" % (n, d.step))
+                if n not in private:
+                    # privatize ONCE per var (the base npz view must not
+                    # be mutated) — not once per chain link: replaying a
+                    # long chain over a [vocab, D] table would otherwise
+                    # pay O(chain · vocab · D) in copies
+                    arrays[n] = np.array(arrays[n], copy=True)
+                    private.add(n)
+                arrays[n] = _apply_delta_ops(arrays[n], ops)
+        host = dict(chain[0].host)
+        return TrainState(step, arrays, host)
 
     def restore(self, scope=None, program=None, executors=None,
                 readers=None, step=None, shardings=None, strict=True,
@@ -1157,7 +1569,7 @@ class TrainStateCheckpointManager:
             try:
                 ts = train_state if (train_state is not None
                                      and step is not None) \
-                    else load_train_state(self._step_dir(s))
+                    else self.load(s)
                 restored = apply_train_state(
                     ts, scope=scope, program=program, executors=executors,
                     readers=readers, shardings=shardings, strict=strict)
@@ -1182,6 +1594,7 @@ class TrainStateCheckpointManager:
             # and the next save at a skipped step's index overwrites
             # the corrupt artifact instead of warning forever
             self._last_saved = restored
+            self._seed_incremental_base(ts)
             monitor.log_event({"event": "checkpoint_restored",
                                "ts": time.time(), "step": restored})
             return restored

@@ -5,33 +5,39 @@ pservers with remote prefetch (``transpiler/distribute_transpiler.py``
 lookup-table handling, ``operators/lookup_table_op.cc`` remote_prefetch,
 ``split_ids_op.cc`` / ``merge_ids_op.cc``) — re-designed TPU-first:
 a table marked ``is_distributed`` by ``layers.embedding`` is row-sharded
-over a mesh axis and GSPMD turns the lookups into gather collectives over
-ICI; there is no server role, no RPC, and no prefetch op — the "remote"
-rows are one all-gather away.
+over a mesh axis (``distributed_embedding_sharding_fn``), and the
+``is_sparse`` lookup + lazy optimizer update run as EXPLICIT shard_map
+lowerings (``sharded_sparse_lookup`` / ``sharded_sparse_update``): the
+forward gathers only local rows and psums the [N, D] activations over
+the table axis; the backward exchanges the O(batch·seq) SelectedRows
+(ids + value slices) over the batch axes — never an all-gathered
+[vocab, D] table, never a dense [vocab, D] gradient collective — and
+each shard's lazy update touches only its local rows.  There is no
+server role, no RPC, and no prefetch op: the "remote" rows are one
+row-slice exchange away (the split_ids/merge_ids pair re-expressed as
+mesh collectives).
 """
 
+import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .mesh import AXIS_DP, AXIS_EP
+from .mesh import AXIS_DP, AXIS_EP, AXIS_FSDP, shard_map_norep
 
-__all__ = ["distributed_embedding_sharding_fn"]
-
-
-def _distributed_tables(program):
-    """Names of lookup_table W params marked is_distributed."""
-    names = set()
-    for blk in program.blocks:
-        for op in blk.ops:
-            if op.type == "lookup_table" and \
-                    op.attrs.get("is_distributed", False):
-                names.update(op.inputs.get("W", []))
-    return names
+__all__ = ["distributed_embedding_sharding_fn", "sharded_sparse_lookup",
+           "sharded_sparse_update", "dim0_axes"]
 
 
 def distributed_embedding_sharding_fn(program, mesh, axis=None):
     """Build a BuildStrategy.param_sharding_fn that row-shards every
     ``is_distributed`` embedding table over ``axis`` (default: the mesh's
     ``ep`` axis if present, else ``dp``).
+
+    Optimizer slot vars of a sharded table (``<table>_moment1_0`` etc.,
+    recognized by the ``<table>_`` name prefix plus a leading dim equal
+    to the table height) INHERIT the row sharding: a lazy sparse Adam
+    over a 1e6-row table must not keep replicated [vocab, D] moments —
+    they dominate state exactly like the table does.
 
     Compose with another policy by chaining: the returned fn yields None
     for non-table params so a wrapper can fall through.
@@ -43,11 +49,179 @@ def distributed_embedding_sharding_fn(program, mesh, axis=None):
             "mesh %r has no %r axis to shard embedding tables over; pass "
             "axis= naming one of its axes" % (tuple(mesh.axis_names), axis))
     size = mesh.devices.shape[mesh.axis_names.index(axis)]
-    tables = _distributed_tables(program)
+    from ..ops.selected_rows import is_row_slot_of, sparse_lookup_tables
+
+    heights = {w: int(v.shape[0]) for w, v in sparse_lookup_tables(
+        program, "is_distributed").items()}
+    tables = set(heights)
 
     def fn(name, shape):
         if name in tables and shape and shape[0] % size == 0:
             return P(axis)
+        for t, h in heights.items():
+            if is_row_slot_of(name, t) and shape and len(shape) >= 1 \
+                    and shape[0] == h and h % size == 0:
+                return P(axis)     # optimizer slot var of a sharded table
         return None
 
     return fn
+
+
+# ---------------------------------------------------------------------------
+# Sharded sparse lookup / update lowerings (the pserver prefetch +
+# sparse-update pair as explicit shard_map collectives)
+# ---------------------------------------------------------------------------
+
+def dim0_axes(spec):
+    """The mesh axes sharding dim 0 of ``spec`` as a flat tuple
+    (() = unsharded/replicated)."""
+    entries = tuple(spec) if spec is not None else ()
+    if not entries or entries[0] is None:
+        return ()
+    e = entries[0]
+    return tuple(e) if isinstance(e, tuple) else (e,)
+
+
+def _extent(mesh, axes):
+    n = 1
+    for a in axes:
+        n *= mesh.devices.shape[mesh.axis_names.index(a)]
+    return n
+
+
+def _shard_offset(mesh, axes, local_rows):
+    """This shard's first global row, from inside a shard_map: the
+    combined (major-to-minor per the P((a, b)) convention) index over
+    ``axes`` times the local row count."""
+    r = jnp.int32(0)
+    for a in axes:
+        r = r * mesh.devices.shape[mesh.axis_names.index(a)] \
+            + lax.axis_index(a)
+    return r * local_rows
+
+
+def _data_axes(ctx):
+    """The mesh axes the PE shards batches over (dp x fsdp, populated
+    only) — the axes a flat [N]-per-batch tensor is sharded along."""
+    mesh = ctx.mesh
+    return tuple(a for a in (AXIS_DP, AXIS_FSDP)
+                 if a in mesh.axis_names
+                 and mesh.devices.shape[mesh.axis_names.index(a)] > 1)
+
+
+def _table_partition(ctx, name, height):
+    """(table_axes, batch_axes) when ``name`` is row-sharded on this
+    trace's mesh and the height divides; None otherwise (caller falls
+    back to the unsharded lowering).  ``batch_axes`` are the data axes
+    NOT used by the table — the axes the SelectedRows exchange gathers
+    over; a table sharded over a data axis simply sees the ids
+    replicated at the shard_map boundary (the gather happens there)."""
+    if ctx is None or ctx.mesh is None or not ctx.state_specs:
+        return None
+    axes = dim0_axes(ctx.state_specs.get(name))
+    if not axes:
+        return None
+    k = _extent(ctx.mesh, axes)
+    if k <= 1 or height % k != 0:
+        return None
+    batch_axes = tuple(a for a in _data_axes(ctx) if a not in axes)
+    return axes, batch_axes
+
+
+def _narrow_batch_axes(ctx, batch_axes, n):
+    """Drop batch axes (rightmost first) until their extent divides the
+    flat id count ``n`` — an indivisible exchange degrades toward
+    replication, never to an invalid spec."""
+    axes = tuple(batch_axes)
+    while axes and n % _extent(ctx.mesh, axes) != 0:
+        axes = axes[:-1]
+    return axes
+
+
+def sharded_sparse_lookup(ctx, w, flat_ids, w_name):
+    """Row-sharded embedding gather: each shard reads ONLY its local
+    rows and the [N, D] results psum over the table axes — the
+    remote-prefetch collective.  Returns the [N, D] lookup, or None when
+    ``w_name`` is not row-sharded on this trace's mesh."""
+    part = _table_partition(ctx, w_name, int(w.shape[0]))
+    if part is None:
+        return None
+    table_axes, batch_axes = part
+    batch_axes = _narrow_batch_axes(ctx, batch_axes, int(flat_ids.shape[0]))
+    mesh = ctx.mesh
+    local_rows = int(w.shape[0]) // _extent(mesh, table_axes)
+    w_spec = ctx.state_specs.get(w_name)
+    bspec = batch_axes if len(batch_axes) != 1 else batch_axes[0]
+    ids_spec = P(bspec) if batch_axes else P()
+    out_spec = P(bspec, None) if batch_axes else P()
+
+    def gather(w_local, ids_local):
+        lo = _shard_offset(mesh, table_axes, local_rows)
+        loc = ids_local.astype(jnp.int32) - lo
+        ok = (loc >= 0) & (loc < local_rows)
+        out = jnp.take(w_local, jnp.where(ok, loc, 0), axis=0)
+        out = out * ok[:, None].astype(out.dtype)
+        return lax.psum(out, table_axes)
+
+    return shard_map_norep(
+        gather, mesh, in_specs=(w_spec, ids_spec),
+        out_specs=out_spec)(w, flat_ids)
+
+
+def sharded_sparse_update(ctx, names, tables, sr, scalars, row_update):
+    """Row-sharded lazy optimizer update: the SelectedRows gradient's
+    (rows, values) are exchanged over the BATCH axes (an O(batch·seq·D)
+    all-gather — ids bucket to their owner by the in-shard range mask),
+    then each table shard applies ``row_update`` to its local rows only.
+    Never materializes an all-gathered table or a dense [vocab, D]
+    gradient.
+
+    ``names``/``tables``: the param + its row-wise slot vars (all must
+    share the param's dim-0 sharding; scalar-shaped slots belong in
+    ``scalars``).  ``row_update(sr_local, scalars, *tables_local)``
+    returns the updated local tables in order.  Returns the updated
+    (sharded) tables, or None when the param is not row-sharded here
+    (caller runs the single-device lazy kernel)."""
+    from ..ops.selected_rows import SelectedRows
+
+    height = int(tables[0].shape[0])
+    part = _table_partition(ctx, names[0], height)
+    if part is None:
+        return None
+    mesh = ctx.mesh
+    table_axes, batch_axes = part
+    # every row-wise operand must ride the SAME dim-0 sharding — a
+    # replicated moment var would force pjit to all-gather a [vocab, D]
+    # buffer right back; fall back loudly-by-structure instead
+    specs = []
+    for n, t in zip(names, tables):
+        ax = dim0_axes(ctx.state_specs.get(n))
+        if ax != table_axes or int(t.shape[0]) != height:
+            return None
+        specs.append(ctx.state_specs.get(n))
+    batch_axes = _narrow_batch_axes(ctx, batch_axes, int(sr.rows.shape[0]))
+    local_rows = height // _extent(mesh, table_axes)
+    bspec = batch_axes if len(batch_axes) != 1 else batch_axes[0]
+    rows_spec = P(bspec) if batch_axes else P()
+    vals_spec = P(*((bspec,) + (None,) * (sr.values.ndim - 1))) \
+        if batch_axes else P(*((None,) * sr.values.ndim))
+
+    def upd(rows, vals, scal, *tabs):
+        if batch_axes:
+            rows = lax.all_gather(rows, batch_axes, axis=0, tiled=True)
+            vals = lax.all_gather(vals, batch_axes, axis=0, tiled=True)
+        lo = _shard_offset(mesh, table_axes, local_rows)
+        loc = rows.astype(jnp.int32) - lo
+        ok = (loc >= 0) & (loc < local_rows)
+        # foreign/sentinel rows -> the local height sentinel with zeroed
+        # values: merge_rows collapses them and the scatter drops them
+        loc = jnp.where(ok, loc, local_rows).astype(jnp.int32)
+        vals = vals * ok.reshape((-1,) + (1,) * (vals.ndim - 1)) \
+            .astype(vals.dtype)
+        return row_update(SelectedRows(loc, vals, local_rows), scal, *tabs)
+
+    out = shard_map_norep(
+        upd, mesh,
+        in_specs=(rows_spec, vals_spec, P()) + tuple(specs),
+        out_specs=tuple(specs))(sr.rows, sr.values, scalars, *tables)
+    return out if isinstance(out, tuple) else (out,)

@@ -35,7 +35,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import compile_cache, fault, flags, guardian, monitor, registry  # noqa: F401  (op registry must be loaded)
 from ..executor import (AsyncDispatchQueue, trace_program, Executor,
-                        _batch_examples, _check_finite)
+                        _batch_examples, _check_finite,
+                        _sparse_step_extras)
 from ..monitor import program_profile
 from ..profiler import RecordEvent, is_profiling
 from ..framework import Variable, default_main_program
@@ -249,6 +250,26 @@ class ParallelExecutor:
         cached = compile_cache.lookup(tkey)
         if cached is not None:
             return cached
+
+        mesh = self._mesh
+        # resolve the state placement BEFORE tracing: sharded-op
+        # lowerings (sparse embedding lookup/update over row-sharded
+        # tables) read their operands' specs from the trace context, so
+        # the placement is an input of the trace, not an afterthought.
+        # state_in below == state_names (trace_program's contract).
+        pre_state_vals = [scope.var(n) for n in state_names]
+        layout = self._sharding_layout()
+        rule_specs = {}
+        if layout is not None:
+            rule_specs = layout.resolve(
+                program, mesh,
+                [(n, tuple(getattr(v, "shape", ())))
+                 for n, v in zip(state_names, pre_state_vals)])
+        spec_by_name = {
+            n: self._state_spec(n, v, rule_specs)
+            for n, v in zip(state_names, pre_state_vals)
+        }
+
         with RecordEvent("parallel_executor/trace"):
             fn, state_in, state_out = trace_program(
                 program, feed_names, state_names, writeback, fetch_names,
@@ -256,9 +277,8 @@ class ParallelExecutor:
                 mesh=self._mesh,
                 sequence_parallel=self._build_strategy.sequence_parallel,
                 pipeline_schedule=bs.pipeline_schedule,
-                pipeline_microbatches=bs.pipeline_microbatches)
-
-        mesh = self._mesh
+                pipeline_microbatches=bs.pipeline_microbatches,
+                state_specs=spec_by_name)
         data_axes = self._data_axes()
         batch_spec = P(data_axes if len(data_axes) > 1 else data_axes[0])
         feed_shardings = []
@@ -289,18 +309,6 @@ class ParallelExecutor:
                     % (n, arr.shape[:1], dp)
                 )
 
-        state_vals = [scope.var(n) for n in state_in]
-        layout = self._sharding_layout()
-        rule_specs = {}
-        if layout is not None:
-            rule_specs = layout.resolve(
-                program, mesh,
-                [(n, tuple(getattr(v, "shape", ())))
-                 for n, v in zip(state_in, state_vals)])
-        spec_by_name = {
-            n: self._state_spec(n, v, rule_specs)
-            for n, v in zip(state_in, state_vals)
-        }
         state_shardings = [
             NamedSharding(mesh, spec_by_name[n]) for n in state_in
         ]
@@ -663,7 +671,9 @@ class ParallelExecutor:
                 examples, len(self._dispatch_queue),
                 device=self._mesh.devices.flat[0],
                 warm=warm_step,
-                fingerprint=fp)
+                fingerprint=fp,
+                extras=_sparse_step_extras(program, feed_names,
+                                           user_feed_vals))
             # per-device memory/step gauges for the whole local mesh
             # (the single-device sample above covers only device 0)
             monitor.sample_device_gauges(

@@ -57,6 +57,15 @@ class ComputeContext:
         # mesh-aware lowerings (fused_attention -> ring attention over sp)
         # consult it at trace time
         self.mesh = mesh
+        # {state var name: PartitionSpec} as the ParallelExecutor placed
+        # the persistable state on the mesh — ops with sharded lowerings
+        # (sparse embedding lookup/update over row-sharded tables) read
+        # their operands' placement from here.  Empty single-device.
+        self.state_specs = {}
+        # the Operator currently being traced (set by compute_op): gives
+        # kernels access to their input/output VAR NAMES so they can
+        # consult state_specs
+        self.op = None
 
     def rng_key(self, op_index):
         if self._key is None:
@@ -150,7 +159,13 @@ def compute_op(op, env, ctx, op_index=0):
         ins[slot] = vals
     if ctx.amp is not None:
         ins = ctx.amp.cast_inputs(op.type, ins)
-    outs = d.compute(ins, op.attrs, ctx, op_index)
+    # save/restore: region ops (pipeline_region, control flow) re-enter
+    # compute_op for their body ops under the same ctx
+    prev_op, ctx.op = ctx.op, op
+    try:
+        outs = d.compute(ins, op.attrs, ctx, op_index)
+    finally:
+        ctx.op = prev_op
     for slot, names in op.outputs.items():
         vals = outs.get(slot)
         if vals is None:

@@ -5,6 +5,7 @@ appended to grads before the optimizer op; per-param override via
 ParamAttr.regularizer).
 """
 
+from .core import VarType
 from .framework import grad_var_name
 from .layer_helper import LayerHelper
 
@@ -48,19 +49,52 @@ class L1DecayRegularizer(WeightDecayRegularizer):
         return decay
 
 
+_SPARSE_DECAY_MODES = {}  # populated below: regularizer class -> mode
+
+
+def _append_sparse_decay(param, grad, block, reg):
+    """The SelectedRows leg of the reference regularizer: decay only the
+    touched rows (``sparse_weight_decay`` merges duplicates and gathers
+    the param rows) so the gradient STAYS sparse — the dense path's
+    full-table ``scale(param)`` + ``sum`` would materialize an O(vocab)
+    gradient and de-lazy the optimizer update."""
+    mode = _SPARSE_DECAY_MODES.get(type(reg))
+    if mode is None:
+        raise TypeError(
+            "regularizer %r has no SelectedRows (sparse-gradient) "
+            "lowering; use L1Decay/L2Decay on is_sparse embedding "
+            "params, or set is_sparse=False" % type(reg).__name__)
+    helper = LayerHelper("sparse_regularized_grad")
+    new_grad = helper.create_variable_for_type_inference(dtype=grad.dtype)
+    new_grad.type = VarType.SELECTED_ROWS
+    block.append_op(
+        type="sparse_weight_decay",
+        inputs={"Grad": [grad], "Param": [param]},
+        outputs={"Out": [new_grad]},
+        attrs={"coeff": reg._regularization_coeff, "mode": mode},
+    )
+    return new_grad
+
+
 def append_regularization_ops(parameters_and_grads, regularization=None):
     """Add decay terms into each gradient (reference regularizer.py:
-    append_regularization_ops).  Per-param regularizer wins over global."""
+    append_regularization_ops).  Per-param regularizer wins over global.
+    SELECTED_ROWS gradients take the lazy touched-rows decay path."""
     params_and_grads = []
     for param, grad in parameters_and_grads:
         if grad is None:
             params_and_grads.append((param, grad))
             continue
-        regularization_term = None
         reg = param.regularizer if param.regularizer is not None \
             else regularization
-        if reg is not None:
-            regularization_term = reg(param, grad, grad.block)
+        if reg is None:
+            params_and_grads.append((param, grad))
+            continue
+        if getattr(grad, "type", None) == VarType.SELECTED_ROWS:
+            params_and_grads.append(
+                (param, _append_sparse_decay(param, grad, grad.block, reg)))
+            continue
+        regularization_term = reg(param, grad, grad.block)
         if regularization_term is None:
             params_and_grads.append((param, grad))
             continue
@@ -73,6 +107,10 @@ def append_regularization_ops(parameters_and_grads, regularization=None):
         )
         params_and_grads.append((param, new_grad))
     return params_and_grads
+
+
+_SPARSE_DECAY_MODES.update({L2DecayRegularizer: "l2",
+                            L1DecayRegularizer: "l1"})
 
 
 L1Decay = L1DecayRegularizer

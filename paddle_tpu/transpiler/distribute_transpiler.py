@@ -104,8 +104,9 @@ class DistributeTranspiler:
         self.trainers = trainers
         self._mesh = mesh
 
-        from ..parallel.embedding import _distributed_tables
-        dist_tables = _distributed_tables(self._program)
+        from ..ops.selected_rows import sparse_lookup_tables
+        dist_tables = set(sparse_lookup_tables(self._program,
+                                               "is_distributed"))
 
         plan = {}
         for p in self._program.all_parameters():

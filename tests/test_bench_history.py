@@ -329,3 +329,44 @@ def test_quantized_rung_accuracy_delta_indexed_but_non_gating(tmp_path):
     assert judged["value"]["current"] == 400.0
     assert runs["r02"]["verdict"] == "PASS"
     assert report["overall"] == "PASS"
+
+
+def test_rec_sparse_rung_fields_indexed_but_non_gating(tmp_path):
+    """ISSUE 15: the rec_sparse rung's vocab-scaling fields
+    (sparse_step_s / dense_step_s / incr_ckpt_bytes) are indexed and
+    judged against prior history (all lower is better), but the rung is
+    informational — a regression in any of them never flips the overall
+    verdict (the ckpt_sharded precedent)."""
+    def rec(sp, dn, incr):
+        return _rung("rec_sparse_vocab_scaling", dn / sp,
+                     informational=True, sparse_step_s=sp,
+                     dense_step_s=dn, incr_ckpt_bytes=incr,
+                     per_vocab={"1000000": {"sparse_step_s": sp}})
+
+    r1 = {"metric": "resnet", "value": 100.0, "unit": "img/s",
+          "vs_baseline": 1.0, "min_step_s": 0.5, "n_windows": 3,
+          "extra_metrics": [rec(0.006, 0.09, 230_000)]}
+    r2 = copy.deepcopy(r1)
+    # sparse step 10x slower, incremental bytes 50x fatter: the exact
+    # regressions the index must surface
+    r2["extra_metrics"] = [rec(0.060, 0.09, 12_000_000)]
+    paths = [_write(tmp_path, "a.json", _wrapper(1, r1)),
+             _write(tmp_path, "b.json", _wrapper(2, r2))]
+    report = bench_history.compare(
+        [bench_history.load_artifact(p, i)
+         for i, p in enumerate(paths)])
+    runs = {r["run"]: r for r in report["runs"]}
+    rec2 = [g for g in runs["r02"]["rungs"]
+            if g["metric"] == "rec_sparse_vocab_scaling"][0]
+    assert rec2["sparse_step_s"] == 0.060
+    assert rec2["incr_ckpt_bytes"] == 12_000_000
+    judged = {c["field"]: c for c in runs["r02"]["comparisons"]
+              if c["metric"] == "rec_sparse_vocab_scaling"}
+    assert judged["sparse_step_s"]["verdict"] == "REGRESSED"
+    assert judged["incr_ckpt_bytes"]["verdict"] == "REGRESSED"
+    assert judged["dense_step_s"]["verdict"] == "PASS"
+    assert all(judged[f]["informational"]
+               for f in ("sparse_step_s", "dense_step_s",
+                         "incr_ckpt_bytes"))
+    assert runs["r02"]["verdict"] == "PASS"   # informational: no gate
+    assert report["overall"] == "PASS"

@@ -118,6 +118,35 @@ def test_late_finish_after_timeout_is_ignored():
     assert st["done"] == 0 and st["todo"] == 2 and st["pending"] == 0
 
 
+def test_stale_epoch_finish_does_not_steal_release(
+        ):
+    """The dense-id staleness hole (the Go FIXME's actual worry): a
+    holder whose lease timed out reports finished AFTER the task was
+    re-dispatched under the same dense id.  The epoch guard must ignore
+    the stale report — the NEW holder's lease stays pending — and the
+    current-epoch finish still lands."""
+    clk = FakeClock()
+    svc = make_service(timeout=10.0, clock=clk)
+    svc.set_dataset(["x"])
+    t_old = svc.get_task(0)
+    clk.advance(11.0)               # holder 1's lease times out
+    # sweep requeues; the SAME dense id is re-leased at epoch+1
+    relet = svc.get_task(0)
+    assert relet.task_id == t_old.task_id
+    assert relet.epoch == t_old.epoch + 1
+    svc.task_finished(t_old.task_id, t_old.epoch)   # stale holder
+    st = svc.stats()
+    assert st["done"] == 0                   # not marked done
+    assert st["pending"] == 1                # new lease NOT cleared
+    svc.task_finished(relet.task_id, relet.epoch)   # real holder
+    st = svc.stats()                         # all done -> pass rolled
+    assert st["cur_pass"] == 1 and st["todo"] == 1
+    # epoch=None (pre-guard caller) keeps the legacy by-id behavior
+    t2 = svc.get_task(1)
+    svc.task_finished(t2.task_id)
+    assert svc.stats()["cur_pass"] == 2      # rolled again
+
+
 def test_snapshot_recover_preserves_leases_and_deadlines(tmp_path):
     clk = FakeClock()
     store = FileStore(tmp_path / "snap.json")

@@ -51,6 +51,10 @@ import sys
 # clock (also informational: disk-bound, not chip-bound).
 # accuracy_delta is the quantized rung's eval delta vs full precision
 # (informational like the rung: indexed and judged, never gating).
+# sparse_step_s / dense_step_s / incr_ckpt_bytes are the rec_sparse
+# rung's vocab-scaling evidence at vocab=1e6 (sparse warm step, the
+# dense A/B step, and the incremental-checkpoint delta bytes — all
+# lower is better; informational like the rung).
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
           ("mfu", "higher", "mfu"),
@@ -58,7 +62,10 @@ FIELDS = (("min_step_s", "lower", "step_s"),
           ("throughput_rps", "higher", "rps"),
           ("p99_ms", "lower", "p99"),
           ("save_wall_s", "lower", "save_s"),
-          ("accuracy_delta", "lower", "acc_d"))
+          ("accuracy_delta", "lower", "acc_d"),
+          ("sparse_step_s", "lower", "sp_step"),
+          ("dense_step_s", "lower", "dn_step"),
+          ("incr_ckpt_bytes", "lower", "incr_b"))
 
 
 def _rung_record(r):
@@ -78,7 +85,8 @@ def _rung_record(r):
     if mfu is not None:
         out["mfu"] = mfu
     for f in ("throughput_rps", "p99_ms", "save_wall_s",
-              "accuracy_delta"):
+              "accuracy_delta", "sparse_step_s", "dense_step_s",
+              "incr_ckpt_bytes"):
         if r.get(f) is not None:
             out[f] = r[f]
     gp = r.get("goodput")

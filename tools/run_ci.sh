@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/14 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/15 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/14 API signature gate =="
+echo "== 2/15 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/14 8-device virtual-mesh dryrun =="
+echo "== 3/15 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/14 bench smoke (CPU backend, tiny) =="
+echo "== 4/15 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/14 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/15 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/14 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/15 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/14 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/15 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -170,7 +170,7 @@ PY
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
-echo "== 8/14 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+echo "== 8/15 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
 GUARD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
 # the drill is installed purely from the environment (FLAGS_fault_spec)
@@ -227,7 +227,7 @@ PY
 grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
 grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
 
-echo "== 9/14 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
+echo "== 9/15 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
 TUNE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
@@ -323,7 +323,7 @@ print("AUTOTUNE TRAINER FINAL %.6f over %d steps"
       % (losses[-1], len(losses)), flush=True)
 PY
 
-echo "== 10/14 goodput smoke + bench-history regression gate =="
+echo "== 10/15 goodput smoke + bench-history regression gate =="
 GOOD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR"' EXIT
 # (a) a 3-step monitored MLP run -> the goodput ledger attributes its
@@ -383,7 +383,7 @@ assert any(c["field"] == "min_step_s" and c["verdict"] == "REGRESSED"
 print("bench_history: +20% perturbation flagged REGRESSED")
 PY
 
-echo "== 11/14 serving smoke (engine over toy MLP, concurrent requests) =="
+echo "== 11/15 serving smoke (engine over toy MLP, concurrent requests) =="
 SERVE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'PY'
@@ -438,7 +438,7 @@ PY
 # per-request serving/* events landed in the JSONL, run_id-correlated
 grep -ql serving_request "$SERVE_DIR"/monitor/*.jsonl
 
-echo "== 12/14 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
+echo "== 12/15 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
 echo "==       loss parity vs GPipe + measured pipeline_bubble drop)        =="
 PIPE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR"' EXIT
@@ -513,7 +513,7 @@ PY
 # the pipeline_bubble bucket landed in the goodput JSONL stamps
 grep -ql pipeline_bubble "$PIPE_DIR"/*.jsonl
 
-echo "== 13/14 cluster elastic-resume drill (2 members, SIGKILL one mid-run) =="
+echo "== 13/15 cluster elastic-resume drill (2 members, SIGKILL one mid-run) =="
 CLUSTER_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR"' EXIT
 # the supervisor runs the whole acceptance drill: an uninterrupted
@@ -539,7 +539,7 @@ print("CKPT_SHARDED per-host wall %.3fs, bytes/N %s, MB/s spread %.2f"
       % (r["save_wall_s"], r["bytes_one_over_n"], r["mb_per_s_spread"]))
 PY
 
-echo "== 14/14 quantized inference smoke (pass -> gate -> save -> serving) =="
+echo "== 14/15 quantized inference smoke (pass -> gate -> save -> serving) =="
 QUANT_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR"' EXIT
 # end-to-end int8: accuracy-gated tune_quantization over a toy inference
@@ -604,5 +604,99 @@ PY
 # the gate's decision trail landed in the JSONL
 grep -ql '"knob": "quantization"' "$QUANT_DIR"/monitor/*.jsonl || \
   grep -ql quantization "$QUANT_DIR"/monitor/*.jsonl
+
+echo "== 15/15 sparse-embedding smoke (ctr_dnn is_sparse + incremental =="
+echo "==       checkpoints: SIGTERM flush -> base+delta resume bit-identical) =="
+SPARSE_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR" "$SPARSE_DIR"' EXIT
+cat > "$SPARSE_DIR/sparse_smoke.py" <<'PY'
+import os, signal, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.getcwd())
+mode, ckpt = sys.argv[1], sys.argv[2]
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.contrib import Trainer, CheckpointConfig
+from paddle_tpu.models.ctr_dnn import ctr_dnn
+from paddle_tpu.reader import checkpointable
+
+monitor.enable(log_dir=os.path.join(os.path.dirname(ckpt), "monitor"))
+DNN_V, LR_V, T = 400, 50, 5
+
+def train_func():
+    fluid.default_main_program().random_seed = 7
+    fluid.default_startup_program().random_seed = 7
+    dnn = fluid.layers.data("dnn_ids", shape=[1], dtype="int64",
+                            lod_level=1)
+    lr = fluid.layers.data("lr_ids", shape=[1], dtype="int64",
+                           lod_level=1)
+    label = fluid.layers.data("click", shape=[1], dtype="int64")
+    cost, _p, _a = ctr_dnn(dnn, lr, label, DNN_V, LR_V)
+    return cost
+
+def samples():
+    rng = np.random.RandomState(0)
+    for _ in range(24):
+        yield (rng.randint(0, DNN_V, (T, 1)).astype("int64"),
+               rng.randint(0, LR_V, (2, 1)).astype("int64"),
+               np.array([int(rng.rand() < 0.5)], "int64"))
+
+# incremental='auto': every is_sparse table + its Adam moments are
+# delta-encoded against the step-1 full base
+cfg = CheckpointConfig(checkpoint_dir=ckpt, step_interval=1,
+                       incremental="auto")
+trainer = Trainer(train_func=train_func, place=fluid.CPUPlace(),
+                  optimizer_func=lambda: fluid.optimizer.Adam(1e-2),
+                  checkpoint_config=cfg)
+if mode == "resume":
+    print("RESUMED", cfg.load_serial, flush=True)
+    assert cfg.load_serial == 3, cfg.load_serial
+state = {"step": cfg.load_serial or 0}
+
+def handler(event):
+    if not hasattr(event, "metrics"):
+        return
+    state["step"] += 1
+    print("STEP %d %r" % (state["step"],
+                          float(np.ravel(event.metrics[0])[0])),
+          flush=True)
+    if mode == "run" and state["step"] == 3:
+        os.kill(os.getpid(), signal.SIGTERM)   # preemption notice
+
+trainer.train(num_epochs=1, event_handler=handler,
+              reader=checkpointable(fluid.batch(samples, batch_size=4)),
+              feed_order=["dnn_ids", "lr_ids", "click"])
+PY
+JAX_PLATFORMS=cpu python "$SPARSE_DIR/sparse_smoke.py" ref "$SPARSE_DIR/ref_ckpt" \
+  > "$SPARSE_DIR/ref.out"
+set +e
+JAX_PLATFORMS=cpu python "$SPARSE_DIR/sparse_smoke.py" run "$SPARSE_DIR/ckpt" \
+  > "$SPARSE_DIR/run.out"
+rc=$?
+set -e
+test "$rc" -eq 143  # checkpoint flushed, then SIGTERM's default proceeded
+# the flushed artifacts are an incremental chain: step 1 full, 2-3 deltas
+python - "$SPARSE_DIR/ckpt" <<'PY'
+import json, os, sys
+ck = sys.argv[1]
+steps = sorted(d for d in os.listdir(ck) if d.startswith("step_"))
+assert len(steps) >= 3, steps
+kinds = []
+for d in steps[:3]:
+    m = json.load(open(os.path.join(ck, d, "MANIFEST.json")))
+    kinds.append("delta" if m.get("incremental") else "full")
+assert kinds == ["full", "delta", "delta"], kinds
+print("INCREMENTAL CHAIN", kinds, flush=True)
+PY
+JAX_PLATFORMS=cpu python "$SPARSE_DIR/sparse_smoke.py" resume "$SPARSE_DIR/ckpt" \
+  > "$SPARSE_DIR/resume.out"
+grep -q "^RESUMED 3$" "$SPARSE_DIR/resume.out"
+# base+delta restore: resumed steps 4-6 reproduce the uninterrupted
+# run's losses bit-exactly (%r prints full precision)
+diff <(grep "^STEP [456] " "$SPARSE_DIR/ref.out") \
+     <(grep "^STEP [456] " "$SPARSE_DIR/resume.out")
+# touched-row telemetry rode the per-step JSONL records
+grep -ql sparse_touched_rows "$SPARSE_DIR"/monitor/*.jsonl
 
 echo "CI OK"

@@ -832,6 +832,165 @@ def bench_serving(args):
     return result
 
 
+def bench_decode_paged(args):
+    """Paged-KV decode rung (ISSUE 16): concurrent generation sessions
+    at fixed HBM, speculative-decoding token rate, and prefix-cache
+    hit rate — the decode raw-speed numbers as one artifact.
+
+    Three arms over the same prompt workload (a shared system prefix
+    spanning whole pages plus unique per-request tails — the workload
+    prefix sharing exists for):
+
+    * **fixed** — the ISSUE-10 fixed-region f32 KV engine: the
+      baseline, one ``max_len`` KV region per slot regardless of how
+      short the session actually runs.
+    * **paged int8** (headline) — block-indexed KV pool + page table,
+      int8 pages, prefix sharing on.  ``sessions_at_fixed_hbm`` is the
+      measured HBM-per-session ratio: fixed-region bytes/session over
+      the paged arm's bytes/session at the *observed* lengths net of
+      the pages prefix sharing actually aliased (counted by the
+      engine's own prefix_hits telemetry, not assumed).  Acceptance is
+      >= 4x; ``vs_baseline`` = ratio/4 so >1 = met.
+    * **speculative** — paged f32 target + same-architecture draft
+      sharing the target's weights (``sync_draft_weights``; the
+      perfect-draft rig, so the rung exercises the full
+      propose/verify/rollback machinery deterministically).
+      ``spec_tok_s`` is measured, p99 recorded, and the greedy outputs
+      must MATCH the fixed arm token-for-token — speculation that
+      changes outputs is a failed rung, not a fast one.
+
+    All three arms decode through ONE compiled signature each
+    (lowering counts recorded); prefix_hit_rate comes from the paged
+    arm's metrics snapshot.  CPU-smokeable; chip numbers come from the
+    same rung on device."""
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.serving.decoder import (build_decoder_lm,
+                                            sync_draft_weights)
+    from paddle_tpu.serving.engine import GenerationEngine
+
+    if not monitor.enabled():
+        fluid.set_flags({"FLAGS_monitor": True})
+    monitor.step_stats().reset()
+    monitor.goodput_reset()
+    place = _place(args)
+    vocab, max_len, slots, page_size = 61, 64, 4, 8
+    dims = dict(n_layer=2, n_head=2, d_model=32, d_inner=64)
+    max_new = 8
+    rng = np.random.RandomState(0)
+    # two full pages of shared system prompt + a unique tail per
+    # request: the tail keeps sessions distinct, the prefix is the
+    # aliasing opportunity
+    system = [int(x) for x in rng.randint(1, vocab, size=2 * page_size)]
+    n_requests = 8 if args.smoke else 16
+    prompts = [system + [int(x) for x in
+                         rng.randint(1, vocab, size=3 + (i % 4))]
+               for i in range(n_requests)]
+
+    def drive(eng):
+        t0 = time.perf_counter()
+        outs = [r.result(600) for r in
+                [eng.submit(p) for p in prompts]]
+        wall = time.perf_counter() - t0
+        toks = sum(len(o["tokens"]) for o in outs)
+        summ = eng.metrics.summary()
+        return ([o["tokens"] for o in outs], round(toks / wall, 2),
+                wall, summ)
+
+    # --- arm 1: fixed-region f32 baseline ------------------------------
+    spec_fixed = build_decoder_lm(vocab, max_len, slots, prefix="bpfx",
+                                  **dims)
+    eng = GenerationEngine(spec_fixed, place=place,
+                           max_new_tokens=max_new, timeout_s=600.0)
+    try:
+        fixed_toks, fixed_tok_s, _, fixed_summ = drive(eng)
+        fixed_sigs = len(eng._exe_decode._cache)
+    finally:
+        eng.close()
+    fixed_bytes_per_session = spec_fixed.cache.bytes() // slots
+
+    # --- arm 2: paged int8 + prefix sharing (the HBM headline) ---------
+    spec_paged = build_decoder_lm(vocab, max_len, slots, paged=True,
+                                  page_size=page_size, kv_dtype="int8",
+                                  prefix="bpq8", **dims)
+    eng = GenerationEngine(spec_paged, place=place,
+                           max_new_tokens=max_new, timeout_s=600.0)
+    try:
+        paged_toks, paged_tok_s, _, paged_summ = drive(eng)
+        paged_sigs = len(eng._exe_decode._cache)
+        snap = eng.metrics.paged_snapshot()
+        leaks = eng._alloc.check_leaks()
+    finally:
+        eng.close()
+    # measured bytes/session: page-slot demand at the OBSERVED lengths
+    # minus the pages prefix sharing aliased (the engine's own hit
+    # counter), times the int8 page cost
+    alloc = spec_paged.cache.make_allocator()
+    demand = sum(alloc.pages_needed(len(p), max_new) for p in prompts)
+    fresh_pages = demand - snap["prefix_hits"]
+    paged_bytes_per_session = (fresh_pages / float(n_requests)
+                               * spec_paged.cache.bytes_per_page())
+    sessions_ratio = round(
+        fixed_bytes_per_session / paged_bytes_per_session, 2)
+
+    # --- arm 3: speculative decoding (perfect-draft rig) ---------------
+    spec_k = 4
+    spec_sp = build_decoder_lm(vocab, max_len, slots, paged=True,
+                               page_size=page_size, spec_k=spec_k,
+                               prefix="bpsp", **dims)
+    draft = build_decoder_lm(vocab, max_len, slots, prefix="bpspd",
+                             **dims)
+    eng = GenerationEngine(spec_sp, place=place, max_new_tokens=max_new,
+                           timeout_s=600.0, draft_spec=draft,
+                           start=False)
+    try:
+        sync_draft_weights(eng._scope, spec_sp, draft)
+        eng.start()
+        spec_toks, spec_tok_s, _, spec_summ = drive(eng)
+        spec_snap = eng.metrics.paged_snapshot()
+    finally:
+        eng.close()
+    # the correctness gate: speculation must reproduce the plain greedy
+    # stream exactly (paged f32 matches fixed f32 bit-for-bit on the
+    # argmax path; acceptance/rollback must not change that)
+    spec_outputs_match = spec_toks == fixed_toks
+
+    int8_match = sum(a == b for a, b in zip(paged_toks, fixed_toks))
+    result = {"metric": "decode_sessions_at_fixed_hbm",
+              "value": sessions_ratio, "unit": "x",
+              # acceptance: >= 4x concurrent sessions at fixed HBM
+              "vs_baseline": round(sessions_ratio / 4.0, 3),
+              "sessions_at_fixed_hbm": sessions_ratio,
+              "bytes_per_session_fixed": int(fixed_bytes_per_session),
+              "bytes_per_session_paged": int(paged_bytes_per_session),
+              "prefix_hit_rate": snap["prefix_hit_rate"],
+              "prefix_hits": snap["prefix_hits"],
+              "page_slot_demand": demand,
+              "spec_tok_s": spec_tok_s,
+              "spec_k": spec_k,
+              "spec_acceptance_rate": spec_snap["spec_acceptance_rate"],
+              "spec_outputs_match": spec_outputs_match,
+              "spec_p99_ms": spec_summ["p99_ms"],
+              "fixed_tok_s": fixed_tok_s,
+              "paged_int8_tok_s": paged_tok_s,
+              "int8_outputs_match_f32": "%d/%d" % (int8_match,
+                                                   n_requests),
+              "p99_ms": paged_summ["p99_ms"],
+              "decode_lowerings": {"fixed": fixed_sigs,
+                                   "paged": paged_sigs},
+              "kv_page_leaks": len(leaks),
+              "n_requests": n_requests,
+              "max_new_tokens": max_new,
+              # seconds per decode step on the headline arm — the
+              # cross-run estimator bench_history indexes
+              "min_step_s": round(
+                  1.0 / (paged_tok_s / slots), 6) if paged_tok_s else None,
+              "n_windows": 1,
+              "step_stats": monitor.step_stats().summary(),
+              "goodput": monitor.goodput_summary()}
+    return result
+
+
 def bench_quantized(args):
     """Quantized-vs-bf16 forward rung (ISSUE 14): the serving-shaped
     small-batch token forward — 3 wide FC layers in the latency-bound
@@ -1935,7 +2094,7 @@ def main():
                             "machine_translation", "alexnet", "googlenet",
                             "smallnet", "reader_capacity", "fault_drill",
                             "serving", "ckpt_sharded", "quantized",
-                            "rec_sparse"])
+                            "rec_sparse", "decode_paged"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -2125,6 +2284,11 @@ def main():
             # vocab-scaling A/B + incremental-checkpoint bytes; the
             # ratio is the claim, not an absolute chip number
             ("rec_sparse", [], True, 300),
+            # paged-KV decode (ISSUE 16): sessions-at-fixed-HBM ratio
+            # (paged int8 vs fixed-region), speculative tok/s, prefix
+            # hit rate; informational while the rung accumulates
+            # history — the >=4x acceptance reads off vs_baseline
+            ("decode_paged", [], True, 300),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -2318,6 +2482,8 @@ def main():
         result = bench_fault_drill(args)
     elif args.model == "serving":
         result = bench_serving(args)
+    elif args.model == "decode_paged":
+        result = bench_decode_paged(args)
     elif args.model == "ckpt_sharded":
         result = bench_ckpt_sharded(args)
     elif args.model == "quantized":

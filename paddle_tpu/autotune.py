@@ -1516,3 +1516,135 @@ def tune_quantization(main_program, scope, feed, fetch_list, place,
         _event({"event": "autotune_decision", "knob": "quantization",
                 "chosen": decision["chosen"], "fingerprint": fp[:12]})
     return decision
+
+
+# ---------------------------------------------------------------------------
+# serving decode tuners (ISSUE 16): int8 KV gate + speculation k
+# ---------------------------------------------------------------------------
+
+def tune_kv_quantization(build_spec, prompts, place=None,
+                         max_new_tokens=8, budget=None, min_speedup=0.0,
+                         config=None):
+    """Accuracy gate for int8 KV pages, riding ``tune_quantization``'s
+    discipline: drive the SAME weights (same build seed/prefix, fresh
+    scope each) through a f32-KV paged engine as the reference and an
+    int8-KV paged engine as the candidate, compare the per-step greedy
+    logits with :func:`eval_delta`, and keep int8 KV only when the
+    delta stays under ``budget`` (``FLAGS_quantize_accuracy_budget``).
+    A rejection is recorded as TunedConfig evidence, exactly like a
+    rejected weight-quantization candidate.
+
+    ``build_spec(kv_dtype)`` -> a paged DecoderSpec (``kv_dtype`` is
+    ``None`` for the f32 reference, ``"int8"`` for the candidate).
+    ``min_speedup`` defaults to 0: int8 KV is an HBM-capacity knob
+    (half the pool bytes), not a latency knob — it must not LOSE
+    accuracy, but it does not have to win time."""
+    import time as _time
+
+    from .executor import CPUPlace
+    from .serving.engine import GenerationEngine
+
+    if budget is None:
+        budget = float(_flag("quantize_accuracy_budget", 0.02))
+    place = place or CPUPlace()
+
+    def _drive(kv_dtype):
+        spec = build_spec(kv_dtype)
+        eng = GenerationEngine(spec, place=place,
+                               max_new_tokens=max_new_tokens,
+                               timeout_s=600.0, record_logits=True)
+        try:
+            t0 = _time.monotonic()
+            outs = [eng.submit(p).result(1200) for p in prompts]
+            wall = _time.monotonic() - t0
+        finally:
+            eng.close()
+        toks = sum(len(o["tokens"]) for o in outs)
+        logits = [row for o in outs for row in o["logits"]]
+        tokens = [tuple(o["tokens"]) for o in outs]
+        return logits, tokens, wall / max(toks, 1)
+
+    ref_logits, ref_tokens, fp_step_s = _drive(None)
+    cand = {"mode": "kv_int8"}
+    try:
+        q_logits, q_tokens, q_step_s = _drive("int8")
+        cand["accuracy_delta"] = round(eval_delta(ref_logits, q_logits),
+                                       6)
+        cand["step_s"] = round(q_step_s, 6)
+        cand["greedy_tokens_match"] = q_tokens == ref_tokens
+    except Exception as e:  # noqa: BLE001 — evidence, not a crash
+        cand["rejected"] = "error: %s" % str(e)[:160]
+    _event({"event": "autotune_probe", "knob": "kv_quantization",
+            "mode": "kv_int8",
+            "accuracy_delta": cand.get("accuracy_delta"),
+            "step_s": cand.get("step_s"),
+            "rejected": cand.get("rejected")})
+    decision = decide_quantization(fp_step_s, [cand], budget,
+                                   min_speedup=min_speedup)
+    decision["knob"] = "kv_quantization"
+    decision["evidence"] = "paged_generation_ab+eval_delta"
+    if config is not None:
+        config.add(decision)
+    else:
+        _event({"event": "autotune_decision", "knob": "kv_quantization",
+                "chosen": decision["chosen"]})
+    return decision
+
+
+def tune_speculation_k(make_engine, prompts, candidates=(None, 2, 4),
+                       config=None):
+    """Learn the speculative-decoding ``k`` for a workload: drive the
+    same prompt set through ``make_engine(k)`` for each candidate
+    (``None`` = speculation off, the baseline) and keep the fastest in
+    decode tokens/second.  Greedy invariance is part of the gate: a
+    candidate whose outputs differ from the baseline is rejected
+    regardless of speed (speculative decoding must be a pure latency
+    transform).  The workload decides — a weak draft (low acceptance)
+    makes every k>1 SLOWER than the baseline and the tuner keeps
+    ``None``."""
+    import time as _time
+
+    baseline_tokens = None
+    cands = []
+    for k in candidates:
+        cand = {"k": k}
+        try:
+            eng = make_engine(k)
+            try:
+                t0 = _time.monotonic()
+                outs = [eng.submit(p).result(1200) for p in prompts]
+                wall = _time.monotonic() - t0
+                toks = sum(len(o["tokens"]) for o in outs)
+                tokens = [tuple(o["tokens"]) for o in outs]
+                snap = eng.metrics.paged_snapshot()
+            finally:
+                eng.close()
+            cand["tok_s"] = round(toks / max(wall, 1e-9), 2)
+            cand["acceptance_rate"] = snap.get("spec_acceptance_rate")
+            if k is None:
+                baseline_tokens = tokens
+            elif baseline_tokens is not None \
+                    and tokens != baseline_tokens:
+                cand["rejected"] = "greedy_outputs_diverged"
+        except Exception as e:  # noqa: BLE001
+            cand["rejected"] = "error: %s" % str(e)[:160]
+        _event({"event": "autotune_probe", "knob": "speculation_k",
+                "k": k, "tok_s": cand.get("tok_s"),
+                "acceptance_rate": cand.get("acceptance_rate"),
+                "rejected": cand.get("rejected")})
+        cands.append(cand)
+    ok = [c for c in cands if not c.get("rejected")
+          and c.get("tok_s")]
+    best = max(ok, key=lambda c: c["tok_s"]) if ok else None
+    decision = {"knob": "speculation_k",
+                "chosen": best["k"] if best else None,
+                "candidates": cands,
+                "evidence": "measured_generation_window"}
+    if best:
+        decision["chosen_tok_s"] = best["tok_s"]
+    if config is not None:
+        config.add(decision)
+    else:
+        _event({"event": "autotune_decision", "knob": "speculation_k",
+                "chosen": decision["chosen"]})
+    return decision

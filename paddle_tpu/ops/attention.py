@@ -175,3 +175,71 @@ register_op(
     infer=_fused_attention_infer, compute=_fused_attention_compute,
     no_grad_inputs=("KLen",), stateful_random=True,
 )
+
+
+# ---------------------------------------------------------------------------
+# paged attention (ISSUE 16): attention over a block-indexed KV pool
+# ---------------------------------------------------------------------------
+
+def _paged_attention_infer(op, block):
+    q = in_var(op, block, "Q")
+    kc = in_var(op, block, "KCache")
+    table = in_var(op, block, "PageTable")
+    if q is None or kc is None or table is None:
+        raise ValueError("paged_attention needs Q, KCache/VCache and "
+                         "PageTable inputs")
+    if len(q.shape) != 4 or len(kc.shape) != 4 or len(table.shape) != 2:
+        raise ValueError(
+            "paged_attention expects Q [S, H, Tq, D], KCache "
+            "[P, H, ps, D], PageTable [S, max_pages]; got %s / %s / %s"
+            % (q.shape, kc.shape, table.shape))
+    tmax = table.shape[1] * kc.shape[2]
+    if q.shape[2] > tmax:
+        raise ValueError(
+            "paged_attention: Tq %d exceeds the paged capacity %d"
+            % (q.shape[2], tmax))
+    import numpy as np
+    if np.dtype(kc.dtype) == np.dtype("int8") \
+            and in_var(op, block, "KScale") is None:
+        raise ValueError(
+            "paged_attention: int8 KV pools need KScale/VScale inputs")
+    set_output(op, block, "Out", q.shape, q.dtype)
+
+
+def _paged_attention_compute(ins, attrs, ctx, op_index):
+    q = ins["Q"][0]
+    k_pool = ins["KCache"][0]
+    v_pool = ins["VCache"][0]
+    table = ins["PageTable"][0].astype(jnp.int32)
+    k_len = ins.get("KLen", [None])[0]
+    k_scale = ins.get("KScale", [None])[0]
+    v_scale = ins.get("VScale", [None])[0]
+    scale = attrs.get("scale", None)
+
+    from .pallas import flash_attention as fa
+    from .pallas import interpret_mode
+    from .. import autotune
+    from ..flags import flag
+
+    # kernel selection on the GATHERED shape (the shape the kernel
+    # actually runs): tuned per-shape ruling wins unless the operator
+    # pinned FLAGS_pallas_kernels — the fused_attention discipline
+    tmax = table.shape[1] * k_pool.shape[2]
+    k_shape = (q.shape[0], q.shape[1], tmax, q.shape[3])
+    choice = autotune.attention_choice(q.shape, k_shape, q.dtype)
+    use_pallas = flag("pallas_kernels") if choice is None else choice
+    out = fa.paged_attention(
+        q, k_pool, v_pool, table, k_len, k_scale, v_scale,
+        causal=attrs.get("causal", True), scale=scale,
+        use_pallas=use_pallas, interpret=interpret_mode(ctx))
+    return {"Out": out}
+
+
+register_op(
+    "paged_attention",
+    ["Q", "KCache", "VCache", "PageTable", "KLen", "KScale", "VScale"],
+    ["Out"],
+    infer=_paged_attention_infer, compute=_paged_attention_compute,
+    grad=None,
+    no_grad_inputs=("PageTable", "KLen", "KScale", "VScale"),
+)

@@ -73,3 +73,136 @@ register_op(
     infer=_kv_cache_write_infer, compute=_kv_cache_write_compute,
     grad=None, no_grad_inputs=("Pos", "Slot"),
 )
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache (ISSUE 16): block-indexed writes over a page table
+# ---------------------------------------------------------------------------
+#
+# The fixed-region cache above pays HBM at the bucket bound per slot; the
+# paged cache pays per PAGE ACTUALLY WRITTEN.  The pool is one persistable
+# var ``[P, H, page_size, D]`` shared by every slot; a host-owned page
+# table ``[S, max_pages]`` int32 maps each slot's logical page j to a
+# physical pool page (entries past the slot's valid length are arbitrary
+# — attention masks them via k_len exactly like stale fixed-region
+# content).  Sharing a prompt prefix across slots is a page-table aliasing
+# decision, not a copy: aliased pages hold identical K/V by construction
+# (causal prefix K/V depend only on prefix tokens), so a re-prefill
+# through a shared page re-writes identical content — a semantic no-op.
+#
+# ``kv_cache_paged_write(Cache, X, Pos, PageTable, Slot?, Scale?)``:
+#
+# * ``Cache``     [P, H, ps, D] — the page pool (float, or int8 under
+#   quantized KV — then ``Scale`` [P, H, ps] carries the per-token-row
+#   dequant scales, the per-channel grid along the time axis);
+# * ``X``         [B, H, t, D]  — new keys/values;
+# * ``Pos``       [B] int32     — global time offset of X's first token;
+# * ``PageTable`` [S, max_pages] int32 — per-slot physical page lists;
+# * ``Slot``      [B] int32, optional — identity when omitted (decode).
+#
+# Decode (t == 1): one scatter row per slot at page
+# ``table[b, pos // ps]``, offset ``pos % ps``.  Prefill (t > 1)
+# requires ``t % ps == 0`` (bucket bounds are page-aligned by the
+# serving admission) and scatters whole pages.
+
+
+def _paged_write_infer(op, block):
+    cache = in_var(op, block, "Cache")
+    x = in_var(op, block, "X")
+    table = in_var(op, block, "PageTable")
+    if cache is None or x is None or table is None:
+        raise ValueError(
+            "kv_cache_paged_write needs Cache, X and PageTable inputs")
+    if len(cache.shape) != 4 or len(x.shape) != 4 or len(table.shape) != 2:
+        raise ValueError(
+            "kv_cache_paged_write expects Cache [P, H, ps, D], X "
+            "[B, H, t, D], PageTable [S, max_pages]; got %s / %s / %s"
+            % (cache.shape, x.shape, table.shape))
+    set_output(op, block, "Out", cache.shape, cache.dtype)
+    scale = in_var(op, block, "Scale")
+    if scale is not None:
+        set_output(op, block, "OutScale", scale.shape, scale.dtype)
+
+
+def _quantize_rows(x):
+    """Per-token-row int8 grid: one abs-max scale per (token, head) row
+    over the D channels — the per-channel machinery of ``ops/quantize``
+    applied along the KV time axis.  Returns (int8 values, f32 scales
+    with trailing D reduced away)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _paged_write_compute(ins, attrs, ctx, op_index):
+    cache = ins["Cache"][0]
+    x = ins["X"][0]
+    pos = ins["Pos"][0].astype(jnp.int32).reshape(-1)
+    table = ins["PageTable"][0].astype(jnp.int32)
+    slot = ins.get("Slot", [None])[0]
+    scales = ins.get("Scale", [None])[0]
+    quantized = cache.dtype == jnp.int8
+    ps = cache.shape[2]
+    b, h, t, d = x.shape
+    out = {}
+    if quantized:
+        qx, qs = _quantize_rows(x)           # [B,H,t,D] int8, [B,H,t] f32
+    else:
+        qx, qs = x.astype(cache.dtype), None
+    if t == 1:
+        # decode fast path: row b writes one token of slot b — page and
+        # offset from the slot's own table row, one batched scatter
+        rows = jnp.arange(b, dtype=jnp.int32) if slot is None \
+            else slot.astype(jnp.int32).reshape(-1)
+        page = table[rows, pos // ps]                       # [B]
+        off = pos % ps                                      # [B]
+        out["Out"] = cache.at[page, :, off, :].set(
+            qx[:, :, 0, :], mode="drop")
+        if quantized and scales is not None:
+            out["OutScale"] = scales.at[page, :, off].set(
+                qs[:, :, 0], mode="drop")
+        return out
+    rows = jnp.arange(b, dtype=jnp.int32) if slot is None \
+        else slot.astype(jnp.int32).reshape(-1)
+    if t % ps:
+        # k-token verify shape (speculative decoding): t is a small
+        # trace-time constant, not page-aligned — scatter per token.
+        # Tokens straddle a page boundary correctly because each token
+        # looks up its own page.
+        cur_s = scales
+        for j in range(t):
+            page = table[rows, (pos + j) // ps]
+            off = (pos + j) % ps
+            cache = cache.at[page, :, off, :].set(qx[:, :, j, :],
+                                                  mode="drop")
+            if quantized and cur_s is not None:
+                cur_s = cur_s.at[page, :, off].set(qs[:, :, j],
+                                                   mode="drop")
+        out["Out"] = cache
+        if quantized and cur_s is not None:
+            out["OutScale"] = cur_s
+        return out
+    # prefill: t is page-aligned; scatter whole pages.  B and t are
+    # trace-time constants (the admitted bucket), so the page count per
+    # request is static: [B, H, npg, ps, D] -> [B*npg] pool rows.
+    npg = t // ps
+    pages = table[rows][:, :npg].reshape(-1)                # [B*npg]
+    chunks = qx.reshape(b, h, npg, ps, d).transpose(0, 2, 1, 3, 4)
+    out["Out"] = cache.at[pages].set(
+        chunks.reshape(b * npg, h, ps, d), mode="drop")
+    if quantized and scales is not None:
+        schunks = qs.reshape(b, h, npg, ps).transpose(0, 2, 1, 3)
+        out["OutScale"] = scales.at[pages].set(
+            schunks.reshape(b * npg, h, ps), mode="drop")
+    return out
+
+
+register_op(
+    "kv_cache_paged_write",
+    ["Cache", "X", "Pos", "PageTable", "Slot", "Scale"],
+    ["Out", "OutScale"],
+    infer=_paged_write_infer, compute=_paged_write_compute,
+    grad=None, no_grad_inputs=("Pos", "PageTable", "Slot", "Scale"),
+)

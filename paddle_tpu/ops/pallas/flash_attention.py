@@ -404,6 +404,47 @@ def _flash_bwd(causal, rate, scale, interpret, res, dout):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+def gather_pages(pool, table, scale=None):
+    """Materialize per-slot K or V views from a paged pool.
+
+    ``pool`` [P, H, ps, D] (float or int8), ``table`` [S, max_pages]
+    int32 physical page ids, ``scale`` [P, H, ps] f32 per-token-row
+    dequant scales (required when the pool is int8).  Returns
+    [S, H, max_pages*ps, D] in f32 for int8 pools, pool dtype otherwise.
+    One gather per pool — XLA fuses it into the attention consumer, so
+    the transient view never round-trips HBM as a separate buffer."""
+    s, mp = table.shape
+    p, h, ps, d = pool.shape
+    pages = pool[table.reshape(-1)]              # [S*mp, H, ps, D]
+    kv = pages.reshape(s, mp, h, ps, d).transpose(0, 2, 1, 3, 4) \
+        .reshape(s, h, mp * ps, d)
+    if pool.dtype == jnp.int8:
+        sc = scale[table.reshape(-1)].reshape(s, mp, h, ps) \
+            .transpose(0, 2, 1, 3).reshape(s, h, mp * ps)
+        kv = kv.astype(jnp.float32) * sc[..., None]
+    return kv
+
+
+def paged_attention(q, k_pool, v_pool, table, k_len, k_scale=None,
+                    v_scale=None, causal=True, scale=None,
+                    use_pallas=False, interpret=False):
+    """The paged-attention path: gather each slot's pages into the
+    contiguous [S, H, Tmax, D] view the bottom-aligned suffix-query
+    kernels already handle (Tq <= Tk, query i at global position
+    klen - Tq + i), then dispatch to the flash kernel or the XLA
+    fallback.  Paging changes where K/V LIVE (page pool + table), not
+    the attention math — so the klen-aware mask work from the decode
+    kernels is reused verbatim."""
+    k = gather_pages(k_pool, table, k_scale)
+    v = gather_pages(v_pool, table, v_scale)
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
+    if use_pallas and supported(q.shape, k.shape, q.dtype):
+        return flash_attention(q, k, v, k_len, None, causal, 0.0, scale,
+                               interpret)
+    return reference_attention(q, k, v, k_len, None, causal, 0.0, scale)
+
+
 def reference_attention(q, k, v, k_len, seed, causal=False, dropout_rate=0.0,
                         scale=None):
     """XLA fallback with bit-identical semantics (same hash dropout mask):

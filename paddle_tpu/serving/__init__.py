@@ -12,7 +12,9 @@ request health (timeouts, poison quarantine).  See the package modules:
   and :class:`GenerationEngine` (prefill + donated KV-cache decode);
 * ``decoder``    — score/prefill/decode program builder for decoder
   LMs;
-* ``kv_cache``   — per-slot cache state over executor scope variables;
+* ``kv_cache``   — per-slot cache state over executor scope variables,
+  plus the paged page-pool store and its host-side page allocator
+  (prefix sharing, int8 pages, leak accounting);
 * ``metrics``    — SLO observability (p50/p99, queue/occupancy gauges,
   per-request JSONL events, serving goodput view).
 """
@@ -21,13 +23,16 @@ from .scheduler import (ContinuousBatchingScheduler, ServingRequest,
                         BatchPlan, RequestTimeoutError,
                         PoisonedRequestError, EngineClosedError)
 from .metrics import ServingMetrics
-from .kv_cache import KVCacheStore
-from .decoder import DecoderSpec, build_decoder_lm
+from .kv_cache import (KVCacheStore, OutOfPagesError, PageAllocator,
+                       PagedKVCacheStore)
+from .decoder import DecoderSpec, build_decoder_lm, sync_draft_weights
 from .engine import InferenceEngine, GenerationEngine
 
 __all__ = [
     "ContinuousBatchingScheduler", "ServingRequest", "BatchPlan",
     "RequestTimeoutError", "PoisonedRequestError", "EngineClosedError",
-    "ServingMetrics", "KVCacheStore", "DecoderSpec", "build_decoder_lm",
-    "InferenceEngine", "GenerationEngine",
+    "ServingMetrics", "KVCacheStore", "PageAllocator",
+    "PagedKVCacheStore", "OutOfPagesError", "DecoderSpec",
+    "build_decoder_lm", "sync_draft_weights", "InferenceEngine",
+    "GenerationEngine",
 ]

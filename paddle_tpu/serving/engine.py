@@ -33,6 +33,7 @@ from .. import io as fluid_io
 from ..executor import CPUPlace, Executor, TPUPlace
 from ..profiler import RecordEvent
 from ..scope import Scope, scope_guard
+from .kv_cache import OutOfPagesError
 from .metrics import ServingMetrics
 from .scheduler import (ContinuousBatchingScheduler, PoisonedRequestError,
                         RequestTimeoutError)
@@ -364,7 +365,7 @@ class GenerationEngine(_EngineBase):
                  max_new_tokens=32, timeout_s=60.0, bucket_bounds=None,
                  tuned_config=None, quarantine_dir=None,
                  name="serving", record_logits=False, start=True,
-                 quantize=None):
+                 quantize=None, draft_spec=None):
         super().__init__()
         self.spec = spec
         self.place = _default_place(place)
@@ -375,7 +376,8 @@ class GenerationEngine(_EngineBase):
         # decode donates so the per-step cache update is in place
         self._exe_prefill = Executor(self.place, donate_state=False)
         self._exe_decode = Executor(self.place, donate_state=True)
-        if scope is None:
+        fresh_scope = scope is None
+        if fresh_scope:
             scope = Scope()
             spec.init_scope(self._exe_prefill, scope)
         self._scope = scope
@@ -388,6 +390,38 @@ class GenerationEngine(_EngineBase):
         if self.quantize_mode:
             self.spec = spec = spec.quantize(scope,
                                              mode=self.quantize_mode)
+        # paged KV: the engine owns the host-side page allocator and the
+        # [slots, max_pages] table it feeds both paged programs.  Unheld
+        # table entries carry the OUT-OF-BOUNDS sentinel (num_pages):
+        # writes routed through them DROP at the scatter, so a freed or
+        # never-filled slot riding the fixed decode batch can never
+        # corrupt another request's live pages.
+        self.paged = bool(getattr(spec, "paged", False))
+        self._alloc = spec.cache.make_allocator() if self.paged else None
+        self._table = (np.full(
+            (spec.slots, spec.cache.max_pages_per_slot),
+            spec.cache.num_pages, "int32") if self.paged else None)
+        # speculative decoding: a small fixed-region draft model shares
+        # the serving scope; the target verifies spec_k tokens per
+        # dispatch through its verify program
+        self.draft_spec = draft_spec
+        if draft_spec is not None:
+            if spec.verify_program is None:
+                raise ValueError(
+                    "speculative decoding needs a spec built with "
+                    "spec_k (no verify program present)")
+            if getattr(draft_spec, "paged", False):
+                raise ValueError(
+                    "the draft model uses the fixed-region cache (it "
+                    "is small by design; paging it buys nothing)")
+            if draft_spec.slots != spec.slots \
+                    or draft_spec.vocab_size != spec.vocab_size \
+                    or draft_spec.max_len < spec.max_len:
+                raise ValueError(
+                    "draft spec must match the target's slots/vocab "
+                    "and cover its max_len")
+            if fresh_scope:
+                draft_spec.init_scope(self._exe_prefill, scope)
         if bucket_bounds is None and tuned is not None:
             bucket_bounds = tuned.value("bucket_bounds")
         if not bucket_bounds:
@@ -396,13 +430,48 @@ class GenerationEngine(_EngineBase):
                 bucket_bounds.append(b)
                 b *= 2
             bucket_bounds.append(spec.max_len)
+        if self.paged:
+            ps = spec.cache.page_size
+            for b in bucket_bounds:
+                if b % ps:
+                    raise ValueError(
+                        "bucket bound %d is not page-aligned (page_size "
+                        "%d) — paged prefill scatters whole pages"
+                        % (b, ps))
         self._sched = ContinuousBatchingScheduler(
-            spec.slots, bucket_bounds, default_timeout_s=timeout_s)
+            spec.slots, bucket_bounds, default_timeout_s=timeout_s,
+            admission_gate=self._page_gate if self.paged else None)
         self.metrics = ServingMetrics(name=name,
                                       quarantine_dir=quarantine_dir)
         self._active = {}             # slot -> decode state dict
         if start:
             self.start()
+
+    # -- paged-KV bookkeeping ------------------------------------------
+    def _page_gate(self, req, picked):
+        """Admission gate: admit only when the pool can cover this
+        request's WORST CASE (no sharing assumed — intra-batch aliases
+        and prefix hits only widen the margin) on top of what this
+        admission already picked.  A refused request stays queued."""
+        reserved = sum(
+            self._alloc.pages_needed(len(r.payload["prompt"]),
+                                     r.payload["max_new"])
+            for r in picked)
+        need = self._alloc.pages_needed(len(req.payload["prompt"]),
+                                        req.payload["max_new"])
+        return need <= self._alloc.free_pages() - reserved
+
+    def _free_pages(self, slot):
+        """Release every page ref a slot holds — called on EVERY
+        terminal path (complete, expire, quarantine, prefill/decode
+        failure, close); the leak regression test drives each."""
+        if self._alloc is None:
+            return 0
+        freed = self._alloc.release(slot)
+        self._table[slot, :] = self.spec.cache.num_pages
+        self.metrics.note_kv_pages(self._alloc.pages_in_use(),
+                                   self._alloc.free_pages())
+        return freed
 
     # -- client side ---------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens=None, timeout_s=None):
@@ -438,6 +507,7 @@ class GenerationEngine(_EngineBase):
                     if r.done():
                         continue
                     self._active.pop(r.slot, None)
+                    self._free_pages(r.slot)
                     self._sched.fail(r, e)
                     self.metrics.note_failure(r, e)
         self._evict_expired_running()
@@ -447,6 +517,7 @@ class GenerationEngine(_EngineBase):
             except Exception as e:  # noqa: BLE001 — fail the batch,
                 for slot in list(self._active):    # keep the engine
                     st = self._active.pop(slot)
+                    self._free_pages(slot)
                     self._sched.fail(st["req"], e)
                     self.metrics.note_failure(st["req"], e)
         elif plan is None:
@@ -455,6 +526,10 @@ class GenerationEngine(_EngineBase):
     def _evict_expired_running(self):
         for req in self._sched.expired_running():
             self._active.pop(req.slot, None)
+            # the timeout-expired generation goes terminal HERE: its KV
+            # pages (and any prefix-page refs) free immediately, not at
+            # slot-reuse time — a wedged decode must not pin pool pages
+            self._free_pages(req.slot)
             err = RequestTimeoutError(
                 "request %s evicted mid-decode after its timeout "
                 "budget" % req.id)
@@ -464,6 +539,31 @@ class GenerationEngine(_EngineBase):
     def _prefill(self, plan):
         spec = self.spec
         reqs = plan.requests
+        if self.paged:
+            # page allocation pre-pass: aliases shared prefix pages,
+            # takes fresh ones for the rest.  The admission gate sized
+            # this against the free list, so exhaustion here means the
+            # gate's invariant broke — fail THAT request, keep the batch.
+            kept = []
+            for r in reqs:
+                try:
+                    pages, shared = self._alloc.alloc_for_prompt(
+                        r.slot, r.payload["prompt"],
+                        r.payload["max_new"])
+                except OutOfPagesError as e:
+                    self._sched.fail(r, e)
+                    self.metrics.note_failure(r, e)
+                    continue
+                self._table[r.slot, :] = spec.cache.num_pages
+                self._table[r.slot, :len(pages)] = pages
+                full = len(r.payload["prompt"]) // spec.cache.page_size
+                self.metrics.note_prefix_cache(shared, full - shared)
+                kept.append(r)
+            self.metrics.note_kv_pages(self._alloc.pages_in_use(),
+                                       self._alloc.free_pages())
+            reqs = kept
+            if not reqs:
+                return
         n, t, p = len(reqs), plan.bucket, spec.slots
         self.metrics.note_admit(plan, self._sched.occupancy(),
                                 self._sched.queue_depth())
@@ -483,11 +583,22 @@ class GenerationEngine(_EngineBase):
             np.arange(t, dtype="int64")[None, :, None], (p, t, 1)).copy()
         feed = {"tok": tok, "tok@LEN": lens, "pos": pos, "slot": slots,
                 "wpos": np.zeros((p,), "int32")}
+        if self.paged:
+            feed["page_table"] = self._table
         with RecordEvent("serving/prefill",
                          args={"batch": n, "bucket": t}):
             (logits,) = self._exe_prefill.run(
                 spec.prefill_program, feed=feed,
                 fetch_list=[spec.prefill_logits], scope=self._scope)
+            if self.draft_spec is not None:
+                # the draft shares the admitted batch: same prompts into
+                # its own (fixed-region) cache, logits unused
+                dfeed = dict(feed)
+                dfeed.pop("page_table", None)
+                self._exe_prefill.run(
+                    self.draft_spec.prefill_program, feed=dfeed,
+                    fetch_list=[self.draft_spec.prefill_logits],
+                    scope=self._scope)
         logits = np.asarray(logits)
         for i, r in enumerate(reqs):
             row = logits[i, int(lens[i]) - 1]
@@ -505,6 +616,8 @@ class GenerationEngine(_EngineBase):
                 self._active[r.slot] = st
 
     def _decode_step(self):
+        if self.draft_spec is not None:
+            return self._speculative_step()
         spec = self.spec
         s = spec.slots
         tok = np.zeros((s, 1, 1), "int64")
@@ -517,6 +630,8 @@ class GenerationEngine(_EngineBase):
             wpos[slot] = st["pos"]
             clen[slot] = st["pos"] + 1
         feed = {"tok": tok, "pos": pos, "wpos": wpos, "cache_len": clen}
+        if self.paged:
+            feed["page_table"] = self._table
         with RecordEvent("serving/decode_step",
                          args={"active": len(self._active)}):
             (logits,) = self._exe_decode.run(
@@ -542,24 +657,103 @@ class GenerationEngine(_EngineBase):
                 self._active.pop(slot)
                 self._complete(slot, st)
 
+    def _speculative_step(self):
+        """One speculative round: the draft proposes ``k-1`` tokens
+        (sequential single-token steps on the SMALL model), the target
+        rules on all of them in ONE ``spec_k``-token verify dispatch,
+        and the host accepts the longest matching prefix plus the
+        target's own next token (correction or bonus).  Greedy outputs
+        are IDENTICAL to the non-speculative path by construction:
+        every emitted token is the argmax of a target logits row, and
+        verify row ``j`` conditions only on tokens the target already
+        ruled valid.  Rollback of rejected draft positions is free —
+        they sit past the slot's valid length, stale-masked by
+        ``cache_len``, overwritten by the next round's writes (both
+        caches)."""
+        spec, draft = self.spec, self.draft_spec
+        s, k = spec.slots, spec.spec_k
+        last = np.zeros((s,), "int64")
+        base = np.zeros((s,), "int32")
+        for slot, st in self._active.items():
+            last[slot] = st["generated"][-1]
+            base[slot] = st["pos"]
+        toks = np.zeros((s, k), "int64")
+        toks[:, 0] = last
+        cur = last.copy()
+        with RecordEvent("serving/speculative_step",
+                         args={"active": len(self._active), "k": k}):
+            for j in range(k - 1):
+                wp = base + j
+                dfeed = {"tok": cur.reshape(s, 1, 1),
+                         "pos": wp.astype("int64").reshape(s, 1, 1),
+                         "wpos": wp.astype("int32"),
+                         "cache_len": (wp + 1).astype("int32")}
+                (dl,) = self._exe_decode.run(
+                    draft.decode_program, feed=dfeed,
+                    fetch_list=[draft.decode_logits], scope=self._scope)
+                cur = np.asarray(dl)[:, 0].argmax(-1).astype("int64")
+                toks[:, j + 1] = cur
+            pos = base[:, None].astype("int64") + np.arange(k, dtype="int64")
+            vfeed = {"tok": toks.reshape(s, k, 1),
+                     "pos": pos.reshape(s, k, 1),
+                     "wpos": base.astype("int32"),
+                     "cache_len": (base + k).astype("int32")}
+            if self.paged:
+                vfeed["page_table"] = self._table
+            (vl,) = self._exe_decode.run(
+                spec.verify_program, feed=vfeed,
+                fetch_list=[spec.verify_logits], scope=self._scope)
+        vl = np.asarray(vl)                       # [s, k, V]
+        greedy = vl.argmax(-1)                    # [s, k]
+        self.metrics.note_decode_step(len(self._active),
+                                      self._sched.occupancy())
+        for slot in list(self._active):
+            st = self._active[slot]
+            if not np.isfinite(vl[slot]).all():
+                self._active.pop(slot)
+                self._quarantine(st["req"],
+                                 reason="non-finite verify logits")
+                continue
+            accepted = 0
+            while accepted < k - 1 and \
+                    int(toks[slot, accepted + 1]) == \
+                    int(greedy[slot, accepted]):
+                accepted += 1
+            self.metrics.note_speculation(accepted, k - 1)
+            emitted = [int(toks[slot, j + 1]) for j in range(accepted)]
+            emitted.append(int(greedy[slot, accepted]))
+            for j, t in enumerate(emitted):
+                st["generated"].append(t)
+                st["pos"] += 1
+                if self.record_logits:
+                    st["logits"].append(vl[slot, j].copy())
+                if self._finished(st, t):
+                    self._active.pop(slot)
+                    self._complete(slot, st)
+                    break
+
     def _finished(self, st, last_tok):
         return (len(st["generated"]) >= st["max_new"]
                 or (self.eos_id is not None and last_tok == self.eos_id))
 
     def _complete(self, slot, st):
         req = st["req"]
+        self._free_pages(slot)
         result = {"tokens": list(st["generated"]),
                   "prompt_len": len(req.payload["prompt"])}
         if self.record_logits:
             result["logits"] = st["logits"]
         if not self._sched.complete(req, result):
             return      # cancelled by close() while its batch ran
-        self.metrics.note_complete(
-            req, extra={"generated": len(st["generated"])})
+        extra = {"generated": len(st["generated"])}
+        if self.paged or self.draft_spec is not None:
+            extra.update(self.metrics.paged_snapshot())
+        self.metrics.note_complete(req, extra=extra)
         self.metrics._count("generated_tokens", "generated_tokens_total",
                             len(st["generated"]))
 
     def _quarantine(self, req, reason):
+        self._free_pages(req.slot)
         self.metrics.quarantine(
             req, feed={"prompt": np.asarray(req.payload["prompt"])},
             reason=reason)
@@ -567,3 +761,12 @@ class GenerationEngine(_EngineBase):
             "request %s: %s (quarantined)" % (req.id, reason))
         self._sched.fail(req, err, status="quarantined")
         self.metrics.note_failure(req, err, status="quarantined")
+
+    def close(self):
+        super().close()
+        # in-flight generations were failed by the scheduler's close;
+        # their pages go with them
+        if self._alloc is not None:
+            for slot in list(self._alloc._slot_pages):
+                self._free_pages(slot)
+        self._active.clear()

@@ -32,6 +32,11 @@ __all__ = ["ServingMetrics"]
 LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
 
+# speculation acceptance rate is a fraction of proposed draft tokens the
+# target accepted per verify round — eighth-width buckets resolve the
+# "is the draft any good on this workload" question at a glance
+ACCEPTANCE_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
 
 def _percentile(sorted_vals, q):
     if not sorted_vals:
@@ -57,7 +62,10 @@ class ServingMetrics:
         self._last_ts = None
         self._counts = {"submitted": 0, "completed": 0, "failed": 0,
                         "expired": 0, "quarantined": 0, "batches": 0,
-                        "decode_steps": 0, "generated_tokens": 0}
+                        "decode_steps": 0, "generated_tokens": 0,
+                        "prefix_hits": 0, "prefix_misses": 0,
+                        "spec_rounds": 0, "spec_proposed": 0,
+                        "spec_accepted": 0}
         # registry handles cached per generation (the monitor's own
         # pattern): the submit/complete hot path must not pay a
         # get-or-create registry lock per request
@@ -114,6 +122,51 @@ class ServingMetrics:
     def note_decode_step(self, active, occupancy):
         self._count("decode_steps", "decode_steps_total")
         self._gauge("batch_occupancy", occupancy)
+
+    # -- paged-KV / speculation telemetry (ISSUE 16) -------------------
+    def note_kv_pages(self, in_use, free):
+        self._gauge("kv_pages_in_use", in_use)
+        self._gauge("kv_pages_free", free)
+
+    def note_prefix_cache(self, hits, misses):
+        """Increment the prefix-sharing counters by this admission's
+        delta (full prompt pages aliased vs freshly written)."""
+        if hits:
+            self._count("prefix_hits", "prefix_cache_hits", hits)
+        if misses:
+            self._count("prefix_misses", "prefix_cache_misses", misses)
+
+    def note_speculation(self, accepted, proposed):
+        """One verify round: ``accepted`` of ``proposed`` draft tokens
+        survived the target's greedy check."""
+        self._count("spec_rounds", "speculation_rounds_total")
+        self._count("spec_proposed", "speculation_proposed_total",
+                    proposed)
+        self._count("spec_accepted", "speculation_accepted_total",
+                    accepted)
+        reg = self._reg()
+        if reg is not None and proposed:
+            self._handle(reg, "histogram",
+                         "speculation_acceptance_rate",
+                         buckets=ACCEPTANCE_BUCKETS).observe(
+                             accepted / float(proposed))
+
+    def paged_snapshot(self):
+        """The paged/speculation counters as a dict — the engine stamps
+        this into each completion's JSONL record (run_id-stamped by
+        ``monitor.log_event`` like every serving event)."""
+        with self._mu:
+            c = self._counts
+            snap = {k: c[k] for k in ("prefix_hits", "prefix_misses",
+                                      "spec_rounds", "spec_proposed",
+                                      "spec_accepted")}
+        total = snap["prefix_hits"] + snap["prefix_misses"]
+        snap["prefix_hit_rate"] = (round(snap["prefix_hits"] / total, 4)
+                                   if total else None)
+        snap["spec_acceptance_rate"] = (
+            round(snap["spec_accepted"] / snap["spec_proposed"], 4)
+            if snap["spec_proposed"] else None)
+        return snap
 
     def note_complete(self, req, now=None, extra=None):
         now = time.time() if now is None else now

@@ -137,7 +137,8 @@ class ContinuousBatchingScheduler:
     completion (None = no expiry)."""
 
     def __init__(self, slots, bucket_bounds=None, clock=time.monotonic,
-                 default_timeout_s=None, max_queue=4096):
+                 default_timeout_s=None, max_queue=4096,
+                 admission_gate=None):
         if slots < 1:
             raise ValueError("need at least one slot")
         self.slots = int(slots)
@@ -146,6 +147,13 @@ class ContinuousBatchingScheduler:
         self._clock = clock
         self.default_timeout_s = default_timeout_s
         self.max_queue = int(max_queue)
+        # optional resource gate consulted per admission candidate:
+        # ``admission_gate(req, picked_so_far) -> bool``.  The paged-KV
+        # engine gates on FREE PAGES here (a free slot is no longer
+        # sufficient — the pool is deliberately under-provisioned);
+        # a refused request stays QUEUED, never fails (exhaustion =
+        # queued-not-crashed, retried next admission after releases)
+        self.admission_gate = admission_gate
         self._cv = threading.Condition()
         self._queue = collections.deque()
         self._free = collections.deque(range(self.slots))
@@ -219,7 +227,9 @@ class ContinuousBatchingScheduler:
             while self._queue and rows < limit:
                 req = self._queue.popleft()
                 if (bucket is None or req.length <= bucket) \
-                        and rows + req.rows <= limit:
+                        and rows + req.rows <= limit \
+                        and (self.admission_gate is None
+                             or self.admission_gate(req, picked)):
                     picked.append(req)
                     rows += req.rows
                 else:

@@ -370,3 +370,45 @@ def test_rec_sparse_rung_fields_indexed_but_non_gating(tmp_path):
                          "incr_ckpt_bytes"))
     assert runs["r02"]["verdict"] == "PASS"   # informational: no gate
     assert report["overall"] == "PASS"
+
+
+def test_decode_paged_rung_fields_indexed_but_non_gating(tmp_path):
+    """ISSUE 16: the decode_paged rung's triple (sessions_at_fixed_hbm /
+    spec_tok_s / prefix_hit_rate — all higher is better) is indexed and
+    judged against prior history, but the rung is informational while
+    it accumulates history — a collapse in any of them surfaces in the
+    comparisons without flipping the overall verdict."""
+    def paged(sess, spec_ts, hit):
+        return _rung("decode_sessions_at_fixed_hbm", sess,
+                     informational=True, sessions_at_fixed_hbm=sess,
+                     spec_tok_s=spec_ts, prefix_hit_rate=hit,
+                     spec_outputs_match=True)
+
+    r1 = {"metric": "resnet", "value": 100.0, "unit": "img/s",
+          "vs_baseline": 1.0, "min_step_s": 0.5, "n_windows": 3,
+          "extra_metrics": [paged(10.2, 37.0, 0.75)]}
+    r2 = copy.deepcopy(r1)
+    # HBM ratio halved, spec tok/s collapsed, prefix cache cold: the
+    # exact decode-path regressions the index must surface
+    r2["extra_metrics"] = [paged(4.8, 12.0, 0.10)]
+    paths = [_write(tmp_path, "a.json", _wrapper(1, r1)),
+             _write(tmp_path, "b.json", _wrapper(2, r2))]
+    report = bench_history.compare(
+        [bench_history.load_artifact(p, i)
+         for i, p in enumerate(paths)])
+    runs = {r["run"]: r for r in report["runs"]}
+    rec = [g for g in runs["r02"]["rungs"]
+           if g["metric"] == "decode_sessions_at_fixed_hbm"][0]
+    assert rec["sessions_at_fixed_hbm"] == 4.8
+    assert rec["spec_tok_s"] == 12.0
+    assert rec["prefix_hit_rate"] == 0.10
+    judged = {c["field"]: c for c in runs["r02"]["comparisons"]
+              if c["metric"] == "decode_sessions_at_fixed_hbm"}
+    assert judged["sessions_at_fixed_hbm"]["verdict"] == "REGRESSED"
+    assert judged["spec_tok_s"]["verdict"] == "REGRESSED"
+    assert judged["prefix_hit_rate"]["verdict"] == "REGRESSED"
+    assert all(judged[f]["informational"]
+               for f in ("sessions_at_fixed_hbm", "spec_tok_s",
+                         "prefix_hit_rate"))
+    assert runs["r02"]["verdict"] == "PASS"   # informational: no gate
+    assert report["overall"] == "PASS"

@@ -55,6 +55,11 @@ import sys
 # rung's vocab-scaling evidence at vocab=1e6 (sparse warm step, the
 # dense A/B step, and the incremental-checkpoint delta bytes — all
 # lower is better; informational like the rung).
+# sessions_at_fixed_hbm / spec_tok_s / prefix_hit_rate are the
+# decode_paged rung's ISSUE-16 triple (HBM-per-session ratio,
+# speculative token rate, prefix-cache hit rate — all higher is
+# better; informational like the rung, indexed so regressions in the
+# decode path surface across rounds without gating).
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
           ("mfu", "higher", "mfu"),
@@ -65,7 +70,10 @@ FIELDS = (("min_step_s", "lower", "step_s"),
           ("accuracy_delta", "lower", "acc_d"),
           ("sparse_step_s", "lower", "sp_step"),
           ("dense_step_s", "lower", "dn_step"),
-          ("incr_ckpt_bytes", "lower", "incr_b"))
+          ("incr_ckpt_bytes", "lower", "incr_b"),
+          ("sessions_at_fixed_hbm", "higher", "sess_x"),
+          ("spec_tok_s", "higher", "spec_ts"),
+          ("prefix_hit_rate", "higher", "pfx_hit"))
 
 
 def _rung_record(r):
@@ -86,7 +94,8 @@ def _rung_record(r):
         out["mfu"] = mfu
     for f in ("throughput_rps", "p99_ms", "save_wall_s",
               "accuracy_delta", "sparse_step_s", "dense_step_s",
-              "incr_ckpt_bytes"):
+              "incr_ckpt_bytes", "sessions_at_fixed_hbm",
+              "spec_tok_s", "prefix_hit_rate"):
         if r.get(f) is not None:
             out[f] = r[f]
     gp = r.get("goodput")

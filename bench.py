@@ -712,7 +712,7 @@ def bench_serving(args):
 
     import paddle_tpu as fluid
     from paddle_tpu import monitor
-    from paddle_tpu.monitor import program_profile
+    from paddle_tpu.monitor import program_profile, tracing
     from paddle_tpu.serving import InferenceEngine
     from paddle_tpu.serving.metrics import ServingMetrics
 
@@ -721,6 +721,10 @@ def bench_serving(args):
     monitor.step_stats().reset()
     program_profile.reset_accounting()
     monitor.goodput_reset()
+    # per-request tracing rides the rung: each curve point's measured
+    # window assembles its own trees, so the artifact carries the stage
+    # breakdown (where the p99 actually went) next to the p99 itself
+    tracing.enable()
     place = _place(args)
     req_rows = 16
     with fluid.program_guard(fluid.Program(), fluid.Program()):
@@ -777,6 +781,7 @@ def bench_serving(args):
                 # requests, not the whole invocation's
                 eng.metrics = ServingMetrics(name="serving")
                 monitor.goodput_reset()
+                tracing.reset()
                 outstanding = collections.deque()
                 t0 = time.perf_counter()
                 for i in range(n_requests):
@@ -789,6 +794,8 @@ def bench_serving(args):
                     outstanding.popleft().result(300)
                 wall = time.perf_counter() - t0
                 summ = eng.metrics.summary()
+                trace_summ = tracing.breakdown_summary(
+                    tracing.assemble(tracing.spans()))
                 curve.append({
                     "slots": slots,
                     "throughput_rps": round(n_requests / wall, 2),
@@ -798,13 +805,18 @@ def bench_serving(args):
                     "mean_ms": summ["mean_ms"],
                     "batches": summ["counts"]["batches"],
                     "n_requests": n_requests,
+                    "request_trace": trace_summ,
+                    "p99_exemplars": summ.get("p99_exemplars"),
                     "goodput_view": summ["goodput_view"]})
             finally:
                 eng.close()
+    tracing.disable()
     bounded = [c for c in curve if c["p99_ms"] is not None
                and c["p99_ms"] <= p99_bound_ms]
     best = max(bounded or curve, key=lambda c: c["throughput_rps"])
     rps = best["throughput_rps"]
+    best_tr = best.get("request_trace") or {}
+    best_stages = best_tr.get("stages") or {}
     result = {"metric": "serving_requests_per_sec",
               "value": rps, "unit": "requests/sec",
               # acceptance ratio: >1.0 = beats 5x the sequential
@@ -821,6 +833,12 @@ def bench_serving(args):
               "baseline_bs16_rps": round(baseline_rps, 2),
               "baseline_bs16_latency_ms": round(base_lat * 1e3, 3),
               "n_requests": best.get("n_requests"),
+              # the best point's stage breakdown, indexed (non-gating)
+              # by bench_history: a p99 regression names its stage
+              "request_trace": best_tr,
+              "p99_queue_wait_ms": (best_stages.get("queue_wait")
+                                    or {}).get("p99_ms"),
+              "p99_exemplars": best.get("p99_exemplars"),
               # service seconds per admitted batch at the best point —
               # the cross-run step-time estimator for bench_history
               "min_step_s": round(
@@ -865,6 +883,7 @@ def bench_decode_paged(args):
     same rung on device."""
     import paddle_tpu as fluid
     from paddle_tpu import monitor
+    from paddle_tpu.monitor import tracing
     from paddle_tpu.serving.decoder import (build_decoder_lm,
                                             sync_draft_weights)
     from paddle_tpu.serving.engine import GenerationEngine
@@ -873,6 +892,10 @@ def bench_decode_paged(args):
         fluid.set_flags({"FLAGS_monitor": True})
     monitor.step_stats().reset()
     monitor.goodput_reset()
+    # per-request tracing on the paged + speculative arms: the artifact
+    # carries the decode-tick breakdown (and the spec_reject share)
+    # next to the token rates derived from the same windows
+    tracing.enable()
     place = _place(args)
     vocab, max_len, slots, page_size = 61, 64, 4, 8
     dims = dict(n_layer=2, n_head=2, d_model=32, d_inner=64)
@@ -888,12 +911,15 @@ def bench_decode_paged(args):
                for i in range(n_requests)]
 
     def drive(eng):
+        tracing.reset()
         t0 = time.perf_counter()
         outs = [r.result(600) for r in
                 [eng.submit(p) for p in prompts]]
         wall = time.perf_counter() - t0
         toks = sum(len(o["tokens"]) for o in outs)
         summ = eng.metrics.summary()
+        summ["request_trace"] = tracing.breakdown_summary(
+            tracing.assemble(tracing.spans()))
         return ([o["tokens"] for o in outs], round(toks / wall, 2),
                 wall, summ)
 
@@ -955,7 +981,10 @@ def bench_decode_paged(args):
     # argmax path; acceptance/rollback must not change that)
     spec_outputs_match = spec_toks == fixed_toks
 
+    tracing.disable()
     int8_match = sum(a == b for a, b in zip(paged_toks, fixed_toks))
+    paged_tr = paged_summ.get("request_trace") or {}
+    paged_stages = paged_tr.get("stages") or {}
     result = {"metric": "decode_sessions_at_fixed_hbm",
               "value": sessions_ratio, "unit": "x",
               # acceptance: >= 4x concurrent sessions at fixed HBM
@@ -981,6 +1010,15 @@ def bench_decode_paged(args):
               "kv_page_leaks": len(leaks),
               "n_requests": n_requests,
               "max_new_tokens": max_new,
+              # stage breakdown of the headline (paged int8) arm plus
+              # the speculative arm's (where spec_reject shows up);
+              # bench_history indexes the p99s as informational fields
+              "request_trace": paged_tr,
+              "request_trace_spec": spec_summ.get("request_trace"),
+              "p99_queue_wait_ms": (paged_stages.get("queue_wait")
+                                    or {}).get("p99_ms"),
+              "p99_decode_ms": (paged_stages.get("decode")
+                                or {}).get("p99_ms"),
               # seconds per decode step on the headline arm — the
               # cross-run estimator bench_history indexes
               "min_step_s": round(

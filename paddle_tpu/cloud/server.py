@@ -21,6 +21,7 @@ import socketserver
 import threading
 import time
 
+from ..monitor import tracing
 from .master import (AllTasksFailed, NoMoreAvailable, PassAfter,
                      PassBefore, Task)
 
@@ -59,6 +60,30 @@ def _jsonable(result):
     return to_dict() if callable(to_dict) else result
 
 
+# per-method RPC latency histograms (``rpc/<method>_seconds``), handles
+# cached against the registry generation like every monitor producer —
+# a cluster reconnect storm shows up in the same exposition as the
+# requests it delays
+_rpc_hists = {}
+_rpc_gen = [-1]
+
+
+def _observe_rpc(method, seconds):
+    from .. import monitor
+
+    if not monitor.enabled():
+        return
+    reg = monitor.registry()
+    if _rpc_gen[0] != reg.generation:
+        _rpc_hists.clear()
+        _rpc_gen[0] = reg.generation
+    h = _rpc_hists.get(method)
+    if h is None:
+        h = _rpc_hists[method] = reg.histogram(
+            "rpc/%s_seconds" % method)
+    h.observe(seconds)
+
+
 class _Handler(socketserver.StreamRequestHandler):
     def handle(self):
         methods = self.server.methods
@@ -66,10 +91,16 @@ class _Handler(socketserver.StreamRequestHandler):
             line = self.rfile.readline()
             if not line:
                 return
+            span = None
             try:
                 req = json.loads(line.decode("utf-8"))
                 method = req["method"]
                 args = req.get("args", [])
+                # the envelope's trace context makes the server-side
+                # span a CHILD of the caller's rpc span: this process's
+                # JSONL joins the caller's tree at assembly time
+                if tracing.enabled() and req.get("trace"):
+                    span = tracing.server_span(method, req["trace"])
                 if method == "ping":
                     resp = {"ok": True, "result": "pong"}
                 elif method in methods:
@@ -84,6 +115,8 @@ class _Handler(socketserver.StreamRequestHandler):
             except Exception as e:  # noqa: BLE001 — marshalled to client
                 resp = {"ok": False, "error": "RuntimeError",
                         "message": f"{type(e).__name__}: {e}"}
+            if span is not None:
+                span.finish("ok" if resp.get("ok") else "error")
             self.wfile.write(json.dumps(resp).encode("utf-8") + b"\n")
             self.wfile.flush()
 
@@ -156,6 +189,13 @@ class MasterClient:
     def _call(self, method, *args):
         from .. import monitor
 
+        endpoint = "%s:%d" % self._addr
+        # client-leg rpc span: parents to the thread's current span
+        # (barrier/heartbeat sessions) and rides the envelope so the
+        # server's span joins the same tree
+        span = (tracing.client_span(method, endpoint)
+                if tracing.enabled() else None)
+        t0 = time.perf_counter()
         with self._mu:
             last_err = None
             delay = self._retry
@@ -166,8 +206,10 @@ class MasterClient:
                         if attempt > 0:
                             monitor.count("master/reconnects")
                         self._connect()
-                    payload = json.dumps(
-                        {"method": method, "args": list(args)})
+                    envelope = {"method": method, "args": list(args)}
+                    if span is not None:
+                        envelope["trace"] = span.context()
+                    payload = json.dumps(envelope)
                     self._file.write(payload.encode("utf-8") + b"\n")
                     self._file.flush()
                     line = self._file.readline()
@@ -175,15 +217,31 @@ class MasterClient:
                         raise ConnectionError("master closed connection")
                     resp = json.loads(line.decode("utf-8"))
                     if resp["ok"]:
+                        if span is not None:
+                            span.finish("ok", attempts=attempt + 1)
+                        _observe_rpc(method, time.perf_counter() - t0)
                         return resp["result"]
                     exc = _ERRORS.get(resp["error"], RuntimeError)
-                    raise exc(resp.get("message", ""))
+                    err = exc(resp.get("message", ""))
+                    if span is not None:
+                        span.finish("error", attempts=attempt + 1,
+                                    error=type(err).__name__)
+                    raise err
                 except (OSError, ConnectionError, json.JSONDecodeError) \
                         as e:
                     last_err = e
                     self.close()
                     if attempt == self._max_retries - 1:
                         break       # budget spent: no trailing sleep
+                    if span is not None:
+                        # one marker per reconnect attempt: a storm is
+                        # visible in the same JSONL as the requests and
+                        # barriers it delays
+                        span.event("rpc_retry", status="error",
+                                   attrs={"method": method,
+                                          "endpoint": endpoint,
+                                          "attempt": attempt + 1,
+                                          "backoff_s": round(delay, 3)})
                     # full-jitter exponential backoff: sleep in
                     # [delay, delay*(1+jitter)], then double toward the
                     # cap — decorrelates a herd of reconnecting trainers
@@ -191,6 +249,9 @@ class MasterClient:
                                         * self._jitter))
                     slept += delay
                     delay = min(delay * 2.0, self._max_retry_interval)
+            if span is not None:
+                span.finish("error", attempts=self._max_retries,
+                            error="unreachable")
             raise ConnectionError(
                 "master at %s:%d unreachable after %d attempts (~%.1fs "
                 "of backoff); last error: %r — check the master "

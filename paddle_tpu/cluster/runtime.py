@@ -23,6 +23,8 @@ JSONL records so cluster-level post-mortems correlate across host logs.
 import threading
 import time
 
+from ..monitor import tracing
+
 __all__ = ["ClusterMember", "ClusterTimeout",
            "local_member", "local_context", "set_local_member"]
 
@@ -70,7 +72,18 @@ class ClusterMember:
         self._mu = threading.Lock()
         self._closed = False
         self._expelled = False
-        view = self._t.call("join", self.host_id, dict(meta or {}))
+        # the membership session's trace root: barrier/heartbeat spans
+        # (and the rpc spans nested under them) all join this trace, so
+        # a cross-host post-mortem assembles one tree per session.  The
+        # open-anchor is emitted NOW — a killed host leaves a rooted
+        # tree behind, not orphan spans.
+        self._trace = (tracing.Span("cluster_session",
+                                    attrs={"host_id": self.host_id})
+                       if tracing.enabled() else None)
+        if self._trace is not None:
+            self._trace.emit_open()
+        with tracing.use_span(self._trace):
+            view = self._t.call("join", self.host_id, dict(meta or {}))
         self._epoch = int(view["epoch"])
         # the epoch of the world this host has BUILT (mesh, executors).
         # Distinct from _epoch (latest observed): the daemon heartbeat
@@ -140,7 +153,9 @@ class ClusterMember:
         """Renew the lease; returns the view (absorbing it).  A
         ``rejoin`` response latches ``expelled`` instead of being
         silently absorbed."""
-        view = self._t.call("heartbeat", self.host_id, step)
+        with tracing.span("cluster/heartbeat", parent=self._trace,
+                          attrs={"host_id": self.host_id}):
+            view = self._t.call("heartbeat", self.host_id, step)
         if view.get("rejoin"):
             self._expelled = True
         self._absorb(view)
@@ -170,25 +185,33 @@ class ClusterMember:
         (None = poll forever)."""
         deadline = None if timeout is None else \
             time.monotonic() + float(timeout)
-        while True:
-            # present the WORLD epoch, not the latest observed one: an
-            # epoch change first noticed by the heartbeat thread must
-            # still surface here as "reshape" (see _world_epoch)
-            res = self._t.call("enter_step", self.host_id, int(step),
-                               self._world_epoch)
-            action = res.get("action")
-            if action == "reshape":
-                if res.get("rejoin"):
-                    self._expelled = True
-                self._absorb(res)
-                return res
-            if action in ("go", "command"):
-                return res
-            if deadline is not None and time.monotonic() > deadline:
-                raise ClusterTimeout(
-                    "member %s: no barrier decision for step %d within "
-                    "%.1fs" % (self.host_id, step, timeout))
-            time.sleep(self._poll)
+        # one barrier span covers the WHOLE poll (every enter_step rpc
+        # nests under it): the span's duration IS the barrier wait
+        with tracing.span("cluster/barrier", parent=self._trace,
+                          attrs={"step": int(step),
+                                 "epoch": self._world_epoch}) as bs:
+            polls = 0
+            while True:
+                # present the WORLD epoch, not the latest observed one:
+                # an epoch change first noticed by the heartbeat thread
+                # must still surface here as "reshape" (_world_epoch)
+                res = self._t.call("enter_step", self.host_id,
+                                   int(step), self._world_epoch)
+                polls += 1
+                action = res.get("action")
+                if action in ("reshape", "go", "command"):
+                    if bs is not None:
+                        bs.attrs.update(action=action, polls=polls)
+                    if action == "reshape":
+                        if res.get("rejoin"):
+                            self._expelled = True
+                        self._absorb(res)
+                    return res
+                if deadline is not None and time.monotonic() > deadline:
+                    raise ClusterTimeout(
+                        "member %s: no barrier decision for step %d "
+                        "within %.1fs" % (self.host_id, step, timeout))
+                time.sleep(self._poll)
 
     # -- arbitration ----------------------------------------------------
     def propose_verdict(self, step, kind, reason, quarantined=False):
@@ -227,6 +250,10 @@ class ClusterMember:
         if self._closed:
             return
         self._closed = True
+        if self._trace is not None:
+            # terminal re-emit of the open-anchored session root:
+            # assembly prefers it, a SIGKILLed host keeps the anchor
+            self._trace.finish("ok", epoch=self._epoch)
         self._hb_stop.set()
         if self._hb_thread is not None:
             self._hb_thread.join(timeout=5)

@@ -223,6 +223,21 @@ register_flag("monitor_console_seconds", 0.0, float, _on_monitor_change)
 # dump queue states + heartbeats + last span to stderr and the event log
 # (0 = watchdog off)
 register_flag("monitor_stall_seconds", 120.0, float, _on_monitor_change)
+
+
+def _on_trace_change(_val):
+    from .monitor import tracing
+
+    tracing._reconcile()
+
+
+# per-request distributed tracing (monitor/tracing.py): span trees over
+# the serving lifecycle + cluster RPC.  Independent of FLAGS_monitor —
+# spans always land in the in-process buffer; a JSONL twin is written
+# whenever FLAGS_monitor_log_dir is also set.
+register_flag("trace", False, bool, _on_trace_change)
+
+
 def _on_preflight_oom(val):
     # validate at set time: a typo ("stric") silently downgrading the
     # hard-fail mode to a warning would defeat the operator's intent

@@ -48,7 +48,7 @@ __all__ = [
     "last_span", "queue_states", "track", "log_event", "count", "run_id",
     "sample_device_gauges", "add_stall_listener", "remove_stall_listener",
     "goodput_ledger", "goodput_summary", "goodput_stamp",
-    "goodput_reset",
+    "goodput_reset", "tracing",
 ]
 
 # fast-path gate: a module-global bool read (no lock, no flag lookup) is
@@ -600,7 +600,14 @@ def _device_state(device):
 # ---------------------------------------------------------------------------
 
 def _stall_probe():
-    return {"queues": queue_states(),
+    qs = queue_states()
+    return {"queues": qs,
+            # the in-flight serving requests (trace_id, age, state) next
+            # to the suspect program: a serving stall postmortem starts
+            # from the stuck REQUEST, not just the stuck program
+            "serving_requests": [r for s in qs
+                                 if s.get("kind") == "serving_engine"
+                                 for r in s.get("requests", [])],
             "last_span": _last_span,
             "last_step": _aggregator.last(),
             "compile_cache": _import_cc_stats(),
@@ -666,7 +673,13 @@ def _stall_sink(diag):
 def _format_diag(diag):
     lines = []
     for q in diag.get("queues", []):
+        if q.get("kind") == "serving_engine":
+            continue            # rendered per-request below
         lines.append("  queue %s" % q)
+    for r in diag.get("serving_requests", []):
+        lines.append("  request %-12s %-8s age %8.1fs trace %s" % (
+            r.get("id"), r.get("state"), r.get("age_s") or 0.0,
+            r.get("trace_id") or "-"))
     for n, age in diag.get("heartbeat_age_s", {}).items():
         lines.append("  heartbeat %-30s %8.1fs ago" % (n, age))
     if diag.get("last_span"):
@@ -687,3 +700,6 @@ def _format_diag(diag):
 # nothing at its import time, and _reconcile/_stall_probe reference the
 # module as an attribute at call time
 from . import program_profile  # noqa: E402
+# request tracing (ISSUE 17): reachable as monitor.tracing; its _emit
+# imports run_id/log_event lazily, so order here is unconstrained
+from . import tracing  # noqa: E402

@@ -174,8 +174,17 @@ def export_chrome_tracing(path):
         # so a shipped trace carries its own goodput summary alongside
         # the spans it was derived from
         trace_meta["goodput"] = gp.summary()
-    payload = {"traceEvents": meta + events, "displayTimeUnit": "ms",
-               "metadata": trace_meta}
+    # request lanes (ISSUE 17): buffered trace spans render one lane
+    # per request under a 'serving requests' process group — same
+    # perf_counter timebase as the host spans, so the exported file
+    # opens in Perfetto with requests aligned against the dispatches
+    # that served them
+    try:
+        tr_events, tr_meta = monitor.tracing.chrome_events()
+    except Exception:  # noqa: BLE001 — export never fails on telemetry
+        tr_events, tr_meta = [], []
+    payload = {"traceEvents": meta + tr_meta + events + tr_events,
+               "displayTimeUnit": "ms", "metadata": trace_meta}
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

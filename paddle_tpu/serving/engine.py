@@ -31,6 +31,7 @@ import numpy as np
 
 from .. import io as fluid_io
 from ..executor import CPUPlace, Executor, TPUPlace
+from ..monitor import tracing
 from ..profiler import RecordEvent
 from ..scope import Scope, scope_guard
 from .kv_cache import OutOfPagesError
@@ -94,6 +95,36 @@ class _EngineBase:
     def __init__(self):
         self._thread = None
         self._stop = threading.Event()
+
+    def _register_monitor(self):
+        """Track the engine for watchdog dumps (weakly held): a stall
+        report names the in-flight requests, not just the program."""
+        from .. import monitor
+
+        monitor.track(self)
+
+    def _running_state(self, slot):
+        return "prefill"
+
+    def monitor_state(self):
+        """The watchdog's in-flight request view: every queued/running
+        request with its trace_id, age, and lifecycle state."""
+        now = self._sched._clock()
+        reqs = []
+        for r in self._sched.pending():
+            reqs.append({"id": r.id,
+                         "trace_id": r.trace.trace_id
+                         if r.trace is not None else None,
+                         "state": "queued",
+                         "age_s": round(now - r.arrival, 3)})
+        for slot, r in sorted(self._sched.running().items()):
+            reqs.append({"id": r.id,
+                         "trace_id": r.trace.trace_id
+                         if r.trace is not None else None,
+                         "state": self._running_state(slot),
+                         "age_s": round(now - r.arrival, 3)})
+        return {"kind": "serving_engine", "name": self.metrics.name,
+                "requests": reqs}
 
     def start(self):
         if self._thread is None:
@@ -215,9 +246,11 @@ class InferenceEngine(_EngineBase):
         if self._seq_feeds and not bucket_bounds:
             bucket_bounds = [2 ** i for i in range(3, 11)]
         self._sched = ContinuousBatchingScheduler(
-            self.slots, bucket_bounds, default_timeout_s=timeout_s)
+            self.slots, bucket_bounds, default_timeout_s=timeout_s,
+            trace_kind="infer")
         self.metrics = ServingMetrics(name=name,
                                       quarantine_dir=quarantine_dir)
+        self._register_monitor()
         if start:
             self.start()
 
@@ -288,6 +321,10 @@ class InferenceEngine(_EngineBase):
         n_rows = sum(r.rows for r in reqs)
         self.metrics.note_admit(plan, n_rows / float(self.slots),
                                 self._sched.queue_depth())
+        traced = [r for r in reqs if r.trace is not None]
+        for r in traced:
+            r.trace.admitted(plan.bucket, self._sched.queue_depth(),
+                             r is not reqs[0])
         feed = {}
         for name in self._feed_names:
             if name.endswith("@LEN"):
@@ -311,12 +348,19 @@ class InferenceEngine(_EngineBase):
                 batch = np.concatenate(
                     [batch, np.repeat(batch[:1], self.slots - n_rows, 0)])
             feed[name] = batch
+        t0 = tracing.now_us() if traced else 0.0
         with RecordEvent("serving/batch",
                          args={"batch": len(reqs), "rows": n_rows,
                                "bucket": plan.bucket}):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetch_vars,
                                  scope=self._scope)
+        if traced:
+            dur = tracing.now_us() - t0
+            for r in traced:
+                r.trace.note_batch(
+                    t0, dur, r.slot, len(reqs), plan.bucket,
+                    (plan.bucket - r.length) if plan.bucket else 0)
         outs = [np.asarray(o) for o in outs]
         off = 0
         for req in reqs:
@@ -440,12 +484,18 @@ class GenerationEngine(_EngineBase):
                         % (b, ps))
         self._sched = ContinuousBatchingScheduler(
             spec.slots, bucket_bounds, default_timeout_s=timeout_s,
-            admission_gate=self._page_gate if self.paged else None)
+            admission_gate=self._page_gate if self.paged else None,
+            trace_kind="generate")
         self.metrics = ServingMetrics(name=name,
                                       quarantine_dir=quarantine_dir)
         self._active = {}             # slot -> decode state dict
+        self._ticks = 0               # decode ticks served (trace attr)
+        self._register_monitor()
         if start:
             self.start()
+
+    def _running_state(self, slot):
+        return "decode" if slot in self._active else "prefill"
 
     # -- paged-KV bookkeeping ------------------------------------------
     def _page_gate(self, req, picked):
@@ -459,7 +509,12 @@ class GenerationEngine(_EngineBase):
             for r in picked)
         need = self._alloc.pages_needed(len(req.payload["prompt"]),
                                         req.payload["max_new"])
-        return need <= self._alloc.free_pages() - reserved
+        ok = need <= self._alloc.free_pages() - reserved
+        if not ok and req.trace is not None:
+            # exhaustion back-pressure: the page_wait span opens at the
+            # FIRST refusal and closes at the eventual grant
+            req.trace.page_refused()
+        return ok
 
     def _free_pages(self, slot):
         """Release every page ref a slot holds — called on EVERY
@@ -539,6 +594,12 @@ class GenerationEngine(_EngineBase):
     def _prefill(self, plan):
         spec = self.spec
         reqs = plan.requests
+        head = reqs[0]
+        for r in reqs:
+            if r.trace is not None:
+                r.trace.admitted(plan.bucket,
+                                 self._sched.queue_depth(),
+                                 r is not head)
         if self.paged:
             # page allocation pre-pass: aliases shared prefix pages,
             # takes fresh ones for the rest.  The admission gate sized
@@ -558,6 +619,10 @@ class GenerationEngine(_EngineBase):
                 self._table[r.slot, :len(pages)] = pages
                 full = len(r.payload["prompt"]) // spec.cache.page_size
                 self.metrics.note_prefix_cache(shared, full - shared)
+                if r.trace is not None:
+                    r.trace.pages_granted(len(pages), shared,
+                                          self._alloc.pages_in_use(),
+                                          self._alloc.free_pages())
                 kept.append(r)
             self.metrics.note_kv_pages(self._alloc.pages_in_use(),
                                        self._alloc.free_pages())
@@ -585,6 +650,8 @@ class GenerationEngine(_EngineBase):
                 "wpos": np.zeros((p,), "int32")}
         if self.paged:
             feed["page_table"] = self._table
+        traced = [r for r in reqs if r.trace is not None]
+        pt0 = tracing.now_us() if traced else 0.0
         with RecordEvent("serving/prefill",
                          args={"batch": n, "bucket": t}):
             (logits,) = self._exe_prefill.run(
@@ -599,6 +666,11 @@ class GenerationEngine(_EngineBase):
                     self.draft_spec.prefill_program, feed=dfeed,
                     fetch_list=[self.draft_spec.prefill_logits],
                     scope=self._scope)
+        if traced:
+            pdur = tracing.now_us() - pt0
+            for r in traced:
+                r.trace.note_prefill(pt0, pdur, r.slot, n, t,
+                                     t - len(r.payload["prompt"]))
         logits = np.asarray(logits)
         for i, r in enumerate(reqs):
             row = logits[i, int(lens[i]) - 1]
@@ -632,12 +704,25 @@ class GenerationEngine(_EngineBase):
         feed = {"tok": tok, "pos": pos, "wpos": wpos, "cache_len": clen}
         if self.paged:
             feed["page_table"] = self._table
+        traced = any(st["req"].trace is not None
+                     for st in self._active.values())
+        t0 = tracing.now_us() if traced else 0.0
         with RecordEvent("serving/decode_step",
                          args={"active": len(self._active)}):
             (logits,) = self._exe_decode.run(
                 spec.decode_program, feed=feed,
                 fetch_list=[spec.decode_logits], scope=self._scope)
         logits = np.asarray(logits)
+        self._ticks += 1
+        if traced:
+            # every rider pays (and is attributed) the full tick: the
+            # batch is one dispatch, each request was waiting on it
+            dur = tracing.now_us() - t0
+            for slot, st in self._active.items():
+                if st["req"].trace is not None:
+                    st["req"].trace.note_decode(t0, dur, slot,
+                                                self._ticks,
+                                                len(self._active))
         self.metrics.note_decode_step(len(self._active),
                                       self._sched.occupancy())
         for slot in list(self._active):
@@ -680,6 +765,9 @@ class GenerationEngine(_EngineBase):
         toks = np.zeros((s, k), "int64")
         toks[:, 0] = last
         cur = last.copy()
+        traced = any(st["req"].trace is not None
+                     for st in self._active.values())
+        t0 = tracing.now_us() if traced else 0.0
         with RecordEvent("serving/speculative_step",
                          args={"active": len(self._active), "k": k}):
             for j in range(k - 1):
@@ -705,6 +793,9 @@ class GenerationEngine(_EngineBase):
                 fetch_list=[spec.verify_logits], scope=self._scope)
         vl = np.asarray(vl)                       # [s, k, V]
         greedy = vl.argmax(-1)                    # [s, k]
+        self._ticks += 1
+        dur = (tracing.now_us() - t0) if traced else 0.0
+        n_active = len(self._active)
         self.metrics.note_decode_step(len(self._active),
                                       self._sched.occupancy())
         for slot in list(self._active):
@@ -720,6 +811,11 @@ class GenerationEngine(_EngineBase):
                     int(greedy[slot, accepted]):
                 accepted += 1
             self.metrics.note_speculation(accepted, k - 1)
+            if st["req"].trace is not None:
+                st["req"].trace.note_decode(t0, dur, slot, self._ticks,
+                                            n_active,
+                                            spec_accepted=accepted,
+                                            spec_proposed=k - 1)
             emitted = [int(toks[slot, j + 1]) for j in range(accepted)]
             emitted.append(int(greedy[slot, accepted]))
             for j, t in enumerate(emitted):

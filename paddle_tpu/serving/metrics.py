@@ -58,6 +58,10 @@ class ServingMetrics:
         self.quarantine_dir = quarantine_dir
         self._mu = threading.Lock()
         self._lat = []             # latency seconds, bounded WINDOW
+        # (latency, trace_id) pairs riding the same window: the p99
+        # exemplars — "why is p99 high" resolves to concrete trace_ids
+        # whose assembled trees show where the time went
+        self._exemplars = []
         self._first_ts = None
         self._last_ts = None
         self._counts = {"submitted": 0, "completed": 0, "failed": 0,
@@ -176,10 +180,14 @@ class ServingMetrics:
         # scheduler-clock stamps, so the difference is wall seconds
         lat = ((req.finished_at - req.arrival)
                if req.finished_at is not None and req.arrival else 0.0)
+        trace = getattr(req, "trace", None)
+        tid = trace.trace_id if trace is not None else None
         self._count("completed", "completed_total")
         with self._mu:
             self._lat.append(lat)
             del self._lat[:-self.WINDOW]
+            self._exemplars.append((lat, tid))
+            del self._exemplars[:-self.WINDOW]
             self._last_ts = now
         reg = self._reg()
         if reg is not None:
@@ -190,9 +198,15 @@ class ServingMetrics:
                "queue_ms": round(queue_s * 1e3, 3),
                "bucket": req.bucket, "slot": req.slot,
                "length": req.length}
+        if tid is not None:
+            rec["trace_id"] = tid
         if extra:
             rec.update(extra)
         self._event(rec)
+        # the terminal is the ONE place every engine path funnels
+        # through, so the request's root span closes here (idempotent)
+        if trace is not None:
+            trace.finish("ok", latency_ms=rec["latency_ms"])
 
     def note_failure(self, req, error, status="failed"):
         # quarantined requests are counted by quarantine() itself (the
@@ -204,9 +218,15 @@ class ServingMetrics:
             key = status if status in self._counts else "failed"
             self._count(key, "timeout_total" if key == "expired"
                         else "%s_total" % key)
-        self._event({"event": "serving_request", "request_id": req.id,
-                     "status": status, "error": str(error)[:200],
-                     "bucket": req.bucket, "length": req.length})
+        trace = getattr(req, "trace", None)
+        rec = {"event": "serving_request", "request_id": req.id,
+               "status": status, "error": str(error)[:200],
+               "bucket": req.bucket, "length": req.length}
+        if trace is not None:
+            rec["trace_id"] = trace.trace_id
+        self._event(rec)
+        if trace is not None:
+            trace.finish(status, error=str(error)[:120])
 
     # -- poison quarantine (guardian-style request health) -------------
     def quarantine(self, req, feed=None, reason="non-finite output"):
@@ -259,6 +279,16 @@ class ServingMetrics:
                 "mean_s": (sum(vals) / len(vals)) if vals else None,
                 "n": len(vals)}
 
+    def p99_exemplars(self, k=5):
+        """The trace_ids of the slowest traced requests in the current
+        latency window, slowest first — p99 attribution: each id
+        resolves to an assembled span tree (tools/request_trace.py)
+        showing where that request's time went."""
+        with self._mu:
+            pairs = [p for p in self._exemplars if p[1] is not None]
+        pairs.sort(key=lambda p: -p[0])
+        return [tid for _lat, tid in pairs[:max(1, int(k))]]
+
     def summary(self):
         """Counts, exact latency percentiles, observed throughput, and
         the serving goodput view (chip-utilization-per-request riding
@@ -284,4 +314,5 @@ class ServingMetrics:
             view["compute_seconds_per_request"] = round(
                 view["compute_seconds"] / counts["completed"], 6)
         out["goodput_view"] = view
+        out["p99_exemplars"] = self.p99_exemplars()
         return out

@@ -25,6 +25,8 @@ import itertools
 import threading
 import time
 
+from ..monitor import tracing
+
 __all__ = [
     "ServingRequest", "BatchPlan", "ContinuousBatchingScheduler",
     "RequestTimeoutError", "PoisonedRequestError", "EngineClosedError",
@@ -77,6 +79,10 @@ class ServingRequest:
         self.admitted_at = None
         self.finished_at = None
         self.bucket = None
+        # per-request trace context (monitor/tracing.RequestTrace) hung
+        # here by the engine's submit when FLAGS_trace is on; None means
+        # every downstream site skips tracing without calling into it
+        self.trace = None
         self._result = None
         self._error = None
         self._done = threading.Event()
@@ -138,10 +144,13 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, slots, bucket_bounds=None, clock=time.monotonic,
                  default_timeout_s=None, max_queue=4096,
-                 admission_gate=None):
+                 admission_gate=None, trace_kind="request"):
         if slots < 1:
             raise ValueError("need at least one slot")
         self.slots = int(slots)
+        # the `kind` attr stamped on request trace roots (the engines
+        # pass "infer"/"generate")
+        self.trace_kind = str(trace_kind)
         self.bucket_bounds = (sorted(int(b) for b in bucket_bounds)
                               if bucket_bounds else None)
         self._clock = clock
@@ -190,6 +199,13 @@ class ContinuousBatchingScheduler:
             deadline=(now + timeout_s) if timeout_s is not None else None,
             rows=rows)
         req.bucket = self.bucket_for(req.length)   # validates length
+        # the trace attaches BEFORE the request becomes visible to the
+        # admission loop: an admit racing this submit must already see
+        # req.trace, or its queue_wait/dispatch spans are silently lost
+        if tracing.enabled():
+            req.trace = tracing.RequestTrace(
+                req.id, kind=self.trace_kind, length=req.length,
+                rows=req.rows)
         with self._cv:
             if self._closed:
                 raise EngineClosedError("scheduler is closed")
@@ -348,3 +364,9 @@ class ContinuousBatchingScheduler:
     def running(self):
         with self._cv:
             return dict(self._running)
+
+    def pending(self):
+        """Snapshot of the queued (not yet admitted) requests, FIFO
+        order — the watchdog's in-flight request dump reads this."""
+        with self._cv:
+            return list(self._queue)

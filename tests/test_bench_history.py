@@ -156,6 +156,37 @@ def test_informational_and_error_rungs_do_not_gate(tmp_path):
     assert not any(c["metric"] == "broken_error" for c in comps)
 
 
+def test_trace_stage_fields_index_without_gating(tmp_path):
+    """ISSUE 17: p99_queue_wait_ms / p99_decode_ms are indexed and
+    judged against history, but NEVER gate — even inside a gating
+    (non-informational) rung, a 10x stage regression stays
+    informational while a real p99_ms regression still gates."""
+    assert "p99_queue_wait_ms" in bench_history.INFORMATIONAL_FIELDS
+    assert "p99_decode_ms" in bench_history.INFORMATIONAL_FIELDS
+    base = _rung("serving_requests_per_sec", 100.0, step_s=0.1,
+                 p99_ms=20.0, p99_queue_wait_ms=5.0, p99_decode_ms=2.0)
+    worse = dict(base, p99_queue_wait_ms=50.0, p99_decode_ms=20.0)
+    runs = [bench_history.load_artifact(
+        _write(tmp_path, "t%d.json" % i, _wrapper(i + 1, r)), i)
+        for i, r in enumerate((base, worse))]
+    report = bench_history.compare(runs, noise=0.05)
+    comps = report["runs"][1]["comparisons"]
+    # both stage fields are indexed, judged REGRESSED, and marked
+    # informational despite riding a gating rung
+    for f in ("p99_queue_wait_ms", "p99_decode_ms"):
+        c = next(c for c in comps if c["field"] == f)
+        assert c["verdict"] == "REGRESSED" and c["informational"], c
+    assert report["runs"][1]["verdict"] == "PASS"
+    assert report["overall"] == "PASS"
+    # control: the same delta on p99_ms itself DOES gate
+    gated = dict(base, p99_ms=200.0)
+    runs = [bench_history.load_artifact(
+        _write(tmp_path, "g%d.json" % i, _wrapper(i + 1, r)), i)
+        for i, r in enumerate((base, gated))]
+    assert bench_history.compare(
+        runs, noise=0.05)["runs"][1]["verdict"] == "REGRESSED"
+
+
 def test_bare_schema_v2_artifact_ingests_with_goodput(tmp_path):
     """A fresh bench.py artifact (bare JSON line, schema_version 2,
     run_id, embedded goodput) ingests as a comparable run keyed after

@@ -1,0 +1,481 @@
+"""Per-request distributed tracing (ISSUE 17): span trees across
+serving admission, prefill/decode, and cluster RPC.
+
+Tier-1 coverage: pure assembly/breakdown units, the flag gate, the
+disabled-is-free raising-monkeypatch A/B (plus zero extra warm-path
+lowerings in both arms), a traced InferenceEngine end to end (complete
+trees, breakdown sums, p99 exemplars, slot-recycling hygiene), RPC
+span propagation over a real TCP MasterServer (including reconnect
+``rpc_retry`` markers and the per-method latency histogram), cluster
+membership-session spans, the watchdog's in-flight request dump, and
+chrome-trace request lanes.  The GenerationEngine end-to-end trees
+(prefill/decode/page spans, expiry terminals) are slow-marked like
+every decoder-LM test."""
+
+import json
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as fluid                                  # noqa: E402
+from paddle_tpu import monitor, profiler                    # noqa: E402
+from paddle_tpu.cloud.server import MasterClient, MasterServer  # noqa: E402
+from paddle_tpu.cluster.membership import ClusterMaster     # noqa: E402
+from paddle_tpu.cluster.runtime import ClusterMember        # noqa: E402
+from paddle_tpu.monitor import tracing                      # noqa: E402
+from paddle_tpu.serving import InferenceEngine              # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def tracing_off_after():
+    """Every test leaves tracing disabled and both the span buffer and
+    the monitor state empty — telemetry never leaks across modules."""
+    tracing.reset()
+    yield
+    tracing.disable()
+    tracing.reset()
+    monitor.disable()
+    monitor.registry().reset()
+    monitor.step_stats().reset()
+
+
+@pytest.fixture
+def saved_mlp(tmp_path):
+    fluid.default_startup_program().random_seed = 7
+    x = fluid.layers.data("x", shape=[6])
+    h = fluid.layers.fc(x, size=8, act="relu")
+    pred = fluid.layers.fc(h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(fluid.default_startup_program())
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["x"],
+                                      [pred], exe)
+    return str(tmp_path / "m")
+
+
+def _drive(eng, n, rows=1):
+    rng = np.random.RandomState(0)
+    reqs = [eng.submit({"x": rng.rand(rows, 6).astype("float32")},
+                       rows=rows)
+            for _ in range(n)]
+    for r in reqs:
+        r.result(timeout=120)
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# flag gate + pure units
+# ---------------------------------------------------------------------------
+
+def test_flag_flips_module_bool():
+    assert not tracing.enabled()
+    fluid.set_flags({"FLAGS_trace": True})
+    assert tracing.enabled()
+    fluid.set_flags({"FLAGS_trace": False})
+    assert not tracing.enabled()
+    tracing.enable()
+    assert tracing.enabled()
+    tracing.disable()
+    assert not tracing.enabled()
+
+
+def test_assemble_dedup_and_completeness():
+    tracing.enable()
+    s = tracing.Span("cluster_session", attrs={"host_id": "h"})
+    s.emit_open()                      # open anchor
+    with tracing.span("cluster/heartbeat", parent=s):
+        pass
+    # before the terminal re-emit: rooted but not complete
+    trees = tracing.assemble(tracing.spans())
+    t = trees[s.trace_id]
+    assert t["root"]["status"] == "open" and not t["complete"]
+    s.finish("ok")
+    trees = tracing.assemble(tracing.spans())
+    t = trees[s.trace_id]
+    # terminal record replaced the open anchor (dedup by span_id)
+    assert len(t["spans"]) == 2
+    assert t["root"]["status"] == "ok" and t["complete"]
+    # a dangling parent link breaks completeness
+    orphan = dict(t["spans"][0], span_id="zz", parent_id="missing")
+    trees = tracing.assemble(tracing.spans() + [orphan])
+    assert not trees[s.trace_id]["complete"]
+
+
+def test_breakdown_attribution_model():
+    """padding = pad share of the dispatch; spec_reject = rejected
+    draft share of the verify window; stages sum to root latency."""
+    tracing.enable()
+    rt = tracing.RequestTrace("req-x", kind="generate", length=12)
+    t0 = tracing.now_us()
+    rt.admitted(16, 3, False)
+    rt.note_prefill(t0, 8000.0, 0, 2, 16, 4)      # 8ms, 4/16 padding
+    rt.note_decode(t0, 4000.0, 0, 1, 2,
+                   spec_accepted=2, spec_proposed=3)   # 1 of 3 rejected
+    rt.finish("ok")
+    tree = tracing.assemble(tracing.spans())[rt.trace_id]
+    assert tree["complete"]
+    bd = tracing.breakdown(tree)
+    st = bd["stages"]
+    assert st["padding"] == pytest.approx(8.0 * 4 / 16)
+    assert st["prefill"] == pytest.approx(8.0 - st["padding"])
+    assert st["spec_reject"] == pytest.approx(4.0 * 1 / 4)
+    assert st["decode"] == pytest.approx(4.0 - st["spec_reject"])
+    # the synthetic children overrun the (instant) root, so the
+    # unattributed remainder clamps to zero rather than going negative
+    assert st["other"] == 0.0
+    assert bd["attributed_ms"] == pytest.approx(
+        sum(v for k, v in st.items() if k != "other"))
+    summ = tracing.breakdown_summary({rt.trace_id: tree})
+    assert summ["complete"] == 1 and summ["complete_fraction"] == 1.0
+    assert "spec_reject" in tracing.render_table(summ)
+
+
+def test_pre_admission_failure_closes_queue_wait():
+    tracing.enable()
+    rt = tracing.RequestTrace("req-y", kind="infer", length=1)
+    rt.finish("expired", error="timed out")
+    tree = tracing.assemble(tracing.spans())[rt.trace_id]
+    assert tree["complete"] and tree["root"]["status"] == "expired"
+    names = {s["name"]: s for s in tree["spans"]}
+    assert names["queue_wait"]["status"] == "expired"
+    rt.finish("ok")                    # terminal is idempotent
+    tree = tracing.assemble(tracing.spans())[rt.trace_id]
+    assert tree["root"]["status"] == "expired"
+
+
+# ---------------------------------------------------------------------------
+# disabled is free (the goodput precedent: raising monkeypatch A/B)
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_performs_zero_tracing_calls(saved_mlp,
+                                                   monkeypatch):
+    """With FLAGS_trace off, the serving path must never reach a
+    tracing call: every producer site is gated on ``enabled()`` or the
+    ``req.trace is None`` it decided.  The monkeypatch raises from the
+    emit path AND both span constructors, so any ungated call fails
+    the request loudly."""
+    def boom(*a, **k):
+        raise AssertionError("tracing call on the disabled path")
+
+    monkeypatch.setattr(tracing, "_emit", boom)
+    monkeypatch.setattr(tracing.Span, "__init__", boom)
+    monkeypatch.setattr(tracing.RequestTrace, "__init__", boom)
+    assert not tracing.enabled()
+    eng = InferenceEngine(model_dir=saved_mlp, slots=2, timeout_s=60.0)
+    try:
+        reqs = _drive(eng, 4)
+        assert all(r.trace is None for r in reqs)
+        assert eng.metrics.summary()["counts"]["completed"] == 4
+        assert eng.metrics.p99_exemplars() == []
+    finally:
+        eng.close()
+    assert tracing.spans() == []
+
+
+def test_no_extra_lowerings_in_either_arm(saved_mlp):
+    """Tracing must not perturb the compiled signature set: the warm
+    engine serves traced and untraced windows through the same cached
+    executables (zero extra warm-path lowerings in both arms)."""
+    eng = InferenceEngine(model_dir=saved_mlp, slots=2, timeout_s=60.0)
+    try:
+        _drive(eng, 3)                       # warm (untraced arm)
+        sigs = len(eng._exe._cache)
+        assert sigs >= 1
+        tracing.enable()
+        _drive(eng, 3)                       # traced arm
+        assert len(eng._exe._cache) == sigs
+        tracing.disable()
+        _drive(eng, 3)                       # back off
+        assert len(eng._exe._cache) == sigs
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# InferenceEngine end to end (tier-1)
+# ---------------------------------------------------------------------------
+
+def test_inference_engine_traces_assemble_complete(saved_mlp):
+    tracing.enable()
+    eng = InferenceEngine(model_dir=saved_mlp, slots=2, timeout_s=60.0)
+    try:
+        reqs = _drive(eng, 8)
+        summ = eng.metrics.summary()
+    finally:
+        eng.close()
+    trees = tracing.assemble(tracing.spans())
+    ts = tracing.breakdown_summary(trees)
+    assert ts["requests"] == 8 and ts["complete"] == 8
+    assert ts["complete_fraction"] == 1.0
+    # slot-recycling hygiene: 8 requests over 2 slots — every request
+    # kept its OWN trace identity (trace is keyed by request, never by
+    # the slot it recycled)
+    tids = [r.trace.trace_id for r in reqs]
+    assert len(set(tids)) == 8
+    for r in reqs:
+        tree = trees[r.trace.trace_id]
+        assert tree["complete"]
+        root = tree["root"]
+        assert root["name"] == "request" and root["status"] == "ok"
+        assert root["attrs"]["request_id"] == r.id
+        # every parent link resolves to the request's own root
+        names = {s["name"] for s in tree["spans"]}
+        assert {"request", "queue_wait", "batch"} <= names
+        bd = tracing.breakdown(tree)
+        # stage attribution sums to the root latency within 5%
+        total = sum(bd["stages"].values())
+        assert total == pytest.approx(bd["latency_ms"],
+                                      rel=0.05, abs=0.5)
+    # p99 exemplars resolve to assembled trees
+    ex = summ["p99_exemplars"]
+    assert ex and all(t in trees for t in ex)
+    assert ex == eng.metrics.p99_exemplars()
+
+
+def test_chrome_export_renders_request_lanes(saved_mlp, tmp_path):
+    tracing.enable()
+    eng = InferenceEngine(model_dir=saved_mlp, slots=2, timeout_s=60.0)
+    try:
+        reqs = _drive(eng, 3)
+    finally:
+        eng.close()
+    path = profiler.export_chrome_tracing(str(tmp_path / "t.json"))
+    data = json.load(open(path))
+    evs = data["traceEvents"]
+    lanes = [e for e in evs if e.get("ph") == "M"
+             and e.get("name") == "thread_name"
+             and str(e.get("args", {}).get("name", "")).startswith(
+                 "req ")]
+    assert len(lanes) == 3
+    req_events = [e for e in evs if e.get("ph") == "X"
+                  and e.get("args", {}).get("trace_id")]
+    assert {e["args"]["trace_id"] for e in req_events} \
+        == {r.trace.trace_id for r in reqs}
+    # request lanes live in their own synthetic process group
+    assert all(e["pid"] != os.getpid() for e in req_events)
+
+
+def test_watchdog_probe_names_inflight_requests(saved_mlp):
+    """The stall dump lists in-flight serving requests (trace_id, age,
+    state) next to the last-program fingerprint."""
+    tracing.enable()
+    rng = np.random.RandomState(0)
+    eng = InferenceEngine(model_dir=saved_mlp, slots=2, timeout_s=60.0,
+                          start=False)        # loop off: stays queued
+    try:
+        req = eng.submit({"x": rng.rand(1, 6).astype("float32")})
+        probe = monitor._stall_probe()
+        inflight = probe["serving_requests"]
+        assert [r["id"] for r in inflight] == [req.id]
+        assert inflight[0]["trace_id"] == req.trace.trace_id
+        assert inflight[0]["state"] == "queued"
+        assert inflight[0]["age_s"] >= 0.0
+        # the human-facing dump renders the request line
+        text = monitor._format_diag(probe)
+        assert req.id in text and req.trace.trace_id in text
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# RPC propagation (cross-process envelope, in one process over TCP)
+# ---------------------------------------------------------------------------
+
+class _EchoService:
+    @staticmethod
+    def rpc_methods():
+        return ("echo",)
+
+    def echo(self, v):
+        return v
+
+
+def test_rpc_spans_propagate_across_tcp():
+    tracing.enable()
+    srv = MasterServer(_EchoService()).start()
+    client = MasterClient(srv.address, timeout=10.0)
+    try:
+        root = tracing.Span("test_session")
+        with tracing.use_span(root):
+            assert client.call("echo", 41) == 41
+        root.finish("ok")
+    finally:
+        client.close()
+        srv.shutdown()
+    by_name = {}
+    for s in tracing.spans():
+        by_name.setdefault(s["name"], []).append(s)
+    (cli,) = by_name["rpc/echo"]
+    (serv,) = by_name["rpc_server/echo"]
+    # one tree: client leg under the session, server leg under the
+    # client leg (the envelope carried the context across the socket)
+    assert cli["trace_id"] == root.trace_id
+    assert cli["parent_id"] == root.span_id
+    assert serv["trace_id"] == cli["trace_id"]
+    assert serv["parent_id"] == cli["span_id"]
+    assert cli["status"] == "ok" and cli["attrs"]["attempts"] == 1
+    tree = tracing.assemble(tracing.spans())[root.trace_id]
+    assert tree["complete"] and len(tree["spans"]) == 3
+
+
+def test_rpc_reconnect_emits_retry_spans_and_fails_typed():
+    tracing.enable()
+    # a port with nothing listening: connect fails fast (ECONNREFUSED)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    client = MasterClient("127.0.0.1:%d" % port, timeout=0.5,
+                          retry_interval=0.01, max_retries=3,
+                          max_retry_interval=0.02, jitter=0.0)
+    with pytest.raises(ConnectionError):
+        client.ping()
+    client.close()
+    spans = tracing.spans()
+    retries = [s for s in spans if s["name"] == "rpc_retry"]
+    # attempts 1 and 2 emit markers; the final attempt reports through
+    # the rpc span's terminal instead of a trailing sleep
+    assert [r["attrs"]["attempt"] for r in retries] == [1, 2]
+    assert all(r["attrs"]["method"] == "ping"
+               and r["attrs"]["backoff_s"] > 0 for r in retries)
+    (rpc,) = [s for s in spans if s["name"] == "rpc/ping"]
+    assert rpc["status"] == "error"
+    assert rpc["attrs"]["attempts"] == 3
+    assert rpc["attrs"]["error"] == "unreachable"
+    # the retry markers are children of the rpc span, one tree
+    assert all(r["trace_id"] == rpc["trace_id"]
+               and r["parent_id"] == rpc["span_id"] for r in retries)
+
+
+def test_rpc_latency_histogram_per_method(tmp_path):
+    monitor.enable(log_dir=str(tmp_path))
+    srv = MasterServer(_EchoService()).start()
+    client = MasterClient(srv.address, timeout=10.0)
+    try:
+        for _ in range(3):
+            client.call("echo", 1)
+        client.ping()
+    finally:
+        client.close()
+        srv.shutdown()
+    text = monitor.expose_text()
+    assert "rpc/echo_seconds" in text.replace('"', "") \
+        or "rpc_echo_seconds" in text
+    assert "echo" in text and "ping" in text
+
+
+# ---------------------------------------------------------------------------
+# cluster membership-session spans
+# ---------------------------------------------------------------------------
+
+def test_cluster_session_spans_join_one_tree():
+    tracing.enable()
+    cm = ClusterMaster(lease_timeout=30.0)
+    m = ClusterMember(cm, "host-a", auto_heartbeat=False,
+                      register_local=False)
+    m.heartbeat()
+    res = m.enter_step(0, timeout=5)
+    assert res["action"] == "go"
+    m.close()
+    trees = tracing.assemble(tracing.spans())
+    # exactly one cluster tree: session root + join/heartbeat/barrier
+    (tree,) = [t for t in trees.values()
+               if t["root"] is not None
+               and t["root"]["name"] == "cluster_session"]
+    assert tree["complete"]
+    assert tree["root"]["status"] == "ok"
+    assert tree["root"]["attrs"]["host_id"] == "host-a"
+    names = [s["name"] for s in tree["spans"]]
+    assert "cluster/heartbeat" in names and "cluster/barrier" in names
+    (bar,) = [s for s in tree["spans"]
+              if s["name"] == "cluster/barrier"]
+    assert bar["attrs"]["action"] == "go" and bar["attrs"]["polls"] == 1
+    # breakdown ignores non-request roots
+    assert tracing.breakdown(tree) is None
+
+
+# ---------------------------------------------------------------------------
+# GenerationEngine end to end (slow, like every decoder-LM test)
+# ---------------------------------------------------------------------------
+
+_DIMS = dict(n_layer=1, n_head=2, d_model=16, d_inner=32)
+
+
+@pytest.mark.slow
+def test_generation_engine_traces_with_pages_and_recycling():
+    from paddle_tpu.serving.decoder import build_decoder_lm
+    from paddle_tpu.serving.engine import GenerationEngine
+
+    tracing.enable()
+    V, L, S, PS = 31, 32, 2, 8
+    spec = build_decoder_lm(V, L, S, paged=True, page_size=PS,
+                            prefix="trg", **_DIMS)
+    eng = GenerationEngine(spec, place=fluid.CPUPlace(),
+                           max_new_tokens=4, timeout_s=300.0)
+    try:
+        # 6 requests over 2 slots: recycling plus paged back-pressure
+        reqs = [eng.submit(list(range(2, 2 + PS)) + [9 + i])
+                for i in range(6)]
+        outs = [r.result(600) for r in reqs]
+    finally:
+        eng.close()
+    assert all(o["tokens"] for o in outs)
+    trees = tracing.assemble(tracing.spans())
+    assert len({r.trace.trace_id for r in reqs}) == 6   # hygiene
+    for r in reqs:
+        tree = trees[r.trace.trace_id]
+        assert tree["complete"], tree
+        names = {s["name"] for s in tree["spans"]}
+        assert {"request", "queue_wait", "prefill", "page_alloc",
+                "decode"} <= names
+        root = tree["root"]
+        assert root["attrs"]["request_id"] == r.id
+        assert root["attrs"]["ticks"] >= 1
+        decodes = [s for s in tree["spans"] if s["name"] == "decode"]
+        # slot id rides every tick; the recycled slot belongs to THIS
+        # request's spans only
+        assert len({s["attrs"]["slot"] for s in decodes}) == 1
+        bd = tracing.breakdown(tree)
+        assert sum(bd["stages"].values()) == pytest.approx(
+            bd["latency_ms"], rel=0.05, abs=0.5)
+    summ = tracing.breakdown_summary(trees)
+    assert summ["complete_fraction"] == 1.0
+    assert summ["stages"]["decode"]["p99_ms"] > 0
+
+
+@pytest.mark.slow
+def test_generation_engine_expired_request_has_terminal_tree():
+    from paddle_tpu.serving.decoder import build_decoder_lm
+    from paddle_tpu.serving.engine import GenerationEngine
+    from paddle_tpu.serving.scheduler import RequestTimeoutError
+
+    tracing.enable()
+    V, L, S, PS = 31, 32, 2, 8
+    spec = build_decoder_lm(V, L, S, paged=True, page_size=PS,
+                            prefix="tre", **_DIMS)
+    eng = GenerationEngine(spec, place=fluid.CPUPlace(),
+                           max_new_tokens=4, timeout_s=300.0,
+                           start=False)
+    try:
+        req = eng.submit([2, 3, 4], timeout_s=0.01)
+        import time as _t
+        _t.sleep(0.05)
+        eng.start()                     # first admit expires it
+        with pytest.raises(RequestTimeoutError):
+            req.result(60)
+    finally:
+        eng.close()
+    tree = tracing.assemble(tracing.spans())[req.trace.trace_id]
+    assert tree["complete"]
+    assert tree["root"]["status"] == "expired"
+    names = {s["name"]: s for s in tree["spans"]}
+    # never admitted: queue_wait closed by the terminal, no dispatch
+    assert names["queue_wait"]["status"] == "expired"
+    assert "prefill" not in names and "decode" not in names

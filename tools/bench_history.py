@@ -60,6 +60,17 @@ import sys
 # speculative token rate, prefix-cache hit rate — all higher is
 # better; informational like the rung, indexed so regressions in the
 # decode path surface across rounds without gating).
+# p99_queue_wait_ms / p99_decode_ms are the ISSUE-17 request-trace
+# stage p99s (serving admission wait; per-tick decode share on the
+# paged arm) — informational, never gating: they attribute a p99_ms
+# move to a stage, they don't independently gate a run.
+# fields that are informational PER-FIELD, even inside a gating rung:
+# judged against history and printed, but never counted into a run's
+# ``regressions`` — stage attribution explains a p99_ms move, it must
+# not double-gate it
+INFORMATIONAL_FIELDS = frozenset({"p99_queue_wait_ms",
+                                  "p99_decode_ms"})
+
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
           ("mfu", "higher", "mfu"),
@@ -73,7 +84,9 @@ FIELDS = (("min_step_s", "lower", "step_s"),
           ("incr_ckpt_bytes", "lower", "incr_b"),
           ("sessions_at_fixed_hbm", "higher", "sess_x"),
           ("spec_tok_s", "higher", "spec_ts"),
-          ("prefix_hit_rate", "higher", "pfx_hit"))
+          ("prefix_hit_rate", "higher", "pfx_hit"),
+          ("p99_queue_wait_ms", "lower", "p99_qw"),
+          ("p99_decode_ms", "lower", "p99_dec"))
 
 
 def _rung_record(r):
@@ -95,7 +108,8 @@ def _rung_record(r):
     for f in ("throughput_rps", "p99_ms", "save_wall_s",
               "accuracy_delta", "sparse_step_s", "dense_step_s",
               "incr_ckpt_bytes", "sessions_at_fixed_hbm",
-              "spec_tok_s", "prefix_hit_rate"):
+              "spec_tok_s", "prefix_hit_rate",
+              "p99_queue_wait_ms", "p99_decode_ms"):
         if r.get(f) is not None:
             out[f] = r[f]
     gp = r.get("goodput")
@@ -202,7 +216,8 @@ def compare(runs, noise=0.05):
                                noise)
                     if v is not None:
                         v.update(metric=rung["metric"],
-                                 informational=rung["informational"])
+                                 informational=rung["informational"]
+                                 or field in INFORMATIONAL_FIELDS)
                         comparisons.append(v)
             run["comparisons"] = comparisons
             run["regressions"] = [

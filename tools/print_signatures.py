@@ -41,6 +41,7 @@ MODULES = [
     "paddle_tpu.profiler",
     "paddle_tpu.monitor",
     "paddle_tpu.monitor.program_profile",
+    "paddle_tpu.monitor.tracing",
     "paddle_tpu.debugger",
     "paddle_tpu.recordio",
     "paddle_tpu.reader",

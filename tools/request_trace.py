@@ -1,0 +1,169 @@
+"""Assemble per-request trace trees from monitor JSONL logs.
+
+Reads ``trace_span`` records out of one or more monitor JSONL files (or
+directories of them — every host's ``monitor-<pid>.jsonl`` plus rotated
+generations), joins them by ``trace_id`` across processes, and prints
+the latency-breakdown table (queue_wait / padding / page_wait / prefill
+/ decode / spec_reject / other) the tracing module computes — one
+attribution model, two consumers (this CLI and the bench rung embeds).
+
+Usage:
+    python tools/request_trace.py /path/to/logdir
+    python tools/request_trace.py host-a.jsonl host-b.jsonl --json
+    python tools/request_trace.py logdir --trace 3900f6574ed14446
+    python tools/request_trace.py logdir --assert-complete 0.99
+
+``--trace <id>`` prints one request's span tree (indent = parent depth,
+cross-process spans annotated with their run_id).  ``--assert-complete
+F`` exits nonzero unless at least fraction F of terminal requests
+assembled into complete trees — the CI serving-smoke gate.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_records(paths):
+    """trace_span records from JSONL files/directories (rotated
+    ``*.jsonl.N`` generations included); non-JSON and non-trace lines
+    are skipped, not fatal — the logs carry every monitor event."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.jsonl")))
+                         + sorted(glob.glob(os.path.join(p,
+                                                         "*.jsonl.*"))))
+        else:
+            files.append(p)
+    records = []
+    for fp in files:
+        try:
+            with open(fp) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) \
+                            and rec.get("event") == "trace_span":
+                        records.append(rec)
+        except OSError as e:
+            print("warning: cannot read %s: %s" % (fp, e),
+                  file=sys.stderr)
+    return records, files
+
+
+def render_tree(tree):
+    """One request's span tree, indented by parent depth."""
+    from paddle_tpu.monitor import tracing
+
+    by_parent = {}
+    for s in tree["spans"]:
+        by_parent.setdefault(s.get("parent_id"), []).append(s)
+    for kids in by_parent.values():
+        kids.sort(key=lambda s: s.get("mono_us") or 0.0)
+    run_ids = tree.get("run_ids") or []
+    multi = len(run_ids) > 1
+    lines = ["trace %s  (%s, %d spans, run_ids: %s)" % (
+        tree["trace_id"],
+        "complete" if tree["complete"] else "INCOMPLETE",
+        len(tree["spans"]), ", ".join(run_ids) or "-")]
+
+    def walk(parent_id, depth):
+        for s in by_parent.get(parent_id, []):
+            attrs = s.get("attrs") or {}
+            extra = " ".join("%s=%s" % kv for kv in sorted(attrs.items()))
+            tag = ("  [run %s]" % s.get("run_id")) if multi else ""
+            lines.append("%s%-24s %10.3fms  %-8s %s%s" % (
+                "  " * depth, s.get("name"),
+                float(s.get("dur_ms") or 0.0), s.get("status"),
+                extra, tag))
+            walk(s.get("span_id"), depth + 1)
+
+    walk(None, 1)
+    # orphans (unresolved parent links) still print, flagged
+    known = {s.get("span_id") for s in tree["spans"]}
+    for s in tree["spans"]:
+        pid = s.get("parent_id")
+        if pid and pid not in known:
+            lines.append("  (orphan) %-24s %10.3fms  %-8s parent=%s"
+                         % (s.get("name"), float(s.get("dur_ms") or 0.0),
+                            s.get("status"), pid))
+    bd = tracing.breakdown(tree)
+    if bd is not None:
+        lines.append("breakdown: " + "  ".join(
+            "%s=%.3fms" % (k, v) for k, v in bd["stages"].items()))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="assemble cross-process request trace trees from "
+                    "monitor JSONL logs")
+    p.add_argument("paths", nargs="+",
+                   help="JSONL files or log directories")
+    p.add_argument("--json", action="store_true",
+                   help="emit the machine-readable summary (the schema "
+                        "bench rungs embed) instead of the table")
+    p.add_argument("--trace", default=None, metavar="TRACE_ID",
+                   help="print one request's span tree")
+    p.add_argument("--assert-complete", type=float, default=None,
+                   metavar="FRACTION",
+                   help="exit 1 unless >= FRACTION of terminal requests "
+                        "assembled into complete trees")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.monitor import tracing
+
+    records, files = load_records(args.paths)
+    trees = tracing.assemble(records)
+
+    if args.trace is not None:
+        tree = trees.get(args.trace)
+        if tree is None:
+            print("no spans for trace %r in %d files"
+                  % (args.trace, len(files)), file=sys.stderr)
+            return 1
+        print(render_tree(tree))
+        return 0
+
+    summary = tracing.breakdown_summary(trees)
+    if args.json:
+        out = dict(summary)
+        out["files"] = len(files)
+        out["spans"] = len(records)
+        out["requests_detail"] = sorted(
+            (b for b in (tracing.breakdown(t) for t in trees.values())
+             if b is not None),
+            key=lambda b: -b["latency_ms"])
+        print(json.dumps(out, indent=2, sort_keys=True))
+    else:
+        print("%d trace_span records in %d files; %d traces"
+              % (len(records), len(files), len(trees)))
+        print(tracing.render_table(summary))
+
+    if args.assert_complete is not None:
+        frac = summary["complete_fraction"]
+        if summary["terminal"] == 0 or frac is None \
+                or frac < args.assert_complete:
+            print("FAIL: complete fraction %s < required %.3f "
+                  "(%d terminal requests)"
+                  % (frac, args.assert_complete, summary["terminal"]),
+                  file=sys.stderr)
+            return 1
+        print("complete fraction %.4f >= %.3f  (%d terminal requests)"
+              % (frac, args.assert_complete, summary["terminal"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

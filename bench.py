@@ -850,6 +850,67 @@ def bench_serving(args):
     return result
 
 
+def bench_serving_fleet(args):
+    """Pod-scale serving-fleet rung (ISSUE 18): the multi-replica
+    routed-serving fabric measured as two multi-process drills from
+    ``tests/fleet_runner.py``:
+
+    * **scaling** — aggregate routed req/s at 1/2/4 replicas against
+      mock backends with a fixed per-request service dwell (each
+      replica an exact ``slots/dwell`` capacity), so the curve measures
+      the routing fabric — least-loaded spread, control-plane overhead
+      — not the CI host's core count (a real engine's decode is
+      host-CPU-bound and N replica processes share the same cores);
+    * **failover** — 2 REAL GenerationEngine replicas under open-loop
+      load, one SIGKILLed mid-flight: zero lost requests, measured
+      re-route latency (first route -> accepted completion on the
+      survivor), affinity hit rate, bit-identical parity with direct
+      dispatch, and complete cross-process trace trees.
+
+    The primary value is aggregate req/s at 4 replicas; ``vs_baseline``
+    is the scaling efficiency measured/(4x the 1-replica point) — the
+    near-linear-scaling acceptance expressed as a ratio (1.0 = perfectly
+    linear).  ``aggregate_rps`` and ``reroute_latency_ms`` (p99) are
+    the fields bench_history indexes."""
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    _sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tests"))
+    from fleet_runner import scaling, supervise
+
+    workdir = tempfile.mkdtemp(prefix="bench_fleet_")
+    try:
+        curve = scaling(os.path.join(workdir, "scale"),
+                        points=(1, 2, 4))
+        drill = supervise(os.path.join(workdir, "drill"), replicas=2,
+                          requests=24)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    r1, r4 = curve[0], curve[-1]
+    efficiency = round(
+        r4["aggregate_rps"] / (r4["replicas"] * r1["aggregate_rps"]), 4)
+    return {"metric": "serving_fleet",
+            "value": r4["aggregate_rps"], "unit": "req_s_4rep",
+            # near-linear acceptance as a ratio: measured 4-replica
+            # aggregate over 4x the 1-replica point
+            "vs_baseline": efficiency, "informational": True,
+            "aggregate_rps": r4["aggregate_rps"],
+            "reroute_latency_ms": drill["reroute_latency_ms"]["p99_ms"],
+            "scaling_efficiency": efficiency,
+            "scaling_curve": curve,
+            "failover": {k: drill[k] for k in (
+                "replicas", "requests", "completed", "lost",
+                "rerouted_requests", "client_reroutes",
+                "reroute_latency_ms", "affinity_hit_rate",
+                "parity_ok", "stale_completions", "p50_latency_ms",
+                "p99_latency_ms", "quarantined")},
+            "trace": drill["trace"],
+            "n_windows": 1}
+
+
 def bench_decode_paged(args):
     """Paged-KV decode rung (ISSUE 16): concurrent generation sessions
     at fixed HBM, speculative-decoding token rate, and prefix-cache
@@ -2132,7 +2193,8 @@ def main():
                             "machine_translation", "alexnet", "googlenet",
                             "smallnet", "reader_capacity", "fault_drill",
                             "serving", "ckpt_sharded", "quantized",
-                            "rec_sparse", "decode_paged"])
+                            "rec_sparse", "decode_paged",
+                            "serving_fleet"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -2327,6 +2389,12 @@ def main():
             # hit rate; informational while the rung accumulates
             # history — the >=4x acceptance reads off vs_baseline
             ("decode_paged", [], True, 300),
+            # serving fleet (ISSUE 18): 1/2/4-replica routed aggregate
+            # req/s (fabric scaling vs mock-backend capacity) + the
+            # real-engine SIGKILL failover drill (zero loss, measured
+            # re-route latency); multi-process, engine compiles in
+            # subprocesses -> the longer budget
+            ("serving_fleet", [], True, 600),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -2520,6 +2588,8 @@ def main():
         result = bench_fault_drill(args)
     elif args.model == "serving":
         result = bench_serving(args)
+    elif args.model == "serving_fleet":
+        result = bench_serving_fleet(args)
     elif args.model == "decode_paged":
         result = bench_decode_paged(args)
     elif args.model == "ckpt_sharded":

@@ -101,11 +101,25 @@ class _Handler(socketserver.StreamRequestHandler):
                 # JSONL joins the caller's tree at assembly time
                 if tracing.enabled() and req.get("trace"):
                     span = tracing.server_span(method, req["trace"])
+                    # open-anchor NOW: a handler killed mid-call (a
+                    # fleet replica SIGKILLed mid-generate) must leave
+                    # its already-flushed child spans — the engine's
+                    # request anchor, queue_wait — linked under a
+                    # resolvable parent, or the caller's otherwise
+                    # terminal tree assembles INCOMPLETE
+                    span.emit_open()
                 if method == "ping":
                     resp = {"ok": True, "result": "pong"}
                 elif method in methods:
-                    resp = {"ok": True,
-                            "result": _jsonable(methods[method](*args))}
+                    # dispatch UNDER the server span (thread-local
+                    # current): spans the service creates — a fleet
+                    # route decision, a replica-side request tree —
+                    # parent to this RPC leg and join the caller's
+                    # cross-process trace
+                    with tracing.use_span(span):
+                        resp = {"ok": True,
+                                "result": _jsonable(
+                                    methods[method](*args))}
                 else:
                     resp = {"ok": False, "error": "Unknown",
                             "message": f"no method {method!r}"}

@@ -202,10 +202,13 @@ class ClusterMaster:
             self._snapshot(material=fresh)
             return self._view()
 
-    def heartbeat(self, host_id, step=None):
+    def heartbeat(self, host_id, step=None, meta=None):
         """Renew ``host_id``'s lease.  An expired (unknown) member gets
         ``{"rejoin": True}`` — its lease died, it must ``join`` again
-        and treat the run as a fresh epoch."""
+        and treat the run as a fresh epoch.  ``meta`` (a serving
+        replica's load report) MERGES into the member's meta — join-time
+        identity keys (data-plane address, kind) survive load-only
+        renewals."""
         host_id = str(host_id)
         with self._mu:
             self._sweep()
@@ -215,6 +218,8 @@ class ClusterMaster:
             m.deadline = self._clock() + self.lease_timeout
             if step is not None:
                 m.last_step = max(m.last_step, int(step))
+            if meta:
+                m.meta.update(meta)
             self._snapshot()
             return self._view()
 

@@ -65,10 +65,13 @@ class ClusterMember:
 
     def __init__(self, transport, host_id, meta=None,
                  auto_heartbeat=True, poll_interval=0.05,
-                 register_local=True):
+                 register_local=True, heartbeat_meta=None):
         self._t = _transport(transport)
         self.host_id = str(host_id)
         self._poll = float(poll_interval)
+        # optional provider of per-heartbeat meta (a serving replica's
+        # live load report rides the lease renewal this way)
+        self._hb_meta = heartbeat_meta
         self._mu = threading.Lock()
         self._closed = False
         self._expelled = False
@@ -152,10 +155,17 @@ class ClusterMember:
     def heartbeat(self, step=None):
         """Renew the lease; returns the view (absorbing it).  A
         ``rejoin`` response latches ``expelled`` instead of being
-        silently absorbed."""
+        silently absorbed.  With a ``heartbeat_meta`` provider, its
+        dict rides the renewal (merged master-side into the member's
+        meta); without one the wire call keeps its two-arg shape."""
+        extra = self._hb_meta() if self._hb_meta is not None else None
         with tracing.span("cluster/heartbeat", parent=self._trace,
                           attrs={"host_id": self.host_id}):
-            view = self._t.call("heartbeat", self.host_id, step)
+            if extra is not None:
+                view = self._t.call("heartbeat", self.host_id, step,
+                                    extra)
+            else:
+                view = self._t.call("heartbeat", self.host_id, step)
         if view.get("rejoin"):
             self._expelled = True
         self._absorb(view)

@@ -47,6 +47,13 @@ Span taxonomy (names are the breakdown table's contract):
 * ``cluster_session`` / ``cluster/heartbeat`` / ``cluster/barrier`` —
   membership-session spans; RPC spans nest under them via the
   thread-local current-span context.
+* ``fleet_request`` / ``route`` — the pod-scale serving legs
+  (``serving.fleet``): the client-side root over route + dispatch
+  (+ any re-routes), and the fleet master's routing decision.  The
+  ``route`` span's context rides back on the route RESPONSE, so the
+  replica-side ``request`` tree parents under the master's decision —
+  one request assembles into one tree across three processes
+  (client, master, replica).
 """
 
 import collections
@@ -314,14 +321,23 @@ class RequestTrace:
 
     Keyed by REQUEST, never by slot: a freed slot re-prefilled between
     decode ticks carries the new request's RequestTrace (the PR-16
-    OOB-sentinel discipline, regression-tested)."""
+    OOB-sentinel discipline, regression-tested).
 
-    __slots__ = ("trace_id", "root_id", "request_id", "_t0", "_ts",
-                 "_attrs", "_queue_t0", "_queue_open", "_page_t0",
-                 "ticks", "_done")
+    ``parent`` (a Span or extracted RPC context) adopts the caller's
+    trace: a fleet replica serving an RPC-dispatched request joins the
+    remote caller's tree instead of rooting its own."""
 
-    def __init__(self, request_id, kind, length, **attrs):
-        self.trace_id = _new_trace_id()
+    __slots__ = ("trace_id", "root_id", "root_parent_id", "request_id",
+                 "_t0", "_ts", "_attrs", "_queue_t0", "_queue_open",
+                 "_page_t0", "ticks", "_done")
+
+    def __init__(self, request_id, kind, length, parent=None, **attrs):
+        if parent is not None:
+            self.trace_id = parent.trace_id
+            self.root_parent_id = parent.span_id
+        else:
+            self.trace_id = _new_trace_id()
+            self.root_parent_id = None
         self.root_id = _new_span_id()
         self.request_id = request_id
         self._t0 = now_us()
@@ -334,6 +350,15 @@ class RequestTrace:
         self._page_t0 = None
         self.ticks = 0
         self._done = False
+        if self.root_parent_id is not None:
+            # cross-process request: anchor the root NOW, open-status.
+            # A replica SIGKILLed mid-request must leave a ROOTED open
+            # subtree behind — orphan children with an unemitted parent
+            # would break the remote caller's tree assembly (the fleet
+            # failover drill's --assert-complete depends on this).
+            _emit("request", self.trace_id, self.root_id,
+                  self.root_parent_id, self._t0, 0.0, status="open",
+                  attrs=dict(self._attrs), ts=self._ts)
 
     def _child(self, name, t0_us, dur_us, attrs=None, status="ok"):
         _emit(name, self.trace_id, _new_span_id(), self.root_id,
@@ -408,9 +433,9 @@ class RequestTrace:
         if attrs:
             self._attrs.update(attrs)
         self._attrs["ticks"] = self.ticks
-        _emit("request", self.trace_id, self.root_id, None,
-              self._t0, now - self._t0, status=status,
-              attrs=self._attrs, ts=self._ts)
+        _emit("request", self.trace_id, self.root_id,
+              self.root_parent_id, self._t0, now - self._t0,
+              status=status, attrs=self._attrs, ts=self._ts)
 
 
 # ---------------------------------------------------------------------------
@@ -460,8 +485,8 @@ def assemble(records):
     return trees
 
 
-STAGES = ("queue_wait", "padding", "page_wait", "prefill", "decode",
-          "spec_reject", "other")
+STAGES = ("route", "queue_wait", "padding", "page_wait", "prefill",
+          "decode", "spec_reject", "other")
 
 
 def breakdown(tree):
@@ -475,12 +500,18 @@ def breakdown(tree):
     * ``decode`` — the ticks the request rode, minus the
       ``spec_reject`` share (rejected draft positions / verify window:
       the speculation work the target threw away);
+    * ``route`` — fleet routing decisions (the ``rpc/route`` client
+      legs of a fleet-dispatched request; zero for direct dispatch);
     * ``other`` — the unattributed remainder (host bookkeeping, loop
-      scheduling gaps).
+      scheduling gaps; for fleet trees also the data-plane RPC legs).
 
-    Returns None for non-request trees (no ``request`` root)."""
+    Returns None for non-request trees: the root must be a ``request``
+    (engine-direct) or ``fleet_request`` (fleet-routed — the engine's
+    ``request`` span is then a CHILD inside the same tree, and its
+    children attribute exactly once)."""
     root = tree.get("root")
-    if root is None or root.get("name") != "request":
+    if root is None or root.get("name") not in ("request",
+                                                "fleet_request"):
         return None
     lat = float(root.get("dur_ms") or 0.0)
     out = {k: 0.0 for k in STAGES}
@@ -488,7 +519,9 @@ def breakdown(tree):
         name = s.get("name")
         dur = float(s.get("dur_ms") or 0.0)
         a = s.get("attrs") or {}
-        if name == "queue_wait":
+        if name == "rpc/route":
+            out["route"] += dur
+        elif name == "queue_wait":
             out["queue_wait"] += dur
         elif name == "page_wait":
             out["page_wait"] += dur

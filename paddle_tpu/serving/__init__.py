@@ -16,23 +16,33 @@ request health (timeouts, poison quarantine).  See the package modules:
   plus the paged page-pool store and its host-side page allocator
   (prefix sharing, int8 pages, leak accounting);
 * ``metrics``    — SLO observability (p50/p99, queue/occupancy gauges,
-  per-request JSONL events, serving goodput view).
+  per-request JSONL events, serving goodput view, fleet routing
+  counters);
+* ``fleet``      — the pod-scale serving fabric: N replica hosts
+  behind a ClusterMaster-backed routing master (least-loaded
+  admission, session affinity, quarantine + epoch-guarded re-dispatch
+  on lease expiry).
 """
 
 from .scheduler import (ContinuousBatchingScheduler, ServingRequest,
                         BatchPlan, RequestTimeoutError,
                         PoisonedRequestError, EngineClosedError)
-from .metrics import ServingMetrics
+from .metrics import ServingMetrics, FleetMetrics
 from .kv_cache import (KVCacheStore, OutOfPagesError, PageAllocator,
                        PagedKVCacheStore)
 from .decoder import DecoderSpec, build_decoder_lm, sync_draft_weights
 from .engine import InferenceEngine, GenerationEngine
+from .fleet import (FleetMaster, FleetReplica, FleetClient,
+                    ReplicaService, FleetError, NoReplicasError,
+                    FleetRouteError)
 
 __all__ = [
     "ContinuousBatchingScheduler", "ServingRequest", "BatchPlan",
     "RequestTimeoutError", "PoisonedRequestError", "EngineClosedError",
-    "ServingMetrics", "KVCacheStore", "PageAllocator",
+    "ServingMetrics", "FleetMetrics", "KVCacheStore", "PageAllocator",
     "PagedKVCacheStore", "OutOfPagesError", "DecoderSpec",
     "build_decoder_lm", "sync_draft_weights", "InferenceEngine",
-    "GenerationEngine",
+    "GenerationEngine", "FleetMaster", "FleetReplica", "FleetClient",
+    "ReplicaService", "FleetError", "NoReplicasError",
+    "FleetRouteError",
 ]

@@ -126,6 +126,21 @@ class _EngineBase:
         return {"kind": "serving_engine", "name": self.metrics.name,
                 "requests": reqs}
 
+    def load_report(self):
+        """The load/SLO snapshot a fleet replica's heartbeat carries
+        (``serving.fleet``): queue depth + occupancy from the
+        scheduler, latency percentiles from the SLO window.  Cheap and
+        lock-light — it rides every lease renewal."""
+        sched = self._sched
+        pct = self.metrics.percentiles()
+        return {"queue_depth": sched.queue_depth(),
+                "busy_slots": sched.busy_slots(),
+                "occupancy": round(sched.occupancy(), 4),
+                "p50_ms": (round(pct["p50_s"] * 1e3, 3)
+                           if pct["p50_s"] is not None else None),
+                "p99_ms": (round(pct["p99_s"] * 1e3, 3)
+                           if pct["p99_s"] is not None else None)}
+
     def start(self):
         if self._thread is None:
             self._thread = threading.Thread(

@@ -1,0 +1,654 @@
+"""Pod-scale serving fabric (ISSUE 18 tentpole): multi-replica routed
+serving over the cluster runtime.
+
+The reference's production story is a fleet — ``listen_and_serv``
+pservers behind a dispatching master, with the Go/etcd master owning
+membership and fault tolerance.  Here the same shape composes from
+parts that already exist:
+
+* **replica hosts** run an :class:`~.engine.InferenceEngine` /
+  :class:`~.engine.GenerationEngine` behind a data-plane
+  ``cloud.MasterServer`` (:class:`ReplicaService`), and hold a
+  ``cluster.ClusterMember`` session against the fleet master whose
+  heartbeats carry the engine's live load report
+  (:meth:`~.engine._EngineBase.load_report` — queue depth, occupancy,
+  SLO percentiles);
+* the **fleet master** (:class:`FleetMaster`, a ``ClusterMaster``
+  subclass served by the unmodified ``cloud.MasterServer``) routes:
+  least-loaded admission over the heartbeat-reported queue depths plus
+  its own in-flight ledger, **session affinity** so a multi-turn
+  generation stays pinned to the replica holding its KV pages (the
+  paged allocator's prefix sharing makes the pin worth keeping), and
+  replica death handled the PR-13 way — lease expiry quarantines the
+  replica and re-dispatches its in-flight tickets under epoch-guarded
+  attempt fencing (the task-master lease pattern), never a drop;
+* the **client** (:class:`FleetClient`) speaks the existing
+  ``MasterClient`` TCP/JSON envelope for BOTH legs — control plane
+  (route/complete) and data plane (generate/infer) — so the
+  full-jitter exponential backoff, ``rpc_retry`` span markers, and
+  per-method latency histograms are the one retry idiom everywhere.
+
+Epoch-guarded semantics (who owns a request is the MASTER's decision,
+never a zombie's): every routed ticket carries an ``attempt`` number;
+any re-dispatch — a swept lease, a client-reported data-plane failure,
+an explicit re-route — bumps it, and ``complete`` only retires the
+ticket when the attempt matches.  A replica that was quarantined while
+still computing (the network-partition zombie) produces a STALE
+completion: the client discards that result and follows the master's
+re-route, so exactly one accepted completion wins.  Requests are
+client-anchored — the client holds the payload and retries until an
+accepted completion — so a SIGKILLed replica loses work, never a
+request.
+
+Tracing: one fleet request assembles into ONE tree across three
+processes — the client's ``fleet_request`` root, the master's ``route``
+decision span (its context rides back on the route response), and the
+replica-side ``request`` tree (adopted via the data-plane envelope +
+the scheduler's current-span parent), i.e.
+``fleet_request → rpc/route → route → rpc/generate →
+rpc_server/generate → request → queue_wait/prefill/decode``.
+"""
+
+import collections
+import itertools
+import threading
+import time
+
+import numpy as np
+
+from ..cloud.server import MasterClient, MasterServer
+from ..cluster.membership import ClusterMaster
+from ..cluster.runtime import ClusterMember, _transport
+from ..monitor import tracing
+from .metrics import FleetMetrics
+
+__all__ = ["FleetMaster", "FleetReplica", "FleetClient",
+           "ReplicaService", "FleetError", "NoReplicasError",
+           "FleetRouteError", "encode_feed", "decode_feed"]
+
+
+class FleetError(RuntimeError):
+    """Base class for fleet routing failures."""
+
+
+class NoReplicasError(FleetError):
+    """No live replica advertised a data-plane address."""
+
+
+class FleetRouteError(FleetError):
+    """The route/dispatch/complete loop exhausted its attempt budget."""
+
+
+def encode_feed(feed):
+    """JSON-marshal an InferenceEngine feed dict (name -> ndarray):
+    nested lists + dtype string.  float32 values survive the JSON
+    double round-trip exactly (float32 -> double -> float32 is
+    value-preserving), so fleet-routed inference stays bit-identical
+    to direct dispatch."""
+    out = {}
+    for name, val in feed.items():
+        arr = np.asarray(val)
+        out[name] = {"data": arr.tolist(), "dtype": str(arr.dtype)}
+    return out
+
+
+def decode_feed(feed):
+    return {name: np.array(v["data"], dtype=v["dtype"])
+            for name, v in feed.items()}
+
+
+# ---------------------------------------------------------------------------
+# fleet master: ClusterMaster + routing
+# ---------------------------------------------------------------------------
+
+class FleetMaster(ClusterMaster):
+    """Routing control plane over ClusterMaster's membership machinery.
+
+    Replicas ``join`` with ``meta={"address": <data-plane host:port>,
+    "kind": ...}`` and renew their lease with heartbeats carrying
+    ``{"load": engine.load_report()}``; everything membership —
+    deadlines in the snapshotted state, lazy ``_sweep`` expiry under
+    the lock, epoch bumps on any change — is inherited unchanged.  This
+    class adds the ticket ledger (``route``/``complete``/
+    ``report_failure``) and the quarantine + re-dispatch reaction to a
+    swept lease.
+
+    Ticket bookkeeping is advisory observability + zombie fencing; the
+    never-drop guarantee is client-anchored (the client holds the
+    payload).  A master restart therefore answers ``complete`` for a
+    pre-restart ticket with ``unknown_ticket`` — the client keeps the
+    (valid) result; only a STALE attempt forces a discard."""
+
+    def __init__(self, store=None, lease_timeout=10.0, clock=time.time,
+                 ticket_timeout=600.0, **kw):
+        super().__init__(store=store, lease_timeout=lease_timeout,
+                         clock=clock, **kw)
+        self.ticket_timeout = float(ticket_timeout)
+        self._tickets = {}         # ticket -> assignment dict
+        self._sessions = {}        # session_id -> pinned replica host
+        self._quarantined = collections.OrderedDict()  # host -> record
+        self._ticket_seq = itertools.count(1)
+        self.fleet_metrics = FleetMetrics()
+
+    @staticmethod
+    def rpc_methods():
+        return ClusterMaster.rpc_methods() + (
+            "route", "complete", "report_failure", "fleet_stats")
+
+    # -- membership reactions ------------------------------------------
+    def _sweep(self):
+        before = set(self._members)
+        changed = super()._sweep()
+        if changed:
+            self._orphan_replicas(before - set(self._members),
+                                  reason="lease_expired")
+        self._expire_tickets()
+        return changed
+
+    def leave(self, host_id):
+        """Graceful departure also orphans the replica's in-flight
+        tickets (a draining replica may still abandon work — the
+        clients re-route exactly like a death, minus the quarantine
+        verdict)."""
+        with self._mu:
+            if str(host_id) in self._members:
+                self._orphan_replicas({str(host_id)}, reason="leave")
+            return super().leave(host_id)
+
+    def _orphan_replicas(self, dead, reason):
+        """Quarantine dead replicas and mark their in-flight tickets
+        for re-dispatch (lock held).  Bumping each orphan's attempt IS
+        the epoch guard: a quarantined-but-alive zombie finishing the
+        old attempt can only produce a stale completion."""
+        for host in sorted(dead):
+            orphans = []
+            for ticket, asn in self._tickets.items():
+                if asn.get("replica") == host:
+                    asn["attempt"] += 1
+                    asn["replica"] = None
+                    asn["address"] = None
+                    asn["avoid"] = host
+                    orphans.append(ticket)
+            for sess, rep in list(self._sessions.items()):
+                if rep == host:          # its KV pages died with it
+                    del self._sessions[sess]
+            if reason == "lease_expired":
+                self._quarantined[host] = {
+                    "at": self._clock(), "epoch": self._epoch,
+                    "orphaned": list(orphans)}
+                while len(self._quarantined) > 64:
+                    self._quarantined.popitem(last=False)
+                self.fleet_metrics.count("quarantined_replicas")
+            if orphans:
+                self.fleet_metrics.count("orphaned", len(orphans))
+            self._event({"event": "fleet_replica_quarantined",
+                         "replica": host, "reason": reason,
+                         "orphaned": orphans, "epoch": self._epoch})
+
+    def _expire_tickets(self):
+        """Drop tickets whose owner client went silent past the ticket
+        timeout (lock held) — ledger hygiene, not a request drop: an
+        expired ticket means the CLIENT died, and a request dies with
+        its owner, never with a replica."""
+        now = self._clock()
+        stale = [t for t, a in self._tickets.items()
+                 if a["deadline"] <= now]
+        for t in stale:
+            del self._tickets[t]
+        if stale:
+            self.fleet_metrics.count("expired_tickets", len(stale))
+
+    # -- routing --------------------------------------------------------
+    def _score(self, member):
+        """Least-loaded rank (lock held): the master's own in-flight
+        ledger (exact) plus the replica's last heartbeat-reported queue
+        depth (fresh to within lease/3)."""
+        inflight = sum(1 for a in self._tickets.values()
+                       if a.get("replica") == member.host_id)
+        load = member.meta.get("load") or {}
+        return inflight + int(load.get("queue_depth") or 0)
+
+    def route(self, session_id, kind, length, ticket=None):
+        """One routing decision; returns the assignment
+        ``{ticket, attempt, replica, address, epoch[, trace]}`` or
+        ``{"unavailable": True}`` when no replica is routable.
+
+        Passing an existing ``ticket`` re-routes it: the previous
+        assignment (if any still stands) is fenced — attempt bumped,
+        session unpinned from the failed replica — and the re-dispatch
+        avoids that replica unless it is the sole survivor."""
+        session_id = str(session_id) if session_id else None
+        with self._mu:
+            self._sweep()
+            now = self._clock()
+            asn = self._tickets.get(ticket) if ticket else None
+            avoid = None
+            if asn is not None:
+                if asn.get("replica") is not None:
+                    avoid = asn["replica"]
+                    asn["avoid"] = avoid
+                    if session_id and \
+                            self._sessions.get(session_id) == avoid:
+                        del self._sessions[session_id]
+                else:
+                    avoid = asn.get("avoid")
+                self.fleet_metrics.count("reroutes")
+            cands = {h: m for h, m in self._members.items()
+                     if m.meta.get("address")}
+            pick_from = {h: m for h, m in cands.items()
+                         if h != avoid} or cands
+            if not pick_from:
+                self.fleet_metrics.count("unavailable")
+                return {"unavailable": True, "epoch": self._epoch}
+            affinity = None
+            choice = None
+            pinned = (self._sessions.get(session_id)
+                      if session_id else None)
+            if pinned is not None:
+                affinity = pinned in pick_from
+                if affinity:
+                    choice = pinned
+            if choice is None:
+                # sorted first: equal scores break deterministically
+                choice = min(sorted(pick_from),
+                             key=lambda h: self._score(pick_from[h]))
+            if session_id:
+                self._sessions[session_id] = choice
+            if asn is None:
+                ticket = "tkt-%06d" % next(self._ticket_seq)
+                asn = self._tickets[ticket] = {
+                    "session": session_id, "kind": str(kind),
+                    "length": int(length or 0), "attempt": 0,
+                    "first_routed": now, "avoid": None}
+            asn["attempt"] += 1
+            asn["replica"] = choice
+            asn["address"] = pick_from[choice].meta["address"]
+            asn["routed_at"] = now
+            asn["deadline"] = now + self.ticket_timeout
+            self.fleet_metrics.note_route(affinity)
+            resp = {"ticket": ticket, "attempt": asn["attempt"],
+                    "replica": choice, "address": asn["address"],
+                    "epoch": self._epoch}
+            if tracing.enabled():
+                # the routing-decision span; its context rides the
+                # response so the client parents the data-plane
+                # dispatch (and through it the replica's request tree)
+                # under THIS span — the master's decision heads the
+                # replica-side subtree across the process boundary
+                s = tracing.Span("route", parent=tracing.current(),
+                                 attrs={"ticket": ticket,
+                                        "replica": choice,
+                                        "attempt": asn["attempt"],
+                                        "affinity": affinity})
+                s.finish("ok")
+                resp["trace"] = s.context()
+            return resp
+
+    def complete(self, ticket, attempt):
+        """Retire a ticket — accepted only when ``attempt`` matches the
+        current assignment (the epoch guard): a ticket re-dispatched
+        after a quarantine rejects the zombie attempt's completion, and
+        the client discards that result and follows the re-route."""
+        with self._mu:
+            self._sweep()
+            asn = self._tickets.get(ticket)
+            if asn is None:
+                return {"accepted": False, "reason": "unknown_ticket"}
+            if int(attempt) != asn["attempt"]:
+                self.fleet_metrics.count("stale_completions")
+                return {"accepted": False, "reason": "stale_attempt",
+                        "attempt": asn["attempt"]}
+            del self._tickets[ticket]
+            self.fleet_metrics.count("completions")
+            if asn["attempt"] > 1:
+                # first route -> accepted completion: the failover cost
+                self.fleet_metrics.note_reroute_complete(
+                    self._clock() - asn["first_routed"])
+            return {"accepted": True}
+
+    def report_failure(self, ticket, attempt, error=None):
+        """Client-observed data-plane failure: fence the assignment
+        (attempt bump — any late result from the failed dispatch goes
+        stale) and unpin the session, so the following ``route`` call
+        re-dispatches away from the failed replica."""
+        with self._mu:
+            self._sweep()
+            self.fleet_metrics.count("failures_reported")
+            asn = self._tickets.get(ticket)
+            if asn is None or int(attempt) != asn["attempt"]:
+                return {"accepted": False}
+            failed = asn.get("replica")
+            if failed is not None:
+                asn["attempt"] += 1
+                asn["replica"] = None
+                asn["address"] = None
+                asn["avoid"] = failed
+                if asn["session"] and \
+                        self._sessions.get(asn["session"]) == failed:
+                    del self._sessions[asn["session"]]
+            self._event({"event": "fleet_data_failure",
+                         "ticket": ticket, "replica": failed,
+                         "error": str(error)[:200]})
+            return {"accepted": True, "attempt": asn["attempt"]}
+
+    def fleet_stats(self):
+        with self._mu:
+            self._sweep()
+            replicas = {}
+            for h, m in self._members.items():
+                if not m.meta.get("address"):
+                    continue
+                replicas[h] = {
+                    "address": m.meta["address"],
+                    "kind": m.meta.get("kind"),
+                    "load": m.meta.get("load") or {},
+                    "inflight": sum(
+                        1 for a in self._tickets.values()
+                        if a.get("replica") == h)}
+            return {"epoch": self._epoch, "replicas": replicas,
+                    "tickets_inflight": len(self._tickets),
+                    "pending_reroute": sum(
+                        1 for a in self._tickets.values()
+                        if a.get("replica") is None),
+                    "sessions_pinned": len(self._sessions),
+                    "quarantined": {
+                        h: {"at": q["at"], "epoch": q["epoch"],
+                            "orphaned": len(q["orphaned"])}
+                        for h, q in self._quarantined.items()},
+                    "fleet": self.fleet_metrics.summary()}
+
+
+# ---------------------------------------------------------------------------
+# replica side: data-plane service + fleet session
+# ---------------------------------------------------------------------------
+
+class ReplicaService:
+    """The data-plane RPC surface of one replica host, served by the
+    unmodified ``cloud.MasterServer`` (allowlist dispatch, threaded
+    handlers — a blocking ``generate`` occupies only its own handler
+    thread).  The server dispatches each call under its
+    ``rpc_server/<method>`` span, so the engine's request tree —
+    created by the scheduler with ``parent=tracing.current()`` — joins
+    the remote caller's trace automatically."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    @staticmethod
+    def rpc_methods():
+        return ("generate", "infer", "load_report", "replica_stats")
+
+    def generate(self, ticket, attempt, session_id, prompt_ids,
+                 max_new_tokens=None, timeout_s=None):
+        req = self.engine.submit([int(t) for t in prompt_ids],
+                                 max_new_tokens=max_new_tokens,
+                                 timeout_s=timeout_s)
+        res = req.result(timeout=None)   # engine deadline bounds this
+        # JSON-safe subset only (record_logits arrays stay host-side)
+        return {"ticket": ticket, "attempt": attempt,
+                "tokens": [int(t) for t in res["tokens"]],
+                "prompt_len": int(res["prompt_len"])}
+
+    def infer(self, ticket, attempt, feed, rows=1, timeout_s=None):
+        req = self.engine.submit(decode_feed(feed), timeout_s=timeout_s,
+                                 rows=rows)
+        outs = req.result(timeout=None)
+        return {"ticket": ticket, "attempt": attempt,
+                "outputs": [{"data": np.asarray(a).tolist(),
+                             "dtype": str(np.asarray(a).dtype)}
+                            for a in outs]}
+
+    def load_report(self):
+        return self.engine.load_report()
+
+    def replica_stats(self):
+        return {"load": self.engine.load_report(),
+                "summary": self.engine.metrics.summary()}
+
+
+class FleetReplica:
+    """One replica host: the engine's data-plane server plus a
+    ``ClusterMember`` session against the fleet master.  The session's
+    join meta advertises the data-plane address; every heartbeat (the
+    member's daemon thread, lease/3 cadence) carries the engine's live
+    load report, which is what the master's least-loaded admission
+    ranks on.  The engine is caller-owned — ``close`` tears down the
+    session and server, not the engine."""
+
+    def __init__(self, master, engine, host_id, host="127.0.0.1",
+                 port=0, kind="generate", register_local=False):
+        self.engine = engine
+        self.host_id = str(host_id)
+        self.service = ReplicaService(engine)
+        self.server = MasterServer(self.service, host=host,
+                                   port=port).start()
+        self.member = ClusterMember(
+            master, host_id,
+            meta={"address": self.server.address, "kind": str(kind)},
+            register_local=register_local,
+            heartbeat_meta=lambda: {"load": engine.load_report()})
+
+    @property
+    def address(self):
+        return self.server.address
+
+    @property
+    def expelled(self):
+        return self.member.expelled
+
+    def close(self, leave=True):
+        try:
+            if leave:
+                self.member.leave()
+        except Exception:  # noqa: BLE001 — master may already be gone
+            pass
+        finally:
+            self.member.close()
+            self.server.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# client side: route -> dispatch -> complete, re-routing on failure
+# ---------------------------------------------------------------------------
+
+class FleetClient:
+    """Routes requests through the fleet master and dispatches them to
+    replicas — both legs over ``MasterClient`` (the ONE retry idiom:
+    full-jitter exponential backoff, ``rpc_retry`` span events,
+    ``master/reconnects`` counters, per-method latency histograms).
+
+    The data-plane clients are pooled per replica address with a SHORT
+    retry budget (``data_retries``): against a dead replica the right
+    move after a couple of fast reconnect attempts is a RE-ROUTE, not
+    more backoff against a corpse.  Control-plane calls keep the
+    default long budget — the master is supposed to come back.
+
+    Failure handling per dispatch attempt:
+
+    * connection-class errors -> ``report_failure`` (fences the old
+      attempt) and re-route to a survivor;
+    * request-level errors marshalled from the replica (timeout,
+      poison-quarantine) -> raised to the caller: re-routing a
+      poisoned request would poison every replica in turn;
+    * a STALE completion verdict -> the master re-dispatched this
+      ticket while we were computing (zombie fence): discard the
+      result and follow the master's re-route."""
+
+    _POOL_MAX = 8                  # idle data clients kept per address
+
+    def __init__(self, master, data_timeout=120.0, data_retries=3,
+                 data_retry_interval=0.05, reroute_backoff=0.05,
+                 max_route_attempts=16):
+        self._master = _transport(master)
+        self._data_timeout = float(data_timeout)
+        self._data_retries = max(1, int(data_retries))
+        self._data_retry_interval = float(data_retry_interval)
+        self._reroute_backoff = float(reroute_backoff)
+        self._max_route_attempts = max(1, int(max_route_attempts))
+        self._pool = {}
+        self._pool_mu = threading.Lock()
+
+    # -- data-plane client pool ----------------------------------------
+    def _acquire(self, address):
+        with self._pool_mu:
+            stack = self._pool.get(address)
+            if stack:
+                return stack.pop()
+        return MasterClient(address, timeout=self._data_timeout,
+                            retry_interval=self._data_retry_interval,
+                            max_retries=self._data_retries,
+                            max_retry_interval=1.0)
+
+    def _release(self, address, cli):
+        with self._pool_mu:
+            stack = self._pool.setdefault(address, [])
+            if len(stack) < self._POOL_MAX:
+                stack.append(cli)
+                return
+        cli.close()
+
+    # -- public surface -------------------------------------------------
+    def generate(self, prompt_ids, max_new_tokens=None, session=None,
+                 timeout=None):
+        """Fleet-routed generation; returns the replica's result dict
+        plus routing evidence (``replica``/``ticket``/``attempts``/
+        ``reroutes``).  ``session`` pins multi-turn conversations to
+        the replica holding their KV pages."""
+        prompt = [int(t) for t in prompt_ids]
+        return self._dispatch(
+            "generate", session, len(prompt),
+            lambda cli, tkt, att: cli.call(
+                "generate", tkt, att, session, prompt, max_new_tokens,
+                timeout),
+            timeout=timeout)
+
+    def infer(self, feed, rows=1, session=None, timeout=None):
+        """Fleet-routed one-shot inference; returns the fetched arrays
+        (dtype-preserving JSON round-trip) plus routing evidence."""
+        enc = encode_feed(feed)
+        res = self._dispatch(
+            "infer", session, rows,
+            lambda cli, tkt, att: cli.call(
+                "infer", tkt, att, enc, rows, timeout),
+            timeout=timeout)
+        res["outputs"] = [np.array(o["data"], dtype=o["dtype"])
+                          for o in res["outputs"]]
+        return res
+
+    def stats(self):
+        return self._master.call("fleet_stats")
+
+    def close(self):
+        with self._pool_mu:
+            pools, self._pool = self._pool, {}
+        for stack in pools.values():
+            for cli in stack:
+                cli.close()
+        close = getattr(self._master, "close", None)
+        if callable(close):
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- the route/dispatch/complete loop ------------------------------
+    @staticmethod
+    def _count(name, amount=1):
+        from .. import monitor
+
+        monitor.count(name, amount)
+
+    def _dispatch(self, kind, session, length, call, timeout=None):
+        deadline = (time.monotonic() + float(timeout)
+                    if timeout is not None else None)
+        root = (tracing.Span("fleet_request",
+                             attrs={"kind": kind, "length": int(length),
+                                    "session": session})
+                if tracing.enabled() else None)
+        ticket = None
+        reroutes = 0
+        status = "error"
+        try:
+            for attempt_no in range(self._max_route_attempts):
+                with tracing.use_span(root):
+                    asn = self._master.call("route", session, kind,
+                                            int(length), ticket)
+                if asn.get("unavailable"):
+                    if deadline is not None \
+                            and time.monotonic() >= deadline:
+                        raise NoReplicasError(
+                            "no routable replica before the %.1fs "
+                            "deadline" % float(timeout))
+                    time.sleep(self._reroute_backoff)
+                    continue
+                ticket = asn["ticket"]
+                # dispatch under the master's route-span context: the
+                # replica-side request tree parents under the routing
+                # decision, assembling one cross-process tree
+                parent = ((tracing.extract(asn.get("trace")) or root)
+                          if tracing.enabled() else None)
+                cli = self._acquire(asn["address"])
+                try:
+                    with tracing.use_span(parent):
+                        res = call(cli, ticket, asn["attempt"])
+                except (ConnectionError, OSError) as e:
+                    cli.close()
+                    reroutes += 1
+                    self._count("fleet_client/reroutes")
+                    with tracing.use_span(root):
+                        try:
+                            self._master.call(
+                                "report_failure", ticket,
+                                asn["attempt"],
+                                "%s: %s" % (type(e).__name__, e))
+                        except Exception:  # noqa: BLE001
+                            pass   # route() re-fences on its own
+                    if deadline is not None \
+                            and time.monotonic() >= deadline:
+                        raise
+                    continue
+                self._release(asn["address"], cli)
+                with tracing.use_span(root):
+                    ack = self._master.call("complete", ticket,
+                                            asn["attempt"])
+                if ack.get("accepted") \
+                        or ack.get("reason") == "unknown_ticket":
+                    # unknown_ticket: the master restarted and lost the
+                    # ledger — the computed result is still the answer
+                    status = "ok"
+                    return dict(res, replica=asn["replica"],
+                                ticket=ticket,
+                                attempts=attempt_no + 1,
+                                reroutes=reroutes)
+                # stale attempt: the master re-dispatched underneath us
+                # (quarantine while this dispatch was in flight) — its
+                # decision owns the request; drop ours and re-route
+                reroutes += 1
+                self._count("fleet_client/stale_results")
+            if ticket is None:
+                # never even assigned: every attempt found an empty
+                # fleet — the typed error admission layers gate on
+                raise NoReplicasError(
+                    "no routable replica in %d route attempts"
+                    % self._max_route_attempts)
+            raise FleetRouteError(
+                "request not completed after %d route attempts "
+                "(%d re-routes)" % (self._max_route_attempts, reroutes))
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            if root is not None:
+                root.finish(status, reroutes=reroutes,
+                            ticket=ticket)

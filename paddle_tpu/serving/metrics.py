@@ -24,7 +24,7 @@ import time
 
 import numpy as np
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "FleetMetrics"]
 
 # latency-shaped buckets in seconds for the registry histogram: serving
 # requests span ~1ms (warm single dispatch) to tens of seconds (long
@@ -316,3 +316,95 @@ class ServingMetrics:
         out["goodput_view"] = view
         out["p99_exemplars"] = self.p99_exemplars()
         return out
+
+
+class FleetMetrics:
+    """Fleet-master-side routing observability (``serving.fleet``):
+    route/re-route/affinity counters under ``fleet/*`` in the monitor
+    registry, plus an exact bounded re-route-latency window — "how long
+    did a failed-over request take to land on a survivor" is an SLO
+    number the failover artifact must state exactly, not estimate.
+
+    Same telemetry contract as :class:`ServingMetrics`: every entry
+    point is cheap, registry handles are generation-cached, and nothing
+    in here ever raises into the routing path."""
+
+    WINDOW = 2048                  # exact re-route latency window
+
+    def __init__(self, name="fleet"):
+        self.name = name
+        self._mu = threading.Lock()
+        self._reroute_lat = []     # seconds, bounded WINDOW
+        self._counts = {"routes": 0, "reroutes": 0, "completions": 0,
+                        "stale_completions": 0, "affinity_hits": 0,
+                        "affinity_misses": 0, "orphaned": 0,
+                        "quarantined_replicas": 0, "unavailable": 0,
+                        "expired_tickets": 0, "failures_reported": 0}
+        self._handles = {}
+        self._handle_gen = -1
+
+    def _reg(self):
+        from .. import monitor
+
+        return monitor.registry() if monitor.enabled() else None
+
+    def _handle(self, reg, kind, metric, **kw):
+        if self._handle_gen != reg.generation:
+            self._handles.clear()
+            self._handle_gen = reg.generation
+        h = self._handles.get(metric)
+        if h is None:
+            h = self._handles[metric] = getattr(reg, kind)(
+                "%s/%s" % (self.name, metric), **kw)
+        return h
+
+    def count(self, key, amount=1):
+        with self._mu:
+            self._counts[key] = self._counts.get(key, 0) + amount
+        reg = self._reg()
+        if reg is not None:
+            self._handle(reg, "counter", "%s_total" % key).inc(amount)
+
+    def note_route(self, affinity):
+        """One routing decision; ``affinity`` is True (pinned replica
+        honored), False (session re-pinned), or None (no session)."""
+        self.count("routes")
+        if affinity is True:
+            self.count("affinity_hits")
+        elif affinity is False:
+            self.count("affinity_misses")
+
+    def note_reroute_complete(self, latency_s):
+        """A re-dispatched request completed: ``latency_s`` is first
+        route to accepted completion — the failover cost the artifact
+        reports as ``reroute_latency_ms``."""
+        with self._mu:
+            self._reroute_lat.append(float(latency_s))
+            del self._reroute_lat[:-self.WINDOW]
+        reg = self._reg()
+        if reg is not None:
+            self._handle(reg, "histogram", "reroute_latency_seconds",
+                         buckets=LATENCY_BUCKETS).observe(
+                             float(latency_s))
+
+    def reroute_percentiles(self):
+        with self._mu:
+            vals = sorted(self._reroute_lat)
+        return {"p50_s": _percentile(vals, 0.50),
+                "p99_s": _percentile(vals, 0.99),
+                "mean_s": (sum(vals) / len(vals)) if vals else None,
+                "n": len(vals)}
+
+    def summary(self):
+        with self._mu:
+            counts = dict(self._counts)
+        pins = counts["affinity_hits"] + counts["affinity_misses"]
+        pct = self.reroute_percentiles()
+        return {"counts": counts,
+                "affinity_hit_rate": (round(counts["affinity_hits"]
+                                            / pins, 4) if pins else None),
+                "reroute_latency_ms": {
+                    k.replace("_s", "_ms"):
+                        (round(v * 1e3, 3) if v is not None else None)
+                    for k, v in pct.items() if k != "n"},
+                "reroutes_measured": pct["n"]}

@@ -201,11 +201,15 @@ class ContinuousBatchingScheduler:
         req.bucket = self.bucket_for(req.length)   # validates length
         # the trace attaches BEFORE the request becomes visible to the
         # admission loop: an admit racing this submit must already see
-        # req.trace, or its queue_wait/dispatch spans are silently lost
+        # req.trace, or its queue_wait/dispatch spans are silently lost.
+        # The submitting thread's current span (a fleet replica's
+        # rpc_server leg) becomes the request tree's parent, so a
+        # routed request joins its remote caller's trace; direct
+        # submits have no current span and root their own tree.
         if tracing.enabled():
             req.trace = tracing.RequestTrace(
                 req.id, kind=self.trace_kind, length=req.length,
-                rows=req.rows)
+                rows=req.rows, parent=tracing.current())
         with self._cv:
             if self._closed:
                 raise EngineClosedError("scheduler is closed")

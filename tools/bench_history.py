@@ -64,12 +64,19 @@ import sys
 # stage p99s (serving admission wait; per-tick decode share on the
 # paged arm) — informational, never gating: they attribute a p99_ms
 # move to a stage, they don't independently gate a run.
+# aggregate_rps / reroute_latency_ms are the ISSUE-18 serving-fleet
+# pair (4-replica routed aggregate req/s against mock-backend
+# capacity; p99 first-route-to-accepted-completion failover latency)
+# — informational: both ride multi-process drills whose absolute
+# numbers move with host load, so they index trends, never gate.
 # fields that are informational PER-FIELD, even inside a gating rung:
 # judged against history and printed, but never counted into a run's
 # ``regressions`` — stage attribution explains a p99_ms move, it must
 # not double-gate it
 INFORMATIONAL_FIELDS = frozenset({"p99_queue_wait_ms",
-                                  "p99_decode_ms"})
+                                  "p99_decode_ms",
+                                  "aggregate_rps",
+                                  "reroute_latency_ms"})
 
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
@@ -86,7 +93,9 @@ FIELDS = (("min_step_s", "lower", "step_s"),
           ("spec_tok_s", "higher", "spec_ts"),
           ("prefix_hit_rate", "higher", "pfx_hit"),
           ("p99_queue_wait_ms", "lower", "p99_qw"),
-          ("p99_decode_ms", "lower", "p99_dec"))
+          ("p99_decode_ms", "lower", "p99_dec"),
+          ("aggregate_rps", "higher", "agg_rps"),
+          ("reroute_latency_ms", "lower", "rerte"))
 
 
 def _rung_record(r):
@@ -109,7 +118,8 @@ def _rung_record(r):
               "accuracy_delta", "sparse_step_s", "dense_step_s",
               "incr_ckpt_bytes", "sessions_at_fixed_hbm",
               "spec_tok_s", "prefix_hit_rate",
-              "p99_queue_wait_ms", "p99_decode_ms"):
+              "p99_queue_wait_ms", "p99_decode_ms",
+              "aggregate_rps", "reroute_latency_ms"):
         if r.get(f) is not None:
             out[f] = r[f]
     gp = r.get("goodput")

@@ -3,9 +3,20 @@
 Reads ``trace_span`` records out of one or more monitor JSONL files (or
 directories of them — every host's ``monitor-<pid>.jsonl`` plus rotated
 generations), joins them by ``trace_id`` across processes, and prints
-the latency-breakdown table (queue_wait / padding / page_wait / prefill
-/ decode / spec_reject / other) the tracing module computes — one
-attribution model, two consumers (this CLI and the bench rung embeds).
+the latency-breakdown table (route / queue_wait / padding / page_wait /
+prefill / decode / spec_reject / other) the tracing module computes —
+one attribution model, two consumers (this CLI and the bench rung
+embeds).
+
+Fleet-routed requests assemble the same way: point this tool at the
+SHARED log dir of a serving fleet (client + fleet master + every
+replica write there) and each request is one ``fleet_request``-rooted
+tree spanning three processes — the client root, the master's ``route``
+decision span, and the replica-side ``request`` subtree — with the
+``route`` stage carrying the control-plane cost.  A replica SIGKILLed
+mid-request still leaves a resolvable subtree (rpc-server spans and
+request roots open-anchor on entry), so ``--assert-complete`` holds
+across failovers.
 
 Usage:
     python tools/request_trace.py /path/to/logdir

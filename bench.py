@@ -911,6 +911,94 @@ def bench_serving_fleet(args):
             "n_windows": 1}
 
 
+def bench_fleet_telemetry(args):
+    """Fleet telemetry rung (ISSUE 19): the digest plane's own cost
+    numbers, both informational.
+
+    * ``digest_build_us`` — member-side cost of one heartbeat digest
+      (``DigestBuilder.build`` + ``committed``) against a member-sized
+      private registry (40 counters / 16 gauges / 8 live histograms,
+      256-sample step ring) with a steady-state mutation profile
+      between cycles.  The per-heartbeat overhead acceptance is
+      <= ~50us (PERF.md r19); ``vs_baseline`` is measured/budget so
+      < 1.0 reads as inside budget.
+    * ``straggler_detect_windows`` — fake-clock 3-host FleetAggregator
+      drill: digest windows from the moment one host goes 6x slow
+      until the detector flags it (persist=2 means the floor is 2) —
+      detection latency in heartbeat-window units.
+    """
+    from paddle_tpu.monitor import aggregate, alerts
+    from paddle_tpu.monitor.registry import MetricsRegistry
+
+    # -- digest build cost over a member-sized registry ----------------
+    reg = MetricsRegistry()
+    counters = [reg.counter("bench/c%02d" % i) for i in range(40)]
+    gauges = [reg.gauge("bench/g%02d" % i) for i in range(16)]
+    hists = [reg.histogram("bench/h%d" % i) for i in range(8)]
+    for h in hists:
+        for i in range(256):
+            h.observe(0.001 * (i % 37 + 1))
+    clock = [1000.0]
+    builder = aggregate.DigestBuilder("bench-host", registry=reg,
+                                      clock=lambda: clock[0])
+    cycles = 2000
+    digest_bytes = 0
+    try:
+        first = builder.build()      # warm: everything ships once
+        builder.committed(first["seq"])
+        t0 = time.perf_counter()
+        for i in range(cycles):
+            clock[0] += 1.0
+            # steady-state mutation between heartbeats: a few counters
+            # tick, a gauge moves, one histogram and the step ring take
+            # samples — the delta filter does real work every cycle
+            counters[i % 40].inc()
+            counters[(i * 7) % 40].inc(3)
+            gauges[i % 16].set(float(i))
+            hists[i % 8].observe(0.002)
+            aggregate.note_step_time(0.05, now=clock[0])
+            d = builder.build()
+            builder.committed(d["seq"])
+        digest_build_us = (time.perf_counter() - t0) / cycles * 1e6
+        digest_bytes = len(json.dumps(d))
+    finally:
+        aggregate._STEP_RING.clear()
+
+    # -- fake-clock straggler-detection drill --------------------------
+    t = [0.0]
+    agg = aggregate.FleetAggregator(
+        clock=lambda: t[0], stale_after=60.0,
+        rules=alerts.default_rules(straggler_for_s=0.0))
+    slow_from = 5
+    detect_windows = -1              # -1 = never flagged (a failure)
+    for w in range(1, 41):
+        t[0] += 2.0
+        for i in range(3):
+            host = "h-%d" % i
+            slow = 6.0 if (i == 0 and w > slow_from) else 1.0
+            steps = [(t[0] - 2.0 + 0.2 * k, 0.05 * slow)
+                     for k in range(1, 11)]
+            agg.ingest(host, {"v": 1, "seq": w, "host": host,
+                              "ts": t[0], "run": "bench",
+                              "counters": {}, "gauges": {}, "hists": {},
+                              "steps": steps})
+        if "h-0" in agg.straggler_hosts():
+            detect_windows = w - slow_from
+            break
+
+    return {"metric": "fleet_telemetry",
+            "value": round(digest_build_us, 2), "unit": "us_per_digest",
+            # acceptance as a ratio: measured digest cost over the
+            # ~50us heartbeat budget (< 1.0 = inside budget)
+            "vs_baseline": round(digest_build_us / 50.0, 4),
+            "informational": True,
+            "digest_build_us": round(digest_build_us, 2),
+            "digest_bytes": digest_bytes,
+            "straggler_detect_windows": detect_windows,
+            "build_cycles": cycles,
+            "n_windows": 1}
+
+
 def bench_decode_paged(args):
     """Paged-KV decode rung (ISSUE 16): concurrent generation sessions
     at fixed HBM, speculative-decoding token rate, and prefix-cache
@@ -2194,7 +2282,7 @@ def main():
                             "smallnet", "reader_capacity", "fault_drill",
                             "serving", "ckpt_sharded", "quantized",
                             "rec_sparse", "decode_paged",
-                            "serving_fleet"])
+                            "serving_fleet", "fleet_telemetry"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -2395,6 +2483,11 @@ def main():
             # re-route latency); multi-process, engine compiles in
             # subprocesses -> the longer budget
             ("serving_fleet", [], True, 600),
+            # fleet telemetry (ISSUE 19): digest build us/heartbeat
+            # (the <=~50us acceptance, measured against a member-sized
+            # registry) + fake-clock straggler-detection latency in
+            # windows; pure in-process, cheap
+            ("fleet_telemetry", [], True, 300),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -2590,6 +2683,8 @@ def main():
         result = bench_serving(args)
     elif args.model == "serving_fleet":
         result = bench_serving_fleet(args)
+    elif args.model == "fleet_telemetry":
+        result = bench_fleet_telemetry(args)
     elif args.model == "decode_paged":
         result = bench_decode_paged(args)
     elif args.model == "ckpt_sharded":

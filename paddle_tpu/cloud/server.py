@@ -67,8 +67,15 @@ def _jsonable(result):
 _rpc_hists = {}
 _rpc_gen = [-1]
 
+# request payload sizes (``rpc/<method>_request_bytes``): byte-shaped
+# buckets, not the latency-shaped defaults — the fleet telemetry digest
+# rides the heartbeat envelope, and this histogram is the wire-side
+# check that it stays inside FLAGS_fleet_digest_bytes
+_RPC_BYTE_BUCKETS = (64.0, 256.0, 1024.0, 4096.0, 16384.0, 65536.0,
+                     262144.0)
 
-def _observe_rpc(method, seconds):
+
+def _observe_rpc(method, seconds, request_bytes=None):
     from .. import monitor
 
     if not monitor.enabled():
@@ -82,6 +89,14 @@ def _observe_rpc(method, seconds):
         h = _rpc_hists[method] = reg.histogram(
             "rpc/%s_seconds" % method)
     h.observe(seconds)
+    if request_bytes is not None:
+        key = method + "/bytes"
+        hb = _rpc_hists.get(key)
+        if hb is None:
+            hb = _rpc_hists[key] = reg.histogram(
+                "rpc/%s_request_bytes" % method,
+                buckets=_RPC_BYTE_BUCKETS)
+        hb.observe(float(request_bytes))
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -233,7 +248,8 @@ class MasterClient:
                     if resp["ok"]:
                         if span is not None:
                             span.finish("ok", attempts=attempt + 1)
-                        _observe_rpc(method, time.perf_counter() - t0)
+                        _observe_rpc(method, time.perf_counter() - t0,
+                                     request_bytes=len(payload))
                         return resp["result"]
                     exc = _ERRORS.get(resp["error"], RuntimeError)
                     err = exc(resp.get("message", ""))

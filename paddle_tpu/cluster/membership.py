@@ -98,6 +98,10 @@ class ClusterMaster:
         self._acks = set()         # host_ids that acked the active cmd
         self._savers = {}          # step -> {"host_id", "until"}
         self._last_snap = -1e18    # clock of the last persisted snapshot
+        # optional fleet telemetry plane (monitor.aggregate): heartbeat
+        # digests are popped from meta and fed here; lock ordering is
+        # strictly master lock -> aggregator lock, never the reverse
+        self._telemetry = None
 
         snap = self.store.load()
         if snap:
@@ -108,7 +112,35 @@ class ClusterMaster:
     def rpc_methods():
         return ("join", "heartbeat", "leave", "membership", "enter_step",
                 "propose_verdict", "poll_command", "ack_command",
-                "request_save", "stats")
+                "request_save", "stats", "fleet_view")
+
+    # -- fleet telemetry (ISSUE 19) ------------------------------------
+    def attach_telemetry(self, aggregator):
+        """Attach a ``monitor.aggregate.FleetAggregator``: heartbeat
+        meta digests flow into it and membership exits notify it."""
+        self._telemetry = aggregator
+
+    def fleet_view(self):
+        """The aggregator's one-pane fleet view (RPC verb), or a
+        minimal membership-only view when no aggregator is attached."""
+        agg = self._telemetry
+        if agg is not None:
+            return agg.fleet_view()
+        with self._mu:
+            self._sweep()
+            return {"hosts": {}, "alerts": [],
+                    "members": sorted(self._members),
+                    "epoch": self._epoch}
+
+    def _notify_expired(self, dead):
+        """Lock held (caller is _sweep): tombstone expired members in
+        the telemetry plane.  Never raises into the control plane."""
+        agg = self._telemetry
+        if agg is not None:
+            try:
+                agg.note_expired(dead)
+            except Exception:
+                pass
 
     # -- snapshot / recover --------------------------------------------
     def _snapshot(self, material=False):
@@ -159,6 +191,7 @@ class ClusterMaster:
         if dead:
             self._epoch += 1
             self._drop_member_state(dead)
+            self._notify_expired(dead)
             self._count("cluster/lease_expired", len(dead))
             self._event({"event": "cluster_member_expired",
                          "members": dead, "epoch": self._epoch})
@@ -208,8 +241,14 @@ class ClusterMaster:
         and treat the run as a fresh epoch.  ``meta`` (a serving
         replica's load report) MERGES into the member's meta — join-time
         identity keys (data-plane address, kind) survive load-only
-        renewals."""
+        renewals.  A ``digest`` key in meta is the member's fleet
+        telemetry payload (monitor.aggregate): it is popped OUT of the
+        merge (digests must not bloat the persisted snapshot) and fed
+        to the attached aggregator after the lease work — outside the
+        service lock, so a slow merge never delays another member's
+        renewal."""
         host_id = str(host_id)
+        digest = meta.pop("digest", None) if meta else None
         with self._mu:
             self._sweep()
             m = self._members.get(host_id)
@@ -221,7 +260,15 @@ class ClusterMaster:
             if meta:
                 m.meta.update(meta)
             self._snapshot()
-            return self._view()
+            view = self._view()
+        agg = self._telemetry
+        if agg is not None and digest is not None:
+            try:
+                agg.ingest(host_id, digest, meta=meta)
+            except Exception:
+                # telemetry must never break lease renewal
+                pass
+        return view
 
     def leave(self, host_id):
         """Graceful departure: removes the member, bumps the epoch."""
@@ -230,6 +277,11 @@ class ClusterMaster:
             if self._members.pop(str(host_id), None) is not None:
                 self._epoch += 1
                 self._drop_member_state([str(host_id)])
+                if self._telemetry is not None:
+                    try:
+                        self._telemetry.drop_host(str(host_id))
+                    except Exception:
+                        pass
                 self._event({"event": "cluster_member_left",
                              "member_id": str(host_id),
                              "epoch": self._epoch})

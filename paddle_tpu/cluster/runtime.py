@@ -100,6 +100,10 @@ class ClusterMember:
         self._members = list(view["members"])
         self._lease = float(view.get("lease_timeout", 10.0))
         self.last_command_seq = 0
+        # fleet telemetry (monitor.aggregate): lazily-built digest
+        # builder; the disabled path pays one module-global bool read
+        # per heartbeat and nothing else
+        self._digest = None
         self._hb_stop = threading.Event()
         self._hb_thread = None
         if auto_heartbeat:
@@ -157,8 +161,21 @@ class ClusterMember:
         ``rejoin`` response latches ``expelled`` instead of being
         silently absorbed.  With a ``heartbeat_meta`` provider, its
         dict rides the renewal (merged master-side into the member's
-        meta); without one the wire call keeps its two-arg shape."""
+        meta); without one the wire call keeps its two-arg shape.
+        With fleet telemetry on (``FLAGS_fleet_telemetry``) a
+        MetricDigest rides the same renewal under meta["digest"] — the
+        digest baseline advances only after the master confirmed
+        delivery, so a failed RPC just re-ships the delta."""
+        from ..monitor import aggregate
+
         extra = self._hb_meta() if self._hb_meta is not None else None
+        digest = None
+        if aggregate._ENABLED:
+            if self._digest is None:
+                self._digest = aggregate.DigestBuilder(self.host_id)
+            digest = self._digest.build()
+            extra = dict(extra or {})
+            extra["digest"] = digest
         with tracing.span("cluster/heartbeat", parent=self._trace,
                           attrs={"host_id": self.host_id}):
             if extra is not None:
@@ -168,8 +185,14 @@ class ClusterMember:
                 view = self._t.call("heartbeat", self.host_id, step)
         if view.get("rejoin"):
             self._expelled = True
+        elif digest is not None:
+            self._digest.committed(digest["seq"])
         self._absorb(view)
         return view
+
+    def fleet_view(self):
+        """The master's one-pane fleet view (telemetry RPC verb)."""
+        return self._t.call("fleet_view")
 
     def _hb_loop(self):
         interval = max(0.05, self._lease / 3.0)

@@ -238,6 +238,23 @@ def _on_trace_change(_val):
 register_flag("trace", False, bool, _on_trace_change)
 
 
+def _on_fleet_telemetry_change(_val):
+    from .monitor import aggregate
+
+    aggregate._reconcile()
+
+
+# fleet telemetry plane (monitor/aggregate.py): each ClusterMember ships
+# a MetricDigest on its existing heartbeat; the master merges digests
+# into fleet-level series, straggler verdicts, and SLO alerts.  Off by
+# default — the disabled path is one module-global bool read.
+register_flag("fleet_telemetry", False, bool, _on_fleet_telemetry_change)
+# digest byte budget per heartbeat: over it, oldest step samples and
+# lowest-traffic histograms decimate (counted in fleet/digest_truncated)
+# so a fat digest never delays lease renewal
+register_flag("fleet_digest_bytes", 16384, int, _on_fleet_telemetry_change)
+
+
 def _on_preflight_oom(val):
     # validate at set time: a typo ("stric") silently downgrading the
     # hard-fail mode to a warning would defeat the operator's intent

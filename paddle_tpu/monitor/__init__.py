@@ -48,7 +48,7 @@ __all__ = [
     "last_span", "queue_states", "track", "log_event", "count", "run_id",
     "sample_device_gauges", "add_stall_listener", "remove_stall_listener",
     "goodput_ledger", "goodput_summary", "goodput_stamp",
-    "goodput_reset", "tracing",
+    "goodput_reset", "tracing", "aggregate", "alerts",
 ]
 
 # fast-path gate: a module-global bool read (no lock, no flag lookup) is
@@ -484,6 +484,10 @@ def record_step(name, step_seconds, examples, dispatch_queue_depth,
         w = _watchdog
         if w is not None:
             w.step_completed()
+    if aggregate._ENABLED:
+        # feed the fleet digest's recent-step ring (one bool read when
+        # fleet telemetry is off — the disabled-is-free contract)
+        aggregate.note_step_time(rec["step_seconds"], now=rec["ts"])
     log_event(rec)
     if gp_emit:
         # periodic cumulative checkpoint record: replays can trust the
@@ -599,9 +603,37 @@ def _device_state(device):
 # watchdog sink/probe
 # ---------------------------------------------------------------------------
 
+def _fleet_stall_view():
+    """Per-host digest ages, straggler verdicts, and active alerts for
+    stall dumps (ISSUE 19 satellite): a "97% input_wait" dump should
+    also say which peer went dark.  Only attempted when fleet telemetry
+    is on AND a cluster member is registered; any transport failure
+    yields None — the dump must land regardless."""
+    if not aggregate._ENABLED:
+        return None
+    try:
+        from ..cluster.runtime import local_member
+
+        m = local_member()
+        if m is None:
+            return None
+        view = m.fleet_view()
+        hosts = view.get("hosts") or {}
+        return {"digest_age_s": {h: d.get("digest_age_s")
+                                 for h, d in hosts.items()},
+                "stragglers": sorted(view.get("stragglers") or {}),
+                "alerts": view.get("alerts") or []}
+    except Exception:  # noqa: BLE001 — diagnostics must land
+        return None
+
+
 def _stall_probe():
     qs = queue_states()
     return {"queues": qs,
+            # which peer went dark / is firing (fleet telemetry): per-
+            # host digest ages + active alerts when this process is a
+            # cluster member with FLAGS_fleet_telemetry on
+            "fleet": _fleet_stall_view(),
             # the in-flight serving requests (trace_id, age, state) next
             # to the suspect program: a serving stall postmortem starts
             # from the stuck REQUEST, not just the stuck program
@@ -693,6 +725,15 @@ def _format_diag(diag):
                       in gp["recent_fractions"].items())))
     if diag.get("last_program"):
         lines.append("  last program %s" % diag["last_program"])
+    fleet = diag.get("fleet") or {}
+    strag = set(fleet.get("stragglers") or ())
+    for h, age in sorted((fleet.get("digest_age_s") or {}).items()):
+        lines.append("  fleet digest %-22s %8.1fs ago%s" % (
+            h, age or 0.0, "  STRAGGLER" if h in strag else ""))
+    for a in fleet.get("alerts") or []:
+        lines.append("  fleet alert [%s] %s%s" % (
+            a.get("severity"), a.get("rule"),
+            " host=%s" % a["member_id"] if a.get("member_id") else ""))
     return "\n".join(lines) if lines else "  (no pipeline state tracked)"
 
 
@@ -703,3 +744,8 @@ from . import program_profile  # noqa: E402
 # request tracing (ISSUE 17): reachable as monitor.tracing; its _emit
 # imports run_id/log_event lazily, so order here is unconstrained
 from . import tracing  # noqa: E402
+# fleet telemetry (ISSUE 19): reachable as monitor.aggregate /
+# monitor.alerts; record_step and ClusterMember gate every call on
+# aggregate._ENABLED, so import order is unconstrained here too
+from . import aggregate  # noqa: E402
+from . import alerts  # noqa: E402

@@ -1,0 +1,823 @@
+"""Fleet telemetry plane (ISSUE 19): cross-host metric aggregation.
+
+Every host's monitor is process-local — the registry, the goodput
+ledger, the JSONL log all describe ONE process.  The cluster control
+plane (PR 13/18) already moves heartbeat metadata from every host to
+one master; this module closes the gap by riding a **MetricDigest** on
+that existing path (``ClusterMaster.heartbeat`` meta-merge — no new
+connection, no new thread) and merging the digests master-side into
+fleet-level series:
+
+* **DigestBuilder** (host side) — a compact snapshot of the host's
+  counters, gauges, fixed-bucket histograms, goodput summary, and
+  recent step wall-times.  Values are CUMULATIVE and the digest is a
+  *delta snapshot*: only metrics that changed since the last
+  **committed** (delivered) digest are included, so a lost heartbeat
+  loses nothing (the next digest re-ships the still-uncommitted
+  change) and a duplicated delivery double-counts nothing (the master
+  folds cumulative differences, and a replayed value differs by zero).
+  A size guard decimates oldest step samples and lowest-traffic
+  histograms when the serialized digest exceeds ``FLAGS_fleet_digest_bytes``
+  — a fat digest must never delay lease renewal — counting each
+  truncation in ``fleet/digest_truncated``.
+
+* **FleetAggregator** (master side) — counters summed across hosts
+  (contributions survive member death), gauges kept per-host plus
+  min/median/max, histograms bucket-merged so fleet p50/p99 are EXACT
+  (same fixed buckets everywhere: the merged counts are bit-equal to
+  pooling every host's raw observations into one histogram), and a
+  fleet goodput ratio (sum compute / sum wall).  Merged series publish
+  into the master process's own monitor registry under ``fleet/...``
+  — the existing /metrics endpoint and JSONL exporters serve them for
+  free — and a periodic ``fleet_view`` JSONL record enables offline
+  replay (``tools/fleet_report.py``).
+
+* **StragglerDetector** — the guardian's rolling median/MAD idiom
+  (one-sided z-score with a relative dispersion floor) applied ACROSS
+  hosts to per-host step wall-time (and per-replica queue depth on
+  serving fleets).  Verdicts are soft: ``FleetMaster.route()``
+  consults them as a tie-break only (quarantine stays lease-driven;
+  stragglers just lose ties).
+
+The disabled path is one module-global bool read (``_ENABLED``) at
+each instrumentation site — the same contract as ``monitor._enabled``
+and ``fault._ACTIVE``.
+"""
+
+import collections
+import json
+import math
+import threading
+import time
+
+from .registry import Counter, Gauge, Histogram
+
+__all__ = [
+    "DigestBuilder", "FleetAggregator", "StragglerDetector",
+    "enabled", "enable", "disable", "note_step_time", "hist_percentile",
+    "merge_hist_counts",
+]
+
+# fast-path gate: one module-global bool read is all a disabled process
+# pays per heartbeat / per step (the disabled-is-free contract)
+_ENABLED = False
+_MAX_BYTES = 16384
+
+# recent step wall-times, fed by monitor.record_step (enabled-gated
+# there); the DigestBuilder drains samples newer than its committed
+# high-water timestamp.  deque append is atomic under the GIL.
+_STEP_RING = collections.deque(maxlen=256)
+
+
+def enabled():
+    """True iff fleet telemetry is on (``FLAGS_fleet_telemetry``)."""
+    return _ENABLED
+
+
+def _reconcile():
+    """Re-read the FLAGS_fleet_telemetry family (on_set hook)."""
+    global _ENABLED, _MAX_BYTES
+    from .. import flags
+
+    try:
+        on = bool(flags.flag("fleet_telemetry"))
+    except KeyError:
+        on = False
+    try:
+        cap = int(flags.flag("fleet_digest_bytes"))
+    except KeyError:
+        cap = 16384
+    if on and not _ENABLED:
+        _STEP_RING.clear()
+    _ENABLED = on
+    _MAX_BYTES = max(1024, cap)
+
+
+def enable():
+    from .. import flags
+
+    flags.set_flags({"fleet_telemetry": True})
+
+
+def disable():
+    from .. import flags
+
+    flags.set_flags({"fleet_telemetry": False})
+
+
+def note_step_time(step_seconds, now=None):
+    """One executor step completed (called from ``monitor.record_step``
+    behind the ``_ENABLED`` gate): feed the digest's recent-step ring."""
+    _STEP_RING.append((time.time() if now is None else now,
+                       float(step_seconds)))
+
+
+# ---------------------------------------------------------------------------
+# exact percentiles from fixed-bucket histograms
+# ---------------------------------------------------------------------------
+
+def hist_percentile(bounds, counts, q):
+    """The q-quantile of a fixed-bucket histogram, reported as the
+    upper bound of the bucket holding the q-th observation (the +Inf
+    overflow reports ``inf``).  Deterministic, so bucket-merged fleet
+    percentiles are bit-equal to pooling every host's observations
+    into one histogram with the same bounds — the merge is just
+    element-wise count addition."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = max(1, int(math.ceil(float(q) * total)))
+    cum = 0
+    for bound, cnt in zip(bounds, counts):
+        cum += cnt
+        if cum >= rank:
+            return float(bound)
+    return float("inf")
+
+
+def merge_hist_counts(into, counts):
+    """Element-wise add ``counts`` into the accumulator list."""
+    for i, c in enumerate(counts):
+        into[i] += c
+    return into
+
+
+# ---------------------------------------------------------------------------
+# host side: DigestBuilder
+# ---------------------------------------------------------------------------
+
+# step samples shipped per digest, newest kept when decimating
+_MAX_STEP_SAMPLES = 32
+# pending (shipped, not yet committed) digests retained for commit
+_MAX_PENDING = 8
+
+
+class DigestBuilder:
+    """Builds one host's MetricDigest per heartbeat.
+
+    ``build()`` snapshots the registry and includes only metrics whose
+    cumulative value moved since the last **committed** digest;
+    ``committed(seq)`` advances the baseline once the transport
+    confirmed delivery (``ClusterMember.heartbeat`` calls it after the
+    RPC returns a non-rejoin view).  An undelivered digest is simply
+    re-shipped — cumulative values make re-delivery idempotent."""
+
+    def __init__(self, host_id, registry=None, max_bytes=None,
+                 clock=time.time):
+        self.host_id = str(host_id)
+        self._registry = registry
+        self._max_bytes = max_bytes
+        self._clock = clock
+        self._seq = 0
+        self._gen = None
+        # committed (known-delivered) cumulative views
+        self._counters = {}       # name -> value
+        self._gauges = {}         # name -> value
+        self._hists = {}          # name -> count (cheap changed check)
+        self._step_ts = 0.0       # high-water ts of committed step samples
+        self._pending = collections.OrderedDict()  # seq -> shipped views
+        self._scan = []           # cached (kind, metric) dispatch list
+        self._scan_n = -1         # registry size the cache was built at
+        self.truncations = 0
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from .. import monitor
+
+        return monitor.registry()
+
+    def _rebaseline(self, gen):
+        self._counters.clear()
+        self._gauges.clear()
+        self._hists.clear()
+        self._pending.clear()
+        self._scan, self._scan_n = [], -1
+        self._gen = gen
+
+    def build(self, now=None):
+        """One MetricDigest dict (JSON-safe; rides the heartbeat meta)."""
+        from .. import monitor
+
+        reg = self._reg()
+        if self._gen != reg.generation:
+            # registry reset (tests/operators): everything re-ships
+            self._rebaseline(reg.generation)
+        now = self._clock() if now is None else now
+        self._seq += 1
+        counters, gauges, hists = {}, {}, {}
+        metrics = reg.metrics()
+        if len(metrics) != self._scan_n:
+            # metrics are append-only within a generation, so the kind
+            # dispatch + name filter is recomputed only when one is
+            # registered — per-heartbeat cost stays one len() compare
+            scan = []
+            for m in metrics:
+                if m.name.startswith(("fleet/", "alerts/")):
+                    # master-side aggregation products: a process that
+                    # is both master and member must not ship its own
+                    # merged series back into itself (a feedback
+                    # cascade)
+                    continue
+                if isinstance(m, Counter):
+                    scan.append((0, m))
+                elif isinstance(m, Gauge):
+                    scan.append((1, m))
+                elif isinstance(m, Histogram):
+                    scan.append((2, m))
+            self._scan, self._scan_n = scan, len(metrics)
+        for kind, m in self._scan:
+            if kind == 0:
+                v = m.value
+                if v != self._counters.get(m.name, 0.0):
+                    counters[m.name] = v
+            elif kind == 1:
+                v = m.value
+                if v != self._gauges.get(m.name):
+                    gauges[m.name] = v
+            elif m.count != self._hists.get(m.name, 0):
+                s = m.snapshot()
+                hists[m.name] = {"b": s["buckets"], "c": s["counts"],
+                                 "sum": round(s["sum"], 6),
+                                 "n": s["count"]}
+        # newest-first scan with early break: the ring is time-ordered
+        # and heartbeats usually find only a handful of new samples, so
+        # this is O(new), not O(ring).  copy() is C-level (atomic under
+        # the GIL) — safe against the training thread's appends.
+        steps = []
+        for ts, sec in reversed(_STEP_RING.copy()):
+            if ts <= self._step_ts or len(steps) == _MAX_STEP_SAMPLES:
+                break
+            steps.append((round(ts, 3), round(sec, 6)))
+        steps.reverse()
+        gp = monitor.goodput_summary() if self._registry is None else None
+        digest = {"v": 1, "seq": self._seq, "host": self.host_id,
+                  "ts": round(now, 3), "run": monitor.run_id(),
+                  "counters": counters, "gauges": gauges, "hists": hists,
+                  "steps": steps}
+        if gp is not None:
+            digest["goodput"] = {
+                "compute": gp["buckets"].get("compute", 0.0),
+                "wall": gp["wall_seconds"],
+                "ratio": gp["goodput_ratio"],
+                "steps": gp["steps"]}
+        self._cap(digest)
+        self._pending[self._seq] = {
+            "counters": dict(digest["counters"]),
+            "gauges": dict(digest["gauges"]),
+            "hists": {n: h["n"] for n, h in digest["hists"].items()},
+            "step_ts": (digest["steps"][-1][0]
+                        if digest["steps"] else self._step_ts)}
+        while len(self._pending) > _MAX_PENDING:
+            self._pending.popitem(last=False)
+        return digest
+
+    def committed(self, seq):
+        """The transport delivered digest ``seq``: advance the baseline
+        (this and every older pending digest is subsumed — values are
+        cumulative, so the newest delivered view wins)."""
+        found = False
+        for s in list(self._pending):
+            if s > seq:
+                break
+            shipped = self._pending.pop(s)
+            self._counters.update(shipped["counters"])
+            self._gauges.update(shipped["gauges"])
+            self._hists.update(shipped["hists"])
+            self._step_ts = max(self._step_ts, shipped["step_ts"])
+            found = s == seq or found
+        return found
+
+    # -- satellite: heartbeat payload size guard -----------------------
+    def _cap(self, digest):
+        """Decimate the digest until it fits the byte budget: halve the
+        step samples (oldest dropped first), then drop the
+        lowest-traffic histograms — dropped metrics stay uncommitted
+        and re-ship next digest, so decimation defers, never loses."""
+        cap = self._max_bytes if self._max_bytes is not None else _MAX_BYTES
+        # cheap upper-bound estimate before paying a json.dumps: names +
+        # per-entry framing + per-bucket digits
+        est = 96
+        for n in digest["counters"]:
+            est += len(n) + 20
+        for n in digest["gauges"]:
+            est += len(n) + 20
+        for n, h in digest["hists"].items():
+            est += len(n) + 40 + 8 * (len(h["b"]) + len(h["c"]))
+        est += 22 * len(digest["steps"])
+        if est <= cap:
+            return
+        from .. import monitor
+
+        truncated = False
+        while True:
+            size = len(json.dumps(digest, separators=(",", ":")))
+            if size <= cap:
+                break
+            if len(digest["steps"]) > 2:
+                digest["steps"] = digest["steps"][
+                    len(digest["steps"]) // 2:]
+            elif digest["hists"]:
+                drop = min(digest["hists"],
+                           key=lambda n: digest["hists"][n]["n"])
+                del digest["hists"][drop]
+            elif len(digest["counters"]) > 8 or len(digest["gauges"]) > 8:
+                for fam in ("gauges", "counters"):
+                    names = sorted(digest[fam])[8:]
+                    for n in names:
+                        del digest[fam][n]
+            else:
+                break              # minimal digest; ship it regardless
+            truncated = True
+        if truncated:
+            digest["trunc"] = True
+            self.truncations += 1
+            monitor.count("fleet/digest_truncated")
+
+
+# ---------------------------------------------------------------------------
+# straggler detection: guardian's median/MAD idiom, across hosts
+# ---------------------------------------------------------------------------
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+class StragglerDetector:
+    """One-sided median/MAD outlier detection across hosts.
+
+    For each series (step wall-time, serving queue depth) the fleet
+    median and MAD define ``z = (v - med) / (1.4826*MAD + floor)``
+    with a dispersion floor RELATIVE to the level (guardian.py: a
+    saturated window — every healthy host bit-identical — must not
+    turn float noise into z ~ 1e4).  A host is a straggler after
+    ``persist`` consecutive windows over ``zmax``; the flag clears on
+    the first in-band window.  Fewer than ``min_hosts`` reporting
+    hosts yields no verdicts — a 2-host MAD is degenerate."""
+
+    def __init__(self, zmax=8.0, persist=2, min_hosts=3, rel_floor=0.05):
+        self.zmax = float(zmax)
+        self.persist = int(persist)
+        self.min_hosts = int(min_hosts)
+        self.rel_floor = float(rel_floor)
+        self._runs = {}       # (series, host) -> consecutive over-z count
+        self._flagged = {}    # host -> {"series", "z", "since"}
+
+    def update(self, series_map, now):
+        """``series_map``: {series_name: {host: latest window value}}.
+        Recomputes verdicts; returns the set of flagged hosts."""
+        seen = set()
+        for series, vals in series_map.items():
+            if len(vals) < self.min_hosts:
+                for key in [k for k in self._runs if k[0] == series]:
+                    del self._runs[key]
+                continue
+            med = _median(list(vals.values()))
+            mad = _median([abs(v - med) for v in vals.values()])
+            denom = 1.4826 * mad + self.rel_floor * max(abs(med), 1e-9)
+            for host, v in vals.items():
+                z = (v - med) / denom
+                key = (series, host)
+                if z > self.zmax:
+                    self._runs[key] = self._runs.get(key, 0) + 1
+                    if self._runs[key] >= self.persist:
+                        cur = self._flagged.get(host)
+                        if cur is None or cur["z"] < z:
+                            self._flagged[host] = {
+                                "series": series, "z": round(z, 2),
+                                "since": (cur or {}).get("since", now)}
+                        seen.add(host)
+                else:
+                    self._runs.pop(key, None)
+                    cur = self._flagged.get(host)
+                    if cur is not None and cur["series"] == series:
+                        del self._flagged[host]
+        # hosts flagged by a series that no longer reports them unflag
+        for host in [h for h in self._flagged if h not in seen
+                     and not any(self._runs.get((s, h), 0) >= self.persist
+                                 for s in series_map)]:
+            self._flagged.pop(host, None)
+        return set(self._flagged)
+
+    def verdicts(self):
+        return {h: dict(v) for h, v in self._flagged.items()}
+
+    def hosts(self):
+        return frozenset(self._flagged)
+
+    def remove(self, host):
+        self._flagged.pop(host, None)
+        for key in [k for k in self._runs if k[1] == host]:
+            del self._runs[key]
+
+
+# ---------------------------------------------------------------------------
+# master side: FleetAggregator
+# ---------------------------------------------------------------------------
+
+class _HostState:
+    __slots__ = ("last_seq", "last_ts", "digest_ts", "run",
+                 "counters", "hists", "gauges", "goodput",
+                 "step_samples", "window_vals", "queue_depth",
+                 "ckpt_last_move", "ckpt_seen", "joined_ts", "live")
+
+    def __init__(self, now):
+        self.live = True
+        self.last_seq = 0
+        self.last_ts = now           # master-clock arrival time
+        self.digest_ts = None        # host-clock digest build time
+        self.run = None
+        self.counters = {}           # name -> last cumulative value
+        self.hists = {}              # name -> last cumulative counts
+        self.gauges = {}
+        self.goodput = {"compute": 0.0, "wall": 0.0, "ratio": None,
+                        "steps": 0}
+        self.step_samples = collections.deque(maxlen=64)
+        self.window_vals = collections.deque(maxlen=16)
+        self.queue_depth = None
+        self.ckpt_last_move = None
+        self.ckpt_seen = False
+        self.joined_ts = now
+
+
+# tombstone retention for expired/quarantined hosts (alert lifecycle:
+# the alert resolves when the host rejoins or the tombstone ages out)
+_TOMBSTONE_S = 600.0
+# per-host gauges published into the master registry (the full gauge
+# set stays reachable via fleet_view; publishing every per-host gauge
+# would flood /metrics)
+_HOST_GAUGES = ("step_time_s", "goodput_ratio", "queue_depth",
+                "straggler")
+
+
+class FleetAggregator:
+    """Merges member digests into fleet-level series (master side).
+
+    Attach to any ClusterMaster/FleetMaster via the constructor (or
+    ``master.attach_telemetry(agg)``): the master feeds digests popped
+    from heartbeat meta into ``ingest`` and notifies membership exits.
+    Thread-safe; never raises into the control plane."""
+
+    def __init__(self, master=None, clock=None, rules=None,
+                 detector=None, stale_after=None, emit_every=10):
+        from . import alerts
+
+        self._clock = clock or (master._clock if master is not None
+                                else time.time)
+        self._mu = threading.RLock()
+        self._hosts = {}             # host -> _HostState (live)
+        self._expired = {}           # host -> expiry ts (tombstones)
+        self._quarantined = {}       # host -> quarantine ts
+        self._counters = {}          # fleet totals (survive host death)
+        self._hists = {}             # name -> {"b": tuple, "c": [..],
+                                     #          "sum": f, "n": int}
+        self._goodput = {"compute": 0.0, "wall": 0.0}
+        self.detector = detector or StragglerDetector()
+        self.engine = alerts.AlertEngine(
+            alerts.default_rules() if rules is None else rules,
+            clock=self._clock)
+        # digests older than this (no fresh window) drop out of the
+        # straggler comparison and read as dark in the view
+        self._stale_after = float(stale_after if stale_after is not None
+                                  else (3.0 * master.lease_timeout
+                                        if master is not None else 30.0))
+        self._emit_every = int(emit_every)
+        self._ingests = 0
+        self._pub = {}               # published-handle cache
+        self._pub_gen = None
+        if master is not None:
+            master.attach_telemetry(self)
+
+    # -- ingestion ------------------------------------------------------
+    def ingest(self, host_id, digest, meta=None, now=None):
+        """Apply one member digest.  Late/out-of-order/duplicate digests
+        (seq <= last applied for the host's run token) are dropped —
+        cumulative values make the ordering guard sufficient for
+        exactly-once folding."""
+        from .. import monitor
+
+        host_id = str(host_id)
+        if not isinstance(digest, dict) or "seq" not in digest:
+            return False
+        events = []
+        with self._mu:
+            now = self._clock() if now is None else now
+            hs = self._hosts.get(host_id)
+            if hs is None:
+                hs = self._hosts[host_id] = _HostState(now)
+            run = digest.get("run")
+            if run != hs.run:
+                # new process incarnation: cumulative views restart
+                hs.counters.clear()
+                hs.hists.clear()
+                hs.gauges.clear()
+                hs.goodput = {"compute": 0.0, "wall": 0.0,
+                              "ratio": None, "steps": 0}
+                hs.run = run
+                hs.last_seq = 0
+            seq = int(digest["seq"])
+            if seq <= hs.last_seq:
+                monitor.count("fleet/digest_stale")
+                return False
+            hs.live = True
+            hs.last_seq = seq
+            hs.last_ts = now
+            hs.digest_ts = digest.get("ts")
+            # a rejoin clears the tombstones: the alert resolves
+            self._expired.pop(host_id, None)
+            self._quarantined.pop(host_id, None)
+            ckpt_moved = False
+            for name, v in (digest.get("counters") or {}).items():
+                prev = hs.counters.get(name, 0.0)
+                diff = v - prev if v >= prev else v
+                hs.counters[name] = v
+                self._counters[name] = self._counters.get(name, 0.0) + diff
+                if diff > 0 and "checkpoint" in name:
+                    ckpt_moved = True
+            for name, h in (digest.get("hists") or {}).items():
+                bounds = tuple(h["b"])
+                fleet = self._hists.get(name)
+                if fleet is None:
+                    fleet = self._hists[name] = {
+                        "b": bounds, "c": [0] * len(h["c"]),
+                        "sum": 0.0, "n": 0}
+                if fleet["b"] != bounds or len(fleet["c"]) != len(h["c"]):
+                    # a version-skewed member's layout cannot merge
+                    # exactly; drop rather than corrupt the percentile
+                    monitor.count("fleet/digest_bucket_mismatch")
+                    continue
+                prev = hs.hists.get(name)
+                if prev is None or prev["n"] > h["n"] \
+                        or len(prev["c"]) != len(h["c"]):
+                    prev = {"c": [0] * len(h["c"]), "sum": 0.0, "n": 0}
+                merge_hist_counts(
+                    fleet["c"], [c - p for c, p in zip(h["c"], prev["c"])])
+                fleet["sum"] += h["sum"] - prev["sum"]
+                fleet["n"] += h["n"] - prev["n"]
+                hs.hists[name] = {"c": list(h["c"]), "sum": h["sum"],
+                                  "n": h["n"]}
+                if h["n"] > prev["n"] and "checkpoint" in name:
+                    ckpt_moved = True
+            if ckpt_moved:
+                hs.ckpt_last_move = now
+                hs.ckpt_seen = True
+            hs.gauges.update(digest.get("gauges") or {})
+            gp = digest.get("goodput")
+            if gp:
+                for k in ("compute", "wall"):
+                    prev = hs.goodput.get(k, 0.0)
+                    v = float(gp.get(k) or 0.0)
+                    self._goodput[k] += v - prev if v >= prev else v
+                    hs.goodput[k] = v
+                hs.goodput["ratio"] = gp.get("ratio")
+                hs.goodput["steps"] = gp.get("steps", 0)
+            steps = digest.get("steps") or ()
+            for ts, sec in steps:
+                hs.step_samples.append((ts, sec))
+            if steps:
+                hs.window_vals.append(
+                    sum(s for _, s in steps) / float(len(steps)))
+            load = (meta or {}).get("load") or {}
+            if load.get("queue_depth") is not None:
+                hs.queue_depth = int(load["queue_depth"])
+            self._ingests += 1
+            self.detector.update(self._detector_series(now), now)
+            self._publish()
+            view = self._view_locked(now)
+            events = self.engine.evaluate(view, now)
+            emit = (self._ingests % self._emit_every == 0) or events
+        for e in events:
+            monitor.log_event(e)
+        if emit:
+            monitor.log_event(dict(view, event="fleet_view"))
+        return True
+
+    def _detector_series(self, now):
+        fresh = {h: s for h, s in self._hosts.items()
+                 if s.live and now - s.last_ts <= self._stale_after}
+        return {
+            "step_time": {h: s.window_vals[-1] for h, s in fresh.items()
+                          if s.window_vals},
+            "queue_depth": {h: float(s.queue_depth)
+                            for h, s in fresh.items()
+                            if s.queue_depth is not None},
+        }
+
+    # -- membership notifications (master calls these) ------------------
+    def note_expired(self, hosts, now=None):
+        """Lease-expired members: gauges/step state drop, counter
+        contributions stay folded, and a tombstone drives the
+        lease-expiry alert until rejoin or retention.  Evaluates the
+        alert rules immediately — a death with no subsequent digest
+        traffic must still fire."""
+        with self._mu:
+            now = self._clock() if now is None else now
+            for h in hosts:
+                self._expired[str(h)] = now
+                self._drop_locked(str(h))
+            events = self.engine.evaluate(self._view_locked(now), now)
+        self._log_events(events)
+
+    def note_quarantined(self, host, now=None):
+        """A FleetMaster quarantined a replica (lease-driven): feeds the
+        replica-quarantine alert rule (evaluated immediately)."""
+        with self._mu:
+            now = self._clock() if now is None else now
+            self._quarantined[str(host)] = now
+            events = self.engine.evaluate(self._view_locked(now), now)
+        self._log_events(events)
+
+    @staticmethod
+    def _log_events(events):
+        from .. import monitor
+
+        for e in events:
+            monitor.log_event(e)
+
+    def drop_host(self, host):
+        """Graceful departure (leave): per-host state drops silently —
+        no tombstone, no alert."""
+        with self._mu:
+            self._drop_locked(str(host))
+
+    def _drop_locked(self, host):
+        hs = self._hosts.get(host)
+        if hs is not None:
+            # dead, not deleted: the counter/hist baselines stay — a
+            # rejoining SAME process (same run token) must diff against
+            # what was already folded, not re-fold its cumulative
+            # totals; a restarted process rebaselines via its fresh run
+            # token.  Point-in-time state (gauges, step windows, queue
+            # depth) drops out of every view immediately.
+            hs.live = False
+            hs.gauges.clear()
+            hs.window_vals.clear()
+            hs.step_samples.clear()
+            hs.queue_depth = None
+        self.detector.remove(host)
+
+    # -- views ----------------------------------------------------------
+    def straggler_hosts(self):
+        """Current straggler verdicts as a frozenset of host ids — the
+        soft deprioritization FleetMaster.route() consults."""
+        with self._mu:
+            return self.detector.hosts()
+
+    def percentile(self, hist_name, q):
+        """Exact fleet percentile of a merged histogram (or None)."""
+        with self._mu:
+            h = self._hists.get(hist_name)
+            if h is None:
+                return None
+            return hist_percentile(h["b"], h["c"], q)
+
+    def fleet_view(self, now=None):
+        """The operator's one-pane view: per-host table, merged series,
+        straggler verdicts, tombstones, active alerts.  JSON-safe (it
+        is the ``fleet_view`` RPC response and JSONL record)."""
+        with self._mu:
+            return self._view_locked(self._clock() if now is None
+                                     else now)
+
+    def _view_locked(self, now):
+        self._gc_tombstones(now)
+        verdicts = self.detector.verdicts()
+        hosts = {}
+        for h, s in self._hosts.items():
+            if not s.live:
+                continue
+            v = verdicts.get(h)
+            hosts[h] = {
+                "digest_age_s": round(now - s.last_ts, 3),
+                "seq": s.last_seq,
+                "step_time_s": (round(s.window_vals[-1], 6)
+                                if s.window_vals else None),
+                "steps_recent": len(s.step_samples),
+                "goodput_ratio": s.goodput.get("ratio"),
+                "queue_depth": s.queue_depth,
+                "straggler": v is not None,
+                "z": v["z"] if v else None,
+                "checkpoint_age_s": (round(now - s.ckpt_last_move, 3)
+                                     if s.ckpt_seen else None),
+            }
+        wall = self._goodput["wall"]
+        pcts = {}
+        for name, h in self._hists.items():
+            if h["n"]:
+                pcts[name] = {"p50": hist_percentile(h["b"], h["c"], 0.50),
+                              "p99": hist_percentile(h["b"], h["c"], 0.99),
+                              "count": h["n"]}
+        return {
+            "ts": round(now, 3),
+            "hosts": hosts,
+            "goodput_ratio": (round(self._goodput["compute"] / wall, 4)
+                              if wall > 0 else None),
+            "counters": {n: v for n, v in self._counters.items()},
+            "percentiles": pcts,
+            "stragglers": verdicts,
+            "expired": {h: round(now - t, 3)
+                        for h, t in self._expired.items()},
+            "quarantined": {h: round(now - t, 3)
+                            for h, t in self._quarantined.items()},
+            "alerts": self.engine.active(),
+        }
+
+    def _gc_tombstones(self, now):
+        for d in (self._expired, self._quarantined):
+            for h in [h for h, t in d.items()
+                      if now - t > _TOMBSTONE_S]:
+                del d[h]
+        # dead host states (kept for rejoin baselines) age out too
+        for h in [h for h, s in self._hosts.items()
+                  if not s.live and now - s.last_ts > _TOMBSTONE_S]:
+            del self._hosts[h]
+
+    # -- master-registry publication ------------------------------------
+    def _publish(self):
+        """Mirror merged series into the master process's own monitor
+        registry (enabled-gated): the existing /metrics endpoint and
+        JSONL snapshots then serve the fleet series for free."""
+        from .. import monitor
+
+        if not monitor.enabled():
+            return
+        reg = monitor.registry()
+        if self._pub_gen != reg.generation:
+            self._pub.clear()
+            self._pub_gen = reg.generation
+        for name, total in self._counters.items():
+            key = "c/" + name
+            h = self._pub.get(key)
+            if h is None:
+                h = self._pub[key] = [reg.counter("fleet/" + name), 0.0]
+            if total > h[1]:
+                h[0].inc(total - h[1])
+                h[1] = total
+        live = {h: s for h, s in self._hosts.items() if s.live}
+        gauge_names = set()
+        for s in live.values():
+            gauge_names.update(s.gauges)
+        for name in gauge_names:
+            vals = [s.gauges[name] for s in live.values()
+                    if name in s.gauges]
+            if not vals:
+                continue
+            for suffix, v in (("min", min(vals)),
+                              ("med", _median(vals)),
+                              ("max", max(vals))):
+                key = "g/%s/%s" % (name, suffix)
+                h = self._pub.get(key)
+                if h is None:
+                    h = self._pub[key] = reg.gauge(
+                        "fleet/%s/%s" % (name, suffix))
+                h.set(v)
+        for name, fh in self._hists.items():
+            if not fh["n"]:
+                continue
+            for q, label in ((0.50, "p50"), (0.99, "p99")):
+                key = "p/%s/%s" % (name, label)
+                h = self._pub.get(key)
+                if h is None:
+                    h = self._pub[key] = reg.gauge(
+                        "fleet/%s/%s" % (name, label))
+                p = hist_percentile(fh["b"], fh["c"], q)
+                if p is not None and not math.isinf(p):
+                    h.set(p)
+        strag = self.detector.hosts()
+        for host, s in live.items():
+            derived = {
+                "step_time_s": s.window_vals[-1] if s.window_vals
+                else None,
+                "goodput_ratio": s.goodput.get("ratio"),
+                "queue_depth": s.queue_depth,
+                "straggler": 1.0 if host in strag else 0.0,
+            }
+            for name in _HOST_GAUGES:
+                v = derived.get(name)
+                if v is None:
+                    continue
+                key = "h/%s/%s" % (host, name)
+                h = self._pub.get(key)
+                if h is None:
+                    h = self._pub[key] = reg.gauge(
+                        "fleet/host/%s/%s" % (host, name))
+                h.set(v)
+        wall = self._goodput["wall"]
+        for key, v in (("fleet/goodput_ratio",
+                        self._goodput["compute"] / wall if wall > 0
+                        else None),
+                       ("fleet/hosts", float(len(live))),
+                       ("fleet/stragglers", float(len(strag))),
+                       ("fleet/alerts_active",
+                        float(len(self.engine.active())))):
+            if v is None:
+                continue
+            h = self._pub.get(key)
+            if h is None:
+                h = self._pub[key] = reg.gauge(key)
+            h.set(v)

@@ -1,0 +1,223 @@
+"""Declarative SLO alerting over the fleet view (ISSUE 19).
+
+An :class:`AlertRule` is (metric selector, comparison, threshold,
+``for_seconds`` hysteresis, severity).  The :class:`AlertEngine`
+evaluates every rule against the :meth:`FleetAggregator.fleet_view`
+dict each time a digest lands and drives a **firing -> resolved**
+lifecycle per ``(rule, host)``:
+
+* a breached condition becomes *pending*; it FIRES only after holding
+  continuously for ``for_seconds`` (hysteresis — one slow heartbeat
+  window must not page anyone);
+* a firing alert emits exactly ONE ``alert`` JSONL event (deduped —
+  re-evaluations while it stays breached are silent) and counts into
+  the ``alerts/`` counter family (``alerts/fired``, per-severity
+  ``alerts/severity/<sev>``);
+* when the condition clears (or its host vanishes from the view), the
+  alert RESOLVES — one ``alert`` event with ``state=resolved``,
+  ``alerts/resolved`` counted — and re-arms: a fresh breach starts a
+  fresh pending window.
+
+Metric selectors (strings, resolved against the view):
+
+========================  ==================================================
+``goodput_ratio``          fleet compute/wall ratio
+``p50:<hist>``/``p99:<hist>``  exact merged-histogram percentile
+``counter:<name>``         fleet counter total
+``host:step_time``         per-host latest step wall-time window mean
+``host:queue_depth``       per-host serving queue depth
+``host:digest_age``        seconds since the host's last digest landed
+``host:straggler``         1.0 while the straggler detector flags the host
+``host:checkpoint_age``    seconds since checkpoint activity (hosts that
+                           have checkpointed at least once)
+``host:lease_expired``     1.0 while an expired member's tombstone stands
+``host:quarantined``       1.0 while a quarantined replica's stands
+========================  ==================================================
+
+``default_rules()`` covers the six conditions the ISSUE names:
+goodput-ratio collapse, p99 over the SLO target, replica quarantine,
+lease expiry, straggler persistence, and checkpoint staleness — plus a
+digest-staleness rule (a peer going dark is the first thing the
+watchdog satellite wants named).
+"""
+
+import time
+
+__all__ = ["AlertRule", "AlertEngine", "default_rules"]
+
+_OPS = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+    "==": lambda v, t: v == t,
+}
+
+SEVERITIES = ("info", "warning", "critical")
+
+
+class AlertRule:
+    """One declarative rule.  ``metric`` is a selector string (table in
+    the module docstring); per-host selectors yield one independent
+    alert lifecycle per host."""
+
+    def __init__(self, name, metric, threshold, op=">", for_seconds=0.0,
+                 severity="warning"):
+        if op not in _OPS:
+            raise ValueError("op must be one of %s, got %r"
+                             % (sorted(_OPS), op))
+        if severity not in SEVERITIES:
+            raise ValueError("severity must be one of %s, got %r"
+                             % (SEVERITIES, severity))
+        self.name = str(name)
+        self.metric = str(metric)
+        self.threshold = float(threshold)
+        self.op = op
+        self.for_seconds = float(for_seconds)
+        self.severity = severity
+
+    def __repr__(self):
+        return "AlertRule(%s: %s %s %g for %gs, %s)" % (
+            self.name, self.metric, self.op, self.threshold,
+            self.for_seconds, self.severity)
+
+    def resolve(self, view):
+        """{key: value} — fleet-level selectors use the ``""`` key,
+        per-host selectors one key per host.  Missing data resolves to
+        no entry (absence never fires; ``digest_age`` ages are computed
+        by the view itself, so a dark host still surfaces)."""
+        m = self.metric
+        hosts = view.get("hosts") or {}
+        if m == "goodput_ratio":
+            v = view.get("goodput_ratio")
+            return {} if v is None else {"": v}
+        if m.startswith(("p50:", "p99:")):
+            q, name = m.split(":", 1)
+            p = (view.get("percentiles") or {}).get(name)
+            v = p.get(q) if p else None
+            return {} if v is None else {"": v}
+        if m.startswith("counter:"):
+            v = (view.get("counters") or {}).get(m[len("counter:"):])
+            return {} if v is None else {"": v}
+        if m == "host:straggler":
+            return {h: 1.0 if d.get("straggler") else 0.0
+                    for h, d in hosts.items()}
+        if m == "host:lease_expired":
+            return {h: 1.0 for h in (view.get("expired") or {})}
+        if m == "host:quarantined":
+            return {h: 1.0 for h in (view.get("quarantined") or {})}
+        if m.startswith("host:"):
+            field = {"step_time": "step_time_s",
+                     "digest_age": "digest_age_s",
+                     "queue_depth": "queue_depth",
+                     "checkpoint_age": "checkpoint_age_s",
+                     "goodput_ratio": "goodput_ratio"}.get(m[5:])
+            if field is None:
+                return {}
+            return {h: d[field] for h, d in hosts.items()
+                    if d.get(field) is not None}
+        return {}
+
+
+class AlertEngine:
+    """Evaluates rules against successive views; owns the firing state.
+    Single-threaded by contract (the aggregator calls it under its own
+    lock); ``active()`` returns copies."""
+
+    def __init__(self, rules, clock=time.time):
+        self.rules = list(rules)
+        self._clock = clock
+        self._pending = {}       # (rule_name, key) -> breach start ts
+        self._active = {}        # (rule_name, key) -> alert dict
+
+    def evaluate(self, view, now=None):
+        """One evaluation pass; returns the ``alert`` event records for
+        this pass's transitions (firing + resolved), already counted
+        into the ``alerts/`` family.  The caller logs them."""
+        from .. import monitor
+
+        now = self._clock() if now is None else now
+        events = []
+        for rule in self.rules:
+            vals = rule.resolve(view)
+            cmp_fn = _OPS[rule.op]
+            for key, v in vals.items():
+                k = (rule.name, key)
+                if v is not None and cmp_fn(v, rule.threshold):
+                    since = self._pending.setdefault(k, now)
+                    if k not in self._active \
+                            and now - since >= rule.for_seconds:
+                        alert = {"rule": rule.name,
+                                 "severity": rule.severity,
+                                 "metric": rule.metric,
+                                 "member_id": key or None,
+                                 "value": v,
+                                 "threshold": rule.threshold,
+                                 "since": round(since, 3),
+                                 "fired_at": round(now, 3)}
+                        self._active[k] = alert
+                        monitor.count("alerts/fired")
+                        monitor.count("alerts/severity/" + rule.severity)
+                        events.append(dict(alert, event="alert",
+                                           state="firing", ts=now))
+                else:
+                    self._pending.pop(k, None)
+                    events.extend(self._resolve(k, now, value=v))
+            # an active alert whose key left the view resolves too (the
+            # expired host rejoined; the straggler's host dropped)
+            for k in [k for k in list(self._active)
+                      if k[0] == rule.name and k[1] not in vals]:
+                self._pending.pop(k, None)
+                events.extend(self._resolve(k, now, value=None))
+        if monitor.enabled():
+            monitor.registry().gauge("alerts/active").set(
+                float(len(self._active)))
+        return events
+
+    def _resolve(self, k, now, value=None):
+        from .. import monitor
+
+        alert = self._active.pop(k, None)
+        if alert is None:
+            return []
+        monitor.count("alerts/resolved")
+        return [dict(alert, event="alert", state="resolved", ts=now,
+                     value=value,
+                     active_s=round(now - alert["fired_at"], 3))]
+
+    def active(self):
+        """Currently-firing alerts (copies), most severe first."""
+        order = {s: i for i, s in enumerate(reversed(SEVERITIES))}
+        return sorted((dict(a) for a in self._active.values()),
+                      key=lambda a: (order.get(a["severity"], 9),
+                                     a["rule"], a["member_id"] or ""))
+
+
+def default_rules(goodput_min=0.5, slo_p99_s=2.5,
+                  latency_hist="serving/request_latency_seconds",
+                  straggler_for_s=10.0, ckpt_max_age_s=900.0,
+                  digest_stale_s=30.0, goodput_for_s=30.0,
+                  p99_for_s=15.0):
+    """The stock rule set (ISSUE 19): every threshold is a parameter so
+    operators (and the CI drill) tighten them without subclassing.
+    The checkpoint-staleness bound defaults to 15 minutes — wider than
+    any cadence the CheckFreq autotune picks; pass the tuned interval
+    times a safety factor for a sharper rule."""
+    return [
+        AlertRule("goodput_collapse", "goodput_ratio", goodput_min,
+                  op="<", for_seconds=goodput_for_s, severity="critical"),
+        AlertRule("p99_over_slo", "p99:" + latency_hist, slo_p99_s,
+                  op=">", for_seconds=p99_for_s, severity="critical"),
+        AlertRule("replica_quarantined", "host:quarantined", 0.5,
+                  op=">", for_seconds=0.0, severity="critical"),
+        AlertRule("lease_expired", "host:lease_expired", 0.5,
+                  op=">", for_seconds=0.0, severity="critical"),
+        AlertRule("straggler", "host:straggler", 0.5,
+                  op=">", for_seconds=straggler_for_s,
+                  severity="warning"),
+        AlertRule("checkpoint_stale", "host:checkpoint_age",
+                  ckpt_max_age_s, op=">", for_seconds=0.0,
+                  severity="warning"),
+        AlertRule("digest_stale", "host:digest_age", digest_stale_s,
+                  op=">", for_seconds=0.0, severity="warning"),
+    ]

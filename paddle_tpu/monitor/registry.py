@@ -203,6 +203,13 @@ class MetricsRegistry:
         with self._mu:
             return sorted(self._metrics)
 
+    def metrics(self):
+        """The live metric objects (one lock, no copies) — the digest
+        builder's cheap iteration path: reading each metric's value is
+        a per-metric lock, not a full snapshot() dict build."""
+        with self._mu:
+            return list(self._metrics.values())
+
     def snapshot(self):
         """{name: metric snapshot dict} for the JSONL/console exporters."""
         with self._mu:

@@ -179,6 +179,12 @@ class FleetMaster(ClusterMaster):
                 while len(self._quarantined) > 64:
                     self._quarantined.popitem(last=False)
                 self.fleet_metrics.count("quarantined_replicas")
+                if self._telemetry is not None:
+                    try:
+                        # feeds the replica-quarantine alert rule
+                        self._telemetry.note_quarantined(host)
+                    except Exception:
+                        pass
             if orphans:
                 self.fleet_metrics.count("orphaned", len(orphans))
             self._event({"event": "fleet_replica_quarantined",
@@ -249,9 +255,20 @@ class FleetMaster(ClusterMaster):
                 if affinity:
                     choice = pinned
             if choice is None:
+                # straggler verdicts (fleet telemetry) are a SOFT
+                # deprioritization: a flagged replica loses score ties
+                # but still serves when it is genuinely least loaded —
+                # quarantine stays lease-driven
+                strag = ()
+                if self._telemetry is not None:
+                    try:
+                        strag = self._telemetry.straggler_hosts()
+                    except Exception:
+                        strag = ()
                 # sorted first: equal scores break deterministically
                 choice = min(sorted(pick_from),
-                             key=lambda h: self._score(pick_from[h]))
+                             key=lambda h: (self._score(pick_from[h]),
+                                            h in strag))
             if session_id:
                 self._sessions[session_id] = choice
             if asn is None:

@@ -1,0 +1,235 @@
+"""Multi-process fleet telemetry drill (ISSUE 19): members push
+MetricDigests over real heartbeat RPC, the master merges them, a
+``delay_dispatch`` fault slows ONE member mid-run, and the straggler
+alert fires with that member's id — then resolves after the fault
+window disarms.
+
+Used two ways:
+* ``tools/run_ci.sh`` step 19 drives ``supervise`` from the CLI;
+* ``tests/test_fleet_telemetry.py`` wraps the same supervisor in a
+  slow-marked test.
+
+Modes (argv):
+    member    <workdir> <host_id> <master_addr> [slow]
+    supervise <workdir> [members]
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# short lease so digest windows (lease/3 heartbeats) are quick; long
+# enough that a GC pause or a loaded CI box cannot expire a live member
+LEASE_SECONDS = 4.0
+# the fault window on the slow member: executor steps [30, 70) each pay
+# an extra DELAY_S at dispatch, then the drill disarms by schedule
+SLOW_STEPS = tuple(range(30, 70))
+DELAY_S = 0.25
+PACE_S = 0.04
+
+
+def _build_mlp():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def member(workdir, host_id, master_addr, slow=False):
+    """One training member: monitored tiny-MLP step loop, fleet
+    telemetry on (digests ride the auto-heartbeat), paced so digest
+    windows hold a steady step rate.  Runs until the supervisor drops
+    the stop file."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import fault, monitor
+    from paddle_tpu.cluster.runtime import ClusterMember
+    from paddle_tpu.monitor import aggregate
+
+    monitor.enable(log_dir=os.path.join(workdir, host_id))
+    aggregate.enable()
+    if slow:
+        fault.delay_dispatch(DELAY_S,
+                             fault.FaultSchedule(steps=SLOW_STEPS))
+    main, startup, loss = _build_mlp()
+    stop = os.path.join(workdir, "stop")
+    rng = np.random.RandomState(0)
+    mem = ClusterMember(master_addr, host_id)
+    scope = fluid.Scope()
+    try:
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            exe = fluid.Executor(fluid.CPUPlace())
+            for _ in range(4000):
+                if os.path.exists(stop):
+                    break
+                feed = {"x": rng.rand(4, 8).astype("float32"),
+                        "label": rng.randint(0, 4, (4, 1))
+                        .astype("int64")}
+                exe.run(main, feed=feed, fetch_list=[loss])
+                time.sleep(PACE_S)
+    finally:
+        mem.leave()
+    return 0
+
+
+def _load_jsonl(log_dir):
+    records = []
+    for f in sorted(glob.glob(os.path.join(log_dir, "*.jsonl"))
+                    + glob.glob(os.path.join(log_dir, "*.jsonl.*"))):
+        with open(f) as fh:
+            for ln in fh:
+                try:
+                    records.append(json.loads(ln))
+                except ValueError:
+                    continue
+    return records
+
+
+def _active_alert(agg, rule):
+    for a in agg.fleet_view()["alerts"]:
+        if a["rule"] == rule:
+            return a
+    return None
+
+
+def _wait(pred, timeout, poll=0.5):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    return None
+
+
+def supervise(workdir, members=3):
+    """The drill: in-process master + aggregator + /metrics endpoint,
+    ``members`` subprocess trainers (index 0 slow), asserts the
+    acceptance evidence and returns it."""
+    from paddle_tpu import monitor
+    from paddle_tpu.cloud import MasterServer
+    from paddle_tpu.cluster.membership import ClusterMaster
+    from paddle_tpu.monitor import aggregate, alerts
+
+    os.makedirs(workdir, exist_ok=True)
+    master_logs = os.path.join(workdir, "master")
+    monitor.enable(log_dir=master_logs)
+    master = ClusterMaster(lease_timeout=LEASE_SECONDS)
+    agg = aggregate.FleetAggregator(
+        master=master,
+        rules=alerts.default_rules(straggler_for_s=1.0,
+                                   digest_stale_s=6.0 * LEASE_SECONDS))
+    srv = MasterServer(master).start()
+    http = monitor.start_http_server(0, monitor.expose_text)
+    stop = os.path.join(workdir, "stop")
+    procs = []
+    t0 = time.monotonic()
+    try:
+        for i in range(members):
+            cmd = [sys.executable, os.path.abspath(__file__), "member",
+                   workdir, "m-%d" % i, srv.address]
+            if i == 0:
+                cmd.append("slow")
+            procs.append(subprocess.Popen(
+                cmd, env=dict(os.environ, JAX_PLATFORMS="cpu")))
+
+        all_report = _wait(
+            lambda: len(agg.fleet_view()["hosts"]) >= members, 120)
+        assert all_report, "not all members pushed digests"
+        hosts_reporting = len(agg.fleet_view()["hosts"])
+
+        fired = _wait(lambda: _active_alert(agg, "straggler"), 120)
+        assert fired, "straggler alert never fired"
+        assert fired["member_id"] == "m-0", fired
+        fired_after_s = time.monotonic() - t0
+
+        # merged fleet series on the master's own /metrics endpoint
+        port = http.server_address[1]
+        text = urllib.request.urlopen(
+            "http://127.0.0.1:%d/metrics" % port, timeout=10) \
+            .read().decode("utf-8")
+        assert "fleet_hosts" in text, "no merged fleet series on /metrics"
+        fleet_series = sorted({ln.split(None, 1)[0] for ln in
+                               text.splitlines()
+                               if ln.startswith("fleet_")
+                               and not ln.startswith("# ")})
+
+        # the fault schedule disarms itself after step 70: the slow
+        # member's windows return in-band and the alert must resolve
+        resolved = _wait(
+            lambda: _active_alert(agg, "straggler") is None, 180)
+        assert resolved, "straggler alert never resolved after disarm"
+
+        open(stop, "w").close()
+        for p in procs:
+            p.wait(timeout=60)
+
+        recs = _load_jsonl(master_logs)
+        alert_recs = [r for r in recs if r.get("event") == "alert"
+                      and r.get("rule") == "straggler"]
+        states = [r["state"] for r in alert_recs]
+        assert "firing" in states and "resolved" in states, states
+        assert all(r.get("member_id") == "m-0" for r in alert_recs)
+        view = agg.fleet_view()
+        evidence = {
+            "members": members,
+            "straggler_member": "m-0",
+            "fired_after_s": round(fired_after_s, 1),
+            "alert_jsonl": {"firing": states.count("firing"),
+                            "resolved": states.count("resolved")},
+            "fleet_series": fleet_series[:12],
+            "fleet_view_records": sum(
+                1 for r in recs if r.get("event") == "fleet_view"),
+            "hosts_reporting": hosts_reporting,
+            "goodput_ratio": view["goodput_ratio"],
+            "member_rcs": [p.returncode for p in procs],
+        }
+        assert evidence["fleet_view_records"] >= 1
+        assert all(rc == 0 for rc in evidence["member_rcs"]), evidence
+        return evidence
+    finally:
+        open(stop, "w").close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        srv.shutdown()
+        http.shutdown()
+        monitor.disable()
+        aggregate.disable()
+
+
+def main(argv):
+    mode = argv[0]
+    if mode == "member":
+        workdir, host_id, addr = argv[1:4]
+        return member(workdir, host_id, addr,
+                      slow="slow" in argv[4:])
+    if mode == "supervise":
+        workdir = argv[1]
+        members = int(argv[2]) if len(argv) > 2 else 3
+        evidence = supervise(workdir, members=members)
+        print(json.dumps(evidence, indent=2, sort_keys=True))
+        print("FLEET TELEMETRY OK")
+        return 0
+    raise SystemExit("unknown mode %r" % mode)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

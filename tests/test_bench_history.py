@@ -208,6 +208,27 @@ def test_fleet_fields_index_without_gating(tmp_path):
     assert report["overall"] == "PASS"
 
 
+def test_fleet_telemetry_fields_index_without_gating(tmp_path):
+    """ISSUE 19: digest_build_us / straggler_detect_windows (the fleet
+    telemetry rung's digest-cost and detection-latency pair) are
+    indexed and judged against history but NEVER gate — microsecond
+    timings swing with CI host load."""
+    assert "digest_build_us" in bench_history.INFORMATIONAL_FIELDS
+    assert "straggler_detect_windows" in bench_history.INFORMATIONAL_FIELDS
+    base = _rung("fleet_telemetry", 40.0, step_s=0.1,
+                 digest_build_us=40.0, straggler_detect_windows=1)
+    worse = dict(base, digest_build_us=300.0, straggler_detect_windows=8)
+    runs = [bench_history.load_artifact(
+        _write(tmp_path, "t%d.json" % i, _wrapper(i + 1, r)), i)
+        for i, r in enumerate((base, worse))]
+    report = bench_history.compare(runs, noise=0.05)
+    comps = report["runs"][1]["comparisons"]
+    for f in ("digest_build_us", "straggler_detect_windows"):
+        c = next(c for c in comps if c["field"] == f)
+        assert c["verdict"] == "REGRESSED" and c["informational"], c
+    assert report["overall"] == "PASS"
+
+
 def test_bare_schema_v2_artifact_ingests_with_goodput(tmp_path):
     """A fresh bench.py artifact (bare JSON line, schema_version 2,
     run_id, embedded goodput) ingests as a comparable run keyed after

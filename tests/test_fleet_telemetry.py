@@ -1,0 +1,641 @@
+"""Fleet telemetry plane (ISSUE 19): digest building/commit, exact
+merged percentiles, fake-clock aggregator semantics, straggler
+detection, alert lifecycle, routing deprioritization, and the
+disabled-path zero-cost A/B.
+
+Tier-1 coverage is all fake-clock/direct-service; the multi-process
+``delay_dispatch`` straggler drill (``fleet_telemetry_runner``) is
+slow-marked and also driven by ``tools/run_ci.sh`` step 19."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from paddle_tpu import monitor                              # noqa: E402
+from paddle_tpu.cluster.membership import ClusterMaster     # noqa: E402
+from paddle_tpu.cluster.runtime import ClusterMember        # noqa: E402
+from paddle_tpu.monitor import aggregate, alerts            # noqa: E402
+from paddle_tpu.monitor.registry import (DEFAULT_BUCKETS,   # noqa: E402
+                                         MetricsRegistry)
+from paddle_tpu.serving.fleet import FleetMaster            # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off_after():
+    yield
+    aggregate.disable()
+    monitor.disable()
+    monitor.registry().reset()
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _digest(host, seq, ts, counters=None, gauges=None, hists=None,
+            steps=None, goodput=None, run="run-1"):
+    d = {"v": 1, "seq": seq, "host": host, "ts": ts, "run": run,
+         "counters": counters or {}, "gauges": gauges or {},
+         "hists": hists or {}, "steps": steps or []}
+    if goodput is not None:
+        d["goodput"] = goodput
+    return d
+
+
+def _hist_payload(reg_hist):
+    s = reg_hist.snapshot()
+    return {"b": s["buckets"], "c": s["counts"], "sum": s["sum"],
+            "n": s["count"]}
+
+
+# ---------------------------------------------------------------------------
+# exact percentiles: merged == pooled, bit-equal
+# ---------------------------------------------------------------------------
+
+def test_merged_percentiles_bit_equal_to_pooled():
+    import random
+
+    rng = random.Random(7)
+    per_host = {"h%d" % i: [rng.uniform(0.0001, 12.0)
+                            for _ in range(200 + 50 * i)]
+                for i in range(4)}
+    clock = _Clock()
+    agg = aggregate.FleetAggregator(clock=clock)
+    pooled = MetricsRegistry().histogram("lat")
+    for seq, (host, vals) in enumerate(sorted(per_host.items()), 1):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")
+        for v in vals:
+            h.observe(v)
+            pooled.observe(v)
+        agg.ingest(host, _digest(host, 1, clock.t,
+                                 hists={"lat": _hist_payload(h)}))
+    snap = pooled.snapshot()
+    for q in (0.5, 0.9, 0.99, 0.999):
+        want = aggregate.hist_percentile(snap["buckets"], snap["counts"],
+                                         q)
+        assert agg.percentile("lat", q) == want
+    view = agg.fleet_view()
+    assert view["percentiles"]["lat"]["count"] == \
+        sum(len(v) for v in per_host.values())
+    assert view["percentiles"]["lat"]["p50"] == aggregate.hist_percentile(
+        snap["buckets"], snap["counts"], 0.5)
+
+
+def test_hist_percentile_edges():
+    bounds = list(DEFAULT_BUCKETS)
+    counts = [0] * (len(bounds) + 1)
+    assert aggregate.hist_percentile(bounds, counts, 0.5) is None
+    counts[-1] = 3      # everything in the +Inf overflow slot
+    assert aggregate.hist_percentile(bounds, counts, 0.99) == \
+        float("inf")
+    counts = [1] + [0] * len(bounds)
+    assert aggregate.hist_percentile(bounds, counts, 0.5) == bounds[0]
+
+
+# ---------------------------------------------------------------------------
+# DigestBuilder: delta snapshots, commit-on-delivery, size guard
+# ---------------------------------------------------------------------------
+
+def test_digest_builder_changed_only_and_commit():
+    reg = MetricsRegistry()
+    clock = _Clock()
+    b = aggregate.DigestBuilder("h0", registry=reg, clock=clock)
+    reg.counter("steps").inc(3)
+    reg.gauge("depth").set(2.0)
+    reg.histogram("lat").observe(0.2)
+    d1 = b.build()
+    assert d1["seq"] == 1 and d1["host"] == "h0"
+    assert d1["counters"] == {"steps": 3.0}
+    assert d1["gauges"] == {"depth": 2.0}
+    assert set(d1["hists"]) == {"lat"}
+    # NOT committed: the next build re-ships the same still-undelivered
+    # values (a lost heartbeat loses nothing)
+    d2 = b.build()
+    assert d2["seq"] == 2 and d2["counters"] == {"steps": 3.0}
+    assert set(d2["hists"]) == {"lat"}
+    # commit seq 2 (subsumes 1); nothing changed -> empty delta
+    assert b.committed(2)
+    d3 = b.build()
+    assert d3["counters"] == {} and d3["gauges"] == {} \
+        and d3["hists"] == {}
+    # only the moved metric ships after the baseline
+    reg.counter("steps").inc()
+    b.committed(3)
+    d4 = b.build()
+    assert d4["counters"] == {"steps": 4.0} and d4["hists"] == {}
+
+
+def test_digest_builder_rebaselines_on_registry_reset():
+    reg = MetricsRegistry()
+    b = aggregate.DigestBuilder("h0", registry=reg)
+    reg.counter("steps").inc(5)
+    b.committed(b.build()["seq"])
+    assert b.build()["counters"] == {}
+    reg.reset()
+    reg.counter("steps").inc(2)
+    # generation moved: committed views drop, everything re-ships
+    assert b.build()["counters"] == {"steps": 2.0}
+
+
+def test_digest_size_guard_decimates_and_counts():
+    reg = MetricsRegistry()
+    clock = _Clock()
+    for i in range(40):
+        h = reg.histogram("hist/%02d" % i)
+        for _ in range(i + 1):
+            h.observe(0.01)
+    b = aggregate.DigestBuilder("h0", registry=reg, max_bytes=2048,
+                                clock=clock)
+    for i in range(64):
+        aggregate.note_step_time(0.01, now=clock.t + i)
+    d = b.build()
+    assert d.get("trunc") is True
+    assert b.truncations == 1
+    assert len(json.dumps(d, separators=(",", ":"))) <= 2048
+    # newest step samples survive the decimation, lowest-n histograms
+    # dropped first (the survivors are the highest-traffic ones)
+    if d["steps"]:
+        assert d["steps"][-1][0] == clock.t + 63
+    if d["hists"]:
+        kept_n = min(h["n"] for h in d["hists"].values())
+        assert kept_n > 1
+    # the enabled-gated counter lands when the master monitors
+    monitor.enable()
+    b2 = aggregate.DigestBuilder("h1", registry=reg, max_bytes=2048,
+                                 clock=clock)
+    b2.build()
+    assert monitor.registry().get("fleet/digest_truncated").value >= 1
+    aggregate._STEP_RING.clear()
+
+
+# ---------------------------------------------------------------------------
+# FleetAggregator: ordering, death, restart, goodput (fake clock)
+# ---------------------------------------------------------------------------
+
+def test_late_and_duplicate_digests_dropped():
+    clock = _Clock()
+    agg = aggregate.FleetAggregator(clock=clock)
+    assert agg.ingest("a", _digest("a", 2, clock.t,
+                                   counters={"steps": 5.0}))
+    # duplicate and out-of-order deliveries fold nothing twice
+    assert not agg.ingest("a", _digest("a", 2, clock.t,
+                                       counters={"steps": 5.0}))
+    assert not agg.ingest("a", _digest("a", 1, clock.t,
+                                       counters={"steps": 3.0}))
+    assert agg.fleet_view()["counters"]["steps"] == 5.0
+    # the next new seq folds only the cumulative difference
+    assert agg.ingest("a", _digest("a", 3, clock.t,
+                                   counters={"steps": 7.0}))
+    assert agg.fleet_view()["counters"]["steps"] == 7.0
+
+
+def test_member_death_drops_gauges_keeps_counters():
+    clock = _Clock()
+    agg = aggregate.FleetAggregator(clock=clock)
+    agg.ingest("a", _digest("a", 1, clock.t, counters={"steps": 10.0},
+                            gauges={"depth": 3.0}))
+    agg.ingest("b", _digest("b", 1, clock.t, counters={"steps": 4.0},
+                            gauges={"depth": 1.0}))
+    agg.note_expired(["a"])
+    view = agg.fleet_view()
+    assert sorted(view["hosts"]) == ["b"]          # gauges/state dropped
+    assert view["counters"]["steps"] == 14.0       # contributions stay
+    assert "a" in view["expired"]
+    # the lease-expiry alert fired for the dead member...
+    assert any(a["rule"] == "lease_expired" and a["member_id"] == "a"
+               for a in view["alerts"])
+    # ...and resolves when the host rejoins (fresh digest clears the
+    # tombstone)
+    agg.ingest("a", _digest("a", 2, clock.t, counters={"steps": 11.0}))
+    view = agg.fleet_view()
+    assert not any(a["rule"] == "lease_expired" for a in view["alerts"])
+    assert view["counters"]["steps"] == 15.0
+
+
+def test_member_restart_rebaselines_without_double_count():
+    clock = _Clock()
+    agg = aggregate.FleetAggregator(clock=clock)
+    agg.ingest("a", _digest("a", 5, clock.t, counters={"steps": 100.0},
+                            run="run-1"))
+    # restarted process: new run token, seq resets, counters restart —
+    # the fresh cumulative value folds as NEW contribution, the old
+    # run's contribution stays (it happened)
+    agg.ingest("a", _digest("a", 1, clock.t, counters={"steps": 3.0},
+                            run="run-2"))
+    assert agg.fleet_view()["counters"]["steps"] == 103.0
+
+
+def test_fleet_goodput_ratio_merges_across_hosts():
+    clock = _Clock()
+    agg = aggregate.FleetAggregator(clock=clock)
+    agg.ingest("a", _digest("a", 1, clock.t, goodput={
+        "compute": 8.0, "wall": 10.0, "ratio": 0.8, "steps": 10}))
+    agg.ingest("b", _digest("b", 1, clock.t, goodput={
+        "compute": 2.0, "wall": 10.0, "ratio": 0.2, "steps": 10}))
+    view = agg.fleet_view()
+    assert view["goodput_ratio"] == pytest.approx(0.5)
+    assert view["hosts"]["a"]["goodput_ratio"] == 0.8
+    # cumulative growth folds only the delta
+    agg.ingest("a", _digest("a", 2, clock.t, goodput={
+        "compute": 9.0, "wall": 11.0, "ratio": 9.0 / 11.0,
+        "steps": 11}))
+    assert agg.fleet_view()["goodput_ratio"] == \
+        pytest.approx(11.0 / 21.0, abs=1e-4)    # view rounds to 4 places
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+def test_straggler_detector_flags_and_clears():
+    det = aggregate.StragglerDetector(zmax=8.0, persist=2, min_hosts=3)
+    slow = {"a": 0.1, "b": 0.1, "c": 0.1, "d": 2.0}
+    fast = {"a": 0.1, "b": 0.1, "c": 0.1, "d": 0.1}
+    assert det.update({"step_time": slow}, 1.0) == set()   # persist=2
+    assert det.update({"step_time": slow}, 2.0) == {"d"}
+    assert det.verdicts()["d"]["series"] == "step_time"
+    assert det.verdicts()["d"]["z"] > 8.0
+    # first in-band window clears the flag
+    assert det.update({"step_time": fast}, 3.0) == set()
+    # below min_hosts: no verdicts even for a wild outlier
+    assert det.update({"step_time": {"a": 0.1, "d": 50.0}}, 4.0) == set()
+
+
+def test_straggler_detector_saturated_window_no_false_positive():
+    det = aggregate.StragglerDetector(persist=1)
+    # every host bit-identical: MAD == 0, the relative floor keeps z
+    # finite and in-band (the guardian's saturated-window lesson)
+    same = {"a": 0.5, "b": 0.5, "c": 0.5, "d": 0.5}
+    assert det.update({"step_time": same}, 1.0) == set()
+
+
+def test_aggregator_detects_straggler_from_digests_and_alerts():
+    clock = _Clock()
+    rules = alerts.default_rules(straggler_for_s=0.0)
+    agg = aggregate.FleetAggregator(clock=clock, rules=rules,
+                                    stale_after=60.0)
+    hosts = {"a": 0.01, "b": 0.01, "c": 0.5}
+    for rnd in range(1, 3):
+        for h, sec in sorted(hosts.items()):
+            agg.ingest(h, _digest(h, rnd, clock.t,
+                                  steps=[[clock.t, sec]]))
+        clock.t += 5.0
+    assert agg.straggler_hosts() == frozenset({"c"})
+    view = agg.fleet_view()
+    assert view["hosts"]["c"]["straggler"] and view["hosts"]["c"]["z"]
+    firing = [a for a in view["alerts"] if a["rule"] == "straggler"]
+    assert firing and firing[0]["member_id"] == "c"
+    # recovery: in-band windows clear the verdict and resolve the alert
+    for rnd in range(3, 5):
+        for h in sorted(hosts):
+            agg.ingest(h, _digest(h, rnd, clock.t,
+                                  steps=[[clock.t, 0.01]]))
+        clock.t += 5.0
+    assert agg.straggler_hosts() == frozenset()
+    assert not [a for a in agg.fleet_view()["alerts"]
+                if a["rule"] == "straggler"]
+
+
+# ---------------------------------------------------------------------------
+# alert engine lifecycle
+# ---------------------------------------------------------------------------
+
+def _view_with_goodput(ratio):
+    return {"hosts": {}, "goodput_ratio": ratio, "counters": {},
+            "percentiles": {}, "stragglers": {}, "expired": {},
+            "quarantined": {}}
+
+
+def test_alert_fires_once_with_hysteresis_and_rearms():
+    clock = _Clock()
+    rule = alerts.AlertRule("goodput_collapse", "goodput_ratio", 0.5,
+                            op="<", for_seconds=10.0,
+                            severity="critical")
+    eng = alerts.AlertEngine([rule], clock=clock)
+    # breach starts the pending window; no fire before for_seconds
+    assert eng.evaluate(_view_with_goodput(0.2), clock.t) == []
+    clock.t += 5.0
+    assert eng.evaluate(_view_with_goodput(0.2), clock.t) == []
+    # a recovery inside the window re-arms the hysteresis entirely
+    clock.t += 1.0
+    assert eng.evaluate(_view_with_goodput(0.9), clock.t) == []
+    clock.t += 1.0
+    assert eng.evaluate(_view_with_goodput(0.2), clock.t) == []
+    # held for the full window: exactly ONE firing event, deduped after
+    clock.t += 10.0
+    evs = eng.evaluate(_view_with_goodput(0.2), clock.t)
+    assert [e["state"] for e in evs] == ["firing"]
+    assert evs[0]["rule"] == "goodput_collapse"
+    assert evs[0]["severity"] == "critical"
+    assert evs[0]["member_id"] is None
+    assert eng.evaluate(_view_with_goodput(0.2), clock.t + 1.0) == []
+    assert len(eng.active()) == 1
+    # resolve emits once and re-arms: a fresh breach needs a fresh
+    # for_seconds window before firing again
+    clock.t += 5.0
+    evs = eng.evaluate(_view_with_goodput(0.9), clock.t)
+    assert [e["state"] for e in evs] == ["resolved"]
+    assert eng.active() == []
+    evs = eng.evaluate(_view_with_goodput(0.2), clock.t)
+    assert evs == []
+    clock.t += 10.0
+    evs = eng.evaluate(_view_with_goodput(0.2), clock.t)
+    assert [e["state"] for e in evs] == ["firing"]
+
+
+def test_per_host_alerts_and_key_vanish_resolution():
+    clock = _Clock()
+    rule = alerts.AlertRule("q", "host:queue_depth", 10.0,
+                            for_seconds=0.0)
+    eng = alerts.AlertEngine([rule], clock=clock)
+    view = {"hosts": {"a": {"queue_depth": 20}, "b": {"queue_depth": 1}}}
+    evs = eng.evaluate(view, clock.t)
+    assert [(e["state"], e["member_id"]) for e in evs] == \
+        [("firing", "a")]
+    # the host leaving the view resolves its alert
+    evs = eng.evaluate({"hosts": {"b": {"queue_depth": 1}}},
+                       clock.t + 1)
+    assert [(e["state"], e["member_id"]) for e in evs] == \
+        [("resolved", "a")]
+
+
+def test_alert_counter_family():
+    monitor.enable()
+    clock = _Clock()
+    eng = alerts.AlertEngine(
+        [alerts.AlertRule("gp", "goodput_ratio", 0.5, op="<")],
+        clock=clock)
+    eng.evaluate(_view_with_goodput(0.1), clock.t)
+    eng.evaluate(_view_with_goodput(0.9), clock.t + 1)
+    reg = monitor.registry()
+    assert reg.get("alerts/fired").value == 1
+    assert reg.get("alerts/resolved").value == 1
+    assert reg.get("alerts/severity/warning").value == 1
+    assert reg.get("alerts/active").value == 0.0
+
+
+def test_checkpoint_staleness_and_digest_age_alerts():
+    clock = _Clock()
+    rules = alerts.default_rules(ckpt_max_age_s=100.0,
+                                 digest_stale_s=30.0)
+    agg = aggregate.FleetAggregator(clock=clock, rules=rules,
+                                    stale_after=1e9)
+    agg.ingest("a", _digest("a", 1, clock.t,
+                            counters={"checkpoint/snapshot": 1.0}))
+    agg.ingest("b", _digest("b", 1, clock.t))
+    assert agg.fleet_view()["hosts"]["a"]["checkpoint_age_s"] == 0.0
+    # host b never checkpointed: the staleness rule has nothing to
+    # measure there (no false positive)
+    assert agg.fleet_view()["hosts"]["b"]["checkpoint_age_s"] is None
+    # 200s later host a still digests (no ckpt movement) -> stale fires;
+    # host b went dark -> digest_stale names it
+    clock.t += 200.0
+    agg.ingest("a", _digest("a", 2, clock.t))
+    view = agg.fleet_view()
+    rules_firing = {(a["rule"], a["member_id"]) for a in view["alerts"]}
+    assert ("checkpoint_stale", "a") in rules_firing
+    assert ("digest_stale", "b") in rules_firing
+    # checkpoint movement (histogram count advancing also counts) and a
+    # fresh digest from b resolve both
+    agg.ingest("a", _digest("a", 3, clock.t,
+                            counters={"checkpoint/snapshot": 2.0}))
+    agg.ingest("b", _digest("b", 2, clock.t))
+    rules_firing = {a["rule"] for a in agg.fleet_view()["alerts"]}
+    assert "checkpoint_stale" not in rules_firing
+    assert "digest_stale" not in rules_firing
+
+
+# ---------------------------------------------------------------------------
+# routing deprioritization (fake-clock FleetMaster)
+# ---------------------------------------------------------------------------
+
+def _fleet_master(n, clock):
+    m = FleetMaster(lease_timeout=10.0, clock=clock)
+    for i in range(n):
+        m.join("rep-%d" % i, {"address": "127.0.0.1:%d" % (9000 + i),
+                              "kind": "generate"})
+    return m
+
+
+def test_straggler_loses_routing_ties_but_still_serves():
+    clock = _Clock()
+    master = _fleet_master(3, clock)
+    agg = aggregate.FleetAggregator(master=master, stale_after=1e9)
+
+    def route_loop(n=9):
+        got = []
+        for _ in range(n):
+            a = master.route(None, "generate", 8)
+            got.append(a["replica"])
+            master.complete(a["ticket"], a["attempt"])
+        return got
+
+    # baseline: all scores equal -> the deterministic tie-winner
+    # (sorted first) takes EVERY request
+    assert route_loop() == ["rep-0"] * 9
+    # flag rep-0 a straggler via digests (rep-0 slow step windows)
+    for rnd in range(1, 3):
+        for h, sec in (("rep-0", 0.9), ("rep-1", 0.01), ("rep-2", 0.01)):
+            agg.ingest(h, _digest(h, rnd, clock.t,
+                                  steps=[[clock.t, sec]]))
+        clock.t += 1.0
+    assert agg.straggler_hosts() == frozenset({"rep-0"})
+    # the soft deprioritization: rep-0 loses every tie now — load
+    # measurably shifts off the straggler (to rep-1, the deterministic
+    # tie-winner among the non-flagged replicas)
+    shifted = route_loop()
+    assert "rep-0" not in shifted
+    assert shifted == ["rep-1"] * 9
+    # but a straggler is NOT quarantine: when it is genuinely least
+    # loaded it still serves
+    a = master.route(None, "generate", 8)     # rep-1 busy (in-flight)
+    b = master.route(None, "generate", 8)     # rep-2 busy
+    assert {a["replica"], b["replica"]} == {"rep-1", "rep-2"}
+    c = master.route(None, "generate", 8)
+    assert c["replica"] == "rep-0"
+
+
+def test_quarantine_feeds_alert_rule():
+    clock = _Clock()
+    master = _fleet_master(2, clock)
+    agg = aggregate.FleetAggregator(master=master, stale_after=1e9)
+    agg.ingest("rep-0", _digest("rep-0", 1, clock.t))
+    agg.ingest("rep-1", _digest("rep-1", 1, clock.t))
+    clock.t += 11.0
+    # rep-1's heartbeat only: rep-0's lease expires at the sweep
+    master.heartbeat("rep-1")
+    view = agg.fleet_view()
+    firing = {(a["rule"], a["member_id"]) for a in view["alerts"]}
+    assert ("replica_quarantined", "rep-0") in firing
+    assert ("lease_expired", "rep-0") in firing
+    assert "rep-0" in view["quarantined"]
+
+
+# ---------------------------------------------------------------------------
+# transport integration: digest rides the heartbeat, /metrics, watchdog
+# ---------------------------------------------------------------------------
+
+def test_digest_rides_heartbeat_and_commits_on_delivery():
+    monitor.enable()
+    aggregate.enable()
+    master = ClusterMaster(lease_timeout=5.0)
+    agg = aggregate.FleetAggregator(master=master)
+    mem = ClusterMember(master, "hostA", auto_heartbeat=False,
+                        register_local=False)
+    monitor.count("train/steps", 3)
+    mem.heartbeat(step=1)
+    view = master.fleet_view()
+    assert view["hosts"]["hostA"]["seq"] == 1
+    assert view["counters"]["train/steps"] == 3.0
+    # delivery committed: an unchanged registry ships an empty delta
+    d = mem._digest.build()
+    assert d["counters"] == {}
+    mem.close()
+
+
+def test_fleet_series_published_to_master_metrics():
+    monitor.enable()
+    clock = _Clock()
+    agg = aggregate.FleetAggregator(clock=clock, stale_after=1e9)
+    reg_a = MetricsRegistry()
+    h = reg_a.histogram("serving/request_latency_seconds")
+    for v in (0.01, 0.02, 0.3):
+        h.observe(v)
+    agg.ingest("a", _digest("a", 1, clock.t,
+                            counters={"steps": 5.0},
+                            gauges={"depth": 2.0},
+                            hists={"serving/request_latency_seconds":
+                                   _hist_payload(h)}))
+    agg.ingest("b", _digest("b", 1, clock.t, counters={"steps": 7.0},
+                            gauges={"depth": 4.0}))
+    reg = monitor.registry()
+    assert reg.get("fleet/steps").value == 12.0
+    assert reg.get("fleet/depth/min").value == 2.0
+    assert reg.get("fleet/depth/med").value == 3.0
+    assert reg.get("fleet/depth/max").value == 4.0
+    assert reg.get("fleet/hosts").value == 2.0
+    p99 = reg.get("fleet/serving/request_latency_seconds/p99")
+    assert p99 is not None and p99.value > 0
+    text = monitor.expose_text()
+    assert "fleet_steps 12" in text
+    assert "fleet_hosts 2" in text
+
+
+def test_watchdog_stall_dump_includes_fleet_view():
+    monitor.enable()
+    aggregate.enable()
+    clock = _Clock()
+    master = ClusterMaster(lease_timeout=5.0)
+    agg = aggregate.FleetAggregator(master=master)
+    agg.ingest("peer", _digest("peer", 1, clock.t))
+    mem = ClusterMember(master, "hostA", auto_heartbeat=False)
+    mem.heartbeat()      # push hostA's own digest into the aggregator
+    try:
+        assert mem is __import__(
+            "paddle_tpu.cluster.runtime",
+            fromlist=["local_member"]).local_member()
+        diag = monitor._stall_probe()
+        fleet = diag["fleet"]
+        assert fleet is not None
+        assert set(fleet["digest_age_s"]) >= {"peer", "hostA"}
+        rendered = monitor._format_diag(dict(diag, stalled_for_s=1.0))
+        assert "fleet digest" in rendered
+    finally:
+        mem.close()
+
+
+def test_stall_probe_fleet_absent_when_disabled_or_no_member():
+    monitor.enable()
+    assert monitor._stall_probe()["fleet"] is None
+    aggregate.enable()
+    assert monitor._stall_probe()["fleet"] is None   # no local member
+
+
+# ---------------------------------------------------------------------------
+# the disabled path makes ZERO aggregation calls (raising monkeypatch)
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_zero_aggregation_calls(monkeypatch):
+    def boom(*a, **k):
+        raise AssertionError("aggregation touched on the disabled path")
+
+    monkeypatch.setattr(aggregate.DigestBuilder, "build", boom)
+    monkeypatch.setattr(aggregate.FleetAggregator, "ingest", boom)
+    monkeypatch.setattr(aggregate, "note_step_time", boom)
+    monitor.enable()
+    assert not aggregate.enabled()
+    # instrumented step path: record_step must not touch aggregation
+    monitor.record_step("executor", 0.01, 4, 0)
+    # heartbeat path: no digest built, none ingested
+    master = ClusterMaster(lease_timeout=5.0)
+    aggregate.FleetAggregator(master=master)
+    mem = ClusterMember(master, "hostA", auto_heartbeat=False,
+                        register_local=False)
+    mem.heartbeat(step=1)
+    mem.close()
+    # control: with the flag ON the same calls DO hit the patched
+    # functions — proving the A/B measured the real sites
+    aggregate.enable()
+    with pytest.raises(AssertionError, match="disabled path"):
+        monitor.record_step("executor", 0.01, 4, 0)
+    mem2 = ClusterMember(master, "hostB", auto_heartbeat=False,
+                         register_local=False)
+    with pytest.raises(AssertionError, match="disabled path"):
+        mem2.heartbeat(step=1)
+    mem2.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet_report: JSONL replay + render
+# ---------------------------------------------------------------------------
+
+def test_fleet_report_replay_and_json(tmp_path, capsys):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import fleet_report
+
+    clock = _Clock()
+    monitor.enable(log_dir=str(tmp_path))
+    agg = aggregate.FleetAggregator(clock=clock, emit_every=1,
+                                    stale_after=1e9)
+    agg.ingest("a", _digest("a", 1, clock.t, counters={"steps": 3.0},
+                            steps=[[clock.t, 0.02]]))
+    agg.ingest("b", _digest("b", 1, clock.t, counters={"steps": 4.0}))
+    monitor.disable()        # flush/close the JSONL writer
+    records = fleet_report.load_records(str(tmp_path))
+    view, history = fleet_report.view_from_records(records)
+    assert view is not None and sorted(view["hosts"]) == ["a", "b"]
+    assert view["counters"]["steps"] == 7.0
+    lines = "\n".join(fleet_report.render_table(view, history))
+    assert "a" in lines and "fleet goodput ratio" in lines
+    assert fleet_report.main([str(tmp_path), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert sorted(out["view"]["hosts"]) == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# multi-process drill (slow; run_ci.sh step 19 drives the same runner)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow   # 3 trainer subprocesses + fault window, ~60s
+def test_delay_dispatch_straggler_drill(tmp_path):
+    from fleet_telemetry_runner import supervise
+
+    evidence = supervise(str(tmp_path), members=3)
+    assert evidence["straggler_member"] == "m-0"
+    assert evidence["alert_jsonl"]["firing"] >= 1
+    assert evidence["alert_jsonl"]["resolved"] >= 1
+    assert evidence["hosts_reporting"] == 3
+    assert evidence["fleet_view_records"] >= 1
+    assert all(rc == 0 for rc in evidence["member_rcs"])

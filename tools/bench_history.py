@@ -76,7 +76,9 @@ import sys
 INFORMATIONAL_FIELDS = frozenset({"p99_queue_wait_ms",
                                   "p99_decode_ms",
                                   "aggregate_rps",
-                                  "reroute_latency_ms"})
+                                  "reroute_latency_ms",
+                                  "digest_build_us",
+                                  "straggler_detect_windows"})
 
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
@@ -95,7 +97,9 @@ FIELDS = (("min_step_s", "lower", "step_s"),
           ("p99_queue_wait_ms", "lower", "p99_qw"),
           ("p99_decode_ms", "lower", "p99_dec"),
           ("aggregate_rps", "higher", "agg_rps"),
-          ("reroute_latency_ms", "lower", "rerte"))
+          ("reroute_latency_ms", "lower", "rerte"),
+          ("digest_build_us", "lower", "dig_us"),
+          ("straggler_detect_windows", "lower", "strag_w"))
 
 
 def _rung_record(r):
@@ -119,7 +123,8 @@ def _rung_record(r):
               "incr_ckpt_bytes", "sessions_at_fixed_hbm",
               "spec_tok_s", "prefix_hit_rate",
               "p99_queue_wait_ms", "p99_decode_ms",
-              "aggregate_rps", "reroute_latency_ms"):
+              "aggregate_rps", "reroute_latency_ms",
+              "digest_build_us", "straggler_detect_windows"):
         if r.get(f) is not None:
             out[f] = r[f]
     gp = r.get("goodput")

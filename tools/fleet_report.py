@@ -1,0 +1,165 @@
+"""Fleet telemetry report — the operator's one pane over a pod.
+
+Renders the per-host table the master-side ``FleetAggregator``
+maintains (step time, goodput ratio, queue depth, digest age, straggler
+flag) plus merged fleet series (exact p50/p99 of every merged
+histogram, fleet goodput ratio) and the alert state (active alerts from
+a live master; full firing→resolved history from a JSONL replay).
+
+Two sources:
+
+* a live master — ``--master host:port`` calls the ``fleet_view`` RPC
+  verb (any ClusterMaster/FleetMaster with a FleetAggregator attached);
+* JSONL replay — point it at a monitor log dir (or one file) from the
+  MASTER process: the latest ``fleet_view`` record is the table, the
+  ``alert`` records are the history.
+
+Usage:
+    python tools/fleet_report.py --master 127.0.0.1:7164
+    python tools/fleet_report.py /path/to/master_monitor_logs
+    python tools/fleet_report.py logs/ --json       # bench embedding
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def load_records(path):
+    """All JSONL records under ``path`` (file or directory, rotated
+    generations included).  Torn tail lines are skipped."""
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*.jsonl"))
+                       + glob.glob(os.path.join(path, "*.jsonl.*")))
+    else:
+        files = [path]
+    records = []
+    for f in files:
+        with open(f) as fh:
+            for ln in fh:
+                try:
+                    records.append(json.loads(ln))
+                except ValueError:
+                    continue
+    return records
+
+
+def view_from_records(records):
+    """(latest fleet_view record, full alert event history) from a
+    master-process JSONL replay — None view when the log has no
+    ``fleet_view`` records (telemetry was off, or this is a member's
+    log, not the master's)."""
+    view = None
+    alerts = []
+    for rec in records:
+        ev = rec.get("event")
+        if ev == "fleet_view":
+            if view is None or rec.get("ts", 0) >= view.get("ts", 0):
+                view = rec
+        elif ev == "alert":
+            alerts.append(rec)
+    alerts.sort(key=lambda a: a.get("ts", 0))
+    return view, alerts
+
+
+def _fmt(v, spec="%s", none="-"):
+    return none if v is None else spec % v
+
+
+def render_table(view, alert_history=None):
+    """The per-host table + fleet summary + alert block as text lines."""
+    lines = []
+    hosts = (view or {}).get("hosts") or {}
+    lines.append("%-20s %10s %10s %8s %8s %6s %10s" % (
+        "host", "step_s", "goodput", "queue", "dig_age", "strag",
+        "ckpt_age"))
+    for h in sorted(hosts):
+        d = hosts[h]
+        lines.append("%-20s %10s %10s %8s %8s %6s %10s" % (
+            h,
+            _fmt(d.get("step_time_s"), "%.4f"),
+            _fmt(d.get("goodput_ratio"), "%.3f"),
+            _fmt(d.get("queue_depth"), "%d"),
+            _fmt(d.get("digest_age_s"), "%.1f"),
+            ("YES z=%s" % d.get("z")) if d.get("straggler") else "no",
+            _fmt(d.get("checkpoint_age_s"), "%.0fs")))
+    if not hosts:
+        lines.append("  (no hosts reporting)")
+    gp = (view or {}).get("goodput_ratio")
+    lines.append("fleet goodput ratio: %s" % _fmt(gp, "%.4f"))
+    for name, p in sorted(((view or {}).get("percentiles") or {})
+                          .items()):
+        lines.append("  %-40s p50 %-10s p99 %-10s n=%d" % (
+            name, _fmt(p.get("p50"), "%.4g"), _fmt(p.get("p99"), "%.4g"),
+            p.get("count", 0)))
+    for label, d in (("expired", (view or {}).get("expired")),
+                     ("quarantined", (view or {}).get("quarantined"))):
+        for h, age in sorted((d or {}).items()):
+            lines.append("  %s %-20s %.0fs ago" % (label, h, age))
+    active = (view or {}).get("alerts") or []
+    lines.append("active alerts: %d" % len(active))
+    for a in active:
+        lines.append("  [%s] %-24s %s value=%s threshold=%s" % (
+            a.get("severity"), a.get("rule"),
+            ("host=%s" % a["member_id"]) if a.get("member_id") else
+            "fleet", a.get("value"), a.get("threshold")))
+    for a in alert_history or []:
+        lines.append("  %s %-9s [%s] %-24s %s" % (
+            _fmt(a.get("ts"), "%.1f"), a.get("state"),
+            a.get("severity"), a.get("rule"),
+            ("host=%s" % a["member_id"]) if a.get("member_id") else
+            "fleet"))
+    return lines
+
+
+def fetch_live(address, timeout=10.0):
+    """The ``fleet_view`` RPC from a live master."""
+    from paddle_tpu.cloud.server import MasterClient
+
+    client = MasterClient(address, timeout=timeout, max_retries=3)
+    try:
+        return client.call("fleet_view")
+    finally:
+        client.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="fleet telemetry report (live master or JSONL replay)")
+    ap.add_argument("log", nargs="?", default=None,
+                    help="monitor JSONL file or log dir (master process)")
+    ap.add_argument("--master", default=None,
+                    help="live master address host:port (fleet_view RPC)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (bench embedding)")
+    args = ap.parse_args(argv)
+    if (args.master is None) == (args.log is None):
+        ap.error("pass exactly one source: a JSONL path or --master")
+    alert_history = []
+    if args.master is not None:
+        view = fetch_live(args.master)
+    else:
+        view, alert_history = view_from_records(load_records(args.log))
+        if view is None:
+            print("no fleet_view records in %r — was fleet telemetry on "
+                  "and is this the MASTER's log dir?" % args.log,
+                  file=sys.stderr)
+            return 1
+    if args.json:
+        print(json.dumps({"view": view, "alert_history": alert_history},
+                         indent=2, sort_keys=True))
+    else:
+        print("\n".join(render_table(view, alert_history)))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # |head closed the pipe: a clean exit
+        os._exit(0)

@@ -42,6 +42,8 @@ MODULES = [
     "paddle_tpu.monitor",
     "paddle_tpu.monitor.program_profile",
     "paddle_tpu.monitor.tracing",
+    "paddle_tpu.monitor.aggregate",
+    "paddle_tpu.monitor.alerts",
     "paddle_tpu.debugger",
     "paddle_tpu.recordio",
     "paddle_tpu.reader",

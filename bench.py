@@ -999,6 +999,117 @@ def bench_fleet_telemetry(args):
             "n_windows": 1}
 
 
+def bench_health(args):
+    """Model-health probe rung (ISSUE 20): what FLAGS_health costs.
+
+    Three arms over the same seeded MLP step, stepped round-robin so
+    machine drift lands on all arms equally: probe off (baseline),
+    probe on at cadence 1 (host publication every step — worst case),
+    probe on at cadence 10 (the default).  Overheads are median-of-steps
+    percentages; the acceptance is cadence-10 overhead <= ~5% of step
+    time, so ``vs_baseline`` is overhead_c10/5.0 (< 1.0 = inside
+    budget).  c1 ~ c10 is the expected reading: the stats are fused
+    into the step module (computed every step), so cadence only moves
+    the tiny host-publication slice.  On this CPU MLP the probe's extra
+    pass over params+grads is a visible fraction of a bandwidth-bound
+    step — the TPU/realistic-model ratio is far smaller (compute per
+    byte is higher and the reductions fuse into the update).
+    ``provenance_replay_ms`` is the one-shot op-walk replay latency on
+    a poisoned step — the off-hot-path cost of naming the first
+    non-finite op.  All informational (CPU wall clock).
+    """
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+
+    iters = max(10, args.iterations or 30)
+    warm = 3
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.unique_name.guard(), \
+                fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[784])
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            h = fluid.layers.fc(img, size=1024, act="relu")
+            h = fluid.layers.fc(h, size=1024, act="relu")
+            pred = fluid.layers.fc(h, size=10, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    batch = args.batch_size or 256
+    feed = {"img": rng.rand(batch, 784).astype("float32"),
+            "label": rng.randint(0, 10, (batch, 1)).astype("int64")}
+
+    class Arm:
+        def __init__(self, health, every):
+            self.flags = {"FLAGS_health": health,
+                          "FLAGS_health_every": every}
+            fluid.set_flags(self.flags)
+            self.main, startup, self.loss = build()
+            self.scope = fluid.Scope()
+            with fluid.scope_guard(self.scope):
+                fluid.Executor(fluid.CPUPlace()).run(startup)
+            self.exe = fluid.Executor(fluid.CPUPlace())
+            self.times = []
+
+        def step(self, record):
+            fluid.set_flags(self.flags)
+            with fluid.scope_guard(self.scope):
+                t0 = time.perf_counter()
+                self.exe.run(self.main, feed=feed,
+                             fetch_list=[self.loss])
+                if record:
+                    self.times.append(time.perf_counter() - t0)
+
+    replay_ms = None
+    try:
+        # interleaved round-robin: each round steps every arm once, so
+        # machine drift (a shared CPU slowing over the run) lands on
+        # all three arms equally instead of biasing the last one
+        arms = [Arm(False, 10), Arm(True, 1), Arm(True, 10)]
+        for i in range(iters + warm):
+            for arm in arms:
+                arm.step(record=i >= warm)
+        base_s, c1_s, c10_s = (float(np.median(a.times)) for a in arms)
+        main, scope = arms[2].main, arms[2].scope
+
+        # provenance replay latency: poison a param in the surviving
+        # scope and time the op-walk on the last stashed step
+        pname = next(n for n in scope.local_var_names()
+                     if n.endswith(".w_0"))
+        bad = np.asarray(scope.var(pname)).copy()
+        bad.flat[0] = np.nan
+        scope.set_var(pname, bad)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe._run_counter = iters + warm
+            exe.run(main, feed=feed, fetch_list=[])
+            prov = monitor.health.nan_provenance(iters + warm)
+        if prov and prov.get("found"):
+            replay_ms = prov["replay_ms"]
+    finally:
+        fluid.set_flags({"FLAGS_health": False, "FLAGS_health_every": 10})
+        monitor.health._clear_for_tests()
+
+    over_c1 = (c1_s - base_s) / base_s * 100.0
+    over_c10 = (c10_s - base_s) / base_s * 100.0
+    return {"metric": "health_probe",
+            "value": round(over_c10, 2), "unit": "pct_overhead",
+            # acceptance as a ratio: cadence-10 overhead over the ~5%
+            # budget (< 1.0 = inside budget)
+            "vs_baseline": round(over_c10 / 5.0, 4),
+            "informational": True,
+            "health_overhead_pct_c1": round(over_c1, 2),
+            "health_overhead_pct_c10": round(over_c10, 2),
+            "provenance_replay_ms": replay_ms,
+            "base_step_ms": round(base_s * 1e3, 3),
+            "iterations": iters, "batch_size": batch}
+
+
 def bench_decode_paged(args):
     """Paged-KV decode rung (ISSUE 16): concurrent generation sessions
     at fixed HBM, speculative-decoding token rate, and prefix-cache
@@ -2282,7 +2393,8 @@ def main():
                             "smallnet", "reader_capacity", "fault_drill",
                             "serving", "ckpt_sharded", "quantized",
                             "rec_sparse", "decode_paged",
-                            "serving_fleet", "fleet_telemetry"])
+                            "serving_fleet", "fleet_telemetry",
+                            "health"])
     p.add_argument("--device", default="auto", choices=["auto", "cpu", "tpu"])
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--iterations", type=int, default=20)
@@ -2488,6 +2600,10 @@ def main():
             # registry) + fake-clock straggler-detection latency in
             # windows; pure in-process, cheap
             ("fleet_telemetry", [], True, 300),
+            # model-health probe (ISSUE 20): FLAGS_health step overhead
+            # at cadence 1 and 10 (the <=~5% acceptance reads off
+            # vs_baseline) + the one-shot NaN-provenance replay latency
+            ("health", [], True, 300),
             # fp32: the A100 comparison config is bf16 (BASELINE.md
             # ruling; fp32 is 2.12x HBM bytes on a chip with less
             # bandwidth — PERF.md roofline proof)
@@ -2685,6 +2801,8 @@ def main():
         result = bench_serving_fleet(args)
     elif args.model == "fleet_telemetry":
         result = bench_fleet_telemetry(args)
+    elif args.model == "health":
+        result = bench_health(args)
     elif args.model == "decode_paged":
         result = bench_decode_paged(args)
     elif args.model == "ckpt_sharded":

@@ -45,17 +45,21 @@ def trace_flag_values():
     from . import autotune, flags
 
     from . import guardian
+    from .monitor import health
 
     # the guardian's in-graph skip guard wraps the traced step (extra
     # ok fetch + state selects), so its enablement is part of the jaxpr
     # identity: flipping FLAGS_guardian re-lowers instead of serving an
-    # unguarded (or guarded) stale trace.  The autotune trace token
-    # carries the attention decision table's content: a tuned kernel
-    # ruling is baked into the lowered step the same way the flags are,
-    # so a changed ruling must re-lower too.
+    # unguarded (or guarded) stale trace.  Same for the health probe
+    # (extra grad fetches + the stats reduction); its CADENCE is host-
+    # side publication only and deliberately not keyed.  The autotune
+    # trace token carries the attention decision table's content: a
+    # tuned kernel ruling is baked into the lowered step the same way
+    # the flags are, so a changed ruling must re-lower too.
     return (flags.flag("pallas_kernels"), flags.flag("bn_two_pass"),
             flags.flag("pallas_attention_max_seq"),
-            guardian.skip_guard_enabled(), autotune.trace_token())
+            guardian.skip_guard_enabled(), health.probe_enabled(),
+            autotune.trace_token())
 
 _mu = threading.Lock()
 # LRU of jitted step entries: the jitted callables keep their traced

@@ -353,6 +353,9 @@ class Trainer:
                     # the ones that don't return cleanly
                     try:
                         monitor.goodput_stamp()
+                        # final per-layer model-health state next to it
+                        # (no-op while FLAGS_health never published)
+                        monitor.health.stamp()
                     except Exception:  # noqa: BLE001 — telemetry must
                         pass           # not mask the real exit
             if self._ckpt_mgr is not None:

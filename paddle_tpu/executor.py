@@ -261,7 +261,7 @@ class _CompiledProgram:
     """One lowered+jitted (program, feed-signature) entry."""
 
     def __init__(self, fn, feed_names, state_in, state_out, fetch_names,
-                 guarded=False):
+                 guarded=False, probe=None):
         self.fn = fn
         self.feed_names = feed_names
         self.state_in = state_in      # read from scope before the step
@@ -272,6 +272,11 @@ class _CompiledProgram:
         # and suppresses its state update when a float fetch is
         # non-finite
         self.guarded = guarded
+        # lowered with the model-health probe (FLAGS_health): a HealthProbe
+        # whose (L, 4) per-layer stats array rides as one extra fetch
+        # between the user fetches and the guard's ok; None = every
+        # health call site in run() is skipped (disabled-is-free)
+        self.probe = probe
         # feed signatures already dispatched through this entry.  jax.jit
         # retraces+recompiles per feed shape, and the entry is shared
         # process-globally (trace cache), so warmth is per-signature: an
@@ -482,9 +487,17 @@ class Executor:
         cached = compile_cache.lookup(tkey)
         if cached is not None:
             return cached
+        # FLAGS_health: per-layer grad/param/update stats ride the step as
+        # one fused extra fetch.  The grad vars are added to the traced
+        # fetch list (XLA sees them as outputs); enablement is part of
+        # trace_flag_values so the probe-free trace is never served stale
+        probe = monitor.health.build_probe(program, state_names) \
+            if monitor.health.probe_enabled() else None
         with RecordEvent("executor/trace"):
+            traced_fetches = list(fetch_names) + \
+                (list(probe.grad_names) if probe is not None else [])
             fn, state_in, state_out = trace_program(
-                program, feed_names, state_names, writeback, fetch_names,
+                program, feed_names, state_names, writeback, traced_fetches,
                 platform=platform,
             )
             guarded = guardian.skip_guard_enabled()
@@ -492,13 +505,21 @@ class Executor:
                 # in-graph sentinel + skip: non-finite float fetches
                 # suppress the whole state update on-device (the
                 # guardian's skip-step rung); baked into the trace key
-                # via trace_flag_values
-                fn = guardian.wrap_step_guard(fn, state_in, state_out)
+                # via trace_flag_values.  n_watch excludes the probe's
+                # grad fetches: an exploding-but-finite gradient is the
+                # probe's business, and a non-finite one already poisons
+                # a watched fetch downstream
+                fn = guardian.wrap_step_guard(fn, state_in, state_out,
+                                              n_watch=len(fetch_names))
+            if probe is not None:
+                fn = monitor.health.wrap_step_probe(
+                    fn, probe, len(fetch_names), guarded, state_in,
+                    state_out)
             donate = (1,) if self.donate_state else ()
             jitted = jax.jit(fn, donate_argnums=donate)
         return compile_cache.store(tkey, _CompiledProgram(
             jitted, feed_names, state_in, state_out, fetch_names,
-            guarded=guarded))
+            guarded=guarded, probe=probe))
 
     # ------------------------------------------------------------------
     def run(
@@ -644,6 +665,17 @@ class Executor:
             # user-visible fetches exclude it
             ok_flag = fetches[-1]
             fetches = fetches[:-1]
+        if compiled.probe is not None:
+            # per-layer health stats ride second-to-last (before ok);
+            # note_step stashes the replay context every step and syncs
+            # the stats to host only on the FLAGS_health_every cadence
+            health_stats = fetches[-1]
+            fetches = fetches[:-1]
+            monitor.health.note_step(
+                "executor", step_idx, compiled.probe, health_stats,
+                program=program, scope=scope, rng=rng,
+                feed_names=feed_names, feed_vals=feed_vals,
+                platform=dev.platform)
 
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
@@ -658,8 +690,14 @@ class Executor:
             ctx = lambda: "run_id=%s fp12=%s step=%d" % (  # noqa: E731
                 monitor.run_id(),
                 compile_cache.program_fingerprint(program)[:12], step_idx)
-            _check_finite(zip(compiled.fetch_names, fetches), context=ctx)
-            _check_finite(zip(compiled.state_out, new_state), context=ctx)
+            try:
+                _check_finite(zip(compiled.fetch_names, fetches),
+                              context=ctx)
+                _check_finite(zip(compiled.state_out, new_state),
+                              context=ctx)
+            except RuntimeError as e:
+                raise _with_provenance(e, compiled.probe, step_idx) \
+                    from None
         if t0 is not None:
             jax.block_until_ready(new_state if new_state else fetches)
             print("[benchmark] step %.3f ms"
@@ -761,26 +799,59 @@ class Executor:
 
 def _check_finite(named_vals, context=None):
     """FLAGS_check_nan_inf parity (operator.cc:31,717): verify every
-    floating output of the step; raise naming the first bad variable.
+    floating output of the step; raise naming the FIRST bad variable and
+    summarizing every other one found in the same scan (one host pass —
+    the whole step already synced, so scanning to the end costs nothing
+    and turns "loss is nan" into "loss, fc_0.w_0@GRAD, ... are nan").
     ``context`` (a callable, evaluated only on failure) adds the run_id
     / program fingerprint / step index so the raise correlates with the
     JSONL and trace records of the same step."""
     from .core import bfloat16
 
+    bad_vars = []
+    first_kind = None
     for name, v in named_vals:
         a = np.asarray(v)
         if bfloat16 is not None and a.dtype == bfloat16:
             a = a.astype(np.float32)  # np.isfinite lacks a bf16 loop
         if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
-            bad = "nan" if np.isnan(a).any() else "inf"
-            where = ""
-            if context is not None:
-                try:
-                    where = " [%s]" % (context() if callable(context)
-                                       else context)
-                except Exception:  # noqa: BLE001 — the raise must land
-                    pass
-            raise RuntimeError(
-                "check_nan_inf: variable %r contains %s after step%s "
-                "(enable FLAGS_debug_nans to localize the producing op)"
-                % (name, bad, where))
+            bad_vars.append(name)
+            if first_kind is None:
+                first_kind = "nan" if np.isnan(a).any() else "inf"
+    if not bad_vars:
+        return
+    where = ""
+    if context is not None:
+        try:
+            where = " [%s]" % (context() if callable(context)
+                               else context)
+        except Exception:  # noqa: BLE001 — the raise must land
+            pass
+    others = "" if len(bad_vars) == 1 else \
+        " (+%d more non-finite: %s)" % (
+            len(bad_vars) - 1, ", ".join(repr(n) for n in bad_vars[1:5]))
+    raise RuntimeError(
+        "check_nan_inf: variable %r contains %s after step%s%s "
+        "(enable FLAGS_debug_nans to localize the producing op)"
+        % (bad_vars[0], first_kind, others, where))
+
+
+def _with_provenance(err, probe, step_idx):
+    """Augment a check_nan_inf raise with op-level NaN provenance when
+    the health probe is on: replay the stashed step off the hot path and
+    name the first op whose output went non-finite.  The original error
+    text is preserved; provenance failures never mask it."""
+    if probe is None:
+        return err
+    from .monitor import health
+
+    try:
+        prov = health.nan_provenance(step_idx)
+    except Exception:  # noqa: BLE001 — diagnostics must not mask the raise
+        return err
+    if not prov or not prov.get("found"):
+        return err
+    return RuntimeError(
+        "%s; first non-finite op: %s -> %r (op #%d%s)"
+        % (err, prov["op_type"], prov["out_var"], prov["op_index"],
+           ", layer %s" % prov["layer"] if prov.get("layer") else ""))

@@ -244,6 +244,27 @@ def _on_fleet_telemetry_change(_val):
     aggregate._reconcile()
 
 
+def _on_health_change(_val):
+    from .monitor import health
+
+    health._reconcile()
+
+
+# model-health telemetry (monitor/health.py): with it on, the executors
+# lower steps with an in-graph per-layer probe (grad L2 norm, param
+# norm, update/param ratio, non-finite count as one extra fetch) and
+# stash per-step NaN-provenance replay contexts.  Baked into the traced
+# jaxpr — flipping it re-keys the trace caches.  Disabled cost is zero
+# health calls (module-global bool; A/B test-enforced) and the seeded
+# training trajectory is bit-identical with the flag on or off.
+register_flag("health", False, bool, _on_health_change)
+# host-side publication cadence for the probe: the stats are computed
+# on-device every step (fused, no sync), but gauges + model_health
+# JSONL records publish every Nth step — the only host sync the probe
+# adds
+register_flag("health_every", 10, int, _on_health_change)
+
+
 # fleet telemetry plane (monitor/aggregate.py): each ClusterMember ships
 # a MetricDigest on its existing heartbeat; the master merges digests
 # into fleet-level series, straggler verdicts, and SLO alerts.  Off by

@@ -121,22 +121,30 @@ def skip_guard_enabled():
     return "skip" in policy
 
 
-def wrap_step_guard(fn, state_in, state_out):
+def wrap_step_guard(fn, state_in, state_out, n_watch=None):
     """Wrap a traced step function with the in-graph sentinel + skip:
     ``ok`` = every floating fetch is finite; state vars that existed
     before the step keep their OLD value when ``ok`` is false (the
     update — params, optimizer slots, LR/step counters — is dropped
     atomically); write-only outputs (first-step initializations) pass
     through.  Returns ``fetches + [ok]``: the executors strip the
-    trailing ``ok`` and hand it to the active guardian."""
+    trailing ``ok`` and hand it to the active guardian.
+
+    ``n_watch`` bounds the sentinel to the first N fetches: the health
+    probe (monitor/health.py) appends ``@GRAD`` extras after the user
+    fetches, and a gradient that overflowed must trip the guard through
+    the loss it poisons, not through a diagnostic fetch — guard
+    semantics are identical with the probe on or off.  None watches
+    everything (the pre-probe behavior)."""
     import jax.numpy as jnp
 
     idx = {n: i for i, n in enumerate(state_in)}
 
     def guarded(feed_vals, state_vals, key):
         fetches, new_state = fn(feed_vals, state_vals, key)
+        watched = fetches if n_watch is None else fetches[:n_watch]
         ok = jnp.asarray(True)
-        for f in fetches:
+        for f in watched:
             if jnp.issubdtype(jnp.result_type(f), jnp.inexact):
                 ok = jnp.logical_and(ok, jnp.isfinite(f).all())
         new_state = [
@@ -162,6 +170,18 @@ def warn_unobserved_skip_guard(executor):
         "guardian is installed: non-finite updates are dropped "
         "silently — install one (guardian.install / Trainer "
         "guardian_config) or clear FLAGS_guardian")
+
+
+def _provenance_clause(prov):
+    """Render a NaN-provenance record into an escalation-message clause
+    ('' when provenance is unavailable or found nothing)."""
+    if not prov or not prov.get("found"):
+        return ""
+    layer = prov.get("layer")
+    return "; first non-finite op: %s -> %r (op #%d%s)" % (
+        prov.get("op_type"), prov.get("out_var"),
+        prov.get("op_index", -1),
+        ", layer %s" % layer if layer else "")
 
 
 def _finite(a):
@@ -325,23 +345,59 @@ class Guardian:
         self._consecutive_skips += 1
         self._counter("guardian/skipped_steps")
         q = self._quarantine(step, feed, "nonfinite_in_graph")
+        prov = self._provenance(step, q)
         self._event({"event": "guardian_skip", "step": step,
                      "consecutive": self._consecutive_skips,
                      "quarantine": q})
         if self._consecutive_skips > self.max_skips:
             self._escalate(step,
                            "%d consecutive in-graph skips exceed the "
-                           "skip budget (%d)"
-                           % (self._consecutive_skips, self.max_skips),
+                           "skip budget (%d)%s"
+                           % (self._consecutive_skips, self.max_skips,
+                              _provenance_clause(prov)),
                            quarantined=True)
 
     def _on_nonfinite(self, step, feed):
         q = self._quarantine(step, feed, "nonfinite_observed")
+        prov = self._provenance(step, q)
         self._event({"event": "guardian_nonfinite", "step": step,
                      "quarantine": q})
         # the update already reached the scope (no in-graph guard, or
         # corruption past it): skipping cannot help — escalate
-        self._escalate(step, "non-finite loss observed", quarantined=False)
+        self._escalate(step, "non-finite loss observed"
+                       + _provenance_clause(prov), quarantined=False)
+
+    def _provenance(self, step, q):
+        """NaN provenance for a quarantined step (ISSUE 20): replay the
+        already-quarantined batch through the debug-lowered op walk and
+        name the first offending op.  The record is attached to the
+        quarantine sidecar (JSON rewritten in place) and published as a
+        ``guardian_nan_provenance`` event.  One health-module read when
+        the probe is off; never raises — this runs on the abort path."""
+        from .monitor import health
+
+        if not health.enabled():
+            return None
+        try:
+            # the stashed replay context holds the same feed values the
+            # quarantine persisted (both executors hand note_step and
+            # the guardian the identical pre-pad batch)
+            prov = health.nan_provenance(step)
+        except Exception:  # noqa: BLE001 — diagnostics must not mask
+            return None
+        if prov is None:
+            return None
+        q["provenance"] = prov
+        if q.get("path"):
+            try:
+                with open(q["path"][: -len(".npz")] + ".json", "w") as f:
+                    json.dump(q, f)
+            except OSError:
+                pass
+        self._counter("guardian/nan_provenance")
+        self._event(dict(prov, event="guardian_nan_provenance",
+                         quarantine_path=q.get("path")))
+        return prov
 
     def _observe_loss(self, step, loss):
         hist = self._history
@@ -407,6 +463,14 @@ class Guardian:
                          "median_second_half": second})
 
     def _escalate(self, step, reason, quarantined):
+        # abort/rollback diagnostics carry the last per-layer health
+        # snapshot (ISSUE 20 satellite): the post-mortem's first
+        # question — which layer was sick — is answered in the message
+        from .monitor import health
+
+        snap = health.format_snapshot()
+        if snap:
+            reason = "%s [health %s]" % (reason, snap)
         if "rollback" in self.policy:
             raise GuardianRollback(step, reason, quarantined=quarantined)
         raise GuardianAbortError(
@@ -537,8 +601,12 @@ class Guardian:
 
         self.quarantined.append((int(step), reason))
         self._counter("guardian/quarantined_batches")
+        # schema: feed_signature/feed_names for repro, provenance for
+        # the first-offending-op record (filled in by _provenance after
+        # the write; the sidecar JSON is rewritten in place then)
         rec = {"run_id": monitor.run_id(), "step": int(step),
-               "reason": reason, "ts": time.time(), "path": None}
+               "reason": reason, "ts": time.time(), "path": None,
+               "provenance": None}
         if feed is not None:
             names, vals = feed
             rec["feed_signature"] = [

@@ -48,7 +48,7 @@ __all__ = [
     "last_span", "queue_states", "track", "log_event", "count", "run_id",
     "sample_device_gauges", "add_stall_listener", "remove_stall_listener",
     "goodput_ledger", "goodput_summary", "goodput_stamp",
-    "goodput_reset", "tracing", "aggregate", "alerts",
+    "goodput_reset", "tracing", "aggregate", "alerts", "health",
 ]
 
 # fast-path gate: a module-global bool read (no lock, no flag lookup) is
@@ -647,6 +647,9 @@ def _stall_probe():
             # says "97% input_wait over the last window" is actionable;
             # "no step completed" is not
             "goodput": _goodput.snapshot_for_stall(),
+            # the last per-layer model-health snapshot (FLAGS_health):
+            # a stall that follows a gradient explosion should say so
+            "health": health.last_snapshot(),
             # the suspect: fingerprint + cost/memory profile of the last
             # program a step completed for — a stall report should name
             # which compiled program the device is (probably) stuck in
@@ -725,6 +728,8 @@ def _format_diag(diag):
                       in gp["recent_fractions"].items())))
     if diag.get("last_program"):
         lines.append("  last program %s" % diag["last_program"])
+    if diag.get("health"):
+        lines.append("  health %s" % health.format_snapshot(diag["health"]))
     fleet = diag.get("fleet") or {}
     strag = set(fleet.get("stragglers") or ())
     for h, age in sorted((fleet.get("digest_age_s") or {}).items()):
@@ -749,3 +754,7 @@ from . import tracing  # noqa: E402
 # aggregate._ENABLED, so import order is unconstrained here too
 from . import aggregate  # noqa: E402
 from . import alerts  # noqa: E402
+# model-health probe + NaN provenance (ISSUE 20): reachable as
+# monitor.health; the executors gate every call on the compiled entry's
+# probe slot, so import order is unconstrained here too
+from . import health  # noqa: E402

@@ -705,6 +705,29 @@ class FleetAggregator:
                 "checkpoint_age_s": (round(now - s.ckpt_last_move, 3)
                                      if s.ckpt_seen else None),
             }
+            # model-health summary from the host's health/<layer>/<stat>
+            # gauges (the FLAGS_health probe rides the digest's registry
+            # snapshot): worst-layer view the grad-norm/update-ratio
+            # alert rules select on
+            health = {}
+            for name, val in s.gauges.items():
+                if name.startswith("health/"):
+                    parts = name.split("/")
+                    if len(parts) == 3:
+                        health.setdefault(parts[1], {})[parts[2]] = val
+            if health:
+                worst = max(health,
+                            key=lambda lb: health[lb].get("grad_norm", 0.0))
+                ratios = [d["update_ratio"] for d in health.values()
+                          if d.get("update_ratio") is not None]
+                hosts[h]["health"] = {
+                    "grad_norm_max": health[worst].get("grad_norm", 0.0),
+                    "worst_layer": worst,
+                    "update_ratio_min": min(ratios) if ratios else None,
+                    "nonfinite_total": sum(d.get("nonfinite", 0) or 0
+                                           for d in health.values()),
+                    "layers": health,
+                }
         wall = self._goodput["wall"]
         pcts = {}
         for name, h in self._hists.items():

@@ -32,6 +32,10 @@ Metric selectors (strings, resolved against the view):
                            have checkpointed at least once)
 ``host:lease_expired``     1.0 while an expired member's tombstone stands
 ``host:quarantined``       1.0 while a quarantined replica's stands
+``host:grad_norm``         per-host worst-layer gradient L2 norm (the
+                           FLAGS_health probe's ``health`` summary)
+``host:update_ratio``      per-host minimum layer update/param ratio
+``host:nonfinite``         per-host total non-finite gradient elements
 ========================  ==================================================
 
 ``default_rules()`` covers the six conditions the ISSUE names:
@@ -106,6 +110,17 @@ class AlertRule:
             return {h: 1.0 for h in (view.get("expired") or {})}
         if m == "host:quarantined":
             return {h: 1.0 for h in (view.get("quarantined") or {})}
+        if m in ("host:grad_norm", "host:update_ratio",
+                 "host:nonfinite"):
+            field = {"host:grad_norm": "grad_norm_max",
+                     "host:update_ratio": "update_ratio_min",
+                     "host:nonfinite": "nonfinite_total"}[m]
+            out = {}
+            for h, d in hosts.items():
+                v = (d.get("health") or {}).get(field)
+                if v is not None:
+                    out[h] = v
+            return out
         if m.startswith("host:"):
             field = {"step_time": "step_time_s",
                      "digest_age": "digest_age_s",
@@ -197,13 +212,22 @@ def default_rules(goodput_min=0.5, slo_p99_s=2.5,
                   latency_hist="serving/request_latency_seconds",
                   straggler_for_s=10.0, ckpt_max_age_s=900.0,
                   digest_stale_s=30.0, goodput_for_s=30.0,
-                  p99_for_s=15.0):
-    """The stock rule set (ISSUE 19): every threshold is a parameter so
-    operators (and the CI drill) tighten them without subclassing.
-    The checkpoint-staleness bound defaults to 15 minutes — wider than
-    any cadence the CheckFreq autotune picks; pass the tuned interval
-    times a safety factor for a sharper rule."""
+                  p99_for_s=15.0, grad_norm_max=1e4,
+                  update_ratio_min=1e-7, health_for_s=0.0):
+    """The stock rule set (ISSUE 19 + the ISSUE 20 model-health pair):
+    every threshold is a parameter so operators (and the CI drill)
+    tighten them without subclassing.  The checkpoint-staleness bound
+    defaults to 15 minutes — wider than any cadence the CheckFreq
+    autotune picks; pass the tuned interval times a safety factor for a
+    sharper rule.  The health thresholds are deliberately loose
+    (norm > 1e4 = explosion, ratio < 1e-7 = frozen training); both only
+    resolve to values on hosts running with FLAGS_health."""
     return [
+        AlertRule("grad_norm_explosion", "host:grad_norm", grad_norm_max,
+                  op=">", for_seconds=health_for_s, severity="critical"),
+        AlertRule("update_ratio_collapse", "host:update_ratio",
+                  update_ratio_min, op="<", for_seconds=health_for_s,
+                  severity="warning"),
         AlertRule("goodput_collapse", "goodput_ratio", goodput_min,
                   op="<", for_seconds=goodput_for_s, severity="critical"),
         AlertRule("p99_over_slo", "p99:" + latency_hist, slo_p99_s,

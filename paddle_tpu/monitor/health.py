@@ -1,0 +1,347 @@
+"""Model-health telemetry + NaN provenance (ISSUE 20 tentpole).
+
+The observability stack covers the *system* axis (StepStats, program
+profiles, goodput, tracing, fleet aggregation); this module covers the
+*model* axis — the numeric health of the thing being trained:
+
+* **In-graph health probe** (``FLAGS_health``): the executors trace the
+  step with the parameter gradients appended as extra fetches and a
+  small fused reduction over them — per layer class: gradient L2 norm,
+  parameter L2 norm, update/param ratio ``||new-old||/(||old||+eps)``,
+  and a non-finite element count — returned as ONE extra ``(L, 4)``
+  fetch.  Parameters are classified into layer classes by reusing
+  ``spec_layout.classify_params``'s program-structure scan (embedding /
+  norm / mlp_col / mlp_row / mlp_bias), so the probe needs no model
+  annotations.  The stats are computed on-device every step (fused into
+  the step module; they never feed the state math, so the training
+  trajectory is bit-identical with the flag on or off) and *published*
+  host-side at a decimated cadence (``FLAGS_health_every``): gauges
+  ``health/<layer>/*`` + a run_id-stamped ``model_health`` JSONL record.
+  Because the fleet digest (ISSUE 19) ships the whole registry, the
+  per-layer gauges ride the existing heartbeat envelope to the fleet
+  master for free.  Disabled cost is zero health calls — the probe is
+  part of the traced jaxpr, so ``FLAGS_health`` is re-keyed through
+  ``compile_cache.trace_flag_values``.
+* **NaN provenance** (``nan_provenance``): when the guardian sentinel
+  trips or ``check_nan_inf`` raises, a one-shot OFF-hot-path
+  instrumented replay of the already-quarantined batch through the
+  debug-lowered program variant (``transpiler.nan_debug``) evaluates
+  per-op output isfinite flags in topological order and names the FIRST
+  offending op (op type, output var, layer class).  The record lands in
+  the quarantine sidecar, a ``guardian_nan_provenance`` JSONL event,
+  and the abort message.  The replay context (program, scope, PRNG key,
+  feed) is stashed per step while the probe is on; the PRNG key data
+  rides in the record so the replay is reproducible offline from the
+  sidecar alone.
+
+``tools/health_report.py`` renders the JSONL records as a per-layer
+table; ``alerts.default_rules()`` gains grad-norm-explosion and
+update-ratio-collapse rules over the fleet view's per-host health
+summary (``aggregate._view_locked``).
+"""
+
+import collections
+import time
+
+import numpy as np
+
+__all__ = [
+    "enabled", "probe_enabled", "build_probe", "wrap_step_probe",
+    "note_step", "last_snapshot", "format_snapshot", "stamp",
+    "nan_provenance", "HealthProbe",
+]
+
+# fast-path gate, same contract as monitor._enabled: a module-global
+# bool read is all a disabled process pays (zero health calls — the
+# executors gate every call site on `compiled.probe is not None`, and
+# the probe is only built while this is True)
+_ENABLED = False
+_EVERY = [10]
+
+# last published per-layer snapshot (kept even while the monitor is
+# off: watchdog stall dumps and guardian abort diagnostics read it)
+_SNAPSHOT = [None]
+
+# per-step replay contexts for NaN provenance, step -> context dict;
+# bounded: the guardian's deferred observations trail the executor by
+# at most the dispatch window, so a small ring covers every step it can
+# still decide on
+_REPLAY = collections.OrderedDict()
+_REPLAY_MAX = 32
+
+_EPS = 1e-12
+
+# logical-axes tuple (spec_layout.classify_params) -> layer class label
+_AXES_LABEL = {
+    ("vocab", "embed"): "embedding",
+    ("norm",): "norm",
+    ("embed", "mlp"): "mlp_col",
+    ("mlp", "embed"): "mlp_row",
+    ("mlp",): "mlp_bias",
+}
+
+
+def _reconcile():
+    """FLAGS_health family on_set hook: mirror the flags into the
+    module globals (one bool + the publication cadence)."""
+    from .. import flags
+
+    global _ENABLED
+    try:
+        _ENABLED = bool(flags.flag("health"))
+        _EVERY[0] = max(1, int(flags.flag("health_every")))
+    except KeyError:
+        # registration-time env override: the sibling flag registers a
+        # beat later; its own on_set re-runs this
+        pass
+    if not _ENABLED:
+        _REPLAY.clear()
+
+
+def enabled():
+    return _ENABLED
+
+
+def probe_enabled():
+    """Whether steps are lowered with the in-graph health probe — part
+    of ``compile_cache.trace_flag_values()`` (the probe's extra fetches
+    are baked into the jaxpr, so flipping FLAGS_health re-lowers
+    instead of serving a stale probed/unprobed trace)."""
+    return _ENABLED
+
+
+class HealthProbe:
+    """One program's probe plan: layer classes in publication order,
+    the ``(param, grad-or-None)`` members of each, and the flat list of
+    gradient vars the executors append as extra fetches."""
+
+    def __init__(self, labels, layers, grad_names):
+        self.labels = labels          # ordered layer-class labels
+        self.layers = layers          # label -> [(param, grad or None)]
+        self.grad_names = grad_names  # extra fetch vars, flat + ordered
+        self.stat_names = ("grad_norm", "param_norm", "update_ratio",
+                           "nonfinite")
+        # param -> label, precomputed once (note_step stashes it into
+        # every step's replay context)
+        self.param_labels = {p: lb for lb in labels
+                             for p, _ in layers[lb]}
+
+
+def build_probe(program, state_names):
+    """Classify ``program``'s parameters into layer classes
+    (``spec_layout.classify_params`` — the same program-structure scan
+    that drives mesh placement) and plan the probe: which ``@GRAD``
+    vars to fetch and which state vars each layer's norms read.
+    Returns None when the program trains nothing (no classified param
+    and no gradient output — eval/startup programs)."""
+    from ..framework import GRAD_VAR_SUFFIX
+    from ..parallel.spec_layout import classify_params
+
+    classes = classify_params(program)
+    produced = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            produced.update(n for n in op.output_arg_names if n)
+    by_label = {}
+    for p in state_names:
+        g = p + GRAD_VAR_SUFFIX
+        has_grad = g in produced
+        label = _AXES_LABEL.get(classes.get(p))
+        if label is None:
+            if not has_grad:
+                # optimizer slots, counters, LR, tables of odd rank:
+                # no gradient and no class — not a layer
+                continue
+            label = "other"
+        by_label.setdefault(label, []).append((p, g if has_grad else None))
+    if not by_label or not any(g for members in by_label.values()
+                               for _, g in members):
+        return None
+    labels = sorted(by_label)
+    grad_names = [g for lb in labels for _, g in by_label[lb]
+                  if g is not None]
+    return HealthProbe(labels, by_label, grad_names)
+
+
+def wrap_step_probe(fn, probe, n_user, guarded, state_in, state_out):
+    """Wrap a traced step function (already guard-wrapped when
+    ``guarded``) with the in-graph stat reduction: the ``@GRAD`` extra
+    fetches are consumed and ONE ``(L, 4)`` float32 stats array is
+    appended after the user fetches (before the guard's trailing
+    ``ok``, which stays last — the executors strip back-to-front).
+    The stats never feed the state math: bit-parity with the probe off
+    is structural, not incidental."""
+    import jax.numpy as jnp
+
+    in_idx = {n: i for i, n in enumerate(state_in)}
+    out_idx = {n: i for i, n in enumerate(state_out)}
+    grad_pos = {g: n_user + i for i, g in enumerate(probe.grad_names)}
+
+    def probed(feed_vals, state_vals, key):
+        fetches, new_state = fn(feed_vals, state_vals, key)
+        tail = [fetches[-1]] if guarded else []
+        body = fetches[:-1] if guarded else list(fetches)
+        rows = []
+        for label in probe.labels:
+            gsq = jnp.float32(0.0)
+            psq = jnp.float32(0.0)
+            usq = jnp.float32(0.0)
+            nf = jnp.float32(0.0)
+            for p, g in probe.layers[label]:
+                if g is not None:
+                    gv = body[grad_pos[g]].astype(jnp.float32)
+                    gsq = gsq + jnp.sum(gv * gv)
+                    nf = nf + jnp.sum(
+                        (~jnp.isfinite(gv)).astype(jnp.float32))
+                ni = out_idx.get(p)
+                oi = in_idx.get(p)
+                pv = new_state[ni] if ni is not None else (
+                    state_vals[oi] if oi is not None else None)
+                if pv is not None:
+                    pv = pv.astype(jnp.float32)
+                    psq = psq + jnp.sum(pv * pv)
+                    if ni is not None and oi is not None:
+                        dv = pv - state_vals[oi].astype(jnp.float32)
+                        usq = usq + jnp.sum(dv * dv)
+            pn = jnp.sqrt(psq)
+            rows.append(jnp.stack([jnp.sqrt(gsq), pn,
+                                   jnp.sqrt(usq) / (pn + _EPS), nf]))
+        stats = jnp.stack(rows)
+        return list(body[:n_user]) + [stats] + tail, new_state
+
+    return probed
+
+
+def note_step(executor_name, step, probe, stats, program=None,
+              scope=None, rng=None, feed_names=(), feed_vals=(),
+              platform=None):
+    """One probed executor step completed.  Always stashes the NaN
+    replay context (cheap: reference assignments, no device sync — the
+    rng key handle is kept as-is and only materialized at provenance
+    time); publishes the per-layer snapshot at the decimated
+    ``FLAGS_health_every`` cadence (``np.asarray`` on the stats fetch —
+    the probe's only host sync, never on off-cadence steps)."""
+    from .. import flags
+    from . import enabled as _mon_enabled, log_event, registry
+
+    if not _ENABLED:
+        return None
+    step = int(step)
+    _REPLAY[step] = {
+        "executor": executor_name, "program": program, "scope": scope,
+        "rng": rng, "impl": "rbg" if flags.flag("fast_prng") else None,
+        "feed_names": tuple(feed_names), "feed_vals": list(feed_vals),
+        "platform": platform,
+        "labels": probe.param_labels,
+    }
+    while len(_REPLAY) > _REPLAY_MAX:
+        _REPLAY.popitem(last=False)
+    if step % _EVERY[0]:
+        return None
+    if hasattr(stats, "is_fully_addressable") \
+            and not stats.is_fully_addressable:
+        # multi-host: the stats fetch is forced replicated (PE fetch
+        # shardings), so any local shard holds the full array
+        stats = stats.addressable_shards[0].data
+    arr = np.asarray(stats, dtype=np.float64)
+    snap = {"event": "model_health", "ts": time.time(),
+            "executor": executor_name, "step": step, "layers": {}}
+    for i, label in enumerate(probe.labels):
+        gn, pn, ur, nf = (float(arr[i, 0]), float(arr[i, 1]),
+                          float(arr[i, 2]), int(arr[i, 3]))
+        snap["layers"][label] = {
+            "grad_norm": gn, "param_norm": pn,
+            "update_ratio": ur, "nonfinite": nf}
+    _SNAPSHOT[0] = snap
+    if _mon_enabled():
+        reg = registry()
+        for label, d in snap["layers"].items():
+            base = "health/%s/" % label
+            for k in ("grad_norm", "param_norm", "update_ratio"):
+                reg.gauge(base + k).set(float(d[k]))
+            reg.gauge(base + "nonfinite").set(float(d["nonfinite"]))
+    log_event(dict(snap, layers={k: dict(v)
+                                 for k, v in snap["layers"].items()}))
+    return snap
+
+
+def last_snapshot():
+    """The last published per-layer snapshot dict (or None): watchdog
+    stall dumps and guardian abort diagnostics read it regardless of
+    the monitor's enablement."""
+    return _SNAPSHOT[0]
+
+
+def format_snapshot(snap=None):
+    """One compact line per layer for abort messages / stall dumps:
+    ``mlp_col grad_norm=1.2e+03 update_ratio=3.4e-03 nonfinite=0``."""
+    snap = snap if snap is not None else _SNAPSHOT[0]
+    if not snap:
+        return ""
+    parts = []
+    for label in sorted(snap.get("layers", {})):
+        d = snap["layers"][label]
+        parts.append("%s grad_norm=%.3g update_ratio=%.3g nonfinite=%d"
+                     % (label, d["grad_norm"], d["update_ratio"],
+                        d["nonfinite"]))
+    return "step %d: %s" % (snap.get("step", -1), "; ".join(parts))
+
+
+def stamp():
+    """Log the last snapshot as a ``model_health`` JSONL record (run
+    boundaries — the Trainer stamps it next to the goodput summary so
+    post-mortems start from the final per-layer state) and return it."""
+    from . import log_event
+
+    snap = _SNAPSHOT[0]
+    if snap is not None:
+        log_event(dict(snap, ts=time.time(),
+                       layers={k: dict(v)
+                               for k, v in snap["layers"].items()}))
+    return snap
+
+
+def _clear_for_tests():
+    _REPLAY.clear()
+    _SNAPSHOT[0] = None
+
+
+def nan_provenance(step, feed=None):
+    """One-shot NaN provenance for ``step``: replay the stashed context
+    (optionally overriding the feed with the guardian's quarantined
+    ``(names, vals)``) through the debug-lowered op walk and name the
+    FIRST op whose output is non-finite.  Returns a JSON-safe record
+    (``found`` False when the replay stays finite — host-side
+    corruption the graph never produced), or None when disabled or no
+    context was stashed.  Never raises: provenance is diagnostics on
+    the abort path, it must not mask the real failure."""
+    if not _ENABLED:
+        return None
+    ctx = _REPLAY.get(int(step))
+    if ctx is None:
+        return None
+    from ..transpiler import nan_debug
+
+    names, vals = (feed if feed is not None
+                   else (ctx["feed_names"], ctx["feed_vals"]))
+    rec = {"step": int(step), "executor": ctx["executor"],
+           "found": False, "key_impl": ctx["impl"]}
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        if ctx["rng"] is not None:
+            rec["key_data"] = np.asarray(
+                jax.random.key_data(ctx["rng"])).tolist()
+        hit = nan_debug.first_nonfinite_op(
+            ctx["program"], dict(zip(names, vals)), ctx["scope"],
+            key=ctx["rng"], platform=ctx["platform"],
+            classify=ctx["labels"])
+    except Exception as e:  # noqa: BLE001 — diagnostics must not mask
+        rec["error"] = repr(e)
+        hit = None
+    rec["replay_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+    if hit is not None:
+        rec.update(hit)
+        rec["found"] = True
+    return rec

@@ -36,7 +36,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .. import compile_cache, fault, flags, guardian, monitor, registry  # noqa: F401  (op registry must be loaded)
 from ..executor import (AsyncDispatchQueue, trace_program, Executor,
                         _batch_examples, _check_finite,
-                        _sparse_step_extras)
+                        _sparse_step_extras, _with_provenance)
 from ..monitor import program_profile
 from ..profiler import RecordEvent, is_profiling
 from ..framework import Variable, default_main_program
@@ -56,7 +56,7 @@ _DEFAULT_SPEC_LAYOUT = SpecLayout()
 class _Compiled:
     def __init__(self, fn, feed_names, state_in, state_out, fetch_names,
                  feed_shardings, state_shardings, out_state_shardings,
-                 partition_key=None, guarded=False):
+                 partition_key=None, guarded=False, probe=None):
         self.fn = fn
         self.feed_names = feed_names
         self.state_in = state_in
@@ -72,6 +72,10 @@ class _Compiled:
         # lowered with the guardian's in-graph skip guard (trailing ok
         # fetch; see executor._CompiledProgram)
         self.guarded = guarded
+        # lowered with the model-health probe (FLAGS_health): the (L, 4)
+        # per-layer stats array rides between user fetches and ok; None
+        # means run() performs zero health calls
+        self.probe = probe
         self.warm = False      # first dispatch = trace+compile (see Executor)
         # schedule accounting for the program's pipeline regions on this
         # mesh (set by PE._compile; None = nothing runs pipelined)
@@ -270,9 +274,16 @@ class ParallelExecutor:
             for n, v in zip(state_names, pre_state_vals)
         }
 
+        # FLAGS_health: grad vars join the traced fetch list, the fused
+        # per-layer stats reduction rides as one extra fetch (see
+        # executor._lower); enablement re-keys via trace_flag_values
+        probe = monitor.health.build_probe(program, state_names) \
+            if monitor.health.probe_enabled() else None
+        traced_fetches = list(fetch_names) + \
+            (list(probe.grad_names) if probe is not None else [])
         with RecordEvent("parallel_executor/trace"):
             fn, state_in, state_out = trace_program(
-                program, feed_names, state_names, writeback, fetch_names,
+                program, feed_names, state_names, writeback, traced_fetches,
                 platform=self._mesh.devices.flat[0].platform,
                 mesh=self._mesh,
                 sequence_parallel=self._build_strategy.sequence_parallel,
@@ -323,18 +334,24 @@ class ParallelExecutor:
         guarded = guardian.skip_guard_enabled()
         if guarded:
             # in-graph sentinel + skip (see executor._lower); wrapped
-            # OUTSIDE remat so the guard's select is not rematerialized
-            fn = guardian.wrap_step_guard(fn, state_in, state_out)
+            # OUTSIDE remat so the guard's select is not rematerialized.
+            # n_watch keeps the probe's grad fetches off the sentinel
+            fn = guardian.wrap_step_guard(fn, state_in, state_out,
+                                          n_watch=len(fetch_names))
+        if probe is not None:
+            fn = monitor.health.wrap_step_probe(
+                fn, probe, len(fetch_names), guarded, state_in, state_out)
 
         donate = (1,) if self._build_strategy.donate_state else ()
         # multi-host: fetches are forced replicated so every process can
         # read them (np.asarray on a non-addressable array would throw)
         fetch_shardings = None
         if jax.process_count() > 1:
-            # +1: the guard's trailing ok fetch is a scalar every
-            # process must be able to read too
+            # +1s: the guard's trailing ok fetch and the probe's stats
+            # array are scalars/small every process must read too
             fetch_shardings = [NamedSharding(mesh, P())] \
-                * (len(fetch_names) + (1 if guarded else 0))
+                * (len(fetch_names) + (1 if probe is not None else 0)
+                   + (1 if guarded else 0))
         # jax.jit here is lazy (tracing deferred to the first call): no
         # span — the real jaxpr cost is the trace_program above
         jitted = jax.jit(
@@ -350,7 +367,7 @@ class ParallelExecutor:
             jitted, feed_names, state_in, state_out,
             fetch_names, feed_shardings, state_shardings,
             out_state_shardings, partition_key=partition_key,
-            guarded=guarded)
+            guarded=guarded, probe=probe)
         compiled.pipeline_stats = self._pipeline_stats(program)
         return compile_cache.store(tkey, compiled)
 
@@ -591,6 +608,18 @@ class ParallelExecutor:
             # the in-graph sentinel's verdict rides as a trailing fetch
             ok_flag = fetches[-1]
             fetches = fetches[:-1]
+        if compiled.probe is not None:
+            # per-layer health stats ride second-to-last (before ok);
+            # the replay context stashes the batch AS FED (pre-pad), the
+            # same artifact the guardian quarantines — so provenance
+            # replays reproduce the quarantined step exactly
+            health_stats = fetches[-1]
+            fetches = fetches[:-1]
+            monitor.health.note_step(
+                "parallel_executor", step_idx, compiled.probe,
+                health_stats, program=program, scope=scope, rng=rng,
+                feed_names=feed_names, feed_vals=user_feed_vals,
+                platform=self._mesh.devices.flat[0].platform)
 
         for n, v in zip(compiled.state_out, new_state):
             scope.set_var(n, v)
@@ -627,12 +656,16 @@ class ParallelExecutor:
             # back device arrays (the check implies a per-step sync, not
             # a type change).
             np_fetches = [self._fetch_to_np(f) for f in fetches]
-            _check_finite(
-                zip(compiled.fetch_names, np_fetches),
-                context=lambda: "run_id=%s fp12=%s step=%d" % (
-                    monitor.run_id(),
-                    compile_cache.program_fingerprint(program)[:12],
-                    step_idx))
+            try:
+                _check_finite(
+                    zip(compiled.fetch_names, np_fetches),
+                    context=lambda: "run_id=%s fp12=%s step=%d" % (
+                        monitor.run_id(),
+                        compile_cache.program_fingerprint(program)[:12],
+                        step_idx))
+            except RuntimeError as e:
+                raise _with_provenance(e, compiled.probe, step_idx) \
+                    from None
         if return_numpy:
             with RecordEvent("parallel_executor/fetch_sync"):
                 fetches = np_fetches if np_fetches is not None else \

@@ -24,6 +24,7 @@ from .passes import (  # noqa: F401
     PassBuilder, apply_pass, const_fold, dead_var_eliminate, find_chain,
     get_pass, list_passes, register_pass)
 from .quantize_pass import quantize_inference  # noqa: F401
+from .nan_debug import first_nonfinite_op  # noqa: F401
 
 __all__ = [
     "DistributeTranspiler", "DistributeTranspilerConfig",
@@ -33,4 +34,5 @@ __all__ = [
     "get_pass",
     "list_passes", "PassBuilder", "find_chain",
     "dead_var_eliminate", "const_fold", "quantize_inference",
+    "first_nonfinite_op",
 ]

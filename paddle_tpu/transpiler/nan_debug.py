@@ -1,0 +1,88 @@
+"""Debug-lowered program variant for NaN provenance (ISSUE 20).
+
+The executors run a whole program as ONE jit-compiled module, so when a
+step produces a non-finite value the step boundary is the observable
+granularity — ``check_nan_inf`` can name the first bad *fetch*, not the
+op that made it.  The reference framework's interpreter checks every
+op's outputs inline (``operator.cc:717`` under its nan/inf debug flag);
+this module recovers that granularity off the hot path: the same op
+walk ``executor.trace_program`` traces is *interpreted eagerly* — each
+op's compute function runs to a concrete value, its float outputs are
+isfinite-tested in topological (program) order, and the walk stops at
+the FIRST offending op.
+
+Used by ``monitor.health.nan_provenance`` on the guardian quarantine /
+``check_nan_inf`` raise paths: one replay of one already-quarantined
+batch, never per step.  The replay is a pure function of (feed, scope
+state, PRNG key), so it is deterministic — replaying a quarantined
+batch reproduces the identical provenance (test-enforced).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .. import registry
+from ..registry import ComputeContext
+
+__all__ = ["first_nonfinite_op"]
+
+
+def _nonfinite(v):
+    """True iff ``v`` is a floating array holding any non-finite
+    element (bf16/f8 included — jnp.isfinite has lowerings numpy
+    lacks)."""
+    dt = getattr(v, "dtype", None)
+    if dt is None or not jnp.issubdtype(dt, jnp.inexact):
+        return False
+    return not bool(jnp.isfinite(v).all())
+
+
+def first_nonfinite_op(program, feed, scope, key=None, platform=None,
+                       classify=None):
+    """Interpret ``program``'s global block op by op with concrete
+    values and return the FIRST op whose output is non-finite:
+
+    ``{"op_index", "op_type", "out_var", "layer", "in_vars"}``
+
+    — or None when every output stays finite (the corruption was
+    host-side, not produced by the graph).  ``feed`` is a name->array
+    dict; unfed op inputs load from ``scope`` like the executor's state
+    analysis; ``key`` is the step's PRNG key (same dropout masks as the
+    quarantined step); ``classify`` maps state var names to layer-class
+    labels (``monitor.health``'s probe plan) so the hit names which
+    layer is sick.  ``in_vars`` lists the op's already-non-finite
+    inputs: an op that merely *propagates* a poisoned input is
+    distinguishable from the op that created it (the first hit, by
+    construction, has no poisoned non-feed input upstream)."""
+    block = program.global_block()
+    env = {n: jnp.asarray(v) for n, v in feed.items()}
+    if key is None:
+        key = jax.random.key(program.random_seed or 0)
+    ctx = ComputeContext(key=key, platform=platform)
+    ctx.sequence_parallel = True
+    ctx.pipeline_schedule = None
+    ctx.pipeline_microbatches = None
+    ctx.program = program
+    ctx.amp = getattr(program, "_amp_policy", None)
+    classify = classify or {}
+    for i, op in enumerate(block.ops):
+        for n in op.input_arg_names:
+            if n and n not in env and scope is not None \
+                    and scope.has_var(n):
+                env[n] = jnp.asarray(scope.var(n))
+        registry.compute_op(op, env, ctx, op_index=i)
+        for out in op.output_arg_names:
+            if not out or out not in env:
+                continue
+            if _nonfinite(env[out]):
+                layer = None
+                bad_ins = []
+                for n in op.input_arg_names:
+                    if layer is None and n in classify:
+                        layer = classify[n]
+                    if n in env and _nonfinite(env[n]):
+                        bad_ins.append(n)
+                return {"op_index": i, "op_type": op.type,
+                        "out_var": out, "layer": layer,
+                        "in_vars": bad_ins}
+    return None

@@ -1,0 +1,406 @@
+"""Model-health telemetry + NaN provenance (ISSUE 20): the in-graph
+probe publishes per-layer stats without perturbing the trajectory
+(bit-parity flag on/off), the disabled path performs zero health calls,
+the guardian's quarantine sidecar names the exact first non-finite op,
+the replay is deterministic, and check_nan_inf names the offending
+variables."""
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import compile_cache, fault, guardian, monitor
+from paddle_tpu.monitor import alerts, health
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fault.clear()
+    fault.clear_injections()
+    guardian.uninstall()
+    fluid.set_flags({
+        "FLAGS_health": False,
+        "FLAGS_health_every": 10,
+        "FLAGS_guardian": False,
+        "FLAGS_guardian_policy": "skip,rollback,abort",
+        "FLAGS_check_nan_inf": False,
+    })
+    monitor.disable()
+    monitor.registry().reset()
+    monitor.step_stats().reset()
+    health._clear_for_tests()
+
+
+def _build_mlp(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = seed
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)
+        pred = fluid.layers.fc(h, size=4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n, bs=4):
+    rng = np.random.RandomState(0)
+    return [{"x": rng.rand(bs, 8).astype("float32"),
+             "label": rng.randint(0, 4, (bs, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def _run(steps=6, fetch_extra=(), **run_kw):
+    """Fresh seeded program + scope, `steps` executor steps; returns
+    the per-step loss bytes (bit-comparable) and the scope."""
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    out = []
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        exe = fluid.Executor(fluid.CPUPlace())
+        for feed in _batches(steps):
+            vals = exe.run(main, feed=feed,
+                           fetch_list=[loss] + list(fetch_extra),
+                           **run_kw)
+            out.append(np.asarray(vals[0], "float32").tobytes())
+    return out, scope
+
+
+# ---------------------------------------------------------------------------
+# tentpole: the probe publishes per-layer stats as one extra fetch
+# ---------------------------------------------------------------------------
+
+def test_probe_publishes_per_layer_stats(tmp_path):
+    monitor.enable(log_dir=str(tmp_path))
+    fluid.set_flags({"FLAGS_health": True, "FLAGS_health_every": 2})
+    _run(steps=4)
+    snap = health.last_snapshot()
+    assert snap is not None and snap["step"] == 2   # steps 0..3, cadence 2
+    layers = snap["layers"]
+    assert layers, "no layer classes published"
+    for d in layers.values():
+        assert np.isfinite(d["grad_norm"])
+        assert d["param_norm"] > 0
+        assert d["nonfinite"] == 0
+    # at least one layer actually moved (Adam update)
+    assert any(d["update_ratio"] > 0 for d in layers.values())
+    # gauges: health/<layer>/<stat> normalized to health_<layer>_<stat>
+    text = monitor.registry().expose_text()
+    label = sorted(layers)[0]
+    assert ("health_%s_grad_norm" % label) in text
+    # JSONL: model_health records at the decimated cadence (steps 2, 4)
+    recs = []
+    for f in glob.glob(str(tmp_path / "*.jsonl")):
+        with open(f) as fh:
+            recs += [json.loads(ln) for ln in fh if "model_health" in ln]
+    recs = [r for r in recs if r.get("event") == "model_health"]
+    assert [r["step"] for r in recs] == [0, 2]
+    assert recs[-1]["layers"][label]["param_norm"] > 0
+    # the compact one-liner used by abort messages / stall dumps
+    line = health.format_snapshot()
+    assert line.startswith("step 2:") and label in line
+
+
+def test_off_cadence_steps_do_not_publish():
+    fluid.set_flags({"FLAGS_health": True, "FLAGS_health_every": 100})
+    _run(steps=3)
+    # only step 0 is on-cadence; steps 1-2 never sync the stats fetch
+    assert health.last_snapshot()["step"] == 0
+    # but the replay ring still has every step (provenance readiness)
+    assert len(health._REPLAY) == 3
+
+
+# ---------------------------------------------------------------------------
+# disabled-is-free: zero health calls per step (raising monkeypatch)
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_performs_zero_health_calls(monkeypatch):
+    def _boom(*a, **k):
+        raise AssertionError("health call on the disabled path")
+    monkeypatch.setattr(health, "build_probe", _boom)
+    monkeypatch.setattr(health, "wrap_step_probe", _boom)
+    monkeypatch.setattr(health, "note_step", _boom)
+    out, _ = _run(steps=2)
+    assert len(out) == 2
+    assert health.last_snapshot() is None
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: the probe never perturbs the trajectory
+# ---------------------------------------------------------------------------
+
+def test_seeded_trajectory_bit_identical_health_on_off():
+    off, _ = _run(steps=6)
+    fluid.set_flags({"FLAGS_health": True, "FLAGS_health_every": 1})
+    on, _ = _run(steps=6)
+    assert off == on
+    fluid.set_flags({"FLAGS_health_every": 3})
+    decimated, _ = _run(steps=6)
+    assert off == decimated   # cadence is host-side only
+
+
+def test_flag_flip_rekeys_the_trace():
+    base = compile_cache.trace_flag_values()
+    fluid.set_flags({"FLAGS_health": True})
+    probed = compile_cache.trace_flag_values()
+    assert base != probed
+    # cadence is NOT trace-shaping: same key at any FLAGS_health_every
+    fluid.set_flags({"FLAGS_health_every": 7})
+    assert compile_cache.trace_flag_values() == probed
+
+
+# ---------------------------------------------------------------------------
+# NaN provenance: quarantine sidecar names the exact first bad op
+# ---------------------------------------------------------------------------
+
+def _poisoned_guardian_run(tmp_path, steps=8, poison_step=3, **gkw):
+    fluid.set_flags({"FLAGS_health": True, "FLAGS_health_every": 1,
+                     "FLAGS_guardian": True})
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    qdir = str(tmp_path / "q")
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        g = guardian.install(guardian.Guardian(quarantine_dir=qdir, **gkw))
+        # poison a PARAM: the next step's very first op (fc_0's mul)
+        # consumes it, so provenance must name that op
+        fault.inject_nan("fc_0.w_0",
+                         fault.FaultSchedule(steps=[poison_step]))
+        exe = fluid.Executor(fluid.CPUPlace())
+        err = None
+        try:
+            for feed in _batches(steps):
+                exe.run(main, feed=feed, fetch_list=[loss])
+            g.flush()
+        except guardian.GuardianAbortError as e:
+            err = e
+        stats = g.stats()
+        guardian.uninstall()
+    return qdir, stats, err
+
+
+def test_quarantine_sidecar_carries_op_provenance(tmp_path):
+    monitor.enable(log_dir=str(tmp_path / "mon"))
+    qdir, stats, _ = _poisoned_guardian_run(tmp_path)
+    assert stats["quarantined"] >= 1
+    sidecars = sorted(glob.glob(os.path.join(qdir, "*.json")))
+    assert sidecars
+    prov = json.load(open(sidecars[0]))["provenance"]
+    assert prov["found"] is True
+    assert prov["op_type"] == "mul"
+    assert prov["out_var"] == "fc_0.tmp_0"
+    assert prov["op_index"] == 0
+    assert "fc_0.w_0" in prov["in_vars"]
+    assert prov["layer"]
+    assert prov["replay_ms"] >= 0
+    # reproducibility fields: the PRNG key data rides in the record
+    assert prov["key_data"]
+    # the JSONL twin landed too
+    evs = []
+    for f in glob.glob(str(tmp_path / "mon" / "*.jsonl")):
+        with open(f) as fh:
+            evs += [json.loads(ln) for ln in fh
+                    if "guardian_nan_provenance" in ln]
+    evs = [e for e in evs if e.get("event") == "guardian_nan_provenance"]
+    assert evs and evs[0]["out_var"] == "fc_0.tmp_0"
+
+
+def test_provenance_replay_is_deterministic(tmp_path):
+    qdir, _, _ = _poisoned_guardian_run(tmp_path)
+    sidecars = sorted(glob.glob(os.path.join(qdir, "*.json")))
+    rec = json.load(open(sidecars[0]))
+    prov = rec["provenance"]
+    # replay the SAME quarantined step again from the stashed context
+    # and the guardian's quarantined feed artifact: identical attribution
+    names = rec["feed_names"]
+    with np.load(rec["path"]) as z:
+        vals = [z["arr_%d" % i] for i in range(len(names))]
+    again = health.nan_provenance(rec["step"], feed=(names, vals))
+    for k in ("op_index", "op_type", "out_var", "layer", "in_vars"):
+        assert again[k] == prov[k], k
+    third = health.nan_provenance(rec["step"], feed=(names, vals))
+    assert third["op_index"] == again["op_index"]
+    assert third["out_var"] == again["out_var"]
+
+
+def test_abort_message_carries_health_and_provenance(tmp_path):
+    _, stats, err = _poisoned_guardian_run(
+        tmp_path, policy="skip,abort", max_skips=1)
+    assert err is not None, stats
+    msg = str(err)
+    assert "[health " in msg
+    assert "grad_norm" in msg
+    assert "first non-finite op: mul -> 'fc_0.tmp_0'" in msg
+
+
+def test_guard_skip_parity_probe_on_vs_off(tmp_path):
+    """The guard watches only the user fetches (n_watch): the probe's
+    stats fetch never influences skip decisions, and the recovered
+    trajectory is bit-identical with the probe on or off."""
+    def run(on, sub):
+        fluid.set_flags({"FLAGS_health": on, "FLAGS_guardian": True})
+        main, startup, loss = _build_mlp()
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(startup)
+            g = guardian.install(guardian.Guardian(
+                quarantine_dir=str(tmp_path / sub)))
+            fault.poison_batch("x", fault.FaultSchedule(steps=[4]))
+            exe = fluid.Executor(fluid.CPUPlace())
+            out = [np.asarray(exe.run(main, feed=feed,
+                                      fetch_list=[loss])[0],
+                              "float32").tobytes()
+                   for feed in _batches(9)]
+            g.flush()
+            stats = g.stats()
+            guardian.uninstall()
+        fault.clear_injections()
+        return out, stats
+
+    off_losses, off_stats = run(False, "q_off")
+    on_losses, on_stats = run(True, "q_on")
+    assert off_losses == on_losses
+    assert off_stats["skipped_steps"] == on_stats["skipped_steps"] == 1
+
+
+# ---------------------------------------------------------------------------
+# parallel executor: same probe, same parity (8 virtual CPU devices)
+# ---------------------------------------------------------------------------
+
+def _pe_run(steps=4, bs=16):
+    main, startup, loss = _build_mlp()
+    rng = np.random.RandomState(0)
+    out = []
+    with fluid.scope_guard(fluid.Scope()), \
+            fluid.program_guard(main, startup):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        pe = fluid.ParallelExecutor(loss_name=loss.name)
+        for _ in range(steps):
+            feed = {"x": rng.rand(bs, 8).astype("float32"),
+                    "label": rng.randint(0, 4, (bs, 1)).astype("int64")}
+            (lv,) = pe.run(feed=feed, fetch_list=[loss])
+            out.append(np.asarray(lv, "float32").tobytes())
+    return out
+
+
+def test_parallel_executor_probe_publishes_and_keeps_parity():
+    off = _pe_run()
+    fluid.set_flags({"FLAGS_health": True, "FLAGS_health_every": 1})
+    on = _pe_run()
+    assert off == on
+    snap = health.last_snapshot()
+    assert snap["executor"] == "parallel_executor"
+    assert snap["layers"]
+    assert all(np.isfinite(d["grad_norm"])
+               for d in snap["layers"].values())
+
+
+# ---------------------------------------------------------------------------
+# check_nan_inf names the first bad variable (+ summary of the rest)
+# ---------------------------------------------------------------------------
+
+def test_check_nan_inf_names_first_and_remaining_vars():
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.unique_name.guard(), fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8])
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        fault.inject_nan("fc_0.w_0", fault.FaultSchedule(steps=[1]))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(RuntimeError) as ei:
+            for feed in _batches(4):
+                exe.run(main, feed=feed, fetch_list=[loss, pred])
+    msg = str(ei.value)
+    assert "check_nan_inf: variable " in msg
+    assert "contains nan" in msg
+    assert "more non-finite" in msg   # both fetches went bad, one named
+
+
+def test_check_nan_inf_gains_provenance_when_probed():
+    fluid.set_flags({"FLAGS_check_nan_inf": True, "FLAGS_health": True})
+    main, startup, loss = _build_mlp()
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+        fault.inject_nan("fc_0.w_0", fault.FaultSchedule(steps=[1]))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(RuntimeError) as ei:
+            for feed in _batches(4):
+                exe.run(main, feed=feed, fetch_list=[loss])
+    assert "first non-finite op: mul -> 'fc_0.tmp_0'" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# satellites: stall dumps, fleet summary + alert rules, report tool
+# ---------------------------------------------------------------------------
+
+def test_stall_probe_includes_last_health_snapshot():
+    fluid.set_flags({"FLAGS_health": True, "FLAGS_health_every": 1})
+    _run(steps=2)
+    probe = monitor._stall_probe()
+    assert probe["health"] is not None
+    assert probe["health"]["layers"]
+
+
+def test_health_alert_rules_fire_on_synthetic_view():
+    rules = {r.name: r for r in alerts.default_rules()}
+    assert "grad_norm_explosion" in rules
+    assert "update_ratio_collapse" in rules
+    view = {"hosts": {
+        "h0": {"health": {"grad_norm_max": 5e6,
+                          "update_ratio_min": 1e-9,
+                          "nonfinite_total": 3}},
+        "h1": {"health": {"grad_norm_max": 2.0,
+                          "update_ratio_min": 1e-3,
+                          "nonfinite_total": 0}},
+        "h2": {}}}
+    assert rules["grad_norm_explosion"].resolve(view) == {
+        "h0": 5e6, "h1": 2.0}
+    eng = alerts.AlertEngine([rules["grad_norm_explosion"],
+                              rules["update_ratio_collapse"]])
+    evs = eng.evaluate(view, now=100.0)
+    fired = {(e["rule"], e["member_id"]) for e in evs
+             if e["state"] == "firing"}
+    assert ("grad_norm_explosion", "h0") in fired
+    assert ("update_ratio_collapse", "h0") in fired
+    assert not any(k == "h1" for _, k in fired)
+
+
+def test_health_report_tool_renders_table(tmp_path):
+    monitor.enable(log_dir=str(tmp_path))
+    _, _, _ = _poisoned_guardian_run(tmp_path)
+    monitor.disable()
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import health_report
+        from program_report import load_records
+    finally:
+        sys.path.pop(0)
+    recs = load_records(str(tmp_path))
+    report = health_report.health_from_records(recs)
+    assert report["layers"]
+    assert report["provenance"]
+    assert report["provenance"][0]["out_var"] == "fc_0.tmp_0"
+    text = health_report.render_table(report)
+    assert "grad_norm" in text
+    assert "nan provenance" in text
+    assert "fc_0.tmp_0" in text

@@ -78,7 +78,10 @@ INFORMATIONAL_FIELDS = frozenset({"p99_queue_wait_ms",
                                   "aggregate_rps",
                                   "reroute_latency_ms",
                                   "digest_build_us",
-                                  "straggler_detect_windows"})
+                                  "straggler_detect_windows",
+                                  "health_overhead_pct_c1",
+                                  "health_overhead_pct_c10",
+                                  "provenance_replay_ms"})
 
 FIELDS = (("min_step_s", "lower", "step_s"),
           ("value", "higher", "value"),
@@ -99,7 +102,14 @@ FIELDS = (("min_step_s", "lower", "step_s"),
           ("aggregate_rps", "higher", "agg_rps"),
           ("reroute_latency_ms", "lower", "rerte"),
           ("digest_build_us", "lower", "dig_us"),
-          ("straggler_detect_windows", "lower", "strag_w"))
+          ("straggler_detect_windows", "lower", "strag_w"),
+          # ISSUE-20 model-health probe: FLAGS_health step overhead at
+          # publication cadence 1 / 10 and the one-shot NaN-provenance
+          # replay latency — informational (CPU wall clock), indexed so
+          # probe-cost regressions surface across rounds
+          ("health_overhead_pct_c1", "lower", "hlth_c1"),
+          ("health_overhead_pct_c10", "lower", "hlth_c10"),
+          ("provenance_replay_ms", "lower", "prov_ms"))
 
 
 def _rung_record(r):
@@ -124,7 +134,9 @@ def _rung_record(r):
               "spec_tok_s", "prefix_hit_rate",
               "p99_queue_wait_ms", "p99_decode_ms",
               "aggregate_rps", "reroute_latency_ms",
-              "digest_build_us", "straggler_detect_windows"):
+              "digest_build_us", "straggler_detect_windows",
+              "health_overhead_pct_c1", "health_overhead_pct_c10",
+              "provenance_replay_ms"):
         if r.get(f) is not None:
             out[f] = r[f]
     gp = r.get("goodput")

@@ -1,0 +1,146 @@
+"""Model-health report — per-layer gradient/update statistics and NaN
+provenance from a monitor JSONL log.
+
+Renders the ``model_health`` records the FLAGS_health probe publishes
+(per layer class: gradient L2 norm, parameter L2 norm, update/param
+ratio, non-finite element count) as a per-layer table — latest value,
+max gradient norm over the run, and the step it peaked at — plus every
+``guardian_nan_provenance`` event (the op-level attribution of a
+non-finite step: first offending op, its output var, layer class,
+replay latency).  The offline twin of watching the ``health/<layer>/*``
+gauges live.
+
+Usage:
+    python tools/health_report.py /path/to/monitor_logs        # dir
+    python tools/health_report.py monitor-1234.jsonl --json
+    python tools/health_report.py logs/ --run_id 6a711a1e-7060
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_TOOLS_DIR))   # repo root: paddle_tpu
+sys.path.insert(0, _TOOLS_DIR)                    # sibling tools
+
+from program_report import load_records  # noqa: E402  (same tools dir)
+
+_STATS = ("grad_norm", "param_norm", "update_ratio", "nonfinite")
+
+
+def health_from_records(records, run_id=None):
+    """Replay JSONL records into the report model: per-layer rows (the
+    LAST ``model_health`` record's values + per-run peaks) and the list
+    of provenance events, in step order.  ``run_id`` filters to one
+    run's records (a shared log dir holds many)."""
+    layers = {}      # label -> row dict
+    provenance = []
+    steps_seen = 0
+    last_step = None
+    for r in records:
+        if not isinstance(r, dict):
+            continue
+        if run_id and r.get("run_id") not in (None, run_id):
+            continue
+        ev = r.get("event")
+        if ev == "model_health" and isinstance(r.get("layers"), dict):
+            steps_seen += 1
+            last_step = r.get("step", last_step)
+            for label, d in r["layers"].items():
+                row = layers.setdefault(label, {
+                    "layer": label, "grad_norm_peak": 0.0,
+                    "grad_norm_peak_step": None, "nonfinite_total": 0})
+                for k in _STATS:
+                    if d.get(k) is not None:
+                        row[k] = d[k]
+                gn = d.get("grad_norm")
+                if gn is not None and gn >= row["grad_norm_peak"]:
+                    row["grad_norm_peak"] = gn
+                    row["grad_norm_peak_step"] = r.get("step")
+                row["nonfinite_total"] += int(d.get("nonfinite") or 0)
+        elif ev == "guardian_nan_provenance":
+            provenance.append(r)
+    provenance.sort(key=lambda r: (r.get("step") or 0))
+    return {
+        "records": steps_seen,
+        "last_step": last_step,
+        "layers": [layers[k] for k in sorted(layers)],
+        "provenance": provenance,
+    }
+
+
+def render_table(report):
+    """The human-facing tables (one string)."""
+    lines = []
+    rows = report["layers"]
+    if not rows:
+        lines.append("no model_health records found "
+                     "(run with FLAGS_health=1 and the monitor on)")
+    else:
+        lines.append("model health — %d records, last step %s"
+                     % (report["records"], report["last_step"]))
+        hdr = ("layer", "grad_norm", "param_norm", "update_ratio",
+               "nonfinite", "peak grad_norm", "@step")
+        table = [hdr]
+        for r in rows:
+            table.append((
+                r["layer"],
+                "%.4g" % r.get("grad_norm", float("nan")),
+                "%.4g" % r.get("param_norm", float("nan")),
+                "%.4g" % r.get("update_ratio", float("nan")),
+                "%d" % r.get("nonfinite_total", 0),
+                "%.4g" % r["grad_norm_peak"],
+                str(r["grad_norm_peak_step"]),
+            ))
+        widths = [max(len(row[i]) for row in table)
+                  for i in range(len(hdr))]
+        for i, row in enumerate(table):
+            lines.append("  ".join(c.ljust(w)
+                                   for c, w in zip(row, widths)).rstrip())
+            if i == 0:
+                lines.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    prov = report["provenance"]
+    if prov:
+        lines.append("")
+        lines.append("nan provenance (%d event%s):"
+                     % (len(prov), "" if len(prov) == 1 else "s"))
+        for p in prov:
+            if p.get("found"):
+                lines.append(
+                    "  step %s: %s -> %r (op #%s%s) replay %.3g ms"
+                    % (p.get("step"), p.get("op_type"),
+                       p.get("out_var"), p.get("op_index"),
+                       ", layer %s" % p["layer"] if p.get("layer")
+                       else "", p.get("replay_ms") or 0.0))
+            else:
+                lines.append(
+                    "  step %s: replay stayed finite%s"
+                    % (p.get("step"),
+                       " (error: %s)" % p["error"] if p.get("error")
+                       else " — host-side corruption?"))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Per-layer model-health + NaN-provenance report "
+                    "from monitor JSONL logs")
+    ap.add_argument("path", help="monitor .jsonl file or log directory")
+    ap.add_argument("--run_id", default=None,
+                    help="filter to one run's records")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report dict as JSON")
+    args = ap.parse_args(argv)
+    report = health_from_records(load_records(args.path),
+                                 run_id=args.run_id)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(render_table(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

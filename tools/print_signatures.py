@@ -44,6 +44,7 @@ MODULES = [
     "paddle_tpu.monitor.tracing",
     "paddle_tpu.monitor.aggregate",
     "paddle_tpu.monitor.alerts",
+    "paddle_tpu.monitor.health",
     "paddle_tpu.debugger",
     "paddle_tpu.recordio",
     "paddle_tpu.reader",

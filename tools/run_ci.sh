@@ -5,22 +5,22 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "== 1/19 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
+echo "== 1/20 test suite (tier-1 gate: -m 'not slow'; run the slow set =="
 echo "==     explicitly with: python -m pytest tests/ -m slow)        =="
 python -m pytest tests/ -q -m 'not slow'
 
-echo "== 2/19 API signature gate =="
+echo "== 2/20 API signature gate =="
 python tools/print_signatures.py > /tmp/api_live.txt
 python tools/diff_api.py tools/api_signatures.txt /tmp/api_live.txt
 
-echo "== 3/19 8-device virtual-mesh dryrun =="
+echo "== 3/20 8-device virtual-mesh dryrun =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "== 4/19 bench smoke (CPU backend, tiny) =="
+echo "== 4/20 bench smoke (CPU backend, tiny) =="
 python bench.py --model mlp --device cpu --iterations 5 --skip_batch_num 1
 
-echo "== 5/19 observability tooling smoke (program_report + trace_summary) =="
+echo "== 5/20 observability tooling smoke (program_report + trace_summary) =="
 OBS_DIR=$(mktemp -d)
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR"' EXIT
@@ -47,7 +47,7 @@ PY
 python tools/program_report.py "$OBS_DIR" --top 5
 python tools/trace_summary.py "$OBS_DIR/trace.json" --top 10 --sorted_key calls
 
-echo "== 6/19 preemption smoke (SIGTERM a monitored run -> exact resume) =="
+echo "== 6/20 preemption smoke (SIGTERM a monitored run -> exact resume) =="
 cat > "$SMOKE_DIR/smoke.py" <<'PY'
 import os, signal, sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -115,7 +115,7 @@ diff <(grep "^STEP [456] " "$SMOKE_DIR/ref.out") \
      <(grep "^STEP [456] " "$SMOKE_DIR/resume.out")
 grep -ql checkpoint_saved "$SMOKE_DIR"/monitor/*.jsonl
 
-echo "== 7/19 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
+echo "== 7/20 fsdp mesh smoke (4 virtual devices, sharding_rules) =="
 FSDP_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR"' EXIT
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
@@ -170,7 +170,7 @@ PY
 python tools/program_report.py "$FSDP_DIR" --top 3 | tee "$FSDP_DIR/report.txt"
 grep -q "parallel_e" "$FSDP_DIR/report.txt"
 
-echo "== 8/19 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
+echo "== 8/20 guardian smoke (NaN injected at step 5 -> rollback -> finite) =="
 GUARD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR"' EXIT
 # the drill is installed purely from the environment (FLAGS_fault_spec)
@@ -227,7 +227,7 @@ PY
 grep -ql fault_injected "$GUARD_DIR"/monitor/*.jsonl
 grep -ql guardian_rollback "$GUARD_DIR"/monitor/*.jsonl
 
-echo "== 9/19 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
+echo "== 9/20 autotune smoke (tune toy MLP -> artifact -> report -> Trainer) =="
 TUNE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$TUNE_DIR" <<'PY'
@@ -323,7 +323,7 @@ print("AUTOTUNE TRAINER FINAL %.6f over %d steps"
       % (losses[-1], len(losses)), flush=True)
 PY
 
-echo "== 10/19 goodput smoke + bench-history regression gate =="
+echo "== 10/20 goodput smoke + bench-history regression gate =="
 GOOD_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR"' EXIT
 # (a) a 3-step monitored MLP run -> the goodput ledger attributes its
@@ -383,7 +383,7 @@ assert any(c["field"] == "min_step_s" and c["verdict"] == "REGRESSED"
 print("bench_history: +20% perturbation flagged REGRESSED")
 PY
 
-echo "== 11/19 serving smoke (engine over toy MLP, concurrent requests) =="
+echo "== 11/20 serving smoke (engine over toy MLP, concurrent requests) =="
 SERVE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'PY'
@@ -438,7 +438,7 @@ PY
 # per-request serving/* events landed in the JSONL, run_id-correlated
 grep -ql serving_request "$SERVE_DIR"/monitor/*.jsonl
 
-echo "== 12/19 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
+echo "== 12/20 pipeline schedules smoke (2 virtual devices: 1F1B/interleaved =="
 echo "==       loss parity vs GPipe + measured pipeline_bubble drop)        =="
 PIPE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR"' EXIT
@@ -513,7 +513,7 @@ PY
 # the pipeline_bubble bucket landed in the goodput JSONL stamps
 grep -ql pipeline_bubble "$PIPE_DIR"/*.jsonl
 
-echo "== 13/19 cluster elastic-resume drill (2 members, SIGKILL one mid-run) =="
+echo "== 13/20 cluster elastic-resume drill (2 members, SIGKILL one mid-run) =="
 CLUSTER_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR"' EXIT
 # the supervisor runs the whole acceptance drill: an uninterrupted
@@ -539,7 +539,7 @@ print("CKPT_SHARDED per-host wall %.3fs, bytes/N %s, MB/s spread %.2f"
       % (r["save_wall_s"], r["bytes_one_over_n"], r["mb_per_s_spread"]))
 PY
 
-echo "== 14/19 quantized inference smoke (pass -> gate -> save -> serving) =="
+echo "== 14/20 quantized inference smoke (pass -> gate -> save -> serving) =="
 QUANT_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR"' EXIT
 # end-to-end int8: accuracy-gated tune_quantization over a toy inference
@@ -605,7 +605,7 @@ PY
 grep -ql '"knob": "quantization"' "$QUANT_DIR"/monitor/*.jsonl || \
   grep -ql quantization "$QUANT_DIR"/monitor/*.jsonl
 
-echo "== 15/19 sparse-embedding smoke (ctr_dnn is_sparse + incremental =="
+echo "== 15/20 sparse-embedding smoke (ctr_dnn is_sparse + incremental =="
 echo "==       checkpoints: SIGTERM flush -> base+delta resume bit-identical) =="
 SPARSE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR" "$SPARSE_DIR"' EXIT
@@ -699,7 +699,7 @@ diff <(grep "^STEP [456] " "$SPARSE_DIR/ref.out") \
 # touched-row telemetry rode the per-step JSONL records
 grep -ql sparse_touched_rows "$SPARSE_DIR"/monitor/*.jsonl
 
-echo "== 16/19 paged-KV + speculative decode smoke (prefix reuse, =="
+echo "== 16/20 paged-KV + speculative decode smoke (prefix reuse, =="
 echo "==       spec==greedy parity, page-leak-free teardown)      =="
 PAGED_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR" "$SPARSE_DIR" "$PAGED_DIR"' EXIT
@@ -757,7 +757,7 @@ PY
 # the paged/speculation counters rode the run_id-stamped JSONL
 grep -ql prefix_hits "$PAGED_DIR"/monitor/*.jsonl
 
-echo "== 17/19 traced serving smoke (request trace trees from JSONL) =="
+echo "== 17/20 traced serving smoke (request trace trees from JSONL) =="
 TRACE_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR" "$SPARSE_DIR" "$PAGED_DIR" "$TRACE_DIR"' EXIT
 JAX_PLATFORMS=cpu python - "$TRACE_DIR/monitor" <<'PY'
@@ -797,7 +797,7 @@ PY
 # breakdown table printed from the same JSONL the run wrote
 python tools/request_trace.py "$TRACE_DIR"/monitor --assert-complete 0.99
 
-echo "== 18/19 serving-fleet failover smoke (2 replicas, SIGKILL one =="
+echo "== 18/20 serving-fleet failover smoke (2 replicas, SIGKILL one =="
 echo "==      under load -> zero lost requests, re-routed completes) =="
 FLEET_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR" "$SPARSE_DIR" "$PAGED_DIR" "$TRACE_DIR" "$FLEET_DIR"' EXIT
@@ -811,7 +811,7 @@ JAX_PLATFORMS=cpu python tests/fleet_runner.py supervise "$FLEET_DIR" 2 24
 # ACROSS the SIGKILL (rpc-server spans open-anchor on entry)
 python tools/request_trace.py "$FLEET_DIR"/monitor --assert-complete 0.99
 
-echo "== 19/19 fleet telemetry drill (3 members, digests over heartbeat, =="
+echo "== 19/20 fleet telemetry drill (3 members, digests over heartbeat, =="
 echo "==      delay_dispatch straggler -> alert fires + resolves) =="
 TELEM_DIR=$(mktemp -d)
 trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR" "$SPARSE_DIR" "$PAGED_DIR" "$TRACE_DIR" "$FLEET_DIR" "$TELEM_DIR"' EXIT
@@ -824,5 +824,75 @@ trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD
 JAX_PLATFORMS=cpu python tests/fleet_telemetry_runner.py supervise "$TELEM_DIR" 3
 # the operator pane renders from the same master JSONL (replay path)
 python tools/fleet_report.py "$TELEM_DIR"/master
+
+echo "== 20/20 model-health + NaN-provenance drill (fault nan at a named =="
+echo "==      param -> guardian quarantines -> provenance names the op)  =="
+HEALTH_DIR=$(mktemp -d)
+trap 'rm -rf "$OBS_DIR" "$SMOKE_DIR" "$FSDP_DIR" "$GUARD_DIR" "$TUNE_DIR" "$GOOD_DIR" "$SERVE_DIR" "$PIPE_DIR" "$CLUSTER_DIR" "$QUANT_DIR" "$SPARSE_DIR" "$PAGED_DIR" "$TRACE_DIR" "$FLEET_DIR" "$TELEM_DIR" "$HEALTH_DIR"' EXIT
+# drill installed purely from the environment: FLAGS_health turns the
+# in-graph probe on, FLAGS_fault_spec poisons fc_0.w_0 after step 5, so
+# step 6's first consumer of that param (mul -> fc_0.tmp_0) goes
+# non-finite — the provenance record must name exactly that op
+JAX_PLATFORMS=cpu \
+FLAGS_health=1 FLAGS_health_every=2 \
+FLAGS_guardian=1 FLAGS_guardian_policy=skip,abort \
+FLAGS_fault_spec='nan_var:fc_0.w_0@5' \
+  python - "$HEALTH_DIR" <<'PY'
+import glob, json, os, sys
+sys.path.insert(0, os.getcwd())
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import guardian, monitor
+
+out = sys.argv[1]
+monitor.enable(log_dir=os.path.join(out, "monitor"))
+fluid.default_main_program().random_seed = 7
+fluid.default_startup_program().random_seed = 7
+x = fluid.layers.data("x", shape=[8])
+label = fluid.layers.data("label", shape=[1], dtype="int64")
+h = fluid.layers.fc(x, size=16, act="relu")
+pred = fluid.layers.fc(h, size=4, act="softmax")
+loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+g = guardian.install(guardian.Guardian(
+    quarantine_dir=os.path.join(out, "quarantine")))
+exe = fluid.Executor(fluid.CPUPlace())
+exe.run(fluid.default_startup_program())
+rng = np.random.RandomState(0)
+aborted = None
+try:
+    for step in range(10):
+        exe.run(feed={"x": rng.rand(4, 8).astype("float32"),
+                      "label": rng.randint(0, 4, (4, 1)).astype("int64")},
+                fetch_list=[loss])
+    g.flush()
+except guardian.GuardianAbortError as e:
+    aborted = str(e)
+stats = g.stats()
+guardian.uninstall()
+assert stats["quarantined"] >= 1, stats
+# the sidecar carries the op-level attribution of the poisoned param
+sidecars = sorted(glob.glob(os.path.join(out, "quarantine", "*.json")))
+assert sidecars, "no quarantine sidecar written"
+prov = json.load(open(sidecars[0])).get("provenance")
+assert prov and prov["found"], prov
+assert prov["out_var"] == "fc_0.tmp_0", prov
+assert "fc_0.w_0" in prov["in_vars"], prov
+# an abort (skip budget) must carry the per-layer health snapshot
+if aborted is not None:
+    assert "health" in aborted, aborted
+print("HEALTH DRILL OK: %s -> %r (op #%d, layer %s)"
+      % (prov["op_type"], prov["out_var"], prov["op_index"],
+         prov.get("layer")), flush=True)
+monitor.disable()
+PY
+# the provenance event and the per-layer health records landed in the
+# JSONL, and the offline report renders both
+grep -ql guardian_nan_provenance "$HEALTH_DIR"/monitor/*.jsonl
+grep -ql model_health "$HEALTH_DIR"/monitor/*.jsonl
+python tools/health_report.py "$HEALTH_DIR/monitor" \
+  | tee "$HEALTH_DIR/report.txt"
+grep -q "grad_norm" "$HEALTH_DIR/report.txt"
+grep -q "nan provenance" "$HEALTH_DIR/report.txt"
 
 echo "CI OK"
